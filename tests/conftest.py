@@ -1,0 +1,21 @@
+"""Test config: force an 8-device virtual CPU mesh before jax import.
+
+Mirrors the reference's single-local-Spark-session test harness
+(utils/.../test/TestSparkContext.scala:46 `master=local[2]`): distribution is
+validated on emulated devices, matching how the driver dry-runs the
+multi-chip path (xla_force_host_platform_device_count).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,124 @@
+"""Feature DAG + stage base contract tests.
+
+Mirrors the reference contract suites: OpPipelineStageSpec (naming/copy),
+OpTransformerSpec (row-level == columnar), FeatureLike graph ops.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    Binary, Dataset, Feature, FeatureBuilder, JaxTransformer, LambdaTransformer,
+    PickList, Real, RealNN, Text, unary_transformer,
+)
+from transmogrifai_tpu.data.dataset import column_from_values
+
+
+def _toy_features():
+    age = FeatureBuilder.Real("age").extract(lambda r: r.get("age")).as_predictor()
+    sex = FeatureBuilder.PickList("sex").extract(lambda r: r.get("sex")).as_predictor()
+    y = FeatureBuilder.RealNN("label").extract(lambda r: float(r["label"])).as_response()
+    return age, sex, y
+
+
+def test_feature_builder_basics():
+    age, sex, y = _toy_features()
+    assert age.name == "age" and age.feature_type is Real and not age.is_response
+    assert sex.feature_type is PickList
+    assert y.is_response and y.feature_type is RealNN
+    assert age.is_raw
+    assert age.origin_stage.extract({"age": 31}) == 31.0
+
+
+def test_transform_with_builds_dag():
+    age, sex, y = _toy_features()
+    doubler = JaxTransformer("double", fn=lambda x: x * 2.0,
+                             input_types=(Real,), output_type=Real)
+    age2 = age.transform_with(doubler)
+    assert age2.parents == (age,)
+    assert age2.origin_stage is doubler
+    assert not age2.is_raw
+    assert age2.feature_type is Real
+    assert "double" in age2.name
+    # response propagation
+    lab2 = y.transform_with(JaxTransformer("noop", fn=lambda x: x,
+                                           input_types=(RealNN,), output_type=RealNN))
+    assert lab2.is_response
+
+
+def test_parent_stages_and_history():
+    age, sex, y = _toy_features()
+    s1 = JaxTransformer("p1", fn=lambda x: x + 1, input_types=(Real,), output_type=Real)
+    s2 = JaxTransformer("p2", fn=lambda x: x * 3, input_types=(Real,), output_type=Real)
+    f2 = age.transform_with(s1).transform_with(s2)
+    dists = f2.parent_stages()
+    assert dists[s2] == 0 and dists[s1] == 1
+    h = f2.history()
+    assert h.origin_features == ("age",)
+    assert len(f2.raw_features()) == 1
+
+
+def test_lambda_transformer_row_equals_columnar():
+    ds = Dataset.from_features([("t", Text, ["a", "bb", None, "cccc"])])
+    lengther = unary_transformer(
+        "len", lambda v: None if v.is_empty else float(len(v.value)), Text, Real)
+    txt = FeatureBuilder.Text("t").as_predictor()
+    out_feat = txt.transform_with(lengther)
+    out = lengther.transform(ds)
+    got = out.data(out_feat.name)
+    assert np.isnan(got[2])
+    assert list(got[[0, 1, 3]]) == [1.0, 2.0, 4.0]
+    # row-level protocol matches
+    assert lengther.transform_keyvalue({"t": "bb"}) == 2.0
+    assert lengther.transform_keyvalue({"t": None}) is None
+
+
+def test_jax_transformer_columnar_and_rowwise_agree():
+    ds = Dataset.from_features([("x", Real, [1.0, 2.0, None, 4.0])])
+    sq = JaxTransformer("sq", fn=lambda x: x * x, input_types=(Real,), output_type=Real)
+    x = FeatureBuilder.Real("x").as_predictor()
+    sq.set_input(x)
+    col = sq.transform_columns(ds.column("x"))
+    assert list(col.data[[0, 1, 3]]) == [1.0, 4.0, 16.0]
+    assert np.isnan(col.data[2])
+    assert sq.transform_value(Real(3.0)).value == 9.0
+    assert sq.transform_value(Real(None)).is_empty
+
+
+def test_stage_copy_preserves_params():
+    sq = JaxTransformer("sq", fn=lambda x: x * x, input_types=(Real,), output_type=Real)
+    c = sq.copy()
+    assert c.uid != sq.uid
+    assert c.operation_name == "sq"
+
+
+def test_type_checking():
+    age, sex, y = _toy_features()
+    sq = JaxTransformer("sq", fn=lambda x: x, input_types=(Real,), output_type=Real)
+    with pytest.raises(TypeError):
+        sq.set_input(sex)  # PickList is not Real
+
+
+def test_from_rows_inference():
+    rows = [
+        {"age": 31.0, "sex": "m", "n": 3, "flag": True, "label": 1},
+        {"age": None, "sex": "f", "n": 5, "flag": False, "label": 0},
+    ]
+    y, feats = FeatureBuilder.from_rows(rows, response="label")
+    by_name = {f.name: f for f in feats}
+    assert by_name["age"].feature_type.__name__ == "Real"
+    assert by_name["sex"].feature_type.__name__ == "PickList"
+    assert by_name["n"].feature_type.__name__ == "Integral"
+    assert by_name["flag"].feature_type.__name__ == "Binary"
+    assert y.feature_type.__name__ == "RealNN" and y.is_response
+
+
+def test_dataset_ops():
+    ds = Dataset.from_features([
+        ("x", Real, [1.0, None, 3.0]),
+        ("s", Text, ["a", None, "c"]),
+    ])
+    assert ds.n_rows == 3
+    assert set(ds.column_names()) == {"x", "s"}
+    sub = ds.take(np.array([0, 2]))
+    assert sub.n_rows == 2 and sub.data("s")[1] == "c"
+    assert ds.select(["x"]).column_names() == ["x"]

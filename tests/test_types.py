@@ -1,0 +1,107 @@
+"""Feature type hierarchy tests (reference FeatureTypeTest and friends)."""
+import math
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import types as t
+
+
+def test_real_empty_and_value():
+    assert t.Real(None).is_empty
+    assert t.Real(float("nan")).is_empty
+    assert t.Real(3).value == 3.0
+    assert t.Real(3.5).non_empty
+
+
+def test_realnn_non_nullable():
+    assert t.RealNN(1.0).value == 1.0
+    with pytest.raises(ValueError):
+        t.RealNN(None)
+
+
+def test_binary_coercion():
+    assert t.Binary(1).value is True
+    assert t.Binary(0.0).value is False
+    assert t.Binary(None).is_empty
+    assert t.Binary(True).to_double() == 1.0
+
+
+def test_integral_from_float():
+    assert t.Integral(3.0).value == 3
+    assert t.Integral(None).is_empty
+
+
+def test_text_and_subtypes():
+    assert t.Text("hi").value == "hi"
+    assert t.Text("").is_empty
+    assert t.Text(None).is_empty
+    e = t.Email("ada@lovelace.org")
+    assert e.prefix() == "ada" and e.domain() == "lovelace.org"
+    assert t.Email("notanemail").domain() is None
+    u = t.URL("https://x.org/a")
+    assert u.domain() == "x.org" and u.protocol() == "https" and u.is_valid()
+    assert not t.URL("garbage").is_valid()
+    assert issubclass(t.PickList, t.Categorical)
+    assert issubclass(t.PickList, t.Text)
+
+
+def test_lists_sets_geo():
+    assert t.TextList(["a", "b"]).value == ["a", "b"]
+    assert t.TextList(None).is_empty
+    assert len(t.MultiPickList({"x", "y"})) == 2
+    g = t.Geolocation([37.4, -122.1, 5.0])
+    assert g.lat == 37.4 and g.lon == -122.1 and g.accuracy == 5.0
+    x, y, z = g.to_unit_sphere()
+    assert math.isclose(x * x + y * y + z * z, 1.0, rel_tol=1e-9)
+    with pytest.raises(ValueError):
+        t.Geolocation([99.0, 0.0, 1.0])  # lat out of range
+    assert t.Geolocation(None).is_empty
+
+
+def test_opvector():
+    v = t.OPVector([1.0, 2.0, 3.0])
+    assert len(v) == 3
+    w = v.combine(t.OPVector([4.0]))
+    assert len(w) == 4
+    assert t.OPVector(None).is_empty
+    assert t.OPVector([1.0, 2.0]) == t.OPVector(np.array([1.0, 2.0]))
+
+
+def test_maps():
+    m = t.RealMap({"a": 1, "b": 2.5})
+    assert m["a"] == 1.0 and m.get("b") == 2.5
+    assert t.RealMap(None).is_empty
+    b = t.BinaryMap({"k": 1})
+    assert b.to_double_map() == {"k": 1.0}
+    mp = t.MultiPickListMap({"k": ["x", "x", "y"]})
+    assert mp["k"] == {"x", "y"}
+    gm = t.GeolocationMap({"home": [1.0, 2.0, 3.0]})
+    assert gm["home"] == [1.0, 2.0, 3.0]
+
+
+def test_prediction():
+    p = t.Prediction(prediction=1.0, raw_prediction=[0.2, 0.8],
+                     probability=[0.3, 0.7])
+    assert p.prediction == 1.0
+    assert p.raw_prediction == [0.2, 0.8]
+    assert p.probability == [0.3, 0.7]
+    assert p.score == [0.3, 0.7]
+    assert t.Prediction(prediction=2.0).score == [2.0]
+    with pytest.raises(ValueError):
+        t.Prediction({"nope": 1.0})
+
+
+def test_type_registry():
+    assert t.FeatureType.from_name("Real") is t.Real
+    assert t.FeatureType.from_name("PickListMap") is t.PickListMap
+    assert t.Real.is_subtype_of(t.OPNumeric)
+    with pytest.raises(ValueError):
+        t.FeatureType.from_name("Nope")
+
+
+def test_defaults():
+    assert t.default_of(t.Real).is_empty
+    assert t.default_of(t.RealNN).value == 0.0
+    assert t.default_of(t.Prediction).prediction == 0.0
+    assert t.default_of(t.TextMap).is_empty

@@ -1,0 +1,36 @@
+"""TransmogrifAI-TPU: a TPU-native AutoML framework for structured data.
+
+A ground-up rebuild of the capabilities of TransmogrifAI (Salesforce's
+Scala/Spark AutoML library) designed for TPUs: typed feature pipelines compile
+to XLA programs, automated feature engineering/validation run as device
+reductions over an HBM-resident feature matrix, and the model-selection
+cross-validation sweep runs as vmapped/sharded JAX programs over a device
+mesh (batch x fold x grid axes) instead of a Spark cluster.
+
+Public API mirrors the reference's (OpWorkflow, FeatureBuilder,
+Transmogrifier, SanityChecker, ModelSelectors, evaluators) so a reference
+user can switch with minimal relearning.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import types
+from .types import *  # noqa: F401,F403 — feature type hierarchy
+from .features.feature import Feature, FeatureHandle, FeatureHistory
+from .features.builder import FeatureBuilder, infer_feature_type
+from .features.generator import FeatureGeneratorStage
+from .stages.base import (
+    Estimator,
+    JaxTransformer,
+    LambdaTransformer,
+    PipelineStage,
+    Transformer,
+    binary_transformer,
+    unary_transformer,
+)
+from .stages.params import Param, ParamMap, param_grid
+from .data.dataset import Column, Dataset, column_from_values
+from .data.vector import VectorColumnMetadata, VectorMetadata
+
+__all__ = [n for n in dir() if not n.startswith("_")]

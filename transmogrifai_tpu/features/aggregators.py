@@ -1,0 +1,143 @@
+"""Monoid aggregators for event aggregation in readers.
+
+Reference: features/.../aggregators/ (MonoidAggregatorDefaults.scala:41,
+TimeBasedAggregator, per-type aggregators) built on algebird. Here: plain
+(zero, plus, present) triples per feature type, applied host-side by the
+aggregate readers when collapsing many events per key into one row.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Type
+
+from ..types import (
+    Binary, Currency, Date, DateList, DateTime, FeatureType, Geolocation,
+    Integral, MultiPickList, OPList, OPMap, OPNumeric, OPSet, Percent,
+    Real, RealNN, Text, TextList,
+)
+
+
+@dataclass
+class MonoidAggregator:
+    """zero + associative plus over raw values (None = empty)."""
+
+    zero: Callable[[], Any]
+    plus: Callable[[Any, Any], Any]
+
+    def reduce(self, values) -> Any:
+        acc = self.zero()
+        for v in values:
+            acc = self.plus(acc, v)
+        return acc
+
+
+def _sum_option(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a + b
+
+
+def _union_list(a, b):
+    return (a or []) + (b or [])
+
+
+def _union_set(a, b):
+    return (a or set()) | (b or set())
+
+
+def _union_map_last(a, b):
+    out = dict(a or {})
+    out.update(b or {})
+    return out
+
+
+def _logical_or(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a or b
+
+
+def _min_option(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return min(a, b)
+
+
+def _max_option(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return max(a, b)
+
+
+class MonoidAggregatorDefaults:
+    """Default aggregator per feature type (reference
+    MonoidAggregatorDefaults.scala:41): numerics sum, booleans OR, text
+    concatenates into lists? — the reference keeps *last* non-empty for plain
+    text, unions for collections, min for Date (first event), sum for
+    numerics."""
+
+    @staticmethod
+    def aggregator_for(type_cls: Type[FeatureType]) -> MonoidAggregator:
+        if issubclass(type_cls, Binary):
+            return MonoidAggregator(lambda: None, _logical_or)
+        if issubclass(type_cls, (Date, DateTime)):
+            return MonoidAggregator(lambda: None, _max_option)
+        if issubclass(type_cls, OPNumeric):
+            return MonoidAggregator(lambda: None, _sum_option)
+        if issubclass(type_cls, (MultiPickList,)) or issubclass(type_cls, OPSet):
+            return MonoidAggregator(set, _union_set)
+        if issubclass(type_cls, Geolocation):
+            # keep last non-empty location
+            return MonoidAggregator(
+                list, lambda a, b: b if b else a)
+        if issubclass(type_cls, OPList):
+            return MonoidAggregator(list, _union_list)
+        if issubclass(type_cls, OPMap):
+            return MonoidAggregator(dict, _union_map_last)
+        if issubclass(type_cls, Text):
+            # concatenate distinct-preserving: keep last non-empty
+            return MonoidAggregator(lambda: None, lambda a, b: b if b is not None else a)
+        return MonoidAggregator(lambda: None, lambda a, b: b if b is not None else a)
+
+
+@dataclass
+class FeatureAggregator:
+    """Aggregator + optional event-time window filter (reference
+    FeatureAggregator / TimeBasedAggregator)."""
+
+    type_cls: Type[FeatureType]
+    aggregator: Optional[MonoidAggregator] = None
+    window_ms: Optional[int] = None  # only events within window of cutoff
+
+    def __post_init__(self):
+        if self.aggregator is None:
+            self.aggregator = MonoidAggregatorDefaults.aggregator_for(self.type_cls)
+
+    def extract(self, events, event_time_fn=None, cutoff_time: Optional[int] = None,
+                is_response: bool = False) -> Any:
+        """Aggregate raw extracted values from events.
+
+        Predictors keep events at/before cutoff; responses keep events after
+        (reference AggregateDataReader semantics, DataReader.scala:219-246).
+        """
+        vals = []
+        for ev_val, ev_time in events:
+            if cutoff_time is not None and ev_time is not None:
+                if is_response:
+                    if ev_time <= cutoff_time:
+                        continue
+                else:
+                    if ev_time > cutoff_time:
+                        continue
+                    if self.window_ms is not None and ev_time < cutoff_time - self.window_ms:
+                        continue
+            vals.append(ev_val)
+        return self.aggregator.reduce(vals)

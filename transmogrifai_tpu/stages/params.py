@@ -1,0 +1,97 @@
+"""Typed stage-parameter system.
+
+Reference: Spark ML ``Params``/``ParamMap`` as used by every OP stage, plus
+``OpParams`` JSON overrides (features/.../OpParams.scala:81). Stages declare
+params with defaults and validators; ``ParamMap`` is a plain dict used by the
+model-selector grids; params round-trip through JSON for persistence and for
+the ``stage_params`` override mechanism (OpWorkflow.setStageParameters,
+core/.../OpWorkflow.scala:166).
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Param:
+    name: str
+    doc: str = ""
+    default: Any = None
+    validator: Optional[Callable[[Any], bool]] = None
+
+    def validate(self, value: Any) -> None:
+        if self.validator is not None and not self.validator(value):
+            raise ValueError(f"Invalid value for param '{self.name}': {value!r}")
+
+
+class HasParams:
+    """Mixin giving a stage a declared-param dictionary.
+
+    Subclasses declare params via ``_declare_params`` returning a list of
+    Param; instances hold current values in ``_param_values``.
+    """
+
+    @classmethod
+    def _declare_params(cls) -> List[Param]:
+        return []
+
+    def _init_params(self, **overrides: Any) -> None:
+        self._params: Dict[str, Param] = {}
+        for klass in reversed(type(self).__mro__):
+            declare = klass.__dict__.get("_declare_params")
+            if declare is not None:
+                for p in declare.__func__(type(self)):
+                    self._params[p.name] = p
+        self._param_values: Dict[str, Any] = {
+            name: copy.copy(p.default) for name, p in self._params.items()
+        }
+        for k, v in overrides.items():
+            self.set_param(k, v)
+
+    # -- access ------------------------------------------------------------
+    def has_param(self, name: str) -> bool:
+        return name in self._params
+
+    def get_param(self, name: str) -> Any:
+        if name not in self._params:
+            raise KeyError(f"{type(self).__name__} has no param '{name}'")
+        return self._param_values[name]
+
+    def set_param(self, name: str, value: Any) -> "HasParams":
+        if name not in self._params:
+            raise KeyError(f"{type(self).__name__} has no param '{name}'")
+        self._params[name].validate(value)
+        self._param_values[name] = value
+        return self
+
+    def set_params(self, **kwargs: Any) -> "HasParams":
+        for k, v in kwargs.items():
+            self.set_param(k, v)
+        return self
+
+    def param_values(self) -> Dict[str, Any]:
+        return dict(self._param_values)
+
+    def explain_params(self) -> str:
+        lines = []
+        for name, p in sorted(self._params.items()):
+            lines.append(f"{name}: {p.doc} (default: {p.default!r}, "
+                         f"current: {self._param_values[name]!r})")
+        return "\n".join(lines)
+
+
+# A hyperparameter assignment used by model-selector grids: stage-param name -> value.
+ParamMap = Dict[str, Any]
+
+
+def param_grid(**axes: List[Any]) -> List[ParamMap]:
+    """Cartesian product grid builder (reference ParamGridBuilder usage in
+    Binary/Multi/Regression selector factories)."""
+    import itertools
+    names = list(axes.keys())
+    grids: List[ParamMap] = []
+    for combo in itertools.product(*[axes[n] for n in names]):
+        grids.append(dict(zip(names, combo)))
+    return grids

@@ -1,0 +1,39 @@
+"""Deterministic-ish UID generation for stages & features.
+
+Reference: utils/.../UID.scala — ids of form ``ClassName_%012x``. A process-
+local counter keeps ids reproducible within a run (the reference uses random
+hex; we use a counter seeded per-process so tests are stable, with the same
+printed format so persisted artifacts look alike).
+"""
+from __future__ import annotations
+
+import itertools
+import re
+import threading
+from typing import Tuple
+
+_counter = itertools.count(1)
+_lock = threading.Lock()
+
+_UID_RE = re.compile(r"^(\w+)_(\w{12})$")
+
+
+def make_uid(cls_or_name) -> str:
+    name = cls_or_name if isinstance(cls_or_name, str) else cls_or_name.__name__
+    with _lock:
+        n = next(_counter)
+    return f"{name}_{n:012x}"
+
+
+def parse_uid(uid: str) -> Tuple[str, str]:
+    """Split a uid into (stage class name, hex suffix). Raises on malformed."""
+    m = _UID_RE.match(uid)
+    if not m:
+        raise ValueError(f"Invalid UID: {uid}")
+    return m.group(1), m.group(2)
+
+
+def reset_uids() -> None:
+    """Reset the counter (test isolation only)."""
+    global _counter
+    _counter = itertools.count(1)
