@@ -1,17 +1,25 @@
-"""Test config: force an 8-device virtual CPU mesh before jax import.
+"""Test config: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's single-local-Spark-session test harness
 (utils/.../test/TestSparkContext.scala:46 `master=local[2]`): distribution is
 validated on emulated devices, matching how the driver dry-runs the
 multi-chip path (xla_force_host_platform_device_count).
+
+NOTE: the environment's sitecustomize imports jax at interpreter startup
+with JAX_PLATFORMS=axon (the TPU tunnel), so env vars set here are too late —
+we must update the live jax config instead, before any backend initializes.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,250 @@
+"""XLA kernels (stats/metrics) + GLM model tests."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops import metrics_ops as M
+from transmogrifai_tpu.ops import stats as S
+
+
+def test_col_stats_with_nan(rng):
+    X = rng.normal(size=(100, 3)).astype(np.float32)
+    X[::7, 1] = np.nan
+    st = S.col_stats(X)
+    ref = X[:, 0]
+    assert np.isclose(st.mean[0], ref.mean(), atol=1e-5)
+    assert np.isclose(st.variance[0], ref.var(ddof=1), atol=1e-4)
+    col1 = X[:, 1][np.isfinite(X[:, 1])]
+    assert np.isclose(st.mean[1], col1.mean(), atol=1e-5)
+    assert st.count[1] == len(col1)
+    assert np.isclose(st.min[0], ref.min()) and np.isclose(st.max[0], ref.max())
+
+
+def test_col_stats_respects_weights(rng):
+    X = rng.normal(size=(50, 2)).astype(np.float32)
+    Xpad = np.concatenate([X, np.full((10, 2), 99.0, np.float32)])
+    w = np.concatenate([np.ones(50), np.zeros(10)]).astype(np.float32)
+    st = S.col_stats(Xpad, w)
+    assert np.isclose(st.mean[0], X[:, 0].mean(), atol=1e-5)
+
+
+def test_pearson_matches_numpy(rng):
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    y = (X[:, 0] * 2 + rng.normal(size=200) * 0.5).astype(np.float32)
+    corr = np.asarray(S.pearson_with_label(X, y))
+    for j in range(4):
+        expect = np.corrcoef(X[:, j], y)[0, 1]
+        assert np.isclose(corr[j], expect, atol=1e-4)
+
+
+def test_spearman_monotone(rng):
+    x = rng.normal(size=(300,)).astype(np.float32)
+    y = np.exp(x)  # monotone but nonlinear
+    rho = np.asarray(S.spearman_with_label(x[:, None], y))
+    assert rho[0] > 0.999
+
+
+def test_contingency_stats_known_values():
+    # classic 2x2: perfect association
+    t = np.array([[50.0, 0.0], [0.0, 50.0]])
+    cs = S.contingency_stats(t)
+    assert np.isclose(cs.cramers_v, 1.0, atol=1e-5)
+    assert np.isclose(cs.max_rule_confidences[0], 1.0)
+    # independence
+    t2 = np.array([[25.0, 25.0], [25.0, 25.0]])
+    cs2 = S.contingency_stats(t2)
+    assert np.isclose(cs2.chi2, 0.0, atol=1e-4)
+    assert np.isclose(cs2.mutual_info, 0.0, atol=1e-5)
+
+
+def test_js_divergence():
+    p = np.array([0.5, 0.5, 0.0])
+    q = np.array([0.0, 0.5, 0.5])
+    d = float(S.js_divergence(p, p))
+    assert np.isclose(d, 0.0, atol=1e-6)
+    assert 0.0 < float(S.js_divergence(p, q)) <= 1.0
+
+
+def test_auroc_aupr_vs_sklearn_formula(rng):
+    # compare against a simple trusted numpy implementation
+    y = (rng.uniform(size=500) < 0.3).astype(np.float32)
+    s = np.clip(y * 0.6 + rng.uniform(size=500) * 0.7, 0, 1).astype(np.float32)
+
+    def np_auc(scores, labels):
+        order = np.argsort(-scores, kind="stable")
+        ys = labels[order]
+        ss = scores[order]
+        tps = np.cumsum(ys)
+        fps = np.cumsum(1 - ys)
+        boundary = np.append(ss[1:] != ss[:-1], True)
+        tpr = np.concatenate([[0], tps[boundary] / tps[-1]])
+        fpr = np.concatenate([[0], fps[boundary] / fps[-1]])
+        return np.trapz(tpr, fpr)
+
+    auc = float(M.au_roc(s, y))
+    assert np.isclose(auc, np_auc(s, y), atol=1e-5)
+    # perfect separation
+    assert np.isclose(float(M.au_roc(y, y)), 1.0, atol=1e-6)
+    # aupr of perfect = 1, of random ~ base rate
+    assert np.isclose(float(M.au_pr(y, y)), 1.0, atol=1e-6)
+    rnd = rng.uniform(size=5000).astype(np.float32)
+    yy = (rng.uniform(size=5000) < 0.25).astype(np.float32)
+    assert abs(float(M.au_pr(rnd, yy)) - 0.25) < 0.05
+
+
+def test_metrics_ignore_zero_weight_rows(rng):
+    y = np.array([1, 0, 1, 0, 1, 1], np.float32)
+    s = np.array([.9, .1, .8, .2, .7, .99], np.float32)
+    w = np.array([1, 1, 1, 1, 1, 0], np.float32)
+    a1 = float(M.au_roc(s[:5], y[:5]))
+    a2 = float(M.au_roc(s, y, w))
+    assert np.isclose(a1, a2, atol=1e-6)
+
+
+def test_binary_metrics_confusion():
+    y = np.array([1, 1, 0, 0], np.float32)
+    s = np.array([0.9, 0.4, 0.6, 0.1], np.float32)
+    m = M.binary_metrics(s, y)
+    assert (float(m.tp), float(m.fn), float(m.fp), float(m.tn)) == (1, 1, 1, 1)
+    assert np.isclose(float(m.error), 0.5)
+
+
+def test_multiclass_metrics():
+    y = np.array([0, 1, 2, 1, 0], np.float32)
+    p = np.array([0, 1, 2, 2, 0], np.float32)
+    m = M.multiclass_metrics(p, y, 3)
+    assert np.isclose(float(m.error), 0.2)
+    assert 0.7 < float(m.f1) <= 1.0
+
+
+def test_regression_metrics():
+    y = np.array([1.0, 2.0, 3.0], np.float32)
+    p = np.array([1.5, 2.0, 2.5], np.float32)
+    m = M.regression_metrics(p, y)
+    assert np.isclose(float(m.mae), 1.0 / 3, atol=1e-6)
+    assert np.isclose(float(m.mse), (0.25 + 0 + 0.25) / 3, atol=1e-6)
+    assert float(m.r2) < 1.0
+
+
+class TestGLMs:
+    def _binary_data(self, rng, n=400, d=5):
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        beta = np.array([2.0, -1.0, 0.5, 0.0, 0.0], np.float32)
+        logits = X @ beta + 0.3
+        y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+        return X, y, beta
+
+    def test_logistic_recovers_signal(self, rng):
+        from transmogrifai_tpu.models.glm import OpLogisticRegression
+        X, y, beta = self._binary_data(rng)
+        model = OpLogisticRegression(reg_param=0.01).fit_arrays(X, y)
+        pred, raw, prob = model.predict_arrays(X)
+        from transmogrifai_tpu.ops.metrics_ops import au_roc
+        assert float(au_roc(prob[:, 1], y)) > 0.85
+        assert np.sign(model.beta[0]) > 0 and np.sign(model.beta[1]) < 0
+
+    def test_logistic_l1_sparsifies(self, rng):
+        from transmogrifai_tpu.models.glm import OpLogisticRegression
+        X, y, _ = self._binary_data(rng)
+        m = OpLogisticRegression(reg_param=0.5, elastic_net_param=1.0).fit_arrays(X, y)
+        # noise coords should be (near) zeroed
+        assert abs(m.beta[3]) < 0.05 and abs(m.beta[4]) < 0.05
+
+    def test_svc(self, rng):
+        from transmogrifai_tpu.models.glm import OpLinearSVC
+        X, y, _ = self._binary_data(rng)
+        m = OpLinearSVC(reg_param=0.01).fit_arrays(X, y)
+        pred, raw, prob = m.predict_arrays(X)
+        assert prob is None
+        # labels are sigmoid-noisy; Bayes accuracy on this draw is ~0.8
+        assert (pred == y).mean() > 0.75
+
+    def test_softmax_multiclass(self, rng):
+        from transmogrifai_tpu.models.glm import OpLogisticRegression
+        n = 600
+        X = rng.normal(size=(n, 4)).astype(np.float32)
+        y = np.argmax(X[:, :3] + 0.3 * rng.normal(size=(n, 3)), axis=1).astype(np.float32)
+        m = OpLogisticRegression(reg_param=0.01, max_iter=30).fit_arrays(X, y)
+        pred, raw, prob = m.predict_arrays(X)
+        assert prob.shape == (n, 3)
+        assert (pred == y).mean() > 0.8
+
+    def test_linear_regression_exact(self, rng):
+        from transmogrifai_tpu.models.glm import OpLinearRegression
+        X = rng.normal(size=(300, 3)).astype(np.float32)
+        y = (X @ np.array([1.0, -2.0, 0.5]) + 3.0).astype(np.float32)
+        m = OpLinearRegression(reg_param=0.0).fit_arrays(X, y)
+        np.testing.assert_allclose(m.beta, [1.0, -2.0, 0.5], atol=1e-2)
+        assert np.isclose(m.intercept, 3.0, atol=1e-2)
+
+    def test_glr_poisson(self, rng):
+        from transmogrifai_tpu.models.glm import OpGeneralizedLinearRegression
+        X = rng.normal(size=(500, 2)).astype(np.float32)
+        rate = np.exp(0.5 * X[:, 0] + 0.2)
+        y = rng.poisson(rate).astype(np.float32)
+        m = OpGeneralizedLinearRegression(family="poisson").fit_arrays(X, y)
+        assert np.isclose(m.beta[0], 0.5, atol=0.1)
+        pred, _, _ = m.predict_arrays(X)
+        assert (pred >= 0).all()
+
+    def test_naive_bayes(self, rng):
+        from transmogrifai_tpu.models.glm import OpNaiveBayes
+        n = 400
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        X = rng.poisson(np.where(y[:, None] > 0, [5.0, 1.0], [1.0, 5.0])).astype(np.float32)
+        m = OpNaiveBayes().fit_arrays(X, y)
+        pred, raw, prob = m.predict_arrays(X)
+        assert (pred == y).mean() > 0.9
+        assert np.allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_weighted_fit_ignores_masked_rows(self, rng):
+        from transmogrifai_tpu.models.glm import OpLogisticRegression
+        X, y, _ = self._binary_data(rng)
+        Xpad = np.concatenate([X, rng.normal(size=(50, 5)).astype(np.float32) * 100])
+        ypad = np.concatenate([y, np.ones(50, np.float32)])
+        w = np.concatenate([np.ones_like(y), np.zeros(50, np.float32)])
+        m1 = OpLogisticRegression(reg_param=0.01).fit_arrays(X, y)
+        m2 = OpLogisticRegression(reg_param=0.01).fit_arrays(Xpad, ypad, w)
+        np.testing.assert_allclose(m1.beta, m2.beta, atol=1e-4)
+
+
+def test_svc_evaluated_by_margin_not_hard_prediction(rng):
+    """Regression: raw-only prediction columns must score by margin."""
+    from transmogrifai_tpu.models.glm import OpLinearSVC
+    from transmogrifai_tpu.models.prediction import (
+        make_prediction_column, positive_score_of, probability_of)
+    import numpy as np
+    margin = np.array([-2.0, -0.5, 0.5, 2.0], np.float32)
+    col = make_prediction_column((margin >= 0).astype(np.float32),
+                                 raw_prediction=np.stack([-margin, margin], 1))
+    assert probability_of(col) is None
+    np.testing.assert_allclose(positive_score_of(col), margin)
+    # and survives row gathers
+    from transmogrifai_tpu.data.dataset import Dataset
+    ds = Dataset({"p": col})
+    sub = ds.take(np.array([0, 3]))
+    np.testing.assert_allclose(positive_score_of(sub.column("p")), [-2.0, 2.0])
+
+
+def test_onehot_max_pct_cardinality_drops_unique_ids():
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu.automl.vectorizers.categorical import OneHotVectorizer
+    from transmogrifai_tpu.types import PickList
+    ids = [f"id_{i}" for i in range(50)]
+    ds = Dataset.from_features([("s", PickList, ids)])
+    s = FeatureBuilder.PickList("s").as_predictor()
+    model = OneHotVectorizer(min_support=1, max_pct_cardinality=0.5).set_input(s).fit(ds)
+    out = model.transform(ds).column(model.output_name())
+    # pivot dropped: only OTHER + NULL remain
+    assert out.data.shape[1] == 2
+
+
+def test_spearman_pairwise_complete(rng):
+    import numpy as np
+    from transmogrifai_tpu.ops import stats as S
+    y = rng.normal(size=200).astype(np.float32)
+    x = y + 0.1 * rng.normal(size=200).astype(np.float32)
+    x_nan = x.copy()
+    x_nan[:100] = np.nan  # valid subset is rows 100:
+    rho_full = float(S.spearman_with_label(x[100:, None], y[100:])[0])
+    rho_masked = float(S.spearman_with_label(x_nan[:, None], y)[0])
+    assert np.isclose(rho_full, rho_masked, atol=1e-5)

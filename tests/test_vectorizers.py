@@ -1,0 +1,155 @@
+"""Vectorizer + Transmogrifier tests (reference vectorizer suites)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import (
+    Binary, Dataset, FeatureBuilder, Geolocation, Integral, MultiPickList,
+    PickList, Real, RealMap, RealNN, Text, TextList, TextMap,
+)
+from transmogrifai_tpu.automl.transmogrifier import transmogrify, vectorize_by_type
+from transmogrifai_tpu.automl.vectorizers.categorical import OneHotVectorizer
+from transmogrifai_tpu.automl.vectorizers.combiner import VectorsCombiner
+from transmogrifai_tpu.automl.vectorizers.maps import MapVectorizer
+from transmogrifai_tpu.automl.vectorizers.numeric import (
+    NumericBucketizer, NumericVectorizer,
+)
+from transmogrifai_tpu.automl.vectorizers.text import SmartTextVectorizer, tokenize
+from transmogrifai_tpu.ops.hashing import murmur3_32
+
+
+def test_murmur3_reference_vectors():
+    # standard MurmurHash3_x86_32 test vectors
+    assert murmur3_32(b"") == 0
+    assert murmur3_32(b"", seed=1) == 0x514E28B7
+    assert murmur3_32(b"abc") == 0xB3DD93FA
+    assert murmur3_32(b"Hello, world!", seed=1234) == 0xFAF6CDB3
+
+
+def test_numeric_vectorizer_mean_impute_and_nulls():
+    x = FeatureBuilder.Real("x").as_predictor()
+    z = FeatureBuilder.Real("z").as_predictor()
+    ds = Dataset.from_features([
+        ("x", Real, [1.0, None, 3.0]),
+        ("z", Real, [10.0, 20.0, None]),
+    ])
+    vec = NumericVectorizer().set_input(x, z)
+    model = vec.fit(ds)
+    out = model.transform(ds)
+    col = out.column(model.output_name())
+    # layout: x, x_null, z, z_null
+    np.testing.assert_allclose(
+        col.data,
+        [[1.0, 0.0, 10.0, 0.0], [2.0, 1.0, 20.0, 0.0], [3.0, 0.0, 15.0, 1.0]])
+    md = col.metadata
+    assert md.size == 4
+    assert md.columns[1].is_null_indicator
+    assert md.columns[0].parent_feature_name == "x"
+    # row-level parity
+    v = model.transform_value(Real(None), Real(5.0))
+    np.testing.assert_allclose(v.value, [2.0, 1.0, 5.0, 0.0])
+
+
+def test_onehot_pivot_topk_other_null():
+    s = FeatureBuilder.PickList("s").as_predictor()
+    vals = ["a"] * 5 + ["b"] * 3 + ["c"] * 1 + [None]
+    ds = Dataset.from_features([("s", PickList, vals)])
+    vec = OneHotVectorizer(top_k=2, min_support=2).set_input(s)
+    model = vec.fit(ds)
+    out = model.transform(ds).column(model.output_name())
+    md = out.metadata
+    # vocab: a, b (c dropped by min_support); cols: a, b, OTHER, NULL
+    assert md.column_names() == ["s_s_a", "s_s_b", "s_s_OTHER",
+                                 "s_s_NullIndicatorValue"]
+    np.testing.assert_allclose(out.data[0], [1, 0, 0, 0])
+    np.testing.assert_allclose(out.data[8], [0, 0, 1, 0])  # 'c' -> OTHER
+    np.testing.assert_allclose(out.data[9], [0, 0, 0, 1])  # None -> NULL
+
+
+def test_onehot_clean_text():
+    s = FeatureBuilder.PickList("s").as_predictor()
+    ds = Dataset.from_features([("s", PickList, ["A!", "a", "  a ", "b.", None] * 3)])
+    model = OneHotVectorizer(top_k=5, min_support=1).set_input(s).fit(ds)
+    out = model.transform(ds).column(model.output_name())
+    # "A!", "a", "  a " all clean to "a"
+    assert out.metadata.column_names()[0] == "s_s_a"
+    assert out.data[:3, 0].sum() == 3.0
+
+
+def test_smart_text_dispatch():
+    lo = FeatureBuilder.Text("lo").as_predictor()
+    hi = FeatureBuilder.Text("hi").as_predictor()
+    lo_vals = ["x", "y"] * 10
+    hi_vals = [f"word{i} hello" for i in range(20)]
+    ds = Dataset.from_features([("lo", Text, lo_vals), ("hi", Text, hi_vals)])
+    vec = SmartTextVectorizer(max_cardinality=5, num_features=16,
+                              min_support=1).set_input(lo, hi)
+    model = vec.fit(ds)
+    assert model.plans[0]["mode"] == "pivot"
+    assert model.plans[1]["mode"] == "hash"
+    out = model.transform(ds).column(model.output_name())
+    # lo: 2 vocab + OTHER + NULL = 4; hi: 16 bins + NULL = 17
+    assert out.data.shape == (20, 4 + 17)
+    assert out.metadata.size == 21
+
+
+def test_bucketizer_quantiles():
+    x = FeatureBuilder.Real("x").as_predictor()
+    ds = Dataset.from_features([("x", Real, list(map(float, range(100))) + [None])])
+    model = NumericBucketizer(num_buckets=4).set_input(x).fit(ds)
+    out = model.transform(ds).column(model.output_name())
+    assert out.data.shape[1] == 5  # 4 buckets + null
+    assert out.data[0, 0] == 1.0 and out.data[99, 3] == 1.0
+    assert out.data[100, 4] == 1.0  # null indicator
+    assert out.data[:100, :4].sum() == 100.0
+
+
+def test_map_vectorizer_real_and_text():
+    rm = FeatureBuilder.RealMap("rm").as_predictor()
+    tm = FeatureBuilder.PickListMap("tm").as_predictor()
+    ds = Dataset.from_features([
+        ("rm", RealMap, [{"a": 1.0, "b": 2.0}, {"a": 3.0}, None]),
+        ("tm", TextMap, [{"k": "u"}, {"k": "v"}, {"k": "u"}]),
+    ])
+    from transmogrifai_tpu.types import PickListMap
+    vec = MapVectorizer(min_support=1).set_input(rm, tm)
+    model = vec.fit(ds)
+    out = model.transform(ds).column(model.output_name())
+    names = out.metadata.column_names()
+    # rm keys a,b -> value+null each = 4 cols; tm key k -> u,v,OTHER,NULL = 4
+    assert len(names) == 8
+    np.testing.assert_allclose(out.data[1][:4], [3.0, 0.0, 2.0, 1.0])
+
+
+def test_transmogrify_dispatch_and_combine():
+    feats = [
+        FeatureBuilder.Real("age").as_predictor(),
+        FeatureBuilder.Integral("sibsp").as_predictor(),
+        FeatureBuilder.Binary("alone").as_predictor(),
+        FeatureBuilder.PickList("sex").as_predictor(),
+    ]
+    ds = Dataset.from_features([
+        ("age", Real, [22.0, None, 35.0, 40.0] * 5),
+        ("sibsp", Integral, [1, 0, None, 2] * 5),
+        ("alone", Binary, [True, False, None, True] * 5),
+        ("sex", PickList, ["m", "f", "f", None] * 5),
+    ])
+    combined = transmogrify(feats)
+    assert combined.feature_type.__name__ == "OPVector"
+    # fit the DAG manually: vectorizers then combiner
+    stages = {}
+    for vf in combined.parents:
+        est = vf.origin_stage
+        model = est.fit(ds)
+        ds = model.transform(ds)
+    out = combined.origin_stage.transform(ds).column(combined.name)
+    # age: 2; sibsp: 2; alone: 2; sex: m,f,OTHER,NULL=4 (min_support=10 on 20 rows:
+    # m appears 5, f 10 -> only f kept => 1+2 extra) — just sanity-check shape & md
+    assert out.data.shape[0] == 20
+    assert out.metadata.size == out.data.shape[1]
+    parents = {c.parent_feature_name for c in out.metadata.columns}
+    assert parents == {"age", "sibsp", "alone", "sex"}
+
+
+def test_tokenize():
+    assert tokenize("Hello, World! foo") == ["hello", "world", "foo"]
+    assert tokenize(None) == []

@@ -1,0 +1,84 @@
+"""Vectorizer base machinery.
+
+Vectorizers are sequence estimators/transformers: N same-typed input features
+-> one OPVector output whose columns carry VectorMetadata provenance
+(reference: the vectorizer family under core/.../impl/feature/ — each is a
+SequenceEstimator producing OPVector with OpVectorMetadata).
+
+Two-phase contract: fit computes a static shape (vocabularies, fill values,
+hash widths) as concrete host values; the resulting model's transform is pure
+array math, fusable into the layer's XLA program.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Type
+
+import numpy as np
+
+from ...data.dataset import Column, Dataset
+from ...data.vector import (
+    NULL_STRING, OTHER_STRING, VectorColumnMetadata, VectorMetadata,
+)
+from ...stages.base import Estimator, Transformer
+from ...types import ColumnKind, FeatureType, OPVector
+
+
+class VectorizerModel(Transformer):
+    """Base fitted vectorizer: emits a dense [n, width] block + metadata."""
+
+    output_type = OPVector
+    is_sequence = True
+
+    def __init__(self, operation_name: str, uid: Optional[str] = None, **params):
+        super().__init__(operation_name, uid=uid, **params)
+        self._metadata: Optional[VectorMetadata] = None
+
+    def output_metadata(self) -> Optional[VectorMetadata]:
+        if self._metadata is not None and self._metadata.name != self.output_name():
+            self._metadata = VectorMetadata(
+                name=self.output_name(), columns=self._metadata.columns,
+                history=self._metadata.history)
+        return self._metadata
+
+    def set_metadata(self, md: VectorMetadata) -> "VectorizerModel":
+        self._metadata = md
+        return self
+
+    # columnar protocol: subclasses implement transform_block
+    def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform_columns(self, *cols: Column) -> Column:
+        block = self.transform_block(list(cols))
+        block = np.asarray(block, dtype=np.float32)
+        md = self.output_metadata()
+        if md is not None and block.shape[1] != md.size:
+            raise AssertionError(
+                f"{self.stage_name}: produced {block.shape[1]} cols, "
+                f"metadata has {md.size}")
+        return Column(kind=ColumnKind.VECTOR, data=block, metadata=md)
+
+    def transform_value(self, *vals: FeatureType):
+        cols = [_single_value_column(v) for v in vals]
+        block = self.transform_block(cols)
+        return OPVector(np.asarray(block, dtype=np.float32)[0])
+
+
+def _single_value_column(v: FeatureType) -> Column:
+    from ...data.dataset import column_from_values
+    return column_from_values(type(v), [v])
+
+
+def numeric_block(cols: Sequence[Column]) -> np.ndarray:
+    """Stack numeric columns into [n, k] float64 (NaN = missing)."""
+    return np.stack([np.asarray(c.data, dtype=np.float64) for c in cols], axis=1)
+
+
+class SequenceVectorizer(Estimator):
+    """Base estimator for N same-typed inputs -> OPVector."""
+
+    output_type = OPVector
+    is_sequence = True
+
+    def feature_names(self) -> List[str]:
+        return self.input_names()

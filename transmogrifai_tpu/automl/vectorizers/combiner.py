@@ -1,0 +1,53 @@
+"""VectorsCombiner: concatenate OPVector features into the final matrix.
+
+Reference: core/.../impl/feature/VectorsCombiner.scala — a SequenceTransformer
+assembling per-family vectors into the single feature vector consumed by
+SanityChecker and models. The combined 2-D block is exactly what gets
+device_put to HBM.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ...data.dataset import Column
+from ...data.vector import VectorMetadata
+from ...types import ColumnKind, OPVector
+from .base import VectorizerModel
+
+
+class VectorsCombiner(VectorizerModel):
+    """Transformer (no fitting): concat vector columns + their metadata."""
+
+    input_types = (OPVector,)
+    is_sequence = True
+
+    def __init__(self, operation_name: str = "combineVectors",
+                 uid: Optional[str] = None, **params):
+        super().__init__(operation_name, uid=uid, **params)
+
+    def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        mats = []
+        for c in cols:
+            m = c.data
+            if m.ndim == 1:
+                m = m[:, None]
+            mats.append(np.asarray(m, dtype=np.float64))
+        return np.concatenate(mats, axis=1)
+
+    def transform_columns(self, *cols: Column) -> Column:
+        parts: List[VectorMetadata] = []
+        for c, f in zip(cols, self.input_features):
+            if c.metadata is not None:
+                parts.append(c.metadata)
+            else:
+                from ...data.vector import VectorColumnMetadata
+                width = c.data.shape[1] if c.data.ndim == 2 else 1
+                parts.append(VectorMetadata(name=f.name, columns=[
+                    VectorColumnMetadata(parent_feature_name=f.name,
+                                         parent_feature_type=f.type_name,
+                                         descriptor_value=str(i))
+                    for i in range(width)]))
+        self.set_metadata(VectorMetadata.concat(self.output_name(), parts))
+        return super().transform_columns(*cols)

@@ -1,0 +1,206 @@
+"""Date/time vectorizers: time-since-reference + circular encodings.
+
+Reference: core/.../impl/feature/{DateToUnitCircleTransformer.scala,
+DateListVectorizer.scala:309}. Default circular periods per
+TransmogrifierDefaults.CircularDateRepresentations: HourOfDay, DayOfWeek,
+DayOfMonth, DayOfYear — each maps to (sin, cos) on the unit circle so
+midnight is close to 23:59.
+"""
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...data.dataset import Column
+from ...data.vector import NULL_STRING, VectorColumnMetadata, VectorMetadata
+from ...stages.params import Param
+from ...types import Date, DateList, Integral
+from .base import SequenceVectorizer, VectorizerModel, numeric_block
+
+MS_PER_DAY = 86400000.0
+
+PERIODS: Dict[str, Any] = {
+    # name -> (period length, extractor on epoch-millis numpy array)
+    "HourOfDay": (24.0, lambda ms: (ms / 3600000.0) % 24.0),
+    "DayOfWeek": (7.0, lambda ms: ((ms / MS_PER_DAY) + 3.0) % 7.0),  # epoch was Thu
+    "DayOfMonth": (31.0, lambda ms: _day_of_month(ms)),
+    "DayOfYear": (366.0, lambda ms: _day_of_year(ms)),
+    "WeekOfYear": (53.0, lambda ms: _day_of_year(ms) / 7.0),
+    "MonthOfYear": (12.0, lambda ms: _month_of_year(ms)),
+}
+
+
+def _dt_apply(ms: np.ndarray, fn) -> np.ndarray:
+    out = np.full(ms.shape, np.nan)
+    finite = np.isfinite(ms)
+    for i in np.nonzero(finite)[0]:
+        d = _dt.datetime.utcfromtimestamp(ms[i] / 1000.0)
+        out[i] = fn(d)
+    return out
+
+
+def _day_of_month(ms: np.ndarray) -> np.ndarray:
+    return _dt_apply(ms, lambda d: float(d.day - 1))
+
+
+def _day_of_year(ms: np.ndarray) -> np.ndarray:
+    return _dt_apply(ms, lambda d: float(d.timetuple().tm_yday - 1))
+
+
+def _month_of_year(ms: np.ndarray) -> np.ndarray:
+    return _dt_apply(ms, lambda d: float(d.month - 1))
+
+
+class DateVectorizerModel(VectorizerModel):
+    def __init__(self, reference_date_ms: float,
+                 circular_periods: Sequence[str], track_nulls: bool = True,
+                 operation_name: str = "vecDate", uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.reference_date_ms = float(reference_date_ms)
+        self.circular_periods = list(circular_periods)
+        self.track_nulls = track_nulls
+
+    def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        X = numeric_block(cols)  # epoch millis, NaN missing
+        blocks: List[np.ndarray] = []
+        for j in range(X.shape[1]):
+            ms = X[:, j]
+            finite = np.isfinite(ms)
+            days_since = np.where(finite,
+                                  (self.reference_date_ms - ms) / MS_PER_DAY, 0.0)
+            parts = [days_since[:, None]]
+            for p in self.circular_periods:
+                period, extract = PERIODS[p]
+                val = extract(ms)
+                ang = 2.0 * np.pi * val / period
+                s = np.where(finite, np.sin(ang), 0.0)
+                c = np.where(finite, np.cos(ang), 0.0)
+                parts.append(s[:, None])
+                parts.append(c[:, None])
+            if self.track_nulls:
+                parts.append((~finite).astype(np.float64)[:, None])
+            blocks.append(np.concatenate(parts, axis=1))
+        return np.concatenate(blocks, axis=1)
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(reference_date_ms=self.reference_date_ms,
+                 circular_periods=self.circular_periods,
+                 track_nulls=self.track_nulls)
+        return d
+
+
+class DateVectorizer(SequenceVectorizer):
+    """Date/DateTime group vectorizer."""
+
+    input_types = (Integral,)  # Date extends Integral; accepts Date/DateTime
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("reference_date_ms", "reference time (None = fit time)", None),
+            Param("circular_periods", "periods to encode",
+                  ["HourOfDay", "DayOfWeek", "DayOfMonth", "DayOfYear"]),
+            Param("track_nulls", "append null indicators", True),
+        ]
+
+    def __init__(self, operation_name: str = "vecDate",
+                 uid: Optional[str] = None, **params):
+        super().__init__(operation_name, uid=uid, **params)
+
+    def fit_columns(self, *cols: Column) -> DateVectorizerModel:
+        ref = self.get_param("reference_date_ms")
+        if ref is None:
+            import time
+            ref = time.time() * 1000.0
+        periods = list(self.get_param("circular_periods"))
+        track = self.get_param("track_nulls")
+        model = DateVectorizerModel(
+            reference_date_ms=float(ref), circular_periods=periods,
+            track_nulls=track, operation_name=self.operation_name)
+        md_cols: List[VectorColumnMetadata] = []
+        for f in self.input_features:
+            md_cols.append(VectorColumnMetadata(
+                parent_feature_name=f.name, parent_feature_type=f.type_name,
+                descriptor_value="daysSinceReference"))
+            for p in periods:
+                for trig in ("sin", "cos"):
+                    md_cols.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.type_name,
+                        descriptor_value=f"{p}_{trig}"))
+            if track:
+                md_cols.append(VectorColumnMetadata(
+                    parent_feature_name=f.name, parent_feature_type=f.type_name,
+                    indicator_value=NULL_STRING))
+        model.set_metadata(VectorMetadata(name=self.output_name(), columns=md_cols))
+        return model
+
+
+class DateListVectorizerModel(VectorizerModel):
+    """DateList pivot modes (reference DateListPivot): SinceLast (default) —
+    days from reference to most recent event; also ModeDay etc. are reduced
+    to SinceFirst/SinceLast here."""
+
+    def __init__(self, reference_date_ms: float, mode: str = "SinceLast",
+                 operation_name: str = "vecDateList", uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.reference_date_ms = float(reference_date_ms)
+        self.mode = mode
+
+    def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        n = len(cols[0])
+        blocks = []
+        for c in cols:
+            out = np.zeros((n, 2), dtype=np.float64)
+            for i in range(n):
+                v = c.data[i]
+                if not v:
+                    out[i, 1] = 1.0
+                    continue
+                anchor = max(v) if self.mode == "SinceLast" else min(v)
+                out[i, 0] = (self.reference_date_ms - anchor) / MS_PER_DAY
+            blocks.append(out)
+        return np.concatenate(blocks, axis=1)
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(reference_date_ms=self.reference_date_ms, mode=self.mode)
+        return d
+
+
+class DateListVectorizer(SequenceVectorizer):
+    input_types = (DateList,)
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("reference_date_ms", "reference time (None = fit time)", None),
+            Param("mode", "SinceLast|SinceFirst", "SinceLast",
+                  lambda v: v in ("SinceLast", "SinceFirst")),
+        ]
+
+    def __init__(self, operation_name: str = "vecDateList",
+                 uid: Optional[str] = None, **params):
+        super().__init__(operation_name, uid=uid, **params)
+
+    def fit_columns(self, *cols: Column) -> DateListVectorizerModel:
+        ref = self.get_param("reference_date_ms")
+        if ref is None:
+            import time
+            ref = time.time() * 1000.0
+        model = DateListVectorizerModel(
+            reference_date_ms=float(ref), mode=self.get_param("mode"),
+            operation_name=self.operation_name)
+        md_cols: List[VectorColumnMetadata] = []
+        for f in self.input_features:
+            md_cols.append(VectorColumnMetadata(
+                parent_feature_name=f.name, parent_feature_type=f.type_name,
+                descriptor_value=f"days{self.get_param('mode')}"))
+            md_cols.append(VectorColumnMetadata(
+                parent_feature_name=f.name, parent_feature_type=f.type_name,
+                indicator_value=NULL_STRING))
+        model.set_metadata(VectorMetadata(name=self.output_name(), columns=md_cols))
+        return model

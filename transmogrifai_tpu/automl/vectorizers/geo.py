@@ -1,0 +1,107 @@
+"""Geolocation vectorizer: mean-impute with null tracking.
+
+Reference: core/.../impl/feature/GeolocationVectorizer.scala:156 — fills
+missing locations with the geographic mean (computed on the unit sphere so
+the mean stays on the globe), emitting (lat, lon, accuracy, null) columns.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...data.dataset import Column
+from ...data.vector import NULL_STRING, VectorColumnMetadata, VectorMetadata
+from ...stages.params import Param
+from ...types import Geolocation
+from .base import SequenceVectorizer, VectorizerModel
+
+
+def geo_mean(values: Sequence[Sequence[float]]) -> List[float]:
+    """Unit-sphere mean of (lat, lon, acc) triples."""
+    if not values:
+        return [0.0, 0.0, 0.0]
+    xs = ys = zs = acc = 0.0
+    for lat, lon, a in values:
+        la, lo = math.radians(lat), math.radians(lon)
+        xs += math.cos(la) * math.cos(lo)
+        ys += math.cos(la) * math.sin(lo)
+        zs += math.sin(la)
+        acc += a
+    n = len(values)
+    xs, ys, zs = xs / n, ys / n, zs / n
+    hyp = math.sqrt(xs * xs + ys * ys)
+    return [math.degrees(math.atan2(zs, hyp)),
+            math.degrees(math.atan2(ys, xs)), acc / n]
+
+
+class GeolocationModel(VectorizerModel):
+    def __init__(self, fills: Sequence[Sequence[float]], track_nulls: bool = True,
+                 operation_name: str = "vecGeo", uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.fills = [list(f) for f in fills]
+        self.track_nulls = track_nulls
+
+    def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        n = len(cols[0])
+        blocks = []
+        for j, c in enumerate(cols):
+            width = 3 + (1 if self.track_nulls else 0)
+            block = np.zeros((n, width), dtype=np.float64)
+            fill = self.fills[j]
+            for i in range(n):
+                v = c.data[i]
+                if v:
+                    block[i, 0:3] = v[:3]
+                else:
+                    block[i, 0:3] = fill
+                    if self.track_nulls:
+                        block[i, 3] = 1.0
+            blocks.append(block)
+        return np.concatenate(blocks, axis=1)
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(fills=self.fills, track_nulls=self.track_nulls)
+        return d
+
+
+class GeolocationVectorizer(SequenceVectorizer):
+    input_types = (Geolocation,)
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("fill_with_mean", "impute with spherical mean", True),
+            Param("fill_value", "constant (lat, lon, acc)", (0.0, 0.0, 0.0)),
+            Param("track_nulls", "append null indicators", True),
+        ]
+
+    def __init__(self, operation_name: str = "vecGeo",
+                 uid: Optional[str] = None, **params):
+        super().__init__(operation_name, uid=uid, **params)
+
+    def fit_columns(self, *cols: Column) -> GeolocationModel:
+        track = self.get_param("track_nulls")
+        fills = []
+        for c in cols:
+            if self.get_param("fill_with_mean"):
+                vals = [v for v in c.data if v]
+                fills.append(geo_mean(vals))
+            else:
+                fills.append(list(self.get_param("fill_value")))
+        model = GeolocationModel(fills=fills, track_nulls=track,
+                                 operation_name=self.operation_name)
+        md_cols: List[VectorColumnMetadata] = []
+        for f in self.input_features:
+            for d in ("lat", "lon", "accuracy"):
+                md_cols.append(VectorColumnMetadata(
+                    parent_feature_name=f.name, parent_feature_type=f.type_name,
+                    descriptor_value=d))
+            if track:
+                md_cols.append(VectorColumnMetadata(
+                    parent_feature_name=f.name, parent_feature_type=f.type_name,
+                    indicator_value=NULL_STRING))
+        model.set_metadata(VectorMetadata(name=self.output_name(), columns=md_cols))
+        return model

@@ -1,0 +1,325 @@
+"""Map vectorizers: per-key expansion of keyed features.
+
+Reference: core/.../impl/feature/{OPMapVectorizer.scala:468,
+TextMapPivotVectorizer, MultiPickListMapVectorizer, SmartTextMapVectorizer,
+GeolocationMapVectorizer}. Fit discovers the key set per map feature (the
+dynamic part), then each (feature, key) pair becomes a statically-shaped
+column group: numeric keys impute+null-track, categorical keys pivot,
+free-text keys smart-dispatch to pivot/hash, geolocation keys emit
+(lat, lon, acc, null).
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...data.dataset import Column
+from ...data.vector import NULL_STRING, OTHER_STRING, VectorColumnMetadata, VectorMetadata
+from ...ops.hashing import hash_tokens_to_counts
+from ...stages.params import Param
+from ...types import (
+    BinaryMap, DateMap, FeatureType, GeolocationMap, IntegralMap,
+    MultiPickListMap, NumericMap, OPMap, RealMap, TextMap,
+)
+from .base import SequenceVectorizer, VectorizerModel
+from .categorical import clean_text_value
+from .geo import geo_mean
+from .text import tokenize
+
+_CATEGORICAL_MAP_TYPES = (
+    "PickListMap", "ComboBoxMap", "CountryMap", "StateMap", "CityMap",
+    "PostalCodeMap", "IDMap",
+)
+
+
+def clean_key(k: str, clean: bool) -> str:
+    return clean_text_value(k, clean) if clean else k
+
+
+def lookup_key(m, key: str, clean_keys: bool):
+    """Fetch a map value by (possibly cleaned) key — single implementation
+    shared by fit-time discovery and transform-time reads."""
+    if not m:
+        return None
+    if clean_keys:
+        for k, v in m.items():
+            if clean_key(str(k), True) == key:
+                return v
+        return None
+    return m.get(key)
+
+
+class MapVectorizerModel(VectorizerModel):
+    """Fitted map vectorizer: per (feature, key) column plans."""
+
+    def __init__(self, feature_plans: Sequence[Dict[str, Any]],
+                 clean_keys: bool = False,
+                 operation_name: str = "vecMap", uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        # feature_plans[i]: {kind: 'real'|'binary'|'categorical'|'hash'|
+        #                    'multipicklist'|'geo',
+        #                    keys: [...], fills: {key: float} | vocab: {key: [...]},
+        #                    bins: int, track_nulls: bool, clean_text: bool}
+        self.feature_plans = [dict(p) for p in feature_plans]
+        self.clean_keys = clean_keys
+
+    def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        blocks: List[np.ndarray] = []
+        for plan, c in zip(self.feature_plans, cols):
+            n = len(c)
+            kind = plan["kind"]
+            keys = plan["keys"]
+            track = plan["track_nulls"]
+            for key in keys:
+                vals = [self._get(c.data[i], key) for i in range(n)]
+                if kind in ("real", "binary"):
+                    fill = plan["fills"].get(key, 0.0)
+                    col = np.array([fill if v is None else float(v) for v in vals])
+                    parts = [col[:, None]]
+                    if track:
+                        parts.append(np.array(
+                            [1.0 if v is None else 0.0 for v in vals])[:, None])
+                    blocks.append(np.concatenate(parts, axis=1))
+                elif kind == "categorical":
+                    vocab = plan["vocab"].get(key, [])
+                    if vocab is None:  # high-cardinality key -> hash space
+                        bins = plan["bins"]
+                        toks = [tokenize(v) if v else [] for v in vals]
+                        counts = hash_tokens_to_counts(toks, bins)
+                        parts = [counts]
+                        if track:
+                            parts.append(np.array(
+                                [1.0 if v is None else 0.0 for v in vals])[:, None])
+                        blocks.append(np.concatenate(parts, axis=1))
+                        continue
+                    index = {v: i for i, v in enumerate(vocab)}
+                    k = len(vocab)
+                    block = np.zeros((n, k + 1 + (1 if track else 0)))
+                    for i, v in enumerate(vals):
+                        if v is None:
+                            if track:
+                                block[i, k + 1] = 1.0
+                            continue
+                        cv = clean_text_value(str(v), plan["clean_text"])
+                        j = index.get(cv)
+                        if j is None:
+                            block[i, k] = 1.0
+                        else:
+                            block[i, j] = 1.0
+                    blocks.append(block)
+                elif kind == "multipicklist":
+                    vocab = plan["vocab"].get(key, [])
+                    index = {v: i for i, v in enumerate(vocab)}
+                    k = len(vocab)
+                    block = np.zeros((n, k + 1 + (1 if track else 0)))
+                    for i, v in enumerate(vals):
+                        if not v:
+                            if track:
+                                block[i, k + 1] = 1.0
+                            continue
+                        for item in v:
+                            cv = clean_text_value(str(item), plan["clean_text"])
+                            j = index.get(cv)
+                            if j is None:
+                                block[i, k] = 1.0
+                            else:
+                                block[i, j] = 1.0
+                    blocks.append(block)
+                elif kind == "geo":
+                    fill = plan["fills"].get(key, [0.0, 0.0, 0.0])
+                    width = 3 + (1 if track else 0)
+                    block = np.zeros((n, width))
+                    for i, v in enumerate(vals):
+                        if v:
+                            block[i, 0:3] = v[:3]
+                        else:
+                            block[i, 0:3] = fill
+                            if track:
+                                block[i, 3] = 1.0
+                    blocks.append(block)
+                else:
+                    raise ValueError(f"Unknown map plan kind {kind}")
+        return np.concatenate(blocks, axis=1) if blocks else np.zeros((len(cols[0]), 0))
+
+    def _get(self, m, key):
+        return lookup_key(m, key, self.clean_keys)
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(feature_plans=self.feature_plans, clean_keys=self.clean_keys)
+        return d
+
+
+class MapVectorizer(SequenceVectorizer):
+    """Key-discovering map vectorizer for every OPMap subtype."""
+
+    input_types = (OPMap,)
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("top_k", "pivot vocabulary cap per key", 20),
+            Param("min_support", "min occurrences for pivot category", 10),
+            Param("max_cardinality", "pivot if distinct <= this (text maps)", 30),
+            Param("num_features", "hash bins for high-cardinality text keys", 512),
+            Param("clean_text", "normalize category strings", True),
+            Param("clean_keys", "normalize map keys", False),
+            Param("track_nulls", "append null indicators", True),
+            Param("allow_listed_keys", "restrict to these keys (None = all)", None),
+            Param("block_listed_keys", "exclude these keys", None),
+        ]
+
+    def __init__(self, operation_name: str = "vecMap",
+                 uid: Optional[str] = None, **params):
+        super().__init__(operation_name, uid=uid, **params)
+
+    def _kind_of(self, t) -> str:
+        name = t.__name__
+        if issubclass(t, GeolocationMap):
+            return "geo"
+        if issubclass(t, MultiPickListMap):
+            return "multipicklist"
+        if issubclass(t, BinaryMap):
+            return "binary"
+        if issubclass(t, NumericMap):
+            return "real"
+        if name in _CATEGORICAL_MAP_TYPES:
+            return "categorical"
+        if issubclass(t, TextMap):
+            return "smarttext"
+        return "real"
+
+    def fit_columns(self, *cols: Column) -> MapVectorizerModel:
+        clean_keys_p = self.get_param("clean_keys")
+        clean = self.get_param("clean_text")
+        track = self.get_param("track_nulls")
+        top_k = int(self.get_param("top_k"))
+        min_support = int(self.get_param("min_support"))
+        max_card = int(self.get_param("max_cardinality"))
+        bins = int(self.get_param("num_features"))
+        allow = self.get_param("allow_listed_keys")
+        block = set(self.get_param("block_listed_keys") or ())
+
+        plans: List[Dict[str, Any]] = []
+        md_cols: List[VectorColumnMetadata] = []
+        for f, c in zip(self.input_features, cols):
+            kind = self._kind_of(f.feature_type)
+            # discover keys
+            key_counts: Counter = Counter()
+            for m in c.data:
+                if m:
+                    for k in m:
+                        key_counts[clean_key(str(k), clean_keys_p)] += 1
+            keys = sorted(k for k in key_counts
+                          if (allow is None or k in allow) and k not in block)
+            plan: Dict[str, Any] = dict(kind=kind, keys=keys, track_nulls=track,
+                                        clean_text=clean, bins=bins,
+                                        fills={}, vocab={})
+            if kind in ("real", "binary"):
+                for key in keys:
+                    vals = [self._lookup(m, key, clean_keys_p) for m in c.data]
+                    nums = [float(v) for v in vals if v is not None]
+                    plan["fills"][key] = (float(np.mean(nums)) if nums and
+                                          kind == "real" else 0.0)
+            elif kind == "geo":
+                for key in keys:
+                    vals = [self._lookup(m, key, clean_keys_p) for m in c.data]
+                    geo_vals = [v for v in vals if v]
+                    plan["fills"][key] = geo_mean(geo_vals)
+            elif kind in ("categorical", "multipicklist", "smarttext"):
+                for key in keys:
+                    vals = [self._lookup(m, key, clean_keys_p) for m in c.data]
+                    counts: Counter = Counter()
+                    for v in vals:
+                        if v is None:
+                            continue
+                        if kind == "multipicklist":
+                            for item in v:
+                                counts[clean_text_value(str(item), clean)] += 1
+                        else:
+                            counts[clean_text_value(str(v), clean)] += 1
+                    if kind == "smarttext" and len(counts) > max_card:
+                        # high-cardinality free text -> hashing for this key
+                        plan["vocab"][key] = None
+                    else:
+                        kept = [(v, n) for v, n in counts.items()
+                                if n >= min_support and v != ""]
+                        kept.sort(key=lambda kv: (-kv[1], kv[0]))
+                        plan["vocab"][key] = [v for v, _ in kept[:top_k]]
+            if kind == "smarttext":
+                plan["kind"] = "categorical"  # vocab[key]=None marks hash keys
+            plans.append(plan)
+            md_cols.extend(self._metadata_for(f, plan))
+
+        model = MapVectorizerModel(feature_plans=plans, clean_keys=clean_keys_p,
+                                   operation_name=self.operation_name)
+        model.set_metadata(VectorMetadata(name=self.output_name(), columns=md_cols))
+        return model
+
+    @staticmethod
+    def _lookup(m, key, clean_keys_p):
+        return lookup_key(m, key, clean_keys_p)
+
+    def _metadata_for(self, f, plan) -> List[VectorColumnMetadata]:
+        out: List[VectorColumnMetadata] = []
+        track = plan["track_nulls"]
+        for key in plan["keys"]:
+            if plan["kind"] in ("real", "binary"):
+                out.append(VectorColumnMetadata(
+                    parent_feature_name=f.name, parent_feature_type=f.type_name,
+                    grouping=key))
+                if track:
+                    out.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.type_name,
+                        grouping=key, indicator_value=NULL_STRING))
+            elif plan["kind"] in ("categorical", "multipicklist"):
+                vocab = plan["vocab"].get(key, [])
+                if vocab is None:  # hashed key
+                    for b in range(plan["bins"]):
+                        out.append(VectorColumnMetadata(
+                            parent_feature_name=f.name,
+                            parent_feature_type=f.type_name,
+                            grouping=key, descriptor_value=f"hash_{b}"))
+                    if track:
+                        out.append(VectorColumnMetadata(
+                            parent_feature_name=f.name,
+                            parent_feature_type=f.type_name,
+                            grouping=key, indicator_value=NULL_STRING))
+                    continue
+                for v in vocab:
+                    out.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.type_name,
+                        grouping=key, indicator_value=v))
+                out.append(VectorColumnMetadata(
+                    parent_feature_name=f.name, parent_feature_type=f.type_name,
+                    grouping=key, indicator_value=OTHER_STRING))
+                if track:
+                    out.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.type_name,
+                        grouping=key, indicator_value=NULL_STRING))
+            elif plan["kind"] == "geo":
+                for d in ("lat", "lon", "accuracy"):
+                    out.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.type_name,
+                        grouping=key, descriptor_value=d))
+                if track:
+                    out.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.type_name,
+                        grouping=key, indicator_value=NULL_STRING))
+        return out
+
+
+def map_vectorizer_for(map_type_name: str, defaults) -> MapVectorizer:
+    return MapVectorizer(
+        top_k=defaults.top_k, min_support=defaults.min_support,
+        max_cardinality=defaults.max_categorical_cardinality,
+        num_features=defaults.default_num_of_features,
+        clean_text=defaults.clean_text, clean_keys=defaults.clean_keys,
+        track_nulls=defaults.track_nulls)

@@ -1,0 +1,246 @@
+"""Text vectorizers: smart cardinality-dispatch, hashing, tokenization.
+
+Reference: core/.../impl/feature/{SmartTextVectorizer.scala:60,
+OPCollectionHashingVectorizer.scala, TextTokenizer.scala}. SmartText computes
+per-feature TextStats cardinality during fit: low-cardinality features pivot
+(one-hot), high-cardinality features hash into a fixed bin space — the
+hash-early-fixed-width design that keeps device shapes static.
+"""
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...data.dataset import Column
+from ...data.vector import NULL_STRING, OTHER_STRING, VectorColumnMetadata, VectorMetadata
+from ...ops.hashing import hash_string, hash_tokens_to_counts
+from ...stages.params import Param
+from ...types import Text, TextList
+from .base import SequenceVectorizer, VectorizerModel
+from .categorical import clean_text_value
+
+_WORD_RE = re.compile(r"\w+", re.UNICODE)
+
+MIN_TOKEN_LENGTH = 1  # reference TextTokenizer.MinTokenLength
+
+
+def tokenize(s: Optional[str], to_lowercase: bool = True,
+             min_token_length: int = MIN_TOKEN_LENGTH) -> List[str]:
+    """Simple unicode word tokenizer (reference TextTokenizer.scala:196 uses
+    Lucene; host-side tokenization feeding fixed-width hashed tensors)."""
+    if s is None:
+        return []
+    if to_lowercase:
+        s = s.lower()
+    return [t for t in _WORD_RE.findall(s) if len(t) >= min_token_length]
+
+
+class SmartTextModel(VectorizerModel):
+    """Fitted smart-text: per feature either a pivot vocab or a hash space."""
+
+    def __init__(self, plans: Sequence[Dict[str, Any]],
+                 operation_name: str = "smartTxt", uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        # each plan: {mode: 'pivot'|'hash'|'ignore', vocab: [...], bins: int,
+        #            track_nulls: bool, clean_text: bool}
+        self.plans = [dict(p) for p in plans]
+
+    def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        blocks: List[np.ndarray] = []
+        for plan, c in zip(self.plans, cols):
+            n = len(c)
+            data = c.data
+            track = plan["track_nulls"]
+            if plan["mode"] == "pivot":
+                vocab = plan["vocab"]
+                index = {v: i for i, v in enumerate(vocab)}
+                k = len(vocab)
+                block = np.zeros((n, k + 1 + (1 if track else 0)), dtype=np.float64)
+                for i in range(n):
+                    v = data[i]
+                    if v is None:
+                        if track:
+                            block[i, k + 1] = 1.0
+                        continue
+                    cv = clean_text_value(str(v), plan["clean_text"])
+                    j = index.get(cv)
+                    if j is None:
+                        block[i, k] = 1.0
+                    else:
+                        block[i, j] = 1.0
+            else:  # hash
+                bins = plan["bins"]
+                tokens = [tokenize(data[i]) for i in range(n)]
+                counts = hash_tokens_to_counts(tokens, bins)
+                if track:
+                    nulls = np.array([[1.0] if data[i] is None else [0.0]
+                                      for i in range(n)])
+                    block = np.concatenate([counts, nulls], axis=1)
+                else:
+                    block = counts
+            blocks.append(block)
+        return np.concatenate(blocks, axis=1)
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(plans=self.plans)
+        return d
+
+
+class SmartTextVectorizer(SequenceVectorizer):
+    """Cardinality-dispatched text vectorizer (reference
+    SmartTextVectorizer.fitFn:79: cardinality <= maxCardinality(30) => pivot
+    else hash into num_features bins)."""
+
+    input_types = (Text,)
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("max_cardinality", "pivot if distinct values <= this", 30),
+            Param("num_features", "hash bins for high-cardinality text", 512),
+            Param("top_k", "pivot vocabulary cap", 20),
+            Param("min_support", "min occurrences for pivot category", 10),
+            Param("clean_text", "normalize strings", True),
+            Param("track_nulls", "append null indicators", True),
+        ]
+
+    def __init__(self, operation_name: str = "smartTxt",
+                 uid: Optional[str] = None, **params):
+        super().__init__(operation_name, uid=uid, **params)
+
+    def fit_columns(self, *cols: Column) -> SmartTextModel:
+        max_card = int(self.get_param("max_cardinality"))
+        bins = int(self.get_param("num_features"))
+        top_k = int(self.get_param("top_k"))
+        min_support = int(self.get_param("min_support"))
+        clean = self.get_param("clean_text")
+        track = self.get_param("track_nulls")
+        plans: List[Dict[str, Any]] = []
+        md_cols: List[VectorColumnMetadata] = []
+        for f, c in zip(self.input_features, cols):
+            counts: Counter = Counter()
+            for v in c.data:
+                if v is not None:
+                    counts[clean_text_value(str(v), clean)] += 1
+            if len(counts) <= max_card:
+                kept = [(val, n) for val, n in counts.items()
+                        if n >= min_support and val != ""]
+                kept.sort(key=lambda kv: (-kv[1], kv[0]))
+                vocab = [v for v, _ in kept[:top_k]]
+                plans.append(dict(mode="pivot", vocab=vocab, bins=0,
+                                  track_nulls=track, clean_text=clean))
+                for v in vocab:
+                    md_cols.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.type_name,
+                        grouping=f.name, indicator_value=v))
+                md_cols.append(VectorColumnMetadata(
+                    parent_feature_name=f.name, parent_feature_type=f.type_name,
+                    grouping=f.name, indicator_value=OTHER_STRING))
+            else:
+                plans.append(dict(mode="hash", vocab=[], bins=bins,
+                                  track_nulls=track, clean_text=clean))
+                for b in range(bins):
+                    md_cols.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.type_name,
+                        grouping=f.name, descriptor_value=f"hash_{b}"))
+            if track:
+                md_cols.append(VectorColumnMetadata(
+                    parent_feature_name=f.name, parent_feature_type=f.type_name,
+                    grouping=f.name, indicator_value=NULL_STRING))
+        model = SmartTextModel(plans=plans, operation_name=self.operation_name)
+        model.set_metadata(VectorMetadata(name=self.output_name(), columns=md_cols))
+        return model
+
+
+class HashingModel(VectorizerModel):
+    """Pure hashing-trick vectorizer (no fit stats beyond widths)."""
+
+    def __init__(self, num_features: int = 512, shared_hash_space: bool = False,
+                 binary_freq: bool = False, is_list: bool = True,
+                 operation_name: str = "hashText", uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.num_features = int(num_features)
+        self.shared_hash_space = shared_hash_space
+        self.binary_freq = binary_freq
+        self.is_list = is_list
+
+    def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        n = len(cols[0])
+        if self.shared_hash_space:
+            token_lists: List[List[str]] = [[] for _ in range(n)]
+            for c in cols:
+                for i in range(n):
+                    v = c.data[i]
+                    toks = list(v) if self.is_list and v else \
+                        (tokenize(v) if v else [])
+                    token_lists[i].extend(toks)
+            return hash_tokens_to_counts(token_lists, self.num_features,
+                                         binary=self.binary_freq)
+        blocks = []
+        for c in cols:
+            token_lists = []
+            for i in range(n):
+                v = c.data[i]
+                toks = list(v) if self.is_list and v else \
+                    (tokenize(v) if v else [])
+                token_lists.append(toks)
+            blocks.append(hash_tokens_to_counts(
+                token_lists, self.num_features, binary=self.binary_freq))
+        return np.concatenate(blocks, axis=1)
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(num_features=self.num_features,
+                 shared_hash_space=self.shared_hash_space,
+                 binary_freq=self.binary_freq, is_list=self.is_list)
+        return d
+
+
+class TextListHashingVectorizer(SequenceVectorizer):
+    """TextList -> hashed token counts (reference
+    OPCollectionHashingVectorizer.scala:398; HashSpaceStrategy.Auto =>
+    separate spaces unless many features)."""
+
+    input_types = (TextList,)
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("num_features", "hash bins per feature", 512),
+            Param("shared_hash_space", "share one hash space", False),
+            Param("binary_freq", "0/1 instead of counts", False),
+        ]
+
+    def __init__(self, operation_name: str = "hashList",
+                 uid: Optional[str] = None, **params):
+        super().__init__(operation_name, uid=uid, **params)
+
+    def fit_columns(self, *cols: Column) -> HashingModel:
+        nf = int(self.get_param("num_features"))
+        shared = self.get_param("shared_hash_space")
+        model = HashingModel(
+            num_features=nf, shared_hash_space=shared,
+            binary_freq=self.get_param("binary_freq"), is_list=True,
+            operation_name=self.operation_name)
+        md_cols: List[VectorColumnMetadata] = []
+        if shared:
+            for b in range(nf):
+                md_cols.append(VectorColumnMetadata(
+                    parent_feature_name="+".join(self.input_names()),
+                    parent_feature_type=self.input_features[0].type_name,
+                    descriptor_value=f"hash_{b}"))
+        else:
+            for f in self.input_features:
+                for b in range(nf):
+                    md_cols.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.type_name,
+                        grouping=f.name, descriptor_value=f"hash_{b}"))
+        model.set_metadata(VectorMetadata(name=self.output_name(), columns=md_cols))
+        return model

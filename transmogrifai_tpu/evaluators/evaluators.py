@@ -1,0 +1,222 @@
+"""Evaluators: named metric bundles over prediction columns.
+
+Reference: core/.../evaluators/{OpEvaluatorBase.scala, Evaluators.scala:40,
+OpBinaryClassificationEvaluator.scala:56, OpMultiClassificationEvaluator.scala:58,
+OpRegressionEvaluator.scala:61, OpBinScoreEvaluator.scala}.
+
+Each evaluator computes a dict of metrics (floats) from (label column,
+prediction column); `evaluate` returns the single default metric used by
+validators to rank models. Compute is the jitted kernels in ops/metrics_ops.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..data.dataset import Column, Dataset
+from ..models.prediction import (
+    n_classes_of, positive_score_of, prediction_of, probability_of,
+)
+from ..ops import metrics_ops as M
+
+
+class Evaluator:
+    """Base: named, with a default metric and larger-is-better flag."""
+
+    name: str = "evaluator"
+    default_metric: str = ""
+    larger_better: bool = True
+
+    def __init__(self, metric: Optional[str] = None):
+        if metric is not None:
+            self.default_metric = metric
+
+    def evaluate_all(self, labels: np.ndarray, pred_col: Column,
+                     w: Optional[np.ndarray] = None) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def evaluate(self, labels: np.ndarray, pred_col: Column,
+                 w: Optional[np.ndarray] = None) -> float:
+        return self.evaluate_all(labels, pred_col, w)[self.default_metric]
+
+    def is_larger_better(self, metric: Optional[str] = None) -> bool:
+        m = metric or self.default_metric
+        return m not in _SMALLER_BETTER
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(metric={self.default_metric})"
+
+
+_SMALLER_BETTER = {"error", "rmse", "mse", "mae", "log_loss", "brier_score"}
+
+
+class BinaryClassificationEvaluator(Evaluator):
+    """AuROC/AuPR/Precision/Recall/F1/Error/confusion counts."""
+
+    name = "binEval"
+    default_metric = "au_pr"
+
+    def __init__(self, metric: Optional[str] = None, threshold: float = 0.5):
+        super().__init__(metric)
+        self.threshold = threshold
+
+    def evaluate_all(self, labels, pred_col, w=None) -> Dict[str, float]:
+        score = positive_score_of(pred_col)
+        m = M.binary_metrics(
+            np.asarray(score, np.float32), np.asarray(labels, np.float32),
+            None if w is None else np.asarray(w, np.float32), self.threshold)
+        return {k: float(v) for k, v in m._asdict().items()}
+
+
+class BinScoreEvaluator(Evaluator):
+    """Calibration bins + Brier score (reference OpBinScoreEvaluator.scala)."""
+
+    name = "binScoreEval"
+    default_metric = "brier_score"
+    larger_better = False
+
+    def __init__(self, num_bins: int = 100, metric: Optional[str] = None):
+        super().__init__(metric)
+        self.num_bins = num_bins
+
+    def evaluate_all(self, labels, pred_col, w=None) -> Dict[str, float]:
+        score = np.asarray(positive_score_of(pred_col), np.float64)
+        y = np.asarray(labels, np.float64)
+        if w is None:
+            w = np.ones_like(y)
+        brier = float((w * (score - y) ** 2).sum() / max(w.sum(), 1e-12))
+        bins = np.clip((score * self.num_bins).astype(int), 0, self.num_bins - 1)
+        counts = np.bincount(bins, weights=w, minlength=self.num_bins)
+        avg_score = np.bincount(bins, weights=w * score, minlength=self.num_bins)
+        avg_label = np.bincount(bins, weights=w * y, minlength=self.num_bins)
+        nz = counts > 0
+        avg_score[nz] /= counts[nz]
+        avg_label[nz] /= counts[nz]
+        return {
+            "brier_score": brier,
+            "bin_centers": list((np.arange(self.num_bins) + 0.5) / self.num_bins),
+            "bin_counts": [float(c) for c in counts],
+            "bin_avg_scores": [float(s) for s in avg_score],
+            "bin_avg_labels": [float(l) for l in avg_label],
+        }
+
+    def evaluate(self, labels, pred_col, w=None) -> float:
+        return self.evaluate_all(labels, pred_col, w)["brier_score"]
+
+
+class MultiClassificationEvaluator(Evaluator):
+    """Weighted precision/recall/F1/error + top-N threshold metrics."""
+
+    name = "multiEval"
+    default_metric = "error"
+    larger_better = False
+
+    def __init__(self, metric: Optional[str] = None,
+                 top_ns: Sequence[int] = (1, 3)):
+        super().__init__(metric)
+        self.top_ns = tuple(top_ns)
+
+    def evaluate_all(self, labels, pred_col, w=None) -> Dict[str, float]:
+        y = np.asarray(labels, np.float32)
+        pred = np.asarray(prediction_of(pred_col), np.float32)
+        prob = probability_of(pred_col)
+        n_classes = max(int(y.max()) + 1 if y.size else 1,
+                        n_classes_of(pred_col), int(pred.max()) + 1 if pred.size else 1)
+        m = M.multiclass_metrics(pred, y, n_classes,
+                                 None if w is None else np.asarray(w, np.float32))
+        out = {k: float(v) for k, v in m._asdict().items()}
+        if prob is not None and prob.size:
+            ww = np.ones_like(y) if w is None else np.asarray(w, np.float64)
+            order = np.argsort(-prob, axis=1)
+            for topn in self.top_ns:
+                hit = (order[:, :topn] == y[:, None].astype(int)).any(axis=1)
+                out[f"top_{topn}_accuracy"] = float(
+                    (ww * hit).sum() / max(ww.sum(), 1e-12))
+        return out
+
+
+class RegressionEvaluator(Evaluator):
+    """RMSE/MSE/MAE/R2."""
+
+    name = "regEval"
+    default_metric = "rmse"
+    larger_better = False
+
+    def evaluate_all(self, labels, pred_col, w=None) -> Dict[str, float]:
+        pred = np.asarray(prediction_of(pred_col), np.float32)
+        m = M.regression_metrics(
+            pred, np.asarray(labels, np.float32),
+            None if w is None else np.asarray(w, np.float32))
+        return {k: float(v) for k, v in m._asdict().items()}
+
+
+class Evaluators:
+    """Factory namespace (reference Evaluators.scala:40)."""
+
+    class BinaryClassification:
+        @staticmethod
+        def au_pr() -> BinaryClassificationEvaluator:
+            return BinaryClassificationEvaluator(metric="au_pr")
+
+        auPR = au_pr
+
+        @staticmethod
+        def au_roc() -> BinaryClassificationEvaluator:
+            return BinaryClassificationEvaluator(metric="au_roc")
+
+        auROC = au_roc
+
+        @staticmethod
+        def precision() -> BinaryClassificationEvaluator:
+            return BinaryClassificationEvaluator(metric="precision")
+
+        @staticmethod
+        def recall() -> BinaryClassificationEvaluator:
+            return BinaryClassificationEvaluator(metric="recall")
+
+        @staticmethod
+        def f1() -> BinaryClassificationEvaluator:
+            return BinaryClassificationEvaluator(metric="f1")
+
+        @staticmethod
+        def error() -> BinaryClassificationEvaluator:
+            return BinaryClassificationEvaluator(metric="error")
+
+        @staticmethod
+        def brier_score() -> BinScoreEvaluator:
+            return BinScoreEvaluator()
+
+    class MultiClassification:
+        @staticmethod
+        def precision() -> MultiClassificationEvaluator:
+            return MultiClassificationEvaluator(metric="precision")
+
+        @staticmethod
+        def recall() -> MultiClassificationEvaluator:
+            return MultiClassificationEvaluator(metric="recall")
+
+        @staticmethod
+        def f1() -> MultiClassificationEvaluator:
+            return MultiClassificationEvaluator(metric="f1")
+
+        @staticmethod
+        def error() -> MultiClassificationEvaluator:
+            return MultiClassificationEvaluator(metric="error")
+
+    class Regression:
+        @staticmethod
+        def rmse() -> RegressionEvaluator:
+            return RegressionEvaluator(metric="rmse")
+
+        @staticmethod
+        def mse() -> RegressionEvaluator:
+            return RegressionEvaluator(metric="mse")
+
+        @staticmethod
+        def mae() -> RegressionEvaluator:
+            return RegressionEvaluator(metric="mae")
+
+        @staticmethod
+        def r2() -> RegressionEvaluator:
+            return RegressionEvaluator(metric="r2")

@@ -1,0 +1,85 @@
+"""Predictor estimator/model bases.
+
+Reference: core/.../sparkwrappers/specific/OpPredictorWrapper.scala:67 — every
+model is an Estimator2(RealNN label, OPVector features) producing a
+Prediction. Here the fitted model holds concrete device arrays; its transform
+is pure array math (jit/vmap-able); `fit_arrays` / `predict_arrays` expose
+the raw tensor path used by the model-selector sweep so no column plumbing
+sits between folds.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Type
+
+import numpy as np
+
+from ..data.dataset import Column, Dataset
+from ..stages.base import Estimator, Transformer
+from ..types import OPVector, Prediction, RealNN
+from .prediction import make_prediction_column, row_prediction
+
+
+def _as_matrix(col: Column) -> np.ndarray:
+    m = col.data
+    if m.ndim == 1:
+        m = m[:, None]
+    return np.ascontiguousarray(m, dtype=np.float32)
+
+
+def _as_labels(col: Column) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(col.data, dtype=np.float64), dtype=np.float32)
+
+
+class PredictionModel(Transformer):
+    """Fitted model: (label, features) -> Prediction column."""
+
+    input_types = (RealNN, OPVector)
+    output_type = Prediction
+
+    def __init__(self, operation_name: str, uid: Optional[str] = None, **params):
+        super().__init__(operation_name, uid=uid, **params)
+
+    # -- tensor path -------------------------------------------------------
+    def predict_arrays(self, X: np.ndarray) -> Tuple[np.ndarray,
+                                                     Optional[np.ndarray],
+                                                     Optional[np.ndarray]]:
+        """X [n,d] -> (prediction [n], raw [n,c]|None, prob [n,c]|None)."""
+        raise NotImplementedError
+
+    # -- column path -------------------------------------------------------
+    def transform_columns(self, *cols: Column) -> Column:
+        vec = cols[-1]  # features are the last input
+        pred, raw, prob = self.predict_arrays(_as_matrix(vec))
+        return make_prediction_column(pred, raw, prob)
+
+    def transform_value(self, *vals):
+        X = np.asarray(vals[-1].value, dtype=np.float32)[None, :]
+        pred, raw, prob = self.predict_arrays(X)
+        col = make_prediction_column(pred, raw, prob)
+        return row_prediction(col, 0)
+
+    def transform_keyvalue(self, row: Dict[str, Any]) -> Any:
+        feats = row.get(self.input_names()[-1])
+        X = np.asarray(feats, dtype=np.float32)[None, :]
+        pred, raw, prob = self.predict_arrays(X)
+        col = make_prediction_column(pred, raw, prob)
+        return row_prediction(col, 0).value
+
+
+class PredictorEstimator(Estimator):
+    """Unfitted model: fit(label, features) -> PredictionModel."""
+
+    input_types = (RealNN, OPVector)
+    output_type = Prediction
+    # model-selector hints
+    problem_types = ("binary",)   # subset of binary|multiclass|regression
+    supports_grid_vmap = False    # GLMs override: grid+fold axes vmappable
+
+    def fit_arrays(self, X: np.ndarray, y: np.ndarray,
+                   w: Optional[np.ndarray] = None) -> PredictionModel:
+        raise NotImplementedError
+
+    def fit_columns(self, *cols: Column) -> PredictionModel:
+        label_col, vec_col = cols
+        model = self.fit_arrays(_as_matrix(vec_col), _as_labels(label_col))
+        return model

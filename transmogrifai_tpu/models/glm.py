@@ -1,0 +1,360 @@
+"""GLM-family predictors: logistic regression, linear SVC, naive Bayes,
+linear regression, generalized linear regression.
+
+Reference wrappers: core/.../impl/classification/{OpLogisticRegression,
+OpLinearSVC, OpNaiveBayes}.scala, core/.../impl/regression/
+{OpLinearRegression, OpGeneralizedLinearRegression}.scala. Param names mirror
+the Spark params the reference grids over (DefaultSelectorParams.scala:35-56).
+
+All fits run through ops/glm solvers — fixed-iteration jitted Newton — so the
+selector can vmap them over (grid x fold).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import glm as G
+from ..stages.params import Param
+from .base import PredictionModel, PredictorEstimator
+
+
+# -- fitted models ---------------------------------------------------------
+
+class LinearBinaryModel(PredictionModel):
+    """Binary linear scorer: logistic (prob via sigmoid) or SVC (margin)."""
+
+    def __init__(self, beta: np.ndarray, intercept: float,
+                 probabilistic: bool = True,
+                 operation_name: str = "linBin", uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.beta = np.asarray(beta, np.float32)
+        self.intercept = float(intercept)
+        self.probabilistic = probabilistic
+
+    def predict_arrays(self, X):
+        margin = X @ self.beta + self.intercept
+        raw = np.stack([-margin, margin], axis=1)
+        if self.probabilistic:
+            p1 = 1.0 / (1.0 + np.exp(-margin))
+            prob = np.stack([1.0 - p1, p1], axis=1)
+            pred = (p1 >= 0.5).astype(np.float32)
+        else:
+            prob = None
+            pred = (margin >= 0.0).astype(np.float32)
+        return pred, raw, prob
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(beta=self.beta.tolist(), intercept=self.intercept,
+                 probabilistic=self.probabilistic)
+        return d
+
+
+class SoftmaxModel(PredictionModel):
+    """Multinomial logistic scorer."""
+
+    def __init__(self, B: np.ndarray, b0: np.ndarray,
+                 operation_name: str = "softmax", uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.B = np.asarray(B, np.float32)
+        self.b0 = np.asarray(b0, np.float32)
+
+    def predict_arrays(self, X):
+        logits = X @ self.B + self.b0[None, :]
+        logits = logits - logits.max(axis=1, keepdims=True)
+        e = np.exp(logits)
+        prob = e / e.sum(axis=1, keepdims=True)
+        pred = prob.argmax(axis=1).astype(np.float32)
+        return pred, logits, prob
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(B=self.B.tolist(), b0=self.b0.tolist())
+        return d
+
+
+class LinearRegressionModel(PredictionModel):
+    def __init__(self, beta: np.ndarray, intercept: float,
+                 link: str = "identity",
+                 operation_name: str = "linReg", uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.beta = np.asarray(beta, np.float32)
+        self.intercept = float(intercept)
+        self.link = link
+
+    def predict_arrays(self, X):
+        eta = X @ self.beta + self.intercept
+        pred = np.exp(eta) if self.link == "log" else eta
+        return pred.astype(np.float32), None, None
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(beta=self.beta.tolist(), intercept=self.intercept, link=self.link)
+        return d
+
+
+class NaiveBayesModel(PredictionModel):
+    def __init__(self, log_prob: np.ndarray, log_prior: np.ndarray,
+                 operation_name: str = "nb", uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.log_prob = np.asarray(log_prob, np.float32)
+        self.log_prior = np.asarray(log_prior, np.float32)
+
+    def predict_arrays(self, X):
+        raw = np.maximum(X, 0.0) @ self.log_prob.T + self.log_prior[None, :]
+        m = raw.max(axis=1, keepdims=True)
+        e = np.exp(raw - m)
+        prob = e / e.sum(axis=1, keepdims=True)
+        pred = raw.argmax(axis=1).astype(np.float32)
+        return pred, raw, prob
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(log_prob=self.log_prob.tolist(), log_prior=self.log_prior.tolist())
+        return d
+
+
+# -- estimators ------------------------------------------------------------
+
+_jit_fit_logistic = jax.jit(G.fit_logistic, static_argnames=(
+    "max_iter", "fit_intercept", "standardize"))
+_jit_fit_linear = jax.jit(G.fit_linear, static_argnames=(
+    "max_iter", "fit_intercept", "standardize"))
+_jit_fit_svc = jax.jit(G.fit_linear_svc, static_argnames=(
+    "max_iter", "fit_intercept", "standardize"))
+_jit_fit_softmax = jax.jit(G.fit_softmax, static_argnames=(
+    "max_iter", "fit_intercept", "standardize"))
+_jit_fit_glr = jax.jit(G.fit_glr, static_argnames=("family", "max_iter",
+                                                   "fit_intercept"))
+_jit_fit_nb = jax.jit(G.fit_naive_bayes)
+
+
+def _ones_like_w(y, w):
+    return np.ones_like(y, np.float32) if w is None else np.asarray(w, np.float32)
+
+
+class OpLogisticRegression(PredictorEstimator):
+    """Reference OpLogisticRegression (impl/classification/, 212 LoC)."""
+
+    problem_types = ("binary", "multiclass")
+    supports_grid_vmap = True
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("reg_param", "regularization strength", 0.0),
+            Param("elastic_net_param", "L1 ratio", 0.0),
+            Param("max_iter", "Newton iterations", 50),
+            Param("tol", "termination tolerance", 1e-6),
+            Param("fit_intercept", "fit intercept", True),
+            Param("standardization", "standardize features", True),
+        ]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("logreg", uid=uid, **params)
+
+    def fit_arrays(self, X, y, w=None):
+        w = _ones_like_w(y, w)
+        n_classes = int(np.max(y)) + 1 if y.size else 2
+        if n_classes <= 2:
+            beta, b0 = _jit_fit_logistic(
+                jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+                jnp.asarray(self.get_param("reg_param"), jnp.float32),
+                jnp.asarray(self.get_param("elastic_net_param"), jnp.float32),
+                max_iter=int(self.get_param("max_iter")),
+                tol=float(self.get_param("tol")),
+                fit_intercept=bool(self.get_param("fit_intercept")),
+                standardize=bool(self.get_param("standardization")))
+            return LinearBinaryModel(np.asarray(beta), float(b0),
+                                     probabilistic=True,
+                                     operation_name=self.operation_name)
+        Y = np.eye(n_classes, dtype=np.float32)[y.astype(int)]
+        B, b0 = _jit_fit_softmax(
+            jnp.asarray(X), jnp.asarray(Y), jnp.asarray(w),
+            jnp.asarray(self.get_param("reg_param"), jnp.float32),
+            jnp.asarray(self.get_param("elastic_net_param"), jnp.float32),
+            max_iter=min(int(self.get_param("max_iter")), 30),
+            fit_intercept=bool(self.get_param("fit_intercept")),
+            standardize=bool(self.get_param("standardization")))
+        return SoftmaxModel(np.asarray(B), np.asarray(b0),
+                            operation_name=self.operation_name)
+
+    # vmapped grid+fold fit used by the selector (binary only)
+    def batched_fit_fn(self):
+        max_iter = int(self.get_param("max_iter"))
+        fit_intercept = bool(self.get_param("fit_intercept"))
+        standardize = bool(self.get_param("standardization"))
+
+        def fit_one(X, y, w, reg, alpha):
+            return G.fit_logistic(X, y, w, reg, alpha, max_iter=max_iter,
+                                  fit_intercept=fit_intercept,
+                                  standardize=standardize)
+
+        return fit_one, ("reg_param", "elastic_net_param")
+
+    def model_from_params(self, beta, b0) -> LinearBinaryModel:
+        return LinearBinaryModel(np.asarray(beta), float(b0), probabilistic=True,
+                                 operation_name=self.operation_name)
+
+
+class OpLinearSVC(PredictorEstimator):
+    """Reference OpLinearSVC (impl/classification/, 166 LoC)."""
+
+    problem_types = ("binary",)
+    supports_grid_vmap = True
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("reg_param", "L2 strength", 0.0),
+            Param("max_iter", "Newton iterations", 50),
+            Param("tol", "termination tolerance", 1e-6),
+            Param("fit_intercept", "fit intercept", True),
+            Param("standardization", "standardize features", True),
+        ]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("svc", uid=uid, **params)
+
+    def fit_arrays(self, X, y, w=None):
+        w = _ones_like_w(y, w)
+        beta, b0 = _jit_fit_svc(
+            jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(self.get_param("reg_param"), jnp.float32),
+            max_iter=int(self.get_param("max_iter")),
+            tol=float(self.get_param("tol")),
+            fit_intercept=bool(self.get_param("fit_intercept")),
+            standardize=bool(self.get_param("standardization")))
+        return LinearBinaryModel(np.asarray(beta), float(b0),
+                                 probabilistic=False,
+                                 operation_name=self.operation_name)
+
+    def batched_fit_fn(self):
+        max_iter = int(self.get_param("max_iter"))
+        fit_intercept = bool(self.get_param("fit_intercept"))
+        standardize = bool(self.get_param("standardization"))
+
+        def fit_one(X, y, w, reg, _alpha):
+            return G.fit_linear_svc(X, y, w, reg, max_iter=max_iter,
+                                    fit_intercept=fit_intercept,
+                                    standardize=standardize)
+
+        return fit_one, ("reg_param",)
+
+    def model_from_params(self, beta, b0) -> LinearBinaryModel:
+        return LinearBinaryModel(np.asarray(beta), float(b0),
+                                 probabilistic=False,
+                                 operation_name=self.operation_name)
+
+
+class OpNaiveBayes(PredictorEstimator):
+    """Reference OpNaiveBayes (multinomial; 112 LoC)."""
+
+    problem_types = ("binary", "multiclass")
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("smoothing", "Laplace smoothing", 1.0)]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("naiveBayes", uid=uid, **params)
+
+    def fit_arrays(self, X, y, w=None):
+        w = _ones_like_w(y, w)
+        n_classes = max(int(np.max(y)) + 1 if y.size else 2, 2)
+        Y = np.eye(n_classes, dtype=np.float32)[y.astype(int)]
+        log_prob, log_prior = _jit_fit_nb(
+            jnp.asarray(X), jnp.asarray(Y), jnp.asarray(w),
+            float(self.get_param("smoothing")))
+        return NaiveBayesModel(np.asarray(log_prob), np.asarray(log_prior),
+                               operation_name=self.operation_name)
+
+
+class OpLinearRegression(PredictorEstimator):
+    """Reference OpLinearRegression (impl/regression/, 186 LoC)."""
+
+    problem_types = ("regression",)
+    supports_grid_vmap = True
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("reg_param", "regularization strength", 0.0),
+            Param("elastic_net_param", "L1 ratio", 0.0),
+            Param("max_iter", "iterations", 50),
+            Param("tol", "termination tolerance", 1e-6),
+            Param("fit_intercept", "fit intercept", True),
+            Param("standardization", "standardize features", True),
+            Param("solver", "auto|normal|l-bfgs (ignored; Newton used)", "auto"),
+        ]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("linReg", uid=uid, **params)
+
+    def fit_arrays(self, X, y, w=None):
+        w = _ones_like_w(y, w)
+        beta, b0 = _jit_fit_linear(
+            jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(self.get_param("reg_param"), jnp.float32),
+            jnp.asarray(self.get_param("elastic_net_param"), jnp.float32),
+            max_iter=int(self.get_param("max_iter")),
+            tol=float(self.get_param("tol")),
+            fit_intercept=bool(self.get_param("fit_intercept")),
+            standardize=bool(self.get_param("standardization")))
+        return LinearRegressionModel(np.asarray(beta), float(b0),
+                                     operation_name=self.operation_name)
+
+    def batched_fit_fn(self):
+        max_iter = int(self.get_param("max_iter"))
+        fit_intercept = bool(self.get_param("fit_intercept"))
+        standardize = bool(self.get_param("standardization"))
+
+        def fit_one(X, y, w, reg, alpha):
+            return G.fit_linear(X, y, w, reg, alpha, max_iter=max_iter,
+                                fit_intercept=fit_intercept,
+                                standardize=standardize)
+
+        return fit_one, ("reg_param", "elastic_net_param")
+
+    def model_from_params(self, beta, b0) -> LinearRegressionModel:
+        return LinearRegressionModel(np.asarray(beta), float(b0),
+                                     operation_name=self.operation_name)
+
+
+class OpGeneralizedLinearRegression(PredictorEstimator):
+    """Reference OpGeneralizedLinearRegression (198 LoC): family/link GLR."""
+
+    problem_types = ("regression",)
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("family", "gaussian|poisson|gamma", "gaussian",
+                  lambda v: v in ("gaussian", "poisson", "gamma")),
+            Param("reg_param", "L2 strength", 0.0),
+            Param("max_iter", "IRLS iterations", 25),
+            Param("fit_intercept", "fit intercept", True),
+        ]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("glr", uid=uid, **params)
+
+    def fit_arrays(self, X, y, w=None):
+        w = _ones_like_w(y, w)
+        family = self.get_param("family")
+        if family in ("poisson", "gamma"):
+            y = np.maximum(y, 1e-6 if family == "gamma" else 0.0)
+        beta, b0 = _jit_fit_glr(
+            jnp.asarray(X), jnp.asarray(y, np.float32), jnp.asarray(w),
+            jnp.asarray(self.get_param("reg_param"), jnp.float32),
+            family=family,
+            max_iter=int(self.get_param("max_iter")),
+            fit_intercept=bool(self.get_param("fit_intercept")))
+        link = "log" if family in ("poisson", "gamma") else "identity"
+        return LinearRegressionModel(np.asarray(beta), float(b0), link=link,
+                                     operation_name=self.operation_name)

@@ -1,0 +1,313 @@
+"""Generalized-linear-model solvers as pure JAX programs.
+
+These replace Spark MLlib's LBFGS/OWLQN/IRLS optimizers (used by the
+reference's OpLogisticRegression / OpLinearRegression / OpLinearSVC /
+OpGeneralizedLinearRegression wrappers, core/.../impl/{classification,
+regression}/). Design goals:
+
+* full-batch second-order steps — X^T W X is one MXU matmul; on a
+  row-sharded X the Gram matrix reduction becomes an ICI psum inserted by
+  XLA, so the same code scales from 1 chip to a pod;
+* everything fixed-iteration (`lax.fori_loop`) and shape-static so the
+  model-selector can `vmap` the whole fit over the hyperparameter grid and
+  CV folds (grid x fold axes replace the reference's 8-thread pool,
+  OpValidator.scala:318);
+* elastic-net via proximal (FISTA-style) steps on the smooth Newton
+  direction, matching Spark's OWLQN behavior closely enough for metric
+  parity.
+
+Weights: every solver takes per-row weights `w` — fold masks, balancing
+weights and padding masks all enter here, so no data movement is needed
+between folds.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-12
+
+
+class GLMParams(NamedTuple):
+    """Static-shape hyperparameters (vmappable leaves)."""
+    reg: jax.Array          # total regularization strength (lambda)
+    elastic_net: jax.Array  # alpha in [0,1]: 0 = ridge, 1 = lasso
+
+
+def _standardize(X: jax.Array, w: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Weighted column standardization; returns (Xs, mean, std)."""
+    wsum = jnp.maximum(w.sum(), EPS)
+    mean = (X * w[:, None]).sum(0) / wsum
+    var = ((X - mean) ** 2 * w[:, None]).sum(0) / wsum
+    std = jnp.sqrt(jnp.maximum(var, EPS))
+    return (X - mean) / std, mean, std
+
+
+def _unstandardize_beta(beta: jax.Array, intercept: jax.Array,
+                        mean: jax.Array, std: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    b = beta / std
+    return b, intercept - (b * mean).sum()
+
+
+def _soft_threshold(x: jax.Array, t: jax.Array) -> jax.Array:
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - t, 0.0)
+
+
+def _newton_prox_fit(grad_hess_fn, d: int, reg: jax.Array, alpha: jax.Array,
+                     max_iter: int, tol: float, dtype=jnp.float32):
+    """Damped-Newton with L2 in the Hessian and L1 via proximal step.
+
+    grad_hess_fn(beta, b0) -> (g, H, g0, h0) for the unpenalized loss
+    (beta: coefficients, b0: intercept handled separately, unregularized).
+    """
+    l1 = reg * alpha
+    l2 = reg * (1.0 - alpha)
+
+    def cond(state):
+        i, _, _, delta = state
+        return (i < max_iter) & (delta > tol)
+
+    def body(state):
+        i, beta, b0, _ = state
+        g, H, g0, h0 = grad_hess_fn(beta, b0)
+        g = g + l2 * beta
+        H = H + l2 * jnp.eye(d, dtype=dtype)
+        # solve with jitter for safety
+        step = jnp.linalg.solve(H + 1e-6 * jnp.eye(d, dtype=dtype), g)
+        beta_new = beta - step
+        # proximal L1 using diagonal curvature as scaling
+        hdiag = jnp.maximum(jnp.diag(H), EPS)
+        beta_new = _soft_threshold(beta_new, l1 / hdiag)
+        b0_new = b0 - g0 / jnp.maximum(h0, EPS)
+        delta = jnp.abs(beta_new - beta).max() + jnp.abs(b0_new - b0)
+        return i + 1, beta_new, b0_new, delta
+
+    beta0 = jnp.zeros((d,), dtype)
+    b00 = jnp.asarray(0.0, dtype)
+    _, beta, b0, _ = jax.lax.while_loop(
+        cond, body, (jnp.asarray(0, jnp.int32), beta0, b00,
+                     jnp.asarray(jnp.inf, dtype)))
+    return beta, b0
+
+
+def fit_logistic(X: jax.Array, y: jax.Array, w: jax.Array,
+                 reg: jax.Array, elastic_net: jax.Array,
+                 max_iter: int = 50, tol: float = 1e-6,
+                 fit_intercept: bool = True,
+                 standardize: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Binary logistic regression via IRLS-Newton (+proximal L1).
+
+    Returns (coefficients [d], intercept). Matches Spark's
+    LogisticRegression(standardization=true, family=binomial) closely.
+    """
+    dtype = X.dtype
+    n, d = X.shape
+    Xs, mean, std = _standardize(X, w) if standardize else (X, jnp.zeros(d, dtype), jnp.ones(d, dtype))
+    wsum = jnp.maximum(w.sum(), EPS)
+
+    def grad_hess(beta, b0):
+        eta = Xs @ beta + b0
+        p = jax.nn.sigmoid(eta)
+        r = (p - y) * w
+        g = Xs.T @ r / wsum
+        s = jnp.maximum(p * (1 - p), 1e-6) * w
+        H = (Xs * s[:, None]).T @ Xs / wsum
+        g0 = r.sum() / wsum if fit_intercept else jnp.asarray(0.0, dtype)
+        h0 = s.sum() / wsum if fit_intercept else jnp.asarray(1.0, dtype)
+        return g, H, g0, h0
+
+    beta, b0 = _newton_prox_fit(grad_hess, d, reg, elastic_net, max_iter, tol, dtype)
+    if standardize:
+        beta, b0 = _unstandardize_beta(beta, b0, mean, std)
+    if not fit_intercept:
+        b0 = jnp.asarray(0.0, dtype)
+    return beta, b0
+
+
+def fit_linear(X: jax.Array, y: jax.Array, w: jax.Array,
+               reg: jax.Array, elastic_net: jax.Array,
+               max_iter: int = 50, tol: float = 1e-6,
+               fit_intercept: bool = True,
+               standardize: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Weighted linear regression with elastic net (Spark LinearRegression).
+
+    Ridge part closed-form per Newton step; L1 via proximal iterations.
+    """
+    dtype = X.dtype
+    n, d = X.shape
+    Xs, mean, std = _standardize(X, w) if standardize else (X, jnp.zeros(d, dtype), jnp.ones(d, dtype))
+    wsum = jnp.maximum(w.sum(), EPS)
+
+    def grad_hess(beta, b0):
+        r = (Xs @ beta + b0 - y) * w
+        g = Xs.T @ r / wsum
+        H = (Xs * w[:, None]).T @ Xs / wsum
+        g0 = r.sum() / wsum if fit_intercept else jnp.asarray(0.0, dtype)
+        h0 = w.sum() / wsum if fit_intercept else jnp.asarray(1.0, dtype)
+        return g, H, g0, h0
+
+    beta, b0 = _newton_prox_fit(grad_hess, d, reg, elastic_net, max_iter, tol, dtype)
+    if standardize:
+        beta, b0 = _unstandardize_beta(beta, b0, mean, std)
+    if not fit_intercept:
+        b0 = jnp.asarray(0.0, dtype)
+    return beta, b0
+
+
+def fit_linear_svc(X: jax.Array, y: jax.Array, w: jax.Array,
+                   reg: jax.Array,
+                   max_iter: int = 50, tol: float = 1e-6,
+                   fit_intercept: bool = True,
+                   standardize: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Linear SVM with squared-hinge loss + L2 (Spark LinearSVC semantics).
+
+    Squared hinge is differentiable, so Newton steps apply with the
+    active-set (margin<1) indicator inside the Hessian.
+    """
+    dtype = X.dtype
+    n, d = X.shape
+    ypm = 2.0 * y - 1.0  # {0,1} -> {-1,+1}
+    Xs, mean, std = _standardize(X, w) if standardize else (X, jnp.zeros(d, dtype), jnp.ones(d, dtype))
+    wsum = jnp.maximum(w.sum(), EPS)
+
+    def grad_hess(beta, b0):
+        margin = ypm * (Xs @ beta + b0)
+        active = (margin < 1.0).astype(dtype) * w
+        r = -ypm * jnp.maximum(1.0 - margin, 0.0) * w  # d/d_eta of 0.5*max(0,1-m)^2 * ypm... scaled
+        g = Xs.T @ r / wsum
+        H = (Xs * active[:, None]).T @ Xs / wsum
+        g0 = r.sum() / wsum if fit_intercept else jnp.asarray(0.0, dtype)
+        h0 = jnp.maximum(active.sum() / wsum, 1e-6) if fit_intercept else jnp.asarray(1.0, dtype)
+        return g, H, g0, h0
+
+    beta, b0 = _newton_prox_fit(grad_hess, d, reg, jnp.asarray(0.0, dtype),
+                                max_iter, tol, dtype)
+    if standardize:
+        beta, b0 = _unstandardize_beta(beta, b0, mean, std)
+    if not fit_intercept:
+        b0 = jnp.asarray(0.0, dtype)
+    return beta, b0
+
+
+def fit_softmax(X: jax.Array, Y: jax.Array, w: jax.Array,
+                reg: jax.Array, elastic_net: jax.Array,
+                max_iter: int = 100, lr: float = 1.0,
+                fit_intercept: bool = True,
+                standardize: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Multinomial logistic regression; Y is one-hot [n, c].
+
+    Uses Boehning's (1992) curvature bound: the softmax Hessian satisfies
+    H <= 0.5 (1 - 1/c) X^T W X per class block, so a CONSTANT preconditioner
+    A = 0.5(1-1/c) X^T W X + l2 I can be Cholesky-factored once and every
+    iteration is pure matmuls + triangular solves — monotone convergence and
+    an ideal TPU profile (no per-iteration d x d solves).
+    Returns (B [d, c], b0 [c]).
+    """
+    dtype = X.dtype
+    n, d = X.shape
+    c = Y.shape[1]
+    Xs, mean, std = _standardize(X, w) if standardize else (X, jnp.zeros(d, dtype), jnp.ones(d, dtype))
+    wsum = jnp.maximum(w.sum(), EPS)
+    l2 = reg * (1.0 - elastic_net)
+    l1 = reg * elastic_net
+    I = jnp.eye(d, dtype=dtype)
+
+    coef = 0.5 * (1.0 - 1.0 / c)
+    A = coef * (Xs * w[:, None]).T @ Xs / wsum + l2 * I + 1e-6 * I
+    chol = jax.scipy.linalg.cho_factor(A)
+    hdiag = jnp.maximum(jnp.diag(A), EPS)
+    h0 = jnp.maximum(coef * w.sum() / wsum, 1e-6)
+
+    def body(_, state):
+        B, b0 = state
+        logits = Xs @ B + b0[None, :]
+        P = jax.nn.softmax(logits, axis=1)
+        R = (P - Y) * w[:, None]          # [n, c]
+        G = Xs.T @ R / wsum + l2 * B      # [d, c]
+        B_new = B - jax.scipy.linalg.cho_solve(chol, G)
+        B_new = _soft_threshold(B_new, l1 / hdiag[:, None])
+        if fit_intercept:
+            b0_new = b0 - (R.sum(0) / wsum) / h0
+        else:
+            b0_new = b0
+        return B_new, b0_new
+
+    B0 = jnp.zeros((d, c), dtype)
+    b00 = jnp.zeros((c,), dtype)
+    B, b0 = jax.lax.fori_loop(0, max_iter, body, (B0, b00))
+    if standardize:
+        Bu = B / std[:, None]
+        b0 = b0 - (Bu * mean[:, None]).sum(0)
+        B = Bu
+    return B, b0
+
+
+def fit_glr(X: jax.Array, y: jax.Array, w: jax.Array,
+            reg: jax.Array, family: str = "gaussian",
+            max_iter: int = 25, fit_intercept: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Generalized linear regression via IRLS (Spark
+    GeneralizedLinearRegression families: gaussian/identity, poisson/log,
+    gamma/log, tweedie — gaussian & poisson are the reference's default grid,
+    DefaultSelectorParams.DistFamily).
+    """
+    dtype = X.dtype
+    n, d = X.shape
+    wsum = jnp.maximum(w.sum(), EPS)
+    I = jnp.eye(d, dtype=dtype)
+
+    if family == "gaussian":
+        link, inv_link, var_fn = (lambda m: m), (lambda e: e), (lambda m: jnp.ones_like(m))
+    elif family == "poisson":
+        link = lambda m: jnp.log(jnp.maximum(m, EPS))
+        inv_link = jnp.exp
+        var_fn = lambda m: jnp.maximum(m, EPS)
+    elif family == "gamma":
+        link = lambda m: jnp.log(jnp.maximum(m, EPS))
+        inv_link = jnp.exp
+        var_fn = lambda m: jnp.maximum(m * m, EPS)
+    else:
+        raise ValueError(f"Unsupported GLR family: {family}")
+
+    def body(_, state):
+        beta, b0 = state
+        eta = X @ beta + b0
+        mu = inv_link(eta)
+        if family == "gaussian":
+            z = y
+            s = w
+        else:
+            # canonical log link: d_mu/d_eta = mu
+            z = eta + (y - mu) / jnp.maximum(mu, EPS)
+            s = w * jnp.maximum(mu, EPS)  # working weights mu^2/var * ... = mu for poisson
+            if family == "gamma":
+                s = w  # mu^2/var = 1 for gamma with log link
+        A = (X * s[:, None]).T @ X / wsum + reg * I + 1e-6 * I
+        rhs = X.T @ (s * (z - b0)) / wsum
+        beta_new = jnp.linalg.solve(A, rhs)
+        if fit_intercept:
+            b0_new = (s * (z - X @ beta_new)).sum() / jnp.maximum(s.sum(), EPS)
+        else:
+            b0_new = b0
+        return beta_new, b0_new
+
+    beta0 = jnp.zeros((d,), dtype)
+    b00 = jnp.asarray(0.0, dtype)
+    return jax.lax.fori_loop(0, max_iter, body, (beta0, b00))
+
+
+def fit_naive_bayes(X: jax.Array, Y: jax.Array, w: jax.Array,
+                    smoothing: float = 1.0) -> Tuple[jax.Array, jax.Array]:
+    """Multinomial naive Bayes (Spark NaiveBayes modelType=multinomial):
+    requires nonnegative features. Returns (log_prob [c, d], log_prior [c])."""
+    w_ = w[:, None]
+    class_count = (Y * w_).sum(0)                      # [c]
+    feat_count = Y.T @ (jnp.maximum(X, 0.0) * w_)      # [c, d]
+    log_prior = jnp.log(jnp.maximum(class_count, EPS)) - \
+        jnp.log(jnp.maximum(class_count.sum(), EPS))
+    num = feat_count + smoothing
+    den = feat_count.sum(1, keepdims=True) + smoothing * X.shape[1]
+    log_prob = jnp.log(num) - jnp.log(den)
+    return log_prob, log_prior

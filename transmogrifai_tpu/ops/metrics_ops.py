@@ -1,0 +1,201 @@
+"""Classification/regression metric kernels (pure jnp, mask-aware).
+
+Reference: core/.../evaluators/ — OpBinaryClassificationEvaluator.scala:56
+(Precision/Recall/F1/AuROC/AuPR/Error/TP-TN-FP-FN + threshold curves),
+OpMultiClassificationEvaluator.scala:58, OpRegressionEvaluator.scala:61.
+
+AuROC/AuPR are sort-based with exact tie handling (metrics evaluated only at
+threshold boundaries), matching Spark MLlib's BinaryClassificationMetrics
+semantics. All functions accept a weight vector so padded rows (device
+sharding) and fold masks (CV) cost nothing.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-12
+
+
+def _sorted_cum_counts(scores: jax.Array, labels: jax.Array,
+                       w: Optional[jax.Array] = None):
+    """Sort by score desc; cumulative weighted TP/FP; tie-boundary mask."""
+    if w is None:
+        w = jnp.ones_like(scores)
+    order = jnp.argsort(-scores)
+    s = scores[order]
+    y = labels[order]
+    ww = w[order]
+    tps = jnp.cumsum(y * ww)
+    fps = jnp.cumsum((1.0 - y) * ww)
+    # boundary i is valid if score[i] != score[i+1] (last of a tie group)
+    nxt = jnp.concatenate([s[1:], jnp.array([-jnp.inf], s.dtype)])
+    boundary = (s != nxt)
+    # zero-weight rows (padding) sort to a tie group; ensure they are inert:
+    # their ww=0 contributes nothing to cumsums. They may create spurious
+    # boundaries but with unchanged cumulative counts => zero-area segments.
+    return tps, fps, boundary
+
+
+def au_roc(scores: jax.Array, labels: jax.Array,
+           w: Optional[jax.Array] = None) -> jax.Array:
+    """Area under ROC (trapezoid over tie-boundary points)."""
+    tps, fps, boundary = _sorted_cum_counts(scores, labels, w)
+    P = tps[-1]
+    N = fps[-1]
+    tpr = tps / jnp.maximum(P, EPS)
+    fpr = fps / jnp.maximum(N, EPS)
+    # prepend (0,0): integrate sum over boundary points of
+    # (fpr_i - fpr_prev) * (tpr_i + tpr_prev)/2, walking only boundaries.
+    # Implement with carry-forward of previous boundary values via scan.
+    def step(carry, xy):
+        pf, pt, acc = carry
+        f, t, b = xy
+        area = jnp.where(b, (f - pf) * (t + pt) * 0.5, 0.0)
+        pf = jnp.where(b, f, pf)
+        pt = jnp.where(b, t, pt)
+        return (pf, pt, acc + area), None
+
+    (pf, pt, acc), _ = jax.lax.scan(
+        step, (jnp.array(0.0, tpr.dtype), jnp.array(0.0, tpr.dtype),
+               jnp.array(0.0, tpr.dtype)),
+        (fpr, tpr, boundary))
+    return acc
+
+
+def au_pr(scores: jax.Array, labels: jax.Array,
+          w: Optional[jax.Array] = None) -> jax.Array:
+    """Area under precision-recall (step interpolation / average precision)."""
+    tps, fps, boundary = _sorted_cum_counts(scores, labels, w)
+    P = jnp.maximum(tps[-1], EPS)
+    recall = tps / P
+    precision = tps / jnp.maximum(tps + fps, EPS)
+
+    def step(carry, xy):
+        pr, acc = carry
+        r, p, b = xy
+        area = jnp.where(b, (r - pr) * p, 0.0)
+        pr = jnp.where(b, r, pr)
+        return (pr, acc + area), None
+
+    (_, acc), _ = jax.lax.scan(
+        step, (jnp.array(0.0, recall.dtype), jnp.array(0.0, recall.dtype)),
+        (recall, precision, boundary))
+    return acc
+
+
+class BinaryMetrics(NamedTuple):
+    au_roc: jax.Array
+    au_pr: jax.Array
+    precision: jax.Array
+    recall: jax.Array
+    f1: jax.Array
+    error: jax.Array
+    tp: jax.Array
+    tn: jax.Array
+    fp: jax.Array
+    fn: jax.Array
+
+
+def binary_metrics(scores: jax.Array, labels: jax.Array,
+                   w: Optional[jax.Array] = None,
+                   threshold: float = 0.5) -> BinaryMetrics:
+    scores = jnp.asarray(scores)
+    labels = jnp.asarray(labels)
+    if w is None:
+        w = jnp.ones_like(scores)
+    pred = (scores >= threshold).astype(scores.dtype)
+    tp = (w * pred * labels).sum()
+    fp = (w * pred * (1 - labels)).sum()
+    tn = (w * (1 - pred) * (1 - labels)).sum()
+    fn = (w * (1 - pred) * labels).sum()
+    precision = tp / jnp.maximum(tp + fp, EPS)
+    recall = tp / jnp.maximum(tp + fn, EPS)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, EPS)
+    error = (fp + fn) / jnp.maximum(tp + tn + fp + fn, EPS)
+    return BinaryMetrics(
+        au_roc=au_roc(scores, labels, w), au_pr=au_pr(scores, labels, w),
+        precision=precision, recall=recall, f1=f1, error=error,
+        tp=tp, tn=tn, fp=fp, fn=fn)
+
+
+def threshold_curves(scores: jax.Array, labels: jax.Array,
+                     w: Optional[jax.Array] = None,
+                     num_bins: int = 100) -> Dict[str, jax.Array]:
+    """Precision/recall/F1 at evenly spaced thresholds (numBins=100,
+    reference OpBinaryClassificationEvaluator threshold metrics)."""
+    scores = jnp.asarray(scores)
+    labels = jnp.asarray(labels)
+    if w is None:
+        w = jnp.ones_like(scores)
+    thresholds = jnp.linspace(0.0, 1.0, num_bins)
+
+    def at(th):
+        pred = (scores >= th).astype(scores.dtype)
+        tp = (w * pred * labels).sum()
+        fp = (w * pred * (1 - labels)).sum()
+        fn = (w * (1 - pred) * labels).sum()
+        prec = tp / jnp.maximum(tp + fp, EPS)
+        rec = tp / jnp.maximum(tp + fn, EPS)
+        f1 = 2 * prec * rec / jnp.maximum(prec + rec, EPS)
+        return prec, rec, f1
+
+    prec, rec, f1 = jax.vmap(at)(thresholds)
+    return {"thresholds": thresholds, "precision": prec, "recall": rec, "f1": f1}
+
+
+class MultiMetrics(NamedTuple):
+    precision: jax.Array  # weighted
+    recall: jax.Array
+    f1: jax.Array
+    error: jax.Array
+
+
+def multiclass_metrics(pred: jax.Array, labels: jax.Array, n_classes: int,
+                       w: Optional[jax.Array] = None) -> MultiMetrics:
+    """Weighted precision/recall/F1/error from predicted & true class ids."""
+    pred = jnp.asarray(pred)
+    labels = jnp.asarray(labels)
+    if w is None:
+        w = jnp.ones(pred.shape, jnp.float32)
+    P = jax.nn.one_hot(pred.astype(jnp.int32), n_classes, dtype=w.dtype)
+    Y = jax.nn.one_hot(labels.astype(jnp.int32), n_classes, dtype=w.dtype) * w[:, None]
+    conf = Y.T @ P  # [true, pred], row-weighted once via Y
+    tp = jnp.diag(conf)
+    per_pred = conf.sum(axis=0)
+    per_true = conf.sum(axis=1)
+    prec_c = tp / jnp.maximum(per_pred, EPS)
+    rec_c = tp / jnp.maximum(per_true, EPS)
+    f1_c = 2 * prec_c * rec_c / jnp.maximum(prec_c + rec_c, EPS)
+    weights = per_true / jnp.maximum(per_true.sum(), EPS)
+    precision = (prec_c * weights).sum()
+    recall = (rec_c * weights).sum()
+    f1 = (f1_c * weights).sum()
+    error = 1.0 - tp.sum() / jnp.maximum(conf.sum(), EPS)
+    return MultiMetrics(precision=precision, recall=recall, f1=f1, error=error)
+
+
+class RegressionMetrics(NamedTuple):
+    rmse: jax.Array
+    mse: jax.Array
+    mae: jax.Array
+    r2: jax.Array
+
+
+def regression_metrics(pred: jax.Array, labels: jax.Array,
+                       w: Optional[jax.Array] = None) -> RegressionMetrics:
+    pred = jnp.asarray(pred)
+    labels = jnp.asarray(labels)
+    if w is None:
+        w = jnp.ones_like(pred)
+    tot = jnp.maximum(w.sum(), EPS)
+    err = pred - labels
+    mse = (w * err * err).sum() / tot
+    mae = (w * jnp.abs(err)).sum() / tot
+    ybar = (w * labels).sum() / tot
+    ss_tot = (w * (labels - ybar) ** 2).sum()
+    ss_res = (w * err * err).sum()
+    r2 = 1.0 - ss_res / jnp.maximum(ss_tot, EPS)
+    return RegressionMetrics(rmse=jnp.sqrt(mse), mse=mse, mae=mae, r2=r2)

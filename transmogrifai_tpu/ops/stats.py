@@ -1,0 +1,230 @@
+"""Statistical reductions as XLA programs.
+
+Reference equivalents: Spark MLlib ``Statistics.colStats`` + the hand-written
+contingency statistics in utils/.../stats/OpStatistics.scala:39
+(chiSquaredTest:188, mutualInfo:234, maxConfidences:280, contingencyStats:300)
+used by the SanityChecker, and Pearson/Spearman correlations
+(SanityChecker.fitFn, core/.../preparators/SanityChecker.scala:535).
+
+All functions are pure, mask-aware (padded rows carry weight 0) and jittable;
+on a sharded feature matrix the reductions lower to per-shard partial sums +
+ICI all-reduce — the TPU version of Spark's treeAggregate.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-12
+
+
+class ColStats(NamedTuple):
+    """Per-column moments over valid (non-NaN, weighted) entries."""
+    count: jax.Array        # [d] valid-entry count
+    mean: jax.Array         # [d]
+    variance: jax.Array     # [d] (unbiased)
+    min: jax.Array          # [d]
+    max: jax.Array          # [d]
+    num_non_zeros: jax.Array  # [d]
+
+
+def col_stats(X: jax.Array, w: Optional[jax.Array] = None) -> ColStats:
+    """Column statistics with NaN-as-missing handling.
+
+    X: [n, d] float; NaN entries are missing. w: [n] row weights (0 for pads).
+    """
+    X = jnp.asarray(X)
+    n, d = X.shape
+    if w is None:
+        w = jnp.ones((n,), X.dtype)
+    valid = jnp.isfinite(X).astype(X.dtype) * w[:, None]
+    Xz = jnp.where(jnp.isfinite(X), X, 0.0)
+    cnt = valid.sum(axis=0)
+    s1 = (Xz * valid).sum(axis=0)
+    s2 = (Xz * Xz * valid).sum(axis=0)
+    mean = s1 / jnp.maximum(cnt, EPS)
+    var = (s2 - cnt * mean * mean) / jnp.maximum(cnt - 1.0, 1.0)
+    var = jnp.maximum(var, 0.0)
+    big = jnp.asarray(jnp.finfo(X.dtype).max, X.dtype)
+    xmin = jnp.where(valid > 0, Xz, big).min(axis=0)
+    xmax = jnp.where(valid > 0, Xz, -big).max(axis=0)
+    nnz = ((Xz != 0) & (valid > 0)).astype(X.dtype).sum(axis=0)
+    return ColStats(count=cnt, mean=mean, variance=var, min=xmin, max=xmax,
+                    num_non_zeros=nnz)
+
+
+def pearson_with_label(X: jax.Array, y: jax.Array,
+                       w: Optional[jax.Array] = None) -> jax.Array:
+    """Pearson correlation of every column with the label. [n,d],[n] -> [d].
+
+    Matches OpStatistics.computeCorrelationsWithLabel (utils
+    OpStatistics.scala:71). NaN entries contribute nothing.
+    """
+    X = jnp.asarray(X)
+    y = jnp.asarray(y)
+    n, d = X.shape
+    if w is None:
+        w = jnp.ones((n,), X.dtype)
+    valid = jnp.isfinite(X).astype(X.dtype) * w[:, None]
+    Xz = jnp.where(jnp.isfinite(X), X, 0.0)
+    cnt = jnp.maximum(valid.sum(axis=0), EPS)
+    mx = (Xz * valid).sum(axis=0) / cnt
+    my = (y[:, None] * valid).sum(axis=0) / cnt
+    dx = (Xz - mx[None, :]) * valid
+    dy = (y[:, None] - my[None, :]) * valid
+    cov = (dx * dy).sum(axis=0)
+    vx = (dx * dx).sum(axis=0)
+    vy = (dy * dy).sum(axis=0)
+    return cov / jnp.sqrt(jnp.maximum(vx * vy, EPS * EPS))
+
+
+def pearson_matrix(X: jax.Array, w: Optional[jax.Array] = None) -> jax.Array:
+    """Full Pearson correlation matrix [d,d] — one X^T X matmul on the MXU
+    (the SanityChecker 'corrType=full' path). NaNs are imputed to column mean
+    (pairwise-complete is a host decision; mean-impute keeps one matmul)."""
+    X = jnp.asarray(X)
+    n, d = X.shape
+    if w is None:
+        w = jnp.ones((n,), X.dtype)
+    stats = col_stats(X, w)
+    Xf = jnp.where(jnp.isfinite(X), X, stats.mean[None, :])
+    wsum = jnp.maximum(w.sum(), EPS)
+    mean = (Xf * w[:, None]).sum(axis=0) / wsum
+    Xc = (Xf - mean[None, :]) * jnp.sqrt(w)[:, None]
+    cov = Xc.T @ Xc
+    sd = jnp.sqrt(jnp.maximum(jnp.diag(cov), EPS))
+    return cov / (sd[:, None] * sd[None, :])
+
+
+def _rank_with_nan(x: jax.Array, w: jax.Array) -> jax.Array:
+    """Average-tie-free ranks; NaN/pad rows get rank 0 weight anyway."""
+    n = x.shape[0]
+    finite = jnp.isfinite(x) & (w > 0)
+    xk = jnp.where(finite, x, jnp.inf)
+    order = jnp.argsort(xk)
+    ranks = jnp.zeros((n,), x.dtype).at[order].set(
+        jnp.arange(1, n + 1, dtype=x.dtype))
+    return jnp.where(finite, ranks, jnp.nan)
+
+
+def spearman_with_label(X: jax.Array, y: jax.Array,
+                        w: Optional[jax.Array] = None) -> jax.Array:
+    """Spearman = Pearson on ranks (SanityChecker CorrelationType.Spearman).
+
+    Pairwise-complete: for each column, BOTH the column and the label are
+    re-ranked within that column's valid (non-NaN, weighted) rows.
+    """
+    X = jnp.asarray(X)
+    y = jnp.asarray(y)
+    if w is None:
+        w = jnp.ones(y.shape, X.dtype)
+
+    def per_column(col):
+        wv = w * jnp.isfinite(col).astype(X.dtype)
+        cr = _rank_with_nan(col, wv)
+        yr = _rank_with_nan(jnp.where(wv > 0, y, jnp.nan), wv)
+        # zero-fill invalid label ranks: the weight mask excludes them, and
+        # NaN * 0 would otherwise poison the weighted sums
+        yr = jnp.where(wv > 0, yr, 0.0)
+        return pearson_with_label(cr[:, None], yr, wv)[0]
+
+    return jax.vmap(per_column, in_axes=1)(X)
+
+
+# -- contingency statistics (OpStatistics.scala) ---------------------------
+
+def contingency_table(G: jax.Array, Y: jax.Array,
+                      w: Optional[jax.Array] = None) -> jax.Array:
+    """Contingency counts between a group of indicator columns and one-hot
+    labels: [n,k] x [n,c] -> [k,c] — a single matmul (MXU) replacing the
+    reference's reduceByKey count aggregation (SanityChecker.scala:440)."""
+    G = jnp.asarray(G)
+    Y = jnp.asarray(Y)
+    if w is not None:
+        G = G * w[:, None]
+    Gz = jnp.where(jnp.isfinite(G), G, 0.0)
+    return Gz.T @ Y
+
+
+class ContingencyStats(NamedTuple):
+    chi2: jax.Array             # scalar chi-squared statistic
+    cramers_v: jax.Array        # scalar
+    mutual_info: jax.Array      # scalar (natural log)
+    pointwise_mutual_info: jax.Array  # [k, c]
+    max_rule_confidences: jax.Array   # [k] max_c P(c | row k)
+    supports: jax.Array         # [k] row support fraction
+
+
+def contingency_stats(table: jax.Array) -> ContingencyStats:
+    """Chi²/Cramér's V/MI/PMI/max-rule-confidence from a [k,c] count table.
+
+    Ports OpStatistics.{chiSquaredTest:188, mutualInfo:234,
+    maxConfidences:280, contingencyStats:300}.
+    """
+    t = jnp.asarray(table, jnp.float64 if table.dtype == jnp.float64 else jnp.float32)
+    total = jnp.maximum(t.sum(), EPS)
+    rows = t.sum(axis=1)
+    cols = t.sum(axis=0)
+    expected = rows[:, None] * cols[None, :] / total
+    chi2 = jnp.where(expected > 0, (t - expected) ** 2 /
+                     jnp.maximum(expected, EPS), 0.0).sum()
+    k = (rows > 0).sum()
+    c = (cols > 0).sum()
+    dof = jnp.maximum(jnp.minimum(k - 1, c - 1), 1).astype(t.dtype)
+    cramers_v = jnp.sqrt(chi2 / (total * dof))
+    p = t / total
+    px = rows / total
+    py = cols / total
+    pxy_ind = px[:, None] * py[None, :]
+    pmi = jnp.where((p > 0) & (pxy_ind > 0),
+                    jnp.log(jnp.maximum(p, EPS) / jnp.maximum(pxy_ind, EPS)),
+                    0.0)
+    mi = (jnp.where(p > 0, p * pmi, 0.0)).sum()
+    conf = t / jnp.maximum(rows[:, None], EPS)
+    max_conf = conf.max(axis=1)
+    support = rows / total
+    return ContingencyStats(chi2=chi2, cramers_v=cramers_v, mutual_info=mi,
+                            pointwise_mutual_info=pmi,
+                            max_rule_confidences=max_conf, supports=support)
+
+
+def fill_rate(X: jax.Array, w: Optional[jax.Array] = None) -> jax.Array:
+    """Fraction of non-missing entries per column (RawFeatureFilter
+    FeatureDistribution.fillRate, core/.../filters/FeatureDistribution.scala:92)."""
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    if w is None:
+        w = jnp.ones((n,), X.dtype)
+    tot = jnp.maximum(w.sum(), EPS)
+    return (jnp.isfinite(X).astype(X.dtype) * w[:, None]).sum(axis=0) / tot
+
+
+def js_divergence(p: jax.Array, q: jax.Array) -> jax.Array:
+    """Jensen-Shannon divergence between (batched) histograms, normalized.
+    (FeatureDistribution.jsDivergence, core/.../filters/FeatureDistribution.scala:138)."""
+    p = p / jnp.maximum(p.sum(axis=-1, keepdims=True), EPS)
+    q = q / jnp.maximum(q.sum(axis=-1, keepdims=True), EPS)
+    m = 0.5 * (p + q)
+
+    def kl(a, b):
+        return jnp.where(a > 0, a * jnp.log2(jnp.maximum(a, EPS) /
+                                             jnp.maximum(b, EPS)), 0.0).sum(axis=-1)
+
+    return 0.5 * kl(p, m) + 0.5 * kl(q, m)
+
+
+def histogram_fixed(x: jax.Array, lo: jax.Array, hi: jax.Array, bins: int,
+                    w: Optional[jax.Array] = None) -> jax.Array:
+    """Fixed-width histogram via one-hot segment sum (static shape: `bins`)."""
+    x = jnp.asarray(x)
+    if w is None:
+        w = jnp.ones(x.shape, x.dtype)
+    finite = jnp.isfinite(x)
+    width = jnp.maximum(hi - lo, EPS)
+    idx = jnp.clip(((x - lo) / width * bins).astype(jnp.int32), 0, bins - 1)
+    idx = jnp.where(finite, idx, 0)
+    wt = jnp.where(finite, w, 0.0)
+    return jax.ops.segment_sum(wt, idx, num_segments=bins)

@@ -1,0 +1,104 @@
+"""Device mesh + sharding substrate.
+
+The reference delegates distribution to Spark (partitioned RDDs + shuffle,
+SURVEY §2.9). Here the equivalent is a named `jax.sharding.Mesh` with GSPMD
+sharding annotations: feature-matrix rows ride the ``batch`` axis (Spark
+partitions), CV-fold and hyperparameter-grid replication ride ``model``
+(thread-pool parallelism of OpValidator.scala:318), and XLA inserts the
+all-reduce/all-gather collectives over ICI/DCN that replace shuffle + Rabit.
+
+All kernels in ops/ and models/ are written mesh-oblivious (pure jnp) and get
+distribution purely through input shardings — single-chip and pod runs use
+identical program text.
+"""
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+BATCH_AXIS = "batch"
+MODEL_AXIS = "model"
+
+_active_mesh: Optional[Mesh] = None
+
+
+def make_mesh(n_batch: Optional[int] = None, n_model: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Create a (batch, model) mesh over available devices."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_batch is None:
+        n_batch = len(devs) // n_model
+    use = devs[: n_batch * n_model]
+    arr = np.array(use).reshape(n_batch, n_model)
+    return Mesh(arr, (BATCH_AXIS, MODEL_AXIS))
+
+
+def default_mesh() -> Mesh:
+    global _active_mesh
+    if _active_mesh is None:
+        _active_mesh = make_mesh()
+    return _active_mesh
+
+
+@contextmanager
+def use_mesh(mesh: Mesh):
+    global _active_mesh
+    prev = _active_mesh
+    _active_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _active_mesh = prev
+
+
+def batch_sharding(mesh: Optional[Mesh] = None, ndim: int = 2) -> NamedSharding:
+    """Rows sharded over the batch axis; all other dims replicated."""
+    mesh = mesh or default_mesh()
+    spec = P(BATCH_AXIS, *([None] * (ndim - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
+    mesh = mesh or default_mesh()
+    return NamedSharding(mesh, P())
+
+
+def pad_rows_to_multiple(x: np.ndarray, multiple: int,
+                         pad_value: float = 0.0) -> Tuple[np.ndarray, int]:
+    """Pad rows so the batch axis divides evenly across devices. Returns the
+    padded array and the original row count (callers carry a weight/mask
+    vector so padded rows never affect statistics)."""
+    n = x.shape[0]
+    rem = n % multiple
+    if rem == 0:
+        return x, n
+    pad = multiple - rem
+    pad_block = np.full((pad,) + x.shape[1:], pad_value, dtype=x.dtype)
+    return np.concatenate([x, pad_block], axis=0), n
+
+
+def device_put_batch(x: np.ndarray, mesh: Optional[Mesh] = None,
+                     pad: bool = True) -> Tuple[jax.Array, int]:
+    """Host -> HBM with rows sharded on the batch axis.
+
+    Returns (device array, true row count). When `pad`, rows are zero-padded
+    to a multiple of the batch-axis size (XLA requires even sharding).
+    """
+    mesh = mesh or default_mesh()
+    n_shards = mesh.shape[BATCH_AXIS]
+    n = x.shape[0]
+    if pad:
+        x, n = pad_rows_to_multiple(np.asarray(x), n_shards)
+    return jax.device_put(x, batch_sharding(mesh, ndim=x.ndim)), n
+
+
+def row_mask(n_padded: int, n_true: int) -> np.ndarray:
+    """1.0 for real rows, 0.0 for padding."""
+    m = np.zeros((n_padded,), dtype=np.float32)
+    m[:n_true] = 1.0
+    return m

@@ -1,0 +1,328 @@
+"""Data readers: ingestion + temporal aggregation.
+
+Reference: readers/ module — Reader.scala:96, DataReader.scala:57-252,
+JoinedDataReader.scala, DataReaders factory. The reference delegates
+partitioned execution to Spark; here ingestion is a host-side columnar
+pipeline (records -> extract per raw feature -> typed Column arrays) feeding
+the device matrix. reduceByKey becomes an in-memory group-by with monoid
+aggregators (the same per-feature aggregators, applied with cutoff-time
+semantics).
+"""
+from __future__ import annotations
+
+import csv as _csv
+import json
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.dataset import Dataset, column_from_values
+from ..features.feature import Feature
+from ..features.generator import FeatureGeneratorStage
+from ..types import FeatureType
+
+
+Record = Any  # dict-like or object; feature extract fns know how to read it
+
+
+class Reader:
+    """Base reader: produce records, then materialize the raw-feature dataset
+    (reference Reader.generateDataFrame, DataReader.scala:173)."""
+
+    def __init__(self, key_fn: Optional[Callable[[Record], str]] = None):
+        self.key_fn = key_fn
+
+    def read(self) -> List[Record]:
+        raise NotImplementedError
+
+    def _generator_of(self, f: Feature) -> FeatureGeneratorStage:
+        st = f.origin_stage
+        if not isinstance(st, FeatureGeneratorStage):
+            raise ValueError(f"Feature '{f.name}' is not a raw feature")
+        return st
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        records = self.read()
+        gens = [self._generator_of(f) for f in raw_features]
+        cols = {}
+        for f, g in zip(raw_features, gens):
+            vals = [g.extract(r) for r in records]
+            cols[f.name] = column_from_values(f.feature_type, vals)
+        key_col = None
+        if self.key_fn is not None:
+            keys = np.empty(len(records), dtype=object)
+            for i, r in enumerate(records):
+                keys[i] = str(self.key_fn(r))
+            from ..data.dataset import Column
+            from ..types import ColumnKind
+            key_col = Column(kind=ColumnKind.STRING, data=keys)
+        ds = Dataset(cols)
+        if key_col is not None:
+            ds = ds.with_column(KEY_COLUMN, key_col)
+        return ds
+
+
+KEY_COLUMN = "key"
+
+
+class ListReader(Reader):
+    """Reader over in-memory records (dicts or objects)."""
+
+    def __init__(self, records: Sequence[Record],
+                 key_fn: Optional[Callable[[Record], str]] = None):
+        super().__init__(key_fn)
+        self._records = list(records)
+
+    def read(self) -> List[Record]:
+        return self._records
+
+
+class CSVReader(Reader):
+    """CSV reader with light type coercion (reference CSVReaders.scala /
+    CSVAutoReaders.scala — schema'd and auto-inferring variants)."""
+
+    def __init__(self, path: str, key_fn: Optional[Callable[[Record], str]] = None,
+                 schema: Optional[Dict[str, Callable[[str], Any]]] = None,
+                 null_values: Sequence[str] = ("", "NA", "null", "NULL", "None")):
+        super().__init__(key_fn)
+        self.path = path
+        self.schema = schema
+        self.null_values = set(null_values)
+
+    def _coerce(self, name: str, v: str) -> Any:
+        if v is None or v in self.null_values:
+            return None
+        if self.schema and name in self.schema:
+            try:
+                return self.schema[name](v)
+            except (ValueError, TypeError):
+                return None
+        try:
+            f = float(v)
+            if f.is_integer() and "." not in v and "e" not in v.lower():
+                return int(v)
+            return f
+        except ValueError:
+            return v
+
+    def read(self) -> List[Record]:
+        out: List[Record] = []
+        with open(self.path, newline="") as fh:
+            for row in _csv.DictReader(fh):
+                out.append({k: self._coerce(k, v) for k, v in row.items()})
+        return out
+
+
+class JSONLinesReader(Reader):
+    def __init__(self, path: str, key_fn: Optional[Callable[[Record], str]] = None):
+        super().__init__(key_fn)
+        self.path = path
+
+    def read(self) -> List[Record]:
+        with open(self.path) as fh:
+            return [json.loads(line) for line in fh if line.strip()]
+
+
+class ParquetReader(Reader):
+    """Parquet via pyarrow if available (reference ParquetProductReader)."""
+
+    def __init__(self, path: str, key_fn: Optional[Callable[[Record], str]] = None):
+        super().__init__(key_fn)
+        self.path = path
+
+    def read(self) -> List[Record]:
+        try:
+            import pyarrow.parquet as pq  # optional dep
+        except ImportError as e:
+            raise ImportError(
+                "ParquetReader requires pyarrow; not available in this "
+                "environment — use CSVReader/JSONLinesReader") from e
+        table = pq.read_table(self.path)
+        return table.to_pylist()
+
+
+class AggregateReader(Reader):
+    """Groups event records by key and aggregates each feature with its monoid
+    aggregator relative to a cutoff time — one output row per key (reference
+    AggregatedReader.generateDataFrame, DataReader.scala:206-252)."""
+
+    def __init__(self, base: Reader, key_fn: Callable[[Record], str],
+                 cutoff_time: Optional[int] = None,
+                 event_time_fn: Optional[Callable[[Record], Optional[int]]] = None):
+        super().__init__(key_fn)
+        self.base = base
+        self.cutoff_time = cutoff_time
+        self.event_time_fn = event_time_fn
+
+    def read(self) -> List[Record]:
+        return self.base.read()
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        records = self.read()
+        gens = [self._generator_of(f) for f in raw_features]
+        # group by key preserving first-seen order (reduceByKey equivalent)
+        groups: Dict[str, List[Record]] = {}
+        order: List[str] = []
+        for r in records:
+            k = str(self.key_fn(r))
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(r)
+        cols: Dict[str, Any] = {}
+        for f, g in zip(raw_features, gens):
+            time_fn = g.event_time_fn or self.event_time_fn
+            vals = []
+            for k in order:
+                events = []
+                for r in groups[k]:
+                    t = time_fn(r) if time_fn else None
+                    events.append((g.extract(r), t))
+                vals.append(g.aggregator.extract(
+                    events, cutoff_time=self.cutoff_time,
+                    is_response=f.is_response))
+            cols[f.name] = column_from_values(f.feature_type, vals)
+        ds = Dataset(cols)
+        keys = np.empty(len(order), dtype=object)
+        for i, k in enumerate(order):
+            keys[i] = k
+        from ..data.dataset import Column
+        from ..types import ColumnKind
+        return ds.with_column(KEY_COLUMN, Column(kind=ColumnKind.STRING, data=keys))
+
+
+class ConditionalReader(AggregateReader):
+    """Two-pass temporal reader (reference ConditionalDataReader): pass 1
+    finds each key's target time via a condition; pass 2 aggregates
+    predictors before and responses after that per-key time."""
+
+    def __init__(self, base: Reader, key_fn: Callable[[Record], str],
+                 condition_fn: Callable[[Record], bool],
+                 event_time_fn: Callable[[Record], Optional[int]],
+                 drop_if_no_condition: bool = True):
+        super().__init__(base, key_fn, cutoff_time=None, event_time_fn=event_time_fn)
+        self.condition_fn = condition_fn
+        self.drop_if_no_condition = drop_if_no_condition
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        records = self.read()
+        gens = [self._generator_of(f) for f in raw_features]
+        groups: Dict[str, List[Record]] = {}
+        order: List[str] = []
+        for r in records:
+            k = str(self.key_fn(r))
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(r)
+        # pass 1: per-key target time = earliest event satisfying condition
+        target: Dict[str, Optional[int]] = {}
+        for k in order:
+            times = [self.event_time_fn(r) for r in groups[k] if self.condition_fn(r)]
+            times = [t for t in times if t is not None]
+            target[k] = min(times) if times else None
+        keep = [k for k in order
+                if target[k] is not None or not self.drop_if_no_condition]
+        cols: Dict[str, Any] = {}
+        for f, g in zip(raw_features, gens):
+            vals = []
+            for k in keep:
+                events = [(g.extract(r), self.event_time_fn(r)) for r in groups[k]]
+                vals.append(g.aggregator.extract(
+                    events, cutoff_time=target[k], is_response=f.is_response))
+            cols[f.name] = column_from_values(f.feature_type, vals)
+        ds = Dataset(cols)
+        keys = np.empty(len(keep), dtype=object)
+        for i, k in enumerate(keep):
+            keys[i] = k
+        from ..data.dataset import Column
+        from ..types import ColumnKind
+        return ds.with_column(KEY_COLUMN, Column(kind=ColumnKind.STRING, data=keys))
+
+
+class JoinedReader(Reader):
+    """Key-joins two readers' generated datasets (reference
+    JoinedDataReader.scala:83 — left-outer by key columns)."""
+
+    def __init__(self, left: Reader, right: Reader, join_type: str = "outer"):
+        super().__init__(None)
+        self.left = left
+        self.right = right
+        if join_type not in ("outer", "inner", "left"):
+            raise ValueError(f"Unsupported join type: {join_type}")
+        self.join_type = join_type
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        left_feats = [f for f in raw_features if self._belongs(self.left, f)]
+        right_feats = [f for f in raw_features if f not in left_feats]
+        lds = self.left.generate_dataset(left_feats)
+        rds = self.right.generate_dataset(right_feats)
+        if KEY_COLUMN not in lds or KEY_COLUMN not in rds:
+            raise ValueError("JoinedReader requires key_fn on both readers")
+        lkeys = list(lds.data(KEY_COLUMN))
+        rkeys = list(rds.data(KEY_COLUMN))
+        rindex = {k: i for i, k in enumerate(rkeys)}
+        lindex = {k: i for i, k in enumerate(lkeys)}
+        if self.join_type == "inner":
+            keys = [k for k in lkeys if k in rindex]
+        elif self.join_type == "left":
+            keys = lkeys
+        else:
+            keys = lkeys + [k for k in rkeys if k not in lindex]
+        cols: Dict[str, Any] = {}
+        for f in left_feats:
+            src = lds.data(f.name)
+            vals = [src[lindex[k]] if k in lindex else None for k in keys]
+            cols[f.name] = _recolumn(f, lds, vals)
+        for f in right_feats:
+            src = rds.data(f.name)
+            vals = [src[rindex[k]] if k in rindex else None for k in keys]
+            cols[f.name] = _recolumn(f, rds, vals)
+        ds = Dataset(cols)
+        arr = np.empty(len(keys), dtype=object)
+        for i, k in enumerate(keys):
+            arr[i] = k
+        from ..data.dataset import Column
+        from ..types import ColumnKind
+        return ds.with_column(KEY_COLUMN, Column(kind=ColumnKind.STRING, data=arr))
+
+    @staticmethod
+    def _belongs(reader: Reader, f: Feature) -> bool:
+        # features are routed to the reader whose records they extract from;
+        # convention: the user lists left features first and tags via
+        # feature origin 'reader_hint' when ambiguous
+        hint = getattr(f.origin_stage, "reader_hint", None)
+        return hint is None or hint is reader or hint == id(reader)
+
+
+def _recolumn(f: Feature, ds: Dataset, vals: List[Any]):
+    col = column_from_values(f.feature_type, vals)
+    return col
+
+
+class DataReaders:
+    """Factory namespace (reference DataReaders.scala:44-198)."""
+
+    class Simple:
+        csv = CSVReader
+        json_lines = JSONLinesReader
+        parquet = ParquetReader
+        records = ListReader
+
+    class Aggregate:
+        @staticmethod
+        def csv(path: str, key_fn, cutoff_time=None, event_time_fn=None, **kw):
+            return AggregateReader(CSVReader(path, **kw), key_fn,
+                                   cutoff_time, event_time_fn)
+
+        @staticmethod
+        def records(records, key_fn, cutoff_time=None, event_time_fn=None):
+            return AggregateReader(ListReader(records), key_fn,
+                                   cutoff_time, event_time_fn)
+
+    class Conditional:
+        @staticmethod
+        def records(records, key_fn, condition_fn, event_time_fn, **kw):
+            return ConditionalReader(ListReader(records), key_fn,
+                                     condition_fn, event_time_fn, **kw)
