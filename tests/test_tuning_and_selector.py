@@ -1,0 +1,222 @@
+"""Tests for splitters, validators, and the ModelSelector sweep.
+
+Mirrors reference suites core/src/test/.../impl/tuning/{DataBalancerTest,
+DataCutterTest,OpCrossValidationTest}.scala and
+.../impl/selector/ModelSelectorTest.scala.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.automl import (
+    BinaryClassificationModelSelector, CrossValidation, DataBalancer,
+    DataCutter, DataSplitter, MultiClassificationModelSelector,
+    RegressionModelSelector, TrainValidationSplit,
+)
+from transmogrifai_tpu.automl.selector import ModelSelector
+from transmogrifai_tpu.data.dataset import column_from_values
+from transmogrifai_tpu.evaluators.evaluators import Evaluators
+from transmogrifai_tpu.models.glm import (
+    OpLinearRegression, OpLinearSVC, OpLogisticRegression, OpNaiveBayes,
+)
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.types import OPVector, RealNN
+
+
+def _binary_data(rng, n=400, d=6):
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.linspace(1.0, -1.0, d).astype(np.float32)
+    p = 1 / (1 + np.exp(-(X @ beta)))
+    y = (rng.uniform(size=n) < p).astype(np.float32)
+    return X, y
+
+
+# -- splitters --------------------------------------------------------------
+
+def test_splitter_holdout_fractions(rng):
+    sp = DataSplitter(seed=1, reserve_test_fraction=0.2)
+    tr, te = sp.split(1000)
+    assert len(tr) == 800 and len(te) == 200
+    assert len(np.intersect1d(tr, te)) == 0
+    assert len(np.union1d(tr, te)) == 1000
+
+
+def test_data_balancer_downsamples_majority(rng):
+    y = np.concatenate([np.ones(50), np.zeros(5000)]).astype(np.float32)
+    b = DataBalancer(seed=7, sample_fraction=0.1)
+    prep = b.prepare(y)
+    yb = y[prep.indices]
+    frac = yb.sum() / len(yb)
+    assert abs(frac - 0.1) < 0.02
+    assert not prep.summary["already_balanced"]
+
+
+def test_data_balancer_balanced_passthrough(rng):
+    y = (rng.uniform(size=1000) < 0.4).astype(np.float32)
+    b = DataBalancer(seed=7, sample_fraction=0.1)
+    prep = b.prepare(y)
+    assert prep.summary["already_balanced"]
+    assert len(prep.indices) == 1000
+
+
+def test_data_balancer_caps_max_training_sample():
+    y = np.concatenate([np.ones(500), np.zeros(5000)]).astype(np.float32)
+    b = DataBalancer(seed=7, sample_fraction=0.2, max_training_sample=2000)
+    prep = b.prepare(y)
+    assert len(prep.indices) <= 2100
+    yb = y[prep.indices]
+    assert abs(yb.sum() / len(yb) - 0.2) < 0.05
+
+
+def test_data_cutter_drops_rare_labels(rng):
+    y = np.array([0.0] * 500 + [1.0] * 450 + [2.0] * 3).astype(np.float32)
+    c = DataCutter(seed=1, min_label_fraction=0.01)
+    prep = c.prepare(y)
+    assert prep.summary["labels_dropped"] == [2.0]
+    assert set(np.unique(y[prep.indices])) == {0.0, 1.0}
+    assert prep.label_map == {0: 0, 1: 1}
+
+
+def test_data_cutter_max_categories(rng):
+    y = rng.integers(0, 20, size=2000).astype(np.float32)
+    c = DataCutter(seed=1, max_label_categories=5)
+    prep = c.prepare(y)
+    assert len(np.unique(y[prep.indices])) == 5
+
+
+# -- validators -------------------------------------------------------------
+
+def test_cv_fold_masks_partition(rng):
+    y = (rng.uniform(size=100) < 0.5).astype(np.float32)
+    cv = CrossValidation(Evaluators.BinaryClassification.au_pr(), num_folds=4)
+    masks = cv.fold_masks(y)
+    assert masks.shape == (4, 100)
+    # every row is in validation exactly once
+    assert np.allclose((1 - masks).sum(axis=0), 1.0)
+
+
+def test_cv_stratified_fold_masks(rng):
+    y = np.concatenate([np.ones(30), np.zeros(90)]).astype(np.float32)
+    cv = CrossValidation(Evaluators.BinaryClassification.au_pr(),
+                         num_folds=3, stratify=True)
+    masks = cv.fold_masks(y)
+    for f in range(3):
+        val = masks[f] == 0
+        assert y[val].sum() == 10  # positives spread evenly
+
+
+def test_cv_vmapped_matches_sequential(rng):
+    """The vmapped GLM sweep must rank grids like the per-fold loop."""
+    X, y = _binary_data(rng)
+    grids = param_grid(reg_param=[0.01, 0.1], elastic_net_param=[0.0])
+    ev = Evaluators.BinaryClassification.au_roc()
+    cv = CrossValidation(ev, num_folds=3, seed=5)
+    est = OpLogisticRegression(max_iter=25)
+
+    best_v = cv.validate([(est, grids)], X, y, problem_type="binary")
+    vmapped = {tuple(sorted(v.grid.items())): v.mean_metric
+               for v in best_v.validated}
+
+    seq = cv._validate_sequential(est, grids, X, y,
+                                  np.ones_like(y), cv.fold_masks(y))
+    seqd = {tuple(sorted(v.grid.items())): v.mean_metric for v in seq}
+    for k in vmapped:
+        assert abs(vmapped[k] - seqd[k]) < 0.02, (k, vmapped[k], seqd[k])
+
+
+def test_cv_picks_better_model(rng):
+    X, y = _binary_data(rng)
+    ev = Evaluators.BinaryClassification.au_roc()
+    cv = CrossValidation(ev, num_folds=3, seed=5)
+    lr = OpLogisticRegression(max_iter=25)
+    # absurd L1 zeroes every coefficient -> constant scores -> AuROC 0.5
+    best = cv.validate(
+        [(lr, param_grid(reg_param=[0.01, 1000.0],
+                         elastic_net_param=[1.0]))], X, y,
+        problem_type="binary")
+    assert best.best_grid["reg_param"] == 0.01
+    assert best.best_metric > 0.8
+
+
+def test_train_validation_split(rng):
+    X, y = _binary_data(rng)
+    ev = Evaluators.BinaryClassification.au_roc()
+    tvs = TrainValidationSplit(ev, train_ratio=0.75, seed=5)
+    masks = tvs.fold_masks(y)
+    assert masks.shape[0] == 1
+    frac_val = (masks[0] == 0).mean()
+    assert 0.2 < frac_val < 0.3
+    best = tvs.validate([(OpLogisticRegression(max_iter=25),
+                          param_grid(reg_param=[0.01]))], X, y,
+                        problem_type="binary")
+    assert best.best_metric > 0.8
+
+
+def test_validator_mixed_vmapped_and_sequential(rng):
+    X, y = _binary_data(rng)
+    ev = Evaluators.BinaryClassification.au_roc()
+    cv = CrossValidation(ev, num_folds=2, seed=3)
+    best = cv.validate(
+        [(OpLogisticRegression(max_iter=25), param_grid(reg_param=[0.01])),
+         (OpNaiveBayes(), [dict()])],
+        X, y, problem_type="binary")
+    assert best.name in ("OpLogisticRegression", "OpNaiveBayes")
+    assert len(best.validated) == 2
+
+
+# -- model selector ---------------------------------------------------------
+
+def test_binary_selector_end_to_end(rng):
+    X, y = _binary_data(rng, n=600)
+    sel = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, seed=11,
+        model_types=("OpLogisticRegression", "OpLinearSVC"))
+    model = sel.fit_arrays(X, y)
+    s = model.summary
+    assert s.best_model_name in ("OpLogisticRegression", "OpLinearSVC")
+    # 4*2 LR grids + 4 SVC grids
+    assert len(s.validation_results) == 12
+    assert s.holdout_evaluation["au_roc"] > 0.75
+    assert "au_pr" in s.train_evaluation
+    pred, raw, prob = model.predict_arrays(X)
+    assert pred.shape == (600,)
+    assert set(np.unique(pred)) <= {0.0, 1.0}
+    assert "Selected:" in s.pretty()
+
+
+def test_multiclass_selector(rng):
+    n, d = 900, 5
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    centers = rng.normal(size=(3, d)).astype(np.float32) * 3
+    y = rng.integers(0, 3, size=n).astype(np.float32)
+    X += centers[y.astype(int)]
+    sel = MultiClassificationModelSelector.with_cross_validation(
+        num_folds=2, seed=3, model_types=("OpLogisticRegression",))
+    model = sel.fit_arrays(X, y)
+    assert model.summary.problem_type == "multiclass"
+    pred, _, prob = model.predict_arrays(X)
+    acc = (pred == y).mean()
+    assert acc > 0.8
+    assert prob.shape == (n, 3)
+
+
+def test_regression_selector(rng):
+    n, d = 500, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    beta = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = X @ beta + 0.1 * rng.normal(size=n).astype(np.float32)
+    sel = RegressionModelSelector.with_train_validation_split(
+        seed=3, model_types=("OpLinearRegression",))
+    model = sel.fit_arrays(X, y.astype(np.float32))
+    assert model.summary.holdout_evaluation["rmse"] < 0.3
+    pred, _, _ = model.predict_arrays(X)
+    assert np.corrcoef(pred, y)[0, 1] > 0.99
+
+
+def test_selector_fit_columns_path(rng):
+    X, y = _binary_data(rng, n=200)
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        seed=1, model_types=("OpLogisticRegression",))
+    label_col = column_from_values(RealNN, [float(v) for v in y])
+    vec_col = column_from_values(OPVector, [list(map(float, r)) for r in X])
+    model = sel.fit_columns(label_col, vec_col)
+    assert model.summary.best_model_name == "OpLogisticRegression"

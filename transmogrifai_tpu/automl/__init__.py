@@ -1,0 +1,54 @@
+"""AutoML: transmogrification, validation, model selection.
+
+Reference: core/.../impl/{feature,preparators,tuning,selector,
+classification,regression}.
+"""
+from .selector import ModelSelector, ModelSelectorSummary, SelectedModel
+from .selectors import (
+    BinaryClassificationModelSelector,
+    DefaultSelectorParams,
+    MultiClassificationModelSelector,
+    RegressionModelSelector,
+    default_grid_for,
+)
+from .transmogrifier import (
+    DEFAULTS as TRANSMOGRIFIER_DEFAULTS,
+    TransmogrifierDefaults,
+    transmogrify,
+    vectorize_by_type,
+)
+from .tuning import (
+    BestEstimator,
+    CrossValidation,
+    DataBalancer,
+    DataCutter,
+    DataSplitter,
+    Splitter,
+    TrainValidationSplit,
+    ValidatedModel,
+    Validator,
+)
+
+__all__ = [
+    "BestEstimator",
+    "BinaryClassificationModelSelector",
+    "CrossValidation",
+    "DataBalancer",
+    "DataCutter",
+    "DataSplitter",
+    "DefaultSelectorParams",
+    "ModelSelector",
+    "ModelSelectorSummary",
+    "MultiClassificationModelSelector",
+    "RegressionModelSelector",
+    "SelectedModel",
+    "Splitter",
+    "TrainValidationSplit",
+    "TransmogrifierDefaults",
+    "TRANSMOGRIFIER_DEFAULTS",
+    "ValidatedModel",
+    "Validator",
+    "default_grid_for",
+    "transmogrify",
+    "vectorize_by_type",
+]

@@ -57,7 +57,11 @@ def transmogrify(features: Sequence[Feature],
                  label: Optional[Feature] = None,
                  defaults: TransmogrifierDefaults = DEFAULTS) -> Feature:
     """Vectorize features by type and combine into one OPVector feature
-    (reference Transmogrifier.transmogrify:102-348 + .transmogrify() dsl)."""
+    (reference Transmogrifier.transmogrify:102-348 + .transmogrify() dsl).
+
+    ``label`` is consumed by label-aware vectorizers (the reference's
+    decision-tree bucketizers); groups without a label-aware default ignore
+    it, matching the reference when no response is in scope."""
     vector_feats = vectorize_by_type(features, label=label, defaults=defaults)
     if len(vector_feats) == 1:
         return vector_feats[0]

@@ -1,0 +1,32 @@
+"""Data splitting/balancing + validation (CV / train-validation split).
+
+Reference: core/.../impl/tuning/{Splitter,DataSplitter,DataBalancer,
+DataCutter,OpValidator,OpCrossValidation,OpTrainValidationSplit}.scala.
+"""
+from .splitters import (
+    DataBalancer,
+    DataCutter,
+    DataSplitter,
+    PreparedData,
+    Splitter,
+)
+from .validators import (
+    BestEstimator,
+    CrossValidation,
+    TrainValidationSplit,
+    ValidatedModel,
+    Validator,
+)
+
+__all__ = [
+    "BestEstimator",
+    "CrossValidation",
+    "DataBalancer",
+    "DataCutter",
+    "DataSplitter",
+    "PreparedData",
+    "Splitter",
+    "TrainValidationSplit",
+    "ValidatedModel",
+    "Validator",
+]

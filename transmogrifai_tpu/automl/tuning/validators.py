@@ -1,0 +1,270 @@
+"""Validators: cross-validation and train/validation split.
+
+Reference: core/.../impl/tuning/{OpValidator.scala:94, OpCrossValidation.scala:41,
+OpTrainValidationSplit.scala:34}. The reference evaluates every
+(model x ParamMap) per fold on an 8-thread pool (OpValidator.scala:318) with
+physical per-fold datasets (MLUtils.kFold).
+
+TPU-first redesign: folds are *weight masks* over the in-HBM feature matrix —
+no data movement between folds. For GLM-family estimators the whole
+(fold x grid) sweep is ONE jitted program: `vmap` over fold masks and
+hyperparameter leaves, fit by fixed-iteration Newton, score with one matmul,
+evaluate with mask-weighted metric kernels. Non-vmappable estimators (trees,
+naive Bayes) fall back to a per-(fold, grid) loop over sliced arrays.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...evaluators.evaluators import Evaluator
+from ...models.base import PredictionModel, PredictorEstimator
+from ...models.prediction import make_prediction_column
+from ...ops import metrics_ops as M
+from ...stages.params import ParamMap
+
+
+@dataclass
+class ValidatedModel:
+    """Validation record for one (estimator, grid point) — reference
+    ModelEvaluation entries in ModelSelectorSummary."""
+
+    model_name: str
+    model_uid: str
+    grid: ParamMap
+    metric_name: str
+    fold_metrics: List[float]
+
+    @property
+    def mean_metric(self) -> float:
+        vals = [v for v in self.fold_metrics if np.isfinite(v)]
+        return float(np.mean(vals)) if vals else float("nan")
+
+
+@dataclass
+class BestEstimator:
+    """Winner of validation (reference OpValidator.wrapBestEstimator:147)."""
+
+    name: str
+    estimator: PredictorEstimator  # configured with the best grid
+    best_grid: ParamMap
+    best_metric: float
+    validated: List[ValidatedModel] = field(default_factory=list)
+
+
+def _metric_fn(problem_type: str, metric: str) -> Callable:
+    """Pure-jax (scores, labels, weights) -> scalar used inside the vmapped
+    sweep. Binary scores are margins (monotone in probability, so rank
+    metrics match; threshold 0 replaces probability 0.5)."""
+    if problem_type == "binary":
+        if metric == "au_pr":
+            return M.au_pr
+        if metric == "au_roc":
+            return M.au_roc
+        def bin_m(s, y, w, _m=metric):
+            return getattr(M.binary_metrics(s, y, w, threshold=0.0), _m)
+        return bin_m
+    if problem_type == "regression":
+        def reg_m(p, y, w, _m=metric):
+            return getattr(M.regression_metrics(p, y, w), _m)
+        return reg_m
+    raise ValueError(f"No vmapped metric for problem type {problem_type}")
+
+
+@partial(jax.jit, static_argnames=("fit_one", "metric", "problem_type"))
+def _sweep(X, y, w, fold_masks, regs, alphas, *, fit_one, metric, problem_type):
+    """The sweep kernel: metrics[F, G] for F fold masks x G grid points.
+
+    One XLA program: on a row-sharded X every Gram-matrix reduction inside
+    fit_one becomes an ICI psum; fold/grid axes are embarrassingly parallel
+    (vmap) and can additionally be laid out on the `model` mesh axis.
+    """
+    mfn = _metric_fn(problem_type, metric)
+
+    def one(mask, reg, alpha):
+        beta, b0 = fit_one(X, y, mask * w, reg, alpha)
+        score = X @ beta + b0
+        return mfn(score, y, (1.0 - mask) * w)
+
+    per_grid = jax.vmap(lambda m: jax.vmap(partial(one, m))(regs, alphas))
+    return per_grid(fold_masks)
+
+
+class Validator:
+    """Base validator (reference OpValidator.scala:94)."""
+
+    def __init__(self, evaluator: Evaluator, seed: int = 42,
+                 stratify: bool = False, parallelism: int = 8):
+        self.evaluator = evaluator
+        self.seed = int(seed)
+        self.stratify = bool(stratify)
+        # kept for API parity; device vmap replaces the thread pool
+        self.parallelism = int(parallelism)
+
+    # -- folds -------------------------------------------------------------
+    def fold_masks(self, y: np.ndarray) -> np.ndarray:
+        """[F, n] float32 train-membership masks (1=train, 0=validation)."""
+        raise NotImplementedError
+
+    def _assign_folds(self, y: np.ndarray, n_folds: int) -> np.ndarray:
+        """Per-row fold id; stratified round-robin within each class when
+        stratify is on (reference prepareStratification:203)."""
+        rng = np.random.default_rng(self.seed)
+        n = len(y)
+        fold_of = np.empty(n, np.int32)
+        if self.stratify:
+            for cls in np.unique(y):
+                idx = np.flatnonzero(y == cls)
+                rng.shuffle(idx)
+                fold_of[idx] = np.arange(len(idx)) % n_folds
+        else:
+            perm = rng.permutation(n)
+            fold_of[perm] = np.arange(n) % n_folds
+        return fold_of
+
+    # -- validation --------------------------------------------------------
+    def validate(self, models: Sequence[Tuple[PredictorEstimator, List[ParamMap]]],
+                 X: np.ndarray, y: np.ndarray,
+                 w: Optional[np.ndarray] = None,
+                 problem_type: str = "binary") -> BestEstimator:
+        if w is None:
+            w = np.ones_like(y, np.float32)
+        masks = self.fold_masks(y)
+        metric = self.evaluator.default_metric
+        larger = self.evaluator.is_larger_better()
+
+        validated: List[ValidatedModel] = []
+        for est, grids in models:
+            if not grids:
+                grids = [dict()]
+            if self._vmappable(est, grids, problem_type):
+                validated.extend(self._validate_vmapped(
+                    est, grids, X, y, w, masks, metric, problem_type))
+            else:
+                validated.extend(self._validate_sequential(
+                    est, grids, X, y, w, masks))
+
+        if not validated:
+            raise ValueError("No models to validate")
+        key = (lambda v: v.mean_metric if np.isfinite(v.mean_metric)
+               else (-np.inf if larger else np.inf))
+        best = max(validated, key=key) if larger else min(validated, key=key)
+        winner = next(e for e, _ in models
+                      if e.uid == best.model_uid).copy(**best.grid)
+        return BestEstimator(name=best.model_name, estimator=winner,
+                             best_grid=best.grid,
+                             best_metric=best.mean_metric, validated=validated)
+
+    # -- vmapped GLM path --------------------------------------------------
+    @staticmethod
+    def _vmappable(est: PredictorEstimator, grids: List[ParamMap],
+                   problem_type: str) -> bool:
+        if not getattr(est, "supports_grid_vmap", False):
+            return False
+        if problem_type not in ("binary", "regression"):
+            return False
+        _, axes = est.batched_fit_fn()
+        # every non-axis grid key must be constant across the grid (those
+        # become static jit args via copy)
+        others = {k for g in grids for k in g if k not in axes}
+        for k in others:
+            vals = {repr(g.get(k, est.get_param(k))) for g in grids}
+            if len(vals) > 1:
+                return False
+        return True
+
+    def _validate_vmapped(self, est, grids, X, y, w, masks, metric,
+                          problem_type) -> List[ValidatedModel]:
+        base = est.copy(**{k: v for k, v in grids[0].items()})
+        fit_one, axes = base.batched_fit_fn()
+        regs = np.array([g.get(axes[0], est.get_param(axes[0]))
+                         for g in grids], np.float32)
+        second = axes[1] if len(axes) > 1 else None
+        alphas = np.array([g.get(second, est.get_param(second)) if second
+                           else 0.0 for g in grids], np.float32)
+        out = _sweep(jnp.asarray(X, jnp.float32), jnp.asarray(y, jnp.float32),
+                     jnp.asarray(w, jnp.float32),
+                     jnp.asarray(masks, jnp.float32),
+                     jnp.asarray(regs), jnp.asarray(alphas),
+                     fit_one=fit_one, metric=metric,
+                     problem_type=problem_type)
+        out = np.asarray(out)  # [F, G]
+        return [
+            ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
+                           grid=g, metric_name=metric,
+                           fold_metrics=[float(v) for v in out[:, gi]])
+            for gi, g in enumerate(grids)
+        ]
+
+    # -- sequential fallback ----------------------------------------------
+    def _validate_sequential(self, est, grids, X, y, w, masks
+                             ) -> List[ValidatedModel]:
+        metric = self.evaluator.default_metric
+        out: List[ValidatedModel] = []
+        for g in grids:
+            est_g = est.copy(**g)
+            fold_vals: List[float] = []
+            for f in range(masks.shape[0]):
+                tr = masks[f] > 0
+                va = ~tr
+                model = est_g.fit_arrays(X[tr], y[tr], w[tr])
+                pred, raw, prob = model.predict_arrays(X[va])
+                col = make_prediction_column(pred, raw, prob)
+                fold_vals.append(self.evaluator.evaluate(y[va], col, w[va]))
+            out.append(ValidatedModel(
+                model_name=type(est).__name__, model_uid=est.uid, grid=g,
+                metric_name=metric, fold_metrics=fold_vals))
+        return out
+
+
+class CrossValidation(Validator):
+    """k-fold CV (reference OpCrossValidation.scala:41; NumFolds default 3)."""
+
+    def __init__(self, evaluator: Evaluator, num_folds: int = 3,
+                 seed: int = 42, stratify: bool = False, parallelism: int = 8):
+        super().__init__(evaluator, seed=seed, stratify=stratify,
+                         parallelism=parallelism)
+        if num_folds < 2:
+            raise ValueError("num_folds must be >= 2")
+        self.num_folds = int(num_folds)
+
+    def fold_masks(self, y: np.ndarray) -> np.ndarray:
+        fold_of = self._assign_folds(y, self.num_folds)
+        masks = np.ones((self.num_folds, len(y)), np.float32)
+        for f in range(self.num_folds):
+            masks[f, fold_of == f] = 0.0
+        return masks
+
+
+class TrainValidationSplit(Validator):
+    """Single split (reference OpTrainValidationSplit.scala:34;
+    TrainRatio default 0.75)."""
+
+    def __init__(self, evaluator: Evaluator, train_ratio: float = 0.75,
+                 seed: int = 42, stratify: bool = False, parallelism: int = 8):
+        super().__init__(evaluator, seed=seed, stratify=stratify,
+                         parallelism=parallelism)
+        if not 0.0 < train_ratio < 1.0:
+            raise ValueError("train_ratio must be in (0, 1)")
+        self.train_ratio = float(train_ratio)
+
+    def fold_masks(self, y: np.ndarray) -> np.ndarray:
+        rng = np.random.default_rng(self.seed)
+        n = len(y)
+        mask = np.ones((1, n), np.float32)
+        if self.stratify:
+            for cls in np.unique(y):
+                idx = np.flatnonzero(y == cls)
+                rng.shuffle(idx)
+                n_val = int(round(len(idx) * (1.0 - self.train_ratio)))
+                mask[0, idx[:n_val]] = 0.0
+        else:
+            perm = rng.permutation(n)
+            n_val = int(round(n * (1.0 - self.train_ratio)))
+            mask[0, perm[:n_val]] = 0.0
+        return mask

@@ -63,9 +63,12 @@ class BinaryClassificationEvaluator(Evaluator):
 
     def evaluate_all(self, labels, pred_col, w=None) -> Dict[str, float]:
         score = positive_score_of(pred_col)
+        # non-probabilistic models (SVC) score by margin: the decision
+        # boundary is 0, not probability 0.5
+        thr = self.threshold if probability_of(pred_col) is not None else 0.0
         m = M.binary_metrics(
             np.asarray(score, np.float32), np.asarray(labels, np.float32),
-            None if w is None else np.asarray(w, np.float32), self.threshold)
+            None if w is None else np.asarray(w, np.float32), thr)
         return {k: float(v) for k, v in m._asdict().items()}
 
 

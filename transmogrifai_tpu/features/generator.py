@@ -29,6 +29,9 @@ class FeatureGeneratorStage(PipelineStage):
         self.is_response = is_response
         self.aggregator = aggregator or FeatureAggregator(type_cls=feature_type)
         self.event_time_fn = event_time_fn
+        # which reader's records this feature extracts from (JoinedReader
+        # routing; set via FeatureBuilder.from_reader or directly)
+        self.reader_hint: Optional[Any] = None
         super().__init__(operation_name=f"gen_{name}", uid=uid)
         self.output_type = feature_type
 

@@ -138,6 +138,35 @@ def _ones_like_w(y, w):
     return np.ones_like(y, np.float32) if w is None else np.asarray(w, np.float32)
 
 
+# fit_one closures are static args of the validator's jitted sweep; cache them
+# per static config so repeated validate() calls hit the XLA compile cache
+@functools.lru_cache(maxsize=None)
+def _batched_logistic(max_iter, fit_intercept, standardize):
+    def fit_one(X, y, w, reg, alpha):
+        return G.fit_logistic(X, y, w, reg, alpha, max_iter=max_iter,
+                              fit_intercept=fit_intercept,
+                              standardize=standardize)
+    return fit_one
+
+
+@functools.lru_cache(maxsize=None)
+def _batched_linear(max_iter, fit_intercept, standardize):
+    def fit_one(X, y, w, reg, alpha):
+        return G.fit_linear(X, y, w, reg, alpha, max_iter=max_iter,
+                            fit_intercept=fit_intercept,
+                            standardize=standardize)
+    return fit_one
+
+
+@functools.lru_cache(maxsize=None)
+def _batched_svc(max_iter, fit_intercept, standardize):
+    def fit_one(X, y, w, reg, _alpha):
+        return G.fit_linear_svc(X, y, w, reg, max_iter=max_iter,
+                                fit_intercept=fit_intercept,
+                                standardize=standardize)
+    return fit_one
+
+
 class OpLogisticRegression(PredictorEstimator):
     """Reference OpLogisticRegression (impl/classification/, 212 LoC)."""
 
@@ -186,15 +215,9 @@ class OpLogisticRegression(PredictorEstimator):
 
     # vmapped grid+fold fit used by the selector (binary only)
     def batched_fit_fn(self):
-        max_iter = int(self.get_param("max_iter"))
-        fit_intercept = bool(self.get_param("fit_intercept"))
-        standardize = bool(self.get_param("standardization"))
-
-        def fit_one(X, y, w, reg, alpha):
-            return G.fit_logistic(X, y, w, reg, alpha, max_iter=max_iter,
-                                  fit_intercept=fit_intercept,
-                                  standardize=standardize)
-
+        fit_one = _batched_logistic(int(self.get_param("max_iter")),
+                                    bool(self.get_param("fit_intercept")),
+                                    bool(self.get_param("standardization")))
         return fit_one, ("reg_param", "elastic_net_param")
 
     def model_from_params(self, beta, b0) -> LinearBinaryModel:
@@ -235,15 +258,9 @@ class OpLinearSVC(PredictorEstimator):
                                  operation_name=self.operation_name)
 
     def batched_fit_fn(self):
-        max_iter = int(self.get_param("max_iter"))
-        fit_intercept = bool(self.get_param("fit_intercept"))
-        standardize = bool(self.get_param("standardization"))
-
-        def fit_one(X, y, w, reg, _alpha):
-            return G.fit_linear_svc(X, y, w, reg, max_iter=max_iter,
-                                    fit_intercept=fit_intercept,
-                                    standardize=standardize)
-
+        fit_one = _batched_svc(int(self.get_param("max_iter")),
+                               bool(self.get_param("fit_intercept")),
+                               bool(self.get_param("standardization")))
         return fit_one, ("reg_param",)
 
     def model_from_params(self, beta, b0) -> LinearBinaryModel:
@@ -310,15 +327,9 @@ class OpLinearRegression(PredictorEstimator):
                                      operation_name=self.operation_name)
 
     def batched_fit_fn(self):
-        max_iter = int(self.get_param("max_iter"))
-        fit_intercept = bool(self.get_param("fit_intercept"))
-        standardize = bool(self.get_param("standardization"))
-
-        def fit_one(X, y, w, reg, alpha):
-            return G.fit_linear(X, y, w, reg, alpha, max_iter=max_iter,
-                                fit_intercept=fit_intercept,
-                                standardize=standardize)
-
+        fit_one = _batched_linear(int(self.get_param("max_iter")),
+                                  bool(self.get_param("fit_intercept")),
+                                  bool(self.get_param("standardization")))
         return fit_one, ("reg_param", "elastic_net_param")
 
     def model_from_params(self, beta, b0) -> LinearRegressionModel:

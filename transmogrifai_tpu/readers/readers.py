@@ -245,17 +245,23 @@ class JoinedReader(Reader):
     """Key-joins two readers' generated datasets (reference
     JoinedDataReader.scala:83 — left-outer by key columns)."""
 
-    def __init__(self, left: Reader, right: Reader, join_type: str = "outer"):
+    def __init__(self, left: Reader, right: Reader, join_type: str = "outer",
+                 left_features: Optional[Sequence[str]] = None,
+                 right_features: Optional[Sequence[str]] = None):
         super().__init__(None)
         self.left = left
         self.right = right
         if join_type not in ("outer", "inner", "left"):
             raise ValueError(f"Unsupported join type: {join_type}")
         self.join_type = join_type
+        self.left_features = set(left_features) if left_features else None
+        self.right_features = set(right_features) if right_features else None
 
     def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
-        left_feats = [f for f in raw_features if self._belongs(self.left, f)]
-        right_feats = [f for f in raw_features if f not in left_feats]
+        left_feats, right_feats = [], []
+        for f in raw_features:
+            side = self._side_of(f)
+            (left_feats if side == "left" else right_feats).append(f)
         lds = self.left.generate_dataset(left_feats)
         rds = self.right.generate_dataset(right_feats)
         if KEY_COLUMN not in lds or KEY_COLUMN not in rds:
@@ -287,13 +293,23 @@ class JoinedReader(Reader):
         from ..types import ColumnKind
         return ds.with_column(KEY_COLUMN, Column(kind=ColumnKind.STRING, data=arr))
 
-    @staticmethod
-    def _belongs(reader: Reader, f: Feature) -> bool:
-        # features are routed to the reader whose records they extract from;
-        # convention: the user lists left features first and tags via
-        # feature origin 'reader_hint' when ambiguous
+    def _side_of(self, f: Feature) -> str:
+        """Route a feature to the reader whose records it extracts from:
+        by explicit left_features/right_features name sets, else by the
+        generator's reader_hint. Ambiguity is an error, not a guess."""
+        if self.left_features is not None and f.name in self.left_features:
+            return "left"
+        if self.right_features is not None and f.name in self.right_features:
+            return "right"
         hint = getattr(f.origin_stage, "reader_hint", None)
-        return hint is None or hint is reader or hint == id(reader)
+        if hint is self.left or hint == id(self.left):
+            return "left"
+        if hint is self.right or hint == id(self.right):
+            return "right"
+        raise ValueError(
+            f"JoinedReader cannot route feature '{f.name}': pass "
+            "left_features/right_features name lists or set the generator's "
+            "reader_hint")
 
 
 def _recolumn(f: Feature, ds: Dataset, vals: List[Any]):

@@ -150,6 +150,11 @@ class PipelineStage(HasParams):
                          for p in sig.parameters.values())
         if not has_kwargs:
             args = {k: v for k, v in args.items() if k in accepted}
+        else:
+            # kwargs catch-all is the declared-params channel; drop ctor args
+            # the subclass sets itself (e.g. hardcoded operation_name)
+            args = {k: v for k, v in args.items()
+                    if k in accepted or self.has_param(k)}
         clone = type(self)(**args)
         for k, v in self.param_values().items():
             clone.set_param(k, v)
