@@ -1,0 +1,137 @@
+"""SanityChecker tests (mirror of core/src/test/.../preparators/
+SanityCheckerTest.scala behaviors)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.automl import SanityChecker, SanityCheckerModel
+from transmogrifai_tpu.data.dataset import Column, column_from_values
+from transmogrifai_tpu.data.vector import (
+    NULL_STRING, VectorColumnMetadata, VectorMetadata,
+)
+from transmogrifai_tpu.types import ColumnKind, OPVector, RealNN
+
+
+def _vec_col(X, meta=None):
+    return Column(kind=ColumnKind.VECTOR, data=np.asarray(X, np.float32),
+                  metadata=meta)
+
+
+def _label_col(y):
+    return column_from_values(RealNN, [float(v) for v in y])
+
+
+def _meta(cols):
+    return VectorMetadata(name="features", columns=cols)
+
+
+def test_low_variance_column_dropped(rng):
+    n = 500
+    X = np.stack([rng.normal(size=n), np.full(n, 3.0)], axis=1)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    chk = SanityChecker(remove_bad_features=True)
+    model = chk.fit_columns(_label_col(y), _vec_col(X))
+    assert model.indices_to_keep == [0]
+    assert "f1" in model.summary.dropped
+    assert any("variance" in r for r in model.summary.drop_reasons["f1"])
+
+
+def test_label_leakage_high_correlation_dropped(rng):
+    n = 500
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    X = np.stack([rng.normal(size=n), y + 1e-4 * rng.normal(size=n)], axis=1)
+    chk = SanityChecker(remove_bad_features=True, remove_feature_group=False)
+    model = chk.fit_columns(_label_col(y), _vec_col(X))
+    assert 1 not in model.indices_to_keep
+    assert any("correlation" in r for r in model.summary.drop_reasons["f1"])
+
+
+def test_no_removal_when_disabled(rng):
+    n = 300
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    X = np.stack([rng.normal(size=n), y], axis=1)
+    chk = SanityChecker()  # remove_bad_features defaults False (ref :728)
+    model = chk.fit_columns(_label_col(y), _vec_col(X))
+    assert model.indices_to_keep == [0, 1]
+    assert model.summary.dropped == ["f1"]  # still recorded
+
+
+def test_categorical_cramers_v_leak_dropped(rng):
+    n = 600
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    # one-hot group perfectly predicting the label
+    leak = np.stack([y, 1 - y], axis=1)
+    noise = rng.normal(size=(n, 1))
+    X = np.concatenate([noise, leak], axis=1)
+    meta = _meta([
+        VectorColumnMetadata("num", "Real", descriptor_value="v", index=0),
+        VectorColumnMetadata("cat", "PickList", grouping="cat",
+                             indicator_value="A", index=1),
+        VectorColumnMetadata("cat", "PickList", grouping="cat",
+                             indicator_value="B", index=2),
+    ])
+    chk = SanityChecker(remove_bad_features=True)
+    model = chk.fit_columns(_label_col(y), _vec_col(X, meta))
+    assert model.indices_to_keep == [0]
+    gs = model.summary.categorical_stats
+    assert len(gs) == 1
+    assert gs[0]["cramers_v"] > 0.95
+    assert model.metadata.size == 1
+    assert model.metadata.columns[0].parent_feature_name == "num"
+
+
+def test_cramers_v_known_value(rng):
+    # independent uniform categorical vs label -> Cramer's V near 0
+    n = 4000
+    y = rng.integers(0, 2, size=n).astype(np.float32)
+    g = rng.integers(0, 3, size=n)
+    G = np.eye(3, dtype=np.float32)[g]
+    X = np.concatenate([G, rng.normal(size=(n, 1))], axis=1)
+    meta = _meta([
+        VectorColumnMetadata("c", "PickList", grouping="c",
+                             indicator_value=v, index=i)
+        for i, v in enumerate("ABC")
+    ] + [VectorColumnMetadata("num", "Real", descriptor_value="v", index=3)])
+    chk = SanityChecker()
+    model = chk.fit_columns(_label_col(y), _vec_col(X, meta))
+    cv = model.summary.categorical_stats[0]["cramers_v"]
+    assert cv < 0.05
+
+
+def test_model_transform_and_jax_fn(rng):
+    n = 50
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+    model = SanityCheckerModel(indices_to_keep=[0, 2])
+    out = model.transform_columns(_label_col(y), _vec_col(X))
+    np.testing.assert_allclose(out.data, X[:, [0, 2]])
+    fn = model.get_jax_fn()
+    np.testing.assert_allclose(np.asarray(fn(y, X)), X[:, [0, 2]])
+
+
+def test_rule_confidence_check(rng):
+    # categorical value 'A' always => label 1: confidence 1.0, support ~0.5
+    n = 400
+    a = rng.uniform(size=n) < 0.5
+    y = np.where(a, 1.0, (rng.uniform(size=n) < 0.5)).astype(np.float32)
+    X = np.stack([a.astype(np.float32), 1 - a, rng.normal(size=n)], axis=1)
+    meta = _meta([
+        VectorColumnMetadata("cat", "PickList", grouping="cat",
+                             indicator_value="A", index=0),
+        VectorColumnMetadata("cat", "PickList", grouping="cat",
+                             indicator_value="B", index=1),
+        VectorColumnMetadata("num", "Real", descriptor_value="v", index=2),
+    ])
+    chk = SanityChecker(remove_bad_features=True, max_rule_confidence=0.9,
+                        min_required_rule_support=0.3)
+    model = chk.fit_columns(_label_col(y), _vec_col(X, meta))
+    # whole cat group dropped (A triggers; B follows via group propagation)
+    assert model.indices_to_keep == [2]
+
+
+def test_sampling_fraction():
+    chk = SanityChecker(check_sample=0.01)
+    # lower limit pulls the fraction up for small data
+    assert chk._fraction(500) == 1.0
+    assert abs(chk._fraction(1_000_000) - 0.01) < 1e-9
+    # upper limit caps huge data
+    assert chk._fraction(1_000_000_000) <= 0.01

@@ -3,6 +3,11 @@
 Reference: core/.../impl/{feature,preparators,tuning,selector,
 classification,regression}.
 """
+from .preparators import (
+    SanityChecker,
+    SanityCheckerModel,
+    SanityCheckerSummary,
+)
 from .selector import ModelSelector, ModelSelectorSummary, SelectedModel
 from .selectors import (
     BinaryClassificationModelSelector,
@@ -41,6 +46,9 @@ __all__ = [
     "ModelSelectorSummary",
     "MultiClassificationModelSelector",
     "RegressionModelSelector",
+    "SanityChecker",
+    "SanityCheckerModel",
+    "SanityCheckerSummary",
     "SelectedModel",
     "Splitter",
     "TrainValidationSplit",

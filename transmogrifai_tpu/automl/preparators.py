@@ -1,0 +1,455 @@
+"""SanityChecker: automated feature validation.
+
+Reference: core/.../impl/preparators/SanityChecker.scala:236 (fitFn:535,
+reasonsToRemove:783, categoricalTests:420, defaults :721-736) and
+SanityCheckerMetadata.scala.
+
+TPU-first: every statistic is an XLA reduction over the HBM feature matrix —
+column moments and label correlations are one fused pass (ops/stats.col_stats,
+pearson/spearman_with_label), contingency tables are a single one-hot matmul
+(ops/stats.contingency_table replacing the reduceByKey at
+SanityChecker.scala:440). The fitted model is a static index-gather that XLA
+fuses into the downstream program.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.dataset import Column, Dataset
+from ..data.vector import VectorColumnMetadata, VectorMetadata
+from ..ops import stats as S
+from ..stages.base import Estimator, Transformer
+from ..stages.params import Param
+from ..types import ColumnKind, OPVector, RealNN
+from ..utils.uid import make_uid
+
+_TEXT_PARENTS = {"Text", "TextArea", "TextMap", "TextAreaMap"}
+
+
+@dataclass
+class ColumnStatistics:
+    """Per-column stats + removal reasons (reference ColumnStatistics)."""
+
+    name: str
+    column: Optional[VectorColumnMetadata]
+    is_label: bool
+    count: float
+    mean: float
+    min: float
+    max: float
+    variance: float
+    corr_label: Optional[float] = None
+    cramers_v: Optional[float] = None
+    parent_corr: Optional[float] = None
+    parent_cramers_v: Optional[float] = None
+    max_rule_confidences: List[float] = field(default_factory=list)
+    supports: List[float] = field(default_factory=list)
+
+    def reasons_to_remove(self, min_variance: float, min_correlation: float,
+                          max_correlation: float, max_cramers_v: float,
+                          max_rule_confidence: float,
+                          min_required_rule_support: float,
+                          remove_feature_group: bool,
+                          protect_text_shared_hash: bool,
+                          removed_groups: Sequence[str]) -> List[str]:
+        if self.is_label:
+            return []
+        reasons = []
+        if self.variance <= min_variance:
+            reasons.append(
+                f"variance {self.variance} lower than min variance {min_variance}")
+        if self.corr_label is not None and np.isfinite(self.corr_label):
+            if abs(self.corr_label) < min_correlation:
+                reasons.append(f"correlation {self.corr_label} lower than "
+                               f"min correlation {min_correlation}")
+            if abs(self.corr_label) > max_correlation:
+                reasons.append(f"correlation {self.corr_label} higher than "
+                               f"max correlation {max_correlation}")
+        if self.cramers_v is not None and self.cramers_v > max_cramers_v:
+            reasons.append(f"Cramer's V {self.cramers_v} higher than "
+                           f"max Cramer's V {max_cramers_v}")
+        for conf, sup in zip(self.max_rule_confidences, self.supports):
+            if conf > max_rule_confidence and sup > min_required_rule_support:
+                reasons.append(
+                    f"association rule confidence {conf} above "
+                    f"{max_rule_confidence} with support {sup} above "
+                    f"{min_required_rule_support}")
+                break
+        group = self.feature_group()
+        if group is not None and group in removed_groups:
+            reasons.append(f"other feature in indicator group {group} flagged "
+                           "for removal via rule confidence checks")
+        if remove_feature_group and not (
+                protect_text_shared_hash and self.is_text_shared_hash()):
+            if self.parent_cramers_v is not None and \
+                    self.parent_cramers_v > max_cramers_v:
+                reasons.append(
+                    f"Cramer's V {self.parent_cramers_v} for something in "
+                    f"parent feature set higher than max Cramer's V "
+                    f"{max_cramers_v}")
+            if self.parent_corr is not None and self.parent_corr > max_correlation:
+                reasons.append(
+                    f"correlation {self.parent_corr} for something in parent "
+                    f"feature set higher than max correlation {max_correlation}")
+        return reasons
+
+    def feature_group(self) -> Optional[str]:
+        if self.column is None or self.column.grouping is None:
+            return None
+        return f"{self.column.parent_feature_name}_{self.column.grouping}"
+
+    def is_text_shared_hash(self) -> bool:
+        c = self.column
+        return (c is not None and c.parent_feature_type in _TEXT_PARENTS
+                and c.grouping is None and c.indicator_value is None)
+
+
+@dataclass
+class CategoricalGroupStats:
+    """Contingency-test results for one indicator group (reference
+    CategoricalGroupStats in SanityCheckerMetadata.scala)."""
+
+    group: str
+    categorical_features: List[str]
+    contingency_matrix: List[List[float]]
+    cramers_v: float
+    chi2: float
+    mutual_info: float
+    pointwise_mutual_info: List[List[float]]
+    max_rule_confidences: List[float]
+    supports: List[float]
+
+
+@dataclass
+class SanityCheckerSummary:
+    """Everything the checker measured (reference SanityCheckerSummary)."""
+
+    correlation_type: str
+    names: List[str]
+    column_stats: List[Dict[str, Any]]
+    categorical_stats: List[Dict[str, Any]]
+    dropped: List[str]
+    drop_reasons: Dict[str, List[str]]
+    sample_fraction: float
+    correlations_matrix: Optional[List[List[float]]] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        from dataclasses import asdict
+        return asdict(self)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "SanityCheckerSummary":
+        return SanityCheckerSummary(**d)
+
+
+class SanityCheckerModel(Transformer):
+    """Fitted checker: static index slice of the feature vector (reference
+    SanityCheckerModel:697 indicesToKeep)."""
+
+    input_types = (RealNN, OPVector)
+    output_type = OPVector
+
+    def __init__(self, indices_to_keep: Sequence[int],
+                 metadata: Optional[VectorMetadata] = None,
+                 summary: Optional[SanityCheckerSummary] = None,
+                 operation_name: str = "sanityCheck",
+                 uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.indices_to_keep = [int(i) for i in indices_to_keep]
+        self.metadata = metadata
+        self.summary = summary
+
+    def get_jax_fn(self):
+        idx = jnp.asarray(np.asarray(self.indices_to_keep, np.int32))
+
+        def keep(_label, vec):
+            return jnp.take(vec, idx, axis=-1)
+
+        return keep
+
+    def transform_columns(self, *cols: Column) -> Column:
+        vec = cols[-1]
+        data = vec.data[:, self.indices_to_keep]
+        return Column(kind=ColumnKind.VECTOR,
+                      data=np.ascontiguousarray(data),
+                      metadata=self.output_metadata() or
+                      (vec.metadata.select(self.indices_to_keep)
+                       if vec.metadata else None))
+
+    def transform_value(self, *vals):
+        vec = np.asarray(vals[-1].value, np.float32)
+        return OPVector(vec[self.indices_to_keep])
+
+    def output_metadata(self) -> Optional[VectorMetadata]:
+        return self.metadata
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(indices_to_keep=self.indices_to_keep,
+                 metadata=self.metadata.to_json() if self.metadata else None,
+                 summary=self.summary.to_json() if self.summary else None)
+        return d
+
+
+class SanityChecker(Estimator):
+    """Estimator2(RealNN label, OPVector) -> cleaned OPVector."""
+
+    input_types = (RealNN, OPVector)
+    output_type = OPVector
+
+    @classmethod
+    def _declare_params(cls):
+        # defaults: reference SanityChecker.scala:721-736
+        return [
+            Param("check_sample", "fraction of data to check", 1.0),
+            Param("sample_lower_limit", "min rows sampled", 1000),
+            Param("sample_upper_limit", "max rows sampled", 1_000_000),
+            Param("sample_seed", "sampling seed", 42),
+            Param("remove_bad_features", "actually drop flagged columns", False),
+            Param("max_correlation", "max |corr| with label", 0.95),
+            Param("min_correlation", "min |corr| with label", 0.0),
+            Param("min_variance", "min column variance", 1e-5),
+            Param("max_cramers_v", "max Cramer's V vs label", 0.95),
+            Param("correlation_type", "pearson|spearman", "pearson",
+                  lambda v: v in ("pearson", "spearman")),
+            Param("categorical_label", "force categorical-label tests", None),
+            Param("remove_feature_group", "drop whole flagged groups", True),
+            Param("protect_text_shared_hash", "keep shared text hash cols", False),
+            Param("max_rule_confidence", "label-leakage rule confidence", 1.0),
+            Param("min_required_rule_support", "rule support threshold", 1.0),
+            Param("feature_label_corr_only", "skip full corr matrix", False),
+        ]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("sanityCheck", uid=uid, **params)
+
+    # -- sampling ----------------------------------------------------------
+    def _fraction(self, total: int) -> float:
+        """Reference SanityChecker.fraction:525."""
+        ck = float(self.get_param("check_sample"))
+        min_frac = min(1.0, float(self.get_param("sample_lower_limit")) / max(total, 1))
+        max_frac = max(0.0, float(self.get_param("sample_upper_limit")) / max(total, 1))
+        return max(min(ck, max_frac), min_frac)
+
+    def fit_columns(self, *cols: Column) -> SanityCheckerModel:
+        label_col, vec_col = cols
+        y_all = np.asarray(label_col.data, np.float64).astype(np.float32)
+        X_all = vec_col.data
+        if X_all.ndim == 1:
+            X_all = X_all[:, None]
+        n_total = len(y_all)
+
+        frac = self._fraction(n_total)
+        if frac < 1.0:
+            rng = np.random.default_rng(int(self.get_param("sample_seed")))
+            take = rng.uniform(size=n_total) < frac
+            X, y = X_all[take], y_all[take]
+        else:
+            X, y = X_all, y_all
+        n = len(y)
+
+        meta = vec_col.metadata
+        names = (meta.column_names() if meta is not None
+                 else [f"f{i}" for i in range(X.shape[1])])
+        columns = (list(meta.columns) if meta is not None
+                   else [None] * X.shape[1])
+
+        # -- device reductions: moments + correlations ---------------------
+        Xj = jnp.asarray(X, jnp.float32)
+        yj = jnp.asarray(y, jnp.float32)
+        cs = S.col_stats(Xj)
+        if self.get_param("correlation_type") == "spearman":
+            corr = np.asarray(S.spearman_with_label(Xj, yj))
+        else:
+            corr = np.asarray(S.pearson_with_label(Xj, yj))
+        # full feature-feature matrix (one X^T X matmul) unless the user opts
+        # out (reference featureLabelCorrOnly, SanityChecker.scala:193)
+        corr_matrix: Optional[np.ndarray] = None
+        if not bool(self.get_param("feature_label_corr_only")) and \
+                self.get_param("correlation_type") == "pearson":
+            corr_matrix = np.asarray(S.pearson_matrix(Xj))
+        label_cs = S.col_stats(yj[:, None])
+
+        counts = np.asarray(cs.count)
+        means = np.asarray(cs.mean)
+        mins = np.asarray(cs.min)
+        maxs = np.asarray(cs.max)
+        variances = np.asarray(cs.variance)
+
+        # -- categorical contingency tests ---------------------------------
+        distinct = np.unique(y)
+        cat_param = self.get_param("categorical_label")
+        is_cat = (bool(cat_param) if cat_param is not None
+                  else len(distinct) < min(100.0, n * 0.1))
+        group_stats: List[CategoricalGroupStats] = []
+        cramers_by_col: Dict[int, float] = {}
+        conf_by_col: Dict[int, Tuple[List[float], List[float]]] = {}
+        if is_cat and meta is not None and len(distinct) > 1:
+            group_stats, cramers_by_col, conf_by_col = self._categorical_tests(
+                X, y, columns, names, distinct)
+
+        # -- assemble per-column statistics --------------------------------
+        col_stats_list: List[ColumnStatistics] = []
+        for i, nm in enumerate(names):
+            col_stats_list.append(ColumnStatistics(
+                name=nm, column=columns[i], is_label=False,
+                count=float(counts[i]), mean=float(means[i]),
+                min=float(mins[i]), max=float(maxs[i]),
+                variance=float(variances[i]),
+                corr_label=float(corr[i]) if np.isfinite(corr[i]) else None,
+                cramers_v=cramers_by_col.get(i),
+                max_rule_confidences=conf_by_col.get(i, ([], []))[0],
+                supports=conf_by_col.get(i, ([], []))[1],
+            ))
+        label_stats = ColumnStatistics(
+            name=self.input_names()[0] if self.input_names() else "label",
+            column=None, is_label=True, count=float(np.asarray(label_cs.count)[0]),
+            mean=float(np.asarray(label_cs.mean)[0]),
+            min=float(np.asarray(label_cs.min)[0]),
+            max=float(np.asarray(label_cs.max)[0]),
+            variance=float(np.asarray(label_cs.variance)[0]))
+
+        # parent-level maxima (reference maxByParent / corrParentMap)
+        by_parent_corr: Dict[str, float] = {}
+        by_parent_cv: Dict[str, float] = {}
+        for st in col_stats_list:
+            if st.column is None:
+                continue
+            p = st.column.parent_feature_name
+            if st.corr_label is not None and not st.column.is_null_indicator:
+                v = abs(st.corr_label)
+                if np.isfinite(v):
+                    by_parent_corr[p] = max(by_parent_corr.get(p, 0.0), v)
+            if st.cramers_v is not None:
+                by_parent_cv[p] = max(by_parent_cv.get(p, 0.0), st.cramers_v)
+        for st in col_stats_list:
+            if st.column is None:
+                continue
+            p = st.column.parent_feature_name
+            if p in by_parent_corr:
+                st.parent_corr = by_parent_corr[p]
+            if p in by_parent_cv:
+                st.parent_cramers_v = by_parent_cv[p]
+
+        # rule-confidence group removals propagate to the whole group
+        removed_groups = [
+            st.feature_group() for st in col_stats_list
+            if st.feature_group() is not None and any(
+                conf > float(self.get_param("max_rule_confidence")) and
+                sup > float(self.get_param("min_required_rule_support"))
+                for conf, sup in zip(st.max_rule_confidences, st.supports))
+        ]
+
+        drop_reasons: Dict[str, List[str]] = {}
+        drop_indices: List[int] = []
+        for i, st in enumerate(col_stats_list):
+            reasons = st.reasons_to_remove(
+                min_variance=float(self.get_param("min_variance")),
+                min_correlation=float(self.get_param("min_correlation")),
+                max_correlation=float(self.get_param("max_correlation")),
+                max_cramers_v=float(self.get_param("max_cramers_v")),
+                max_rule_confidence=float(self.get_param("max_rule_confidence")),
+                min_required_rule_support=float(
+                    self.get_param("min_required_rule_support")),
+                remove_feature_group=bool(self.get_param("remove_feature_group")),
+                protect_text_shared_hash=bool(
+                    self.get_param("protect_text_shared_hash")),
+                removed_groups=removed_groups)
+            if reasons:
+                drop_reasons[st.name] = reasons
+                drop_indices.append(i)
+
+        if bool(self.get_param("remove_bad_features")):
+            keep = [i for i in range(X.shape[1]) if i not in set(drop_indices)]
+            if not keep:  # never drop everything
+                keep = list(range(X.shape[1]))
+        else:
+            keep = list(range(X.shape[1]))
+
+        summary = SanityCheckerSummary(
+            correlation_type=self.get_param("correlation_type"),
+            names=names,
+            column_stats=[{
+                "name": st.name, "count": st.count, "mean": st.mean,
+                "min": st.min, "max": st.max, "variance": st.variance,
+                "corr_label": st.corr_label, "cramers_v": st.cramers_v,
+                "parent_corr": st.parent_corr,
+                "parent_cramers_v": st.parent_cramers_v,
+            } for st in [label_stats] + col_stats_list],
+            categorical_stats=[{
+                "group": g.group, "categorical_features": g.categorical_features,
+                "cramers_v": g.cramers_v, "chi2": g.chi2,
+                "mutual_info": g.mutual_info,
+                "max_rule_confidences": g.max_rule_confidences,
+                "supports": g.supports,
+            } for g in group_stats],
+            dropped=[names[i] for i in drop_indices],
+            drop_reasons=drop_reasons,
+            sample_fraction=frac,
+            correlations_matrix=(corr_matrix.tolist()
+                                 if corr_matrix is not None else None),
+        )
+        out_meta = meta.select(keep) if meta is not None else None
+        return SanityCheckerModel(indices_to_keep=keep, metadata=out_meta,
+                                  summary=summary,
+                                  operation_name=self.operation_name)
+
+    # -- contingency machinery --------------------------------------------
+    def _categorical_tests(self, X: np.ndarray, y: np.ndarray,
+                           columns: Sequence[Optional[VectorColumnMetadata]],
+                           names: Sequence[str], distinct: np.ndarray):
+        """Reference categoricalTests:420: per indicator group, contingency
+        matrix of indicator columns vs label classes."""
+        label_idx = {float(v): j for j, v in enumerate(distinct)}
+        Y = np.zeros((len(y), len(distinct)), np.float32)
+        Y[np.arange(len(y)), [label_idx[float(v)] for v in y]] = 1.0
+
+        # group columns with both grouping and indicator_value
+        groups: Dict[str, List[int]] = {}
+        for i, c in enumerate(columns):
+            if c is None or c.grouping is None or c.indicator_value is None:
+                continue
+            groups.setdefault(f"{c.parent_feature_name}_{c.grouping}",
+                              []).append(i)
+
+        group_stats: List[CategoricalGroupStats] = []
+        cramers_by_col: Dict[int, float] = {}
+        conf_by_col: Dict[int, Tuple[List[float], List[float]]] = {}
+        label_totals = Y.sum(axis=0)
+
+        for group, idxs in groups.items():
+            # MultiPickList parents: clip multi-hot counts to 1 (reference :428)
+            is_mpl = any(columns[i].parent_feature_type == "MultiPickList"
+                         for i in idxs)
+            G = X[:, idxs]
+            if is_mpl:
+                G = np.minimum(G, 1.0)
+            table = np.asarray(S.contingency_table(
+                jnp.asarray(G, jnp.float32), jnp.asarray(Y)))
+            if len(idxs) == 1:
+                # single indicator: synthesize the complement row (ref :477)
+                table = np.concatenate([table, (label_totals - table[0])[None, :]],
+                                       axis=0)
+            st = S.contingency_stats(jnp.asarray(table))
+            k = len(idxs)
+            confs = [float(v) for v in np.asarray(st.max_rule_confidences)[:k]]
+            sups = [float(v) for v in np.asarray(st.supports)[:k]]
+            cv = float(np.asarray(st.cramers_v))
+            for j, i in enumerate(idxs):
+                cramers_by_col[i] = cv
+                conf_by_col[i] = ([confs[j]], [sups[j]])
+            group_stats.append(CategoricalGroupStats(
+                group=group,
+                categorical_features=[names[i] for i in idxs],
+                contingency_matrix=[[float(v) for v in row] for row in table],
+                cramers_v=cv, chi2=float(np.asarray(st.chi2)),
+                mutual_info=float(np.asarray(st.mutual_info)),
+                pointwise_mutual_info=[[float(v) for v in row]
+                                       for row in np.asarray(
+                                           st.pointwise_mutual_info)],
+                max_rule_confidences=confs, supports=sups))
+        return group_stats, cramers_by_col, conf_by_col

@@ -12,7 +12,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..evaluators.evaluators import Evaluator, _SMALLER_BETTER
+from ..evaluators.evaluators import Evaluator
 from ..models.base import PredictionModel, PredictorEstimator
 from ..models.prediction import make_prediction_column
 from ..stages.params import ParamMap
@@ -75,7 +75,15 @@ class ModelSelectorSummary:
 
 
 def _larger_better(metric: str) -> bool:
-    return metric not in _SMALLER_BETTER
+    return Evaluator.larger_better_metric(metric)
+
+
+def _remap_labels(arr: np.ndarray, mapping: Dict[int, int]) -> np.ndarray:
+    """Vectorized label remap that is safe on empty arrays."""
+    out = np.asarray(arr, np.float32).copy()
+    for src, dst in mapping.items():
+        out[np.asarray(arr) == src] = dst
+    return out
 
 
 class SelectedModel(PredictionModel):
@@ -97,8 +105,7 @@ class SelectedModel(PredictionModel):
         if self.label_map:
             inv = {v: k for k, v in self.label_map.items()}
             if any(k != v for k, v in inv.items()):
-                pred = np.vectorize(lambda p: inv.get(int(p), p))(pred).astype(
-                    np.float32)
+                pred = _remap_labels(pred, inv)
         return pred, raw, prob
 
     def save_args(self) -> Dict[str, Any]:
@@ -149,8 +156,7 @@ class ModelSelector(PredictorEstimator):
         Xt, yt = X[use_idx], y[use_idx]
         wt = w[use_idx] * prep.weights
         if prep.label_map and any(k != v for k, v in prep.label_map.items()):
-            yt = np.vectorize(lambda v: prep.label_map.get(int(v), 0))(yt
-                                                                       ).astype(np.float32)
+            yt = _remap_labels(yt, prep.label_map)
 
         best: BestEstimator = self.validator.validate(
             self.models, Xt, yt, wt, problem_type=self.problem_type)
@@ -166,8 +172,7 @@ class ModelSelector(PredictorEstimator):
             if prep.label_map and any(k != v for k, v in prep.label_map.items()):
                 keep = np.isin(yh, list(prep.label_map.keys()))
                 test_idx = test_idx[keep]
-                yh = np.vectorize(
-                    lambda v: prep.label_map.get(int(v), 0))(yh[keep]).astype(np.float32)
+                yh = _remap_labels(yh[keep], prep.label_map)
             if len(test_idx):
                 holdout_eval = self._evaluate(
                     evaluator, best_model, X[test_idx], yh, w[test_idx])

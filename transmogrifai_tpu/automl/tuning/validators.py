@@ -57,17 +57,19 @@ class BestEstimator:
     validated: List[ValidatedModel] = field(default_factory=list)
 
 
-def _metric_fn(problem_type: str, metric: str) -> Callable:
+def _metric_fn(problem_type: str, metric: str,
+               margin_threshold: float = 0.0) -> Callable:
     """Pure-jax (scores, labels, weights) -> scalar used inside the vmapped
     sweep. Binary scores are margins (monotone in probability, so rank
-    metrics match; threshold 0 replaces probability 0.5)."""
+    metrics match); thresholded metrics use the margin equivalent of the
+    evaluator's probability threshold (logit for probabilistic models)."""
     if problem_type == "binary":
         if metric == "au_pr":
             return M.au_pr
         if metric == "au_roc":
             return M.au_roc
-        def bin_m(s, y, w, _m=metric):
-            return getattr(M.binary_metrics(s, y, w, threshold=0.0), _m)
+        def bin_m(s, y, w, _m=metric, _t=margin_threshold):
+            return getattr(M.binary_metrics(s, y, w, threshold=_t), _m)
         return bin_m
     if problem_type == "regression":
         def reg_m(p, y, w, _m=metric):
@@ -76,15 +78,17 @@ def _metric_fn(problem_type: str, metric: str) -> Callable:
     raise ValueError(f"No vmapped metric for problem type {problem_type}")
 
 
-@partial(jax.jit, static_argnames=("fit_one", "metric", "problem_type"))
-def _sweep(X, y, w, fold_masks, regs, alphas, *, fit_one, metric, problem_type):
+@partial(jax.jit, static_argnames=("fit_one", "metric", "problem_type",
+                                   "margin_threshold"))
+def _sweep(X, y, w, fold_masks, regs, alphas, *, fit_one, metric, problem_type,
+           margin_threshold=0.0):
     """The sweep kernel: metrics[F, G] for F fold masks x G grid points.
 
     One XLA program: on a row-sharded X every Gram-matrix reduction inside
     fit_one becomes an ICI psum; fold/grid axes are embarrassingly parallel
     (vmap) and can additionally be laid out on the `model` mesh axis.
     """
-    mfn = _metric_fn(problem_type, metric)
+    mfn = _metric_fn(problem_type, metric, margin_threshold)
 
     def one(mask, reg, alpha):
         beta, b0 = fit_one(X, y, mask * w, reg, alpha)
@@ -187,12 +191,20 @@ class Validator:
         second = axes[1] if len(axes) > 1 else None
         alphas = np.array([g.get(second, est.get_param(second)) if second
                            else 0.0 for g in grids], np.float32)
+        # thresholded metrics: probability threshold t maps to margin logit(t)
+        # for probabilistic models; margin models cut at 0 (their decision rule)
+        thr = float(getattr(self.evaluator, "threshold", 0.5))
+        if getattr(est, "produces_probabilities", True) and 0.0 < thr < 1.0:
+            margin_thr = float(np.log(thr / (1.0 - thr)))
+        else:
+            margin_thr = 0.0
         out = _sweep(jnp.asarray(X, jnp.float32), jnp.asarray(y, jnp.float32),
                      jnp.asarray(w, jnp.float32),
                      jnp.asarray(masks, jnp.float32),
                      jnp.asarray(regs), jnp.asarray(alphas),
                      fit_one=fit_one, metric=metric,
-                     problem_type=problem_type)
+                     problem_type=problem_type,
+                     margin_threshold=margin_thr)
         out = np.asarray(out)  # [F, G]
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,

@@ -44,6 +44,11 @@ class Evaluator:
         m = metric or self.default_metric
         return m not in _SMALLER_BETTER
 
+    @staticmethod
+    def larger_better_metric(metric: str) -> bool:
+        """Direction of a metric by name (single source of truth)."""
+        return metric not in _SMALLER_BETTER
+
     def __repr__(self) -> str:
         return f"{type(self).__name__}(metric={self.default_metric})"
 

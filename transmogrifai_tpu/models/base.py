@@ -74,6 +74,7 @@ class PredictorEstimator(Estimator):
     # model-selector hints
     problem_types = ("binary",)   # subset of binary|multiclass|regression
     supports_grid_vmap = False    # GLMs override: grid+fold axes vmappable
+    produces_probabilities = True  # margin-only models (SVC) override False
 
     def fit_arrays(self, X: np.ndarray, y: np.ndarray,
                    w: Optional[np.ndarray] = None) -> PredictionModel:

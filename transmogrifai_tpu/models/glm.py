@@ -230,6 +230,7 @@ class OpLinearSVC(PredictorEstimator):
 
     problem_types = ("binary",)
     supports_grid_vmap = True
+    produces_probabilities = False
 
     @classmethod
     def _declare_params(cls):
