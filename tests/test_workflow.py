@@ -1,0 +1,147 @@
+"""End-to-end workflow engine tests.
+
+Mirrors reference suites core/src/test/scala/com/salesforce/op/
+{OpWorkflowTest,OpWorkflowModelReaderWriterTest}.scala and the canonical
+helloworld flow (OpTitanicSimple.scala:94-149): raw features -> transmogrify
+-> sanityCheck -> BinaryClassificationModelSelector -> train -> score ->
+save/load -> score parity.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.preparators import SanityChecker
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.evaluators.evaluators import Evaluators
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.types import PickList, Real, RealNN
+from transmogrifai_tpu.workflow import Workflow, WorkflowModel, compute_dag
+
+
+def titanic_like_records(rng, n=300):
+    """Synthetic records shaped like the Titanic demo (pclass/sex/age/fare)."""
+    rows = []
+    for i in range(n):
+        sex = "female" if rng.uniform() < 0.4 else "male"
+        pclass = int(rng.integers(1, 4))
+        age = float(rng.normal(30, 12)) if rng.uniform() > 0.1 else None
+        fare = float(abs(rng.normal(30, 20)))
+        logit = (1.8 * (sex == "female") - 0.7 * (pclass - 2)
+                 + (0.0 if age is None else -0.01 * (age - 30)) + 0.01 * fare - 0.4)
+        p = 1 / (1 + np.exp(-logit))
+        survived = float(rng.uniform() < p)
+        rows.append({"survived": survived, "sex": sex, "pclass": str(pclass),
+                     "age": age, "fare": fare})
+    return rows
+
+
+def build_features():
+    survived = FeatureBuilder.RealNN("survived").extract(
+        lambda r: r["survived"]).as_response()
+    sex = FeatureBuilder.PickList("sex").extract(lambda r: r["sex"]).as_predictor()
+    pclass = FeatureBuilder.PickList("pclass").extract(
+        lambda r: r["pclass"]).as_predictor()
+    age = FeatureBuilder.Real("age").extract(lambda r: r["age"]).as_predictor()
+    fare = FeatureBuilder.Real("fare").extract(lambda r: r["fare"]).as_predictor()
+    return survived, [sex, pclass, age, fare]
+
+
+def small_selector():
+    return BinaryClassificationModelSelector.with_cross_validation(
+        model_types=[],
+        models_and_parameters=[
+            (OpLogisticRegression(), param_grid(reg_param=[0.01, 0.1]))],
+        num_folds=3, seed=11)
+
+
+@pytest.fixture
+def trained(rng):
+    rows = titanic_like_records(rng)
+    survived, predictors = build_features()
+    vec = transmogrify(predictors)
+    checked = SanityChecker(min_variance=1e-6).set_input(
+        survived, vec).get_output()
+    pred = small_selector().set_input(survived, checked).get_output()
+    wf = (Workflow()
+          .set_reader(ListReader(rows))
+          .set_result_features(pred))
+    model = wf.train()
+    return rows, survived, pred, model
+
+
+def test_dag_layering():
+    survived, predictors = build_features()
+    vec = transmogrify(predictors)
+    checked = SanityChecker().set_input(survived, vec).get_output()
+    pred = small_selector().set_input(survived, checked).get_output()
+    dag = compute_dag((pred,))
+    # vectorizers -> combiner -> sanity checker -> selector = 4 layers
+    assert len(dag.layers) == 4
+    # selector is last, alone
+    assert len(dag.layers[-1]) == 1
+    # every vectorizer sits in the first layer
+    assert len(dag.layers[0]) >= 2
+
+
+def test_train_and_score_end_to_end(trained):
+    rows, survived, pred, model = trained
+    assert model.selector_summary() is not None
+    scores = model.score(keep_raw_features=False)
+    assert pred.name in scores.column_names()
+    block = scores.data(pred.name)
+    assert block.shape[0] == len(rows)
+
+    metrics = model.evaluate(Evaluators.BinaryClassification.au_roc())
+    # learnable synthetic signal: anything above 0.7 means the pipe works
+    assert metrics["au_roc"] > 0.7
+
+    pretty = model.summary_pretty()
+    assert "Evaluated" in pretty and "OpLogisticRegression" in pretty
+
+
+def test_score_without_labels(trained):
+    rows, survived, pred, model = trained
+    # scoring reader data has no 'survived' field at all
+    unlabeled = [{k: v for k, v in r.items() if k != "survived"} for r in rows]
+    scored = model.transform(ListReader(unlabeled).generate_dataset(
+        [f for f in model.raw_features() if not f.is_response]))
+    assert scored.data(pred.name).shape[0] == len(rows)
+
+
+def test_save_load_score_parity(trained, tmp_path):
+    rows, survived, pred, model = trained
+    before = model.score().data(pred.name)
+
+    path = str(tmp_path / "model")
+    model.save(path)
+    loaded = WorkflowModel.load(path)
+    loaded.set_reader(ListReader(rows))
+    after = loaded.score().data(pred.name)
+    np.testing.assert_allclose(before, after, rtol=1e-6, atol=1e-6)
+
+    # summaries survive the round trip
+    assert loaded.selector_summary().best_model_name == \
+        model.selector_summary().best_model_name
+    assert loaded.sanity_checker_summary() is not None
+
+
+def test_compute_data_up_to(rng):
+    rows = titanic_like_records(rng, n=50)
+    survived, predictors = build_features()
+    vec = transmogrify(predictors)
+    wf = Workflow().set_reader(ListReader(rows)).set_result_features(vec)
+    ds = wf.compute_data_up_to(vec)
+    assert vec.name in ds.column_names()
+    assert ds.data(vec.name).ndim == 2
+
+
+def test_missing_raw_column_fails(rng):
+    survived, predictors = build_features()
+    vec = transmogrify(predictors)
+    ds = Dataset.from_features([("fare", Real, [1.0, 2.0])])
+    wf = Workflow().set_input_dataset(ds).set_result_features(vec)
+    with pytest.raises(ValueError, match="missing raw feature"):
+        wf.train()

@@ -194,6 +194,17 @@ class SanityCheckerModel(Transformer):
                  summary=self.summary.to_json() if self.summary else None)
         return d
 
+    @classmethod
+    def from_save_args(cls, args: Dict[str, Any]) -> "SanityCheckerModel":
+        return cls(
+            indices_to_keep=args["indices_to_keep"],
+            metadata=(VectorMetadata.from_json(args["metadata"])
+                      if args.get("metadata") else None),
+            summary=(SanityCheckerSummary.from_json(args["summary"])
+                     if args.get("summary") else None),
+            operation_name=args.get("operation_name", "sanityCheck"),
+            uid=args.get("uid"))
+
 
 class SanityChecker(Estimator):
     """Estimator2(RealNN label, OPVector) -> cleaned OPVector."""
@@ -222,6 +233,9 @@ class SanityChecker(Estimator):
             Param("max_rule_confidence", "label-leakage rule confidence", 1.0),
             Param("min_required_rule_support", "rule support threshold", 1.0),
             Param("feature_label_corr_only", "skip full corr matrix", False),
+            Param("max_corr_matrix_columns",
+                  "widest vector for which the full d x d correlation matrix "
+                  "is stored in the summary", 256),
         ]
 
     def __init__(self, uid: Optional[str] = None, **params):
@@ -268,9 +282,15 @@ class SanityChecker(Estimator):
             corr = np.asarray(S.pearson_with_label(Xj, yj))
         # full feature-feature matrix (one X^T X matmul) unless the user opts
         # out (reference featureLabelCorrOnly, SanityChecker.scala:193)
+        # cap on columns for which the full d x d matrix is materialized and
+        # stored in the summary: beyond this the matrix costs O(d^2) host
+        # memory + JSON size for little diagnostic value (the drop logic only
+        # needs corr-with-label)
+        corr_matrix_cap = int(self.get_param("max_corr_matrix_columns"))
         corr_matrix: Optional[np.ndarray] = None
         if not bool(self.get_param("feature_label_corr_only")) and \
-                self.get_param("correlation_type") == "pearson":
+                self.get_param("correlation_type") == "pearson" and \
+                X.shape[1] <= corr_matrix_cap:
             corr_matrix = np.asarray(S.pearson_matrix(Xj))
         label_cs = S.col_stats(yj[:, None])
 

@@ -118,6 +118,20 @@ class SelectedModel(PredictionModel):
         )
         return d
 
+    @classmethod
+    def from_save_args(cls, args: Dict[str, Any]) -> "SelectedModel":
+        """Reference ModelSelector.scala:235-240 — the wrapped best model is
+        re-instantiated from its own class + args on load."""
+        from ..stages.registry import build_stage
+        best = build_stage(args["best_model_class"], args["best_model_args"])
+        return cls(
+            best_model=best,
+            summary=ModelSelectorSummary.from_json(args["summary"]),
+            label_map={int(k): int(v)
+                       for k, v in (args.get("label_map") or {}).items()} or None,
+            operation_name=args.get("operation_name", "modelSelector"),
+            uid=args.get("uid"))
+
 
 class ModelSelector(PredictorEstimator):
     """Estimator2(RealNN label, OPVector features) -> Prediction running the

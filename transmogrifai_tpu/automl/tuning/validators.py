@@ -57,43 +57,43 @@ class BestEstimator:
     validated: List[ValidatedModel] = field(default_factory=list)
 
 
-def _metric_fn(problem_type: str, metric: str,
-               margin_threshold: float = 0.0) -> Callable:
-    """Pure-jax (scores, labels, weights) -> scalar used inside the vmapped
-    sweep. Binary scores are margins (monotone in probability, so rank
-    metrics match); thresholded metrics use the margin equivalent of the
-    evaluator's probability threshold (logit for probabilistic models)."""
+def _metric_fn(problem_type: str, metric: str) -> Callable:
+    """Pure-jax (scores, labels, weights, margin_threshold) -> scalar used
+    inside the vmapped sweep. Binary scores are margins (monotone in
+    probability, so rank metrics match); thresholded metrics use the margin
+    equivalent of the evaluator's probability threshold (logit for
+    probabilistic models). The threshold is a traced scalar so distinct
+    evaluator thresholds do NOT trigger sweep-kernel recompiles."""
     if problem_type == "binary":
         if metric == "au_pr":
-            return M.au_pr
+            return lambda s, y, w, thr: M.au_pr(s, y, w)
         if metric == "au_roc":
-            return M.au_roc
-        def bin_m(s, y, w, _m=metric, _t=margin_threshold):
-            return getattr(M.binary_metrics(s, y, w, threshold=_t), _m)
+            return lambda s, y, w, thr: M.au_roc(s, y, w)
+        def bin_m(s, y, w, thr, _m=metric):
+            return getattr(M.binary_metrics(s, y, w, threshold=thr), _m)
         return bin_m
     if problem_type == "regression":
-        def reg_m(p, y, w, _m=metric):
+        def reg_m(p, y, w, thr, _m=metric):
             return getattr(M.regression_metrics(p, y, w), _m)
         return reg_m
     raise ValueError(f"No vmapped metric for problem type {problem_type}")
 
 
-@partial(jax.jit, static_argnames=("fit_one", "metric", "problem_type",
-                                   "margin_threshold"))
-def _sweep(X, y, w, fold_masks, regs, alphas, *, fit_one, metric, problem_type,
-           margin_threshold=0.0):
+@partial(jax.jit, static_argnames=("fit_one", "metric", "problem_type"))
+def _sweep(X, y, w, fold_masks, regs, alphas, margin_threshold, *, fit_one,
+           metric, problem_type):
     """The sweep kernel: metrics[F, G] for F fold masks x G grid points.
 
     One XLA program: on a row-sharded X every Gram-matrix reduction inside
     fit_one becomes an ICI psum; fold/grid axes are embarrassingly parallel
     (vmap) and can additionally be laid out on the `model` mesh axis.
     """
-    mfn = _metric_fn(problem_type, metric, margin_threshold)
+    mfn = _metric_fn(problem_type, metric)
 
     def one(mask, reg, alpha):
         beta, b0 = fit_one(X, y, mask * w, reg, alpha)
         score = X @ beta + b0
-        return mfn(score, y, (1.0 - mask) * w)
+        return mfn(score, y, (1.0 - mask) * w, margin_threshold)
 
     per_grid = jax.vmap(lambda m: jax.vmap(partial(one, m))(regs, alphas))
     return per_grid(fold_masks)
@@ -202,9 +202,9 @@ class Validator:
                      jnp.asarray(w, jnp.float32),
                      jnp.asarray(masks, jnp.float32),
                      jnp.asarray(regs), jnp.asarray(alphas),
+                     jnp.asarray(margin_thr, jnp.float32),
                      fit_one=fit_one, metric=metric,
-                     problem_type=problem_type,
-                     margin_threshold=margin_thr)
+                     problem_type=problem_type)
         out = np.asarray(out)  # [F, G]
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,

@@ -56,3 +56,17 @@ class FeatureGeneratorStage(PipelineStage):
         d.update(name=self.feature_name, type=self.feature_type.type_name(),
                  is_response=self.is_response)
         return d
+
+    @classmethod
+    def from_save_args(cls, args: Dict[str, Any]) -> "FeatureGeneratorStage":
+        """Rebuilt generators extract by field name from dict records — the
+        original user extract lambda is not persisted (same restriction as
+        the reference: FeatureGeneratorStage extract functions must be
+        re-supplied for retraining; scoring reads named columns)."""
+        name = args["name"]
+        tcls = FeatureType.from_name(args["type"])
+        return cls(name=name, feature_type=tcls,
+                   extract_fn=lambda rec: rec.get(name) if isinstance(rec, dict)
+                   else getattr(rec, name, None),
+                   is_response=bool(args.get("is_response", False)),
+                   uid=args.get("uid"))

@@ -306,11 +306,6 @@ class JoinedReader(Reader):
             return "left"
         if hint is self.right or hint == id(self.right):
             return "right"
-        # one explicit list determines the other side by complement
-        if self.left_features is not None and self.right_features is None:
-            return "right"
-        if self.right_features is not None and self.left_features is None:
-            return "left"
         raise ValueError(
             f"JoinedReader cannot route feature '{f.name}': pass "
             "left_features/right_features name lists or set the generator's "

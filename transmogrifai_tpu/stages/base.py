@@ -138,6 +138,18 @@ class PipelineStage(HasParams):
         OpPipelineStageWriter ctor-arg capture, but explicit, not reflective)."""
         return {"operation_name": self.operation_name, "uid": self.uid}
 
+    @classmethod
+    def from_save_args(cls, args: Dict[str, Any]) -> "PipelineStage":
+        """Rebuild from save_args (reference OpPipelineStageReader.scala:52).
+        Default: cls(**args) filtered through the ctor signature; stages whose
+        state is not plain ctor kwargs override this."""
+        from .registry import default_from_save_args
+        if args.get("lambda"):
+            raise ValueError(
+                f"{cls.__name__} wraps a python lambda and cannot be rebuilt "
+                f"from JSON; pass it via load(..., custom_stages={{uid: stage}})")
+        return default_from_save_args(cls, args)
+
     def copy(self, **param_overrides: Any) -> "PipelineStage":
         """Fresh instance with same ctor args (new uid) and current+overridden
         params — used by the model selector to expand grids."""
@@ -336,3 +348,12 @@ class JaxTransformer(Transformer):
 
     def get_jax_fn(self) -> Optional[Callable]:
         return self._fn
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        if self._fn is not None:
+            # ctor-passed callables can't round-trip through JSON; flag so
+            # load fails fast with the custom_stages hint (subclasses that
+            # override get_jax_fn rebuild their fn and don't set this)
+            d["lambda"] = True
+        return d

@@ -1,0 +1,131 @@
+"""Stage class registry + save-arg (de)serialization.
+
+Reference: features/.../stages/OpPipelineStageWriter.scala:52 /
+OpPipelineStageReader.scala:52 — stages persist as JSON of ctor args and are
+recovered reflectively. Here recovery is explicit: every stage class exposes
+``save_args()`` (JSON-able ctor kwargs) and the classmethod
+``from_save_args``; the registry maps class names to classes. Arrays embedded
+in save_args are hoisted into a side npz store by ``pack_args`` so the JSON
+graph stays small and arrays load zero-copy.
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+# Modules scanned for PipelineStage subclasses. Extended via register_module /
+# register_stage for user stages (the reference's analogous requirement: stage
+# classes must be on the classpath at load time).
+_STAGE_MODULES = [
+    "transmogrifai_tpu.stages.base",
+    "transmogrifai_tpu.features.generator",
+    "transmogrifai_tpu.automl.vectorizers.base",
+    "transmogrifai_tpu.automl.vectorizers.numeric",
+    "transmogrifai_tpu.automl.vectorizers.categorical",
+    "transmogrifai_tpu.automl.vectorizers.text",
+    "transmogrifai_tpu.automl.vectorizers.dates",
+    "transmogrifai_tpu.automl.vectorizers.geo",
+    "transmogrifai_tpu.automl.vectorizers.maps",
+    "transmogrifai_tpu.automl.vectorizers.combiner",
+    "transmogrifai_tpu.automl.preparators",
+    "transmogrifai_tpu.automl.selector",
+    "transmogrifai_tpu.models.glm",
+]
+
+_EXTRA_STAGES: Dict[str, type] = {}
+_registry_cache: Optional[Dict[str, type]] = None
+
+
+def register_stage(cls: type) -> type:
+    """Register a user stage class for load-time recovery (decorator-friendly)."""
+    global _registry_cache
+    _EXTRA_STAGES[cls.__name__] = cls
+    _registry_cache = None
+    return cls
+
+
+def register_module(module_name: str) -> None:
+    global _registry_cache
+    if module_name not in _STAGE_MODULES:
+        _STAGE_MODULES.append(module_name)
+        _registry_cache = None
+
+
+def stage_registry() -> Dict[str, type]:
+    global _registry_cache
+    if _registry_cache is not None:
+        return _registry_cache
+    from .base import PipelineStage
+    reg: Dict[str, type] = {}
+    for mod_name in _STAGE_MODULES:
+        try:
+            mod = importlib.import_module(mod_name)
+        except ImportError:
+            continue
+        for obj in vars(mod).values():
+            if isinstance(obj, type) and issubclass(obj, PipelineStage):
+                reg[obj.__name__] = obj
+    reg.update(_EXTRA_STAGES)
+    _registry_cache = reg
+    return reg
+
+
+def resolve_stage_class(name: str) -> type:
+    reg = stage_registry()
+    if name not in reg:
+        raise KeyError(
+            f"Unknown stage class '{name}'. Register its module via "
+            f"transmogrifai_tpu.stages.registry.register_module/register_stage "
+            f"before loading (reference: stage classes must be on the "
+            f"classpath, OpPipelineStageReader.scala:52)")
+    return reg[name]
+
+
+# -- array packing ---------------------------------------------------------
+
+def pack_args(obj: Any, store: Dict[str, np.ndarray], prefix: str) -> Any:
+    """Recursively replace ndarrays with {"__ndarray__": key} refs, hoisting
+    the arrays into `store` (saved as one npz next to the JSON graph)."""
+    if isinstance(obj, np.ndarray):
+        key = f"{prefix}.{len(store)}"
+        store[key] = obj
+        return {"__ndarray__": key}
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, dict):
+        return {str(k): pack_args(v, store, prefix) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [pack_args(v, store, prefix) for v in obj]
+    return obj
+
+
+def unpack_args(obj: Any, store: Dict[str, np.ndarray]) -> Any:
+    if isinstance(obj, dict):
+        if set(obj.keys()) == {"__ndarray__"}:
+            return store[obj["__ndarray__"]]
+        return {k: unpack_args(v, store) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [unpack_args(v, store) for v in obj]
+    return obj
+
+
+def build_stage(class_name: str, args: Dict[str, Any]):
+    """Instantiate a stage from its class name + unpacked save_args."""
+    cls = resolve_stage_class(class_name)
+    return cls.from_save_args(args)
+
+
+def default_from_save_args(cls: type, args: Dict[str, Any]):
+    """Construct cls(**args), dropping keys its __init__ does not accept
+    (mirror of PipelineStage.copy's filtering)."""
+    args = {k: v for k, v in args.items() if k != "lambda"}
+    sig = inspect.signature(cls.__init__)
+    has_kwargs = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                     for p in sig.parameters.values())
+    if not has_kwargs:
+        accepted = set(sig.parameters) - {"self"}
+        args = {k: v for k, v in args.items() if k in accepted}
+    return cls(**args)

@@ -1,0 +1,14 @@
+"""Workflow engine: DAG assembly, layered XLA-fused fit/transform, scoring,
+persistence (reference core/.../OpWorkflow.scala, OpWorkflowModel.scala,
+utils/stages/FitStagesUtil.scala)."""
+from .dag import (CutDAG, StagesDAG, collect_features, collect_raw_features,
+                  compute_dag, cut_dag, validate_stages)
+from .fitting import LayerRunner
+from .io import load_model, save_model
+from .workflow import Workflow, WorkflowModel
+
+__all__ = [
+    "CutDAG", "StagesDAG", "collect_features", "collect_raw_features",
+    "compute_dag", "cut_dag", "validate_stages", "LayerRunner",
+    "load_model", "save_model", "Workflow", "WorkflowModel",
+]

@@ -1,0 +1,142 @@
+"""Layer fit/transform execution with XLA fusion.
+
+Reference: core/.../utils/stages/FitStagesUtil.scala —
+``fitAndTransformDAG:213`` fits a DAG layer-by-layer; within a layer every
+estimator is fitted, then ``applyOpTransformations:96`` fuses all row-level
+transformers of the layer into ONE rdd.map pass. The TPU redesign does the
+fusing in the compiler: every jax-able transformer of a layer is traced into
+a single jitted XLA program over whole columns (XLA then fuses the
+elementwise work into as few kernels as HBM traffic requires); host-only
+transformers (string/object columns) run columnar on the host.
+
+Missing response columns at scoring time are synthesized as all-NaN columns
+so (label, features) stages score without labels — the reference gets this
+for free from nullable DataFrame columns.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.dataset import Column, Dataset
+from ..stages.base import Estimator, PipelineStage, Transformer
+from ..types import ColumnKind
+from .dag import StagesDAG
+
+_DEVICE_KINDS = (ColumnKind.FLOAT, ColumnKind.INT, ColumnKind.BOOL,
+                 ColumnKind.VECTOR)
+
+
+def _ensure_input_columns(ds: Dataset, stage: PipelineStage) -> Dataset:
+    """Synthesize all-NaN columns for missing *response* inputs (score path)."""
+    for f in stage.input_features:
+        if f.name not in ds and f.is_response:
+            kind = f.feature_type.column_kind
+            if kind in (ColumnKind.FLOAT, ColumnKind.INT, ColumnKind.BOOL):
+                ds = ds.with_column(f.name, Column(
+                    kind=kind, data=np.full(ds.n_rows, np.nan)))
+            else:
+                arr = np.empty(ds.n_rows, dtype=object)
+                ds = ds.with_column(f.name, Column(kind=kind, data=arr))
+    return ds
+
+
+class LayerRunner:
+    """Applies the transformers of DAG layers, fusing jax-able ones into one
+    jitted XLA program per layer. Keeps a jit cache keyed by the layer's stage
+    uids so scoring re-uses the compiled programs."""
+
+    def __init__(self):
+        self._jit_cache: Dict[Tuple[str, ...], Callable] = {}
+
+    # -- one layer ---------------------------------------------------------
+    def apply_layer(self, ds: Dataset,
+                    transformers: Sequence[Transformer]) -> Dataset:
+        for st in transformers:
+            ds = _ensure_input_columns(ds, st)
+        fusable: List[Transformer] = []
+        host: List[Transformer] = []
+        for st in transformers:
+            fn = st.get_jax_fn()
+            ok = fn is not None and all(
+                ds.column(n).kind in _DEVICE_KINDS for n in st.input_names())
+            (fusable if ok else host).append(st)
+
+        if fusable:
+            ds = self._apply_fused(ds, fusable)
+        for st in host:
+            ds = st.transform(ds)
+        return ds
+
+    def _apply_fused(self, ds: Dataset, stages: List[Transformer]) -> Dataset:
+        input_names: List[str] = []
+        for st in stages:
+            for n in st.input_names():
+                if n not in input_names:
+                    input_names.append(n)
+        key = tuple(st.uid for st in stages) + ("|",) + tuple(input_names)
+        jitted = self._jit_cache.get(key)
+        if jitted is None:
+            jitted = _build_fused_program(stages, input_names)
+            self._jit_cache[key] = jitted
+        arrays = [ds.data(n) for n in input_names]
+        outs = jitted(*arrays)
+        for st, out in zip(stages, outs):
+            out = np.asarray(out)
+            kind = st.output_type.column_kind
+            if kind == ColumnKind.VECTOR:
+                if out.ndim == 1:
+                    out = out[:, None]
+                col = Column(kind=kind, data=out.astype(np.float32),
+                             metadata=st.output_metadata())
+            else:
+                col = Column(kind=kind, data=out.astype(np.float64))
+            ds = ds.with_column(st.output_name(), col)
+        return ds
+
+    # -- whole DAG ---------------------------------------------------------
+    def apply_dag(self, ds: Dataset, dag: StagesDAG) -> Dataset:
+        """Score path: every stage must already be a transformer (reference
+        OpWorkflowCore.applyTransformationsDAG:290)."""
+        for layer in dag.layers:
+            for st in layer:
+                if isinstance(st, Estimator):
+                    raise ValueError(
+                        f"DAG contains unfitted estimator {st.stage_name}; "
+                        f"train the workflow first")
+            ds = self.apply_layer(ds, layer)  # type: ignore[arg-type]
+        return ds
+
+    def fit_dag(self, ds: Dataset, dag: StagesDAG) -> Tuple[Dataset, StagesDAG]:
+        """Train path (reference fitAndTransformDAG:213): per layer — fit all
+        estimators, then apply the layer's transformers (originals + freshly
+        fitted models) in one fused pass."""
+        fitted_layers: List[List[Transformer]] = []
+        for layer in dag.layers:
+            fitted: List[Transformer] = []
+            for st in layer:
+                if isinstance(st, Estimator):
+                    ds_in = _ensure_input_columns(ds, st)
+                    model = st.fit(ds_in)
+                    fitted.append(model)
+                else:
+                    fitted.append(st)  # type: ignore[arg-type]
+            ds = self.apply_layer(ds, fitted)
+            fitted_layers.append(fitted)
+        return ds, StagesDAG(layers=fitted_layers)  # type: ignore[arg-type]
+
+
+def _build_fused_program(stages: Sequence[Transformer],
+                         input_names: Sequence[str]) -> Callable:
+    import jax
+
+    fns = [st.get_jax_fn() for st in stages]
+    index = {n: i for i, n in enumerate(input_names)}
+    arg_ix = [[index[n] for n in st.input_names()] for st in stages]
+
+    def fused(*arrays):
+        return tuple(fn(*[arrays[i] for i in ix])
+                     for fn, ix in zip(fns, arg_ix))
+
+    return jax.jit(fused)

@@ -1,0 +1,166 @@
+"""Workflow-model persistence: one JSON graph + one npz array store.
+
+Reference: core/.../OpWorkflowModelWriter.scala:52 (single ``op-model.json``
+with uids, features JSON, stages JSON, params) and OpWorkflowModelReader.scala
+:51. Spark's per-stage native saves become entries in ``arrays.npz``; loading
+rebuilds stages via the registry (stages/registry.py) and re-wires the feature
+lineage graph, after which scoring recompiles the same XLA programs.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..data.vector import VectorMetadata
+from ..features.feature import Feature
+from ..features.generator import FeatureGeneratorStage
+from ..stages.base import PipelineStage
+from ..stages.registry import build_stage, pack_args, unpack_args
+from ..types import FeatureType
+from .dag import StagesDAG, collect_features
+from .workflow import WorkflowModel
+
+MODEL_JSON = "op-model.json"
+ARRAYS_NPZ = "arrays.npz"
+FORMAT_VERSION = 1
+
+
+def save_model(model: WorkflowModel, path: str, overwrite: bool = True) -> None:
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(path)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        else:
+            os.remove(path)
+    os.makedirs(path, exist_ok=True)
+
+    store: Dict[str, np.ndarray] = {}
+    features = collect_features(model.result_features)
+
+    feat_json: List[Dict[str, Any]] = []
+    gen_stages: Dict[str, FeatureGeneratorStage] = {}
+    for f in features:
+        entry = {
+            "uid": f.uid,
+            "name": f.name,
+            "type": f.feature_type.type_name(),
+            "is_response": f.is_response,
+            "origin_stage_uid": f.origin_stage.uid if f.origin_stage else None,
+            "parent_uids": [p.uid for p in f.parents],
+        }
+        feat_json.append(entry)
+        if isinstance(f.origin_stage, FeatureGeneratorStage):
+            gen_stages[f.origin_stage.uid] = f.origin_stage
+
+    gen_json = [
+        {"class": type(g).__name__, "args": pack_args(g.save_args(), store, g.uid)}
+        for g in gen_stages.values()
+    ]
+
+    layers_json: List[List[Dict[str, Any]]] = []
+    for layer in model.dag.layers:
+        lj: List[Dict[str, Any]] = []
+        for st in layer:
+            entry = {
+                "class": type(st).__name__,
+                "uid": st.uid,
+                "args": pack_args(st.save_args(), store, st.uid),
+                "input_uids": [f.uid for f in st.input_features],
+                "output_name": st.output_name(),
+            }
+            md = getattr(st, "output_metadata", lambda: None)()
+            if isinstance(md, VectorMetadata):
+                entry["metadata"] = md.to_json()
+            lj.append(entry)
+        layers_json.append(lj)
+
+    doc = {
+        "format_version": FORMAT_VERSION,
+        "result_feature_uids": [f.uid for f in model.result_features],
+        "blacklisted_features": model.blacklist,
+        "features": feat_json,
+        "generators": gen_json,
+        "stage_layers": layers_json,
+        "raw_feature_filter": (model.rff_results.to_json()
+                               if model.rff_results is not None else None),
+    }
+    with open(os.path.join(path, MODEL_JSON), "w") as fh:
+        json.dump(doc, fh, indent=1)
+    np.savez_compressed(os.path.join(path, ARRAYS_NPZ), **store)
+
+
+def load_model(path: str,
+               custom_stages: Optional[Dict[str, PipelineStage]] = None
+               ) -> WorkflowModel:
+    with open(os.path.join(path, MODEL_JSON)) as fh:
+        doc = json.load(fh)
+    if doc.get("format_version", 0) > FORMAT_VERSION:
+        raise ValueError(f"Model format {doc['format_version']} is newer than "
+                         f"this library supports ({FORMAT_VERSION})")
+    npz_path = os.path.join(path, ARRAYS_NPZ)
+    store: Dict[str, np.ndarray] = {}
+    if os.path.exists(npz_path):
+        with np.load(npz_path, allow_pickle=False) as z:
+            store = {k: z[k] for k in z.files}
+    custom_stages = custom_stages or {}
+
+    # 1. rebuild stages
+    stages: Dict[str, PipelineStage] = {}
+    for gj in doc["generators"]:
+        args = unpack_args(gj["args"], store)
+        st = custom_stages.get(args.get("uid")) or build_stage(gj["class"], args)
+        stages[st.uid] = st
+    layer_entries: List[List[Dict[str, Any]]] = doc["stage_layers"]
+    for layer in layer_entries:
+        for ej in layer:
+            if ej["uid"] in custom_stages:
+                st = custom_stages[ej["uid"]]
+            else:
+                st = build_stage(ej["class"], unpack_args(ej["args"], store))
+            stages[ej["uid"]] = st
+
+    # 2. rebuild the feature graph (parents before children by construction)
+    feats: Dict[str, Feature] = {}
+    for fj in doc["features"]:
+        origin = stages.get(fj["origin_stage_uid"]) if fj["origin_stage_uid"] else None
+        f = Feature(
+            name=fj["name"],
+            feature_type=FeatureType.from_name(fj["type"]),
+            is_response=fj["is_response"],
+            origin_stage=origin,
+            parents=[feats[p] for p in fj["parent_uids"]],
+            uid=fj["uid"],
+        )
+        feats[f.uid] = f
+
+    # 3. wire stage inputs / outputs
+    for layer in layer_entries:
+        for ej in layer:
+            st = stages[ej["uid"]]
+            st.set_input(*[feats[u] for u in ej["input_uids"]])
+            st.set_output_name(ej["output_name"])
+            if ej.get("metadata") and hasattr(st, "set_metadata"):
+                st.set_metadata(VectorMetadata.from_json(ej["metadata"]))
+
+    dag = StagesDAG(layers=[[stages[ej["uid"]] for ej in layer]
+                            for layer in layer_entries])
+
+    rff = None
+    if doc.get("raw_feature_filter"):
+        try:
+            from ..filters.raw_feature_filter import RawFeatureFilterResults
+            rff = RawFeatureFilterResults.from_json(doc["raw_feature_filter"])
+        except ImportError:
+            rff = None
+
+    return WorkflowModel(
+        result_features=[feats[u] for u in doc["result_feature_uids"]],
+        dag=dag,
+        blacklist=doc.get("blacklisted_features", []),
+        rff_results=rff,
+    )

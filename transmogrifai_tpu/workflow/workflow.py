@@ -1,0 +1,289 @@
+"""Workflow: DAG assembly, training, scoring.
+
+Reference: core/.../OpWorkflow.scala:59 (setResultFeatures:85 reconstructs
+the stage DAG from feature lineage; train:332 / fitStages:368),
+core/.../OpWorkflowCore.scala:52 (shared state, applyTransformationsDAG:290)
+and core/.../OpWorkflowModel.scala:59 (score:254, scoreAndEvaluate:291,
+evaluate:319, summaryPretty:205, save:219).
+
+TPU-first: train fits the DAG layer-by-layer, each layer's transform is one
+jitted XLA program (workflow/fitting.py); the fitted model's score path is a
+fixed pipeline of compiled programs reusable on any backend (TPU for bulk
+scoring, CPU for "local" serving — replacing the reference's MLeap path).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.dataset import Column, Dataset
+from ..evaluators.evaluators import Evaluator
+from ..features.feature import Feature
+from ..readers.readers import Reader
+from ..stages.base import PipelineStage, Transformer
+from ..types import ColumnKind, Prediction
+from .dag import (StagesDAG, collect_features, collect_raw_features,
+                  compute_dag, validate_stages)
+from .fitting import LayerRunner
+
+
+class Workflow:
+    """Assembles the stage DAG from result features and trains it."""
+
+    def __init__(self):
+        self._result_features: Tuple[Feature, ...] = ()
+        self._reader: Optional[Reader] = None
+        self._input_dataset: Optional[Dataset] = None
+        self._raw_feature_filter = None  # set via with_raw_feature_filter
+        self._blacklist: List[str] = []
+
+    # -- configuration (reference OpWorkflow setters) ----------------------
+    def set_result_features(self, *features: Feature) -> "Workflow":
+        self._result_features = tuple(features)
+        dag = compute_dag(self._result_features)
+        validate_stages(dag)
+        return self
+
+    def set_reader(self, reader: Reader) -> "Workflow":
+        self._reader = reader
+        return self
+
+    def set_input_dataset(self, ds: Dataset) -> "Workflow":
+        self._input_dataset = ds
+        return self
+
+    def with_raw_feature_filter(self, rff) -> "Workflow":
+        """Attach a RawFeatureFilter (reference OpWorkflow.withRawFeatureFilter
+        :523); applied to raw data before fitting, its exclusions become the
+        workflow blacklist."""
+        self._raw_feature_filter = rff
+        return self
+
+    @property
+    def result_features(self) -> Tuple[Feature, ...]:
+        return self._result_features
+
+    def raw_features(self) -> List[Feature]:
+        return collect_raw_features(self._result_features)
+
+    # -- data --------------------------------------------------------------
+    def generate_raw_data(self) -> Dataset:
+        """Reference OpWorkflow.generateRawData:222."""
+        raw = self.raw_features()
+        if self._reader is not None:
+            ds = self._reader.generate_dataset(raw)
+        elif self._input_dataset is not None:
+            ds = self._input_dataset
+            missing = [f.name for f in raw if f.name not in ds]
+            if missing:
+                raise ValueError(
+                    f"Input dataset is missing raw feature columns: {missing}")
+        else:
+            raise ValueError("Set a reader or an input dataset before training")
+        if self._raw_feature_filter is not None:
+            result = self._raw_feature_filter.apply(ds, self.raw_features())
+            self._blacklist = list(result.dropped)
+            ds = result.cleaned
+        return ds
+
+    # -- training ----------------------------------------------------------
+    def train(self) -> "WorkflowModel":
+        raw_data = self.generate_raw_data()
+        dag = compute_dag(self._result_features)
+        validate_stages(dag)
+        runner = LayerRunner()
+        transformed, fitted_dag = runner.fit_dag(raw_data, dag)
+        model = WorkflowModel(
+            result_features=self._result_features,
+            dag=fitted_dag,
+            runner=runner,
+            blacklist=list(self._blacklist),
+            rff_results=(self._raw_feature_filter.results
+                         if self._raw_feature_filter is not None else None),
+        )
+        model._train_data = transformed
+        model._reader = self._reader
+        return model
+
+    def compute_data_up_to(self, feature: Feature) -> Dataset:
+        """Materialize the DAG only up to `feature` (reference
+        OpWorkflow.computeDataUpTo / runner Features run type)."""
+        sub = Workflow().set_result_features(feature)
+        if self._reader is not None:
+            sub.set_reader(self._reader)
+        if self._input_dataset is not None:
+            sub.set_input_dataset(self._input_dataset)
+        raw = sub.generate_raw_data()
+        dag = compute_dag((feature,))
+        runner = LayerRunner()
+        out, _ = runner.fit_dag(raw, dag)
+        return out
+
+    def load_model(self, path: str, custom_stages: Optional[Dict[str, PipelineStage]] = None
+                   ) -> "WorkflowModel":
+        from .io import load_model
+        return load_model(path, custom_stages=custom_stages)
+
+
+class WorkflowModel:
+    """Fitted workflow: every stage is a transformer; scoring is a fixed
+    sequence of per-layer XLA programs."""
+
+    def __init__(self, result_features: Sequence[Feature],
+                 dag: StagesDAG,
+                 runner: Optional[LayerRunner] = None,
+                 blacklist: Sequence[str] = (),
+                 rff_results=None):
+        self.result_features = tuple(result_features)
+        self.dag = dag
+        self.runner = runner or LayerRunner()
+        self.blacklist = list(blacklist)
+        self.rff_results = rff_results
+        self._train_data: Optional[Dataset] = None
+        self._reader: Optional[Reader] = None
+
+    # -- access ------------------------------------------------------------
+    @property
+    def stages(self) -> List[Transformer]:
+        return self.dag.stages  # type: ignore[return-value]
+
+    def raw_features(self) -> List[Feature]:
+        return collect_raw_features(self.result_features)
+
+    def set_reader(self, reader: Reader) -> "WorkflowModel":
+        self._reader = reader
+        return self
+
+    def _selected_model(self):
+        from ..automl.selector import SelectedModel
+        for st in self.stages:
+            if isinstance(st, SelectedModel):
+                return st
+        return None
+
+    def _sanity_checker(self):
+        from ..automl.preparators import SanityCheckerModel
+        for st in self.stages:
+            if isinstance(st, SanityCheckerModel):
+                return st
+        return None
+
+    # -- scoring (reference OpWorkflowModel.score:254 / scoreFn:326) -------
+    def transform(self, ds: Optional[Dataset] = None) -> Dataset:
+        """Apply the full DAG; returns raw+derived columns."""
+        if ds is None:
+            if self._reader is None:
+                raise ValueError("score needs a dataset or a reader")
+            ds = self._reader.generate_dataset(self.raw_features())
+        return self.runner.apply_dag(ds, self.dag)
+
+    def score(self, ds: Optional[Dataset] = None,
+              keep_raw_features: bool = False) -> Dataset:
+        """Reference saveScores:376 — keep result-feature columns (+ raw if
+        asked)."""
+        full = self.transform(ds)
+        keep = [f.name for f in self.result_features if f.name in full]
+        if keep_raw_features:
+            keep = [f.name for f in self.raw_features() if f.name in full] + keep
+        return full.select(keep)
+
+    def score_and_evaluate(self, evaluator: Evaluator,
+                           ds: Optional[Dataset] = None
+                           ) -> Tuple[Dataset, Dict[str, float]]:
+        full = self.transform(ds)
+        metrics = self._evaluate_on(full, evaluator)
+        keep = [f.name for f in self.result_features if f.name in full]
+        return full.select(keep), metrics
+
+    def evaluate(self, evaluator: Evaluator,
+                 ds: Optional[Dataset] = None) -> Dict[str, float]:
+        """Reference OpWorkflowModel.evaluate:319 (falls back to the cached
+        training data like the reference's evaluate-on-train)."""
+        if ds is None and self._train_data is not None:
+            return self._evaluate_on(self._train_data, evaluator)
+        return self._evaluate_on(self.transform(ds), evaluator)
+
+    def _evaluate_on(self, full: Dataset, evaluator: Evaluator) -> Dict[str, float]:
+        label_name = self._response_name()
+        pred_name = self._prediction_name()
+        labels = np.asarray(full.data(label_name), dtype=np.float64)
+        pred_col = full.column(pred_name)
+        mask = ~np.isnan(labels)
+        if not mask.all():
+            labels = labels[mask]
+            pred_col = Column(kind=pred_col.kind, data=pred_col.data[mask],
+                              metadata=pred_col.metadata)
+        return evaluator.evaluate_all(labels, pred_col)
+
+    def _response_name(self) -> str:
+        for f in self.raw_features():
+            if f.is_response:
+                return f.name
+        raise ValueError("No response raw feature in this workflow")
+
+    def _prediction_name(self) -> str:
+        for f in self.result_features:
+            if issubclass(f.feature_type, Prediction):
+                return f.name
+        # fall back to the selector's output
+        sel = self._selected_model()
+        if sel is not None:
+            return sel.output_name()
+        raise ValueError("No Prediction result feature")
+
+    # -- introspection -----------------------------------------------------
+    def selector_summary(self):
+        sel = self._selected_model()
+        return sel.summary if sel is not None else None
+
+    def sanity_checker_summary(self):
+        sc = self._sanity_checker()
+        return sc.summary if sc is not None else None
+
+    def model_insights(self):
+        from ..insights.model_insights import extract_insights
+        return extract_insights(self)
+
+    def summary(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"stages": [st.stage_name for st in self.stages],
+                               "blacklisted_features": self.blacklist}
+        sel = self.selector_summary()
+        if sel is not None:
+            out["model_selection"] = sel.to_json()
+        sc = self.sanity_checker_summary()
+        if sc is not None:
+            out["sanity_check"] = sc.to_json()
+        if self.rff_results is not None:
+            out["raw_feature_filter"] = self.rff_results.to_json()
+        return out
+
+    def summary_pretty(self) -> str:
+        """Reference OpWorkflowModel.summaryPretty:205 — the README table."""
+        lines: List[str] = []
+        sel = self.selector_summary()
+        if sel is not None:
+            lines.append(sel.pretty())
+        sc = self.sanity_checker_summary()
+        if sc is not None and getattr(sc, "dropped", None) is not None:
+            lines.append(f"SanityChecker dropped {len(sc.dropped)} columns: "
+                         f"{sc.dropped[:10]}")
+        if self.blacklist:
+            lines.append(f"RawFeatureFilter excluded: {self.blacklist}")
+        return "\n".join(lines) if lines else "(no selector in workflow)"
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: str, overwrite: bool = True) -> None:
+        from .io import save_model
+        save_model(self, path, overwrite=overwrite)
+
+    @staticmethod
+    def load(path: str, custom_stages: Optional[Dict[str, PipelineStage]] = None
+             ) -> "WorkflowModel":
+        from .io import load_model
+        return load_model(path, custom_stages=custom_stages)
+
+    # -- local scoring hook (reference local/OpWorkflowModelLocal) ---------
+    def score_function(self) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
+        from ..local.scoring import score_function
+        return score_function(self)
