@@ -1,0 +1,77 @@
+"""Spark-free local scoring parity.
+
+Mirrors the reference suite local/src/test/.../OpWorkflowModelLocalTest.scala:
+the row-level score function must (a) run on UNLABELED records and (b) agree
+with the batch scoring path row by row.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.preparators import SanityChecker
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.models.trees import OpGBTClassifier
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.workflow import Workflow
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(7)
+    rows = []
+    for _ in range(400):
+        age = float(rng.uniform(18, 80))
+        fare = float(rng.lognormal(3, 1))
+        pclass = str(int(rng.integers(1, 4)))
+        label = float((age < 30 and fare > 20) or pclass == "1")
+        rows.append({"age": age, "fare": fare, "pclass": pclass,
+                     "survived": label})
+    f_age = FeatureBuilder.Real("age").extract(
+        lambda r: r.get("age")).as_predictor()
+    f_fare = FeatureBuilder.Real("fare").extract(
+        lambda r: r.get("fare")).as_predictor()
+    f_cls = FeatureBuilder.PickList("pclass").extract(
+        lambda r: r.get("pclass")).as_predictor()
+    f_y = FeatureBuilder.RealNN("survived").extract(
+        lambda r: r["survived"]).as_response()  # [] access: label REQUIRED
+    vec = transmogrify([f_age, f_fare, f_cls])
+    checked = SanityChecker().set_input(f_y, vec).get_output()
+    sel = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[
+            (OpLogisticRegression(), param_grid(reg_param=[0.01])),
+            (OpGBTClassifier(), param_grid(max_iter=[10], max_depth=[3])),
+        ])
+    pred = sel.set_input(f_y, checked).get_output()
+    wf = Workflow().set_reader(ListReader(rows)).set_result_features(pred)
+    model = wf.train()
+    return model, rows, pred
+
+
+def test_scores_unlabeled_record(fitted):
+    model, rows, pred = fitted
+    fn = model.score_function()
+    rec = {k: v for k, v in rows[0].items() if k != "survived"}
+    out = fn(rec)  # must not raise despite extract_fn using r["survived"]
+    (value,) = out.values()
+    assert isinstance(value, dict)
+    assert "prediction" in value
+
+
+def test_row_level_matches_batch(fitted):
+    model, rows, pred = fitted
+    fn = model.score_function()
+    scored = model.score()
+    col = scored.column(pred.name)
+    for i in (0, 7, 211, 399):
+        rec = {k: v for k, v in rows[i].items() if k != "survived"}
+        out = list(fn(rec).values())[0]
+        batch = col.data[i]
+        batch_pred = (batch.get("prediction") if isinstance(batch, dict)
+                      else batch)
+        assert np.isclose(out["prediction"],
+                          float(np.asarray(batch_pred).ravel()[0]
+                                if not np.isscalar(batch_pred)
+                                else batch_pred), atol=1e-5)

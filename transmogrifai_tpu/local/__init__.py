@@ -1,0 +1,6 @@
+"""Spark-free local serving (reference local/ module, 402 LoC): one fitted
+workflow artifact scores as a plain ``dict -> dict`` function with no
+cluster runtime — see `scoring.score_function`."""
+from .scoring import ScoreFunction, score_function
+
+__all__ = ["ScoreFunction", "score_function"]

@@ -1,0 +1,47 @@
+"""Spark-free row-level scoring: ``Map[String,Any] -> Map[String,Any]``.
+
+Reference: local/.../OpWorkflowModelLocal.scala:93,141,154 — the fitted
+workflow replayed per input map, OP stages applied via ``transformKeyValue``
+and Spark-wrapped stages through MLeap. Here every fitted stage already
+scores host-side through the same ``transform_keyvalue`` protocol (tree
+ensembles traverse raw-value thresholds in numpy; GLMs are a dot product),
+so no second model format is needed — one artifact serves both the batch
+XLA path and this dependency-light local path.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..workflow.workflow import WorkflowModel
+
+ScoreFunction = Callable[[Dict[str, Any]], Dict[str, Any]]
+
+
+def score_function(model: "WorkflowModel") -> ScoreFunction:
+    """Build the per-row scorer for a fitted workflow.
+
+    The returned function takes a raw record dict (same keys the reader's
+    extract functions expect), replays raw-feature extraction and every
+    fitted stage in DAG order, and returns {result_feature_name: value}.
+    Mirrors OpWorkflowModelLocal.scoreFunction (stage replay in DAG order,
+    local/.../OpWorkflowModelLocal.scala:93).
+    """
+    raw_feats = model.raw_features()
+    # responses are not extracted at serving time (records are unlabeled;
+    # reference scores without labels) — downstream stages read them as None
+    generators = [f.origin_stage for f in raw_feats if not f.is_response]
+    response_names = [f.name for f in raw_feats if f.is_response]
+    layers = model.dag.layers
+    result_names = [f.name for f in model.result_features]
+
+    def score(record: Dict[str, Any]) -> Dict[str, Any]:
+        row: Dict[str, Any] = {n: None for n in response_names}
+        for gen in generators:
+            row[gen.feature_name] = gen.extract(record)
+        for layer in layers:
+            for st in layer:
+                row[st.output_name()] = st.transform_keyvalue(row)
+        return {n: row[n] for n in result_names}
+
+    return score

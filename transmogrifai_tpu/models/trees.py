@@ -1,0 +1,433 @@
+"""Tree-family predictors: decision tree, random forest, GBT, XGBoost-class.
+
+Reference wrappers: core/.../impl/classification/{OpDecisionTreeClassifier,
+OpRandomForestClassifier, OpGBTClassifier, OpXGBoostClassifier}.scala and
+core/.../impl/regression/{OpDecisionTreeRegressor, OpRandomForestRegressor,
+OpGBTRegressor, OpXGBoostRegressor}.scala. Param names mirror the Spark/
+XGBoost params the reference grids over (DefaultSelectorParams.scala:35-56).
+
+All training runs through ops/trees histogram kernels — quantile binning +
+level-wise growth as one XLA program per ensemble (scan over trees/rounds).
+The reference reached C++ (libxgboost via JNI + Rabit allreduce) for exactly
+this workload; here the same histogram build is a segment-sum whose
+cross-chip reduction is an XLA psum over ICI.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import trees as T
+from ..stages.params import Param
+from .base import PredictionModel, PredictorEstimator
+
+
+def _softmax_np(raw: np.ndarray) -> np.ndarray:
+    m = raw.max(axis=1, keepdims=True)
+    e = np.exp(raw - m)
+    return e / e.sum(axis=1, keepdims=True)
+
+
+class TreeEnsembleModel(PredictionModel):
+    """Fitted tree ensemble. Serving traverses raw-value thresholds in numpy
+    (the Spark-free local-scoring path); `feat`/`thresh_val`/`leaf` carry a
+    leading [n_trees] axis (flattened rounds x classes for softmax boosting).
+
+    mode: 'classify_mean'  — payload K=n_classes distributions, averaged
+          'margin'         — payload K=1 logistic margins, summed + base
+          'regress_mean'   — payload K=1 values, averaged
+          'regress_sum'    — payload K=1 boosting steps, summed + base
+          'softmax'        — n_trees = rounds*n_classes, per-class margin sum
+    """
+
+    def __init__(self, feat: np.ndarray, thresh_val: np.ndarray,
+                 leaf: np.ndarray, depth: int, mode: str,
+                 base: float = 0.0, n_classes: int = 2,
+                 operation_name: str = "treeEnsemble",
+                 uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.feat = np.asarray(feat, np.int32)
+        self.thresh_val = np.asarray(thresh_val, np.float32)
+        self.leaf = np.asarray(leaf, np.float32)
+        self.depth = int(depth)
+        self.mode = mode
+        self.base = float(base)
+        self.n_classes = int(n_classes)
+
+    def predict_arrays(self, X):
+        X = np.asarray(X, np.float32)
+        agg = T.np_predict_ensemble(self.feat, self.thresh_val, self.leaf,
+                                    X, self.depth)          # [N, K] sums
+        n_trees = self.feat.shape[0]
+        if self.mode == "classify_mean":
+            prob = agg / n_trees
+            prob = np.clip(prob, 0.0, None)
+            prob = prob / np.maximum(prob.sum(axis=1, keepdims=True), 1e-12)
+            pred = prob.argmax(axis=1).astype(np.float32)
+            return pred, agg, prob
+        if self.mode == "margin":
+            margin = agg[:, 0] + self.base
+            p1 = 1.0 / (1.0 + np.exp(-margin))
+            prob = np.stack([1.0 - p1, p1], axis=1)
+            raw = np.stack([-margin, margin], axis=1)
+            return (p1 >= 0.5).astype(np.float32), raw, prob
+        if self.mode == "regress_mean":
+            return (agg[:, 0] / n_trees).astype(np.float32), None, None
+        # regress_sum
+        return (agg[:, 0] + self.base).astype(np.float32), None, None
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(feat=self.feat, thresh_val=self.thresh_val, leaf=self.leaf,
+                 depth=self.depth, mode=self.mode, base=self.base,
+                 n_classes=self.n_classes)
+        return d
+
+
+class SoftmaxEnsembleModel(PredictionModel):
+    """Multiclass boosted ensemble: trees grouped [rounds, n_classes]."""
+
+    def __init__(self, feat: np.ndarray, thresh_val: np.ndarray,
+                 leaf: np.ndarray, depth: int, n_classes: int,
+                 operation_name: str = "xgbSoftmax",
+                 uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.feat = np.asarray(feat, np.int32)          # [R*C, I]
+        self.thresh_val = np.asarray(thresh_val, np.float32)
+        self.leaf = np.asarray(leaf, np.float32)        # [R*C, L, 1]
+        self.depth = int(depth)
+        self.n_classes = int(n_classes)
+
+    def predict_arrays(self, X):
+        X = np.asarray(X, np.float32)
+        n = X.shape[0]
+        C = self.n_classes
+        margins = np.zeros((n, C), np.float32)
+        for c in range(C):
+            margins[:, c] = T.np_predict_ensemble(
+                self.feat[c::C], self.thresh_val[c::C], self.leaf[c::C],
+                X, self.depth)[:, 0]
+        prob = _softmax_np(margins)
+        pred = prob.argmax(axis=1).astype(np.float32)
+        return pred, margins, prob
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(feat=self.feat, thresh_val=self.thresh_val, leaf=self.leaf,
+                 depth=self.depth, n_classes=self.n_classes)
+        return d
+
+
+# -- estimator machinery ----------------------------------------------------
+
+class _TreeEstimator(PredictorEstimator):
+    """Shared: quantile-bin on device, grow, freeze raw-value thresholds."""
+
+    supports_grid_vmap = False
+
+    def _bin(self, X):
+        n_bins = int(self.get_param("max_bins"))
+        Xd = jnp.asarray(X, jnp.float32)
+        edges = T.quantile_edges(Xd, n_bins)
+        Xb = T.bin_matrix(Xd, edges)
+        return Xb, edges, n_bins
+
+    def _freeze(self, trees: T.Tree, edges) -> Dict[str, np.ndarray]:
+        feat = np.asarray(trees.feat)
+        thresh = np.asarray(trees.thresh)
+        tv = np.asarray(T.thresholds_to_values(
+            jnp.asarray(feat), jnp.asarray(thresh), edges))
+        leaf = np.asarray(trees.leaf)
+        # stack any leading (rounds, classes) axes into one tree axis
+        feat = feat.reshape(-1, feat.shape[-1])
+        tv = tv.reshape(-1, tv.shape[-1])
+        leaf = leaf.reshape(-1, leaf.shape[-2], leaf.shape[-1])
+        return dict(feat=feat, thresh_val=tv, leaf=leaf)
+
+    def _key(self):
+        return jax.random.PRNGKey(int(self.get_param("seed")))
+
+    def _w(self, y, w):
+        return (np.ones_like(y, np.float32) if w is None
+                else np.asarray(w, np.float32))
+
+
+def _feature_frac(strategy: str, n_feat: int, classification: bool) -> float:
+    """Spark featureSubsetStrategy -> fraction (RandomForest.scala defaults)."""
+    if strategy == "all":
+        return 1.0
+    if strategy == "auto":
+        return (np.sqrt(n_feat) / n_feat) if classification else (1.0 / 3.0)
+    if strategy == "sqrt":
+        return np.sqrt(n_feat) / n_feat
+    if strategy == "log2":
+        return max(np.log2(max(n_feat, 2)) / n_feat, 1.0 / n_feat)
+    if strategy == "onethird":
+        return 1.0 / 3.0
+    try:
+        return float(strategy)
+    except ValueError:
+        return 1.0
+
+
+class _ForestBase(_TreeEstimator):
+    classification = True
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("num_trees", "ensemble size", 50),
+            Param("max_depth", "tree depth", 5),
+            Param("max_bins", "histogram bins", 32),
+            Param("min_instances_per_node", "min rows per child", 1),
+            Param("min_info_gain", "min impurity decrease", 0.0),
+            Param("subsampling_rate", "bootstrap rate", 1.0),
+            Param("feature_subset_strategy", "auto|all|sqrt|log2|onethird",
+                  "auto"),
+            Param("impurity", "gini|entropy|variance (variance-equivalent "
+                  "gain used)", "gini"),
+            Param("seed", "rng seed", 42),
+        ]
+
+    def _fit_forest(self, X, y, w, G, leaf_mode):
+        Xb, edges, n_bins = self._bin(X)
+        frac = _feature_frac(str(self.get_param("feature_subset_strategy")),
+                             X.shape[1], self.classification)
+        trees = T.fit_forest(
+            Xb, jnp.asarray(G), jnp.asarray(w), self._key(),
+            n_trees=int(self.get_param("num_trees")),
+            depth=int(self.get_param("max_depth")), n_bins=n_bins,
+            subsample=float(self.get_param("subsampling_rate")),
+            feature_frac=float(frac),
+            min_instances=float(self.get_param("min_instances_per_node")),
+            min_info_gain=float(self.get_param("min_info_gain")),
+            leaf_mode=leaf_mode)
+        return self._freeze(trees, edges)
+
+
+class OpRandomForestClassifier(_ForestBase):
+    """Reference OpRandomForestClassifier (impl/classification/, 159 LoC)."""
+
+    problem_types = ("binary", "multiclass")
+    classification = True
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("randomForestClassifier", uid=uid, **params)
+
+    def fit_arrays(self, X, y, w=None):
+        w = self._w(y, w)
+        n_classes = max(int(np.max(y)) + 1 if y.size else 2, 2)
+        G = np.eye(n_classes, dtype=np.float32)[y.astype(int)] * w[:, None]
+        frozen = self._fit_forest(X, y, w, G, leaf_mode="mean")
+        return TreeEnsembleModel(depth=int(self.get_param("max_depth")),
+                                 mode="classify_mean", n_classes=n_classes,
+                                 operation_name=self.operation_name, **frozen)
+
+
+class OpRandomForestRegressor(_ForestBase):
+    """Reference OpRandomForestRegressor (impl/regression/, 133 LoC)."""
+
+    problem_types = ("regression",)
+    classification = False
+    produces_probabilities = False
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("randomForestRegressor", uid=uid, **params)
+
+    def fit_arrays(self, X, y, w=None):
+        w = self._w(y, w)
+        G = (np.asarray(y, np.float32) * w)[:, None]
+        frozen = self._fit_forest(X, y, w, G, leaf_mode="mean")
+        return TreeEnsembleModel(depth=int(self.get_param("max_depth")),
+                                 mode="regress_mean",
+                                 operation_name=self.operation_name, **frozen)
+
+
+def _single_tree_params():
+    return [p for p in _ForestBase._declare_params()
+            if p.name not in ("num_trees", "subsampling_rate",
+                              "feature_subset_strategy")]
+
+
+class OpDecisionTreeClassifier(OpRandomForestClassifier):
+    """Reference OpDecisionTreeClassifier (120 LoC): single tree, all
+    features, no bagging."""
+
+    @classmethod
+    def _declare_params(cls):
+        return _single_tree_params()
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        PredictorEstimator.__init__(self, "decisionTreeClassifier", uid=uid,
+                                    **params)
+
+    def _fit_forest(self, X, y, w, G, leaf_mode):
+        Xb, edges, n_bins = self._bin(X)
+        trees = T.fit_forest(
+            Xb, jnp.asarray(G), jnp.asarray(w), self._key(),
+            n_trees=1, depth=int(self.get_param("max_depth")), n_bins=n_bins,
+            subsample=1.0, feature_frac=1.0, bootstrap=False,
+            min_instances=float(self.get_param("min_instances_per_node")),
+            min_info_gain=float(self.get_param("min_info_gain")),
+            leaf_mode=leaf_mode)
+        return self._freeze(trees, edges)
+
+
+class OpDecisionTreeRegressor(OpRandomForestRegressor):
+    """Reference OpDecisionTreeRegressor (119 LoC)."""
+
+    _fit_forest = OpDecisionTreeClassifier._fit_forest
+
+    @classmethod
+    def _declare_params(cls):
+        return _single_tree_params()
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        PredictorEstimator.__init__(self, "decisionTreeRegressor", uid=uid,
+                                    **params)
+
+
+class _GBTBase(_TreeEstimator):
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("max_iter", "boosting rounds", 20),
+            Param("max_depth", "tree depth", 5),
+            Param("max_bins", "histogram bins", 32),
+            Param("step_size", "learning rate", 0.1),
+            Param("min_instances_per_node", "min rows per child", 1),
+            Param("min_info_gain", "min gain to split", 0.0),
+            Param("subsampling_rate", "row subsample per round", 1.0),
+            Param("seed", "rng seed", 42),
+        ]
+
+    def _fit_gbt(self, X, y, w, loss):
+        Xb, edges, n_bins = self._bin(X)
+        trees, base = T.fit_gbt(
+            Xb, jnp.asarray(y, jnp.float32), jnp.asarray(w), self._key(),
+            n_rounds=int(self.get_param("max_iter")),
+            depth=int(self.get_param("max_depth")), n_bins=n_bins,
+            learning_rate=float(self.get_param("step_size")),
+            min_instances=float(self.get_param("min_instances_per_node")),
+            min_info_gain=float(self.get_param("min_info_gain")),
+            subsample=float(self.get_param("subsampling_rate")),
+            loss=loss)
+        return self._freeze(trees, edges), float(base)
+
+
+class OpGBTClassifier(_GBTBase):
+    """Reference OpGBTClassifier (147 LoC). Binary only — matching Spark's
+    GBTClassifier; multiclass boosting lives in OpXGBoostClassifier."""
+
+    problem_types = ("binary",)
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("gbtClassifier", uid=uid, **params)
+
+    def fit_arrays(self, X, y, w=None):
+        frozen, base = self._fit_gbt(X, y, self._w(y, w), loss="logistic")
+        return TreeEnsembleModel(depth=int(self.get_param("max_depth")),
+                                 mode="margin", base=base,
+                                 operation_name=self.operation_name, **frozen)
+
+
+class OpGBTRegressor(_GBTBase):
+    """Reference OpGBTRegressor (145 LoC)."""
+
+    problem_types = ("regression",)
+    produces_probabilities = False
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("gbtRegressor", uid=uid, **params)
+
+    def fit_arrays(self, X, y, w=None):
+        frozen, base = self._fit_gbt(X, y, self._w(y, w), loss="squared")
+        return TreeEnsembleModel(depth=int(self.get_param("max_depth")),
+                                 mode="regress_sum", base=base,
+                                 operation_name=self.operation_name, **frozen)
+
+
+class _XGBBase(_TreeEstimator):
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("num_round", "boosting rounds", 100),
+            Param("eta", "learning rate", 0.3),
+            Param("max_depth", "tree depth", 6),
+            Param("max_bins", "histogram bins", 256),
+            Param("min_child_weight", "min hessian per child", 1.0),
+            Param("reg_lambda", "L2 on leaves", 1.0),
+            Param("gamma", "complexity penalty per split", 0.0),
+            Param("subsample", "row subsample per round", 1.0),
+            Param("colsample_bytree", "feature subsample", 1.0),
+            Param("seed", "rng seed", 42),
+        ]
+
+    def _common(self):
+        return dict(
+            n_rounds=int(self.get_param("num_round")),
+            depth=int(self.get_param("max_depth")),
+            learning_rate=float(self.get_param("eta")),
+            reg_lambda=float(self.get_param("reg_lambda")),
+            min_child_weight=float(self.get_param("min_child_weight")),
+            gamma=float(self.get_param("gamma")),
+            subsample=float(self.get_param("subsample")),
+            feature_frac=float(self.get_param("colsample_bytree")))
+
+
+class OpXGBoostClassifier(_XGBBase):
+    """Reference OpXGBoostClassifier (375 LoC, JNI -> libxgboost): binary
+    logistic or multiclass softprob, histogram algorithm."""
+
+    problem_types = ("binary", "multiclass")
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("xgbClassifier", uid=uid, **params)
+
+    def fit_arrays(self, X, y, w=None):
+        w = self._w(y, w)
+        n_classes = max(int(np.max(y)) + 1 if y.size else 2, 2)
+        Xb, edges, n_bins = self._bin(X)
+        kw = self._common()
+        depth = kw["depth"]
+        if n_classes <= 2:
+            trees, base = T.fit_gbt(
+                Xb, jnp.asarray(y, jnp.float32), jnp.asarray(w), self._key(),
+                n_bins=n_bins, loss="logistic", **kw)
+            frozen = self._freeze(trees, edges)
+            return TreeEnsembleModel(depth=depth, mode="margin",
+                                     base=float(base),
+                                     operation_name=self.operation_name,
+                                     **frozen)
+        trees = T.fit_gbt_softmax(
+            Xb, jnp.asarray(y, jnp.float32), jnp.asarray(w), self._key(),
+            n_bins=n_bins, n_classes=n_classes, **kw)
+        frozen = self._freeze(trees, edges)
+        return SoftmaxEnsembleModel(depth=depth, n_classes=n_classes,
+                                    operation_name=self.operation_name,
+                                    **frozen)
+
+
+class OpXGBoostRegressor(_XGBBase):
+    """Reference OpXGBoostRegressor (346 LoC): squared-error objective."""
+
+    problem_types = ("regression",)
+    produces_probabilities = False
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("xgbRegressor", uid=uid, **params)
+
+    def fit_arrays(self, X, y, w=None):
+        w = self._w(y, w)
+        Xb, edges, n_bins = self._bin(X)
+        kw = self._common()
+        trees, base = T.fit_gbt(
+            Xb, jnp.asarray(y, jnp.float32), jnp.asarray(w), self._key(),
+            n_bins=n_bins, loss="squared", **kw)
+        frozen = self._freeze(trees, edges)
+        return TreeEnsembleModel(depth=kw["depth"], mode="regress_sum",
+                                 base=float(base),
+                                 operation_name=self.operation_name, **frozen)

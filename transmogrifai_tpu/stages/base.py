@@ -261,9 +261,24 @@ class Transformer(PipelineStage):
 
     def transform_keyvalue(self, row: Dict[str, Any]) -> Any:
         """Row-level scoring protocol (reference OpTransformer.transformKeyValue
-        :551) used by the local scorer: dict in -> raw output value."""
-        in_types = [f.feature_type for f in self._input_features]
-        vals = [t(row.get(n)) for n, t in zip(self.input_names(), in_types)]
+        :551) used by the local scorer: dict in -> raw output value.
+
+        Serving records carry no labels; a missing response value is replaced
+        by a placeholder (fitted transformers never read responses — the
+        reference's scoring path likewise runs label-free) so non-nullable
+        response types (RealNN) don't reject None.
+        """
+        vals = []
+        for f in self._input_features:
+            t = f.feature_type
+            v = row.get(f.name)
+            if v is None and f.is_response:
+                try:
+                    vals.append(t(None))
+                except Exception:
+                    vals.append(t(0.0))
+            else:
+                vals.append(t(v))
         return self.transform_value(*vals).value
 
 

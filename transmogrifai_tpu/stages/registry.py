@@ -33,6 +33,7 @@ _STAGE_MODULES = [
     "transmogrifai_tpu.automl.preparators",
     "transmogrifai_tpu.automl.selector",
     "transmogrifai_tpu.models.glm",
+    "transmogrifai_tpu.models.trees",
 ]
 
 _EXTRA_STAGES: Dict[str, type] = {}
