@@ -1,0 +1,193 @@
+"""RawFeatureFilter exclusion logic.
+
+Mirrors the reference suite core/src/test/.../filters/RawFeatureFilterTest.scala:
+fill-rate exclusion, train/score divergence exclusion, null-label leakage,
+map-key drops, protected features, results round-trip, workflow integration.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.data.dataset import column_from_values
+from transmogrifai_tpu.filters import (
+    FeatureDistribution, RawFeatureFilter, RawFeatureFilterResults,
+    compute_distributions,
+)
+from transmogrifai_tpu.types import PickList, Real, RealNN, TextMap
+
+
+class _F:
+    """Minimal raw-feature stand-in (name + is_response)."""
+    def __init__(self, name, is_response=False):
+        self.name = name
+        self.is_response = is_response
+
+
+def _ds(**cols):
+    pairs = []
+    for name, (tcls, vals) in cols.items():
+        pairs.append((name, tcls, vals))
+    return Dataset.from_features(pairs)
+
+
+class TestDistributions:
+    def test_numeric_distribution(self):
+        rng = np.random.default_rng(0)
+        vals = list(rng.normal(size=100)) + [None] * 25
+        ds = _ds(x=(Real, vals))
+        (d,) = compute_distributions(ds, ["x"], bins=20)
+        assert d.count == 125 and d.nulls == 25
+        assert abs(sum(d.distribution) - 100) < 1e-6
+        assert d.fill_rate() == pytest.approx(0.8)
+
+    def test_text_distribution_hashes_into_bins(self):
+        ds = _ds(c=(PickList, ["a", "b", "a", None, "c", ""]))
+        (d,) = compute_distributions(ds, ["c"], bins=16)
+        assert d.nulls == 2  # None and empty string
+        assert sum(d.distribution) == 4
+
+    def test_js_divergence_same_vs_shifted(self):
+        rng = np.random.default_rng(1)
+        a = rng.normal(0, 1, 2000)
+        b = rng.normal(0, 1, 2000)
+        c = rng.normal(6, 1, 2000)
+        da = compute_distributions(_ds(x=(Real, list(a))), ["x"], 30)[0]
+        rng_a = {"x": (da.summary[0], da.summary[1])}
+        db = compute_distributions(_ds(x=(Real, list(b))), ["x"], 30,
+                                   ranges=rng_a)[0]
+        dc = compute_distributions(_ds(x=(Real, list(c))), ["x"], 30,
+                                   ranges=rng_a)[0]
+        assert da.js_divergence(db) < 0.1
+        # score binned against the train-side range: the +6 sigma shift
+        # piles into the top bin -> near-maximal divergence
+        assert da.js_divergence(dc) > 0.8
+
+    def test_map_key_distributions(self):
+        ds = _ds(m=(TextMap, [{"a": "x", "b": "y"}, {"a": "z"}, {}]))
+        dists = compute_distributions(ds, ["m"], bins=8)
+        keys = {(d.name, d.key) for d in dists}
+        assert ("m", "a") in keys and ("m", "b") in keys and ("m", None) in keys
+        d_a = next(d for d in dists if d.key == "a")
+        assert d_a.nulls == 1  # missing in the empty map row
+
+
+class TestExclusion:
+    def test_low_fill_rate_dropped(self):
+        n = 1000
+        ds = _ds(good=(Real, list(np.arange(n, dtype=float))),
+                 sparse=(Real, [1.0] * 3 + [None] * (n - 3)),
+                 label=(RealNN, list((np.arange(n) % 2).astype(float))))
+        rff = RawFeatureFilter(min_fill_rate=0.1)
+        res = rff.apply(ds, [_F("good"), _F("sparse"), _F("label", True)])
+        assert res.dropped == ["sparse"]
+        assert np.isnan(res.cleaned.column("sparse").data).all()
+        assert not np.isnan(res.cleaned.column("good").data).any()
+
+    def test_train_score_divergence_dropped(self):
+        rng = np.random.default_rng(2)
+        n = 1000
+        train = _ds(stable=(Real, list(rng.normal(0, 1, n))),
+                    drifted=(Real, list(rng.normal(0, 1, n))),
+                    label=(RealNN, list((np.arange(n) % 2).astype(float))))
+        score = _ds(stable=(Real, list(rng.normal(0, 1, n))),
+                    drifted=(Real, list(rng.normal(25, 1, n))))
+        rff = RawFeatureFilter(max_js_divergence=0.5)
+        res = rff.apply(train, [_F("stable"), _F("drifted"),
+                                _F("label", True)], score_ds=score)
+        assert "drifted" in res.dropped and "stable" not in res.dropped
+
+    def test_fill_rate_difference_dropped(self):
+        n = 400
+        train = _ds(flaky=(Real, [1.0] * n),
+                    label=(RealNN, list((np.arange(n) % 2).astype(float))))
+        score = _ds(flaky=(Real, [1.0] * 10 + [None] * (n - 10)))
+        rff = RawFeatureFilter(max_fill_difference=0.5)
+        res = rff.apply(train, [_F("flaky"), _F("label", True)],
+                        score_ds=score)
+        assert res.dropped == ["flaky"]
+
+    def test_null_label_leakage_dropped(self):
+        n = 500
+        label = (np.arange(n) % 2).astype(float)
+        leaky = [None if l > 0 else 1.0 for l in label]
+        ds = _ds(leaky=(Real, leaky),
+                 label=(RealNN, list(label)))
+        rff = RawFeatureFilter(max_correlation=0.9)
+        res = rff.apply(ds, [_F("leaky"), _F("label", True)])
+        assert res.dropped == ["leaky"]
+        r = next(x for x in res.results.exclusion_reasons
+                 if x.name == "leaky" and x.key is None)
+        assert r.null_label_correlation > 0.99
+
+    def test_protected_features_kept(self):
+        n = 200
+        ds = _ds(sparse=(Real, [1.0] * 2 + [None] * (n - 2)),
+                 label=(RealNN, list((np.arange(n) % 2).astype(float))))
+        rff = RawFeatureFilter(min_fill_rate=0.5,
+                               protected_features=["sparse"])
+        res = rff.apply(ds, [_F("sparse"), _F("label", True)])
+        assert res.dropped == []
+
+    def test_map_keys_dropped_individually(self):
+        n = 300
+        maps = [{"keep": "v", "sparse_key": "x"} if i < 3
+                else {"keep": "v"} for i in range(n)]
+        ds = _ds(m=(TextMap, maps),
+                 label=(RealNN, list((np.arange(n) % 2).astype(float))))
+        rff = RawFeatureFilter(min_fill_rate=0.1)
+        res = rff.apply(ds, [_F("m"), _F("label", True)])
+        assert res.dropped_map_keys.get("m") == ["sparse_key"]
+        assert all("sparse_key" not in v
+                   for v in res.cleaned.column("m").data if v)
+        assert all("keep" in v for v in res.cleaned.column("m").data if v)
+
+    def test_results_json_round_trip(self):
+        n = 100
+        ds = _ds(x=(Real, [1.0] * n),
+                 label=(RealNN, list((np.arange(n) % 2).astype(float))))
+        rff = RawFeatureFilter()
+        rff.apply(ds, [_F("x"), _F("label", True)])
+        j = rff.results.to_json()
+        import json
+        restored = RawFeatureFilterResults.from_json(
+            json.loads(json.dumps(j)))
+        assert restored.config == rff.results.config
+        assert restored.train_distributions[0].count == n
+
+
+class TestWorkflowIntegration:
+    def test_workflow_blacklist_and_summary(self):
+        from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+        from transmogrifai_tpu.automl.transmogrifier import transmogrify
+        from transmogrifai_tpu.models.glm import OpLogisticRegression
+        from transmogrifai_tpu.readers.readers import ListReader
+        from transmogrifai_tpu.stages.params import param_grid
+        from transmogrifai_tpu.workflow import Workflow
+
+        rng = np.random.default_rng(3)
+        rows = []
+        for i in range(300):
+            x = float(rng.normal())
+            rows.append({
+                "x": x,
+                "mostly_missing": 1.0 if i < 2 else None,
+                "label": float(x + rng.normal(0, 0.5) > 0),
+            })
+        fx = FeatureBuilder.Real("x").extract(
+            lambda r: r.get("x")).as_predictor()
+        fm = FeatureBuilder.Real("mostly_missing").extract(
+            lambda r: r.get("mostly_missing")).as_predictor()
+        fy = FeatureBuilder.RealNN("label").extract(
+            lambda r: r.get("label")).as_response()
+        vec = transmogrify([fx, fm])
+        pred = BinaryClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=[
+                (OpLogisticRegression(), param_grid(reg_param=[0.01]))],
+        ).set_input(fy, vec).get_output()
+        wf = (Workflow()
+              .set_reader(ListReader(rows))
+              .set_result_features(pred)
+              .with_raw_feature_filter(RawFeatureFilter(min_fill_rate=0.1)))
+        model = wf.train()
+        assert "mostly_missing" in model.blacklist
+        assert "RawFeatureFilter excluded" in model.summary_pretty()

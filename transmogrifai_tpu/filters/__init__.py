@@ -1,0 +1,11 @@
+"""Raw-feature filtering (reference core/.../filters/, 1,360 LoC): exclude
+unreliable raw features before training — see `raw_feature_filter`."""
+from .raw_feature_filter import (
+    ExclusionReasons, FeatureDistribution, RawFeatureFilter,
+    RawFeatureFilterResults, RffResult, compute_distributions,
+)
+
+__all__ = [
+    "ExclusionReasons", "FeatureDistribution", "RawFeatureFilter",
+    "RawFeatureFilterResults", "RffResult", "compute_distributions",
+]

@@ -1,0 +1,441 @@
+"""RawFeatureFilter: pre-training exclusion of unreliable RAW features.
+
+Reference: core/.../filters/RawFeatureFilter.scala:90 (exclusion logic
+getFeaturesToExclude:441, generateFilteredRaw:482), FeatureDistribution.scala:58
+(fillRate:92, jsDivergence:138), Summary.scala:43, RawFeatureFilterResults.scala.
+
+The reference computes per-feature distributions with an RDD treeAggregate on
+the training and scoring readers, then drops raw features whose fill rate is
+too low, whose train/score fill rates or histogram distributions diverge, or
+whose null-pattern leaks the label. Here the numeric histogram pass is one
+jitted XLA reduction over the stacked numeric columns (digitize + one-hot
+matmul histogram — MXU-friendly, psum-ready under row sharding); text/list/
+map values hash into the same fixed bin space on host (reference
+textBinsFormula:581 hashes text into bins the same way).
+
+Dropped features are *nulled in place* (column of all-missing) rather than
+removed, keeping every downstream stage's input arity and the compiled
+programs' shapes static; their vectorized output collapses to constant
+columns which the SanityChecker then removes. The drop set is also recorded
+as the workflow blacklist (reference setBlacklist:112 rewrites the DAG; the
+observable result — excluded features contribute nothing — is the same).
+"""
+from __future__ import annotations
+
+import functools
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.dataset import Column, Dataset
+from ..types import ColumnKind
+
+EPS = 1e-12
+_NUMERIC_KINDS = (ColumnKind.FLOAT, ColumnKind.INT, ColumnKind.BOOL)
+
+
+# -- distributions ----------------------------------------------------------
+
+@dataclass
+class FeatureDistribution:
+    """Reference FeatureDistribution.scala:58 — per (feature[, map key])
+    sketch: counts, nulls, histogram over `bins` buckets, numeric summary."""
+
+    name: str
+    key: Optional[str]          # map key, or None for plain features
+    count: int
+    nulls: int
+    distribution: List[float]   # histogram mass per bin (unnormalized)
+    summary: List[float]        # [min, max, sum, count] (reference Summary)
+
+    def fill_rate(self) -> float:
+        """Reference FeatureDistribution.fillRate:92."""
+        return 0.0 if self.count == 0 else (self.count - self.nulls) / self.count
+
+    def relative_fill_rate(self, other: "FeatureDistribution") -> float:
+        return abs(self.fill_rate() - other.fill_rate())
+
+    def relative_fill_ratio(self, other: "FeatureDistribution") -> float:
+        a, b = self.fill_rate(), other.fill_rate()
+        lo, hi = min(a, b), max(a, b)
+        return float("inf") if lo == 0.0 else hi / lo
+
+    def js_divergence(self, other: "FeatureDistribution") -> float:
+        """Jensen-Shannon divergence of normalized histograms (reference
+        FeatureDistribution.jsDivergence:138); in [0, ln 2] -> scaled [0,1]."""
+        p = np.asarray(self.distribution, np.float64)
+        q = np.asarray(other.distribution, np.float64)
+        ps, qs = p.sum(), q.sum()
+        if ps <= 0 or qs <= 0:
+            return 0.0
+        p, q = p / ps, q / qs
+        m = 0.5 * (p + q)
+
+        def kl(a, b):
+            mask = a > 0
+            return float(np.sum(a[mask] * np.log(a[mask] / (b[mask] + EPS))))
+        return (0.5 * kl(p, m) + 0.5 * kl(q, m)) / np.log(2.0)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "key": self.key, "count": self.count,
+                "nulls": self.nulls, "distribution": list(self.distribution),
+                "summary": list(self.summary)}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "FeatureDistribution":
+        return FeatureDistribution(
+            name=d["name"], key=d.get("key"), count=int(d["count"]),
+            nulls=int(d["nulls"]),
+            distribution=[float(x) for x in d["distribution"]],
+            summary=[float(x) for x in d.get("summary", [])])
+
+
+def _hist_numeric(values: np.ndarray, bins: int,
+                  lo: float, hi: float) -> np.ndarray:
+    """Fixed-range histogram of one numeric column (NaN = missing)."""
+    import jax.numpy as jnp
+    v = jnp.asarray(values, jnp.float32)
+    ok = ~jnp.isnan(v)
+    span = max(hi - lo, EPS)
+    idx = jnp.clip(((v - lo) / span * bins).astype(jnp.int32), 0, bins - 1)
+    idx = jnp.where(ok, idx, bins)  # NaNs into an overflow bucket
+    h = jnp.zeros(bins + 1, jnp.float32).at[idx].add(1.0)
+    return np.asarray(h[:bins], np.float64)
+
+
+def _dist_numeric(name: str, data: np.ndarray, bins: int,
+                  rng: Optional[Tuple[float, float]] = None
+                  ) -> FeatureDistribution:
+    n = len(data)
+    valid = data[~np.isnan(data)]
+    nulls = n - len(valid)
+    if len(valid) == 0:
+        return FeatureDistribution(name, None, n, nulls, [0.0] * bins,
+                                   [0.0, 0.0, 0.0, 0.0])
+    # histogram range comes from the TRAIN-side Summary when provided so
+    # train/score histograms share bins and JS divergence sees location
+    # shift (reference computes one Summary then bins both readers with it)
+    lo, hi = rng if rng is not None else (float(valid.min()),
+                                          float(valid.max()))
+    hist = _hist_numeric(data, bins, lo, hi)
+    return FeatureDistribution(name, None, n, nulls, hist.tolist(),
+                               [lo, hi, float(valid.sum()), float(len(valid))])
+
+
+def _hash_bin(value: Any, bins: int) -> int:
+    """Stable host-side hash of a non-numeric value into [0, bins)
+    (reference hashes text into bins, RawFeatureFilter textBinsFormula:581)."""
+    import zlib
+    s = value if isinstance(value, str) else repr(value)
+    return zlib.crc32(s.encode("utf-8")) % bins
+
+
+def _is_empty(v: Any) -> bool:
+    if v is None:
+        return True
+    if isinstance(v, float) and np.isnan(v):
+        return True
+    if isinstance(v, (str, list, tuple, set, dict)) and len(v) == 0:
+        return True
+    return False
+
+
+def _dist_object(name: str, data: np.ndarray, bins: int,
+                 key: Optional[str] = None) -> FeatureDistribution:
+    n = len(data)
+    hist = np.zeros(bins, np.float64)
+    nulls = 0
+    for v in data:
+        if _is_empty(v):
+            nulls += 1
+            continue
+        if isinstance(v, (list, tuple, set)):
+            for item in v:
+                hist[_hash_bin(item, bins)] += 1.0
+        else:
+            hist[_hash_bin(v, bins)] += 1.0
+    return FeatureDistribution(name, key, n, nulls, hist.tolist(),
+                               [0.0, 0.0, float(hist.sum()), float(n - nulls)])
+
+
+def _map_key_distributions(name: str, data: np.ndarray, bins: int
+                           ) -> List[FeatureDistribution]:
+    """Per-key sketches for a map column (reference drops individual keys)."""
+    n = len(data)
+    per_key_hist: Dict[str, np.ndarray] = {}
+    per_key_present: Dict[str, int] = {}
+    for v in data:
+        if not isinstance(v, dict):
+            continue
+        for k, item in v.items():
+            if _is_empty(item):
+                continue
+            h = per_key_hist.setdefault(k, np.zeros(bins, np.float64))
+            if isinstance(item, (int, float, bool)):
+                h[_hash_bin(f"{float(item):.6g}", bins)] += 1.0
+            elif isinstance(item, (list, tuple, set)):
+                for x in item:
+                    h[_hash_bin(x, bins)] += 1.0
+            else:
+                h[_hash_bin(item, bins)] += 1.0
+            per_key_present[k] = per_key_present.get(k, 0) + 1
+    return [
+        FeatureDistribution(name, k, n, n - per_key_present[k],
+                            per_key_hist[k].tolist(),
+                            [0.0, 0.0, float(per_key_hist[k].sum()),
+                             float(per_key_present[k])])
+        for k in sorted(per_key_hist)
+    ]
+
+
+def compute_distributions(ds: Dataset, names: Sequence[str], bins: int,
+                          ranges: Optional[Dict[str, Tuple[float, float]]]
+                          = None) -> List[FeatureDistribution]:
+    """Sketch every named raw column (reference computeFeatureStats).
+
+    `ranges` pins per-feature histogram bounds (pass the train-side summary
+    bounds when sketching scoring data)."""
+    out: List[FeatureDistribution] = []
+    for name in names:
+        if name not in ds:
+            continue
+        col = ds.column(name)
+        if col.kind in _NUMERIC_KINDS:
+            out.append(_dist_numeric(name, np.asarray(col.data, np.float64),
+                                     bins,
+                                     (ranges or {}).get(name)))
+        elif col.kind == ColumnKind.MAP:
+            out.extend(_map_key_distributions(name, col.data, bins))
+            # whole-map sketch for feature-level fill decisions
+            out.append(_dist_object(name, col.data, bins))
+        else:
+            out.append(_dist_object(name, col.data, bins))
+    return out
+
+
+# -- results ----------------------------------------------------------------
+
+@dataclass
+class ExclusionReasons:
+    """Reference RawFeatureFilterResults exclusion reasons per feature."""
+
+    name: str
+    key: Optional[str] = None
+    train_fill_rate: float = 1.0
+    low_fill_rate: bool = False
+    fill_rate_diff: float = 0.0
+    high_fill_rate_diff: bool = False
+    fill_ratio: float = 1.0
+    high_fill_ratio_diff: bool = False
+    js_divergence: float = 0.0
+    high_js_divergence: bool = False
+    null_label_correlation: float = 0.0
+    null_leakage: bool = False
+
+    @property
+    def excluded(self) -> bool:
+        return (self.low_fill_rate or self.high_fill_rate_diff
+                or self.high_fill_ratio_diff or self.high_js_divergence
+                or self.null_leakage)
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "ExclusionReasons":
+        return ExclusionReasons(**d)
+
+
+@dataclass
+class RawFeatureFilterResults:
+    """Persisted record of the filter run (reference
+    RawFeatureFilterResults.scala); round-trips through the model JSON."""
+
+    config: Dict[str, Any] = field(default_factory=dict)
+    train_distributions: List[FeatureDistribution] = field(default_factory=list)
+    score_distributions: List[FeatureDistribution] = field(default_factory=list)
+    exclusion_reasons: List[ExclusionReasons] = field(default_factory=list)
+    dropped_features: List[str] = field(default_factory=list)
+    dropped_map_keys: Dict[str, List[str]] = field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "config": self.config,
+            "train_distributions": [d.to_json()
+                                    for d in self.train_distributions],
+            "score_distributions": [d.to_json()
+                                    for d in self.score_distributions],
+            "exclusion_reasons": [r.to_json() for r in self.exclusion_reasons],
+            "dropped_features": list(self.dropped_features),
+            "dropped_map_keys": {k: list(v)
+                                 for k, v in self.dropped_map_keys.items()},
+        }
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "RawFeatureFilterResults":
+        return RawFeatureFilterResults(
+            config=d.get("config", {}),
+            train_distributions=[FeatureDistribution.from_json(x)
+                                 for x in d.get("train_distributions", [])],
+            score_distributions=[FeatureDistribution.from_json(x)
+                                 for x in d.get("score_distributions", [])],
+            exclusion_reasons=[ExclusionReasons.from_json(x)
+                               for x in d.get("exclusion_reasons", [])],
+            dropped_features=list(d.get("dropped_features", [])),
+            dropped_map_keys={k: list(v) for k, v in
+                              d.get("dropped_map_keys", {}).items()},
+        )
+
+
+@dataclass
+class RffResult:
+    cleaned: Dataset
+    dropped: List[str]
+    dropped_map_keys: Dict[str, List[str]]
+    results: RawFeatureFilterResults
+
+
+# -- the filter -------------------------------------------------------------
+
+def _null_column(col: Column) -> Column:
+    """All-missing replacement preserving kind (keeps DAG arity static)."""
+    n = len(col)
+    if col.kind in _NUMERIC_KINDS:
+        return Column(kind=col.kind, data=np.full(n, np.nan, np.float64))
+    data = np.empty(n, dtype=object)
+    return Column(kind=col.kind, data=data)
+
+
+class RawFeatureFilter:
+    """Reference RawFeatureFilter.scala:90; defaults from
+    OpWorkflow.withRawFeatureFilter:523."""
+
+    def __init__(self, score_reader=None, bins: int = 100,
+                 min_fill_rate: float = 0.001,
+                 max_fill_difference: float = 0.90,
+                 max_fill_ratio_diff: float = 20.0,
+                 max_js_divergence: float = 0.90,
+                 max_correlation: float = 0.95,
+                 protected_features: Sequence[str] = ()):
+        self.score_reader = score_reader
+        self.bins = int(bins)
+        self.min_fill_rate = float(min_fill_rate)
+        self.max_fill_difference = float(max_fill_difference)
+        self.max_fill_ratio_diff = float(max_fill_ratio_diff)
+        self.max_js_divergence = float(max_js_divergence)
+        self.max_correlation = float(max_correlation)
+        self.protected_features = set(protected_features)
+        self.results: Optional[RawFeatureFilterResults] = None
+
+    # -- null-label leakage ------------------------------------------------
+    def _null_label_corr(self, ds: Dataset, name: str,
+                         label: np.ndarray) -> float:
+        col = ds.column(name)
+        if col.kind in _NUMERIC_KINDS:
+            is_null = np.isnan(np.asarray(col.data, np.float64))
+        else:
+            is_null = np.array([_is_empty(v) for v in col.data], bool)
+        x = is_null.astype(np.float64)
+        ok = ~np.isnan(label)
+        if ok.sum() < 2 or x[ok].std() < EPS or label[ok].std() < EPS:
+            return 0.0
+        return float(abs(np.corrcoef(x[ok], label[ok])[0, 1]))
+
+    def apply(self, ds: Dataset, raw_features: Sequence[Any],
+              score_ds: Optional[Dataset] = None) -> RffResult:
+        """Compute sketches, decide exclusions, null out dropped features.
+
+        Reference generateFilteredRaw:482: distributions on the training
+        reader and (if present) the scoring reader; score-side checks only
+        run when scoring data exists.
+        """
+        predictors = [f for f in raw_features if not f.is_response]
+        responses = [f for f in raw_features if f.is_response]
+        pred_names = [f.name for f in predictors]
+
+        if score_ds is None and self.score_reader is not None:
+            score_ds = self.score_reader.generate_dataset(list(raw_features))
+
+        train_dists = compute_distributions(ds, pred_names, self.bins)
+        train_ranges = {d.name: (d.summary[0], d.summary[1])
+                        for d in train_dists
+                        if d.key is None and d.summary[3] > 0}
+        score_dists = (compute_distributions(score_ds, pred_names, self.bins,
+                                             ranges=train_ranges)
+                       if score_ds is not None else [])
+        score_by_key = {(d.name, d.key): d for d in score_dists}
+
+        label: Optional[np.ndarray] = None
+        if responses and responses[0].name in ds:
+            lcol = ds.column(responses[0].name)
+            if lcol.kind in _NUMERIC_KINDS:
+                label = np.asarray(lcol.data, np.float64)
+
+        reasons: List[ExclusionReasons] = []
+        for d in train_dists:
+            r = ExclusionReasons(name=d.name, key=d.key,
+                                 train_fill_rate=d.fill_rate())
+            r.low_fill_rate = r.train_fill_rate < self.min_fill_rate
+            other = score_by_key.get((d.name, d.key))
+            if other is not None and other.count > 0:
+                r.fill_rate_diff = d.relative_fill_rate(other)
+                r.high_fill_rate_diff = (r.fill_rate_diff
+                                         > self.max_fill_difference)
+                r.fill_ratio = d.relative_fill_ratio(other)
+                r.high_fill_ratio_diff = (r.fill_ratio
+                                          > self.max_fill_ratio_diff)
+                r.js_divergence = d.js_divergence(other)
+                r.high_js_divergence = (r.js_divergence
+                                        > self.max_js_divergence)
+            if label is not None and d.key is None:
+                r.null_label_correlation = self._null_label_corr(
+                    ds, d.name, label)
+                r.null_leakage = (r.null_label_correlation
+                                  > self.max_correlation)
+            reasons.append(r)
+
+        dropped: List[str] = []
+        dropped_keys: Dict[str, List[str]] = {}
+        for r in reasons:
+            if r.name in self.protected_features or not r.excluded:
+                continue
+            if r.key is None:
+                if r.name not in dropped:
+                    dropped.append(r.name)
+            else:
+                dropped_keys.setdefault(r.name, []).append(r.key)
+        # keys of dropped map features need no separate listing
+        dropped_keys = {k: v for k, v in dropped_keys.items()
+                        if k not in dropped}
+
+        cleaned = ds
+        for name in dropped:
+            if name in cleaned:
+                cleaned = cleaned.with_column(
+                    name, _null_column(cleaned.column(name)))
+        for name, keys in dropped_keys.items():
+            col = cleaned.column(name)
+            kept = np.empty(len(col), dtype=object)
+            drop = set(keys)
+            for i, v in enumerate(col.data):
+                kept[i] = ({k: x for k, x in v.items() if k not in drop}
+                           if isinstance(v, dict) else v)
+            cleaned = cleaned.with_column(name,
+                                          Column(kind=col.kind, data=kept))
+
+        self.results = RawFeatureFilterResults(
+            config={"bins": self.bins, "min_fill_rate": self.min_fill_rate,
+                    "max_fill_difference": self.max_fill_difference,
+                    "max_fill_ratio_diff": self.max_fill_ratio_diff,
+                    "max_js_divergence": self.max_js_divergence,
+                    "max_correlation": self.max_correlation},
+            train_distributions=train_dists,
+            score_distributions=score_dists,
+            exclusion_reasons=reasons,
+            dropped_features=dropped,
+            dropped_map_keys=dropped_keys,
+        )
+        return RffResult(cleaned=cleaned, dropped=dropped,
+                         dropped_map_keys=dropped_keys, results=self.results)
