@@ -1,0 +1,134 @@
+"""ModelInsights + RecordInsightsLOCO.
+
+Mirrors reference suites core/src/test/.../ModelInsightsTest.scala and
+.../impl/insights/RecordInsightsLOCOTest.scala.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.preparators import SanityChecker
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.insights import (
+    RecordInsightsLOCO, extract_insights, model_contributions)
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.models.trees import OpGBTClassifier
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.workflow import Workflow
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(11)
+    rows = []
+    for _ in range(500):
+        strong = float(rng.normal())
+        weak = float(rng.normal())
+        noise = float(rng.normal())
+        label = float(2.5 * strong + 0.3 * weak + rng.normal(0, 0.5) > 0)
+        rows.append({"strong": strong, "weak": weak, "noise": noise,
+                     "label": label})
+    fs = FeatureBuilder.Real("strong").extract(
+        lambda r: r.get("strong")).as_predictor()
+    fw = FeatureBuilder.Real("weak").extract(
+        lambda r: r.get("weak")).as_predictor()
+    fn = FeatureBuilder.Real("noise").extract(
+        lambda r: r.get("noise")).as_predictor()
+    fy = FeatureBuilder.RealNN("label").extract(
+        lambda r: r.get("label")).as_response()
+    vec = transmogrify([fs, fw, fn])
+    checked = SanityChecker().set_input(fy, vec).get_output()
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[
+            (OpLogisticRegression(), param_grid(reg_param=[0.01]))],
+    ).set_input(fy, checked).get_output()
+    wf = Workflow().set_reader(ListReader(rows)).set_result_features(pred)
+    return wf.train(), rows
+
+
+class TestModelInsights:
+    def test_contributions_rank_strong_first(self, fitted):
+        model, _ = fitted
+        mi = model.model_insights()
+        by_name = {f.feature_name: f for f in mi.features}
+        assert by_name["strong"].max_contribution() > \
+            by_name["weak"].max_contribution()
+        assert by_name["strong"].max_contribution() > \
+            by_name["noise"].max_contribution()
+
+    def test_correlations_populated(self, fitted):
+        model, _ = fitted
+        mi = model.model_insights()
+        by_name = {f.feature_name: f for f in mi.features}
+        assert by_name["strong"].max_corr() > 0.5
+        assert by_name["strong"].max_corr() > by_name["noise"].max_corr()
+
+    def test_selected_model_and_evals(self, fitted):
+        model, _ = fitted
+        mi = model.model_insights()
+        assert mi.selected_model["best_model_type"] == "OpLogisticRegression"
+        assert mi.problem_type == "binary"
+        assert "au_pr" in mi.train_evaluation
+        assert mi.label_name == "label"
+
+    def test_json_serializable(self, fitted):
+        model, _ = fitted
+        j = model.model_insights().to_json()
+        assert json.dumps(j)  # round-trips through JSON
+
+    def test_pretty_tables(self, fitted):
+        model, _ = fitted
+        s = model.model_insights().pretty()
+        assert "Top Model Contributions" in s
+        assert "Top Correlations" in s
+        assert "strong" in s
+
+    def test_tree_contributions(self):
+        X = np.random.default_rng(5).normal(size=(400, 4)).astype(np.float32)
+        y = ((X[:, 1] > 0)).astype(np.float32)
+        m = OpGBTClassifier(max_iter=10, max_depth=3).fit_arrays(X, y)
+        imp = model_contributions(m, 4)
+        assert imp is not None and imp.argmax() == 1
+        assert imp.sum() == pytest.approx(1.0, abs=1e-6)
+
+
+class TestLOCO:
+    def test_loco_ranks_causal_column(self):
+        rng = np.random.default_rng(9)
+        X = rng.normal(size=(50, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        model = OpLogisticRegression(reg_param=0.01).fit_arrays(X, y)
+        loco = RecordInsightsLOCO(model=model, top_k=2)
+        deltas = loco.insights_matrix(X)
+        assert deltas.shape == (50, 3, 2)
+        # column 0 must dominate the attribution for nearly every row
+        strongest = np.abs(deltas).max(axis=2).argmax(axis=1)
+        assert (strongest == 0).mean() > 0.9
+
+    def test_loco_transform_emits_topk_maps(self, fitted):
+        model, rows = fitted
+        sel = model._selected_model()
+        sc = model._sanity_checker()
+        scored = model.transform()
+        vec_col = scored.column(sc.output_name())
+        loco = RecordInsightsLOCO(model=sel, top_k=2)
+        out = loco.transform_columns(vec_col)
+        first = out.data[0]
+        assert isinstance(first, dict) and len(first) == 2
+        for k, v in first.items():
+            deltas = json.loads(v)
+            assert isinstance(k, str) and len(deltas) == 2  # two classes
+
+    def test_loco_zero_for_constant_column(self):
+        rng = np.random.default_rng(10)
+        X = rng.normal(size=(30, 3)).astype(np.float32)
+        X[:, 2] = 0.0
+        y = (X[:, 0] > 0).astype(np.float32)
+        model = OpLogisticRegression().fit_arrays(X, y)
+        loco = RecordInsightsLOCO(model=model)
+        deltas = loco.insights_matrix(X)
+        assert np.abs(deltas[:, 2, :]).max() < 1e-6

@@ -1,0 +1,12 @@
+"""Model explainability (reference ModelInsights.scala:72 and
+impl/insights/RecordInsightsLOCO.scala:62)."""
+from .loco import RecordInsightsLOCO
+from .model_insights import (
+    DerivedFeatureInsights, FeatureInsights, ModelInsights,
+    extract_insights, model_contributions,
+)
+
+__all__ = [
+    "DerivedFeatureInsights", "FeatureInsights", "ModelInsights",
+    "RecordInsightsLOCO", "extract_insights", "model_contributions",
+]

@@ -1,0 +1,266 @@
+"""ModelInsights: merged per-feature diagnostics of a fitted workflow.
+
+Reference: core/.../ModelInsights.scala:72 (extractFromStages used at
+OpWorkflowModel.scala:173, prettyPrint:99) — joins the assembled vector's
+column provenance (OpVectorMetadata) with SanityChecker statistics, the
+ModelSelector summary, RawFeatureFilter results, and the winning model's
+per-column contributions into one JSON artifact + pretty tables.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+# -- contributions ----------------------------------------------------------
+
+def model_contributions(model: Any, n_cols: int) -> Optional[np.ndarray]:
+    """Per-column contribution of a fitted model: |coefficient| for linear
+    family, split-frequency importance for tree ensembles (reference exposes
+    Spark's coefficients/featureImportances through ModelInsights).
+    Returns [n_cols] or None when the model family has no notion of it."""
+    from ..models import glm
+    from ..models import trees as tr
+    from ..automl.selector import SelectedModel
+
+    if isinstance(model, SelectedModel):
+        return model_contributions(model.best_model, n_cols)
+    if isinstance(model, glm.LinearBinaryModel):
+        return np.abs(model.beta[:n_cols])
+    if isinstance(model, glm.LinearRegressionModel):
+        return np.abs(model.beta[:n_cols])
+    if isinstance(model, glm.SoftmaxModel):
+        return np.abs(model.B[:n_cols, :]).sum(axis=1)
+    if isinstance(model, glm.NaiveBayesModel):
+        return np.abs(model.log_prob.T[:n_cols, :]).sum(axis=1)
+    if isinstance(model, (tr.TreeEnsembleModel, tr.SoftmaxEnsembleModel)):
+        live = np.isfinite(model.thresh_val)          # dead splits are +inf
+        counts = np.bincount(model.feat[live].ravel(), minlength=n_cols)
+        total = counts.sum()
+        return (counts / total if total else counts).astype(np.float64)[:n_cols]
+    return None
+
+
+# -- insight records --------------------------------------------------------
+
+@dataclass
+class DerivedFeatureInsights:
+    """One column of the model's input vector (reference Insights per
+    derived feature)."""
+
+    column_name: str
+    column_index: int
+    grouping: Optional[str] = None
+    indicator_value: Optional[str] = None
+    contribution: Optional[float] = None
+    corr_label: Optional[float] = None
+    cramers_v: Optional[float] = None
+    variance: Optional[float] = None
+    mean: Optional[float] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
+@dataclass
+class FeatureInsights:
+    """All derived columns of one raw feature + exclusion info."""
+
+    feature_name: str
+    feature_type: str = ""
+    derived: List[DerivedFeatureInsights] = field(default_factory=list)
+    excluded_by: Optional[str] = None     # 'SanityChecker'|'RawFeatureFilter'
+    exclusion_reasons: List[str] = field(default_factory=list)
+
+    def max_contribution(self) -> float:
+        vals = [d.contribution for d in self.derived
+                if d.contribution is not None]
+        return max(vals) if vals else 0.0
+
+    def max_corr(self) -> float:
+        vals = [abs(d.corr_label) for d in self.derived
+                if d.corr_label is not None and np.isfinite(d.corr_label)]
+        return max(vals) if vals else 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"feature_name": self.feature_name,
+                "feature_type": self.feature_type,
+                "derived": [d.to_json() for d in self.derived],
+                "excluded_by": self.excluded_by,
+                "exclusion_reasons": list(self.exclusion_reasons)}
+
+
+@dataclass
+class ModelInsights:
+    """The merged artifact (reference ModelInsights case class)."""
+
+    label_name: Optional[str]
+    problem_type: Optional[str]
+    features: List[FeatureInsights] = field(default_factory=list)
+    selected_model: Optional[Dict[str, Any]] = None
+    validation_results: List[Dict[str, Any]] = field(default_factory=list)
+    train_evaluation: Dict[str, float] = field(default_factory=dict)
+    holdout_evaluation: Dict[str, float] = field(default_factory=dict)
+    stage_names: List[str] = field(default_factory=list)
+    blacklisted: List[str] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "label_name": self.label_name,
+            "problem_type": self.problem_type,
+            "features": [f.to_json() for f in self.features],
+            "selected_model": self.selected_model,
+            "validation_results": self.validation_results,
+            "train_evaluation": self.train_evaluation,
+            "holdout_evaluation": self.holdout_evaluation,
+            "stage_names": self.stage_names,
+            "blacklisted": self.blacklisted,
+        }
+
+    # -- pretty (reference prettyPrint:99 -> README tables) ----------------
+    def pretty(self, top_k: int = 15) -> str:
+        lines: List[str] = []
+        if self.selected_model:
+            lines.append(
+                f"Selected model: {self.selected_model.get('best_model_type')}"
+                f" grid={self.selected_model.get('best_grid')}")
+        if self.train_evaluation:
+            ev = ", ".join(f"{k}={v:.4f}"
+                           for k, v in sorted(self.train_evaluation.items()))
+            lines.append(f"Train evaluation: {ev}")
+        if self.holdout_evaluation:
+            ev = ", ".join(f"{k}={v:.4f}"
+                           for k, v in sorted(self.holdout_evaluation.items()))
+            lines.append(f"Holdout evaluation: {ev}")
+
+        ranked = sorted(self.features, key=lambda f: -f.max_contribution())
+        lines.append("")
+        lines.append(f"{'Top Model Contributions':<32}{'Contribution':>14}")
+        for f in ranked[:top_k]:
+            lines.append(f"{f.feature_name:<32}{f.max_contribution():>14.4f}")
+
+        by_corr = sorted(self.features, key=lambda f: -f.max_corr())
+        lines.append("")
+        lines.append(f"{'Top Correlations':<32}{'Correlation':>14}")
+        for f in by_corr[:top_k]:
+            lines.append(f"{f.feature_name:<32}{f.max_corr():>14.4f}")
+
+        excluded = [f for f in self.features if f.excluded_by]
+        if excluded:
+            lines.append("")
+            lines.append("Excluded features:")
+            for f in excluded:
+                why = "; ".join(f.exclusion_reasons) or f.excluded_by
+                lines.append(f"  {f.feature_name} ({f.excluded_by}): {why}")
+        return "\n".join(lines)
+
+
+# -- extraction -------------------------------------------------------------
+
+def _final_vector_metadata(model) -> Optional[Any]:
+    """Metadata of the vector the winning model consumed: the sanity
+    checker's post-slice metadata when present, else the last vector-
+    producing stage's."""
+    sc = model._sanity_checker()
+    if sc is not None and getattr(sc, "metadata", None) is not None:
+        idx = getattr(sc, "indices_to_keep", None)
+        md = sc.metadata
+        return md.select(list(idx)) if idx is not None else md
+    for st in reversed(model.stages):
+        md = st.output_metadata()
+        if md is not None:
+            return md
+    return None
+
+
+def extract_insights(model) -> ModelInsights:
+    """Build ModelInsights from a fitted WorkflowModel (reference
+    extractFromStages, OpWorkflowModel.scala:173)."""
+    sel = model._selected_model()
+    sel_summary = model.selector_summary()
+    sc_summary = model.sanity_checker_summary()
+    md = _final_vector_metadata(model)
+
+    # sanity-checker stats by column name (first entry is the label)
+    stats_by_name: Dict[str, Dict[str, Any]] = {}
+    label_name = None
+    if sc_summary is not None:
+        cs = sc_summary.column_stats
+        if cs:
+            label_name = cs[0]["name"]
+        for st in cs[1:]:
+            stats_by_name[st["name"]] = st
+
+    contrib = None
+    if sel is not None and md is not None:
+        contrib = model_contributions(sel, md.size)
+
+    features: Dict[str, FeatureInsights] = {}
+    if md is not None:
+        for c in md.columns:
+            fi = features.setdefault(
+                c.parent_feature_name,
+                FeatureInsights(feature_name=c.parent_feature_name,
+                                feature_type=c.parent_feature_type))
+            name = c.column_name()
+            st = stats_by_name.get(name, {})
+            fi.derived.append(DerivedFeatureInsights(
+                column_name=name, column_index=c.index,
+                grouping=c.grouping, indicator_value=c.indicator_value,
+                contribution=(float(contrib[c.index])
+                              if contrib is not None and c.index < len(contrib)
+                              else None),
+                corr_label=st.get("corr_label"),
+                cramers_v=st.get("cramers_v"),
+                variance=st.get("variance"),
+                mean=st.get("mean")))
+
+    # columns the SanityChecker dropped still deserve a line w/ reasons
+    if sc_summary is not None:
+        for dropped_col in sc_summary.dropped:
+            reasons = sc_summary.drop_reasons.get(dropped_col, [])
+            parent = dropped_col.split("_")[0]
+            fi = features.setdefault(parent, FeatureInsights(parent))
+            if fi.excluded_by is None and all(
+                    d.column_name != dropped_col for d in fi.derived):
+                fi.derived.append(DerivedFeatureInsights(
+                    column_name=dropped_col, column_index=-1))
+            # only mark the whole feature excluded when ALL its columns drop
+        kept_parents = {c.parent_feature_name for c in md.columns} if md else set()
+        for name, fi in features.items():
+            if name not in kept_parents and sc_summary.dropped:
+                fi.excluded_by = "SanityChecker"
+                fi.exclusion_reasons = sorted({
+                    r for col in sc_summary.dropped
+                    if col.split("_")[0] == name
+                    for r in sc_summary.drop_reasons.get(col, [])})
+
+    # raw-feature-filter exclusions
+    if model.rff_results is not None:
+        for name in model.rff_results.dropped_features:
+            fi = features.setdefault(name, FeatureInsights(name))
+            fi.excluded_by = "RawFeatureFilter"
+            fi.exclusion_reasons = [
+                k for r in model.rff_results.exclusion_reasons
+                if r.name == name and r.key is None and r.excluded
+                for k, v in r.to_json().items()
+                if isinstance(v, bool) and v]
+
+    return ModelInsights(
+        label_name=label_name,
+        problem_type=(sel_summary.problem_type if sel_summary else None),
+        features=list(features.values()),
+        selected_model=({"best_model_type": sel_summary.best_model_type,
+                         "best_model_name": sel_summary.best_model_name,
+                         "best_grid": sel_summary.best_grid}
+                        if sel_summary else None),
+        validation_results=(sel_summary.validation_results
+                            if sel_summary else []),
+        train_evaluation=(sel_summary.train_evaluation if sel_summary else {}),
+        holdout_evaluation=(sel_summary.holdout_evaluation
+                            if sel_summary else {}),
+        stage_names=[st.stage_name for st in model.stages],
+        blacklisted=list(model.blacklist),
+    )

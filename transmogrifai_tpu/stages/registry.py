@@ -34,6 +34,7 @@ _STAGE_MODULES = [
     "transmogrifai_tpu.automl.selector",
     "transmogrifai_tpu.models.glm",
     "transmogrifai_tpu.models.trees",
+    "transmogrifai_tpu.insights.loco",
 ]
 
 _EXTRA_STAGES: Dict[str, type] = {}
