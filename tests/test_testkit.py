@@ -1,0 +1,110 @@
+"""Testkit generators + TestFeatureBuilder.
+
+Mirrors reference testkit suites (testkit/src/test/.../testkit/): streams
+are reproducible, distribution-shaped, typed, and missingness-controlled.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.testkit import (
+    RandomBinary, RandomGeolocation, RandomIntegral, RandomList, RandomMap,
+    RandomReal, RandomSet, RandomText, RandomVector, TestFeatureBuilder,
+)
+from transmogrifai_tpu.types import (
+    Binary, Country, Email, Geolocation, Integral, MultiPickList, PickList,
+    Real, RealNN, TextList, TextMap,
+)
+
+
+class TestGenerators:
+    def test_normal_reals_shape_and_type(self):
+        vals = RandomReal.normal(mean=5.0, sigma=2.0, seed=1).take(2000)
+        assert all(isinstance(v, Real) for v in vals)
+        arr = np.array([v.value for v in vals])
+        assert abs(arr.mean() - 5.0) < 0.2
+        assert abs(arr.std() - 2.0) < 0.2
+
+    def test_probability_of_empty(self):
+        vals = (RandomReal.uniform(seed=2)
+                .with_probability_of_empty(0.3).take(3000))
+        frac = sum(1 for v in vals if v.is_empty) / len(vals)
+        assert 0.25 < frac < 0.35
+
+    def test_reproducible_with_reset(self):
+        g = RandomReal.normal(seed=7)
+        a = [v.value for v in g.take(10)]
+        b = [v.value for v in g.reset().take(10)]
+        assert a == b
+
+    def test_integrals_and_binary(self):
+        ints = RandomIntegral.integrals(0, 10, seed=3).take(500)
+        assert all(isinstance(v, Integral) for v in ints)
+        assert all(0 <= v.value < 10 for v in ints)
+        bins = RandomBinary(probability_of_success=0.8, seed=4).take(1000)
+        assert all(isinstance(v, Binary) for v in bins)
+        assert 0.75 < sum(1 for v in bins if v.value) / 1000 < 0.85
+
+    def test_text_families(self):
+        emails = RandomText.emails(seed=5).take(20)
+        assert all(isinstance(v, Email) and "@" in v.value for v in emails)
+        countries = RandomText.countries(seed=6).take(20)
+        assert all(isinstance(v, Country) for v in countries)
+        picks = RandomText.pick_lists(["a", "b", "c"], seed=7).take(50)
+        assert {v.value for v in picks} <= {"a", "b", "c"}
+        phones = RandomText.phones(seed=8).take(5)
+        assert all(v.value.startswith("+1") and len(v.value) == 12
+                   for v in phones)
+
+    def test_collections_and_maps(self):
+        lists = RandomList.of_texts(1, 4, seed=9).take(30)
+        assert all(isinstance(v, TextList) and 1 <= len(v.value) <= 4
+                   for v in lists)
+        sets_ = RandomSet.of(["x", "y", "z"], 1, 3, seed=10).take(30)
+        assert all(isinstance(v, MultiPickList) for v in sets_)
+        maps = RandomMap.of_texts(["k1", "k2"], seed=11).take(30)
+        assert all(isinstance(v, TextMap) for v in maps)
+        geos = RandomGeolocation(seed=12).take(10)
+        assert all(isinstance(v, Geolocation) and len(v.value) == 3
+                   for v in geos)
+
+    def test_vectors(self):
+        vecs = RandomVector.normal(8, seed=13).take(10)
+        assert all(len(v.value) == 8 for v in vecs)
+
+
+class TestTestFeatureBuilder:
+    def test_build_from_literals(self):
+        ds, (age, label) = TestFeatureBuilder.build(
+            ("age", Real, [20.0, 30.0, None]),
+            ("label", RealNN, [0.0, 1.0, 1.0]),
+            response_index=1)
+        assert ds.n_rows == 3
+        assert age.name == "age" and not age.is_response
+        assert label.is_response
+        assert np.isnan(ds.column("age").data[2])
+
+    def test_build_from_instances(self):
+        ds, (c,) = TestFeatureBuilder.build(
+            ("color", [PickList("red"), PickList("blue")]))
+        assert ds.column("color").data[0] == "red"
+        assert c.feature_type is PickList
+
+    def test_random(self):
+        ds, (x, name) = TestFeatureBuilder.random(
+            50, x=RandomReal.normal(seed=1), name=RandomText.names(seed=2))
+        assert ds.n_rows == 50
+        assert x.name == "x" and name.name == "name"
+
+    def test_features_usable_in_workflow_stage(self):
+        from transmogrifai_tpu.automl.transmogrifier import transmogrify
+        from transmogrifai_tpu.workflow import Workflow
+        ds, (x, y, label) = TestFeatureBuilder.build(
+            ("x", Real, [1.0, 2.0, 3.0, 4.0] * 25),
+            ("y", Real, [1.0, 0.0] * 50),
+            ("label", RealNN, [0.0, 1.0] * 50),
+            response_index=2)
+        vec = transmogrify([x, y])
+        wf = Workflow().set_input_dataset(ds).set_result_features(vec)
+        model = wf.train()
+        out = model.transform(ds)
+        assert out.column(vec.name).data.shape[0] == 100

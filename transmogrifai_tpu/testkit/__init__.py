@@ -1,0 +1,13 @@
+"""Test scaffolding (reference testkit module, 2,769 LoC): random typed
+data generators + TestFeatureBuilder."""
+from .feature_builder import TestFeatureBuilder
+from .random_data import (
+    RandomBinary, RandomData, RandomGeolocation, RandomIntegral, RandomList,
+    RandomMap, RandomReal, RandomSet, RandomText, RandomVector,
+)
+
+__all__ = [
+    "RandomBinary", "RandomData", "RandomGeolocation", "RandomIntegral",
+    "RandomList", "RandomMap", "RandomReal", "RandomSet", "RandomText",
+    "RandomVector", "TestFeatureBuilder",
+]
