@@ -1,0 +1,199 @@
+"""Math/text/misc transformers + rich dsl syntax.
+
+Mirrors reference suites core/src/test/.../impl/feature/ (MathTransformers,
+TextTokenizer, NGram/Jaccard similarity, StringIndexer, CountVectorizer,
+ScalerTransformer, DecisionTreeNumericBucketizer...) and the dsl tests.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+from transmogrifai_tpu.types import (
+    Binary, Integral, MultiPickList, PickList, Real, RealNN, Text, TextList,
+)
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _run(ds, *result_features):
+    wf = Workflow().set_input_dataset(ds).set_result_features(*result_features)
+    model = wf.train()
+    return model.transform(ds)
+
+
+class TestMath:
+    def test_add_and_scalar_ops_through_workflow(self):
+        ds, (a, b) = TestFeatureBuilder.build(
+            ("a", Real, [1.0, 2.0, None]),
+            ("b", Real, [10.0, 20.0, 30.0]))
+        s = a + b
+        t = a * 2.0
+        out = _run(ds, s, t)
+        np.testing.assert_allclose(out.column(s.name).data[:2], [11.0, 22.0])
+        assert np.isnan(out.column(s.name).data[2])  # empty propagates
+        np.testing.assert_allclose(out.column(t.name).data[:2], [2.0, 4.0])
+
+    def test_divide_by_zero_is_empty(self):
+        ds, (a, b) = TestFeatureBuilder.build(
+            ("a", Real, [1.0, 4.0]), ("b", Real, [2.0, 0.0]))
+        q = a / b
+        out = _run(ds, q)
+        assert out.column(q.name).data[0] == pytest.approx(0.5)
+        assert np.isnan(out.column(q.name).data[1])
+
+    def test_unary_chain(self):
+        ds, (a,) = TestFeatureBuilder.build(("a", Real, [-4.0, 9.0]))
+        r = a.abs().sqrt()
+        out = _run(ds, r)
+        np.testing.assert_allclose(out.column(r.name).data, [2.0, 3.0])
+
+    def test_log_negative_empty(self):
+        ds, (a,) = TestFeatureBuilder.build(("a", Real, [np.e, -1.0]))
+        r = a.log()
+        out = _run(ds, r)
+        assert out.column(r.name).data[0] == pytest.approx(1.0, abs=1e-6)
+        assert np.isnan(out.column(r.name).data[1])
+
+
+class TestTextTransformers:
+    def test_tokenize_tf_idf(self):
+        docs = ["the cat sat on the mat", "the dog ate the bone",
+                "cats and dogs", None]
+        ds, (txt,) = TestFeatureBuilder.build(("txt", Text, docs))
+        vec = txt.tokenize().tf_idf(vocab_size=16)
+        out = _run(ds, vec)
+        X = out.column(vec.name).data
+        assert X.shape == (4, min(16, X.shape[1]))
+        assert np.abs(X[3]).sum() == 0.0  # empty doc -> zero vector
+
+    def test_string_indexer_ranks_by_frequency(self):
+        vals = ["b", "a", "b", "b", "a", "c"]
+        ds, (txt,) = TestFeatureBuilder.build(("txt", Text, vals))
+        idx = txt.index_string()
+        out = _run(ds, idx)
+        got = out.column(idx.name).data
+        assert got[0] == 0.0  # 'b' most frequent
+        assert got[5] == 2.0  # 'c' least frequent
+
+    def test_similarity_measures(self):
+        from transmogrifai_tpu.transformers.text import (
+            JaccardSimilarity, NGramSimilarity)
+        sim = NGramSimilarity()
+        v = sim.transform_value(TextList(["hello", "world"]),
+                                TextList(["hello", "world"]))
+        assert v.value == pytest.approx(1.0)
+        j = JaccardSimilarity()
+        assert j.transform_value(MultiPickList({"a", "b"}),
+                                 MultiPickList({"b", "c"})).value \
+            == pytest.approx(1 / 3)
+        assert j.transform_value(MultiPickList(set()),
+                                 MultiPickList(set())).value == 1.0
+
+    def test_lang_mime_phone_email(self):
+        from transmogrifai_tpu.transformers.text import (
+            EmailToPickList, LangDetector, MimeTypeDetector,
+            PhoneNumberParser)
+        assert LangDetector().transform_value(
+            Text("the cat and the dog is in the house")).value == "en"
+        assert LangDetector().transform_value(
+            Text("le chat est dans la maison et il est content")).value == "fr"
+        import base64
+        png = base64.b64encode(b"\x89PNG\r\n\x1a\n123").decode()
+        assert MimeTypeDetector().transform_value(Text(png)).value == "image/png"
+        assert PhoneNumberParser().transform_value(
+            Text("(415) 555-2671")).value is True
+        assert PhoneNumberParser().transform_value(Text("123")).value is False
+        assert EmailToPickList().transform_value(
+            Text("ada@example.com")).value == "example.com"
+
+    def test_text_len(self):
+        ds, (txt,) = TestFeatureBuilder.build(("txt", Text, ["abc", None]))
+        ln = txt.text_len()
+        out = _run(ds, ln)
+        assert out.column(ln.name).data[0] == 3.0
+        assert out.column(ln.name).data[1] == 0.0
+
+
+class TestMisc:
+    def test_to_occur_and_alias(self):
+        ds, (txt,) = TestFeatureBuilder.build(("txt", Text, ["x", None, ""]))
+        occ = txt.to_occur()
+        out = _run(ds, occ)
+        np.testing.assert_allclose(out.column(occ.name).data, [1.0, 0.0, 0.0])
+
+    def test_fill_missing_with_mean(self):
+        ds, (a,) = TestFeatureBuilder.build(("a", Real, [1.0, None, 3.0]))
+        f = a.fill_missing_with_mean()
+        out = _run(ds, f)
+        np.testing.assert_allclose(out.column(f.name).data, [1.0, 2.0, 3.0])
+
+    def test_scaler_descaler_round_trip(self):
+        from transmogrifai_tpu.transformers.misc import (
+            DescalerTransformer, ScalerTransformer)
+        sc = ScalerTransformer(scaling_type="linear", slope=2.0,
+                               intercept=1.0)
+        scaled = sc.transform_value(Real(3.0))
+        assert scaled.value == pytest.approx(7.0)
+        de = DescalerTransformer(scaler=sc)
+        assert de.transform_value(scaled, scaled).value == pytest.approx(3.0)
+
+    def test_percentile_calibrator(self):
+        rng = np.random.default_rng(0)
+        ds, (s,) = TestFeatureBuilder.build(
+            ("s", RealNN, list(rng.uniform(size=1000))))
+        cal = s.calibrate_percentile(buckets=100)
+        out = _run(ds, cal)
+        got = out.column(cal.name).data
+        assert got.min() >= 0 and got.max() <= 99
+        # roughly uniform bucket occupancy
+        assert np.bincount(got.astype(int), minlength=100).std() < 5
+
+    def test_autobucketize_finds_label_cut(self):
+        rng = np.random.default_rng(1)
+        x = rng.uniform(0, 1, 800)
+        label = (x > 0.5).astype(float)
+        ds, (fx, fy) = TestFeatureBuilder.build(
+            ("x", Real, list(x)), ("label", RealNN, list(label)),
+            response_index=1)
+        bucketed = fx.autobucketize(fy, max_splits=7)
+        out = _run(ds, bucketed)
+        X = out.column(bucketed.name).data
+        assert X.shape[0] == 800 and X.shape[1] >= 2
+        # the learned boundaries must separate the label: rows with x<0.5
+        # and x>0.5 never share a bucket
+        lo = X[x < 0.45].argmax(axis=1)
+        hi = X[x > 0.55].argmax(axis=1)
+        assert set(np.unique(lo)).isdisjoint(set(np.unique(hi)))
+
+    def test_drop_indices_by(self):
+        from transmogrifai_tpu.automl.transmogrifier import transmogrify
+        from transmogrifai_tpu.transformers.misc import DropIndicesByTransformer
+        ds, (a, p) = TestFeatureBuilder.build(
+            ("a", Real, [1.0, None, 2.0]),
+            ("p", PickList, ["x", "y", "x"]))
+        vec = transmogrify([a, p])
+        wf = Workflow().set_input_dataset(ds).set_result_features(vec)
+        model = wf.train()
+        out = model.transform(ds)
+        col = out.column(vec.name)
+        drop = DropIndicesByTransformer(
+            predicate=lambda c: c.is_null_indicator)
+        dropped = drop.transform_columns(col)
+        assert dropped.data.shape[1] < col.data.shape[1]
+        assert all(not c.is_null_indicator for c in dropped.metadata.columns)
+
+
+class TestPersistenceOfTransformers:
+    def test_math_chain_save_load(self, tmp_path):
+        ds, (a, b) = TestFeatureBuilder.build(
+            ("a", Real, [1.0, 2.0, 3.0]), ("b", Real, [4.0, 5.0, 6.0]))
+        r = (a + b) * 2.0
+        wf = Workflow().set_input_dataset(ds).set_result_features(r)
+        model = wf.train()
+        path = str(tmp_path / "m")
+        model.save(path)
+        from transmogrifai_tpu.workflow import WorkflowModel
+        loaded = WorkflowModel.load(path)
+        out = loaded.transform(ds)
+        np.testing.assert_allclose(out.column(r.name).data,
+                                   [10.0, 14.0, 18.0])

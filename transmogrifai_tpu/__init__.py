@@ -32,5 +32,6 @@ from .stages.base import (
 from .stages.params import Param, ParamMap, param_grid
 from .data.dataset import Column, Dataset, column_from_values
 from .data.vector import VectorColumnMetadata, VectorMetadata
+from . import dsl  # installs rich feature syntax (reference dsl/ implicits)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,260 @@
+"""Rich feature syntax: the reference's dsl implicits as Feature methods.
+
+Reference: core/.../dsl/ (10 files, ~3,900 LoC) — `Rich{Numeric,Text,Date,
+List,Map,Vector}Feature` add `feature.tokenize()`, `f1 + f2`, `.pivot()`,
+`.sanityCheck()`, `.transmogrify()` to features by implicit conversion.
+Python shape: the methods are installed directly on Feature at import time
+(this module is imported by the package __init__), so
+``fare + age``, ``name.tokenize().tf_idf()``, ``features.transmogrify()``
+read the same as the Scala dsl.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+from .features.feature import Feature
+from .types import (
+    Binary, Integral, MultiPickList, OPNumeric, OPVector, PickList, Real,
+    RealNN, Text, TextList,
+)
+
+Number = Union[int, float]
+
+
+def _is_numeric(f: Feature) -> bool:
+    return issubclass(f.feature_type, (OPNumeric, Binary))
+
+
+# -- arithmetic (RichNumericFeature) ----------------------------------------
+
+def _binary_op(self: Feature, other: Any, cls_scalar, cls_feature):
+    from .transformers import math as M
+    if isinstance(other, Feature):
+        stage = cls_feature()
+        return stage.set_input(self, other).get_output()
+    stage = cls_scalar(scalar=float(other))
+    return stage.set_input(self).get_output()
+
+
+def _add(self, other):
+    from .transformers.math import AddTransformer, ScalarAddTransformer
+    return _binary_op(self, other, ScalarAddTransformer, AddTransformer)
+
+
+def _sub(self, other):
+    from .transformers.math import SubtractTransformer, ScalarSubtractTransformer
+    return _binary_op(self, other, ScalarSubtractTransformer,
+                      SubtractTransformer)
+
+
+def _mul(self, other):
+    from .transformers.math import MultiplyTransformer, ScalarMultiplyTransformer
+    return _binary_op(self, other, ScalarMultiplyTransformer,
+                      MultiplyTransformer)
+
+
+def _div(self, other):
+    from .transformers.math import DivideTransformer, ScalarDivideTransformer
+    return _binary_op(self, other, ScalarDivideTransformer, DivideTransformer)
+
+
+def _unary(self: Feature, cls, **kw):
+    return cls(**kw).set_input(self).get_output()
+
+
+def _abs(self):
+    from .transformers.math import AbsTransformer
+    return _unary(self, AbsTransformer)
+
+
+def _log(self, base: float = 2.718281828459045):
+    from .transformers.math import LogTransformer
+    return _unary(self, LogTransformer, base=base)
+
+
+def _exp(self):
+    from .transformers.math import ExpTransformer
+    return _unary(self, ExpTransformer)
+
+
+def _sqrt(self):
+    from .transformers.math import SqrtTransformer
+    return _unary(self, SqrtTransformer)
+
+
+def _round(self):
+    from .transformers.math import RoundTransformer
+    return _unary(self, RoundTransformer)
+
+
+def _ceil(self):
+    from .transformers.math import CeilTransformer
+    return _unary(self, CeilTransformer)
+
+
+def _floor(self):
+    from .transformers.math import FloorTransformer
+    return _unary(self, FloorTransformer)
+
+
+def _power(self, p: float):
+    from .transformers.math import PowerTransformer
+    return _unary(self, PowerTransformer, exponent=p)
+
+
+# -- misc (RichFeature) ------------------------------------------------------
+
+def _alias(self, name: str):
+    from .transformers.misc import AliasTransformer
+    return _unary(self, AliasTransformer, name=name)
+
+
+def _to_occur(self):
+    from .transformers.misc import ToOccurTransformer
+    return _unary(self, ToOccurTransformer)
+
+
+def _fill_missing_with_mean(self):
+    from .transformers.misc import FillMissingWithMean
+    return _unary(self, FillMissingWithMean)
+
+
+def _scale(self, scaling_type: str = "linear", slope: float = 1.0,
+           intercept: float = 0.0):
+    from .transformers.misc import ScalerTransformer
+    return _unary(self, ScalerTransformer, scaling_type=scaling_type,
+                  slope=slope, intercept=intercept)
+
+
+def _autobucketize(self, label: Feature, max_splits: int = 15,
+                   min_info_gain: float = 0.01):
+    from .transformers.misc import DecisionTreeNumericBucketizer
+    stage = DecisionTreeNumericBucketizer(max_splits=max_splits,
+                                          min_info_gain=min_info_gain)
+    return stage.set_input(label, self).get_output()
+
+
+def _calibrate_percentile(self, buckets: int = 100):
+    from .transformers.misc import PercentileCalibrator
+    return _unary(self, PercentileCalibrator, buckets=buckets)
+
+
+# -- text (RichTextFeature) --------------------------------------------------
+
+def _tokenize(self, min_token_length: int = 1, to_lowercase: bool = True,
+              filter_stopwords: bool = False):
+    from .transformers.text import TextTokenizer
+    return _unary(self, TextTokenizer, min_token_length=min_token_length,
+                  to_lowercase=to_lowercase,
+                  filter_stopwords=filter_stopwords)
+
+
+def _text_len(self):
+    from .transformers.text import TextLenTransformer
+    return _unary(self, TextLenTransformer)
+
+
+def _detect_languages(self):
+    from .transformers.text import LangDetector
+    return _unary(self, LangDetector)
+
+
+def _detect_mime_types(self):
+    from .transformers.text import MimeTypeDetector
+    return _unary(self, MimeTypeDetector)
+
+
+def _is_valid_phone(self, default_region: str = "US"):
+    from .transformers.text import PhoneNumberParser
+    return _unary(self, PhoneNumberParser, default_region=default_region)
+
+
+def _email_domain(self):
+    from .transformers.text import EmailToPickList
+    return _unary(self, EmailToPickList)
+
+
+def _index_string(self, handle_invalid: str = "keep"):
+    from .transformers.text import OpStringIndexer
+    return _unary(self, OpStringIndexer, handle_invalid=handle_invalid)
+
+
+def _count_vectorize(self, vocab_size: int = 512, min_df: int = 1,
+                     binary: bool = False):
+    from .transformers.text import OpCountVectorizer
+    return _unary(self, OpCountVectorizer, vocab_size=vocab_size,
+                  min_df=min_df, binary=binary)
+
+
+def _tf_idf(self, vocab_size: int = 512, min_df: int = 1):
+    from .transformers.text import TfIdfVectorizer
+    return _unary(self, TfIdfVectorizer, vocab_size=vocab_size, min_df=min_df)
+
+
+# -- similarity --------------------------------------------------------------
+
+def _ngram_similarity(self, other: Feature, n: int = 3):
+    from .transformers.text import NGramSimilarity
+    return NGramSimilarity(n=n).set_input(self, other).get_output()
+
+
+def _jaccard_similarity(self, other: Feature):
+    from .transformers.text import JaccardSimilarity
+    return JaccardSimilarity().set_input(self, other).get_output()
+
+
+# -- vectorize / check (RichFeaturesCollection) ------------------------------
+
+def _vectorize(self, **kwargs):
+    from .automl.transmogrifier import transmogrify
+    return transmogrify([self], **kwargs)
+
+
+def _pivot(self, top_k: int = 20):
+    from .automl.vectorizers.categorical import OneHotVectorizer
+    return OneHotVectorizer(top_k=top_k).set_input(self).get_output()
+
+
+def _sanity_check(self, label: Feature, **kwargs):
+    from .automl.preparators import SanityChecker
+    return SanityChecker(**kwargs).set_input(label, self).get_output()
+
+
+def _loco_insights(self, model, top_k: int = 20):
+    from .insights import RecordInsightsLOCO
+    return RecordInsightsLOCO(model=model, top_k=top_k) \
+        .set_input(self).get_output()
+
+
+def install() -> None:
+    """Install the dsl methods on Feature (idempotent)."""
+    ops = {
+        "__add__": _add, "__radd__": _add, "__sub__": _sub,
+        "__mul__": _mul, "__rmul__": _mul, "__truediv__": _div,
+        "abs": _abs, "log": _log, "exp": _exp, "sqrt": _sqrt,
+        "round": _round, "ceil": _ceil, "floor": _floor, "power": _power,
+        "alias": _alias, "to_occur": _to_occur,
+        "fill_missing_with_mean": _fill_missing_with_mean, "scale": _scale,
+        "autobucketize": _autobucketize,
+        "calibrate_percentile": _calibrate_percentile,
+        "tokenize": _tokenize, "text_len": _text_len,
+        "detect_languages": _detect_languages,
+        "detect_mime_types": _detect_mime_types,
+        "is_valid_phone": _is_valid_phone, "email_domain": _email_domain,
+        "index_string": _index_string, "count_vectorize": _count_vectorize,
+        "tf_idf": _tf_idf, "ngram_similarity": _ngram_similarity,
+        "jaccard_similarity": _jaccard_similarity,
+        "vectorize": _vectorize, "pivot": _pivot,
+        "sanity_check": _sanity_check, "loco_insights": _loco_insights,
+    }
+    for name, fn in ops.items():
+        setattr(Feature, name, fn)
+
+
+def transmogrify(features: Sequence[Feature], **kwargs):
+    """Module-level shortcut mirroring RichFeaturesCollection.transmogrify."""
+    from .automl.transmogrifier import transmogrify as tf
+    return tf(list(features), **kwargs)
+
+
+install()

@@ -135,8 +135,12 @@ class PipelineStage(HasParams):
     # -- persistence hooks (stages/io.py drives these) ---------------------
     def save_args(self) -> Dict[str, Any]:
         """Constructor args needed to rebuild this stage on load (reference
-        OpPipelineStageWriter ctor-arg capture, but explicit, not reflective)."""
-        return {"operation_name": self.operation_name, "uid": self.uid}
+        OpPipelineStageWriter ctor-arg capture, but explicit, not reflective).
+        Declared param values ride along so load restores them (reference
+        stages persist their Spark params in the same JSON)."""
+        d = {"operation_name": self.operation_name, "uid": self.uid}
+        d.update(self.param_values())
+        return d
 
     @classmethod
     def from_save_args(cls, args: Dict[str, Any]) -> "PipelineStage":

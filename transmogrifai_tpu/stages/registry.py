@@ -35,6 +35,9 @@ _STAGE_MODULES = [
     "transmogrifai_tpu.models.glm",
     "transmogrifai_tpu.models.trees",
     "transmogrifai_tpu.insights.loco",
+    "transmogrifai_tpu.transformers.math",
+    "transmogrifai_tpu.transformers.misc",
+    "transmogrifai_tpu.transformers.text",
 ]
 
 _EXTRA_STAGES: Dict[str, type] = {}

@@ -1,0 +1,5 @@
+"""Feature-engineering transformers (reference core/.../impl/feature/):
+math ops, text processing, scaling/calibration, label-driven bucketization."""
+from . import math, misc, text  # noqa: F401 — registered stage modules
+
+__all__ = ["math", "misc", "text"]

@@ -1,0 +1,198 @@
+"""Arithmetic feature transformers.
+
+Reference: core/.../impl/feature/MathTransformers.scala (393 LoC) and the
+RichNumericFeature dsl operators (core/.../dsl/RichNumericFeature.scala).
+Every op is a JaxTransformer — pure array math over the column block, fused
+into the layer's single XLA program; empties are NaN and propagate exactly
+as the reference's None-propagating semantics.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..stages.base import JaxTransformer
+from ..stages.params import Param
+from ..types import Real, RealNN
+
+_EPS = 1e-12
+
+
+class _BinaryMath(JaxTransformer):
+    input_types = (Real, Real)
+    output_type = Real
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        params.pop("operation_name", None)
+        super().__init__(self._op_name, uid=uid, **params)
+
+
+class AddTransformer(_BinaryMath):
+    """x + y (reference BinaryOperationTransformer '+')."""
+    _op_name = "plus"
+
+    def get_jax_fn(self):
+        return lambda a, b: a + b
+
+
+class SubtractTransformer(_BinaryMath):
+    _op_name = "minus"
+
+    def get_jax_fn(self):
+        return lambda a, b: a - b
+
+
+class MultiplyTransformer(_BinaryMath):
+    _op_name = "multiply"
+
+    def get_jax_fn(self):
+        return lambda a, b: a * b
+
+
+class DivideTransformer(_BinaryMath):
+    """x / y; division by ~0 yields empty (reference divide semantics)."""
+    _op_name = "divide"
+
+    def get_jax_fn(self):
+        def fn(a, b):
+            out = a / b
+            return jnp.where(jnp.abs(b) < _EPS, jnp.nan, out)
+        return fn
+
+
+class _ScalarMath(JaxTransformer):
+    input_types = (Real,)
+    output_type = Real
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("scalar", "scalar operand", 0.0)]
+
+    def __init__(self, scalar: float = 0.0, uid: Optional[str] = None,
+                 **params):
+        params.setdefault("scalar", scalar)
+        params.pop("operation_name", None)
+        super().__init__(self._op_name, uid=uid, **params)
+
+
+class ScalarAddTransformer(_ScalarMath):
+    _op_name = "plusS"
+
+    def get_jax_fn(self):
+        s = float(self.get_param("scalar"))
+        return lambda a: a + s
+
+
+class ScalarSubtractTransformer(_ScalarMath):
+    _op_name = "minusS"
+
+    def get_jax_fn(self):
+        s = float(self.get_param("scalar"))
+        return lambda a: a - s
+
+
+class ScalarMultiplyTransformer(_ScalarMath):
+    _op_name = "multiplyS"
+
+    def get_jax_fn(self):
+        s = float(self.get_param("scalar"))
+        return lambda a: a * s
+
+
+class ScalarDivideTransformer(_ScalarMath):
+    _op_name = "divideS"
+
+    def get_jax_fn(self):
+        s = float(self.get_param("scalar"))
+        return (lambda a: a / s) if abs(s) > _EPS else (
+            lambda a: jnp.full_like(a, jnp.nan))
+
+
+class _UnaryMath(JaxTransformer):
+    input_types = (Real,)
+    output_type = Real
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        params.pop("operation_name", None)
+        super().__init__(self._op_name, uid=uid, **params)
+
+
+class AbsTransformer(_UnaryMath):
+    _op_name = "abs"
+
+    def get_jax_fn(self):
+        return jnp.abs
+
+
+class CeilTransformer(_UnaryMath):
+    _op_name = "ceil"
+
+    def get_jax_fn(self):
+        return jnp.ceil
+
+
+class FloorTransformer(_UnaryMath):
+    _op_name = "floor"
+
+    def get_jax_fn(self):
+        return jnp.floor
+
+
+class RoundTransformer(_UnaryMath):
+    """Round half away from zero (reference RoundTransformer)."""
+    _op_name = "round"
+
+    def get_jax_fn(self):
+        return lambda a: jnp.sign(a) * jnp.floor(jnp.abs(a) + 0.5)
+
+
+class ExpTransformer(_UnaryMath):
+    _op_name = "exp"
+
+    def get_jax_fn(self):
+        return jnp.exp
+
+class SqrtTransformer(_UnaryMath):
+    """sqrt; negative input yields empty."""
+    _op_name = "sqrt"
+
+    def get_jax_fn(self):
+        return lambda a: jnp.where(a < 0, jnp.nan, jnp.sqrt(jnp.maximum(a, 0)))
+
+
+class LogTransformer(_UnaryMath):
+    """log base b; non-positive input yields empty (reference LogTransformer)."""
+    _op_name = "log"
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("base", "logarithm base", float(np.e))]
+
+    def __init__(self, base: float = float(np.e), uid: Optional[str] = None,
+                 **params):
+        params.setdefault("base", base)
+        super().__init__(uid=uid, **params)
+
+    def get_jax_fn(self):
+        lb = float(np.log(self.get_param("base")))
+        return lambda a: jnp.where(a > 0, jnp.log(jnp.maximum(a, _EPS)) / lb,
+                                   jnp.nan)
+
+
+class PowerTransformer(_UnaryMath):
+    _op_name = "power"
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("exponent", "power", 1.0)]
+
+    def __init__(self, exponent: float = 1.0, uid: Optional[str] = None,
+                 **params):
+        params.setdefault("exponent", exponent)
+        super().__init__(uid=uid, **params)
+
+    def get_jax_fn(self):
+        p = float(self.get_param("exponent"))
+        return lambda a: jnp.power(a, p)

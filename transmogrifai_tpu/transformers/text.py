@@ -1,0 +1,455 @@
+"""Text-processing transformers.
+
+Reference: core/.../impl/feature/{TextTokenizer(196), NGramSimilarity,
+JaccardSimilarity, OpCountVectorizer, TextLenTransformer, SubstringTransformer,
+OpStringIndexer, OpIndexToString, LangDetector, MimeTypeDetector,
+PhoneNumberParser(566)}.scala + utils/.../text analyzers.
+
+Host/device split (SURVEY hard-parts): tokenization/parsing stays host-side
+(strings never reach the device); everything downstream emits fixed-width
+numeric columns. The reference leaned on Lucene/Optimaize/Tika/libphonenumber
+(all JVM); these are self-contained re-implementations of the behaviors the
+AutoML pipeline actually consumes — analyzers are pluggable the same way the
+reference's TextAnalyzer interface is.
+"""
+from __future__ import annotations
+
+import base64 as b64mod
+import math
+import re
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.dataset import Column, column_from_values
+from ..stages.base import Estimator, Transformer
+from ..stages.params import Param
+from ..types import (
+    Binary, ColumnKind, Integral, MultiPickList, OPVector, PickList, Real,
+    RealNN, Text, TextList,
+)
+
+_TOKEN_RE = re.compile(r"[^a-zA-Z0-9']+")
+_STOPWORDS = {
+    "a", "an", "and", "are", "as", "at", "be", "but", "by", "for", "if",
+    "in", "into", "is", "it", "no", "not", "of", "on", "or", "such", "that",
+    "the", "their", "then", "there", "these", "they", "this", "to", "was",
+    "will", "with",
+}
+
+
+def tokenize_text(value: Optional[str], min_token_length: int = 1,
+                  to_lowercase: bool = True,
+                  filter_stopwords: bool = False) -> List[str]:
+    """The default analyzer (reference TextTokenizer.Analyzer / Lucene
+    standard analyzer behavior)."""
+    if not value:
+        return []
+    s = value.lower() if to_lowercase else value
+    toks = [t for t in _TOKEN_RE.split(s) if len(t) >= min_token_length]
+    if filter_stopwords:
+        toks = [t for t in toks if t not in _STOPWORDS]
+    return toks
+
+
+class TextTokenizer(Transformer):
+    """Text -> TextList (reference TextTokenizer.scala:196)."""
+
+    input_types = (Text,)
+    output_type = TextList
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("min_token_length", "min token length", 1),
+                Param("to_lowercase", "lowercase before split", True),
+                Param("filter_stopwords", "drop english stopwords", False)]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "tokenize"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        return TextList(tokenize_text(
+            vals[0].value, int(self.get_param("min_token_length")),
+            bool(self.get_param("to_lowercase")),
+            bool(self.get_param("filter_stopwords"))))
+
+
+class TextLenTransformer(Transformer):
+    """Text -> Integral length (reference TextLenTransformer); empty -> 0."""
+
+    input_types = (Text,)
+    output_type = Integral
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "textLen"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        v = vals[0].value
+        return Integral(0 if v is None else len(v))
+
+
+class SubstringTransformer(Transformer):
+    """(Text, Text) -> Binary: second contains first (reference
+    SubstringTransformer)."""
+
+    input_types = (Text, Text)
+    output_type = Binary
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "substring"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        sub, s = vals[0].value, vals[1].value
+        if sub is None or s is None:
+            return Binary(None)
+        return Binary(sub.lower() in s.lower())
+
+
+def _ngrams(tokens: Sequence[str], n: int) -> Counter:
+    joined = " ".join(tokens)
+    return Counter(joined[i:i + n] for i in range(max(len(joined) - n + 1, 0)))
+
+
+class NGramSimilarity(Transformer):
+    """(TextList, TextList) -> RealNN cosine similarity over char n-grams
+    (reference NGramSimilarity.scala, Lucene NGramDistance)."""
+
+    input_types = (TextList, TextList)
+    output_type = RealNN
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("n", "gram size", 3)]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "nGramSimilarity"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        a, b = vals[0].value or [], vals[1].value or []
+        if not a or not b:
+            return RealNN(0.0)
+        n = int(self.get_param("n"))
+        ca, cb = _ngrams(a, n), _ngrams(b, n)
+        dot = sum(ca[g] * cb[g] for g in ca.keys() & cb.keys())
+        na = math.sqrt(sum(v * v for v in ca.values()))
+        nb = math.sqrt(sum(v * v for v in cb.values()))
+        return RealNN(dot / (na * nb) if na and nb else 0.0)
+
+
+class JaccardSimilarity(Transformer):
+    """(MultiPickList, MultiPickList) -> RealNN (reference
+    JaccardSimilarity.scala); both empty -> 1.0."""
+
+    input_types = (MultiPickList, MultiPickList)
+    output_type = RealNN
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "jaccardSimilarity"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        a = set(vals[0].value or ())
+        b = set(vals[1].value or ())
+        if not a and not b:
+            return RealNN(1.0)
+        union = len(a | b)
+        return RealNN(len(a & b) / union if union else 0.0)
+
+
+class OpStringIndexer(Estimator):
+    """Text -> RealNN frequency-rank index (reference OpStringIndexer;
+    unseen/null handled per handle_invalid like StringIndexer)."""
+
+    input_types = (Text,)
+    output_type = RealNN
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("handle_invalid", "error|skip|keep", "keep")]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "stringIndexer"),
+                         uid=uid, **params)
+
+    def fit_columns(self, *cols: Column) -> Transformer:
+        counts = Counter(v for v in cols[0].data
+                         if v is not None and v != "")
+        labels = [w for w, _ in counts.most_common()]
+        return OpStringIndexerModel(
+            labels=labels,
+            handle_invalid=str(self.get_param("handle_invalid")),
+            operation_name=self.operation_name)
+
+
+class OpStringIndexerModel(Transformer):
+    input_types = (Text,)
+    output_type = RealNN
+
+    def __init__(self, labels: Optional[Sequence[str]] = None,
+                 handle_invalid: str = "keep",
+                 uid: Optional[str] = None, **params):
+        self.labels = list(labels or [])
+        self.handle_invalid = handle_invalid
+        self._index = {w: i for i, w in enumerate(self.labels)}
+        super().__init__(params.pop("operation_name", "stringIndexer"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        v = vals[0].value
+        idx = self._index.get(v)
+        if idx is None:
+            if self.handle_invalid == "error":
+                raise ValueError(f"Unseen label: {v!r}")
+            idx = len(self.labels)  # keep: unseen bucket
+        return RealNN(float(idx))
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(labels=self.labels, handle_invalid=self.handle_invalid)
+        return d
+
+
+class OpIndexToString(Transformer):
+    """RealNN index -> Text label (reference OpIndexToString)."""
+
+    input_types = (RealNN,)
+    output_type = Text
+
+    def __init__(self, labels: Optional[Sequence[str]] = None,
+                 uid: Optional[str] = None, **params):
+        self.labels = list(labels or [])
+        super().__init__(params.pop("operation_name", "indexToString"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        i = int(vals[0].value)
+        return Text(self.labels[i] if 0 <= i < len(self.labels) else None)
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(labels=self.labels)
+        return d
+
+
+class OpCountVectorizer(Estimator):
+    """TextList -> OPVector of top-K vocabulary counts (reference
+    OpCountVectorizer wrapping Spark CountVectorizer)."""
+
+    input_types = (TextList,)
+    output_type = OPVector
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("vocab_size", "max vocabulary", 512),
+                Param("min_df", "min docs containing term", 1),
+                Param("binary", "0/1 instead of counts", False)]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "countVec"),
+                         uid=uid, **params)
+
+    def _vocab(self, col: Column) -> List[str]:
+        df: Counter = Counter()
+        for toks in col.data:
+            if toks:
+                df.update(set(toks))
+        min_df = int(self.get_param("min_df"))
+        vocab = [w for w, c in df.most_common() if c >= min_df]
+        return vocab[: int(self.get_param("vocab_size"))]
+
+    def fit_columns(self, *cols: Column) -> Transformer:
+        return OpCountVectorizerModel(
+            vocab=self._vocab(cols[0]),
+            binary=bool(self.get_param("binary")),
+            operation_name=self.operation_name)
+
+
+class OpCountVectorizerModel(Transformer):
+    input_types = (TextList,)
+    output_type = OPVector
+
+    def __init__(self, vocab: Optional[Sequence[str]] = None,
+                 binary: bool = False, idf: Optional[np.ndarray] = None,
+                 uid: Optional[str] = None, **params):
+        self.vocab = list(vocab or [])
+        self.binary = bool(binary)
+        self.idf = None if idf is None else np.asarray(idf, np.float64)
+        self._index = {w: i for i, w in enumerate(self.vocab)}
+        super().__init__(params.pop("operation_name", "countVec"),
+                         uid=uid, **params)
+
+    def _encode(self, toks) -> np.ndarray:
+        out = np.zeros(len(self.vocab), np.float32)
+        for t in toks or []:
+            i = self._index.get(t)
+            if i is not None:
+                out[i] += 1.0
+        if self.binary:
+            out = (out > 0).astype(np.float32)
+        if self.idf is not None:
+            out = out * self.idf
+        return out
+
+    def transform_value(self, *vals):
+        return OPVector(self._encode(vals[0].value))
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(vocab=self.vocab, binary=self.binary,
+                 idf=self.idf if self.idf is not None else None)
+        return d
+
+
+class TfIdfVectorizer(OpCountVectorizer):
+    """TextList -> OPVector TF-IDF (reference `idf` dsl on tokenized text
+    wrapping Spark IDF)."""
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        Estimator.__init__(self, "tfidf", uid=uid, **params)
+
+    def fit_columns(self, *cols: Column) -> Transformer:
+        vocab = self._vocab(cols[0])
+        index = {w: i for i, w in enumerate(vocab)}
+        n_docs = len(cols[0])
+        df = np.zeros(len(vocab), np.float64)
+        for toks in cols[0].data:
+            for w in set(toks or []):
+                i = index.get(w)
+                if i is not None:
+                    df[i] += 1.0
+        idf = np.log((n_docs + 1.0) / (df + 1.0))
+        return OpCountVectorizerModel(vocab=vocab, idf=idf,
+                                      operation_name=self.operation_name)
+
+
+# -- light analyzers (reference leaned on JVM libs; behavior-parity impls) --
+
+_LANG_PROFILES = {
+    "en": set("the and ing ion to of in er it is".split()),
+    "fr": set("le la les de et un une est que dans".split()),
+    "de": set("der die das und ist ein nicht mit sich den".split()),
+    "es": set("el la los de y un una es que en".split()),
+}
+
+
+class LangDetector(Transformer):
+    """Text -> PickList language code (reference LangDetector via Optimaize;
+    here a stopword-profile heuristic over the same output contract)."""
+
+    input_types = (Text,)
+    output_type = PickList
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "langDetect"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        v = vals[0].value
+        if not v:
+            return PickList(None)
+        toks = set(tokenize_text(v))
+        best, score = None, 0
+        for lang, words in _LANG_PROFILES.items():
+            s = len(toks & words)
+            if s > score:
+                best, score = lang, s
+        return PickList(best or "unknown")
+
+
+_MIME_MAGIC: List[Tuple[bytes, str]] = [
+    (b"\x89PNG", "image/png"),
+    (b"\xff\xd8\xff", "image/jpeg"),
+    (b"GIF8", "image/gif"),
+    (b"%PDF", "application/pdf"),
+    (b"PK\x03\x04", "application/zip"),
+    (b"\x1f\x8b", "application/gzip"),
+    (b"<?xml", "application/xml"),
+    (b"{", "application/json"),
+]
+
+
+class MimeTypeDetector(Transformer):
+    """Base64 -> PickList MIME type via magic bytes (reference
+    MimeTypeDetector via Tika)."""
+
+    input_types = (Text,)   # Base64 is a Text subtype
+    output_type = PickList
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "mimeDetect"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        v = vals[0].value
+        if not v:
+            return PickList(None)
+        try:
+            head = b64mod.b64decode(v[:64] + "=" * (-len(v[:64]) % 4))
+        except Exception:
+            return PickList(None)
+        for magic, mime in _MIME_MAGIC:
+            if head.startswith(magic):
+                return PickList(mime)
+        try:
+            head.decode("utf-8")
+            return PickList("text/plain")
+        except UnicodeDecodeError:
+            return PickList("application/octet-stream")
+
+
+class PhoneNumberParser(Transformer):
+    """Phone -> Binary validity (reference PhoneNumberParser.scala:566 via
+    libphonenumber; NANP-style structural validation)."""
+
+    input_types = (Text,)
+    output_type = Binary
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("default_region", "region for bare numbers", "US"),
+                Param("strict", "strict length validation", True)]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "phoneValid"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        v = vals[0].value
+        if not v:
+            return Binary(None)
+        digits = re.sub(r"[^\d+]", "", v)
+        if digits.startswith("+"):
+            body = digits[1:]
+            ok = 8 <= len(body) <= 15 and body.isdigit()
+        else:
+            region = str(self.get_param("default_region"))
+            n = len(digits)
+            ok = digits.isdigit() and (
+                (region == "US" and (n == 10 or (n == 11 and
+                                                 digits.startswith("1"))))
+                or (region != "US" and 7 <= n <= 15))
+        return Binary(bool(ok))
+
+
+class EmailToPickList(Transformer):
+    """Email -> PickList of the domain (reference RichEmailFeature
+    .toEmailDomain)."""
+
+    input_types = (Text,)
+    output_type = PickList
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "emailDomain"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        v = vals[0].value
+        if not v or "@" not in v:
+            return PickList(None)
+        local, _, domain = v.rpartition("@")
+        return PickList(domain if local and domain else None)
