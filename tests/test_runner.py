@@ -1,0 +1,143 @@
+"""OpParams + OpWorkflowRunner/OpApp.
+
+Mirrors reference suite core/src/test/.../OpWorkflowRunnerTest.scala:
+Train/Score/Features/Evaluate run types, params round-trip, stage-param
+overrides, metrics artifacts, app-end handlers.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.evaluators.evaluators import (
+    BinaryClassificationEvaluator)
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.workflow import (
+    OpApp, OpParams, OpWorkflowRunner, ReaderParams, Workflow)
+
+
+def _rows(n=300, seed=5):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        x = float(rng.normal())
+        rows.append({"x": x, "y": float(rng.normal()),
+                     "label": float(x + rng.normal(0, 0.5) > 0)})
+    return rows
+
+
+def _workflow():
+    fx = FeatureBuilder.Real("x").extract(lambda r: r.get("x")).as_predictor()
+    fy = FeatureBuilder.Real("y").extract(lambda r: r.get("y")).as_predictor()
+    fl = FeatureBuilder.RealNN("label").extract(
+        lambda r: r.get("label")).as_response()
+    vec = transmogrify([fx, fy])
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[
+            (OpLogisticRegression(), param_grid(reg_param=[0.01]))],
+    ).set_input(fl, vec).get_output()
+    return Workflow().set_result_features(pred), vec
+
+
+class TestOpParams:
+    def test_json_file_round_trip(self, tmp_path):
+        p = OpParams(stage_params={"SanityChecker": {"min_variance": 0.01}},
+                     reader_params={"train": ReaderParams(path="/data")},
+                     model_location="/m", custom_params={"tag": "run1"})
+        path = str(tmp_path / "params.json")
+        p.save(path)
+        q = OpParams.from_file(path)
+        assert q.stage_params == p.stage_params
+        assert q.reader_params["train"].path == "/data"
+        assert q.model_location == "/m"
+        assert q.custom_params == {"tag": "run1"}
+
+    def test_with_values(self):
+        p = OpParams().with_values(model_location="/m2")
+        assert p.model_location == "/m2"
+        assert OpParams().model_location is None
+
+
+class TestRunner:
+    def test_train_then_score_then_evaluate(self, tmp_path):
+        rows = _rows()
+        wf, _ = _workflow()
+        model_loc = str(tmp_path / "model")
+        runner = OpWorkflowRunner(wf, train_reader=ListReader(rows),
+                                  score_reader=ListReader(rows),
+                                  evaluator=BinaryClassificationEvaluator())
+        seen = []
+        runner.add_application_end_handler(lambda r: seen.append(r.run_type))
+
+        params = OpParams(model_location=model_loc,
+                          write_location=str(tmp_path / "scores"),
+                          metrics_location=str(tmp_path / "metrics"))
+        tr = runner.run(OpWorkflowRunner.TRAIN, params)
+        assert tr.run_type == "Train" and "Selected" in tr.model_summary
+        assert os.path.isdir(model_loc)
+
+        sc = runner.run(OpWorkflowRunner.SCORE, params)
+        assert sc.n_rows == len(rows)
+        assert sc.metrics.get("au_roc", 0) > 0.8
+        assert os.path.exists(tmp_path / "scores" / "scores.jsonl")
+
+        ev = runner.run(OpWorkflowRunner.EVALUATE, params)
+        assert ev.metrics.get("au_roc", 0) > 0.8
+
+        assert seen == ["Train", "Score", "Evaluate"]
+        assert os.path.exists(tmp_path / "metrics" / "train_metrics.json")
+
+    def test_features_run(self, tmp_path):
+        rows = _rows()
+        wf, vec = _workflow()
+        runner = OpWorkflowRunner(wf, train_reader=ListReader(rows),
+                                  features_to_compute=[vec])
+        params = OpParams(write_location=str(tmp_path / "feat"))
+        fr = runner.run(OpWorkflowRunner.FEATURES, params)
+        assert fr.n_rows == len(rows)
+        data = np.load(tmp_path / "feat" / "features.npz")
+        assert any(k for k in data.files)
+
+    def test_stage_param_overrides(self):
+        rows = _rows()
+        fx = FeatureBuilder.Real("x").extract(
+            lambda r: r.get("x")).as_predictor()
+        fl = FeatureBuilder.RealNN("label").extract(
+            lambda r: r.get("label")).as_response()
+        from transmogrifai_tpu.automl.preparators import SanityChecker
+        vec = transmogrify([fx])
+        checker = SanityChecker()
+        checked = checker.set_input(fl, vec).get_output()
+        wf = Workflow().set_result_features(checked)
+        runner = OpWorkflowRunner(wf, train_reader=ListReader(rows))
+        from transmogrifai_tpu.workflow.runner import apply_stage_params
+        apply_stage_params(wf, OpParams(
+            stage_params={"SanityChecker": {"min_variance": 0.5}}))
+        assert checker.get_param("min_variance") == 0.5
+
+    def test_unknown_run_type_raises(self):
+        wf, _ = _workflow()
+        runner = OpWorkflowRunner(wf)
+        with pytest.raises(ValueError, match="Unknown run type"):
+            runner.run("Bogus")
+
+
+class TestOpApp:
+    def test_main_dispatches(self, tmp_path):
+        rows = _rows()
+
+        class App(OpApp):
+            def runner(self):
+                wf, _ = _workflow()
+                return OpWorkflowRunner(wf, train_reader=ListReader(rows))
+
+        res = App().main(["--run-type", "Train",
+                          "--model-location", str(tmp_path / "m")])
+        assert res.run_type == "Train"
+        assert os.path.isdir(tmp_path / "m")

@@ -5,10 +5,14 @@ from .dag import (CutDAG, StagesDAG, collect_features, collect_raw_features,
                   compute_dag, cut_dag, validate_stages)
 from .fitting import LayerRunner
 from .io import load_model, save_model
+from .runner import (EvaluateResult, FeaturesResult, OpApp, OpParams,
+                     OpWorkflowRunner, ReaderParams, ScoreResult, TrainResult)
 from .workflow import Workflow, WorkflowModel
 
 __all__ = [
     "CutDAG", "StagesDAG", "collect_features", "collect_raw_features",
     "compute_dag", "cut_dag", "validate_stages", "LayerRunner",
     "load_model", "save_model", "Workflow", "WorkflowModel",
+    "EvaluateResult", "FeaturesResult", "OpApp", "OpParams",
+    "OpWorkflowRunner", "ReaderParams", "ScoreResult", "TrainResult",
 ]
