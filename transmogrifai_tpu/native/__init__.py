@@ -1,0 +1,5 @@
+"""Native host kernels (C++, ctypes-loaded): murmur3 hashing trick, fused
+tokenize+hash+count, CSV scanning. See build.py and ops/native_bridge.py."""
+from .build import LIB, SRC, build
+
+__all__ = ["LIB", "SRC", "build"]

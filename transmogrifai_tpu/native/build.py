@@ -1,0 +1,34 @@
+"""On-demand build of the native host-kernel library.
+
+Compiles native/hashing.cpp into _tmog_native.so next to this file with the
+baked-in g++ toolchain; rebuilt when the source is newer than the binary.
+Everything degrades gracefully — when no compiler is available the callers
+fall back to the NumPy paths (see ops/native_bridge.py).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "hashing.cpp")
+LIB = os.path.join(_DIR, "_tmog_native.so")
+
+
+def build(force: bool = False) -> Optional[str]:
+    """Build (if needed) and return the library path, or None on failure."""
+    if not os.path.exists(SRC):
+        return None
+    if (not force and os.path.exists(LIB)
+            and os.path.getmtime(LIB) >= os.path.getmtime(SRC)):
+        return LIB
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", LIB, SRC]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    return LIB

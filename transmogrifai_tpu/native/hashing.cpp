@@ -1,0 +1,222 @@
+// Host-side native kernels: MurmurHash3 hashing trick, fused
+// tokenize+hash+count, and CSV field scanning.
+//
+// The reference's host hot loops ran on the JVM (Lucene tokenization,
+// MurmurHash3 via Spark's HashingTF, spark-csv parsing; see
+// core/.../impl/feature/OPCollectionHashingVectorizer.scala and
+// readers/.../CSVReaders.scala). In the TPU build those loops prepare
+// fixed-width tensors on the host before device_put; this library is that
+// data path in C++ — bulk byte-packed APIs, no per-row Python overhead.
+// Loaded via ctypes (ops/native_bridge.py); every entry point has a pure
+// NumPy fallback, so the library is an accelerator, not a dependency.
+//
+// Build: g++ -O3 -shared -fPIC (driven by native/build.py).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+extern "C" {
+
+// ---- MurmurHash3 x86_32 ---------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t fmix32(uint32_t h) {
+  h ^= h >> 16;
+  h *= 0x85ebca6b;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35;
+  h ^= h >> 16;
+  return h;
+}
+
+uint32_t tmog_murmur3_32(const uint8_t* data, int64_t len, uint32_t seed) {
+  const int64_t nblocks = len / 4;
+  uint32_t h1 = seed;
+  const uint32_t c1 = 0xcc9e2d51;
+  const uint32_t c2 = 0x1b873593;
+
+  const uint8_t* blocks = data;
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k1;
+    std::memcpy(&k1, blocks + i * 4, 4);  // little-endian hosts
+    k1 *= c1;
+    k1 = rotl32(k1, 15);
+    k1 *= c2;
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    h1 = h1 * 5 + 0xe6546b64;
+  }
+
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k1 = 0;
+  switch (len & 3) {
+    case 3: k1 ^= static_cast<uint32_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k1 ^= static_cast<uint32_t>(tail[1]) << 8; [[fallthrough]];
+    case 1:
+      k1 ^= tail[0];
+      k1 *= c1;
+      k1 = rotl32(k1, 15);
+      k1 *= c2;
+      h1 ^= k1;
+  }
+
+  h1 ^= static_cast<uint32_t>(len);
+  return fmix32(h1);
+}
+
+// ---- batch string hashing -------------------------------------------------
+
+// buf: concatenated UTF-8 bytes; offsets: [n+1] prefix offsets.
+// out: [n] uint32 hash values.
+void tmog_hash_strings(const uint8_t* buf, const int64_t* offsets, int64_t n,
+                       uint32_t seed, uint32_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = tmog_murmur3_32(buf + offsets[i], offsets[i + 1] - offsets[i],
+                             seed);
+  }
+}
+
+// token stream -> per-doc hashed counts.
+// buf/tok_offsets: [n_tokens+1] packed tokens; doc_tok_counts: [n_docs]
+// tokens per document. out: [n_docs * bins] float64, caller-zeroed.
+void tmog_hash_tokens_to_counts(const uint8_t* buf, const int64_t* tok_offsets,
+                                const int64_t* doc_tok_counts, int64_t n_docs,
+                                int64_t bins, uint32_t seed, double* out) {
+  int64_t t = 0;
+  for (int64_t d = 0; d < n_docs; d++) {
+    double* row = out + d * bins;
+    const int64_t end = t + doc_tok_counts[d];
+    for (; t < end; t++) {
+      const uint32_t h = tmog_murmur3_32(buf + tok_offsets[t],
+                                         tok_offsets[t + 1] - tok_offsets[t],
+                                         seed);
+      row[h % bins] += 1.0;
+    }
+  }
+}
+
+// ---- fused tokenize + hash + count ---------------------------------------
+
+// ASCII-lowercase tokenizer matching transformers/text.tokenize_text:
+// tokens are maximal runs of [A-Za-z0-9'], lowercased, len >= min_len.
+// docs packed in buf with [n_docs+1] offsets; out: [n_docs * bins] float64,
+// caller-zeroed. This is the whole text->tensor hot loop in one pass.
+void tmog_tokenize_hash_counts(const uint8_t* buf, const int64_t* doc_offsets,
+                               int64_t n_docs, int64_t bins, uint32_t seed,
+                               int64_t min_len, double* out) {
+  uint8_t tok[256];
+  for (int64_t d = 0; d < n_docs; d++) {
+    double* row = out + d * bins;
+    const uint8_t* p = buf + doc_offsets[d];
+    const uint8_t* end = buf + doc_offsets[d + 1];
+    int64_t tlen = 0;
+    for (; p <= end; p++) {
+      uint8_t c = (p < end) ? *p : 0;
+      uint8_t lc = (c >= 'A' && c <= 'Z') ? c + 32 : c;
+      bool is_tok = (lc >= 'a' && lc <= 'z') || (lc >= '0' && lc <= '9') ||
+                    lc == '\'';
+      if (is_tok) {
+        if (tlen < static_cast<int64_t>(sizeof(tok))) tok[tlen++] = lc;
+      } else {
+        if (tlen >= min_len) {
+          const uint32_t h = tmog_murmur3_32(tok, tlen, seed);
+          row[h % bins] += 1.0;
+        }
+        tlen = 0;
+      }
+    }
+  }
+}
+
+// ---- CSV field scanning ---------------------------------------------------
+
+// Scans one CSV buffer, recording field start/end offsets (RFC-4180 quoting:
+// fields may be "..." with doubled quotes). Returns the number of fields
+// written, or -(needed) if out capacity is insufficient.
+// field_bounds: [capacity * 2] (start, end) byte offsets into buf (quotes
+// stripped); row_ends records the running field count at each row boundary
+// into row_field_counts [max_rows]; n_rows receives the row count.
+int64_t tmog_csv_scan(const uint8_t* buf, int64_t len, uint8_t delim,
+                      int64_t* field_bounds, int64_t capacity,
+                      int64_t* row_field_counts, int64_t max_rows,
+                      int64_t* n_rows) {
+  int64_t nf = 0;      // fields emitted
+  int64_t rows = 0;
+  int64_t i = 0;
+  while (i < len) {
+    // one row
+    int64_t row_start_nf = nf;
+    while (true) {
+      // one field
+      int64_t start, endo;
+      if (buf[i] == '"') {
+        start = ++i;
+        int64_t w = i;  // write cursor for unescaping "" -> " in place is
+        // not allowed (const buf); instead record bounds only when no
+        // doubled quotes exist; bail to slow path by marking end=-1.
+        bool doubled = false;
+        while (i < len) {
+          if (buf[i] == '"') {
+            if (i + 1 < len && buf[i + 1] == '"') { doubled = true; i += 2; }
+            else break;
+          } else i++;
+        }
+        endo = i;
+        if (i < len) i++;  // closing quote
+        if (doubled) { start = -(start + 1); }  // flag: python re-parses
+        (void)w;
+      } else {
+        start = i;
+        while (i < len && buf[i] != delim && buf[i] != '\n' && buf[i] != '\r')
+          i++;
+        endo = i;
+      }
+      if (nf >= capacity) return -(nf + 1);
+      field_bounds[2 * nf] = start;
+      field_bounds[2 * nf + 1] = endo;
+      nf++;
+      if (i < len && buf[i] == delim) { i++; continue; }
+      break;
+    }
+    // row terminator
+    while (i < len && (buf[i] == '\r' || buf[i] == '\n')) {
+      if (buf[i] == '\n') { i++; break; }
+      i++;
+    }
+    if (rows < max_rows) row_field_counts[rows] = nf - row_start_nf;
+    rows++;
+  }
+  *n_rows = rows;
+  return nf;
+}
+
+// ---- bulk float parsing ---------------------------------------------------
+
+// Parse fields [bounds as from tmog_csv_scan] into float64 (NaN when empty
+// or non-numeric). Small fast strtod over the bounded field.
+void tmog_parse_floats(const uint8_t* buf, const int64_t* field_bounds,
+                       int64_t n_fields, double* out) {
+  for (int64_t f = 0; f < n_fields; f++) {
+    int64_t s = field_bounds[2 * f];
+    int64_t e = field_bounds[2 * f + 1];
+    if (s < 0) { out[f] = __builtin_nan(""); continue; }  // quoted-escaped
+    // trim spaces
+    while (s < e && (buf[s] == ' ' || buf[s] == '\t')) s++;
+    while (e > s && (buf[e - 1] == ' ' || buf[e - 1] == '\t')) e--;
+    if (s >= e) { out[f] = __builtin_nan(""); continue; }
+    char tmp[64];
+    int64_t n = e - s;
+    if (n >= static_cast<int64_t>(sizeof(tmp))) { out[f] = __builtin_nan(""); continue; }
+    std::memcpy(tmp, buf + s, n);
+    tmp[n] = 0;
+    char* endp = nullptr;
+    double v = std::strtod(tmp, &endp);
+    out[f] = (endp == tmp + n) ? v : __builtin_nan("");
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,194 @@
+"""ctypes bridge to the native host kernels (native/hashing.cpp).
+
+Loads _tmog_native.so (building it on first use) and exposes numpy-typed
+wrappers. Every function returns None when the library is unavailable so
+callers keep their NumPy fallback — the native path is an accelerator for
+the host's text->tensor and CSV data loops, mirroring where the reference
+leaned on JVM-native code (Spark HashingTF murmur3, spark-csv).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("TMOG_DISABLE_NATIVE"):
+        return None
+    try:
+        from ..native.build import build
+        path = build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+    except OSError:
+        return None
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+
+    lib.tmog_murmur3_32.restype = ctypes.c_uint32
+    lib.tmog_murmur3_32.argtypes = [u8p, ctypes.c_int64, ctypes.c_uint32]
+    lib.tmog_hash_strings.restype = None
+    lib.tmog_hash_strings.argtypes = [u8p, i64p, ctypes.c_int64,
+                                      ctypes.c_uint32, u32p]
+    lib.tmog_hash_tokens_to_counts.restype = None
+    lib.tmog_hash_tokens_to_counts.argtypes = [
+        u8p, i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint32,
+        f64p]
+    lib.tmog_tokenize_hash_counts.restype = None
+    lib.tmog_tokenize_hash_counts.argtypes = [
+        u8p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint32,
+        ctypes.c_int64, f64p]
+    lib.tmog_csv_scan.restype = ctypes.c_int64
+    lib.tmog_csv_scan.argtypes = [u8p, ctypes.c_int64, ctypes.c_uint8,
+                                  i64p, ctypes.c_int64, i64p, ctypes.c_int64,
+                                  i64p]
+    lib.tmog_parse_floats.restype = None
+    lib.tmog_parse_floats.argtypes = [u8p, i64p, ctypes.c_int64, f64p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_u8p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _as_i64p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _as_f64p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def native_murmur3(data: bytes, seed: int = 0) -> Optional[int]:
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8) if data else \
+        np.zeros(1, np.uint8)
+    return int(lib.tmog_murmur3_32(_as_u8p(buf), len(data), seed))
+
+
+def _pack_strings(strings: Sequence[str]):
+    encoded = [s.encode("utf-8") for s in strings]
+    offsets = np.zeros(len(encoded) + 1, np.int64)
+    np.cumsum([len(b) for b in encoded], out=offsets[1:])
+    buf = np.frombuffer(b"".join(encoded), dtype=np.uint8) if encoded else \
+        np.zeros(0, np.uint8)
+    if buf.size == 0:
+        buf = np.zeros(1, np.uint8)
+    return buf, offsets
+
+
+def native_hash_strings(strings: Sequence[str], seed: int = 0
+                        ) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    buf, offsets = _pack_strings(strings)
+    out = np.zeros(len(strings), np.uint32)
+    lib.tmog_hash_strings(_as_u8p(buf), _as_i64p(offsets), len(strings),
+                          seed, out.ctypes.data_as(
+                              ctypes.POINTER(ctypes.c_uint32)))
+    return out
+
+
+def native_hash_tokens(token_lists: Sequence[Optional[Sequence[str]]],
+                       num_bins: int, seed: int = 0) -> Optional[np.ndarray]:
+    """[rows of token lists] -> [n, bins] float64 counts, or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    flat: List[str] = []
+    counts = np.zeros(len(token_lists), np.int64)
+    for i, toks in enumerate(token_lists):
+        if toks:
+            counts[i] = len(toks)
+            flat.extend(toks)
+    buf, offsets = _pack_strings(flat)
+    out = np.zeros((len(token_lists), num_bins), np.float64)
+    lib.tmog_hash_tokens_to_counts(
+        _as_u8p(buf), _as_i64p(offsets), _as_i64p(counts),
+        len(token_lists), num_bins, seed, _as_f64p(out))
+    return out
+
+
+def native_tokenize_hash_counts(docs: Sequence[Optional[str]], num_bins: int,
+                                seed: int = 0, min_len: int = 1
+                                ) -> Optional[np.ndarray]:
+    """Fused tokenize+hash+count over raw documents -> [n, bins] float64."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf, offsets = _pack_strings([d or "" for d in docs])
+    out = np.zeros((len(docs), num_bins), np.float64)
+    lib.tmog_tokenize_hash_counts(_as_u8p(buf), _as_i64p(offsets), len(docs),
+                                  num_bins, seed, min_len, _as_f64p(out))
+    return out
+
+
+def native_csv_parse(data: bytes, delim: str = ","
+                     ) -> Optional[List[List[str]]]:
+    """Full-buffer CSV scan -> rows of string fields (quotes handled;
+    doubled-quote fields re-parsed host-side)."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(1, np.uint8)
+    cap = len(data) + 16  # upper bound: every byte a field
+    bounds = np.zeros(cap * 2, np.int64)
+    max_rows = data.count(b"\n") + 2
+    row_counts = np.zeros(max_rows, np.int64)
+    n_rows = np.zeros(1, np.int64)
+    nf = lib.tmog_csv_scan(_as_u8p(buf), len(data), ord(delim),
+                           _as_i64p(bounds), cap, _as_i64p(row_counts),
+                           max_rows, _as_i64p(n_rows))
+    if nf < 0:
+        return None
+    text = data.decode("utf-8", errors="replace")
+    rows: List[List[str]] = []
+    f = 0
+    for r in range(int(n_rows[0])):
+        cnt = int(row_counts[r])
+        fields = []
+        for j in range(cnt):
+            s, e = int(bounds[2 * (f + j)]), int(bounds[2 * (f + j) + 1])
+            if s < 0:  # doubled-quote field: unescape here
+                s = -s - 1
+                fields.append(text[s:e].replace('""', '"'))
+            else:
+                fields.append(text[s:e])
+        rows.append(fields)
+        f += cnt
+    return rows
+
+
+def native_parse_floats(data: bytes, bounds: np.ndarray
+                        ) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(1, np.uint8)
+    n = len(bounds) // 2
+    out = np.zeros(n, np.float64)
+    lib.tmog_parse_floats(_as_u8p(buf), _as_i64p(np.ascontiguousarray(
+        bounds, np.int64)), n, _as_f64p(out))
+    return out

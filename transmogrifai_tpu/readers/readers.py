@@ -107,6 +107,18 @@ class CSVReader(Reader):
             return v
 
     def read(self) -> List[Record]:
+        # native C++ scan when built (ops/native_bridge; the reference's
+        # spark-csv data-loader slot), python csv module otherwise
+        try:
+            from ..ops.native_bridge import native_csv_parse
+            with open(self.path, "rb") as fb:
+                rows = native_csv_parse(fb.read())
+        except Exception:
+            rows = None
+        if rows is not None and rows:
+            header, body = rows[0], rows[1:]
+            return [{k: self._coerce(k, v) for k, v in zip(header, r)}
+                    for r in body if any(f != "" for f in r)]
         out: List[Record] = []
         with open(self.path, newline="") as fh:
             for row in _csv.DictReader(fh):
