@@ -1,0 +1,46 @@
+"""Metrics/tracing registry (reference OpSparkListener semantics)."""
+import json
+import os
+
+import numpy as np
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.utils.metrics import MetricsCollector, collector
+from transmogrifai_tpu.workflow import (
+    OpParams, OpWorkflowRunner, Workflow)
+
+
+def test_span_records_only_when_enabled():
+    c = MetricsCollector()
+    with c.span("s", "u", "fit", n_rows=5):
+        pass
+    assert c.current.stage_metrics == []
+    c.enable("app")
+    with c.span("s", "u", "fit", n_rows=5):
+        pass
+    app = c.finish()
+    assert len(app.stage_metrics) == 1
+    m = app.stage_metrics[0]
+    assert m.phase == "fit" and m.n_rows == 5 and m.wall_seconds >= 0
+    assert "Total:" in app.pretty()
+
+
+def test_workflow_run_collects_stage_metrics(tmp_path):
+    rows = [{"x": float(i % 7), "y": float(i % 3)} for i in range(100)]
+    fx = FeatureBuilder.Real("x").extract(lambda r: r.get("x")).as_predictor()
+    fy = FeatureBuilder.Real("y").extract(lambda r: r.get("y")).as_predictor()
+    vec = transmogrify([fx, fy])
+    wf = Workflow().set_result_features(vec)
+    runner = OpWorkflowRunner(wf, train_reader=ListReader(rows))
+    params = OpParams(collect_stage_metrics=True,
+                      metrics_location=str(tmp_path))
+    runner.run(OpWorkflowRunner.TRAIN, params)
+    path = tmp_path / "train_stage_metrics.json"
+    assert path.exists()
+    doc = json.loads(path.read_text())
+    assert doc["stage_metrics"], "expected recorded spans"
+    phases = {m["phase"] for m in doc["stage_metrics"]}
+    assert "fit" in phases
+    collector.disable()

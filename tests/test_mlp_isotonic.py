@@ -1,0 +1,88 @@
+"""MLP classifier + isotonic calibration.
+
+Mirrors reference suites OpMultilayerPerceptronClassifierTest.scala and
+IsotonicRegressionCalibratorTest.scala.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.models.mlp import (
+    IsotonicRegressionCalibrator, OpMultilayerPerceptronClassifier, pav_fit)
+from transmogrifai_tpu.data.dataset import column_from_values
+from transmogrifai_tpu.types import RealNN
+
+
+class TestMLP:
+    def test_solves_xor(self):
+        rng = np.random.default_rng(0)
+        X = rng.uniform(-1, 1, size=(1500, 2)).astype(np.float32)
+        y = ((X[:, 0] > 0) ^ (X[:, 1] > 0)).astype(np.float32)
+        m = OpMultilayerPerceptronClassifier(
+            hidden_layers=[16, 16], max_iter=600, step_size=0.05)
+        model = m.fit_arrays(X, y)
+        pred, raw, prob = model.predict_arrays(X)
+        assert (pred == y).mean() > 0.95
+        assert prob.shape == (1500, 2)
+        assert np.allclose(prob.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_multiclass(self):
+        rng = np.random.default_rng(1)
+        centers = rng.normal(scale=4, size=(3, 4))
+        y = rng.integers(0, 3, 900)
+        X = (centers[y] + rng.normal(size=(900, 4))).astype(np.float32)
+        m = OpMultilayerPerceptronClassifier(hidden_layers=[12],
+                                             max_iter=400)
+        model = m.fit_arrays(X, y.astype(np.float32))
+        pred, _, prob = model.predict_arrays(X)
+        assert prob.shape[1] == 3
+        assert (pred == y).mean() > 0.9
+
+    def test_save_load(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(200, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        model = OpMultilayerPerceptronClassifier(
+            hidden_layers=[5], max_iter=50).fit_arrays(X, y)
+        restored = type(model).from_save_args(model.save_args())
+        np.testing.assert_allclose(model.predict_arrays(X)[2],
+                                   restored.predict_arrays(X)[2], atol=1e-6)
+
+
+class TestIsotonic:
+    def test_pav_monotone_and_fits_steps(self):
+        x = np.array([1, 2, 3, 4, 5, 6], float)
+        y = np.array([0.1, 0.0, 0.3, 0.2, 0.8, 0.9])
+        bounds, values = pav_fit(x, y)
+        assert (np.diff(values) >= 0).all()
+        # pooled blocks: (0.1,0.0)->0.05, (0.3,0.2)->0.25
+        assert values[0] == pytest.approx(0.05)
+        assert 0.25 in np.round(values, 6)
+
+    def test_calibrator_end_to_end(self):
+        rng = np.random.default_rng(3)
+        n = 2000
+        score = rng.uniform(size=n)
+        # true P(y|s) is monotone but nonlinear in score
+        p = np.clip(score ** 2, 0, 1)
+        label = (rng.uniform(size=n) < p).astype(float)
+        cal = IsotonicRegressionCalibrator()
+        lbl_col = column_from_values(RealNN, list(label))
+        s_col = column_from_values(RealNN, list(score))
+        model = cal.fit_columns(lbl_col, s_col)
+        out = model.transform_columns(lbl_col, s_col)
+        cali = np.asarray(out.data)
+        # calibrated outputs monotone in score and close to s^2
+        order = np.argsort(score)
+        assert (np.diff(cali[order]) >= -1e-9).all()
+        err = np.abs(cali - p).mean()
+        assert err < 0.08
+
+    def test_model_round_trip(self):
+        from transmogrifai_tpu.models.mlp import IsotonicRegressionModel
+        m = IsotonicRegressionModel(boundaries=np.array([0.0, 0.5]),
+                                    values=np.array([0.2, 0.8]))
+        r = type(m).from_save_args(m.save_args())
+        assert r.transform_value(RealNN(0.0), RealNN(0.7)).value \
+            == pytest.approx(0.8)
+        assert r.transform_value(RealNN(0.0), RealNN(0.3)).value \
+            == pytest.approx(0.2)

@@ -57,6 +57,12 @@ def _models_by_name() -> Dict[str, type]:
         "OpGeneralizedLinearRegression": glm.OpGeneralizedLinearRegression,
     }
     try:
+        from ..models.mlp import OpMultilayerPerceptronClassifier
+        out["OpMultilayerPerceptronClassifier"] = \
+            OpMultilayerPerceptronClassifier
+    except ImportError:
+        pass
+    try:
         from ..models import trees
         out.update({
             "OpRandomForestClassifier": trees.OpRandomForestClassifier,

@@ -1,0 +1,236 @@
+"""Multilayer perceptron classifier + isotonic regression calibrator.
+
+Reference: core/.../impl/classification/OpMultilayerPerceptronClassifier.scala
+(149 LoC; Spark MLP = sigmoid hidden layers + softmax out, LBFGS) and
+core/.../impl/regression/IsotonicRegressionCalibrator.scala (63 LoC).
+
+TPU shape: the MLP trains as one jitted lax.scan of full-batch Adam steps
+(matmuls on the MXU; no python loop), matching Spark's full-batch LBFGS
+training regime more closely than minibatch SGD would. Isotonic regression
+is the classic pool-adjacent-violators pass on host (O(n) after sort) with
+a device-friendly step-function transform.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..stages.base import Estimator, Transformer
+from ..stages.params import Param
+from ..types import RealNN
+from .base import PredictionModel, PredictorEstimator
+from .glm import SoftmaxModel
+
+
+def _init_params(key, sizes: Sequence[int]):
+    params = []
+    for i in range(len(sizes) - 1):
+        key, sub = jax.random.split(key)
+        w = jax.random.normal(sub, (sizes[i], sizes[i + 1])) * \
+            jnp.sqrt(2.0 / sizes[i])
+        params.append((w, jnp.zeros(sizes[i + 1])))
+    return params
+
+
+def _forward(params, X):
+    h = X
+    for w, b in params[:-1]:
+        h = jax.nn.sigmoid(h @ w + b)   # Spark MLP uses sigmoid hidden units
+    w, b = params[-1]
+    return h @ w + b                     # logits
+
+
+def _fit_mlp(X, Y, w_row, sizes, steps: int, lr: float, l2: float, seed: int):
+    key = jax.random.PRNGKey(seed)
+    params = _init_params(key, sizes)
+
+    def loss_fn(params):
+        logits = _forward(params, X)
+        logp = jax.nn.log_softmax(logits, axis=1)
+        ce = -(Y * logp).sum(axis=1)
+        reg = sum((w * w).sum() for w, _ in params)
+        return (w_row * ce).sum() / (w_row.sum() + 1e-12) + l2 * reg
+
+    # full-batch Adam as a lax.scan (one XLA program)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    zeros = jax.tree.map(jnp.zeros_like, params)
+
+    def step(carry, i):
+        params, m, v = carry
+        g = jax.grad(loss_fn)(params)
+        m = jax.tree.map(lambda a, b_: b1 * a + (1 - b1) * b_, m, g)
+        v = jax.tree.map(lambda a, b_: b2 * a + (1 - b2) * b_ * b_, v, g)
+        t = i + 1.0
+        mhat = jax.tree.map(lambda a: a / (1 - b1 ** t), m)
+        vhat = jax.tree.map(lambda a: a / (1 - b2 ** t), v)
+        params = jax.tree.map(
+            lambda p, mh, vh: p - lr * mh / (jnp.sqrt(vh) + eps),
+            params, mhat, vhat)
+        return (params, m, v), None
+
+    (params, _, _), _ = jax.lax.scan(
+        step, (params, zeros, zeros), jnp.arange(steps, dtype=jnp.float32))
+    return params
+
+
+_fit_mlp_jit = jax.jit(_fit_mlp, static_argnames=("sizes", "steps", "seed"))
+
+
+class MLPModel(PredictionModel):
+    """Fitted MLP: list of (W, b) layers, sigmoid hidden + softmax out."""
+
+    def __init__(self, weights: List[np.ndarray], biases: List[np.ndarray],
+                 operation_name: str = "mlp", uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.weights = [np.asarray(w, np.float32) for w in weights]
+        self.biases = [np.asarray(b, np.float32) for b in biases]
+
+    def predict_arrays(self, X):
+        h = np.asarray(X, np.float32)
+        for w, b in zip(self.weights[:-1], self.biases[:-1]):
+            h = 1.0 / (1.0 + np.exp(-(h @ w + b)))
+        logits = h @ self.weights[-1] + self.biases[-1]
+        m = logits.max(axis=1, keepdims=True)
+        e = np.exp(logits - m)
+        prob = e / e.sum(axis=1, keepdims=True)
+        return prob.argmax(axis=1).astype(np.float32), logits, prob
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(weights=self.weights, biases=self.biases)
+        return d
+
+
+class OpMultilayerPerceptronClassifier(PredictorEstimator):
+    """Reference OpMultilayerPerceptronClassifier (149 LoC)."""
+
+    problem_types = ("binary", "multiclass")
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("hidden_layers", "hidden layer sizes", [10, 10]),
+            Param("max_iter", "Adam steps", 200),
+            Param("step_size", "learning rate", 0.05),
+            Param("reg_param", "L2 strength", 1e-4),
+            Param("seed", "init seed", 42),
+        ]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("mlpClassifier", uid=uid, **params)
+
+    def fit_arrays(self, X, y, w=None):
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y)
+        w = np.ones(len(y), np.float32) if w is None else np.asarray(
+            w, np.float32)
+        n_classes = max(int(y.max()) + 1 if y.size else 2, 2)
+        Y = np.eye(n_classes, dtype=np.float32)[y.astype(int)]
+        hidden = [int(h) for h in self.get_param("hidden_layers")]
+        sizes = tuple([X.shape[1]] + hidden + [n_classes])
+        params = _fit_mlp_jit(
+            jnp.asarray(X), jnp.asarray(Y), jnp.asarray(w), sizes,
+            steps=int(self.get_param("max_iter")),
+            lr=float(self.get_param("step_size")),
+            l2=float(self.get_param("reg_param")),
+            seed=int(self.get_param("seed")))
+        return MLPModel([np.asarray(w_) for w_, _ in params],
+                        [np.asarray(b_) for _, b_ in params],
+                        operation_name=self.operation_name)
+
+
+# -- isotonic regression ----------------------------------------------------
+
+def pav_fit(x: np.ndarray, y: np.ndarray, w: Optional[np.ndarray] = None
+            ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pool-adjacent-violators: weighted isotonic fit of y on x.
+
+    Returns (boundaries, values): step function value[i] on x >=
+    boundaries[i] (right-continuous), non-decreasing.
+    """
+    order = np.argsort(x, kind="stable")
+    xs, ys = x[order], y[order].astype(np.float64)
+    ws = (np.ones(len(y)) if w is None else w[order]).astype(np.float64)
+    # blocks: (sum_w, sum_wy, x_start)
+    vals: List[float] = []
+    wsum: List[float] = []
+    xstart: List[float] = []
+    for xi, yi, wi in zip(xs, ys, ws):
+        vals.append(yi * wi)
+        wsum.append(wi)
+        xstart.append(xi)
+        while len(vals) > 1 and vals[-2] / wsum[-2] >= vals[-1] / wsum[-1]:
+            v, s = vals.pop(), wsum.pop()
+            xstart.pop()
+            vals[-1] += v
+            wsum[-1] += s
+    values = np.array([v / s for v, s in zip(vals, wsum)])
+    return np.asarray(xstart, np.float64), values
+
+
+class IsotonicRegressionCalibrator(Estimator):
+    """(RealNN label, RealNN score) -> RealNN calibrated score (reference
+    IsotonicRegressionCalibrator.scala:63 wrapping Spark IsotonicRegression)."""
+
+    input_types = (RealNN, RealNN)
+    output_type = RealNN
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("isotonic", "non-decreasing if true", True)]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__("isoCalibrator", uid=uid, **params)
+
+    def fit_columns(self, *cols) -> Transformer:
+        label = np.asarray(cols[0].data, np.float64)
+        score = np.asarray(cols[1].data, np.float64)
+        ok = ~(np.isnan(label) | np.isnan(score))
+        x, y = score[ok], label[ok]
+        if not bool(self.get_param("isotonic")):
+            x = -x
+        bounds, values = pav_fit(x, y)
+        return IsotonicRegressionModel(
+            boundaries=bounds, values=values,
+            increasing=bool(self.get_param("isotonic")),
+            operation_name=self.operation_name)
+
+
+class IsotonicRegressionModel(Transformer):
+    input_types = (RealNN, RealNN)
+    output_type = RealNN
+
+    def __init__(self, boundaries: Optional[np.ndarray] = None,
+                 values: Optional[np.ndarray] = None, increasing: bool = True,
+                 uid: Optional[str] = None, **params):
+        self.boundaries = np.asarray(
+            boundaries if boundaries is not None else [0.0], np.float64)
+        self.values = np.asarray(values if values is not None else [0.0],
+                                 np.float64)
+        self.increasing = bool(increasing)
+        super().__init__(params.pop("operation_name", "isoCalibrator"),
+                         uid=uid, **params)
+
+    def _apply(self, score: np.ndarray) -> np.ndarray:
+        x = score if self.increasing else -score
+        idx = np.clip(np.searchsorted(self.boundaries, x, side="right") - 1,
+                      0, len(self.values) - 1)
+        return self.values[idx]
+
+    def transform_value(self, *vals):
+        return RealNN(float(self._apply(np.asarray([vals[-1].value]))[0]))
+
+    def transform_columns(self, *cols):
+        from ..data.dataset import Column
+        from ..types import ColumnKind
+        return Column(kind=ColumnKind.FLOAT,
+                      data=self._apply(np.asarray(cols[-1].data, np.float64)))
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(boundaries=self.boundaries, values=self.values,
+                 increasing=self.increasing)
+        return d

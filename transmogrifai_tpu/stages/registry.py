@@ -34,6 +34,7 @@ _STAGE_MODULES = [
     "transmogrifai_tpu.automl.selector",
     "transmogrifai_tpu.models.glm",
     "transmogrifai_tpu.models.trees",
+    "transmogrifai_tpu.models.mlp",
     "transmogrifai_tpu.insights.loco",
     "transmogrifai_tpu.transformers.math",
     "transmogrifai_tpu.transformers.misc",

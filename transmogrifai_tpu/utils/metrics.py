@@ -1,0 +1,120 @@
+"""Run metrics + tracing registry.
+
+Reference: utils/.../spark/OpSparkListener.scala:56-164 — per-stage/job/app
+metrics (durations, GC, shuffle/IO bytes) collected by a Spark listener,
+opt-in via OpParams.collectStageMetrics, surfaced at app end. The TPU
+equivalents are per-stage wall clock + row counts + XLA compile counts, and
+a `trace()` context manager around jax.profiler for device timelines.
+
+Collection is opt-in and process-local: `enable()` (or
+OpParams.collect_stage_metrics=True through the runner) turns it on; the
+workflow engine reports fit/transform spans here.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+
+@dataclass
+class StageMetric:
+    """One fit/transform span (reference StageMetrics case class)."""
+
+    stage_name: str
+    uid: str
+    phase: str              # 'fit' | 'transform' | 'fused-transform'
+    wall_seconds: float
+    n_rows: int = 0
+    n_stages_fused: int = 1
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
+@dataclass
+class AppMetrics:
+    """Whole-run metrics (reference AppMetrics)."""
+
+    app_name: str = "transmogrifai_tpu"
+    start_time: float = 0.0
+    end_time: float = 0.0
+    stage_metrics: List[StageMetric] = field(default_factory=list)
+
+    @property
+    def duration_seconds(self) -> float:
+        return max(self.end_time - self.start_time, 0.0)
+
+    def total_stage_seconds(self) -> float:
+        return sum(m.wall_seconds for m in self.stage_metrics)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"app_name": self.app_name,
+                "duration_seconds": self.duration_seconds,
+                "total_stage_seconds": self.total_stage_seconds(),
+                "stage_metrics": [m.to_json() for m in self.stage_metrics]}
+
+    def pretty(self) -> str:
+        lines = [f"{'Stage':<42}{'Phase':<18}{'Rows':>9}{'Seconds':>10}"]
+        for m in self.stage_metrics:
+            lines.append(f"{m.stage_name[:41]:<42}{m.phase:<18}"
+                         f"{m.n_rows:>9}{m.wall_seconds:>10.4f}")
+        lines.append(f"Total: {self.total_stage_seconds():.4f}s over "
+                     f"{len(self.stage_metrics)} spans")
+        return "\n".join(lines)
+
+
+class MetricsCollector:
+    """Process-local registry (the listener's slot in this runtime)."""
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.current = AppMetrics()
+
+    def enable(self, app_name: str = "transmogrifai_tpu") -> None:
+        self.enabled = True
+        self.current = AppMetrics(app_name=app_name, start_time=time.time())
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def finish(self) -> AppMetrics:
+        self.current.end_time = time.time()
+        return self.current
+
+    @contextlib.contextmanager
+    def span(self, stage_name: str, uid: str, phase: str,
+             n_rows: int = 0, n_stages_fused: int = 1) -> Iterator[None]:
+        if not self.enabled:
+            yield
+            return
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self.current.stage_metrics.append(StageMetric(
+                stage_name=stage_name, uid=uid, phase=phase,
+                wall_seconds=time.time() - t0, n_rows=n_rows,
+                n_stages_fused=n_stages_fused))
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.finish().to_json(), f, indent=2)
+
+
+# the process-wide collector the workflow engine reports to
+collector = MetricsCollector()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str) -> Iterator[None]:
+    """Device-timeline tracing via jax.profiler (the reference's Spark UI /
+    event-log slot). View with TensorBoard or xprof."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -63,10 +63,17 @@ class LayerRunner:
                 ds.column(n).kind in _DEVICE_KINDS for n in st.input_names())
             (fusable if ok else host).append(st)
 
+        from ..utils.metrics import collector
         if fusable:
-            ds = self._apply_fused(ds, fusable)
+            with collector.span(
+                    "+".join(st.stage_name for st in fusable)[:120],
+                    fusable[0].uid, "fused-transform", n_rows=len(ds),
+                    n_stages_fused=len(fusable)):
+                ds = self._apply_fused(ds, fusable)
         for st in host:
-            ds = st.transform(ds)
+            with collector.span(st.stage_name, st.uid, "transform",
+                                n_rows=len(ds)):
+                ds = st.transform(ds)
         return ds
 
     def _apply_fused(self, ds: Dataset, stages: List[Transformer]) -> Dataset:
@@ -117,8 +124,11 @@ class LayerRunner:
             fitted: List[Transformer] = []
             for st in layer:
                 if isinstance(st, Estimator):
+                    from ..utils.metrics import collector
                     ds_in = _ensure_input_columns(ds, st)
-                    model = st.fit(ds_in)
+                    with collector.span(st.stage_name, st.uid, "fit",
+                                        n_rows=len(ds_in)):
+                        model = st.fit(ds_in)
                     fitted.append(model)
                 else:
                     fitted.append(st)  # type: ignore[arg-type]

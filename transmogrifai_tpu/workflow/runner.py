@@ -170,6 +170,12 @@ class OpWorkflowRunner:
         return self
 
     def _finish(self, result: RunResult, params: OpParams) -> RunResult:
+        if params.collect_stage_metrics and params.metrics_location:
+            from ..utils.metrics import collector
+            os.makedirs(params.metrics_location, exist_ok=True)
+            collector.save(os.path.join(
+                params.metrics_location,
+                f"{result.run_type.lower()}_stage_metrics.json"))
         if params.metrics_location:
             os.makedirs(params.metrics_location, exist_ok=True)
             payload = {k: v for k, v in result.__dict__.items()
@@ -187,6 +193,9 @@ class OpWorkflowRunner:
     def run(self, run_type: str, params: Optional[OpParams] = None
             ) -> RunResult:
         params = params or OpParams()
+        if params.collect_stage_metrics:
+            from ..utils.metrics import collector
+            collector.enable(app_name=type(self.workflow).__name__)
         t0 = time.time()
         if run_type == self.TRAIN:
             out = self._train(params)
