@@ -1,0 +1,63 @@
+"""OpBoston: regression model selection.
+
+Reference: helloworld/src/main/scala/com/salesforce/hw/OpBoston.scala
+(RegressionModelSelector at :86). Housing-shaped synthetic data (no files
+copied from the reference).
+
+    python examples/op_boston.py
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import RegressionModelSelector
+from transmogrifai_tpu.automl.preparators import SanityChecker
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.workflow import Workflow
+
+
+def synthetic_housing(n: int = 506, seed: int = 1978):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        crim = float(rng.lognormal(-1.5, 1.8))
+        rm = float(np.clip(rng.normal(6.3, 0.7), 3.5, 8.8))
+        age = float(rng.uniform(2, 100))
+        dis = float(rng.lognormal(1.2, 0.5))
+        tax = float(rng.uniform(187, 711))
+        ptratio = float(rng.uniform(12.6, 22.0))
+        lstat = float(np.clip(rng.lognormal(2.4, 0.5), 1.7, 38))
+        medv = float(np.clip(
+            22.5 + 5.0 * (rm - 6.3) - 0.6 * lstat / 3.0
+            - 1.2 * np.log1p(crim) - 0.3 * (ptratio - 18)
+            + rng.normal(0, 2.5), 5, 50))
+        rows.append({"crim": crim, "rm": rm, "age": age, "dis": dis,
+                     "tax": tax, "ptratio": ptratio, "lstat": lstat,
+                     "medv": medv})
+    return rows
+
+
+def main() -> None:
+    medv = FeatureBuilder.RealNN("medv").extract(
+        lambda r: r.get("medv")).as_response()
+    names = ["crim", "rm", "age", "dis", "tax", "ptratio", "lstat"]
+    feats = [FeatureBuilder.Real(n).extract(
+        lambda r, _n=n: r.get(_n)).as_predictor() for n in names]
+
+    vec = transmogrify(feats)
+    checked = SanityChecker().set_input(medv, vec).get_output()
+    pred = RegressionModelSelector.with_train_validation_split(
+        train_ratio=0.75, seed=42,
+        model_types=["OpLinearRegression", "OpGBTRegressor"],
+    ).set_input(medv, checked).get_output()
+
+    wf = Workflow().set_reader(ListReader(synthetic_housing())) \
+        .set_result_features(pred)
+    model = wf.train()
+    print(model.summary_pretty())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""OpIris: multiclass model selection.
+
+Reference: helloworld/src/main/scala/com/salesforce/hw/OpIris.scala
+(MultiClassificationModelSelector at :66). The classic iris measurements are
+synthesized from per-species Gaussians fit to the well-known summary
+statistics (no data files copied).
+
+    python examples/op_iris.py
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import MultiClassificationModelSelector
+from transmogrifai_tpu.automl.preparators import SanityChecker
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.workflow import Workflow
+
+# per-species (mean, std) of sepal_l, sepal_w, petal_l, petal_w
+_SPECIES = {
+    "setosa": [(5.01, 0.35), (3.43, 0.38), (1.46, 0.17), (0.25, 0.11)],
+    "versicolor": [(5.94, 0.52), (2.77, 0.31), (4.26, 0.47), (1.33, 0.20)],
+    "virginica": [(6.59, 0.64), (2.97, 0.32), (5.55, 0.55), (2.03, 0.27)],
+}
+
+
+def synthetic_iris(n_per_class: int = 50, seed: int = 7):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for label, (cls, stats) in enumerate(_SPECIES.items()):
+        for _ in range(n_per_class):
+            vals = [float(rng.normal(m, s)) for m, s in stats]
+            rows.append({"sepalLength": vals[0], "sepalWidth": vals[1],
+                         "petalLength": vals[2], "petalWidth": vals[3],
+                         "irisClass": float(label), "species": cls})
+    rng.shuffle(rows)
+    return rows
+
+
+def main() -> None:
+    label = FeatureBuilder.RealNN("irisClass").extract(
+        lambda r: r.get("irisClass")).as_response()
+    feats = [FeatureBuilder.Real(n).extract(
+        lambda r, _n=n: r.get(_n)).as_predictor()
+        for n in ("sepalLength", "sepalWidth", "petalLength", "petalWidth")]
+
+    vec = transmogrify(feats)
+    checked = SanityChecker().set_input(label, vec).get_output()
+    pred = MultiClassificationModelSelector.with_cross_validation(
+        num_folds=3, seed=42,
+        model_types=["OpLogisticRegression", "OpRandomForestClassifier"],
+    ).set_input(label, checked).get_output()
+
+    wf = Workflow().set_reader(ListReader(synthetic_iris())) \
+        .set_result_features(pred)
+    model = wf.train()
+    print(model.summary_pretty())
+
+
+if __name__ == "__main__":
+    main()

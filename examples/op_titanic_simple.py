@@ -1,0 +1,101 @@
+"""OpTitanicSimple: the canonical binary-classification flow.
+
+Reference: helloworld/src/main/scala/com/salesforce/hw/OpTitanicSimple.scala
+(features :94-105, transmogrify/sanityCheck/selector :110-135, README
+summary table). Runs on a bundled synthetic Titanic-shaped dataset (no data
+copied from the reference); pass a CSV path with the real Kaggle columns to
+run on actual data.
+
+    python examples/op_titanic_simple.py [titanic.csv]
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.preparators import SanityChecker
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.readers.readers import CSVReader, ListReader
+from transmogrifai_tpu.workflow import Workflow
+
+
+def synthetic_passengers(n: int = 891, seed: int = 1912):
+    """Titanic-shaped records: survival depends on sex, class, age, fare."""
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        sex = "female" if rng.uniform() < 0.35 else "male"
+        pclass = int(rng.choice([1, 2, 3], p=[0.24, 0.21, 0.55]))
+        age = float(np.clip(rng.normal(29, 14), 0.4, 80)) \
+            if rng.uniform() > 0.2 else None
+        sibsp = int(rng.poisson(0.5))
+        parch = int(rng.poisson(0.4))
+        fare = float(np.clip(rng.lognormal(
+            3.6 - 0.5 * (pclass - 1), 0.6), 4, 512))
+        embarked = str(rng.choice(["S", "C", "Q"], p=[0.72, 0.19, 0.09]))
+        logit = (2.5 * (sex == "female") - 0.9 * (pclass - 2)
+                 - 0.02 * ((age or 29) - 29) + 0.004 * fare
+                 - 0.3 * (sibsp + parch > 3) - 0.7)
+        survived = float(rng.uniform() < 1 / (1 + np.exp(-logit)))
+        rows.append({
+            "survived": survived, "pClass": str(pclass), "sex": sex,
+            "age": age, "sibSp": sibsp, "parCh": parch,
+            "fare": fare, "embarked": embarked,
+        })
+    return rows
+
+
+def build_workflow():
+    # raw features (reference OpTitanicSimple.scala:94-105)
+    survived = FeatureBuilder.RealNN("survived").extract(
+        lambda r: r.get("survived")).as_response()
+    p_class = FeatureBuilder.PickList("pClass").extract(
+        lambda r: None if r.get("pClass") is None
+        else str(r.get("pClass"))).as_predictor()
+    sex = FeatureBuilder.PickList("sex").extract(
+        lambda r: r.get("sex")).as_predictor()
+    age = FeatureBuilder.Real("age").extract(
+        lambda r: r.get("age")).as_predictor()
+    sib_sp = FeatureBuilder.Integral("sibSp").extract(
+        lambda r: r.get("sibSp")).as_predictor()
+    par_ch = FeatureBuilder.Integral("parCh").extract(
+        lambda r: r.get("parCh")).as_predictor()
+    fare = FeatureBuilder.Real("fare").extract(
+        lambda r: r.get("fare")).as_predictor()
+    embarked = FeatureBuilder.PickList("embarked").extract(
+        lambda r: r.get("embarked")).as_predictor()
+
+    # derived feature via the dsl (reference: familySize = sibSp + parCh + 1)
+    family_size = (sib_sp + par_ch) + 1.0
+
+    features = transmogrify(
+        [p_class, sex, age, sib_sp, par_ch, fare, embarked, family_size])
+    checked = SanityChecker(check_sample=1.0).set_input(
+        survived, features).get_output()
+    prediction = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, seed=42,
+        model_types=["OpLogisticRegression", "OpRandomForestClassifier"],
+    ).set_input(survived, checked).get_output()
+    return Workflow().set_result_features(prediction), prediction
+
+
+def main(argv=None) -> None:
+    argv = argv if argv is not None else sys.argv[1:]
+    if argv:
+        reader = CSVReader(argv[0])
+    else:
+        reader = ListReader(synthetic_passengers())
+    wf, prediction = build_workflow()
+    model = wf.set_reader(reader).train()
+    print("Model summary:\n")
+    print(model.summary_pretty())
+    scores = model.score()
+    print(f"\nScored {scores.n_rows} rows; "
+          f"prediction column: {prediction.name[:60]}...")
+
+
+if __name__ == "__main__":
+    main()

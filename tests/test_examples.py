@@ -1,0 +1,30 @@
+"""The canonical demo flows stay runnable (reference helloworld suites)."""
+import sys
+import os
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+
+def test_titanic_flow_builds_and_trains(capsys):
+    import op_titanic_simple as t
+    from transmogrifai_tpu.readers.readers import ListReader
+    wf, pred = t.build_workflow()
+    model = wf.set_reader(ListReader(t.synthetic_passengers(300))).train()
+    s = model.summary_pretty()
+    assert "Selected" in s and "au_pr" in s.lower()
+
+
+def test_iris_main_runs(capsys):
+    import op_iris
+    op_iris.main()
+    out = capsys.readouterr().out
+    assert "Selected" in out
+
+
+def test_boston_main_runs(capsys):
+    import op_boston
+    op_boston.main()
+    out = capsys.readouterr().out
+    assert "Selected" in out and "rmse" in out.lower()

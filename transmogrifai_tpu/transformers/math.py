@@ -15,13 +15,14 @@ import numpy as np
 
 from ..stages.base import JaxTransformer
 from ..stages.params import Param
-from ..types import Real, RealNN
+from ..types import OPNumeric, Real, RealNN
 
 _EPS = 1e-12
 
 
 class _BinaryMath(JaxTransformer):
-    input_types = (Real, Real)
+    # any numeric subtype is accepted, as in RichNumericFeature's implicits
+    input_types = (OPNumeric, OPNumeric)
     output_type = Real
 
     def __init__(self, uid: Optional[str] = None, **params):
@@ -63,7 +64,7 @@ class DivideTransformer(_BinaryMath):
 
 
 class _ScalarMath(JaxTransformer):
-    input_types = (Real,)
+    input_types = (OPNumeric,)
     output_type = Real
 
     @classmethod
@@ -111,7 +112,7 @@ class ScalarDivideTransformer(_ScalarMath):
 
 
 class _UnaryMath(JaxTransformer):
-    input_types = (Real,)
+    input_types = (OPNumeric,)
     output_type = Real
 
     def __init__(self, uid: Optional[str] = None, **params):
