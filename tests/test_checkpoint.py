@@ -1,0 +1,97 @@
+"""Sweep checkpoint/resume (SURVEY §5 failure-recovery subsystem)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.automl.tuning.checkpoint import (
+    SweepCheckpoint, sweep_key)
+from transmogrifai_tpu.automl.tuning.validators import CrossValidation
+from transmogrifai_tpu.evaluators.evaluators import (
+    BinaryClassificationEvaluator)
+from transmogrifai_tpu.models.trees import OpGBTClassifier
+from transmogrifai_tpu.stages.params import param_grid
+
+
+def _data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+def test_key_stability_and_sensitivity():
+    k1 = sweep_key("M", {"a": 1, "b": 2}, 3, 42, False, "au_pr")
+    k2 = sweep_key("M", {"b": 2, "a": 1}, 3, 42, False, "au_pr")
+    assert k1 == k2  # order-insensitive
+    assert k1 != sweep_key("M", {"a": 1, "b": 3}, 3, 42, False, "au_pr")
+    assert k1 != sweep_key("M", {"a": 1, "b": 2}, 5, 42, False, "au_pr")
+
+
+def test_checkpoint_append_and_reload(tmp_path):
+    path = str(tmp_path / "sweep.jsonl")
+    c = SweepCheckpoint(path)
+    c.record("k1", "M", {"a": 1}, [0.9, 0.8], "au_pr")
+    c2 = SweepCheckpoint(path)
+    assert c2.get("k1")["fold_metrics"] == [0.9, 0.8]
+    # torn tail line is ignored
+    with open(path, "a") as f:
+        f.write('{"key": "k2", "model_na')
+    c3 = SweepCheckpoint(path)
+    assert c3.get("k1") is not None and c3.get("k2") is None
+
+
+def test_resume_skips_finished_cells(tmp_path, monkeypatch):
+    X, y = _data()
+    path = str(tmp_path / "sweep.jsonl")
+    grids = param_grid(max_iter=[3, 5], max_depth=[2])
+
+    cv = CrossValidation(BinaryClassificationEvaluator(), num_folds=2,
+                         seed=7)
+    cv.checkpoint_path = path
+    best1 = cv.validate([(OpGBTClassifier(), grids)], X, y,
+                        np.ones_like(y), problem_type="binary")
+    assert len(SweepCheckpoint(path)) == 2
+
+    # resume: fits must NOT run again
+    calls = {"n": 0}
+    orig = OpGBTClassifier.fit_arrays
+
+    def spy(self, *a, **k):
+        calls["n"] += 1
+        return orig(self, *a, **k)
+    monkeypatch.setattr(OpGBTClassifier, "fit_arrays", spy)
+
+    cv2 = CrossValidation(BinaryClassificationEvaluator(), num_folds=2,
+                          seed=7)
+    cv2.checkpoint_path = path
+    best2 = cv2.validate([(OpGBTClassifier(), grids)], X, y,
+                         np.ones_like(y), problem_type="binary")
+    assert calls["n"] == 0  # all cells came from the checkpoint
+    assert best2.best_grid == best1.best_grid
+    assert best2.best_metric == pytest.approx(best1.best_metric)
+
+
+def test_different_seed_does_not_reuse(tmp_path, monkeypatch):
+    X, y = _data()
+    path = str(tmp_path / "sweep.jsonl")
+    grids = param_grid(max_iter=[3], max_depth=[2])
+    cv = CrossValidation(BinaryClassificationEvaluator(), num_folds=2, seed=7)
+    cv.checkpoint_path = path
+    cv.validate([(OpGBTClassifier(), grids)], X, y, np.ones_like(y),
+                problem_type="binary")
+
+    calls = {"n": 0}
+    orig = OpGBTClassifier.fit_arrays
+
+    def spy(self, *a, **k):
+        calls["n"] += 1
+        return orig(self, *a, **k)
+    monkeypatch.setattr(OpGBTClassifier, "fit_arrays", spy)
+    cv2 = CrossValidation(BinaryClassificationEvaluator(), num_folds=2,
+                          seed=8)  # different folds -> stale metrics invalid
+    cv2.checkpoint_path = path
+    cv2.validate([(OpGBTClassifier(), grids)], X, y, np.ones_like(y),
+                 problem_type="binary")
+    assert calls["n"] > 0

@@ -1,0 +1,65 @@
+"""Sweep checkpoint/resume.
+
+Reference gap filled per SURVEY §5: the reference has no mid-sweep recovery
+(Spark task retry is its whole failure story); the TPU build checkpoints the
+model-selection sweep so a preempted run resumes without refitting finished
+(model x grid) cells — deterministic replay comes from the seeded fold
+assignment (Validator._assign_folds) plus this record.
+
+Format: JSON-lines, one record per validated (model, grid) with its fold
+metrics, keyed by a stable hash of (model class, grid, folds, seed,
+stratify, metric). Orbax-style atomic append (write + flush) keeps partial
+lines out.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+def sweep_key(model_class: str, grid: Dict[str, Any], n_folds: int,
+              seed: int, stratify: bool, metric: str) -> str:
+    payload = json.dumps(
+        {"model": model_class, "grid": {k: grid[k] for k in sorted(grid)},
+         "folds": n_folds, "seed": seed, "stratify": stratify,
+         "metric": metric},
+        sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+
+class SweepCheckpoint:
+    """Append-only record of finished sweep cells."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._done: Dict[str, Dict[str, Any]] = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                        self._done[rec["key"]] = rec
+                    except (json.JSONDecodeError, KeyError):
+                        continue  # torn tail line from a crash — ignore
+
+    def get(self, key: str) -> Optional[Dict[str, Any]]:
+        return self._done.get(key)
+
+    def record(self, key: str, model_name: str, grid: Dict[str, Any],
+               fold_metrics: List[float], metric_name: str) -> None:
+        rec = {"key": key, "model_name": model_name, "grid": grid,
+               "fold_metrics": fold_metrics, "metric_name": metric_name}
+        self._done[key] = rec
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec, default=str) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def __len__(self) -> int:
+        return len(self._done)

@@ -109,6 +109,8 @@ class Validator:
         self.stratify = bool(stratify)
         # kept for API parity; device vmap replaces the thread pool
         self.parallelism = int(parallelism)
+        # optional sweep checkpoint (resume skips finished model x grid cells)
+        self.checkpoint_path: Optional[str] = None
 
     # -- folds -------------------------------------------------------------
     def fold_masks(self, y: np.ndarray) -> np.ndarray:
@@ -214,11 +216,30 @@ class Validator:
         ]
 
     # -- sequential fallback ----------------------------------------------
+    def _checkpoint(self):
+        if self.checkpoint_path is None:
+            return None
+        from .checkpoint import SweepCheckpoint
+        return SweepCheckpoint(self.checkpoint_path)
+
     def _validate_sequential(self, est, grids, X, y, w, masks
                              ) -> List[ValidatedModel]:
+        from .checkpoint import sweep_key
         metric = self.evaluator.default_metric
+        ckpt = self._checkpoint()
         out: List[ValidatedModel] = []
         for g in grids:
+            key = sweep_key(type(est).__name__, g, masks.shape[0],
+                            self.seed, self.stratify, metric)
+            if ckpt is not None:
+                done = ckpt.get(key)
+                if done is not None:
+                    out.append(ValidatedModel(
+                        model_name=type(est).__name__, model_uid=est.uid,
+                        grid=g, metric_name=metric,
+                        fold_metrics=[float(v)
+                                      for v in done["fold_metrics"]]))
+                    continue
             est_g = est.copy(**g)
             fold_vals: List[float] = []
             for f in range(masks.shape[0]):
@@ -228,6 +249,8 @@ class Validator:
                 pred, raw, prob = model.predict_arrays(X[va])
                 col = make_prediction_column(pred, raw, prob)
                 fold_vals.append(self.evaluator.evaluate(y[va], col, w[va]))
+            if ckpt is not None:
+                ckpt.record(key, type(est).__name__, g, fold_vals, metric)
             out.append(ValidatedModel(
                 model_name=type(est).__name__, model_uid=est.uid, grid=g,
                 metric_name=metric, fold_metrics=fold_vals))
