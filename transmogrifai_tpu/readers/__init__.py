@@ -1,0 +1,20 @@
+"""Data ingestion (reference readers module, 2,454 LoC): batch readers
+(list/CSV/JSONL/Parquet/Avro), temporal aggregation, conditional and joined
+readers, streaming micro-batch readers."""
+from .avro import AvroReader, read_avro_file, write_avro_file
+from .readers import (
+    AggregateReader, ConditionalReader, CSVReader, DataReaders,
+    JSONLinesReader, JoinedReader, ListReader, ParquetReader, Reader,
+)
+from .streaming import (
+    AvroStreamingReader, CSVStreamingReader, FileStreamingReader,
+    ListStreamingReader, StreamingReader, score_stream,
+)
+
+__all__ = [
+    "AggregateReader", "AvroReader", "AvroStreamingReader",
+    "ConditionalReader", "CSVReader", "CSVStreamingReader", "DataReaders",
+    "FileStreamingReader", "JSONLinesReader", "JoinedReader", "ListReader",
+    "ListStreamingReader", "ParquetReader", "Reader", "StreamingReader",
+    "read_avro_file", "score_stream", "write_avro_file",
+]

@@ -1,0 +1,306 @@
+"""Avro Object Container File reader (pure Python, no dependency).
+
+Reference: readers/.../AvroReaders.scala + utils/.../io/AvroInOut.scala —
+Avro is the reference's native event format. This is a self-contained OCF
+decoder: magic/metadata/sync framing, null and deflate codecs, and the
+standard binary encoding for records of null/boolean/int/long/float/double/
+bytes/string/enum/fixed/array/map/union — the shapes the reference's
+schemas (e.g. Passenger) use.
+"""
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zlib
+from typing import Any, BinaryIO, Callable, Dict, Iterator, List, Optional
+
+_MAGIC = b"Obj\x01"
+
+
+class AvroDecodeError(ValueError):
+    pass
+
+
+class _Bin:
+    """Avro binary decoder over a byte buffer."""
+
+    def __init__(self, data: bytes):
+        self.buf = data
+        self.pos = 0
+
+    def read(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise AvroDecodeError("truncated avro data")
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def at_end(self) -> bool:
+        return self.pos >= len(self.buf)
+
+    # zig-zag varint
+    def long(self) -> int:
+        shift = 0
+        acc = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            acc |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        return (acc >> 1) ^ -(acc & 1)
+
+    def float_(self) -> float:
+        return struct.unpack("<f", self.read(4))[0]
+
+    def double(self) -> float:
+        return struct.unpack("<d", self.read(8))[0]
+
+    def bytes_(self) -> bytes:
+        return self.read(self.long())
+
+    def string(self) -> str:
+        return self.bytes_().decode("utf-8")
+
+    def boolean(self) -> bool:
+        return self.read(1) != b"\x00"
+
+
+def _resolve(schema: Any, named: Dict[str, Any]) -> Any:
+    if isinstance(schema, str) and schema in named:
+        return named[schema]
+    return schema
+
+
+def _collect_named(schema: Any, named: Dict[str, Any]) -> None:
+    if isinstance(schema, dict):
+        t = schema.get("type")
+        if t in ("record", "enum", "fixed") and "name" in schema:
+            named[schema["name"]] = schema
+            ns = schema.get("namespace")
+            if ns:
+                named[f"{ns}.{schema['name']}"] = schema
+        for key in ("fields", "items", "values"):
+            v = schema.get(key)
+            if isinstance(v, list):
+                for f in v:
+                    _collect_named(f.get("type") if isinstance(f, dict)
+                                   else f, named)
+            elif v is not None:
+                _collect_named(v, named)
+    elif isinstance(schema, list):
+        for s in schema:
+            _collect_named(s, named)
+
+
+def _decode(schema: Any, d: _Bin, named: Dict[str, Any]) -> Any:
+    schema = _resolve(schema, named)
+    if isinstance(schema, list):                     # union
+        idx = d.long()
+        if idx < 0 or idx >= len(schema):
+            raise AvroDecodeError(f"bad union index {idx}")
+        return _decode(schema[idx], d, named)
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            return {f["name"]: _decode(f["type"], d, named)
+                    for f in schema["fields"]}
+        if t == "enum":
+            return schema["symbols"][d.long()]
+        if t == "fixed":
+            return d.read(int(schema["size"]))
+        if t == "array":
+            out: List[Any] = []
+            while True:
+                n = d.long()
+                if n == 0:
+                    break
+                if n < 0:
+                    d.long()  # block byte size, unused
+                    n = -n
+                for _ in range(n):
+                    out.append(_decode(schema["items"], d, named))
+            return out
+        if t == "map":
+            m: Dict[str, Any] = {}
+            while True:
+                n = d.long()
+                if n == 0:
+                    break
+                if n < 0:
+                    d.long()
+                    n = -n
+                for _ in range(n):
+                    k = d.string()
+                    m[k] = _decode(schema["values"], d, named)
+            return m
+        # logical types ride on a primitive "type"
+        return _decode(t, d, named)
+    # primitive
+    if schema == "null":
+        return None
+    if schema == "boolean":
+        return d.boolean()
+    if schema in ("int", "long"):
+        return d.long()
+    if schema == "float":
+        return d.float_()
+    if schema == "double":
+        return d.double()
+    if schema == "bytes":
+        return d.bytes_()
+    if schema == "string":
+        return d.string()
+    raise AvroDecodeError(f"unsupported schema: {schema!r}")
+
+
+def read_avro_file(path: str) -> Iterator[Dict[str, Any]]:
+    """Iterate records of one OCF file."""
+    with open(path, "rb") as f:
+        data = f.read()
+    d = _Bin(data)
+    if d.read(4) != _MAGIC:
+        raise AvroDecodeError(f"{path}: not an Avro object container file")
+    meta: Dict[str, bytes] = {}
+    while True:
+        n = d.long()
+        if n == 0:
+            break
+        if n < 0:
+            d.long()
+            n = -n
+        for _ in range(n):
+            k = d.string()
+            meta[k] = d.bytes_()
+    sync = d.read(16)
+    schema = json.loads(meta["avro.schema"].decode("utf-8"))
+    codec = meta.get("avro.codec", b"null").decode()
+    named: Dict[str, Any] = {}
+    _collect_named(schema, named)
+
+    while not d.at_end():
+        count = d.long()
+        size = d.long()
+        block = d.read(size)
+        if codec == "deflate":
+            block = zlib.decompress(block, -15)
+        elif codec != "null":
+            raise AvroDecodeError(f"unsupported codec {codec!r}")
+        bd = _Bin(block)
+        for _ in range(count):
+            yield _decode(schema, bd, named)
+        if d.read(16) != sync:
+            raise AvroDecodeError("sync marker mismatch")
+
+
+from .readers import Reader
+
+
+class AvroReader(Reader):
+    """Reader over one or more Avro container files (reference
+    DataReaders.Simple.avro, AvroReaders.scala)."""
+
+    def __init__(self, paths, key_fn: Optional[Callable[[Any], str]] = None):
+        super().__init__(key_fn)
+        self.paths = [paths] if isinstance(paths, str) else list(paths)
+
+    def read(self) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for p in self.paths:
+            out.extend(read_avro_file(p))
+        return out
+
+
+# -- writer (for test fixtures + score export) ------------------------------
+
+def _zigzag(n: int) -> bytes:
+    u = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = u & 0x7F
+        u >>= 7
+        if u:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            break
+    return bytes(out)
+
+
+def _encode(schema: Any, v: Any, out: bytearray) -> None:
+    if isinstance(schema, list):  # union: null | T
+        if v is None:
+            out += _zigzag(schema.index("null"))
+            return
+        idx = next(i for i, s in enumerate(schema) if s != "null")
+        out += _zigzag(idx)
+        _encode(schema[idx], v, out)
+        return
+    if isinstance(schema, dict):
+        t = schema["type"]
+        if t == "record":
+            for f in schema["fields"]:
+                _encode(f["type"], v.get(f["name"]), out)
+            return
+        if t == "array":
+            if v:
+                out += _zigzag(len(v))
+                for item in v:
+                    _encode(schema["items"], item, out)
+            out += _zigzag(0)
+            return
+        if t == "map":
+            if v:
+                out += _zigzag(len(v))
+                for k, item in v.items():
+                    _encode("string", k, out)
+                    _encode(schema["values"], item, out)
+            out += _zigzag(0)
+            return
+        _encode(t, v, out)
+        return
+    if schema == "null":
+        return
+    if schema == "boolean":
+        out += b"\x01" if v else b"\x00"
+    elif schema in ("int", "long"):
+        out += _zigzag(int(v))
+    elif schema == "float":
+        out += struct.pack("<f", float(v))
+    elif schema == "double":
+        out += struct.pack("<d", float(v))
+    elif schema == "string":
+        b = str(v).encode("utf-8")
+        out += _zigzag(len(b)) + b
+    elif schema == "bytes":
+        out += _zigzag(len(v)) + bytes(v)
+    else:
+        raise AvroDecodeError(f"unsupported write schema {schema!r}")
+
+
+def write_avro_file(path: str, schema: Dict[str, Any],
+                    records: List[Dict[str, Any]],
+                    codec: str = "null") -> None:
+    sync = b"\x00\x01\x02\x03\x04\x05\x06\x07\x08\x09\x0a\x0b\x0c\x0d\x0e\x0f"
+    out = bytearray()
+    out += _MAGIC
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec.encode()}
+    out += _zigzag(len(meta))
+    for k, v in meta.items():
+        kb = k.encode()
+        out += _zigzag(len(kb)) + kb + _zigzag(len(v)) + v
+    out += _zigzag(0)
+    out += sync
+    block = bytearray()
+    for r in records:
+        _encode(schema, r, block)
+    payload = bytes(block)
+    if codec == "deflate":
+        comp = zlib.compressobj(wbits=-15)
+        payload = comp.compress(payload) + comp.flush()
+    out += _zigzag(len(records)) + _zigzag(len(payload)) + payload + sync
+    with open(path, "wb") as f:
+        f.write(bytes(out))

@@ -1,0 +1,92 @@
+"""Streaming (micro-batch) readers for scoring.
+
+Reference: readers/.../StreamingReaders.scala:43-59 (`StreamingReaders
+.Simple.avro` — Spark DStreams of new avro files) and the StreamingScore
+run type (OpWorkflowRunner.scala:232). The DStream abstraction maps to a
+plain iterator of record batches; the fitted model scores each batch with
+its already-compiled layer programs, so scoring latency is one device step
+per batch.
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+from .readers import Reader
+
+Record = Dict[str, Any]
+
+
+class StreamingReader:
+    """Base: iterate record micro-batches."""
+
+    def __init__(self, key_fn: Optional[Callable[[Record], str]] = None):
+        self.key_fn = key_fn
+
+    def stream(self) -> Iterator[List[Record]]:
+        raise NotImplementedError
+
+
+class ListStreamingReader(StreamingReader):
+    """Batches from an in-memory sequence (testing / replay)."""
+
+    def __init__(self, records: Sequence[Record], batch_size: int = 100,
+                 key_fn: Optional[Callable[[Record], str]] = None):
+        super().__init__(key_fn)
+        self.records = list(records)
+        self.batch_size = int(batch_size)
+
+    def stream(self) -> Iterator[List[Record]]:
+        for i in range(0, len(self.records), self.batch_size):
+            yield self.records[i:i + self.batch_size]
+
+
+class FileStreamingReader(StreamingReader):
+    """One batch per new file matching a glob pattern, in mtime order
+    (the reference's 'new files in a directory' DStream source). `poll()`
+    re-scans and yields only unseen files, enabling tail-follow loops."""
+
+    def __init__(self, pattern: str, reader_factory: Callable[[str], Reader],
+                 key_fn: Optional[Callable[[Record], str]] = None):
+        super().__init__(key_fn)
+        self.pattern = pattern
+        self.reader_factory = reader_factory
+        self._seen: set = set()
+
+    def _paths(self) -> List[str]:
+        paths = [p for p in glob.glob(self.pattern) if p not in self._seen]
+        return sorted(paths, key=lambda p: (os.path.getmtime(p), p))
+
+    def stream(self) -> Iterator[List[Record]]:
+        for p in self._paths():
+            self._seen.add(p)
+            yield self.reader_factory(p).read()
+
+    def poll(self) -> List[List[Record]]:
+        return [batch for batch in self.stream()]
+
+
+class AvroStreamingReader(FileStreamingReader):
+    """Reference StreamingReaders.Simple.avro."""
+
+    def __init__(self, pattern: str,
+                 key_fn: Optional[Callable[[Record], str]] = None):
+        from .avro import AvroReader
+        super().__init__(pattern, lambda p: AvroReader(p), key_fn)
+
+
+class CSVStreamingReader(FileStreamingReader):
+    def __init__(self, pattern: str,
+                 key_fn: Optional[Callable[[Record], str]] = None):
+        from .readers import CSVReader
+        super().__init__(pattern, lambda p: CSVReader(p), key_fn)
+
+
+def score_stream(model, stream_reader: StreamingReader
+                 ) -> Iterator[List[Dict[str, Any]]]:
+    """Score every micro-batch with the fitted workflow's row function
+    (reference StreamingScore: per-batch scoreFn over the DStream)."""
+    fn = model.score_function()
+    for batch in stream_reader.stream():
+        yield [fn(r) for r in batch]

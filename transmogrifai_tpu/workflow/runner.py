@@ -151,6 +151,7 @@ class OpWorkflowRunner:
 
     TRAIN = "Train"
     SCORE = "Score"
+    STREAMING_SCORE = "StreamingScore"
     FEATURES = "Features"
     EVALUATE = "Evaluate"
 
@@ -201,6 +202,8 @@ class OpWorkflowRunner:
             out = self._train(params)
         elif run_type == self.SCORE:
             out = self._score(params)
+        elif run_type == self.STREAMING_SCORE:
+            out = self._streaming_score(params)
         elif run_type == self.FEATURES:
             out = self._features(params)
         elif run_type == self.EVALUATE:
@@ -253,6 +256,32 @@ class OpWorkflowRunner:
         with open(os.path.join(loc, "scores.jsonl"), "w") as f:
             for v in rows:
                 f.write(json.dumps(v, default=str) + "\n")
+
+    def _streaming_score(self, params: OpParams) -> ScoreResult:
+        """Reference StreamingScore:232 — per-batch scoring over a
+        StreamingReader (self.score_reader must be one)."""
+        from ..readers.streaming import StreamingReader, score_stream
+        if not isinstance(self.score_reader, StreamingReader):
+            raise ValueError("StreamingScore needs a StreamingReader as "
+                             "score_reader")
+        model = self._load_model(params)
+        loc = params.write_location
+        n = 0
+        out_f = None
+        if loc:
+            os.makedirs(loc, exist_ok=True)
+            out_f = open(os.path.join(loc, "scores.jsonl"), "a")
+        try:
+            for batch_scores in score_stream(model, self.score_reader):
+                n += len(batch_scores)
+                if out_f is not None:
+                    for s in batch_scores:
+                        out_f.write(json.dumps(s, default=str) + "\n")
+        finally:
+            if out_f is not None:
+                out_f.close()
+        return ScoreResult(run_type=self.STREAMING_SCORE, n_rows=n,
+                           write_location=loc)
 
     def _features(self, params: OpParams) -> FeaturesResult:
         """Reference Features run type: computeDataUpTo(feature, path)."""
