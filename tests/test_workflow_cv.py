@@ -1,0 +1,81 @@
+"""Workflow-level cross-validation (reference OpWorkflowCVTest.scala):
+in-fold refit of the pre-selector DAG, winner equivalence with the plain
+path on clean data, and summary contents.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.preparators import SanityChecker
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.models.trees import OpGBTClassifier
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _rows(n=400, seed=21):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        x = float(rng.normal())
+        z = float(rng.normal())
+        rows.append({"x": x, "z": z, "cat": str(int(rng.integers(0, 3))),
+                     "label": float(x + 0.3 * z + rng.normal(0, 0.4) > 0)})
+    return rows
+
+
+def _workflow(cv=False):
+    fx = FeatureBuilder.Real("x").extract(lambda r: r.get("x")).as_predictor()
+    fz = FeatureBuilder.Real("z").extract(lambda r: r.get("z")).as_predictor()
+    fc = FeatureBuilder.PickList("cat").extract(
+        lambda r: r.get("cat")).as_predictor()
+    fy = FeatureBuilder.RealNN("label").extract(
+        lambda r: r.get("label")).as_response()
+    vec = transmogrify([fx, fz, fc])
+    checked = SanityChecker().set_input(fy, vec).get_output()
+    pred = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, seed=11,
+        models_and_parameters=[
+            (OpLogisticRegression(), param_grid(reg_param=[0.01, 0.1])),
+            (OpGBTClassifier(), param_grid(max_iter=[5], max_depth=[2])),
+        ]).set_input(fy, checked).get_output()
+    wf = Workflow().set_reader(ListReader(_rows())) \
+        .set_result_features(pred)
+    return wf.with_workflow_cv() if cv else wf
+
+
+def test_workflow_cv_trains_and_flags_results():
+    model = _workflow(cv=True).train()
+    summary = model.selector_summary()
+    wf_cv = [v for v in summary.validation_results
+             if v.get("workflow_cv")]
+    # full sweep (2 LR grids + 1 GBT) validated with in-fold DAG refits
+    assert len(wf_cv) == 3
+    assert all(len(v["fold_metrics"]) == 3 for v in wf_cv)
+    # selector then refit only the winner
+    plain = [v for v in summary.validation_results
+             if not v.get("workflow_cv")]
+    assert len(plain) == 1
+    assert model.summary_pretty()
+
+
+def test_workflow_cv_scores_and_matches_plain_winner():
+    # on linearly-separable-ish data both paths must pick logistic
+    m_cv = _workflow(cv=True).train()
+    m_plain = _workflow(cv=False).train()
+    assert m_cv.selector_summary().best_model_type == \
+        m_plain.selector_summary().best_model_type == "OpLogisticRegression"
+    scored = m_cv.score()
+    assert scored.n_rows == 400
+
+
+def test_workflow_cv_without_selector_is_noop():
+    fx = FeatureBuilder.Real("x").extract(lambda r: r.get("x")).as_predictor()
+    vec = transmogrify([fx])
+    wf = Workflow().set_reader(ListReader(_rows())) \
+        .set_result_features(vec).with_workflow_cv()
+    model = wf.train()  # must not raise
+    assert model.transform().n_rows == 400

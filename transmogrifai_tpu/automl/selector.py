@@ -203,11 +203,15 @@ class ModelSelector(PredictorEstimator):
             best_model_name=best.name,
             best_model_type=type(best.estimator).__name__,
             best_grid=best.best_grid,
-            validation_results=[
-                {"model_name": v.model_name, "model_uid": v.model_uid,
-                 "grid": v.grid, "metric_name": v.metric_name,
-                 "fold_metrics": v.fold_metrics, "mean_metric": v.mean_metric}
-                for v in best.validated],
+            validation_results=(
+                # workflow-level CV results (leakage-free in-fold DAG refits,
+                # stashed by Workflow._run_workflow_cv) come first
+                list(getattr(self, "_extra_validation_results", []))
+                + [{"model_name": v.model_name, "model_uid": v.model_uid,
+                    "grid": v.grid, "metric_name": v.metric_name,
+                    "fold_metrics": v.fold_metrics,
+                    "mean_metric": v.mean_metric}
+                   for v in best.validated]),
             train_evaluation=train_eval,
             holdout_evaluation=holdout_eval,
         )

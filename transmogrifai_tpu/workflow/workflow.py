@@ -28,6 +28,27 @@ from .dag import (StagesDAG, collect_features, collect_raw_features,
 from .fitting import LayerRunner
 
 
+def _copy_dag(dag: StagesDAG) -> StagesDAG:
+    """Fresh unfitted copies of every stage, wiring (inputs, output names,
+    uids) preserved — used for per-fold refits in workflow-level CV."""
+    layers = []
+    for layer in dag.layers:
+        row = []
+        for st in layer:
+            c = st.copy()
+            c.uid = st.uid
+            c.set_output_name(st.output_name())
+            row.append(c)
+        layers.append(row)
+    return StagesDAG(layers=layers)
+
+
+def _grid_key(g: Dict[str, Any]) -> str:
+    import json
+    return json.dumps({k: g[k] for k in sorted(g)}, sort_keys=True,
+                      default=str)
+
+
 class Workflow:
     """Assembles the stage DAG from result features and trains it."""
 
@@ -87,12 +108,26 @@ class Workflow:
             ds = result.cleaned
         return ds
 
+    def with_workflow_cv(self) -> "Workflow":
+        """Leakage-free workflow-level CV (reference OpWorkflowCore
+        .withWorkflowCV:104): every estimator between the first fitted
+        statistic and the model selector is REFIT inside each fold, so no
+        fold's validation rows leak into upstream vectorizer/sanity-checker
+        statistics."""
+        self._workflow_cv = True
+        return self
+
     # -- training ----------------------------------------------------------
     def train(self) -> "WorkflowModel":
         raw_data = self.generate_raw_data()
         dag = compute_dag(self._result_features)
         validate_stages(dag)
         runner = LayerRunner()
+        if getattr(self, "_workflow_cv", False):
+            from .dag import cut_dag
+            cut = cut_dag(dag)
+            if cut.model_selector is not None:
+                self._run_workflow_cv(raw_data, cut, runner)
         transformed, fitted_dag = runner.fit_dag(raw_data, dag)
         model = WorkflowModel(
             result_features=self._result_features,
@@ -105,6 +140,67 @@ class Workflow:
         model._train_data = transformed
         model._reader = self._reader
         return model
+
+    def _run_workflow_cv(self, raw_data: Dataset, cut, runner) -> None:
+        """Reference ModelSelector.findBestEstimator:112 + OpValidator
+        .applyDAG:228: per fold, refit the in-CV ('during') DAG on the fold's
+        train rows only, transform both halves with those fold-fitted stages,
+        then score every (model x grid) cell. The winning config replaces the
+        selector's candidate list before the normal full fit; the full sweep
+        results are stashed for the ModelSelectorSummary."""
+        from ..models.base import _as_labels, _as_matrix
+        from ..models.prediction import make_prediction_column
+
+        sel = cut.model_selector
+        ds1, _ = runner.fit_dag(raw_data, cut.before)
+        label_name, vec_name = sel.input_names()
+        y = _as_labels(ds1.column(label_name))
+        masks = sel.validator.fold_masks(y)
+        evaluator = sel.validator.evaluator
+        metric = evaluator.default_metric
+        larger = evaluator.is_larger_better()
+
+        cells: Dict[tuple, List[float]] = {}
+        for f in range(masks.shape[0]):
+            tr = np.flatnonzero(masks[f] > 0)
+            va = np.flatnonzero(masks[f] <= 0)
+            # in-fold refit of the during-DAG (fresh copies per fold so the
+            # real stages stay unfitted for the final full fit)
+            fold_runner = type(runner)()
+            during_copy = _copy_dag(cut.during)
+            ds_tr, fitted_during = fold_runner.fit_dag(ds1.take(tr),
+                                                       during_copy)
+            ds_va = fold_runner.apply_dag(ds1.take(va), fitted_during)
+            Xtr = _as_matrix(ds_tr.column(vec_name))
+            Xva = _as_matrix(ds_va.column(vec_name))
+            ytr, yva = y[tr], y[va]
+            for mi, (est, grids) in enumerate(sel.models):
+                for g in (grids or [dict()]):
+                    model = est.copy(**g).fit_arrays(Xtr, ytr)
+                    pred, raw_p, prob = model.predict_arrays(Xva)
+                    col = make_prediction_column(pred, raw_p, prob)
+                    cells.setdefault(
+                        (mi, _grid_key(g)),
+                        []).append(evaluator.evaluate(yva, col,
+                                                      np.ones(len(yva),
+                                                              np.float32)))
+        means = {k: float(np.mean(v)) for k, v in cells.items()}
+        best_key = (max if larger else min)(means, key=means.get)
+        mi, _ = best_key
+        winner_est, winner_grids = sel.models[mi]
+        best_grid = next(g for g in (winner_grids or [dict()])
+                         if _grid_key(g) == best_key[1])
+        # stash the full sweep for the summary, narrow the selector to the
+        # winner (reference refits the winner on the full prepared data)
+        sel._extra_validation_results = [
+            {"model_name": type(sel.models[k[0]][0]).__name__,
+             "model_uid": sel.models[k[0]][0].uid,
+             "grid": dict(next(g for g in (sel.models[k[0]][1] or [dict()])
+                               if _grid_key(g) == k[1])),
+             "metric_name": metric, "fold_metrics": v,
+             "mean_metric": means[k], "workflow_cv": True}
+            for k, v in cells.items()]
+        sel.models = [(winner_est.copy(**best_grid), [dict(best_grid)])]
 
     def compute_data_up_to(self, feature: Feature) -> Dataset:
         """Materialize the DAG only up to `feature` (reference
