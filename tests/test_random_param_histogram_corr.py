@@ -1,0 +1,109 @@
+"""RandomParamBuilder + StreamingHistogram + RecordInsightsCorr."""
+import json
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.automl import RandomParamBuilder
+from transmogrifai_tpu.utils.streaming_histogram import StreamingHistogram
+
+
+class TestRandomParamBuilder:
+    def test_domains_and_reproducibility(self):
+        def build(seed):
+            return (RandomParamBuilder(seed)
+                    .uniform("step_size", 0.01, 0.3)
+                    .exponential("reg_param", 1e-6, 1.0)
+                    .uniform_int("max_depth", 3, 12)
+                    .subset("impurity", ["gini", "entropy"])
+                    .build(25))
+        grids = build(3)
+        assert len(grids) == 25
+        for g in grids:
+            assert 0.01 <= g["step_size"] <= 0.3
+            assert 1e-6 <= g["reg_param"] <= 1.0
+            assert 3 <= g["max_depth"] <= 12
+            assert g["impurity"] in ("gini", "entropy")
+        assert grids == build(3)           # seeded
+        assert grids != build(4)
+        # log-uniform spreads across decades
+        regs = [g["reg_param"] for g in grids]
+        assert min(regs) < 1e-3 and max(regs) > 1e-2
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            RandomParamBuilder().exponential("x", 0.0, 1.0)
+        with pytest.raises(ValueError):
+            RandomParamBuilder().uniform("x", 2.0, 1.0)
+        with pytest.raises(ValueError):
+            RandomParamBuilder().subset("x", [])
+
+    def test_feeds_selector(self):
+        from transmogrifai_tpu.automl import (
+            BinaryClassificationModelSelector)
+        from transmogrifai_tpu.models.glm import OpLogisticRegression
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        grids = RandomParamBuilder(1).exponential(
+            "reg_param", 1e-4, 1.0).build(5)
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=[(OpLogisticRegression(), grids)])
+        best = sel.fit_arrays(X, y)
+        assert len(best.summary.validation_results) == 5
+
+
+class TestStreamingHistogram:
+    def test_capacity_and_mass(self):
+        h = StreamingHistogram(max_bins=8)
+        rng = np.random.default_rng(0)
+        vals = rng.normal(size=5000)
+        h.update_all(vals)
+        assert len(h.bins()) <= 8
+        assert h.total() == pytest.approx(5000)
+
+    def test_quantiles_close_to_exact(self):
+        rng = np.random.default_rng(1)
+        vals = rng.normal(size=20000)
+        h = StreamingHistogram(max_bins=64).update_all(vals)
+        for q in (0.1, 0.5, 0.9):
+            assert abs(h.quantile(q) - np.quantile(vals, q)) < 0.12
+
+    def test_merge_equals_union(self):
+        rng = np.random.default_rng(2)
+        a, b = rng.normal(size=3000), rng.normal(3, 1, size=3000)
+        ha = StreamingHistogram(32).update_all(a)
+        hb = StreamingHistogram(32).update_all(b)
+        hm = ha.merge(hb)
+        hu = StreamingHistogram(32).update_all(np.concatenate([a, b]))
+        assert hm.total() == pytest.approx(6000)
+        assert abs(hm.quantile(0.5) - hu.quantile(0.5)) < 0.25
+
+    def test_sum_to_monotone(self):
+        h = StreamingHistogram(16).update_all([1, 2, 2, 3, 5, 8, 13])
+        xs = np.linspace(0, 14, 50)
+        sums = [h.sum_to(x) for x in xs]
+        assert (np.diff(sums) >= -1e-9).all()
+        assert sums[-1] == pytest.approx(7)
+
+
+class TestRecordInsightsCorr:
+    def test_corr_insights_rank_causal_column(self):
+        from transmogrifai_tpu.data.dataset import Column
+        from transmogrifai_tpu.insights import RecordInsightsCorr
+        from transmogrifai_tpu.models.prediction import (
+            make_prediction_column)
+        from transmogrifai_tpu.types import ColumnKind
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(200, 3)).astype(np.float32)
+        score = 1 / (1 + np.exp(-2 * X[:, 1]))           # column 1 drives it
+        pred_col = make_prediction_column(
+            (score > 0.5).astype(np.float32),
+            np.stack([-score, score], 1),
+            np.stack([1 - score, score], 1))
+        vec_col = Column(kind=ColumnKind.VECTOR, data=X)
+        out = RecordInsightsCorr(top_k=1).transform_columns(vec_col, pred_col)
+        top_cols = [list(v)[0] for v in out.data]
+        assert sum(1 for t in top_cols if t == "f1") > 120  # column 1 wins
+        payload = json.loads(out.data[0][top_cols[0]])
+        assert set(payload) == {"contribution", "correlation"}

@@ -1,0 +1,109 @@
+"""Aggregate / conditional / joined readers.
+
+Mirrors reference suites readers/src/test/.../DataReadersTest,
+JoinedDataReaderDataTest: monoid aggregation per key with cutoff times,
+two-pass conditional aggregation, key joins.
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.readers.readers import (
+    AggregateReader, ConditionalReader, JoinedReader, ListReader, KEY_COLUMN)
+
+
+EVENTS = [
+    # user, t, amount, kind
+    {"user": "a", "t": 1, "amount": 10.0, "kind": "buy"},
+    {"user": "a", "t": 2, "amount": 5.0, "kind": "view"},
+    {"user": "a", "t": 9, "amount": 100.0, "kind": "buy"},   # after cutoff
+    {"user": "b", "t": 3, "amount": 7.0, "kind": "view"},
+    {"user": "b", "t": 4, "amount": 3.0, "kind": "buy"},
+    {"user": "b", "t": 6, "amount": 2.0, "kind": "view"},
+]
+
+
+def _features(cutoff_response=False):
+    amount = FeatureBuilder.Real("amount").extract(
+        lambda r: r.get("amount")).aggregate("sum").as_predictor()
+    last_kind = FeatureBuilder.PickList("kind").extract(
+        lambda r: r.get("kind")).aggregate("last").as_predictor()
+    return amount, last_kind
+
+
+class TestAggregateReader:
+    def test_sum_and_last_with_cutoff(self):
+        amount, last_kind = _features()
+        reader = AggregateReader(ListReader(EVENTS),
+                                 key_fn=lambda r: r["user"],
+                                 cutoff_time=8,
+                                 event_time_fn=lambda r: r["t"])
+        ds = reader.generate_dataset([amount, last_kind])
+        assert ds.n_rows == 2  # one row per user
+        keys = list(ds.column(KEY_COLUMN).data)
+        i_a, i_b = keys.index("a"), keys.index("b")
+        # events at t>=8 excluded for predictors
+        assert ds.column("amount").data[i_a] == pytest.approx(15.0)
+        assert ds.column("amount").data[i_b] == pytest.approx(12.0)
+        assert ds.column("kind").data[i_a] == "view"   # last before cutoff
+        assert ds.column("kind").data[i_b] == "view"
+
+
+class TestConditionalReader:
+    def test_predictors_before_responses_after_condition(self):
+        # condition: first 'buy' event sets the per-key clock
+        amount = FeatureBuilder.Real("amount").extract(
+            lambda r: r.get("amount")).aggregate("sum").as_predictor()
+        spent_after = FeatureBuilder.Real("amount").extract(
+            lambda r: r.get("amount")).aggregate("sum").as_response()
+        spent_after = FeatureBuilder.RealNN("after").extract(
+            lambda r: r.get("amount")).aggregate("sum").as_response()
+        reader = ConditionalReader(
+            ListReader(EVENTS), key_fn=lambda r: r["user"],
+            condition_fn=lambda r: r["kind"] == "buy",
+            event_time_fn=lambda r: r["t"])
+        ds = reader.generate_dataset([amount, spent_after])
+        keys = list(ds.column(KEY_COLUMN).data)
+        i_a, i_b = keys.index("a"), keys.index("b")
+        # user a: first buy at t=1 -> predictors at/before t=1: the buy
+        assert ds.column("amount").data[i_a] == pytest.approx(10.0)
+        # responses strictly after t=1: 5 + 100
+        assert ds.column("after").data[i_a] == pytest.approx(105.0)
+        # user b: first buy at t=4 -> predictors 7+3, response t=6 only
+        assert ds.column("amount").data[i_b] == pytest.approx(10.0)
+        assert ds.column("after").data[i_b] == pytest.approx(2.0)
+
+    def test_drop_keys_without_condition(self):
+        events = EVENTS + [{"user": "c", "t": 1, "amount": 1.0,
+                            "kind": "view"}]
+        amount = FeatureBuilder.Real("amount").extract(
+            lambda r: r.get("amount")).aggregate("sum").as_predictor()
+        reader = ConditionalReader(
+            ListReader(events), key_fn=lambda r: r["user"],
+            condition_fn=lambda r: r["kind"] == "buy",
+            event_time_fn=lambda r: r["t"])
+        ds = reader.generate_dataset([amount])
+        assert "c" not in set(ds.column(KEY_COLUMN).data)
+
+
+class TestJoinedReader:
+    def test_key_join(self):
+        users = [{"uid": "a", "plan": "pro"}, {"uid": "b", "plan": "free"}]
+        plan = FeatureBuilder.PickList("plan").extract(
+            lambda r: r.get("plan")).as_predictor()
+        amount, _ = _features()
+        left = AggregateReader(ListReader(EVENTS),
+                               key_fn=lambda r: r["user"],
+                               event_time_fn=lambda r: r["t"])
+        right = ListReader(users, key_fn=lambda r: r["uid"])
+        joined = JoinedReader(left, right,
+                              left_features=["amount"],
+                              right_features=["plan"])
+        ds = joined.generate_dataset([amount, plan])
+        assert ds.n_rows == 2
+        keys = list(ds.column(KEY_COLUMN).data)
+        i_a = keys.index("a")
+        assert ds.column("plan").data[i_a] == "pro"
+        assert ds.column("amount").data[i_a] == pytest.approx(115.0)
+        i_b = keys.index("b")
+        assert ds.column("plan").data[i_b] == "free"

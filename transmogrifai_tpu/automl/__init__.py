@@ -8,6 +8,7 @@ from .preparators import (
     SanityCheckerModel,
     SanityCheckerSummary,
 )
+from .random_param import RandomParamBuilder
 from .selector import ModelSelector, ModelSelectorSummary, SelectedModel
 from .selectors import (
     BinaryClassificationModelSelector,
@@ -43,6 +44,7 @@ __all__ = [
     "DataSplitter",
     "DefaultSelectorParams",
     "ModelSelector",
+    "RandomParamBuilder",
     "ModelSelectorSummary",
     "MultiClassificationModelSelector",
     "RegressionModelSelector",

@@ -77,6 +77,38 @@ def _max_option(a, b):
     return max(a, b)
 
 
+def _last_option(a, b):
+    return b if b is not None else a
+
+
+def _first_option(a, b):
+    return a if a is not None else b
+
+
+def named_aggregator(name: str, type_cls: Type[FeatureType]
+                     ) -> MonoidAggregator:
+    """Named default monoids (reference MonoidAggregatorDefaults named
+    aggregators): sum/min/max/last/first/union."""
+    if name == "sum":
+        return MonoidAggregator(lambda: None, _sum_option)
+    if name == "min":
+        return MonoidAggregator(lambda: None, _min_option)
+    if name == "max":
+        return MonoidAggregator(lambda: None, _max_option)
+    if name == "last":
+        return MonoidAggregator(lambda: None, _last_option)
+    if name == "first":
+        return MonoidAggregator(lambda: None, _first_option)
+    if name == "union":
+        if issubclass(type_cls, OPSet):
+            return MonoidAggregator(lambda: set(), _union_set)
+        if issubclass(type_cls, OPMap):
+            return MonoidAggregator(lambda: {}, _union_map_last)
+        return MonoidAggregator(lambda: [], _union_list)
+    raise ValueError(f"Unknown aggregator name {name!r} "
+                     f"(sum|min|max|last|first|union)")
+
+
 class MonoidAggregatorDefaults:
     """Default aggregator per feature type (reference
     MonoidAggregatorDefaults.scala:41): numerics sum, booleans OR, text

