@@ -1,0 +1,24 @@
+import time
+import numpy as np, jax, jax.numpy as jnp
+from transmogrifai_tpu.ops import trees as T
+for N in (100_000, 1_000_000):
+    F, B, D, R = 64, 32, 6, 20
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(N, F)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    Xd = jax.device_put(jnp.asarray(X)); yd = jax.device_put(jnp.asarray(y))
+    w = jnp.ones(N, jnp.float32)
+    t0 = time.time()
+    edges = T.quantile_edges(Xd, B); Xb = T.bin_matrix(Xd, edges); Xb.block_until_ready()
+    t_bin = time.time() - t0
+    times = []
+    for trial in range(3):
+        key = jax.random.PRNGKey(trial)
+        t0 = time.time()
+        trees, base = T.fit_gbt(Xb, yd, w, key, n_rounds=R, depth=D, n_bins=B,
+                                learning_rate=0.1, loss="logistic")
+        s = float(np.asarray(trees.leaf).sum())
+        times.append(time.time()-t0)
+    margin = float(base) + np.asarray(T.predict_forest_bins(trees, Xb, D))[:, 0]
+    acc = ((margin > 0) == (y > 0.5)).mean()
+    print(f"N={N}: bin={t_bin:.2f}s fit times={['%.3f' % t for t in times]} acc={acc:.4f}")

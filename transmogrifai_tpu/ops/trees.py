@@ -49,12 +49,21 @@ class Tree(NamedTuple):
 
 # -- binning ----------------------------------------------------------------
 
+_QUANTILE_SAMPLE = 131_072
+
+
 def quantile_edges(X: jax.Array, n_bins: int) -> jax.Array:
     """Per-feature quantile bin edges.
 
     X: [n, d] -> edges [d, n_bins - 1], ascending per feature. Constant
     features produce repeated edges (empty bins; zero split gain — harmless).
+    Rows are strided-sampled above _QUANTILE_SAMPLE — the XGBoost `hist`
+    approximation — so the sort stays cheap at 10M+ rows.
     """
+    n = X.shape[0]
+    if n > _QUANTILE_SAMPLE:
+        stride = -(-n // _QUANTILE_SAMPLE)  # ceil
+        X = X[::stride]
     qs = jnp.arange(1, n_bins, dtype=jnp.float32) / n_bins
     edges = jnp.quantile(X, qs, axis=0)          # [n_bins-1, d]
     return jnp.asarray(edges.T, jnp.float32)     # [d, n_bins-1]
@@ -128,6 +137,53 @@ def _feature_mask(key: jax.Array, n_nodes: int, n_feat: int,
     return scores <= kth
 
 
+def _histograms_segment(Xb, G, H, count_unit, node, n_nodes: int, B: int):
+    """One fused segment-sum over node*F*B ids (CPU/GPU path; under row
+    sharding the partial sums all-reduce — the Rabit-allreduce slot)."""
+    N, F = Xb.shape
+    K = G.shape[1]
+    ids = (node[:, None] * (F * B)
+           + jnp.arange(F, dtype=jnp.int32)[None, :] * B + Xb)  # [N, F]
+    ids_f = ids.reshape(-1)
+    seg = n_nodes * F * B
+    hg = jax.ops.segment_sum(
+        jnp.broadcast_to(G[:, None, :], (N, F, K)).reshape(-1, K),
+        ids_f, num_segments=seg).reshape(n_nodes, F, B, K)
+    hh = jax.ops.segment_sum(
+        jnp.broadcast_to(H[:, None], (N, F)).reshape(-1),
+        ids_f, num_segments=seg).reshape(n_nodes, F, B)
+    hc = jax.ops.segment_sum(
+        jnp.broadcast_to(count_unit[:, None], (N, F)).reshape(-1),
+        ids_f, num_segments=seg).reshape(n_nodes, F, B)
+    return hg, hh, hc
+
+
+def _histograms_matmul(Xb, G, H, count_unit, node, n_nodes: int, B: int):
+    """Histograms as dense MXU contractions (TPU path — scatter-free).
+
+    Fold (node one-hot x payload channels) into Q [N, n_nodes*C], then for
+    each bin b contract Q^T @ (Xb == b) -> [n_nodes*C, F]. All FLOPs land
+    on the systolic array; the bin loop is a lax.map of B matmuls.
+    """
+    N, F = Xb.shape
+    K = G.shape[1]
+    C = K + 2
+    node_oh = jax.nn.one_hot(node, n_nodes, dtype=jnp.float32)   # [N, nodes]
+    P = jnp.concatenate([G, H[:, None], count_unit[:, None]], axis=1)
+    Q = (node_oh[:, :, None] * P[:, None, :]).reshape(N, n_nodes * C)
+
+    def per_bin(b):
+        mask = (Xb == b).astype(jnp.float32)                     # [N, F]
+        return Q.T @ mask                                        # [nodes*C, F]
+
+    hist = jax.lax.map(per_bin, jnp.arange(B, dtype=jnp.int32))  # [B, nC, F]
+    hist = hist.transpose(1, 2, 0).reshape(n_nodes, C, F, B)
+    hg = hist[:, :K].transpose(0, 2, 3, 1)                       # [n,F,B,K]
+    hh = hist[:, K]                                              # [n,F,B]
+    hc = hist[:, K + 1]
+    return hg, hh, hc
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("depth", "n_bins", "leaf_mode", "feature_frac",
@@ -156,25 +212,20 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
     B = n_bins
     rows = jnp.arange(N)
     count_unit = jnp.asarray(H > 0, jnp.float32)
+    # TPU: histograms as MXU matmuls (scatter lowers poorly there);
+    # CPU/GPU: one fused segment-sum. Identical results either way.
+    use_matmul = jax.default_backend() == "tpu"
 
     node = jnp.zeros(N, jnp.int32)   # in-level relative node id
     feats, threshs = [], []
     for d in range(depth):
         n_nodes = 1 << d
-        # -- histograms: one fused segment-sum over node*F*B ids ------------
-        ids = (node[:, None] * (F * B)
-               + jnp.arange(F, dtype=jnp.int32)[None, :] * B + Xb)  # [N, F]
-        ids_f = ids.reshape(-1)
-        seg = n_nodes * F * B
-        hg = jax.ops.segment_sum(
-            jnp.broadcast_to(G[:, None, :], (N, F, K)).reshape(-1, K),
-            ids_f, num_segments=seg).reshape(n_nodes, F, B, K)
-        hh = jax.ops.segment_sum(
-            jnp.broadcast_to(H[:, None], (N, F)).reshape(-1),
-            ids_f, num_segments=seg).reshape(n_nodes, F, B)
-        hc = jax.ops.segment_sum(
-            jnp.broadcast_to(count_unit[:, None], (N, F)).reshape(-1),
-            ids_f, num_segments=seg).reshape(n_nodes, F, B)
+        if use_matmul:
+            hg, hh, hc = _histograms_matmul(Xb, G, H, count_unit, node,
+                                            n_nodes, B)
+        else:
+            hg, hh, hc = _histograms_segment(Xb, G, H, count_unit, node,
+                                             n_nodes, B)
 
         GL = jnp.cumsum(hg, axis=2)
         HL = jnp.cumsum(hh, axis=2)
