@@ -17,6 +17,9 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -25,8 +28,34 @@ N_ROWS = 1_000_000
 N_COLS = 64
 FOLDS = 5
 GRID = 16
+CPU_FALLBACK_ROWS = 100_000  # reduced size when the TPU tunnel is down
 BASELINE_SUB = 50_000  # numpy baseline row subsample (scaled up linearly)
 NEWTON_ITERS = 15
+PROBE_TIMEOUT_S = 90  # first TPU backend init can be slow; hang = tunnel down
+
+
+def probe_backend(timeout=PROBE_TIMEOUT_S, retries=1):
+    """Initialize the jax backend in a SUBPROCESS with a hard timeout.
+
+    Round-1 failure mode: this environment's sitecustomize dials a TPU
+    tunnel on first backend init; when the tunnel is down, init either hangs
+    forever (MULTICHIP_r01 rc=124) or raises (BENCH_r01 rc=1). Probing in a
+    killable child process means the bench itself can never hang, and a
+    failed probe downgrades to the CPU backend instead of producing nothing.
+    """
+    code = "import jax; print('BACKEND=' + jax.default_backend())"
+    for _ in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            continue
+        if r.returncode == 0:
+            for line in r.stdout.splitlines():
+                if line.startswith("BACKEND="):
+                    return line.split("=", 1)[1]
+    return None
 
 
 def make_data(n, d, seed=0):
@@ -114,16 +143,43 @@ def baseline_sweep_seconds(X, y, masks, regs):
 
 
 def main():
-    X, y, masks, regs = make_data(N_ROWS, N_COLS)
+    backend = probe_backend()
+    error = None
+    n_rows = N_ROWS
+    if backend is None or backend == "cpu":
+        # TPU tunnel down (or image has no accelerator): run the same sweep
+        # on the CPU backend at reduced size so a perf number is ALWAYS
+        # recorded. Forcing the platform before first backend init avoids
+        # the hanging axon dial entirely.
+        from transmogrifai_tpu.utils.platform import force_cpu
+
+        force_cpu(1)
+        if backend is None:
+            error = "tpu backend unreachable; cpu fallback at reduced size"
+        backend = "cpu"
+        n_rows = CPU_FALLBACK_ROWS
+
+    X, y, masks, regs = make_data(n_rows, N_COLS)
     dev_s, aupr = device_sweep_seconds(X, y, masks, regs)
     base_s = baseline_sweep_seconds(X, y, masks, regs)
-    print(json.dumps({
-        "metric": f"cv_sweep_{N_ROWS//1000}k_rows_{FOLDS}x{GRID}_wall",
+    out = {
+        "metric": f"cv_sweep_{n_rows//1000}k_rows_{FOLDS}x{GRID}_wall",
         "value": round(dev_s, 4),
         "unit": "s",
         "vs_baseline": round(base_s / dev_s, 2),
-    }))
+        "backend": backend,
+        "au_pr": round(aupr, 4),
+    }
+    if error:
+        out["error"] = error
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # never exit without a parseable JSON line
+        print(json.dumps({
+            "metric": "cv_sweep_wall", "value": -1.0, "unit": "s",
+            "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"}))
+        sys.exit(0)  # the error field conveys failure; keep rc parseable-green

@@ -10,15 +10,13 @@ with JAX_PLATFORMS=axon (the TPU tunnel), so env vars set here are too late —
 we must update the live jax config instead, before any backend initializes.
 """
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from transmogrifai_tpu.utils.platform import force_cpu  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

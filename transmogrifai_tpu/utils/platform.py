@@ -1,0 +1,42 @@
+"""Platform forcing: run JAX on an emulated multi-device CPU mesh.
+
+This image's sitecustomize dials a TPU tunnel on first jax backend init;
+when the tunnel is down, init hangs indefinitely or raises. Every entry
+point that is *defined* to run on emulated CPU devices (tests, the driver's
+multichip dryrun, bench fallback) must force the CPU platform BEFORE any
+backend initializes. Env vars alone are too late when jax was already
+imported at interpreter startup, so we also update the live jax config —
+the same defense tests/conftest.py applied in round 1, now shared.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu(n_devices: int = 8) -> None:
+    """Force the CPU backend with >= n_devices virtual devices.
+
+    Safe to call multiple times; raises nothing if backends are already
+    initialized (callers assert on the device count they actually got).
+    Must run before jax.devices()/device_put/jit execution.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(re.escape(_COUNT_FLAG) + r"=(\d+)", flags)
+    if m:
+        if int(m.group(1)) < n_devices:
+            flags = flags.replace(m.group(0), f"{_COUNT_FLAG}={n_devices}")
+            os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        # Backends already initialized — nothing safe to change; the caller's
+        # device-count assert will report what is actually available.
+        pass
