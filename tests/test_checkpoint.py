@@ -29,6 +29,19 @@ def test_key_stability_and_sensitivity():
     assert k1 != sweep_key("M", {"a": 1, "b": 2}, 5, 42, False, "au_pr")
 
 
+def test_key_invalidates_on_data_or_base_param_change():
+    from transmogrifai_tpu.automl.tuning.checkpoint import data_fingerprint
+    X1, y1 = _data(seed=0)
+    X2, y2 = _data(seed=1)
+    fp1, fp2 = data_fingerprint(X1, y1), data_fingerprint(X2, y2)
+    assert fp1 != fp2
+    assert fp1 == data_fingerprint(X1.copy(), y1.copy())  # content-stable
+    k1 = sweep_key("M", {"a": 1}, 3, 42, False, "au_pr", data_fp=fp1)
+    assert k1 != sweep_key("M", {"a": 1}, 3, 42, False, "au_pr", data_fp=fp2)
+    assert k1 != sweep_key("M", {"a": 1}, 3, 42, False, "au_pr", data_fp=fp1,
+                           base_params={"max_depth": 6})
+
+
 def test_checkpoint_append_and_reload(tmp_path):
     path = str(tmp_path / "sweep.jsonl")
     c = SweepCheckpoint(path)

@@ -73,6 +73,15 @@ class TestCSV:
         expected = list(pycsv.reader(io.StringIO(text)))
         assert native == expected
 
+    def test_csv_non_ascii_utf8(self):
+        # regression: field bounds are BYTE offsets; multi-byte characters
+        # must not shift later fields (José is 5 bytes / 4 chars)
+        text = ('name,city,score\nJosé,Köln,1.5\n"Fran ""çois""",東京,2\n'
+                'plain,row,3\n')
+        native = NB.native_csv_parse(text.encode("utf-8"))
+        expected = list(pycsv.reader(io.StringIO(text)))
+        assert native == expected
+
     def test_parse_floats(self):
         data = b"1.5,-2e3, ,abc,42"
         bounds = np.array([0, 3, 4, 8, 9, 10, 11, 14, 15, 17], np.int64)

@@ -43,6 +43,32 @@ def test_spearman_monotone(rng):
     assert rho[0] > 0.999
 
 
+def test_spearman_ties_match_scipy(rng):
+    # discrete columns (post-pivot indicators, small-integer counts) are the
+    # common case: average-rank tie handling must match scipy/Spark
+    from scipy import stats as sps
+    x = rng.integers(0, 4, size=500).astype(np.float32)       # heavy ties
+    y = (x + rng.integers(0, 3, size=500)).astype(np.float32)  # ties in label
+    rho = float(np.asarray(S.spearman_with_label(x[:, None], y))[0])
+    expect = sps.spearmanr(x, y).statistic
+    assert np.isclose(rho, expect, atol=1e-5)
+    # binary indicator vs binary label, the extreme tie case
+    b = (rng.uniform(size=500) < 0.3).astype(np.float32)
+    yb = np.where(rng.uniform(size=500) < 0.8, b, 1 - b).astype(np.float32)
+    rho_b = float(np.asarray(S.spearman_with_label(b[:, None], yb))[0])
+    assert np.isclose(rho_b, sps.spearmanr(b, yb).statistic, atol=1e-5)
+
+
+def test_stable_sigmoid_extremes():
+    from transmogrifai_tpu.models.base import stable_sigmoid
+    with np.errstate(over="raise"):  # must not overflow at +-1000
+        p = stable_sigmoid(np.array([-1000.0, -20.0, 0.0, 20.0, 1000.0],
+                                    np.float32))
+    assert p[0] == 0.0 and p[2] == 0.5 and p[4] == 1.0
+    assert np.isclose(p[1], np.float32(1 / (1 + np.exp(20.0))))
+    assert np.isclose(p[3], np.float32(1 / (1 + np.exp(-20.0))))
+
+
 def test_contingency_stats_known_values():
     # classic 2x2: perfect association
     t = np.array([[50.0, 0.0], [0.0, 50.0]])

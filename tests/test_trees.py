@@ -238,6 +238,34 @@ class TestServingParity:
             np.asarray(trees.feat), tv, np.asarray(trees.leaf), X, 3)[:, 0]
         assert np.allclose(binned, raw, atol=1e-5)
 
+    def test_nan_features_agree_between_binned_and_raw(self):
+        # NaN canonicalizes to -inf at binning (bin 0, goes left); raw
+        # serving's `x >= thresh` is False for NaN (also left) — train and
+        # serve must agree when a NaN escapes imputation
+        import jax
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(600, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        X[rng.uniform(size=600) < 0.15, 0] = np.nan
+        X[rng.uniform(size=600) < 0.1, 2] = np.nan
+        # quantile_edges sees the raw NaN matrix, same as models/trees._bin
+        edges = T.quantile_edges(jnp.asarray(X), 16)
+        assert np.isfinite(np.asarray(edges)[:, -1]).all()  # not NaN-poisoned
+        Xb = T.bin_matrix(jnp.asarray(X), edges)
+        trees, base = T.fit_gbt(Xb, jnp.asarray(y),
+                                jnp.ones(600, jnp.float32),
+                                jax.random.PRNGKey(1), n_rounds=4, depth=3,
+                                n_bins=16, learning_rate=0.3,
+                                loss="logistic")
+        binned = float(base) + np.asarray(
+            T.predict_forest_bins(trees, Xb, 3))[:, 0]
+        tv = np.asarray(T.thresholds_to_values(trees.feat, trees.thresh,
+                                               edges))
+        raw = float(base) + T.np_predict_ensemble(
+            np.asarray(trees.feat), tv, np.asarray(trees.leaf), X, 3)[:, 0]
+        assert np.isfinite(binned).all()
+        assert np.allclose(binned, raw, atol=1e-5)
+
 
 class TestPersistence:
     def test_tree_model_save_load_round_trip(self, tmp_path):

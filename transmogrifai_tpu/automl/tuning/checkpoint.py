@@ -19,12 +19,33 @@ import os
 from typing import Any, Dict, List, Optional
 
 
+def data_fingerprint(X, y) -> str:
+    """Cheap, stable fingerprint of the sweep's training data: shape plus a
+    hash of the label vector and a strided feature sample. Folded into
+    sweep_key so a checkpoint file reused after the data changes invalidates
+    instead of silently replaying stale fold metrics."""
+    import numpy as np
+
+    X = np.asarray(X)
+    y = np.asarray(y)
+    h = hashlib.sha256()
+    h.update(str(X.shape).encode())
+    h.update(np.ascontiguousarray(y[:65536]).tobytes())
+    stride = max(1, X.shape[0] // 1024)
+    h.update(np.ascontiguousarray(X[::stride][:1024]).tobytes())
+    return h.hexdigest()[:16]
+
+
 def sweep_key(model_class: str, grid: Dict[str, Any], n_folds: int,
-              seed: int, stratify: bool, metric: str) -> str:
+              seed: int, stratify: bool, metric: str,
+              data_fp: str = "", base_params: Optional[Dict[str, Any]] = None
+              ) -> str:
     payload = json.dumps(
         {"model": model_class, "grid": {k: grid[k] for k in sorted(grid)},
          "folds": n_folds, "seed": seed, "stratify": stratify,
-         "metric": metric},
+         "metric": metric, "data": data_fp,
+         "base": {k: base_params[k] for k in sorted(base_params)}
+         if base_params else {}},
         sort_keys=True, default=str)
     return hashlib.sha256(payload.encode()).hexdigest()[:24]
 

@@ -224,13 +224,17 @@ class Validator:
 
     def _validate_sequential(self, est, grids, X, y, w, masks
                              ) -> List[ValidatedModel]:
-        from .checkpoint import sweep_key
+        from .checkpoint import data_fingerprint, sweep_key
         metric = self.evaluator.default_metric
         ckpt = self._checkpoint()
+        data_fp = data_fingerprint(X, y) if ckpt is not None else ""
+        base_params = est.param_values() if hasattr(est, "param_values") \
+            else None
         out: List[ValidatedModel] = []
         for g in grids:
             key = sweep_key(type(est).__name__, g, masks.shape[0],
-                            self.seed, self.stratify, metric)
+                            self.seed, self.stratify, metric,
+                            data_fp=data_fp, base_params=base_params)
             if ckpt is not None:
                 done = ckpt.get(key)
                 if done is not None:

@@ -217,11 +217,22 @@ def extract_insights(model) -> ModelInsights:
                 variance=st.get("variance"),
                 mean=st.get("mean")))
 
-    # columns the SanityChecker dropped still deserve a line w/ reasons
+    # columns the SanityChecker dropped still deserve a line w/ reasons.
+    # Resolve each dropped column's parent from the checker's PRE-slice
+    # vector metadata — string-splitting the column name breaks for any raw
+    # feature whose name contains an underscore (e.g. 'pickup_time').
+    dropped_parent: Dict[str, str] = {}
+    if sc_summary is not None and sc_summary.dropped:
+        sc_stage = model._sanity_checker()
+        if sc_stage is not None and \
+                getattr(sc_stage, "metadata", None) is not None:
+            dropped_parent = {c.column_name(): c.parent_feature_name
+                              for c in sc_stage.metadata.columns}
     if sc_summary is not None:
         for dropped_col in sc_summary.dropped:
             reasons = sc_summary.drop_reasons.get(dropped_col, [])
-            parent = dropped_col.split("_")[0]
+            parent = dropped_parent.get(dropped_col,
+                                        dropped_col.split("_")[0])
             fi = features.setdefault(parent, FeatureInsights(parent))
             if fi.excluded_by is None and all(
                     d.column_name != dropped_col for d in fi.derived):
@@ -234,7 +245,7 @@ def extract_insights(model) -> ModelInsights:
                 fi.excluded_by = "SanityChecker"
                 fi.exclusion_reasons = sorted({
                     r for col in sc_summary.dropped
-                    if col.split("_")[0] == name
+                    if dropped_parent.get(col, col.split("_")[0]) == name
                     for r in sc_summary.drop_reasons.get(col, [])})
 
     # raw-feature-filter exclusions

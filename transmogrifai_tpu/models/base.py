@@ -19,6 +19,18 @@ from ..types import OPVector, Prediction, RealNN
 from .prediction import make_prediction_column, row_prediction
 
 
+def stable_sigmoid(x: np.ndarray) -> np.ndarray:
+    """Overflow-safe logistic: exp only ever sees non-positive arguments."""
+    x = np.asarray(x)
+    out = np.empty_like(
+        x, dtype=x.dtype if x.dtype.kind == "f" else np.float64)
+    pos = x >= 0
+    out[pos] = 1.0 / (1.0 + np.exp(-x[pos]))
+    ex = np.exp(x[~pos])
+    out[~pos] = ex / (1.0 + ex)
+    return out
+
+
 def _as_matrix(col: Column) -> np.ndarray:
     m = col.data
     if m.ndim == 1:

@@ -20,7 +20,7 @@ import numpy as np
 
 from ..ops import glm as G
 from ..stages.params import Param
-from .base import PredictionModel, PredictorEstimator
+from .base import PredictionModel, PredictorEstimator, stable_sigmoid
 
 
 # -- fitted models ---------------------------------------------------------
@@ -40,7 +40,7 @@ class LinearBinaryModel(PredictionModel):
         margin = X @ self.beta + self.intercept
         raw = np.stack([-margin, margin], axis=1)
         if self.probabilistic:
-            p1 = 1.0 / (1.0 + np.exp(-margin))
+            p1 = stable_sigmoid(margin)
             prob = np.stack([1.0 - p1, p1], axis=1)
             pred = (p1 >= 0.5).astype(np.float32)
         else:

@@ -21,7 +21,7 @@ import numpy as np
 from ..stages.base import Estimator, Transformer
 from ..stages.params import Param
 from ..types import RealNN
-from .base import PredictionModel, PredictorEstimator
+from .base import PredictionModel, PredictorEstimator, stable_sigmoid
 from .glm import SoftmaxModel
 
 
@@ -91,7 +91,7 @@ class MLPModel(PredictionModel):
     def predict_arrays(self, X):
         h = np.asarray(X, np.float32)
         for w, b in zip(self.weights[:-1], self.biases[:-1]):
-            h = 1.0 / (1.0 + np.exp(-(h @ w + b)))
+            h = stable_sigmoid(h @ w + b)
         logits = h @ self.weights[-1] + self.biases[-1]
         m = logits.max(axis=1, keepdims=True)
         e = np.exp(logits - m)

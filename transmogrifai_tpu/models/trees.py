@@ -22,7 +22,7 @@ import numpy as np
 
 from ..ops import trees as T
 from ..stages.params import Param
-from .base import PredictionModel, PredictorEstimator
+from .base import PredictionModel, PredictorEstimator, stable_sigmoid
 
 
 def _softmax_np(raw: np.ndarray) -> np.ndarray:
@@ -70,7 +70,7 @@ class TreeEnsembleModel(PredictionModel):
             return pred, agg, prob
         if self.mode == "margin":
             margin = agg[:, 0] + self.base
-            p1 = 1.0 / (1.0 + np.exp(-margin))
+            p1 = stable_sigmoid(margin)
             prob = np.stack([1.0 - p1, p1], axis=1)
             raw = np.stack([-margin, margin], axis=1)
             return (p1 >= 0.5).astype(np.float32), raw, prob

@@ -163,7 +163,18 @@ def native_csv_parse(data: bytes, delim: str = ","
                            max_rows, _as_i64p(n_rows))
     if nf < 0:
         return None
-    text = data.decode("utf-8", errors="replace")
+    # bounds are BYTE offsets from the C scanner. Pure-ASCII buffers (the
+    # common case) decode once and slice the str — byte and char offsets
+    # coincide. Any non-ASCII byte forces per-field byte slicing: slicing a
+    # decoded str with byte offsets would shift every later field.
+    ascii_fast = data.isascii()
+    text = data.decode("utf-8", errors="replace") if ascii_fast else ""
+
+    def field(s: int, e: int) -> str:
+        if ascii_fast:
+            return text[s:e]
+        return data[s:e].decode("utf-8", errors="replace")
+
     rows: List[List[str]] = []
     f = 0
     for r in range(int(n_rows[0])):
@@ -173,9 +184,9 @@ def native_csv_parse(data: bytes, delim: str = ","
             s, e = int(bounds[2 * (f + j)]), int(bounds[2 * (f + j) + 1])
             if s < 0:  # doubled-quote field: unescape here
                 s = -s - 1
-                fields.append(text[s:e].replace('""', '"'))
+                fields.append(field(s, e).replace('""', '"'))
             else:
-                fields.append(text[s:e])
+                fields.append(field(s, e))
         rows.append(fields)
         f += cnt
     return rows

@@ -100,13 +100,23 @@ def pearson_matrix(X: jax.Array, w: Optional[jax.Array] = None) -> jax.Array:
 
 
 def _rank_with_nan(x: jax.Array, w: jax.Array) -> jax.Array:
-    """Average-tie-free ranks; NaN/pad rows get rank 0 weight anyway."""
+    """Average (tie-aware) ranks, scipy.stats.rankdata 'average' semantics.
+
+    Ties receive the mean of the positions they occupy — on discrete columns
+    (the common case post-pivot) arbitrary within-tie order would drift the
+    correlation away from Spark/scipy values, which feeds SanityChecker drop
+    decisions. Tied group bounds come from two searchsorteds over the sorted
+    values (XLA-friendly; no segment bookkeeping). NaN/pad rows rank NaN.
+    """
     n = x.shape[0]
     finite = jnp.isfinite(x) & (w > 0)
     xk = jnp.where(finite, x, jnp.inf)
     order = jnp.argsort(xk)
-    ranks = jnp.zeros((n,), x.dtype).at[order].set(
-        jnp.arange(1, n + 1, dtype=x.dtype))
+    xs = xk[order]
+    lo = jnp.searchsorted(xs, xs, side="left")    # first index of tie group
+    hi = jnp.searchsorted(xs, xs, side="right")   # one past last index
+    avg = (lo + hi + 1).astype(x.dtype) / 2.0     # mean of 1-based positions
+    ranks = jnp.zeros((n,), x.dtype).at[order].set(avg)
     return jnp.where(finite, ranks, jnp.nan)
 
 

@@ -64,6 +64,9 @@ def quantile_edges(X: jax.Array, n_bins: int) -> jax.Array:
     if n > _QUANTILE_SAMPLE:
         stride = -(-n // _QUANTILE_SAMPLE)  # ceil
         X = X[::stride]
+    # same NaN canonicalization as bin_matrix: a NaN row would otherwise
+    # poison jnp.quantile and turn EVERY edge of that feature into NaN
+    X = jnp.where(jnp.isnan(X), -jnp.inf, X)
     qs = jnp.arange(1, n_bins, dtype=jnp.float32) / n_bins
     edges = jnp.quantile(X, qs, axis=0)          # [n_bins-1, d]
     return jnp.asarray(edges.T, jnp.float32)     # [d, n_bins-1]
@@ -80,8 +83,14 @@ def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
     """
     def one(col, e):
         return jnp.searchsorted(e, col, side="right")
+    # canonicalize NaN to -inf so missing values land in bin 0 and go LEFT
+    # at every split — np_predict_ensemble's raw `x >= thresh` comparison is
+    # False for NaN (also left), keeping device training and host serving
+    # bit-identical when a NaN escapes imputation
+    Xf = jnp.asarray(X, jnp.float32)
+    Xf = jnp.where(jnp.isnan(Xf), -jnp.inf, Xf)
     return jax.vmap(one, in_axes=(1, 0), out_axes=1)(
-        jnp.asarray(X, jnp.float32), edges).astype(jnp.int32)
+        Xf, edges).astype(jnp.int32)
 
 
 def thresholds_to_values(feat: jax.Array, thresh: jax.Array,

@@ -30,6 +30,8 @@ class _Bin:
         self.pos = 0
 
     def read(self, n: int) -> bytes:
+        if n < 0:  # negative decoded length would rewind the cursor
+            raise AvroDecodeError("negative length in avro data")
         if self.pos + n > len(self.buf):
             raise AvroDecodeError("truncated avro data")
         out = self.buf[self.pos:self.pos + n]
@@ -44,12 +46,16 @@ class _Bin:
         shift = 0
         acc = 0
         while True:
+            if self.pos >= len(self.buf):
+                raise AvroDecodeError("truncated avro data")
             b = self.buf[self.pos]
             self.pos += 1
             acc |= (b & 0x7F) << shift
             if not (b & 0x80):
                 break
             shift += 7
+            if shift > 63:
+                raise AvroDecodeError("malformed varint (shift > 63)")
         return (acc >> 1) ^ -(acc & 1)
 
     def float_(self) -> float:
