@@ -54,15 +54,29 @@ class TestBatchHashing:
         np.testing.assert_array_equal(native, py)
 
     def test_fused_tokenizer_matches_python_pipeline(self):
+        # contract: the byte-level C tokenizer equals the unicode python
+        # analyzer on ASCII documents (the only inputs it is routed)
         from transmogrifai_tpu.transformers.text import tokenize_text
-        docs = ["The CAT sat on the mat!", None, "", "naïve café 123's",
-                "a,b;c  d\te"]
+        docs = ["The CAT sat on the mat!", None, "", "123's it's-fine",
+                "a,b;c  d\te", "under_score splits"]
         fused = NB.native_tokenize_hash_counts(docs, 64, seed=1, min_len=1)
         py = np.zeros((len(docs), 64))
         for i, d in enumerate(docs):
             for t in tokenize_text(d, 1, True, False):
                 py[i, hash_string(t, 64, 1)] += 1
         np.testing.assert_array_equal(fused, py)
+
+    def test_non_ascii_docs_route_to_unicode_python_path(self):
+        from transmogrifai_tpu.automl.vectorizers.text import (
+            tokenize, tokenize_hash_counts)
+        docs = ["naïve café crème", "北京 大学", None]
+        out = tokenize_hash_counts(docs, 32, seed=2)
+        py = np.zeros((len(docs), 32))
+        for i, d in enumerate(docs):
+            for t in tokenize(d):
+                py[i, hash_string(t, 32, 2)] += 1
+        np.testing.assert_array_equal(out, py)
+        assert out[1].sum() == 2.0  # unicode tokens kept, not dropped
 
 
 class TestCSV:

@@ -1,0 +1,141 @@
+"""LDA, Word2Vec, and NER stages (reference OpLDA.scala:60, OpWord2Vec.scala,
+NameEntityRecognizer.scala)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.data.dataset import Dataset, column_from_values
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.transformers.ner import NameEntityRecognizer, tag_tokens
+from transmogrifai_tpu.transformers.topics import (
+    OpLDA, OpLDAModel, OpWord2Vec, OpWord2VecModel)
+from transmogrifai_tpu.types import OPVector, Text, TextList
+
+
+def _topic_corpus(rng, n=120, v=30):
+    """Two planted topics over disjoint vocab halves."""
+    C = np.zeros((n, v), np.float32)
+    for i in range(n):
+        half = (0, v // 2) if i % 2 == 0 else (v // 2, v)
+        words = rng.integers(half[0], half[1], size=40)
+        np.add.at(C[i], words, 1.0)
+    return C
+
+
+class TestLDA:
+    def test_recovers_planted_topics(self, rng):
+        C = _topic_corpus(rng)
+        est = OpLDA(k=2, max_iter=80, seed=0)
+        col = column_from_values(OPVector, [OPVector(r) for r in C])
+        model = est.fit_columns(col)
+        theta = model.transform_block([col])
+        assert theta.shape == (len(C), 2)
+        assert np.allclose(theta.sum(axis=1), 1.0, atol=1e-4)
+        # even rows should concentrate on one topic, odd rows on the other
+        even = theta[::2].mean(axis=0)
+        odd = theta[1::2].mean(axis=0)
+        assert even.argmax() != odd.argmax()
+        assert even.max() > 0.8 and odd.max() > 0.8
+
+    def test_fold_in_matches_training_docs(self, rng):
+        C = _topic_corpus(rng)
+        col = column_from_values(OPVector, [OPVector(r) for r in C])
+        model = OpLDA(k=2, max_iter=80, seed=0).fit_columns(col)
+        # transforming the training docs should produce consistent assignment
+        t1 = model.transform_block([col])
+        t2 = model.transform_block([col])
+        np.testing.assert_allclose(t1, t2)
+
+    def test_save_load_round_trip(self, rng):
+        from transmogrifai_tpu.stages.registry import build_stage
+        C = _topic_corpus(rng, n=40)
+        col = column_from_values(OPVector, [OPVector(r) for r in C])
+        model = OpLDA(k=2, max_iter=30, seed=0).fit_columns(col)
+        rebuilt = build_stage(type(model).__name__, model.save_args())
+        np.testing.assert_allclose(rebuilt.beta, model.beta)
+        np.testing.assert_allclose(rebuilt.transform_block([col]),
+                                   model.transform_block([col]))
+
+
+class TestWord2Vec:
+    def test_cooccurring_words_embed_nearby(self, rng):
+        # two families of words that only co-occur within their family
+        docs_a = [["cat", "dog", "pet", "fur"] for _ in range(40)]
+        docs_b = [["stock", "bond", "yield", "market"] for _ in range(40)]
+        docs = [d for pair in zip(docs_a, docs_b) for d in pair]
+        col = column_from_values(TextList, docs)
+        model = OpWord2Vec(vector_size=8, vocab_bins=256, seed=1,
+                           num_iterations=15).fit_columns(col)
+        va = model.transform_block([column_from_values(TextList, [["cat"]])])[0]
+        vb = model.transform_block(
+            [column_from_values(TextList, [["dog"]])])[0]
+        vc = model.transform_block(
+            [column_from_values(TextList, [["stock"]])])[0]
+
+        def cos(u, w):
+            return float(u @ w / (np.linalg.norm(u) * np.linalg.norm(w)
+                                  + 1e-12))
+        assert cos(va, vb) > cos(va, vc)
+
+    def test_doc_embedding_is_word_mean_and_empty_is_zero(self, rng):
+        docs = [["a", "b"], ["a"], [], None]
+        col = column_from_values(TextList, docs)
+        model = OpWord2Vec(vector_size=4, vocab_bins=64, seed=0,
+                           num_iterations=3).fit_columns(col)
+        out = model.transform_block([col])
+        assert out.shape == (4, 4)
+        va = model.transform_block(
+            [column_from_values(TextList, [["a"]])])[0]
+        vb = model.transform_block(
+            [column_from_values(TextList, [["b"]])])[0]
+        np.testing.assert_allclose(out[0], (va + vb) / 2, atol=1e-6)
+        np.testing.assert_allclose(out[2], 0.0)
+        np.testing.assert_allclose(out[3], 0.0)
+
+    def test_save_load_round_trip(self):
+        from transmogrifai_tpu.stages.registry import build_stage
+        docs = [["x", "y", "z"]] * 10
+        col = column_from_values(TextList, docs)
+        model = OpWord2Vec(vector_size=4, vocab_bins=32, seed=2,
+                           num_iterations=2).fit_columns(col)
+        rebuilt = build_stage(type(model).__name__, model.save_args())
+        np.testing.assert_allclose(rebuilt.embeddings, model.embeddings)
+
+
+class TestNER:
+    def test_tags_all_entity_families(self):
+        text = ("Dr Maria Garcia flew from Paris to Tokyo on 2024-03-15 "
+                "at 9:30am, spending $1,200 (3.5% of budget) with "
+                "Acme Corp in Japan.")
+        tags = tag_tokens(text)
+        assert "Person" in tags.get("Maria", [])
+        assert "Person" in tags.get("Garcia", [])
+        assert "Location" in tags.get("Paris", [])
+        assert "Location" in tags.get("Tokyo", [])
+        assert "Location" in tags.get("Japan", [])
+        assert any("Date" in v for v in tags.values())
+        assert any("Time" in v for v in tags.values())
+        assert any("Money" in v for v in tags.values())
+        assert any("Percentage" in v for v in tags.values())
+        assert "Organization" in tags.get("Acme", [])
+        assert "Organization" in tags.get("Corp", [])
+
+    def test_empty_and_plain_text(self):
+        assert tag_tokens(None) == {}
+        assert tag_tokens("") == {}
+        assert tag_tokens("the quick brown fox") == {}
+
+    def test_stage_and_extra_gazetteer(self):
+        ner = NameEntityRecognizer(
+            extra_gazetteers={"Location": {"Gotham"}})
+        out = ner.transform_value(Text("Bruce lives in Gotham"))
+        assert "Location" in out.value.get("Gotham", [])
+
+    def test_dsl_hooks_exist(self):
+        f = FeatureBuilder.Text("bio").extract(
+            lambda r: r.get("bio")).as_predictor()
+        assert hasattr(f, "recognize_entities")
+        assert hasattr(f, "word2vec")
+        # lda applies to a count vector
+        v = f.tokenize().count_vectorize(vocab_size=16)
+        topic = v.lda(k=2, max_iter=5)
+        assert topic.type_name == "OPVector"

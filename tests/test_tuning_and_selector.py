@@ -123,6 +123,35 @@ def test_cv_vmapped_matches_sequential(rng):
         assert abs(vmapped[k] - seqd[k]) < 0.02, (k, vmapped[k], seqd[k])
 
 
+def test_multiclass_cv_vmapped_matches_sequential(rng):
+    """The softmax sweep runs as ONE XLA program (no host fold loops) and
+    ranks grids like the sequential per-fold path."""
+    n, d, k = 600, 4, 3
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    centers = rng.normal(size=(k, d)).astype(np.float32) * 2.5
+    y = rng.integers(0, k, size=n).astype(np.float32)
+    X += centers[y.astype(int)]
+    grids = param_grid(reg_param=[0.001, 0.3], elastic_net_param=[0.0])
+    ev = Evaluators.MultiClassification.error()
+    cv = CrossValidation(ev, num_folds=3, seed=11)
+    est = OpLogisticRegression(max_iter=30)
+
+    assert cv._vmappable(est, grids, "multiclass")
+    best = cv.validate([(est, grids)], X, y, problem_type="multiclass")
+    vmapped = {tuple(sorted(v.grid.items())): v.mean_metric
+               for v in best.validated}
+
+    seq = cv._validate_sequential(est, grids, X, y,
+                                  np.ones_like(y), cv.fold_masks(y))
+    seqd = {tuple(sorted(v.grid.items())): v.mean_metric for v in seq}
+    for key in vmapped:
+        assert abs(vmapped[key] - seqd[key]) < 0.02, (
+            key, vmapped[key], seqd[key])
+    # same winner either way
+    best_seq = min(seqd, key=seqd.get)
+    assert tuple(sorted(best.best_grid.items())) == best_seq
+
+
 def test_cv_picks_better_model(rng):
     X, y = _binary_data(rng)
     ev = Evaluators.BinaryClassification.au_roc()

@@ -57,13 +57,16 @@ class BestEstimator:
     validated: List[ValidatedModel] = field(default_factory=list)
 
 
-def _metric_fn(problem_type: str, metric: str) -> Callable:
+def _metric_fn(problem_type: str, metric: str, n_classes: int = 2) -> Callable:
     """Pure-jax (scores, labels, weights, margin_threshold) -> scalar used
     inside the vmapped sweep. Binary scores are margins (monotone in
     probability, so rank metrics match); thresholded metrics use the margin
     equivalent of the evaluator's probability threshold (logit for
     probabilistic models). The threshold is a traced scalar so distinct
-    evaluator thresholds do NOT trigger sweep-kernel recompiles."""
+    evaluator thresholds do NOT trigger sweep-kernel recompiles. Multiclass
+    scores are [n, c] logits; argmax is invariant to softmax, so class
+    metrics come straight from the confusion matmul
+    (OpMultiClassificationEvaluator.scala:58)."""
     if problem_type == "binary":
         if metric == "au_pr":
             return lambda s, y, w, thr: M.au_pr(s, y, w)
@@ -72,6 +75,11 @@ def _metric_fn(problem_type: str, metric: str) -> Callable:
         def bin_m(s, y, w, thr, _m=metric):
             return getattr(M.binary_metrics(s, y, w, threshold=thr), _m)
         return bin_m
+    if problem_type == "multiclass":
+        def multi_m(s, y, w, thr, _m=metric, _k=n_classes):
+            pred = jnp.argmax(s, axis=1)
+            return getattr(M.multiclass_metrics(pred, y, _k, w), _m)
+        return multi_m
     if problem_type == "regression":
         def reg_m(p, y, w, thr, _m=metric):
             return getattr(M.regression_metrics(p, y, w), _m)
@@ -79,16 +87,19 @@ def _metric_fn(problem_type: str, metric: str) -> Callable:
     raise ValueError(f"No vmapped metric for problem type {problem_type}")
 
 
-@partial(jax.jit, static_argnames=("fit_one", "metric", "problem_type"))
+@partial(jax.jit,
+         static_argnames=("fit_one", "metric", "problem_type", "n_classes"))
 def _sweep(X, y, w, fold_masks, regs, alphas, margin_threshold, *, fit_one,
-           metric, problem_type):
+           metric, problem_type, n_classes=2):
     """The sweep kernel: metrics[F, G] for F fold masks x G grid points.
 
     One XLA program: on a row-sharded X every Gram-matrix reduction inside
     fit_one becomes an ICI psum; fold/grid axes are embarrassingly parallel
     (vmap) and can additionally be laid out on the `model` mesh axis.
+    Multiclass fit_one returns (B [d, c], b0 [c]) and the same `X @ beta + b0`
+    scoring broadcasts to [n, c] logits.
     """
-    mfn = _metric_fn(problem_type, metric)
+    mfn = _metric_fn(problem_type, metric, n_classes)
 
     def one(mask, reg, alpha):
         beta, b0 = fit_one(X, y, mask * w, reg, alpha)
@@ -172,7 +183,10 @@ class Validator:
                    problem_type: str) -> bool:
         if not getattr(est, "supports_grid_vmap", False):
             return False
-        if problem_type not in ("binary", "regression"):
+        if problem_type == "multiclass":
+            if not getattr(est, "supports_multiclass_vmap", False):
+                return False
+        elif problem_type not in ("binary", "regression"):
             return False
         _, axes = est.batched_fit_fn()
         # every non-axis grid key must be constant across the grid (those
@@ -187,7 +201,11 @@ class Validator:
     def _validate_vmapped(self, est, grids, X, y, w, masks, metric,
                           problem_type) -> List[ValidatedModel]:
         base = est.copy(**{k: v for k, v in grids[0].items()})
-        fit_one, axes = base.batched_fit_fn()
+        n_classes = int(np.max(y)) + 1 if problem_type == "multiclass" else 2
+        if problem_type == "multiclass":
+            fit_one, axes = base.batched_fit_fn(n_classes=n_classes)
+        else:
+            fit_one, axes = base.batched_fit_fn()
         regs = np.array([g.get(axes[0], est.get_param(axes[0]))
                          for g in grids], np.float32)
         second = axes[1] if len(axes) > 1 else None
@@ -206,7 +224,7 @@ class Validator:
                      jnp.asarray(regs), jnp.asarray(alphas),
                      jnp.asarray(margin_thr, jnp.float32),
                      fit_one=fit_one, metric=metric,
-                     problem_type=problem_type)
+                     problem_type=problem_type, n_classes=n_classes)
         out = np.asarray(out)  # [F, G]
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,

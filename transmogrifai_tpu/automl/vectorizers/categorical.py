@@ -7,7 +7,6 @@ OpSetVectorizer for MultiPickList.
 from __future__ import annotations
 
 import re
-from collections import Counter
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -17,6 +16,7 @@ from ...data.vector import NULL_STRING, OTHER_STRING, VectorColumnMetadata, Vect
 from ...stages.params import Param
 from ...types import MultiPickList, Text
 from .base import SequenceVectorizer, VectorizerModel
+from .encoding import category_counts, pivot_block_multi, pivot_block_single
 
 _CLEAN_RE = re.compile(r"[^\w\s]|_", re.UNICODE)
 
@@ -39,45 +39,15 @@ class OneHotModel(VectorizerModel):
         self.track_nulls = track_nulls
         self.clean_text = clean_text
         self.multiset = multiset
-        self._index = [{v: i for i, v in enumerate(vocab)} for vocab in self.vocabs]
 
     def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
-        n = len(cols[0])
         blocks = []
+        clean = self.clean_text
+        pivot = pivot_block_multi if self.multiset else pivot_block_single
         for j, c in enumerate(cols):
-            vocab = self.vocabs[j]
-            index = self._index[j]
-            k = len(vocab)
-            width = k + 1 + (1 if self.track_nulls else 0)
-            block = np.zeros((n, width), dtype=np.float64)
-            data = c.data
-            for i in range(n):
-                v = data[i]
-                if self.multiset:
-                    vals = v if v else None
-                    if not vals:
-                        if self.track_nulls:
-                            block[i, k + 1] = 1.0
-                        continue
-                    for item in vals:
-                        cv = clean_text_value(str(item), self.clean_text)
-                        idx = index.get(cv)
-                        if idx is None:
-                            block[i, k] = 1.0
-                        else:
-                            block[i, idx] = 1.0
-                else:
-                    if v is None:
-                        if self.track_nulls:
-                            block[i, k + 1] = 1.0
-                        continue
-                    cv = clean_text_value(str(v), self.clean_text)
-                    idx = index.get(cv)
-                    if idx is None:
-                        block[i, k] = 1.0
-                    else:
-                        block[i, idx] = 1.0
-            blocks.append(block)
+            blocks.append(pivot(
+                c.data, self.vocabs[j], self.track_nulls,
+                lambda s: clean_text_value(s, clean)))
         return np.concatenate(blocks, axis=1)
 
     def save_args(self) -> Dict[str, Any]:
@@ -121,17 +91,9 @@ class OneHotVectorizer(SequenceVectorizer):
         max_pct = float(self.get_param("max_pct_cardinality"))
         vocabs: List[List[str]] = []
         for c in cols:
-            counts: Counter = Counter()
-            n_present = 0
-            for v in c.data:
-                if v is None:
-                    continue
-                n_present += 1
-                if self.multiset:
-                    for item in v:
-                        counts[clean_text_value(str(item), clean)] += 1
-                else:
-                    counts[clean_text_value(str(v), clean)] += 1
+            counts, n_present = category_counts(
+                c.data, lambda s: clean_text_value(s, clean),
+                multiset=self.multiset)
             if n_present > 0 and len(counts) / n_present > max_pct:
                 # near-unique (ID-like) column: drop the pivot entirely
                 # (reference OpOneHotVectorizer.MaxPctCardinality guard)

@@ -16,24 +16,22 @@ from ...data.vector import NULL_STRING, VectorColumnMetadata, VectorMetadata
 from ...stages.params import Param
 from ...types import Geolocation
 from .base import SequenceVectorizer, VectorizerModel
+from .encoding import empty_mask, triple_block
 
 
 def geo_mean(values: Sequence[Sequence[float]]) -> List[float]:
-    """Unit-sphere mean of (lat, lon, acc) triples."""
-    if not values:
+    """Unit-sphere mean of (lat, lon, acc) triples (vectorized)."""
+    if not len(values):
         return [0.0, 0.0, 0.0]
-    xs = ys = zs = acc = 0.0
-    for lat, lon, a in values:
-        la, lo = math.radians(lat), math.radians(lon)
-        xs += math.cos(la) * math.cos(lo)
-        ys += math.cos(la) * math.sin(lo)
-        zs += math.sin(la)
-        acc += a
-    n = len(values)
-    xs, ys, zs = xs / n, ys / n, zs / n
+    arr = np.asarray(values, np.float64)[:, :3]
+    la = np.radians(arr[:, 0])
+    lo = np.radians(arr[:, 1])
+    xs = float(np.mean(np.cos(la) * np.cos(lo)))
+    ys = float(np.mean(np.cos(la) * np.sin(lo)))
+    zs = float(np.mean(np.sin(la)))
     hyp = math.sqrt(xs * xs + ys * ys)
     return [math.degrees(math.atan2(zs, hyp)),
-            math.degrees(math.atan2(ys, xs)), acc / n]
+            math.degrees(math.atan2(ys, xs)), float(np.mean(arr[:, 2]))]
 
 
 class GeolocationModel(VectorizerModel):
@@ -44,21 +42,13 @@ class GeolocationModel(VectorizerModel):
         self.track_nulls = track_nulls
 
     def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
-        n = len(cols[0])
         blocks = []
         for j, c in enumerate(cols):
-            width = 3 + (1 if self.track_nulls else 0)
-            block = np.zeros((n, width), dtype=np.float64)
-            fill = self.fills[j]
-            for i in range(n):
-                v = c.data[i]
-                if v:
-                    block[i, 0:3] = v[:3]
-                else:
-                    block[i, 0:3] = fill
-                    if self.track_nulls:
-                        block[i, 3] = 1.0
-            blocks.append(block)
+            triples = triple_block(c.data, self.fills[j])
+            if self.track_nulls:
+                nulls = empty_mask(c.data).astype(np.float64)[:, None]
+                triples = np.concatenate([triples, nulls], axis=1)
+            blocks.append(triples)
         return np.concatenate(blocks, axis=1)
 
     def save_args(self) -> Dict[str, Any]:

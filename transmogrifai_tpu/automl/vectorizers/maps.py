@@ -17,7 +17,6 @@ import numpy as np
 
 from ...data.dataset import Column
 from ...data.vector import NULL_STRING, OTHER_STRING, VectorColumnMetadata, VectorMetadata
-from ...ops.hashing import hash_tokens_to_counts
 from ...stages.params import Param
 from ...types import (
     BinaryMap, DateMap, FeatureType, GeolocationMap, IntegralMap,
@@ -25,8 +24,12 @@ from ...types import (
 )
 from .base import SequenceVectorizer, VectorizerModel
 from .categorical import clean_text_value
+from .encoding import (
+    category_counts, empty_mask, extract_key_columns, float_column,
+    null_mask, pivot_block_multi, pivot_block_single, triple_block,
+)
 from .geo import geo_mean
-from .text import tokenize
+from .text import tokenize_hash_counts
 
 _CATEGORICAL_MAP_TYPES = (
     "PickListMap", "ComboBoxMap", "CountryMap", "StateMap", "CityMap",
@@ -36,19 +39,6 @@ _CATEGORICAL_MAP_TYPES = (
 
 def clean_key(k: str, clean: bool) -> str:
     return clean_text_value(k, clean) if clean else k
-
-
-def lookup_key(m, key: str, clean_keys: bool):
-    """Fetch a map value by (possibly cleaned) key — single implementation
-    shared by fit-time discovery and transform-time reads."""
-    if not m:
-        return None
-    if clean_keys:
-        for k, v in m.items():
-            if clean_key(str(k), True) == key:
-                return v
-        return None
-    return m.get(key)
 
 
 class MapVectorizerModel(VectorizerModel):
@@ -68,83 +58,52 @@ class MapVectorizerModel(VectorizerModel):
     def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
         blocks: List[np.ndarray] = []
         for plan, c in zip(self.feature_plans, cols):
-            n = len(c)
             kind = plan["kind"]
             keys = plan["keys"]
             track = plan["track_nulls"]
+            clean = plan["clean_text"]
+            key_clean = (lambda s: clean_key(s, True)) if self.clean_keys \
+                else None
+            keycols = extract_key_columns(c.data, keys, key_clean)
+
+            def clean_fn(s, _c=clean):
+                return clean_text_value(s, _c)
+
+            def nulls_of(vals):
+                return null_mask(vals).astype(np.float64)[:, None]
+
             for key in keys:
-                vals = [self._get(c.data[i], key) for i in range(n)]
+                vals = keycols[key]
                 if kind in ("real", "binary"):
-                    fill = plan["fills"].get(key, 0.0)
-                    col = np.array([fill if v is None else float(v) for v in vals])
+                    col = float_column(vals, plan["fills"].get(key, 0.0))
                     parts = [col[:, None]]
                     if track:
-                        parts.append(np.array(
-                            [1.0 if v is None else 0.0 for v in vals])[:, None])
+                        parts.append(nulls_of(vals))
                     blocks.append(np.concatenate(parts, axis=1))
                 elif kind == "categorical":
                     vocab = plan["vocab"].get(key, [])
                     if vocab is None:  # high-cardinality key -> hash space
-                        bins = plan["bins"]
-                        toks = [tokenize(v) if v else [] for v in vals]
-                        counts = hash_tokens_to_counts(toks, bins)
+                        counts = tokenize_hash_counts(vals, plan["bins"])
                         parts = [counts]
                         if track:
-                            parts.append(np.array(
-                                [1.0 if v is None else 0.0 for v in vals])[:, None])
+                            parts.append(nulls_of(vals))
                         blocks.append(np.concatenate(parts, axis=1))
-                        continue
-                    index = {v: i for i, v in enumerate(vocab)}
-                    k = len(vocab)
-                    block = np.zeros((n, k + 1 + (1 if track else 0)))
-                    for i, v in enumerate(vals):
-                        if v is None:
-                            if track:
-                                block[i, k + 1] = 1.0
-                            continue
-                        cv = clean_text_value(str(v), plan["clean_text"])
-                        j = index.get(cv)
-                        if j is None:
-                            block[i, k] = 1.0
-                        else:
-                            block[i, j] = 1.0
-                    blocks.append(block)
+                    else:
+                        blocks.append(pivot_block_single(
+                            vals, vocab, track, clean_fn))
                 elif kind == "multipicklist":
-                    vocab = plan["vocab"].get(key, [])
-                    index = {v: i for i, v in enumerate(vocab)}
-                    k = len(vocab)
-                    block = np.zeros((n, k + 1 + (1 if track else 0)))
-                    for i, v in enumerate(vals):
-                        if not v:
-                            if track:
-                                block[i, k + 1] = 1.0
-                            continue
-                        for item in v:
-                            cv = clean_text_value(str(item), plan["clean_text"])
-                            j = index.get(cv)
-                            if j is None:
-                                block[i, k] = 1.0
-                            else:
-                                block[i, j] = 1.0
-                    blocks.append(block)
+                    blocks.append(pivot_block_multi(
+                        vals, plan["vocab"].get(key, []), track, clean_fn))
                 elif kind == "geo":
-                    fill = plan["fills"].get(key, [0.0, 0.0, 0.0])
-                    width = 3 + (1 if track else 0)
-                    block = np.zeros((n, width))
-                    for i, v in enumerate(vals):
-                        if v:
-                            block[i, 0:3] = v[:3]
-                        else:
-                            block[i, 0:3] = fill
-                            if track:
-                                block[i, 3] = 1.0
-                    blocks.append(block)
+                    triples = triple_block(
+                        vals, plan["fills"].get(key, [0.0, 0.0, 0.0]))
+                    if track:
+                        empt = empty_mask(vals).astype(np.float64)[:, None]
+                        triples = np.concatenate([triples, empt], axis=1)
+                    blocks.append(triples)
                 else:
                     raise ValueError(f"Unknown map plan kind {kind}")
         return np.concatenate(blocks, axis=1) if blocks else np.zeros((len(cols[0]), 0))
-
-    def _get(self, m, key):
-        return lookup_key(m, key, self.clean_keys)
 
     def save_args(self) -> Dict[str, Any]:
         d = super().save_args()
@@ -217,29 +176,24 @@ class MapVectorizer(SequenceVectorizer):
             plan: Dict[str, Any] = dict(kind=kind, keys=keys, track_nulls=track,
                                         clean_text=clean, bins=bins,
                                         fills={}, vocab={})
+            key_clean = (lambda s: clean_key(s, True)) if clean_keys_p else None
+            keycols = extract_key_columns(c.data, keys, key_clean)
             if kind in ("real", "binary"):
                 for key in keys:
-                    vals = [self._lookup(m, key, clean_keys_p) for m in c.data]
-                    nums = [float(v) for v in vals if v is not None]
-                    plan["fills"][key] = (float(np.mean(nums)) if nums and
-                                          kind == "real" else 0.0)
+                    vals = keycols[key]
+                    present = ~null_mask(vals)
+                    plan["fills"][key] = (
+                        float(float_column(vals, 0.0)[present].mean())
+                        if kind == "real" and present.any() else 0.0)
             elif kind == "geo":
                 for key in keys:
-                    vals = [self._lookup(m, key, clean_keys_p) for m in c.data]
-                    geo_vals = [v for v in vals if v]
+                    geo_vals = [v for v in keycols[key] if v]
                     plan["fills"][key] = geo_mean(geo_vals)
             elif kind in ("categorical", "multipicklist", "smarttext"):
                 for key in keys:
-                    vals = [self._lookup(m, key, clean_keys_p) for m in c.data]
-                    counts: Counter = Counter()
-                    for v in vals:
-                        if v is None:
-                            continue
-                        if kind == "multipicklist":
-                            for item in v:
-                                counts[clean_text_value(str(item), clean)] += 1
-                        else:
-                            counts[clean_text_value(str(v), clean)] += 1
+                    counts, _ = category_counts(
+                        keycols[key], lambda s: clean_text_value(s, clean),
+                        multiset=(kind == "multipicklist"))
                     if kind == "smarttext" and len(counts) > max_card:
                         # high-cardinality free text -> hashing for this key
                         plan["vocab"][key] = None
@@ -257,10 +211,6 @@ class MapVectorizer(SequenceVectorizer):
                                    operation_name=self.operation_name)
         model.set_metadata(VectorMetadata(name=self.output_name(), columns=md_cols))
         return model
-
-    @staticmethod
-    def _lookup(m, key, clean_keys_p):
-        return lookup_key(m, key, clean_keys_p)
 
     def _metadata_for(self, f, plan) -> List[VectorColumnMetadata]:
         out: List[VectorColumnMetadata] = []

@@ -8,8 +8,6 @@ hash-early-fixed-width design that keeps device shapes static.
 """
 from __future__ import annotations
 
-import re
-from collections import Counter
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -21,21 +19,41 @@ from ...stages.params import Param
 from ...types import Text, TextList
 from .base import SequenceVectorizer, VectorizerModel
 from .categorical import clean_text_value
-
-_WORD_RE = re.compile(r"\w+", re.UNICODE)
+from .encoding import category_counts, null_mask, pivot_block_single
 
 MIN_TOKEN_LENGTH = 1  # reference TextTokenizer.MinTokenLength
 
 
 def tokenize(s: Optional[str], to_lowercase: bool = True,
              min_token_length: int = MIN_TOKEN_LENGTH) -> List[str]:
-    """Simple unicode word tokenizer (reference TextTokenizer.scala:196 uses
-    Lucene; host-side tokenization feeding fixed-width hashed tensors)."""
-    if s is None:
-        return []
-    if to_lowercase:
-        s = s.lower()
-    return [t for t in _WORD_RE.findall(s) if len(t) >= min_token_length]
+    """Default analyzer (reference TextTokenizer.scala:196 uses Lucene's
+    standard analyzer): maximal runs of [A-Za-z0-9'], lowercased — the
+    same semantics as the fused C++ tokenize+hash path, so host fallback
+    and native fast path produce identical tensors."""
+    from ...transformers.text import tokenize_text
+
+    return tokenize_text(s, min_token_length, to_lowercase, False)
+
+
+def tokenize_hash_counts(docs: Sequence[Optional[str]], bins: int,
+                         seed: int = 0) -> np.ndarray:
+    """Documents -> [n, bins] hashed token counts: the whole text->tensor
+    loop in ONE native pass when the C++ library is built, else a python
+    tokenize + (native or numpy) hashing fallback.
+
+    The C++ tokenizer is byte-level ASCII; it only takes over when every
+    document isascii(), where it is token-for-token identical to the
+    unicode python analyzer. Non-ASCII corpora keep unicode tokens."""
+    if all(d is None or d.isascii() for d in docs):
+        try:
+            from ...ops.native_bridge import native_tokenize_hash_counts
+            out = native_tokenize_hash_counts(docs, bins, seed=seed,
+                                              min_len=MIN_TOKEN_LENGTH)
+            if out is not None:
+                return out
+        except ImportError:
+            pass
+    return hash_tokens_to_counts([tokenize(d) for d in docs], bins, seed=seed)
 
 
 class SmartTextModel(VectorizerModel):
@@ -51,33 +69,17 @@ class SmartTextModel(VectorizerModel):
     def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
         blocks: List[np.ndarray] = []
         for plan, c in zip(self.plans, cols):
-            n = len(c)
             data = c.data
             track = plan["track_nulls"]
             if plan["mode"] == "pivot":
-                vocab = plan["vocab"]
-                index = {v: i for i, v in enumerate(vocab)}
-                k = len(vocab)
-                block = np.zeros((n, k + 1 + (1 if track else 0)), dtype=np.float64)
-                for i in range(n):
-                    v = data[i]
-                    if v is None:
-                        if track:
-                            block[i, k + 1] = 1.0
-                        continue
-                    cv = clean_text_value(str(v), plan["clean_text"])
-                    j = index.get(cv)
-                    if j is None:
-                        block[i, k] = 1.0
-                    else:
-                        block[i, j] = 1.0
+                clean = plan["clean_text"]
+                block = pivot_block_single(
+                    data, plan["vocab"], track,
+                    lambda s: clean_text_value(s, clean))
             else:  # hash
-                bins = plan["bins"]
-                tokens = [tokenize(data[i]) for i in range(n)]
-                counts = hash_tokens_to_counts(tokens, bins)
+                counts = tokenize_hash_counts(data, plan["bins"])
                 if track:
-                    nulls = np.array([[1.0] if data[i] is None else [0.0]
-                                      for i in range(n)])
+                    nulls = null_mask(data).astype(np.float64)[:, None]
                     block = np.concatenate([counts, nulls], axis=1)
                 else:
                     block = counts
@@ -122,10 +124,8 @@ class SmartTextVectorizer(SequenceVectorizer):
         plans: List[Dict[str, Any]] = []
         md_cols: List[VectorColumnMetadata] = []
         for f, c in zip(self.input_features, cols):
-            counts: Counter = Counter()
-            for v in c.data:
-                if v is not None:
-                    counts[clean_text_value(str(v), clean)] += 1
+            counts, _ = category_counts(
+                c.data, lambda s: clean_text_value(s, clean))
             if len(counts) <= max_card:
                 kept = [(val, n) for val, n in counts.items()
                         if n >= min_support and val != ""]
@@ -171,28 +171,19 @@ class HashingModel(VectorizerModel):
         self.is_list = is_list
 
     def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
-        n = len(cols[0])
+        # per-column count matrices straight from the (native) kernels;
+        # token-hash counts are additive, so a shared hash space is the SUM
+        # of per-column matrices — no per-row list concatenation needed
+        mats = [hash_tokens_to_counts(c.data, self.num_features)
+                if self.is_list
+                else tokenize_hash_counts(c.data, self.num_features)
+                for c in cols]
         if self.shared_hash_space:
-            token_lists: List[List[str]] = [[] for _ in range(n)]
-            for c in cols:
-                for i in range(n):
-                    v = c.data[i]
-                    toks = list(v) if self.is_list and v else \
-                        (tokenize(v) if v else [])
-                    token_lists[i].extend(toks)
-            return hash_tokens_to_counts(token_lists, self.num_features,
-                                         binary=self.binary_freq)
-        blocks = []
-        for c in cols:
-            token_lists = []
-            for i in range(n):
-                v = c.data[i]
-                toks = list(v) if self.is_list and v else \
-                    (tokenize(v) if v else [])
-                token_lists.append(toks)
-            blocks.append(hash_tokens_to_counts(
-                token_lists, self.num_features, binary=self.binary_freq))
-        return np.concatenate(blocks, axis=1)
+            out = mats[0] if len(mats) == 1 else np.sum(mats, axis=0)
+            return np.minimum(out, 1.0) if self.binary_freq else out
+        if self.binary_freq:
+            mats = [np.minimum(m, 1.0) for m in mats]
+        return np.concatenate(mats, axis=1)
 
     def save_args(self) -> Dict[str, Any]:
         d = super().save_args()

@@ -191,6 +191,23 @@ def _tf_idf(self, vocab_size: int = 512, min_df: int = 1):
     return _unary(self, TfIdfVectorizer, vocab_size=vocab_size, min_df=min_df)
 
 
+def _lda(self, k: int = 10, max_iter: int = 50, seed: int = 42):
+    from .transformers.topics import OpLDA
+    return _unary(self, OpLDA, k=k, max_iter=max_iter, seed=seed)
+
+
+def _word2vec(self, vector_size: int = 100, vocab_bins: int = 2048,
+              window_size: int = 5, seed: int = 42):
+    from .transformers.topics import OpWord2Vec
+    return _unary(self, OpWord2Vec, vector_size=vector_size,
+                  vocab_bins=vocab_bins, window_size=window_size, seed=seed)
+
+
+def _recognize_entities(self):
+    from .transformers.ner import NameEntityRecognizer
+    return _unary(self, NameEntityRecognizer)
+
+
 # -- similarity --------------------------------------------------------------
 
 def _ngram_similarity(self, other: Feature, n: int = 3):
@@ -242,7 +259,9 @@ def install() -> None:
         "detect_mime_types": _detect_mime_types,
         "is_valid_phone": _is_valid_phone, "email_domain": _email_domain,
         "index_string": _index_string, "count_vectorize": _count_vectorize,
-        "tf_idf": _tf_idf, "ngram_similarity": _ngram_similarity,
+        "tf_idf": _tf_idf, "lda": _lda, "word2vec": _word2vec,
+        "recognize_entities": _recognize_entities,
+        "ngram_similarity": _ngram_similarity,
         "jaccard_similarity": _jaccard_similarity,
         "vectorize": _vectorize, "pivot": _pivot,
         "sanity_check": _sanity_check, "loco_insights": _loco_insights,

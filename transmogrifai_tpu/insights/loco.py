@@ -67,15 +67,19 @@ class RecordInsightsLOCO(Transformer):
         deltas = self.insights_matrix(X)                  # [n, d, c]
         strength = np.abs(deltas).max(axis=2)             # [n, d]
         k = min(self.top_k, X.shape[1])
+        # top-k per row in one vectorized argpartition + within-k sort
+        orders = np.argpartition(-strength, kth=k - 1, axis=1)[:, :k]
+        part = np.take_along_axis(strength, orders, axis=1)
+        orders = np.take_along_axis(orders, np.argsort(-part, axis=1), axis=1)
+        n_classes = deltas.shape[2]
         vals: List[Dict[str, Any]] = []
         for i in range(X.shape[0]):
-            order = np.argsort(-strength[i])[:k]
             # TextMap values are strings: per-class deltas as JSON, matching
             # the reference's serialized insight arrays
             vals.append({
                 names[j]: json.dumps([[int(c), float(deltas[i, j, c])]
-                                      for c in range(deltas.shape[2])])
-                for j in order
+                                      for c in range(n_classes)])
+                for j in orders[i]
             })
         return column_from_values(TextMap, vals)
 

@@ -1,0 +1,111 @@
+"""Word embeddings via hashed co-occurrence factorization.
+
+Reference: core/.../impl/feature/OpWord2Vec.scala wraps Spark ML Word2Vec
+(skip-gram, async SGD over a driver-broadcast vocab). The TPU-native design
+swaps the sampling loop for a GloVe-style closed-form pipeline that is
+entirely matmul-shaped:
+
+1. host: hash tokens into a fixed vocab of V bins (no dynamic vocab — the
+   same hash-early trick the vectorizers use) and accumulate a windowed
+   co-occurrence matrix C [V, V] with vectorized numpy scatters;
+2. device: factorize M = log(1 + C) with alternating least squares —
+   each half-step is one Gram matrix + one [V, V] x [V, d] matmul + one
+   Cholesky solve, repeated a fixed number of iterations.
+
+Document embeddings are mean-pooled word vectors (Spark Word2Vec.transform
+does exactly this average).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hashing import hash_string
+
+
+def hash_token_ids(tokens: Sequence[str], vocab_bins: int,
+                   seed: int = 0) -> np.ndarray:
+    """Token strings -> hashed vocab ids (native murmur3 when built)."""
+    try:
+        from .native_bridge import native_hash_strings
+        out = native_hash_strings(list(tokens), seed)
+        if out is not None:
+            return (out % vocab_bins).astype(np.int64)
+    except ImportError:
+        pass
+    return np.fromiter((hash_string(t, vocab_bins, seed) for t in tokens),
+                       np.int64, len(tokens))
+
+
+def cooccurrence_matrix(token_lists: Sequence[Optional[Sequence[str]]],
+                        vocab_bins: int, window: int = 5,
+                        seed: int = 0) -> np.ndarray:
+    """Symmetric windowed co-occurrence counts [V, V].
+
+    Per document the inner accumulation is vectorized (np.add.at per window
+    offset over the whole id array); only the document loop is Python.
+    """
+    C = np.zeros((vocab_bins, vocab_bins), np.float64)
+    for toks in token_lists:
+        if not toks or len(toks) < 2:
+            continue
+        ids = hash_token_ids(list(toks), vocab_bins, seed)
+        for off in range(1, min(window, len(ids) - 1) + 1):
+            a, b = ids[:-off], ids[off:]
+            np.add.at(C, (a, b), 1.0)
+            np.add.at(C, (b, a), 1.0)
+    return C
+
+
+@partial(jax.jit, static_argnames=("dim", "n_iter"))
+def factorize_embeddings(C: jax.Array, key: jax.Array, dim: int,
+                         n_iter: int = 10, reg: float = 1e-2) -> jax.Array:
+    """ALS factorization of log(1+C) -> row embeddings [V, dim].
+
+    Symmetric target, two factors W/H pulled together by averaging at the
+    end (standard GloVe practice: w + w~).
+    """
+    M = jnp.log1p(jnp.asarray(C, jnp.float32))
+    v = M.shape[0]
+    k1, k2 = jax.random.split(key)
+    W = jax.random.normal(k1, (v, dim), jnp.float32) * 0.1
+    H = jax.random.normal(k2, (v, dim), jnp.float32) * 0.1
+    I = jnp.eye(dim, dtype=jnp.float32)
+
+    def body(_, state):
+        W, H = state
+        G = H.T @ H + reg * I
+        W = jax.scipy.linalg.solve(G, (M @ H).T, assume_a="pos").T
+        G2 = W.T @ W + reg * I
+        H = jax.scipy.linalg.solve(G2, (M.T @ W).T, assume_a="pos").T
+        return W, H
+
+    W, H = jax.lax.fori_loop(0, n_iter, body, (W, H))
+    return 0.5 * (W + H)
+
+
+def mean_pool_docs(token_lists: Sequence[Optional[Sequence[str]]],
+                   embeddings: np.ndarray, seed: int = 0) -> np.ndarray:
+    """Documents -> [n, dim] mean of hashed word vectors (empty doc -> 0).
+
+    Vectorized: one flat hash pass + np.add.at segment-sum over doc ids.
+    """
+    n = len(token_lists)
+    V, dim = embeddings.shape
+    lengths = np.fromiter((len(t) if t else 0 for t in token_lists),
+                          np.int64, n)
+    total = int(lengths.sum())
+    out = np.zeros((n, dim), np.float64)
+    if not total:
+        return out
+    flat: List[str] = [t for toks in token_lists if toks for t in toks]
+    ids = hash_token_ids(flat, V, seed)
+    doc_of = np.repeat(np.arange(n), lengths)
+    np.add.at(out, doc_of, embeddings[ids])
+    nz = lengths > 0
+    out[nz] /= lengths[nz, None]
+    return out

@@ -40,6 +40,8 @@ _STAGE_MODULES = [
     "transmogrifai_tpu.transformers.math",
     "transmogrifai_tpu.transformers.misc",
     "transmogrifai_tpu.transformers.text",
+    "transmogrifai_tpu.transformers.topics",
+    "transmogrifai_tpu.transformers.ner",
 ]
 
 _EXTRA_STAGES: Dict[str, type] = {}

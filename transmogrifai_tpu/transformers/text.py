@@ -30,7 +30,12 @@ from ..types import (
     RealNN, Text, TextList,
 )
 
-_TOKEN_RE = re.compile(r"[^a-zA-Z0-9']+")
+# token = maximal run of unicode alphanumerics or apostrophes (underscore is
+# a separator). For pure-ASCII text this is exactly the C++ fused tokenizer's
+# [A-Za-z0-9'] rule (native/hashing.cpp:104), so the native fast path can be
+# used whenever the input is ASCII; non-ASCII text keeps unicode tokens like
+# Lucene's (unicode-aware) standard analyzer instead of mangling them.
+_TOKEN_RE = re.compile(r"(?:[^\W_]|')+", re.UNICODE)
 _STOPWORDS = {
     "a", "an", "and", "are", "as", "at", "be", "but", "by", "for", "if",
     "in", "into", "is", "it", "no", "not", "of", "on", "or", "such", "that",
@@ -47,7 +52,7 @@ def tokenize_text(value: Optional[str], min_token_length: int = 1,
     if not value:
         return []
     s = value.lower() if to_lowercase else value
-    toks = [t for t in _TOKEN_RE.split(s) if len(t) >= min_token_length]
+    toks = [t for t in _TOKEN_RE.findall(s) if len(t) >= min_token_length]
     if filter_stopwords:
         toks = [t for t in toks if t not in _STOPWORDS]
     return toks

@@ -1,0 +1,168 @@
+"""Topic-model and embedding stages: OpLDA, OpWord2Vec.
+
+Reference: core/.../impl/feature/OpLDA.scala:60 (LDA over a count vector ->
+topic-distribution vector, params k/maxIter/optimizer) and OpWord2Vec.scala
+(TextList -> averaged word vectors). Kernels live in ops/lda.py and
+ops/embeddings.py; these stages provide the estimator/model contract,
+vector metadata, and persistence.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..automl.vectorizers.base import VectorizerModel
+from ..data.dataset import Column
+from ..data.vector import VectorColumnMetadata, VectorMetadata
+from ..stages.base import Estimator
+from ..stages.params import Param
+from ..types import OPVector, TextList
+
+
+def _as_matrix(col: Column) -> np.ndarray:
+    X = np.asarray(col.data, np.float32)
+    if X.ndim == 1:
+        X = X[:, None]
+    return X
+
+
+class OpLDA(Estimator):
+    """OPVector (term counts) -> OPVector of topic distributions.
+
+    Reference OpLDA.scala:60 defaults: k=10, maxIter=10 (online) — here EM
+    runs a fixed 50 iterations (pure matmuls; far cheaper per iteration
+    than Spark's distributed EM)."""
+
+    input_types = (OPVector,)
+    output_type = OPVector
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("k", "number of topics", 10, lambda v: v >= 2),
+                Param("max_iter", "EM iterations", 50, lambda v: v > 0),
+                Param("doc_concentration", "alpha prior", 1.1),
+                Param("topic_concentration", "eta prior", 1.01),
+                Param("seed", "init seed", 42)]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "lda"), uid=uid,
+                         **params)
+
+    def fit_columns(self, *cols: Column) -> "OpLDAModel":
+        from ..ops.lda import fit_lda
+
+        C = _as_matrix(cols[0])
+        k = int(self.get_param("k"))
+        _, beta = fit_lda(
+            C, jax.random.PRNGKey(int(self.get_param("seed"))),
+            n_topics=k, n_iter=int(self.get_param("max_iter")),
+            alpha=float(self.get_param("doc_concentration")),
+            eta=float(self.get_param("topic_concentration")))
+        model = OpLDAModel(
+            beta=np.asarray(beta),
+            alpha=float(self.get_param("doc_concentration")),
+            operation_name=self.operation_name)
+        parent = self.input_features[0] if self.input_features else None
+        model.set_metadata(VectorMetadata(
+            name=self.output_name(),
+            columns=[VectorColumnMetadata(
+                parent_feature_name=parent.name if parent else "lda",
+                parent_feature_type=parent.type_name if parent else "OPVector",
+                descriptor_value=f"topic_{t}") for t in range(k)]))
+        return model
+
+
+class OpLDAModel(VectorizerModel):
+    """Frozen topics; transform = variational fold-in (topicDistribution)."""
+
+    input_types = (OPVector,)
+
+    def __init__(self, beta: Optional[np.ndarray] = None, alpha: float = 1.1,
+                 uid: Optional[str] = None, **params):
+        self.beta = np.asarray(beta, np.float32) if beta is not None else \
+            np.zeros((0, 0), np.float32)
+        self.alpha = float(alpha)
+        super().__init__(params.pop("operation_name", "lda"), uid=uid,
+                         **params)
+
+    def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        from ..ops.lda import lda_fold_in
+
+        return np.asarray(lda_fold_in(_as_matrix(cols[0]),
+                                      self.beta, alpha=self.alpha))
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(beta=self.beta, alpha=self.alpha)
+        return d
+
+
+class OpWord2Vec(Estimator):
+    """TextList -> OPVector document embedding (mean of word vectors).
+
+    Reference OpWord2Vec.scala wraps Spark Word2Vec (vectorSize=100 default,
+    skip-gram SGD); here word vectors come from ALS factorization of the
+    hashed windowed co-occurrence matrix (ops/embeddings.py) — deterministic
+    given the seed and shaped for the MXU."""
+
+    input_types = (TextList,)
+    output_type = OPVector
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("vector_size", "embedding dim", 100, lambda v: v >= 2),
+                Param("vocab_bins", "hashed vocabulary size", 2048),
+                Param("window_size", "co-occurrence window", 5),
+                Param("num_iterations", "ALS iterations", 10),
+                Param("seed", "hash + init seed", 42)]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "w2v"), uid=uid,
+                         **params)
+
+    def fit_columns(self, *cols: Column) -> "OpWord2VecModel":
+        from ..ops.embeddings import cooccurrence_matrix, factorize_embeddings
+
+        seed = int(self.get_param("seed"))
+        bins = int(self.get_param("vocab_bins"))
+        dim = int(self.get_param("vector_size"))
+        C = cooccurrence_matrix(cols[0].data, bins,
+                                window=int(self.get_param("window_size")),
+                                seed=seed)
+        emb = factorize_embeddings(
+            C, jax.random.PRNGKey(seed), dim=dim,
+            n_iter=int(self.get_param("num_iterations")))
+        model = OpWord2VecModel(embeddings=np.asarray(emb), seed=seed,
+                                operation_name=self.operation_name)
+        parent = self.input_features[0] if self.input_features else None
+        model.set_metadata(VectorMetadata(
+            name=self.output_name(),
+            columns=[VectorColumnMetadata(
+                parent_feature_name=parent.name if parent else "w2v",
+                parent_feature_type=parent.type_name if parent else "TextList",
+                descriptor_value=f"dim_{j}") for j in range(dim)]))
+        return model
+
+
+class OpWord2VecModel(VectorizerModel):
+    input_types = (TextList,)
+
+    def __init__(self, embeddings: Optional[np.ndarray] = None, seed: int = 42,
+                 uid: Optional[str] = None, **params):
+        self.embeddings = np.asarray(embeddings, np.float32) \
+            if embeddings is not None else np.zeros((0, 0), np.float32)
+        self.seed = int(seed)
+        super().__init__(params.pop("operation_name", "w2v"), uid=uid,
+                         **params)
+
+    def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        from ..ops.embeddings import mean_pool_docs
+
+        return mean_pool_docs(cols[0].data, self.embeddings, seed=self.seed)
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(embeddings=self.embeddings, seed=self.seed)
+        return d
