@@ -38,7 +38,17 @@ class RecordInsightsCorr(Transformer):
     def _scores(pred_col: Column) -> np.ndarray:
         """Score per row: last probability column when present (P(class1)
         for binary), else the prediction itself. Prediction columns are
-        dense [pred, raw_*, prob_*] blocks with named metadata."""
+        dense [pred, raw_*, prob_*] blocks with named metadata; map-kind
+        columns of Prediction dicts (the row-level API boundary) are also
+        accepted."""
+        if pred_col.data.dtype == object:
+            out = np.empty(len(pred_col.data), np.float64)
+            for i, m in enumerate(pred_col.data):
+                prob_keys = sorted(
+                    (k for k in m if k.startswith("probability_")),
+                    key=lambda k: int(k.rsplit("_", 1)[1]))
+                out[i] = m[prob_keys[-1]] if prob_keys else m["prediction"]
+            return out
         data = np.asarray(pred_col.data, np.float64)
         if data.ndim == 1:
             return data
