@@ -69,12 +69,19 @@ def test_resume_skips_finished_cells(tmp_path, monkeypatch):
 
     # resume: fits must NOT run again
     calls = {"n": 0}
-    orig = OpGBTClassifier.fit_arrays
+    orig_fit = OpGBTClassifier.fit_arrays
+    orig_mask = OpGBTClassifier.mask_fit_scores
 
-    def spy(self, *a, **k):
+    def spy_fit(self, *a, **k):
         calls["n"] += 1
-        return orig(self, *a, **k)
-    monkeypatch.setattr(OpGBTClassifier, "fit_arrays", spy)
+        return orig_fit(self, *a, **k)
+
+    def spy_mask(self, *a, **k):
+        calls["n"] += 1
+        return orig_mask(self, *a, **k)
+    # GBT sweeps run through the mask-fold path; spy both fit entries
+    monkeypatch.setattr(OpGBTClassifier, "fit_arrays", spy_fit)
+    monkeypatch.setattr(OpGBTClassifier, "mask_fit_scores", spy_mask)
 
     cv2 = CrossValidation(BinaryClassificationEvaluator(), num_folds=2,
                           seed=7)
@@ -96,12 +103,19 @@ def test_different_seed_does_not_reuse(tmp_path, monkeypatch):
                 problem_type="binary")
 
     calls = {"n": 0}
-    orig = OpGBTClassifier.fit_arrays
+    orig_fit = OpGBTClassifier.fit_arrays
+    orig_mask = OpGBTClassifier.mask_fit_scores
 
-    def spy(self, *a, **k):
+    def spy_fit(self, *a, **k):
         calls["n"] += 1
-        return orig(self, *a, **k)
-    monkeypatch.setattr(OpGBTClassifier, "fit_arrays", spy)
+        return orig_fit(self, *a, **k)
+
+    def spy_mask(self, *a, **k):
+        calls["n"] += 1
+        return orig_mask(self, *a, **k)
+    # GBT sweeps run through the mask-fold path; spy both fit entries
+    monkeypatch.setattr(OpGBTClassifier, "fit_arrays", spy_fit)
+    monkeypatch.setattr(OpGBTClassifier, "mask_fit_scores", spy_mask)
     cv2 = CrossValidation(BinaryClassificationEvaluator(), num_folds=2,
                           seed=8)  # different folds -> stale metrics invalid
     cv2.checkpoint_path = path

@@ -173,8 +173,8 @@ FIT_PRODUCTS = {
     "PercentileCalibratorModel": "PercentileCalibrator",
     "SanityCheckerModel": "SanityChecker",
     "SmartTextModel": "SmartTextVectorizer",
-    "SoftmaxEnsembleModel": "OpGBTClassifier",   # multiclass ensembles
-    "SoftmaxModel": "OpLogisticRegression",       # multiclass GLM head
+    "SoftmaxEnsembleModel": "OpXGBoostClassifier",  # multiclass boosting
+    "SoftmaxModel": "OpLogisticRegression",         # multiclass GLM head
     "TreeEnsembleModel": "OpRandomForestClassifier",
 }
 
@@ -401,10 +401,27 @@ def test_registry_coverage():
         f"Add them to the sweep, FIT_PRODUCTS, or EXCLUDED (with a reason).")
 
 
-def test_fit_products_are_produced():
-    """The FIT_PRODUCTS map is honest: fitting each named estimator yields
-    the claimed model class (or a subclass)."""
+# model classes only produced when the label column is multiclass; the
+# default harness fixture is binary, so these are fitted separately below
+_MULTICLASS_PRODUCTS = {"SoftmaxModel", "SoftmaxEnsembleModel"}
+
+
+@pytest.mark.parametrize("model_name", sorted(FIT_PRODUCTS))
+def test_fit_products_are_produced(model_name):
+    """The FIT_PRODUCTS map is honest: fitting each named estimator on
+    harness data actually yields the claimed model class."""
     reg = stage_registry()
-    for model_name, est_name in sorted(FIT_PRODUCTS.items()):
-        assert est_name in reg, f"estimator {est_name} vanished from registry"
-        assert model_name in reg, f"model {model_name} vanished from registry"
+    est_name = FIT_PRODUCTS[model_name]
+    assert est_name in reg, f"estimator {est_name} vanished from registry"
+    assert model_name in reg, f"model {model_name} vanished from registry"
+    est_cls = reg[est_name]
+    stage, ds, feats, rows = build_stage_fixture(est_name, est_cls)
+    if model_name in _MULTICLASS_PRODUCTS:
+        # replace the binary label with a 3-class one
+        label_name = stage.input_names()[0]
+        vals = [float(i % 3) for i in range(N_ROWS)]
+        ds = ds.with_column(label_name, column_from_values(T.RealNN, vals))
+    model = stage.fit(ds)
+    assert isinstance(model, reg[model_name]), (
+        f"fitting {est_name} produced {type(model).__name__}, "
+        f"FIT_PRODUCTS claims {model_name}")

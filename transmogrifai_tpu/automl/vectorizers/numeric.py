@@ -54,6 +54,9 @@ class NumericVectorizer(SequenceVectorizer):
     """
 
     input_types = (Real,)
+    # fitted-model class; subclasses narrow it so save/load records the
+    # faithful class name (BinaryVectorizer -> BinaryVectorizerModel)
+    model_cls: Type["NumericVectorizerModel"]
 
     @classmethod
     def _declare_params(cls):
@@ -88,7 +91,7 @@ class NumericVectorizer(SequenceVectorizer):
         else:
             fills = np.full((X.shape[1],), float(self.get_param("fill_value")))
         track = self.get_param("track_nulls")
-        model = NumericVectorizerModel(
+        model = self.model_cls(
             fills=fills, track_nulls=track, operation_name=self.operation_name)
         model.set_metadata(self._make_metadata(track))
         return model
@@ -105,6 +108,9 @@ class NumericVectorizer(SequenceVectorizer):
         return VectorMetadata(name=self.output_name(), columns=cols)
 
 
+NumericVectorizer.model_cls = NumericVectorizerModel
+
+
 class BinaryVectorizerModel(NumericVectorizerModel):
     pass
 
@@ -114,6 +120,7 @@ class BinaryVectorizer(NumericVectorizer):
     (reference BinaryVectorizer.scala, BinaryFillValue=false)."""
 
     input_types = (Binary,)
+    model_cls = BinaryVectorizerModel
 
     def __init__(self, operation_name: str = "vecBin",
                  uid: Optional[str] = None, **params):

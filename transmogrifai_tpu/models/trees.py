@@ -127,6 +127,10 @@ class _TreeEstimator(PredictorEstimator):
     """Shared: quantile-bin on device, grow, freeze raw-value thresholds."""
 
     supports_grid_vmap = False
+    # validator fast path: folds enter as weight masks over one binned matrix
+    # (Validator._validate_mask_folds) — no per-fold host slicing. Bin edges
+    # then come from the full feature columns (labels never participate).
+    supports_mask_folds = True
 
     def _bin(self, X):
         n_bins = int(self.get_param("max_bins"))
@@ -134,6 +138,25 @@ class _TreeEstimator(PredictorEstimator):
         edges = T.quantile_edges(Xd, n_bins)
         Xb = T.bin_matrix(Xd, edges)
         return Xb, edges, n_bins
+
+    # -- mask-fold sweep protocol ------------------------------------------
+    def mask_sweep_context(self, X):
+        """Device-binned context shared by every (grid, fold) fit."""
+        return self._bin(X)
+
+    def mask_fit_scores(self, ctx, y, w, masks, n_classes: int = 2,
+                        multiclass: bool = False):
+        """[F, n] margins (binary/regression) or [F, n, c] class scores:
+        one vmapped-over-folds fit+predict per grid point, entirely on
+        device against the shared binned matrix. `multiclass` (the
+        validator's problem type, NOT n_classes — a multiclass sweep over
+        2-class data must still return [F, n, c]) picks the score shape."""
+        def one(m):
+            return self._mask_score(ctx, y, w * m, n_classes, multiclass)
+        return jax.vmap(one)(masks)
+
+    def _mask_score(self, ctx, y, w, n_classes, multiclass):
+        raise NotImplementedError
 
     def _freeze(self, trees: T.Tree, edges) -> Dict[str, np.ndarray]:
         feat = np.asarray(trees.feat)
@@ -175,6 +198,39 @@ def _feature_frac(strategy: str, n_feat: int, classification: bool) -> float:
 
 class _ForestBase(_TreeEstimator):
     classification = True
+
+    def _forest_cfg(self, n_feat: int) -> Dict[str, Any]:
+        return dict(
+            n_trees=int(self.get_param("num_trees")),
+            subsample=float(self.get_param("subsampling_rate")),
+            feature_frac=float(_feature_frac(
+                str(self.get_param("feature_subset_strategy")), n_feat,
+                self.classification)),
+            bootstrap=True)
+
+    def _mask_score(self, ctx, y, w, n_classes, multiclass):
+        Xb, edges, n_bins = ctx
+        cfg = self._forest_cfg(Xb.shape[1])
+        depth = int(self.get_param("max_depth"))
+        if self.classification:
+            G = jax.nn.one_hot(y.astype(jnp.int32), n_classes,
+                               dtype=jnp.float32) * w[:, None]
+        else:
+            G = (y * w)[:, None]
+        trees = T.fit_forest(
+            Xb, G, w, self._key(), depth=depth, n_bins=n_bins,
+            min_instances=float(self.get_param("min_instances_per_node")),
+            min_info_gain=float(self.get_param("min_info_gain")),
+            leaf_mode="mean", **cfg)
+        agg = T.predict_forest_bins(trees, Xb, depth)  # [n, K]
+        if not self.classification:
+            return agg[:, 0] / cfg["n_trees"]
+        prob = jnp.clip(agg / cfg["n_trees"], 0.0, None)
+        prob = prob / jnp.maximum(prob.sum(axis=1, keepdims=True), 1e-12)
+        if multiclass:
+            return prob  # [n, c] class scores (argmax = predicted class)
+        p1 = jnp.clip(prob[:, 1], 1e-7, 1.0 - 1e-7)
+        return jnp.log(p1 / (1.0 - p1))  # margin for the binary metrics
 
     @classmethod
     def _declare_params(cls):
@@ -256,6 +312,10 @@ class OpDecisionTreeClassifier(OpRandomForestClassifier):
     """Reference OpDecisionTreeClassifier (120 LoC): single tree, all
     features, no bagging."""
 
+    def _forest_cfg(self, n_feat: int) -> Dict[str, Any]:
+        return dict(n_trees=1, subsample=1.0, feature_frac=1.0,
+                    bootstrap=False)
+
     @classmethod
     def _declare_params(cls):
         return _single_tree_params()
@@ -280,6 +340,7 @@ class OpDecisionTreeRegressor(OpRandomForestRegressor):
     """Reference OpDecisionTreeRegressor (119 LoC)."""
 
     _fit_forest = OpDecisionTreeClassifier._fit_forest
+    _forest_cfg = OpDecisionTreeClassifier._forest_cfg
 
     @classmethod
     def _declare_params(cls):
@@ -304,6 +365,8 @@ class _GBTBase(_TreeEstimator):
             Param("seed", "rng seed", 42),
         ]
 
+    _loss = "logistic"  # subclass override; used by the mask-fold sweep
+
     def _fit_gbt(self, X, y, w, loss):
         Xb, edges, n_bins = self._bin(X)
         trees, base = T.fit_gbt(
@@ -316,6 +379,20 @@ class _GBTBase(_TreeEstimator):
             subsample=float(self.get_param("subsampling_rate")),
             loss=loss)
         return self._freeze(trees, edges), float(base)
+
+    def _mask_score(self, ctx, y, w, n_classes, multiclass):
+        Xb, edges, n_bins = ctx
+        depth = int(self.get_param("max_depth"))
+        trees, base = T.fit_gbt(
+            Xb, y, w, self._key(),
+            n_rounds=int(self.get_param("max_iter")), depth=depth,
+            n_bins=n_bins,
+            learning_rate=float(self.get_param("step_size")),
+            min_instances=float(self.get_param("min_instances_per_node")),
+            min_info_gain=float(self.get_param("min_info_gain")),
+            subsample=float(self.get_param("subsampling_rate")),
+            loss=self._loss)
+        return base + T.predict_forest_bins(trees, Xb, depth)[:, 0]
 
 
 class OpGBTClassifier(_GBTBase):
@@ -339,6 +416,7 @@ class OpGBTRegressor(_GBTBase):
 
     problem_types = ("regression",)
     produces_probabilities = False
+    _loss = "squared"
 
     def __init__(self, uid: Optional[str] = None, **params):
         super().__init__("gbtRegressor", uid=uid, **params)
@@ -376,6 +454,31 @@ class _XGBBase(_TreeEstimator):
             gamma=float(self.get_param("gamma")),
             subsample=float(self.get_param("subsample")),
             feature_frac=float(self.get_param("colsample_bytree")))
+
+    _regression = False
+
+    def _mask_score(self, ctx, y, w, n_classes, multiclass):
+        Xb, edges, n_bins = ctx
+        kw = self._common()
+        depth = kw["depth"]
+        if self._regression or not multiclass:
+            loss = "squared" if self._regression else "logistic"
+            trees, base = T.fit_gbt(Xb, y, w, self._key(), n_bins=n_bins,
+                                    loss=loss, **kw)
+            return base + T.predict_forest_bins(trees, Xb, depth)[:, 0]
+        trees = T.fit_gbt_softmax(Xb, y, w, self._key(), n_bins=n_bins,
+                                  n_classes=n_classes, **kw)
+
+        # trees carry leading [rounds, classes] axes with K=1 payloads;
+        # per-class margin = sum over rounds (mirrors the training step)
+        def per_round(carry, tree_c):
+            step = jax.vmap(
+                lambda t: T.predict_bins(t, Xb, depth)[:, 0])(tree_c)
+            return carry + step.T, None
+
+        init = jnp.zeros((Xb.shape[0], n_classes), jnp.float32)
+        margins, _ = jax.lax.scan(per_round, init, trees)
+        return margins  # [n, c]
 
 
 class OpXGBoostClassifier(_XGBBase):
@@ -416,6 +519,7 @@ class OpXGBoostRegressor(_XGBBase):
 
     problem_types = ("regression",)
     produces_probabilities = False
+    _regression = True
 
     def __init__(self, uid: Optional[str] = None, **params):
         super().__init__("xgbRegressor", uid=uid, **params)
