@@ -1,185 +1,665 @@
-"""Headline benchmark: ModelSelector CV sweep wall-clock.
+"""Headline benchmark: the BASELINE.json workloads, measured end to end.
 
-The reference's north-star workload (BASELINE.json): a
-BinaryClassificationModelSelector sweep — folds x hyperparameter-grid
-logistic fits + AuPR scoring — over an HBM-resident feature matrix
-(reference inner loop: core/.../impl/tuning/OpValidator.scala:270-312, one
-Spark fit per (model, grid, fold) on 8 driver threads).
+North-star (BASELINE.json config 5): a BinaryClassificationModelSelector
+sweep — 5-fold CV x 64 model configurations (48 logistic-regression grid
+points + 16 XGBoost-style histogram-GBT configs) over a 10M x 64 feature
+matrix. Reference inner loop: core/.../impl/tuning/OpValidator.scala:270-312
+(one Spark fit per (model, grid, fold) on 8 driver threads).
 
-Here the whole sweep is ONE XLA program (vmap over folds x grid, Newton
-solves on the MXU). The baseline stand-in is the same sweep, fit
-sequentially with host-BLAS numpy on a row subsample and scaled to full
-size — an optimistic proxy for the reference's Spark-local path (which adds
-JVM/DataFrame overhead on top of BLAS).
+Device path = the framework's own validator: the GLM grid runs as chunked
+vmapped XLA programs (bf16 X, f32 solver state), trees run mask-fold fits
+against a once-binned matrix. The host baseline is MEASURED at the full row
+count (per-config cost x config count — configs within a family are
+cost-identical by construction), not extrapolated from a subsample; numpy's
+multithreaded BLAS makes it a GENEROUS stand-in for the reference's
+Spark-local path (which adds JVM/DataFrame overhead on top of the same
+BLAS). vs_baseline_8thread additionally divides by the reference's
+8-thread pool for the most conservative comparison.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Also measured: MFU from XLA's own cost analysis, an AuPR parity delta
+between the device sweep winner and the same config fit on host, the
+wide-transmogrify config (vectorized host transforms vs a reference-shaped
+per-row loop), and the three helloworld example flows.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+A watchdog emits the partial JSON if the time budget expires mid-phase.
 """
 from __future__ import annotations
 
+import contextlib
+import io
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
 
 import numpy as np
 
-N_ROWS = 1_000_000
-N_COLS = 64
-FOLDS = 5
-GRID = 16
-CPU_FALLBACK_ROWS = 100_000  # reduced size when the TPU tunnel is down
-BASELINE_SUB = 50_000  # numpy baseline row subsample (scaled up linearly)
-NEWTON_ITERS = 15
-PROBE_TIMEOUT_S = 90  # first TPU backend init can be slow; hang = tunnel down
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2400"))
+PROBE_TIMEOUT_S = 90
+
+TPU_CFG = dict(n_rows=10_000_000, n_cols=64, folds=5, glm_grid=48,
+               gbt_grid=16, gbt_rounds=10, gbt_depth=6, gbt_bins=32,
+               wide_rows=1_000_000)
+# CPU fallback records liveness when the TPU tunnel is down, not a perf
+# claim — sized so the whole bench finishes in a few minutes
+CPU_CFG = dict(n_rows=200_000, n_cols=64, folds=5, glm_grid=12,
+               gbt_grid=4, gbt_rounds=5, gbt_depth=4, gbt_bins=32,
+               wide_rows=60_000)
+
+# peak bf16 TFLOP/s by device kind substring (ordered: most specific first)
+PEAK_BF16 = [("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),
+             ("v4", 275e12), ("v3", 123e12), ("v2", 46e12)]
+
+RESULT: dict = {"metric": "cv_sweep_wall", "value": -1.0, "unit": "s",
+                "vs_baseline": 0.0}
+_T0 = time.time()
+
+
+def emit_and_exit(signum=None, frame=None):
+    RESULT.setdefault("errors", []).append("time budget expired; partial run")
+    print(json.dumps(RESULT), flush=True)
+    os._exit(0)
+
+
+def remaining() -> float:
+    return BUDGET_S - (time.time() - _T0)
+
+
+def log(msg: str) -> None:
+    print(f"[bench +{time.time() - _T0:6.1f}s] {msg}", file=sys.stderr,
+          flush=True)
 
 
 def probe_backend(timeout=PROBE_TIMEOUT_S, retries=1):
     """Initialize the jax backend in a SUBPROCESS with a hard timeout.
 
     Round-1 failure mode: this environment's sitecustomize dials a TPU
-    tunnel on first backend init; when the tunnel is down, init either hangs
-    forever (MULTICHIP_r01 rc=124) or raises (BENCH_r01 rc=1). Probing in a
-    killable child process means the bench itself can never hang, and a
-    failed probe downgrades to the CPU backend instead of producing nothing.
+    tunnel on first backend init; when the tunnel is down, init either
+    hangs forever (MULTICHIP_r01 rc=124) or raises (BENCH_r01 rc=1).
+    Probing in a killable child means the bench itself can never hang, and
+    a failed probe downgrades to the CPU backend instead of producing
+    nothing.
     """
-    code = "import jax; print('BACKEND=' + jax.default_backend())"
-    for _ in range(retries):
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu", "cpu"  # caller pinned the platform; nothing to probe
+    code = ("import jax; d=jax.devices()[0]; "
+            "print('BACKEND='+jax.default_backend()+'|'+d.device_kind)")
+    for _ in range(retries + 1):
         try:
-            r = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=timeout)
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout)
         except subprocess.TimeoutExpired:
             continue
         if r.returncode == 0:
             for line in r.stdout.splitlines():
                 if line.startswith("BACKEND="):
-                    return line.split("=", 1)[1]
-    return None
+                    backend, _, kind = line[8:].partition("|")
+                    return backend, kind
+    return None, ""
+
+
+# -- data -------------------------------------------------------------------
+
+def truth_beta(d):
+    """Ground-truth coefficients shared by the device draw and the host
+    twin, so both fits chase the SAME population optimum (the AuPR parity
+    probe depends on this)."""
+    rng = np.random.default_rng(123)
+    return (rng.normal(size=d) / np.sqrt(d)).astype(np.float32)
 
 
 def make_data(n, d, seed=0):
     rng = np.random.default_rng(seed)
     X = rng.normal(size=(n, d)).astype(np.float32)
-    beta = (rng.normal(size=d) / np.sqrt(d)).astype(np.float32)
-    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-(X @ beta)))).astype(np.float32)
-    fold = rng.integers(0, FOLDS, size=n)
-    masks = np.stack([(fold != k).astype(np.float32) for k in range(FOLDS)])
-    regs = np.logspace(-4, -0.5, GRID).astype(np.float32)
-    return X, y, masks, regs
+    logits = X @ truth_beta(d)
+    y = (rng.uniform(size=n) < 1 / (1 + np.exp(-logits))).astype(np.float32)
+    return X, y
 
 
-def device_sweep_seconds(X, y, masks, regs):
+def device_data(n, d, folds, dtype):
+    """Generate the sweep data ON DEVICE (one XLA program) — over a remote
+    TPU tunnel this avoids shipping a multi-GB host matrix through the
+    wire; the host baseline uses an independently drawn twin of the same
+    distribution (its cost is data-independent: fixed-iteration solvers).
+    Same key + static dtype means X can be regenerated bit-identically in
+    another precision later."""
     import jax
     import jax.numpy as jnp
-    from transmogrifai_tpu.ops.glm import fit_logistic
-    from transmogrifai_tpu.ops import metrics_ops as M
 
-    @jax.jit
-    def sweep(X, y, masks, regs):
-        w = jnp.ones(X.shape[0], jnp.float32)
+    beta_np = truth_beta(d)
 
-        def one(mask, reg):
-            beta, b0 = fit_logistic(X, y, mask * w, reg, 0.0)
-            score = X @ beta + b0
-            return M.au_pr(score, y, (1.0 - mask) * w)
+    def gen(key):
+        kx, _, ku, kf = jax.random.split(key, 4)
+        X = jax.random.normal(kx, (n, d), jnp.float32)
+        p = jax.nn.sigmoid(X @ jnp.asarray(beta_np))
+        y = (jax.random.uniform(ku, (n,)) < p).astype(jnp.float32)
+        fold = jax.random.randint(kf, (n,), 0, folds)
+        masks = (fold[None, :]
+                 != jnp.arange(folds)[:, None]).astype(jnp.float32)
+        return X.astype(dtype), y, masks
 
-        return jax.vmap(lambda m: jax.vmap(lambda r: one(m, r))(regs))(masks)
+    X, y, masks = jax.jit(gen)(jax.random.PRNGKey(0))
+    jax.block_until_ready((X, y, masks))
+    return X, y, masks
 
-    Xd, yd, md, rd = map(jax.device_put, (X, y, masks, regs))
-    # NB: time to host materialization, not block_until_ready — under remote
-    # device tunnels readiness can resolve before execution completes; the
-    # [FOLDS, GRID] result is tiny so the readback adds only RPC latency
-    np.asarray(sweep(Xd, yd, md, rd))  # compile + warm
+
+def glm_grids(g):
+    regs = np.logspace(-4, -0.5, max(g // 3, 1))
+    out = [{"reg_param": float(r), "elastic_net_param": a}
+           for r in regs for a in (0.0, 0.25, 0.5)]
+    return out[:g]
+
+
+def gbt_grids(cfg):
+    out = [{"num_round": cfg["gbt_rounds"], "max_depth": d, "eta": e,
+            "reg_lambda": l, "max_bins": cfg["gbt_bins"]}
+           for d in (cfg["gbt_depth"] - 2, cfg["gbt_depth"])
+           for e in (0.05, 0.1, 0.2, 0.3) for l in (1.0, 5.0)]
+    return out[:cfg["gbt_grid"]]
+
+
+# -- device sweeps (the framework's own validator paths) --------------------
+
+def device_sweeps(X, y, cfg, sweep_dtype):
+    import jax.numpy as jnp
+    from transmogrifai_tpu.automl.tuning.validators import CrossValidation
+    from transmogrifai_tpu.evaluators.evaluators import Evaluators
+    from transmogrifai_tpu.models.glm import OpLogisticRegression
+    from transmogrifai_tpu.models.trees import OpXGBoostClassifier
+
+    ev = Evaluators.BinaryClassification.au_pr()
+    val = CrossValidation(ev, num_folds=cfg["folds"], seed=42,
+                          sweep_dtype=sweep_dtype)
+    # synthetic standard-normal features: standardization is a statistical
+    # no-op; skipping it avoids a per-lane [n, d] standardized copy
+    lr = OpLogisticRegression(max_iter=15, standardization=False)
+    ggrids = glm_grids(cfg["glm_grid"])
+    tgrids = gbt_grids(cfg)
+
+    log(f"GLM sweep: {len(ggrids)} grids x {cfg['folds']} folds")
     t0 = time.perf_counter()
-    out = np.asarray(sweep(Xd, yd, md, rd))
-    dt = time.perf_counter() - t0
-    aupr = float(out.mean(axis=0).max())
-    return dt, aupr
+    best_glm = val.validate([(lr, [dict(g) for g in ggrids])], X, y)
+    glm_s = time.perf_counter() - t0
+    log(f"GLM sweep done in {glm_s:.2f}s (incl. compile)")
+
+    xgb = OpXGBoostClassifier()
+    log(f"tree sweep: {len(tgrids)} configs x {cfg['folds']} folds")
+    t0 = time.perf_counter()
+    best_tree = val.validate([(xgb, [dict(g) for g in tgrids])], X, y)
+    tree_s = time.perf_counter() - t0
+    log(f"tree sweep done in {tree_s:.2f}s")
+
+    best = best_glm if best_glm.best_metric >= best_tree.best_metric \
+        else best_tree
+    return dict(glm_s=glm_s, tree_s=tree_s,
+                glm_fits=len(ggrids) * cfg["folds"],
+                tree_fits=len(tgrids) * cfg["folds"],
+                best_name=best.name, best_grid=best.best_grid,
+                best_au_pr=float(best.best_metric))
 
 
-def numpy_fit_logistic(X, y, w, reg, iters=NEWTON_ITERS):
+def glm_flops_estimate(cfg):
+    """XLA-countable FLOPs for the GLM sweep (per Newton iteration: score
+    matmul 2nd, gram matmul 2nd^2, plus elementwise ~6n; 15 iterations)."""
+    n, d = cfg["n_rows"], cfg["n_cols"]
+    per_iter = 2 * n * d + 2 * n * d * d + 6 * n
+    fits = cfg["glm_grid"] * cfg["folds"]
+    return per_iter * 15 * fits
+
+
+def tree_flops_cost_analysis(cfg, sweep_dtype):
+    """Ask XLA itself for the per-fit FLOPs of one GBT config (AOT lowering
+    hits the jit cache when shapes match the sweep's)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        from transmogrifai_tpu.ops import trees as T
+        n, d = cfg["n_rows"], cfg["n_cols"]
+        Xb = jax.ShapeDtypeStruct((n, d), jnp.int32)
+        y = jax.ShapeDtypeStruct((n,), jnp.float32)
+        w = jax.ShapeDtypeStruct((n,), jnp.float32)
+        key = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        lowered = T.fit_gbt.lower(
+            Xb, y, w, key, n_rounds=cfg["gbt_rounds"],
+            depth=cfg["gbt_depth"], n_bins=cfg["gbt_bins"])
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0))
+    except Exception as e:  # cost analysis is best-effort
+        log(f"tree cost_analysis unavailable: {e}")
+        return 0.0
+
+
+# -- host baselines (measured at FULL size) ---------------------------------
+
+def numpy_fit_logistic(X, y, w, reg, iters=15):
+    """Newton IRLS with f32 BLAS matmuls (f64 d x d solve). f32 sgemm is
+    ~2x dgemm throughput, making this baseline FASTER — i.e. the
+    vs_baseline ratio more conservative — than the reference's netlib
+    path, and halving host RAM at the 10M-row config."""
     n, d = X.shape
-    beta = np.zeros(d, np.float64)
+    beta = np.zeros(d, np.float32)
     b0 = 0.0
-    Xw = X.astype(np.float64)
+    Xw = np.ascontiguousarray(X, np.float32)
     for _ in range(iters):
         m = Xw @ beta + b0
-        p = 1 / (1 + np.exp(-m))
-        g = w * (p - y)
-        h = np.maximum(w * p * (1 - p), 1e-6)
+        p = 1 / (1 + np.exp(-np.clip(m, -30, 30)))
+        g = (w * (p - y)).astype(np.float32)
+        h = np.maximum(w * p * (1 - p), 1e-6).astype(np.float32)
         Xh = Xw * h[:, None]
-        H = Xw.T @ Xh + reg * np.sum(w) * np.eye(d)
-        gb = Xw.T @ g + reg * np.sum(w) * beta
-        beta -= np.linalg.solve(H, gb)
+        H = (Xw.T @ Xh).astype(np.float64) + reg * np.sum(w) * np.eye(d)
+        gb = (Xw.T @ g).astype(np.float64) + reg * np.sum(w) * beta
+        beta = (beta - np.linalg.solve(H, gb)).astype(np.float32)
         b0 -= g.sum() / h.sum()
-    return beta, b0
+    return beta.astype(np.float64), float(b0)
 
 
 def numpy_au_pr(score, y, w):
+    keep = w > 0
+    score, y = score[keep], y[keep]
     order = np.argsort(-score)
-    y, w = y[order], w[order]
-    tp = np.cumsum(w * y)
-    fp = np.cumsum(w * (1 - y))
+    y = y[order]
+    tp = np.cumsum(y)
+    fp = np.cumsum(1 - y)
     prec = tp / np.maximum(tp + fp, 1e-12)
     rec = tp / max(tp[-1], 1e-12)
-    return float(np.trapezoid(prec, rec) if hasattr(np, "trapezoid")
-                 else np.trapz(prec, rec))
+    dr = np.diff(rec, prepend=0.0)
+    return float((dr * prec).sum())
 
 
-def baseline_sweep_seconds(X, y, masks, regs):
-    """Sequential numpy sweep on a subsample, scaled to N_ROWS."""
-    n_sub = min(BASELINE_SUB, X.shape[0])
-    Xs, ys = X[:n_sub], y[:n_sub]
-    ms = masks[:, :n_sub]
+def baseline_glm(X, y, masks, cfg, n_measure=2):
+    """Per-fit cost measured at full rows (configs in the logistic grid are
+    cost-identical: same matmuls, fixed iterations); total = cost x fits."""
+    w = masks[0]
+    times = []
+    for i in range(n_measure):
+        t0 = time.perf_counter()
+        numpy_fit_logistic(X, y, w, 0.01)
+        times.append(time.perf_counter() - t0)
+        log(f"baseline GLM fit {i}: {times[-1]:.2f}s")
+    per_fit = float(np.median(times))
+    fits = cfg["glm_grid"] * cfg["folds"]
+    return per_fit, per_fit * fits
+
+
+def numpy_gbt_round(Xb, g, h, depth, n_bins):
+    """One boosting round of histogram GBT in numpy (reference-shaped host
+    compute): level-wise, per-feature bincount histograms, best-gain split."""
+    n, F = Xb.shape
+    node = np.zeros(n, np.int32)
+    feats = []
+    threshs = []
+    for lvl in range(depth):
+        n_nodes = 1 << lvl
+        best_gain = np.full(n_nodes, -np.inf)
+        best_f = np.zeros(n_nodes, np.int32)
+        best_t = np.zeros(n_nodes, np.int32)
+        for f in range(F):
+            idx = node * n_bins + Xb[:, f]
+            gh = np.bincount(idx, weights=g, minlength=n_nodes * n_bins)
+            hh = np.bincount(idx, weights=h, minlength=n_nodes * n_bins)
+            gh = gh.reshape(n_nodes, n_bins)
+            hh = hh.reshape(n_nodes, n_bins)
+            gl = np.cumsum(gh, axis=1)
+            hl = np.cumsum(hh, axis=1)
+            gt = gl[:, -1:]
+            ht = hl[:, -1:]
+            gain = (gl ** 2 / np.maximum(hl + 1.0, 1e-6)
+                    + (gt - gl) ** 2 / np.maximum(ht - hl + 1.0, 1e-6)
+                    - gt ** 2 / np.maximum(ht + 1.0, 1e-6))
+            fb = np.argmax(gain, axis=1)
+            fg = np.take_along_axis(gain, fb[:, None], 1)[:, 0]
+            upd = fg > best_gain
+            best_gain = np.where(upd, fg, best_gain)
+            best_f = np.where(upd, f, best_f)
+            best_t = np.where(upd, fb, best_t)
+        feats.append(best_f)
+        threshs.append(best_t)
+        node = 2 * node + (Xb[np.arange(n), best_f[node]]
+                           > best_t[node]).astype(np.int32)
+    leaves = 1 << depth
+    gl = np.bincount(node, weights=g, minlength=leaves)
+    hl = np.bincount(node, weights=h, minlength=leaves)
+    return -gl / (hl + 1.0 + 1e-6), node
+
+
+def baseline_gbt(X, y, masks, cfg):
+    """One full boosting ROUND measured at full rows (rounds are
+    cost-identical); total = round cost x rounds x configs x folds, plus the
+    one-time binning cost per (config, fold)."""
     t0 = time.perf_counter()
-    for k in range(FOLDS):
-        w = ms[k]
-        for reg in regs:
-            beta, b0 = numpy_fit_logistic(Xs, ys, w, float(reg))
-            numpy_au_pr(Xs @ beta + b0, ys, 1.0 - w)
-    dt = time.perf_counter() - t0
-    return dt * (X.shape[0] / n_sub)
+    edges = np.quantile(X[:: max(1, len(X) // 200_000)],
+                        np.linspace(0, 1, cfg["gbt_bins"] + 1)[1:-1], axis=0)
+    Xb = np.empty(X.shape, np.int32)
+    for f in range(X.shape[1]):
+        Xb[:, f] = np.searchsorted(edges[:, f], X[:, f], side="right")
+    bin_s = time.perf_counter() - t0
+    log(f"baseline GBT binning: {bin_s:.2f}s")
 
+    w = masks[0]
+    margin = np.zeros(len(y), np.float64)
+    p = 1 / (1 + np.exp(-margin))
+    g = w * (p - y)
+    h = np.maximum(w * p * (1 - p), 1e-6)
+    t0 = time.perf_counter()
+    numpy_gbt_round(Xb, g, h, cfg["gbt_depth"], cfg["gbt_bins"])
+    round_s = time.perf_counter() - t0
+    log(f"baseline GBT round: {round_s:.2f}s")
+    fits = cfg["gbt_grid"] * cfg["folds"]
+    total = (round_s * cfg["gbt_rounds"] + bin_s) * fits
+    return round_s, total
+
+
+def aupr_parity(Xh, yh, masks_h, best_grid, Xd, yd):
+    """Statistical-parity probe: fit the winning config on device (its own
+    10M draw) AND on host (the host twin) with the SAME fold-0 training
+    mask as weights, then score the SAME host data with both coefficient
+    vectors and compare exact AuPR. Both fits see the same fraction of the
+    same distribution, so the betas converge to the same population
+    optimum; the delta isolates solver disagreement."""
+    from transmogrifai_tpu.models.glm import OpLogisticRegression
+
+    w = masks_h[0]
+    reg = float(best_grid.get("reg_param", 0.01))
+    alpha = float(best_grid.get("elastic_net_param", 0.0))
+    est = OpLogisticRegression(max_iter=15, standardization=False,
+                               reg_param=reg, elastic_net_param=alpha)
+    model = est.fit_arrays(Xd, yd, w=w)  # device fit, fold-0 train mask
+    dev_beta = np.asarray(model.beta, np.float64)
+    dev_b0 = float(model.intercept)
+    host_beta, host_b0 = numpy_fit_logistic(Xh, yh, w, reg)
+    val_w = 1.0 - w
+    a_dev = numpy_au_pr(Xh @ dev_beta + dev_b0, yh, val_w)
+    a_host = numpy_au_pr(Xh @ host_beta + host_b0, yh, val_w)
+    return abs(a_dev - a_host), a_host, a_dev
+
+
+# -- wide transmogrify ------------------------------------------------------
+
+def make_wide_rows(n, seed=2):
+    rng = np.random.default_rng(seed)
+    cats_a = [f"cat{i}" for i in range(50)]
+    cats_b = [f"seg{i}" for i in range(12)]
+    words = ["alpha", "beta", "gamma", "delta", "omega", "sigma", "tau"]
+    cols = {
+        "plA": rng.choice(cats_a, size=n),
+        "plB": rng.choice(cats_b, size=n),
+        "txt": np.array([" ".join(rng.choice(words, size=5))
+                         for _ in range(n // 100)])[
+                             rng.integers(0, max(n // 100, 1), size=n)],
+        "r1": rng.normal(size=n),
+        "r2": np.where(rng.uniform(size=n) < 0.1, np.nan, rng.normal(size=n)),
+        "dt": (1_500_000_000_000
+               + rng.integers(0, 10**9, size=n)).astype(np.int64),
+        "m1": rng.normal(size=n),  # map keys k0/k1 assembled below
+        "m2": rng.normal(size=n),
+    }
+    return cols
+
+
+def wide_transmogrify(n):
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    from transmogrifai_tpu.data.dataset import Dataset
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.types import (
+        Date, Integral, PickList, Real, RealMap, Text,
+    )
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    cols = make_wide_rows(n)
+    maps = np.empty(n, dtype=object)
+    for i in range(n):
+        maps[i] = {"k0": cols["m1"][i], "k1": cols["m2"][i]}
+    ds = Dataset.from_features([
+        ("plA", PickList, cols["plA"].tolist()),
+        ("plB", PickList, cols["plB"].tolist()),
+        ("txt", Text, cols["txt"].tolist()),
+        ("r1", Real, cols["r1"].tolist()),
+        ("r2", Real, [None if np.isnan(v) else float(v)
+                      for v in cols["r2"]]),
+        ("dt", Date, cols["dt"].tolist()),
+        ("mp", RealMap, list(maps)),
+    ])
+    feats = [
+        FeatureBuilder.PickList("plA").extract(lambda r: r.get("plA")).as_predictor(),
+        FeatureBuilder.PickList("plB").extract(lambda r: r.get("plB")).as_predictor(),
+        FeatureBuilder.Text("txt").extract(lambda r: r.get("txt")).as_predictor(),
+        FeatureBuilder.Real("r1").extract(lambda r: r.get("r1")).as_predictor(),
+        FeatureBuilder.Real("r2").extract(lambda r: r.get("r2")).as_predictor(),
+        FeatureBuilder.Date("dt").extract(lambda r: r.get("dt")).as_predictor(),
+        FeatureBuilder.RealMap("mp").extract(lambda r: r.get("mp")).as_predictor(),
+    ]
+    vec = transmogrify(feats)
+    wf = Workflow().set_input_dataset(ds).set_result_features(vec)
+    t0 = time.perf_counter()
+    model = wf.train()
+    fit_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    scored = model.score(ds)
+    score_s = time.perf_counter() - t0
+    width = scored.column(vec.name).data.shape[1]
+
+    # reference-shaped baseline: per-row python closure loop (the fused
+    # rdd.map of FitStagesUtil.applyOpTransformations:96) producing the
+    # SAME output width — 512-dim text hashing, one-hot + null columns,
+    # circular date features, per-key map expansion. Measured on the same
+    # rows with a time cap; per-row cost is constant so the cap-scale is
+    # exact arithmetic, and measured_rows is reported.
+    import math
+    vocab_a = {c: i for i, c in enumerate(sorted(set(cols["plA"])))}
+    vocab_b = {c: i for i, c in enumerate(sorted(set(cols["plB"])))}
+    t0 = time.perf_counter()
+    cap = min(120.0, max(remaining() - 60.0, 10.0))
+    done = 0
+    two_pi = 2 * math.pi
+    for i in range(n):
+        row = []
+        oh = [0.0] * (len(vocab_a) + 2)  # topK + OTHER + null
+        oh[vocab_a.get(cols["plA"][i], len(vocab_a))] = 1.0
+        row += oh
+        oh = [0.0] * (len(vocab_b) + 2)
+        oh[vocab_b.get(cols["plB"][i], len(vocab_b))] = 1.0
+        row += oh
+        toks = cols["txt"][i].lower().split()
+        hv = [0.0] * 512  # TransmogrifierDefaults.DefaultNumOfFeatures
+        for t in toks:
+            hv[hash(t) % 512] += 1.0
+        row += hv
+        row += [cols["r1"][i], 0.0]
+        v = cols["r2"][i]
+        isnan = v != v
+        row += [0.0 if isnan else v, 1.0 if isnan else 0.0]
+        ts = cols["dt"][i] / 86_400_000.0
+        for period in (1.0, 7.0, 30.4375, 365.25):
+            row += [math.sin(two_pi * ts / period),
+                    math.cos(two_pi * ts / period)]
+        row += [cols["m1"][i], 0.0, cols["m2"][i], 0.0]
+        done = i + 1
+        if (i & 1023) == 0 and time.perf_counter() - t0 > cap:
+            break
+    loop_s = (time.perf_counter() - t0) * (n / done)
+    return dict(rows=n, fit_s=round(fit_s, 3), score_s=round(score_s, 3),
+                vector_width=int(width),
+                rows_per_s=int(n / max(score_s, 1e-9)),
+                row_loop_s=round(loop_s, 3),
+                row_loop_measured_rows=done,
+                vs_row_loop=round(loop_s / max(score_s, 1e-9), 2))
+
+
+# -- cpu-subprocess phases --------------------------------------------------
+# Tiny example flows and the host-transform-dominated wide bench dispatch
+# hundreds of small programs; over a remote TPU tunnel every dispatch pays
+# an RPC, so they run in CPU-backend child processes (the number being
+# measured — host transform throughput / end-to-end capability — is the
+# same) with hard timeouts so no phase can starve the headline metric.
+
+def run_subprocess_phase(args, timeout_s):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # keep the axon sitecustomize off the child's path (it dials the TPU
+    # tunnel at interpreter start — round-1 hang)
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)] + args,
+                       capture_output=True, text=True, timeout=timeout_s,
+                       env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+    if r.returncode != 0:
+        raise RuntimeError(f"phase {args} rc={r.returncode}: "
+                           f"{r.stderr.strip()[-300:]}")
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def run_example(mod_name):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "examples"))
+    sys.argv = sys.argv[:1]  # examples parse argv (CSV path arg)
+    import importlib
+    t0 = time.perf_counter()
+    with contextlib.redirect_stdout(io.StringIO()):
+        mod = importlib.import_module(mod_name)
+        mod.main()
+    return time.perf_counter() - t0
+
+
+# -- main -------------------------------------------------------------------
 
 def main():
-    backend = probe_backend()
-    error = None
-    n_rows = N_ROWS
-    if backend is None or backend == "cpu":
-        # TPU tunnel down (or image has no accelerator): run the same sweep
-        # on the CPU backend at reduced size so a perf number is ALWAYS
-        # recorded. Forcing the platform before first backend init avoids
-        # the hanging axon dial entirely.
-        from transmogrifai_tpu.utils.platform import force_cpu
+    # subcommands executed in CPU child processes
+    if len(sys.argv) > 2 and sys.argv[1] == "--wide":
+        print(json.dumps(wide_transmogrify(int(sys.argv[2]))))
+        return
+    if len(sys.argv) > 2 and sys.argv[1] == "--example":
+        print(json.dumps({"s": round(run_example(sys.argv[2]), 2)}))
+        return
 
+    signal.signal(signal.SIGALRM, emit_and_exit)
+    signal.alarm(max(int(BUDGET_S) - 30, 60))
+
+    backend, kind = probe_backend()
+    errors = []
+    RESULT["errors"] = errors
+    if backend is None or backend == "cpu":
+        from transmogrifai_tpu.utils.platform import force_cpu
         force_cpu(1)
         if backend is None:
-            error = "tpu backend unreachable; cpu fallback at reduced size"
-        backend = "cpu"
-        n_rows = CPU_FALLBACK_ROWS
+            errors.append("tpu backend unreachable; cpu fallback at "
+                          "reduced size")
+        backend, kind = "cpu", kind or "cpu"
+        cfg = dict(CPU_CFG)
+        sweep_dtype = None  # f32 — CPU matmuls have no bf16 units
+    else:
+        cfg = dict(TPU_CFG)
+        import jax.numpy as jnp
+        sweep_dtype = jnp.bfloat16
+    RESULT.update(backend=backend, device_kind=kind, n_rows=cfg["n_rows"],
+                  config=f"{cfg['glm_grid']}+{cfg['gbt_grid']} models x "
+                         f"{cfg['folds']} folds")
+    log(f"backend={backend} kind={kind} cfg={cfg}")
 
-    X, y, masks, regs = make_data(n_rows, N_COLS)
-    dev_s, aupr = device_sweep_seconds(X, y, masks, regs)
-    base_s = baseline_sweep_seconds(X, y, masks, regs)
-    out = {
-        "metric": f"cv_sweep_{n_rows//1000}k_rows_{FOLDS}x{GRID}_wall",
-        "value": round(dev_s, 4),
-        "unit": "s",
-        "vs_baseline": round(base_s / dev_s, 2),
-        "backend": backend,
-        "au_pr": round(aupr, 4),
+    # 1. headline sweep — data generated ON DEVICE (no tunnel transfer)
+    import jax.numpy as jnp
+    t0 = time.perf_counter()
+    Xd, yd, _ = device_data(cfg["n_rows"], cfg["n_cols"],
+                            cfg["folds"], sweep_dtype or jnp.float32)
+    log(f"device data gen: {time.perf_counter() - t0:.2f}s")
+
+    sweep = device_sweeps(Xd, yd, cfg, sweep_dtype)
+    device_s = sweep["glm_s"] + sweep["tree_s"]
+    RESULT.update(metric=f"cv_sweep_{cfg['n_rows'] / 1e6:g}m_rows_"
+                         f"{cfg['glm_grid'] + cfg['gbt_grid']}"
+                         f"model_{cfg['folds']}fold_wall",
+                  value=round(device_s, 3), sweep=sweep)
+
+    # 2. MFU
+    glm_flops = glm_flops_estimate(cfg)
+    tree_flops = tree_flops_cost_analysis(cfg, sweep_dtype) \
+        * cfg["gbt_grid"] * cfg["folds"]
+    peak = next((p for s, p in PEAK_BF16 if s in kind.lower()), None)
+    mfu = {"glm_tflops_analytic": round(glm_flops / 1e12, 2),
+           "tree_tflops_xla": round(tree_flops / 1e12, 2),
+           "achieved_tflops_per_s": round(
+               (glm_flops + tree_flops) / device_s / 1e12, 2)}
+    if peak and backend == "tpu":
+        mfu["peak_bf16_tflops"] = peak / 1e12
+        mfu["mfu"] = round((glm_flops + tree_flops) / device_s / peak, 4)
+    RESULT["mfu"] = mfu
+
+    # 3. measured host baseline (independent same-distribution twin; fixed
+    # iteration counts make the cost data-independent)
+    log(f"host twin gen {cfg['n_rows']} x {cfg['n_cols']}")
+    Xh, yh = make_data(cfg["n_rows"], cfg["n_cols"], seed=1)
+    rng = np.random.default_rng(7)
+    fold = rng.integers(0, cfg["folds"], size=cfg["n_rows"])
+    masks_h = np.stack([(fold != k).astype(np.float32)
+                        for k in range(cfg["folds"])])
+    glm_fit_s, glm_total = baseline_glm(Xh, yh, masks_h, cfg)
+    gbt_round_s, gbt_total = baseline_gbt(Xh, yh, masks_h, cfg)
+    base_total = glm_total + gbt_total
+    RESULT["baseline"] = {
+        "total_s": round(base_total, 1),
+        "glm_fit_s_measured": round(glm_fit_s, 2),
+        "gbt_round_s_measured": round(gbt_round_s, 2),
+        "method": ("sequential host numpy/BLAS (multithreaded); per-fit / "
+                   "per-round cost measured at the FULL row count, totals "
+                   "are cost x config x fold counts (configs within a "
+                   "family are cost-identical). Generous vs Spark-local: "
+                   "no JVM/DataFrame overhead counted."),
     }
-    if error:
-        out["error"] = error
-    print(json.dumps(out))
+    RESULT["vs_baseline"] = round(base_total / device_s, 2)
+    RESULT["vs_baseline_8thread"] = round(base_total / 8 / device_s, 2)
+
+    # 4. AuPR parity: device-trained vs host-trained winner coefficients
+    # scored on the SAME host data
+    try:
+        if "reg_param" in sweep["best_grid"] and remaining() > 120:
+            delta, a_host, a_dev = aupr_parity(
+                Xh, yh, masks_h, sweep["best_grid"], Xd, yd)
+            RESULT["sweep"]["au_pr_host_fit"] = round(a_host, 4)
+            RESULT["sweep"]["au_pr_device_fit"] = round(a_dev, 4)
+            RESULT["sweep"]["au_pr_parity_delta"] = round(delta, 4)
+    except Exception as e:
+        errors.append(f"parity: {type(e).__name__}: {e}")
+    del Xh, Xd  # free 2 x [n, d] before the host-heavy phases
+
+    # 5. wide transmogrify + example configs, in CPU children
+    configs = {}
+    try:
+        if remaining() > 240:
+            configs["wide_transmogrify"] = run_subprocess_phase(
+                ["--wide", str(cfg["wide_rows"])],
+                min(remaining() - 120, 600))
+        else:
+            errors.append("wide_transmogrify skipped: budget")
+    except Exception as e:
+        errors.append(f"wide: {type(e).__name__}: {str(e)[:200]}")
+    for key, mod in (("titanic_s", "op_titanic_simple"),
+                     ("iris_s", "op_iris"), ("boston_s", "op_boston")):
+        try:
+            if remaining() > 90:
+                configs[key] = run_subprocess_phase(
+                    ["--example", mod], min(remaining() - 40, 240))["s"]
+                log(f"{mod}: {configs[key]}s")
+            else:
+                errors.append(f"{mod} skipped: budget")
+        except Exception as e:
+            errors.append(f"{mod}: {type(e).__name__}: {str(e)[:200]}")
+    RESULT["configs"] = configs
+
+    if not errors:
+        RESULT.pop("errors", None)
+    signal.alarm(0)
+    print(json.dumps(RESULT), flush=True)
 
 
 if __name__ == "__main__":
     try:
         main()
     except Exception as e:  # never exit without a parseable JSON line
-        print(json.dumps({
-            "metric": "cv_sweep_wall", "value": -1.0, "unit": "s",
-            "vs_baseline": 0.0, "error": f"{type(e).__name__}: {e}"}))
-        sys.exit(0)  # the error field conveys failure; keep rc parseable-green
+        RESULT.setdefault("errors", []).append(
+            f"{type(e).__name__}: {e}")
+        print(json.dumps(RESULT), flush=True)
+        sys.exit(0)  # the error field conveys failure; keep rc green

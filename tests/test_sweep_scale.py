@@ -262,6 +262,26 @@ def test_workflow_train_kill_and_resume(tmp_path, monkeypatch):
     assert winner(model) == winner(model_ref)
 
 
+def test_checkpoint_does_not_cross_sweep_paths(tmp_path):
+    """Metrics from the mask-fold path must NOT be replayed into a
+    physically-split rerun (they can differ enough to flip the winner) —
+    the checkpoint key carries the compute path."""
+    X, y = _binary_data(700, d=4, seed=47)
+    grids = [{"step_size": 0.2, "max_iter": 5, "max_depth": 3}]
+    ev = Evaluators.BinaryClassification.au_pr()
+    ck = str(tmp_path / "sweep.jsonl")
+    v1 = V.CrossValidation(ev, num_folds=2, seed=5)
+    v1.checkpoint_path = ck
+    v1.validate([(OpGBTClassifier(), [dict(g) for g in grids])], X, y)
+    n_records = len(open(ck).read().splitlines())
+
+    v2 = V.CrossValidation(ev, num_folds=2, seed=5, mask_fold_trees=False)
+    v2.checkpoint_path = ck
+    v2.validate([(OpGBTClassifier(), [dict(g) for g in grids])], X, y)
+    assert len(open(ck).read().splitlines()) == 2 * n_records, \
+        "sequential rerun must compute its own cells, not reuse mask-fold's"
+
+
 def test_mask_fold_sweep_honors_max_bins_grid():
     """max_bins is itself a grid axis: the binned context must be rebuilt
     per distinct value, not frozen from the base estimator."""

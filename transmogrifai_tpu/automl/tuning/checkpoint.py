@@ -38,12 +38,16 @@ def data_fingerprint(X, y) -> str:
 
 def sweep_key(model_class: str, grid: Dict[str, Any], n_folds: int,
               seed: int, stratify: bool, metric: str,
-              data_fp: str = "", base_params: Optional[Dict[str, Any]] = None
-              ) -> str:
+              data_fp: str = "", base_params: Optional[Dict[str, Any]] = None,
+              path: str = "") -> str:
     payload = json.dumps(
         {"model": model_class, "grid": {k: grid[k] for k in sorted(grid)},
          "folds": n_folds, "seed": seed, "stratify": stratify,
          "metric": metric, "data": data_fp,
+         # compute path + its statistically relevant knobs (e.g.
+         # "mask_folds" vs "sequential" tree fits, sweep dtype) — metrics
+         # from different paths are not interchangeable
+         "path": path,
          "base": {k: base_params[k] for k in sorted(base_params)}
          if base_params else {}},
         sort_keys=True, default=str)

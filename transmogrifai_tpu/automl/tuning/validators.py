@@ -259,12 +259,18 @@ class Validator:
             return max(1, int(self.grid_chunk))
         lane_bytes = max(n * d * itemsize, 1)
         lanes = max(int(SWEEP_LANE_BUDGET_BYTES / lane_bytes), 1)
-        return int(np.clip(lanes // max(n_folds, 1), 1, n_grids))
+        # cap: total vmap lanes also scale XLA compile time — past ~8 grid
+        # points per program the compile cost outweighs the dispatch savings
+        return int(np.clip(lanes // max(n_folds, 1), 1, min(n_grids, 8)))
 
-    def _cell_bookkeeping(self, est, grids, X, y, metric, n_folds):
-        """(checkpoint, per-grid keys, finished results) — cell-level records
-        shared by every sweep path, so vmapped, mask-fold, and sequential
-        sweeps all resume from the same file."""
+    def _cell_bookkeeping(self, est, grids, X, y, metric, n_folds,
+                          path: str = ""):
+        """(checkpoint, per-grid keys, finished results) — cell-level
+        records shared across resumes of the SAME sweep path. `path` names
+        the compute path and its statistically relevant knobs (mask-fold
+        vs physically-split binning, sweep dtype): metrics from one path
+        must never be replayed into another, since they can legitimately
+        differ enough to flip the winner."""
         from .checkpoint import data_fingerprint, sweep_key
         ckpt = self._checkpoint()
         if ckpt is None:
@@ -274,7 +280,8 @@ class Validator:
             else None
         keys = [sweep_key(type(est).__name__, g, n_folds,
                           self.seed, self.stratify, metric,
-                          data_fp=data_fp, base_params=base_params)
+                          data_fp=data_fp, base_params=base_params,
+                          path=path)
                 for g in grids]
         results = {}
         for gi, key in enumerate(keys):
@@ -303,11 +310,12 @@ class Validator:
                            else 0.0 for g in grids], np.float32)
         margin_thr = self._margin_threshold(est)
 
+        dtype = self.sweep_dtype or jnp.float32
         ckpt, keys, results = self._cell_bookkeeping(
-            est, grids, X, y, metric, masks.shape[0])
+            est, grids, X, y, metric, masks.shape[0],
+            path=f"vmapped:{jnp.dtype(dtype).name}")
         pending = [gi for gi in range(len(grids)) if gi not in results]
         if pending:
-            dtype = self.sweep_dtype or jnp.float32
             Xd = jnp.asarray(X, dtype)
             yd = jnp.asarray(y, jnp.float32)
             wd = jnp.asarray(w, jnp.float32)
@@ -354,7 +362,7 @@ class Validator:
         n_classes = int(np.max(y)) + 1 if problem_type == "multiclass" else 2
         margin_thr = self._margin_threshold(est)
         ckpt, keys, results = self._cell_bookkeeping(
-            est, grids, X, y, metric, masks.shape[0])
+            est, grids, X, y, metric, masks.shape[0], path="mask_folds")
         pending = [gi for gi in range(len(grids)) if gi not in results]
         if pending:
             yd = jnp.asarray(y, jnp.float32)
@@ -363,16 +371,6 @@ class Validator:
             rank_bins = self._rank_bins(X.shape[0])
             mfn = _metric_fn(problem_type, metric, n_classes, rank_bins)
             thr_d = jnp.asarray(margin_thr, jnp.float32)
-            # the binned context depends on max_bins, which may itself be a
-            # grid axis — bin once per distinct value, not once per sweep
-            ctx_cache: Dict[Any, Any] = {}
-
-            def ctx_for(est_g):
-                key = est_g.get_param("max_bins") \
-                    if est_g.has_param("max_bins") else None
-                if key not in ctx_cache:
-                    ctx_cache[key] = est_g.mask_sweep_context(X)
-                return ctx_cache[key]
 
             @jax.jit
             def fold_metrics(scores, y_, w_, m_, t_):
@@ -380,17 +378,35 @@ class Validator:
                     return mfn(s, y_, (1.0 - m) * w_, t_)
                 return jax.vmap(per_fold)(scores, m_)
 
+            # the binned context depends on max_bins, which may itself be a
+            # grid axis — group grids by value and bin once per GROUP,
+            # releasing each multi-GB [n, d] binned matrix before the next
+            # (three live contexts at the 10M config would eat the HBM
+            # budget the lane chunker assumes)
+            def bins_of(gi):
+                g = grids[gi]
+                if "max_bins" in g:
+                    return g["max_bins"]
+                return est.get_param("max_bins") \
+                    if est.has_param("max_bins") else None
+
+            groups: Dict[Any, List[int]] = {}
             for gi in pending:
-                est_g = est.copy(**grids[gi])
-                scores = est_g.mask_fit_scores(
-                    ctx_for(est_g), yd, wd, md, n_classes=n_classes,
-                    multiclass=(problem_type == "multiclass"))  # [F, n(, c)]
-                out = np.asarray(fold_metrics(scores, yd, wd, md, thr_d))
-                fm = [float(v) for v in out]
-                results[gi] = fm
-                if ckpt is not None:
-                    ckpt.record(keys[gi], type(est).__name__, grids[gi],
-                                fm, metric)
+                groups.setdefault(bins_of(gi), []).append(gi)
+            for _, group in sorted(groups.items(), key=lambda kv: str(kv[0])):
+                ctx = est.copy(**grids[group[0]]).mask_sweep_context(X)
+                for gi in group:
+                    est_g = est.copy(**grids[gi])
+                    scores = est_g.mask_fit_scores(
+                        ctx, yd, wd, md, n_classes=n_classes,
+                        multiclass=(problem_type == "multiclass"))
+                    out = np.asarray(fold_metrics(scores, yd, wd, md, thr_d))
+                    fm = [float(v) for v in out]
+                    results[gi] = fm
+                    if ckpt is not None:
+                        ckpt.record(keys[gi], type(est).__name__, grids[gi],
+                                    fm, metric)
+                del ctx  # free the binned matrix before the next group
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
                            grid=g, metric_name=metric,
@@ -409,7 +425,7 @@ class Validator:
                              ) -> List[ValidatedModel]:
         metric = self.evaluator.default_metric
         ckpt, keys, results = self._cell_bookkeeping(
-            est, grids, X, y, metric, masks.shape[0])
+            est, grids, X, y, metric, masks.shape[0], path="sequential")
         for gi, g in enumerate(grids):
             if gi in results:
                 continue

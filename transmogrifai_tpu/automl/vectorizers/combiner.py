@@ -28,13 +28,21 @@ class VectorsCombiner(VectorizerModel):
         super().__init__(operation_name, uid=uid, **params)
 
     def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        # single preallocated f32 pass — input vector columns are already
+        # f32, so each part is one slice copy, never an f64 round-trip
+        n = len(cols[0]) if cols else 0
         mats = []
         for c in cols:
             m = c.data
             if m.ndim == 1:
                 m = m[:, None]
-            mats.append(np.asarray(m, dtype=np.float64))
-        return np.concatenate(mats, axis=1)
+            mats.append(m)
+        out = np.empty((n, sum(m.shape[1] for m in mats)), np.float32)
+        at = 0
+        for m in mats:
+            out[:, at:at + m.shape[1]] = m
+            at += m.shape[1]
+        return out
 
     def transform_columns(self, *cols: Column) -> Column:
         parts: List[VectorMetadata] = []

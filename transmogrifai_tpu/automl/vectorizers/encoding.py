@@ -60,7 +60,7 @@ def pivot_block_single(data: Sequence[Any], vocab: Sequence[str],
     n = len(data)
     k = len(vocab)
     width = k + 1 + (1 if track_nulls else 0)
-    block = np.zeros((n, width), dtype=np.float64)
+    block = np.zeros((n, width), dtype=np.float32)
     if n == 0:
         return block
     uniq, inv, nm = factorize(data)
@@ -81,7 +81,7 @@ def pivot_block_multi(data: Sequence[Any], vocab: Sequence[str],
     n = len(data)
     k = len(vocab)
     width = k + 1 + (1 if track_nulls else 0)
-    block = np.zeros((n, width), dtype=np.float64)
+    block = np.zeros((n, width), dtype=np.float32)
     if n == 0:
         return block
     lengths = np.fromiter((len(v) if v else 0 for v in data), np.int64, n)

@@ -82,19 +82,20 @@ void tmog_hash_strings(const uint8_t* buf, const int64_t* offsets, int64_t n,
 
 // token stream -> per-doc hashed counts.
 // buf/tok_offsets: [n_tokens+1] packed tokens; doc_tok_counts: [n_docs]
-// tokens per document. out: [n_docs * bins] float64, caller-zeroed.
+// tokens per document. out: [n_docs * bins] float32, caller-zeroed
+// (float: the block feeds the f32 device matrix; counts fit exactly).
 void tmog_hash_tokens_to_counts(const uint8_t* buf, const int64_t* tok_offsets,
                                 const int64_t* doc_tok_counts, int64_t n_docs,
-                                int64_t bins, uint32_t seed, double* out) {
+                                int64_t bins, uint32_t seed, float* out) {
   int64_t t = 0;
   for (int64_t d = 0; d < n_docs; d++) {
-    double* row = out + d * bins;
+    float* row = out + d * bins;
     const int64_t end = t + doc_tok_counts[d];
     for (; t < end; t++) {
       const uint32_t h = tmog_murmur3_32(buf + tok_offsets[t],
                                          tok_offsets[t + 1] - tok_offsets[t],
                                          seed);
-      row[h % bins] += 1.0;
+      row[h % bins] += 1.0f;
     }
   }
 }
@@ -103,14 +104,14 @@ void tmog_hash_tokens_to_counts(const uint8_t* buf, const int64_t* tok_offsets,
 
 // ASCII-lowercase tokenizer matching transformers/text.tokenize_text:
 // tokens are maximal runs of [A-Za-z0-9'], lowercased, len >= min_len.
-// docs packed in buf with [n_docs+1] offsets; out: [n_docs * bins] float64,
+// docs packed in buf with [n_docs+1] offsets; out: [n_docs * bins] float32,
 // caller-zeroed. This is the whole text->tensor hot loop in one pass.
 void tmog_tokenize_hash_counts(const uint8_t* buf, const int64_t* doc_offsets,
                                int64_t n_docs, int64_t bins, uint32_t seed,
-                               int64_t min_len, double* out) {
+                               int64_t min_len, float* out) {
   uint8_t tok[256];
   for (int64_t d = 0; d < n_docs; d++) {
-    double* row = out + d * bins;
+    float* row = out + d * bins;
     const uint8_t* p = buf + doc_offsets[d];
     const uint8_t* end = buf + doc_offsets[d + 1];
     int64_t tlen = 0;
@@ -124,7 +125,7 @@ void tmog_tokenize_hash_counts(const uint8_t* buf, const int64_t* doc_offsets,
       } else {
         if (tlen >= min_len) {
           const uint32_t h = tmog_murmur3_32(tok, tlen, seed);
-          row[h % bins] += 1.0;
+          row[h % bins] += 1.0f;
         }
         tlen = 0;
       }

@@ -71,7 +71,7 @@ def hash_tokens_to_counts(token_lists: Sequence[Optional[Sequence[str]]],
             return np.minimum(out, 1.0) if binary else out
     except ImportError:
         pass
-    out = np.zeros((len(token_lists), num_bins), dtype=np.float64)
+    out = np.zeros((len(token_lists), num_bins), dtype=np.float32)
     for i, toks in enumerate(token_lists):
         if not toks:
             continue

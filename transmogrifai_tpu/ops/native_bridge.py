@@ -37,6 +37,7 @@ def _load() -> Optional[ctypes.CDLL]:
     u8p = ctypes.POINTER(ctypes.c_uint8)
     i64p = ctypes.POINTER(ctypes.c_int64)
     f64p = ctypes.POINTER(ctypes.c_double)
+    f32p = ctypes.POINTER(ctypes.c_float)
     u32p = ctypes.POINTER(ctypes.c_uint32)
 
     lib.tmog_murmur3_32.restype = ctypes.c_uint32
@@ -47,11 +48,11 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.tmog_hash_tokens_to_counts.restype = None
     lib.tmog_hash_tokens_to_counts.argtypes = [
         u8p, i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint32,
-        f64p]
+        f32p]
     lib.tmog_tokenize_hash_counts.restype = None
     lib.tmog_tokenize_hash_counts.argtypes = [
         u8p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint32,
-        ctypes.c_int64, f64p]
+        ctypes.c_int64, f32p]
     lib.tmog_csv_scan.restype = ctypes.c_int64
     lib.tmog_csv_scan.argtypes = [u8p, ctypes.c_int64, ctypes.c_uint8,
                                   i64p, ctypes.c_int64, i64p, ctypes.c_int64,
@@ -111,9 +112,13 @@ def native_hash_strings(strings: Sequence[str], seed: int = 0
     return out
 
 
+def _as_f32p(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
 def native_hash_tokens(token_lists: Sequence[Optional[Sequence[str]]],
                        num_bins: int, seed: int = 0) -> Optional[np.ndarray]:
-    """[rows of token lists] -> [n, bins] float64 counts, or None."""
+    """[rows of token lists] -> [n, bins] float32 counts, or None."""
     lib = _load()
     if lib is None:
         return None
@@ -124,24 +129,24 @@ def native_hash_tokens(token_lists: Sequence[Optional[Sequence[str]]],
             counts[i] = len(toks)
             flat.extend(toks)
     buf, offsets = _pack_strings(flat)
-    out = np.zeros((len(token_lists), num_bins), np.float64)
+    out = np.zeros((len(token_lists), num_bins), np.float32)
     lib.tmog_hash_tokens_to_counts(
         _as_u8p(buf), _as_i64p(offsets), _as_i64p(counts),
-        len(token_lists), num_bins, seed, _as_f64p(out))
+        len(token_lists), num_bins, seed, _as_f32p(out))
     return out
 
 
 def native_tokenize_hash_counts(docs: Sequence[Optional[str]], num_bins: int,
                                 seed: int = 0, min_len: int = 1
                                 ) -> Optional[np.ndarray]:
-    """Fused tokenize+hash+count over raw documents -> [n, bins] float64."""
+    """Fused tokenize+hash+count over raw documents -> [n, bins] float32."""
     lib = _load()
     if lib is None:
         return None
     buf, offsets = _pack_strings([d or "" for d in docs])
-    out = np.zeros((len(docs), num_bins), np.float64)
+    out = np.zeros((len(docs), num_bins), np.float32)
     lib.tmog_tokenize_hash_counts(_as_u8p(buf), _as_i64p(offsets), len(docs),
-                                  num_bins, seed, min_len, _as_f64p(out))
+                                  num_bins, seed, min_len, _as_f32p(out))
     return out
 
 
