@@ -1,0 +1,155 @@
+"""DecisionTreeNumericMapBucketizer + the date/map/geo/set dsl breadth.
+
+Reference: core/.../impl/feature/DecisionTreeNumericMapBucketizer.scala
+(170 LoC) and core/.../dsl/{RichDateFeature, RichMapFeature,
+RichLocationFeature, RichVectorFeature}.scala.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.data.dataset import Dataset, column_from_values
+from transmogrifai_tpu.testkit.feature_builder import TestFeatureBuilder
+from transmogrifai_tpu.transformers.misc import (
+    DateToListTransformer, DateToUnitCircleTransformer,
+    DecisionTreeNumericMapBucketizer, FilterMapKeys,
+)
+from transmogrifai_tpu.types import (
+    Date, DateTime, Geolocation, RealMap, RealNN,
+)
+
+
+def _map_fixture(n=400, seed=3):
+    """k0 predicts the label with a boundary at 0; k1 is noise; k2 is
+    missing half the time."""
+    rng = np.random.default_rng(seed)
+    rows, labels = [], []
+    for i in range(n):
+        x = float(rng.normal())
+        m = {"k0": x, "k1": float(rng.normal())}
+        if i % 2 == 0:
+            m["k2"] = float(rng.normal())
+        rows.append(m)
+        labels.append(float(x > 0))
+    return TestFeatureBuilder.build(
+        ("label", RealNN, labels), ("mp", RealMap, rows), response_index=0)
+
+
+def test_map_bucketizer_finds_signal_key_splits():
+    ds, (label, mp) = _map_fixture()
+    est = DecisionTreeNumericMapBucketizer(max_splits=7).set_input(label, mp)
+    model = est.fit(ds)
+    by_key = dict(zip(model.keys, model.splits_per_key))
+    assert set(model.keys) == {"k0", "k1", "k2"}
+    assert len(by_key["k0"]) >= 1, "informative key must split"
+    assert any(abs(s) < 0.25 for s in by_key["k0"]), \
+        f"boundary should be near 0, got {by_key['k0']}"
+
+    out = model.transform(ds)
+    col = out.column(model.output_name())
+    md_names = [c.grouping for c in col.metadata.columns]
+    assert col.data.shape[1] == len(col.metadata.columns)
+    assert {"k0", "k1", "k2"} == set(md_names)
+    # null indicator for k2 fires on the odd rows
+    null_idx = [i for i, c in enumerate(col.metadata.columns)
+                if c.grouping == "k2" and c.indicator_value == "NullIndicatorValue"]
+    assert len(null_idx) == 1
+    assert col.data[1, null_idx[0]] == 1.0
+    assert col.data[0, null_idx[0]] == 0.0
+
+
+def test_map_bucketizer_row_parity_and_roundtrip(tmp_path):
+    from transmogrifai_tpu.stages.registry import (
+        build_stage, pack_args, unpack_args,
+    )
+    ds, (label, mp) = _map_fixture(200)
+    model = DecisionTreeNumericMapBucketizer().set_input(label, mp).fit(ds)
+    col = model.transform(ds).column(model.output_name())
+    for i in (0, 1, 7):
+        row = {"label": ds.data("label")[i], "mp": ds.data("mp")[i]}
+        rv = model.transform_keyvalue(dict(row))
+        np.testing.assert_allclose(np.asarray(rv), col.data[i], atol=1e-6)
+    store = {}
+    packed = pack_args(model.save_args(), store, model.uid)
+    rebuilt = build_stage(type(model).__name__, unpack_args(packed, store))
+    rebuilt.set_input(label, mp)
+    rebuilt.set_output_name(model.output_name())
+    np.testing.assert_allclose(
+        rebuilt.transform(ds).column(model.output_name()).data, col.data)
+
+
+def test_filter_map_keys():
+    ds, (label, mp) = _map_fixture(50)
+    f = FilterMapKeys(block=["k1"]).set_input(mp)
+    out = f.transform(ds).column(f.output_name())
+    assert all("k1" not in (m or {}) for m in out.data)
+    assert f.transform_keyvalue({"mp": {"k0": 1.0, "k1": 2.0}}) == {"k0": 1.0}
+    f2 = FilterMapKeys(allow=["k2"]).set_input(mp)
+    out2 = f2.transform(ds).column(f2.output_name())
+    assert all(set(m or {}) <= {"k2"} for m in out2.data)
+
+
+# -- dsl breadth --------------------------------------------------------------
+
+def test_dsl_date_ops():
+    ms = [1_500_000_000_000 + 3_600_000 * i for i in range(48)]
+    ds, (dt,) = TestFeatureBuilder.build(("dt", Date, ms))
+    circ = dt.to_unit_circle("HourOfDay")
+    stage = circ.origin_stage
+    col = stage.transform(ds).column(stage.output_name())
+    assert col.data.shape == (48, 2)
+    np.testing.assert_allclose((col.data ** 2).sum(axis=1), 1.0, atol=1e-5)
+    # 24h later = same point on the circle
+    np.testing.assert_allclose(col.data[0], col.data[24], atol=1e-5)
+
+    dl = dt.to_date_list()
+    assert dl.type_name == "DateList"
+    lst_col = dl.origin_stage.transform(ds).column(dl.name)
+    assert lst_col.data[3] == [ms[3]]
+
+    vec = dt.vectorize_dates()
+    assert vec.type_name == "OPVector"
+
+
+def test_dsl_datetime_to_list_narrows():
+    ds, (dt,) = TestFeatureBuilder.build(
+        ("ts", DateTime, [1_500_000_000_000]))
+    assert dt.to_date_list().type_name == "DateTimeList"
+
+
+def test_dsl_map_and_geo_ops():
+    ds, (label, mp) = _map_fixture(80)
+    filtered = mp.filter_keys(block=["k1"])
+    assert filtered.type_name == "RealMap"
+    vec = mp.vectorize_map()
+    assert vec.type_name == "OPVector"
+    bucketed = mp.autobucketize_map(label, max_splits=3)
+    assert bucketed.origin_stage.fit(ds) is not None
+
+    gds, (geo,) = TestFeatureBuilder.build(
+        ("loc", Geolocation, [[37.4, -122.1, 5.0], [40.7, -74.0, 3.0]]))
+    gvec = geo.vectorize_geo()
+    assert gvec.type_name == "OPVector"
+    gmodel = gvec.origin_stage.fit(gds)
+    assert gmodel.transform(gds).column(gmodel.output_name()).data.shape[0] == 2
+
+
+def test_dsl_vector_combine_and_descale():
+    ds, (a, b) = TestFeatureBuilder.build(
+        ("a", RealNN, [1.0, 2.0]), ("b", RealNN, [3.0, 4.0]))
+    from transmogrifai_tpu.transformers.misc import ScalerTransformer
+    scaler = ScalerTransformer(scaling_type="linear", slope=2.0,
+                               intercept=1.0)
+    scaled = scaler.set_input(a).get_output()
+    descaled = b.descale(scaled, scaler=scaler)
+    st = descaled.origin_stage
+    sds = scaler.transform(ds)
+    out = st.transform(sds).column(st.output_name())
+    np.testing.assert_allclose(out.data, [(3.0 - 1.0) / 2.0,
+                                          (4.0 - 1.0) / 2.0])
+
+    va = a.vectorize()
+    vb = b.vectorize()
+    combined = va.combine_with(vb)
+    assert combined.type_name == "OPVector"

@@ -154,6 +154,7 @@ FIT_PRODUCTS = {
     "DateListVectorizerModel": "DateListVectorizer",
     "DateVectorizerModel": "DateVectorizer",
     "DecisionTreeNumericBucketizerModel": "DecisionTreeNumericBucketizer",
+    "DecisionTreeNumericMapBucketizerModel": "DecisionTreeNumericMapBucketizer",
     "FillMissingWithMeanModel": "FillMissingWithMean",
     "GeolocationModel": "GeolocationVectorizer",
     "HashingModel": "TextListHashingVectorizer",

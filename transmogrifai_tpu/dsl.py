@@ -220,6 +220,86 @@ def _jaccard_similarity(self, other: Feature):
     return JaccardSimilarity().set_input(self, other).get_output()
 
 
+# -- dates (RichDateFeature) -------------------------------------------------
+
+def _to_unit_circle(self, time_period: str = "HourOfDay"):
+    """Date -> [sin, cos] of a calendar period (RichDateFeature
+    .toUnitCircle:68)."""
+    from .transformers.misc import DateToUnitCircleTransformer
+    return DateToUnitCircleTransformer(time_period=time_period) \
+        .set_input(self).get_output()
+
+
+def _to_date_list(self):
+    """Date -> DateList / DateTime -> DateTimeList (RichDateFeature
+    .toDateList:54)."""
+    from .transformers.misc import DateToListTransformer
+    return DateToListTransformer().set_input(self).get_output()
+
+
+def _vectorize_dates(self, *others, **kwargs):
+    """Date features -> circular-encoded vector (RichDateFeature
+    .vectorize:97)."""
+    from .automl.vectorizers.dates import DateVectorizer
+    return DateVectorizer(**kwargs).set_input(self, *others).get_output()
+
+
+# -- maps (RichMapFeature) ---------------------------------------------------
+
+def _filter_keys(self, allow: Optional[Sequence[str]] = None,
+                 block: Optional[Sequence[str]] = None):
+    """Keep/drop map keys (RichMapFeature.filter:58 whiteList/blackList)."""
+    from .transformers.misc import FilterMapKeys
+    return FilterMapKeys(allow=allow, block=block) \
+        .set_input(self).get_output()
+
+
+def _vectorize_map(self, *others, **kwargs):
+    """Per-key map vectorization dispatched on the map's type
+    (RichMapFeature.vectorize overloads)."""
+    from .automl.transmogrifier import TransmogrifierDefaults
+    from .automl.vectorizers.maps import map_vectorizer_for
+    stage = map_vectorizer_for(self.type_name, TransmogrifierDefaults)
+    for k, v in kwargs.items():
+        stage.set_param(k, v)
+    return stage.set_input(self, *others).get_output()
+
+
+def _autobucketize_map(self, label: Feature, **kwargs):
+    """Label-aware bucketization of every numeric map key
+    (RichMapFeature.autoBucketize:542 ->
+    DecisionTreeNumericMapBucketizer)."""
+    from .transformers.misc import DecisionTreeNumericMapBucketizer
+    return DecisionTreeNumericMapBucketizer(**kwargs) \
+        .set_input(label, self).get_output()
+
+
+# -- geolocation (RichLocationFeature) ---------------------------------------
+
+def _vectorize_geo(self, *others, **kwargs):
+    """Geolocation -> mean-imputed (lat, lon, acc) block
+    (RichLocationFeature.vectorize:63)."""
+    from .automl.vectorizers.geo import GeolocationVectorizer
+    return GeolocationVectorizer(**kwargs).set_input(self, *others) \
+        .get_output()
+
+
+# -- vector (RichVectorFeature) ----------------------------------------------
+
+def _combine_with(self, *others):
+    """Concatenate OPVector features (RichVectorFeature combine)."""
+    from .automl.vectorizers.combiner import VectorsCombiner
+    return VectorsCombiner().set_input(self, *others).get_output()
+
+
+def _descale(self, scaled_source: Feature, scaler=None):
+    """Invert a ScalerTransformer's scaling (RichVectorFeature
+    .descale:1113)."""
+    from .transformers.misc import DescalerTransformer
+    return DescalerTransformer(scaler=scaler) \
+        .set_input(self, scaled_source).get_output()
+
+
 # -- vectorize / check (RichFeaturesCollection) ------------------------------
 
 def _vectorize(self, **kwargs):
@@ -265,6 +345,12 @@ def install() -> None:
         "jaccard_similarity": _jaccard_similarity,
         "vectorize": _vectorize, "pivot": _pivot,
         "sanity_check": _sanity_check, "loco_insights": _loco_insights,
+        "to_unit_circle": _to_unit_circle, "to_date_list": _to_date_list,
+        "vectorize_dates": _vectorize_dates,
+        "filter_keys": _filter_keys, "vectorize_map": _vectorize_map,
+        "autobucketize_map": _autobucketize_map,
+        "vectorize_geo": _vectorize_geo,
+        "combine_with": _combine_with, "descale": _descale,
     }
     for name, fn in ops.items():
         setattr(Feature, name, fn)
