@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# CI recipe (reference: .circleci/config.yml:35-62 — style -> compile ->
+# parallel test). The TPU-native equivalents:
+#   1. lint-ish import check (no compile step in pure Python; the native
+#      kernel library builds on demand and must compile cleanly)
+#   2. full pytest on an 8-device virtual CPU mesh (tests/conftest.py sets
+#      XLA_FLAGS=--xla_force_host_platform_device_count=8 — the analogue of
+#      the reference testing distribution on local[2] Spark)
+#   3. the three helloworld example flows
+#   4. driver-contract smoke: dryrun_multichip + a reduced-size bench that
+#      must emit one parseable JSON line
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== 1/4 import + native kernel build =="
+python - <<'PY'
+import transmogrifai_tpu
+from transmogrifai_tpu.ops import native_bridge
+print("package import ok; native kernels:",
+      "built" if native_bridge.available() else "UNAVAILABLE (numpy fallbacks)")
+PY
+
+echo "== 2/4 test suite (8-device virtual CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== 3/4 examples =="
+for ex in op_titanic_simple op_iris op_boston; do
+  JAX_PLATFORMS=cpu python "examples/${ex}.py" > /dev/null
+  echo "  ${ex} ok"
+done
+
+echo "== 4/4 driver-contract smoke =="
+python - <<'PY'
+import __graft_entry__ as g
+g.dryrun_multichip(8)
+PY
+JAX_PLATFORMS=cpu BENCH_BUDGET_S=600 python bench.py | python - <<'PY'
+import json, sys
+line = sys.stdin.read().strip().splitlines()[-1]
+out = json.loads(line)
+assert {"metric", "value", "unit", "vs_baseline"} <= set(out), out
+print("bench JSON ok:", out["metric"], out["value"], out["unit"])
+PY
+
+echo "CI GREEN"

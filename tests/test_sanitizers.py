@@ -1,0 +1,100 @@
+"""Sanitizer subsystem: NaN/Inf trapping, finiteness audit, purity laws.
+
+SURVEY §5 race-detection/sanitizers row — the compiled-pipeline analogues
+of the reference's closure-serializability checks (OpWorkflow.scala:265).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.data.dataset import Dataset, column_from_values
+from transmogrifai_tpu.testkit.feature_builder import TestFeatureBuilder
+from transmogrifai_tpu.types import Real, RealNN
+from transmogrifai_tpu.utils.sanitizers import (
+    assert_stage_pure, check_finite, debug_nans,
+)
+
+
+def test_debug_nans_traps_and_restores():
+    import jax
+    import jax.numpy as jnp
+    prev = jax.config.jax_debug_nans
+    with debug_nans():
+        with pytest.raises(FloatingPointError):
+            jnp.asarray(0.0) / jnp.asarray(0.0)
+    assert jax.config.jax_debug_nans == prev
+    # NaN passes silently outside the scope
+    assert np.isnan(float(jnp.asarray(0.0) / jnp.asarray(0.0)))
+
+
+def test_check_finite_flags_vector_defects_not_missing():
+    from transmogrifai_tpu.data.dataset import Column
+    from transmogrifai_tpu.types import ColumnKind
+    vec = np.array([[1.0, np.nan], [np.inf, 2.0]], np.float32)
+    ds = Dataset({
+        "num": column_from_values(Real, [1.0, None, 3.0]),
+    })
+    ds2 = Dataset({
+        "num": column_from_values(Real, [1.0, None]),
+        "vec": Column(kind=ColumnKind.VECTOR, data=vec),
+    })
+    assert check_finite(ds) == {}  # NaN in a Real column = missing, fine
+    rep = check_finite(ds2)
+    assert rep == {"vec": {"nan": 1, "inf": 1}}
+
+
+def test_assert_stage_pure_passes_for_real_stage():
+    from transmogrifai_tpu.automl.preparators import SanityChecker
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(120, 3))
+    y = (X[:, 0] > 0).astype(float)
+    ds, (label, *fs) = TestFeatureBuilder.build(
+        ("label", RealNN, y.tolist()),
+        *[(f"f{i}", Real, X[:, i].tolist()) for i in range(3)],
+        response_index=0)
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    vec = transmogrify(list(fs))
+    stage = vec.origin_stage
+    # walk the tiny dag: fit each layer onto the dataset
+    from transmogrifai_tpu.workflow.workflow import Workflow
+    model = Workflow().set_input_dataset(ds).set_result_features(vec).train()
+    out = model.score(ds)
+    checker = SanityChecker(check_sample=1.0).set_input(label, vec)
+    assert_stage_pure(checker, out.with_column(
+        "label", ds.column("label")))
+
+
+def test_assert_stage_pure_catches_mutation():
+    from transmogrifai_tpu.stages.base import Transformer
+    from transmogrifai_tpu.data.dataset import Column
+    from transmogrifai_tpu.types import ColumnKind
+
+    class Mutator(Transformer):
+        input_types = (Real,)
+        output_type = Real
+
+        def __init__(self, **kw):
+            super().__init__("mutator", **kw)
+
+        def transform_columns(self, *cols):
+            cols[0].data[0] = 999.0  # mutates shared input
+            return Column(kind=ColumnKind.FLOAT, data=cols[0].data.copy())
+
+    ds, (f,) = TestFeatureBuilder.build(("x", Real, [1.0, 2.0]))
+    with pytest.raises(AssertionError, match="mutated"):
+        assert_stage_pure(Mutator().set_input(f), ds)
+
+
+def test_runner_debug_nans_flag():
+    """OpParams.debug_nans wraps the whole run in the NaN trap and
+    round-trips through JSON (reference OpParams flag style)."""
+    import jax
+    from transmogrifai_tpu.workflow.runner import OpParams
+    p = OpParams(debug_nans=True)
+    assert OpParams.from_json(p.to_json()).debug_nans is True
+    prev = jax.config.jax_debug_nans
+    from transmogrifai_tpu.utils.sanitizers import debug_nans
+    with debug_nans():
+        assert jax.config.jax_debug_nans is True
+    assert jax.config.jax_debug_nans == prev

@@ -307,12 +307,23 @@ def _column_value(col, i):
 
 
 def _check_transformer_laws(model, ds, feats, rows, name, check_parity=True):
-    # 1. transform appends a column of the declared kind with n rows
+    from transmogrifai_tpu.utils.sanitizers import _columns_equal, _snapshot, _unchanged
+
+    # 1. transform appends a column of the declared kind with n rows;
+    #    purity laws (utils/sanitizers): inputs unmutated, deterministic
+    before = {n: _snapshot(ds.column(n)) for n in model.input_names()}
     out_ds = model.transform(ds)
     out_name = model.output_name()
     assert out_name in out_ds.column_names(), f"{name}: output column missing"
     out_col = out_ds.column(out_name)
     assert len(out_col) == len(ds), f"{name}: row count changed"
+    for n in model.input_names():
+        assert _unchanged(before[n], ds.column(n)), \
+            f"{name}: transform mutated input column '{n}'"
+    if name.split("->")[-1] not in LOOSE_PARITY:
+        out_again = model.transform(ds).column(out_name)
+        assert _columns_equal(out_col, out_again), \
+            f"{name}: repeated transform is not deterministic"
 
     # 2. row-level scoring == columnar transform (OpTransformerSpec law)
     base_name = name.split("->")[-1]

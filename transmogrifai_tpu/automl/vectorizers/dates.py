@@ -8,7 +8,7 @@ midnight is close to 23:59.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -61,6 +61,20 @@ def _month_of_year(ms: np.ndarray) -> np.ndarray:
     return _calendar_delta(ms, "M", "Y")
 
 
+def unit_circle(ms: np.ndarray, period_name: str
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(sin, cos, finite_mask) of one calendar period for epoch-ms values —
+    the circular encoding shared by DateVectorizer and the dsl-exposed
+    DateToUnitCircleTransformer. Missing dates map to the origin (0, 0):
+    equidistant from every point on the circle."""
+    period, extract = PERIODS[period_name]
+    finite = np.isfinite(ms)
+    ang = 2.0 * np.pi * extract(ms) / period
+    s = np.where(finite, np.sin(ang), 0.0)
+    c = np.where(finite, np.cos(ang), 0.0)
+    return s, c, finite
+
+
 class DateVectorizerModel(VectorizerModel):
     def __init__(self, reference_date_ms: float,
                  circular_periods: Sequence[str], track_nulls: bool = True,
@@ -80,11 +94,7 @@ class DateVectorizerModel(VectorizerModel):
                                   (self.reference_date_ms - ms) / MS_PER_DAY, 0.0)
             parts = [days_since[:, None]]
             for p in self.circular_periods:
-                period, extract = PERIODS[p]
-                val = extract(ms)
-                ang = 2.0 * np.pi * val / period
-                s = np.where(finite, np.sin(ang), 0.0)
-                c = np.where(finite, np.cos(ang), 0.0)
+                s, c, _ = unit_circle(ms, p)
                 parts.append(s[:, None])
                 parts.append(c[:, None])
             if self.track_nulls:

@@ -550,7 +550,12 @@ class DecisionTreeNumericMapBucketizerModel(Transformer):
         m = vals[-1].value or {}
         from ..automl.vectorizers.maps import clean_key
         if self.clean_keys:
-            m = {clean_key(str(k), True): v for k, v in m.items()}
+            # first-wins on cleaned-key collisions — must mirror
+            # extract_key_columns so row scoring matches the columnar path
+            cleaned: Dict[str, Any] = {}
+            for k, v in m.items():
+                cleaned.setdefault(clean_key(str(k), True), v)
+            m = cleaned
         key_cols = {k: [m.get(k)] for k in self.keys}
         return OPVector(self._encode(key_cols, 1)[0])
 
@@ -649,13 +654,11 @@ class DateToUnitCircleTransformer(Transformer):
                          uid=uid, **params)
 
     def _encode(self, ms: np.ndarray) -> np.ndarray:
-        from ..automl.vectorizers.dates import PERIODS
-        period, extract = PERIODS[str(self.get_param("time_period"))]
-        finite = np.isfinite(ms)
-        ang = 2.0 * np.pi * extract(ms) / period
-        out = np.zeros((len(ms), 2), np.float32)
-        out[:, 0] = np.where(finite, np.sin(ang), 0.0)
-        out[:, 1] = np.where(finite, np.cos(ang), 0.0)
+        from ..automl.vectorizers.dates import unit_circle
+        s, c, _ = unit_circle(ms, str(self.get_param("time_period")))
+        out = np.empty((len(ms), 2), np.float32)
+        out[:, 0] = s
+        out[:, 1] = c
         return out
 
     def transform_columns(self, *cols: Column) -> Column:

@@ -334,17 +334,124 @@ class TfIdfVectorizer(OpCountVectorizer):
 
 # -- light analyzers (reference leaned on JVM libs; behavior-parity impls) --
 
-_LANG_PROFILES = {
-    "en": set("the and ing ion to of in er it is".split()),
-    "fr": set("le la les de et un une est que dans".split()),
-    "de": set("der die das und ist ein nicht mit sich den".split()),
-    "es": set("el la los de y un una es que en".split()),
+# Script ranges decide non-Latin languages outright (deterministic — these
+# scripts map 1:1 or nearly so to a language for detection purposes).
+_SCRIPT_LANGS: List[Tuple[int, int, str]] = [
+    (0x0400, 0x04FF, "ru"),   # Cyrillic (uk split off below)
+    (0x0370, 0x03FF, "el"),   # Greek
+    (0x0590, 0x05FF, "he"),   # Hebrew
+    (0x0600, 0x06FF, "ar"),   # Arabic (fa split off below)
+    (0x0900, 0x097F, "hi"),   # Devanagari
+    (0x0980, 0x09FF, "bn"),   # Bengali
+    (0x0B80, 0x0BFF, "ta"),   # Tamil
+    (0x0C00, 0x0C7F, "te"),   # Telugu
+    (0x0E00, 0x0E7F, "th"),   # Thai
+    (0x10A0, 0x10FF, "ka"),   # Georgian
+    (0x0530, 0x058F, "hy"),   # Armenian
+    (0x1100, 0x11FF, "ko"),   # Hangul Jamo
+    (0xAC00, 0xD7AF, "ko"),   # Hangul syllables
+    (0x3040, 0x309F, "ja"),   # Hiragana
+    (0x30A0, 0x30FF, "ja"),   # Katakana
+    (0x4E00, 0x9FFF, "zh"),   # CJK unified (ja wins if kana present)
+]
+
+# Latin-script profiles: top stopwords + characteristic trigrams +
+# diacritics distinctive of the language (the same n-gram-profile family
+# as Optimaize's detector, hand-compacted). Stopword hit = 2, trigram = 1,
+# diacritic = 3 (rarely shared between these languages).
+_LATIN_PROFILES: Dict[str, Tuple[set, set, str]] = {
+    "en": (set("the and of to in is you that it he was for are with".split()),
+           {"the", "ing", "and", "ion", "ent"}, ""),
+    "de": (set("der die das und ist ein nicht mit sich den auf werden"
+               .split()),
+           {"der", "ein", "ich", "sch", "und"}, "äöüß"),
+    "fr": (set("le la les de et un une est que dans pour qui pas vous"
+               .split()),
+           {"les", "des", "ent", "que", "ait"}, "àâçéèêëîïôùûœ"),
+    "es": (set("el la los las de y un una es que en por con para no"
+               .split()),
+           {"que", "ión", "los", "ado", "nte"}, "áéíóúñ¿¡"),
+    "pt": (set("o a os as de e um uma é que em não com para mais".split()),
+           {"que", "ção", "não", "ado", "com"}, "ãõáâêéíóôúç"),
+    "it": (set("il lo la i gli le di e un una è che in per non".split()),
+           {"che", "ion", "lla", "ato", "gli"}, "àèéìòù"),
+    "nl": (set("de het een en van ik dat niet met op zijn voor".split()),
+           {"een", "van", "het", "ijk", "aar"}, "ĳ"),
+    "sv": (set("och att det som en på är av för med den inte".split()),
+           {"och", "att", "för", "ing", "den"}, "åäö"),
+    "da": (set("og at det som en på er af for med den ikke".split()),
+           {"det", "og", "ikke", "der", "til"}, "æøå"),
+    "no": (set("og i det som en på er av for med den ikke å".split()),
+           {"det", "og", "ikke", "som", "til"}, "æøå"),
+    "fi": (set("ja on ei se että en hän oli mutta kun".split()),
+           {"en ", "in ", "ssa", "lla", "sta"}, "äö"),
+    "pl": (set("i w nie na się z do to że jest jak po".split()),
+           {"nie", "się", "rze", "ych", "ego"}, "ąćęłńóśźż"),
+    "cs": (set("a je se v na to že s z do o ale".split()),
+           {"je", "na", "pro", "ost", "ter"}, "áčďéěíňóřšťúůýž"),
+    "ro": (set("și de la a în cu o pe un este nu ce".split()),
+           {"ul ", "în ", "are", "eșt", "lui"}, "ăâîșț"),
+    "tr": (set("ve bir bu da ne için de ile çok ama ben".split()),
+           {"bir", "lar", "ler", "içi", "dır"}, "çğıöşü"),
+    "hu": (set("a az és hogy nem is egy van ez meg".split()),
+           {"egy", "nek", "ban", "ogy", "tal"}, "áéíóöőúüű"),
+    "id": (set("yang dan di itu dengan untuk tidak ini dari ke".split()),
+           {"ang", "men", "kan", "nya", "ber"}, ""),
+    "vi": (set("là và của có không được cho người trong một".split()),
+           {"ng ", "nh ", "anh", "ông", "ười"},
+           "ăâđêôơưáàảãạếềểễệ"),
 }
 
 
+def detect_language(text: str) -> Optional[str]:
+    """Best-effort language code for a document: script ranges decide
+    non-Latin languages; Latin scripts score stopword/trigram/diacritic
+    profiles over ~18 languages (reference LangDetector wraps Optimaize's
+    n-gram profiles — same algorithm family, hand-compacted tables)."""
+    if not text:
+        return None
+    # script pass
+    script_counts: Dict[str, int] = {}
+    kana = False
+    for ch in text[:512]:
+        cp = ord(ch)
+        if cp < 0x80:
+            continue
+        if 0x3040 <= cp <= 0x30FF:
+            kana = True
+        for lo, hi, lang in _SCRIPT_LANGS:
+            if lo <= cp <= hi:
+                script_counts[lang] = script_counts.get(lang, 0) + 1
+                break
+    if script_counts:
+        lang = max(script_counts, key=script_counts.get)
+        if lang == "zh" and kana:
+            return "ja"
+        head = text[:512]
+        if lang == "ru" and any(c in head for c in "іїєґ"):
+            return "uk"  # letters absent from Russian orthography
+        if lang == "ar" and any(c in head for c in "\u067e\u0686\u0698\u06af"):
+            return "fa"  # pe/che/zhe/gaf: Persian additions to Arabic script
+        return lang
+    # latin pass (capped like the script pass: multi-KB documents gain no
+    # accuracy from scanning past the first 512 chars)
+    low = text[:512].lower()
+    toks = set(tokenize_text(low))
+    grams = {low[i:i + 3] for i in range(max(len(low) - 2, 0))}
+    best, score = None, 0
+    for lang, (stops, tris, marks) in _LATIN_PROFILES.items():
+        s = 2 * len(toks & stops) + len(grams & tris)
+        s += 3 * sum(1 for m in marks if m in low)
+        if s > score:
+            best, score = lang, s
+    return best or "unknown"
+
+
 class LangDetector(Transformer):
-    """Text -> PickList language code (reference LangDetector via Optimaize;
-    here a stopword-profile heuristic over the same output contract)."""
+    """Text -> PickList language code over ~30 languages: deterministic
+    script detection (Cyrillic/Greek/Hebrew/Arabic/CJK/Hangul/Thai/indic/
+    ...) + stopword/trigram/diacritic profiles for 18 Latin-script
+    languages (reference LangDetector via Optimaize's n-gram profiles)."""
 
     input_types = (Text,)
     output_type = PickList
@@ -354,16 +461,7 @@ class LangDetector(Transformer):
                          uid=uid, **params)
 
     def transform_value(self, *vals):
-        v = vals[0].value
-        if not v:
-            return PickList(None)
-        toks = set(tokenize_text(v))
-        best, score = None, 0
-        for lang, words in _LANG_PROFILES.items():
-            s = len(toks & words)
-            if s > score:
-                best, score = lang, s
-        return PickList(best or "unknown")
+        return PickList(detect_language(vals[0].value))
 
 
 _MIME_MAGIC: List[Tuple[bytes, str]] = [
@@ -407,9 +505,101 @@ class MimeTypeDetector(Transformer):
             return PickList("application/octet-stream")
 
 
+# Per-region phone metadata: (country code, set of valid NATIONAL number
+# lengths, trunk prefix stripped from national format). A hand-compacted
+# slice of the ITU numbering plans libphonenumber ships in full — covers
+# the regions the reference's PhoneNumberParser tests exercise plus the
+# majors. NANP members share cc=1 with 10-digit nationals and no trunk 0.
+_PHONE_REGIONS: Dict[str, Tuple[int, frozenset, str]] = {
+    "US": (1, frozenset({10}), ""), "CA": (1, frozenset({10}), ""),
+    "MX": (52, frozenset({10}), ""),
+    "GB": (44, frozenset({9, 10}), "0"), "IE": (353, frozenset({7, 8, 9}), "0"),
+    "DE": (49, frozenset(range(6, 12)), "0"),
+    "FR": (33, frozenset({9}), "0"), "ES": (34, frozenset({9}), ""),
+    "IT": (39, frozenset(range(8, 12)), ""),
+    "PT": (351, frozenset({9}), ""), "NL": (31, frozenset({9}), "0"),
+    "BE": (32, frozenset({8, 9}), "0"), "CH": (41, frozenset({9}), "0"),
+    "AT": (43, frozenset(range(7, 14)), "0"),
+    "SE": (46, frozenset(range(7, 10)), "0"),
+    "NO": (47, frozenset({8}), ""), "DK": (45, frozenset({8}), ""),
+    "FI": (358, frozenset(range(6, 12)), "0"),
+    "PL": (48, frozenset({9}), ""), "CZ": (420, frozenset({9}), ""),
+    "RO": (40, frozenset({9}), "0"), "GR": (30, frozenset({10}), ""),
+    "TR": (90, frozenset({10}), "0"), "RU": (7, frozenset({10}), "8"),
+    "UA": (380, frozenset({9}), "0"), "IL": (972, frozenset({8, 9}), "0"),
+    "SA": (966, frozenset({8, 9}), "0"), "AE": (971, frozenset({8, 9}), "0"),
+    "IN": (91, frozenset({10}), "0"), "PK": (92, frozenset({9, 10}), "0"),
+    "BD": (880, frozenset({8, 9, 10}), "0"),
+    "CN": (86, frozenset({11}), "0"), "JP": (81, frozenset({9, 10}), "0"),
+    "KR": (82, frozenset(range(8, 11)), "0"),
+    "TW": (886, frozenset({8, 9}), "0"),
+    "SG": (65, frozenset({8}), ""), "HK": (852, frozenset({8}), ""),
+    "MY": (60, frozenset(range(7, 10)), "0"),
+    "TH": (66, frozenset({8, 9}), "0"), "VN": (84, frozenset({9, 10}), "0"),
+    "ID": (62, frozenset(range(8, 12)), "0"),
+    "PH": (63, frozenset({8, 10}), "0"),
+    "AU": (61, frozenset({9}), "0"), "NZ": (64, frozenset(range(8, 10)), "0"),
+    "BR": (55, frozenset({10, 11}), "0"), "AR": (54, frozenset({10}), "0"),
+    "CL": (56, frozenset({9}), ""), "CO": (57, frozenset({10}), ""),
+    "PE": (51, frozenset({9}), "0"),
+    "ZA": (27, frozenset({9}), "0"), "NG": (234, frozenset({8, 10}), "0"),
+    "EG": (20, frozenset({9, 10}), "0"), "KE": (254, frozenset({9}), "0"),
+}
+
+# cc -> candidate regions (longest-prefix match over 1-3 digit codes)
+_CC_TO_REGIONS: Dict[int, List[str]] = {}
+for _r, (_cc, _lens, _tp) in _PHONE_REGIONS.items():
+    _CC_TO_REGIONS.setdefault(_cc, []).append(_r)
+
+
+def parse_phone(raw: str, default_region: str = "US"
+                ) -> Tuple[bool, Optional[str]]:
+    """(is_valid, region) for a raw phone string — structural validation
+    against per-region numbering metadata (reference
+    PhoneNumberParser.scala:566 wraps libphonenumber; this is a compacted
+    50-region metadata table with the same decision shape: resolve
+    region from +cc or the default, strip trunk prefix, check national
+    length)."""
+    if not raw:
+        return False, None
+    s = raw.strip()
+    digits = re.sub(r"[^\d+]", "", s)
+    if digits.count("+") > 1 or ("+" in digits and not digits.startswith("+")):
+        return False, None
+    if digits.startswith("+"):
+        body = digits[1:]
+        if not body.isdigit():
+            return False, None
+        for cc_len in (3, 2, 1):
+            cc = int(body[:cc_len]) if len(body) >= cc_len else -1
+            for region in _CC_TO_REGIONS.get(cc, ()):
+                _, lens, _trunk = _PHONE_REGIONS[region]
+                if len(body) - cc_len in lens:
+                    return True, region
+        # unknown cc: fall back to the ITU E.164 structural bound
+        return 8 <= len(body) <= 15, None
+    if not digits.isdigit() or not digits:
+        return False, None
+    region = default_region.upper()
+    meta = _PHONE_REGIONS.get(region)
+    if meta is None:
+        return 7 <= len(digits) <= 15, None
+    cc, lens, trunk = meta
+    national = digits
+    cc_str = str(cc)
+    # NANP-style: national form may carry the country code (1-555-...)
+    if national.startswith(cc_str) and (len(national) - len(cc_str)) in lens:
+        national = national[len(cc_str):]
+    elif trunk and national.startswith(trunk) and \
+            (len(national) - len(trunk)) in lens:
+        national = national[len(trunk):]
+    return len(national) in lens, region
+
+
 class PhoneNumberParser(Transformer):
-    """Phone -> Binary validity (reference PhoneNumberParser.scala:566 via
-    libphonenumber; NANP-style structural validation)."""
+    """Phone -> Binary validity against per-region numbering metadata
+    (country code, national length set, trunk prefix) for ~50 regions
+    (reference PhoneNumberParser.scala:566 via libphonenumber)."""
 
     input_types = (Text,)
     output_type = Binary
@@ -427,17 +617,10 @@ class PhoneNumberParser(Transformer):
         v = vals[0].value
         if not v:
             return Binary(None)
-        digits = re.sub(r"[^\d+]", "", v)
-        if digits.startswith("+"):
-            body = digits[1:]
-            ok = 8 <= len(body) <= 15 and body.isdigit()
-        else:
-            region = str(self.get_param("default_region"))
-            n = len(digits)
-            ok = digits.isdigit() and (
-                (region == "US" and (n == 10 or (n == 11 and
-                                                 digits.startswith("1"))))
-                or (region != "US" and 7 <= n <= 15))
+        ok, _region = parse_phone(v, str(self.get_param("default_region")))
+        if not ok and not bool(self.get_param("strict")):
+            digits = re.sub(r"\D", "", v)
+            ok = 7 <= len(digits) <= 15
         return Binary(bool(ok))
 
 

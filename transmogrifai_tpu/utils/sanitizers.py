@@ -1,0 +1,150 @@
+"""Numeric + purity sanitizers for the compiled feature pipeline.
+
+SURVEY §5 "race detection / sanitizers": the reference has none in-repo
+(immutable RDDs and the JVM are its whole story; the closest analogues are
+`checkSerializable` closure checks at OpWorkflow.scala:265 and the
+scalastyle gate). The failure modes of a compiled-array pipeline are
+different — silent NaN/Inf propagation through fused XLA programs, stages
+mutating shared input buffers, impure `get_jax_fn`s whose Python side
+effects bake stale values into a trace — so the sanitizers here target
+those:
+
+* `debug_nans()` / `debug_infs()` — context managers flipping JAX's
+  trap-on-NaN/Inf modes for a scoped block (fit or score), restoring prior
+  state on exit.
+* `check_finite(ds)` — one pass over a Dataset's numeric/vector columns
+  reporting NaN/Inf counts per column (cheap reductions, no device sync
+  beyond the scalars).
+* `assert_stage_pure(stage, ds)` — fits/transforms twice and verifies
+  (a) the input columns were not mutated, (b) repeated transforms are
+  bit-identical (catches RNG/global-state leaks into traces).
+
+All opt-in, all host-side orchestration; nothing here runs inside a jitted
+program.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Iterator, Optional
+
+import numpy as np
+
+from ..data.dataset import Column, Dataset
+from ..types import ColumnKind
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True) -> Iterator[None]:
+    """Trap NaNs produced by any jax computation in this block (jax
+    re-runs the offending primitive un-jitted and raises with a stack)."""
+    import jax
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", bool(enable))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+@contextlib.contextmanager
+def debug_infs(enable: bool = True) -> Iterator[None]:
+    import jax
+    prev = jax.config.jax_debug_infs
+    jax.config.update("jax_debug_infs", bool(enable))
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_infs", prev)
+
+
+def check_finite(ds: Dataset, columns: Optional[list] = None
+                 ) -> Dict[str, Dict[str, int]]:
+    """Per-column NaN/Inf counts over numeric and vector columns.
+
+    NaN in a FLOAT/INT/BOOL column is the *encoding of missing* and is NOT
+    reported (it is expected); NaN or Inf inside a VECTOR column — the
+    post-vectorizer device matrix — is always a defect and is.
+    """
+    report: Dict[str, Dict[str, int]] = {}
+    names = columns if columns is not None else ds.column_names()
+    for name in names:
+        col = ds.column(name)
+        if col.kind == ColumnKind.VECTOR:
+            data = np.asarray(col.data)
+            nan = int(np.isnan(data).sum())
+            inf = int(np.isinf(data).sum())
+            if nan or inf:
+                report[name] = {"nan": nan, "inf": inf}
+        elif col.kind in (ColumnKind.FLOAT, ColumnKind.INT, ColumnKind.BOOL):
+            data = np.asarray(col.data, np.float64)
+            inf = int(np.isinf(data).sum())
+            if inf:
+                report[name] = {"nan": 0, "inf": inf}
+    return report
+
+
+def _snapshot(col: Column) -> Any:
+    data = col.data
+    if isinstance(data, np.ndarray) and data.dtype != object:
+        return data.copy()
+    return [v.copy() if isinstance(v, (dict, list, set, np.ndarray)) else v
+            for v in data]
+
+
+def _rows_equal(a: Any, b: Any) -> bool:
+    """Structural row equality that treats NaN == NaN (a deterministic
+    stage may legitimately emit NaN) and handles ndarray/dict/list rows."""
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        return a == b or (np.isnan(a) and np.isnan(b))
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        try:
+            return np.array_equal(np.asarray(a), np.asarray(b),
+                                  equal_nan=True)
+        except TypeError:  # non-numeric arrays: elementwise
+            return np.array_equal(np.asarray(a), np.asarray(b))
+    if isinstance(a, dict) and isinstance(b, dict):
+        return set(a) == set(b) and all(_rows_equal(a[k], b[k]) for k in a)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(_rows_equal(x, y)
+                                        for x, y in zip(a, b))
+    return a == b
+
+
+def _unchanged(before: Any, col: Column) -> bool:
+    data = col.data
+    if isinstance(before, np.ndarray):
+        return np.array_equal(before, np.asarray(data), equal_nan=True)
+    return all(_rows_equal(a, b) for a, b in zip(before, data))
+
+
+def _columns_equal(a: Column, b: Column) -> bool:
+    da, db = a.data, b.data
+    if isinstance(da, np.ndarray) and da.dtype != object:
+        return np.array_equal(da, np.asarray(db), equal_nan=True)
+    return len(da) == len(db) and all(_rows_equal(x, y)
+                                      for x, y in zip(da, db))
+
+
+def assert_stage_pure(stage, ds: Dataset) -> None:
+    """Purity laws for a stage against a dataset:
+
+    1. transform/fit must not mutate its input columns;
+    2. transforming twice must be bit-identical (impure jax_fns or global
+       RNG leaking into the trace break this).
+
+    Raises AssertionError with the offending column/stage names.
+    """
+    from ..stages.base import Estimator
+
+    in_names = stage.input_names()
+    before = {n: _snapshot(ds.column(n)) for n in in_names}
+    model = stage.fit(ds) if isinstance(stage, Estimator) else stage
+    out1 = model.transform(ds).column(model.output_name())
+    for n in in_names:
+        assert _unchanged(before[n], ds.column(n)), \
+            f"{stage.stage_name} mutated its input column '{n}'"
+    out2 = model.transform(ds).column(model.output_name())
+    assert _columns_equal(out1, out2), \
+        f"{stage.stage_name}: repeated transform is not deterministic"

@@ -52,6 +52,10 @@ class OpParams:
     metrics_location: Optional[str] = None
     custom_params: Dict[str, Any] = field(default_factory=dict)
     collect_stage_metrics: bool = False
+    # sanitizer opt-in (utils/sanitizers): trap NaNs/Infs produced by any
+    # jitted program during the run — the compiled-pipeline analogue of the
+    # reference's closure-serializability validation (OpWorkflow.scala:265)
+    debug_nans: bool = False
 
     def with_values(self, **kwargs: Any) -> "OpParams":
         """Reference withValues:116 — functional update."""
@@ -70,6 +74,7 @@ class OpParams:
             "metrics_location": self.metrics_location,
             "custom_params": self.custom_params,
             "collect_stage_metrics": self.collect_stage_metrics,
+            "debug_nans": self.debug_nans,
         }
 
     @staticmethod
@@ -83,6 +88,7 @@ class OpParams:
             metrics_location=d.get("metrics_location"),
             custom_params=d.get("custom_params", {}),
             collect_stage_metrics=d.get("collect_stage_metrics", False),
+            debug_nans=d.get("debug_nans", False),
         )
 
     @staticmethod
@@ -197,6 +203,13 @@ class OpWorkflowRunner:
         if params.collect_stage_metrics:
             from ..utils.metrics import collector
             collector.enable(app_name=type(self.workflow).__name__)
+        if params.debug_nans:
+            from ..utils.sanitizers import debug_nans
+            with debug_nans():
+                return self._dispatch(run_type, params)
+        return self._dispatch(run_type, params)
+
+    def _dispatch(self, run_type: str, params: OpParams) -> RunResult:
         t0 = time.time()
         if run_type == self.TRAIN:
             out = self._train(params)
