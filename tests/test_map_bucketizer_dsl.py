@@ -153,3 +153,86 @@ def test_dsl_vector_combine_and_descale():
     vb = b.vectorize()
     combined = va.combine_with(vb)
     assert combined.type_name == "OPVector"
+
+
+# -- generic RichFeature + text-extra dsl ops --------------------------------
+
+def test_dsl_generic_feature_ops():
+    from transmogrifai_tpu.types import PickList, Real as _Real
+    ds, (f,) = TestFeatureBuilder.build(
+        ("x", _Real, [1.0, -2.0, None, 4.0]))
+    doubled = f.map_values(lambda v: None if v is None else v * 2)
+    st = doubled.origin_stage
+    out = [st.transform_value(_Real(v)).value for v in (1.0, None)]
+    assert out == [2.0, None]
+
+    swapped = f.replace_with(-2.0, 0.0)
+    col = swapped.origin_stage.transform(ds).column(swapped.name)
+    assert col.data[1] == 0.0 and col.data[0] == 1.0
+
+    pos = f.exists(lambda v: v > 0)
+    pcol = pos.origin_stage.transform(ds).column(pos.name)
+    assert list(pcol.data[:2]) == [1.0, 0.0]
+
+    clipped = f.filter_values(lambda v: v > 0, default=None)
+    ccol = clipped.origin_stage.transform(ds).column(clipped.name)
+    assert np.isnan(ccol.data[1])
+
+
+def test_dsl_email_url_ops():
+    from transmogrifai_tpu.types import Email, URL
+    ds, (em, url) = TestFeatureBuilder.build(
+        ("em", Email, ["jane.doe@example.com", "not-an-email", None]),
+        ("url", URL, ["https://sub.example.com/p?q=1", "nope", None]))
+    valid = em.is_valid_email()
+    vcol = valid.origin_stage.transform(ds).column(valid.name)
+    assert list(vcol.data[:2]) == [1.0, 0.0] and np.isnan(vcol.data[2])
+    pre = em.email_prefix()
+    assert pre.origin_stage.transform(ds).column(pre.name).data[0] == \
+        "jane.doe"
+    dom = url.url_domain()
+    assert dom.origin_stage.transform(ds).column(dom.name).data[0] == \
+        "sub.example.com"
+    proto = url.url_protocol()
+    assert proto.origin_stage.transform(ds).column(proto.name).data[0] == \
+        "https"
+    ok = url.is_valid_url()
+    ocol = ok.origin_stage.transform(ds).column(ok.name)
+    assert list(ocol.data[:2]) == [1.0, 0.0]
+
+    from transmogrifai_tpu.types import Text as _Text
+    tds, (t,) = TestFeatureBuilder.build(("t", _Text, ["red", None]))
+    mpl = t.to_multi_pick_list()
+    mcol = mpl.origin_stage.transform(tds).column(mpl.name)
+    assert mcol.data[0] == {"red"} and mcol.data[1] == set()
+
+
+def test_url_parsing_userinfo_and_localhost():
+    """One urllib parser everywhere: userinfo/port stripped from domains
+    (java.net.URL.getHost semantics), dotless hosts valid."""
+    from transmogrifai_tpu.transformers.text import (
+        UrlPartsTransformer, ValidUrlTransformer,
+    )
+    from transmogrifai_tpu.types import URL
+    dom = UrlPartsTransformer(part="domain")
+    assert dom.transform_value(URL("https://user:pw@example.com/a")).value \
+        == "example.com"
+    assert dom.transform_value(URL("https://example.com:8443/a")).value \
+        == "example.com"
+    valid = ValidUrlTransformer()
+    assert valid.transform_value(URL("http://localhost:8080/x")).value is True
+    assert valid.transform_value(URL("https://user:pw@example.com/")).value \
+        is True
+    assert valid.transform_value(URL("nope")).value is False
+
+
+def test_replace_with_array_values():
+    import numpy as np
+    from transmogrifai_tpu.transformers.misc import ReplaceWithTransformer
+    from transmogrifai_tpu.types import OPVector
+    t = ReplaceWithTransformer(old_value=np.zeros(2), new_value=np.ones(2))
+    t.output_type = OPVector
+    out = t.transform_value(OPVector(np.zeros(2)))
+    np.testing.assert_array_equal(out.value, np.ones(2))
+    out2 = t.transform_value(OPVector(np.array([3.0, 4.0])))
+    np.testing.assert_array_equal(out2.value, [3.0, 4.0])

@@ -220,6 +220,85 @@ def _jaccard_similarity(self, other: Feature):
     return JaccardSimilarity().set_input(self, other).get_output()
 
 
+# -- generic (RichFeature) ---------------------------------------------------
+
+def _map_values(self, fn, output_type=None, operation_name: str = "map"):
+    """Apply a python function per value (RichFeature.map:61). Lambda
+    stages persist only with load(..., custom_stages=...) — same closure
+    caveat as the reference's lambda transformers."""
+    from .stages.base import LambdaTransformer
+    out_t = output_type or self.feature_type
+    return LambdaTransformer(
+        operation_name,
+        lambda v, _f=fn, _t=out_t: _t(_f(v.value)),
+        (self.feature_type,), out_t).set_input(self).get_output()
+
+
+def _replace_with(self, old_value, new_value):
+    """Swap one raw value for another (RichFeature.replaceWith:75)."""
+    from .transformers.misc import ReplaceWithTransformer
+    return ReplaceWithTransformer(old_value=old_value, new_value=new_value) \
+        .set_input(self).get_output()
+
+
+def _exists(self, pred, operation_name: str = "exists"):
+    """Binary: predicate holds for the raw value (RichFeature.exists:176).
+    Lambda-stage persistence caveat as in map_values."""
+    from .stages.base import LambdaTransformer
+    return LambdaTransformer(
+        operation_name,
+        lambda v, _p=pred: Binary(None if v.value is None else
+                                  bool(_p(v.value))),
+        (self.feature_type,), Binary).set_input(self).get_output()
+
+
+def _filter_values(self, pred, default, operation_name: str = "filter"):
+    """Keep values passing the predicate, else the default
+    (RichFeature.filter:134). Lambda-stage persistence caveat applies."""
+    from .stages.base import LambdaTransformer
+    t = self.feature_type
+    return LambdaTransformer(
+        operation_name,
+        lambda v, _p=pred, _d=default, _t=t: (
+            v if (v.value is not None and _p(v.value)) else _t(_d)),
+        (t,), t).set_input(self).get_output()
+
+
+# -- text extras (RichTextFeature / Email / URL) -----------------------------
+
+def _to_multi_pick_list(self):
+    from .transformers.text import TextToMultiPickList
+    return TextToMultiPickList().set_input(self).get_output()
+
+
+def _is_valid_email(self):
+    from .transformers.text import ValidEmailTransformer
+    return ValidEmailTransformer().set_input(self).get_output()
+
+
+def _email_prefix(self):
+    from .transformers.text import EmailPrefixTransformer
+    return EmailPrefixTransformer().set_input(self).get_output()
+
+
+def _url_domain(self):
+    from .transformers.text import UrlPartsTransformer
+    return UrlPartsTransformer(part="domain").set_input(self).get_output()
+
+
+def _url_protocol(self):
+    from .transformers.text import UrlPartsTransformer
+    return UrlPartsTransformer(part="protocol").set_input(self).get_output()
+
+
+def _is_valid_url(self, protocols=None):
+    from .transformers.text import ValidUrlTransformer
+    stage = ValidUrlTransformer()
+    if protocols is not None:
+        stage.set_param("protocols", list(protocols))
+    return stage.set_input(self).get_output()
+
+
 # -- dates (RichDateFeature) -------------------------------------------------
 
 def _to_unit_circle(self, time_period: str = "HourOfDay"):
@@ -351,6 +430,12 @@ def install() -> None:
         "autobucketize_map": _autobucketize_map,
         "vectorize_geo": _vectorize_geo,
         "combine_with": _combine_with, "descale": _descale,
+        "map_values": _map_values, "replace_with": _replace_with,
+        "exists": _exists, "filter_values": _filter_values,
+        "to_multi_pick_list": _to_multi_pick_list,
+        "is_valid_email": _is_valid_email, "email_prefix": _email_prefix,
+        "url_domain": _url_domain, "url_protocol": _url_protocol,
+        "is_valid_url": _is_valid_url,
     }
     for name, fn in ops.items():
         setattr(Feature, name, fn)

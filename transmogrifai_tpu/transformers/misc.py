@@ -714,3 +714,38 @@ class DateToListTransformer(Transformer):
         for i, v in enumerate(data):
             out[i] = [] if np.isnan(v) else [float(v)]
         return Column(kind=ColumnKind.FLOAT_LIST, data=out)
+
+
+class ReplaceWithTransformer(Transformer):
+    """Replace one value with another, any type (reference
+    RichFeature.replaceWith:75). Values compare on the raw `.value`."""
+
+    input_types = (FeatureType,)
+
+    def __init__(self, old_value: Any = None, new_value: Any = None,
+                 uid: Optional[str] = None, **params):
+        self.old_value = old_value
+        self.new_value = new_value
+        super().__init__(params.pop("operation_name", "replaceWith"),
+                         uid=uid, **params)
+
+    def set_input(self, *features):
+        out = super().set_input(*features)
+        self.output_type = features[0].feature_type
+        return out
+
+    @staticmethod
+    def _values_eq(a, b) -> bool:
+        if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+            return np.array_equal(np.asarray(a), np.asarray(b))
+        return a == b
+
+    def transform_value(self, *vals):
+        v = vals[0].value
+        return self.output_type(
+            self.new_value if self._values_eq(v, self.old_value) else v)
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(old_value=self.old_value, new_value=self.new_value)
+        return d

@@ -636,8 +636,116 @@ class EmailToPickList(Transformer):
                          uid=uid, **params)
 
     def transform_value(self, *vals):
+        from ..types import Email
+        e = vals[0] if isinstance(vals[0], Email) else Email(vals[0].value)
+        return PickList(e.domain())
+
+
+_EMAIL_RE = re.compile(
+    r"^[A-Za-z0-9.!#$%&'*+/=?^_`{|}~-]+@"
+    r"[A-Za-z0-9](?:[A-Za-z0-9-]{0,61}[A-Za-z0-9])?"
+    r"(?:\.[A-Za-z0-9](?:[A-Za-z0-9-]{0,61}[A-Za-z0-9])?)+$")
+
+
+class ValidEmailTransformer(Transformer):
+    """Email -> Binary RFC-shaped validity (reference RichEmailFeature
+    .isValidEmail:591 / ValidEmailTransformer)."""
+
+    input_types = (Text,)
+    output_type = Binary
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "validEmail"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
         v = vals[0].value
-        if not v or "@" not in v:
-            return PickList(None)
-        local, _, domain = v.rpartition("@")
-        return PickList(domain if local and domain else None)
+        if not v:
+            return Binary(None)
+        return Binary(bool(_EMAIL_RE.match(v)))
+
+
+class EmailPrefixTransformer(Transformer):
+    """Email -> Text local part (reference RichEmailFeature
+    .toEmailPrefix:578)."""
+
+    input_types = (Text,)
+    output_type = Text
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "emailPrefix"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        from ..types import Email
+        e = vals[0] if isinstance(vals[0], Email) else Email(vals[0].value)
+        return Text(e.prefix())
+
+
+class UrlPartsTransformer(Transformer):
+    """URL -> Text domain or protocol (reference RichURLFeature
+    .toDomain:630 / .toProtocol:635); `part` selects which. Parsing
+    delegates to the URL type helpers (types/text.py) — ONE urllib-based
+    parser in the codebase, java.net.URL.getHost semantics (userinfo and
+    port stripped)."""
+
+    input_types = (Text,)
+    output_type = Text
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("part", "domain|protocol", "domain")]
+
+    def __init__(self, part: str = "domain", uid: Optional[str] = None,
+                 **params):
+        params.setdefault("part", part)
+        super().__init__(params.pop("operation_name", "urlParts"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        from ..types import URL
+        u = vals[0] if isinstance(vals[0], URL) else URL(vals[0].value)
+        return Text(u.domain() if str(self.get_param("part")) == "domain"
+                    else u.protocol())
+
+
+class ValidUrlTransformer(Transformer):
+    """URL -> Binary validity, optionally restricted to protocols
+    (reference RichURLFeature.isValidUrl:642,650 — defaults http/https/ftp,
+    dotless hosts like localhost accepted, matching java.net.URL parsing).
+    Delegates to URL.is_valid (types/text.py)."""
+
+    input_types = (Text,)
+    output_type = Binary
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("protocols", "accepted schemes",
+                      ["http", "https", "ftp"])]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "validUrl"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        from ..types import URL
+        if vals[0].value is None:
+            return Binary(None)
+        u = vals[0] if isinstance(vals[0], URL) else URL(vals[0].value)
+        return Binary(u.is_valid(tuple(self.get_param("protocols"))))
+
+
+class TextToMultiPickList(Transformer):
+    """Text -> MultiPickList singleton set (reference RichTextFeature
+    .toMultiPickList:58)."""
+
+    input_types = (Text,)
+    output_type = MultiPickList
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "toMultiPickList"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        v = vals[0].value
+        return MultiPickList(set() if not v else {v})

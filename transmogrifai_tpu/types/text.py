@@ -83,8 +83,9 @@ class URL(Text):
             return None
         from urllib.parse import urlparse
         try:
-            netloc = urlparse(v).netloc
-            return netloc or None
+            # hostname strips userinfo and port (java.net.URL.getHost
+            # semantics, which the reference's RichURLFeature relies on)
+            return urlparse(v).hostname or None
         except Exception:
             return None
 
@@ -99,14 +100,15 @@ class URL(Text):
         except Exception:
             return None
 
-    def is_valid(self) -> bool:
+    def is_valid(self, protocols=("http", "https", "ftp")) -> bool:
         v = self.value
         if v is None:
             return False
         from urllib.parse import urlparse
         try:
             p = urlparse(v)
-            return p.scheme in ("http", "https", "ftp") and bool(p.netloc)
+            return p.scheme in tuple(s.lower() for s in protocols) \
+                and bool(p.netloc)
         except Exception:
             return False
 
