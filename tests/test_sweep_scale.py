@@ -262,6 +262,46 @@ def test_workflow_train_kill_and_resume(tmp_path, monkeypatch):
     assert winner(model) == winner(model_ref)
 
 
+def test_mesh_sharded_sweep_matches_single_device():
+    """The same sweep under a (batch, model) device mesh — rows sharded,
+    GSPMD-inserted psums — must reproduce the single-device metrics.
+    n is chosen NOT divisible by the batch axis to exercise zero-weight
+    row padding."""
+    from transmogrifai_tpu.parallel.mesh import make_mesh
+    X, y = _binary_data(1111, d=6, seed=61)  # 1111 % 4 != 0
+    models = lambda: [(OpLogisticRegression(max_iter=20), _lr_grids()[:4])]
+    ev = Evaluators.BinaryClassification.au_pr()
+    plain = V.CrossValidation(ev, num_folds=3, seed=9).validate(
+        models(), X, y)
+    mesh = make_mesh(n_batch=4, n_model=2)
+    sharded = V.CrossValidation(ev, num_folds=3, seed=9,
+                                mesh=mesh).validate(models(), X, y)
+    assert sharded.best_grid == plain.best_grid
+    for a, b in zip(plain.validated, sharded.validated):
+        np.testing.assert_allclose(a.fold_metrics, b.fold_metrics,
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_mesh_sharded_tree_sweep_matches_single_device():
+    from transmogrifai_tpu.parallel.mesh import make_mesh
+    X, y = _binary_data(1001, d=5, seed=63)
+    grids = [{"step_size": s, "max_iter": 6, "max_depth": 3}
+             for s in (0.1, 0.3)]
+    models = lambda: [(OpGBTClassifier(), [dict(g) for g in grids])]
+    ev = Evaluators.BinaryClassification.au_pr()
+    plain = V.CrossValidation(ev, num_folds=2, seed=3).validate(
+        models(), X, y)
+    mesh = make_mesh(n_batch=8, n_model=1)
+    sharded = V.CrossValidation(ev, num_folds=2, seed=3,
+                                mesh=mesh).validate(models(), X, y)
+    assert sharded.best_grid == plain.best_grid
+    # padding repeats a real row inside the unweighted quantile sample, so
+    # bin edges (and an occasional split) may shift marginally
+    for a, b in zip(plain.validated, sharded.validated):
+        np.testing.assert_allclose(a.fold_metrics, b.fold_metrics,
+                                   atol=2e-2)
+
+
 def test_checkpoint_does_not_cross_sweep_paths(tmp_path):
     """Metrics from the mask-fold path must NOT be replayed into a
     physically-split rerun (they can differ enough to flip the winner) —

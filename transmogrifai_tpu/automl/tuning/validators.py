@@ -138,7 +138,8 @@ class Validator:
                  stratify: bool = False, parallelism: int = 8,
                  grid_chunk: Optional[int] = None,
                  sweep_dtype: Optional[Any] = None,
-                 mask_fold_trees: bool = True):
+                 mask_fold_trees: bool = True,
+                 mesh: Optional[Any] = None):
         self.evaluator = evaluator
         self.seed = int(seed)
         self.stratify = bool(stratify)
@@ -158,6 +159,14 @@ class Validator:
         # full column (features only, never labels) rather than per-fold
         # train rows — set False to force physically split refits
         self.mask_fold_trees = bool(mask_fold_trees)
+        # optional jax.sharding.Mesh (parallel/mesh.py axes): the sweep's
+        # feature matrix/labels/weights shard rows over the `batch` axis,
+        # fold masks shard their row dim — every Gram/histogram reduction
+        # inside the jitted sweep then becomes an ICI psum inserted by
+        # GSPMD; program text is unchanged (SURVEY §2.9 translation of
+        # Spark partitioning). Rows pad to the axis size with zero weights,
+        # which every kernel treats as absent.
+        self.mesh = mesh
 
     # -- folds -------------------------------------------------------------
     def fold_masks(self, y: np.ndarray) -> np.ndarray:
@@ -258,10 +267,53 @@ class Validator:
         if self.grid_chunk is not None:
             return max(1, int(self.grid_chunk))
         lane_bytes = max(n * d * itemsize, 1)
+        if self.mesh is not None:  # rows shard: per-chip lane cost shrinks
+            from ...parallel.mesh import BATCH_AXIS
+            lane_bytes = max(
+                lane_bytes // max(self.mesh.shape.get(BATCH_AXIS, 1), 1), 1)
         lanes = max(int(SWEEP_LANE_BUDGET_BYTES / lane_bytes), 1)
         # cap: total vmap lanes also scale XLA compile time — past ~8 grid
         # points per program the compile cost outweighs the dispatch savings
         return int(np.clip(lanes // max(n_folds, 1), 1, min(n_grids, 8)))
+
+    def _device_arrays(self, X, y, w, masks, dtype):
+        """Place sweep arrays on device; with a mesh, rows pad to the batch
+        axis (zero weight = inert everywhere: fits see mask*w, metrics see
+        (1-mask)*w) and shard across it."""
+        if self.mesh is None:
+            return (jnp.asarray(X, dtype), jnp.asarray(y, jnp.float32),
+                    jnp.asarray(w, jnp.float32),
+                    jnp.asarray(masks, jnp.float32))
+        from ...parallel.mesh import (
+            BATCH_AXIS, batch_sharding, pad_rows_to_multiple, sharded_along,
+        )
+        nb = self.mesh.shape[BATCH_AXIS]
+        # X pads by repeating the last real row (pad_value=None): tree
+        # quantile binning is unweighted, so synthetic values would shift
+        # bin edges. Labels/weights pad with zeros — inert in every
+        # weighted reduction; masks pad with 1s (irrelevant under w=0).
+        X, _ = pad_rows_to_multiple(np.asarray(X), nb, pad_value=None)
+        y, _ = pad_rows_to_multiple(np.asarray(y, np.float32), nb)
+        w, _ = pad_rows_to_multiple(np.asarray(w, np.float32), nb)
+        masks = pad_rows_to_multiple(
+            np.asarray(masks, np.float32).T, nb, pad_value=1.0)[0].T
+        put = jax.device_put
+        return (
+            put(jnp.asarray(X, dtype), batch_sharding(self.mesh, 2)),
+            put(jnp.asarray(y, jnp.float32), batch_sharding(self.mesh, 1)),
+            put(jnp.asarray(w, jnp.float32), batch_sharding(self.mesh, 1)),
+            put(jnp.asarray(masks, jnp.float32),
+                sharded_along(self.mesh, 1, 2)),
+        )
+
+    def _sweep_path(self, base: str) -> str:
+        """Checkpoint path tag: a mesh run pads rows (shifting tree bin
+        edges and f32 reduction orders), so its metrics must not be
+        replayed into a differently-sharded resume."""
+        if self.mesh is None:
+            return base
+        from ...parallel.mesh import BATCH_AXIS
+        return f"{base}:mesh{self.mesh.shape.get(BATCH_AXIS, 1)}"
 
     def _cell_bookkeeping(self, est, grids, X, y, metric, n_folds,
                           path: str = ""):
@@ -313,13 +365,10 @@ class Validator:
         dtype = self.sweep_dtype or jnp.float32
         ckpt, keys, results = self._cell_bookkeeping(
             est, grids, X, y, metric, masks.shape[0],
-            path=f"vmapped:{jnp.dtype(dtype).name}")
+            path=self._sweep_path(f"vmapped:{jnp.dtype(dtype).name}"))
         pending = [gi for gi in range(len(grids)) if gi not in results]
         if pending:
-            Xd = jnp.asarray(X, dtype)
-            yd = jnp.asarray(y, jnp.float32)
-            wd = jnp.asarray(w, jnp.float32)
-            md = jnp.asarray(masks, jnp.float32)
+            Xd, yd, wd, md = self._device_arrays(X, y, w, masks, dtype)
             thr_d = jnp.asarray(margin_thr, jnp.float32)
             rank_bins = self._rank_bins(X.shape[0])
             chunk = self._auto_grid_chunk(
@@ -362,12 +411,11 @@ class Validator:
         n_classes = int(np.max(y)) + 1 if problem_type == "multiclass" else 2
         margin_thr = self._margin_threshold(est)
         ckpt, keys, results = self._cell_bookkeeping(
-            est, grids, X, y, metric, masks.shape[0], path="mask_folds")
+            est, grids, X, y, metric, masks.shape[0],
+            path=self._sweep_path("mask_folds"))
         pending = [gi for gi in range(len(grids)) if gi not in results]
         if pending:
-            yd = jnp.asarray(y, jnp.float32)
-            wd = jnp.asarray(w, jnp.float32)
-            md = jnp.asarray(masks, jnp.float32)
+            Xd, yd, wd, md = self._device_arrays(X, y, w, masks, jnp.float32)
             rank_bins = self._rank_bins(X.shape[0])
             mfn = _metric_fn(problem_type, metric, n_classes, rank_bins)
             thr_d = jnp.asarray(margin_thr, jnp.float32)
@@ -394,7 +442,7 @@ class Validator:
             for gi in pending:
                 groups.setdefault(bins_of(gi), []).append(gi)
             for _, group in sorted(groups.items(), key=lambda kv: str(kv[0])):
-                ctx = est.copy(**grids[group[0]]).mask_sweep_context(X)
+                ctx = est.copy(**grids[group[0]]).mask_sweep_context(Xd)
                 for gi in group:
                     est_g = est.copy(**grids[gi])
                     scores = est_g.mask_fit_scores(

@@ -68,17 +68,33 @@ def replicated(mesh: Optional[Mesh] = None) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def sharded_along(mesh: Optional[Mesh], dim: int, ndim: int) -> NamedSharding:
+    """Shard one dimension over the batch axis, others replicated (e.g.
+    fold masks [F, n] shard dim=1)."""
+    mesh = mesh or default_mesh()
+    spec = [None] * ndim
+    spec[dim] = BATCH_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
 def pad_rows_to_multiple(x: np.ndarray, multiple: int,
-                         pad_value: float = 0.0) -> Tuple[np.ndarray, int]:
+                         pad_value: Optional[float] = 0.0
+                         ) -> Tuple[np.ndarray, int]:
     """Pad rows so the batch axis divides evenly across devices. Returns the
     padded array and the original row count (callers carry a weight/mask
-    vector so padded rows never affect statistics)."""
+    vector so padded rows never affect statistics). ``pad_value=None``
+    repeats the LAST real row instead — for feature matrices feeding
+    unweighted statistics (tree quantile binning), where synthetic values
+    would shift the distribution but duplicates barely do."""
     n = x.shape[0]
     rem = n % multiple
     if rem == 0:
         return x, n
     pad = multiple - rem
-    pad_block = np.full((pad,) + x.shape[1:], pad_value, dtype=x.dtype)
+    if pad_value is None:
+        pad_block = np.repeat(np.asarray(x)[-1:], pad, axis=0)
+    else:
+        pad_block = np.full((pad,) + x.shape[1:], pad_value, dtype=x.dtype)
     return np.concatenate([x, pad_block], axis=0), n
 
 
