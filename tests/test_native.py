@@ -115,3 +115,37 @@ class TestIntegration:
             for t in (toks or []):
                 py[i, hash_string(t, 16, 0)] += 1
         np.testing.assert_array_equal(out, py)
+
+
+def test_native_dict_encode_matches_numpy_unique():
+    from transmogrifai_tpu.ops.native_bridge import native_dict_encode
+    import numpy as np
+    rng = np.random.default_rng(3)
+    strs = [f"v{int(i)}" for i in rng.integers(0, 37, size=5000)]
+    out = native_dict_encode(strs)
+    if out is None:
+        import pytest
+        pytest.skip("native library unavailable")
+    codes, uniques = out
+    # exact decode round-trip
+    assert [uniques[c] for c in codes] == strs
+    # same unique SET as np.unique (order differs by design)
+    arr = np.empty(len(strs), object); arr[:] = strs
+    assert set(uniques) == set(np.unique(arr))
+    # unicode + empties + collisions in one table
+    c, u = native_dict_encode(["", "ü", "", "a" * 300, "ü"])
+    assert list(c) == [0, 1, 0, 2, 1] and u == ["", "ü", "a" * 300]
+
+
+def test_factorize_native_and_fallback_agree(monkeypatch):
+    import numpy as np
+    from transmogrifai_tpu.automl.vectorizers import encoding as E
+    data = ["b", None, "a", "b", 7, None, "a"]
+    u1, inv1, nm1 = E.factorize(data)
+    # force the numpy fallback
+    import transmogrifai_tpu.ops.native_bridge as NB
+    monkeypatch.setattr(NB, "native_dict_encode", lambda s: None)
+    u2, inv2, nm2 = E.factorize(data)
+    # decode both: identical value streams regardless of unique order
+    assert [u1[i] for i in inv1] == [u2[i] for i in inv2]
+    np.testing.assert_array_equal(nm1, nm2)

@@ -297,12 +297,15 @@ class Validator:
         w, _ = pad_rows_to_multiple(np.asarray(w, np.float32), nb)
         masks = pad_rows_to_multiple(
             np.asarray(masks, np.float32).T, nb, pad_value=1.0)[0].T
+        # device_put host arrays DIRECTLY with the sharding: jnp.asarray
+        # first would commit the whole matrix to device 0 before resharding
+        # — an OOM at exactly the >1-chip scale the mesh exists for
         put = jax.device_put
         return (
-            put(jnp.asarray(X, dtype), batch_sharding(self.mesh, 2)),
-            put(jnp.asarray(y, jnp.float32), batch_sharding(self.mesh, 1)),
-            put(jnp.asarray(w, jnp.float32), batch_sharding(self.mesh, 1)),
-            put(jnp.asarray(masks, jnp.float32),
+            put(np.asarray(X, jnp.dtype(dtype)), batch_sharding(self.mesh, 2)),
+            put(np.asarray(y, np.float32), batch_sharding(self.mesh, 1)),
+            put(np.asarray(w, np.float32), batch_sharding(self.mesh, 1)),
+            put(np.asarray(masks, np.float32),
                 sharded_along(self.mesh, 1, 2)),
         )
 

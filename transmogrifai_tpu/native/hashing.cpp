@@ -220,4 +220,51 @@ void tmog_parse_floats(const uint8_t* buf, const int64_t* field_bounds,
   }
 }
 
+
+// ---- exact dictionary encoding --------------------------------------------
+
+// Dictionary-encode packed strings: open-addressing hash table keyed by
+// murmur3 with memcmp verification (exact, not hashed-bucket). Emits
+// codes[i] = dense id in FIRST-OCCURRENCE order and firsts[id] = row index
+// of each id's first occurrence (so the caller materializes the unique
+// strings without re-scanning). Returns n_unique, or -1 when the caller's
+// table capacity (table_cap, must be a power of two > n) is too small.
+//
+// This is the ingest-side replacement for per-column np.unique sorts
+// (O(n log n) + object comparisons): one O(n) pass at C speed. The
+// reference's analogue is Spark's StringIndexer/dictionary encoding on the
+// JVM.
+int64_t tmog_dict_encode(const uint8_t* buf, const int64_t* offsets,
+                         int64_t n, int64_t* table, int64_t table_cap,
+                         int64_t* codes, int64_t* firsts) {
+  // table entries: -1 = empty, else row index of the representative
+  for (int64_t i = 0; i < table_cap; i++) table[i] = -1;
+  const int64_t mask = table_cap - 1;
+  int64_t n_unique = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* s = buf + offsets[i];
+    const int64_t len = offsets[i + 1] - offsets[i];
+    uint64_t slot = tmog_murmur3_32(s, len, 0x9747b28c) & mask;
+    for (int64_t probe = 0;; probe++) {
+      if (probe > table_cap) return -1;  // table full (caller sized wrong)
+      int64_t rep = table[slot];
+      if (rep < 0) {
+        table[slot] = i;
+        codes[i] = n_unique;
+        firsts[n_unique] = i;
+        n_unique++;
+        break;
+      }
+      const int64_t rlen = offsets[rep + 1] - offsets[rep];
+      if (rlen == len && std::memcmp(buf + offsets[rep], s, len) == 0) {
+        codes[i] = codes[rep];
+        break;
+      }
+      slot = (slot + probe + 1) & mask;  // quadratic-ish probing
+    }
+  }
+  return n_unique;
+}
+
+
 }  // extern "C"

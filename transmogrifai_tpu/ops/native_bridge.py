@@ -40,6 +40,23 @@ def _load() -> Optional[ctypes.CDLL]:
     f32p = ctypes.POINTER(ctypes.c_float)
     u32p = ctypes.POINTER(ctypes.c_uint32)
 
+    try:
+        return _bind(lib, u8p, i64p, f64p, f32p, u32p)
+    except AttributeError:
+        # a stale prebuilt .so missing newer symbols (mtime defeated the
+        # rebuild check): try one forced rebuild, else degrade to the
+        # numpy fallbacks instead of crashing callers
+        try:
+            path = build(force=True)
+            if path is not None:
+                return _bind(ctypes.CDLL(path), u8p, i64p, f64p, f32p, u32p)
+        except (OSError, AttributeError):
+            pass
+        return None
+
+
+def _bind(lib, u8p, i64p, f64p, f32p, u32p) -> ctypes.CDLL:
+    global _lib
     lib.tmog_murmur3_32.restype = ctypes.c_uint32
     lib.tmog_murmur3_32.argtypes = [u8p, ctypes.c_int64, ctypes.c_uint32]
     lib.tmog_hash_strings.restype = None
@@ -59,6 +76,9 @@ def _load() -> Optional[ctypes.CDLL]:
                                   i64p]
     lib.tmog_parse_floats.restype = None
     lib.tmog_parse_floats.argtypes = [u8p, i64p, ctypes.c_int64, f64p]
+    lib.tmog_dict_encode.restype = ctypes.c_int64
+    lib.tmog_dict_encode.argtypes = [u8p, i64p, ctypes.c_int64, i64p,
+                                     ctypes.c_int64, i64p, i64p]
     _lib = lib
     return _lib
 
@@ -89,7 +109,9 @@ def native_murmur3(data: bytes, seed: int = 0) -> Optional[int]:
 
 
 def _pack_strings(strings: Sequence[str]):
-    encoded = [s.encode("utf-8") for s in strings]
+    # surrogatepass: strings decoded upstream with errors='surrogateescape'
+    # (raw byte columns) must hash/encode instead of crashing ingest
+    encoded = [s.encode("utf-8", errors="surrogatepass") for s in strings]
     offsets = np.zeros(len(encoded) + 1, np.int64)
     np.cumsum([len(b) for b in encoded], out=offsets[1:])
     buf = np.frombuffer(b"".join(encoded), dtype=np.uint8) if encoded else \
@@ -148,6 +170,33 @@ def native_tokenize_hash_counts(docs: Sequence[Optional[str]], num_bins: int,
     lib.tmog_tokenize_hash_counts(_as_u8p(buf), _as_i64p(offsets), len(docs),
                                   num_bins, seed, min_len, _as_f32p(out))
     return out
+
+
+def native_dict_encode(strings: Sequence[str]
+                       ) -> Optional[tuple]:
+    """Exact dictionary encoding: (codes int64 [n], uniques list[str]) in
+    first-occurrence order, or None without the library. One O(n) hashed
+    pass replacing np.unique's O(n log n) object sort at ingest."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(strings)
+    if n == 0:
+        return np.zeros(0, np.int64), []
+    buf, offsets = _pack_strings(strings)
+    cap = 1
+    while cap < 2 * n + 2:
+        cap <<= 1
+    table = np.empty(cap, np.int64)
+    codes = np.empty(n, np.int64)
+    firsts = np.empty(n, np.int64)
+    n_unique = lib.tmog_dict_encode(
+        _as_u8p(buf), _as_i64p(offsets), n, _as_i64p(table), cap,
+        _as_i64p(codes), _as_i64p(firsts))
+    if n_unique < 0:
+        return None
+    uniques = [strings[i] for i in firsts[:n_unique]]
+    return codes, uniques
 
 
 def native_csv_parse(data: bytes, delim: str = ","
