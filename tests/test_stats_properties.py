@@ -1,0 +1,92 @@
+"""Property tests: ops/stats correlation & contingency kernels vs scipy.
+
+Reference analogue: utils/src/test/.../stats/OpStatisticsTest.scala and
+SanityCheckerTest correlation assertions (Spark MLlib Statistics as the
+oracle; scipy here).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+import scipy.stats
+
+from transmogrifai_tpu.ops import stats as S
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_pearson_with_label_matches_scipy(seed):
+    rng = np.random.default_rng(seed)
+    n, d = 300, 4
+    X = rng.normal(size=(n, d))
+    y = X @ rng.normal(size=d) + rng.normal(size=n)
+    got = np.asarray(S.pearson_with_label(jnp.asarray(X, jnp.float32),
+                                          jnp.asarray(y, jnp.float32)))
+    for j in range(d):
+        want = scipy.stats.pearsonr(X[:, j], y).statistic
+        assert abs(got[j] - want) < 1e-4, (j, got[j], want)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_spearman_with_label_matches_scipy_on_ties(seed):
+    rng = np.random.default_rng(seed)
+    n = 250
+    # heavily tied discrete columns — the post-pivot case VERDICT r1 flagged
+    X = np.stack([rng.integers(0, 4, size=n).astype(float),
+                  np.round(rng.normal(size=n), 1)], axis=1)
+    y = X[:, 0] * 2 + rng.normal(size=n)
+    got = np.asarray(S.spearman_with_label(jnp.asarray(X, jnp.float32),
+                                           jnp.asarray(y, jnp.float32)))
+    for j in range(2):
+        want = scipy.stats.spearmanr(X[:, j], y).statistic
+        assert abs(got[j] - want) < 1e-3, (j, got[j], want)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_chi2_cramers_v_match_scipy(seed):
+    rng = np.random.default_rng(seed)
+    table = rng.integers(1, 60, size=(3, 4)).astype(np.float64)
+    got = S.contingency_stats(jnp.asarray(table, jnp.float32))
+    chi2, _, _, _ = scipy.stats.chi2_contingency(table, correction=False)
+    assert abs(float(got.chi2) - chi2) / max(chi2, 1.0) < 1e-3
+    n = table.sum()
+    k = min(table.shape) - 1
+    cramers = np.sqrt(chi2 / (n * k))
+    assert abs(float(got.cramers_v) - cramers) < 1e-3
+
+
+def test_col_stats_weighted():
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=200)
+    x[::13] = np.nan
+    w = rng.choice([0.5, 1.0, 2.0], size=200)
+    st = S.col_stats(jnp.asarray(x[:, None], jnp.float32), jnp.asarray(w))
+    ok = ~np.isnan(x)
+    wsum = w[ok].sum()
+    mean = (w[ok] * x[ok]).sum() / wsum
+    # unbiased weighted variance (Spark colStats convention: /(count-1))
+    var = (w[ok] * (x[ok] - mean) ** 2).sum() / (wsum - 1.0)
+    assert abs(float(np.asarray(st.mean)[0]) - mean) < 1e-4
+    assert abs(float(np.asarray(st.variance)[0]) - var) < 2e-3
+    assert abs(float(np.asarray(st.min)[0]) - np.nanmin(x)) < 1e-6
+    assert abs(float(np.asarray(st.max)[0]) - np.nanmax(x)) < 1e-6
+
+
+def test_js_divergence_properties():
+    rng = np.random.default_rng(9)
+    p = rng.dirichlet(np.ones(16))
+    q = rng.dirichlet(np.ones(16))
+    jsd_pq = float(S.js_divergence(jnp.asarray(p, jnp.float32),
+                                   jnp.asarray(q, jnp.float32)))
+    jsd_qp = float(S.js_divergence(jnp.asarray(q, jnp.float32),
+                                   jnp.asarray(p, jnp.float32)))
+    assert abs(jsd_pq - jsd_qp) < 1e-5            # symmetric
+    assert 0.0 <= jsd_pq <= 1.0 + 1e-6            # bounded (bits — log2,
+    # the reference FeatureDistribution.jsDivergence convention)
+    self_d = float(S.js_divergence(jnp.asarray(p, jnp.float32),
+                                   jnp.asarray(p, jnp.float32)))
+    assert abs(self_d) < 1e-6                     # identity
+    m = (p + q) / 2
+    kl = lambda a, b: float((a * np.log2(a / b)).sum())
+    want = 0.5 * kl(p, m) + 0.5 * kl(q, m)
+    assert abs(jsd_pq - want) < 1e-4

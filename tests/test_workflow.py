@@ -145,3 +145,45 @@ def test_missing_raw_column_fails(rng):
     wf = Workflow().set_input_dataset(ds).set_result_features(vec)
     with pytest.raises(ValueError, match="missing raw feature"):
         wf.train()
+
+
+def test_with_model_stages_reuses_fitted_stages(monkeypatch):
+    """Reference OpWorkflow.withModelStages:457: a second train() with the
+    fitted model spliced in refits NOTHING that the model already fitted,
+    and scores identically."""
+    import numpy as np
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    from transmogrifai_tpu.data.dataset import Dataset
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.types import PickList, Real
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    rng = np.random.default_rng(2)
+    n = 300
+    ds = Dataset.from_features([
+        ("num", Real, rng.normal(size=n).tolist()),
+        ("cat", PickList, [f"c{int(i)}" for i in
+                           rng.integers(0, 5, size=n)]),
+    ])
+    num = FeatureBuilder.Real("num").extract(
+        lambda r: r.get("num")).as_predictor()
+    cat = FeatureBuilder.PickList("cat").extract(
+        lambda r: r.get("cat")).as_predictor()
+    vec = transmogrify([num, cat])
+    wf = Workflow().set_input_dataset(ds).set_result_features(vec)
+    model1 = wf.train()
+    scored1 = model1.score(ds).column(vec.name).data
+
+    from transmogrifai_tpu.stages.base import Estimator
+    calls = []
+    orig = Estimator.fit
+
+    def spy(self, data):
+        calls.append(self.uid)
+        return orig(self, data)
+
+    monkeypatch.setattr(Estimator, "fit", spy)
+    model2 = wf.with_model_stages(model1).train()
+    assert calls == [], f"estimators refit despite with_model_stages: {calls}"
+    np.testing.assert_allclose(model2.score(ds).column(vec.name).data,
+                               scored1, atol=1e-6)

@@ -57,7 +57,10 @@ def murmur3_32(data: bytes, seed: int = 0) -> int:
 
 
 def hash_string(s: str, num_bins: int, seed: int = 0) -> int:
-    return murmur3_32(s.encode("utf-8"), seed) % num_bins
+    # surrogatepass mirrors native_bridge._pack_strings so the numpy
+    # fallback hashes surrogate-bearing strings identically to the C++ path
+    return murmur3_32(s.encode("utf-8", errors="surrogatepass"),
+                      seed) % num_bins
 
 
 def hash_tokens_to_counts(token_lists: Sequence[Optional[Sequence[str]]],

@@ -44,12 +44,18 @@ def _load() -> Optional[ctypes.CDLL]:
         return _bind(lib, u8p, i64p, f64p, f32p, u32p)
     except AttributeError:
         # a stale prebuilt .so missing newer symbols (mtime defeated the
-        # rebuild check): try one forced rebuild, else degrade to the
-        # numpy fallbacks instead of crashing callers
+        # rebuild check): force a rebuild and dlopen it from a FRESH path —
+        # CDLL of the original path would return the already-mapped stale
+        # object. Failing that, degrade to the numpy fallbacks.
         try:
+            import shutil
+            import tempfile
             path = build(force=True)
             if path is not None:
-                return _bind(ctypes.CDLL(path), u8p, i64p, f64p, f32p, u32p)
+                fd, fresh = tempfile.mkstemp(suffix="_tmog_native.so")
+                os.close(fd)
+                shutil.copyfile(path, fresh)
+                return _bind(ctypes.CDLL(fresh), u8p, i64p, f64p, f32p, u32p)
         except (OSError, AttributeError):
             pass
         return None

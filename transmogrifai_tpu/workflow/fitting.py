@@ -115,15 +115,32 @@ class LayerRunner:
             ds = self.apply_layer(ds, layer)  # type: ignore[arg-type]
         return ds
 
-    def fit_dag(self, ds: Dataset, dag: StagesDAG) -> Tuple[Dataset, StagesDAG]:
+    def fit_dag(self, ds: Dataset, dag: StagesDAG,
+                prefitted: Optional[Dict[str, Transformer]] = None
+                ) -> Tuple[Dataset, StagesDAG]:
         """Train path (reference fitAndTransformDAG:213): per layer — fit all
         estimators, then apply the layer's transformers (originals + freshly
-        fitted models) in one fused pass."""
+        fitted models) in one fused pass. `prefitted` maps stage uid -> an
+        already-fitted transformer (Workflow.with_model_stages — reference
+        OpWorkflow.withModelStages:457); matching estimators reuse it,
+        rewired to this DAG's features, instead of refitting."""
+        prefitted = prefitted or {}
         fitted_layers: List[List[Transformer]] = []
         for layer in dag.layers:
             fitted: List[Transformer] = []
             for st in layer:
                 if isinstance(st, Estimator):
+                    prev = prefitted.get(st.uid)
+                    if prev is not None:
+                        # deep-copy before rewiring: the source model's DAG
+                        # still aliases these objects, and mutating their
+                        # input/output wiring would corrupt it
+                        import copy
+                        prev = copy.deepcopy(prev)
+                        prev.set_input(*st.input_features)
+                        prev.set_output_name(st.output_name())
+                        fitted.append(prev)
+                        continue
                     from ..utils.metrics import collector
                     ds_in = _ensure_input_columns(ds, st)
                     with collector.span(st.stage_name, st.uid, "fit",

@@ -108,6 +108,15 @@ class Workflow:
             ds = result.cleaned
         return ds
 
+    def with_model_stages(self, model: "WorkflowModel") -> "Workflow":
+        """Splice a fitted model's stages back into this workflow
+        (reference OpWorkflow.withModelStages:457): on the next train(),
+        estimators whose uid matches a fitted stage REUSE it instead of
+        refitting — incremental retrain fits only the stages that changed
+        (e.g. swap the selector, keep the fitted vectorizers)."""
+        self._prefitted = {st.uid: st for st in model.stages}
+        return self
+
     def with_workflow_cv(self) -> "Workflow":
         """Leakage-free workflow-level CV (reference OpWorkflowCore
         .withWorkflowCV:104): every estimator between the first fitted
@@ -128,7 +137,8 @@ class Workflow:
             cut = cut_dag(dag)
             if cut.model_selector is not None:
                 self._run_workflow_cv(raw_data, cut, runner)
-        transformed, fitted_dag = runner.fit_dag(raw_data, dag)
+        transformed, fitted_dag = runner.fit_dag(
+            raw_data, dag, prefitted=getattr(self, "_prefitted", None))
         model = WorkflowModel(
             result_features=self._result_features,
             dag=fitted_dag,
