@@ -611,8 +611,10 @@ def main():
     fold = rng.integers(0, cfg["folds"], size=cfg["n_rows"])
     masks_h = np.stack([(fold != k).astype(np.float32)
                         for k in range(cfg["folds"])])
-    glm_fit_s, glm_total = baseline_glm(Xh, yh, masks_h, cfg)
-    gbt_round_s, gbt_total = baseline_gbt(Xh, yh, masks_h, cfg)
+    glm_fit_s, glm_total = (baseline_glm(Xh, yh, masks_h, cfg)
+                            if sweep["glm_fits"] else (0.0, 0.0))
+    gbt_round_s, gbt_total = (baseline_gbt(Xh, yh, masks_h, cfg)
+                              if sweep["tree_fits"] else (0.0, 0.0))
     # compare like with like: only count baseline families whose device
     # sweep actually ran (a family zeroed by a device failure would
     # otherwise inflate the ratio)

@@ -24,8 +24,8 @@ echo "== 2/4 test suite (8-device virtual CPU mesh) =="
 python -m pytest tests/ -q
 
 echo "== 3/4 examples =="
-for ex in op_titanic_simple op_iris op_boston; do
-  JAX_PLATFORMS=cpu python "examples/${ex}.py" > /dev/null
+for ex in op_titanic_simple op_titanic_mini op_iris op_boston; do
+  JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python "examples/${ex}.py" > /dev/null
   echo "  ${ex} ok"
 done
 

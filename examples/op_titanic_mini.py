@@ -1,0 +1,51 @@
+"""OpTitanicMini: the fully-automatic flow — features inferred from rows.
+
+Reference: helloworld/src/main/scala/com/salesforce/hw/OpTitanicMini.scala —
+no hand-declared features: `FeatureBuilder.fromDataFrame` infers a typed
+feature per column, everything transmogrifies, SanityChecker cleans, and the
+selector sweeps. Runs on the same synthetic Titanic-shaped data as
+op_titanic_simple (nothing copied from the reference).
+
+    python examples/op_titanic_mini.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+# allow running as a standalone script from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.preparators import SanityChecker
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.workflow import Workflow
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from op_titanic_simple import synthetic_passengers
+
+
+def main(argv=None) -> None:
+    rows = synthetic_passengers()
+    # the whole feature declaration is ONE call (OpTitanicMini.scala:
+    # FeatureBuilder.fromDataFrame[RealNN](df, response = "survived"))
+    survived, predictors = FeatureBuilder.from_rows(rows, response="survived")
+
+    features = transmogrify(predictors)
+    checked = SanityChecker(check_sample=1.0).set_input(
+        survived, features).get_output()
+    prediction = BinaryClassificationModelSelector.with_cross_validation(
+        num_folds=3, seed=42,
+        model_types=["OpLogisticRegression"],
+    ).set_input(survived, checked).get_output()
+
+    model = Workflow().set_reader(ListReader(rows)) \
+        .set_result_features(prediction).train()
+    print("Model summary:\n")
+    print(model.summary_pretty())
+
+
+if __name__ == "__main__":
+    main()

@@ -10,7 +10,11 @@ run on actual data.
 """
 from __future__ import annotations
 
+import os
 import sys
+
+# allow running as a standalone script from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

@@ -28,3 +28,10 @@ def test_boston_main_runs(capsys):
     op_boston.main()
     out = capsys.readouterr().out
     assert "Selected" in out and "rmse" in out.lower()
+
+
+def test_titanic_mini_auto_features_runs(capsys):
+    import op_titanic_mini
+    op_titanic_mini.main()
+    out = capsys.readouterr().out
+    assert "Selected" in out

@@ -55,7 +55,9 @@ def _load() -> Optional[ctypes.CDLL]:
                 fd, fresh = tempfile.mkstemp(suffix="_tmog_native.so")
                 os.close(fd)
                 shutil.copyfile(path, fresh)
-                return _bind(ctypes.CDLL(fresh), u8p, i64p, f64p, f32p, u32p)
+                fresh_lib = ctypes.CDLL(fresh)
+                os.unlink(fresh)  # the live mapping keeps the file alive
+                return _bind(fresh_lib, u8p, i64p, f64p, f32p, u32p)
         except (OSError, AttributeError):
             pass
         return None
