@@ -108,3 +108,33 @@ class TestTestFeatureBuilder:
         model = wf.train()
         out = model.transform(ds)
         assert out.column(vec.name).data.shape[0] == 100
+
+
+def test_assert_feature_and_transforms():
+    from transmogrifai_tpu.testkit import assert_feature, assert_transforms
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.types import Real, RealNN, Text
+    import pytest
+
+    age = FeatureBuilder.Real("age").extract(
+        lambda r: r.get("age")).as_predictor()
+    assert_feature(age, in_row={"age": 33.0}, out=33.0, name="age",
+                   feature_type=Real)
+    label = FeatureBuilder.RealNN("y").extract(
+        lambda r: r.get("y")).as_response()
+    assert_feature(label, in_row={"y": 1.0}, out=1.0, name="y",
+                   is_response=True, feature_type=RealNN)
+    with pytest.raises(AssertionError, match="name"):
+        assert_feature(age, in_row={}, out=None, name="wrong")
+    with pytest.raises(AssertionError, match="extract"):
+        assert_feature(age, in_row={"age": 1.0}, out=2.0, name="age")
+
+    windowed = FeatureBuilder.Real("w").extract(
+        lambda r: r.get("w")).window(86_400_000).as_predictor()
+    assert_feature(windowed, in_row={"w": 5.0}, out=5.0, name="w",
+                   window_ms=86_400_000)
+
+    from transmogrifai_tpu.transformers.text import TextLenTransformer
+    t = TextLenTransformer().set_input(
+        FeatureBuilder.Text("t").extract(lambda r: r.get("t")).as_predictor())
+    assert_transforms(t, [Text("abc"), Text(None)], [3, 0])

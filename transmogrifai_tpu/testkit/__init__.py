@@ -1,5 +1,6 @@
 """Test scaffolding (reference testkit module, 2,769 LoC): random typed
-data generators + TestFeatureBuilder."""
+data generators, TestFeatureBuilder, and feature asserts."""
+from .asserts import assert_feature, assert_transforms
 from .feature_builder import TestFeatureBuilder
 from .random_data import (
     RandomBinary, RandomData, RandomGeolocation, RandomIntegral, RandomList,
@@ -7,6 +8,7 @@ from .random_data import (
 )
 
 __all__ = [
+    "assert_feature", "assert_transforms",
     "RandomBinary", "RandomData", "RandomGeolocation", "RandomIntegral",
     "RandomList", "RandomMap", "RandomReal", "RandomSet", "RandomText",
     "RandomVector", "TestFeatureBuilder",
