@@ -309,3 +309,63 @@ class TestSelectorIntegration:
         best = sel.fit_arrays(X, y)
         # XOR is not linearly separable: trees must win the sweep
         assert best.summary.best_model_type == "OpGBTClassifier"
+
+
+class TestHistogramPaths:
+    """The TPU matmul-histogram path must agree with the segment-sum path
+    (they are alternative lowerings of the same reduction; grow_tree picks
+    by backend, so CPU tests exercise the matmul path explicitly here)."""
+
+    def _inputs(self, n=1000, f=6, b=8, n_nodes=4, k=2, seed=3):
+        rng = np.random.default_rng(seed)
+        Xb = jnp.asarray(rng.integers(0, b, size=(n, f)), jnp.int32)
+        G = jnp.asarray(rng.normal(size=(n, k)), jnp.float32)
+        H = jnp.asarray(rng.uniform(0.1, 1.0, size=n), jnp.float32)
+        cu = jnp.asarray(H > 0, jnp.float32)
+        node = jnp.asarray(rng.integers(0, n_nodes, size=n), jnp.int32)
+        return Xb, G, H, cu, node, n_nodes, b
+
+    def test_matmul_matches_segment(self):
+        args = self._inputs()
+        out_m = T._histograms_matmul(*args)
+        out_s = T._histograms_segment(*args)
+        for m, s in zip(out_m, out_s):
+            assert np.allclose(np.asarray(m), np.asarray(s), atol=1e-3)
+
+    def test_matmul_chunked_with_padding(self, monkeypatch):
+        # force several chunks + a ragged tail (n=1000 with chunk=256)
+        monkeypatch.setattr(T, "_HIST_CHUNK", 256)
+        args = self._inputs(n=1000)
+        out_m = T._histograms_matmul(*args)
+        out_s = T._histograms_segment(*args)
+        for m, s in zip(out_m, out_s):
+            assert np.allclose(np.asarray(m), np.asarray(s), atol=1e-3)
+
+    def test_grow_tree_matmul_path_matches(self, monkeypatch):
+        """Full tree growth with the matmul histograms (as on TPU) produces
+        the same splits and near-identical leaves as the segment path."""
+        X, y = _xor_data(n=800, seed=7)
+        edges = T.quantile_edges(jnp.asarray(X), 16)
+        Xb = T.bin_matrix(jnp.asarray(X), edges)
+        G = jnp.asarray((0.5 - y)[:, None], jnp.float32)
+        H = jnp.full((len(y),), 0.25, jnp.float32)
+        key = __import__("jax").random.PRNGKey(0)
+
+        real_backend = T.jax.default_backend
+
+        def grow(force_tpu):
+            monkeypatch.setattr(
+                T.jax, "default_backend",
+                (lambda: "tpu") if force_tpu else real_backend)
+            # bypass the jit cache: call the wrapped fn directly
+            return T.grow_tree.__wrapped__(
+                Xb, G, H, key, depth=3, n_bins=16, reg_lambda=1.0,
+                leaf_mode="newton")
+
+        t_mat = grow(True)
+        t_seg = grow(False)
+        assert np.array_equal(np.asarray(t_mat.feat), np.asarray(t_seg.feat))
+        assert np.array_equal(np.asarray(t_mat.thresh),
+                              np.asarray(t_seg.thresh))
+        assert np.allclose(np.asarray(t_mat.leaf), np.asarray(t_seg.leaf),
+                           atol=1e-4)

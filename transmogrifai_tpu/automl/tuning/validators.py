@@ -418,7 +418,10 @@ class Validator:
             path=self._sweep_path("mask_folds"))
         pending = [gi for gi in range(len(grids)) if gi not in results]
         if pending:
-            Xd, yd, wd, md = self._device_arrays(X, y, w, masks, jnp.float32)
+            # trees only read X through quantile binning, so the bf16 sweep
+            # dtype is safe here too and halves the resident matrix
+            Xd, yd, wd, md = self._device_arrays(
+                X, y, w, masks, self.sweep_dtype or jnp.float32)
             rank_bins = self._rank_bins(X.shape[0])
             mfn = _metric_fn(problem_type, metric, n_classes, rank_bins)
             thr_d = jnp.asarray(margin_thr, jnp.float32)

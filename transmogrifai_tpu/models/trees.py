@@ -134,7 +134,10 @@ class _TreeEstimator(PredictorEstimator):
 
     def _bin(self, X):
         n_bins = int(self.get_param("max_bins"))
-        Xd = jnp.asarray(X, jnp.float32)
+        # keep X's dtype (bf16 sweeps stay bf16 — no full-size f32 copy;
+        # quantile_edges casts only its row sample, bin_matrix canonicalizes
+        # per chunk)
+        Xd = jnp.asarray(X)
         edges = T.quantile_edges(Xd, n_bins)
         Xb = T.bin_matrix(Xd, edges)
         return Xb, edges, n_bins
@@ -144,16 +147,28 @@ class _TreeEstimator(PredictorEstimator):
         """Device-binned context shared by every (grid, fold) fit."""
         return self._bin(X)
 
+    # Above this row count the fold axis stops being vmapped: XLA lays the
+    # vmapped traversal's [folds, n] node-index arrays out fold-minor and
+    # pads the fold axis to the 128-lane tile (5 -> 128 = 25.6x HBM; the
+    # 10M-row bench config needed 20.9G and failed to compile). One fold of
+    # 10M rows already saturates the MXU, so large-N folds run sequentially
+    # through the SAME cached per-fold executable.
+    _VMAP_FOLD_MAX_ROWS = 2_000_000
+
     def mask_fit_scores(self, ctx, y, w, masks, n_classes: int = 2,
                         multiclass: bool = False):
         """[F, n] margins (binary/regression) or [F, n, c] class scores:
-        one vmapped-over-folds fit+predict per grid point, entirely on
-        device against the shared binned matrix. `multiclass` (the
-        validator's problem type, NOT n_classes — a multiclass sweep over
-        2-class data must still return [F, n, c]) picks the score shape."""
+        one fit+predict per fold per grid point, entirely on device against
+        the shared binned matrix. `multiclass` (the validator's problem
+        type, NOT n_classes — a multiclass sweep over 2-class data must
+        still return [F, n, c]) picks the score shape. Folds are vmapped
+        below _VMAP_FOLD_MAX_ROWS and loop over one compiled program above
+        it (see the constant's rationale)."""
         def one(m):
             return self._mask_score(ctx, y, w * m, n_classes, multiclass)
-        return jax.vmap(one)(masks)
+        if y.shape[0] <= self._VMAP_FOLD_MAX_ROWS:
+            return jax.vmap(one)(masks)
+        return jnp.stack([one(masks[f]) for f in range(masks.shape[0])])
 
     def _mask_score(self, ctx, y, w, n_classes, multiclass):
         raise NotImplementedError

@@ -7,7 +7,8 @@ Spark MLlib's tree learners behind OpRandomForest*/OpGBT*/OpDecisionTree*
 (core/.../impl/classification/, core/.../impl/regression/).
 
 Design (TPU-first, not a port):
-- Features are quantile-binned to int32 once (`quantile_edges` / `bin_matrix`);
+- Features are quantile-binned once to int8 (int32 past 128 bins;
+  `quantile_edges` / `bin_matrix`);
   all growth happens on the binned matrix, which is the XGBoost `hist`
   algorithm shape and keeps every per-level pass a dense, static-shape
   gather/segment-sum that XLA tiles well.
@@ -64,33 +65,58 @@ def quantile_edges(X: jax.Array, n_bins: int) -> jax.Array:
     if n > _QUANTILE_SAMPLE:
         stride = -(-n // _QUANTILE_SAMPLE)  # ceil
         X = X[::stride]
-    # same NaN canonicalization as bin_matrix: a NaN row would otherwise
-    # poison jnp.quantile and turn EVERY edge of that feature into NaN
+    # cast only the (<=131K-row) sample to f32 — a bf16 sweep matrix must
+    # not be copied whole — and canonicalize NaN as bin_matrix does: a NaN
+    # row would otherwise poison jnp.quantile and turn EVERY edge of that
+    # feature into NaN
+    X = jnp.asarray(X, jnp.float32)
     X = jnp.where(jnp.isnan(X), -jnp.inf, X)
     qs = jnp.arange(1, n_bins, dtype=jnp.float32) / n_bins
     edges = jnp.quantile(X, qs, axis=0)          # [n_bins-1, d]
     return jnp.asarray(edges.T, jnp.float32)     # [d, n_bins-1]
 
 
+# Rows per chunk of the binning map — bounds the f32 canonicalized copy and
+# searchsorted temporaries to O(chunk * d) instead of O(n * d) (the 10M-row
+# bench OOM'd binning: four live [10M, 64] copies).
+_BIN_CHUNK = 1 << 20
+
+
 def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
     """Digitize: bin = #edges strictly below-or-equal (searchsorted right).
 
-    X [n, d], edges [d, n_bins-1] -> int32 [n, d] in [0, n_bins-1].
-    `bin > t` is equivalent to `x >= edges[t]` for t < n_bins-1 (right-side
-    search counts edges <= x, so equality on an edge goes right) — the raw
-    serving traversal must therefore compare with >=, which matters for
-    discrete columns (one-hot indicators sit exactly on their edge).
+    X [n, d], edges [d, n_bins-1] -> int8 (int32 when n_bins > 127) [n, d]
+    in [0, n_bins-1]. `bin > t` is equivalent to `x >= edges[t]` for
+    t < n_bins-1 (right-side search counts edges <= x, so equality on an
+    edge goes right) — the raw serving traversal must therefore compare
+    with >=, which matters for discrete columns (one-hot indicators sit
+    exactly on their edge). Row blocks are processed by a lax.map so the
+    f32 temporaries never exceed O(_BIN_CHUNK * d); int8 output keeps the
+    resident binned matrix at n*d bytes (640MB at the 10M config).
     """
-    def one(col, e):
-        return jnp.searchsorted(e, col, side="right")
-    # canonicalize NaN to -inf so missing values land in bin 0 and go LEFT
-    # at every split — np_predict_ensemble's raw `x >= thresh` comparison is
-    # False for NaN (also left), keeping device training and host serving
-    # bit-identical when a NaN escapes imputation
-    Xf = jnp.asarray(X, jnp.float32)
-    Xf = jnp.where(jnp.isnan(Xf), -jnp.inf, Xf)
-    return jax.vmap(one, in_axes=(1, 0), out_axes=1)(
-        Xf, edges).astype(jnp.int32)
+    n_bins = edges.shape[1] + 1
+    # max stored bin is n_bins-1, so up to 128 bins fit int8 exactly
+    out_dtype = jnp.int8 if n_bins <= 128 else jnp.int32
+
+    def one_block(xb):
+        # canonicalize NaN to -inf so missing values land in bin 0 and go
+        # LEFT at every split — np_predict_ensemble's raw `x >= thresh`
+        # comparison is False for NaN (also left), keeping device training
+        # and host serving bit-identical when a NaN escapes imputation
+        xf = jnp.asarray(xb, jnp.float32)
+        xf = jnp.where(jnp.isnan(xf), -jnp.inf, xf)
+        return jax.vmap(
+            lambda col, e: jnp.searchsorted(e, col, side="right"),
+            in_axes=(1, 0), out_axes=1)(xf, edges).astype(out_dtype)
+
+    N, d = X.shape
+    chunk = min(_BIN_CHUNK, N)
+    nchunks = -(-N // chunk)
+    pad = nchunks * chunk - N
+    if pad:
+        X = jnp.pad(X, ((0, pad), (0, 0)))
+    out = jax.lax.map(one_block, X.reshape(nchunks, chunk, d))
+    return out.reshape(nchunks * chunk, d)[:N]
 
 
 def thresholds_to_values(feat: jax.Array, thresh: jax.Array,
@@ -167,26 +193,61 @@ def _histograms_segment(Xb, G, H, count_unit, node, n_nodes: int, B: int):
     return hg, hh, hc
 
 
+# Rows per chunk of the matmul-histogram scan. Bounds the on-device
+# temporaries (combined one-hot [chunk, F*B] + Q [chunk, nodes*C]) that the
+# unchunked design materialized at full N — the round-2 bench OOM at the
+# 10M-row config with 5 fold lanes vmapped on top.
+_HIST_CHUNK = 65_536
+
+
 def _histograms_matmul(Xb, G, H, count_unit, node, n_nodes: int, B: int):
     """Histograms as dense MXU contractions (TPU path — scatter-free).
 
-    Fold (node one-hot x payload channels) into Q [N, n_nodes*C], then for
-    each bin b contract Q^T @ (Xb == b) -> [n_nodes*C, F]. All FLOPs land
-    on the systolic array; the bin loop is a lax.map of B matmuls.
+    One combined one-hot over the (feature, bin) axis: oh[i, f*B+b] =
+    (Xb[i, f] == b), so the whole level histogram is ONE contraction
+    Q^T @ oh -> [n_nodes*C, F*B] per row chunk (Q folds the node one-hot
+    with the K+2 payload channels). F*B ~ 2048 columns keeps the MXU tiles
+    square-ish, and the chunked lax.scan caps HBM temporaries at
+    O(_HIST_CHUNK * F * B) regardless of N. Under the fold-vmapped sweep
+    the one-hot depends only on Xb (unbatched), so XLA shares it across
+    fold lanes and batches the Q contraction.
     """
     N, F = Xb.shape
     K = G.shape[1]
     C = K + 2
-    node_oh = jax.nn.one_hot(node, n_nodes, dtype=jnp.float32)   # [N, nodes]
+    FB = F * B
     P = jnp.concatenate([G, H[:, None], count_unit[:, None]], axis=1)
-    Q = (node_oh[:, :, None] * P[:, None, :]).reshape(N, n_nodes * C)
 
-    def per_bin(b):
-        mask = (Xb == b).astype(jnp.float32)                     # [N, F]
-        return Q.T @ mask                                        # [nodes*C, F]
+    chunk = min(_HIST_CHUNK, N)
+    nchunks = -(-N // chunk)
+    pad = nchunks * chunk - N
+    if pad:
+        # zero-payload padding is inert: P rows are 0, so whatever one-hot
+        # cell a padded row lands in receives +0
+        Xb = jnp.pad(Xb, ((0, pad), (0, 0)))
+        P = jnp.pad(P, ((0, pad), (0, 0)))
+        node = jnp.pad(node, ((0, pad),))
 
-    hist = jax.lax.map(per_bin, jnp.arange(B, dtype=jnp.int32))  # [B, nC, F]
-    hist = hist.transpose(1, 2, 0).reshape(n_nodes, C, F, B)
+    offs = (jnp.arange(F, dtype=jnp.int32) * B)[None, :]
+    cols = jnp.arange(FB, dtype=jnp.int32)[None, :]
+
+    def body(acc, sl):
+        xb_c, p_c, node_c = sl
+        oh = (jnp.repeat(xb_c.astype(jnp.int32) + offs, B, axis=1)
+              == cols).astype(jnp.float32)                       # [c, F*B]
+        node_oh = jax.nn.one_hot(node_c, n_nodes, dtype=jnp.float32)
+        Q = (node_oh[:, :, None] * p_c[:, None, :]).reshape(chunk,
+                                                            n_nodes * C)
+        acc = acc + jax.lax.dot_general(
+            Q, oh, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                  # [nC, F*B]
+        return acc, None
+
+    xs = (Xb.reshape(nchunks, chunk, F), P.reshape(nchunks, chunk, C),
+          node.reshape(nchunks, chunk))
+    acc0 = jnp.zeros((n_nodes * C, FB), jnp.float32)
+    hist, _ = jax.lax.scan(body, acc0, xs)
+    hist = hist.reshape(n_nodes, C, F, B)
     hg = hist[:, :K].transpose(0, 2, 3, 1)                       # [n,F,B,K]
     hh = hist[:, K]                                              # [n,F,B]
     hc = hist[:, K + 1]
@@ -207,7 +268,7 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
               feature_mask: Optional[jax.Array] = None) -> Tree:
     """Grow one depth-`depth` tree level-wise on binned features.
 
-    Xb: int32 [N, F] bins; G: f32 [N, K] per-row gradient payload (weights
+    Xb: int8/int32 [N, F] bins; G: f32 [N, K] per-row gradient payload (weights
     folded in); H: f32 [N] per-row hessian/weight (0 = row excluded, which
     is how bootstrap, fold masks and padding enter). Rows, features and bins
     are all machine axes; the level loop is a static Python unroll.
@@ -219,14 +280,25 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
     N, F = Xb.shape
     K = G.shape[1]
     B = n_bins
-    rows = jnp.arange(N)
     count_unit = jnp.asarray(H > 0, jnp.float32)
     # TPU: histograms as MXU matmuls (scatter lowers poorly there);
     # CPU/GPU: one fused segment-sum. Identical results either way.
     use_matmul = jax.default_backend() == "tpu"
+    if use_matmul and N > _HIST_CHUNK:
+        # pad rows ONCE to the histogram chunk multiple (zero payload =
+        # inert) so the per-level histogram calls never re-copy the arrays
+        pad = -(-N // _HIST_CHUNK) * _HIST_CHUNK - N
+        if pad:
+            Xb = jnp.pad(Xb, ((0, pad), (0, 0)))
+            G = jnp.pad(G, ((0, pad), (0, 0)))
+            H = jnp.pad(H, ((0, pad),))
+            count_unit = jnp.pad(count_unit, ((0, pad),))
+            N += pad
+    rows = jnp.arange(N)
 
     node = jnp.zeros(N, jnp.int32)   # in-level relative node id
     feats, threshs = [], []
+    last = None                      # (GL, HL, Gt, Ht, f_lvl, t_lvl)
     for d in range(depth):
         n_nodes = 1 << d
         if use_matmul:
@@ -259,14 +331,30 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
         t_lvl = jnp.where(ok, (best % B).astype(jnp.int32), B - 1)
         feats.append(f_lvl)
         threshs.append(t_lvl)
+        last = (GL, HL, Gt, Ht, f_lvl, t_lvl)
 
         xb = Xb[rows, f_lvl[node]]
         node = 2 * node + (xb > t_lvl[node]).astype(jnp.int32)
 
     # -- leaves -------------------------------------------------------------
+    # Leaf sums come for free from the LAST level's cumulative histograms:
+    # left child of node n = GL[n, f_n, t_n] (everything at or below the
+    # chosen threshold), right child = Gt[n] - left. A dead node
+    # (t = B-1) sends its whole mass left and 0 right — exactly the
+    # all-rows-left traversal encoding. This removes the full-N
+    # segment-sum (a scatter XLA serializes on TPU) from the leaf pass.
     n_leaves = 1 << depth
-    Gl = jax.ops.segment_sum(G, node, num_segments=n_leaves)     # [L, K]
-    Hl = jax.ops.segment_sum(H, node, num_segments=n_leaves)     # [L]
+    if depth == 0:
+        Gl = G.sum(axis=0, keepdims=True)                        # [1, K]
+        Hl = H.sum()[None]
+    else:
+        GL, HL, Gt, Ht, f_lvl, t_lvl = last
+        n_nodes = n_leaves // 2
+        nid = jnp.arange(n_nodes)
+        Gleft = GL[nid, f_lvl, t_lvl, :]                         # [n, K]
+        Hleft = HL[nid, f_lvl, t_lvl]                            # [n]
+        Gl = jnp.stack([Gleft, Gt - Gleft], 1).reshape(n_leaves, K)
+        Hl = jnp.stack([Hleft, Ht - Hleft], 1).reshape(n_leaves)
     if leaf_mode == "newton":
         leaf = -Gl / (Hl + reg_lambda + EPS)[:, None]
     else:  # mean

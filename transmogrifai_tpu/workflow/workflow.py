@@ -23,6 +23,7 @@ from ..features.feature import Feature
 from ..readers.readers import Reader
 from ..stages.base import PipelineStage, Transformer
 from ..types import ColumnKind, Prediction
+from ..utils.gcpause import paused_gc
 from .dag import (StagesDAG, collect_features, collect_raw_features,
                   compute_dag, validate_stages)
 from .fitting import LayerRunner
@@ -128,6 +129,10 @@ class Workflow:
 
     # -- training ----------------------------------------------------------
     def train(self) -> "WorkflowModel":
+        with paused_gc():
+            return self._train()
+
+    def _train(self) -> "WorkflowModel":
         raw_data = self.generate_raw_data()
         dag = compute_dag(self._result_features)
         validate_stages(dag)
@@ -282,7 +287,8 @@ class WorkflowModel:
             if self._reader is None:
                 raise ValueError("score needs a dataset or a reader")
             ds = self._reader.generate_dataset(self.raw_features())
-        return self.runner.apply_dag(ds, self.dag)
+        with paused_gc():
+            return self.runner.apply_dag(ds, self.dag)
 
     def score(self, ds: Optional[Dataset] = None,
               keep_raw_features: bool = False) -> Dataset:
