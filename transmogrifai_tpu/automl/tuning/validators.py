@@ -104,6 +104,26 @@ def _metric_fn(problem_type: str, metric: str, n_classes: int = 2,
     raise ValueError(f"No vmapped metric for problem type {problem_type}")
 
 
+# Rows above which GLM sweeps route through the streaming lane-batched
+# kernel (ops/glm_sweep.py): one X pass per Newton iteration for ALL
+# (fold x grid) lanes instead of one per lane. Below it, the per-lane
+# vmapped program is simpler and compile-cheaper.
+STREAMED_SWEEP_MIN_ROWS = 200_000
+
+
+@partial(jax.jit,
+         static_argnames=("metric", "problem_type", "n_classes",
+                          "rank_bins", "chunk"))
+def _streamed_eval(X, y, vw, Bc, b0c, thr, *, metric, problem_type,
+                   n_classes=2, rank_bins=None, chunk=8):
+    """Metrics for one fold's grid chunk of streamed-sweep coefficients:
+    scores in one MXU contraction, metric kernels vmapped over lanes."""
+    mfn = _metric_fn(problem_type, metric, n_classes, rank_bins)
+    from ...ops.glm_sweep import sweep_scores_fold
+    s = sweep_scores_fold(X, Bc, b0c)                   # [n, chunk]
+    return jax.vmap(lambda col: mfn(col, y, vw, thr), in_axes=1)(s)
+
+
 @partial(jax.jit,
          static_argnames=("fit_one", "metric", "problem_type", "n_classes",
                           "rank_bins"))
@@ -204,7 +224,10 @@ class Validator:
         for est, grids in models:
             if not grids:
                 grids = [dict()]
-            if self._vmappable(est, grids, problem_type):
+            if self._streamable(est, grids, problem_type, X):
+                validated.extend(self._validate_streamed(
+                    est, grids, X, y, w, masks, metric, problem_type))
+            elif self._vmappable(est, grids, problem_type):
                 validated.extend(self._validate_vmapped(
                     est, grids, X, y, w, masks, metric, problem_type))
             elif (self.mask_fold_trees
@@ -229,6 +252,18 @@ class Validator:
 
     # -- vmapped GLM path --------------------------------------------------
     @staticmethod
+    def _constant_off_axis(est: PredictorEstimator, grids: List[ParamMap],
+                           axes) -> bool:
+        """Every non-axis grid key must be constant across the grid (those
+        become static jit args via copy)."""
+        others = {k for g in grids for k in g if k not in axes}
+        for k in others:
+            vals = {repr(g.get(k, est.get_param(k))) for g in grids}
+            if len(vals) > 1:
+                return False
+        return True
+
+    @staticmethod
     def _vmappable(est: PredictorEstimator, grids: List[ParamMap],
                    problem_type: str) -> bool:
         if not getattr(est, "supports_grid_vmap", False):
@@ -239,14 +274,27 @@ class Validator:
         elif problem_type not in ("binary", "regression"):
             return False
         _, axes = est.batched_fit_fn()
-        # every non-axis grid key must be constant across the grid (those
-        # become static jit args via copy)
-        others = {k for g in grids for k in g if k not in axes}
-        for k in others:
-            vals = {repr(g.get(k, est.get_param(k))) for g in grids}
-            if len(vals) > 1:
-                return False
-        return True
+        return Validator._constant_off_axis(est, grids, axes)
+
+    def _streamable(self, est: PredictorEstimator, grids: List[ParamMap],
+                    problem_type: str, X) -> bool:
+        """Large binary/regression GLM sweeps route through the streaming
+        lane-batched kernel (ops/glm_sweep.py). Mesh runs keep the vmapped
+        program whose row-sharded matmuls GSPMD already partitions. Wide
+        matrices stay vmapped too: the streamed kernel's per-block
+        compressed outer-product buffer scales O(_ROW_BLOCK * d^2 / 2) and
+        would blow HBM past ~128 features (the vmapped path's HBM-budget
+        chunker handles those)."""
+        if getattr(est, "streamed_loss", None) is None:
+            return False
+        if problem_type not in ("binary", "regression"):
+            return False
+        if self.mesh is not None or X.shape[0] < STREAMED_SWEEP_MIN_ROWS:
+            return False
+        if X.shape[1] > 128:
+            return False
+        _, axes = est.batched_fit_fn()
+        return self._constant_off_axis(est, grids, axes)
 
     # -- shared helpers for the device-sweep paths --------------------------
     def _margin_threshold(self, est) -> float:
@@ -355,14 +403,10 @@ class Validator:
         base = est.copy(**{k: v for k, v in grids[0].items()})
         n_classes = int(np.max(y)) + 1 if problem_type == "multiclass" else 2
         if problem_type == "multiclass":
-            fit_one, axes = base.batched_fit_fn(n_classes=n_classes)
+            fit_one, _ = base.batched_fit_fn(n_classes=n_classes)
         else:
-            fit_one, axes = base.batched_fit_fn()
-        regs = np.array([g.get(axes[0], est.get_param(axes[0]))
-                         for g in grids], np.float32)
-        second = axes[1] if len(axes) > 1 else None
-        alphas = np.array([g.get(second, est.get_param(second)) if second
-                           else 0.0 for g in grids], np.float32)
+            fit_one, _ = base.batched_fit_fn()
+        regs, alphas = self._grid_axis_arrays(est, grids)
         margin_thr = self._margin_threshold(est)
 
         dtype = self.sweep_dtype or jnp.float32
@@ -394,6 +438,79 @@ class Validator:
                     if ckpt is not None:
                         ckpt.record(keys[gi], type(est).__name__, grids[gi],
                                     fm, metric)
+        return [
+            ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
+                           grid=g, metric_name=metric,
+                           fold_metrics=results[gi])
+            for gi, g in enumerate(grids)
+        ]
+
+    @staticmethod
+    def _grid_axis_arrays(est, grids) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-grid (regs, alphas) along the estimator's sweep axes —
+        shared by the vmapped and streamed paths."""
+        _, axes = est.batched_fit_fn()
+        regs = np.array([g.get(axes[0], est.get_param(axes[0]))
+                         for g in grids], np.float32)
+        second = axes[1] if len(axes) > 1 else None
+        alphas = np.array([g.get(second, est.get_param(second)) if second
+                           else 0.0 for g in grids], np.float32)
+        return regs, alphas
+
+    # -- streamed GLM path --------------------------------------------------
+    _STREAMED_EVAL_CHUNK = 8
+
+    def _validate_streamed(self, est, grids, X, y, w, masks, metric,
+                           problem_type) -> List[ValidatedModel]:
+        """Streaming lane-batched sweep: ONE program fits every pending
+        (fold x grid) cell with a single X pass per Newton iteration
+        (ops/glm_sweep.sweep_glm_streamed); metrics then run per fold in
+        grid chunks of one scoring matmul each."""
+        from ...ops.glm_sweep import sweep_glm_streamed
+
+        regs, alphas = self._grid_axis_arrays(est, grids)
+        # constant off-axis grid keys (admitted by _constant_off_axis) must
+        # bind exactly as on the vmapped path: est.copy(**grids[0])
+        base = est.copy(**{k: v for k, v in grids[0].items()})
+        margin_thr = self._margin_threshold(est)
+        dtype = self.sweep_dtype or jnp.float32
+        ckpt, keys, results = self._cell_bookkeeping(
+            est, grids, X, y, metric, masks.shape[0],
+            path=self._sweep_path(f"streamed:{jnp.dtype(dtype).name}"))
+        pending = [gi for gi in range(len(grids)) if gi not in results]
+        if pending:
+            Xd, yd, wd, md = self._device_arrays(X, y, w, masks, dtype)
+            B, b0 = sweep_glm_streamed(
+                Xd, yd, wd, md, jnp.asarray(regs[pending]),
+                jnp.asarray(alphas[pending]),
+                loss=est.streamed_loss,
+                max_iter=int(base.get_param("max_iter")),
+                tol=float(base.get_param("tol")),
+                fit_intercept=bool(base.get_param("fit_intercept"))
+                if base.has_param("fit_intercept") else True,
+                standardize=bool(base.get_param("standardization"))
+                if base.has_param("standardization") else True)
+            rank_bins = self._rank_bins(X.shape[0])
+            thr_d = jnp.asarray(margin_thr, jnp.float32)
+            chunk = min(self._STREAMED_EVAL_CHUNK, len(pending))
+            out = np.empty((masks.shape[0], len(pending)), np.float64)
+            for f in range(masks.shape[0]):
+                vw = (1.0 - md[f]) * wd
+                for s in range(0, len(pending), chunk):
+                    idx = list(range(s, min(s + chunk, len(pending))))
+                    padded = idx + [idx[-1]] * (chunk - len(idx))
+                    vals = _streamed_eval(
+                        Xd, yd, vw, B[f, jnp.asarray(padded)],
+                        b0[f, jnp.asarray(padded)], thr_d, metric=metric,
+                        problem_type=problem_type, rank_bins=rank_bins,
+                        chunk=chunk)
+                    out[f, idx] = np.asarray(vals)[:len(idx)]
+            for j, gi in enumerate(pending):
+                fm = [float(v) for v in out[:, j]]
+                results[gi] = fm
+                if ckpt is not None:
+                    ckpt.record(keys[gi], type(est).__name__, grids[gi],
+                                fm, metric)
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
                            grid=g, metric_name=metric,

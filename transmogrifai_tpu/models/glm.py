@@ -188,6 +188,9 @@ class OpLogisticRegression(PredictorEstimator):
     problem_types = ("binary", "multiclass")
     supports_grid_vmap = True
     supports_multiclass_vmap = True
+    # large binary sweeps stream ALL (fold x grid) lanes through one
+    # X pass per Newton iteration (ops/glm_sweep.py)
+    streamed_loss = "logistic"
 
     @classmethod
     def _declare_params(cls):
@@ -259,6 +262,7 @@ class OpLinearSVC(PredictorEstimator):
     problem_types = ("binary",)
     supports_grid_vmap = True
     produces_probabilities = False
+    streamed_loss = "squared_hinge"
 
     @classmethod
     def _declare_params(cls):
@@ -326,6 +330,7 @@ class OpLinearRegression(PredictorEstimator):
 
     problem_types = ("regression",)
     supports_grid_vmap = True
+    streamed_loss = "squared"
 
     @classmethod
     def _declare_params(cls):

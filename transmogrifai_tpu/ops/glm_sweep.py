@@ -1,0 +1,207 @@
+"""Lane-batched streaming GLM sweep: every (fold x grid) fit in ONE pass
+over the feature matrix per Newton iteration.
+
+The vmapped sweep (`automl/tuning/validators._sweep`) runs `fit_one` per
+lane, so each of the L = folds x grid lanes re-streams the [n, d] matrix
+from HBM every iteration and materializes its own weighted [n, d] product
+for the Gram matmul — at the 10M-row BASELINE config that is ~5GB of HBM
+traffic per lane-iteration and forces the validator to chunk the grid to a
+handful of lanes per program. The whole sweep is HBM-bound at a few
+percent MFU.
+
+This kernel restructures the math so X streams ONCE per iteration for ALL
+lanes (reference workload: the 8-thread pool of OpValidator.scala:270-332,
+every thread refitting against the same cached DataFrame):
+
+- one row-block scan per Newton iteration, carrying per-lane accumulators
+  (g [L, d], compressed Hessian [L, T], intercept sums);
+- lane etas in one MXU contraction `X_blk @ B.T` ([c, d] x [d, L]);
+- every lane's Gram matrix from ONE contraction against the compressed
+  outer-product block XX [c, T], T = d(d+1)/2 upper-triangle pairs:
+  H_tri = S^T @ XX, where S [c, L] are the per-lane curvature weights.
+  No per-lane scaled copy of X exists anywhere, and the triangle halves
+  the contraction FLOPs vs naive [L, d, d] Grams;
+- per-lane 64x64 Newton solves + proximal L1 + intercept steps are
+  batched dense linalg on [L, d, d] — microscopic next to the scan.
+
+Fold masks enter as weights (mask * w), exactly like the vmapped path, so
+fold semantics are identical; the elementwise residual/curvature rules per
+loss mirror ops/glm's solvers (logistic IRLS, squared, squared-hinge).
+
+Standardization note: the per-lane solvers standardize with the lane's own
+(fold-masked) weights; this kernel standardizes ONCE with the global
+weights so the standardized matrix can be shared by every lane. Fold
+means/stds differ from global ones by O(1/sqrt(n)) — statistically inert
+at the scales where this kernel is selected (the validator still routes
+small problems through the per-lane path).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import glm as G
+
+EPS = 1e-12
+
+# Rows per scan block: bounds the [c, T] outer-product block (f32, T=2080
+# at d=64 -> 256MB) and the [c, L] residual/curvature blocks.
+_ROW_BLOCK = 32_768
+
+
+@functools.lru_cache(maxsize=None)
+def _tri_maps(d: int):
+    """(iu0, iu1, expand) for the compressed symmetric Gram.
+
+    iu0/iu1 [T]: column pairs of the upper triangle (diagonal included).
+    expand [d*d]: full-matrix cell -> triangle slot, so
+    H_full = H_tri[:, expand].reshape(L, d, d) (a static gather)."""
+    iu = np.triu_indices(d)
+    slot = np.zeros((d, d), np.int32)
+    slot[iu] = np.arange(iu[0].size, dtype=np.int32)
+    slot = np.maximum(slot, slot.T)
+    # numpy (NOT jnp): this cache is populated inside jit traces, where
+    # jnp.asarray would capture a per-trace constant tracer and leak it
+    # into later traces
+    return (iu[0].astype(np.int32), iu[1].astype(np.int32),
+            slot.reshape(-1).astype(np.int32))
+
+
+def _residual_curvature(loss: str):
+    """Unweighted per-row residual r and curvature s for eta [c, L]."""
+    if loss == "logistic":
+        def rc(eta, y):
+            p = jax.nn.sigmoid(eta)
+            return p - y[:, None], jnp.maximum(p * (1.0 - p), 1e-6)
+    elif loss == "squared":
+        def rc(eta, y):
+            return eta - y[:, None], jnp.ones_like(eta)
+    elif loss == "squared_hinge":
+        def rc(eta, y):
+            ypm = (2.0 * y - 1.0)[:, None]
+            gap = jnp.maximum(1.0 - ypm * eta, 0.0)
+            return -2.0 * gap * ypm, 2.0 * (gap > 0.0).astype(eta.dtype)
+    else:
+        raise ValueError(f"unknown streamed loss {loss!r}")
+    return rc
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("loss", "max_iter", "tol", "fit_intercept",
+                     "standardize"))
+def sweep_glm_streamed(X: jax.Array, y: jax.Array, w: jax.Array,
+                       fold_masks: jax.Array, regs: jax.Array,
+                       alphas: jax.Array, *, loss: str = "logistic",
+                       max_iter: int = 50, tol: float = 1e-6,
+                       fit_intercept: bool = True,
+                       standardize: bool = True
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """All (fold, grid) fits in one program: returns (B [F, G, d] f32,
+    b0 [F, G]) in RAW feature units (unstandardized)."""
+    n, d = X.shape
+    F = fold_masks.shape[0]
+    Gn = regs.shape[0]
+    L = F * Gn
+    rc = _residual_curvature(loss)
+    iu0, iu1, expand = _tri_maps(d)
+    T = iu0.shape[0]
+
+    if standardize:
+        Xs, mean, std = G._standardize(X, w)
+    else:
+        Xs = X
+        mean = jnp.zeros(d, jnp.float32)
+        std = jnp.ones(d, jnp.float32)
+
+    # lane layout: l = f * Gn + g  (fold-major, so per-fold weights expand
+    # by broadcast over the grid axis)
+    l1 = jnp.tile(regs * alphas, F)                     # [L]
+    l2 = jnp.tile(regs * (1.0 - alphas), F)             # [L]
+    wsum_f = jnp.maximum((fold_masks * w[None, :]).sum(1), EPS)   # [F]
+    wsum_l = jnp.repeat(wsum_f, Gn)                     # [L]
+
+    # pad rows to the block multiple with w=0 (inert in every reduction)
+    c = min(_ROW_BLOCK, n)
+    nb = -(-n // c)
+    pad = nb * c - n
+    if pad:
+        Xs = jnp.pad(Xs, ((0, pad), (0, 0)))
+        y = jnp.pad(y, (0, pad))
+        w = jnp.pad(w, (0, pad))
+        fold_masks = jnp.pad(fold_masks, ((0, 0), (0, pad)))
+    xs = (Xs.reshape(nb, c, d), y.reshape(nb, c), w.reshape(nb, c),
+          fold_masks.reshape(F, nb, c).transpose(1, 0, 2))
+
+    eye = jnp.eye(d, dtype=jnp.float32)
+
+    def accumulate(B, b0):
+        """One streaming pass: per-lane (g [L,d], H_tri [L,T], g0, h0)."""
+        Bt = B.T.astype(Xs.dtype)                       # [d, L]
+
+        def body(acc, sl):
+            x_blk, y_blk, w_blk, m_blk = sl             # m_blk [F, c]
+            gA, hA, g0A, h0A = acc
+            eta = jnp.matmul(x_blk, Bt,
+                             preferred_element_type=jnp.float32) + b0[None, :]
+            r0, s0 = rc(eta, y_blk)                     # [c, L]
+            wlf = m_blk.T * w_blk[:, None]              # [c, F]
+            wl = jnp.repeat(wlf, Gn, axis=1)            # [c, L] lane weights
+            R = r0 * wl
+            S = s0 * wl
+            xf = x_blk.astype(jnp.float32)
+            xx = xf[:, iu0] * xf[:, iu1]                # [c, T]
+            gA = gA + jnp.matmul(xf.T, R,
+                                 preferred_element_type=jnp.float32).T
+            hA = hA + jnp.matmul(S.T, xx,
+                                 preferred_element_type=jnp.float32)
+            return (gA, hA, g0A + R.sum(0), h0A + S.sum(0)), None
+
+        acc0 = (jnp.zeros((L, d), jnp.float32), jnp.zeros((L, T), jnp.float32),
+                jnp.zeros(L, jnp.float32), jnp.zeros(L, jnp.float32))
+        (gA, hA, g0A, h0A), _ = jax.lax.scan(body, acc0, xs)
+        return gA, hA, g0A, h0A
+
+    def cond(state):
+        i, _, _, delta = state
+        return (i < max_iter) & (delta > tol)
+
+    def body(state):
+        i, B, b0, _ = state
+        gA, hA, g0A, h0A = accumulate(B, b0)
+        g = gA / wsum_l[:, None] + l2[:, None] * B                  # [L, d]
+        H = hA[:, expand].reshape(L, d, d) / wsum_l[:, None, None]
+        H = H + (l2[:, None, None] + 1e-6) * eye[None]
+        step = jnp.linalg.solve(H, g[..., None])[..., 0]
+        B_new = B - step
+        hdiag = jnp.maximum(jnp.diagonal(H, axis1=1, axis2=2), EPS)
+        B_new = (jnp.sign(B_new)
+                 * jnp.maximum(jnp.abs(B_new) - l1[:, None] / hdiag, 0.0))
+        if fit_intercept:
+            b0_new = b0 - (g0A / wsum_l) / jnp.maximum(h0A / wsum_l, EPS)
+        else:
+            b0_new = b0
+        delta = (jnp.abs(B_new - B).max(axis=1)
+                 + jnp.abs(b0_new - b0)).max()
+        return i + 1, B_new, b0_new, delta
+
+    state = (jnp.asarray(0, jnp.int32), jnp.zeros((L, d), jnp.float32),
+             jnp.zeros(L, jnp.float32), jnp.asarray(jnp.inf, jnp.float32))
+    _, B, b0, _ = jax.lax.while_loop(cond, body, state)
+
+    if standardize:
+        B = B / std[None, :]
+        b0 = b0 - (B * mean[None, :]).sum(1)
+    return B.reshape(F, Gn, d), b0.reshape(F, Gn)
+
+
+def sweep_scores_fold(X: jax.Array, B_f: jax.Array, b0_f: jax.Array
+                      ) -> jax.Array:
+    """[n, Gc] margins for one fold's grid chunk: one MXU contraction
+    (bf16 X stays bf16; f32 accumulation)."""
+    return jnp.matmul(X, B_f.T.astype(X.dtype),
+                      preferred_element_type=jnp.float32) + b0_f[None, :]
