@@ -369,3 +369,53 @@ class TestHistogramPaths:
                               np.asarray(t_seg.thresh))
         assert np.allclose(np.asarray(t_mat.leaf), np.asarray(t_seg.leaf),
                            atol=1e-4)
+
+    def test_tpu_gather_free_paths_match(self, monkeypatch):
+        """bin_matrix (edge counting), routing and prediction one-hot
+        contractions — selected when backend=='tpu' — must equal the
+        gather-based CPU lowerings exactly."""
+        import jax
+        X, y = _xor_data(n=700, seed=11)
+        real_backend = T.jax.default_backend
+        edges = T.quantile_edges(jnp.asarray(X), 16)
+
+        monkeypatch.setattr(T.jax, "default_backend", lambda: "tpu")
+        Xb_t = T.bin_matrix(jnp.asarray(X), edges)
+        monkeypatch.setattr(T.jax, "default_backend", real_backend)
+        Xb_c = T.bin_matrix(jnp.asarray(X), edges)
+        assert np.array_equal(np.asarray(Xb_t), np.asarray(Xb_c))
+
+        G = (0.5 - y)[:, None]
+        H = jnp.full((len(y),), 0.25, jnp.float32)
+        tree = T.grow_tree(Xb_c, jnp.asarray(G), H, __import__("jax").random.PRNGKey(3),
+                           depth=4, n_bins=16, reg_lambda=1.0,
+                           leaf_mode="newton")
+        # routing parity
+        node = jnp.asarray(np.random.default_rng(0).integers(0, 4, len(y)),
+                           jnp.int32)
+        f_lvl = tree.feat[3:7]
+        t_lvl = tree.thresh[3:7]
+        routed = T._route_level_matmul(Xb_c, node, f_lvl, t_lvl, 4)
+        rows = jnp.arange(len(y))
+        expect = 2 * node + (Xb_c[rows, f_lvl[node]]
+                             > t_lvl[node]).astype(jnp.int32)
+        assert np.array_equal(np.asarray(routed), np.asarray(expect))
+        # prediction parity
+        out_m = T._predict_bins_matmul(tree, Xb_c, 4)
+        out_g = T.predict_bins(tree, Xb_c, 4)
+        assert np.allclose(np.asarray(out_m), np.asarray(out_g), atol=1e-6)
+
+    def test_route_chunk_padding(self, monkeypatch):
+        monkeypatch.setattr(T, "_ROUTE_CHUNK", 128)
+        X, y = _xor_data(n=500, seed=13)
+        edges = T.quantile_edges(jnp.asarray(X), 8)
+        Xb = T.bin_matrix(jnp.asarray(X), edges)
+        node = jnp.asarray(np.random.default_rng(1).integers(0, 2, len(y)),
+                           jnp.int32)
+        f_lvl = jnp.asarray([1, 2], jnp.int32)
+        t_lvl = jnp.asarray([3, 5], jnp.int32)
+        routed = T._route_level_matmul(Xb, node, f_lvl, t_lvl, 2)
+        rows = jnp.arange(len(y))
+        expect = 2 * node + (Xb[rows, f_lvl[node]]
+                             > t_lvl[node]).astype(jnp.int32)
+        assert np.array_equal(np.asarray(routed), np.asarray(expect))

@@ -154,6 +154,10 @@ class _TreeEstimator(PredictorEstimator):
     # 10M rows already saturates the MXU, so large-N folds run sequentially
     # through the SAME cached per-fold executable.
     _VMAP_FOLD_MAX_ROWS = 2_000_000
+    # the fold-vmapped branch must never reach the pallas histogram path
+    # (pallas_call does not sit under a batch axis here) — enforced against
+    # the kernel-selection threshold, not by comment
+    assert _VMAP_FOLD_MAX_ROWS < T._PALLAS_MIN_ROWS
 
     def mask_fit_scores(self, ctx, y, w, masks, n_classes: int = 2,
                         multiclass: bool = False):

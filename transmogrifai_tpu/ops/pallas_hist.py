@@ -1,0 +1,109 @@
+"""Pallas TPU kernel for tree gradient histograms.
+
+The XLA chunked histogram path (ops/trees._histograms_matmul) materializes
+its [chunk, F*B] one-hot block in HBM every scan step — ~1GB of write+read
+traffic per 64K-row chunk, ~150GB per level at the 10M-row BASELINE
+config, which dominates the tree sweep's wall clock. This kernel builds
+the one-hot tiles directly in VMEM (they never exist in HBM) and leaves
+one MXU contraction per row block:
+
+    out[slot*C + c, f*B + b] += sum_i  1[slot_i = slot] * P[c, i]
+                                     * 1[Xb[f, i] = b]
+
+- inputs arrive TRANSPOSED ([F, N] / [C, N] / [1, N]) so the huge axis is
+  minor: TPU tiling pads the minor axis to 128 lanes, and feeding [N, C]
+  with C=4 would inflate HBM 32x (the round-2 fold-vmap OOM was exactly
+  this padding on [5, 10M] arrays);
+- the (feature, bin) one-hot is a VPU broadcast-compare reshaped
+  [F, B, blk] -> [F*B, blk] (leading-dim merge, layout-free);
+- slot one-hots drop out-of-range ids (slot = n_slots encodes "row
+  contributes nothing" — how histogram subtraction or padded rows enter);
+- grid steps run sequentially on the core, accumulating into the same
+  VMEM output block (zeroed at step 0).
+
+Reference workload: XGBoost's hist-method gradient histograms, the C++
+path behind the reference's OpXGBoost* wrappers (SURVEY §2.9).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_BLK = 512
+
+
+def available() -> bool:
+    """Pallas path usable? (TPU backend with pallas importable.)"""
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        from jax.experimental.pallas import tpu  # noqa: F401
+    except Exception:
+        return False
+    return True
+
+
+def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots):
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    blk = xb_ref.shape[1]
+    xf = xb_ref[:].astype(jnp.float32)                      # [F, blk]
+    bins = jax.lax.broadcasted_iota(jnp.float32, (1, B, 1), 1)
+    oh = (xf[:, None, :] == bins).astype(jnp.float32)       # [F, B, blk]
+    oh = oh.reshape(F * B, blk)
+
+    slot = slot_ref[:]                                      # [1, blk]
+    slots = jax.lax.broadcasted_iota(jnp.float32, (n_slots, blk), 0)
+    slot_oh = (slots == slot).astype(jnp.float32)           # [n_slots, blk]
+    pay = pay_ref[:]                                        # [C, blk]
+    q = (slot_oh[:, None, :] * pay[None, :, :]).reshape(n_slots * C, blk)
+
+    out_ref[:] += jax.lax.dot_general(
+        q, oh, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                 # [S*C, F*B]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_slots", "n_bins", "interpret"))
+def hist_pallas(Xb_t: jax.Array, pay_t: jax.Array, slot_t: jax.Array,
+                *, n_slots: int, n_bins: int,
+                interpret: bool = False) -> jax.Array:
+    """Gradient histograms [n_slots * C, F * n_bins] (f32).
+
+    Xb_t [F, N] int bins; pay_t [C, N] f32 payload channels; slot_t [1, N]
+    f32 slot ids (n_slots drops the row). N must be a _BLK multiple (the
+    tree grower pads rows once per fit).
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    F, N = Xb_t.shape
+    C = pay_t.shape[0]
+    B = n_bins
+    assert N % _BLK == 0, f"rows {N} not a multiple of {_BLK}"
+
+    kernel = functools.partial(_kernel, F=F, B=B, C=C, n_slots=n_slots)
+    return pl.pallas_call(
+        kernel,
+        grid=(N // _BLK,),
+        in_specs=[
+            pl.BlockSpec((F, _BLK), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, _BLK), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _BLK), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((n_slots * C, F * B), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_slots * C, F * B), jnp.float32),
+        interpret=interpret,
+    )(Xb_t, pay_t, slot_t)

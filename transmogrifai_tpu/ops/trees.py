@@ -32,6 +32,7 @@ Design (TPU-first, not a port):
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -77,9 +78,10 @@ def quantile_edges(X: jax.Array, n_bins: int) -> jax.Array:
 
 
 # Rows per chunk of the binning map — bounds the f32 canonicalized copy and
-# searchsorted temporaries to O(chunk * d) instead of O(n * d) (the 10M-row
-# bench OOM'd binning: four live [10M, 64] copies).
-_BIN_CHUNK = 1 << 20
+# the [chunk, d, B-1] digitize-compare broadcast to O(chunk * d * B) instead
+# of O(n * d * B) (the 10M-row bench OOM'd binning: four live [10M, 64]
+# copies).
+_BIN_CHUNK = 1 << 18
 
 
 def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
@@ -98,6 +100,12 @@ def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
     # max stored bin is n_bins-1, so up to 128 bins fit int8 exactly
     out_dtype = jnp.int8 if n_bins <= 128 else jnp.int32
 
+    # TPU: digitize by counting edges <= x (identical to right-side
+    # searchsorted) — a fused broadcast-compare+reduce instead of the
+    # binary-search gathers searchsorted lowers to (TPU serializes
+    # data-dependent gathers); CPU keeps the O(log B) search.
+    count_edges = jax.default_backend() == "tpu"
+
     def one_block(xb):
         # canonicalize NaN to -inf so missing values land in bin 0 and go
         # LEFT at every split — np_predict_ensemble's raw `x >= thresh`
@@ -105,6 +113,9 @@ def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
         # and host serving bit-identical when a NaN escapes imputation
         xf = jnp.asarray(xb, jnp.float32)
         xf = jnp.where(jnp.isnan(xf), -jnp.inf, xf)
+        if count_edges:
+            bins = (xf[:, :, None] >= edges[None, :, :]).sum(axis=2)
+            return bins.astype(out_dtype)
         return jax.vmap(
             lambda col, e: jnp.searchsorted(e, col, side="right"),
             in_axes=(1, 0), out_axes=1)(xf, edges).astype(out_dtype)
@@ -199,6 +210,34 @@ def _histograms_segment(Xb, G, H, count_unit, node, n_nodes: int, B: int):
 # 10M-row config with 5 fold lanes vmapped on top.
 _HIST_CHUNK = 65_536
 
+# Above this many rows the level histograms go through the pallas kernel
+# (ops/pallas_hist.py): the one-hot tiles then live only in VMEM instead
+# of costing ~1GB of HBM write+read per 64K-row chunk. MUST stay above
+# models/trees._VMAP_FOLD_MAX_ROWS so a pallas_call never sits under the
+# fold vmap (models/trees.py asserts the ordering at import).
+_PALLAS_MIN_ROWS = 4_000_000
+
+# Read once at import: grow_tree is jitted, so a mid-process env toggle
+# could never affect already-cached executables anyway — a module constant
+# makes the set-before-first-use contract explicit.
+_NO_PALLAS = bool(os.environ.get("TMOG_NO_PALLAS"))
+
+
+def _histograms_pallas(Xb, G, H, count_unit, node, n_nodes: int, B: int):
+    """Level histograms via the VMEM-resident pallas kernel (transposed
+    operands — see ops/pallas_hist.py for the layout rationale)."""
+    from . import pallas_hist
+    N, F = Xb.shape
+    K = G.shape[1]
+    C = K + 2
+    pay = jnp.concatenate(
+        [G.T, H[None, :], count_unit[None, :]], axis=0)      # [C, N]
+    hist = pallas_hist.hist_pallas(
+        Xb.T, pay, node[None, :].astype(jnp.float32),
+        n_slots=n_nodes, n_bins=B)                           # [nC, F*B]
+    hist = hist.reshape(n_nodes, C, F, B)
+    return (hist[:, :K].transpose(0, 2, 3, 1), hist[:, K], hist[:, K + 1])
+
 
 def _histograms_matmul(Xb, G, H, count_unit, node, n_nodes: int, B: int):
     """Histograms as dense MXU contractions (TPU path — scatter-free).
@@ -254,10 +293,53 @@ def _histograms_matmul(Xb, G, H, count_unit, node, n_nodes: int, B: int):
     return hg, hh, hc
 
 
+# Rows per chunk of the one-hot routing/prediction maps (bounds the
+# [chunk, F] selection products).
+_ROUTE_CHUNK = 1 << 20
+
+
+def _onehot_route_step(xf, rel, f_lvl, t_lvl, n_nodes: int):
+    """One gather-free routing step: rel' = 2*rel + (xf[i, f(rel)] > t(rel)).
+
+    TPU serializes data-dependent row gathers, so the per-row feature
+    select becomes a one-hot contraction: sel = onehot(rel) @ FS with
+    FS[n, f] = (f_lvl[n] == f); the selected bin is then a masked row sum.
+    Exact for bin values (< 2^24, f32-representable). Shared by training
+    routing (_route_level_matmul) and prediction (_predict_bins_matmul)."""
+    F = xf.shape[1]
+    rel_oh = jax.nn.one_hot(rel, n_nodes, dtype=jnp.float32)
+    FS = (f_lvl[:, None] == jnp.arange(F)[None, :]).astype(jnp.float32)
+    sel = jnp.matmul(rel_oh, FS, preferred_element_type=jnp.float32)
+    xb_sel = (xf * sel).sum(axis=1)
+    t_sel = jnp.matmul(rel_oh, t_lvl.astype(jnp.float32)[:, None],
+                       preferred_element_type=jnp.float32)[:, 0]
+    return 2 * rel + (xb_sel > t_sel).astype(jnp.int32)
+
+
+def _route_level_matmul(Xb, node, f_lvl, t_lvl, n_nodes: int):
+    """Gather-free level routing over row chunks (see _onehot_route_step)."""
+    N, F = Xb.shape
+
+    def one_block(sl):
+        xb_blk, node_blk = sl
+        return _onehot_route_step(xb_blk.astype(jnp.float32), node_blk,
+                                  f_lvl, t_lvl, n_nodes)
+
+    chunk = min(_ROUTE_CHUNK, N)
+    nchunks = -(-N // chunk)
+    pad = nchunks * chunk - N
+    if pad:
+        Xb = jnp.pad(Xb, ((0, pad), (0, 0)))
+        node = jnp.pad(node, ((0, pad),))
+    out = jax.lax.map(one_block, (Xb.reshape(nchunks, chunk, F),
+                                  node.reshape(nchunks, chunk)))
+    return out.reshape(-1)[:N]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("depth", "n_bins", "leaf_mode", "feature_frac",
-                     "normalize_gain"))
+                     "normalize_gain", "allow_pallas"))
 def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
               key: jax.Array, *, depth: int, n_bins: int,
               reg_lambda: float = 0.0, min_child_weight: float = 0.0,
@@ -265,7 +347,8 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
               gamma: float = 0.0, leaf_mode: str = "newton",
               feature_frac: float = 1.0, learning_rate: float = 1.0,
               normalize_gain: bool = True,
-              feature_mask: Optional[jax.Array] = None) -> Tree:
+              feature_mask: Optional[jax.Array] = None,
+              allow_pallas: bool = True) -> Tree:
     """Grow one depth-`depth` tree level-wise on binned features.
 
     Xb: int8/int32 [N, F] bins; G: f32 [N, K] per-row gradient payload (weights
@@ -281,9 +364,15 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
     K = G.shape[1]
     B = n_bins
     count_unit = jnp.asarray(H > 0, jnp.float32)
-    # TPU: histograms as MXU matmuls (scatter lowers poorly there);
-    # CPU/GPU: one fused segment-sum. Identical results either way.
+    # TPU: histograms as MXU matmuls (scatter lowers poorly there) — via
+    # the VMEM-resident pallas kernel at large N, the chunked XLA scan
+    # otherwise; CPU/GPU: one fused segment-sum. Identical results.
     use_matmul = jax.default_backend() == "tpu"
+    use_pallas = False
+    if use_matmul and allow_pallas and N >= _PALLAS_MIN_ROWS \
+            and not _NO_PALLAS:
+        from . import pallas_hist
+        use_pallas = pallas_hist.available()
     if use_matmul and N > _HIST_CHUNK:
         # pad rows ONCE to the histogram chunk multiple (zero payload =
         # inert) so the per-level histogram calls never re-copy the arrays
@@ -301,7 +390,10 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
     last = None                      # (GL, HL, Gt, Ht, f_lvl, t_lvl)
     for d in range(depth):
         n_nodes = 1 << d
-        if use_matmul:
+        if use_pallas:
+            hg, hh, hc = _histograms_pallas(Xb, G, H, count_unit, node,
+                                            n_nodes, B)
+        elif use_matmul:
             hg, hh, hc = _histograms_matmul(Xb, G, H, count_unit, node,
                                             n_nodes, B)
         else:
@@ -333,8 +425,11 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
         threshs.append(t_lvl)
         last = (GL, HL, Gt, Ht, f_lvl, t_lvl)
 
-        xb = Xb[rows, f_lvl[node]]
-        node = 2 * node + (xb > t_lvl[node]).astype(jnp.int32)
+        if use_matmul:
+            node = _route_level_matmul(Xb, node, f_lvl, t_lvl, n_nodes)
+        else:
+            xb = Xb[rows, f_lvl[node]]
+            node = 2 * node + (xb > t_lvl[node]).astype(jnp.int32)
 
     # -- leaves -------------------------------------------------------------
     # Leaf sums come for free from the LAST level's cumulative histograms:
@@ -364,16 +459,48 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
 
 
 def predict_bins(tree: Tree, Xb: jax.Array, depth: int) -> jax.Array:
-    """Traverse one tree on binned rows: Xb [N, F] -> leaf payload [N, K]."""
-    N = Xb.shape[0]
-    rows = jnp.arange(N)
-    rel = jnp.zeros(N, jnp.int32)
-    for d in range(depth):
-        idx = (1 << d) - 1 + rel
-        f = tree.feat[idx]
-        t = tree.thresh[idx]
-        rel = 2 * rel + (Xb[rows, f] > t).astype(jnp.int32)
-    return tree.leaf[rel]
+    """Traverse one tree on binned rows: Xb [N, F] -> leaf payload [N, K].
+
+    CPU: data-dependent gathers (fast there). TPU: gather-free — per-level
+    one-hot routing exactly as _route_level_matmul, and the leaf payload
+    lookup as onehot(leaf) @ leaf-table, all inside one chunked lax.map."""
+    if jax.default_backend() != "tpu":
+        N = Xb.shape[0]
+        rows = jnp.arange(N)
+        rel = jnp.zeros(N, jnp.int32)
+        for d in range(depth):
+            idx = (1 << d) - 1 + rel
+            f = tree.feat[idx]
+            t = tree.thresh[idx]
+            rel = 2 * rel + (Xb[rows, f] > t).astype(jnp.int32)
+        return tree.leaf[rel]
+    return _predict_bins_matmul(tree, Xb, depth)
+
+
+def _predict_bins_matmul(tree: Tree, Xb: jax.Array, depth: int) -> jax.Array:
+    N, F = Xb.shape
+    K = tree.leaf.shape[-1]
+    n_leaves = 1 << depth
+
+    def one_block(xb_blk):
+        c = xb_blk.shape[0]
+        xf = xb_blk.astype(jnp.float32)
+        rel = jnp.zeros(c, jnp.int32)
+        for d in range(depth):
+            lo = (1 << d) - 1
+            rel = _onehot_route_step(xf, rel, tree.feat[lo: lo + (1 << d)],
+                                     tree.thresh[lo: lo + (1 << d)], 1 << d)
+        leaf_oh = jax.nn.one_hot(rel, n_leaves, dtype=jnp.float32)
+        return jnp.matmul(leaf_oh, tree.leaf.astype(jnp.float32),
+                          preferred_element_type=jnp.float32)   # [c, K]
+
+    chunk = min(_ROUTE_CHUNK, N)
+    nchunks = -(-N // chunk)
+    pad = nchunks * chunk - N
+    if pad:
+        Xb = jnp.pad(Xb, ((0, pad), (0, 0)))
+    out = jax.lax.map(one_block, Xb.reshape(nchunks, chunk, F))
+    return out.reshape(-1, K)[:N]
 
 
 def predict_forest_bins(trees: Tree, Xb: jax.Array, depth: int) -> jax.Array:
@@ -514,12 +641,14 @@ def fit_gbt_softmax(Xb: jax.Array, y: jax.Array, w: jax.Array,
               if feature_frac < 1.0 else None)  # colsample_bytree
 
         def per_class(gc, hc, kc):
+            # allow_pallas=False: this grow sits under the class vmap and
+            # pallas_call must not be batched
             return grow_tree(Xb, gc[:, None], hc, kc, depth=depth,
                              n_bins=n_bins, reg_lambda=reg_lambda,
                              min_child_weight=min_child_weight, gamma=gamma,
                              leaf_mode="newton", feature_mask=fm,
                              learning_rate=learning_rate,
-                             normalize_gain=False)
+                             normalize_gain=False, allow_pallas=False)
         trees = jax.vmap(per_class, in_axes=(1, 1, 0))(
             g, h, jax.random.split(kf, n_classes))
         step = jax.vmap(lambda t: predict_bins(t, Xb, depth)[:, 0])(trees)
