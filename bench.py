@@ -186,6 +186,7 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
 
     best_glm = best_tree = None
     glm_s = tree_s = 0.0
+    glm_warm_s = None
     log(f"GLM sweep: {len(ggrids)} grids x {cfg['folds']} folds")
     try:
         t0 = time.perf_counter()
@@ -194,6 +195,19 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
         log(f"GLM sweep done in {glm_s:.2f}s (incl. compile)")
     except Exception as e:
         errors.append(f"glm sweep: {type(e).__name__}: {str(e)[:200]}")
+    if best_glm is not None:
+        # steady state: the re-run hits the jit cache, isolating XLA
+        # compile time (reported separately; the headline keeps cold).
+        # Own try/except: a warm-only failure must not read as the GLM
+        # family failing — the cold result above already stands.
+        try:
+            t0 = time.perf_counter()
+            val.validate([(lr, [dict(g) for g in ggrids])], X, y)
+            glm_warm_s = time.perf_counter() - t0
+            log(f"GLM sweep warm: {glm_warm_s:.2f}s")
+        except Exception as e:
+            errors.append(f"glm warm rerun: {type(e).__name__}: "
+                          f"{str(e)[:200]}")
 
     log(f"tree sweep: {len(tgrids)} configs x {cfg['folds']} folds")
     try:
@@ -209,20 +223,26 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
     if not candidates:
         raise RuntimeError("both sweep families failed: " + "; ".join(errors))
     best = max(candidates, key=lambda b: b.best_metric)
-    return dict(glm_s=glm_s, tree_s=tree_s,
-                glm_fits=len(ggrids) * cfg["folds"] if best_glm else 0,
-                tree_fits=len(tgrids) * cfg["folds"] if best_tree else 0,
-                best_name=best.name, best_grid=best.best_grid,
-                best_au_pr=float(best.best_metric))
+    out = dict(glm_s=glm_s, tree_s=tree_s,
+               glm_fits=len(ggrids) * cfg["folds"] if best_glm else 0,
+               tree_fits=len(tgrids) * cfg["folds"] if best_tree else 0,
+               best_name=best.name, best_grid=best.best_grid,
+               best_au_pr=float(best.best_metric))
+    if glm_warm_s is not None:
+        out["glm_warm_s"] = round(glm_warm_s, 3)
+    return out
 
 
 def glm_flops_estimate(cfg):
-    """XLA-countable FLOPs for the GLM sweep (per Newton iteration: score
-    matmul 2nd, gram matmul 2nd^2, plus elementwise ~6n; 15 iterations)."""
+    """Executed FLOPs for the streamed GLM sweep (ops/glm_sweep.py): per
+    Newton iteration per lane — eta 2nd + gradient 2nd + compressed Gram
+    2nT with T = d(d+1)/2 (the triangle halves the naive 2nd^2 Gram);
+    15 iterations, lanes = grid x folds."""
     n, d = cfg["n_rows"], cfg["n_cols"]
-    per_iter = 2 * n * d + 2 * n * d * d + 6 * n
+    T = d * (d + 1) // 2
+    per_iter_lane = 4 * n * d + 2 * n * T
     fits = cfg["glm_grid"] * cfg["folds"]
-    return per_iter * 15 * fits
+    return per_iter_lane * 15 * fits
 
 
 def tree_flops_cost_analysis(cfg, sweep_dtype):
@@ -604,9 +624,15 @@ def main():
            "tree_tflops_xla": round(tree_flops / 1e12, 2),
            "achieved_tflops_per_s": round(
                (glm_flops + tree_flops) / device_s / 1e12, 2)}
+    glm_warm = sweep.get("glm_warm_s")
+    if glm_warm:
+        mfu["glm_achieved_tflops_warm"] = round(
+            glm_flops / glm_warm / 1e12, 2)
     if peak and backend == "tpu":
         mfu["peak_bf16_tflops"] = peak / 1e12
         mfu["mfu"] = round((glm_flops + tree_flops) / device_s / peak, 4)
+        if glm_warm:
+            mfu["glm_mfu_warm"] = round(glm_flops / glm_warm / peak, 4)
     RESULT["mfu"] = mfu
 
     # 3. measured host baseline (independent same-distribution twin; fixed

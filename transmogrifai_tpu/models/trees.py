@@ -156,8 +156,10 @@ class _TreeEstimator(PredictorEstimator):
     _VMAP_FOLD_MAX_ROWS = 2_000_000
     # the fold-vmapped branch must never reach the pallas histogram path
     # (pallas_call does not sit under a batch axis here) — enforced against
-    # the kernel-selection threshold, not by comment
-    assert _VMAP_FOLD_MAX_ROWS < T._PALLAS_MIN_ROWS
+    # the kernel-selection threshold, and not via `assert` (stripped by -O)
+    if _VMAP_FOLD_MAX_ROWS >= T._PALLAS_MIN_ROWS:
+        raise RuntimeError(
+            "_VMAP_FOLD_MAX_ROWS must stay below ops.trees._PALLAS_MIN_ROWS")
 
     def mask_fit_scores(self, ctx, y, w, masks, n_classes: int = 2,
                         multiclass: bool = False):

@@ -219,8 +219,10 @@ _PALLAS_MIN_ROWS = 4_000_000
 
 # Read once at import: grow_tree is jitted, so a mid-process env toggle
 # could never affect already-cached executables anyway — a module constant
-# makes the set-before-first-use contract explicit.
-_NO_PALLAS = bool(os.environ.get("TMOG_NO_PALLAS"))
+# makes the set-before-first-use contract explicit. "0"/"false"/"" keep
+# pallas enabled.
+_NO_PALLAS = os.environ.get("TMOG_NO_PALLAS", "").strip().lower() \
+    not in ("", "0", "false")
 
 
 def _histograms_pallas(Xb, G, H, count_unit, node, n_nodes: int, B: int):
