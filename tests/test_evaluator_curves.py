@@ -1,0 +1,83 @@
+"""Binary threshold curves (reference OpBinaryClassificationEvaluator
+numBins=100 threshold metrics) — vectorized implementation vs a brute-force
+per-threshold confusion computation."""
+import numpy as np
+
+from transmogrifai_tpu.evaluators.evaluators import (
+    BinaryClassificationEvaluator, Evaluators,
+)
+from transmogrifai_tpu.models.prediction import make_prediction_column
+
+
+def _pred_col(scores):
+    scores = np.asarray(scores, np.float32)
+    prob = np.stack([1 - scores, scores], axis=1)
+    pred = (scores >= 0.5).astype(np.float32)
+    raw = np.log(np.clip(prob, 1e-9, None))
+    return make_prediction_column(pred, raw, prob)
+
+
+def _brute(scores, y, w, thresholds):
+    out = []
+    for t in thresholds:
+        pos = scores >= t
+        tp = (w * pos * y).sum()
+        fp = (w * pos * (1 - y)).sum()
+        fn = (w * ~pos * y).sum()
+        prec = tp / max(tp + fp, 1e-12)
+        rec = tp / max(tp + fn, 1e-12)
+        out.append((prec, rec))
+    return np.array(out)
+
+
+class TestThresholdCurves:
+    def test_matches_brute_force(self):
+        rng = np.random.default_rng(0)
+        n = 2000
+        y = (rng.uniform(size=n) < 0.4).astype(np.float64)
+        scores = np.clip(0.4 * y + rng.uniform(size=n) * 0.6, 0, 1)
+        w = rng.uniform(0.5, 2.0, size=n)
+        ev = BinaryClassificationEvaluator()
+        col = _pred_col(scores)
+        curves = ev.threshold_curves(y, col, w, num_bins=50)
+        thr = np.array(curves["thresholds"])
+        # the Prediction column stores f32 scores — brute-force on the
+        # same rounded values the evaluator actually sees
+        brute = _brute(scores.astype(np.float32).astype(np.float64), y, w,
+                       thr)
+        assert np.allclose(curves["precision_by_threshold"], brute[:, 0],
+                           atol=1e-9)
+        assert np.allclose(curves["recall_by_threshold"], brute[:, 1],
+                           atol=1e-9)
+
+    def test_recall_monotone_and_endpoints(self):
+        rng = np.random.default_rng(1)
+        y = (rng.uniform(size=500) < 0.5).astype(np.float64)
+        scores = rng.uniform(size=500)
+        ev = BinaryClassificationEvaluator()
+        curves = ev.threshold_curves(y, _pred_col(scores), None)
+        rec = np.array(curves["recall_by_threshold"])
+        # thresholds descend => predicted-positive set grows => recall
+        # non-decreasing, ending at 1 (lowest threshold = min score)
+        assert (np.diff(rec) >= -1e-12).all()
+        assert abs(rec[-1] - 1.0) < 1e-9
+
+    def test_curves_included_in_evaluate_all_but_not_summary_floats(self):
+        rng = np.random.default_rng(2)
+        y = (rng.uniform(size=300) < 0.5).astype(np.float64)
+        scores = rng.uniform(size=300)
+        ev = Evaluators.BinaryClassification.au_pr()
+        out = ev.evaluate_all(y, _pred_col(scores))
+        assert len(out["thresholds"]) == 100
+        assert {"au_pr", "au_roc", "precision", "recall"} <= set(out)
+        # scalar metrics stay floats (selector summaries filter on that)
+        assert isinstance(out["au_pr"], float)
+
+    def test_constant_scores_degenerate(self):
+        y = np.array([0.0, 1.0, 1.0, 0.0])
+        scores = np.full(4, 0.7)
+        ev = BinaryClassificationEvaluator()
+        curves = ev.threshold_curves(y, _pred_col(scores), None, num_bins=10)
+        # every threshold equals the constant score: all rows positive
+        assert np.allclose(curves["recall_by_threshold"], 1.0)
+        assert np.allclose(curves["precision_by_threshold"], 0.5)

@@ -1,0 +1,130 @@
+"""Vectorizer edge-case behavior (mirrors the degenerate-input cases the
+reference exercises across OpOneHotVectorizerTest / SmartTextVectorizerTest
+/ RealVectorizerTest etc.): all-null columns, empty vocabularies, top-K
+ties, single-row fits, constant features, unseen map keys."""
+import numpy as np
+
+from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.types import (
+    Date, Geolocation, PickList, Real, RealMap, Text,
+)
+
+
+def _fit_out(vec_cls, tp_name, tp, vals, transform_vals=None, **params):
+    f = getattr(FeatureBuilder, tp_name)("x").as_predictor()
+    ds = Dataset.from_features([("x", tp, vals)])
+    model = vec_cls(**params).set_input(f).fit(ds)
+    ds2 = (ds if transform_vals is None
+           else Dataset.from_features([("x", tp, transform_vals)]))
+    out = model.transform(ds2).column(model.output_name())
+    return model, out
+
+
+class TestAllNull:
+    def test_numeric_all_null_imputes_zero_and_flags(self):
+        from transmogrifai_tpu.automl.vectorizers.numeric import (
+            NumericVectorizer)
+        _, out = _fit_out(NumericVectorizer, "Real", Real,
+                          [None, None, None, None])
+        X = np.asarray(out.data, np.float32)
+        assert X.shape == (4, 2)
+        assert np.allclose(X[:, 0], 0.0)    # mean of nothing -> 0 fill
+        assert np.allclose(X[:, 1], 1.0)    # null indicator all on
+
+    def test_picklist_all_null_gets_null_column(self):
+        from transmogrifai_tpu.automl.vectorizers.categorical import (
+            OneHotVectorizer)
+        _, out = _fit_out(OneHotVectorizer, "PickList", PickList,
+                          [None, None, None], top_k=5, min_support=1)
+        X = np.asarray(out.data, np.float32)
+        null_idx = [i for i, c in enumerate(out.metadata.columns)
+                    if c.is_null_indicator]
+        assert len(null_idx) == 1
+        assert np.allclose(X[:, null_idx[0]], 1.0)
+
+    def test_text_all_null_hash_block_zero(self):
+        from transmogrifai_tpu.automl.vectorizers.text import (
+            SmartTextVectorizer)
+        fit_vals = [f"doc {i} unique words here" for i in range(40)]
+        _, out = _fit_out(SmartTextVectorizer, "Text", Text, fit_vals,
+                          transform_vals=[None] * 6,
+                          max_cardinality=5, num_features=32)
+        X = np.asarray(out.data, np.float32)
+        assert np.allclose(X[:, :-1], 0.0)
+        assert np.allclose(X[:, -1], 1.0)
+
+
+class TestVocabEdges:
+    def test_min_support_filters_all_categories(self):
+        from transmogrifai_tpu.automl.vectorizers.categorical import (
+            OneHotVectorizer)
+        _, out = _fit_out(OneHotVectorizer, "PickList", PickList,
+                          ["a", "b", "c", "d"], top_k=10, min_support=3)
+        X = np.asarray(out.data, np.float32)
+        # empty vocab: every row lands in exactly one indicator (OTHER)
+        assert np.allclose(X.sum(axis=1), 1.0)
+        names = out.metadata.column_names()
+        assert any("OTHER" in n for n in names)
+
+    def test_topk_tie_deterministic(self):
+        from transmogrifai_tpu.automl.vectorizers.categorical import (
+            OneHotVectorizer)
+        vals = ["x", "y"] * 5  # exact tie at count 5
+        names = set()
+        for _ in range(3):
+            _, out = _fit_out(OneHotVectorizer, "PickList", PickList,
+                              list(vals), top_k=1, min_support=1)
+            names.add(tuple(out.metadata.column_names()))
+        assert len(names) == 1  # same winner every fit
+
+    def test_single_row_fit(self):
+        from transmogrifai_tpu.automl.vectorizers.categorical import (
+            OneHotVectorizer)
+        _, out = _fit_out(OneHotVectorizer, "PickList", PickList, ["only"],
+                          top_k=5, min_support=1)
+        X = np.asarray(out.data, np.float32)
+        assert X.shape[0] == 1 and X[0].sum() >= 1.0
+
+
+class TestNumericEdges:
+    def test_constant_column_bucketizer(self):
+        from transmogrifai_tpu.automl.vectorizers.numeric import (
+            NumericBucketizer)
+        _, out = _fit_out(NumericBucketizer, "Real", Real, [5.0] * 20,
+                          num_buckets=4)
+        X = np.asarray(out.data, np.float32)
+        # constant feature: every row in exactly one bucket
+        assert np.allclose(X.sum(axis=1), 1.0)
+
+    def test_date_epoch_boundary(self):
+        from transmogrifai_tpu.automl.vectorizers.dates import (
+            DateVectorizer)
+        _, out = _fit_out(DateVectorizer, "Date", Date,
+                          [0, 86_400_000, None])
+        X = np.asarray(out.data, np.float32)
+        assert np.isfinite(X).all()
+
+    def test_geolocation_missing(self):
+        from transmogrifai_tpu.automl.vectorizers.geo import (
+            GeolocationVectorizer)
+        _, out = _fit_out(GeolocationVectorizer, "Geolocation", Geolocation,
+                          [[37.7, -122.4, 5.0], None, [40.7, -74.0, 3.0]])
+        X = np.asarray(out.data, np.float32)
+        assert np.isfinite(X).all()
+        null_idx = [i for i, c in enumerate(out.metadata.columns)
+                    if c.is_null_indicator]
+        assert null_idx and X[1, null_idx[0]] == 1.0
+
+
+class TestMapEdges:
+    def test_map_key_absent_at_transform(self):
+        from transmogrifai_tpu.automl.vectorizers.maps import MapVectorizer
+        _, out = _fit_out(MapVectorizer, "RealMap", RealMap,
+                          [{"a": 1.0, "b": 2.0}, {"a": 3.0}],
+                          transform_vals=[{"c": 9.0}, {}])
+        X = np.asarray(out.data, np.float32)
+        assert np.isfinite(X).all() and X.shape[0] == 2
+        # unseen key 'c' is ignored; fitted keys impute with their fill
+        names = out.metadata.column_names()
+        assert not any(n.endswith("_c") for n in names)

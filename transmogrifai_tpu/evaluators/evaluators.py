@@ -10,7 +10,7 @@ validators to rank models. Compute is the jitted kernels in ops/metrics_ops.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
@@ -66,7 +66,7 @@ class BinaryClassificationEvaluator(Evaluator):
         super().__init__(metric)
         self.threshold = threshold
 
-    def evaluate_all(self, labels, pred_col, w=None) -> Dict[str, float]:
+    def _scalar_metrics(self, labels, pred_col, w=None) -> Dict[str, float]:
         score = positive_score_of(pred_col)
         # non-probabilistic models (SVC) score by margin: the decision
         # boundary is 0, not probability 0.5
@@ -75,6 +75,57 @@ class BinaryClassificationEvaluator(Evaluator):
             np.asarray(score, np.float32), np.asarray(labels, np.float32),
             None if w is None else np.asarray(w, np.float32), thr)
         return {k: float(v) for k, v in m._asdict().items()}
+
+    def evaluate(self, labels, pred_col, w=None) -> float:
+        # hot path (one call per grid x fold in the sequential validator):
+        # scalar metrics only — no curve sort
+        return self._scalar_metrics(labels, pred_col, w)[self.default_metric]
+
+    def evaluate_all(self, labels, pred_col, w=None) -> Dict[str, Any]:
+        """Scalar metrics + threshold curves (the summary-artifact path;
+        curve values are lists, which summary builders filter on)."""
+        out: Dict[str, Any] = self._scalar_metrics(labels, pred_col, w)
+        out.update(self.threshold_curves(labels, pred_col, w))
+        return out
+
+    def threshold_curves(self, labels, pred_col, w=None,
+                         num_bins: int = 100) -> Dict[str, list]:
+        """Per-threshold P/R/F1 + ROC points at num_bins score cutoffs
+        (reference OpBinaryClassificationEvaluator.scala:68 threshold
+        curves, numBins default 100) — one sort + cumsums, no per-threshold
+        pass."""
+        score = np.asarray(positive_score_of(pred_col), np.float64)
+        y = np.asarray(labels, np.float64)
+        if len(y) == 0:
+            return {k: [] for k in
+                    ("thresholds", "precision_by_threshold",
+                     "recall_by_threshold", "f1_by_threshold",
+                     "false_positive_rate_by_threshold")}
+        wv = np.ones_like(y) if w is None else np.asarray(w, np.float64)
+        order = np.argsort(-score, kind="stable")
+        ys, ws, ss = y[order], wv[order], score[order]
+        tp_cum = np.cumsum(ws * ys)
+        fp_cum = np.cumsum(ws * (1.0 - ys))
+        P = max(tp_cum[-1], 1e-12)
+        N = max(fp_cum[-1], 1e-12)
+        lo, hi = float(ss.min()), float(ss.max())
+        thresholds = np.linspace(hi, lo, num_bins)
+        # rows with score >= t are predicted positive: index of the last
+        # such row in descending order
+        idx = np.searchsorted(-ss, -thresholds, side="right") - 1
+        valid = idx >= 0
+        tp = np.where(valid, tp_cum[np.maximum(idx, 0)], 0.0)
+        fp = np.where(valid, fp_cum[np.maximum(idx, 0)], 0.0)
+        precision = tp / np.maximum(tp + fp, 1e-12)
+        recall = tp / P
+        f1 = 2 * precision * recall / np.maximum(precision + recall, 1e-12)
+        return {
+            "thresholds": [float(t) for t in thresholds],
+            "precision_by_threshold": [float(v) for v in precision],
+            "recall_by_threshold": [float(v) for v in recall],
+            "f1_by_threshold": [float(v) for v in f1],
+            "false_positive_rate_by_threshold": [float(v) for v in fp / N],
+        }
 
 
 class BinScoreEvaluator(Evaluator):

@@ -309,14 +309,14 @@ class WorkflowModel:
         return full.select(keep), metrics
 
     def evaluate(self, evaluator: Evaluator,
-                 ds: Optional[Dataset] = None) -> Dict[str, float]:
+                 ds: Optional[Dataset] = None) -> Dict[str, Any]:
         """Reference OpWorkflowModel.evaluate:319 (falls back to the cached
         training data like the reference's evaluate-on-train)."""
         if ds is None and self._train_data is not None:
             return self._evaluate_on(self._train_data, evaluator)
         return self._evaluate_on(self.transform(ds), evaluator)
 
-    def _evaluate_on(self, full: Dataset, evaluator: Evaluator) -> Dict[str, float]:
+    def _evaluate_on(self, full: Dataset, evaluator: Evaluator) -> Dict[str, Any]:
         label_name = self._response_name()
         pred_name = self._prediction_name()
         labels = np.asarray(full.data(label_name), dtype=np.float64)
