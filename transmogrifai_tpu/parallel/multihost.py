@@ -1,0 +1,143 @@
+"""Multi-host (DCN) scale-out entry points.
+
+The reference delegates cross-machine execution to Spark: the driver ships
+closures to executors, readers produce per-partition rows, reduceByKey
+shuffles over the cluster network (SURVEY §2.9). The TPU-native analogue
+is JAX multi-process SPMD: every host runs this same program, owns a slice
+of the global row axis, and XLA inserts the collectives (psum over ICI
+within a slice, DCN across slices) wherever a sharded reduction appears —
+the Gram matrices, gradient histograms and metric sums of the sweep
+kernels need no code changes.
+
+This module holds the process-level plumbing that Spark's driver/executor
+split used to provide:
+
+- `initialize()`         — jax.distributed bring-up (coordinator + rank
+                           from args or JAX_COORDINATOR_ADDRESS /
+                           JAX_NUM_PROCESSES / JAX_PROCESS_ID env vars);
+- `global_mesh()`        — a Mesh over ALL processes' devices;
+- `padded_global_rows(n)`— the device-count row multiple arrays pad to;
+- `process_row_range(n)` — which REAL rows of a global dataset this host
+                           loads (the reader-partition analogue: each host
+                           reads only its slice; padding is all-tail);
+- `host_local_rows(...)` — assemble a GLOBAL row-sharded jax.Array from
+                           this host's local rows (jax.make_array_from_
+                           process_local_data); padded rows carry
+                           pad_value and are masked by `mesh.row_mask`
+                           exactly like the single-host sweep padding
+                           (zero weight = inert in every reduction).
+
+Single-process use degrades to the local mesh: every helper works
+unchanged with one process, which is how the unit tests cover it.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .mesh import BATCH_AXIS, make_mesh
+
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Bring up jax.distributed; single-process calls are safe no-ops.
+
+    Arguments fall back to JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID. An explicit coordinator with an unknown process count
+    raises (silently degrading a requested distributed run to one process
+    would compute per-host-only results). Only a REAL bring-up latches:
+    an early no-arg call does not block a later configured one."""
+    global _initialized
+    if _initialized:
+        return
+    explicit = coordinator_address is not None
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "0") or 0)
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0") or 0)
+    if not coordinator_address:
+        return  # single-process; a later configured call may still init
+    if num_processes <= 0:
+        raise ValueError(
+            "initialize: coordinator_address given but num_processes "
+            "unknown — pass it or set JAX_NUM_PROCESSES")
+    if num_processes == 1 and not explicit:
+        return
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def global_mesh(n_model: int = 1):
+    """(batch, model) Mesh over every device of every process.
+
+    The batch axis spans hosts: row-sharded arrays then reduce over DCN
+    between slices exactly where the reference's Spark shuffle sat."""
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev % n_model:
+        raise ValueError(f"{n_dev} devices not divisible by "
+                         f"model axis {n_model}")
+    return make_mesh(n_batch=n_dev // n_model, n_model=n_model)
+
+
+def padded_global_rows(n_rows: int) -> int:
+    """Global row counts pad up to a device-count multiple (row-sharded
+    dims must divide the batch axis; mesh.row_mask masks the tail)."""
+    import jax
+    nd = len(jax.devices())
+    return -(-n_rows // nd) * nd
+
+
+def process_row_range(n_rows: int) -> Tuple[int, int]:
+    """[start, stop) of the REAL rows this process loads.
+
+    The padded row space splits uniformly across processes (equal device
+    counts per host), so real rows fill processes in order and all padding
+    lands on the last process's tail — the global array is real rows
+    first, padding last, matching mesh.row_mask."""
+    import jax
+    per = padded_global_rows(n_rows) // jax.process_count()
+    i = jax.process_index()
+    return min(i * per, n_rows), min((i + 1) * per, n_rows)
+
+
+def host_local_rows(local: np.ndarray, mesh, n_rows_global: int,
+                    pad_value: float = 0.0):
+    """Global row-sharded jax.Array from this host's local block.
+
+    `local` must be exactly this process's `process_row_range(n_rows_global)`
+    slice; the block pads to the uniform per-process length with
+    `pad_value` rows (weight-0 semantics downstream — give padded rows
+    zero sample weight via `mesh.row_mask(padded_global_rows(n), n)`).
+    Returns an array of `padded_global_rows(n_rows_global)` rows."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    padded_total = padded_global_rows(n_rows_global)
+    per = padded_total // jax.process_count()
+    if local.shape[0] < per:
+        pad = np.full((per - local.shape[0],) + tuple(local.shape[1:]),
+                      pad_value, dtype=local.dtype)
+        local = np.concatenate([local, pad], axis=0)
+    spec = P(BATCH_AXIS, *([None] * (local.ndim - 1)))
+    sharding = NamedSharding(mesh, spec)
+    global_shape = (padded_total,) + tuple(local.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(local), global_shape)
