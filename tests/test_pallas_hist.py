@@ -62,3 +62,55 @@ def test_histograms_pallas_wrapper_shapes(monkeypatch):
     for a, b_ in zip(out_p, out_s):
         assert a.shape == b_.shape
         assert np.allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+
+
+class TestBinnedLanes:
+    """Lane-batched binned rank metrics vs the per-lane scatter path."""
+
+    def _lanes(self, L=3, n=1500, seed=7):
+        rng = np.random.default_rng(seed)
+        scores = jnp.asarray(rng.normal(size=(L, n)), jnp.float32)
+        y = jnp.asarray((rng.uniform(size=n) < 0.4), jnp.float32)
+        w = jnp.asarray(rng.uniform(0.2, 1.0, size=(L, n)), jnp.float32)
+        return scores, y, w
+
+    def test_cpu_route_matches_scatter(self):
+        from transmogrifai_tpu.ops import metrics_ops as M
+        scores, y, w = self._lanes()
+        tps, fps = M.binned_cum_counts_lanes(scores, y, w, 256)
+        for l in range(scores.shape[0]):
+            t1, f1 = M._binned_cum_counts(scores[l], y, w[l], 256)
+            assert np.allclose(np.asarray(tps[l]), np.asarray(t1), atol=1e-3)
+            assert np.allclose(np.asarray(fps[l]), np.asarray(f1), atol=1e-3)
+
+    def test_pallas_route_matches_scatter(self, monkeypatch):
+        import functools
+        from transmogrifai_tpu.ops import metrics_ops as M
+        monkeypatch.setattr(M.jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(PH, "available", lambda: True)
+        monkeypatch.setattr(PH, "hist_pallas",
+                            functools.partial(PH.hist_pallas.__wrapped__,
+                                              interpret=True))
+        scores, y, w = self._lanes(L=4, n=1100)  # forces tail padding
+        tps, fps = M.binned_cum_counts_lanes(scores, y, w, 128)
+        monkeypatch.undo()
+        for l in range(scores.shape[0]):
+            t1, f1 = M._binned_cum_counts(scores[l], y, w[l], 128)
+            assert np.allclose(np.asarray(tps[l]), np.asarray(t1), atol=1e-3)
+            assert np.allclose(np.asarray(fps[l]), np.asarray(f1), atol=1e-3)
+
+    def test_au_pr_lanes_matches_scalar(self):
+        from transmogrifai_tpu.ops import metrics_ops as M
+        scores, y, w = self._lanes(L=2, n=900, seed=9)
+        vals = np.asarray(M.au_pr_binned_lanes(scores, y, w, 512))
+        for l in range(2):
+            ref = float(M.au_pr_binned(scores[l], y, w[l], 512))
+            assert abs(vals[l] - ref) < 1e-4
+
+    def test_au_roc_lanes_matches_scalar(self):
+        from transmogrifai_tpu.ops import metrics_ops as M
+        scores, y, w = self._lanes(L=2, n=900, seed=11)
+        vals = np.asarray(M.au_roc_binned_lanes(scores, y, w, 512))
+        for l in range(2):
+            ref = float(M.au_roc_binned(scores[l], y, w[l], 512))
+            assert abs(vals[l] - ref) < 1e-4

@@ -111,16 +111,36 @@ def _metric_fn(problem_type: str, metric: str, n_classes: int = 2,
 STREAMED_SWEEP_MIN_ROWS = 200_000
 
 
+def _lanes_metric_fn(metric: str, problem_type: str, rank_bins):
+    """(scores [L, n], labels [n], w_lanes [L, n]) -> [L] metric values
+    when the metric has a lane-batched binned kernel, else None. Single
+    source of the guard for every sweep path (streamed eval, tree fold
+    metrics)."""
+    if not (rank_bins and problem_type == "binary"):
+        return None
+    if metric == "au_pr":
+        return lambda s, y, wl: M.au_pr_binned_lanes(s, y, wl, rank_bins)
+    if metric == "au_roc":
+        return lambda s, y, wl: M.au_roc_binned_lanes(s, y, wl, rank_bins)
+    return None
+
+
 @partial(jax.jit,
          static_argnames=("metric", "problem_type", "n_classes",
                           "rank_bins", "chunk"))
 def _streamed_eval(X, y, vw, Bc, b0c, thr, *, metric, problem_type,
                    n_classes=2, rank_bins=None, chunk=8):
     """Metrics for one fold's grid chunk of streamed-sweep coefficients:
-    scores in one MXU contraction, metric kernels vmapped over lanes."""
-    mfn = _metric_fn(problem_type, metric, n_classes, rank_bins)
+    scores in one MXU contraction; binned rank metrics go through the
+    lane-batched kernel (one pallas histogram for the whole chunk on TPU
+    instead of per-lane scatter-adds), everything else vmaps."""
     from ...ops.glm_sweep import sweep_scores_fold
     s = sweep_scores_fold(X, Bc, b0c)                   # [n, chunk]
+    lanes_fn = _lanes_metric_fn(metric, problem_type, rank_bins)
+    if lanes_fn is not None:
+        wl = jnp.broadcast_to(vw[None, :], (s.shape[1], vw.shape[0]))
+        return lanes_fn(s.T, y, wl)
+    mfn = _metric_fn(problem_type, metric, n_classes, rank_bins)
     return jax.vmap(lambda col: mfn(col, y, vw, thr), in_axes=1)(s)
 
 
@@ -542,9 +562,16 @@ class Validator:
             rank_bins = self._rank_bins(X.shape[0])
             mfn = _metric_fn(problem_type, metric, n_classes, rank_bins)
             thr_d = jnp.asarray(margin_thr, jnp.float32)
+            lanes_fn = _lanes_metric_fn(metric, problem_type, rank_bins)
 
             @jax.jit
             def fold_metrics(scores, y_, w_, m_, t_):
+                if lanes_fn is not None:
+                    # scores [F, n]: all folds through ONE lane-batched
+                    # binned-counts kernel (pallas on TPU; a fold-vmapped
+                    # scatter-add would serialize there)
+                    return lanes_fn(scores, y_, (1.0 - m_) * w_[None, :])
+
                 def per_fold(s, m):
                     return mfn(s, y_, (1.0 - m) * w_, t_)
                 return jax.vmap(per_fold)(scores, m_)

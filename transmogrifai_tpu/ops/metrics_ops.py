@@ -86,24 +86,110 @@ def au_pr(scores: jax.Array, labels: jax.Array,
     return acc
 
 
+def _bin_idx(scores: jax.Array, n_bins: int) -> jax.Array:
+    """Shared score->bucket rule for every binned-counts route (scores pass
+    through a sigmoid — monotone, so ranking is unchanged whether the
+    caller supplies margins or probabilities)."""
+    p = jax.nn.sigmoid(scores.astype(jnp.float32))
+    return jnp.clip((p * n_bins).astype(jnp.int32), 0, n_bins - 1)
+
+
 def _binned_cum_counts(scores: jax.Array, labels: jax.Array,
                        w: Optional[jax.Array], n_bins: int):
     """Weighted TP/FP cumulative counts over a score histogram.
 
-    Scores pass through a sigmoid (monotone, so ranking is unchanged whether
-    the caller supplies margins or probabilities) and land in `n_bins`
-    equal-width buckets; one scatter-add replaces the O(n log n) sort of
-    `_sorted_cum_counts`. Cumulative counts run from the high-score end, so
-    bucket k's entry is the (TP, FP) at threshold k/n_bins."""
+    Scores land in `n_bins` equal-width buckets (_bin_idx); one
+    scatter-add replaces the O(n log n) sort of `_sorted_cum_counts`.
+    Cumulative counts run from the high-score end, so bucket k's entry is
+    the (TP, FP) at threshold k/n_bins."""
     if w is None:
         w = jnp.ones_like(scores)
-    p = jax.nn.sigmoid(scores.astype(jnp.float32))
-    idx = jnp.clip((p * n_bins).astype(jnp.int32), 0, n_bins - 1)
+    idx = _bin_idx(scores, n_bins)
     pos = jnp.zeros(n_bins, jnp.float32).at[idx].add(labels * w)
     neg = jnp.zeros(n_bins, jnp.float32).at[idx].add((1.0 - labels) * w)
     tps = jnp.cumsum(pos[::-1])
     fps = jnp.cumsum(neg[::-1])
     return tps, fps
+
+
+def binned_cum_counts_lanes(scores: jax.Array, labels: jax.Array,
+                            w_lanes: jax.Array, n_bins: int
+                            ) -> Tuple[jax.Array, jax.Array]:
+    """Per-lane weighted TP/FP cumulative counts: scores [L, n] (one lane
+    per fold/grid cell over the SAME rows), labels [n], w_lanes [L, n].
+
+    TPU route: ONE pallas histogram call for all lanes — the lane id is
+    the kernel's slot axis (ops/pallas_hist.py), so the [L, n] scatter-add
+    the vmapped path would lower to (TPU serializes scatters) becomes MXU
+    one-hot contractions over VMEM tiles. CPU/fallback: vmap of the
+    scatter path. Identical results.
+    """
+    L, n = scores.shape
+
+    def _vmapped():
+        return jax.vmap(
+            lambda s, wl: _binned_cum_counts(s, labels, wl, n_bins)
+        )(scores, w_lanes)
+
+    if jax.default_backend() != "tpu":
+        return _vmapped()
+    from . import pallas_hist
+    if not pallas_hist.available():
+        return _vmapped()
+
+    idx = _bin_idx(scores, n_bins)
+    pos_w = w_lanes * labels[None, :]
+    neg_w = w_lanes * (1.0 - labels[None, :])
+    lane = jnp.broadcast_to(
+        jnp.arange(L, dtype=jnp.float32)[:, None], (L, n))
+    total = L * n
+    flat = lambda a: a.reshape(1, total)
+    pay = jnp.concatenate([flat(pos_w), flat(neg_w)], axis=0)
+    # ragged totals pad inside the kernel call (dropped-slot rows)
+    hist = pallas_hist.hist_pallas(flat(idx), pay, flat(lane),
+                                   n_slots=L, n_bins=n_bins)  # [L*2, bins]
+    hist = hist.reshape(L, 2, n_bins)
+    tps = jnp.cumsum(hist[:, 0, ::-1], axis=1)
+    fps = jnp.cumsum(hist[:, 1, ::-1], axis=1)
+    return tps, fps
+
+
+def _au_pr_from_counts(tps: jax.Array, fps: jax.Array) -> jax.Array:
+    """Average precision from cumulative counts; bins on the LAST axis
+    (shared by the scalar and lane-batched routes)."""
+    P = jnp.maximum(tps[..., -1:], EPS)
+    recall = tps / P
+    precision = tps / jnp.maximum(tps + fps, EPS)
+    dr = jnp.diff(recall, axis=-1, prepend=0.0)
+    return (dr * precision).sum(axis=-1)
+
+
+def _au_roc_from_counts(tps: jax.Array, fps: jax.Array) -> jax.Array:
+    """Trapezoid AuROC from cumulative counts; bins on the LAST axis."""
+    P = jnp.maximum(tps[..., -1:], EPS)
+    N = jnp.maximum(fps[..., -1:], EPS)
+    tpr = tps / P
+    fpr = fps / N
+    dfpr = jnp.diff(fpr, axis=-1, prepend=0.0)
+    tpr_prev = jnp.concatenate(
+        [jnp.zeros(tpr.shape[:-1] + (1,), tpr.dtype), tpr[..., :-1]],
+        axis=-1)
+    return (dfpr * (tpr + tpr_prev) * 0.5).sum(axis=-1)
+
+
+def au_pr_binned_lanes(scores: jax.Array, labels: jax.Array,
+                       w_lanes: jax.Array, n_bins: int) -> jax.Array:
+    """[L] average-precision values from per-lane binned counts (same
+    approximation contract as au_pr_binned)."""
+    return _au_pr_from_counts(
+        *binned_cum_counts_lanes(scores, labels, w_lanes, n_bins))
+
+
+def au_roc_binned_lanes(scores: jax.Array, labels: jax.Array,
+                        w_lanes: jax.Array, n_bins: int) -> jax.Array:
+    """[L] AuROC values from per-lane binned counts."""
+    return _au_roc_from_counts(
+        *binned_cum_counts_lanes(scores, labels, w_lanes, n_bins))
 
 
 def au_pr_binned(scores: jax.Array, labels: jax.Array,
@@ -118,11 +204,7 @@ def au_pr_binned(scores: jax.Array, labels: jax.Array,
     smooth score distributions (the reference's threshold curves likewise
     bin at numBins=100, OpBinaryClassificationEvaluator.scala:68)."""
     tps, fps = _binned_cum_counts(scores, labels, w, n_bins)
-    P = jnp.maximum(tps[-1], EPS)
-    recall = tps / P
-    precision = tps / jnp.maximum(tps + fps, EPS)
-    dr = jnp.diff(recall, prepend=0.0)
-    return (dr * precision).sum()
+    return _au_pr_from_counts(tps, fps)
 
 
 def au_roc_binned(scores: jax.Array, labels: jax.Array,
@@ -131,13 +213,7 @@ def au_roc_binned(scores: jax.Array, labels: jax.Array,
     """Histogram-approximate AuROC (trapezoid over bin boundaries); see
     au_pr_binned for the approximation contract."""
     tps, fps = _binned_cum_counts(scores, labels, w, n_bins)
-    P = jnp.maximum(tps[-1], EPS)
-    N = jnp.maximum(fps[-1], EPS)
-    tpr = tps / P
-    fpr = fps / N
-    dfpr = jnp.diff(fpr, prepend=0.0)
-    tpr_prev = jnp.concatenate([jnp.zeros(1, tpr.dtype), tpr[:-1]])
-    return (dfpr * (tpr + tpr_prev) * 0.5).sum()
+    return _au_roc_from_counts(tps, fps)
 
 
 class BinaryMetrics(NamedTuple):

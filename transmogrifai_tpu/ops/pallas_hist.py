@@ -35,6 +35,16 @@ import numpy as np
 _BLK = 512
 
 
+def block_rows(n_onehot_cols: int) -> int:
+    """Rows per grid step, sized so the [cols, blk] f32 one-hot tile stays
+    ~<= 4MB of VMEM (tree histograms: F*B ~ 2048 -> 512 rows; 4096-bin
+    rank metrics -> 256)."""
+    blk = _BLK
+    while blk > 128 and n_onehot_cols * blk * 4 > (4 << 20):
+        blk //= 2
+    return blk
+
+
 def available() -> bool:
     """Pallas path usable? (TPU backend with pallas importable.)"""
     if jax.default_backend() != "tpu":
@@ -79,8 +89,9 @@ def hist_pallas(Xb_t: jax.Array, pay_t: jax.Array, slot_t: jax.Array,
     """Gradient histograms [n_slots * C, F * n_bins] (f32).
 
     Xb_t [F, N] int bins; pay_t [C, N] f32 payload channels; slot_t [1, N]
-    f32 slot ids (n_slots drops the row). N must be a _BLK multiple (the
-    tree grower pads rows once per fit).
+    f32 slot ids (n_slots drops the row). Ragged N pads internally with
+    dropped-slot rows; the block size adapts to the one-hot width so VMEM
+    tiles stay bounded (see block_rows).
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -88,18 +99,25 @@ def hist_pallas(Xb_t: jax.Array, pay_t: jax.Array, slot_t: jax.Array,
     F, N = Xb_t.shape
     C = pay_t.shape[0]
     B = n_bins
-    assert N % _BLK == 0, f"rows {N} not a multiple of {_BLK}"
+    blk = block_rows(F * B)
+    pad = (-N) % blk
+    if pad:
+        Xb_t = jnp.pad(Xb_t, ((0, 0), (0, pad)))
+        pay_t = jnp.pad(pay_t, ((0, 0), (0, pad)))
+        slot_t = jnp.pad(slot_t, ((0, 0), (0, pad)),
+                         constant_values=float(n_slots))  # dropped
+        N += pad
 
     kernel = functools.partial(_kernel, F=F, B=B, C=C, n_slots=n_slots)
     return pl.pallas_call(
         kernel,
-        grid=(N // _BLK,),
+        grid=(N // blk,),
         in_specs=[
-            pl.BlockSpec((F, _BLK), lambda i: (0, i),
+            pl.BlockSpec((F, blk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((C, _BLK), lambda i: (0, i),
+            pl.BlockSpec((C, blk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _BLK), lambda i: (0, i),
+            pl.BlockSpec((1, blk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((n_slots * C, F * B), lambda i: (0, 0),
