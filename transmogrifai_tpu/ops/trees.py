@@ -368,7 +368,9 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
     count_unit = jnp.asarray(H > 0, jnp.float32)
     # TPU: histograms as MXU matmuls (scatter lowers poorly there) — via
     # the VMEM-resident pallas kernel at large N, the chunked XLA scan
-    # otherwise; CPU/GPU: one fused segment-sum. Identical results.
+    # otherwise; CPU/GPU: one fused segment-sum. Results agree up to f32
+    # rounding (the TPU path derives right-child histograms by sibling
+    # subtraction, so near-tie splits can differ across backends).
     use_matmul = jax.default_backend() == "tpu"
     use_pallas = False
     if use_matmul and allow_pallas and N >= _PALLAS_MIN_ROWS \
@@ -390,9 +392,31 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
     node = jnp.zeros(N, jnp.int32)   # in-level relative node id
     feats, threshs = [], []
     last = None                      # (GL, HL, Gt, Ht, f_lvl, t_lvl)
+    prev = None                      # previous level's raw histograms
+
+    def _interleave(left, right, n_nodes):
+        # children [2p] = left[p], [2p+1] = right[p]
+        return jnp.stack([left, right], axis=1).reshape(
+            (n_nodes,) + left.shape[1:])
+
     for d in range(depth):
         n_nodes = 1 << d
-        if use_pallas:
+        if use_matmul and d > 0:
+            # histogram subtraction (the XGBoost sibling trick): compute
+            # LEFT children only — rows in right children carry the
+            # out-of-range slot (dropped by one_hot / the pallas kernel)
+            # — and derive right = parent - left from the previous
+            # level's raw histograms. Halves the one-hot contraction
+            # FLOPs of every level past the root.
+            n_half = n_nodes // 2
+            slots = jnp.where(node % 2 == 0, node // 2, n_half)
+            fn = _histograms_pallas if use_pallas else _histograms_matmul
+            hgl, hhl, hcl = fn(Xb, G, H, count_unit, slots, n_half, B)
+            pg, ph, pc = prev
+            hg = _interleave(hgl, pg - hgl, n_nodes)
+            hh = _interleave(hhl, ph - hhl, n_nodes)
+            hc = _interleave(hcl, pc - hcl, n_nodes)
+        elif use_pallas:
             hg, hh, hc = _histograms_pallas(Xb, G, H, count_unit, node,
                                             n_nodes, B)
         elif use_matmul:
@@ -401,6 +425,7 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
         else:
             hg, hh, hc = _histograms_segment(Xb, G, H, count_unit, node,
                                              n_nodes, B)
+        prev = (hg, hh, hc)
 
         GL = jnp.cumsum(hg, axis=2)
         HL = jnp.cumsum(hh, axis=2)
@@ -450,8 +475,8 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
         nid = jnp.arange(n_nodes)
         Gleft = GL[nid, f_lvl, t_lvl, :]                         # [n, K]
         Hleft = HL[nid, f_lvl, t_lvl]                            # [n]
-        Gl = jnp.stack([Gleft, Gt - Gleft], 1).reshape(n_leaves, K)
-        Hl = jnp.stack([Hleft, Ht - Hleft], 1).reshape(n_leaves)
+        Gl = _interleave(Gleft, Gt - Gleft, n_leaves)
+        Hl = _interleave(Hleft, Ht - Hleft, n_leaves)
     if leaf_mode == "newton":
         leaf = -Gl / (Hl + reg_lambda + EPS)[:, None]
     else:  # mean
