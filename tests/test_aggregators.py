@@ -1,0 +1,78 @@
+"""Monoid aggregator + time-window semantics (reference
+features/.../aggregators/: MonoidAggregatorDefaults, TimeBasedAggregator;
+readers cutoff behavior DataReader.scala:219-246)."""
+import numpy as np
+
+from transmogrifai_tpu.features.aggregators import (
+    FeatureAggregator, MonoidAggregatorDefaults, named_aggregator,
+)
+from transmogrifai_tpu.types import (
+    Binary, Integral, MultiPickList, PickList, Real, RealMap, Text, TextList,
+)
+
+
+class TestMonoidDefaults:
+    def test_real_sums(self):
+        agg = MonoidAggregatorDefaults.aggregator_for(Real)
+        assert agg.reduce([1.5, 2.5, None]) == 4.0
+
+    def test_empty_reduce_is_empty_value(self):
+        for tp in (Real, Integral, Text, PickList):
+            agg = MonoidAggregatorDefaults.aggregator_for(tp)
+            assert agg.reduce([]) is None or agg.reduce([]) in ((), {}, [])
+
+    def test_binary_logical_or(self):
+        agg = MonoidAggregatorDefaults.aggregator_for(Binary)
+        assert agg.reduce([False, True, None]) is True
+        assert agg.reduce([False, False]) is False
+
+    def test_textlist_concatenates(self):
+        agg = MonoidAggregatorDefaults.aggregator_for(TextList)
+        out = agg.reduce([["a"], ["b", "c"]])
+        assert list(out) == ["a", "b", "c"]
+
+    def test_multipicklist_unions(self):
+        agg = MonoidAggregatorDefaults.aggregator_for(MultiPickList)
+        out = agg.reduce([{"x"}, {"y", "x"}])
+        assert set(out) == {"x", "y"}
+
+    def test_realmap_merges_last_wins(self):
+        agg = MonoidAggregatorDefaults.aggregator_for(RealMap)
+        out = agg.reduce([{"a": 1.0}, {"a": 2.0, "b": 3.0}])
+        assert out["a"] == 2.0 and out["b"] == 3.0
+
+    def test_named_min_max_first_last(self):
+        assert named_aggregator("min", Real).reduce([3.0, 1.0, 2.0]) == 1.0
+        assert named_aggregator("max", Real).reduce([3.0, 1.0, 2.0]) == 3.0
+        assert named_aggregator("first", Real).reduce([3.0, 1.0]) == 3.0
+        assert named_aggregator("last", Real).reduce([3.0, 1.0]) == 1.0
+
+
+class TestTimeWindows:
+    EVENTS = [(10.0, 100), (20.0, 200), (40.0, 400), (80.0, 800)]
+
+    def test_predictor_keeps_at_or_before_cutoff(self):
+        fa = FeatureAggregator(Real)
+        assert fa.extract(self.EVENTS, cutoff_time=400) == 70.0
+
+    def test_response_keeps_after_cutoff(self):
+        fa = FeatureAggregator(Real)
+        assert fa.extract(self.EVENTS, cutoff_time=400,
+                          is_response=True) == 80.0
+
+    def test_window_limits_lookback(self):
+        # window 250ms before cutoff 800: keep events in (550, 800]
+        fa = FeatureAggregator(Real, window_ms=250)
+        assert fa.extract(self.EVENTS, cutoff_time=800) == 80.0
+        # wider window picks up the 400-ms event too
+        fa2 = FeatureAggregator(Real, window_ms=500)
+        assert fa2.extract(self.EVENTS, cutoff_time=800) == 120.0
+
+    def test_no_cutoff_aggregates_everything(self):
+        fa = FeatureAggregator(Real)
+        assert fa.extract(self.EVENTS) == 150.0
+
+    def test_untimed_events_always_kept(self):
+        fa = FeatureAggregator(Real)
+        # untimed event kept; the t=100 event is after cutoff 50 -> dropped
+        assert fa.extract([(5.0, None), (7.0, 100)], cutoff_time=50) == 5.0

@@ -450,7 +450,7 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
         t_lvl = jnp.where(ok, (best % B).astype(jnp.int32), B - 1)
         feats.append(f_lvl)
         threshs.append(t_lvl)
-        last = (GL, HL, Gt, Ht, f_lvl, t_lvl)
+        last = (GL, HL, CL, Gt, Ht, Ct, f_lvl, t_lvl)
 
         if use_matmul:
             node = _route_level_matmul(Xb, node, f_lvl, t_lvl, n_nodes)
@@ -469,18 +469,26 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
     if depth == 0:
         Gl = G.sum(axis=0, keepdims=True)                        # [1, K]
         Hl = H.sum()[None]
+        Cl = count_unit.sum()[None]
     else:
-        GL, HL, Gt, Ht, f_lvl, t_lvl = last
+        GL, HL, CL, Gt, Ht, Ct, f_lvl, t_lvl = last
         n_nodes = n_leaves // 2
         nid = jnp.arange(n_nodes)
         Gleft = GL[nid, f_lvl, t_lvl, :]                         # [n, K]
         Hleft = HL[nid, f_lvl, t_lvl]                            # [n]
+        Cleft = CL[nid, f_lvl, t_lvl]
         Gl = _interleave(Gleft, Gt - Gleft, n_leaves)
         Hl = _interleave(Hleft, Ht - Hleft, n_leaves)
+        Cl = _interleave(Cleft, Ct - Cleft, n_leaves)
     if leaf_mode == "newton":
         leaf = -Gl / (Hl + reg_lambda + EPS)[:, None]
     else:  # mean
         leaf = Gl / (Hl + EPS)[:, None]
+    # training-empty leaves predict exactly 0: the count histogram is
+    # integer-exact, while sibling-subtracted G/H can leave f32 noise
+    # whose ratio would be an arbitrary payload for a serving row routed
+    # into an empty (min_instances=0) child
+    leaf = jnp.where(Cl[:, None] >= 0.5, leaf, 0.0)
     return Tree(jnp.concatenate(feats), jnp.concatenate(threshs),
                 learning_rate * leaf)
 
