@@ -218,6 +218,23 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
         log(f"tree sweep done in {tree_s:.2f}s")
     except Exception as e:
         errors.append(f"tree sweep: {type(e).__name__}: {str(e)[:200]}")
+        # first contact with real hardware may surface a Mosaic/pallas
+        # compile failure — retry once on the XLA-only path rather than
+        # losing the whole tree family's perf record
+        from transmogrifai_tpu.ops import trees as Tmod
+        if Tmod.pallas_enabled():
+            try:
+                Tmod.set_pallas_enabled(False)
+                log("retrying tree sweep without pallas")
+                t0 = time.perf_counter()
+                best_tree = val.validate([(OpXGBoostClassifier(),
+                                           [dict(g) for g in tgrids])], X, y)
+                tree_s = time.perf_counter() - t0
+                errors.append("tree sweep ok on retry without pallas")
+                log(f"tree sweep (no pallas) done in {tree_s:.2f}s")
+            except Exception as e2:
+                errors.append(f"tree sweep retry: {type(e2).__name__}: "
+                              f"{str(e2)[:200]}")
 
     candidates = [b for b in (best_glm, best_tree) if b is not None]
     if not candidates:

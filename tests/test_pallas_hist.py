@@ -114,3 +114,16 @@ class TestBinnedLanes:
         for l in range(2):
             ref = float(M.au_roc_binned(scores[l], y, w[l], 512))
             assert abs(vals[l] - ref) < 1e-4
+
+
+def test_set_pallas_enabled_toggles_and_clears_caches():
+    from transmogrifai_tpu.ops import trees as T2
+    orig = T2.pallas_enabled()
+    try:
+        T2.set_pallas_enabled(False)
+        assert not T2.pallas_enabled()
+        T2.set_pallas_enabled(False)  # idempotent
+        T2.set_pallas_enabled(True)
+        assert T2.pallas_enabled()
+    finally:
+        T2.set_pallas_enabled(orig)

@@ -144,6 +144,12 @@ def _streamed_eval(X, y, vw, Bc, b0c, thr, *, metric, problem_type,
     return jax.vmap(lambda col: mfn(col, y, vw, thr), in_axes=1)(s)
 
 
+# _streamed_eval's executables bake the lanes-kernel (pallas) choice in;
+# the kill switch clears them on toggle
+from ...ops import pallas_hist as _pallas_hist  # noqa: E402
+_pallas_hist.register_cache_consumer(_streamed_eval)
+
+
 @partial(jax.jit,
          static_argnames=("fit_one", "metric", "problem_type", "n_classes",
                           "rank_bins"))

@@ -27,6 +27,7 @@ path behind the reference's OpXGBoost* wrappers (SURVEY §2.9).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -45,9 +46,34 @@ def block_rows(n_onehot_cols: int) -> int:
     return blk
 
 
+# THE pallas kill switch — single flag for every consumer (tree
+# histograms, lane-batched metrics). Env default: TMOG_NO_PALLAS truthy
+# (not "0"/"false"/"") disables; set_enabled() is the runtime toggle.
+_enabled = os.environ.get("TMOG_NO_PALLAS", "").strip().lower() \
+    in ("", "0", "false")
+
+# jitted functions whose compiled executables bake the pallas choice in;
+# cleared on toggle so a cached program cannot pin the previous choice
+_cache_consumers = []
+
+
+def register_cache_consumer(fn) -> None:
+    """Register a jitted function that traces through available()."""
+    _cache_consumers.append(fn)
+
+
+def set_enabled(enabled: bool) -> None:
+    global _enabled
+    if _enabled == bool(enabled):
+        return
+    _enabled = bool(enabled)
+    for fn in _cache_consumers:
+        fn.clear_cache()
+
+
 def available() -> bool:
-    """Pallas path usable? (TPU backend with pallas importable.)"""
-    if jax.default_backend() != "tpu":
+    """Pallas path usable? (enabled + TPU backend + pallas importable.)"""
+    if not _enabled or jax.default_backend() != "tpu":
         return False
     try:
         from jax.experimental import pallas  # noqa: F401

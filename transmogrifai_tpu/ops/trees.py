@@ -32,7 +32,6 @@ Design (TPU-first, not a port):
 from __future__ import annotations
 
 import functools
-import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -217,12 +216,22 @@ _HIST_CHUNK = 65_536
 # fold vmap (models/trees.py asserts the ordering at import).
 _PALLAS_MIN_ROWS = 4_000_000
 
-# Read once at import: grow_tree is jitted, so a mid-process env toggle
-# could never affect already-cached executables anyway — a module constant
-# makes the set-before-first-use contract explicit. "0"/"false"/"" keep
-# pallas enabled.
-_NO_PALLAS = os.environ.get("TMOG_NO_PALLAS", "").strip().lower() \
-    not in ("", "0", "false")
+def pallas_enabled() -> bool:
+    """The single pallas switch lives in ops/pallas_hist (env default
+    TMOG_NO_PALLAS); these are convenience delegates."""
+    from . import pallas_hist
+    return pallas_hist._enabled
+
+
+def set_pallas_enabled(enabled: bool) -> None:
+    """Runtime pallas kill switch (e.g. the bench's retry after a Mosaic
+    compile failure on untested hardware). Flipping it clears every
+    registered pallas-consuming jit cache (tree fits here, the streamed
+    metric evaluator in the validator) so already-compiled executables
+    cannot pin the previous choice — the flag is read at trace time and
+    is not part of the jit key."""
+    from . import pallas_hist
+    pallas_hist.set_enabled(enabled)
 
 
 def _histograms_pallas(Xb, G, H, count_unit, node, n_nodes: int, B: int):
@@ -373,10 +382,9 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
     # subtraction, so near-tie splits can differ across backends).
     use_matmul = jax.default_backend() == "tpu"
     use_pallas = False
-    if use_matmul and allow_pallas and N >= _PALLAS_MIN_ROWS \
-            and not _NO_PALLAS:
+    if use_matmul and allow_pallas and N >= _PALLAS_MIN_ROWS:
         from . import pallas_hist
-        use_pallas = pallas_hist.available()
+        use_pallas = pallas_hist.available()  # honors the kill switch
     if use_matmul and N > _HIST_CHUNK:
         # pad rows ONCE to the histogram chunk multiple (zero payload =
         # inert) so the per-level histogram calls never re-copy the arrays
@@ -692,6 +700,17 @@ def fit_gbt_softmax(Xb: jax.Array, y: jax.Array, w: jax.Array,
     init = jnp.zeros((y.shape[0], n_classes), jnp.float32)
     (_,), trees = jax.lax.scan(one, (init,), jax.random.split(key, n_rounds))
     return trees
+
+
+def _register_pallas_consumers():
+    """Tree-fit executables bake the pallas choice in at trace time; the
+    kill switch must be able to clear them (set_pallas_enabled)."""
+    from . import pallas_hist
+    for fn in (grow_tree, fit_forest, fit_gbt, fit_gbt_softmax):
+        pallas_hist.register_cache_consumer(fn)
+
+
+_register_pallas_consumers()
 
 
 # -- host-side (numpy) ensemble traversal for serving -----------------------
