@@ -221,8 +221,8 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
         # first contact with real hardware may surface a Mosaic/pallas
         # compile failure — retry once on the XLA-only path rather than
         # losing the whole tree family's perf record
-        from transmogrifai_tpu.ops import trees as Tmod
-        if Tmod.pallas_enabled():
+        from transmogrifai_tpu.ops import pallas_hist, trees as Tmod
+        if pallas_hist.available():  # only retry when pallas was in the trace
             try:
                 Tmod.set_pallas_enabled(False)
                 log("retrying tree sweep without pallas")

@@ -75,3 +75,92 @@ def test_row_level_matches_batch(fitted):
                           float(np.asarray(batch_pred).ravel()[0]
                                 if not np.isscalar(batch_pred)
                                 else batch_pred), atol=1e-5)
+
+
+class TestModelFamilyParity:
+    """Row-level score_function == batch scoring for every serving-capable
+    model family (reference OpWorkflowModelLocalTest: Spark score == local
+    score across stage types)."""
+
+    def _flow(self, est):
+        import numpy as np
+        from transmogrifai_tpu.automl.transmogrifier import transmogrify
+        from transmogrifai_tpu.data.dataset import Dataset
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.local.scoring import score_function
+        from transmogrifai_tpu.types import Real, RealNN
+        from transmogrifai_tpu.workflow.workflow import Workflow
+
+        rng = np.random.default_rng(3)
+        n = 600
+        a = rng.normal(size=n)
+        b = rng.normal(size=n)
+        y = ((a + 0.5 * b + 0.3 * rng.normal(size=n)) > 0).astype(float)
+        ds = Dataset.from_features([
+            ("a", Real, a.tolist()), ("b", Real, b.tolist()),
+            ("y", RealNN, y.tolist()),
+        ])
+        fa = FeatureBuilder.Real("a").extract(lambda r: r.get("a")).as_predictor()
+        fb = FeatureBuilder.Real("b").extract(lambda r: r.get("b")).as_predictor()
+        fy = FeatureBuilder.RealNN("y").extract(lambda r: r.get("y")).as_response()
+        vec = transmogrify([fa, fb])
+        pred = est.set_input(fy, vec).get_output()
+        model = Workflow().set_input_dataset(ds).set_result_features(
+            pred).train()
+        scored = model.score(ds)
+        fn = score_function(model)
+        col = scored.column(pred.name)
+        from transmogrifai_tpu.models.prediction import (
+            prediction_of, probability_of)
+        preds = prediction_of(col)
+        probs = probability_of(col)
+        for i in (0, 7, 311):
+            row_out = fn({"a": float(a[i]), "b": float(b[i])})[pred.name]
+            rv = dict(row_out.value if hasattr(row_out, "value") else row_out)
+            assert abs(float(rv["prediction"]) - float(preds[i])) < 1e-4
+            if probs is not None and "probability_1" in rv:
+                assert abs(float(rv["probability_1"])
+                           - float(probs[i, 1])) < 1e-4
+
+    def test_logistic(self):
+        from transmogrifai_tpu.automl.selectors import (
+            BinaryClassificationModelSelector)
+        from transmogrifai_tpu.models.glm import OpLogisticRegression
+        from transmogrifai_tpu.stages.params import param_grid
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=[(OpLogisticRegression(max_iter=20),
+                                    param_grid(reg_param=[0.01]))])
+        self._flow(sel)
+
+    def test_random_forest(self):
+        from transmogrifai_tpu.automl.selectors import (
+            BinaryClassificationModelSelector)
+        from transmogrifai_tpu.models.trees import OpRandomForestClassifier
+        from transmogrifai_tpu.stages.params import param_grid
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=[(OpRandomForestClassifier(num_trees=8,
+                                                             max_depth=3),
+                                    param_grid())])
+        self._flow(sel)
+
+    def test_naive_bayes(self):
+        from transmogrifai_tpu.automl.selectors import (
+            BinaryClassificationModelSelector)
+        from transmogrifai_tpu.models.glm import OpNaiveBayes
+        from transmogrifai_tpu.stages.params import param_grid
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=[(OpNaiveBayes(), param_grid())])
+        self._flow(sel)
+
+    def test_mlp(self):
+        from transmogrifai_tpu.automl.selectors import (
+            BinaryClassificationModelSelector)
+        from transmogrifai_tpu.models.mlp import (
+            OpMultilayerPerceptronClassifier)
+        from transmogrifai_tpu.stages.params import param_grid
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=[(
+                OpMultilayerPerceptronClassifier(hidden_layers=(8,),
+                                                 max_iter=40),
+                param_grid())])
+        self._flow(sel)

@@ -62,6 +62,10 @@ def register_cache_consumer(fn) -> None:
     _cache_consumers.append(fn)
 
 
+def enabled() -> bool:
+    return _enabled
+
+
 def set_enabled(enabled: bool) -> None:
     global _enabled
     if _enabled == bool(enabled):

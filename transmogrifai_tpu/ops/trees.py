@@ -220,7 +220,7 @@ def pallas_enabled() -> bool:
     """The single pallas switch lives in ops/pallas_hist (env default
     TMOG_NO_PALLAS); these are convenience delegates."""
     from . import pallas_hist
-    return pallas_hist._enabled
+    return pallas_hist.enabled()
 
 
 def set_pallas_enabled(enabled: bool) -> None:
