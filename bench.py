@@ -195,6 +195,24 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
         log(f"GLM sweep done in {glm_s:.2f}s (incl. compile)")
     except Exception as e:
         errors.append(f"glm sweep: {type(e).__name__}: {str(e)[:200]}")
+        # the streamed lane-batched kernel is the newest code on this
+        # hardware — retry once through the battle-tested vmapped route
+        # rather than losing the headline family (round 1 recorded no
+        # perf number at all; never again)
+        import transmogrifai_tpu.automl.tuning.validators as V
+        if V.STREAMED_SWEEP_MIN_ROWS <= cfg["n_rows"]:
+            try:
+                V.STREAMED_SWEEP_MIN_ROWS = 10 ** 15
+                log("retrying GLM sweep on the vmapped route")
+                t0 = time.perf_counter()
+                best_glm = val.validate([(lr, [dict(g) for g in ggrids])],
+                                        X, y)
+                glm_s = time.perf_counter() - t0
+                errors.append("glm sweep ok on vmapped-route retry")
+                log(f"GLM sweep (vmapped) done in {glm_s:.2f}s")
+            except Exception as e2:
+                errors.append(f"glm sweep retry: {type(e2).__name__}: "
+                              f"{str(e2)[:200]}")
     if best_glm is not None:
         # steady state: the re-run hits the jit cache, isolating XLA
         # compile time (reported separately; the headline keeps cold).
