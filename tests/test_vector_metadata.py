@@ -1,0 +1,80 @@
+"""VectorMetadata provenance laws (reference OpVectorMetadata /
+OpVectorColumnMetadata, features/.../utils/spark/): naming, select/concat
+algebra, JSON round-trip, and end-to-end provenance through transmogrify
+— ModelInsights depends on every one of these invariants."""
+import numpy as np
+
+from transmogrifai_tpu.data.vector import VectorColumnMetadata, VectorMetadata
+
+
+def _md(n=4, parent="f"):
+    cols = [VectorColumnMetadata(parent_feature_name=parent,
+                                 parent_feature_type="Real",
+                                 grouping=None, indicator_value=None,
+                                 descriptor_value=f"c{i}")
+            for i in range(n)]
+    return VectorMetadata(name="vec", columns=cols)
+
+
+class TestAlgebra:
+    def test_select_preserves_provenance(self):
+        md = _md(5)
+        sub = md.select([0, 2, 4])
+        assert sub.size == 3
+        assert all(c.parent_feature_name == "f" for c in sub.columns)
+
+    def test_concat_sizes_and_order(self):
+        a, b = _md(2, "a"), _md(3, "b")
+        cat = VectorMetadata.concat("out", [a, b])
+        assert cat.size == 5
+        assert cat.parent_features()[:1] == ["a"]
+        assert [c.parent_feature_name for c in cat.columns] == \
+            ["a", "a", "b", "b", "b"]
+
+    def test_json_round_trip(self):
+        md = _md(3)
+        md2 = VectorMetadata.from_json(md.to_json())
+        assert md2.size == md.size
+        assert md2.column_names() == md.column_names()
+
+    def test_index_of(self):
+        md = _md(3)
+        names = md.column_names()
+        for i, nm in enumerate(names):
+            assert md.index_of(nm) == i
+
+
+class TestEndToEndProvenance:
+    def test_transmogrify_columns_trace_to_raw_features(self):
+        from transmogrifai_tpu.automl.transmogrifier import transmogrify
+        from transmogrifai_tpu.data.dataset import Dataset
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.types import PickList, Real
+        from transmogrifai_tpu.workflow.workflow import Workflow
+
+        rng = np.random.default_rng(0)
+        n = 200
+        ds = Dataset.from_features([
+            ("age", Real, rng.uniform(1, 80, n).tolist()),
+            ("cls", PickList, rng.choice(["a", "b", "c"], n).tolist()),
+        ])
+        fage = FeatureBuilder.Real("age").extract(
+            lambda r: r.get("age")).as_predictor()
+        fcls = FeatureBuilder.PickList("cls").extract(
+            lambda r: r.get("cls")).as_predictor()
+        vec = transmogrify([fage, fcls])
+        model = Workflow().set_input_dataset(ds) \
+            .set_result_features(vec).train()
+        col = model.transform(ds).column(vec.name)
+        md = col.metadata
+        # every output column traces to one of the two raw features
+        assert set(md.parent_features()) <= {"age", "cls"}
+        assert md.size == col.data.shape[1]
+        # null indicators present and flagged
+        nulls = [c for c in md.columns if c.is_null_indicator]
+        assert nulls and all(c.parent_feature_name in ("age", "cls")
+                             for c in nulls)
+        # indicator (one-hot) columns carry their category value
+        indicators = [c for c in md.columns
+                      if c.indicator_value not in (None, "")]
+        assert {c.indicator_value for c in indicators} >= {"a", "b"}
