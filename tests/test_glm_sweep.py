@@ -166,3 +166,48 @@ class TestValidatorRouting:
         # 1-iteration Newton and 50-iteration fits differ measurably
         assert not np.allclose(b1.validated[0].fold_metrics,
                                b2.validated[0].fold_metrics, atol=1e-6)
+
+
+class TestStreamedProperties:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_nonuniform_sample_weights_match_per_lane(self, seed):
+        """Sample weights compose with fold masks identically on both
+        routes (balancing weights enter the sweep this way)."""
+        X, y = _binary(n=1800, d=6, seed=seed)
+        rng = np.random.default_rng(seed + 100)
+        w = rng.uniform(0.25, 3.0, size=len(y)).astype(np.float32)
+        masks = _masks(y, folds=2, seed=seed)
+        regs = np.array([0.01], np.float32)
+        alphas = np.array([0.25], np.float32)
+        B, b0 = sweep_glm_streamed(
+            jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(masks), jnp.asarray(regs), jnp.asarray(alphas),
+            loss="logistic", max_iter=25, standardize=False)
+        for f in range(2):
+            beta_ref, b0_ref = fit_logistic(
+                jnp.asarray(X), jnp.asarray(y),
+                jnp.asarray(masks[f] * w), jnp.asarray(0.01),
+                jnp.asarray(0.25), max_iter=25, standardize=False)
+            assert np.allclose(np.asarray(B)[f, 0], np.asarray(beta_ref),
+                               atol=3e-3), seed
+            assert abs(float(b0[f, 0]) - float(b0_ref)) < 3e-3
+
+    def test_row_block_boundary_sizes(self, monkeypatch):
+        """n exactly at, one under, and one over the scan block size."""
+        from transmogrifai_tpu.ops import glm_sweep as GS
+        monkeypatch.setattr(GS, "_ROW_BLOCK", 512)
+        for n in (511, 512, 513, 1024, 1030):
+            X, y = _binary(n=n, d=4, seed=3)
+            w = np.ones_like(y)
+            masks = _masks(y, folds=2, seed=4)
+            B, b0 = GS.sweep_glm_streamed.__wrapped__(
+                jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+                jnp.asarray(masks), jnp.asarray([0.01], np.float32),
+                jnp.asarray([0.0], np.float32),
+                loss="logistic", max_iter=15, standardize=False)
+            beta_ref, _ = fit_logistic(
+                jnp.asarray(X), jnp.asarray(y), jnp.asarray(masks[0] * w),
+                jnp.asarray(0.01), jnp.asarray(0.0), max_iter=15,
+                standardize=False)
+            assert np.allclose(np.asarray(B)[0, 0], np.asarray(beta_ref),
+                               atol=3e-3), n
