@@ -743,11 +743,23 @@ def main():
     print(json.dumps(RESULT), flush=True)
 
 
+def _silence_broken_stdout():
+    """Point stdout at devnull so the interpreter-shutdown flush of a
+    broken pipe can't flip the exit status to 120 (python docs pattern)."""
+    os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+
+
 if __name__ == "__main__":
     try:
         main()
+    except BrokenPipeError:
+        _silence_broken_stdout()
+        sys.exit(0)  # consumer closed stdout; nothing left to say
     except Exception as e:  # never exit without a parseable JSON line
         RESULT.setdefault("errors", []).append(
             f"{type(e).__name__}: {e}")
-        print(json.dumps(RESULT), flush=True)
+        try:
+            print(json.dumps(RESULT), flush=True)
+        except BrokenPipeError:
+            _silence_broken_stdout()
         sys.exit(0)  # the error field conveys failure; keep rc green

@@ -34,12 +34,15 @@ python - <<'PY'
 import __graft_entry__ as g
 g.dryrun_multichip(8)
 PY
-JAX_PLATFORMS=cpu BENCH_BUDGET_S=600 python bench.py | python - <<'PY'
+# NOTE: `python - <<HEREDOC` would clobber the piped stdin with the
+# heredoc — the checker must use -c so the pipe stays on stdin
+JAX_PLATFORMS=cpu BENCH_BUDGET_S=600 python bench.py | python -c '
 import json, sys
-line = sys.stdin.read().strip().splitlines()[-1]
-out = json.loads(line)
+lines = sys.stdin.read().strip().splitlines()
+assert lines, "bench produced no output"
+out = json.loads(lines[-1])
 assert {"metric", "value", "unit", "vs_baseline"} <= set(out), out
 print("bench JSON ok:", out["metric"], out["value"], out["unit"])
-PY
+'
 
 echo "CI GREEN"
