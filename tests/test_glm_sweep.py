@@ -211,3 +211,113 @@ class TestStreamedProperties:
                 standardize=False)
             assert np.allclose(np.asarray(B)[0, 0], np.asarray(beta_ref),
                                atol=3e-3), n
+
+
+class TestShardedStreamed:
+    def _mesh(self):
+        from transmogrifai_tpu.parallel.mesh import make_mesh
+        return make_mesh(n_batch=4, n_model=1)
+
+    def test_sharded_matches_unsharded(self):
+        """shard_map row-sharded streamed sweep == single-device streamed
+        sweep (psum'd accumulators are the only difference)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from transmogrifai_tpu.ops.glm_sweep import (
+            sweep_glm_streamed_sharded)
+
+        mesh = self._mesh()
+        n = 4096  # multiple of the 4-way batch axis
+        X, y = _binary(n=n, d=6, seed=5)
+        w = np.ones_like(y)
+        masks = _masks(y, folds=2, seed=6)
+        regs = np.array([0.01, 0.1], np.float32)
+        alphas = np.array([0.0, 0.5], np.float32)
+
+        B1, b01 = sweep_glm_streamed(
+            jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(masks), jnp.asarray(regs), jnp.asarray(alphas),
+            loss="logistic", max_iter=20, standardize=False)
+
+        row = NamedSharding(mesh, P("batch", None))
+        vec = NamedSharding(mesh, P("batch"))
+        mrow = NamedSharding(mesh, P(None, "batch"))
+        B2, b02 = sweep_glm_streamed_sharded(
+            mesh,
+            jax.device_put(X, row), jax.device_put(y, vec),
+            jax.device_put(w, vec), jax.device_put(masks, mrow),
+            jnp.asarray(regs), jnp.asarray(alphas),
+            loss="logistic", max_iter=20, standardize=False)
+        assert np.allclose(np.asarray(B1), np.asarray(B2), atol=2e-3)
+        assert np.allclose(np.asarray(b01), np.asarray(b02), atol=2e-3)
+
+    def test_sharded_standardize(self):
+        """One-pass psum'd standardization lands within f32 tolerance of
+        the single-device two-pass."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from transmogrifai_tpu.ops.glm_sweep import (
+            sweep_glm_streamed_sharded)
+
+        mesh = self._mesh()
+        X, y = _binary(n=2048, d=5, seed=9)
+        X = X * 3.0 + 1.5  # non-trivial mean/std
+        w = np.ones_like(y)
+        masks = _masks(y, folds=2, seed=2)
+        regs = np.array([0.05], np.float32)
+        alphas = np.array([0.0], np.float32)
+        B1, b01 = sweep_glm_streamed(
+            jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(masks), jnp.asarray(regs), jnp.asarray(alphas),
+            loss="logistic", max_iter=25, standardize=True)
+        row = NamedSharding(mesh, P("batch", None))
+        vec = NamedSharding(mesh, P("batch"))
+        mrow = NamedSharding(mesh, P(None, "batch"))
+        B2, b02 = sweep_glm_streamed_sharded(
+            mesh, jax.device_put(X, row), jax.device_put(y, vec),
+            jax.device_put(w, vec), jax.device_put(masks, mrow),
+            jnp.asarray(regs), jnp.asarray(alphas),
+            loss="logistic", max_iter=25, standardize=True)
+        assert np.allclose(np.asarray(B1), np.asarray(B2), atol=5e-3)
+
+    def test_validator_mesh_routes_streamed(self, monkeypatch):
+        """Validator(mesh=...) + large-n gate routes through the sharded
+        streamed kernel and agrees with the meshless route."""
+        monkeypatch.setattr(V, "STREAMED_SWEEP_MIN_ROWS", 0)
+        mesh = self._mesh()
+        X, y = _binary(n=1000, d=5, seed=12)  # NOT a multiple of 4: pads
+        ev = Evaluators.BinaryClassification.au_pr()
+        grids = [{"reg_param": 0.001}, {"reg_param": 0.1}]
+        v_mesh = CrossValidation(ev, num_folds=2, seed=3, mesh=mesh)
+        best_m = v_mesh.validate(
+            [(OpLogisticRegression(max_iter=20), grids)], X, y)
+        v_plain = CrossValidation(ev, num_folds=2, seed=3)
+        best_p = v_plain.validate(
+            [(OpLogisticRegression(max_iter=20), grids)], X, y)
+        assert best_m.best_grid == best_p.best_grid
+        for a, b in zip(best_p.validated, best_m.validated):
+            assert np.allclose(a.fold_metrics, b.fold_metrics, atol=5e-3)
+
+    def test_sharded_standardize_large_mean(self):
+        """Epoch-timestamp-scale means must not destroy the variance
+        (two-pass psum'd moments; the one-pass form cancels in f32)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from transmogrifai_tpu.ops.glm_sweep import (
+            sweep_glm_streamed_sharded)
+
+        mesh = self._mesh()
+        X, y = _binary(n=2048, d=4, seed=13)
+        X = X + np.float32(1.6e9)  # large mean, unit variance
+        w = np.ones_like(y)
+        masks = _masks(y, folds=2, seed=1)
+        row = NamedSharding(mesh, P("batch", None))
+        vec = NamedSharding(mesh, P("batch"))
+        mrow = NamedSharding(mesh, P(None, "batch"))
+        B, b0 = sweep_glm_streamed_sharded(
+            mesh, jax.device_put(X, row), jax.device_put(y, vec),
+            jax.device_put(w, vec), jax.device_put(masks, mrow),
+            jnp.asarray([0.05], np.float32), jnp.asarray([0.0], np.float32),
+            loss="logistic", max_iter=20, standardize=True)
+        assert np.isfinite(np.asarray(B)).all()
+        assert np.abs(np.asarray(B)).max() < 100.0  # no exploded scales

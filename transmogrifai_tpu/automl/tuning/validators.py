@@ -127,16 +127,19 @@ def _lanes_metric_fn(metric: str, problem_type: str, rank_bins):
 
 @partial(jax.jit,
          static_argnames=("metric", "problem_type", "n_classes",
-                          "rank_bins", "chunk"))
+                          "rank_bins", "chunk", "use_lanes"))
 def _streamed_eval(X, y, vw, Bc, b0c, thr, *, metric, problem_type,
-                   n_classes=2, rank_bins=None, chunk=8):
+                   n_classes=2, rank_bins=None, chunk=8, use_lanes=True):
     """Metrics for one fold's grid chunk of streamed-sweep coefficients:
     scores in one MXU contraction; binned rank metrics go through the
     lane-batched kernel (one pallas histogram for the whole chunk on TPU
-    instead of per-lane scatter-adds), everything else vmaps."""
+    instead of per-lane scatter-adds), everything else vmaps. Mesh
+    callers pass use_lanes=False (a pallas_call must not consume
+    row-sharded operands; GSPMD partitions the vmapped kernels instead)."""
     from ...ops.glm_sweep import sweep_scores_fold
     s = sweep_scores_fold(X, Bc, b0c)                   # [n, chunk]
-    lanes_fn = _lanes_metric_fn(metric, problem_type, rank_bins)
+    lanes_fn = _lanes_metric_fn(metric, problem_type, rank_bins) \
+        if use_lanes else None
     if lanes_fn is not None:
         wl = jnp.broadcast_to(vw[None, :], (s.shape[1], vw.shape[0]))
         return lanes_fn(s.T, y, wl)
@@ -305,17 +308,17 @@ class Validator:
     def _streamable(self, est: PredictorEstimator, grids: List[ParamMap],
                     problem_type: str, X) -> bool:
         """Large binary/regression GLM sweeps route through the streaming
-        lane-batched kernel (ops/glm_sweep.py). Mesh runs keep the vmapped
-        program whose row-sharded matmuls GSPMD already partitions. Wide
-        matrices stay vmapped too: the streamed kernel's per-block
-        compressed outer-product buffer scales O(_ROW_BLOCK * d^2 / 2) and
-        would blow HBM past ~128 features (the vmapped path's HBM-budget
-        chunker handles those)."""
+        lane-batched kernel (ops/glm_sweep.py) — under a mesh, its
+        shard_map variant (per-shard row scans, psum'd accumulators). Wide
+        matrices stay vmapped: the streamed kernel's per-block compressed
+        outer-product buffer scales O(_ROW_BLOCK * d^2 / 2) and would blow
+        HBM past ~128 features (the vmapped path's HBM-budget chunker
+        handles those)."""
         if getattr(est, "streamed_loss", None) is None:
             return False
         if problem_type not in ("binary", "regression"):
             return False
-        if self.mesh is not None or X.shape[0] < STREAMED_SWEEP_MIN_ROWS:
+        if X.shape[0] < STREAMED_SWEEP_MIN_ROWS:
             return False
         if X.shape[1] > 128:
             return False
@@ -506,9 +509,7 @@ class Validator:
         pending = [gi for gi in range(len(grids)) if gi not in results]
         if pending:
             Xd, yd, wd, md = self._device_arrays(X, y, w, masks, dtype)
-            B, b0 = sweep_glm_streamed(
-                Xd, yd, wd, md, jnp.asarray(regs[pending]),
-                jnp.asarray(alphas[pending]),
+            fit_kwargs = dict(
                 loss=est.streamed_loss,
                 max_iter=int(base.get_param("max_iter")),
                 tol=float(base.get_param("tol")),
@@ -516,6 +517,15 @@ class Validator:
                 if base.has_param("fit_intercept") else True,
                 standardize=bool(base.get_param("standardization"))
                 if base.has_param("standardization") else True)
+            if self.mesh is not None:
+                from ...ops.glm_sweep import sweep_glm_streamed_sharded
+                B, b0 = sweep_glm_streamed_sharded(
+                    self.mesh, Xd, yd, wd, md, jnp.asarray(regs[pending]),
+                    jnp.asarray(alphas[pending]), **fit_kwargs)
+            else:
+                B, b0 = sweep_glm_streamed(
+                    Xd, yd, wd, md, jnp.asarray(regs[pending]),
+                    jnp.asarray(alphas[pending]), **fit_kwargs)
             rank_bins = self._rank_bins(X.shape[0])
             thr_d = jnp.asarray(margin_thr, jnp.float32)
             chunk = min(self._STREAMED_EVAL_CHUNK, len(pending))
@@ -529,7 +539,7 @@ class Validator:
                         Xd, yd, vw, B[f, jnp.asarray(padded)],
                         b0[f, jnp.asarray(padded)], thr_d, metric=metric,
                         problem_type=problem_type, rank_bins=rank_bins,
-                        chunk=chunk)
+                        chunk=chunk, use_lanes=self.mesh is None)
                     out[f, idx] = np.asarray(vals)[:len(idx)]
             for j, gi in enumerate(pending):
                 fm = [float(v) for v in out[:, j]]
@@ -568,7 +578,10 @@ class Validator:
             rank_bins = self._rank_bins(X.shape[0])
             mfn = _metric_fn(problem_type, metric, n_classes, rank_bins)
             thr_d = jnp.asarray(margin_thr, jnp.float32)
-            lanes_fn = _lanes_metric_fn(metric, problem_type, rank_bins)
+            # mesh runs keep the vmapped metric (pallas must not consume
+            # row-sharded operands)
+            lanes_fn = _lanes_metric_fn(metric, problem_type, rank_bins) \
+                if self.mesh is None else None
 
             @jax.jit
             def fold_metrics(scores, y_, w_, m_, t_):

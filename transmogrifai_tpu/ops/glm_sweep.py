@@ -28,6 +28,12 @@ Fold masks enter as weights (mask * w), exactly like the vmapped path, so
 fold semantics are identical; the elementwise residual/curvature rules per
 loss mirror ops/glm's solvers (logistic IRLS, squared, squared-hinge).
 
+Distribution: `sweep_glm_streamed_sharded` runs the SAME core inside a
+shard_map over the mesh `batch` axis — each shard scans its local rows,
+then every accumulator reduction psums over ICI/DCN (the Spark-shuffle /
+Rabit-allreduce slot of SURVEY §2.9); the tiny replicated solves run on
+every shard. Sharded standardization uses one-pass psum'd moments.
+
 Standardization note: the per-lane solvers standardize with the lane's own
 (fold-masked) weights; this kernel standardizes ONCE with the global
 weights so the standardized matrix can be shared by every lane. Fold
@@ -38,7 +44,7 @@ small problems through the per-lane path).
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -90,19 +96,12 @@ def _residual_curvature(loss: str):
     return rc
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("loss", "max_iter", "tol", "fit_intercept",
-                     "standardize"))
-def sweep_glm_streamed(X: jax.Array, y: jax.Array, w: jax.Array,
-                       fold_masks: jax.Array, regs: jax.Array,
-                       alphas: jax.Array, *, loss: str = "logistic",
-                       max_iter: int = 50, tol: float = 1e-6,
-                       fit_intercept: bool = True,
-                       standardize: bool = True
-                       ) -> Tuple[jax.Array, jax.Array]:
-    """All (fold, grid) fits in one program: returns (B [F, G, d] f32,
-    b0 [F, G]) in RAW feature units (unstandardized)."""
+def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
+                   tol, fit_intercept, standardize,
+                   axis_name: Optional[str] = None):
+    """The sweep body. Under shard_map, X/y/w/fold_masks hold this shard's
+    LOCAL rows and `axis_name` names the mesh axis every accumulator
+    reduction psums over; axis_name=None is the single-device path."""
     n, d = X.shape
     F = fold_masks.shape[0]
     Gn = regs.shape[0]
@@ -111,8 +110,28 @@ def sweep_glm_streamed(X: jax.Array, y: jax.Array, w: jax.Array,
     iu0, iu1, expand = _tri_maps(d)
     T = iu0.shape[0]
 
+    def allreduce(v):
+        return jax.lax.psum(v, axis_name) if axis_name else v
+
     if standardize:
-        Xs, mean, std = G._standardize(X, w)
+        if axis_name is None:
+            Xs, mean, std = G._standardize(X, w)
+        else:
+            # two-pass weighted moments with psum'd partials — one-pass
+            # E[x^2]-mean^2 cancels catastrophically in f32 for
+            # large-mean features (epoch-millisecond timestamps would
+            # lose ALL unit-scale variance), silently diverging from the
+            # single-device path
+            f32 = jnp.float32
+            wsum = jnp.maximum(allreduce(w.sum().astype(f32)), EPS)
+            xf = X.astype(f32)
+            mean = allreduce((xf * w[:, None]).sum(0)) / wsum
+            centered = xf - mean[None, :]
+            var = allreduce(
+                (centered * centered * w[:, None]).sum(0)) / wsum
+            std = jnp.sqrt(jnp.maximum(var, EPS))
+            Xs = ((X.astype(f32) - mean[None, :]) / std[None, :]) \
+                .astype(X.dtype)
     else:
         Xs = X
         mean = jnp.zeros(d, jnp.float32)
@@ -122,10 +141,11 @@ def sweep_glm_streamed(X: jax.Array, y: jax.Array, w: jax.Array,
     # by broadcast over the grid axis)
     l1 = jnp.tile(regs * alphas, F)                     # [L]
     l2 = jnp.tile(regs * (1.0 - alphas), F)             # [L]
-    wsum_f = jnp.maximum((fold_masks * w[None, :]).sum(1), EPS)   # [F]
+    wsum_f = jnp.maximum(
+        allreduce((fold_masks * w[None, :]).sum(1)), EPS)         # [F]
     wsum_l = jnp.repeat(wsum_f, Gn)                     # [L]
 
-    # pad rows to the block multiple with w=0 (inert in every reduction)
+    # pad local rows to the block multiple with w=0 (inert everywhere)
     c = min(_ROW_BLOCK, n)
     nb = -(-n // c)
     pad = nb * c - n
@@ -163,8 +183,16 @@ def sweep_glm_streamed(X: jax.Array, y: jax.Array, w: jax.Array,
 
         acc0 = (jnp.zeros((L, d), jnp.float32), jnp.zeros((L, T), jnp.float32),
                 jnp.zeros(L, jnp.float32), jnp.zeros(L, jnp.float32))
+        if axis_name is not None and hasattr(jax.lax, "pvary"):
+            # under shard_map's varying-manual-axes tracking the carry
+            # becomes batch-varying inside the body; the initial zeros
+            # must carry the same type
+            acc0 = jax.lax.pvary(acc0, axis_name)
         (gA, hA, g0A, h0A), _ = jax.lax.scan(body, acc0, xs)
-        return gA, hA, g0A, h0A
+        # the Rabit-allreduce/Spark-shuffle slot: partial per-shard sums
+        # combine over ICI/DCN
+        return (allreduce(gA), allreduce(hA),
+                allreduce(g0A), allreduce(h0A))
 
     def cond(state):
         i, _, _, delta = state
@@ -197,6 +225,65 @@ def sweep_glm_streamed(X: jax.Array, y: jax.Array, w: jax.Array,
         B = B / std[None, :]
         b0 = b0 - (B * mean[None, :]).sum(1)
     return B.reshape(F, Gn, d), b0.reshape(F, Gn)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("loss", "max_iter", "tol",
+                                    "fit_intercept", "standardize"))
+def sweep_glm_streamed(X: jax.Array, y: jax.Array, w: jax.Array,
+                       fold_masks: jax.Array, regs: jax.Array,
+                       alphas: jax.Array, *, loss: str = "logistic",
+                       max_iter: int = 50, tol: float = 1e-6,
+                       fit_intercept: bool = True,
+                       standardize: bool = True
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """All (fold, grid) fits in one program: returns (B [F, G, d] f32,
+    b0 [F, G]) in RAW feature units (unstandardized)."""
+    return _streamed_core(X, y, w, fold_masks, regs, alphas, loss=loss,
+                          max_iter=max_iter, tol=tol,
+                          fit_intercept=fit_intercept,
+                          standardize=standardize, axis_name=None)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_sweep_fn(mesh, loss, max_iter, tol, fit_intercept,
+                      standardize):
+    try:  # jax >= 0.8 top-level; experimental path for older releases
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import BATCH_AXIS
+
+    core = functools.partial(
+        _streamed_core, loss=loss, max_iter=max_iter, tol=tol,
+        fit_intercept=fit_intercept, standardize=standardize,
+        axis_name=BATCH_AXIS)
+    sm = shard_map(
+        core, mesh=mesh,
+        in_specs=(P(BATCH_AXIS, None), P(BATCH_AXIS), P(BATCH_AXIS),
+                  P(None, BATCH_AXIS), P(None), P(None)),
+        out_specs=(P(None, None, None), P(None, None)))
+    return jax.jit(sm)
+
+
+def sweep_glm_streamed_sharded(mesh, X, y, w, fold_masks, regs, alphas, *,
+                               loss: str = "logistic", max_iter: int = 50,
+                               tol: float = 1e-6, fit_intercept: bool = True,
+                               standardize: bool = True
+                               ) -> Tuple[jax.Array, jax.Array]:
+    """Row-sharded streamed sweep over the mesh `batch` axis.
+
+    Same math as sweep_glm_streamed; rows must be padded to the batch-axis
+    multiple with zero weights (the validator's mesh device_put does
+    this). Each shard scans only its local rows; accumulator psums ride
+    ICI within a slice and DCN across slices. Sharded standardization uses
+    one-pass psum'd moments (f32), which differs from the single-device
+    two-pass by f32 rounding only."""
+    return _sharded_sweep_fn(mesh, loss, int(max_iter), float(tol),
+                             bool(fit_intercept), bool(standardize))(
+        X, y, w, fold_masks, regs, alphas)
 
 
 def sweep_scores_fold(X: jax.Array, B_f: jax.Array, b0_f: jax.Array
