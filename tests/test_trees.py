@@ -419,3 +419,34 @@ class TestHistogramPaths:
         expect = 2 * node + (Xb[rows, f_lvl[node]]
                              > t_lvl[node]).astype(jnp.int32)
         assert np.array_equal(np.asarray(routed), np.asarray(expect))
+
+    def test_chunked_scan_boundary_full_fit(self, monkeypatch):
+        """Full GBT fit through the forced-TPU path at N just past the
+        histogram chunk boundary (chunked scan + sibling subtraction +
+        one-hot routing all active) == the segment path's splits."""
+        real_backend = T.jax.default_backend
+        N, F, B = T._HIST_CHUNK + 1234, 8, 16
+        rng = np.random.default_rng(21)
+        X = rng.normal(size=(N, F)).astype(np.float32)
+        y = (rng.uniform(size=N)
+             < 1 / (1 + np.exp(-X @ np.linspace(1, -1, F)))).astype(
+                 np.float32)
+        w = jnp.ones(N, jnp.float32)
+        edges = T.quantile_edges(jnp.asarray(X), B)
+        Xb = T.bin_matrix(jnp.asarray(X), edges)
+        key = __import__("jax").random.PRNGKey(2)
+
+        def fit():
+            return T.fit_gbt.__wrapped__(
+                Xb, jnp.asarray(y), w, key, n_rounds=2, depth=4, n_bins=B,
+                learning_rate=0.3, loss="logistic")
+
+        monkeypatch.setattr(T.jax, "default_backend", lambda: "tpu")
+        trees_t, base_t = fit()
+        pred_t = np.asarray(T.predict_forest_bins(trees_t, Xb, 4))
+        monkeypatch.setattr(T.jax, "default_backend", real_backend)
+        trees_c, base_c = fit()
+        pred_c = np.asarray(T.predict_forest_bins(trees_c, Xb, 4))
+        assert np.array_equal(np.asarray(trees_t.feat),
+                              np.asarray(trees_c.feat))
+        assert np.allclose(pred_t, pred_c, atol=5e-3)
