@@ -127,3 +127,32 @@ def test_set_pallas_enabled_toggles_and_clears_caches():
         assert T2.pallas_enabled()
     finally:
         T2.set_pallas_enabled(orig)
+
+
+def test_lanes_4096_bins_block_sizing():
+    """The production rank-metric shape (4096 bins): block_rows shrinks
+    the tile, results still match the scatter path."""
+    from transmogrifai_tpu.ops import metrics_ops as M
+    assert PH.block_rows(4096) < PH._BLK
+    rng = np.random.default_rng(17)
+    L, n = 3, 700
+    scores = jnp.asarray(rng.normal(size=(L, n)), jnp.float32)
+    y = jnp.asarray((rng.uniform(size=n) < 0.5), jnp.float32)
+    w = jnp.asarray(rng.uniform(0.5, 1.5, size=(L, n)), jnp.float32)
+    idx = M._bin_idx(scores, 4096)
+    pos = w * y[None, :]
+    neg = w * (1.0 - y[None, :])
+    lane = jnp.broadcast_to(jnp.arange(L, dtype=jnp.float32)[:, None],
+                            (L, n))
+    flat = lambda a: a.reshape(1, L * n)
+    hist = PH.hist_pallas(flat(idx),
+                          jnp.concatenate([flat(pos), flat(neg)], axis=0),
+                          flat(lane), n_slots=L, n_bins=4096,
+                          interpret=True)
+    hist = np.asarray(hist).reshape(L, 2, 4096)
+    for l in range(L):
+        t1, f1 = M._binned_cum_counts(scores[l], y, w[l], 4096)
+        assert np.allclose(np.cumsum(hist[l, 0][::-1]), np.asarray(t1),
+                           atol=1e-3)
+        assert np.allclose(np.cumsum(hist[l, 1][::-1]), np.asarray(f1),
+                           atol=1e-3)
