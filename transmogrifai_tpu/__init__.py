@@ -13,7 +13,21 @@ user can switch with minimal relearning.
 """
 from __future__ import annotations
 
+import os as _os
+
 __version__ = "0.1.0"
+
+# Honor an explicit JAX_PLATFORMS=cpu at the CONFIG level before any
+# backend init: this image's sitecustomize registers a remote-TPU plugin
+# whose half-up tunnel can hang backend creation even when the env var is
+# set (the register hook bypasses the env filter; jax.config does not).
+# Examples, CI and user scripts then cannot deadlock on the tunnel.
+if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    try:
+        import jax as _jax
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception:  # jax absent/old: nothing to guard
+        pass
 
 from . import types
 from .types import *  # noqa: F401,F403 — feature type hierarchy
