@@ -23,9 +23,16 @@ Design (TPU-first, not a port):
   K=n_classes mean leaves (G/H with G=onehot·w, H=w) give RF/DT class
   distributions whose variance-reduction gain IS the Gini gain; K=1 mean
   leaves give regression-tree variance reduction (Spark `impurity`).
-- Per-level gradient histograms via one `segment_sum` over node·feature·bin
-  ids — the psum-friendly reduction; under pjit row-sharding the partial
-  histograms all-reduce over ICI exactly where XGBoost used Rabit allreduce.
+- Per-level gradient histograms are one reduction over (node, feature,
+  bin) cells with three lowerings: a fused `segment_sum` on CPU/GPU, a
+  chunked one-hot MXU contraction on TPU, and a pallas kernel (VMEM
+  one-hot tiles) above _PALLAS_MIN_ROWS. Levels past the root compute
+  left children only and derive siblings by subtraction. Under pjit row
+  sharding the partial histograms all-reduce over ICI exactly where
+  XGBoost used Rabit allreduce.
+- TPU serializes data-dependent gathers, so routing, traversal, leaf
+  lookup and digitize all lower as one-hot contractions / fused compares
+  there (CPU keeps the gather forms; results agree up to f32 rounding).
 - Row parallelism = whole-array ops over N; tree/round loops are lax.scan;
   the class axis of softmax boosting is vmapped.
 """
