@@ -1,0 +1,51 @@
+"""TPU validation: piecewise tree-path timings, then the full 10M sweep.
+
+Run on first contact with real hardware (the tree kernels' pallas path
+compiles here for the first time); every phase prints immediately so a
+stall pinpoints itself. TMOG_NO_PALLAS=1 re-runs on the XLA-only path.
+
+Usage: python tools/tpu_tree_validate.py
+"""
+import os, sys, time
+import numpy as np
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import jax, jax.numpy as jnp
+from bench import device_data, gbt_grids, TPU_CFG
+from transmogrifai_tpu.ops import trees as T
+from transmogrifai_tpu.ops import metrics_ops as M
+
+cfg = dict(TPU_CFG)
+N, F, B = cfg["n_rows"], cfg["n_cols"], cfg["gbt_bins"]
+t0 = time.time()
+Xd, yd, masks = device_data(N, F, cfg["folds"], jnp.bfloat16)
+print("data gen", round(time.time()-t0, 1), flush=True)
+w = jnp.ones(N, jnp.float32)
+
+def timed(label, f, reps=2):
+    out = None
+    for i in range(reps):
+        t0 = time.time(); out = f(i); jax.block_until_ready(out)
+        print(f"{label} [{i}]", round(time.time()-t0, 2), "s", flush=True)
+    return out
+
+edges = timed("quantile_edges", lambda i: T.quantile_edges(Xd, B), 1)
+Xb = timed("bin_matrix", lambda i: T.bin_matrix(Xd, edges), 2)
+print("Xb dtype", Xb.dtype, flush=True)
+trees_ = timed("fit_gbt d6 r10", lambda i: T.fit_gbt(
+    Xb, yd, w, jax.random.PRNGKey(i), n_rounds=10, depth=6, n_bins=B,
+    learning_rate=0.1, loss="logistic")[0], 2)
+timed("predict_forest", lambda i: T.predict_forest_bins(trees_, Xb, 6), 2)
+timed("au_pr_binned_lanes 5xN", lambda i: M.au_pr_binned_lanes(
+    jnp.broadcast_to((Xb[:, 0] + i).astype(jnp.float32)[None, :], (5, N)),
+    yd, (1.0 - masks) * w[None, :], 4096), 2)
+
+from transmogrifai_tpu.automl.tuning.validators import CrossValidation
+from transmogrifai_tpu.evaluators.evaluators import Evaluators
+from transmogrifai_tpu.models.trees import OpXGBoostClassifier
+val = CrossValidation(Evaluators.BinaryClassification.au_pr(), num_folds=5,
+                      seed=42, sweep_dtype=jnp.bfloat16)
+tg = gbt_grids(cfg)
+t0 = time.time()
+best = val.validate([(OpXGBoostClassifier(), [dict(g) for g in tg])], Xd, yd)
+print("FULL tree sweep", round(time.time()-t0, 1), "s; best",
+      best.best_grid, round(best.best_metric, 4), flush=True)
