@@ -57,9 +57,30 @@ RESULT: dict = {"metric": "cv_sweep_wall", "value": -1.0, "unit": "s",
                 "vs_baseline": 0.0}
 _T0 = time.time()
 
+# Incremental persistence: every completed phase snapshots RESULT to disk,
+# so a dying TPU tunnel / killed process can no longer erase the evidence
+# already gathered (round-2 failure mode: the recorded artifact was a CPU
+# fallback because the tunnel died mid-run and took the session's TPU
+# numbers with it).
+PARTIAL_PATH = os.environ.get(
+    "BENCH_PARTIAL_PATH",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "bench_partial.json"))
+
+
+def persist_partial(phase: str) -> None:
+    try:
+        RESULT["last_phase"] = phase
+        with open(PARTIAL_PATH + ".tmp", "w") as f:
+            json.dump(RESULT, f)
+        os.replace(PARTIAL_PATH + ".tmp", PARTIAL_PATH)
+    except OSError:
+        pass
+
 
 def emit_and_exit(signum=None, frame=None):
     RESULT.setdefault("errors", []).append("time budget expired; partial run")
+    persist_partial("budget_expired")
     print(json.dumps(RESULT), flush=True)
     os._exit(0)
 
@@ -175,6 +196,8 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
     from transmogrifai_tpu.models.glm import OpLogisticRegression
     from transmogrifai_tpu.models.trees import OpXGBoostClassifier
 
+    import transmogrifai_tpu.automl.tuning.validators as V
+
     ev = Evaluators.BinaryClassification.au_pr()
     val = CrossValidation(ev, num_folds=cfg["folds"], seed=42,
                           sweep_dtype=sweep_dtype)
@@ -187,45 +210,55 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
     best_glm = best_tree = None
     glm_s = tree_s = 0.0
     glm_warm_s = None
+    glm_route = None
+    saved_min_rows = V.STREAMED_SWEEP_MIN_ROWS
     log(f"GLM sweep: {len(ggrids)} grids x {cfg['folds']} folds")
     try:
-        t0 = time.perf_counter()
-        best_glm = val.validate([(lr, [dict(g) for g in ggrids])], X, y)
-        glm_s = time.perf_counter() - t0
-        log(f"GLM sweep done in {glm_s:.2f}s (incl. compile)")
-    except Exception as e:
-        errors.append(f"glm sweep: {type(e).__name__}: {str(e)[:200]}")
-        # the streamed lane-batched kernel is the newest code on this
-        # hardware — retry once through the battle-tested vmapped route
-        # rather than losing the headline family (round 1 recorded no
-        # perf number at all; never again)
-        import transmogrifai_tpu.automl.tuning.validators as V
-        if V.STREAMED_SWEEP_MIN_ROWS <= cfg["n_rows"]:
-            try:
-                V.STREAMED_SWEEP_MIN_ROWS = 10 ** 15
-                log("retrying GLM sweep on the vmapped route")
-                t0 = time.perf_counter()
-                best_glm = val.validate([(lr, [dict(g) for g in ggrids])],
-                                        X, y)
-                glm_s = time.perf_counter() - t0
-                errors.append("glm sweep ok on vmapped-route retry")
-                log(f"GLM sweep (vmapped) done in {glm_s:.2f}s")
-            except Exception as e2:
-                errors.append(f"glm sweep retry: {type(e2).__name__}: "
-                              f"{str(e2)[:200]}")
-    if best_glm is not None:
-        # steady state: the re-run hits the jit cache, isolating XLA
-        # compile time (reported separately; the headline keeps cold).
-        # Own try/except: a warm-only failure must not read as the GLM
-        # family failing — the cold result above already stands.
         try:
             t0 = time.perf_counter()
-            val.validate([(lr, [dict(g) for g in ggrids])], X, y)
-            glm_warm_s = time.perf_counter() - t0
-            log(f"GLM sweep warm: {glm_warm_s:.2f}s")
+            best_glm = val.validate([(lr, [dict(g) for g in ggrids])], X, y)
+            glm_s = time.perf_counter() - t0
+            glm_route = best_glm.validated[0].route
+            log(f"GLM sweep done in {glm_s:.2f}s (incl. compile, "
+                f"route={glm_route})")
         except Exception as e:
-            errors.append(f"glm warm rerun: {type(e).__name__}: "
-                          f"{str(e)[:200]}")
+            errors.append(f"glm sweep: {type(e).__name__}: {str(e)[:200]}")
+            # the streamed lane-batched kernel is the newest code on this
+            # hardware — retry once through the battle-tested vmapped route
+            # rather than losing the headline family (round 1 recorded no
+            # perf number at all; never again). The override stays in
+            # place through the warm re-run below so the warm timing runs
+            # the SAME route as the cold one it is compared against;
+            # restored in the outer finally.
+            if V.STREAMED_SWEEP_MIN_ROWS <= cfg["n_rows"]:
+                try:
+                    V.STREAMED_SWEEP_MIN_ROWS = 10 ** 15
+                    log("retrying GLM sweep on the vmapped route")
+                    t0 = time.perf_counter()
+                    best_glm = val.validate([(lr, [dict(g) for g in ggrids])],
+                                            X, y)
+                    glm_s = time.perf_counter() - t0
+                    glm_route = best_glm.validated[0].route
+                    errors.append("glm sweep ok on vmapped-route retry")
+                    log(f"GLM sweep (vmapped) done in {glm_s:.2f}s")
+                except Exception as e2:
+                    errors.append(f"glm sweep retry: {type(e2).__name__}: "
+                                  f"{str(e2)[:200]}")
+        if best_glm is not None:
+            # steady state: the re-run hits the jit cache, isolating XLA
+            # compile time (reported separately; the headline keeps cold).
+            # Own try/except: a warm-only failure must not read as the GLM
+            # family failing — the cold result above already stands.
+            try:
+                t0 = time.perf_counter()
+                val.validate([(lr, [dict(g) for g in ggrids])], X, y)
+                glm_warm_s = time.perf_counter() - t0
+                log(f"GLM sweep warm: {glm_warm_s:.2f}s")
+            except Exception as e:
+                errors.append(f"glm warm rerun: {type(e).__name__}: "
+                              f"{str(e)[:200]}")
+    finally:
+        V.STREAMED_SWEEP_MIN_ROWS = saved_min_rows
 
     log(f"tree sweep: {len(tgrids)} configs x {cfg['folds']} folds")
     try:
@@ -258,7 +291,7 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
     if not candidates:
         raise RuntimeError("both sweep families failed: " + "; ".join(errors))
     best = max(candidates, key=lambda b: b.best_metric)
-    out = dict(glm_s=glm_s, tree_s=tree_s,
+    out = dict(glm_s=glm_s, tree_s=tree_s, glm_route=glm_route,
                glm_fits=len(ggrids) * cfg["folds"] if best_glm else 0,
                tree_fits=len(tgrids) * cfg["folds"] if best_tree else 0,
                best_name=best.name, best_grid=best.best_grid,
@@ -268,14 +301,20 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
     return out
 
 
-def glm_flops_estimate(cfg):
-    """Executed FLOPs for the streamed GLM sweep (ops/glm_sweep.py): per
-    Newton iteration per lane — eta 2nd + gradient 2nd + compressed Gram
-    2nT with T = d(d+1)/2 (the triangle halves the naive 2nd^2 Gram);
-    15 iterations, lanes = grid x folds."""
+def glm_flops_estimate(cfg, route):
+    """Executed FLOPs for the GLM sweep, matched to the route that actually
+    ran (ADVICE r2: attributing vmapped timings to the streamed FLOP model
+    misstates MFU). Streamed (ops/glm_sweep.py): per Newton iteration per
+    lane — eta 2nd + gradient 2nd + compressed Gram 2nT with T = d(d+1)/2
+    (the triangle halves the naive Gram). Vmapped (ops/glm.py per lane):
+    eta 2nd + gradient 2nd + full weighted Gram 2nd^2 + the [n, d] scale
+    nd. 15 iterations, lanes = grid x folds."""
     n, d = cfg["n_rows"], cfg["n_cols"]
-    T = d * (d + 1) // 2
-    per_iter_lane = 4 * n * d + 2 * n * T
+    if route == "streamed":
+        T = d * (d + 1) // 2
+        per_iter_lane = 4 * n * d + 2 * n * T
+    else:  # vmapped / sequential per-lane solve
+        per_iter_lane = 4 * n * d + 2 * n * d * d + n * d
     fits = cfg["glm_grid"] * cfg["folds"]
     return per_iter_lane * 15 * fits
 
@@ -634,6 +673,7 @@ def main():
                   config=f"{cfg['glm_grid']}+{cfg['gbt_grid']} models x "
                          f"{cfg['folds']} folds")
     log(f"backend={backend} kind={kind} cfg={cfg}")
+    persist_partial("backend_probe")
 
     # 1. headline sweep — data generated ON DEVICE (no tunnel transfer)
     import jax.numpy as jnp
@@ -648,9 +688,12 @@ def main():
                          f"{cfg['glm_grid'] + cfg['gbt_grid']}"
                          f"model_{cfg['folds']}fold_wall",
                   value=round(device_s, 3), sweep=sweep)
+    persist_partial("device_sweeps")
 
-    # 2. MFU — count only families whose device sweep actually ran
-    glm_flops = glm_flops_estimate(cfg) if sweep["glm_fits"] else 0.0
+    # 2. MFU — count only families whose device sweep actually ran, with
+    # the FLOP model matched to the route that produced the timing
+    glm_flops = (glm_flops_estimate(cfg, sweep.get("glm_route"))
+                 if sweep["glm_fits"] else 0.0)
     tree_flops = (tree_flops_cost_analysis(cfg, sweep_dtype)
                   * cfg["gbt_grid"] * cfg["folds"]
                   if sweep["tree_fits"] else 0.0)
@@ -668,7 +711,9 @@ def main():
         mfu["mfu"] = round((glm_flops + tree_flops) / device_s / peak, 4)
         if glm_warm:
             mfu["glm_mfu_warm"] = round(glm_flops / glm_warm / peak, 4)
+    mfu["glm_flop_model"] = sweep.get("glm_route") or "n/a"
     RESULT["mfu"] = mfu
+    persist_partial("mfu")
 
     # 3. measured host baseline (independent same-distribution twin; fixed
     # iteration counts make the cost data-independent)
@@ -699,6 +744,7 @@ def main():
     }
     RESULT["vs_baseline"] = round(base_total / device_s, 2)
     RESULT["vs_baseline_8thread"] = round(base_total / 8 / device_s, 2)
+    persist_partial("host_baseline")
 
     # 4. AuPR parity: device-trained vs host-trained winner coefficients
     # scored on the SAME host data
@@ -711,10 +757,12 @@ def main():
             RESULT["sweep"]["au_pr_parity_delta"] = round(delta, 4)
     except Exception as e:
         errors.append(f"parity: {type(e).__name__}: {e}")
+    persist_partial("aupr_parity")
     del Xh, Xd  # free 2 x [n, d] before the host-heavy phases
 
     # 5. wide transmogrify + example configs, in CPU children
     configs = {}
+    RESULT["configs"] = configs
     try:
         if remaining() > 240:
             configs["wide_transmogrify"] = run_subprocess_phase(
@@ -724,6 +772,7 @@ def main():
             errors.append("wide_transmogrify skipped: budget")
     except Exception as e:
         errors.append(f"wide: {type(e).__name__}: {str(e)[:200]}")
+    persist_partial("wide_transmogrify")
     for key, mod in (("titanic_s", "op_titanic_simple"),
                      ("iris_s", "op_iris"), ("boston_s", "op_boston")):
         try:
@@ -735,11 +784,12 @@ def main():
                 errors.append(f"{mod} skipped: budget")
         except Exception as e:
             errors.append(f"{mod}: {type(e).__name__}: {str(e)[:200]}")
-    RESULT["configs"] = configs
+        persist_partial(f"example_{key}")
 
     if not errors:
         RESULT.pop("errors", None)
     signal.alarm(0)
+    persist_partial("complete")
     print(json.dumps(RESULT), flush=True)
 
 
@@ -758,6 +808,7 @@ if __name__ == "__main__":
     except Exception as e:  # never exit without a parseable JSON line
         RESULT.setdefault("errors", []).append(
             f"{type(e).__name__}: {e}")
+        persist_partial("fatal_error")
         try:
             print(json.dumps(RESULT), flush=True)
         except BrokenPipeError:

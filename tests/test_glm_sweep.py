@@ -90,6 +90,33 @@ class TestKernelParity:
             assert np.isfinite(np.asarray(B)).all()
             assert np.isfinite(np.asarray(b0)).all()
 
+    def test_streamed_hinge_matches_per_lane_svc(self):
+        """Streamed squared_hinge must reproduce fit_linear_svc per lane —
+        same loss scaling (0.5*gap^2), so the same effective L2 for a
+        given reg_param above and below STREAMED_SWEEP_MIN_ROWS."""
+        from transmogrifai_tpu.ops.glm import fit_linear_svc
+        X, y = _binary(n=3000)
+        masks = _masks(y, folds=2)
+        w = np.ones_like(y)
+        regs = np.array([0.01, 0.1, 1.0], np.float32)
+        alphas = np.zeros(3, np.float32)
+        B, b0 = sweep_glm_streamed(
+            jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(masks), jnp.asarray(regs), jnp.asarray(alphas),
+            loss="squared_hinge", max_iter=30, standardize=False)
+        B = np.asarray(B)
+        b0 = np.asarray(b0)
+        for f in range(masks.shape[0]):
+            for g in range(len(regs)):
+                beta_ref, b0_ref = fit_linear_svc(
+                    jnp.asarray(X), jnp.asarray(y),
+                    jnp.asarray(masks[f] * w), jnp.asarray(regs[g]),
+                    max_iter=30, standardize=False)
+                assert np.allclose(B[f, g], np.asarray(beta_ref),
+                                   atol=5e-3), (f, g, B[f, g],
+                                                np.asarray(beta_ref))
+                assert abs(b0[f, g] - float(b0_ref)) < 5e-3, (f, g)
+
 
 class TestValidatorRouting:
     def test_streamed_and_vmapped_agree_end_to_end(self, monkeypatch):

@@ -39,6 +39,10 @@ class ValidatedModel:
     grid: ParamMap
     metric_name: str
     fold_metrics: List[float]
+    # which sweep kernel produced these metrics ("streamed" | "vmapped" |
+    # "mask_folds" | "sequential") — callers attributing timings/FLOPs
+    # (bench.py MFU accounting) read it off the validation result
+    route: str = ""
 
     @property
     def mean_metric(self) -> float:
@@ -109,7 +113,6 @@ def _metric_fn(problem_type: str, metric: str, n_classes: int = 2,
 # (fold x grid) lanes instead of one per lane. Below it, the per-lane
 # vmapped program is simpler and compile-cheaper.
 STREAMED_SWEEP_MIN_ROWS = 200_000
-
 
 def _lanes_metric_fn(metric: str, problem_type: str, rank_bins):
     """(scores [L, n], labels [n], w_lanes [L, n]) -> [L] metric values
@@ -470,7 +473,7 @@ class Validator:
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
                            grid=g, metric_name=metric,
-                           fold_metrics=results[gi])
+                           fold_metrics=results[gi], route="vmapped")
             for gi, g in enumerate(grids)
         ]
 
@@ -550,7 +553,7 @@ class Validator:
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
                            grid=g, metric_name=metric,
-                           fold_metrics=results[gi])
+                           fold_metrics=results[gi], route="streamed")
             for gi, g in enumerate(grids)
         ]
 
@@ -627,7 +630,7 @@ class Validator:
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
                            grid=g, metric_name=metric,
-                           fold_metrics=results[gi])
+                           fold_metrics=results[gi], route="mask_folds")
             for gi, g in enumerate(grids)
         ]
 
@@ -662,7 +665,7 @@ class Validator:
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
                            grid=g, metric_name=metric,
-                           fold_metrics=results[gi])
+                           fold_metrics=results[gi], route="sequential")
             for gi, g in enumerate(grids)
         ]
 

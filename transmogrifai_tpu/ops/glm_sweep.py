@@ -88,9 +88,12 @@ def _residual_curvature(loss: str):
             return eta - y[:, None], jnp.ones_like(eta)
     elif loss == "squared_hinge":
         def rc(eta, y):
+            # loss 0.5*gap^2 (NOT gap^2): matches glm.fit_linear_svc's
+            # residual/curvature so the streamed and per-lane routes see
+            # the same effective L2 for a given reg_param
             ypm = (2.0 * y - 1.0)[:, None]
             gap = jnp.maximum(1.0 - ypm * eta, 0.0)
-            return -2.0 * gap * ypm, 2.0 * (gap > 0.0).astype(eta.dtype)
+            return -gap * ypm, (gap > 0.0).astype(eta.dtype)
     else:
         raise ValueError(f"unknown streamed loss {loss!r}")
     return rc
@@ -183,11 +186,15 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
 
         acc0 = (jnp.zeros((L, d), jnp.float32), jnp.zeros((L, T), jnp.float32),
                 jnp.zeros(L, jnp.float32), jnp.zeros(L, jnp.float32))
-        if axis_name is not None and hasattr(jax.lax, "pvary"):
+        if axis_name is not None:
             # under shard_map's varying-manual-axes tracking the carry
             # becomes batch-varying inside the body; the initial zeros
-            # must carry the same type
-            acc0 = jax.lax.pvary(acc0, axis_name)
+            # must carry the same type. pcast is the current spelling;
+            # pvary the deprecated one on older jax.
+            if hasattr(jax.lax, "pcast"):
+                acc0 = jax.lax.pcast(acc0, axis_name, to="varying")
+            elif hasattr(jax.lax, "pvary"):
+                acc0 = jax.lax.pvary(acc0, axis_name)
         (gA, hA, g0A, h0A), _ = jax.lax.scan(body, acc0, xs)
         # the Rabit-allreduce/Spark-shuffle slot: partial per-shard sums
         # combine over ICI/DCN

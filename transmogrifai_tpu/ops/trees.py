@@ -221,6 +221,16 @@ _HIST_CHUNK = 65_536
 # of costing ~1GB of HBM write+read per 64K-row chunk. MUST stay above
 # models/trees._VMAP_FOLD_MAX_ROWS so a pallas_call never sits under the
 # fold vmap (models/trees.py asserts the ordering at import).
+#
+# Accumulation-width limit: all histogram channels (G/H/count) accumulate
+# in f32, whose integer ladder ends at 2^24 (~16.7M). Per-NODE unit-weight
+# counts are exact below that; past ~16M rows in a single node the
+# empty-leaf zeroing (Cl >= 0.5) and min_child_weight comparisons can
+# drift by ulps. The BASELINE 10M-row config sits safely inside the
+# window; scaling a single unsharded fit past ~16M rows/node requires
+# splitting counts into two channels or a widened final reduce. (Under
+# pjit row sharding each shard accumulates its local rows only, so the
+# per-shard bound is rows/shard, and the psum is exact far longer.)
 _PALLAS_MIN_ROWS = 4_000_000
 
 def pallas_enabled() -> bool:
