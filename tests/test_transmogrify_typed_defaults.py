@@ -1,0 +1,123 @@
+"""Type-specific transmogrify defaults for structured text types
+(reference Transmogrifier.scala:277-340 via dsl/RichTextFeature.scala):
+Email -> domain pivot, URL -> valid-domain pivot, Phone -> validity
+binary, Base64 -> MIME pivot, Street -> plain pivot. Generic SmartText
+hashing would discard exactly the structure these types declare."""
+import base64
+
+import numpy as np
+
+from transmogrifai_tpu.automl.transmogrifier import (
+    _group_key, transmogrify, vectorize_by_type,
+)
+from transmogrifai_tpu.data.dataset import Dataset
+from transmogrifai_tpu.features.builder import FeatureBuilder
+from transmogrifai_tpu.types import (
+    Base64, Email, Phone, RealNN, Street, Text, URL,
+)
+from transmogrifai_tpu.workflow.workflow import Workflow
+
+
+def test_group_keys_route_structured_text():
+    assert _group_key(Email) == "email"
+    assert _group_key(Phone) == "phone"
+    assert _group_key(URL) == "url"
+    assert _group_key(Base64) == "base64"
+    assert _group_key(Street) == "categorical"
+    assert _group_key(Text) == "text"
+
+
+def _b64(raw: bytes) -> str:
+    return base64.b64encode(raw).decode("ascii")
+
+
+def _build(n=40, seed=3):
+    rng = np.random.default_rng(seed)
+    emails = rng.choice(
+        ["ann@gmail.com", "bob@acme.org", "eve@gmail.com", None], n).tolist()
+    phones = rng.choice(
+        ["+1 650 253 0000", "555", "(212) 555-7890", None], n).tolist()
+    urls = rng.choice(
+        ["https://salesforce.com/x", "http://data.com", "notaurl", None],
+        n).tolist()
+    blobs = rng.choice(
+        [_b64(b"%PDF-1.4 etc"), _b64(b"\x89PNG\r\n rest"), None], n).tolist()
+    streets = rng.choice(
+        ["123 Main St", "9 Elm Ave", None], n).tolist()
+    ds = Dataset.from_features([
+        ("em", Email, emails),
+        ("ph", Phone, phones),
+        ("ur", URL, urls),
+        ("bl", Base64, blobs),
+        ("st", Street, streets),
+    ])
+    feats = [
+        FeatureBuilder.Email("em").extract(lambda r: r.get("em")).as_predictor(),
+        FeatureBuilder.Phone("ph").extract(lambda r: r.get("ph")).as_predictor(),
+        FeatureBuilder.URL("ur").extract(lambda r: r.get("ur")).as_predictor(),
+        FeatureBuilder.Base64("bl").extract(lambda r: r.get("bl")).as_predictor(),
+        FeatureBuilder.Street("st").extract(lambda r: r.get("st")).as_predictor(),
+    ]
+    return ds, feats
+
+
+def test_typed_defaults_specialized_columns():
+    ds, feats = _build(n=80)
+    vec = transmogrify(feats)
+    model = Workflow().set_input_dataset(ds).set_result_features(vec).train()
+    out = model.score(ds).column(vec.name)
+    md = out.metadata
+
+    def indicators(parent):
+        # derived groups carry the derivation feature's name
+        # ("em_emailDomain_<uid>"), rooted at the raw feature name
+        return {c.indicator_value for c in md.columns
+                if c.parent_feature_name.startswith(parent)
+                and c.indicator_value}
+
+    # Email: domain pivot — gmail.com / acme.org columns, not 512 hashes
+    em = indicators("em")
+    assert any("gmail" in v for v in em), em
+    assert any("acme" in v for v in em), em
+    # URL: domains of VALID urls only — salesforce/data, never "notaurl"
+    ur = indicators("ur")
+    assert any("salesforce" in v for v in ur), ur
+    assert not any("notaurl" in v for v in ur), ur
+    # Base64: MIME pivot
+    bl = indicators("bl")
+    assert any("pdf" in v for v in bl), bl
+    assert any("png" in v for v in bl), bl
+    # Street: plain pivot (categorical), values kept as-is up to cleaning
+    st = indicators("st")
+    assert any("main" in v.lower() for v in st), st
+
+    # Phone: exactly validity (+ null tracker) columns, no hash space
+    ph_cols = [c for c in md.columns
+               if c.parent_feature_name.startswith("ph")]
+    assert 1 <= len(ph_cols) <= 2, [c.column_name for c in ph_cols]
+    # valid numbers -> 1.0, junk "555" -> 0.0
+    ph_idx = ph_cols[0].index
+    raw = ds.column("ph").data
+    valid_mask = np.array([v in ("+1 650 253 0000", "(212) 555-7890")
+                           for v in raw])
+    np.testing.assert_allclose(out.data[valid_mask, ph_idx], 1.0)
+    junk_mask = np.array([v == "555" for v in raw])
+    np.testing.assert_allclose(out.data[junk_mask, ph_idx], 0.0)
+
+
+def test_typed_defaults_survive_fit_transform_groups():
+    """vectorize_by_type returns one vector per type group, and the whole
+    DAG (derivation transformer + vectorizer + combiner) fits through the
+    layered workflow engine."""
+    ds, feats = _build(n=25, seed=11)
+    groups = vectorize_by_type(feats)
+    assert len(groups) == 5
+    vec = transmogrify(feats)
+    model = Workflow().set_input_dataset(ds).set_result_features(vec).train()
+    out = model.score(ds).column(vec.name)
+    assert out.data.shape[0] == 25
+    assert md_size_matches(out)
+
+
+def md_size_matches(col):
+    return col.metadata.size == col.data.shape[1]

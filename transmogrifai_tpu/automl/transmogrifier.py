@@ -49,8 +49,11 @@ class TransmogrifierDefaults:
 
 DEFAULTS = TransmogrifierDefaults()
 
-# dispatch order matters: most-specific first (a PickList is a Text)
-_CATEGORICAL_TEXT = (PickList, ComboBox, Country, State, City, PostalCode, ID)
+# dispatch order matters: most-specific first (a PickList is a Text).
+# Street pivots like a PickList (Transmogrifier.scala:338 — the reference
+# ships no smarter Street default either).
+_CATEGORICAL_TEXT = (PickList, ComboBox, Country, State, City, PostalCode,
+                     ID, Street)
 
 
 def transmogrify(features: Sequence[Feature],
@@ -85,10 +88,41 @@ def vectorize_by_type(features: Sequence[Feature],
 
     out: List[Feature] = []
     for key in order:
-        feats = groups[key]
-        stage = _vectorizer_for(key, defaults)
-        out.append(stage.set_input(*feats).get_output())
+        out.append(_vectorize_group(key, groups[key], defaults))
     return out
+
+
+def _derivations():
+    """key -> (derivation transformer, target vectorizer group) for
+    structured text types (Transmogrifier.scala:277-340 via
+    dsl/RichTextFeature.scala): Email/URL domain pivots
+    (RichEmailFeature.vectorize:608, RichURLFeature.vectorize:666 —
+    valid-URL domains only), phone validity bits
+    (RichPhoneFeature.vectorize:558), Base64 MIME pivots
+    (RichBase64Feature.vectorize:711)."""
+    from ..transformers.text import (
+        EmailToPickList, MimeTypeDetector, PhoneNumberParser,
+        UrlToDomainPickList,
+    )
+    return {"email": (EmailToPickList, "categorical"),
+            "url": (UrlToDomainPickList, "categorical"),
+            "base64": (MimeTypeDetector, "categorical"),
+            "phone": (PhoneNumberParser, "binary")}
+
+
+def _vectorize_group(key: str, feats: List[Feature],
+                     d: TransmogrifierDefaults) -> Feature:
+    """Derive-then-vectorize for structured text groups; plain
+    per-group default vectorizer otherwise. The derived group reuses
+    _vectorizer_for's default for its target group, so categorical/binary
+    defaults stay single-sourced."""
+    derivation = _derivations().get(key)
+    if derivation is not None:
+        transformer_cls, target = derivation
+        feats = [transformer_cls().set_input(f).get_output() for f in feats]
+        key = target
+    stage = _vectorizer_for(key, d)
+    return stage.set_input(*feats).get_output()
 
 
 def _group_key(t: Type[FeatureType]) -> str:
@@ -106,6 +140,18 @@ def _group_key(t: Type[FeatureType]) -> str:
         return "multipicklist"
     if issubclass(t, _CATEGORICAL_TEXT):
         return "categorical"
+    # structured text types get derivation-then-vectorize defaults
+    # (Transmogrifier.scala:277-340): domain pivots for Email/URL, phone
+    # validity, MIME pivot for Base64 — generic hashing would discard the
+    # structure these types declare
+    if issubclass(t, Email):
+        return "email"
+    if issubclass(t, Phone):
+        return "phone"
+    if issubclass(t, URL):
+        return "url"
+    if issubclass(t, Base64):
+        return "base64"
     if issubclass(t, (TextArea, Text)):
         return "text"
     if issubclass(t, TextList):
@@ -133,7 +179,8 @@ def _vectorizer_for(key: str, d: TransmogrifierDefaults):
             fill_mode="mode" if d.fill_with_mode else "constant",
             track_nulls=d.track_nulls)
     if key == "binary":
-        return BinaryVectorizer(track_nulls=d.track_nulls)
+        return BinaryVectorizer(fill_value=float(d.binary_fill_value),
+                                track_nulls=d.track_nulls)
     if key == "categorical":
         return OneHotVectorizer(top_k=d.top_k, min_support=d.min_support,
                                 clean_text=d.clean_text,

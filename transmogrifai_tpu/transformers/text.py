@@ -735,6 +735,32 @@ class ValidUrlTransformer(Transformer):
         return Binary(u.is_valid(tuple(self.get_param("protocols"))))
 
 
+class UrlToDomainPickList(Transformer):
+    """URL -> PickList of the domain when the URL is valid, empty
+    otherwise (reference RichURLFeature.vectorize:676: `if (v.isValid)
+    v.domain.toPickList else PickList.empty`) — the derivation step of
+    the URL transmogrify default."""
+
+    input_types = (Text,)
+    output_type = PickList
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("protocols", "accepted schemes",
+                      ["http", "https", "ftp"])]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "urlDomainPick"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        from ..types import URL
+        u = vals[0] if isinstance(vals[0], URL) else URL(vals[0].value)
+        if u.value is None or not u.is_valid(tuple(self.get_param("protocols"))):
+            return PickList(None)
+        return PickList(u.domain())
+
+
 class TextToMultiPickList(Transformer):
     """Text -> MultiPickList singleton set (reference RichTextFeature
     .toMultiPickList:58)."""
