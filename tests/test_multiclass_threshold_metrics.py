@@ -1,0 +1,124 @@
+"""Multiclass top-N threshold metrics vs a literal port of the reference
+algorithm (OpMultiClassificationEvaluator.scala:154 computeMetrics):
+per-row stable-descending-sort top-N membership + indexWhere threshold
+cutoffs, aggregated with numpy loops. The XLA kernel must agree exactly
+(counts are integers)."""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops.metrics_ops import multiclass_threshold_metrics
+
+
+def _oracle(probs, labels, top_ns, thresholds):
+    """Direct transcription of the reference computeMetrics/treeAggregate."""
+    n, C = probs.shape
+    T = len(thresholds)
+    correct = {t: np.zeros(T, np.int64) for t in top_ns}
+    incorrect = {t: np.zeros(T, np.int64) for t in top_ns}
+    for i in range(n):
+        scores = probs[i]
+        label = int(labels[i])
+        true_score = scores[label] if 0 <= label < C else 0.0
+        # stable sort descending by score (scala sortBy(-_._1))
+        order = sorted(range(C), key=lambda j: (-scores[j], j))
+        top_score = scores[order[0]]
+
+        def index_where_gt(x):
+            for k in range(T):
+                if thresholds[k] > x:
+                    return k
+            return T
+
+        c_true = index_where_gt(true_score)
+        c_max = index_where_gt(top_score)
+        for t in top_ns:
+            in_topn = label in order[:t]
+            if in_topn:
+                correct[t][0:c_true] += 1
+                incorrect[t][c_true:c_max] += 1
+            else:
+                incorrect[t][0:c_max] += 1
+    no_pred = {t: n - correct[t] - incorrect[t] for t in top_ns}
+    return correct, incorrect, no_pred
+
+
+def _check(probs, labels, top_ns=(1, 3), thresholds=None):
+    if thresholds is None:
+        thresholds = (np.arange(101) / 100.0).astype(np.float32)
+    tm = multiclass_threshold_metrics(probs, labels, top_ns=top_ns,
+                                      thresholds=thresholds)
+    corr, incorr, nopred = _oracle(np.asarray(probs, np.float32),
+                                   labels, top_ns, list(thresholds))
+    for i, t in enumerate(top_ns):
+        np.testing.assert_array_equal(np.asarray(tm.correct_counts[i]),
+                                      corr[t], err_msg=f"correct top{t}")
+        np.testing.assert_array_equal(np.asarray(tm.incorrect_counts[i]),
+                                      incorr[t], err_msg=f"incorrect top{t}")
+        np.testing.assert_array_equal(
+            np.asarray(tm.no_prediction_counts[i]), nopred[t],
+            err_msg=f"no_prediction top{t}")
+    # contract from the reference docstring: the three arrays sum to n
+    total = (np.asarray(tm.correct_counts) + np.asarray(tm.incorrect_counts)
+             + np.asarray(tm.no_prediction_counts))
+    assert (total == probs.shape[0]).all()
+    return tm
+
+
+def test_random_probabilities_match_oracle():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(200, 5)).astype(np.float32)
+    probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    labels = rng.integers(0, 5, size=200).astype(np.float32)
+    _check(probs, labels, top_ns=(1, 2, 3, 10))
+
+
+def test_ties_match_stable_sort_semantics():
+    # equal scores everywhere: top-N membership must follow the original
+    # class index order (scala's stable sortBy), not an arbitrary one
+    probs = np.full((6, 4), 0.25, np.float32)
+    labels = np.array([0, 1, 2, 3, 1, 2], np.float32)
+    _check(probs, labels, top_ns=(1, 2, 3))
+
+
+def test_unseen_label_scores_as_zero():
+    # label index beyond the score vector: trueClassScore = 0.0 and the
+    # label can never be in the top N (scores.lift semantics)
+    probs = np.array([[0.7, 0.3], [0.2, 0.8]], np.float32)
+    labels = np.array([5.0, 1.0])
+    tm = _check(probs, labels, top_ns=(1, 2))
+    # row 0 can never be correct at any threshold
+    assert np.asarray(tm.correct_counts)[1].max() == 1  # only row 1
+
+
+def test_threshold_edges():
+    probs = np.array([[1.0, 0.0], [0.5, 0.5], [0.0, 1.0]], np.float32)
+    labels = np.array([0.0, 1.0, 0.0])
+    _check(probs, labels, top_ns=(1,),
+           thresholds=np.array([0.0, 0.5, 1.0], np.float32))
+
+
+def test_evaluator_surfaces_threshold_metrics():
+    from transmogrifai_tpu.evaluators.evaluators import (
+        MultiClassificationEvaluator,
+    )
+    from transmogrifai_tpu.models.prediction import make_prediction_column
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(120, 3)).astype(np.float32)
+    probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    labels = rng.integers(0, 3, size=120).astype(np.float32)
+    pred = probs.argmax(1).astype(np.float32)
+    col = make_prediction_column(pred, logits, probs)
+    out = MultiClassificationEvaluator(top_ns=(1, 3)).evaluate_all(
+        labels, col)
+    tmj = out["threshold_metrics"]
+    assert tmj["top_ns"] == [1, 3]
+    assert len(tmj["thresholds"]) == 101
+    assert set(tmj["correct_counts"]) == {"1", "3"}
+    # every cell sums to n
+    for t in ("1", "3"):
+        tot = (np.array(tmj["correct_counts"][t])
+               + np.array(tmj["incorrect_counts"][t])
+               + np.array(tmj["no_prediction_counts"][t]))
+        assert (tot == 120).all()
+    import json
+    json.dumps(out)  # summary-JSON serializable end to end

@@ -221,14 +221,18 @@ class ModelSelector(PredictorEstimator):
 
     def _evaluate(self, evaluator: Evaluator, model: PredictionModel,
                   X: np.ndarray, y: np.ndarray,
-                  w: np.ndarray) -> Dict[str, float]:
+                  w: np.ndarray) -> Dict[str, Any]:
         pred, raw, prob = model.predict_arrays(X)
         col = make_prediction_column(pred, raw, prob)
         out: Dict[str, Any] = dict(evaluator.evaluate_all(y, col, w))
         for ev in self.extra_evaluators:
             for k, v in ev.evaluate_all(y, col, w).items():
                 out.setdefault(f"{ev.name}_{k}", v)
-        return {k: v for k, v in out.items() if isinstance(v, float)}
+        # floats are the metric scalars; dicts carry structured curves
+        # (multiclass threshold_metrics) into the summary JSON — the
+        # pretty printer formats floats only
+        return {k: v for k, v in out.items()
+                if isinstance(v, (float, dict))}
 
     def _validator_params(self) -> Dict[str, Any]:
         v = self.validator

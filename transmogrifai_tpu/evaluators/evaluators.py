@@ -176,15 +176,24 @@ class MultiClassificationEvaluator(Evaluator):
         super().__init__(metric)
         self.top_ns = tuple(top_ns)
 
-    def evaluate_all(self, labels, pred_col, w=None) -> Dict[str, float]:
+    def _scalar_metrics(self, labels, pred_col, w=None) -> Dict[str, float]:
         y = np.asarray(labels, np.float32)
         pred = np.asarray(prediction_of(pred_col), np.float32)
-        prob = probability_of(pred_col)
         n_classes = max(int(y.max()) + 1 if y.size else 1,
                         n_classes_of(pred_col), int(pred.max()) + 1 if pred.size else 1)
         m = M.multiclass_metrics(pred, y, n_classes,
                                  None if w is None else np.asarray(w, np.float32))
-        out = {k: float(v) for k, v in m._asdict().items()}
+        return {k: float(v) for k, v in m._asdict().items()}
+
+    def evaluate(self, labels, pred_col, w=None) -> float:
+        # hot path (one call per grid x fold in the sequential validator):
+        # scalar metrics only — no threshold-curve kernel
+        return self._scalar_metrics(labels, pred_col, w)[self.default_metric]
+
+    def evaluate_all(self, labels, pred_col, w=None) -> Dict[str, Any]:
+        y = np.asarray(labels, np.float32)
+        prob = probability_of(pred_col)
+        out: Dict[str, Any] = self._scalar_metrics(labels, pred_col, w)
         if prob is not None and prob.size:
             ww = np.ones_like(y) if w is None else np.asarray(w, np.float64)
             order = np.argsort(-prob, axis=1)
@@ -192,6 +201,11 @@ class MultiClassificationEvaluator(Evaluator):
                 hit = (order[:, :topn] == y[:, None].astype(int)).any(axis=1)
                 out[f"top_{topn}_accuracy"] = float(
                     (ww * hit).sum() / max(ww.sum(), 1e-12))
+            # per-probability-threshold top-N correctness curves (reference
+            # calculateThresholdMetrics, OpMultiClassificationEvaluator
+            # .scala:154); counts are unweighted like the reference's
+            tm = M.multiclass_threshold_metrics(prob, y, top_ns=self.top_ns)
+            out["threshold_metrics"] = tm.to_json()
         return out
 
 

@@ -126,13 +126,17 @@ class ModelInsights:
             lines.append(
                 f"Selected model: {self.selected_model.get('best_model_type')}"
                 f" grid={self.selected_model.get('best_grid')}")
+        # scalar metrics only: structured entries (threshold_metrics
+        # curves) live in the JSON artifact, not the table
         if self.train_evaluation:
             ev = ", ".join(f"{k}={v:.4f}"
-                           for k, v in sorted(self.train_evaluation.items()))
+                           for k, v in sorted(self.train_evaluation.items())
+                           if isinstance(v, float))
             lines.append(f"Train evaluation: {ev}")
         if self.holdout_evaluation:
             ev = ", ".join(f"{k}={v:.4f}"
-                           for k, v in sorted(self.holdout_evaluation.items()))
+                           for k, v in sorted(self.holdout_evaluation.items())
+                           if isinstance(v, float))
             lines.append(f"Holdout evaluation: {ev}")
 
         ranked = sorted(self.features, key=lambda f: -f.max_contribution())
