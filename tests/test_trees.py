@@ -47,11 +47,24 @@ class TestBinning:
         X = np.random.default_rng(1).normal(size=(400, 2)).astype(np.float32)
         edges = T.quantile_edges(jnp.asarray(X), 8)
         Xb = np.asarray(T.bin_matrix(jnp.asarray(X), edges))
-        assert Xb.min() >= 0 and Xb.max() <= 7
-        # bin > t  <=>  x >= edges[t] (equality on an edge goes right)
+        # present values occupy [1, n_bins]; bin 0 is reserved for missing
+        assert Xb.min() >= 1 and Xb.max() <= 8
+        # bin > t  <=>  x >= edges[t-1] (equality on an edge goes right)
         e = np.asarray(edges)
         t = 3
-        assert ((Xb[:, 0] > t) == (X[:, 0] >= e[0, t])).all()
+        assert ((Xb[:, 0] > t) == (X[:, 0] >= e[0, t - 1])).all()
+
+    def test_bin_matrix_missing_bin(self):
+        X = np.random.default_rng(2).normal(size=(300, 2)).astype(np.float32)
+        X[::7, 0] = np.nan
+        edges = T.quantile_edges(jnp.asarray(X), 8)
+        Xb = np.asarray(T.bin_matrix(jnp.asarray(X), edges))
+        nan = np.isnan(X[:, 0])
+        assert (Xb[nan, 0] == 0).all()
+        assert (Xb[~nan, 0] >= 1).all()
+        # NaN rows are excluded from the quantile sketch: edges of the
+        # NaN-carrying column are finite
+        assert np.isfinite(np.asarray(edges)[0]).all()
 
     def test_constant_feature_is_harmless(self):
         X = np.ones((100, 2), np.float32)
@@ -90,8 +103,9 @@ class TestGrowTree:
                            jnp.zeros(2, dtype=jnp.uint32),
                            depth=2, n_bins=8, leaf_mode="mean",
                            min_info_gain=1e-6)
-        # dead splits encode thresh = n_bins-1 (all rows left)
-        assert (np.asarray(tree.thresh) == 7).all()
+        # dead splits encode thresh = n_bins (all rows left; bin 0 is the
+        # missing slot so live bins are [1, n_bins])
+        assert (np.asarray(tree.thresh) == 8).all()
         # every populated leaf predicts the pure value
         assert np.allclose(np.asarray(tree.leaf)[0, 0], 1.0, atol=1e-5)
 
@@ -107,7 +121,7 @@ class TestGrowTree:
                            depth=1, n_bins=64, leaf_mode="mean",
                            min_instances=10.0)
         n_right = int((np.asarray(Xb)[:, 0] > int(tree.thresh[0])).sum())
-        assert n_right >= 10 or int(tree.thresh[0]) == 63
+        assert n_right >= 10 or int(tree.thresh[0]) == 64
 
 
 class TestEstimators:
@@ -239,9 +253,10 @@ class TestServingParity:
         assert np.allclose(binned, raw, atol=1e-5)
 
     def test_nan_features_agree_between_binned_and_raw(self):
-        # NaN canonicalizes to -inf at binning (bin 0, goes left); raw
-        # serving's `x >= thresh` is False for NaN (also left) — train and
-        # serve must agree when a NaN escapes imputation
+        # NaN occupies the dedicated bin 0 and routes by each node's
+        # LEARNED default direction (Tree.miss); raw serving applies the
+        # same bit on isnan rows — train and serve must agree when a NaN
+        # escapes imputation
         import jax
         rng = np.random.default_rng(7)
         X = rng.normal(size=(600, 3)).astype(np.float32)
@@ -262,9 +277,15 @@ class TestServingParity:
         tv = np.asarray(T.thresholds_to_values(trees.feat, trees.thresh,
                                                edges))
         raw = float(base) + T.np_predict_ensemble(
-            np.asarray(trees.feat), tv, np.asarray(trees.leaf), X, 3)[:, 0]
+            np.asarray(trees.feat), tv, np.asarray(trees.leaf), X, 3,
+            miss=np.asarray(trees.miss))[:, 0]
         assert np.isfinite(binned).all()
         assert np.allclose(binned, raw, atol=1e-5)
+        # the missing mass is informative here (y depends on x0 which is
+        # NaN-ed at random): some node learns default-right across rounds
+        # (5/28 at this seed), proving the direction is actually used —
+        # if learning regressed to always-left this catches it
+        assert (np.asarray(trees.miss) > 0).any()
 
 
 class TestPersistence:
@@ -395,10 +416,13 @@ class TestHistogramPaths:
                            jnp.int32)
         f_lvl = tree.feat[3:7]
         t_lvl = tree.thresh[3:7]
-        routed = T._route_level_matmul(Xb_c, node, f_lvl, t_lvl, 4)
+        m_lvl = tree.miss[3:7]
+        routed = T._route_level_matmul(Xb_c, node, f_lvl, t_lvl, m_lvl, 4)
         rows = jnp.arange(len(y))
-        expect = 2 * node + (Xb_c[rows, f_lvl[node]]
-                             > t_lvl[node]).astype(jnp.int32)
+        xb = Xb_c[rows, f_lvl[node]]
+        expect = 2 * node + ((xb > t_lvl[node])
+                             | ((xb == 0)
+                                & (m_lvl[node] > 0))).astype(jnp.int32)
         assert np.array_equal(np.asarray(routed), np.asarray(expect))
         # prediction parity
         out_m = T._predict_bins_matmul(tree, Xb_c, 4)
@@ -414,10 +438,13 @@ class TestHistogramPaths:
                            jnp.int32)
         f_lvl = jnp.asarray([1, 2], jnp.int32)
         t_lvl = jnp.asarray([3, 5], jnp.int32)
-        routed = T._route_level_matmul(Xb, node, f_lvl, t_lvl, 2)
+        m_lvl = jnp.asarray([0, 1], jnp.int32)
+        routed = T._route_level_matmul(Xb, node, f_lvl, t_lvl, m_lvl, 2)
         rows = jnp.arange(len(y))
-        expect = 2 * node + (Xb[rows, f_lvl[node]]
-                             > t_lvl[node]).astype(jnp.int32)
+        xb = Xb[rows, f_lvl[node]]
+        expect = 2 * node + ((xb > t_lvl[node])
+                             | ((xb == 0)
+                                & (m_lvl[node] > 0))).astype(jnp.int32)
         assert np.array_equal(np.asarray(routed), np.asarray(expect))
 
     def test_chunked_scan_boundary_full_fit(self, monkeypatch):

@@ -187,8 +187,12 @@ class MultiClassificationEvaluator(Evaluator):
 
     def evaluate(self, labels, pred_col, w=None) -> float:
         # hot path (one call per grid x fold in the sequential validator):
-        # scalar metrics only — no threshold-curve kernel
-        return self._scalar_metrics(labels, pred_col, w)[self.default_metric]
+        # scalar metrics only — no threshold-curve kernel. Metrics outside
+        # the scalar set (top_N_accuracy) fall through to evaluate_all.
+        scalars = self._scalar_metrics(labels, pred_col, w)
+        if self.default_metric in scalars:
+            return scalars[self.default_metric]
+        return self.evaluate_all(labels, pred_col, w)[self.default_metric]
 
     def evaluate_all(self, labels, pred_col, w=None) -> Dict[str, Any]:
         y = np.asarray(labels, np.float32)

@@ -46,12 +46,16 @@ class TreeEnsembleModel(PredictionModel):
     def __init__(self, feat: np.ndarray, thresh_val: np.ndarray,
                  leaf: np.ndarray, depth: int, mode: str,
                  base: float = 0.0, n_classes: int = 2,
+                 miss: Optional[np.ndarray] = None,
                  operation_name: str = "treeEnsemble",
                  uid: Optional[str] = None):
         super().__init__(operation_name, uid=uid)
         self.feat = np.asarray(feat, np.int32)
         self.thresh_val = np.asarray(thresh_val, np.float32)
         self.leaf = np.asarray(leaf, np.float32)
+        # models saved before missing-direction learning default NaN left
+        self.miss = (np.zeros_like(self.feat) if miss is None
+                     else np.asarray(miss, np.int32))
         self.depth = int(depth)
         self.mode = mode
         self.base = float(base)
@@ -60,7 +64,8 @@ class TreeEnsembleModel(PredictionModel):
     def predict_arrays(self, X):
         X = np.asarray(X, np.float32)
         agg = T.np_predict_ensemble(self.feat, self.thresh_val, self.leaf,
-                                    X, self.depth)          # [N, K] sums
+                                    X, self.depth,
+                                    miss=self.miss)         # [N, K] sums
         n_trees = self.feat.shape[0]
         if self.mode == "classify_mean":
             prob = agg / n_trees
@@ -82,8 +87,8 @@ class TreeEnsembleModel(PredictionModel):
     def save_args(self) -> Dict[str, Any]:
         d = super().save_args()
         d.update(feat=self.feat, thresh_val=self.thresh_val, leaf=self.leaf,
-                 depth=self.depth, mode=self.mode, base=self.base,
-                 n_classes=self.n_classes)
+                 miss=self.miss, depth=self.depth, mode=self.mode,
+                 base=self.base, n_classes=self.n_classes)
         return d
 
 
@@ -92,12 +97,15 @@ class SoftmaxEnsembleModel(PredictionModel):
 
     def __init__(self, feat: np.ndarray, thresh_val: np.ndarray,
                  leaf: np.ndarray, depth: int, n_classes: int,
+                 miss: Optional[np.ndarray] = None,
                  operation_name: str = "xgbSoftmax",
                  uid: Optional[str] = None):
         super().__init__(operation_name, uid=uid)
         self.feat = np.asarray(feat, np.int32)          # [R*C, I]
         self.thresh_val = np.asarray(thresh_val, np.float32)
         self.leaf = np.asarray(leaf, np.float32)        # [R*C, L, 1]
+        self.miss = (np.zeros_like(self.feat) if miss is None
+                     else np.asarray(miss, np.int32))
         self.depth = int(depth)
         self.n_classes = int(n_classes)
 
@@ -109,7 +117,7 @@ class SoftmaxEnsembleModel(PredictionModel):
         for c in range(C):
             margins[:, c] = T.np_predict_ensemble(
                 self.feat[c::C], self.thresh_val[c::C], self.leaf[c::C],
-                X, self.depth)[:, 0]
+                X, self.depth, miss=self.miss[c::C])[:, 0]
         prob = _softmax_np(margins)
         pred = prob.argmax(axis=1).astype(np.float32)
         return pred, margins, prob
@@ -117,7 +125,7 @@ class SoftmaxEnsembleModel(PredictionModel):
     def save_args(self) -> Dict[str, Any]:
         d = super().save_args()
         d.update(feat=self.feat, thresh_val=self.thresh_val, leaf=self.leaf,
-                 depth=self.depth, n_classes=self.n_classes)
+                 miss=self.miss, depth=self.depth, n_classes=self.n_classes)
         return d
 
 
@@ -185,11 +193,13 @@ class _TreeEstimator(PredictorEstimator):
         tv = np.asarray(T.thresholds_to_values(
             jnp.asarray(feat), jnp.asarray(thresh), edges))
         leaf = np.asarray(trees.leaf)
+        miss = np.asarray(trees.miss)
         # stack any leading (rounds, classes) axes into one tree axis
         feat = feat.reshape(-1, feat.shape[-1])
         tv = tv.reshape(-1, tv.shape[-1])
         leaf = leaf.reshape(-1, leaf.shape[-2], leaf.shape[-1])
-        return dict(feat=feat, thresh_val=tv, leaf=leaf)
+        miss = miss.reshape(-1, miss.shape[-1])
+        return dict(feat=feat, thresh_val=tv, leaf=leaf, miss=miss)
 
     def _key(self):
         return jax.random.PRNGKey(int(self.get_param("seed")))

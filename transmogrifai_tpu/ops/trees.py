@@ -13,11 +13,15 @@ Design (TPU-first, not a port):
   algorithm shape and keeps every per-level pass a dense, static-shape
   gather/segment-sum that XLA tiles well.
 - Trees are complete binary trees of static depth in heap layout: internal
-  node arrays `feat`/`thresh` of length 2^depth - 1, leaf payloads
-  [2^depth, K]. A node that fails its split test is encoded as
-  (feat=0, thresh=n_bins-1): `bin > thresh` is then never true, so all rows
-  fall left — traversal stays branchless and data-independent (no
-  dynamic shapes under jit, reference-free control flow for lax.scan).
+  node arrays `feat`/`thresh`/`miss` of length 2^depth - 1, leaf payloads
+  [2^depth, K]. Bins are shifted: 0 is the dedicated missing bin, present
+  values occupy [1, n_bins] (so int8 holds up to 127 quantile bins), and
+  every node learns the default direction for missing rows (`miss`,
+  XGBoost's sparsity-aware split). A node that fails its split test is
+  encoded as (feat=0, thresh=n_bins, miss=0): `bin > thresh` is then
+  never true, so all rows fall left — traversal stays branchless and
+  data-independent (no dynamic shapes under jit, reference-free control
+  flow for lax.scan).
 - Multi-output payloads unify every leaf statistic the reference needs:
   K=1 Newton leaves (-G/(H+lambda)) give XGBoost/GBT boosting steps;
   K=n_classes mean leaves (G/H with G=onehot·w, H=w) give RF/DT class
@@ -49,10 +53,16 @@ EPS = 1e-12
 
 
 class Tree(NamedTuple):
-    """One complete binary tree (heap layout). Leading axes may batch trees."""
+    """One complete binary tree (heap layout). Leading axes may batch trees.
+
+    Missing values occupy the dedicated bin 0 (bin_matrix); present values
+    bin to [1, n_bins]. Each node carries a learned default direction for
+    missing rows (XGBoost's sparsity-aware split: both directions are
+    scored during growth and the better one recorded)."""
     feat: jax.Array    # int32 [..., 2^depth - 1] split feature id
     thresh: jax.Array  # int32 [..., 2^depth - 1] go right iff bin > thresh
     leaf: jax.Array    # f32   [..., 2^depth, K] leaf payload
+    miss: jax.Array    # int32 [..., 2^depth - 1] 1 = missing goes right
 
 
 # -- binning ----------------------------------------------------------------
@@ -61,25 +71,26 @@ _QUANTILE_SAMPLE = 131_072
 
 
 def quantile_edges(X: jax.Array, n_bins: int) -> jax.Array:
-    """Per-feature quantile bin edges.
+    """Per-feature quantile bin edges over PRESENT values.
 
     X: [n, d] -> edges [d, n_bins - 1], ascending per feature. Constant
     features produce repeated edges (empty bins; zero split gain — harmless).
     Rows are strided-sampled above _QUANTILE_SAMPLE — the XGBoost `hist`
-    approximation — so the sort stays cheap at 10M+ rows.
+    approximation — so the sort stays cheap at 10M+ rows. NaN rows are
+    excluded from the sketch (nanquantile), matching XGBoost: missing
+    values get the dedicated bin 0 in bin_matrix, not a quantile slot; an
+    all-NaN feature yields NaN edges, which bin every present value to 1
+    and can never win a split.
     """
     n = X.shape[0]
     if n > _QUANTILE_SAMPLE:
         stride = -(-n // _QUANTILE_SAMPLE)  # ceil
         X = X[::stride]
     # cast only the (<=131K-row) sample to f32 — a bf16 sweep matrix must
-    # not be copied whole — and canonicalize NaN as bin_matrix does: a NaN
-    # row would otherwise poison jnp.quantile and turn EVERY edge of that
-    # feature into NaN
+    # not be copied whole
     X = jnp.asarray(X, jnp.float32)
-    X = jnp.where(jnp.isnan(X), -jnp.inf, X)
     qs = jnp.arange(1, n_bins, dtype=jnp.float32) / n_bins
-    edges = jnp.quantile(X, qs, axis=0)          # [n_bins-1, d]
+    edges = jnp.nanquantile(X, qs, axis=0)       # [n_bins-1, d]
     return jnp.asarray(edges.T, jnp.float32)     # [d, n_bins-1]
 
 
@@ -91,20 +102,24 @@ _BIN_CHUNK = 1 << 18
 
 
 def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
-    """Digitize: bin = #edges strictly below-or-equal (searchsorted right).
+    """Digitize with a dedicated missing bin: NaN -> 0, present values ->
+    1 + #edges below-or-equal (searchsorted right, shifted).
 
     X [n, d], edges [d, n_bins-1] -> int8 (int32 when n_bins > 127) [n, d]
-    in [0, n_bins-1]. `bin > t` is equivalent to `x >= edges[t]` for
-    t < n_bins-1 (right-side search counts edges <= x, so equality on an
-    edge goes right) — the raw serving traversal must therefore compare
-    with >=, which matters for discrete columns (one-hot indicators sit
-    exactly on their edge). Row blocks are processed by a lax.map so the
-    f32 temporaries never exceed O(_BIN_CHUNK * d); int8 output keeps the
+    in [0, n_bins]. For present values `bin > t` is equivalent to
+    `x >= edges[t-1]` for t in [1, n_bins-1] (right-side search counts
+    edges <= x, so equality on an edge goes right) — the raw serving
+    traversal compares with >=, which matters for discrete columns
+    (one-hot indicators sit exactly on their edge). Missing rows route by
+    each node's learned default direction (Tree.miss), never by the
+    comparison. Row blocks are processed by a lax.map so the f32
+    temporaries never exceed O(_BIN_CHUNK * d); int8 output keeps the
     resident binned matrix at n*d bytes (640MB at the 10M config).
     """
     n_bins = edges.shape[1] + 1
-    # max stored bin is n_bins-1, so up to 128 bins fit int8 exactly
-    out_dtype = jnp.int8 if n_bins <= 128 else jnp.int32
+    # max stored bin is n_bins (missing bin shifts present bins up by 1),
+    # so up to 127 quantile bins fit int8 exactly
+    out_dtype = jnp.int8 if n_bins <= 127 else jnp.int32
 
     # TPU: digitize by counting edges <= x (identical to right-side
     # searchsorted) — a fused broadcast-compare+reduce instead of the
@@ -113,18 +128,18 @@ def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
     count_edges = jax.default_backend() == "tpu"
 
     def one_block(xb):
-        # canonicalize NaN to -inf so missing values land in bin 0 and go
-        # LEFT at every split — np_predict_ensemble's raw `x >= thresh`
-        # comparison is False for NaN (also left), keeping device training
-        # and host serving bit-identical when a NaN escapes imputation
         xf = jnp.asarray(xb, jnp.float32)
-        xf = jnp.where(jnp.isnan(xf), -jnp.inf, xf)
+        missing = jnp.isnan(xf)
         if count_edges:
-            bins = (xf[:, :, None] >= edges[None, :, :]).sum(axis=2)
-            return bins.astype(out_dtype)
-        return jax.vmap(
-            lambda col, e: jnp.searchsorted(e, col, side="right"),
-            in_axes=(1, 0), out_axes=1)(xf, edges).astype(out_dtype)
+            # NaN >= edge is False, so the count is 0 for missing rows
+            # before the shift; the where picks bin 0 for them explicitly
+            bins = (xf[:, :, None] >= edges[None, :, :]).sum(axis=2) + 1
+        else:
+            xs = jnp.where(missing, -jnp.inf, xf)
+            bins = jax.vmap(
+                lambda col, e: jnp.searchsorted(e, col, side="right"),
+                in_axes=(1, 0), out_axes=1)(xs, edges) + 1
+        return jnp.where(missing, 0, bins).astype(out_dtype)
 
     N, d = X.shape
     chunk = min(_BIN_CHUNK, N)
@@ -140,41 +155,60 @@ def thresholds_to_values(feat: jax.Array, thresh: jax.Array,
                          edges: jax.Array) -> jax.Array:
     """Map bin thresholds to raw-value thresholds for serving on unbinned X.
 
-    The raw rule is `x >= value` (matching `bin > t` under right-side
-    binning). Dead nodes (thresh == n_bins-1, all-left) become +inf.
+    The raw rule for PRESENT values is `x >= value` (matching `bin > t`
+    under shifted right-side binning: bin = 1 + #edges <= x, so bin > t
+    iff x >= edges[t-1] for t in [1, n_bins-1]). t == 0 sends every
+    present value right (-inf); dead nodes (thresh == n_bins, all-left)
+    become +inf. Missing rows ignore the value and follow Tree.miss.
     """
     n_bins = edges.shape[1] + 1
-    tv = edges[feat, jnp.minimum(thresh, n_bins - 2)]
-    return jnp.where(thresh >= n_bins - 1, jnp.inf, tv)
+    ti = jnp.clip(thresh - 1, 0, n_bins - 2)
+    tv = edges[feat, ti]
+    tv = jnp.where(thresh <= 0, -jnp.inf, tv)
+    return jnp.where(thresh >= n_bins, jnp.inf, tv)
 
 
 # -- single-tree growth -----------------------------------------------------
 
-def _split_scores(GL, HL, CL, Gt, Ht, Ct, reg_lambda, min_child_weight,
-                  min_instances, min_info_gain, gamma, normalize_gain):
-    """Gain + validity for every (node, feature, bin) split candidate.
+def _split_scores(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, reg_lambda,
+                  min_child_weight, min_instances, min_info_gain, gamma,
+                  normalize_gain):
+    """Gain + validity for every (node, feature, bin, missing-direction)
+    split candidate — XGBoost's sparsity-aware split search.
 
-    GL/HL/CL: cumulative left sums [nodes, F, B(, K)]; Gt/Ht/Ct totals.
-    Gain is the multi-output sum-of-squares improvement
-    sum_k GL_k^2/(HL+l) + GR_k^2/(HR+l) - Gt_k^2/(Ht+l); for mean-mode
-    payloads (H = weight) this is total variance reduction, i.e. n x the
-    Spark impurity gain — `normalize_gain` divides by Ht to compare against
-    Spark's per-row minInfoGain; `gamma` is XGBoost's complexity penalty.
+    GL/HL/CL: cumulative left sums [nodes, F, B(, K)] over the shifted bin
+    axis, so slot 0 (the missing bin) is inside every prefix; Gt/Ht/Ct
+    totals; Gm/Hm/Cm the per-(node, feature) missing-bin mass. Direction 0
+    keeps missing in the left prefix (default-left); direction 1 moves the
+    missing mass right (left' = GL - Gm). Gain is the multi-output
+    sum-of-squares improvement sum_k GL_k^2/(HL+l) + GR_k^2/(HR+l) -
+    Gt_k^2/(Ht+l); for mean-mode payloads (H = weight) this is total
+    variance reduction, i.e. n x the Spark impurity gain —
+    `normalize_gain` divides by Ht to compare against Spark's per-row
+    minInfoGain; `gamma` is XGBoost's complexity penalty.
+
+    Returns gain [nodes, F, B, 2] with -inf at invalid candidates.
     """
-    GR = Gt[:, None, None, :] - GL
-    HR = Ht[:, None, None] - HL
-    CR = Ct[:, None, None] - CL
-
     def score(G, H):
         return (G * G).sum(-1) / (H + reg_lambda + EPS)
 
     parent = score(Gt, Ht)[:, None, None]
-    gain = score(GL, HL) + score(GR, HR) - parent
     norm = jnp.maximum(Ht, 1.0)[:, None, None] if normalize_gain else 1.0
-    ok = ((HL >= min_child_weight) & (HR >= min_child_weight)
-          & (CL >= min_instances) & (CR >= min_instances)
-          & (gain / norm > min_info_gain) & (gain > 2.0 * gamma))
-    return jnp.where(ok, gain, -jnp.inf)
+
+    def one_direction(GLd, HLd, CLd):
+        GR = Gt[:, None, None, :] - GLd
+        HR = Ht[:, None, None] - HLd
+        CR = Ct[:, None, None] - CLd
+        gain = score(GLd, HLd) + score(GR, HR) - parent
+        ok = ((HLd >= min_child_weight) & (HR >= min_child_weight)
+              & (CLd >= min_instances) & (CR >= min_instances)
+              & (gain / norm > min_info_gain) & (gain > 2.0 * gamma))
+        return jnp.where(ok, gain, -jnp.inf)
+
+    g_left = one_direction(GL, HL, CL)
+    g_right = one_direction(GL - Gm[:, :, None, :], HL - Hm[:, :, None],
+                            CL - Cm[:, :, None])
+    return jnp.stack([g_left, g_right], axis=-1)
 
 
 def _feature_mask(key: jax.Array, n_nodes: int, n_feat: int,
@@ -326,32 +360,39 @@ def _histograms_matmul(Xb, G, H, count_unit, node, n_nodes: int, B: int):
 _ROUTE_CHUNK = 1 << 20
 
 
-def _onehot_route_step(xf, rel, f_lvl, t_lvl, n_nodes: int):
-    """One gather-free routing step: rel' = 2*rel + (xf[i, f(rel)] > t(rel)).
+def _onehot_route_step(xf, rel, f_lvl, t_lvl, m_lvl, n_nodes: int):
+    """One gather-free routing step:
+    rel' = 2*rel + ((bin > t(rel)) | (bin == 0 & miss(rel))).
 
     TPU serializes data-dependent row gathers, so the per-row feature
     select becomes a one-hot contraction: sel = onehot(rel) @ FS with
-    FS[n, f] = (f_lvl[n] == f); the selected bin is then a masked row sum.
-    Exact for bin values (< 2^24, f32-representable). Shared by training
-    routing (_route_level_matmul) and prediction (_predict_bins_matmul)."""
+    FS[n, f] = (f_lvl[n] == f); the selected bin is then a masked row sum
+    (exact: bin 0 contributes 0, so a missing row's masked sum is 0 —
+    precisely the missing-bin value). Exact for bin values (< 2^24,
+    f32-representable). Shared by training routing (_route_level_matmul)
+    and prediction (_predict_bins_matmul)."""
     F = xf.shape[1]
     rel_oh = jax.nn.one_hot(rel, n_nodes, dtype=jnp.float32)
     FS = (f_lvl[:, None] == jnp.arange(F)[None, :]).astype(jnp.float32)
     sel = jnp.matmul(rel_oh, FS, preferred_element_type=jnp.float32)
     xb_sel = (xf * sel).sum(axis=1)
-    t_sel = jnp.matmul(rel_oh, t_lvl.astype(jnp.float32)[:, None],
-                       preferred_element_type=jnp.float32)[:, 0]
-    return 2 * rel + (xb_sel > t_sel).astype(jnp.int32)
+    tm = jnp.stack([t_lvl.astype(jnp.float32),
+                    m_lvl.astype(jnp.float32)], axis=1)          # [n, 2]
+    tm_sel = jnp.matmul(rel_oh, tm,
+                        preferred_element_type=jnp.float32)      # [N, 2]
+    right = (xb_sel > tm_sel[:, 0]) | ((xb_sel == 0.0)
+                                       & (tm_sel[:, 1] > 0.5))
+    return 2 * rel + right.astype(jnp.int32)
 
 
-def _route_level_matmul(Xb, node, f_lvl, t_lvl, n_nodes: int):
+def _route_level_matmul(Xb, node, f_lvl, t_lvl, m_lvl, n_nodes: int):
     """Gather-free level routing over row chunks (see _onehot_route_step)."""
     N, F = Xb.shape
 
     def one_block(sl):
         xb_blk, node_blk = sl
         return _onehot_route_step(xb_blk.astype(jnp.float32), node_blk,
-                                  f_lvl, t_lvl, n_nodes)
+                                  f_lvl, t_lvl, m_lvl, n_nodes)
 
     chunk = min(_ROUTE_CHUNK, N)
     nchunks = -(-N // chunk)
@@ -387,10 +428,14 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
     `feature_frac` < 1 resamples a feature subset at every node (Spark RF
     featureSubsetStrategy semantics); `feature_mask` [F] bool fixes one
     subset for the whole tree (XGBoost colsample_bytree semantics).
+
+    Bins arrive shifted (bin_matrix): 0 = missing, present in [1, n_bins],
+    so histograms carry n_bins + 1 slots and every split learns the
+    missing default direction (sparsity-aware search, _split_scores).
     """
     N, F = Xb.shape
     K = G.shape[1]
-    B = n_bins
+    B = n_bins + 1   # histogram slots: missing bin 0 + n_bins value bins
     count_unit = jnp.asarray(H > 0, jnp.float32)
     # TPU: histograms as MXU matmuls (scatter lowers poorly there) — via
     # the VMEM-resident pallas kernel at large N, the chunked XLA scan
@@ -415,8 +460,8 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
     rows = jnp.arange(N)
 
     node = jnp.zeros(N, jnp.int32)   # in-level relative node id
-    feats, threshs = [], []
-    last = None                      # (GL, HL, Gt, Ht, f_lvl, t_lvl)
+    feats, threshs, misses = [], [], []
+    last = None                      # split state for the leaf pass
     prev = None                      # previous level's raw histograms
 
     def _interleave(left, right, n_nodes):
@@ -456,32 +501,38 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
         HL = jnp.cumsum(hh, axis=2)
         CL = jnp.cumsum(hc, axis=2)
         Gt, Ht, Ct = GL[:, 0, -1, :], HL[:, 0, -1], CL[:, 0, -1]
+        Gm, Hm, Cm = hg[:, :, 0, :], hh[:, :, 0], hc[:, :, 0]
 
-        gain = _split_scores(GL, HL, CL, Gt, Ht, Ct, reg_lambda,
-                             min_child_weight, min_instances, min_info_gain,
-                             gamma, normalize_gain)
+        gain = _split_scores(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm,
+                             reg_lambda, min_child_weight, min_instances,
+                             min_info_gain, gamma, normalize_gain)
         if feature_mask is not None:
-            gain = jnp.where(feature_mask[None, :, None], gain, -jnp.inf)
+            gain = jnp.where(feature_mask[None, :, None, None],
+                             gain, -jnp.inf)
         if feature_frac < 1.0:
             key, sub = jax.random.split(key)
             fm = _feature_mask(sub, n_nodes, F, feature_frac)
-            gain = jnp.where(fm[:, :, None], gain, -jnp.inf)
+            gain = jnp.where(fm[:, :, None, None], gain, -jnp.inf)
 
-        flat = gain.reshape(n_nodes, F * B)
+        flat = gain.reshape(n_nodes, F * B * 2)
         best = jnp.argmax(flat, axis=1)
         best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
         ok = jnp.isfinite(best_gain)
-        f_lvl = jnp.where(ok, (best // B).astype(jnp.int32), 0)
-        t_lvl = jnp.where(ok, (best % B).astype(jnp.int32), B - 1)
+        f_lvl = jnp.where(ok, (best // (B * 2)).astype(jnp.int32), 0)
+        t_lvl = jnp.where(ok, ((best // 2) % B).astype(jnp.int32), B - 1)
+        m_lvl = jnp.where(ok, (best % 2).astype(jnp.int32), 0)
         feats.append(f_lvl)
         threshs.append(t_lvl)
-        last = (GL, HL, CL, Gt, Ht, Ct, f_lvl, t_lvl)
+        misses.append(m_lvl)
+        last = (GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, f_lvl, t_lvl, m_lvl)
 
         if use_matmul:
-            node = _route_level_matmul(Xb, node, f_lvl, t_lvl, n_nodes)
+            node = _route_level_matmul(Xb, node, f_lvl, t_lvl, m_lvl,
+                                       n_nodes)
         else:
             xb = Xb[rows, f_lvl[node]]
-            node = 2 * node + (xb > t_lvl[node]).astype(jnp.int32)
+            right = (xb > t_lvl[node]) | ((xb == 0) & (m_lvl[node] > 0))
+            node = 2 * node + right.astype(jnp.int32)
 
     # -- leaves -------------------------------------------------------------
     # Leaf sums come for free from the LAST level's cumulative histograms:
@@ -496,12 +547,15 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
         Hl = H.sum()[None]
         Cl = count_unit.sum()[None]
     else:
-        GL, HL, CL, Gt, Ht, Ct, f_lvl, t_lvl = last
+        GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, f_lvl, t_lvl, m_lvl = last
         n_nodes = n_leaves // 2
         nid = jnp.arange(n_nodes)
-        Gleft = GL[nid, f_lvl, t_lvl, :]                         # [n, K]
-        Hleft = HL[nid, f_lvl, t_lvl]                            # [n]
-        Cleft = CL[nid, f_lvl, t_lvl]
+        # default-right splits move the missing-bin mass out of the prefix
+        mr = m_lvl.astype(jnp.float32)
+        Gleft = (GL[nid, f_lvl, t_lvl, :]
+                 - mr[:, None] * Gm[nid, f_lvl, :])              # [n, K]
+        Hleft = HL[nid, f_lvl, t_lvl] - mr * Hm[nid, f_lvl]      # [n]
+        Cleft = CL[nid, f_lvl, t_lvl] - mr * Cm[nid, f_lvl]
         Gl = _interleave(Gleft, Gt - Gleft, n_leaves)
         Hl = _interleave(Hleft, Ht - Hleft, n_leaves)
         Cl = _interleave(Cleft, Ct - Cleft, n_leaves)
@@ -515,7 +569,7 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
     # into an empty (min_instances=0) child
     leaf = jnp.where(Cl[:, None] >= 0.5, leaf, 0.0)
     return Tree(jnp.concatenate(feats), jnp.concatenate(threshs),
-                learning_rate * leaf)
+                learning_rate * leaf, jnp.concatenate(misses))
 
 
 def predict_bins(tree: Tree, Xb: jax.Array, depth: int) -> jax.Array:
@@ -532,7 +586,9 @@ def predict_bins(tree: Tree, Xb: jax.Array, depth: int) -> jax.Array:
             idx = (1 << d) - 1 + rel
             f = tree.feat[idx]
             t = tree.thresh[idx]
-            rel = 2 * rel + (Xb[rows, f] > t).astype(jnp.int32)
+            xb = Xb[rows, f]
+            right = (xb > t) | ((xb == 0) & (tree.miss[idx] > 0))
+            rel = 2 * rel + right.astype(jnp.int32)
         return tree.leaf[rel]
     return _predict_bins_matmul(tree, Xb, depth)
 
@@ -549,7 +605,8 @@ def _predict_bins_matmul(tree: Tree, Xb: jax.Array, depth: int) -> jax.Array:
         for d in range(depth):
             lo = (1 << d) - 1
             rel = _onehot_route_step(xf, rel, tree.feat[lo: lo + (1 << d)],
-                                     tree.thresh[lo: lo + (1 << d)], 1 << d)
+                                     tree.thresh[lo: lo + (1 << d)],
+                                     tree.miss[lo: lo + (1 << d)], 1 << d)
         leaf_oh = jax.nn.one_hot(rel, n_leaves, dtype=jnp.float32)
         return jnp.matmul(leaf_oh, tree.leaf.astype(jnp.float32),
                           preferred_element_type=jnp.float32)   # [c, K]
@@ -734,13 +791,17 @@ _register_pallas_consumers()
 
 def np_predict_ensemble(feat: np.ndarray, thresh_val: np.ndarray,
                         leaf: np.ndarray, X: np.ndarray,
-                        depth: int) -> np.ndarray:
+                        depth: int,
+                        miss: Optional[np.ndarray] = None) -> np.ndarray:
     """Vectorized numpy traversal on RAW feature values.
 
-    feat/thresh_val: [T, 2^depth - 1] (thresh in raw units, go right iff
-    x >= thresh, +inf = all-left); leaf: [T, 2^depth, K]; X: [N, F]. Returns
-    per-tree payload sum [N, K] — this is the Spark-free "local scoring" path
-    (reference local/.../OpWorkflowModelLocal.scala:93), no JAX required.
+    feat/thresh_val: [T, 2^depth - 1] (thresh in raw units; present values
+    go right iff x >= thresh, +inf = all-left, -inf = all-present-right);
+    miss: [T, 2^depth - 1] 0/1 learned default direction for NaN rows
+    (None = all default-left, the pre-miss serialization); leaf:
+    [T, 2^depth, K]; X: [N, F]. Returns per-tree payload sum [N, K] — this
+    is the Spark-free "local scoring" path (reference
+    local/.../OpWorkflowModelLocal.scala:93), no JAX required.
     """
     N = X.shape[0]
     T = feat.shape[0]
@@ -751,5 +812,9 @@ def np_predict_ensemble(feat: np.ndarray, thresh_val: np.ndarray,
         f = feat[t_idx, gi]                    # [N, T]
         tv = thresh_val[t_idx, gi]
         x = X[np.arange(N)[:, None], f]
-        rel = 2 * rel + (x >= tv)
+        nan = np.isnan(x)
+        right = ~nan & (x >= tv)               # NaN compares False
+        if miss is not None:
+            right |= nan & (miss[t_idx, gi] > 0)
+        rel = 2 * rel + right
     return leaf[t_idx, rel].sum(axis=1)        # [N, K]
