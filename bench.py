@@ -612,12 +612,17 @@ def wide_transmogrify(n):
 # measured — host transform throughput / end-to-end capability — is the
 # same) with hard timeouts so no phase can starve the headline metric.
 
-def run_subprocess_phase(args, timeout_s):
+def run_subprocess_phase(args, timeout_s, compile_cache=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # keep the axon sitecustomize off the child's path (it dials the TPU
     # tunnel at interpreter start — round-1 hang)
     env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+    # cold numbers must stay cold across bench reruns: the user-level
+    # persistent compile cache would warm them invisibly, so each phase
+    # gets an explicit cache dir ("0" disables; a per-run temp dir makes
+    # a controlled cold -> warm pair)
+    env["TMOG_COMPILE_CACHE"] = compile_cache or "0"
     r = subprocess.run([sys.executable, os.path.abspath(__file__)] + args,
                        capture_output=True, text=True, timeout=timeout_s,
                        env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
@@ -773,18 +778,35 @@ def main():
     except Exception as e:
         errors.append(f"wide: {type(e).__name__}: {str(e)[:200]}")
     persist_partial("wide_transmogrify")
+    import tempfile
+    cache_dir = tempfile.mkdtemp(prefix="bench_xla_cache_")
     for key, mod in (("titanic_s", "op_titanic_simple"),
                      ("iris_s", "op_iris"), ("boston_s", "op_boston")):
         try:
             if remaining() > 90:
                 configs[key] = run_subprocess_phase(
-                    ["--example", mod], min(remaining() - 40, 240))["s"]
+                    ["--example", mod], min(remaining() - 40, 240),
+                    compile_cache=cache_dir)["s"]
                 log(f"{mod}: {configs[key]}s")
             else:
                 errors.append(f"{mod} skipped: budget")
         except Exception as e:
             errors.append(f"{mod}: {type(e).__name__}: {str(e)[:200]}")
         persist_partial(f"example_{key}")
+    # cold-vs-warm XLA-compile-cache effect: a SECOND cold process of the
+    # same example pays tracing but loads compiles from the per-run cache
+    # dir the first run just populated (a controlled pair — the user-level
+    # cache is excluded from both)
+    try:
+        if "titanic_s" in configs and remaining() > 90:
+            configs["titanic_s_cached_process"] = run_subprocess_phase(
+                ["--example", "op_titanic_simple"],
+                min(remaining() - 40, 240), compile_cache=cache_dir)["s"]
+            log(f"titanic cached-process: "
+                f"{configs['titanic_s_cached_process']}s")
+    except Exception as e:
+        errors.append(f"titanic warm: {type(e).__name__}: {str(e)[:200]}")
+    persist_partial("example_warm")
 
     if not errors:
         RESULT.pop("errors", None)

