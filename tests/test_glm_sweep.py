@@ -90,6 +90,41 @@ class TestKernelParity:
             assert np.isfinite(np.asarray(B)).all()
             assert np.isfinite(np.asarray(b0)).all()
 
+    def test_streamed_tiled_wide_matches_per_lane(self):
+        """Feature-tiled Gram path (d > TRI_MAX_D): same Newton math at
+        tile-pair granularity, so wide transmogrified matrices (the r2
+        wide bench is d=567) use the one-pass kernel too. Parity vs the
+        per-lane logistic solver at d=600 (tiled, non-multiple of the
+        64-tile so column padding is exercised)."""
+        from transmogrifai_tpu.ops.glm_sweep import TRI_MAX_D
+        rng = np.random.default_rng(11)
+        n, d = 1200, 600
+        assert d > TRI_MAX_D
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        beta = np.zeros(d, np.float32)
+        beta[:10] = np.linspace(1.0, -1.0, 10)
+        p = 1 / (1 + np.exp(-(X @ beta)))
+        y = (rng.uniform(size=n) < p).astype(np.float32)
+        masks = _masks(y, folds=2)
+        w = np.ones_like(y)
+        regs = np.array([0.01, 0.3], np.float32)
+        alphas = np.zeros(2, np.float32)
+        B, b0 = sweep_glm_streamed(
+            jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+            jnp.asarray(masks), jnp.asarray(regs), jnp.asarray(alphas),
+            loss="logistic", max_iter=20, standardize=False)
+        B = np.asarray(B)
+        assert B.shape == (2, 2, d)
+        for f in range(2):
+            for g in range(2):
+                beta_ref, b0_ref = fit_logistic(
+                    jnp.asarray(X), jnp.asarray(y),
+                    jnp.asarray(masks[f] * w), jnp.asarray(regs[g]),
+                    jnp.asarray(0.0), max_iter=20, standardize=False)
+                assert np.allclose(B[f, g], np.asarray(beta_ref),
+                                   atol=5e-3), (f, g)
+                assert abs(float(b0[f, g]) - float(b0_ref)) < 5e-3
+
     def test_streamed_hinge_matches_per_lane_svc(self):
         """Streamed squared_hinge must reproduce fit_linear_svc per lane —
         same loss scaling (0.5*gap^2), so the same effective L2 for a

@@ -225,6 +225,13 @@ class Validator:
         """[F, n] float32 train-membership masks (1=train, 0=validation)."""
         raise NotImplementedError
 
+    def fold_masks_count(self) -> int:
+        """Number of fold masks without materializing them (route-choice
+        budget arithmetic). Subclasses MUST override alongside
+        fold_masks — guessing via attribute names would silently
+        understate the lane count in the budget guard."""
+        raise NotImplementedError
+
     def _assign_folds(self, y: np.ndarray, n_folds: int) -> np.ndarray:
         """Per-row fold id; stratified round-robin within each class when
         stratify is on (reference prepareStratification:203)."""
@@ -312,18 +319,22 @@ class Validator:
                     problem_type: str, X) -> bool:
         """Large binary/regression GLM sweeps route through the streaming
         lane-batched kernel (ops/glm_sweep.py) — under a mesh, its
-        shard_map variant (per-shard row scans, psum'd accumulators). Wide
-        matrices stay vmapped: the streamed kernel's per-block compressed
-        outer-product buffer scales O(_ROW_BLOCK * d^2 / 2) and would blow
-        HBM past ~128 features (the vmapped path's HBM-budget chunker
-        handles those)."""
+        shard_map variant (per-shard row scans, psum'd accumulators).
+        Past TRI_MAX_D features the kernel switches internally to
+        feature-tiled Gram accumulation, so width no longer excludes the
+        route; the remaining guard is the per-iteration [L, d, d]
+        Hessian-assembly + batched-solve footprint against the sweep HBM
+        budget (lanes L = folds x grid points)."""
         if getattr(est, "streamed_loss", None) is None:
             return False
         if problem_type not in ("binary", "regression"):
             return False
         if X.shape[0] < STREAMED_SWEEP_MIN_ROWS:
             return False
-        if X.shape[1] > 128:
+        from ...ops.glm_sweep import streamed_route_ok
+        lanes = self.fold_masks_count() * max(len(grids), 1)
+        if not streamed_route_ok(X.shape[1], lanes,
+                                 SWEEP_LANE_BUDGET_BYTES):
             return False
         _, axes = est.batched_fit_fn()
         return self._constant_off_axis(est, grids, axes)
@@ -689,6 +700,9 @@ class CrossValidation(Validator):
             masks[f, fold_of == f] = 0.0
         return masks
 
+    def fold_masks_count(self) -> int:
+        return self.num_folds
+
 
 class TrainValidationSplit(Validator):
     """Single split (reference OpTrainValidationSplit.scala:34;
@@ -718,3 +732,6 @@ class TrainValidationSplit(Validator):
             n_val = int(round(n * (1.0 - self.train_ratio)))
             mask[0, perm[:n_val]] = 0.0
         return mask
+
+    def fold_masks_count(self) -> int:
+        return 1

@@ -58,6 +58,43 @@ EPS = 1e-12
 # at d=64 -> 256MB) and the [c, L] residual/curvature blocks.
 _ROW_BLOCK = 32_768
 
+# Widest matrix the single compressed-triangle pass handles: past this the
+# [c, d(d+1)/2] pair-product block outgrows HBM and the kernel switches to
+# the feature-tiled accumulation (same math, tile-pair granularity).
+TRI_MAX_D = 128
+
+# Feature-tile edge for the wide path: each scan step materializes one
+# [c, TILE^2] pair-product block per tile pair. 64 keeps MXU tiles square
+# and the transient at c * 16K floats.
+_FEATURE_TILE = 64
+
+# Rows per scan block on the wide path — c * TILE^2 * 4B = 64MB at 4096.
+_ROW_BLOCK_WIDE = 4_096
+
+# Graph-size ceiling for the tiled path: the tile-pair loop is a Python
+# unroll inside the scan body inside the Newton while_loop, so pairs
+# multiply XLA graph size. 406 pairs = d_pad 1792 (28 tiles) — far past
+# any transmogrified width seen in practice, well before compile blowup.
+_MAX_TILE_PAIRS = 406
+
+
+def streamed_route_ok(d: int, lanes: int, budget_bytes: float) -> bool:
+    """Can the streamed kernel take a (d features, lanes) sweep within
+    `budget_bytes` of device memory? Owns the kernel's own padding and
+    graph-size policy so route guards (validators._streamable) cannot
+    drift from it: per-iteration footprint is the assembled [L, d, d]
+    Hessian + LU workspace + tile accumulators (~4x), and the tiled
+    path's Python-unrolled tile-pair loop is capped before XLA graph
+    size explodes."""
+    if d <= TRI_MAX_D:
+        d_work = d
+    else:
+        nt = -(-d // _FEATURE_TILE)
+        if nt * (nt + 1) // 2 > _MAX_TILE_PAIRS:
+            return False
+        d_work = nt * _FEATURE_TILE
+    return lanes * d_work * d_work * 4.0 * 4.0 <= budget_bytes
+
 
 @functools.lru_cache(maxsize=None)
 def _tri_maps(d: int):
@@ -110,8 +147,22 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
     Gn = regs.shape[0]
     L = F * Gn
     rc = _residual_curvature(loss)
-    iu0, iu1, expand = _tri_maps(d)
-    T = iu0.shape[0]
+    tiled = d > TRI_MAX_D
+    if tiled:
+        bt = _FEATURE_TILE
+        nt = -(-d // bt)
+        d_pad = nt * bt
+        if d_pad > d:
+            # zero columns are inert end to end: mean 0 -> centered 0,
+            # grad 0, H diagonal = l2 + 1e-6 ridge -> Newton step 0, so
+            # padded betas stay exactly 0 and are sliced off on return
+            X = jnp.pad(X, ((0, 0), (0, d_pad - d)))
+        tile_pairs = [(a, b) for a in range(nt) for b in range(a, nt)]
+        d_work = d_pad
+    else:
+        iu0, iu1, expand = _tri_maps(d)
+        T = iu0.shape[0]
+        d_work = d
 
     def allreduce(v):
         return jax.lax.psum(v, axis_name) if axis_name else v
@@ -137,8 +188,8 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
                 .astype(X.dtype)
     else:
         Xs = X
-        mean = jnp.zeros(d, jnp.float32)
-        std = jnp.ones(d, jnp.float32)
+        mean = jnp.zeros(d_work, jnp.float32)
+        std = jnp.ones(d_work, jnp.float32)
 
     # lane layout: l = f * Gn + g  (fold-major, so per-fold weights expand
     # by broadcast over the grid axis)
@@ -149,7 +200,7 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
     wsum_l = jnp.repeat(wsum_f, Gn)                     # [L]
 
     # pad local rows to the block multiple with w=0 (inert everywhere)
-    c = min(_ROW_BLOCK, n)
+    c = min(_ROW_BLOCK_WIDE if tiled else _ROW_BLOCK, n)
     nb = -(-n // c)
     pad = nb * c - n
     if pad:
@@ -157,13 +208,54 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
         y = jnp.pad(y, (0, pad))
         w = jnp.pad(w, (0, pad))
         fold_masks = jnp.pad(fold_masks, ((0, 0), (0, pad)))
-    xs = (Xs.reshape(nb, c, d), y.reshape(nb, c), w.reshape(nb, c),
+    xs = (Xs.reshape(nb, c, d_work), y.reshape(nb, c), w.reshape(nb, c),
           fold_masks.reshape(F, nb, c).transpose(1, 0, 2))
 
-    eye = jnp.eye(d, dtype=jnp.float32)
+    eye = jnp.eye(d_work, dtype=jnp.float32)
+
+    def _hessian_blocks_tri(xf, S):
+        """Compressed-triangle contribution [L, T] for one row block."""
+        xx = xf[:, iu0] * xf[:, iu1]                    # [c, T]
+        return jnp.matmul(S.T, xx, preferred_element_type=jnp.float32)
+
+    def _hessian_blocks_tiled(xf, S):
+        """Tile-pair contributions [npairs, L, bt*bt] for one row block —
+        the wide-d path: each pair materializes only a [c, bt^2] product
+        (the [c, d(d+1)/2] full triangle would outgrow HBM past ~128
+        features); off-diagonal tile pairs are computed once and mirrored
+        at assembly, keeping the triangle savings at tile granularity."""
+        out = []
+        for a, b in tile_pairs:
+            xa = xf[:, a * bt:(a + 1) * bt]
+            xb = xf[:, b * bt:(b + 1) * bt]
+            P = (xa[:, :, None] * xb[:, None, :]).reshape(-1, bt * bt)
+            out.append(jnp.matmul(S.T, P,
+                                  preferred_element_type=jnp.float32))
+        return jnp.stack(out)
+
+    def _assemble_tri(hA):
+        return hA[:, expand].reshape(L, d_work, d_work)
+
+    def _assemble_tiled(hA):
+        H = jnp.zeros((L, d_work, d_work), jnp.float32)
+        for p, (a, b) in enumerate(tile_pairs):
+            blk = hA[p].reshape(L, bt, bt)
+            H = H.at[:, a * bt:(a + 1) * bt, b * bt:(b + 1) * bt].set(blk)
+            if a != b:
+                H = H.at[:, b * bt:(b + 1) * bt,
+                         a * bt:(a + 1) * bt].set(
+                             blk.transpose(0, 2, 1))
+        return H
+
+    if tiled:
+        hess_blocks, assemble = _hessian_blocks_tiled, _assemble_tiled
+        h_acc0 = jnp.zeros((len(tile_pairs), L, bt * bt), jnp.float32)
+    else:
+        hess_blocks, assemble = _hessian_blocks_tri, _assemble_tri
+        h_acc0 = jnp.zeros((L, T), jnp.float32)
 
     def accumulate(B, b0):
-        """One streaming pass: per-lane (g [L,d], H_tri [L,T], g0, h0)."""
+        """One streaming pass: per-lane (g [L,d], Hessian blocks, g0, h0)."""
         Bt = B.T.astype(Xs.dtype)                       # [d, L]
 
         def body(acc, sl):
@@ -177,14 +269,12 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
             R = r0 * wl
             S = s0 * wl
             xf = x_blk.astype(jnp.float32)
-            xx = xf[:, iu0] * xf[:, iu1]                # [c, T]
             gA = gA + jnp.matmul(xf.T, R,
                                  preferred_element_type=jnp.float32).T
-            hA = hA + jnp.matmul(S.T, xx,
-                                 preferred_element_type=jnp.float32)
+            hA = hA + hess_blocks(xf, S)
             return (gA, hA, g0A + R.sum(0), h0A + S.sum(0)), None
 
-        acc0 = (jnp.zeros((L, d), jnp.float32), jnp.zeros((L, T), jnp.float32),
+        acc0 = (jnp.zeros((L, d_work), jnp.float32), h_acc0,
                 jnp.zeros(L, jnp.float32), jnp.zeros(L, jnp.float32))
         if axis_name is not None:
             # under shard_map's varying-manual-axes tracking the carry
@@ -209,7 +299,7 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
         i, B, b0, _ = state
         gA, hA, g0A, h0A = accumulate(B, b0)
         g = gA / wsum_l[:, None] + l2[:, None] * B                  # [L, d]
-        H = hA[:, expand].reshape(L, d, d) / wsum_l[:, None, None]
+        H = assemble(hA) / wsum_l[:, None, None]
         H = H + (l2[:, None, None] + 1e-6) * eye[None]
         step = jnp.linalg.solve(H, g[..., None])[..., 0]
         B_new = B - step
@@ -224,13 +314,14 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
                  + jnp.abs(b0_new - b0)).max()
         return i + 1, B_new, b0_new, delta
 
-    state = (jnp.asarray(0, jnp.int32), jnp.zeros((L, d), jnp.float32),
+    state = (jnp.asarray(0, jnp.int32), jnp.zeros((L, d_work), jnp.float32),
              jnp.zeros(L, jnp.float32), jnp.asarray(jnp.inf, jnp.float32))
     _, B, b0, _ = jax.lax.while_loop(cond, body, state)
 
     if standardize:
         B = B / std[None, :]
         b0 = b0 - (B * mean[None, :]).sum(1)
+    B = B[:, :d]  # drop inert padded columns on the tiled path
     return B.reshape(F, Gn, d), b0.reshape(F, Gn)
 
 
