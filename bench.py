@@ -551,10 +551,15 @@ def wide_transmogrify(n):
     scored = model.score(ds)
     score_cold_s = time.perf_counter() - t0
     # serving throughput is a warm-path number: the cold pass pays one-time
-    # page-fault/allocator costs for the [n, width] output blocks
-    t0 = time.perf_counter()
-    scored = model.score(ds)
-    score_s = time.perf_counter() - t0
+    # page-fault/allocator costs for the [n, width] output blocks. Best of
+    # 3 passes: single-shot timings on a contended 1-core box swing +-30%
+    # (the r2 driver artifact recorded a noise spike as the result).
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        scored = model.score(ds)
+        times.append(time.perf_counter() - t0)
+    score_s = min(times)
     width = scored.column(vec.name).data.shape[1]
 
     # reference-shaped baseline: per-row python closure loop (the fused
@@ -566,36 +571,43 @@ def wide_transmogrify(n):
     import math
     vocab_a = {c: i for i, c in enumerate(sorted(set(cols["plA"])))}
     vocab_b = {c: i for i, c in enumerate(sorted(set(cols["plB"])))}
-    t0 = time.perf_counter()
-    cap = min(120.0, max(remaining() - 60.0, 10.0))
-    done = 0
     two_pi = 2 * math.pi
-    for i in range(n):
-        row = []
-        oh = [0.0] * (len(vocab_a) + 2)  # topK + OTHER + null
-        oh[vocab_a.get(cols["plA"][i], len(vocab_a))] = 1.0
-        row += oh
-        oh = [0.0] * (len(vocab_b) + 2)
-        oh[vocab_b.get(cols["plB"][i], len(vocab_b))] = 1.0
-        row += oh
-        toks = cols["txt"][i].lower().split()
-        hv = [0.0] * 512  # TransmogrifierDefaults.DefaultNumOfFeatures
-        for t in toks:
-            hv[hash(t) % 512] += 1.0
-        row += hv
-        row += [cols["r1"][i], 0.0]
-        v = cols["r2"][i]
-        isnan = v != v
-        row += [0.0 if isnan else v, 1.0 if isnan else 0.0]
-        ts = cols["dt"][i] / 86_400_000.0
-        for period in (1.0, 7.0, 30.4375, 365.25):
-            row += [math.sin(two_pi * ts / period),
-                    math.cos(two_pi * ts / period)]
-        row += [cols["m1"][i], 0.0, cols["m2"][i], 0.0]
-        done = i + 1
-        if (i & 1023) == 0 and time.perf_counter() - t0 > cap:
-            break
-    loop_s = (time.perf_counter() - t0) * (n / done)
+
+    def row_loop_pass(cap):
+        t0 = time.perf_counter()
+        done = 0
+        for i in range(n):
+            row = []
+            oh = [0.0] * (len(vocab_a) + 2)  # topK + OTHER + null
+            oh[vocab_a.get(cols["plA"][i], len(vocab_a))] = 1.0
+            row += oh
+            oh = [0.0] * (len(vocab_b) + 2)
+            oh[vocab_b.get(cols["plB"][i], len(vocab_b))] = 1.0
+            row += oh
+            toks = cols["txt"][i].lower().split()
+            hv = [0.0] * 512  # TransmogrifierDefaults.DefaultNumOfFeatures
+            for t in toks:
+                hv[hash(t) % 512] += 1.0
+            row += hv
+            row += [cols["r1"][i], 0.0]
+            v = cols["r2"][i]
+            isnan = v != v
+            row += [0.0 if isnan else v, 1.0 if isnan else 0.0]
+            ts = cols["dt"][i] / 86_400_000.0
+            for period in (1.0, 7.0, 30.4375, 365.25):
+                row += [math.sin(two_pi * ts / period),
+                        math.cos(two_pi * ts / period)]
+            row += [cols["m1"][i], 0.0, cols["m2"][i], 0.0]
+            done = i + 1
+            if (i & 1023) == 0 and time.perf_counter() - t0 > cap:
+                break
+        return (time.perf_counter() - t0) * (n / done), done
+
+    # best of 2 passes, same contention-noise defense as score_s (the
+    # baseline must not be inflated by a noise spike either)
+    cap = min(120.0, max(remaining() - 60.0, 10.0)) / 2
+    (l1, d1), (l2, d2) = row_loop_pass(cap), row_loop_pass(cap)
+    loop_s, done = ((l1, d1) if l1 <= l2 else (l2, d2))
     return dict(rows=n, fit_s=round(fit_s, 3), score_s=round(score_s, 3),
                 score_cold_s=round(score_cold_s, 3),
                 vector_width=int(width),

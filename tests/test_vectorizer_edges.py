@@ -128,3 +128,22 @@ class TestMapEdges:
         # unseen key 'c' is ignored; fitted keys impute with their fill
         names = out.metadata.column_names()
         assert not any(n.endswith("_c") for n in names)
+
+
+def test_pivot_mixed_type_values_stringify_independently():
+    """1, True and 1.0 are ==/same-hash but stringify differently; the
+    serving pivot's memo must not collapse them to one indicator column
+    (str(v) semantics, matching the fit-time vocab counting)."""
+    import numpy as np
+    from transmogrifai_tpu.automl.vectorizers.encoding import (
+        pivot_block_single,
+    )
+    out = pivot_block_single([1, True, 1.0, None, "zzz"],
+                             ["1", "True", "1.0"], True, lambda s: s)
+    exp = np.zeros((5, 5), np.float32)
+    exp[0, 0] = 1  # 1 -> "1"
+    exp[1, 1] = 1  # True -> "True"
+    exp[2, 2] = 1  # 1.0 -> "1.0"
+    exp[3, 4] = 1  # None -> null column
+    exp[4, 3] = 1  # unseen -> OTHER
+    np.testing.assert_array_equal(out, exp)

@@ -67,21 +67,42 @@ def pivot_codes(uniq: np.ndarray, vocab_index: Dict[str, int], other_code: int,
 def pivot_block_single(data: Sequence[Any], vocab: Sequence[str],
                        track_nulls: bool, clean_fn) -> np.ndarray:
     """One-hot pivot of a scalar categorical column: [n, K+1(+1)] with
-    topK indicators, OTHER, and optionally a null column. Vectorized."""
+    topK indicators, OTHER, and optionally a null column.
+
+    Serving hot path (the fused row-map slot, FitStagesUtil.scala:96):
+    ONE python pass with a memoized raw-value -> column lookup instead of
+    the earlier stringify + null-scan + dictionary-encode passes —
+    categorical cardinality is tiny next to n, so every row after the
+    first sighting of a value is a single dict hit."""
     n = len(data)
     k = len(vocab)
     width = k + 1 + (1 if track_nulls else 0)
     block = np.zeros((n, width), dtype=np.float32)
     if n == 0:
         return block
-    uniq, inv, nm = factorize(data)
     index = {v: i for i, v in enumerate(vocab)}
-    codes = pivot_codes(uniq, index, k, clean_fn)[inv]
-    rows = np.arange(n)
-    present = ~nm
-    block[rows[present], codes[present]] = 1.0
-    if track_nulls:
-        block[nm, k + 1] = 1.0
+    null_code = k + 1 if track_nulls else -1
+    memo: Dict[Any, int] = {}
+
+    def code_of(v):
+        if v is None:
+            return null_code
+        # memo keys carry the type: 1, 1.0 and True are ==/same-hash but
+        # stringify differently, and the pivot must see str(v) semantics
+        mk = (v.__class__, v)
+        try:
+            c = memo.get(mk)
+        except TypeError:  # unhashable oddball: stringify, no memo
+            return index.get(clean_fn(str(v)), k)
+        if c is None:
+            s = v if type(v) is str else str(v)
+            c = index.get(clean_fn(s), k)
+            memo[mk] = c
+        return c
+
+    codes = np.fromiter(map(code_of, data), np.int64, n)
+    keep = codes >= 0
+    block[np.arange(n)[keep], codes[keep]] = 1.0
     return block
 
 

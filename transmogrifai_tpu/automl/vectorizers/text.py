@@ -36,10 +36,12 @@ def tokenize(s: Optional[str], to_lowercase: bool = True,
 
 
 def tokenize_hash_counts(docs: Sequence[Optional[str]], bins: int,
-                         seed: int = 0) -> np.ndarray:
-    """Documents -> [n, bins] hashed token counts: the whole text->tensor
-    loop in ONE native pass when the C++ library is built, else a python
-    tokenize + (native or numpy) hashing fallback.
+                         seed: int = 0, pad_cols: int = 0) -> np.ndarray:
+    """Documents -> [n, bins + pad_cols] hashed token counts: the whole
+    text->tensor loop in ONE native pass when the C++ library is built,
+    else a python tokenize + (native or numpy) hashing fallback.
+    `pad_cols` appends zero columns for in-place indicator writes (the
+    serving path's null tracker) without a second full-matrix copy.
 
     The C++ tokenizer is byte-level ASCII; it only takes over when every
     document isascii(), where it is token-for-token identical to the
@@ -48,12 +50,19 @@ def tokenize_hash_counts(docs: Sequence[Optional[str]], bins: int,
         try:
             from ...ops.native_bridge import native_tokenize_hash_counts
             out = native_tokenize_hash_counts(docs, bins, seed=seed,
-                                              min_len=MIN_TOKEN_LENGTH)
+                                              min_len=MIN_TOKEN_LENGTH,
+                                              pad_cols=pad_cols)
             if out is not None:
                 return out
         except ImportError:
             pass
-    return hash_tokens_to_counts([tokenize(d) for d in docs], bins, seed=seed)
+    counts = hash_tokens_to_counts([tokenize(d) for d in docs], bins,
+                                   seed=seed)
+    if pad_cols:
+        out = np.zeros((counts.shape[0], bins + pad_cols), np.float32)
+        out[:, :bins] = counts
+        return out
+    return counts
 
 
 class SmartTextModel(VectorizerModel):
@@ -77,16 +86,16 @@ class SmartTextModel(VectorizerModel):
                     data, plan["vocab"], track,
                     lambda s: clean_text_value(s, clean))
             else:  # hash
-                counts = tokenize_hash_counts(data, plan["bins"])
                 if track:
-                    # preallocate f32 and slice-assign: at 512 bins the
-                    # f64-concat alternative copies ~8 bytes/cell twice
-                    block = np.empty((counts.shape[0], counts.shape[1] + 1),
-                                     np.float32)
-                    block[:, :-1] = counts
+                    # counts land directly in a [n, bins+1] matrix (the
+                    # native kernel writes with the wider row stride) and
+                    # the null indicator fills the trailing column in
+                    # place — no second full-matrix copy on serving
+                    block = tokenize_hash_counts(data, plan["bins"],
+                                                 pad_cols=1)
                     block[:, -1] = null_mask(data)
                 else:
-                    block = counts
+                    block = tokenize_hash_counts(data, plan["bins"])
             blocks.append(np.asarray(block, np.float32))
         if len(blocks) == 1:
             return blocks[0]

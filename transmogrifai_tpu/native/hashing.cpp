@@ -106,12 +106,16 @@ void tmog_hash_tokens_to_counts(const uint8_t* buf, const int64_t* tok_offsets,
 // tokens are maximal runs of [A-Za-z0-9'], lowercased, len >= min_len.
 // docs packed in buf with [n_docs+1] offsets; out: [n_docs * bins] float32,
 // caller-zeroed. This is the whole text->tensor hot loop in one pass.
-void tmog_tokenize_hash_counts(const uint8_t* buf, const int64_t* doc_offsets,
+// row_stride >= bins lets the caller write counts directly into a wider
+// matrix (e.g. [n, bins+1] with a trailing null-indicator column) without
+// a second full-size copy on the serving path.
+void tmog_tokenize_hash_counts_s(const uint8_t* buf, const int64_t* doc_offsets,
                                int64_t n_docs, int64_t bins, uint32_t seed,
-                               int64_t min_len, float* out) {
+                               int64_t min_len, int64_t row_stride,
+                               float* out) {
   uint8_t tok[256];
   for (int64_t d = 0; d < n_docs; d++) {
-    float* row = out + d * bins;
+    float* row = out + d * row_stride;
     const uint8_t* p = buf + doc_offsets[d];
     const uint8_t* end = buf + doc_offsets[d + 1];
     int64_t tlen = 0;

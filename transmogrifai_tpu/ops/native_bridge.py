@@ -74,10 +74,15 @@ def _bind(lib, u8p, i64p, f64p, f32p, u32p) -> ctypes.CDLL:
     lib.tmog_hash_tokens_to_counts.argtypes = [
         u8p, i64p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint32,
         f32p]
-    lib.tmog_tokenize_hash_counts.restype = None
-    lib.tmog_tokenize_hash_counts.argtypes = [
+    # _s suffix = strided-output ABI (row_stride arg). The rename is
+    # deliberate: changing the original symbol's signature in place would
+    # let a stale prebuilt .so bind successfully and then read the output
+    # pointer from the wrong stack slot; a NEW symbol makes staleness an
+    # AttributeError the rebuild fallback handles.
+    lib.tmog_tokenize_hash_counts_s.restype = None
+    lib.tmog_tokenize_hash_counts_s.argtypes = [
         u8p, i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint32,
-        ctypes.c_int64, f32p]
+        ctypes.c_int64, ctypes.c_int64, f32p]
     lib.tmog_csv_scan.restype = ctypes.c_int64
     lib.tmog_csv_scan.argtypes = [u8p, ctypes.c_int64, ctypes.c_uint8,
                                   i64p, ctypes.c_int64, i64p, ctypes.c_int64,
@@ -167,16 +172,21 @@ def native_hash_tokens(token_lists: Sequence[Optional[Sequence[str]]],
 
 
 def native_tokenize_hash_counts(docs: Sequence[Optional[str]], num_bins: int,
-                                seed: int = 0, min_len: int = 1
-                                ) -> Optional[np.ndarray]:
-    """Fused tokenize+hash+count over raw documents -> [n, bins] float32."""
+                                seed: int = 0, min_len: int = 1,
+                                pad_cols: int = 0) -> Optional[np.ndarray]:
+    """Fused tokenize+hash+count over raw documents ->
+    [n, bins + pad_cols] float32. `pad_cols` trailing zero columns let the
+    caller append indicators (null tracking) in place — the C kernel
+    writes with the wider row stride, so no second full-matrix copy."""
     lib = _load()
     if lib is None:
         return None
     buf, offsets = _pack_strings([d or "" for d in docs])
-    out = np.zeros((len(docs), num_bins), np.float32)
-    lib.tmog_tokenize_hash_counts(_as_u8p(buf), _as_i64p(offsets), len(docs),
-                                  num_bins, seed, min_len, _as_f32p(out))
+    stride = num_bins + int(pad_cols)
+    out = np.zeros((len(docs), stride), np.float32)
+    lib.tmog_tokenize_hash_counts_s(_as_u8p(buf), _as_i64p(offsets), len(docs),
+                                  num_bins, seed, min_len, stride,
+                                  _as_f32p(out))
     return out
 
 
