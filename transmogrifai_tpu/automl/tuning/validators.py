@@ -625,7 +625,11 @@ class Validator:
             for gi in pending:
                 groups.setdefault(bins_of(gi), []).append(gi)
             for _, group in sorted(groups.items(), key=lambda kv: str(kv[0])):
-                ctx = est.copy(**grids[group[0]]).mask_sweep_context(Xd)
+                # n_valid: mesh runs pad rows (repeat-last) — the quantile
+                # sketch must see only the real rows so mesh and meshless
+                # sweeps grow from identical bin edges
+                ctx = est.copy(**grids[group[0]]).mask_sweep_context(
+                    Xd, n_valid=X.shape[0])
                 for gi in group:
                     est_g = est.copy(**grids[gi])
                     scores = est_g.mask_fit_scores(

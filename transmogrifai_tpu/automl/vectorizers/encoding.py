@@ -87,6 +87,11 @@ def pivot_block_single(data: Sequence[Any], vocab: Sequence[str],
     def code_of(v):
         if v is None:
             return null_code
+        if v != v:  # NaN: every instance misses a (cls, v) memo ((nan !=
+            # nan) and they share hash 0) — memoizing would grow the dict
+            # one entry per NaN row with full-chain probes; resolve
+            # directly like the old factorize dedup did
+            return index.get(clean_fn(str(v)), k)
         # memo keys carry the type: 1, 1.0 and True are ==/same-hash but
         # stringify differently, and the pivot must see str(v) semantics
         mk = (v.__class__, v)

@@ -140,21 +140,30 @@ class _TreeEstimator(PredictorEstimator):
     # then come from the full feature columns (labels never participate).
     supports_mask_folds = True
 
-    def _bin(self, X):
+    def _bin(self, X, n_valid: int = None):
+        """(binned matrix, edges, n_bins).
+
+        Keeps X's dtype (bf16 sweeps stay bf16 — no full-size f32 copy;
+        quantile_edges casts only its row sample). NaN gets the dedicated
+        bin 0 and routes by each node's learned direction (Tree.miss) —
+        never folded into the value bins. `n_valid`: number of REAL rows
+        when the caller padded X to a mesh multiple
+        (validators._device_arrays repeats the last row) — the quantile
+        sketch uses only the real rows so mesh and meshless runs grow
+        from IDENTICAL bin edges; padded rows still bin (real values,
+        zero weight — inert in every histogram)."""
         n_bins = int(self.get_param("max_bins"))
-        # keep X's dtype (bf16 sweeps stay bf16 — no full-size f32 copy;
-        # quantile_edges casts only its row sample). NaN gets the
-        # dedicated bin 0 and routes by each node's learned direction
-        # (Tree.miss) — never folded into the value bins.
         Xd = jnp.asarray(X)
-        edges = T.quantile_edges(Xd, n_bins)
+        Xq = Xd if n_valid is None or n_valid >= Xd.shape[0] \
+            else Xd[:n_valid]
+        edges = T.quantile_edges(Xq, n_bins)
         Xb = T.bin_matrix(Xd, edges)
         return Xb, edges, n_bins
 
     # -- mask-fold sweep protocol ------------------------------------------
-    def mask_sweep_context(self, X):
+    def mask_sweep_context(self, X, n_valid: int = None):
         """Device-binned context shared by every (grid, fold) fit."""
-        return self._bin(X)
+        return self._bin(X, n_valid=n_valid)
 
     # Above this row count the fold axis stops being vmapped: XLA lays the
     # vmapped traversal's [folds, n] node-index arrays out fold-minor and
