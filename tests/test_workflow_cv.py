@@ -79,3 +79,26 @@ def test_workflow_cv_without_selector_is_noop():
         .set_result_features(vec).with_workflow_cv()
     model = wf.train()  # must not raise
     assert model.transform().n_rows == 400
+
+
+def test_workflow_cv_glm_takes_device_route():
+    """The inner (model x grid) sweep runs through the validator's device
+    paths — fold-masked vmapped lanes for GLM candidates, mask-fold trees
+    for the GBT — not a host fit_arrays loop (reference parallelism slot:
+    OpValidator.scala:318's 8-thread pool)."""
+    wf = _workflow(cv=True)
+    model = wf.train()
+    routes = getattr(wf, "_workflow_cv_routes", {})
+    assert routes, "workflow CV recorded no sweep routes"
+    summary = model.selector_summary()
+    wf_cv = [v for v in summary.validation_results if v.get("workflow_cv")]
+    by_model = {}
+    for key, route in routes.items():
+        mi, _ = key
+        by_model.setdefault(mi, set()).add(route)
+    # model 0 = OpLogisticRegression grids, model 1 = OpGBTClassifier
+    assert by_model[0] == {"vmapped"}, by_model
+    assert by_model[1] == {"mask_folds"}, by_model
+    # and the full sweep still covers every cell across 3 folds
+    assert len(wf_cv) == 3
+    assert all(len(v["fold_metrics"]) == 3 for v in wf_cv)

@@ -199,6 +199,7 @@ class Validator:
         self.parallelism = int(parallelism)
         # optional sweep checkpoint (resume skips finished model x grid cells)
         self.checkpoint_path: Optional[str] = None
+        self._external_mask_tag = ""  # set per validate() call
         # grid points swept per XLA call (None = auto from the HBM budget);
         # checkpoints land after every chunk, so a preempted vmapped sweep
         # resumes mid-grid
@@ -225,13 +226,6 @@ class Validator:
         """[F, n] float32 train-membership masks (1=train, 0=validation)."""
         raise NotImplementedError
 
-    def fold_masks_count(self) -> int:
-        """Number of fold masks without materializing them (route-choice
-        budget arithmetic). Subclasses MUST override alongside
-        fold_masks — guessing via attribute names would silently
-        understate the lane count in the budget guard."""
-        raise NotImplementedError
-
     def _assign_folds(self, y: np.ndarray, n_folds: int) -> np.ndarray:
         """Per-row fold id; stratified round-robin within each class when
         stratify is on (reference prepareStratification:203)."""
@@ -252,10 +246,24 @@ class Validator:
     def validate(self, models: Sequence[Tuple[PredictorEstimator, List[ParamMap]]],
                  X: np.ndarray, y: np.ndarray,
                  w: Optional[np.ndarray] = None,
-                 problem_type: str = "binary") -> BestEstimator:
+                 problem_type: str = "binary",
+                 masks: Optional[np.ndarray] = None) -> BestEstimator:
+        """`masks` overrides self.fold_masks(y) — the workflow-level CV
+        (leakage-free in-fold DAG refits, OpValidator.applyDAG:228) feeds
+        one fold-fitted matrix at a time with that fold's single mask, so
+        its inner (model x grid) sweep rides the same device routes."""
         if w is None:
             w = np.ones_like(y, np.float32)
-        masks = self.fold_masks(y)
+        if masks is None:
+            masks = self.fold_masks(y)
+            self._external_mask_tag = ""
+        else:
+            # checkpoint cells must be keyed by WHICH masks ran: external
+            # per-fold masks can share a data fingerprint across calls
+            import hashlib
+            self._external_mask_tag = hashlib.sha1(
+                np.ascontiguousarray(masks, np.float32).tobytes()
+            ).hexdigest()[:12]
         metric = self.evaluator.default_metric
         larger = self.evaluator.is_larger_better()
 
@@ -263,7 +271,8 @@ class Validator:
         for est, grids in models:
             if not grids:
                 grids = [dict()]
-            if self._streamable(est, grids, problem_type, X):
+            if self._streamable(est, grids, problem_type, X,
+                                masks.shape[0]):
                 validated.extend(self._validate_streamed(
                     est, grids, X, y, w, masks, metric, problem_type))
             elif self._vmappable(est, grids, problem_type):
@@ -316,7 +325,7 @@ class Validator:
         return Validator._constant_off_axis(est, grids, axes)
 
     def _streamable(self, est: PredictorEstimator, grids: List[ParamMap],
-                    problem_type: str, X) -> bool:
+                    problem_type: str, X, n_folds: int) -> bool:
         """Large binary/regression GLM sweeps route through the streaming
         lane-batched kernel (ops/glm_sweep.py) — under a mesh, its
         shard_map variant (per-shard row scans, psum'd accumulators).
@@ -332,7 +341,7 @@ class Validator:
         if X.shape[0] < STREAMED_SWEEP_MIN_ROWS:
             return False
         from ...ops.glm_sweep import streamed_route_ok
-        lanes = self.fold_masks_count() * max(len(grids), 1)
+        lanes = n_folds * max(len(grids), 1)
         if not streamed_route_ok(X.shape[1], lanes,
                                  SWEEP_LANE_BUDGET_BYTES):
             return False
@@ -403,7 +412,12 @@ class Validator:
     def _sweep_path(self, base: str) -> str:
         """Checkpoint path tag: a mesh run pads rows (shifting tree bin
         edges and f32 reduction orders), so its metrics must not be
-        replayed into a differently-sharded resume."""
+        replayed into a differently-sharded resume; externally supplied
+        fold masks (workflow-level CV calls validate() once per fold,
+        possibly on identical matrices when the in-fold DAG has no
+        estimators) must not replay one fold's cells into another."""
+        if self._external_mask_tag:
+            base = f"{base}:masks{self._external_mask_tag}"
         if self.mesh is None:
             return base
         from ...parallel.mesh import BATCH_AXIS
@@ -660,7 +674,8 @@ class Validator:
                              ) -> List[ValidatedModel]:
         metric = self.evaluator.default_metric
         ckpt, keys, results = self._cell_bookkeeping(
-            est, grids, X, y, metric, masks.shape[0], path="sequential")
+            est, grids, X, y, metric, masks.shape[0],
+            path=self._sweep_path("sequential"))
         for gi, g in enumerate(grids):
             if gi in results:
                 continue
@@ -704,9 +719,6 @@ class CrossValidation(Validator):
             masks[f, fold_of == f] = 0.0
         return masks
 
-    def fold_masks_count(self) -> int:
-        return self.num_folds
-
 
 class TrainValidationSplit(Validator):
     """Single split (reference OpTrainValidationSplit.scala:34;
@@ -736,6 +748,3 @@ class TrainValidationSplit(Validator):
             n_val = int(round(n * (1.0 - self.train_ratio)))
             mask[0, perm[:n_val]] = 0.0
         return mask
-
-    def fold_masks_count(self) -> int:
-        return 1

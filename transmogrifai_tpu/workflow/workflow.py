@@ -159,12 +159,16 @@ class Workflow:
     def _run_workflow_cv(self, raw_data: Dataset, cut, runner) -> None:
         """Reference ModelSelector.findBestEstimator:112 + OpValidator
         .applyDAG:228: per fold, refit the in-CV ('during') DAG on the fold's
-        train rows only, transform both halves with those fold-fitted stages,
-        then score every (model x grid) cell. The winning config replaces the
-        selector's candidate list before the normal full fit; the full sweep
-        results are stashed for the ModelSelectorSummary."""
+        train rows only, transform ALL rows with those fold-fitted stages,
+        then run the (model x grid) sweep through the validator's DEVICE
+        paths — the fold enters as one weight mask over the fold-fitted
+        matrix (vmapped/streamed GLM lanes, mask-fold trees, checkpoint
+        cells), not a host fit_arrays loop. Feature spaces may differ per
+        fold (per-fold vocabularies), which is exactly why each fold gets
+        its own matrix + single-mask validate() call. The winning config
+        replaces the selector's candidate list before the normal full fit;
+        the full sweep results are stashed for the ModelSelectorSummary."""
         from ..models.base import _as_labels, _as_matrix
-        from ..models.prediction import make_prediction_column
 
         sel = cut.model_selector
         ds1, _ = runner.fit_dag(raw_data, cut.before)
@@ -174,8 +178,14 @@ class Workflow:
         evaluator = sel.validator.evaluator
         metric = evaluator.default_metric
         larger = evaluator.is_larger_better()
+        problem_type = getattr(sel, "problem_type", "binary")
 
         cells: Dict[tuple, List[float]] = {}
+        self._workflow_cv_routes = {}
+        grid_keys = {}
+        for mi, (est, grids) in enumerate(sel.models):
+            for g in (grids or [dict()]):
+                grid_keys[(est.uid, _grid_key(g))] = (mi, _grid_key(g))
         for f in range(masks.shape[0]):
             tr = np.flatnonzero(masks[f] > 0)
             va = np.flatnonzero(masks[f] <= 0)
@@ -185,22 +195,31 @@ class Workflow:
             during_copy = _copy_dag(cut.during)
             ds_tr, fitted_during = fold_runner.fit_dag(ds1.take(tr),
                                                        during_copy)
+            # fit_dag already transformed the train rows; transform only
+            # the validation slice and reassemble row order — the fitted
+            # stages are the same objects, so the feature space matches
             ds_va = fold_runner.apply_dag(ds1.take(va), fitted_during)
             Xtr = _as_matrix(ds_tr.column(vec_name))
             Xva = _as_matrix(ds_va.column(vec_name))
-            ytr, yva = y[tr], y[va]
-            for mi, (est, grids) in enumerate(sel.models):
-                for g in (grids or [dict()]):
-                    model = est.copy(**g).fit_arrays(Xtr, ytr)
-                    pred, raw_p, prob = model.predict_arrays(Xva)
-                    col = make_prediction_column(pred, raw_p, prob)
-                    cells.setdefault(
-                        (mi, _grid_key(g)),
-                        []).append(evaluator.evaluate(yva, col,
-                                                      np.ones(len(yva),
-                                                              np.float32)))
+            Xf = np.empty((len(y), Xtr.shape[1]), Xtr.dtype)
+            Xf[tr] = Xtr
+            Xf[va] = Xva
+            candidates = [(est, [dict(g) for g in (grids or [dict()])])
+                          for est, grids in sel.models]
+            fold_best = sel.validator.validate(
+                candidates, Xf, y, problem_type=problem_type,
+                masks=masks[f:f + 1])
+            for v in fold_best.validated:
+                key = grid_keys[(v.model_uid, _grid_key(v.grid))]
+                cells.setdefault(key, []).append(float(v.fold_metrics[0]))
+                self._workflow_cv_routes[key] = v.route
         means = {k: float(np.mean(v)) for k, v in cells.items()}
-        best_key = (max if larger else min)(means, key=means.get)
+        # NaN guard mirroring Validator.validate: a degenerate fold's NaN
+        # metric must never win max() by comparison short-circuit
+        fallback = -np.inf if larger else np.inf
+        rank = {k: (v if np.isfinite(v) else fallback)
+                for k, v in means.items()}
+        best_key = (max if larger else min)(rank, key=rank.get)
         mi, _ = best_key
         winner_est, winner_grids = sel.models[mi]
         best_grid = next(g for g in (winner_grids or [dict()])
