@@ -38,14 +38,17 @@ class TestGenerate:
         files = generate_project(titanic_csv, response="survived",
                                  output=out, id_col="passengerId",
                                  name="Titanic")
-        assert set(files) == {"app.py", "params.json", "README.md"}
+        assert set(files) == {"features.py", "app.py", "params.json",
+                              "test_app.py", "README.md"}
         app = (tmp_path / "proj" / "app.py").read_text()
+        feats = (tmp_path / "proj" / "features.py").read_text()
         assert "BinaryClassificationModelSelector" in app
-        assert "passengerId" not in app  # id column excluded
-        assert "FeatureBuilder.RealNN('survived')" in app \
-            or 'FeatureBuilder.RealNN("survived")' in app
-        # generated app compiles
+        assert "passengerId" not in feats  # id column excluded
+        assert "FeatureBuilder.RealNN('survived')" in feats \
+            or 'FeatureBuilder.RealNN("survived")' in feats
+        # generated files compile
         compile(app, "app.py", "exec")
+        compile(feats, "features.py", "exec")
 
     def test_generated_app_trains(self, titanic_csv, tmp_path):
         out = tmp_path / "proj"
@@ -65,3 +68,108 @@ class TestGenerate:
         with pytest.raises(ValueError, match="Response column"):
             generate_project(titanic_csv, response="nope",
                              output=str(tmp_path / "p"))
+
+
+AVSC = """{
+  "type": "record", "name": "Passenger", "fields": [
+    {"name": "passengerId", "type": "long"},
+    {"name": "survived", "type": "boolean"},
+    {"name": "pclass", "type": ["null", "int"]},
+    {"name": "sex", "type": {"type": "enum", "name": "Sex",
+                             "symbols": ["male", "female"]}},
+    {"name": "age", "type": ["null", "double"]},
+    {"name": "fare", "type": "double"},
+    {"name": "boarded", "type": {"type": "long",
+                                 "logicalType": "timestamp-millis"}},
+    {"name": "notes", "type": {"type": "map", "values": "string"}}
+  ]
+}"""
+
+
+class TestAvroSchema:
+    def _write_schema(self, tmp_path, text=AVSC):
+        p = tmp_path / "passenger.avsc"
+        p.write_text(text)
+        return str(p)
+
+    def test_schema_driven_types_and_kind(self, tmp_path):
+        """Types come from the Avro schema (AvroField semantics: nullable
+        unions, enum -> PickList, logical timestamp -> DateTime,
+        unsupported map skipped) and a boolean response makes the kind
+        binary with NO data scan (ProblemKind.from)."""
+        from transmogrifai_tpu.cli import SchemaSource
+        src = SchemaSource.from_avro_schema(self._write_schema(tmp_path))
+        by_name = {f.name: f for f in src.fields}
+        assert by_name["survived"].feature_type == "Binary"
+        assert by_name["pclass"].feature_type == "Integral"
+        assert by_name["pclass"].nullable
+        assert by_name["sex"].feature_type == "PickList"
+        assert by_name["boarded"].feature_type == "DateTime"
+        assert "notes" not in by_name  # complex type skipped
+        out = str(tmp_path / "proj")
+        files = generate_project(response="survived", output=out,
+                                 id_col="passengerId",
+                                 schema_path=self._write_schema(tmp_path))
+        feats = files["features.py"]
+        assert "FeatureBuilder.PickList('sex')" in feats
+        assert "FeatureBuilder.DateTime('boarded')" in feats
+        assert "BinaryClassificationModelSelector" in files["app.py"]
+        for fname in ("features.py", "app.py", "test_app.py"):
+            compile(files[fname], fname, "exec")
+
+    def test_ambiguous_int_response_requires_kind_or_data(self, tmp_path):
+        schema = AVSC.replace('"name": "survived", "type": "boolean"',
+                              '"name": "survived", "type": "long"')
+        with pytest.raises(ValueError, match="ambiguous"):
+            generate_project(response="survived",
+                             output=str(tmp_path / "p"),
+                             schema_path=self._write_schema(tmp_path, schema))
+        files = generate_project(response="survived",
+                                 output=str(tmp_path / "p2"),
+                                 schema_path=self._write_schema(tmp_path,
+                                                                schema),
+                                 kind="multiclass")
+        assert "MultiClassificationModelSelector" in files["app.py"]
+
+    def test_schema_plus_data_trains(self, titanic_csv, tmp_path):
+        """The reference's full flow: Avro schema drives types, CSV test
+        data feeds the generated project, and the project TRAINS."""
+        schema = """{
+          "type": "record", "name": "Titanic", "fields": [
+            {"name": "passengerId", "type": "long"},
+            {"name": "survived", "type": "boolean"},
+            {"name": "pclass", "type": "int"},
+            {"name": "sex", "type": "string"},
+            {"name": "age", "type": ["null", "double"]},
+            {"name": "fare", "type": "double"}
+          ]
+        }"""
+        out = tmp_path / "proj"
+        generate_project(input_path=titanic_csv, response="survived",
+                         output=str(out), id_col="passengerId",
+                         schema_path=self._write_schema(tmp_path, schema))
+        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+        env.pop("PYTHONSTARTUP", None)
+        proc = subprocess.run(
+            [sys.executable, "app.py", "--run-type", "Train",
+             "--model-location", str(tmp_path / "model")],
+            cwd=str(out), env=env, capture_output=True, text=True,
+            timeout=600)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert (tmp_path / "model").is_dir()
+
+    def test_response_missing_from_data_raises(self, titanic_csv, tmp_path):
+        schema = AVSC.replace('"name": "survived", "type": "boolean"',
+                              '"name": "label", "type": "long"')
+        with pytest.raises(ValueError, match="no values in the data"):
+            generate_project(input_path=titanic_csv, response="label",
+                             output=str(tmp_path / "p"),
+                             schema_path=self._write_schema(tmp_path, schema))
+
+    def test_schema_only_placeholder_flagged(self, tmp_path):
+        files = generate_project(response="survived",
+                                 output=str(tmp_path / "p"),
+                                 schema_path=self._write_schema(tmp_path))
+        assert "PLACEHOLDER" in files["app.py"]
+        assert "placeholder" in files["README.md"]
+        compile(files["test_app.py"], "test_app.py", "exec")

@@ -1,14 +1,18 @@
 """Project generator CLI: ``python -m transmogrifai_tpu gen ...``.
 
 Reference: cli module (2,369 LoC) — ``op gen --input data.csv --id id
---response label ...`` builds a ready-to-run project from a data schema
-(CliExec, CommandParser, SchemaSource, ProblemKind, ProblemSchema,
-ProjectGenerator under cli/src/main/scala/com/salesforce/op/cli/).
+--response label --schema schema.avsc`` builds a ready-to-run project
+from a data schema (CliExec, CommandParser, SchemaSource, AvroField,
+ProblemKind, ProblemSchema, ProjectGenerator/FileGenerator under
+cli/src/main/scala/com/salesforce/op/cli/).
 
-Here: inspect the CSV (or Avro) input, infer a FeatureBuilder declaration
-per column, detect the problem kind from the response (reference
-ProblemKind binary/multiclass/regression detection), and emit app.py +
-params.json + README.md wired to OpApp/OpWorkflowRunner.
+Here: a SchemaSource either parses an Avro schema (.avsc — field types
+drive feature types and the problem kind, AvroField.scala semantics:
+union[null, T] = nullable T, logical date/timestamp types map to
+Date/DateTime) or inspects CSV/Avro DATA (type inference per column).
+The generator emits a multi-file project: features.py (typed
+FeatureBuilder declarations), app.py (workflow + OpApp entry),
+params.json, test_app.py (smoke test) and README.md.
 """
 from __future__ import annotations
 
@@ -16,7 +20,119 @@ import argparse
 import json
 import os
 import sys
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# Avro primitive -> FeatureType (reference AvroField.AvroTypes: AInt,
+# ABoolean, ALong, AFloat, ADouble, AString, AEnum)
+_AVRO_TYPE_MAP = {
+    "boolean": "Binary",
+    "int": "Integral",
+    "long": "Integral",
+    "float": "Real",
+    "double": "Real",
+    "string": "Text",
+    "enum": "PickList",
+}
+_AVRO_LOGICAL_MAP = {
+    "date": "Date",
+    "timestamp-millis": "DateTime",
+    "timestamp-micros": "DateTime",
+    "time-millis": "Integral",
+}
+
+
+@dataclass
+class SchemaField:
+    """One typed column (reference AvroField)."""
+
+    name: str
+    feature_type: str
+    avro_type: Optional[str] = None  # primitive name when schema-driven
+    nullable: bool = True
+
+
+@dataclass
+class SchemaSource:
+    """Typed column list + where it came from (reference
+    SchemaSource.scala: AvroSchemaFromFile | AutomaticSchema)."""
+
+    fields: List[SchemaField]
+    origin: str  # "avro-schema" | "data-inference"
+    record_name: Optional[str] = None
+    rows: List[Dict[str, Any]] = field(default_factory=list)
+
+    def field_named(self, name: str) -> Optional[SchemaField]:
+        return next((f for f in self.fields if f.name == name), None)
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_avro_schema(path: str) -> "SchemaSource":
+        """Parse a .avsc record schema — no data scan needed (reference
+        AvroSchemaFromFile)."""
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("type") != "record" or "fields" not in doc:
+            raise ValueError(f"{path} is not an Avro record schema")
+        fields: List[SchemaField] = []
+        for fd in doc["fields"]:
+            parsed = _parse_avro_field(fd)
+            if parsed is not None:
+                fields.append(parsed)
+        if not fields:
+            raise ValueError(f"No usable fields in Avro schema {path}")
+        return SchemaSource(fields=fields, origin="avro-schema",
+                            record_name=doc.get("name"))
+
+    @staticmethod
+    def from_data(path: str, limit: int = 1000) -> "SchemaSource":
+        """Infer types by scanning data rows (reference AutomaticSchema)."""
+        from .features.builder import infer_feature_type
+
+        rows = _load_rows(path, limit)
+        if not rows:
+            raise ValueError(f"No rows read from {path}")
+        keys: List[str] = []
+        for r in rows:
+            for k in r:
+                if k not in keys:
+                    keys.append(k)
+        fields = [
+            SchemaField(name=k,
+                        feature_type=infer_feature_type(
+                            [r.get(k) for r in rows]).__name__)
+            for k in keys
+        ]
+        return SchemaSource(fields=fields, origin="data-inference",
+                            rows=rows)
+
+
+def _parse_avro_field(fd: Dict[str, Any]) -> Optional[SchemaField]:
+    """Schema.Field -> SchemaField (reference AvroField.from:166 —
+    union [null, T] makes T nullable; unsupported complex types are
+    skipped rather than failing the whole schema)."""
+    t = fd.get("type")
+    nullable = False
+    if isinstance(t, list):  # union
+        non_null = [x for x in t if x != "null"]
+        if len(non_null) != 1:
+            return None
+        nullable = len(non_null) != len(t)
+        t = non_null[0]
+    logical = None
+    if isinstance(t, dict):
+        logical = t.get("logicalType")
+        t = t.get("type")
+    if not isinstance(t, str):
+        return None
+    if logical and logical in _AVRO_LOGICAL_MAP:
+        ftype = _AVRO_LOGICAL_MAP[logical]
+    elif t in _AVRO_TYPE_MAP:
+        ftype = _AVRO_TYPE_MAP[t]
+    else:
+        return None  # records/maps/arrays: not feature columns
+    return SchemaField(name=fd["name"], feature_type=ftype,
+                       avro_type=t, nullable=nullable)
 
 
 def _load_rows(path: str, limit: int = 1000) -> List[Dict[str, Any]]:
@@ -33,7 +149,7 @@ def _load_rows(path: str, limit: int = 1000) -> List[Dict[str, Any]]:
 
 
 def detect_problem_kind(values: Sequence[Any]) -> str:
-    """Reference ProblemKind: binary / multiclass / regression."""
+    """Data-driven kind: binary / multiclass / regression."""
     vals = [v for v in values if v is not None]
     distinct = set(vals)
     if len(distinct) <= 2:
@@ -45,26 +161,18 @@ def detect_problem_kind(values: Sequence[Any]) -> str:
     return "regression"
 
 
-def infer_features(rows: List[Dict[str, Any]], id_col: Optional[str],
-                   response: str) -> List[Tuple[str, str]]:
-    """[(column, FeatureTypeName)] for every non-id column (reference
-    SchemaSource/AvroField inference)."""
-    from .features.builder import infer_feature_type
-    keys: List[str] = []
-    for r in rows:
-        for k in r:
-            if k not in keys:
-                keys.append(k)
-    out: List[Tuple[str, str]] = []
-    for k in keys:
-        if k == id_col:
-            continue
-        if k == response:
-            out.append((k, "RealNN"))
-            continue
-        tcls = infer_feature_type([r.get(k) for r in rows])
-        out.append((k, tcls.__name__))
-    return out
+def detect_problem_kind_from_schema(f: SchemaField) -> Optional[str]:
+    """Schema-driven kind (reference ProblemKind.from): a boolean
+    response is binary, floating point is regression, enum is
+    multiclass; int/long/string are ambiguous (reference prompts the
+    user — here the caller passes --kind or provides data to refine)."""
+    if f.avro_type == "boolean":
+        return "binary"
+    if f.avro_type in ("float", "double"):
+        return "regression"
+    if f.avro_type == "enum":
+        return "multiclass"
+    return None
 
 
 _SELECTOR_BY_KIND = {
@@ -73,33 +181,42 @@ _SELECTOR_BY_KIND = {
     "regression": "RegressionModelSelector",
 }
 
-_APP_TEMPLATE = '''"""{name}: generated by `python -m transmogrifai_tpu gen`.
+_FEATURES_TEMPLATE = '''"""{name} feature declarations (generated).
 
-Problem kind: {kind}. Edit the feature declarations below to refine types
-or extraction; see the transmogrifai_tpu docs for the stage catalogue.
+Edit types/extractions here; app.py imports PREDICTORS and RESPONSE.
+Schema origin: {origin}.
 """
 from transmogrifai_tpu import FeatureBuilder
+
+{feature_decls}
+
+PREDICTORS = [{predictor_names}]
+RESPONSE = {response_var}
+'''
+
+_APP_TEMPLATE = '''"""{name}: generated by `python -m transmogrifai_tpu gen`.
+
+Problem kind: {kind}. The workflow wires transmogrify -> SanityChecker
+-> {selector}; tune grids or stages here.
+"""
 from transmogrifai_tpu.automl import {selector}
 from transmogrifai_tpu.automl.preparators import SanityChecker
 from transmogrifai_tpu.automl.transmogrifier import transmogrify
 from transmogrifai_tpu.readers.readers import CSVReader
 from transmogrifai_tpu.workflow import OpApp, OpWorkflowRunner, Workflow
 
-DATA = {data_path!r}
+from features import PREDICTORS, RESPONSE
 
-# -- raw features ----------------------------------------------------------
-{feature_decls}
-
-PREDICTORS = [{predictor_names}]
+DATA = {data_path!r}{data_note}
 
 
 def build_workflow() -> Workflow:
     vectorized = transmogrify(PREDICTORS)
-    checked = SanityChecker().set_input({response_var}, vectorized) \\
+    checked = SanityChecker().set_input(RESPONSE, vectorized) \\
         .get_output()
     prediction = {selector}.with_cross_validation(
         num_folds=3, seed=42,
-    ).set_input({response_var}, checked).get_output()
+    ).set_input(RESPONSE, checked).get_output()
     return Workflow().set_result_features(prediction)
 
 
@@ -114,51 +231,129 @@ if __name__ == "__main__":
     {app_class}().main()
 '''
 
+_TEST_TEMPLATE = '''"""Smoke test for the generated {name} project."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+def test_train_runs(tmp_path):
+    import app
+    if not os.path.exists(app.DATA):
+        pytest.skip(f"edit DATA in app.py first (placeholder: "
+                    f"{{app.DATA!r}} does not exist)")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "app.py", "--run-type", "Train",
+         "--model-location", str(tmp_path / "model")],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert (tmp_path / "model").is_dir()
+'''
+
+
+def _pyname(col: str) -> str:
+    return col.replace("-", "_").replace(" ", "_")
+
 
 def _feature_decl(col: str, type_name: str, response: str) -> str:
-    var = col.replace("-", "_").replace(" ", "_")
+    var = _pyname(col)
     role = "as_response" if col == response else "as_predictor"
     return (f'{var} = FeatureBuilder.{type_name}({col!r}).extract(\n'
             f'    lambda r: r.get({col!r})).{role}()')
 
 
-def generate_project(input_path: str, response: str, output: str,
+def generate_project(input_path: Optional[str] = None,
+                     response: str = "", output: str = ".",
                      id_col: Optional[str] = None,
-                     name: Optional[str] = None) -> Dict[str, str]:
-    rows = _load_rows(input_path)
-    if not rows:
-        raise ValueError(f"No rows read from {input_path}")
-    if response not in rows[0]:
-        raise ValueError(f"Response column {response!r} not in data "
-                         f"(columns: {list(rows[0])})")
-    kind = detect_problem_kind([r.get(response) for r in rows])
-    feats = infer_features(rows, id_col, response)
-    name = name or os.path.splitext(os.path.basename(input_path))[0].title()
+                     name: Optional[str] = None,
+                     schema_path: Optional[str] = None,
+                     kind: Optional[str] = None) -> Dict[str, str]:
+    """Build the project files; returns {filename: content}.
+
+    Sources, in reference order (SchemaSource.scala): an explicit Avro
+    schema wins (types and problem kind come from the schema, with data
+    as a refinement for ambiguous int/long responses); otherwise the
+    data file is scanned and types inferred.
+    """
+    if schema_path:
+        src = SchemaSource.from_avro_schema(schema_path)
+        if input_path:
+            src.rows = _load_rows(input_path)
+    elif input_path:
+        src = SchemaSource.from_data(input_path)
+    else:
+        raise ValueError("need --input data and/or --schema avsc")
+
+    rf = src.field_named(response)
+    if rf is None:
+        raise ValueError(f"Response column {response!r} not in schema "
+                         f"(columns: {[f.name for f in src.fields]})")
+
+    if src.rows and all(r.get(response) is None for r in src.rows):
+        raise ValueError(
+            f"Response column {response!r} has no values in the data file "
+            f"(its columns: {sorted(src.rows[0])})")
+    if kind is None:
+        kind = detect_problem_kind_from_schema(rf) \
+            if src.origin == "avro-schema" else None
+        if kind is None and src.rows:
+            kind = detect_problem_kind([r.get(response) for r in src.rows])
+        if kind is None:
+            raise ValueError(
+                f"Problem kind is ambiguous from the schema alone for "
+                f"{response!r} ({rf.avro_type}); pass --kind "
+                f"binary|multiclass|regression or --input data")
+    if kind not in _SELECTOR_BY_KIND:
+        raise ValueError(f"Unknown problem kind {kind!r}")
+
+    feats: List[Tuple[str, str]] = [
+        (f.name, "RealNN" if f.name == response else f.feature_type)
+        for f in src.fields if f.name != id_col]
+
+    base = schema_path or input_path
+    name = name or (src.record_name
+                    or os.path.splitext(os.path.basename(base))[0].title())
     app_class = "".join(c for c in name.title() if c.isalnum()) or "App"
 
     decls = "\n".join(_feature_decl(c, t, response) for c, t in feats)
-    predictors = ", ".join(c.replace("-", "_").replace(" ", "_")
-                           for c, _ in feats if c != response)
+    predictors = ", ".join(_pyname(c) for c, _ in feats if c != response)
+    features_py = _FEATURES_TEMPLATE.format(
+        name=name, origin=src.origin, feature_decls=decls,
+        predictor_names=predictors, response_var=_pyname(response))
     app_py = _APP_TEMPLATE.format(
         name=name, kind=kind, selector=_SELECTOR_BY_KIND[kind],
-        data_path=os.path.abspath(input_path), feature_decls=decls,
-        predictor_names=predictors,
-        response_var=response.replace("-", "_").replace(" ", "_"),
+        data_path=os.path.abspath(input_path) if input_path else "data.csv",
+        data_note=("" if input_path
+                   else "  # PLACEHOLDER: point at your dataset"),
         app_class=app_class)
+    test_py = _TEST_TEMPLATE.format(name=name)
 
     params = {"stage_params": {}, "model_location": "./model",
               "write_location": "./scores", "metrics_location": "./metrics"}
+    data_hint = ("" if input_path else
+                 "\n> **Before running:** `DATA` in `app.py` is a "
+                 "placeholder (`data.csv`) — point it at your dataset.\n")
     readme = (f"# {name}\n\nGenerated by transmogrifai_tpu "
-              f"(problem kind: **{kind}**, "
-              f"{len(feats)} features).\n\n"
+              f"(problem kind: **{kind}**, schema: {src.origin}, "
+              f"{len(feats)} features).\n{data_hint}\n"
+              f"- `features.py` — typed feature declarations\n"
+              f"- `app.py` — workflow + Train/Score/Evaluate entry\n"
+              f"- `params.json` — run configuration (OpParams)\n"
+              f"- `test_app.py` — smoke test (`pytest test_app.py`)\n\n"
               f"```bash\npython app.py --run-type Train "
               f"--param-location params.json\n"
               f"python app.py --run-type Score --param-location params.json\n"
               f"```\n")
 
     os.makedirs(output, exist_ok=True)
-    files = {"app.py": app_py,
+    files = {"features.py": features_py,
+             "app.py": app_py,
              "params.json": json.dumps(params, indent=2),
+             "test_app.py": test_py,
              "README.md": readme}
     for fname, content in files.items():
         with open(os.path.join(output, fname), "w") as f:
@@ -170,15 +365,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(prog="transmogrifai_tpu")
     sub = p.add_subparsers(dest="command", required=True)
     gen = sub.add_parser("gen", help="generate a project from a dataset")
-    gen.add_argument("--input", required=True, help="CSV or Avro data file")
+    gen.add_argument("--input", default=None, help="CSV or Avro data file")
+    gen.add_argument("--schema", default=None,
+                     help="Avro record schema (.avsc)")
     gen.add_argument("--response", required=True, help="label column")
     gen.add_argument("--id", default=None, help="id column to exclude")
+    gen.add_argument("--kind", default=None,
+                     choices=sorted(_SELECTOR_BY_KIND),
+                     help="problem kind override")
     gen.add_argument("--output", default=".", help="project directory")
     gen.add_argument("--name", default=None, help="project name")
     a = p.parse_args(argv)
     if a.command == "gen":
         files = generate_project(a.input, a.response, a.output,
-                                 id_col=a.id, name=a.name)
+                                 id_col=a.id, name=a.name,
+                                 schema_path=a.schema, kind=a.kind)
         print(f"Generated {', '.join(files)} in {a.output}")
         return 0
     return 1
