@@ -2,6 +2,7 @@
 features/.../aggregators/: MonoidAggregatorDefaults, TimeBasedAggregator;
 readers cutoff behavior DataReader.scala:219-246)."""
 import numpy as np
+import pytest
 
 from transmogrifai_tpu.features.aggregators import (
     FeatureAggregator, MonoidAggregatorDefaults, named_aggregator,
@@ -36,10 +37,11 @@ class TestMonoidDefaults:
         out = agg.reduce([{"x"}, {"y", "x"}])
         assert set(out) == {"x", "y"}
 
-    def test_realmap_merges_last_wins(self):
+    def test_realmap_merges_per_key_sum(self):
+        # reference UnionRealMap (Maps.scala:52): shared keys SUM
         agg = MonoidAggregatorDefaults.aggregator_for(RealMap)
         out = agg.reduce([{"a": 1.0}, {"a": 2.0, "b": 3.0}])
-        assert out["a"] == 2.0 and out["b"] == 3.0
+        assert out["a"] == 3.0 and out["b"] == 3.0
 
     def test_named_min_max_first_last(self):
         assert named_aggregator("min", Real).reduce([3.0, 1.0, 2.0]) == 1.0
@@ -76,3 +78,127 @@ class TestTimeWindows:
         fa = FeatureAggregator(Real)
         # untimed event kept; the t=100 event is after cutoff 50 -> dropped
         assert fa.extract([(5.0, None), (7.0, 100)], cutoff_time=50) == 5.0
+
+
+class TestExpandedPalette:
+    """Round-3 aggregator breadth (reference aggregators/ 9-file suite):
+    means, mode, concat, logical ops, geographic midpoint, time-based
+    first/last, per-key map value monoids."""
+
+    def test_mean_and_percent_clamping(self):
+        from transmogrifai_tpu.features.aggregators import mean_aggregator
+        assert mean_aggregator().reduce([1.0, 2.0, None, 3.0]) == 2.0
+        # Percent clamps into [0,1] BEFORE averaging (PercentPrepare)
+        assert mean_aggregator(percent=True).reduce([0.5, 1.5, -0.5]) == \
+            pytest.approx((0.5 + 1.0 + 0.0) / 3)
+
+    def test_mode_picklist(self):
+        from transmogrifai_tpu.features.aggregators import (
+            MonoidAggregatorDefaults,
+        )
+        from transmogrifai_tpu.types import PickList
+        agg = MonoidAggregatorDefaults.aggregator_for(PickList)
+        assert agg.reduce(["a", "b", "b", None, "c"]) == "b"
+        # deterministic tie-break: lexicographically smallest
+        assert agg.reduce(["b", "a"]) == "a"
+
+    def test_concat_text(self):
+        from transmogrifai_tpu.features.aggregators import (
+            MonoidAggregatorDefaults,
+        )
+        from transmogrifai_tpu.types import ComboBox, Text
+        assert MonoidAggregatorDefaults.aggregator_for(Text).reduce(
+            ["hello", None, "world"]) == "hello world"
+        assert MonoidAggregatorDefaults.aggregator_for(ComboBox).reduce(
+            ["a", "b"]) == "a,b"
+
+    def test_logical_named(self):
+        from transmogrifai_tpu.types import Binary
+        assert named_aggregator("logical_and", Binary).reduce(
+            [True, True, None]) is True
+        assert named_aggregator("logical_and", Binary).reduce(
+            [True, False]) is False
+        assert named_aggregator("logical_xor", Binary).reduce(
+            [True, True]) is False
+
+    def test_geolocation_midpoint(self):
+        from transmogrifai_tpu.features.aggregators import (
+            MonoidAggregatorDefaults,
+        )
+        from transmogrifai_tpu.types import Geolocation
+        agg = MonoidAggregatorDefaults.aggregator_for(Geolocation)
+        # symmetric points on the equator: midpoint on the meridian between
+        out = agg.reduce([[0.0, 10.0, 1.0], [0.0, -10.0, 3.0]])
+        assert out[0] == pytest.approx(0.0, abs=1e-9)
+        assert out[1] == pytest.approx(0.0, abs=1e-9)
+        assert out[2] == pytest.approx(2.0)
+        assert agg.reduce([None, None]) is None
+
+    def test_time_based_first_last(self):
+        from transmogrifai_tpu.types import Text
+        # events arrive OUT of time order; first/last follow event time
+        vals, times = ["mid", "oldest", "newest"], [200, 100, 300]
+        assert named_aggregator("first", Text).reduce(vals, times) == "oldest"
+        assert named_aggregator("last", Text).reduce(vals, times) == "newest"
+        # no timestamps: encounter order
+        assert named_aggregator("first", Text).reduce(["a", "b"]) == "a"
+        assert named_aggregator("last", Text).reduce(["a", "b"]) == "b"
+        # mixed: an untimed event never beats a timed one
+        assert named_aggregator("first", Text).reduce(
+            ["a", "b"], [100, None]) == "a"
+        assert named_aggregator("last", Text).reduce(
+            ["b", "a"], [None, 100]) == "a"
+
+    def test_map_value_monoids(self):
+        from transmogrifai_tpu.features.aggregators import (
+            MonoidAggregatorDefaults,
+        )
+        from transmogrifai_tpu.types import (
+            BinaryMap, DateMap, MultiPickListMap, TextMap,
+        )
+        assert MonoidAggregatorDefaults.aggregator_for(DateMap).reduce(
+            [{"k": 100}, {"k": 50}])["k"] == 100
+        assert MonoidAggregatorDefaults.aggregator_for(BinaryMap).reduce(
+            [{"k": False}, {"k": True}])["k"] is True
+        out = MonoidAggregatorDefaults.aggregator_for(
+            MultiPickListMap).reduce([{"k": {"a"}}, {"k": {"b"}}])
+        assert out["k"] == {"a", "b"}
+        assert MonoidAggregatorDefaults.aggregator_for(TextMap).reduce(
+            [{"k": "x"}, {"k": "y"}])["k"] == "x,y"
+
+    def test_aggregate_reader_uses_event_times(self):
+        """End to end: FeatureAggregator passes event times through, so
+        a 'last' aggregate over out-of-order events is time-correct."""
+        from transmogrifai_tpu.features.aggregators import FeatureAggregator
+        from transmogrifai_tpu.types import Text
+        fa = FeatureAggregator(type_cls=Text,
+                               aggregator=named_aggregator("last", Text))
+        out = fa.extract([("new", 300), ("old", 100)], cutoff_time=400)
+        assert out == "new"
+        # response keeps only post-cutoff events
+        out = fa.extract([("pre", 100), ("post", 500)], cutoff_time=400,
+                         is_response=True)
+        assert out == "post"
+
+
+def test_map_subclass_inherits_numeric_monoid():
+    """issubclass dispatch: a user RealMap subclass sums per key instead
+    of silently falling into string concat."""
+    from transmogrifai_tpu.types import RealMap
+
+    class SignalMap(RealMap):
+        pass
+
+    out = MonoidAggregatorDefaults.aggregator_for(SignalMap).reduce(
+        [{"k": 1.0}, {"k": 2.0}])
+    assert out["k"] == 3.0
+
+
+def test_tuple_valued_raw_values_are_not_misparsed():
+    """Geolocation values ARE tuples; reduce must never unpack them as
+    (value, time) pairs."""
+    from transmogrifai_tpu.types import Geolocation
+    agg = MonoidAggregatorDefaults.aggregator_for(Geolocation)
+    out = agg.reduce([(10.0, 20.0, 1.0)])
+    assert out[0] == pytest.approx(10.0, abs=1e-6)
+    assert out[1] == pytest.approx(20.0, abs=1e-6)

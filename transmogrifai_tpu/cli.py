@@ -241,7 +241,11 @@ import pytest
 
 def test_train_runs(tmp_path):
     import app
-    if not os.path.exists(app.DATA):
+    proj = os.path.dirname(os.path.abspath(__file__))
+    # resolve DATA exactly as the subprocess will (cwd = project dir)
+    data = app.DATA if os.path.isabs(app.DATA) \\
+        else os.path.join(proj, app.DATA)
+    if not os.path.exists(data):
         pytest.skip(f"edit DATA in app.py first (placeholder: "
                     f"{{app.DATA!r}} does not exist)")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
