@@ -163,8 +163,13 @@ class TestExpandedPalette:
         out = MonoidAggregatorDefaults.aggregator_for(
             MultiPickListMap).reduce([{"k": {"a"}}, {"k": {"b"}}])
         assert out["k"] == {"a", "b"}
+        # free-text TextMap concats with " " (UnionConcatTextMap,
+        # Maps.scala:145); structured subclasses like EmailMap use ","
         assert MonoidAggregatorDefaults.aggregator_for(TextMap).reduce(
-            [{"k": "x"}, {"k": "y"}])["k"] == "x,y"
+            [{"k": "x"}, {"k": "y"}])["k"] == "x y"
+        from transmogrifai_tpu.types import EmailMap
+        assert MonoidAggregatorDefaults.aggregator_for(EmailMap).reduce(
+            [{"k": "a@b.c"}, {"k": "d@e.f"}])["k"] == "a@b.c,d@e.f"
 
     def test_aggregate_reader_uses_event_times(self):
         """End to end: FeatureAggregator passes event times through, so

@@ -401,10 +401,20 @@ class MonoidAggregatorDefaults:
                     value_present=_mean_present)
             if issubclass(type_cls, NumericMap):
                 return map_value_aggregator(_sum_option)
-            # text-valued maps: per-key concat
-            return map_value_aggregator(
-                lambda a, b: f"{a},{b}" if a is not None and b is not None
-                else (b if a is None else a))
+            # text-valued maps: per-key concat — " " for free-text
+            # TextMap/TextAreaMap themselves, "," for the structured
+            # subclasses (reference UnionConcat*Map, Maps.scala:139-152)
+            from ..types import TextAreaMap, TextMap
+            sep = " " if type_cls in (TextMap, TextAreaMap) else ","
+
+            def _concat_kv(a, b, _s=sep):
+                if a is None:
+                    return b
+                if b is None:
+                    return a
+                return f"{a}{_s}{b}"
+
+            return map_value_aggregator(_concat_kv)
         if issubclass(type_cls, Binary):
             return MonoidAggregator(lambda: None, _logical_or)
         if issubclass(type_cls, (Date, DateTime)):
@@ -413,7 +423,7 @@ class MonoidAggregatorDefaults:
             return mean_aggregator(percent=True)
         if issubclass(type_cls, OPNumeric):
             return MonoidAggregator(lambda: None, _sum_option)
-        if issubclass(type_cls, MultiPickList) or issubclass(type_cls, OPSet):
+        if issubclass(type_cls, OPSet):  # includes MultiPickList
             return MonoidAggregator(set, _union_set)
         if issubclass(type_cls, Geolocation):
             return geolocation_midpoint_aggregator()

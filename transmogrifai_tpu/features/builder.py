@@ -44,8 +44,11 @@ class _TypedFeatureBuilder:
     def aggregate(self, plus,
                   zero: Callable[[], Any] = lambda: None) -> "_TypedFeatureBuilder":
         """Monoid for event aggregation (reference FeatureBuilder
-        .aggregate:283-302). Pass a callable plus (with optional zero) or a
-        named default: "sum" | "min" | "max" | "last" | "first" | "union"."""
+        .aggregate:283-302). Pass a callable plus (with optional zero) or
+        a named default: "sum" | "min" | "max" | "last" | "first" |
+        "union" | "mean" | "mode" | "concat" | "logical_and" |
+        "logical_or" | "logical_xor" | "midpoint" ("first"/"last" follow
+        event TIME, not encounter order)."""
         if isinstance(plus, str):
             from .aggregators import named_aggregator
             agg = named_aggregator(plus, self.type_cls)
