@@ -250,3 +250,91 @@ def test_phone_parser_regional_metadata():
     # +cc resolution names the region
     assert parse_phone("+44 20 7031 3000")[1] == "GB"
     assert parse_phone("+49 30 303986300")[1] == "DE"
+
+
+class TestRound3DslBreadth:
+    """New dsl ops closing the gap vs the reference's Rich*Feature files:
+    bucketize, z_normalize, to_isotonic_calibrated, is_substring,
+    tokenize_regex, remove_stop_words, ngram, tf, drop_indices_by, map."""
+
+    def test_bucketize_fixed_splits(self):
+        ds, (x,) = TestFeatureBuilder.build(
+            ("x", Real, [1.0, 5.0, 9.0, None]))
+        b = x.bucketize(splits=[0.0, 4.0, 8.0, 12.0], track_nulls=True)
+        out = _run(ds, b).column(b.name).data
+        assert out.shape == (4, 4)  # 3 buckets + null
+        assert out[0, 0] == 1.0 and out[1, 1] == 1.0 and out[2, 2] == 1.0
+        assert out[3, 3] == 1.0
+
+    def test_z_normalize(self):
+        vals = [2.0, 4.0, 6.0, None]
+        ds, (x,) = TestFeatureBuilder.build(("x", Real, vals))
+        z = x.z_normalize()
+        out = _run(ds, z).column(z.name).data
+        present = np.array([2.0, 4.0, 6.0])
+        # sample std (ddof=1) — Spark StandardScaler semantics
+        exp = (present - present.mean()) / present.std(ddof=1)
+        np.testing.assert_allclose(out[:3], exp, atol=1e-6)
+        assert out[3] == 0.0  # empty -> centered value
+
+    def test_isotonic_calibration_monotone(self):
+        rng = np.random.default_rng(0)
+        score = rng.uniform(0, 1, 200)
+        label = (rng.uniform(size=200) < score).astype(float)
+        ds, (y, s) = TestFeatureBuilder.build(
+            ("y", RealNN, label.tolist()),
+            ("s", RealNN, score.tolist()))
+        cal = s.to_isotonic_calibrated(y)
+        out = _run(ds, cal).column(cal.name).data
+        order = np.argsort(score)
+        assert (np.diff(out[order]) >= -1e-9).all()  # non-decreasing
+
+    def test_is_substring(self):
+        ds, (a, b) = TestFeatureBuilder.build(
+            ("a", Text, ["cat", "dog", None]),
+            ("b", Text, ["concatenate", "fish", "x"]))
+        r = a.is_substring(b)
+        out = _run(ds, r).column(r.name).data
+        assert out[0] == 1.0 and out[1] == 0.0
+        assert np.isnan(out[2])
+
+    def test_tokenize_regex_and_ngram_and_stopwords(self):
+        ds, (t,) = TestFeatureBuilder.build(
+            ("t", Text, ["the Cat-sat on  the Mat", None]))
+        toks = t.tokenize_regex(pattern=r"[a-z]+")
+        kept = toks.remove_stop_words()
+        bi = toks.ngram(2)
+        out = _run(ds, toks, kept, bi)
+        assert list(out.column(toks.name).data[0]) == \
+            ["the", "cat", "sat", "on", "the", "mat"]
+        assert "the" not in list(out.column(kept.name).data[0])
+        assert "the cat" in list(out.column(bi.name).data[0])
+        assert list(out.column(toks.name).data[1]) == []
+
+    def test_tf_hashed_counts(self):
+        ds, (t,) = TestFeatureBuilder.build(
+            ("t", Text, ["a b a", "c"]))
+        vec = t.tokenize().tf(num_features=64)
+        out = _run(ds, vec).column(vec.name).data
+        assert out.shape[1] >= 64
+        assert out[0].sum() == 3.0 and out[1].sum() == 1.0
+
+    def test_drop_indices_by(self):
+        ds, (p,) = TestFeatureBuilder.build(
+            ("p", PickList, ["a", "b", "a"]))
+        vec = p.pivot(top_k=5)
+        dropped = vec.drop_indices_by(
+            lambda c: c.is_null_indicator or c.is_other_indicator)
+        out = _run(ds, vec, dropped)
+        assert out.column(dropped.name).data.shape[1] < \
+            out.column(vec.name).data.shape[1]
+
+    def test_generic_map(self):
+        from transmogrifai_tpu.types import Integral as IntegralT, Text as TextT
+        ds, (t,) = TestFeatureBuilder.build(
+            ("t", Text, ["abc", "de", None]))
+        ln = t.map(lambda v: IntegralT(None if v.value is None
+                                       else len(v.value) * 10),
+                   output_type=IntegralT)
+        out = _run(ds, ln).column(ln.name).data
+        assert out[0] == 30 and out[1] == 20

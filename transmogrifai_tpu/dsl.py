@@ -396,6 +396,82 @@ def _sanity_check(self, label: Feature, **kwargs):
     return SanityChecker(**kwargs).set_input(label, self).get_output()
 
 
+# -- round-3 breadth (closing the dsl gap vs the reference's ~3,900 LoC) ----
+
+def _bucketize(self, splits=None, num_buckets: int = 4,
+               track_nulls: bool = True):
+    """Fixed-split or quantile buckets (RichNumericFeature.bucketize)."""
+    from .automl.vectorizers.numeric import NumericBucketizer
+    given = None if splits is None else [list(splits)]
+    return NumericBucketizer(splits=given, num_buckets=num_buckets,
+                             track_nulls=track_nulls) \
+        .set_input(self).get_output()
+
+
+def _z_normalize(self):
+    """Z-score scaling fit on the data (RichNumericFeature.zNormalize)."""
+    from .transformers.math import ZNormalizeEstimator
+    return ZNormalizeEstimator().set_input(self).get_output()
+
+
+def _to_isotonic_calibrated(self, label: Feature, isotonic: bool = True):
+    """Calibrate a score against a label by isotonic regression
+    (RichNumericFeature.toIsotonicCalibrated)."""
+    from .models.mlp import IsotonicRegressionCalibrator
+    return IsotonicRegressionCalibrator(isotonic=isotonic) \
+        .set_input(label, self).get_output()
+
+
+def _is_substring(self, other: Feature):
+    """Binary: is this text contained in `other` (RichTextFeature
+    .isSubstring)."""
+    from .transformers.text import SubstringTransformer
+    return SubstringTransformer().set_input(self, other).get_output()
+
+
+def _tokenize_regex(self, pattern: str = r"\w+", to_lowercase: bool = True,
+                    min_token_length: int = 1):
+    from .transformers.text import RegexTokenizer
+    return _unary(self, RegexTokenizer, pattern=pattern,
+                  to_lowercase=to_lowercase,
+                  min_token_length=min_token_length)
+
+
+def _remove_stop_words(self):
+    from .transformers.text import StopWordsRemover
+    return _unary(self, StopWordsRemover)
+
+
+def _ngram(self, n: int = 2):
+    from .transformers.text import NGramTransformer
+    return _unary(self, NGramTransformer, n=n)
+
+
+def _tf(self, num_features: int = 512):
+    """Hashed term frequencies (RichListFeature.tf via HashingTF)."""
+    from .automl.vectorizers.text import TextListHashingVectorizer
+    return TextListHashingVectorizer(num_features=num_features) \
+        .set_input(self).get_output()
+
+
+def _drop_indices_by(self, predicate):
+    """Drop vector columns whose metadata matches `predicate`
+    (RichVectorFeature.dropIndicesBy)."""
+    from .transformers.misc import DropIndicesByTransformer
+    return DropIndicesByTransformer(predicate=predicate) \
+        .set_input(self).get_output()
+
+
+def _map_feature(self, fn, output_type, operation_name: str = "map"):
+    """Arbitrary row-level transform (RichFeature.map): `fn` takes and
+    returns FeatureType instances."""
+    from .stages.base import LambdaTransformer
+    stage = LambdaTransformer(operation_name, fn,
+                              input_types=(self.feature_type,),
+                              output_type=output_type)
+    return stage.set_input(self).get_output()
+
+
 def _loco_insights(self, model, top_k: int = 20):
     from .insights import RecordInsightsLOCO
     return RecordInsightsLOCO(model=model, top_k=top_k) \
@@ -436,6 +512,12 @@ def install() -> None:
         "is_valid_email": _is_valid_email, "email_prefix": _email_prefix,
         "url_domain": _url_domain, "url_protocol": _url_protocol,
         "is_valid_url": _is_valid_url,
+        "bucketize": _bucketize, "z_normalize": _z_normalize,
+        "to_isotonic_calibrated": _to_isotonic_calibrated,
+        "is_substring": _is_substring, "tokenize_regex": _tokenize_regex,
+        "remove_stop_words": _remove_stop_words, "ngram": _ngram,
+        "tf": _tf, "drop_indices_by": _drop_indices_by,
+        "map": _map_feature,
     }
     for name, fn in ops.items():
         setattr(Feature, name, fn)

@@ -13,7 +13,7 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
-from ..stages.base import JaxTransformer
+from ..stages.base import Estimator, JaxTransformer
 from ..stages.params import Param
 from ..types import OPNumeric, Real, RealNN
 
@@ -197,3 +197,49 @@ class PowerTransformer(_UnaryMath):
     def get_jax_fn(self):
         p = float(self.get_param("exponent"))
         return lambda a: jnp.power(a, p)
+
+
+class ZNormalizeEstimator(Estimator):
+    """Real -> RealNN z-score (reference RichNumericFeature.zNormalize
+    via OpScalarStandardScaler): fit mean/std over the present values,
+    transform to (x - mean) / std with NaN -> 0 after scaling (the
+    centered empty value)."""
+
+    input_types = (Real,)
+    output_type = RealNN
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "zNormalize"),
+                         uid=uid, **params)
+
+    def fit_columns(self, *cols):
+        x = np.asarray(cols[0].data, np.float64)
+        ok = np.isfinite(x)
+        mean = float(x[ok].mean()) if ok.any() else 0.0
+        # sample std (ddof=1), matching Spark StandardScaler's estimator
+        # semantics the reference wraps; a single present value has no
+        # spread -> unit scale
+        std = float(x[ok].std(ddof=1)) if ok.sum() > 1 else 1.0
+        return ZNormalizeModel(mean=mean, std=max(std, _EPS),
+                               operation_name=self.operation_name)
+
+
+class ZNormalizeModel(JaxTransformer):
+    input_types = (Real,)
+    output_type = RealNN
+
+    def __init__(self, mean: float = 0.0, std: float = 1.0,
+                 operation_name: str = "zNormalize",
+                 uid: Optional[str] = None, **params):
+        self.mean = float(mean)
+        self.std = float(std)
+        super().__init__(operation_name, uid=uid, **params)
+
+    def get_jax_fn(self):
+        m, s = self.mean, self.std
+        return lambda a: jnp.nan_to_num((a - m) / s, nan=0.0)
+
+    def save_args(self):
+        d = super().save_args()
+        d.update(mean=self.mean, std=self.std)
+        return d

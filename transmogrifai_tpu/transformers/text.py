@@ -81,6 +81,77 @@ class TextTokenizer(Transformer):
             bool(self.get_param("filter_stopwords"))))
 
 
+class RegexTokenizer(Transformer):
+    """Text -> TextList by a custom token pattern (reference
+    RichTextFeature.tokenizeRegex — Lucene pattern analyzer)."""
+
+    input_types = (Text,)
+    output_type = TextList
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("pattern", "regex matching TOKENS", r"\w+"),
+                Param("to_lowercase", "lowercase before match", True),
+                Param("min_token_length", "min token length", 1)]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "tokenizeRegex"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        v = vals[0].value
+        if not v:
+            return TextList([])
+        if bool(self.get_param("to_lowercase")):
+            v = v.lower()
+        ml = int(self.get_param("min_token_length"))
+        # finditer + group(0): findall would return group captures (or
+        # tuples) for patterns containing groups, corrupting the token list
+        toks = [m.group(0)
+                for m in re.finditer(str(self.get_param("pattern")), v)
+                if len(m.group(0)) >= ml]
+        return TextList(toks)
+
+
+class StopWordsRemover(Transformer):
+    """TextList -> TextList without english stopwords (reference
+    RichListFeature.removeStopWords via Spark StopWordsRemover)."""
+
+    input_types = (TextList,)
+    output_type = TextList
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "rmStopWords"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        toks = vals[0].value or []
+        return TextList([t for t in toks if t.lower() not in _STOPWORDS])
+
+
+class NGramTransformer(Transformer):
+    """TextList -> TextList of word n-grams joined by spaces (reference
+    RichListFeature.ngram via Spark NGram)."""
+
+    input_types = (TextList,)
+    output_type = TextList
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("n", "gram size", 2)]
+
+    def __init__(self, n: int = 2, uid: Optional[str] = None, **params):
+        params.setdefault("n", n)
+        super().__init__(params.pop("operation_name", "ngram"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        toks = vals[0].value or []
+        n = max(int(self.get_param("n")), 1)
+        return TextList([" ".join(toks[i:i + n])
+                         for i in range(max(len(toks) - n + 1, 0))])
+
+
 class TextLenTransformer(Transformer):
     """Text -> Integral length (reference TextLenTransformer); empty -> 0."""
 
