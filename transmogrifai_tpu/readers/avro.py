@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import struct
 import zlib
 from typing import Any, BinaryIO, Callable, Dict, Iterator, List, Optional
@@ -289,6 +290,11 @@ def _encode(schema: Any, v: Any, out: bytearray) -> None:
 def write_avro_file(path: str, schema: Dict[str, Any],
                     records: List[Dict[str, Any]],
                     codec: str = "null") -> None:
+    if codec not in ("null", "deflate"):
+        # an unknown codec would be STAMPED into the container header
+        # over an uncompressed payload — unreadable far from the cause
+        raise ValueError(f"unsupported Avro codec {codec!r} "
+                         f"(null | deflate)")
     sync = b"\x00\x01\x02\x03\x04\x05\x06\x07\x08\x09\x0a\x0b\x0c\x0d\x0e\x0f"
     out = bytearray()
     out += _MAGIC
@@ -310,3 +316,111 @@ def write_avro_file(path: str, schema: Dict[str, Any],
     out += _zigzag(len(records)) + _zigzag(len(payload)) + payload + sync
     with open(path, "wb") as f:
         f.write(bytes(out))
+
+
+def infer_avro_schema(rows: List[Dict[str, Any]],
+                      name: str = "Record") -> Dict[str, Any]:
+    """Infer a nullable Avro record schema from python rows (reference
+    utils/io/CSVToAvro + CSVAutoReaders schema inference): bool -> boolean,
+    64-bit int -> long, float -> double, everything else -> string
+    (including out-of-range ints, which a "long" varint would silently
+    wrap); a column with any missing value becomes a [null, T] union.
+    Names are sanitized to the Avro name grammar
+    ([A-Za-z_][A-Za-z0-9_]*) so spec-compliant readers accept the file;
+    the original column names stay as the field order's source keys via
+    csv_to_avro's mapping."""
+    keys: List[str] = []
+    for r in rows:
+        for k in r:
+            if k not in keys:
+                keys.append(k)
+    lo, hi = -(1 << 63), (1 << 63) - 1
+    fields = []
+    for k in keys:
+        vals = [r.get(k) for r in rows]
+        present = [v for v in vals if v is not None]
+        nullable = len(present) < len(vals)
+        if present and all(isinstance(v, bool) for v in present):
+            t = "boolean"
+        elif present and all(
+                isinstance(v, bool)
+                or (isinstance(v, int) and lo <= v <= hi)
+                for v in present):
+            t = "long"
+        elif present and all(isinstance(v, (bool, float))
+                             or (isinstance(v, int) and lo <= v <= hi)
+                             for v in present):
+            t = "double"
+        else:
+            t = "string"
+        fields.append({"name": avro_name(k),
+                       "type": ["null", t] if nullable or not present
+                       else t})
+    return {"type": "record", "name": avro_name(name), "fields": fields}
+
+
+def avro_name(raw: str) -> str:
+    """Sanitize to the Avro name grammar [A-Za-z_][A-Za-z0-9_]*
+    (ASCII only — unicode alphanumerics are rejected by spec readers)."""
+    out = "".join(c if ("a" <= c <= "z" or "A" <= c <= "Z"
+                        or "0" <= c <= "9" or c == "_") else "_"
+                  for c in raw)
+    if not out or "0" <= out[0] <= "9":
+        out = "_" + out
+    return out
+
+
+def csv_to_avro(csv_path: str, avro_path: str,
+                schema: Optional[Dict[str, Any]] = None,
+                codec: str = "null") -> Dict[str, Any]:
+    """Convert a CSV file to Avro (reference utils/io/CSVToAvro): read
+    with the CSV reader's type coercion, infer a nullable record schema
+    unless one is given, write with the container codec. Returns the
+    schema used."""
+    from .readers import CSVReader
+
+    rows = CSVReader(csv_path).read()
+    headers: List[str] = []
+    if rows:
+        for r in rows:
+            for k in r:
+                if k not in headers:
+                    headers.append(k)
+    else:
+        # header-only CSV: the header still declares the columns
+        # (reference CSVToAvro derives the schema from the header)
+        import csv as _csv
+        with open(csv_path, newline="") as f:
+            first = next(_csv.reader(f), [])
+        headers = [h for h in first if h]
+    if schema is None:
+        base = os.path.splitext(os.path.basename(csv_path))[0]
+        if rows:
+            schema = infer_avro_schema(rows, name=base.title())
+        else:
+            schema = {"type": "record", "name": avro_name(base.title()),
+                      "fields": [{"name": avro_name(h),
+                                  "type": ["null", "string"]}
+                                 for h in headers]}
+    # original CSV column -> sanitized Avro field name, positionally
+    # (sanitizing is order-preserving)
+    key_of = dict(zip((f["name"] for f in schema["fields"]), headers))
+    types = {f["name"]: f["type"] for f in schema["fields"]}
+
+    def norm(fname, v):
+        t = types.get(fname)
+        t = [x for x in t if x != "null"][0] if isinstance(t, list) else t
+        if v is None:
+            return None
+        if t == "string" and not isinstance(v, str):
+            return str(v)
+        if t == "double" and isinstance(v, (int, bool)):
+            return float(v)
+        if t == "long" and isinstance(v, float) and float(v).is_integer():
+            return int(v)
+        return v
+
+    records = [{fn: norm(fn, r.get(key_of.get(fn, fn))) for fn in types}
+               for r in rows]
+    write_avro_file(avro_path, schema, records, codec=codec)
+    return schema
