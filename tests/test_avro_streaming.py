@@ -177,3 +177,22 @@ class TestCsvToAvro:
             write_avro_file(str(tmp_path / "x.avro"),
                             {"type": "record", "name": "X", "fields": []},
                             [], codec="snappy")
+
+    def test_colliding_and_reordered_headers(self, tmp_path):
+        from transmogrifai_tpu.readers.avro import csv_to_avro, read_avro_file
+        # 'a-b' and 'a_b' sanitize identically: must not collapse
+        coll = tmp_path / "c.csv"
+        coll.write_text("a-b,a_b\n1,2\n")
+        schema = csv_to_avro(str(coll), str(tmp_path / "c.avro"))
+        names = [f["name"] for f in schema["fields"]]
+        assert len(set(names)) == 2, names
+        row = list(read_avro_file(str(tmp_path / "c.avro")))[0]
+        assert sorted(row.values()) == [1, 2]
+        # caller-supplied schema in a DIFFERENT field order than the CSV
+        data = tmp_path / "r.csv"
+        data.write_text("a,b\n1,hello\n")
+        schema = {"type": "record", "name": "R", "fields": [
+            {"name": "b", "type": "string"}, {"name": "a", "type": "long"}]}
+        csv_to_avro(str(data), str(tmp_path / "r.avro"), schema=schema)
+        row = list(read_avro_file(str(tmp_path / "r.avro")))[0]
+        assert row["a"] == 1 and row["b"] == "hello"

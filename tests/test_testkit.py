@@ -138,3 +138,21 @@ def test_assert_feature_and_transforms():
     t = TextLenTransformer().set_input(
         FeatureBuilder.Text("t").extract(lambda r: r.get("t")).as_predictor())
     assert_transforms(t, [Text("abc"), Text(None)], [3, 0])
+
+
+def test_format_table():
+    """ASCII table renderer (reference utils Table.scala)."""
+    from transmogrifai_tpu.utils.table import format_table
+    out = format_table(["name", "auc"],
+                       [["logReg", 0.912345678], ["gbt", 0.88]],
+                       title="models")
+    lines = out.splitlines()
+    assert "models" in lines[1]
+    assert any("logReg" in ln and "0.912346" in ln for ln in lines)
+    # numeric column right-aligns; text column left-aligns
+    row = next(ln for ln in lines if "gbt" in ln)
+    assert row.startswith("| gbt ")
+    assert row.rstrip().endswith("0.88 |")
+    # truncation
+    out2 = format_table(["x"], [["y" * 100]], max_col_width=10)
+    assert "…" in out2

@@ -58,11 +58,13 @@ class ModelSelectorSummary:
             self.validation_results,
             key=lambda v: v.get("mean_metric", float("nan")),
             reverse=_larger_better(self.evaluation_metric))
-        lines.append(f"{'Model':<30} {'Grid':<45} {self.evaluation_metric}")
-        for v in ranked[:20]:
-            grid = str(v.get("grid", {}))[:44]
-            lines.append(f"{v['model_name']:<30} {grid:<45} "
-                         f"{v.get('mean_metric', float('nan')):.6f}")
+        from ..utils.table import format_table
+        lines.append(format_table(
+            ["Model", "Grid", self.evaluation_metric],
+            [[v["model_name"], str(v.get("grid", {})),
+              float(v.get("mean_metric", float("nan")))]
+             for v in ranked[:20]],
+            title="Evaluated models"))
         if self.train_evaluation:
             lines.append("Train evaluation: " + ", ".join(
                 f"{k}={v:.6f}" for k, v in sorted(self.train_evaluation.items())

@@ -336,6 +336,7 @@ def infer_avro_schema(rows: List[Dict[str, Any]],
                 keys.append(k)
     lo, hi = -(1 << 63), (1 << 63) - 1
     fields = []
+    used = set()
     for k in keys:
         vals = [r.get(k) for r in rows]
         present = [v for v in vals if v is not None]
@@ -353,10 +354,23 @@ def infer_avro_schema(rows: List[Dict[str, Any]],
             t = "double"
         else:
             t = "string"
-        fields.append({"name": avro_name(k),
+        fields.append({"name": _dedup_name(avro_name(k), used),
                        "type": ["null", t] if nullable or not present
                        else t})
     return {"type": "record", "name": avro_name(name), "fields": fields}
+
+
+def _dedup_name(base: str, used: set) -> str:
+    """Distinct sanitized names: 'a-b' and 'a_b' both map to 'a_b', which
+    would be a spec-invalid duplicate field AND silently collapse a
+    column — suffix collisions instead."""
+    out = base
+    i = 2
+    while out in used:
+        out = f"{base}_{i}"
+        i += 1
+    used.add(out)
+    return out
 
 
 def avro_name(raw: str) -> str:
@@ -398,13 +412,35 @@ def csv_to_avro(csv_path: str, avro_path: str,
         if rows:
             schema = infer_avro_schema(rows, name=base.title())
         else:
+            used: set = set()
             schema = {"type": "record", "name": avro_name(base.title()),
-                      "fields": [{"name": avro_name(h),
+                      "fields": [{"name": _dedup_name(avro_name(h), used),
                                   "type": ["null", "string"]}
                                  for h in headers]}
-    # original CSV column -> sanitized Avro field name, positionally
-    # (sanitizing is order-preserving)
-    key_of = dict(zip((f["name"] for f in schema["fields"]), headers))
+    # Avro field name -> original CSV column. Resolve by NAME (direct
+    # header match, then unique sanitized match); fall back to position
+    # only for the leftovers — a caller-supplied schema may order fields
+    # differently from the CSV, where a pure positional zip would swap
+    # columns.
+    by_sanitized: Dict[str, List[str]] = {}
+    for h in headers:
+        by_sanitized.setdefault(avro_name(h), []).append(h)
+    key_of: Dict[str, str] = {}
+    unresolved = []
+    taken = set()
+    for f in schema["fields"]:
+        fn = f["name"]
+        if fn in headers:
+            key_of[fn] = fn
+            taken.add(fn)
+        elif len(by_sanitized.get(fn, [])) == 1:
+            key_of[fn] = by_sanitized[fn][0]
+            taken.add(key_of[fn])
+        else:
+            unresolved.append(fn)
+    leftovers = [h for h in headers if h not in taken]
+    for fn, h in zip(unresolved, leftovers):
+        key_of[fn] = h
     types = {f["name"]: f["type"] for f in schema["fields"]}
 
     def norm(fname, v):
