@@ -338,3 +338,21 @@ class TestRound3DslBreadth:
                    output_type=IntegralT)
         out = _run(ds, ln).column(ln.name).data
         assert out[0] == 30 and out[1] == 20
+
+
+def test_map_phone_and_mime_ops():
+    """RichMapFeature.isValidPhoneDefaultCountryMap / detectMimeTypes."""
+    import base64
+    from transmogrifai_tpu.types import Base64Map, TextMap
+    pdf = base64.b64encode(b"%PDF-1.4").decode()
+    ds, (pm, bm) = TestFeatureBuilder.build(
+        ("pm", TextMap, [{"home": "+1 650 253 0000", "junk": "55"}, None]),
+        ("bm", Base64Map, [{"doc": pdf}, {}]))
+    valid = pm.is_valid_phone_map()
+    mimes = bm.detect_mime_types_map()
+    out = _run(ds, valid, mimes)
+    v0 = out.column(valid.name).data[0]
+    assert v0["home"] is True and v0["junk"] is False
+    m0 = out.column(mimes.name).data[0]
+    assert m0["doc"] == "application/pdf"
+    assert out.column(mimes.name).data[1] == {}

@@ -344,6 +344,21 @@ def _vectorize_map(self, *others, **kwargs):
     return stage.set_input(self, *others).get_output()
 
 
+def _is_valid_phone_map(self, default_region: str = "US"):
+    """Per-key phone validity (RichMapFeature
+    .isValidPhoneDefaultCountryMap)."""
+    from .transformers.misc import PhoneValidityMap
+    return PhoneValidityMap(default_region=default_region) \
+        .set_input(self).get_output()
+
+
+def _detect_mime_types_map(self):
+    """Per-key MIME detection on Base64 maps (RichMapFeature
+    .detectMimeTypes)."""
+    from .transformers.misc import MimeTypeMap
+    return MimeTypeMap().set_input(self).get_output()
+
+
 def _autobucketize_map(self, label: Feature, **kwargs):
     """Label-aware bucketization of every numeric map key
     (RichMapFeature.autoBucketize:542 ->
@@ -518,6 +533,8 @@ def install() -> None:
         "remove_stop_words": _remove_stop_words, "ngram": _ngram,
         "tf": _tf, "drop_indices_by": _drop_indices_by,
         "map": _map_feature,
+        "is_valid_phone_map": _is_valid_phone_map,
+        "detect_mime_types_map": _detect_mime_types_map,
     }
     for name, fn in ops.items():
         setattr(Feature, name, fn)

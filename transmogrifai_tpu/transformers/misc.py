@@ -17,8 +17,8 @@ from ..data.vector import VectorColumnMetadata, VectorMetadata
 from ..stages.base import Estimator, JaxTransformer, Transformer
 from ..stages.params import Param
 from ..types import (
-    Binary, ColumnKind, FeatureType, Integral, OPMap, OPVector, Real, RealMap,
-    RealNN,
+    Binary, BinaryMap, ColumnKind, FeatureType, Integral, OPMap, OPVector,
+    PickListMap, Real, RealMap, RealNN, TextMap,
 )
 
 
@@ -749,3 +749,51 @@ class ReplaceWithTransformer(Transformer):
         d = super().save_args()
         d.update(old_value=self.old_value, new_value=self.new_value)
         return d
+
+
+class PhoneValidityMap(Transformer):
+    """PhoneMap/TextMap -> BinaryMap of per-key phone validity (reference
+    RichMapFeature.isValidPhoneDefaultCountryMap via libphonenumber;
+    validation shares transformers/text.parse_phone)."""
+
+    input_types = (TextMap,)  # PhoneMap is a TextMap subtype
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("default_region", "region for bare numbers", "US")]
+
+    output_type = BinaryMap
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "phoneValidMap"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        from ..transformers.text import parse_phone
+        m = vals[0].value or {}
+        region = str(self.get_param("default_region"))
+        return BinaryMap({k: parse_phone(str(v), region)[0]
+                          for k, v in m.items() if v is not None})
+
+
+class MimeTypeMap(Transformer):
+    """Base64Map -> PickListMap of per-key detected MIME types (reference
+    RichMapFeature.detectMimeTypes via Tika; detection shares the scalar
+    detector's magic-byte matcher, transformers/text.detect_mime)."""
+
+    input_types = (TextMap,)  # Base64Map is a TextMap subtype
+    output_type = PickListMap
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "mimeMap"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        from ..transformers.text import detect_mime
+        m = vals[0].value or {}
+        out = {}
+        for k, v in m.items():
+            mime = detect_mime(v)
+            if mime is not None:
+                out[k] = mime
+        return PickListMap(out)

@@ -547,6 +547,26 @@ _MIME_MAGIC: List[Tuple[bytes, str]] = [
 ]
 
 
+def detect_mime(b64_value: Optional[str]) -> Optional[str]:
+    """MIME type of a base64 payload via magic bytes, or None for
+    empty/undecodable input (shared by the scalar and map detectors)."""
+    if not b64_value:
+        return None
+    try:
+        head = b64mod.b64decode(
+            b64_value[:64] + "=" * (-len(b64_value[:64]) % 4))
+    except Exception:
+        return None
+    for magic, mime in _MIME_MAGIC:
+        if head.startswith(magic):
+            return mime
+    try:
+        head.decode("utf-8")
+        return "text/plain"
+    except UnicodeDecodeError:
+        return "application/octet-stream"
+
+
 class MimeTypeDetector(Transformer):
     """Base64 -> PickList MIME type via magic bytes (reference
     MimeTypeDetector via Tika)."""
@@ -559,21 +579,7 @@ class MimeTypeDetector(Transformer):
                          uid=uid, **params)
 
     def transform_value(self, *vals):
-        v = vals[0].value
-        if not v:
-            return PickList(None)
-        try:
-            head = b64mod.b64decode(v[:64] + "=" * (-len(v[:64]) % 4))
-        except Exception:
-            return PickList(None)
-        for magic, mime in _MIME_MAGIC:
-            if head.startswith(magic):
-                return PickList(mime)
-        try:
-            head.decode("utf-8")
-            return PickList("text/plain")
-        except UnicodeDecodeError:
-            return PickList("application/octet-stream")
+        return PickList(detect_mime(vals[0].value))
 
 
 # Per-region phone metadata: (country code, set of valid NATIONAL number
