@@ -96,12 +96,16 @@ def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots):
 
     blk = xb_ref.shape[1]
     xf = xb_ref[:].astype(jnp.float32)                      # [F, blk]
-    bins = jax.lax.broadcasted_iota(jnp.float32, (1, B, 1), 1)
+    # Mosaic's tpu.iota only produces integer vectors; build int32 and cast
+    # (f32 iota verified fine in interpret mode but fails TPU lowering)
+    bins = jax.lax.broadcasted_iota(jnp.int32, (1, B, 1), 1) \
+        .astype(jnp.float32)
     oh = (xf[:, None, :] == bins).astype(jnp.float32)       # [F, B, blk]
     oh = oh.reshape(F * B, blk)
 
     slot = slot_ref[:]                                      # [1, blk]
-    slots = jax.lax.broadcasted_iota(jnp.float32, (n_slots, blk), 0)
+    slots = jax.lax.broadcasted_iota(jnp.int32, (n_slots, blk), 0) \
+        .astype(jnp.float32)
     slot_oh = (slots == slot).astype(jnp.float32)           # [n_slots, blk]
     pay = pay_ref[:]                                        # [C, blk]
     q = (slot_oh[:, None, :] * pay[None, :, :]).reshape(n_slots * C, blk)
