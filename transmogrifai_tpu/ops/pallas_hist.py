@@ -33,15 +33,34 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_BLK = 512
+_BLK = 4096
+
+
+def _tile_budget() -> int:
+    """VMEM budget for the [cols, blk] f32 one-hot tile, by device
+    generation. v5e+ carries 128MB of VMEM per core, so a 16MB tile (plus
+    the accumulator and payload tiles, all much smaller) clears the
+    compiler's headroom while cutting the grid-step count 4x vs the old
+    4MB budget — at 10M rows the per-step loop overhead and the skinny
+    [S*C, 256] matmuls were the tree sweep's real wall (8.5s warm fit,
+    BENCH_NOTES r3). Older generations (v2-v4: 16-32MB VMEM) keep the
+    conservative 4MB budget that is known to compile there."""
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return 4 << 20
+    if any(s in kind for s in ("v5", "v6", "v7")):
+        return 24 << 20
+    return 4 << 20
 
 
 def block_rows(n_onehot_cols: int) -> int:
     """Rows per grid step, sized so the [cols, blk] f32 one-hot tile stays
-    ~<= 4MB of VMEM (tree histograms: F*B ~ 2048 -> 512 rows; 4096-bin
-    rank metrics -> 256)."""
+    within the device's tile budget (v5e tree histograms: F*B ~ 2048 ->
+    2048 rows; 4096-bin rank metrics -> 1024)."""
     blk = _BLK
-    while blk > 128 and n_onehot_cols * blk * 4 > (4 << 20):
+    budget = _tile_budget()
+    while blk > 128 and n_onehot_cols * blk * 4 > budget:
         blk //= 2
     return blk
 
