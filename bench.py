@@ -261,31 +261,49 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
         V.STREAMED_SWEEP_MIN_ROWS = saved_min_rows
 
     log(f"tree sweep: {len(tgrids)} configs x {cfg['folds']} folds")
+    # On TPU the tree family runs in a KILLABLE subprocess: round-3 first
+    # contact saw fit_gbt HANG (not raise) inside the pallas path for 14+
+    # minutes — an in-process hang is unkillable (blocked RPC) and eats
+    # the whole bench budget with nothing recorded. The child regenerates
+    # the same device data (deterministic gen), so nothing is shipped.
     try:
-        t0 = time.perf_counter()
-        best_tree = val.validate([(OpXGBoostClassifier(),
-                                   [dict(g) for g in tgrids])], X, y)
-        tree_s = time.perf_counter() - t0
-        log(f"tree sweep done in {tree_s:.2f}s")
-    except Exception as e:
-        errors.append(f"tree sweep: {type(e).__name__}: {str(e)[:200]}")
-        # first contact with real hardware may surface a Mosaic/pallas
-        # compile failure — retry once on the XLA-only path rather than
-        # losing the whole tree family's perf record
-        from transmogrifai_tpu.ops import pallas_hist, trees as Tmod
-        if pallas_hist.available():  # only retry when pallas was in the trace
-            try:
-                Tmod.set_pallas_enabled(False)
-                log("retrying tree sweep without pallas")
-                t0 = time.perf_counter()
-                best_tree = val.validate([(OpXGBoostClassifier(),
-                                           [dict(g) for g in tgrids])], X, y)
-                tree_s = time.perf_counter() - t0
-                errors.append("tree sweep ok on retry without pallas")
-                log(f"tree sweep (no pallas) done in {tree_s:.2f}s")
-            except Exception as e2:
-                errors.append(f"tree sweep retry: {type(e2).__name__}: "
-                              f"{str(e2)[:200]}")
+        import jax
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    in_process = not (on_tpu
+                      and os.environ.get("BENCH_TREE_SUBPROC", "1") != "0")
+    if not in_process:
+        best_tree, tree_s, child_ran = _tree_sweep_subprocess(cfg, errors)
+        # single-tenant runtime: the child never got the device — the
+        # in-process path below is the one that works there
+        in_process = best_tree is None and not child_ran
+    if in_process:
+        try:
+            t0 = time.perf_counter()
+            best_tree = val.validate([(OpXGBoostClassifier(),
+                                       [dict(g) for g in tgrids])], X, y)
+            tree_s = time.perf_counter() - t0
+            log(f"tree sweep done in {tree_s:.2f}s")
+        except Exception as e:
+            errors.append(f"tree sweep: {type(e).__name__}: {str(e)[:200]}")
+            # a Mosaic/pallas compile failure surfaces as an exception —
+            # retry once on the XLA-only path rather than losing the family
+            from transmogrifai_tpu.ops import pallas_hist, trees as Tmod
+            if pallas_hist.available():
+                try:
+                    Tmod.set_pallas_enabled(False)
+                    log("retrying tree sweep without pallas")
+                    t0 = time.perf_counter()
+                    best_tree = val.validate(
+                        [(OpXGBoostClassifier(),
+                          [dict(g) for g in tgrids])], X, y)
+                    tree_s = time.perf_counter() - t0
+                    errors.append("tree sweep ok on retry without pallas")
+                    log(f"tree sweep (no pallas) done in {tree_s:.2f}s")
+                except Exception as e2:
+                    errors.append(f"tree sweep retry: {type(e2).__name__}: "
+                                  f"{str(e2)[:200]}")
 
     candidates = [b for b in (best_glm, best_tree) if b is not None]
     if not candidates:
@@ -296,9 +314,108 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
                tree_fits=len(tgrids) * cfg["folds"] if best_tree else 0,
                best_name=best.name, best_grid=best.best_grid,
                best_au_pr=float(best.best_metric))
+    child_flops = getattr(best_tree, "fit_flops", 0.0)
+    if child_flops:
+        out["tree_fit_flops"] = child_flops
     if glm_warm_s is not None:
         out["glm_warm_s"] = round(glm_warm_s, 3)
     return out
+
+
+class _TreeSweepResult:
+    """Duck-typed stand-in for the validator's BestEstimator when the tree
+    sweep ran in a child process (only the fields device_sweeps reads)."""
+
+    def __init__(self, name, best_grid, best_metric, fit_flops=0.0):
+        self.name = name
+        self.best_grid = best_grid
+        self.best_metric = best_metric
+        self.fit_flops = fit_flops
+
+
+def tree_sweep_child(cfg):
+    """Child-process body (--tree-sweep): regenerate the device data and
+    run the tree family through the validator; one JSON line out."""
+    import jax.numpy as jnp
+    from transmogrifai_tpu.automl.tuning.validators import CrossValidation
+    from transmogrifai_tpu.evaluators.evaluators import Evaluators
+    from transmogrifai_tpu.models.trees import OpXGBoostClassifier
+
+    dtype = jnp.bfloat16 if os.environ.get("BENCH_TREE_DTYPE",
+                                           "bf16") == "bf16" else jnp.float32
+    X, y, _ = device_data(cfg["n_rows"], cfg["n_cols"], cfg["folds"], dtype)
+    val = CrossValidation(Evaluators.BinaryClassification.au_pr(),
+                          num_folds=cfg["folds"], seed=42, sweep_dtype=dtype)
+    tgrids = gbt_grids(cfg)
+    t0 = time.perf_counter()
+    best = val.validate([(OpXGBoostClassifier(),
+                          [dict(g) for g in tgrids])], X, y)
+    dt = time.perf_counter() - t0
+    from transmogrifai_tpu.ops import pallas_hist
+    # per-fit FLOPs from XLA cost analysis, here where the jit cache is
+    # warm (the parent would re-lower — and re-risk a pallas compile hang)
+    flops = tree_flops_cost_analysis(cfg, dtype)
+    print("TREE|" + json.dumps(dict(
+        tree_s=round(dt, 3), name=best.name, best_grid=best.best_grid,
+        best_metric=float(best.best_metric), fit_flops=flops,
+        pallas=pallas_hist.available())), flush=True)
+
+
+def _tree_sweep_subprocess(cfg, errors, timeout_s=None):
+    """Run the tree family in a killable child; on hang/crash retry once
+    with pallas disabled. Returns (result_or_None, tree_s, child_ran):
+    child_ran=False means no child even initialized a backend (e.g. a
+    single-tenant libtpu refusing a second process) and the caller should
+    fall back to the in-process path."""
+    if timeout_s is None:
+        timeout_s = min(max(remaining() * 0.5, 300), 1200)
+    attempts = [("pallas", {}), ("no_pallas", {"TMOG_NO_PALLAS": "1"})]
+    from transmogrifai_tpu.ops import pallas_hist
+    if not pallas_hist.enabled():
+        attempts = attempts[1:]
+    child_ran = False
+    for tag, extra_env in attempts:
+        # the child timeout must fire well before the parent's SIGALRM
+        # (BUDGET_S-30): an orphaned child would keep the device busy
+        # after the parent reports
+        budget = min(timeout_s, remaining() - 90)
+        if budget < 240:
+            errors.append(f"tree sweep ({tag}) skipped: budget")
+            break
+        env = dict(os.environ)
+        env.update(extra_env)
+        log(f"tree sweep child ({tag}), timeout {budget:.0f}s")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--tree-sweep"],
+                capture_output=True, text=True, timeout=budget, env=env,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            child_ran = True  # it got far enough to hang on real work
+            errors.append(f"tree sweep ({tag}): HANG killed at {budget:.0f}s")
+            continue
+        for line in (r.stdout or "").splitlines():
+            if line.startswith("TREE|"):
+                d = json.loads(line[5:])
+                if tag == "no_pallas":
+                    errors.append("tree sweep ok on no-pallas child retry")
+                log(f"tree sweep child ({tag}) done in {d['tree_s']}s")
+                return (_TreeSweepResult(d["name"], d["best_grid"],
+                                         d["best_metric"],
+                                         d.get("fit_flops", 0.0)),
+                        d["tree_s"], True)
+        stderr = (r.stderr or "").strip()
+        # device-contention init failure: the runtime is single-tenant,
+        # so stop burning attempts and let the caller run in-process
+        if "already in use" in stderr.lower() or \
+                "unable to initialize backend" in stderr.lower():
+            errors.append(f"tree sweep child ({tag}): device single-tenant; "
+                          "falling back in-process")
+            return None, 0.0, False
+        child_ran = True
+        errors.append(f"tree sweep ({tag}): rc={r.returncode} "
+                      f"{stderr[-200:]}")
+    return None, 0.0, child_ran
 
 
 def glm_flops_estimate(cfg, route):
@@ -331,10 +448,18 @@ def tree_flops_cost_analysis(cfg, sweep_dtype):
         y = jax.ShapeDtypeStruct((n,), jnp.float32)
         w = jax.ShapeDtypeStruct((n,), jnp.float32)
         key = jax.ShapeDtypeStruct((2,), jnp.uint32)
-        lowered = T.fit_gbt.lower(
-            Xb, y, w, key, n_rounds=cfg["gbt_rounds"],
-            depth=cfg["gbt_depth"], n_bins=cfg["gbt_bins"])
-        cost = lowered.compile().cost_analysis()
+        # lower the XLA-only variant: custom-call FLOPs are invisible to
+        # cost analysis anyway, and a fresh Mosaic compile is the one step
+        # that has hung on first hardware contact (round 3)
+        pallas_was = T.pallas_enabled()
+        T.set_pallas_enabled(False)
+        try:
+            lowered = T.fit_gbt.lower(
+                Xb, y, w, key, n_rounds=cfg["gbt_rounds"],
+                depth=cfg["gbt_depth"], n_bins=cfg["gbt_bins"])
+            cost = lowered.compile().cost_analysis()
+        finally:
+            T.set_pallas_enabled(pallas_was)
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         return float(cost.get("flops", 0.0))
@@ -666,6 +791,9 @@ def main():
     if len(sys.argv) > 2 and sys.argv[1] == "--example":
         print(json.dumps({"s": round(run_example(sys.argv[2]), 2)}))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--tree-sweep":
+        tree_sweep_child(dict(TPU_CFG))
+        return
 
     signal.signal(signal.SIGALRM, emit_and_exit)
     signal.alarm(max(int(BUDGET_S) - 30, 60))
@@ -711,9 +839,11 @@ def main():
     # the FLOP model matched to the route that produced the timing
     glm_flops = (glm_flops_estimate(cfg, sweep.get("glm_route"))
                  if sweep["glm_fits"] else 0.0)
-    tree_flops = (tree_flops_cost_analysis(cfg, sweep_dtype)
-                  * cfg["gbt_grid"] * cfg["folds"]
-                  if sweep["tree_fits"] else 0.0)
+    per_fit = (sweep.get("tree_fit_flops")
+               or (tree_flops_cost_analysis(cfg, sweep_dtype)
+                   if sweep["tree_fits"] else 0.0))
+    tree_flops = per_fit * cfg["gbt_grid"] * cfg["folds"] \
+        if sweep["tree_fits"] else 0.0
     peak = next((p for s, p in PEAK_BF16 if s in kind.lower()), None)
     mfu = {"glm_tflops_analytic": round(glm_flops / 1e12, 2),
            "tree_tflops_xla": round(tree_flops / 1e12, 2),

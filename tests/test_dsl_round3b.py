@@ -1,0 +1,121 @@
+"""Round-3 dsl breadth: parse_phone, idf, deindexed, collect/filter_not,
+smart_vectorize, random_forest sugar.
+
+Mirrors reference dsl suites (RichTextFeatureTest parsePhone cases,
+RichVectorFeatureTest idf, RichFeatureTest collect/filterNot).
+"""
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.testkit import TestFeatureBuilder
+from transmogrifai_tpu.transformers.text import parse_phone_e164
+from transmogrifai_tpu.types import PickList, Real, RealNN, Text
+from transmogrifai_tpu.workflow import Workflow
+
+
+def _run(ds, *result_features):
+    wf = Workflow().set_input_dataset(ds).set_result_features(*result_features)
+    return wf.train().transform(ds)
+
+
+class TestParsePhone:
+    def test_e164_helper(self):
+        assert parse_phone_e164("(555) 123-4567", "US") == "+15551234567"
+        assert parse_phone_e164("+1 555 123 4567") == "+15551234567"
+        # NANP national form carrying the country code
+        assert parse_phone_e164("1-555-123-4567", "US") == "+15551234567"
+        assert parse_phone_e164("garbage") is None
+        assert parse_phone_e164("123") is None
+        # GB trunk prefix stripped before the cc is applied
+        out = parse_phone_e164("07911 123456", "GB")
+        assert out is not None and out.startswith("+44") and "07911" not in out
+
+    def test_dsl_stage(self):
+        ds, (p,) = TestFeatureBuilder.build(
+            ("p", Text, ["555-123-4567", "12", None]))
+        parsed = p.parse_phone()
+        out = _run(ds, parsed)
+        col = out.column(parsed.name).data
+        assert col[0] == "+15551234567"
+        assert col[1] is None and col[2] is None
+
+
+class TestIdf:
+    def test_matches_spark_formula(self):
+        docs = [["a", "b"], ["a"], ["a", "c"], []]
+        ds, (t,) = TestFeatureBuilder.build(
+            ("t", Text, [" ".join(d) for d in docs]))
+        counts = t.tokenize().count_vectorize(vocab_size=8)
+        scaled = counts.idf()
+        out = _run(ds, counts, scaled)
+        raw = out.column(counts.name).data
+        got = out.column(scaled.name).data
+        m = raw.shape[0]
+        df = (raw > 0).sum(axis=0)
+        expect = raw * np.log((m + 1.0) / (df + 1.0))[None, :]
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+        # idf passes metadata through untouched (count vectors carry none)
+        md = out.column(scaled.name).metadata
+        assert md is None or md.size == raw.shape[1]
+
+    def test_min_doc_freq_zeroes(self):
+        # df(a)=3 of m=3 (idf exactly 0 by the formula), df(b)=2, df(c)=1
+        ds, (t,) = TestFeatureBuilder.build(
+            ("t", Text, ["a b", "a b", "a c"]))
+        counts = t.tokenize().count_vectorize(vocab_size=8)
+        scaled = counts.idf(min_doc_freq=2)
+        out = _run(ds, counts, scaled)
+        raw = out.column(counts.name).data
+        got = out.column(scaled.name).data
+        df = (raw > 0).sum(axis=0)
+        assert np.all(got[:, df < 2] == 0.0)
+        # the df=2 column survives with idf log(4/3)
+        keep = (df == 2)
+        assert np.any(got[:, keep] != 0.0)
+
+
+class TestDeindexCollect:
+    def test_index_then_deindex_roundtrip(self):
+        vals = ["red", "blue", "red", "green"]
+        ds, (t,) = TestFeatureBuilder.build(("t", Text, vals))
+        idx = t.index_string()
+        # the indexer orders its vocabulary by frequency (Counter
+        # .most_common, insertion-stable on ties) — mirror that ordering
+        from collections import Counter
+        labels = [w for w, _ in Counter(vals).most_common()]
+        back = idx.deindexed(labels=labels)
+        out = _run(ds, back)
+        assert list(out.column(back.name).data) == vals
+
+    def test_collect_and_filter_not(self):
+        ds, (a,) = TestFeatureBuilder.build(("a", Real, [1.0, -2.0, 3.0]))
+        pos = a.collect(lambda v: v * 10 if v > 0 else None, default=0.0)
+        nn = a.filter_not(lambda v: v < 0, default=-99.0)
+        out = _run(ds, pos, nn)
+        np.testing.assert_allclose(out.column(pos.name).data, [10.0, 0.0, 30.0])
+        np.testing.assert_allclose(out.column(nn.name).data, [1.0, -99.0, 3.0])
+
+
+class TestVectorSugar:
+    def test_smart_vectorize_two_texts(self):
+        ds, (t1, t2) = TestFeatureBuilder.build(
+            ("t1", Text, ["x", "y", "x", "y"]),
+            ("t2", Text, ["p q", "r s", "p r", "q s"]))
+        vec = t1.smart_vectorize(t2, max_cardinality=3, num_features=16)
+        out = _run(ds, vec)
+        assert out.column(vec.name).data.shape[0] == 4
+        assert out.column(vec.name).data.shape[1] > 2
+
+    def test_random_forest_sugar(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=80)
+        y = (x > 0).astype(float)
+        ds, (label, xf) = TestFeatureBuilder.build(
+            ("label", RealNN, y.tolist()),
+            ("x", Real, x.tolist()))
+        vec = xf.vectorize()
+        pred = vec.random_forest(label, num_trees=5, max_depth=3)
+        out = _run(ds, pred)
+        from transmogrifai_tpu.models.prediction import prediction_of
+        preds = prediction_of(out.column(pred.name))
+        assert (preds == y).mean() > 0.9

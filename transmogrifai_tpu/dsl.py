@@ -493,6 +493,67 @@ def _loco_insights(self, model, top_k: int = 20):
         .set_input(self).get_output()
 
 
+def _parse_phone(self, default_region: str = "US"):
+    """Normalized E.164 text (RichTextFeature.parsePhone:464 /
+    parsePhoneDefaultCountry:489)."""
+    from .transformers.text import PhoneParser
+    return _unary(self, PhoneParser, default_region=default_region)
+
+
+def _deindexed(self, labels: Sequence[str]):
+    """Index -> original string label (RichNumericFeature.deindexed:418
+    via OpIndexToString). `labels` is the indexer's fitted vocabulary —
+    required here because, unlike Spark, no column metadata carries it."""
+    if not labels:
+        raise ValueError("deindexed() needs the fitted label vocabulary "
+                         "(the paired OpStringIndexer's ordering)")
+    from .transformers.text import OpIndexToString
+    return OpIndexToString(labels=list(labels)).set_input(self).get_output()
+
+
+def _filter_not(self, pred, default, operation_name: str = "filterNot"):
+    """Complement of filter_values (RichFeature.filterNot:148)."""
+    return _filter_values(self, lambda v, _p=pred: not _p(v), default,
+                          operation_name=operation_name)
+
+
+def _collect(self, fn, default, output_type=None,
+             operation_name: str = "collect"):
+    """Partial map: `fn` returns None where undefined, replaced by
+    `default` (RichFeature.collect:160)."""
+    from .stages.base import LambdaTransformer
+    out_t = output_type or self.feature_type
+
+    def apply(v, _f=fn, _t=out_t, _d=default):
+        r = None if v.value is None else _f(v.value)
+        return _t(_d if r is None else r)
+
+    return LambdaTransformer(operation_name, apply, (self.feature_type,),
+                             out_t).set_input(self).get_output()
+
+
+def _idf(self, min_doc_freq: int = 0):
+    """Inverse-document-frequency rescaling of a count vector
+    (RichVectorFeature.idf:56)."""
+    from .transformers.text import OpIDF
+    return _unary(self, OpIDF, min_doc_freq=min_doc_freq)
+
+
+def _random_forest_vec(self, label: Feature, **params):
+    """Fit a random-forest classifier on (label, vector) and emit the
+    Prediction feature (RichVectorFeature.randomForest:77)."""
+    from .models.trees import OpRandomForestClassifier
+    return OpRandomForestClassifier(**params) \
+        .set_input(label, self).get_output()
+
+
+def _smart_vectorize(self, *others, **kwargs):
+    """Cardinality-adaptive text vectorization (RichTextFeature
+    .smartVectorize:223 -> SmartTextVectorizer)."""
+    from .automl.vectorizers.text import SmartTextVectorizer
+    return SmartTextVectorizer(**kwargs).set_input(self, *others).get_output()
+
+
 def install() -> None:
     """Install the dsl methods on Feature (idempotent)."""
     ops = {
@@ -535,6 +596,12 @@ def install() -> None:
         "map": _map_feature,
         "is_valid_phone_map": _is_valid_phone_map,
         "detect_mime_types_map": _detect_mime_types_map,
+        "parse_phone": _parse_phone, "deindexed": _deindexed,
+        "filter_not": _filter_not, "collect": _collect, "idf": _idf,
+        "random_forest": _random_forest_vec,
+        "smart_vectorize": _smart_vectorize,
+        "to_date_time_list": _to_date_list,  # DateTime in -> DateTimeList
+        "auto_transform": _vectorize,  # RichFeaturesCollection alias
     }
     for name, fn in ops.items():
         setattr(Feature, name, fn)

@@ -629,38 +629,40 @@ for _r, (_cc, _lens, _tp) in _PHONE_REGIONS.items():
     _CC_TO_REGIONS.setdefault(_cc, []).append(_r)
 
 
-def parse_phone(raw: str, default_region: str = "US"
-                ) -> Tuple[bool, Optional[str]]:
-    """(is_valid, region) for a raw phone string — structural validation
-    against per-region numbering metadata (reference
+def _resolve_phone(raw: str, default_region: str = "US"
+                   ) -> Tuple[bool, Optional[str], Optional[str]]:
+    """(is_valid, region, e164) — THE phone resolution path (reference
     PhoneNumberParser.scala:566 wraps libphonenumber; this is a compacted
-    50-region metadata table with the same decision shape: resolve
-    region from +cc or the default, strip trunk prefix, check national
-    length)."""
+    50-region metadata table with the same decision shape: resolve region
+    from +cc or the default, strip trunk prefix, check national length).
+    Validity (parse_phone) and normalization (parse_phone_e164) are views
+    of this one function so they can never disagree."""
     if not raw:
-        return False, None
+        return False, None, None
     s = raw.strip()
     digits = re.sub(r"[^\d+]", "", s)
     if digits.count("+") > 1 or ("+" in digits and not digits.startswith("+")):
-        return False, None
+        return False, None, None
     if digits.startswith("+"):
         body = digits[1:]
         if not body.isdigit():
-            return False, None
+            return False, None, None
         for cc_len in (3, 2, 1):
             cc = int(body[:cc_len]) if len(body) >= cc_len else -1
             for region in _CC_TO_REGIONS.get(cc, ()):
                 _, lens, _trunk = _PHONE_REGIONS[region]
                 if len(body) - cc_len in lens:
-                    return True, region
+                    return True, region, "+" + body
         # unknown cc: fall back to the ITU E.164 structural bound
-        return 8 <= len(body) <= 15, None
+        ok = 8 <= len(body) <= 15
+        return ok, None, ("+" + body) if ok else None
     if not digits.isdigit() or not digits:
-        return False, None
+        return False, None, None
     region = default_region.upper()
     meta = _PHONE_REGIONS.get(region)
     if meta is None:
-        return 7 <= len(digits) <= 15, None
+        # structurally plausible but no metadata to produce a +cc form
+        return 7 <= len(digits) <= 15, None, None
     cc, lens, trunk = meta
     national = digits
     cc_str = str(cc)
@@ -670,7 +672,15 @@ def parse_phone(raw: str, default_region: str = "US"
     elif trunk and national.startswith(trunk) and \
             (len(national) - len(trunk)) in lens:
         national = national[len(trunk):]
-    return len(national) in lens, region
+    ok = len(national) in lens
+    return ok, region, f"+{cc}{national}" if ok else None
+
+
+def parse_phone(raw: str, default_region: str = "US"
+                ) -> Tuple[bool, Optional[str]]:
+    """(is_valid, region) for a raw phone string — see _resolve_phone."""
+    ok, region, _ = _resolve_phone(raw, default_region)
+    return ok, region
 
 
 class PhoneNumberParser(Transformer):
@@ -699,6 +709,90 @@ class PhoneNumberParser(Transformer):
             digits = re.sub(r"\D", "", v)
             ok = 7 <= len(digits) <= 15
         return Binary(bool(ok))
+
+
+def parse_phone_e164(raw: str, default_region: str = "US") -> Optional[str]:
+    """Normalized ``+<cc><national>`` form, or None when invalid
+    (reference RichPhoneFeature.parsePhone -> libphonenumber E164).
+    Same single resolution path as parse_phone (_resolve_phone)."""
+    return _resolve_phone(raw, default_region)[2]
+
+
+class PhoneParser(Transformer):
+    """Phone/Text -> normalized E.164 Text, empty when unparseable
+    (reference RichPhoneFeature.parsePhone / parsePhoneDefaultCountry)."""
+
+    input_types = (Text,)
+    output_type = Text
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("default_region", "region for bare numbers", "US")]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "parsePhone"),
+                         uid=uid, **params)
+
+    def transform_value(self, *vals):
+        v = vals[0].value
+        if not v:
+            return Text(None)
+        return Text(parse_phone_e164(v, str(self.get_param("default_region"))))
+
+
+class OpIDF(Estimator):
+    """OPVector -> OPVector rescaled by inverse document frequency
+    (reference RichVectorFeature.idf:56 wrapping Spark ml IDF): per column
+    j, idf_j = log((m + 1) / (df_j + 1)) with df_j = #rows where x_j > 0;
+    columns under min_doc_freq get idf 0 (Spark's semantics). Fit is one
+    columnwise reduction over the dense matrix."""
+
+    input_types = (OPVector,)
+    output_type = OPVector
+
+    @classmethod
+    def _declare_params(cls):
+        return [Param("min_doc_freq", "df below this zeroes the column", 0)]
+
+    def __init__(self, uid: Optional[str] = None, **params):
+        super().__init__(params.pop("operation_name", "idf"), uid=uid,
+                         **params)
+
+    def fit_columns(self, *cols: Column) -> Transformer:
+        X = np.asarray(cols[0].data, np.float32)
+        m = X.shape[0]
+        df = (X > 0).sum(axis=0).astype(np.float64)
+        idf = np.log((m + 1.0) / (df + 1.0))
+        idf[df < int(self.get_param("min_doc_freq"))] = 0.0
+        return OpIDFModel(idf=idf, operation_name=self.operation_name)
+
+
+class OpIDFModel(Transformer):
+    input_types = (OPVector,)
+    output_type = OPVector
+
+    def __init__(self, idf: Optional[Sequence[float]] = None,
+                 uid: Optional[str] = None, **params):
+        self.idf = np.asarray([] if idf is None else idf, np.float32)
+        super().__init__(params.pop("operation_name", "idf"), uid=uid,
+                         **params)
+
+    def transform_columns(self, *cols: Column) -> Column:
+        vec = cols[0]
+        if not len(self.idf):  # unfitted default: identity
+            return vec
+        return Column(kind=ColumnKind.VECTOR,
+                      data=np.asarray(vec.data, np.float32) * self.idf[None, :],
+                      metadata=vec.metadata)
+
+    def transform_value(self, *vals):
+        x = np.asarray(vals[0].value, np.float32)
+        return OPVector(x * self.idf if len(self.idf) else x)
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(idf=[float(v) for v in self.idf])
+        return d
 
 
 class EmailToPickList(Transformer):
