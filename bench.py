@@ -381,9 +381,13 @@ def _tree_sweep_subprocess(cfg, errors, timeout_s=None):
         budget = min(timeout_s, remaining() - 90)
         if budget < 240:
             errors.append(f"tree sweep ({tag}) skipped: budget")
+            # not a single-tenant signal: the caller must NOT fall back to
+            # the unkillable in-process path with this little budget left
+            child_ran = True
             break
         env = dict(os.environ)
         env.update(extra_env)
+        env["BENCH_TREE_CFG"] = json.dumps(cfg)  # child runs THIS config
         log(f"tree sweep child ({tag}), timeout {budget:.0f}s")
         try:
             r = subprocess.run(
@@ -792,7 +796,9 @@ def main():
         print(json.dumps({"s": round(run_example(sys.argv[2]), 2)}))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--tree-sweep":
-        tree_sweep_child(dict(TPU_CFG))
+        cfg_json = os.environ.get("BENCH_TREE_CFG")
+        tree_sweep_child(json.loads(cfg_json) if cfg_json
+                         else dict(TPU_CFG))
         return
 
     signal.signal(signal.SIGALRM, emit_and_exit)

@@ -643,7 +643,7 @@ class Validator:
                 # sketch must see only the real rows so mesh and meshless
                 # sweeps grow from identical bin edges
                 ctx = est.copy(**grids[group[0]]).mask_sweep_context(
-                    Xd, n_valid=X.shape[0])
+                    Xd, n_valid=X.shape[0], mesh=self.mesh)
                 for gi in group:
                     est_g = est.copy(**grids[gi])
                     scores = est_g.mask_fit_scores(

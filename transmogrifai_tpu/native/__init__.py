@@ -1,5 +1,7 @@
 """Native host kernels (C++, ctypes-loaded): murmur3 hashing trick, fused
-tokenize+hash+count, CSV scanning. See build.py and ops/native_bridge.py."""
-from .build import LIB, SRC, build
+tokenize+hash+count, CSV scanning (hashing.cpp) and the occupancy-aware
+tree builder (trees.cpp). See build.py, ops/native_bridge.py and
+ops/trees_host.py."""
+from .build import LIB, SOURCES, build
 
-__all__ = ["LIB", "SRC", "build"]
+__all__ = ["LIB", "SOURCES", "build"]

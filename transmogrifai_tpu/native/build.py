@@ -1,9 +1,11 @@
 """On-demand build of the native host-kernel library.
 
-Compiles native/hashing.cpp into _tmog_native.so next to this file with the
-baked-in g++ toolchain; rebuilt when the source is newer than the binary.
-Everything degrades gracefully — when no compiler is available the callers
-fall back to the NumPy paths (see ops/native_bridge.py).
+Compiles native/*.cpp (hashing.cpp text/CSV kernels + trees.cpp
+occupancy-aware tree builder) into _tmog_native.so next to this file with
+the baked-in g++ toolchain; rebuilt when any source is newer than the
+binary. Everything degrades gracefully — when no compiler is available the
+callers fall back to the NumPy/XLA paths (see ops/native_bridge.py,
+ops/trees_host.py).
 """
 from __future__ import annotations
 
@@ -12,21 +14,23 @@ import subprocess
 from typing import Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-SRC = os.path.join(_DIR, "hashing.cpp")
+SOURCES = [os.path.join(_DIR, "hashing.cpp"), os.path.join(_DIR, "trees.cpp")]
 LIB = os.path.join(_DIR, "_tmog_native.so")
 
 
 def build(force: bool = False) -> Optional[str]:
     """Build (if needed) and return the library path, or None on failure."""
-    if not os.path.exists(SRC):
+    srcs = [s for s in SOURCES if os.path.exists(s)]
+    if not srcs:
         return None
     if (not force and os.path.exists(LIB)
-            and os.path.getmtime(LIB) >= os.path.getmtime(SRC)):
+            and all(os.path.getmtime(LIB) >= os.path.getmtime(s)
+                    for s in srcs)):
         return LIB
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", LIB, SRC]
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", LIB] + srcs
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=120)
+                              timeout=240)
     except (OSError, subprocess.TimeoutExpired):
         return None
     if proc.returncode != 0:

@@ -1,0 +1,411 @@
+// Native host tree builder — the CPU twin of ops/trees.py.
+//
+// The XLA tree kernels are designed for the TPU regime (N >> 2^depth):
+// dense per-level histograms over all 2^d nodes lower to MXU contractions
+// and tile perfectly. On the host at small N with deep trees (the
+// reference's default RF grid reaches maxDepth=12 -> 4096-node levels for
+// 900-row Titanic) that density is pure waste: most nodes are empty or
+// stopped. This builder is the occupancy-aware equivalent — per-node row
+// partitions, work only on live nodes, early subtree termination — i.e.
+// the same role libxgboost's C++ hist algorithm plays for the reference
+// (XGBoost4J JNI, SURVEY 2.9). Semantics mirror ops/trees.py grow_tree:
+//   - binned matrix with dedicated missing bin 0, present bins [1, B-1]
+//   - gain = sum_k GL_k^2/(HL+l) + GR_k^2/(HR+l) - Gt_k^2/(Ht+l) with
+//     sparsity-aware missing direction (left prefix keeps / drops the
+//     missing-bin mass), validity = min_child_weight / min_instances /
+//     min_info_gain (optionally normalized by max(Ht,1)) / gamma
+//   - candidate order (feature, bin, direction) with first-max wins,
+//     matching jnp.argmax over the same flattening
+//   - dead node encoding feat=0, thresh=B-1, miss=0 (all rows left); a
+//     dead node's subtree is provably dead (children inherit the exact
+//     row set), so its mass lands at the leftmost descendant leaf
+//   - leaf = lr * -G/(H+lambda+eps) (newton) or G/(H+eps) (mean),
+//     zeroed when the (H>0) row count is < 0.5
+// Differences: accumulation in double (XLA: f32 tree-reduce) and its own
+// splitmix64 RNG for bootstrap/feature subsets — near-tie splits and
+// sampled ensembles agree statistically, not bit-for-bit.
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+constexpr double EPS = 1e-12;
+
+struct Rng {  // splitmix64
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed) {}
+  uint64_t next() {
+    uint64_t z = (s += 0x9e3779b97f4a7c15ULL);
+    z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+    return z ^ (z >> 31);
+  }
+  double uniform() { return (next() >> 11) * 0x1.0p-53; }
+  int poisson(double mean) {  // Knuth; mean <= ~10 here
+    double L = std::exp(-mean), p = 1.0;
+    int k = 0;
+    do { ++k; p *= uniform(); } while (p > L);
+    return k - 1;
+  }
+};
+
+struct GrowParams {
+  int depth, B, K;
+  double reg_lambda, min_child_weight, min_instances, min_info_gain, gamma;
+  bool normalize_gain;
+  double lr;
+  int leaf_mode;  // 0 newton, 1 mean
+  double feature_frac;  // < 1 => per-node subsets (RF)
+};
+
+struct Node { int rel; int lo; int hi; };  // within-level id + idx range
+
+inline double score(const double* g, double h, int K, double lam) {
+  double s = 0.0;
+  for (int k = 0; k < K; ++k) s += g[k] * g[k];
+  return s / (h + lam + EPS);
+}
+
+// Grow one tree. Xb [N, F] int32 bins; G [N, K]; H [N]. Outputs feat/
+// thresh/miss [2^depth - 1] (pre-filled dead), leaf [2^depth, K]
+// (pre-zeroed), and per-row payload `row_out` [N, K] (training-time
+// prediction for boosting; may be null).
+void grow_tree(const int32_t* Xb, int64_t N, int F, const float* G,
+               const float* H, const GrowParams& P,
+               const uint8_t* tree_fmask, Rng& rng,
+               int32_t* feat, int32_t* thresh, int32_t* miss, float* leaf,
+               float* row_out, int32_t* idx, int32_t* idx_tmp) {
+  const int B = P.B, K = P.K, depth = P.depth;
+  const int M = (1 << depth) - 1;
+  const int L = 1 << depth;
+  for (int i = 0; i < M; ++i) { feat[i] = 0; thresh[i] = B - 1; miss[i] = 0; }
+  std::memset(leaf, 0, sizeof(float) * L * K);
+  for (int64_t r = 0; r < N; ++r) idx[r] = (int32_t)r;
+
+  // per-node (feature, bin) histograms, reused across nodes
+  std::vector<double> hg((size_t)F * B * K), hh((size_t)F * B),
+      hc((size_t)F * B);
+  std::vector<double> cg(K), bg(K);
+  std::vector<uint8_t> node_fmask(F);
+
+  auto finalize = [&](int lvl, int rel, int lo, int hi) {
+    // node (lvl, rel) takes no further splits: payload at the leftmost
+    // descendant leaf (all-left dead routing)
+    double gs_[16];
+    std::vector<double> gs_v;
+    double* gs = K <= 16 ? gs_ : (gs_v.resize(K), gs_v.data());
+    for (int k = 0; k < K; ++k) gs[k] = 0.0;
+    double hs = 0.0, cs = 0.0;
+    for (int i = lo; i < hi; ++i) {
+      const int32_t r = idx[i];
+      for (int k = 0; k < K; ++k) gs[k] += G[(size_t)r * K + k];
+      hs += H[r];
+      cs += H[r] > 0.f ? 1.0 : 0.0;
+    }
+    const int leaf_rel = rel << (depth - lvl);
+    float* out = leaf + (size_t)leaf_rel * K;
+    if (cs >= 0.5) {
+      for (int k = 0; k < K; ++k)
+        out[k] = (float)(P.lr * (P.leaf_mode == 0
+                                     ? -gs[k] / (hs + P.reg_lambda + EPS)
+                                     : gs[k] / (hs + EPS)));
+    }
+    if (row_out) {
+      for (int i = lo; i < hi; ++i)
+        for (int k = 0; k < K; ++k)
+          row_out[(size_t)idx[i] * K + k] = out[k];
+    }
+  };
+
+  std::vector<Node> cur{{0, 0, (int)N}}, nxt;
+  for (int lvl = 0; lvl < depth; ++lvl) {
+    nxt.clear();
+    for (const Node& nd : cur) {
+      if (nd.hi == nd.lo) continue;  // empty subtree: zeros everywhere
+      // histograms over this node's rows
+      std::memset(hg.data(), 0, sizeof(double) * hg.size());
+      std::memset(hh.data(), 0, sizeof(double) * hh.size());
+      std::memset(hc.data(), 0, sizeof(double) * hc.size());
+      double ht = 0.0, ct = 0.0;
+      std::vector<double> gt(K, 0.0);
+      for (int i = nd.lo; i < nd.hi; ++i) {
+        const int32_t r = idx[i];
+        const int32_t* xr = Xb + (size_t)r * F;
+        const float* gr = G + (size_t)r * K;
+        const double h = H[r];
+        const double c = H[r] > 0.f ? 1.0 : 0.0;
+        for (int f = 0; f < F; ++f) {
+          const size_t cell = (size_t)f * B + xr[f];
+          double* gcell = hg.data() + cell * K;
+          for (int k = 0; k < K; ++k) gcell[k] += gr[k];
+          hh[cell] += h;
+          hc[cell] += c;
+        }
+        for (int k = 0; k < K; ++k) gt[k] += gr[k];
+        ht += h;
+        ct += c;
+      }
+      const double parent = score(gt.data(), ht, K, P.reg_lambda);
+      const double norm = P.normalize_gain ? std::max(ht, 1.0) : 1.0;
+
+      const uint8_t* fmask = tree_fmask;
+      if (P.feature_frac < 1.0) {
+        // per-node feature subset (Spark featureSubsetStrategy): partial
+        // Fisher-Yates drawing kf distinct features
+        int kf = std::max(1, (int)std::lround(P.feature_frac * F));
+        std::fill(node_fmask.begin(), node_fmask.end(), 0);
+        std::vector<int> ids(F);
+        for (int f = 0; f < F; ++f) ids[f] = f;
+        for (int t = 0; t < kf; ++t) {
+          int j = t + (int)(rng.next() % (uint64_t)(F - t));
+          std::swap(ids[t], ids[j]);
+          node_fmask[ids[t]] = 1;
+        }
+        fmask = node_fmask.data();
+      }
+
+      // split search: (feature, bin, direction) first-max order
+      double best_gain = -1.0;
+      int bf = -1, bt = -1, bm = 0;
+      for (int f = 0; f < F; ++f) {
+        if (fmask && !fmask[f]) continue;
+        const double* fg = hg.data() + (size_t)f * B * K;
+        const double* fh = hh.data() + (size_t)f * B;
+        const double* fc = hc.data() + (size_t)f * B;
+        const double* gm = fg;        // missing-bin (slot 0) mass
+        const double hm = fh[0], cm = fc[0];
+        for (int k = 0; k < K; ++k) cg[k] = 0.0;
+        double chl = 0.0, ccl = 0.0;
+        for (int b = 0; b < B; ++b) {
+          for (int k = 0; k < K; ++k) cg[k] += fg[(size_t)b * K + k];
+          chl += fh[b];
+          ccl += fc[b];
+          for (int dir = 0; dir < 2; ++dir) {
+            double hl = chl, cl = ccl;
+            const double* gl = cg.data();
+            if (dir == 1) {  // move missing mass right
+              for (int k = 0; k < K; ++k) bg[k] = cg[k] - gm[k];
+              gl = bg.data();
+              hl -= hm;
+              cl -= cm;
+            }
+            const double hr = ht - hl, cr = ct - cl;
+            double sr = 0.0, sl = 0.0, grk;
+            for (int k = 0; k < K; ++k) {
+              grk = gt[k] - gl[k];
+              sr += grk * grk;
+            }
+            for (int k = 0; k < K; ++k) sl += gl[k] * gl[k];
+            const double gain = sl / (hl + P.reg_lambda + EPS)
+                + sr / (hr + P.reg_lambda + EPS) - parent;
+            const bool ok = hl >= P.min_child_weight
+                && hr >= P.min_child_weight && cl >= P.min_instances
+                && cr >= P.min_instances && gain / norm > P.min_info_gain
+                && gain > 2.0 * P.gamma;
+            if (ok && gain > best_gain) {
+              best_gain = gain;
+              bf = f; bt = b; bm = dir;
+            }
+          }
+        }
+      }
+
+      const int gi = (1 << lvl) - 1 + nd.rel;
+      if (bf < 0) {  // no valid split: terminal (whole subtree dead)
+        finalize(lvl, nd.rel, nd.lo, nd.hi);
+        continue;
+      }
+      feat[gi] = bf;
+      thresh[gi] = bt;
+      miss[gi] = bm;
+
+      // partition rows: right iff bin > t or (bin == 0 and miss)
+      int nl = nd.lo, nr = 0;
+      for (int i = nd.lo; i < nd.hi; ++i) {
+        const int32_t r = idx[i];
+        const int32_t b = Xb[(size_t)r * F + bf];
+        const bool right = (b > bt) || (b == 0 && bm > 0);
+        if (right) idx_tmp[nr++] = r;
+        else idx[nl++] = r;
+      }
+      std::memcpy(idx + nl, idx_tmp, sizeof(int32_t) * nr);
+      nxt.push_back({2 * nd.rel, nd.lo, nl});
+      nxt.push_back({2 * nd.rel + 1, nl, nd.hi});
+    }
+    cur.swap(nxt);
+  }
+  for (const Node& nd : cur)  // survivors at full depth -> real leaves
+    if (nd.hi > nd.lo) finalize(depth, nd.rel, nd.lo, nd.hi);
+}
+
+void tree_feature_mask(std::vector<uint8_t>& mask, int F,
+                       double feature_frac, Rng& rng) {
+  mask.assign(F, 1);
+  if (feature_frac >= 1.0) return;
+  int kf = std::max(1, (int)std::lround(feature_frac * F));
+  mask.assign(F, 0);
+  std::vector<int> ids(F);
+  for (int f = 0; f < F; ++f) ids[f] = f;
+  for (int t = 0; t < kf; ++t) {
+    int j = t + (int)(rng.next() % (uint64_t)(F - t));
+    std::swap(ids[t], ids[j]);
+    mask[ids[t]] = 1;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Binary-logistic / squared-loss boosting (ops/trees.fit_gbt twin).
+// feat/thresh/miss [n_rounds, 2^depth - 1]; leaf [n_rounds, 2^depth].
+int tmog_gbt_fit(const int32_t* Xb, int64_t N, int32_t F, int32_t B,
+                 const float* y, const float* w, int32_t loss,
+                 int32_t n_rounds, int32_t depth, double lr,
+                 double reg_lambda, double min_child_weight,
+                 double min_instances, double min_info_gain, double gamma,
+                 double subsample, double feature_frac, uint64_t seed,
+                 int32_t* feat, int32_t* thresh, int32_t* miss, float* leaf,
+                 float* base_out) {
+  if (N <= 0 || depth < 1 || depth > 20) return 1;
+  Rng rng(seed * 0x9e3779b97f4a7c15ULL + 1);
+  double wsum = 0.0, wy = 0.0;
+  for (int64_t r = 0; r < N; ++r) { wsum += w[r]; wy += w[r] * y[r]; }
+  wsum += EPS;
+  double base;
+  if (loss == 0) {
+    double p0 = std::min(std::max(wy / wsum, 1e-6), 1.0 - 1e-6);
+    base = std::log(p0 / (1.0 - p0));
+  } else {
+    base = wy / wsum;
+  }
+  *base_out = (float)base;
+
+  const int M = (1 << depth) - 1, L = 1 << depth;
+  std::vector<float> margin(N, (float)base), g(N), h(N), step(N);
+  std::vector<float> gsub(N), hsub(N);
+  std::vector<int32_t> idx(N), idx_tmp(N);
+  std::vector<uint8_t> fmask;
+  GrowParams P{depth, B, 1, reg_lambda, min_child_weight, min_instances,
+               min_info_gain, gamma, false, lr, 0, 1.0};
+  for (int t = 0; t < n_rounds; ++t) {
+    for (int64_t r = 0; r < N; ++r) {
+      if (loss == 0) {
+        const double m = margin[r];
+        const double p = 1.0 / (1.0 + std::exp(-m));
+        g[r] = (float)(w[r] * (p - y[r]));
+        h[r] = (float)std::max((double)w[r] * p * (1.0 - p), EPS);
+      } else {
+        g[r] = w[r] * (margin[r] - y[r]);
+        h[r] = w[r];
+      }
+    }
+    float* gp = g.data();
+    float* hp = h.data();
+    if (subsample < 1.0) {
+      for (int64_t r = 0; r < N; ++r) {
+        const float keep = rng.uniform() < subsample ? 1.f : 0.f;
+        gsub[r] = g[r] * keep;
+        hsub[r] = h[r] * keep;
+      }
+      gp = gsub.data();
+      hp = hsub.data();
+    }
+    tree_feature_mask(fmask, F, feature_frac, rng);
+    grow_tree(Xb, N, F, gp, hp, P, fmask.data(), rng,
+              feat + (size_t)t * M, thresh + (size_t)t * M,
+              miss + (size_t)t * M, leaf + (size_t)t * L, step.data(),
+              idx.data(), idx_tmp.data());
+    for (int64_t r = 0; r < N; ++r) margin[r] += step[r];
+  }
+  return 0;
+}
+
+// Multiclass softmax boosting (fit_gbt_softmax twin).
+// Outputs stacked [n_rounds * n_classes] trees (round-major, class-minor).
+int tmog_gbt_softmax_fit(const int32_t* Xb, int64_t N, int32_t F, int32_t B,
+                         const float* y, const float* w, int32_t n_classes,
+                         int32_t n_rounds, int32_t depth, double lr,
+                         double reg_lambda, double min_child_weight,
+                         double gamma, double subsample, double feature_frac,
+                         uint64_t seed, int32_t* feat, int32_t* thresh,
+                         int32_t* miss, float* leaf) {
+  if (N <= 0 || depth < 1 || depth > 20 || n_classes < 2) return 1;
+  Rng rng(seed * 0x9e3779b97f4a7c15ULL + 2);
+  const int M = (1 << depth) - 1, L = 1 << depth, C = n_classes;
+  std::vector<float> margin((size_t)N * C, 0.f), p((size_t)N * C);
+  std::vector<float> g(N), h(N), step(N), keep(N);
+  std::vector<int32_t> idx(N), idx_tmp(N);
+  std::vector<uint8_t> fmask;
+  // min_instances=1, min_info_gain=0: fit_gbt_softmax grows with
+  // grow_tree's defaults for those
+  GrowParams P{depth, B, 1, reg_lambda, min_child_weight, 1.0, 0.0, gamma,
+               false, lr, 0, 1.0};
+  for (int t = 0; t < n_rounds; ++t) {
+    for (int64_t r = 0; r < N; ++r) {  // softmax over classes
+      const float* mr = margin.data() + (size_t)r * C;
+      float mx = mr[0];
+      for (int c = 1; c < C; ++c) mx = std::max(mx, mr[c]);
+      double Z = 0.0;
+      for (int c = 0; c < C; ++c) Z += std::exp((double)mr[c] - mx);
+      for (int c = 0; c < C; ++c)
+        p[(size_t)r * C + c] = (float)(std::exp((double)mr[c] - mx) / Z);
+    }
+    for (int64_t r = 0; r < N; ++r)
+      keep[r] = (subsample >= 1.0 || rng.uniform() < subsample) ? 1.f : 0.f;
+    tree_feature_mask(fmask, F, feature_frac, rng);
+    for (int c = 0; c < C; ++c) {
+      for (int64_t r = 0; r < N; ++r) {
+        const double pc = p[(size_t)r * C + c];
+        const double yc = ((int)y[r] == c) ? 1.0 : 0.0;
+        g[r] = (float)(w[r] * (pc - yc)) * keep[r];
+        h[r] = (float)std::max((double)w[r] * pc * (1.0 - pc), EPS)
+            * keep[r];
+      }
+      const size_t ti = (size_t)t * C + c;
+      grow_tree(Xb, N, F, g.data(), h.data(), P, fmask.data(), rng,
+                feat + ti * M, thresh + ti * M, miss + ti * M, leaf + ti * L,
+                step.data(), idx.data(), idx_tmp.data());
+      for (int64_t r = 0; r < N; ++r) margin[(size_t)r * C + c] += step[r];
+    }
+  }
+  return 0;
+}
+
+// Random forest / single tree (fit_forest twin): mean-mode leaves, Poisson
+// bootstrap, per-node feature subsets. G [N, K] payload (class one-hots x
+// weight, or y x weight); H [N] weights. leaf [n_trees, 2^depth, K].
+int tmog_rf_fit(const int32_t* Xb, int64_t N, int32_t F, int32_t B,
+                const float* G, const float* H, int32_t K, int32_t n_trees,
+                int32_t depth, double reg_lambda, double min_instances,
+                double min_info_gain, double subsample, double feature_frac,
+                int32_t bootstrap, uint64_t seed, int32_t* feat,
+                int32_t* thresh, int32_t* miss, float* leaf) {
+  if (N <= 0 || depth < 1 || depth > 20 || K < 1) return 1;
+  Rng rng(seed * 0x9e3779b97f4a7c15ULL + 3);
+  const int M = (1 << depth) - 1, L = 1 << depth;
+  std::vector<float> Gt((size_t)N * K), Ht(N);
+  std::vector<int32_t> idx(N), idx_tmp(N);
+  GrowParams P{depth, B, (int)K, reg_lambda, 0.0, min_instances,
+               min_info_gain, 0.0, true, 1.0, 1, feature_frac};
+  for (int t = 0; t < n_trees; ++t) {
+    for (int64_t r = 0; r < N; ++r) {
+      float rw;
+      if (bootstrap) rw = (float)rng.poisson(subsample);
+      else rw = rng.uniform() < subsample ? 1.f : 0.f;
+      Ht[r] = H[r] * rw;
+      for (int k = 0; k < K; ++k)
+        Gt[(size_t)r * K + k] = G[(size_t)r * K + k] * rw;
+    }
+    grow_tree(Xb, N, F, Gt.data(), Ht.data(), P, nullptr, rng,
+              feat + (size_t)t * M, thresh + (size_t)t * M,
+              miss + (size_t)t * M, leaf + (size_t)t * L * K, nullptr,
+              idx.data(), idx_tmp.data());
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -60,8 +60,27 @@ def enable_compilation_cache() -> None:
     if loc.lower() in ("0", "off", "none", "disable"):
         return
     if not loc:
+        # scope the default cache by the host's CPU feature set: XLA:CPU
+        # AOT results bake in target machine features, and this image
+        # migrates across hosts — loading an avx512-variant executable on
+        # a host without those features risks SIGILL (cpu_aot_loader
+        # warns exactly this). An explicit $TMOG_COMPILE_CACHE is taken
+        # as-is (single-machine setups, the bench's per-run dirs).
+        import hashlib
+        import platform as _pf
+        tag = _pf.machine()
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    # x86 lists "flags", aarch64 lists "Features"
+                    if line.startswith(("flags", "Features")):
+                        tag += hashlib.sha1(
+                            line.encode()).hexdigest()[:10]
+                        break
+        except OSError:
+            pass
         loc = os.path.join(os.path.expanduser("~"), ".cache",
-                           "transmogrifai_tpu", "xla")
+                           "transmogrifai_tpu", f"xla-{tag}")
     try:
         os.makedirs(loc, exist_ok=True)
     except OSError:
