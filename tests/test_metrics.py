@@ -44,3 +44,31 @@ def test_workflow_run_collects_stage_metrics(tmp_path):
     phases = {m["phase"] for m in doc["stage_metrics"]}
     assert "fit" in phases
     collector.disable()
+
+
+class TestCustomEvaluator:
+    def test_custom_metric_in_validator(self):
+        import numpy as np
+        from transmogrifai_tpu.automl.tuning.validators import CrossValidation
+        from transmogrifai_tpu.evaluators.evaluators import Evaluators
+        from transmogrifai_tpu.models.glm import OpLogisticRegression
+        from transmogrifai_tpu.models.prediction import positive_score_of
+
+        def neg_brier(labels, pred_col, w):
+            p = positive_score_of(pred_col)
+            return -float(np.mean((p - np.asarray(labels)) ** 2))
+
+        ev = Evaluators.custom("neg_brier", larger_better=True,
+                               evaluate_fn=neg_brier)
+        assert ev.is_larger_better()
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(200, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        val = CrossValidation(ev, num_folds=3, seed=0)
+        best = val.validate(
+            [(OpLogisticRegression(max_iter=10),
+              [{"reg_param": 0.01}, {"reg_param": 1.0}])], X, y)
+        assert np.isfinite(best.best_metric)
+        assert best.validated[0].metric_name == "neg_brier"
+        # lower regularization should win on separable data
+        assert best.best_grid["reg_param"] == 0.01

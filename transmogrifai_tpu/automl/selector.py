@@ -37,6 +37,10 @@ class ModelSelectorSummary:
     validation_results: List[Dict[str, Any]] = field(default_factory=list)
     train_evaluation: Dict[str, float] = field(default_factory=dict)
     holdout_evaluation: Dict[str, float] = field(default_factory=dict)
+    # direction of evaluation_metric as the EVALUATOR declared it — name
+    # lookup alone misranks custom smaller-is-better metrics; None (old
+    # saved summaries) falls back to the name-based table
+    metric_larger_better: Optional[bool] = None
 
     def to_json(self) -> Dict[str, Any]:
         from dataclasses import asdict
@@ -54,10 +58,13 @@ class ModelSelectorSummary:
             f"Selected: {self.best_model_name} "
             f"(uid {self.best_model_uid}) grid={self.best_grid}",
         ]
+        larger = (self.metric_larger_better
+                  if self.metric_larger_better is not None
+                  else _larger_better(self.evaluation_metric))
         ranked = sorted(
             self.validation_results,
             key=lambda v: v.get("mean_metric", float("nan")),
-            reverse=_larger_better(self.evaluation_metric))
+            reverse=larger)
         from ..utils.table import format_table
         lines.append(format_table(
             ["Model", "Grid", self.evaluation_metric],
@@ -200,6 +207,7 @@ class ModelSelector(PredictorEstimator):
                                   if self.splitter else {}),
             data_prep_results=prep.summary,
             evaluation_metric=evaluator.default_metric,
+            metric_larger_better=bool(evaluator.is_larger_better()),
             problem_type=self.problem_type,
             best_model_uid=best.estimator.uid,
             best_model_name=best.name,

@@ -267,12 +267,20 @@ class Validator:
         metric = self.evaluator.default_metric
         larger = self.evaluator.is_larger_better()
 
+        # a user-supplied metric (Evaluators.custom) has no device kernel:
+        # every candidate goes through the sequential per-fold route, which
+        # is the only one that calls evaluator.evaluate on host columns
+        device_metric = getattr(self.evaluator, "device_metric", True)
+
         validated: List[ValidatedModel] = []
         for est, grids in models:
             if not grids:
                 grids = [dict()]
-            if self._streamable(est, grids, problem_type, X,
-                                masks.shape[0]):
+            if not device_metric:
+                validated.extend(self._validate_sequential(
+                    est, grids, X, y, w, masks))
+            elif self._streamable(est, grids, problem_type, X,
+                                  masks.shape[0]):
                 validated.extend(self._validate_streamed(
                     est, grids, X, y, w, masks, metric, problem_type))
             elif self._vmappable(est, grids, problem_type):
@@ -438,8 +446,13 @@ class Validator:
         data_fp = data_fingerprint(X, y)
         base_params = est.param_values() if hasattr(est, "param_values") \
             else None
+        # a custom metric is an arbitrary function: its identity must be
+        # part of the cell key, or editing the function silently replays
+        # the OLD function's cached fold metrics (the name alone is not a
+        # fingerprint the way built-in metric names are)
+        metric_key = getattr(self.evaluator, "metric_key", metric)
         keys = [sweep_key(type(est).__name__, g, n_folds,
-                          self.seed, self.stratify, metric,
+                          self.seed, self.stratify, metric_key,
                           data_fp=data_fp, base_params=base_params,
                           path=path)
                 for g in grids]

@@ -240,8 +240,54 @@ class RegressionEvaluator(Evaluator):
         return {k: float(v) for k, v in m._asdict().items()}
 
 
+class CustomEvaluator(Evaluator):
+    """User-supplied metric (reference Evaluators.custom adapters):
+    ``evaluate_fn(labels, pred_col, w) -> float`` wrapped with a name and
+    a direction, usable anywhere a built-in evaluator is (validators,
+    score_and_evaluate, runner Evaluate)."""
+
+    name = "customEval"
+    # no jitted kernel for a user lambda: validators take the sequential
+    # per-fold route and call evaluate() on host columns
+    device_metric = False
+
+    def __init__(self, metric_name: str, larger_better: bool, evaluate_fn):
+        super().__init__(metric_name)
+        self.larger_better = bool(larger_better)
+        self._fn = evaluate_fn
+
+    @property
+    def metric_key(self) -> str:
+        """Checkpoint identity: metric name + a fingerprint of the user
+        function's bytecode, so editing the function invalidates cached
+        sweep cells instead of silently replaying the old metric."""
+        import hashlib
+        try:
+            code = self._fn.__code__
+            fp = hashlib.sha1(code.co_code
+                              + repr(code.co_consts).encode()).hexdigest()[:10]
+        except AttributeError:  # non-function callable
+            fp = type(self._fn).__name__
+        return f"{self.default_metric}@{fp}"
+
+    def evaluate_all(self, labels, pred_col, w=None) -> Dict[str, float]:
+        return {self.default_metric: float(self._fn(labels, pred_col, w))}
+
+    def is_larger_better(self, metric: Optional[str] = None) -> bool:
+        return self.larger_better
+
+
 class Evaluators:
     """Factory namespace (reference Evaluators.scala:40)."""
+
+    @staticmethod
+    def custom(metric_name: str, larger_better: bool,
+               evaluate_fn) -> CustomEvaluator:
+        """Reference Evaluators.*.custom(metricName, isLargerBetter,
+        evaluateFn). `evaluate_fn(labels, pred_col, w) -> float`; helpers
+        `prediction_of`/`probability_of`/`positive_score_of` (models/
+        prediction.py) extract the score views from the column."""
+        return CustomEvaluator(metric_name, larger_better, evaluate_fn)
 
     class BinaryClassification:
         @staticmethod
