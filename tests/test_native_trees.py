@@ -160,3 +160,103 @@ class TestEstimatorRoute:
             model = est.fit_arrays(X, y)
             pred, _, _ = model.predict_arrays(X)
             assert (pred == y).mean() > 0.85, type(est).__name__
+
+
+class TestNativeEdgeCases:
+    """Adversarial shapes for the C++ builder (segfault/UB guards)."""
+
+    def test_depth_exceeds_data(self):
+        # 8 rows, depth 6: almost every node empty/dead
+        X = np.arange(8, dtype=np.float32).reshape(8, 1)
+        y = np.array([0, 1, 0, 1, 0, 1, 0, 1], np.float32)
+        Xb, edges, nb = TH.bin_context(X, 4)
+        trees, base = TH.fit_gbt_host(Xb, y, np.ones(8, np.float32),
+                                      n_rounds=3, depth=6, n_bins=nb)
+        m = base + TH.predict_bins_host(trees, Xb, 6)[:, 0]
+        assert np.isfinite(m).all()
+
+    def test_all_missing_and_constant_features(self):
+        rng = np.random.default_rng(0)
+        X = np.stack([np.full(300, np.nan, np.float32),       # all missing
+                      np.ones(300, np.float32),               # constant
+                      rng.normal(size=300).astype(np.float32)], axis=1)
+        y = (X[:, 2] > 0).astype(np.float32)
+        Xb, edges, nb = TH.bin_context(X, 8)
+        trees, base = TH.fit_gbt_host(Xb, y, np.ones(300, np.float32),
+                                      n_rounds=4, depth=3, n_bins=nb)
+        m = base + TH.predict_bins_host(trees, Xb, 3)[:, 0]
+        assert ((m > 0) == y).mean() > 0.95
+        # splits must only use the informative feature
+        used = set(trees.feat[trees.thresh < nb].tolist())
+        assert used <= {0, 2} and (2 in used or len(used) == 0)
+
+    def test_all_zero_weights(self):
+        X = np.random.default_rng(1).normal(size=(50, 3)).astype(np.float32)
+        y = np.zeros(50, np.float32)
+        Xb, edges, nb = TH.bin_context(X, 8)
+        trees, base = TH.fit_gbt_host(Xb, y, np.zeros(50, np.float32),
+                                      n_rounds=2, depth=3, n_bins=nb)
+        m = TH.predict_bins_host(trees, Xb, 3)[:, 0]
+        assert np.isfinite(m).all() and np.abs(m).max() == 0.0
+
+    def test_single_row(self):
+        Xb = np.array([[1, 2]], np.int32)
+        trees, base = TH.fit_gbt_host(Xb, np.ones(1, np.float32),
+                                      np.ones(1, np.float32),
+                                      n_rounds=2, depth=3, n_bins=8)
+        assert np.isfinite(TH.predict_bins_host(trees, Xb, 3)).all()
+
+    def test_wide_bins_int32(self):
+        # n_bins > 127: the int32 binning path the XGB default (256) uses
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(2000, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        Xb, edges, nb = TH.bin_context(X, 256)
+        assert nb == 256 and Xb.max() <= 256
+        trees, base = TH.fit_gbt_host(Xb, y, np.ones_like(y),
+                                      n_rounds=4, depth=4, n_bins=nb)
+        m = base + TH.predict_bins_host(trees, Xb, 4)[:, 0]
+        assert ((m > 0) == y).mean() > 0.97
+
+    def test_gating_params_respected(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(500, 3)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        Xb, edges, nb = TH.bin_context(X, 16)
+        # impossibly high min_child_weight: no split is ever valid, so
+        # every node carries the dead sentinel (constant trees)
+        trees, base = TH.fit_gbt_host(Xb, y, np.ones_like(y), n_rounds=2,
+                                      depth=3, n_bins=nb,
+                                      min_child_weight=1e9)
+        assert (trees.thresh == nb).all()  # dead sentinel B-1
+        # huge gamma likewise
+        trees2, _ = TH.fit_gbt_host(Xb, y, np.ones_like(y), n_rounds=2,
+                                    depth=3, n_bins=nb, gamma=1e9)
+        assert (trees2.thresh == nb).all()  # dead sentinel B-1
+
+    def test_subsample_and_colsample(self):
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(1000, 6)).astype(np.float32)
+        y = ((X[:, 0] + X[:, 1]) > 0).astype(np.float32)
+        Xb, edges, nb = TH.bin_context(X, 16)
+        trees, base = TH.fit_gbt_host(Xb, y, np.ones_like(y), n_rounds=10,
+                                      depth=3, n_bins=nb, learning_rate=0.3,
+                                      subsample=0.7, feature_frac=0.5)
+        m = base + TH.predict_bins_host(trees, Xb, 3)[:, 0]
+        assert ((m > 0) == y).mean() > 0.85
+
+    def test_rf_many_classes(self):
+        rng = np.random.default_rng(5)
+        n, C = 1000, 5
+        y = rng.integers(0, C, size=n).astype(np.float32)
+        X = (rng.normal(size=(n, 6), scale=0.6)
+             + np.eye(6, dtype=np.float64)[:C][y.astype(int)] * 2
+             ).astype(np.float32)
+        Xb, edges, nb = TH.bin_context(X, 16)
+        G = np.eye(C, dtype=np.float32)[y.astype(int)]
+        trees = TH.fit_forest_host(Xb, G, np.ones(n, np.float32),
+                                   n_trees=15, depth=6, n_bins=nb,
+                                   feature_frac=0.7)
+        agg = TH.predict_bins_host(trees, Xb, 6)
+        assert agg.shape == (n, C)
+        assert (agg.argmax(1) == y).mean() > 0.9
