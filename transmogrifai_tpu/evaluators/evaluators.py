@@ -183,6 +183,10 @@ class MultiClassificationEvaluator(Evaluator):
     def _scalar_metrics(self, labels, pred_col, w=None) -> Dict[str, float]:
         y = np.asarray(labels, np.float32)
         pred = np.asarray(prediction_of(pred_col), np.float32)
+        # n_classes is a static jit key of multiclass_metrics; the max with
+        # the column layout (model class count, dataset-constant) keeps it
+        # stable across folds/grid points — the data-derived terms only
+        # raise it when a label id exceeds the model's classes
         n_classes = max(int(y.max()) + 1 if y.size else 1,
                         n_classes_of(pred_col), int(pred.max()) + 1 if pred.size else 1)
         m = M.multiclass_metrics(pred, y, n_classes,

@@ -40,6 +40,7 @@ def _sorted_cum_counts(scores: jax.Array, labels: jax.Array,
     return tps, fps, boundary
 
 
+@jax.jit
 def au_roc(scores: jax.Array, labels: jax.Array,
            w: Optional[jax.Array] = None) -> jax.Array:
     """Area under ROC (trapezoid over tie-boundary points)."""
@@ -66,6 +67,7 @@ def au_roc(scores: jax.Array, labels: jax.Array,
     return acc
 
 
+@jax.jit
 def au_pr(scores: jax.Array, labels: jax.Array,
           w: Optional[jax.Array] = None) -> jax.Array:
     """Area under precision-recall (step interpolation / average precision)."""
@@ -230,6 +232,7 @@ class BinaryMetrics(NamedTuple):
     fn: jax.Array
 
 
+@jax.jit
 def binary_metrics(scores: jax.Array, labels: jax.Array,
                    w: Optional[jax.Array] = None,
                    threshold: float = 0.5) -> BinaryMetrics:
@@ -252,6 +255,7 @@ def binary_metrics(scores: jax.Array, labels: jax.Array,
         tp=tp, tn=tn, fp=fp, fn=fn)
 
 
+@partial(jax.jit, static_argnames=("num_bins",))
 def threshold_curves(scores: jax.Array, labels: jax.Array,
                      w: Optional[jax.Array] = None,
                      num_bins: int = 100) -> Dict[str, jax.Array]:
@@ -284,6 +288,7 @@ class MultiMetrics(NamedTuple):
     error: jax.Array
 
 
+@partial(jax.jit, static_argnames=("n_classes",))
 def multiclass_metrics(pred: jax.Array, labels: jax.Array, n_classes: int,
                        w: Optional[jax.Array] = None) -> MultiMetrics:
     """Weighted precision/recall/F1/error from predicted & true class ids."""
@@ -419,6 +424,7 @@ class RegressionMetrics(NamedTuple):
     r2: jax.Array
 
 
+@jax.jit
 def regression_metrics(pred: jax.Array, labels: jax.Array,
                        w: Optional[jax.Array] = None) -> RegressionMetrics:
     pred = jnp.asarray(pred)

@@ -31,6 +31,7 @@ class ColStats(NamedTuple):
     num_non_zeros: jax.Array  # [d]
 
 
+@jax.jit
 def col_stats(X: jax.Array, w: Optional[jax.Array] = None) -> ColStats:
     """Column statistics with NaN-as-missing handling.
 
@@ -56,6 +57,7 @@ def col_stats(X: jax.Array, w: Optional[jax.Array] = None) -> ColStats:
                     num_non_zeros=nnz)
 
 
+@jax.jit
 def pearson_with_label(X: jax.Array, y: jax.Array,
                        w: Optional[jax.Array] = None) -> jax.Array:
     """Pearson correlation of every column with the label. [n,d],[n] -> [d].
@@ -81,6 +83,7 @@ def pearson_with_label(X: jax.Array, y: jax.Array,
     return cov / jnp.sqrt(jnp.maximum(vx * vy, EPS * EPS))
 
 
+@jax.jit
 def pearson_matrix(X: jax.Array, w: Optional[jax.Array] = None) -> jax.Array:
     """Full Pearson correlation matrix [d,d] — one X^T X matmul on the MXU
     (the SanityChecker 'corrType=full' path). NaNs are imputed to column mean
@@ -120,6 +123,7 @@ def _rank_with_nan(x: jax.Array, w: jax.Array) -> jax.Array:
     return jnp.where(finite, ranks, jnp.nan)
 
 
+@jax.jit
 def spearman_with_label(X: jax.Array, y: jax.Array,
                         w: Optional[jax.Array] = None) -> jax.Array:
     """Spearman = Pearson on ranks (SanityChecker CorrelationType.Spearman).
@@ -146,6 +150,7 @@ def spearman_with_label(X: jax.Array, y: jax.Array,
 
 # -- contingency statistics (OpStatistics.scala) ---------------------------
 
+@jax.jit
 def contingency_table(G: jax.Array, Y: jax.Array,
                       w: Optional[jax.Array] = None) -> jax.Array:
     """Contingency counts between a group of indicator columns and one-hot
@@ -168,6 +173,7 @@ class ContingencyStats(NamedTuple):
     supports: jax.Array         # [k] row support fraction
 
 
+@jax.jit
 def contingency_stats(table: jax.Array) -> ContingencyStats:
     """Chi²/Cramér's V/MI/PMI/max-rule-confidence from a [k,c] count table.
 
@@ -201,6 +207,7 @@ def contingency_stats(table: jax.Array) -> ContingencyStats:
                             max_rule_confidences=max_conf, supports=support)
 
 
+@jax.jit
 def fill_rate(X: jax.Array, w: Optional[jax.Array] = None) -> jax.Array:
     """Fraction of non-missing entries per column (RawFeatureFilter
     FeatureDistribution.fillRate, core/.../filters/FeatureDistribution.scala:92)."""
@@ -212,6 +219,7 @@ def fill_rate(X: jax.Array, w: Optional[jax.Array] = None) -> jax.Array:
     return (jnp.isfinite(X).astype(X.dtype) * w[:, None]).sum(axis=0) / tot
 
 
+@jax.jit
 def js_divergence(p: jax.Array, q: jax.Array) -> jax.Array:
     """Jensen-Shannon divergence between (batched) histograms, normalized.
     (FeatureDistribution.jsDivergence, core/.../filters/FeatureDistribution.scala:138)."""
@@ -226,6 +234,7 @@ def js_divergence(p: jax.Array, q: jax.Array) -> jax.Array:
     return 0.5 * kl(p, m) + 0.5 * kl(q, m)
 
 
+@functools.partial(jax.jit, static_argnames=("bins",))
 def histogram_fixed(x: jax.Array, lo: jax.Array, hi: jax.Array, bins: int,
                     w: Optional[jax.Array] = None) -> jax.Array:
     """Fixed-width histogram via one-hot segment sum (static shape: `bins`)."""
