@@ -106,7 +106,33 @@ def available() -> bool:
     return True
 
 
-def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots):
+# Kernel variant selector (first-contact A/B lever): the "reshape" form
+# builds the (feature, bin) one-hot as a 3D broadcast-compare reshaped
+# [F, B, blk] -> [F*B, blk] (a leading-dim merge); "concat" builds it as F
+# independent [B, blk] 2D compares concatenated along the leading dim — no
+# 3D intermediate and no reshape at all, a genuinely different Mosaic
+# lowering path in case the reshape form is what stalled the round-3
+# 10M-row first contact (note jnp.repeat would NOT qualify: it lowers to
+# the same broadcast+reshape). Runtime-switchable so
+# tools/tpu_staged_probe.py can try both.
+_VARIANTS = ("reshape", "concat")
+_VARIANT = os.environ.get("TMOG_PALLAS_HIST_VARIANT", "reshape").strip() \
+    or "reshape"
+
+
+def set_variant(name: str) -> None:
+    global _VARIANT
+    if name not in _VARIANTS:
+        raise ValueError(f"unknown pallas hist variant: {name!r}")
+    if name != _VARIANT:
+        _VARIANT = name
+        for fn in _cache_consumers:
+            fn.clear_cache()
+        hist_pallas.clear_cache()
+
+
+def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots,
+            variant):
     import jax.experimental.pallas as pl
 
     @pl.when(pl.program_id(0) == 0)
@@ -115,12 +141,20 @@ def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots):
 
     blk = xb_ref.shape[1]
     xf = xb_ref[:].astype(jnp.float32)                      # [F, blk]
-    # Mosaic's tpu.iota only produces integer vectors; build int32 and cast
-    # (f32 iota verified fine in interpret mode but fails TPU lowering)
-    bins = jax.lax.broadcasted_iota(jnp.int32, (1, B, 1), 1) \
-        .astype(jnp.float32)
-    oh = (xf[:, None, :] == bins).astype(jnp.float32)       # [F, B, blk]
-    oh = oh.reshape(F * B, blk)
+    # Mosaic's tpu.iota only produces integer vectors; build int32 and
+    # cast (f32 iota verified fine in interpret mode but fails TPU
+    # lowering)
+    if variant == "concat":
+        bins2 = jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0) \
+            .astype(jnp.float32)                            # [B, 1]
+        oh = jnp.concatenate(
+            [(xf[f:f + 1, :] == bins2).astype(jnp.float32)  # [B, blk]
+             for f in range(F)], axis=0)                    # [F*B, blk]
+    else:
+        bins = jax.lax.broadcasted_iota(jnp.int32, (1, B, 1), 1) \
+            .astype(jnp.float32)
+        oh = (xf[:, None, :] == bins).astype(jnp.float32)   # [F, B, blk]
+        oh = oh.reshape(F * B, blk)
 
     slot = slot_ref[:]                                      # [1, blk]
     slots = jax.lax.broadcasted_iota(jnp.int32, (n_slots, blk), 0) \
@@ -161,7 +195,12 @@ def hist_pallas(Xb_t: jax.Array, pay_t: jax.Array, slot_t: jax.Array,
                          constant_values=float(n_slots))  # dropped
         N += pad
 
-    kernel = functools.partial(_kernel, F=F, B=B, C=C, n_slots=n_slots)
+    if _VARIANT not in _VARIANTS:  # env typo must not silently re-run
+        raise ValueError(          # the default variant as false evidence
+            f"TMOG_PALLAS_HIST_VARIANT={_VARIANT!r}; expected one of "
+            f"{_VARIANTS}")
+    kernel = functools.partial(_kernel, F=F, B=B, C=C, n_slots=n_slots,
+                               variant=_VARIANT)
     return pl.pallas_call(
         kernel,
         grid=(N // blk,),
