@@ -122,3 +122,32 @@ def test_different_seed_does_not_reuse(tmp_path, monkeypatch):
     cv2.validate([(OpGBTClassifier(), grids)], X, y, np.ones_like(y),
                  problem_type="binary")
     assert calls["n"] > 0
+
+
+def test_engine_change_does_not_replay(tmp_path, monkeypatch):
+    """Host-native and device tree fits are distinct compute paths (their
+    near-tie splits differ): cells recorded under one engine must NOT be
+    replayed into a sweep running the other."""
+    from transmogrifai_tpu.ops import trees_host as TH
+    if not TH.available():
+        pytest.skip("native tree builder unavailable")
+    X, y = _data()
+    path = str(tmp_path / "sweep.jsonl")
+    grids = param_grid(max_iter=[3], max_depth=[2])
+
+    cv = CrossValidation(BinaryClassificationEvaluator(), num_folds=2,
+                         seed=7)
+    cv.checkpoint_path = path
+    cv.validate([(OpGBTClassifier(), grids)], X, y, np.ones_like(y),
+                problem_type="binary")
+    n_host = len(SweepCheckpoint(path))
+    assert n_host == 1
+
+    # device engine (host route disabled): the host cells must not match
+    monkeypatch.setenv("TMOG_NO_HOST_TREES", "1")
+    cv2 = CrossValidation(BinaryClassificationEvaluator(), num_folds=2,
+                          seed=7)
+    cv2.checkpoint_path = path
+    cv2.validate([(OpGBTClassifier(), grids)], X, y, np.ones_like(y),
+                 problem_type="binary")
+    assert len(SweepCheckpoint(path)) == 2  # a NEW cell was computed

@@ -609,7 +609,10 @@ class Validator:
         margin_thr = self._margin_threshold(est)
         ckpt, keys, results = self._cell_bookkeeping(
             est, grids, X, y, metric, masks.shape[0],
-            path=self._sweep_path("mask_folds"))
+            path=self._sweep_path(
+                "mask_folds:host" if (self.mesh is None
+                                      and est._host_route())
+                else "mask_folds"))
         pending = [gi for gi in range(len(grids)) if gi not in results]
         if pending:
             # trees only read X through quantile binning, so the bf16 sweep
@@ -688,7 +691,10 @@ class Validator:
         metric = self.evaluator.default_metric
         ckpt, keys, results = self._cell_bookkeeping(
             est, grids, X, y, metric, masks.shape[0],
-            path=self._sweep_path("sequential"))
+            path=self._sweep_path(
+                "sequential:host"
+                if getattr(est, "_host_route", lambda: False)()
+                else "sequential"))
         for gi, g in enumerate(grids):
             if gi in results:
                 continue
