@@ -239,6 +239,46 @@ def main():
                 body, t, {"TMOG_PALLAS_HIST_VARIANT": "concat"})
             skip.pop("tree_pallas_10m", None)
 
+    if "--no-bench" not in args:
+        _run_bench_with_findings(results)
+
+
+def _run_bench_with_findings(results):
+    """Chain straight into the full bench while the window is open,
+    configured by what the stages proved: the short round-2/3 TPU windows
+    died before a human could react — the evidence run must be automatic.
+    The bench has its own watchdogs/persistence; we only pick env."""
+    env = dict(os.environ)
+    pallas_ok = results.get("pallas_direct")
+    concat_ok = results.get("pallas_direct_concat")
+    if not pallas_ok and concat_ok:
+        env["TMOG_PALLAS_HIST_VARIANT"] = "concat"
+    elif not pallas_ok and "pallas_direct" in results:
+        env["TMOG_NO_PALLAS"] = "1"
+    env.setdefault("BENCH_BUDGET_S", "2400")
+    out_path = os.path.join(REPO, "BENCH_TPU_AUTORUN.json")
+    log_line({"stage": "bench_autorun", "ok": True, "s": 0,
+              "detail": {"env": {k: env[k] for k in
+                                 ("TMOG_NO_PALLAS",
+                                  "TMOG_PALLAS_HIST_VARIANT")
+                                 if k in env}}})
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=2700, env=env, cwd=REPO)
+        line = next((l for l in (r.stdout or "").splitlines()[::-1]
+                     if l.startswith("{")), None)
+        if line:
+            with open(out_path, "w") as f:
+                f.write(line + "\n")
+        log_line({"stage": "bench", "ok": bool(line) and r.returncode == 0,
+                  "s": 0, "detail": {"rc": r.returncode,
+                                     "json_written": bool(line)}})
+    except subprocess.TimeoutExpired:
+        log_line({"stage": "bench", "ok": False, "s": 2700,
+                  "error": "bench timed out (partial in "
+                           "bench_partial.json)"})
+
 
 if __name__ == "__main__":
     main()
