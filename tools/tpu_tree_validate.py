@@ -4,6 +4,10 @@ Run on first contact with real hardware (the tree kernels' pallas path
 compiles here for the first time); every phase prints immediately so a
 stall pinpoints itself. TMOG_NO_PALLAS=1 re-runs on the XLA-only path.
 
+Superseded for first contact by tools/tpu_staged_probe.py (killable
+per-stage subprocesses + evidence log + automatic bench chaining); this
+script remains for interactive piecewise timing on a LIVE, stable chip.
+
 Usage: python tools/tpu_tree_validate.py
 """
 import os, sys, time
