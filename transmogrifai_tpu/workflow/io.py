@@ -79,8 +79,12 @@ def save_model(model: WorkflowModel, path: str, overwrite: bool = True) -> None:
             lj.append(entry)
         layers_json.append(lj)
 
+    from .. import __version__
     doc = {
         "format_version": FORMAT_VERSION,
+        # provenance stamp (reference VersionInfo in model metadata):
+        # which framework build trained this artifact
+        "framework_version": __version__,
         "result_feature_uids": [f.uid for f in model.result_features],
         "blacklisted_features": model.blacklist,
         "features": feat_json,
