@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import os as _os
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 # Honor an explicit JAX_PLATFORMS=cpu at the CONFIG level before any
 # backend init: this image's sitecustomize registers a remote-TPU plugin
