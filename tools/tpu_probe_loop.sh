@@ -1,0 +1,15 @@
+#!/bin/bash
+# Keep a staged TPU probe alive until real hardware evidence lands.
+# Each tpu_staged_probe.py run waits up to 2h for the tunnel, then runs
+# the staged validation + full bench when it opens. Loop while no stage
+# has ever succeeded (ok:true) so an expired wait window restarts the
+# watch, but a completed hardware run is never repeated/contended.
+cd "$(dirname "$0")/.." || exit 1
+LOG=tools/tpu_stages.jsonl
+for i in $(seq 1 24); do
+  if [ -f "$LOG" ] && grep -q '"ok": true' "$LOG"; then
+    echo "evidence present; stopping probe loop"
+    exit 0
+  fi
+  python tools/tpu_staged_probe.py
+done
