@@ -18,7 +18,12 @@
 //     matching jnp.argmax over the same flattening
 //   - dead node encoding feat=0, thresh=B-1, miss=0 (all rows left); a
 //     dead node's subtree is provably dead (children inherit the exact
-//     row set), so its mass lands at the leftmost descendant leaf
+//     row set), so its mass lands at the leftmost descendant leaf.
+//     One RF nuance: with per-node feature subsets the XLA path redraws
+//     a new subset for the (same-rows) child at the next level and may
+//     find a split there; this builder finalizes the node immediately —
+//     Spark's semantics (a no-split node is a leaf). Both are defensible;
+//     RF parity is statistical anyway (different bootstrap RNG).
 //   - leaf = lr * -G/(H+lambda+eps) (newton) or G/(H+eps) (mean),
 //     zeroed when the (H>0) row count is < 0.5
 // Differences: accumulation in double (XLA: f32 tree-reduce) and its own
