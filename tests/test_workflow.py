@@ -187,3 +187,28 @@ def test_with_model_stages_reuses_fitted_stages(monkeypatch):
     assert calls == [], f"estimators refit despite with_model_stages: {calls}"
     np.testing.assert_allclose(model2.score(ds).column(vec.name).data,
                                scored1, atol=1e-6)
+
+
+def test_tiny_dataset_selector_trains_and_scores():
+    """Folds > rows: empty validation folds must degrade gracefully
+    (NaN fold metrics are excluded from the mean), not crash."""
+    from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    from transmogrifai_tpu.models.glm import OpLogisticRegression
+    from transmogrifai_tpu.testkit import TestFeatureBuilder
+    from transmogrifai_tpu.types import Real, RealNN
+
+    for n in (5, 3):
+        ds, (fx, fy) = TestFeatureBuilder.build(
+            ("x", Real, list(np.linspace(-1, 1, n))),
+            ("label", RealNN, [float(i % 2) for i in range(n)]),
+            response_index=1)
+        vec = transmogrify([fx])
+        pred = BinaryClassificationModelSelector.with_cross_validation(
+            num_folds=3, models_and_parameters=[
+                (OpLogisticRegression(max_iter=5), [{"reg_param": 0.1}])],
+        ).set_input(fy, vec).get_output()
+        model = Workflow().set_input_dataset(ds) \
+            .set_result_features(pred).train()
+        out = model.score(ds)
+        assert out.column(pred.name).data.shape[0] == n
