@@ -74,11 +74,13 @@ inline double score(const double* g, double h, int K, double lam) {
   return s / (h + lam + EPS);
 }
 
-// Grow one tree. Xb [N, F] int32 bins; G [N, K]; H [N]. Outputs feat/
-// thresh/miss [2^depth - 1] (pre-filled dead), leaf [2^depth, K]
-// (pre-zeroed), and per-row payload `row_out` [N, K] (training-time
-// prediction for boosting; may be null).
-void grow_tree(const int32_t* Xb, int64_t N, int F, const float* G,
+// Grow one tree. Xb [N, F] bins (int32 or uint8 — the Xb stream is the
+// dominant memory traffic at big N, so 1-byte bins matter); G [N, K];
+// H [N]. Outputs feat/thresh/miss [2^depth - 1] (pre-filled dead), leaf
+// [2^depth, K] (pre-zeroed), and per-row payload `row_out` [N, K]
+// (training-time prediction for boosting; may be null).
+template <typename XbT>
+void grow_tree(const XbT* Xb, int64_t N, int F, const float* G,
                const float* H, const GrowParams& P,
                const uint8_t* tree_fmask, Rng& rng,
                int32_t* feat, int32_t* thresh, int32_t* miss, float* leaf,
@@ -139,7 +141,7 @@ void grow_tree(const int32_t* Xb, int64_t N, int F, const float* G,
       std::vector<double> gt(K, 0.0);
       for (int i = nd.lo; i < nd.hi; ++i) {
         const int32_t r = idx[i];
-        const int32_t* xr = Xb + (size_t)r * F;
+        const XbT* xr = Xb + (size_t)r * F;
         const float* gr = G + (size_t)r * K;
         const double h = H[r];
         const double c = H[r] > 0.f ? 1.0 : 0.0;
@@ -231,7 +233,7 @@ void grow_tree(const int32_t* Xb, int64_t N, int F, const float* G,
       int nl = nd.lo, nr = 0;
       for (int i = nd.lo; i < nd.hi; ++i) {
         const int32_t r = idx[i];
-        const int32_t b = Xb[(size_t)r * F + bf];
+        const int32_t b = (int32_t)Xb[(size_t)r * F + bf];
         const bool right = (b > bt) || (b == 0 && bm > 0);
         if (right) idx_tmp[nr++] = r;
         else idx[nl++] = r;
@@ -261,13 +263,11 @@ void tree_feature_mask(std::vector<uint8_t>& mask, int F,
   }
 }
 
-}  // namespace
-
-extern "C" {
 
 // Binary-logistic / squared-loss boosting (ops/trees.fit_gbt twin).
 // feat/thresh/miss [n_rounds, 2^depth - 1]; leaf [n_rounds, 2^depth].
-int tmog_gbt_fit(const int32_t* Xb, int64_t N, int32_t F, int32_t B,
+template <typename XbT>
+int gbt_fit_impl(const XbT* Xb, int64_t N, int32_t F, int32_t B,
                  const float* y, const float* w, int32_t loss,
                  int32_t n_rounds, int32_t depth, double lr,
                  double reg_lambda, double min_child_weight,
@@ -331,7 +331,8 @@ int tmog_gbt_fit(const int32_t* Xb, int64_t N, int32_t F, int32_t B,
 
 // Multiclass softmax boosting (fit_gbt_softmax twin).
 // Outputs stacked [n_rounds * n_classes] trees (round-major, class-minor).
-int tmog_gbt_softmax_fit(const int32_t* Xb, int64_t N, int32_t F, int32_t B,
+template <typename XbT>
+int gbt_softmax_impl(const XbT* Xb, int64_t N, int32_t F, int32_t B,
                          const float* y, const float* w, int32_t n_classes,
                          int32_t n_rounds, int32_t depth, double lr,
                          double reg_lambda, double min_child_weight,
@@ -383,7 +384,8 @@ int tmog_gbt_softmax_fit(const int32_t* Xb, int64_t N, int32_t F, int32_t B,
 // Random forest / single tree (fit_forest twin): mean-mode leaves, Poisson
 // bootstrap, per-node feature subsets. G [N, K] payload (class one-hots x
 // weight, or y x weight); H [N] weights. leaf [n_trees, 2^depth, K].
-int tmog_rf_fit(const int32_t* Xb, int64_t N, int32_t F, int32_t B,
+template <typename XbT>
+int rf_fit_impl(const XbT* Xb, int64_t N, int32_t F, int32_t B,
                 const float* G, const float* H, int32_t K, int32_t n_trees,
                 int32_t depth, double reg_lambda, double min_instances,
                 double min_info_gain, double subsample, double feature_frac,
@@ -411,6 +413,76 @@ int tmog_rf_fit(const int32_t* Xb, int64_t N, int32_t F, int32_t B,
               idx.data(), idx_tmp.data());
   }
   return 0;
+}
+
+}  // namespace
+
+// C ABI: `xb_itemsize` selects the bin dtype (4 = int32, 1 = uint8 —
+// 1-byte bins quarter the dominant Xb memory stream at big N).
+extern "C" {
+
+int tmog_gbt_fit(const void* Xb, int64_t N, int32_t F, int32_t B,
+                 int32_t xb_itemsize, const float* y, const float* w,
+                 int32_t loss, int32_t n_rounds, int32_t depth, double lr,
+                 double reg_lambda, double min_child_weight,
+                 double min_instances, double min_info_gain, double gamma,
+                 double subsample, double feature_frac, uint64_t seed,
+                 int32_t* feat, int32_t* thresh, int32_t* miss, float* leaf,
+                 float* base_out) {
+  if (xb_itemsize == 1)
+    return gbt_fit_impl((const uint8_t*)Xb, N, F, B, y, w, loss, n_rounds,
+                        depth, lr, reg_lambda, min_child_weight,
+                        min_instances, min_info_gain, gamma, subsample,
+                        feature_frac, seed, feat, thresh, miss, leaf,
+                        base_out);
+  if (xb_itemsize == 4)
+    return gbt_fit_impl((const int32_t*)Xb, N, F, B, y, w, loss, n_rounds,
+                        depth, lr, reg_lambda, min_child_weight,
+                        min_instances, min_info_gain, gamma, subsample,
+                        feature_frac, seed, feat, thresh, miss, leaf,
+                        base_out);
+  return 2;
+}
+
+int tmog_gbt_softmax_fit(const void* Xb, int64_t N, int32_t F, int32_t B,
+                         int32_t xb_itemsize, const float* y, const float* w,
+                         int32_t n_classes, int32_t n_rounds, int32_t depth,
+                         double lr, double reg_lambda,
+                         double min_child_weight, double gamma,
+                         double subsample, double feature_frac,
+                         uint64_t seed, int32_t* feat, int32_t* thresh,
+                         int32_t* miss, float* leaf) {
+  if (xb_itemsize == 1)
+    return gbt_softmax_impl((const uint8_t*)Xb, N, F, B, y, w, n_classes,
+                            n_rounds, depth, lr, reg_lambda,
+                            min_child_weight, gamma, subsample,
+                            feature_frac, seed, feat, thresh, miss, leaf);
+  if (xb_itemsize == 4)
+    return gbt_softmax_impl((const int32_t*)Xb, N, F, B, y, w, n_classes,
+                            n_rounds, depth, lr, reg_lambda,
+                            min_child_weight, gamma, subsample,
+                            feature_frac, seed, feat, thresh, miss, leaf);
+  return 2;
+}
+
+int tmog_rf_fit(const void* Xb, int64_t N, int32_t F, int32_t B,
+                int32_t xb_itemsize, const float* G, const float* H,
+                int32_t K, int32_t n_trees, int32_t depth,
+                double reg_lambda, double min_instances,
+                double min_info_gain, double subsample, double feature_frac,
+                int32_t bootstrap, uint64_t seed, int32_t* feat,
+                int32_t* thresh, int32_t* miss, float* leaf) {
+  if (xb_itemsize == 1)
+    return rf_fit_impl((const uint8_t*)Xb, N, F, B, G, H, K, n_trees,
+                       depth, reg_lambda, min_instances, min_info_gain,
+                       subsample, feature_frac, bootstrap, seed, feat,
+                       thresh, miss, leaf);
+  if (xb_itemsize == 4)
+    return rf_fit_impl((const int32_t*)Xb, N, F, B, G, H, K, n_trees,
+                       depth, reg_lambda, min_instances, min_info_gain,
+                       subsample, feature_frac, bootstrap, seed, feat,
+                       thresh, miss, leaf);
+  return 2;
 }
 
 }  // extern "C"

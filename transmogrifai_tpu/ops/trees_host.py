@@ -77,11 +77,15 @@ def quantile_edges_host(X: np.ndarray, n_bins: int) -> np.ndarray:
 
 
 def bin_matrix_host(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
-    """Numpy twin of ops/trees.bin_matrix: int32 bins, NaN -> 0, present ->
-    1 + right-side searchsorted (native builder takes int32)."""
+    """Numpy twin of ops/trees.bin_matrix: NaN -> 0, present -> 1 +
+    right-side searchsorted. uint8 when the bins fit (<= 127 value bins —
+    the Xb stream is the native builder's dominant memory traffic at big
+    N), int32 otherwise."""
     X = np.asarray(X, np.float32)
     n, d = X.shape
-    out = np.empty((n, d), np.int32)
+    n_bins = edges.shape[1] + 1
+    dtype = np.uint8 if n_bins <= 127 else np.int32
+    out = np.empty((n, d), dtype)
     for f in range(d):
         col = X[:, f]
         missing = np.isnan(col)
@@ -93,7 +97,7 @@ def bin_matrix_host(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
 
 def bin_context(X: np.ndarray, n_bins: int
                 ) -> Tuple[np.ndarray, np.ndarray, int]:
-    """(Xb int32, edges, n_bins) — the host twin of _TreeEstimator._bin."""
+    """(Xb uint8|int32, edges, n_bins) — host twin of _TreeEstimator._bin."""
     X = np.asarray(X, np.float32)
     edges = quantile_edges_host(X, n_bins)
     return bin_matrix_host(X, edges), edges, n_bins
@@ -103,6 +107,17 @@ def bin_context(X: np.ndarray, n_bins: int
 
 def _c(arr: np.ndarray, ptr):
     return arr.ctypes.data_as(ptr)
+
+
+def _xb_native(Xb: np.ndarray):
+    """(contiguous array, void pointer, itemsize) for the bin matrix —
+    uint8/int8 pass through (itemsize 1), everything else widens to
+    int32."""
+    if Xb.dtype in (np.uint8, np.int8):
+        Xb = np.ascontiguousarray(Xb)
+        return Xb, Xb.ctypes.data_as(ctypes.c_void_p), 1
+    Xb = np.ascontiguousarray(Xb, np.int32)
+    return Xb, Xb.ctypes.data_as(ctypes.c_void_p), 4
 
 
 def fit_gbt_host(Xb: np.ndarray, y: np.ndarray, w: np.ndarray, *,
@@ -117,7 +132,7 @@ def fit_gbt_host(Xb: np.ndarray, y: np.ndarray, w: np.ndarray, *,
     lib = _load()
     if lib is None:
         return None
-    Xb = np.ascontiguousarray(Xb, np.int32)
+    Xb, xb_ptr, itemsize = _xb_native(np.asarray(Xb))
     N, F = Xb.shape
     B = n_bins + 1
     M, L = (1 << depth) - 1, 1 << depth
@@ -129,8 +144,9 @@ def fit_gbt_host(Xb: np.ndarray, y: np.ndarray, w: np.ndarray, *,
     leaf = np.zeros((n_rounds, L), np.float32)
     base = ctypes.c_float(0.0)
     rc = lib.tmog_gbt_fit(
-        _c(Xb, _i32p), ctypes.c_int64(N), ctypes.c_int32(F),
-        ctypes.c_int32(B), _c(y32, _f32p), _c(w32, _f32p),
+        xb_ptr, ctypes.c_int64(N), ctypes.c_int32(F),
+        ctypes.c_int32(B), ctypes.c_int32(itemsize),
+        _c(y32, _f32p), _c(w32, _f32p),
         ctypes.c_int32(0 if loss == "logistic" else 1),
         ctypes.c_int32(n_rounds), ctypes.c_int32(depth),
         ctypes.c_double(learning_rate), ctypes.c_double(reg_lambda),
@@ -158,7 +174,7 @@ def fit_gbt_softmax_host(Xb: np.ndarray, y: np.ndarray, w: np.ndarray, *,
     lib = _load()
     if lib is None:
         return None
-    Xb = np.ascontiguousarray(Xb, np.int32)
+    Xb, xb_ptr, itemsize = _xb_native(np.asarray(Xb))
     N, F = Xb.shape
     B = n_bins + 1
     M, L = (1 << depth) - 1, 1 << depth
@@ -170,8 +186,9 @@ def fit_gbt_softmax_host(Xb: np.ndarray, y: np.ndarray, w: np.ndarray, *,
     miss = np.zeros((RC, M), np.int32)
     leaf = np.zeros((RC, L), np.float32)
     rc = lib.tmog_gbt_softmax_fit(
-        _c(Xb, _i32p), ctypes.c_int64(N), ctypes.c_int32(F),
-        ctypes.c_int32(B), _c(y32, _f32p), _c(w32, _f32p),
+        xb_ptr, ctypes.c_int64(N), ctypes.c_int32(F),
+        ctypes.c_int32(B), ctypes.c_int32(itemsize),
+        _c(y32, _f32p), _c(w32, _f32p),
         ctypes.c_int32(n_classes), ctypes.c_int32(n_rounds),
         ctypes.c_int32(depth), ctypes.c_double(learning_rate),
         ctypes.c_double(reg_lambda), ctypes.c_double(min_child_weight),
@@ -198,7 +215,7 @@ def fit_forest_host(Xb: np.ndarray, G: np.ndarray, H: np.ndarray, *,
     lib = _load()
     if lib is None:
         return None
-    Xb = np.ascontiguousarray(Xb, np.int32)
+    Xb, xb_ptr, itemsize = _xb_native(np.asarray(Xb))
     N, F = Xb.shape
     B = n_bins + 1
     G = np.ascontiguousarray(G, np.float32)
@@ -210,8 +227,9 @@ def fit_forest_host(Xb: np.ndarray, G: np.ndarray, H: np.ndarray, *,
     miss = np.zeros((n_trees, M), np.int32)
     leaf = np.zeros((n_trees, L, K), np.float32)
     rc = lib.tmog_rf_fit(
-        _c(Xb, _i32p), ctypes.c_int64(N), ctypes.c_int32(F),
-        ctypes.c_int32(B), _c(G, _f32p), _c(H32, _f32p), ctypes.c_int32(K),
+        xb_ptr, ctypes.c_int64(N), ctypes.c_int32(F),
+        ctypes.c_int32(B), ctypes.c_int32(itemsize),
+        _c(G, _f32p), _c(H32, _f32p), ctypes.c_int32(K),
         ctypes.c_int32(n_trees), ctypes.c_int32(depth),
         ctypes.c_double(reg_lambda), ctypes.c_double(min_instances),
         ctypes.c_double(min_info_gain), ctypes.c_double(subsample),
