@@ -6,6 +6,8 @@ deterministic GBT fits (double vs f32 accumulation allows near-tie split
 divergence), and statistically equivalent sampled ensembles. Mirrors the
 role of the reference's libxgboost parity expectations (AuPR contract).
 """
+import os
+
 import numpy as np
 import pytest
 
@@ -260,3 +262,52 @@ class TestNativeEdgeCases:
         agg = TH.predict_bins_host(trees, Xb, 6)
         assert agg.shape == (n, C)
         assert (agg.argmax(1) == y).mean() > 0.9
+
+
+def test_hist_group_budget_bit_identical():
+    """Tiny TMOG_TREE_HIST_BUDGET_MB forces the grouped multi-sweep path
+    (several histogram groups per level); outputs must be bit-identical
+    to the single-group default (grouping only reorders WHICH sweep
+    accumulates a node, never the per-node row order). The child asserts
+    grouping actually ran (sweep counter > level count), so a shrunk
+    budget that silently fails to engage cannot pass vacuously."""
+    import json
+    import subprocess
+    import sys
+
+    child = r"""
+import ctypes, hashlib, json, numpy as np
+from transmogrifai_tpu.ops import trees_host as TH
+rng = np.random.default_rng(0)
+n, d = 4000, 128  # 128 features -> ~100KB histograms: 1MB budget => ~10/group
+X = rng.normal(size=(n, d)).astype(np.float32)
+y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+Xb, edges, nb = TH.bin_context(X, 32)
+trees, base = TH.fit_gbt_host(Xb, y, np.ones(n, np.float32),
+                              n_rounds=3, depth=7, n_bins=nb)
+sweeps = TH._load().tmog_debug_group_sweeps()
+digest = hashlib.sha256(
+    trees.feat.tobytes() + trees.thresh.tobytes() + trees.miss.tobytes()
+    + trees.leaf.tobytes()).hexdigest()
+print("R|" + json.dumps({"digest": digest, "base": float(base),
+                         "sweeps": int(sweeps)}))
+"""
+    outs = []
+    for budget in (None, "1"):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("TMOG_TREE_HIST_BUDGET_MB", None)
+        if budget:
+            env["TMOG_TREE_HIST_BUDGET_MB"] = budget
+        r = subprocess.run([sys.executable, "-c", child],
+                           capture_output=True, text=True, timeout=300,
+                           env=env, cwd=os.path.dirname(
+                               os.path.dirname(os.path.abspath(__file__))))
+        assert r.returncode == 0, r.stderr[-400:]
+        line = next(l for l in r.stdout.splitlines() if l.startswith("R|"))
+        outs.append(json.loads(line[2:]))
+    # 3 rounds x 7 levels = at most 21 single-group sweeps; the shrunk
+    # budget must have split levels into multiple groups
+    assert outs[1]["sweeps"] > 21, outs
+    assert outs[0]["sweeps"] <= 21, outs
+    assert outs[0]["digest"] == outs[1]["digest"]
+    assert outs[0]["base"] == outs[1]["base"]

@@ -30,6 +30,7 @@
 // splitmix64 RNG for bootstrap/feature subsets — near-tie splits and
 // sampled ensembles agree statistically, not bit-for-bit.
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <cmath>
 #include <vector>
@@ -38,6 +39,8 @@
 namespace {
 
 constexpr double EPS = 1e-12;
+
+int64_t g_group_sweeps = 0;  // histogram sweeps (tests probe grouping)
 
 struct Rng {  // splitmix64
   uint64_t s;
@@ -66,186 +69,264 @@ struct GrowParams {
   double feature_frac;  // < 1 => per-node subsets (RF)
 };
 
-struct Node { int rel; int lo; int hi; };  // within-level id + idx range
-
 inline double score(const double* g, double h, int K, double lam) {
   double s = 0.0;
   for (int k = 0; k < K; ++k) s += g[k] * g[k];
   return s / (h + lam + EPS);
 }
 
-// Grow one tree. Xb [N, F] bins (int32 or uint8 — the Xb stream is the
-// dominant memory traffic at big N, so 1-byte bins matter); G [N, K];
+// Grow one tree. Xb [N, F] bins (int32 or uint8 — 1-byte bins matter:
+// the Xb stream is the dominant memory traffic at big N); G [N, K];
 // H [N]. Outputs feat/thresh/miss [2^depth - 1] (pre-filled dead), leaf
 // [2^depth, K] (pre-zeroed), and per-row payload `row_out` [N, K]
 // (training-time prediction for boosting; may be null).
+//
+// Level pass = SEQUENTIAL sweeps over the whole row array (libxgboost's
+// cache strategy): one sweep accumulates every live node's interleaved
+// histogram (each uint8 row of F=64 is exactly one cache line), a second
+// sweep routes rows / settles dead nodes in place via `nodeid`. The
+// earlier range-partition design gathered rows per node — one cache miss
+// per (row, pass) at big N. Live-node histograms are compact (allocated
+// for occupied nodes only, grouped under a memory budget when a deep
+// level has many live nodes), so deep trees on small data stay cheap and
+// big data stays bandwidth-bound, not latency-bound.
 template <typename XbT>
 void grow_tree(const XbT* Xb, int64_t N, int F, const float* G,
                const float* H, const GrowParams& P,
                const uint8_t* tree_fmask, Rng& rng,
                int32_t* feat, int32_t* thresh, int32_t* miss, float* leaf,
-               float* row_out, int32_t* idx, int32_t* idx_tmp) {
+               float* row_out, int32_t* nodeid) {
   const int B = P.B, K = P.K, depth = P.depth;
   const int M = (1 << depth) - 1;
   const int L = 1 << depth;
   for (int i = 0; i < M; ++i) { feat[i] = 0; thresh[i] = B - 1; miss[i] = 0; }
   std::memset(leaf, 0, sizeof(float) * L * K);
-  for (int64_t r = 0; r < N; ++r) idx[r] = (int32_t)r;
+  const int C2 = K + 2;  // interleaved cell: [g_0..g_{K-1}, h, count]
+  const size_t hist_sz = (size_t)F * B * C2;
+  // histogram bytes per group; TMOG_TREE_HIST_BUDGET_MB overrides (the
+  // grouping path is hard to reach with real sizes — tests shrink it)
+  static const size_t BUDGET = [] {
+    const char* e = std::getenv("TMOG_TREE_HIST_BUDGET_MB");
+    long mb = e ? std::atol(e) : 0;
+    return (size_t)(mb > 0 ? mb : 768) << 20;
+  }();
 
-  // per-node (feature, bin) histograms, reused across nodes. One
-  // INTERLEAVED array — cell (f, bin) holds [g_0..g_{K-1}, h, c]
-  // contiguously — so the inner build loop touches one cache line per
-  // (row, feature) instead of three (measured ~2x on 10M-row fits).
-  const int C2 = K + 2;
-  std::vector<double> hist((size_t)F * B * C2);
+  // rel node id of each row at the current level; -1 = settled
+  for (int64_t r = 0; r < N; ++r) nodeid[r] = 0;
+
   std::vector<double> cg(K), bg(K);
   std::vector<uint8_t> node_fmask(F);
 
-  auto finalize = [&](int lvl, int rel, int lo, int hi) {
-    // node (lvl, rel) takes no further splits: payload at the leftmost
-    // descendant leaf (all-left dead routing)
-    double gs_[16];
-    std::vector<double> gs_v;
-    double* gs = K <= 16 ? gs_ : (gs_v.resize(K), gs_v.data());
-    for (int k = 0; k < K; ++k) gs[k] = 0.0;
-    double hs = 0.0, cs = 0.0;
-    for (int i = lo; i < hi; ++i) {
-      const int32_t r = idx[i];
-      for (int k = 0; k < K; ++k) gs[k] += G[(size_t)r * K + k];
-      hs += H[r];
-      cs += H[r] > 0.f ? 1.0 : 0.0;
-    }
-    const int leaf_rel = rel << (depth - lvl);
-    float* out = leaf + (size_t)leaf_rel * K;
-    if (cs >= 0.5) {
+  // terminal payload for node (lvl, rel) with totals (gt, ht, ct); the
+  // subtree of a dead node is provably dead (children inherit the exact
+  // row set), so the mass lands at the leftmost descendant leaf
+  auto leaf_value = [&](const double* gt, double ht, double ct, int lvl,
+                        int rel) -> const float* {
+    float* out = leaf + ((size_t)rel << (depth - lvl)) * K;
+    if (ct >= 0.5)
       for (int k = 0; k < K; ++k)
         out[k] = (float)(P.lr * (P.leaf_mode == 0
-                                     ? -gs[k] / (hs + P.reg_lambda + EPS)
-                                     : gs[k] / (hs + EPS)));
-    }
-    if (row_out) {
-      for (int i = lo; i < hi; ++i)
-        for (int k = 0; k < K; ++k)
-          row_out[(size_t)idx[i] * K + k] = out[k];
-    }
+                                     ? -gt[k] / (ht + P.reg_lambda + EPS)
+                                     : gt[k] / (ht + EPS)));
+    return out;
   };
 
-  std::vector<Node> cur{{0, 0, (int)N}}, nxt;
-  for (int lvl = 0; lvl < depth; ++lvl) {
-    nxt.clear();
-    for (const Node& nd : cur) {
-      if (nd.hi == nd.lo) continue;  // empty subtree: zeros everywhere
-      // histograms over this node's rows
-      std::memset(hist.data(), 0, sizeof(double) * hist.size());
-      double ht = 0.0, ct = 0.0;
-      std::vector<double> gt(K, 0.0);
-      for (int i = nd.lo; i < nd.hi; ++i) {
-        const int32_t r = idx[i];
+  // split search over one node's histogram: (feature, bin, direction)
+  // first-max order (matches jnp.argmax over the same flattening)
+  auto search = [&](const double* hist, const double* gt, double ht,
+                    double ct, const uint8_t* fmask, int* out_f,
+                    int* out_t, int* out_m) {
+    const double parent = score(gt, ht, K, P.reg_lambda);
+    const double norm = P.normalize_gain ? std::max(ht, 1.0) : 1.0;
+    double best_gain = -1.0;
+    int bf = -1, bt = -1, bm = 0;
+    for (int f = 0; f < F; ++f) {
+      if (fmask && !fmask[f]) continue;
+      const double* fcell = hist + (size_t)f * B * C2;
+      const double* gm = fcell;  // missing-bin (slot 0) mass
+      const double hm = fcell[K], cm = fcell[K + 1];
+      for (int k = 0; k < K; ++k) cg[k] = 0.0;
+      double chl = 0.0, ccl = 0.0;
+      for (int b = 0; b < B; ++b) {
+        const double* cell = fcell + (size_t)b * C2;
+        for (int k = 0; k < K; ++k) cg[k] += cell[k];
+        chl += cell[K];
+        ccl += cell[K + 1];
+        for (int dir = 0; dir < 2; ++dir) {
+          double hl = chl, cl = ccl;
+          const double* gl = cg.data();
+          if (dir == 1) {  // move missing mass right
+            for (int k = 0; k < K; ++k) bg[k] = cg[k] - gm[k];
+            gl = bg.data();
+            hl -= hm;
+            cl -= cm;
+          }
+          const double hr = ht - hl, cr = ct - cl;
+          double sr = 0.0, sl = 0.0, grk;
+          for (int k = 0; k < K; ++k) {
+            grk = gt[k] - gl[k];
+            sr += grk * grk;
+          }
+          for (int k = 0; k < K; ++k) sl += gl[k] * gl[k];
+          const double gain = sl / (hl + P.reg_lambda + EPS)
+              + sr / (hr + P.reg_lambda + EPS) - parent;
+          const bool ok = hl >= P.min_child_weight
+              && hr >= P.min_child_weight && cl >= P.min_instances
+              && cr >= P.min_instances && gain / norm > P.min_info_gain
+              && gain > 2.0 * P.gamma;
+          if (ok && gain > best_gain) {
+            best_gain = gain;
+            bf = f; bt = b; bm = dir;
+          }
+        }
+      }
+    }
+    *out_f = bf; *out_t = bt; *out_m = bm;
+  };
+
+  std::vector<int32_t> live{0};  // sorted rel ids of occupied nodes
+  std::vector<double> hists, gtot, htot, ctot;
+  std::vector<int32_t> slot_of, bf_s, bt_s, bm_s;
+  std::vector<const float*> dead_leaf;
+  std::vector<int64_t> child_cnt;
+
+  for (int lvl = 0; lvl < depth && !live.empty(); ++lvl) {
+    const int n_live = (int)live.size();
+    slot_of.assign((size_t)1 << lvl, -1);
+    for (int s = 0; s < n_live; ++s) slot_of[live[s]] = s;
+    gtot.assign((size_t)n_live * K, 0.0);
+    htot.assign(n_live, 0.0);
+    ctot.assign(n_live, 0.0);
+    bf_s.assign(n_live, -1);
+    bt_s.assign(n_live, B - 1);
+    bm_s.assign(n_live, 0);
+
+    const int group = std::max<int>(1, (int)std::min<size_t>(
+        (size_t)n_live, BUDGET / (hist_sz * sizeof(double))));
+    for (int g0 = 0; g0 < n_live; g0 += group) {
+      const int g1 = std::min(n_live, g0 + group);
+      ++g_group_sweeps;
+      hists.assign((size_t)(g1 - g0) * hist_sz, 0.0);
+      for (int64_t r = 0; r < N; ++r) {  // sequential histogram sweep
+        const int32_t rel = nodeid[r];
+        if (rel < 0) continue;
+        const int32_t s = slot_of[rel];
+        if (s < g0 || s >= g1) continue;
+        double* hist = hists.data() + (size_t)(s - g0) * hist_sz;
         const XbT* xr = Xb + (size_t)r * F;
         const float* gr = G + (size_t)r * K;
         const double h = H[r];
         const double c = H[r] > 0.f ? 1.0 : 0.0;
         for (int f = 0; f < F; ++f) {
-          double* cell = hist.data()
-              + ((size_t)f * B + xr[f]) * C2;
+          double* cell = hist + ((size_t)f * B + xr[f]) * C2;
           for (int k = 0; k < K; ++k) cell[k] += gr[k];
           cell[K] += h;
           cell[K + 1] += c;
         }
+        double* gt = gtot.data() + (size_t)s * K;
         for (int k = 0; k < K; ++k) gt[k] += gr[k];
-        ht += h;
-        ct += c;
+        htot[s] += h;
+        ctot[s] += c;
       }
-      const double parent = score(gt.data(), ht, K, P.reg_lambda);
-      const double norm = P.normalize_gain ? std::max(ht, 1.0) : 1.0;
-
-      const uint8_t* fmask = tree_fmask;
-      if (P.feature_frac < 1.0) {
-        // per-node feature subset (Spark featureSubsetStrategy): partial
-        // Fisher-Yates drawing kf distinct features
-        int kf = std::max(1, (int)std::lround(P.feature_frac * F));
-        std::fill(node_fmask.begin(), node_fmask.end(), 0);
-        std::vector<int> ids(F);
-        for (int f = 0; f < F; ++f) ids[f] = f;
-        for (int t = 0; t < kf; ++t) {
-          int j = t + (int)(rng.next() % (uint64_t)(F - t));
-          std::swap(ids[t], ids[j]);
-          node_fmask[ids[t]] = 1;
-        }
-        fmask = node_fmask.data();
-      }
-
-      // split search: (feature, bin, direction) first-max order
-      double best_gain = -1.0;
-      int bf = -1, bt = -1, bm = 0;
-      for (int f = 0; f < F; ++f) {
-        if (fmask && !fmask[f]) continue;
-        const double* fcell = hist.data() + (size_t)f * B * C2;
-        const double* gm = fcell;     // missing-bin (slot 0) mass
-        const double hm = fcell[K], cm = fcell[K + 1];
-        for (int k = 0; k < K; ++k) cg[k] = 0.0;
-        double chl = 0.0, ccl = 0.0;
-        for (int b = 0; b < B; ++b) {
-          const double* cell = fcell + (size_t)b * C2;
-          for (int k = 0; k < K; ++k) cg[k] += cell[k];
-          chl += cell[K];
-          ccl += cell[K + 1];
-          for (int dir = 0; dir < 2; ++dir) {
-            double hl = chl, cl = ccl;
-            const double* gl = cg.data();
-            if (dir == 1) {  // move missing mass right
-              for (int k = 0; k < K; ++k) bg[k] = cg[k] - gm[k];
-              gl = bg.data();
-              hl -= hm;
-              cl -= cm;
-            }
-            const double hr = ht - hl, cr = ct - cl;
-            double sr = 0.0, sl = 0.0, grk;
-            for (int k = 0; k < K; ++k) {
-              grk = gt[k] - gl[k];
-              sr += grk * grk;
-            }
-            for (int k = 0; k < K; ++k) sl += gl[k] * gl[k];
-            const double gain = sl / (hl + P.reg_lambda + EPS)
-                + sr / (hr + P.reg_lambda + EPS) - parent;
-            const bool ok = hl >= P.min_child_weight
-                && hr >= P.min_child_weight && cl >= P.min_instances
-                && cr >= P.min_instances && gain / norm > P.min_info_gain
-                && gain > 2.0 * P.gamma;
-            if (ok && gain > best_gain) {
-              best_gain = gain;
-              bf = f; bt = b; bm = dir;
-            }
+      for (int s = g0; s < g1; ++s) {
+        const uint8_t* fmask = tree_fmask;
+        if (P.feature_frac < 1.0) {
+          // per-node feature subset (Spark featureSubsetStrategy):
+          // partial Fisher-Yates drawing kf distinct features, in live
+          // (sorted-rel) order so the RNG stream is deterministic
+          int kf = std::max(1, (int)std::lround(P.feature_frac * F));
+          std::fill(node_fmask.begin(), node_fmask.end(), 0);
+          std::vector<int> ids(F);
+          for (int f = 0; f < F; ++f) ids[f] = f;
+          for (int t = 0; t < kf; ++t) {
+            int j = t + (int)(rng.next() % (uint64_t)(F - t));
+            std::swap(ids[t], ids[j]);
+            node_fmask[ids[t]] = 1;
           }
+          fmask = node_fmask.data();
         }
+        search(hists.data() + (size_t)(s - g0) * hist_sz,
+               gtot.data() + (size_t)s * K, htot[s], ctot[s], fmask,
+               &bf_s[s], &bt_s[s], &bm_s[s]);
       }
+    }
 
-      const int gi = (1 << lvl) - 1 + nd.rel;
-      if (bf < 0) {  // no valid split: terminal (whole subtree dead)
-        finalize(lvl, nd.rel, nd.lo, nd.hi);
+    dead_leaf.assign(n_live, nullptr);
+    for (int s = 0; s < n_live; ++s) {
+      const int rel = live[s];
+      if (bf_s[s] < 0) {
+        dead_leaf[s] = leaf_value(gtot.data() + (size_t)s * K, htot[s],
+                                  ctot[s], lvl, rel);
+      } else {
+        const int gi = (1 << lvl) - 1 + rel;
+        feat[gi] = bf_s[s];
+        thresh[gi] = bt_s[s];
+        miss[gi] = bm_s[s];
+      }
+    }
+
+    // sequential routing sweep: settle dead rows, advance the rest
+    child_cnt.assign((size_t)2 * n_live, 0);
+    for (int64_t r = 0; r < N; ++r) {
+      const int32_t rel = nodeid[r];
+      if (rel < 0) continue;
+      const int32_t s = slot_of[rel];
+      if (bf_s[s] < 0) {
+        if (row_out) {
+          const float* out = dead_leaf[s];
+          for (int k = 0; k < K; ++k)
+            row_out[(size_t)r * K + k] = out[k];
+        }
+        nodeid[r] = -1;
         continue;
       }
-      feat[gi] = bf;
-      thresh[gi] = bt;
-      miss[gi] = bm;
-
-      // partition rows: right iff bin > t or (bin == 0 and miss)
-      int nl = nd.lo, nr = 0;
-      for (int i = nd.lo; i < nd.hi; ++i) {
-        const int32_t r = idx[i];
-        const int32_t b = (int32_t)Xb[(size_t)r * F + bf];
-        const bool right = (b > bt) || (b == 0 && bm > 0);
-        if (right) idx_tmp[nr++] = r;
-        else idx[nl++] = r;
-      }
-      std::memcpy(idx + nl, idx_tmp, sizeof(int32_t) * nr);
-      nxt.push_back({2 * nd.rel, nd.lo, nl});
-      nxt.push_back({2 * nd.rel + 1, nl, nd.hi});
+      const int32_t b = (int32_t)Xb[(size_t)r * F + bf_s[s]];
+      const int right = (b > bt_s[s]) || (b == 0 && bm_s[s] > 0) ? 1 : 0;
+      nodeid[r] = 2 * rel + right;
+      ++child_cnt[2 * s + right];
     }
-    cur.swap(nxt);
+
+    std::vector<int32_t> nxt;
+    nxt.reserve((size_t)2 * n_live);
+    for (int s = 0; s < n_live; ++s) {
+      if (bf_s[s] < 0) continue;
+      if (child_cnt[2 * s]) nxt.push_back(2 * live[s]);
+      if (child_cnt[2 * s + 1]) nxt.push_back(2 * live[s] + 1);
+    }
+    live.swap(nxt);
   }
-  for (const Node& nd : cur)  // survivors at full depth -> real leaves
-    if (nd.hi > nd.lo) finalize(depth, nd.rel, nd.lo, nd.hi);
+
+  // full-depth survivors: one totals sweep -> leaves (+ row_out)
+  if (!live.empty()) {
+    const int n_live = (int)live.size();
+    slot_of.assign((size_t)1 << depth, -1);
+    for (int s = 0; s < n_live; ++s) slot_of[live[s]] = s;
+    gtot.assign((size_t)n_live * K, 0.0);
+    htot.assign(n_live, 0.0);
+    ctot.assign(n_live, 0.0);
+    for (int64_t r = 0; r < N; ++r) {
+      const int32_t rel = nodeid[r];
+      if (rel < 0) continue;
+      const int32_t s = slot_of[rel];
+      const float* gr = G + (size_t)r * K;
+      double* gt = gtot.data() + (size_t)s * K;
+      for (int k = 0; k < K; ++k) gt[k] += gr[k];
+      htot[s] += H[r];
+      ctot[s] += H[r] > 0.f ? 1.0 : 0.0;
+    }
+    std::vector<const float*> outp(n_live);
+    for (int s = 0; s < n_live; ++s)
+      outp[s] = leaf_value(gtot.data() + (size_t)s * K, htot[s], ctot[s],
+                           depth, live[s]);
+    if (row_out) {
+      for (int64_t r = 0; r < N; ++r) {
+        const int32_t rel = nodeid[r];
+        if (rel < 0) continue;
+        const float* out = outp[slot_of[rel]];
+        for (int k = 0; k < K; ++k) row_out[(size_t)r * K + k] = out[k];
+      }
+    }
+  }
 }
 
 void tree_feature_mask(std::vector<uint8_t>& mask, int F,
@@ -292,7 +373,7 @@ int gbt_fit_impl(const XbT* Xb, int64_t N, int32_t F, int32_t B,
   const int M = (1 << depth) - 1, L = 1 << depth;
   std::vector<float> margin(N, (float)base), g(N), h(N), step(N);
   std::vector<float> gsub(N), hsub(N);
-  std::vector<int32_t> idx(N), idx_tmp(N);
+  std::vector<int32_t> nodeid(N);
   std::vector<uint8_t> fmask;
   GrowParams P{depth, B, 1, reg_lambda, min_child_weight, min_instances,
                min_info_gain, gamma, false, lr, 0, 1.0};
@@ -323,7 +404,7 @@ int gbt_fit_impl(const XbT* Xb, int64_t N, int32_t F, int32_t B,
     grow_tree(Xb, N, F, gp, hp, P, fmask.data(), rng,
               feat + (size_t)t * M, thresh + (size_t)t * M,
               miss + (size_t)t * M, leaf + (size_t)t * L, step.data(),
-              idx.data(), idx_tmp.data());
+              nodeid.data());
     for (int64_t r = 0; r < N; ++r) margin[r] += step[r];
   }
   return 0;
@@ -344,7 +425,7 @@ int gbt_softmax_impl(const XbT* Xb, int64_t N, int32_t F, int32_t B,
   const int M = (1 << depth) - 1, L = 1 << depth, C = n_classes;
   std::vector<float> margin((size_t)N * C, 0.f), p((size_t)N * C);
   std::vector<float> g(N), h(N), step(N), keep(N);
-  std::vector<int32_t> idx(N), idx_tmp(N);
+  std::vector<int32_t> nodeid(N);
   std::vector<uint8_t> fmask;
   // min_instances=1, min_info_gain=0: fit_gbt_softmax grows with
   // grow_tree's defaults for those
@@ -374,7 +455,7 @@ int gbt_softmax_impl(const XbT* Xb, int64_t N, int32_t F, int32_t B,
       const size_t ti = (size_t)t * C + c;
       grow_tree(Xb, N, F, g.data(), h.data(), P, fmask.data(), rng,
                 feat + ti * M, thresh + ti * M, miss + ti * M, leaf + ti * L,
-                step.data(), idx.data(), idx_tmp.data());
+                step.data(), nodeid.data());
       for (int64_t r = 0; r < N; ++r) margin[(size_t)r * C + c] += step[r];
     }
   }
@@ -395,7 +476,7 @@ int rf_fit_impl(const XbT* Xb, int64_t N, int32_t F, int32_t B,
   Rng rng(seed * 0x9e3779b97f4a7c15ULL + 3);
   const int M = (1 << depth) - 1, L = 1 << depth;
   std::vector<float> Gt((size_t)N * K), Ht(N);
-  std::vector<int32_t> idx(N), idx_tmp(N);
+  std::vector<int32_t> nodeid(N);
   GrowParams P{depth, B, (int)K, reg_lambda, 0.0, min_instances,
                min_info_gain, 0.0, true, 1.0, 1, feature_frac};
   for (int t = 0; t < n_trees; ++t) {
@@ -410,7 +491,7 @@ int rf_fit_impl(const XbT* Xb, int64_t N, int32_t F, int32_t B,
     grow_tree(Xb, N, F, Gt.data(), Ht.data(), P, nullptr, rng,
               feat + (size_t)t * M, thresh + (size_t)t * M,
               miss + (size_t)t * M, leaf + (size_t)t * L * K, nullptr,
-              idx.data(), idx_tmp.data());
+              nodeid.data());
   }
   return 0;
 }
@@ -484,5 +565,7 @@ int tmog_rf_fit(const void* Xb, int64_t N, int32_t F, int32_t B,
                        thresh, miss, leaf);
   return 2;
 }
+
+int64_t tmog_debug_group_sweeps(void) { return g_group_sweeps; }
 
 }  // extern "C"

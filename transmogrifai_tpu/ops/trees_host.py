@@ -50,6 +50,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.tmog_gbt_fit.restype = ctypes.c_int
         lib.tmog_gbt_softmax_fit.restype = ctypes.c_int
         lib.tmog_rf_fit.restype = ctypes.c_int
+        lib.tmog_debug_group_sweeps.restype = ctypes.c_int64
     except (OSError, AttributeError):
         return None
     _lib = lib
