@@ -496,6 +496,39 @@ int rf_fit_impl(const XbT* Xb, int64_t N, int32_t F, int32_t B,
   return 0;
 }
 
+// Sum of tree payloads on binned rows (predict_forest_bins twin). Rows
+// outer, trees inner: each row's bins stay in cache across the whole
+// ensemble; node arrays live in L1. feat/thresh/miss [T, 2^depth - 1],
+// leaf [T, 2^depth, K], out [N, K] (pre-zeroed by the caller).
+template <typename XbT>
+void predict_bins_impl(const XbT* Xb, int64_t N, int32_t F,
+                              const int32_t* feat, const int32_t* thresh,
+                              const int32_t* miss, const float* leaf,
+                              int32_t T, int32_t depth, int32_t K,
+                              float* out) {
+  const int M = (1 << depth) - 1;
+  const int L = 1 << depth;
+  for (int64_t r = 0; r < N; ++r) {
+    const XbT* xr = Xb + (size_t)r * F;
+    float* o = out + (size_t)r * K;
+    for (int t = 0; t < T; ++t) {
+      const int32_t* tf = feat + (size_t)t * M;
+      const int32_t* tt = thresh + (size_t)t * M;
+      const int32_t* tm = miss + (size_t)t * M;
+      int rel = 0;
+      for (int d = 0; d < depth; ++d) {
+        const int gi = (1 << d) - 1 + rel;
+        const int32_t b = (int32_t)xr[tf[gi]];
+        const int right = (b > tt[gi]) || (b == 0 && tm[gi] > 0) ? 1 : 0;
+        rel = 2 * rel + right;
+      }
+      const float* lf = leaf + ((size_t)t * L + rel) * K;
+      for (int k = 0; k < K; ++k) o[k] += lf[k];
+    }
+  }
+}
+
+
 }  // namespace
 
 // C ABI: `xb_itemsize` selects the bin dtype (4 = int32, 1 = uint8 —
@@ -567,5 +600,23 @@ int tmog_rf_fit(const void* Xb, int64_t N, int32_t F, int32_t B,
 }
 
 int64_t tmog_debug_group_sweeps(void) { return g_group_sweeps; }
+
+int tmog_predict_bins(const void* Xb, int64_t N, int32_t F,
+                      int32_t xb_itemsize, const int32_t* feat,
+                      const int32_t* thresh, const int32_t* miss,
+                      const float* leaf, int32_t T, int32_t depth,
+                      int32_t K, float* out) {
+  if (xb_itemsize == 1) {
+    predict_bins_impl((const uint8_t*)Xb, N, F, feat, thresh, miss, leaf,
+                      T, depth, K, out);
+    return 0;
+  }
+  if (xb_itemsize == 4) {
+    predict_bins_impl((const int32_t*)Xb, N, F, feat, thresh, miss, leaf,
+                      T, depth, K, out);
+    return 0;
+  }
+  return 2;
+}
 
 }  // extern "C"

@@ -51,6 +51,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.tmog_gbt_softmax_fit.restype = ctypes.c_int
         lib.tmog_rf_fit.restype = ctypes.c_int
         lib.tmog_debug_group_sweeps.restype = ctypes.c_int64
+        lib.tmog_predict_bins.restype = ctypes.c_int
     except (OSError, AttributeError):
         return None
     _lib = lib
@@ -245,12 +246,14 @@ def fit_forest_host(Xb: np.ndarray, G: np.ndarray, H: np.ndarray, *,
 
 def predict_bins_host(trees: T.Tree, Xb: np.ndarray, depth: int
                       ) -> np.ndarray:
-    """Sum of tree payloads on binned rows (numpy; mirrors
-    predict_forest_bins). trees may carry any leading batch axes."""
-    feat = np.asarray(trees.feat)
-    thresh = np.asarray(trees.thresh)
-    miss = np.asarray(trees.miss)
-    leaf = np.asarray(trees.leaf)
+    """Sum of tree payloads on binned rows (mirrors predict_forest_bins).
+    trees may carry any leading batch axes. Native row-major traversal
+    when the library is loaded (each row's bins stay in cache across the
+    ensemble); numpy gather fallback otherwise."""
+    feat = np.ascontiguousarray(np.asarray(trees.feat), np.int32)
+    thresh = np.ascontiguousarray(np.asarray(trees.thresh), np.int32)
+    miss = np.ascontiguousarray(np.asarray(trees.miss), np.int32)
+    leaf = np.ascontiguousarray(np.asarray(trees.leaf), np.float32)
     M = feat.shape[-1]
     K = leaf.shape[-1]
     feat = feat.reshape(-1, M)
@@ -259,6 +262,19 @@ def predict_bins_host(trees: T.Tree, Xb: np.ndarray, depth: int
     leaf = leaf.reshape(-1, leaf.shape[-2], K)
     N = Xb.shape[0]
     out = np.zeros((N, K), np.float32)
+
+    lib = _load()
+    if lib is not None:
+        Xbc, xb_ptr, itemsize = _xb_native(np.asarray(Xb))
+        rc = lib.tmog_predict_bins(
+            xb_ptr, ctypes.c_int64(N), ctypes.c_int32(Xbc.shape[1]),
+            ctypes.c_int32(itemsize), _c(feat, _i32p), _c(thresh, _i32p),
+            _c(miss, _i32p), _c(leaf, _f32p),
+            ctypes.c_int32(feat.shape[0]), ctypes.c_int32(depth),
+            ctypes.c_int32(K), _c(out, _f32p))
+        if rc == 0:
+            return out
+
     rows = np.arange(N)
     for t in range(feat.shape[0]):
         rel = np.zeros(N, np.int64)
