@@ -529,6 +529,38 @@ void predict_bins_impl(const XbT* Xb, int64_t N, int32_t F,
 }
 
 
+
+// Raw-value ensemble traversal (serving): x >= thresh goes right, NaN
+// follows the learned miss direction. thresh_val in raw units
+// (+inf = all-left dead node, -inf = all-present-right).
+void predict_raw_impl(const float* X, int64_t N, int32_t F,
+                      const int32_t* feat, const float* thresh_val,
+                      const int32_t* miss, const float* leaf, int32_t T,
+                      int32_t depth, int32_t K, float* out) {
+  const int M = (1 << depth) - 1;
+  const int L = 1 << depth;
+  for (int64_t r = 0; r < N; ++r) {
+    const float* xr = X + (size_t)r * F;
+    float* o = out + (size_t)r * K;
+    for (int t = 0; t < T; ++t) {
+      const int32_t* tf = feat + (size_t)t * M;
+      const float* tv = thresh_val + (size_t)t * M;
+      const int32_t* tm = miss + (size_t)t * M;
+      int rel = 0;
+      for (int d = 0; d < depth; ++d) {
+        const int gi = (1 << d) - 1 + rel;
+        const float x = xr[tf[gi]];
+        int right;
+        if (std::isnan(x)) right = tm[gi] > 0 ? 1 : 0;
+        else right = x >= tv[gi] ? 1 : 0;
+        rel = 2 * rel + right;
+      }
+      const float* lf = leaf + ((size_t)t * L + rel) * K;
+      for (int k = 0; k < K; ++k) o[k] += lf[k];
+    }
+  }
+}
+
 }  // namespace
 
 // C ABI: `xb_itemsize` selects the bin dtype (4 = int32, 1 = uint8 —
@@ -600,6 +632,15 @@ int tmog_rf_fit(const void* Xb, int64_t N, int32_t F, int32_t B,
 }
 
 int64_t tmog_debug_group_sweeps(void) { return g_group_sweeps; }
+
+int tmog_predict_raw(const float* X, int64_t N, int32_t F,
+                     const int32_t* feat, const float* thresh_val,
+                     const int32_t* miss, const float* leaf, int32_t T,
+                     int32_t depth, int32_t K, float* out) {
+  predict_raw_impl(X, N, F, feat, thresh_val, miss, leaf, T, depth, K,
+                   out);
+  return 0;
+}
 
 int tmog_predict_bins(const void* Xb, int64_t N, int32_t F,
                       int32_t xb_itemsize, const int32_t* feat,

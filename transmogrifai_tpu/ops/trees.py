@@ -802,8 +802,21 @@ def np_predict_ensemble(feat: np.ndarray, thresh_val: np.ndarray,
     [T, 2^depth, K]; X: [N, F]. Returns per-tree payload sum [N, K] — this
     is the Spark-free "local scoring" path (reference
     local/.../OpWorkflowModelLocal.scala:93), no JAX required.
+
+    Batches route through the native row-major traversal when the C++
+    library is loaded (single-row calls stay in numpy: the ctypes
+    call overhead exceeds one row's traversal).
     """
     N = X.shape[0]
+    if N > 1:
+        from . import trees_host as TH
+        miss_arr = (np.zeros_like(np.asarray(feat, np.int32))
+                    if miss is None else miss)
+        out = TH.predict_raw_native(feat, thresh_val, leaf,
+                                    np.asarray(X, np.float32), depth,
+                                    miss_arr)
+        if out is not None:
+            return out
     T = feat.shape[0]
     rel = np.zeros((N, T), np.int64)
     t_idx = np.arange(T)[None, :]

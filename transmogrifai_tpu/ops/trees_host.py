@@ -52,6 +52,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.tmog_rf_fit.restype = ctypes.c_int
         lib.tmog_debug_group_sweeps.restype = ctypes.c_int64
         lib.tmog_predict_bins.restype = ctypes.c_int
+        lib.tmog_predict_raw.restype = ctypes.c_int
     except (OSError, AttributeError):
         return None
     _lib = lib
@@ -286,3 +287,27 @@ def predict_bins_host(trees: T.Tree, Xb: np.ndarray, depth: int
             rel = 2 * rel + right
         out += leaf[t, rel]
     return out
+
+
+def predict_raw_native(feat: np.ndarray, thresh_val: np.ndarray,
+                       leaf: np.ndarray, X: np.ndarray, depth: int,
+                       miss: np.ndarray) -> Optional[np.ndarray]:
+    """Native raw-value ensemble traversal (serving twin of
+    ops/trees.np_predict_ensemble); None when the library is absent."""
+    lib = _load()
+    if lib is None:
+        return None
+    X = np.ascontiguousarray(X, np.float32)
+    feat = np.ascontiguousarray(feat, np.int32)
+    tv = np.ascontiguousarray(thresh_val, np.float32)
+    miss = np.ascontiguousarray(miss, np.int32)
+    leaf = np.ascontiguousarray(leaf, np.float32)
+    N, F = X.shape
+    T_, K = feat.shape[0], leaf.shape[-1]
+    out = np.zeros((N, K), np.float32)
+    rc = lib.tmog_predict_raw(
+        _c(X, _f32p), ctypes.c_int64(N), ctypes.c_int32(F),
+        _c(feat, _i32p), _c(tv, _f32p), _c(miss, _i32p), _c(leaf, _f32p),
+        ctypes.c_int32(T_), ctypes.c_int32(depth), ctypes.c_int32(K),
+        _c(out, _f32p))
+    return out if rc == 0 else None
