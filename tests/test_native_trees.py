@@ -311,3 +311,38 @@ print("R|" + json.dumps({"digest": digest, "base": float(base),
     assert outs[0]["sweeps"] <= 21, outs
     assert outs[0]["digest"] == outs[1]["digest"]
     assert outs[0]["base"] == outs[1]["base"]
+
+
+def test_predict_kernels_match_numpy_fallback(monkeypatch):
+    """The native binned and raw-value traversals must be bit-equal to
+    the numpy fallbacks (same trees, NaN-bearing raw rows)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(9)
+    n, d = 2000, 6
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    X[rng.uniform(size=(n, d)) < 0.15] = np.nan
+    y = (np.nan_to_num(X).sum(1) > 0).astype(np.float32)
+    Xb, edges, nb = TH.bin_context(X, 16)
+    trees, base = TH.fit_gbt_host(Xb, y, np.ones(n, np.float32),
+                                  n_rounds=5, depth=4, n_bins=nb)
+
+    native_bins = TH.predict_bins_host(trees, Xb, 4)
+    tv = np.asarray(T.thresholds_to_values(
+        jnp.asarray(trees.feat), jnp.asarray(trees.thresh),
+        jnp.asarray(edges)))
+    native_raw = T.np_predict_ensemble(trees.feat, tv, trees.leaf[:, :, :],
+                                       X, 4, miss=trees.miss)
+
+    # force the numpy fallbacks
+    monkeypatch.setattr(TH, "_load", lambda: None)
+    numpy_bins = TH.predict_bins_host(trees, Xb, 4)
+    monkeypatch.setattr(TH, "predict_raw_native", lambda *a, **k: None)
+    numpy_raw = T.np_predict_ensemble(trees.feat, tv, trees.leaf[:, :, :],
+                                      X, 4, miss=trees.miss)
+
+    np.testing.assert_array_equal(native_bins, numpy_bins)
+    np.testing.assert_array_equal(native_raw, numpy_raw)
+    # binned and raw traversals agree on the training rows too
+    np.testing.assert_allclose(native_bins[:, 0], native_raw[:, 0],
+                               atol=1e-5)
