@@ -46,24 +46,69 @@ def synthetic_housing(n: int = 506, seed: int = 1978):
     return rows
 
 
-def main() -> None:
+#: UCI housing.data column order (reference BostonHouse.scala case class)
+HOUSING_COLUMNS = ["crim", "zn", "indus", "chas", "nox", "rm", "age", "dis",
+                   "rad", "tax", "ptratio", "b", "lstat", "medv"]
+
+
+def load_housing(path: str):
+    """The classic UCI housing.data file (reference
+    helloworld/src/main/resources/BostonDataset): 14 whitespace-separated
+    columns per line, no header."""
+    rows = []
+    with open(path) as fh:
+        for i, line in enumerate(fh):
+            parts = line.split()
+            if len(parts) != len(HOUSING_COLUMNS):
+                continue
+            row = {k: float(v) for k, v in zip(HOUSING_COLUMNS, parts)}
+            row["rowId"] = i
+            rows.append(row)
+    return rows
+
+
+def build_workflow(names=None, model_types=None):
+    """Reference OpBoston.scala: chas is a PickList, rad Integral, the other
+    predictors RealNN (BostonFeatures.scala:37-51); selector GBT+RF (:89)."""
     medv = FeatureBuilder.RealNN("medv").extract(
         lambda r: r.get("medv")).as_response()
-    names = ["crim", "rm", "age", "dis", "tax", "ptratio", "lstat"]
-    feats = [FeatureBuilder.Real(n).extract(
-        lambda r, _n=n: r.get(_n)).as_predictor() for n in names]
+    names = names or ["crim", "rm", "age", "dis", "tax", "ptratio", "lstat"]
+    feats = []
+    for n in names:
+        if n == "chas":
+            feats.append(FeatureBuilder.PickList(n).extract(
+                lambda r: None if r.get("chas") is None
+                else str(int(r["chas"]))).as_predictor())
+        elif n == "rad":
+            feats.append(FeatureBuilder.Integral(n).extract(
+                lambda r: None if r.get("rad") is None
+                else int(r["rad"])).as_predictor())
+        else:
+            feats.append(FeatureBuilder.Real(n).extract(
+                lambda r, _n=n: r.get(_n)).as_predictor())
 
     vec = transmogrify(feats)
     checked = SanityChecker().set_input(medv, vec).get_output()
     pred = RegressionModelSelector.with_train_validation_split(
         train_ratio=0.75, seed=42,
-        model_types=["OpLinearRegression", "OpGBTRegressor"],
+        model_types=model_types or ["OpLinearRegression", "OpGBTRegressor"],
     ).set_input(medv, checked).get_output()
+    return Workflow().set_result_features(pred), pred
 
-    wf = Workflow().set_reader(ListReader(synthetic_housing())) \
-        .set_result_features(pred)
-    model = wf.train()
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if argv:
+        reader = ListReader(load_housing(argv[0]))
+        wf, _ = build_workflow(
+            names=[c for c in HOUSING_COLUMNS if c != "medv"],
+            model_types=["OpGBTRegressor", "OpRandomForestRegressor"])
+    else:
+        reader = ListReader(synthetic_housing())
+        wf, _ = build_workflow()
+    model = wf.set_reader(reader).train()
     print(model.summary_pretty())
+    return model
 
 
 if __name__ == "__main__":

@@ -46,7 +46,30 @@ def synthetic_iris(n_per_class: int = 50, seed: int = 7):
     return rows
 
 
-def main() -> None:
+def load_iris(path: str):
+    """The classic UCI iris.data file (reference
+    helloworld/src/main/resources/IrisDataset; OpIris.scala reads it with the
+    Iris case class): 4 measurements + ``Iris-<species>`` label per line.
+    The species string is index-encoded like the reference's
+    ``irisClass.indexed()`` (OpIris.scala:58)."""
+    rows = []
+    classes: dict = {}
+    with open(path) as fh:
+        for line in fh:
+            parts = line.strip().split(",")
+            if len(parts) != 5:
+                continue
+            cls = parts[4]
+            label = classes.setdefault(cls, len(classes))
+            rows.append({"sepalLength": float(parts[0]),
+                         "sepalWidth": float(parts[1]),
+                         "petalLength": float(parts[2]),
+                         "petalWidth": float(parts[3]),
+                         "irisClass": float(label), "species": cls})
+    return rows
+
+
+def build_workflow(splitter=None):
     label = FeatureBuilder.RealNN("irisClass").extract(
         lambda r: r.get("irisClass")).as_response()
     feats = [FeatureBuilder.Real(n).extract(
@@ -56,14 +79,25 @@ def main() -> None:
     vec = transmogrify(feats)
     checked = SanityChecker().set_input(label, vec).get_output()
     pred = MultiClassificationModelSelector.with_cross_validation(
-        num_folds=3, seed=42,
+        num_folds=3, seed=42, splitter=splitter,
         model_types=["OpLogisticRegression", "OpRandomForestClassifier"],
     ).set_input(label, checked).get_output()
+    return Workflow().set_result_features(pred), pred
 
-    wf = Workflow().set_reader(ListReader(synthetic_iris())) \
-        .set_result_features(pred)
-    model = wf.train()
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if argv:
+        # real data: reference OpIris.scala:64 holds out 20% via DataCutter
+        from transmogrifai_tpu.automl.tuning.splitters import DataCutter
+        reader = ListReader(load_iris(argv[0]))
+        splitter = DataCutter(seed=42, reserve_test_fraction=0.2)
+    else:
+        reader, splitter = ListReader(synthetic_iris()), None
+    wf, _ = build_workflow(splitter)
+    model = wf.set_reader(reader).train()
     print(model.summary_pretty())
+    return model
 
 
 if __name__ == "__main__":

@@ -52,13 +52,26 @@ def synthetic_passengers(n: int = 891, seed: int = 1912):
     return rows
 
 
+#: reference data file carries no header row (csvCase reads the schema from
+#: the Passenger case class, OpTitanicSimple.scala:59-73)
+PASSENGER_COLUMNS = ["id", "survived", "pClass", "name", "sex", "age",
+                     "sibSp", "parCh", "ticket", "fare", "cabin", "embarked"]
+
+
 def build_workflow():
-    # raw features (reference OpTitanicSimple.scala:94-105)
+    """The reference flow end to end (OpTitanicSimple.scala:94-137): raw
+    features, the hand-engineered derived features (familySize,
+    estimatedCostOfTickets, pivotedSex, normedAge, ageGroup), transmogrify,
+    sanity check, and an LR-only train/validation-split selector."""
+    from transmogrifai_tpu.types import PickList
+
     survived = FeatureBuilder.RealNN("survived").extract(
         lambda r: r.get("survived")).as_response()
     p_class = FeatureBuilder.PickList("pClass").extract(
         lambda r: None if r.get("pClass") is None
         else str(r.get("pClass"))).as_predictor()
+    name = FeatureBuilder.Text("name").extract(
+        lambda r: r.get("name")).as_predictor()
     sex = FeatureBuilder.PickList("sex").extract(
         lambda r: r.get("sex")).as_predictor()
     age = FeatureBuilder.Real("age").extract(
@@ -67,29 +80,63 @@ def build_workflow():
         lambda r: r.get("sibSp")).as_predictor()
     par_ch = FeatureBuilder.Integral("parCh").extract(
         lambda r: r.get("parCh")).as_predictor()
+    ticket = FeatureBuilder.PickList("ticket").extract(
+        lambda r: None if r.get("ticket") is None
+        else str(r.get("ticket"))).as_predictor()
     fare = FeatureBuilder.Real("fare").extract(
         lambda r: r.get("fare")).as_predictor()
+    cabin = FeatureBuilder.PickList("cabin").extract(
+        lambda r: None if r.get("cabin") is None
+        else str(r.get("cabin"))).as_predictor()
     embarked = FeatureBuilder.PickList("embarked").extract(
         lambda r: r.get("embarked")).as_predictor()
 
-    # derived feature via the dsl (reference: familySize = sibSp + parCh + 1)
+    # hand-engineered features (reference :118-122)
     family_size = (sib_sp + par_ch) + 1.0
+    estimated_cost = family_size * fare
+    pivoted_sex = sex.pivot()
+    normed_age = age.fill_missing_with_mean().z_normalize()
+    age_group = age.map(
+        lambda v: None if v.value is None
+        else ("adult" if v.value > 18 else "child"),
+        output_type=PickList, operation_name="ageGroup")
 
     features = transmogrify(
-        [p_class, sex, age, sib_sp, par_ch, fare, embarked, family_size])
-    checked = SanityChecker(check_sample=1.0).set_input(
-        survived, features).get_output()
-    prediction = BinaryClassificationModelSelector.with_cross_validation(
-        num_folds=3, seed=42,
-        model_types=["OpLogisticRegression", "OpRandomForestClassifier"],
+        [p_class, name, age, sib_sp, par_ch, ticket, cabin, embarked,
+         family_size, estimated_cost, pivoted_sex, age_group, normed_age])
+    checked = SanityChecker(check_sample=1.0, remove_bad_features=True) \
+        .set_input(survived, features).get_output()
+    prediction = BinaryClassificationModelSelector.with_train_validation_split(
+        seed=42, model_types=["OpLogisticRegression"],
     ).set_input(survived, checked).get_output()
     return Workflow().set_result_features(prediction), prediction
 
 
-def main(argv=None) -> None:
+#: Kaggle train.csv header names -> the reference case-class field names
+_KAGGLE_RENAME = {"PassengerId": "id", "Survived": "survived",
+                  "Pclass": "pClass", "Name": "name", "Sex": "sex",
+                  "Age": "age", "SibSp": "sibSp", "Parch": "parCh",
+                  "Ticket": "ticket", "Fare": "fare", "Cabin": "cabin",
+                  "Embarked": "embarked"}
+
+
+def passenger_reader(path: str):
+    """Reader for either Titanic file layout: the reference's headerless
+    TitanicPassengersTrainData.csv, or a Kaggle train.csv with a header row
+    (sniffed from the first line)."""
+    with open(path) as fh:
+        first = fh.readline()
+    if "Survived" in first or "survived" in first:
+        rows = [{_KAGGLE_RENAME.get(k, k): v for k, v in r.items()}
+                for r in CSVReader(path).read()]
+        return ListReader(rows)
+    return CSVReader(path, columns=PASSENGER_COLUMNS)
+
+
+def main(argv=None):
     argv = argv if argv is not None else sys.argv[1:]
     if argv:
-        reader = CSVReader(argv[0])
+        reader = passenger_reader(argv[0])
     else:
         reader = ListReader(synthetic_passengers())
     wf, prediction = build_workflow()
@@ -99,6 +146,7 @@ def main(argv=None) -> None:
     scores = model.score()
     print(f"\nScored {scores.n_rows} rows; "
           f"prediction column: {prediction.name[:60]}...")
+    return model
 
 
 if __name__ == "__main__":

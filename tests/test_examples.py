@@ -18,14 +18,14 @@ def test_titanic_flow_builds_and_trains(capsys):
 
 def test_iris_main_runs(capsys):
     import op_iris
-    op_iris.main()
+    op_iris.main([])
     out = capsys.readouterr().out
     assert "Selected" in out
 
 
 def test_boston_main_runs(capsys):
     import op_boston
-    op_boston.main()
+    op_boston.main([])
     out = capsys.readouterr().out
     assert "Selected" in out and "rmse" in out.lower()
 

@@ -84,11 +84,16 @@ class CSVReader(Reader):
 
     def __init__(self, path: str, key_fn: Optional[Callable[[Record], str]] = None,
                  schema: Optional[Dict[str, Callable[[str], Any]]] = None,
-                 null_values: Sequence[str] = ("", "NA", "null", "NULL", "None")):
+                 null_values: Sequence[str] = ("", "NA", "null", "NULL", "None"),
+                 columns: Optional[Sequence[str]] = None):
+        """``columns`` names the fields of a HEADERLESS file (reference
+        ``DataReaders.Simple.csvCase`` reads schema from the case class, so
+        its files carry no header row — e.g. the Titanic training CSV)."""
         super().__init__(key_fn)
         self.path = path
         self.schema = schema
         self.null_values = set(null_values)
+        self.columns = list(columns) if columns is not None else None
 
     def _coerce(self, name: str, v: str) -> Any:
         if v is None or v in self.null_values:
@@ -116,14 +121,33 @@ class CSVReader(Reader):
         except Exception:
             rows = None
         if rows is not None and rows:
-            header, body = rows[0], rows[1:]
-            return [{k: self._coerce(k, v) for k, v in zip(header, r)}
-                    for r in body if any(f != "" for f in r)]
+            if self.columns is not None:
+                header, body = self.columns, rows
+            else:
+                header, body = rows[0], rows[1:]
+            return [{k: self._coerce(k, v)
+                     for k, v in zip(header, self._checked(r, i))}
+                    for i, r in enumerate(body) if any(f != "" for f in r)]
         out: List[Record] = []
         with open(self.path, newline="") as fh:
-            for row in _csv.DictReader(fh):
-                out.append({k: self._coerce(k, v) for k, v in row.items()})
+            if self.columns is not None:
+                for i, raw in enumerate(_csv.reader(fh)):
+                    if any(f != "" for f in raw):
+                        out.append({k: self._coerce(k, v) for k, v
+                                    in zip(self.columns, self._checked(raw, i))})
+            else:
+                for row in _csv.DictReader(fh):
+                    out.append({k: self._coerce(k, v) for k, v in row.items()})
         return out
+
+    def _checked(self, row: Sequence[str], i: int) -> Sequence[str]:
+        """In explicit-columns mode a field-count mismatch is malformed input
+        — zip() would silently null or drop trailing fields otherwise."""
+        if self.columns is not None and len(row) != len(self.columns):
+            raise ValueError(
+                f"{self.path}: row {i + 1} has {len(row)} fields, expected "
+                f"{len(self.columns)} ({', '.join(self.columns[:4])}...)")
+        return row
 
 
 class JSONLinesReader(Reader):
