@@ -1,0 +1,48 @@
+"""Measure batched-LOCO throughput vs the host knockout loop (VERDICT r3
+#10 asks >=10x at 567 columns). Prints one JSON line per family."""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    n, d = int(os.environ.get("LOCO_ROWS", "2000")), 567
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+
+    from transmogrifai_tpu.insights.knockout import knockout_deltas
+    from transmogrifai_tpu.insights.loco import RecordInsightsLOCO
+    from transmogrifai_tpu.models.glm import OpLogisticRegression
+    from transmogrifai_tpu.models.trees import OpGBTClassifier
+
+    for name, model, force in (
+        ("glm", OpLogisticRegression(max_iter=10).fit_arrays(X, y), None),
+        ("gbt_scan", OpGBTClassifier(max_iter=10, max_depth=5)
+         .fit_arrays(X, y), True),
+    ):
+        loco = RecordInsightsLOCO(model=model)
+        knockout_deltas(model, X, force_tree=force)  # same-shape warmup
+        t0 = time.perf_counter()
+        batched = knockout_deltas(model, X, force_tree=force)
+        t_batched = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        loop = loco.insights_matrix_loop(X)
+        t_loop = time.perf_counter() - t0
+        err = float(np.abs(batched - loop).max())
+        print(json.dumps({
+            "family": name, "rows": n, "cols": d,
+            "batched_s": round(t_batched, 3), "loop_s": round(t_loop, 3),
+            "speedup": round(t_loop / t_batched, 1), "max_abs_err": err,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

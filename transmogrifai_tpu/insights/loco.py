@@ -47,7 +47,22 @@ class RecordInsightsLOCO(Transformer):
         return np.asarray(pred, np.float64)[:, None]
 
     def insights_matrix(self, X: np.ndarray) -> np.ndarray:
-        """[n, d, c] deltas: base_score - score_with_column_zeroed."""
+        """[n, d, c] deltas: base_score - score_with_column_zeroed.
+
+        Known model families route through a single device program per
+        family (insights/knockout.py: closed-form GLM shifts, lax.scan tree
+        re-traversal over the ensemble's active features); anything else
+        falls back to the generic one-pass-per-column host loop below."""
+        X = np.ascontiguousarray(X, np.float32)
+        from .knockout import knockout_deltas
+        batched = knockout_deltas(self.model, X)
+        if batched is not None:
+            return batched
+        return self.insights_matrix_loop(X)
+
+    def insights_matrix_loop(self, X: np.ndarray) -> np.ndarray:
+        """Generic host knockout loop (one forward pass per column); also
+        the parity oracle for the batched routes."""
         X = np.ascontiguousarray(X, np.float32)
         base = self._base_scores(X)                       # [n, c]
         n, d = X.shape
