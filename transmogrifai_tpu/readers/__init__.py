@@ -4,7 +4,8 @@ readers, streaming micro-batch readers."""
 from .avro import AvroReader, read_avro_file, write_avro_file
 from .readers import (
     AggregateReader, ConditionalReader, CSVReader, DataReaders,
-    JSONLinesReader, JoinedReader, ListReader, ParquetReader, Reader,
+    JSONLinesReader, JoinedAggregateReader, JoinedReader, ListReader,
+    ParquetReader, Reader, TimeBasedFilter, TimeColumn,
 )
 from .streaming import (
     AvroStreamingReader, CSVStreamingReader, FileStreamingReader,
@@ -14,7 +15,8 @@ from .streaming import (
 __all__ = [
     "AggregateReader", "AvroReader", "AvroStreamingReader",
     "ConditionalReader", "CSVReader", "CSVStreamingReader", "DataReaders",
-    "FileStreamingReader", "JSONLinesReader", "JoinedReader", "ListReader",
+    "FileStreamingReader", "JSONLinesReader", "JoinedAggregateReader",
+    "JoinedReader", "ListReader", "TimeBasedFilter", "TimeColumn",
     "ListStreamingReader", "ParquetReader", "Reader", "StreamingReader",
     "read_avro_file", "score_stream", "write_avro_file",
 ]

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import csv as _csv
 import json
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -277,9 +278,81 @@ class ConditionalReader(AggregateReader):
         return ds.with_column(KEY_COLUMN, Column(kind=ColumnKind.STRING, data=keys))
 
 
+def _merge_join_indices(lkeys: np.ndarray, rkeys: np.ndarray,
+                        join_type: str):
+    """Columnar one-to-many join plan: (l_idx, r_idx) row-index arrays into
+    the two sides (-1 = no match on that side). Sorted-merge via
+    argsort/searchsorted — no per-row python dict (reference
+    JoinedDataReader joins Spark DataFrames; a 10M-row parent-child join
+    must not walk a hash per row on the host)."""
+    L = len(lkeys)
+    if len(rkeys) == 0:
+        if join_type in ("left", "outer"):
+            return np.arange(L, dtype=np.int64), np.full(L, -1, np.int64)
+        return np.empty(0, np.int64), np.empty(0, np.int64)
+    r_order = np.argsort(rkeys, kind="stable")
+    rsorted = rkeys[r_order]
+    lo = np.searchsorted(rsorted, lkeys, "left")
+    hi = np.searchsorted(rsorted, lkeys, "right")
+    m = hi - lo
+    n_per = np.where(m > 0, m, 1 if join_type in ("left", "outer") else 0)
+    total = int(n_per.sum())
+    l_idx = np.repeat(np.arange(L), n_per)
+    starts = np.cumsum(n_per) - n_per
+    off = np.arange(total) - np.repeat(starts, n_per)
+    has = np.repeat(m > 0, n_per)
+    r_pos = np.repeat(lo, n_per) + off
+    r_idx = np.where(has, r_order[np.where(has, r_pos, 0)], -1)
+    if join_type == "outer" and len(rkeys):
+        # append right rows whose key never appears on the left
+        if L:
+            lsorted = np.sort(lkeys)
+            pos = np.clip(np.searchsorted(lsorted, rkeys), 0, L - 1)
+            matched = lsorted[pos] == rkeys
+        else:
+            matched = np.zeros(len(rkeys), bool)
+        extra = np.flatnonzero(~matched)
+        l_idx = np.concatenate([l_idx, np.full(len(extra), -1)])
+        r_idx = np.concatenate([r_idx, extra])
+    return l_idx.astype(np.int64), r_idx.astype(np.int64)
+
+
+def _gather_column(col, idx: np.ndarray):
+    """Columnar take with -1 -> missing, preserving the column's storage
+    (NaN for float kinds, None for object kinds)."""
+    from ..data.dataset import Column
+    from ..types import ColumnKind
+    miss = idx < 0
+    safe = np.where(miss, 0, idx)
+    data = col.data
+    if not isinstance(data, np.ndarray):
+        data = np.asarray(data, dtype=object)
+    if len(data) == 0:   # gathering from an empty side: all-missing rows
+        if col.kind == ColumnKind.VECTOR:
+            out = np.full((len(idx), 0), np.nan, np.float32)
+        elif data.dtype.kind == "f":
+            out = np.full(len(idx), np.nan)
+        else:
+            out = np.full(len(idx), None, dtype=object)
+        return Column(kind=col.kind, data=out, metadata=col.metadata)
+    out = data[safe]
+    if miss.any():
+        out = out.copy()
+        if data.dtype.kind == "f":
+            out[miss] = np.nan
+        else:
+            out = out.astype(object)
+            out[miss] = None
+    return Column(kind=col.kind, data=out, metadata=col.metadata)
+
+
 class JoinedReader(Reader):
     """Key-joins two readers' generated datasets (reference
-    JoinedDataReader.scala:83 — left-outer by key columns)."""
+    JoinedDataReader.scala:83). Columnar sorted-merge, one-to-many aware:
+    joining a parent reader to an event-level child reader emits one row
+    per (parent, child event) pair — feed that to
+    ``with_secondary_aggregation`` to re-aggregate per key afterwards
+    (reference JoinedAggregateDataReader)."""
 
     def __init__(self, left: Reader, right: Reader, join_type: str = "outer",
                  left_features: Optional[Sequence[str]] = None,
@@ -293,6 +366,16 @@ class JoinedReader(Reader):
         self.left_features = set(left_features) if left_features else None
         self.right_features = set(right_features) if right_features else None
 
+    def with_secondary_aggregation(
+            self, time_filter: "TimeBasedFilter",
+            combined: bool = False) -> "JoinedAggregateReader":
+        """Re-aggregate joined child rows per key with a time-based filter
+        (reference JoinedDataReader.withSecondaryAggregation:232)."""
+        return JoinedAggregateReader(
+            self.left, self.right, time_filter, join_type=self.join_type,
+            left_features=self.left_features,
+            right_features=self.right_features, combined=combined)
+
     def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
         left_feats, right_feats = [], []
         for f in raw_features:
@@ -302,32 +385,23 @@ class JoinedReader(Reader):
         rds = self.right.generate_dataset(right_feats)
         if KEY_COLUMN not in lds or KEY_COLUMN not in rds:
             raise ValueError("JoinedReader requires key_fn on both readers")
-        lkeys = list(lds.data(KEY_COLUMN))
-        rkeys = list(rds.data(KEY_COLUMN))
-        rindex = {k: i for i, k in enumerate(rkeys)}
-        lindex = {k: i for i, k in enumerate(lkeys)}
-        if self.join_type == "inner":
-            keys = [k for k in lkeys if k in rindex]
-        elif self.join_type == "left":
-            keys = lkeys
-        else:
-            keys = lkeys + [k for k in rkeys if k not in lindex]
+        lkeys = np.asarray(lds.data(KEY_COLUMN), dtype=object)
+        rkeys = np.asarray(rds.data(KEY_COLUMN), dtype=object)
+        l_idx, r_idx = _merge_join_indices(lkeys, rkeys, self.join_type)
         cols: Dict[str, Any] = {}
         for f in left_feats:
-            src = lds.data(f.name)
-            vals = [src[lindex[k]] if k in lindex else None for k in keys]
-            cols[f.name] = _recolumn(f, lds, vals)
+            cols[f.name] = _gather_column(lds.column(f.name), l_idx)
         for f in right_feats:
-            src = rds.data(f.name)
-            vals = [src[rindex[k]] if k in rindex else None for k in keys]
-            cols[f.name] = _recolumn(f, rds, vals)
+            cols[f.name] = _gather_column(rds.column(f.name), r_idx)
+        keys = np.empty(len(l_idx), dtype=object)
+        lm = l_idx >= 0
+        keys[lm] = lkeys[l_idx[lm]]
+        keys[~lm] = rkeys[r_idx[~lm]]
         ds = Dataset(cols)
-        arr = np.empty(len(keys), dtype=object)
-        for i, k in enumerate(keys):
-            arr[i] = k
         from ..data.dataset import Column
         from ..types import ColumnKind
-        return ds.with_column(KEY_COLUMN, Column(kind=ColumnKind.STRING, data=arr))
+        return ds.with_column(
+            KEY_COLUMN, Column(kind=ColumnKind.STRING, data=keys))
 
     def _side_of(self, f: Feature) -> str:
         """Route a feature to the reader whose records it extracts from:
@@ -348,9 +422,128 @@ class JoinedReader(Reader):
             "reader_hint")
 
 
-def _recolumn(f: Feature, ds: Dataset, vals: List[Any]):
-    col = column_from_values(f.feature_type, vals)
-    return col
+@dataclass
+class TimeColumn:
+    """Time column for post-join aggregation (reference TimeColumn,
+    JoinedDataReader.scala:54): ``keep=False`` drops it from the result."""
+
+    name: str
+    keep: bool = True
+
+
+@dataclass
+class TimeBasedFilter:
+    """Window filter for post-join conditional aggregation (reference
+    TimeBasedFilter, JoinedDataReader.scala:69). ``time_window`` is in the
+    same units as the two time columns (reference uses millis)."""
+
+    condition: TimeColumn
+    primary: TimeColumn
+    time_window: int
+
+
+class JoinedAggregateReader(JoinedReader):
+    """Join then RE-AGGREGATE per key with a time-based filter (reference
+    JoinedAggregateDataReader, JoinedDataReader.scala:250-345).
+
+    The join emits one row per (parent, child event) pair; this reader then
+    groups by key and folds each feature with its generator's monoid, but
+    only over rows inside the feature's time window relative to the row's
+    condition time (JoinedConditionalAggregator:430-441):
+
+    - predictors: ``cutoff - window < t < cutoff``
+    - responses:  ``cutoff <= t < cutoff + window``
+
+    Parent-side features keep one copy per key (DummyJoinedAggregator)
+    unless ``combined=True`` (reference isCombinedJoin), in which case they
+    are window-filtered too. The per-feature window defaults to the
+    filter's but is overridden by the feature generator's own
+    ``aggregator.window_ms`` (reference getConditionalAggregators:337).
+    """
+
+    def __init__(self, left: Reader, right: Reader,
+                 time_filter: TimeBasedFilter, join_type: str = "outer",
+                 left_features: Optional[Sequence[str]] = None,
+                 right_features: Optional[Sequence[str]] = None,
+                 combined: bool = False):
+        super().__init__(left, right, join_type=join_type,
+                         left_features=left_features,
+                         right_features=right_features)
+        self.time_filter = time_filter
+        self.combined = combined
+
+    def _time_values(self, ds: Dataset, name: str) -> np.ndarray:
+        """Column -> float64 time array; missing -> 0 (reference
+        JoinedConditionalAggregator.update: getOrElse(0L))."""
+        if name not in ds:
+            raise ValueError(
+                f"time filter column '{name}' is not in the joined data — "
+                "include its feature in raw_features")
+        arr = ds.column(name).data
+        if isinstance(arr, np.ndarray) and arr.dtype.kind == "f":
+            return np.nan_to_num(arr, nan=0.0)
+        return np.array([0.0 if v is None else float(v) for v in arr])
+
+    def generate_dataset(self, raw_features: Sequence[Feature]) -> Dataset:
+        joined = super().generate_dataset(raw_features)
+        keys = np.asarray(joined.data(KEY_COLUMN), dtype=object)
+        n = len(keys)
+        # group numbers in first-seen key order (np.unique sorts; reorder
+        # by first occurrence so output matches AggregateReader's order)
+        uniq, first_idx, inv = np.unique(keys, return_index=True,
+                                         return_inverse=True)
+        rank = np.argsort(np.argsort(first_idx))
+        group_of_row = rank[inv]
+        n_groups = len(uniq)
+        ordered_keys = uniq[np.argsort(first_idx)]
+        # member rows per group, original order preserved within group
+        row_order = np.argsort(group_of_row, kind="stable")
+        bounds = np.searchsorted(group_of_row[row_order],
+                                 np.arange(n_groups + 1))
+        t = self._time_values(joined, self.time_filter.primary.name)
+        cutoff = self._time_values(joined, self.time_filter.condition.name)
+
+        left_names = {f.name for f in raw_features
+                      if self._side_of(f) == "left"}
+        drop = {c.name for c in (self.time_filter.condition,
+                                 self.time_filter.primary) if not c.keep}
+        cols: Dict[str, Any] = {}
+        for f in raw_features:
+            if f.name in drop:
+                continue
+            g = self._generator_of(f)
+            data = joined.column(f.name).data
+            is_float = isinstance(data, np.ndarray) and data.dtype.kind == "f"
+            dummy = f.name in left_names and not self.combined
+            if dummy:
+                ok = np.ones(n, bool)
+            else:
+                w = g.aggregator.window_ms
+                w = self.time_filter.time_window if w is None else w
+                if f.is_response:
+                    ok = (t >= cutoff) & (t < cutoff + w)
+                else:
+                    ok = (t < cutoff) & (t > cutoff - w)
+            vals = []
+            for gi in range(n_groups):
+                rows = row_order[bounds[gi]:bounds[gi + 1]]
+                if dummy:
+                    # one copy per key (merge keeps the later value)
+                    v = data[rows[-1]]
+                    vals.append(None if is_float and np.isnan(v) else v)
+                    continue
+                rows = rows[ok[rows]]
+                ev = [(None if is_float and np.isnan(data[r]) else data[r],
+                       t[r]) for r in rows]
+                vals.append(g.aggregator.aggregator.reduce(
+                    [v for v, _ in ev], [tt for _, tt in ev]))
+            cols[f.name] = column_from_values(f.feature_type, vals)
+        ds = Dataset(cols)
+        from ..data.dataset import Column
+        from ..types import ColumnKind
+        return ds.with_column(
+            KEY_COLUMN, Column(kind=ColumnKind.STRING,
+                               data=ordered_keys.astype(object)))
 
 
 class DataReaders:
