@@ -132,3 +132,70 @@ class TestLOCO:
         loco = RecordInsightsLOCO(model=model)
         deltas = loco.insights_matrix(X)
         assert np.abs(deltas[:, 2, :]).max() < 1e-6
+
+
+class TestModelInsightsDepth:
+    """VERDICT r3 #9: per-derived-column records at reference depth
+    (Insights/LabelSummary fields of ModelInsights.scala:280-390)."""
+
+    def test_label_summary(self, fitted):
+        model, _ = fitted
+        lab = model.model_insights().label
+        assert lab.label_name == "label"
+        assert lab.raw_feature_name == ["label"]
+        assert lab.raw_feature_type == ["RealNN"]
+        assert lab.sample_size == 500
+        assert lab.distribution["kind"] == "discrete"
+        assert sorted(lab.distribution["domain"]) == ["0.0", "1.0"]
+        assert sum(lab.distribution["prob"]) == pytest.approx(1.0)
+
+    def test_derived_columns_carry_stats_and_stages(self, fitted):
+        model, _ = fitted
+        mi = model.model_insights()
+        by_name = {f.feature_name: f for f in mi.features}
+        d = by_name["strong"].derived[0]
+        assert d.mean is not None and d.variance is not None
+        assert d.min is not None and d.max is not None
+        assert d.excluded is False
+        assert d.contributions, "per-class contributions missing"
+        assert any("vecReal" in s or "sanityCheck" in s or s
+                   for s in d.stages_applied)
+
+    def test_stage_info_map(self, fitted):
+        model, _ = fitted
+        mi = model.model_insights()
+        assert mi.stage_info, "stage_info empty"
+        assert any("sanityCheck" in k for k in mi.stage_info)
+
+    def test_titanic_insights_list_every_raw_feature(self):
+        """Reference-flow acceptance: insights JSON for the titanic example
+        lists every raw predictor with derived columns + checker stats +
+        model contribution (VERDICT r3 #9 'done' bar)."""
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "examples"))
+        import op_titanic_simple as t
+        wf, _ = t.build_workflow()
+        model = wf.set_reader(
+            ListReader(t.synthetic_passengers(400))).train()
+        mi = model.model_insights()
+        by_name = {f.feature_name: f for f in mi.features}
+        for raw in ("pClass", "age", "sibSp", "parCh", "embarked"):
+            assert raw in by_name, f"{raw} missing from insights"
+            fi = by_name[raw]
+            assert fi.derived, f"{raw} has no derived columns"
+            kept = [d for d in fi.derived if d.column_index >= 0]
+            assert any(d.contribution is not None for d in kept) or \
+                fi.excluded_by, raw
+            assert any(d.mean is not None for d in kept) or fi.excluded_by
+            assert all(d.stages_applied for d in kept), raw
+        # one-hot pivot columns carry categorical group stats
+        cat_cols = [d for f in mi.features for d in f.derived
+                    if d.indicator_value is not None
+                    and d.column_index >= 0]
+        assert any(d.count_matrix for d in cat_cols), \
+            "no contingency stats on categorical columns"
+        assert any(d.mutual_information is not None for d in cat_cols)
+        j = json.dumps(mi.to_json())
+        assert "count_matrix" in j and "stages_applied" in j

@@ -136,6 +136,12 @@ class SanityCheckerSummary:
     drop_reasons: Dict[str, List[str]]
     sample_fraction: float
     correlations_matrix: Optional[List[List[float]]] = None
+    # discrete label domain + per-value counts when the label was treated
+    # as categorical (feeds ModelInsights LabelSummary.distribution)
+    label_distribution: Optional[Dict[str, List[float]]] = None
+    # dropped column name -> parent raw feature (resolved from the
+    # PRE-slice metadata, which the fitted model no longer carries)
+    dropped_parents: Dict[str, str] = field(default_factory=dict)
 
     def to_json(self) -> Dict[str, Any]:
         from dataclasses import asdict
@@ -404,6 +410,8 @@ class SanityChecker(Estimator):
                 "group": g.group, "categorical_features": g.categorical_features,
                 "cramers_v": g.cramers_v, "chi2": g.chi2,
                 "mutual_info": g.mutual_info,
+                "pointwise_mutual_info": g.pointwise_mutual_info,
+                "contingency_matrix": g.contingency_matrix,
                 "max_rule_confidences": g.max_rule_confidences,
                 "supports": g.supports,
             } for g in group_stats],
@@ -412,6 +420,14 @@ class SanityChecker(Estimator):
             sample_fraction=frac,
             correlations_matrix=(corr_matrix.tolist()
                                  if corr_matrix is not None else None),
+            label_distribution=(
+                {"domain": [float(v) for v in distinct],
+                 "counts": [float(c) for c in
+                            (y[:, None] == distinct[None, :]).sum(axis=0)]}
+                if is_cat else None),
+            dropped_parents={
+                names[i]: columns[i].parent_feature_name
+                for i in drop_indices if columns[i] is not None},
         )
         out_meta = meta.select(keep) if meta is not None else None
         return SanityCheckerModel(indices_to_keep=keep, metadata=out_meta,

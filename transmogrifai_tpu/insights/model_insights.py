@@ -43,22 +43,66 @@ def model_contributions(model: Any, n_cols: int) -> Optional[np.ndarray]:
     return None
 
 
+def model_contributions_per_class(model: Any,
+                                  n_cols: int) -> Optional[np.ndarray]:
+    """[n_cols, c] per-class contributions where the family has them
+    (reference Insights.contribution is a Seq — one weight per class for
+    multinomial models); single-column for binary/regression/tree models."""
+    from ..models import glm
+    from ..automl.selector import SelectedModel
+
+    if isinstance(model, SelectedModel):
+        return model_contributions_per_class(model.best_model, n_cols)
+    if isinstance(model, glm.SoftmaxModel):
+        return np.abs(model.B[:n_cols, :])
+    if isinstance(model, glm.NaiveBayesModel):
+        return np.abs(model.log_prob.T[:n_cols, :])
+    flat = model_contributions(model, n_cols)
+    return None if flat is None else flat[:, None]
+
+
 # -- insight records --------------------------------------------------------
+
+@dataclass
+class LabelSummary:
+    """Label provenance + distribution (reference LabelSummary,
+    ModelInsights.scala:291)."""
+
+    label_name: Optional[str] = None
+    raw_feature_name: List[str] = field(default_factory=list)
+    raw_feature_type: List[str] = field(default_factory=list)
+    stages_applied: List[str] = field(default_factory=list)
+    sample_size: Optional[float] = None
+    # {"kind": "continuous", min, max, mean, variance} or
+    # {"kind": "discrete", "domain": [...], "prob": [...]}
+    distribution: Optional[Dict[str, Any]] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
 
 @dataclass
 class DerivedFeatureInsights:
     """One column of the model's input vector (reference Insights per
-    derived feature)."""
+    derived feature, ModelInsights.scala:372)."""
 
     column_name: str
     column_index: int
     grouping: Optional[str] = None
     indicator_value: Optional[str] = None
+    stages_applied: List[str] = field(default_factory=list)
+    excluded: Optional[bool] = None
     contribution: Optional[float] = None
+    contributions: List[float] = field(default_factory=list)  # per class
     corr_label: Optional[float] = None
     cramers_v: Optional[float] = None
+    mutual_information: Optional[float] = None
+    pointwise_mutual_information: Dict[str, float] = field(default_factory=dict)
+    count_matrix: Dict[str, float] = field(default_factory=dict)
     variance: Optional[float] = None
     mean: Optional[float] = None
+    min: Optional[float] = None
+    max: Optional[float] = None
 
     def to_json(self) -> Dict[str, Any]:
         return dict(self.__dict__)
@@ -66,13 +110,17 @@ class DerivedFeatureInsights:
 
 @dataclass
 class FeatureInsights:
-    """All derived columns of one raw feature + exclusion info."""
+    """All derived columns of one raw feature + exclusion info
+    (reference FeatureInsights, ModelInsights.scala:336)."""
 
     feature_name: str
     feature_type: str = ""
     derived: List[DerivedFeatureInsights] = field(default_factory=list)
     excluded_by: Optional[str] = None     # 'SanityChecker'|'RawFeatureFilter'
     exclusion_reasons: List[str] = field(default_factory=list)
+    # RawFeatureFilter artifacts for this raw feature, when it ran
+    rff_metrics: List[Dict[str, Any]] = field(default_factory=list)
+    rff_distributions: List[Dict[str, Any]] = field(default_factory=list)
 
     def max_contribution(self) -> float:
         vals = [d.contribution for d in self.derived
@@ -89,7 +137,9 @@ class FeatureInsights:
                 "feature_type": self.feature_type,
                 "derived": [d.to_json() for d in self.derived],
                 "excluded_by": self.excluded_by,
-                "exclusion_reasons": list(self.exclusion_reasons)}
+                "exclusion_reasons": list(self.exclusion_reasons),
+                "rff_metrics": list(self.rff_metrics),
+                "rff_distributions": list(self.rff_distributions)}
 
 
 @dataclass
@@ -105,17 +155,22 @@ class ModelInsights:
     holdout_evaluation: Dict[str, float] = field(default_factory=dict)
     stage_names: List[str] = field(default_factory=list)
     blacklisted: List[str] = field(default_factory=list)
+    label: Optional[LabelSummary] = None
+    # per-stage parameter snapshot (reference stageInfo map)
+    stage_info: Dict[str, Dict[str, Any]] = field(default_factory=dict)
 
     def to_json(self) -> Dict[str, Any]:
         return {
             "label_name": self.label_name,
             "problem_type": self.problem_type,
+            "label": self.label.to_json() if self.label else None,
             "features": [f.to_json() for f in self.features],
             "selected_model": self.selected_model,
             "validation_results": self.validation_results,
             "train_evaluation": self.train_evaluation,
             "holdout_evaluation": self.holdout_evaluation,
             "stage_names": self.stage_names,
+            "stage_info": self.stage_info,
             "blacklisted": self.blacklisted,
         }
 
@@ -169,14 +224,64 @@ def _final_vector_metadata(model) -> Optional[Any]:
     producing stage's."""
     sc = model._sanity_checker()
     if sc is not None and getattr(sc, "metadata", None) is not None:
-        idx = getattr(sc, "indices_to_keep", None)
-        md = sc.metadata
-        return md.select(list(idx)) if idx is not None else md
+        # the fitted checker's metadata is already the POST-slice view
+        # (SanityChecker.fit builds it via meta.select(keep))
+        return sc.metadata
     for st in reversed(model.stages):
         md = st.output_metadata()
         if md is not None:
             return md
     return None
+
+
+def _feature_graph_by_name(model) -> Dict[str, Any]:
+    """name -> Feature for every node reachable from the result features."""
+    out: Dict[str, Any] = {}
+    for rf in getattr(model, "result_features", ()):
+        for f in rf.all_features():
+            out.setdefault(f.name, f)
+    return out
+
+
+def _stages_applied(feature) -> List[str]:
+    """Stage-name chain that produced this feature from its raw inputs
+    (reference Insights.stagesApplied via FeatureHistory)."""
+    if feature is None:
+        return []
+    names: List[str] = []
+    for st in feature.parent_stages():
+        nm = getattr(st, "stage_name", None) or type(st).__name__
+        if nm not in names:
+            names.append(nm)
+    return names
+
+
+def _label_summary(model, sc_summary, label_name) -> LabelSummary:
+    """Reference LabelSummary: provenance from the label feature's history,
+    distribution from the checker's label stats."""
+    graph = _feature_graph_by_name(model)
+    lf = graph.get(label_name) if label_name else None
+    raws = [f.name for f in lf.raw_features()] if lf is not None else []
+    raw_types = [f.type_name for f in lf.raw_features()] if lf is not None \
+        else []
+    sample, dist = None, None
+    if sc_summary is not None and sc_summary.column_stats:
+        ls = sc_summary.column_stats[0]
+        sample = ls.get("count")
+        ld = getattr(sc_summary, "label_distribution", None)
+        if ld:
+            total = sum(ld["counts"]) or 1.0
+            dist = {"kind": "discrete",
+                    "domain": [str(v) for v in ld["domain"]],
+                    "prob": [c / total for c in ld["counts"]]}
+        else:
+            dist = {"kind": "continuous", "min": ls.get("min"),
+                    "max": ls.get("max"), "mean": ls.get("mean"),
+                    "variance": ls.get("variance")}
+    return LabelSummary(label_name=label_name, raw_feature_name=raws,
+                        raw_feature_type=raw_types,
+                        stages_applied=_stages_applied(lf),
+                        sample_size=sample, distribution=dist)
 
 
 def extract_insights(model) -> ModelInsights:
@@ -197,9 +302,26 @@ def extract_insights(model) -> ModelInsights:
         for st in cs[1:]:
             stats_by_name[st["name"]] = st
 
+    # categorical group stats indexed by member column name: the group's
+    # MI is shared, the PMI / contingency columns are per member
+    cat_by_col: Dict[str, Dict[str, Any]] = {}
+    label_domain: List[str] = []
+    if sc_summary is not None:
+        ld = getattr(sc_summary, "label_distribution", None)
+        if ld:
+            label_domain = [str(v) for v in ld["domain"]]
+        for g in sc_summary.categorical_stats:
+            for pos, col in enumerate(g.get("categorical_features", [])):
+                cat_by_col[col] = {"group": g, "pos": pos}
+
     contrib = None
+    contrib_pc = None
     if sel is not None and md is not None:
         contrib = model_contributions(sel, md.size)
+        contrib_pc = model_contributions_per_class(sel, md.size)
+
+    graph = _feature_graph_by_name(model)
+    dropped_set = set(sc_summary.dropped) if sc_summary is not None else set()
 
     features: Dict[str, FeatureInsights] = {}
     if md is not None:
@@ -210,28 +332,56 @@ def extract_insights(model) -> ModelInsights:
                                 feature_type=c.parent_feature_type))
             name = c.column_name()
             st = stats_by_name.get(name, {})
+            mi = pmi = counts = None
+            cat = cat_by_col.get(name)
+            if cat is not None:
+                # contingency/PMI rows are the group's member features,
+                # columns the label values (preparators._categorical_tests)
+                g, pos = cat["group"], cat["pos"]
+                mi = g.get("mutual_info")
+                pm = g.get("pointwise_mutual_info")
+                cm = g.get("contingency_matrix")
+
+                def _label_row(matrix):
+                    if matrix is None or pos >= len(matrix):
+                        return None
+                    row = matrix[pos]
+                    dom = (label_domain if len(label_domain) == len(row)
+                           else [str(i) for i in range(len(row))])
+                    return {dom[j]: float(v) for j, v in enumerate(row)}
+
+                pmi = _label_row(pm)
+                counts = _label_row(cm)
             fi.derived.append(DerivedFeatureInsights(
                 column_name=name, column_index=c.index,
                 grouping=c.grouping, indicator_value=c.indicator_value,
+                stages_applied=_stages_applied(
+                    graph.get(c.parent_feature_name)),
+                excluded=(name in dropped_set) if sc_summary is not None
+                else None,
                 contribution=(float(contrib[c.index])
                               if contrib is not None and c.index < len(contrib)
                               else None),
+                contributions=([float(v) for v in contrib_pc[c.index]]
+                               if contrib_pc is not None
+                               and c.index < len(contrib_pc) else []),
                 corr_label=st.get("corr_label"),
                 cramers_v=st.get("cramers_v"),
+                mutual_information=mi,
+                pointwise_mutual_information=pmi or {},
+                count_matrix=counts or {},
                 variance=st.get("variance"),
-                mean=st.get("mean")))
+                mean=st.get("mean"),
+                min=st.get("min"), max=st.get("max")))
 
     # columns the SanityChecker dropped still deserve a line w/ reasons.
-    # Resolve each dropped column's parent from the checker's PRE-slice
-    # vector metadata — string-splitting the column name breaks for any raw
-    # feature whose name contains an underscore (e.g. 'pickup_time').
+    # Their parents come from the summary's dropped_parents map (resolved
+    # at fit time from the PRE-slice metadata) — string-splitting the
+    # column name breaks for any raw feature whose name contains an
+    # underscore (e.g. 'pickup_time').
     dropped_parent: Dict[str, str] = {}
     if sc_summary is not None and sc_summary.dropped:
-        sc_stage = model._sanity_checker()
-        if sc_stage is not None and \
-                getattr(sc_stage, "metadata", None) is not None:
-            dropped_parent = {c.column_name(): c.parent_feature_name
-                              for c in sc_stage.metadata.columns}
+        dropped_parent = dict(getattr(sc_summary, "dropped_parents", {}))
     if sc_summary is not None:
         for dropped_col in sc_summary.dropped:
             reasons = sc_summary.drop_reasons.get(dropped_col, [])
@@ -263,9 +413,38 @@ def extract_insights(model) -> ModelInsights:
                 for k, v in r.to_json().items()
                 if isinstance(v, bool) and v]
 
+    # RawFeatureFilter per-feature artifacts (reference FeatureInsights
+    # metrics/distributions fields)
+    if model.rff_results is not None:
+        rff = model.rff_results
+        for fd in rff.train_distributions:
+            fi = features.get(fd.name)
+            if fi is not None:
+                d = fd.to_json() if hasattr(fd, "to_json") else dict(
+                    fd.__dict__)
+                fi.rff_distributions.append(d)
+        for er in rff.exclusion_reasons:
+            fi = features.get(er.name)
+            if fi is not None:
+                fi.rff_metrics.append(er.to_json()
+                                      if hasattr(er, "to_json")
+                                      else dict(er.__dict__))
+
+    stage_info: Dict[str, Dict[str, Any]] = {}
+    for st in model.stages:
+        try:
+            stage_info[st.stage_name] = {
+                k: v for k, v in st.param_values().items()
+                if isinstance(v, (int, float, str, bool, type(None)))}
+        except Exception:
+            stage_info[st.stage_name] = {}
+
+    problem_type = sel_summary.problem_type if sel_summary else None
     return ModelInsights(
         label_name=label_name,
-        problem_type=(sel_summary.problem_type if sel_summary else None),
+        problem_type=problem_type,
+        label=_label_summary(model, sc_summary, label_name),
+        stage_info=stage_info,
         features=list(features.values()),
         selected_model=({"best_model_type": sel_summary.best_model_type,
                          "best_model_name": sel_summary.best_model_name,
