@@ -273,3 +273,93 @@ def map_vectorizer_for(map_type_name: str, defaults) -> MapVectorizer:
         num_features=defaults.default_num_of_features,
         clean_text=defaults.clean_text, clean_keys=defaults.clean_keys,
         track_nulls=defaults.track_nulls)
+
+
+class DateMapUnitCircleModel(VectorizerModel):
+    """Fitted DateMap -> per-key [sin, cos] unit-circle blocks (reference
+    DateMapToUnitCircleVectorizer.scala via RichMapFeature
+    .toUnitCircle:716). Missing keys map to the origin (0, 0) exactly like
+    the scalar DateToUnitCircleTransformer."""
+
+    def __init__(self, key_sets: Sequence[List[str]] = (),
+                 time_period: str = "HourOfDay", clean_keys: bool = False,
+                 operation_name: str = "dateMapUnitCircle",
+                 uid: Optional[str] = None):
+        super().__init__(operation_name, uid=uid)
+        self.key_sets = [list(ks) for ks in key_sets]
+        self.time_period = str(time_period)
+        self.clean_keys = bool(clean_keys)
+
+    def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        from .dates import unit_circle
+        key_clean = (lambda s: clean_key(s, True)) if self.clean_keys \
+            else None
+        blocks: List[np.ndarray] = []
+        for keys, c in zip(self.key_sets, cols):
+            keycols = extract_key_columns(c.data, keys, key_clean)
+            for key in keys:
+                ms = float_column(keycols[key], np.nan)
+                s, co, _ = unit_circle(ms, self.time_period)
+                blocks.append(np.stack([s, co], axis=1))
+        n = len(cols[0].data) if cols else 0
+        return (np.concatenate(blocks, axis=1) if blocks
+                else np.zeros((n, 0)))
+
+    def save_args(self) -> Dict[str, Any]:
+        d = super().save_args()
+        d.update(key_sets=self.key_sets, time_period=self.time_period,
+                 clean_keys=self.clean_keys)
+        return d
+
+
+class DateMapUnitCircleVectorizer(SequenceVectorizer):
+    """Estimator: discover each DateMap's key set, emit [sin, cos] per key
+    for one calendar period (reference RichMapFeature.toUnitCircle)."""
+
+    input_types = (OPMap,)
+
+    @classmethod
+    def _declare_params(cls):
+        return [
+            Param("time_period", "HourOfDay|DayOfWeek|DayOfMonth|DayOfYear|"
+                  "WeekOfYear|MonthOfYear", "HourOfDay"),
+            Param("clean_keys", "normalize map keys", False),
+            Param("allow_listed_keys", "restrict to these keys (None = all)",
+                  None),
+            Param("block_listed_keys", "exclude these keys", None),
+        ]
+
+    def __init__(self, operation_name: str = "dateMapUnitCircle",
+                 uid: Optional[str] = None, **params):
+        super().__init__(operation_name, uid=uid, **params)
+
+    def fit_columns(self, *cols: Column) -> DateMapUnitCircleModel:
+        clean_keys_p = bool(self.get_param("clean_keys"))
+        allow = self.get_param("allow_listed_keys")
+        block = set(self.get_param("block_listed_keys") or ())
+        period = str(self.get_param("time_period"))
+
+        key_sets: List[List[str]] = []
+        md_cols: List[VectorColumnMetadata] = []
+        for f, c in zip(self.input_features, cols):
+            seen: Dict[str, None] = {}
+            for m in c.data:
+                if m:
+                    for k in m:
+                        seen.setdefault(clean_key(str(k), clean_keys_p))
+            keys = [k for k in sorted(seen)
+                    if (allow is None or k in set(allow)) and k not in block]
+            key_sets.append(keys)
+            for key in keys:
+                for d in ("sin", "cos"):
+                    md_cols.append(VectorColumnMetadata(
+                        parent_feature_name=f.name,
+                        parent_feature_type=f.type_name,
+                        grouping=key, descriptor_value=f"{period}_{d}"))
+        model = DateMapUnitCircleModel(
+            key_sets, time_period=period, clean_keys=clean_keys_p,
+            operation_name=self.operation_name)
+        model.set_metadata(VectorMetadata(
+            name=self.output_name() or "dateMapUnitCircle",
+            columns=md_cols))
+        return model

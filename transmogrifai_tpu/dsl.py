@@ -301,12 +301,42 @@ def _is_valid_url(self, protocols=None):
 
 # -- dates (RichDateFeature) -------------------------------------------------
 
-def _to_unit_circle(self, time_period: str = "HourOfDay"):
+def _to_unit_circle(self, time_period: str = "HourOfDay", **kwargs):
     """Date -> [sin, cos] of a calendar period (RichDateFeature
-    .toUnitCircle:68)."""
+    .toUnitCircle:68); DateMap/DateTimeMap inputs take the per-key map
+    route (RichMapFeature.toUnitCircle:716)."""
+    from .types import OPMap
+    if issubclass(self.feature_type, OPMap):
+        return _to_unit_circle_map(self, time_period=time_period, **kwargs)
     from .transformers.misc import DateToUnitCircleTransformer
     return DateToUnitCircleTransformer(time_period=time_period) \
         .set_input(self).get_output()
+
+
+def _to_unit_circle_map(self, time_period: str = "HourOfDay",
+                        clean_keys: bool = False,
+                        allow_listed_keys=None, block_listed_keys=None):
+    """DateMap -> per-key [sin, cos] unit-circle vector (RichMapFeature
+    .toUnitCircle:716 -> DateMapToUnitCircleVectorizer)."""
+    from .automl.vectorizers.maps import DateMapUnitCircleVectorizer
+    return DateMapUnitCircleVectorizer(
+        time_period=time_period, clean_keys=clean_keys,
+        allow_listed_keys=allow_listed_keys,
+        block_listed_keys=block_listed_keys).set_input(self).get_output()
+
+
+def _tupled(self):
+    """Prediction -> (prediction RealNN, rawPrediction OPVector,
+    probability OPVector) (RichMapFeature RichPredictionFeature
+    .tupled:1098)."""
+    from .types import OPVector, RealNN
+    pred = _map_feature(self, lambda p: p.prediction, RealNN,
+                        operation_name="predictionValue")
+    raw = _map_feature(self, lambda p: p.raw_prediction, OPVector,
+                       operation_name="rawPrediction")
+    prob = _map_feature(self, lambda p: p.probability, OPVector,
+                        operation_name="probability")
+    return pred, raw, prob
 
 
 def _to_date_list(self):
@@ -387,11 +417,17 @@ def _combine_with(self, *others):
 
 
 def _descale(self, scaled_source: Feature, scaler=None):
-    """Invert a ScalerTransformer's scaling (RichVectorFeature
-    .descale:1113)."""
+    """Invert a ScalerTransformer's scaling (RichNumericFeature
+    .descale); a Prediction input descales its prediction value
+    (RichPredictionFeature.descale:1113 -> PredictionDescaler)."""
     from .transformers.misc import DescalerTransformer
+    from .types import Prediction, RealNN
+    target = self
+    if issubclass(self.feature_type, Prediction):
+        target = _map_feature(self, lambda p: p.prediction, RealNN,
+                              operation_name="predictionValue")
     return DescalerTransformer(scaler=scaler) \
-        .set_input(self, scaled_source).get_output()
+        .set_input(target, scaled_source).get_output()
 
 
 # -- vectorize / check (RichFeaturesCollection) ------------------------------
@@ -549,7 +585,13 @@ def _random_forest_vec(self, label: Feature, **params):
 
 def _smart_vectorize(self, *others, **kwargs):
     """Cardinality-adaptive text vectorization (RichTextFeature
-    .smartVectorize:223 -> SmartTextVectorizer)."""
+    .smartVectorize:223 -> SmartTextVectorizer); text-map inputs route
+    through the key-discovering map vectorizer, whose 'smarttext' kind is
+    the SmartTextMapVectorizer equivalent (RichMapFeature:280,425)."""
+    from .types import OPMap
+    if issubclass(self.feature_type, OPMap):
+        from .automl.vectorizers.maps import MapVectorizer
+        return MapVectorizer(**kwargs).set_input(self, *others).get_output()
     from .automl.vectorizers.text import SmartTextVectorizer
     return SmartTextVectorizer(**kwargs).set_input(self, *others).get_output()
 
@@ -576,7 +618,9 @@ def install() -> None:
         "jaccard_similarity": _jaccard_similarity,
         "vectorize": _vectorize, "pivot": _pivot,
         "sanity_check": _sanity_check, "loco_insights": _loco_insights,
-        "to_unit_circle": _to_unit_circle, "to_date_list": _to_date_list,
+        "to_unit_circle": _to_unit_circle,
+        "to_unit_circle_map": _to_unit_circle_map, "tupled": _tupled,
+        "to_date_list": _to_date_list,
         "vectorize_dates": _vectorize_dates,
         "filter_keys": _filter_keys, "vectorize_map": _vectorize_map,
         "autobucketize_map": _autobucketize_map,
@@ -611,6 +655,14 @@ def transmogrify(features: Sequence[Feature], **kwargs):
     """Module-level shortcut mirroring RichFeaturesCollection.transmogrify."""
     from .automl.transmogrifier import transmogrify as tf
     return tf(list(features), **kwargs)
+
+
+def combine(features: Sequence[Feature]):
+    """Concatenate OPVector features into one (RichFeaturesCollection
+    .combine:76 -> VectorsCombiner)."""
+    from .automl.vectorizers.combiner import VectorsCombiner
+    feats = list(features)
+    return VectorsCombiner().set_input(*feats).get_output()
 
 
 install()
