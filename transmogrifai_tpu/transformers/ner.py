@@ -63,7 +63,11 @@ _COMMON_FIRST_NAMES = {
 
 _DATE_RE = re.compile(
     r"^(\d{1,4}[-/]\d{1,2}[-/]\d{1,4}"
-    r"|(jan|feb|mar|apr|may|jun|jul|aug|sep|oct|nov|dec)[a-z]*\.?,?"
+    # month names must match exactly (full or 3-letter form): the old
+    # open-ended (mar)[a-z]* tail tagged words like 'Maria' as Date
+    r"|(january|february|march|april|may|june|july|august|september"
+    r"|october|november|december"
+    r"|jan|feb|mar|apr|jun|jul|aug|sep|sept|oct|nov|dec)\.?,?"
     r"|\d{4}|\d{1,2}(st|nd|rd|th))$", re.IGNORECASE)
 _TIME_RE = re.compile(r"^\d{1,2}:\d{2}(:\d{2})?(am|pm)?$|^\d{1,2}(am|pm)$",
                       re.IGNORECASE)
@@ -95,12 +99,18 @@ def merge_lexicon(extra: Optional[Dict[str, Set[str]]]
 
 def tag_tokens(text: Optional[str],
                extra: Optional[Dict[str, Set[str]]] = None,
-               lexicon: Optional[Dict[str, Set[str]]] = None
-               ) -> Dict[str, List[str]]:
+               lexicon: Optional[Dict[str, Set[str]]] = None,
+               tagger=None) -> Dict[str, List[str]]:
     """Tag a sentence: token -> sorted entity-type list (one entry per
     distinct tagged token, matching the reference tagger's token->set map).
     Callers tagging many rows should pass a prebuilt `lexicon`
-    (merge_lexicon(extra)) so gazetteers merge once, not per row."""
+    (merge_lexicon(extra)) so gazetteers merge once, not per row.
+
+    With a trained `tagger` (ner_model.PerceptronNerTagger — the
+    OpenNLP-model slot), Person/Organization/Location come from the model
+    while the numeric entity classes (Date/Time/Money/Percentage) stay on
+    the deterministic regexes, mirroring the reference's split between
+    statistical and rule-based tagging."""
     if not text:
         return {}
     lex = lexicon if lexicon is not None else merge_lexicon(extra)
@@ -110,6 +120,23 @@ def tag_tokens(text: Optional[str],
 
     def add(tok: str, ent: str) -> None:
         tags.setdefault(tok, set()).add(ent)
+
+    if tagger is not None:
+        from .ner_model import OUTSIDE
+        for tok, lab in zip(raw, tagger.predict_tokens(raw)):
+            # numeric-shaped tokens belong to the regex classes below; the
+            # statistical tagger only owns Person/Organization/Location
+            if lab != OUTSIDE and not any(c.isdigit() for c in tok):
+                add(tok, lab)
+            if _DATE_RE.match(tok):
+                add(tok, "Date")
+            if _TIME_RE.match(tok):
+                add(tok, "Time")
+            if _MONEY_RE.match(tok):
+                add(tok, "Money")
+            if _PERCENT_RE.match(tok):
+                add(tok, "Percentage")
+        return {tok: sorted(ents) for tok, ents in tags.items()}
 
     for i, tok in enumerate(raw):
         low = tok.lower()
@@ -151,12 +178,16 @@ class NameEntityRecognizer(Transformer):
     @classmethod
     def _declare_params(cls):
         return [Param("extra_gazetteers",
-                      "entity -> extra lexicon words", None)]
+                      "entity -> extra lexicon words", None),
+                Param("model_path", "trained PerceptronNerTagger JSON "
+                      "(OpenNLP-model slot); None = heuristic tagger", None)]
 
     def __init__(self, uid: Optional[str] = None, **params):
         super().__init__(params.pop("operation_name", "ner"), uid=uid,
                          **params)
         self._lexicon: Optional[Dict[str, Set[str]]] = None
+        self._tagger = None
+        self._tagger_loaded = False
 
     def _lex(self) -> Dict[str, Set[str]]:
         if self._lexicon is None:
@@ -165,13 +196,24 @@ class NameEntityRecognizer(Transformer):
                 {k: set(v) for k, v in extra.items()} if extra else None)
         return self._lexicon
 
+    def _model(self):
+        if not self._tagger_loaded:
+            self._tagger_loaded = True
+            path = self.get_param("model_path")
+            if path:
+                from .ner_model import PerceptronNerTagger
+                self._tagger = PerceptronNerTagger.load(path)
+        return self._tagger
+
     def transform_value(self, *vals):
         return MultiPickListMap(tag_tokens(vals[0].value,
-                                           lexicon=self._lex()))
+                                           lexicon=self._lex(),
+                                           tagger=self._model()))
 
     def save_args(self) -> Dict[str, Any]:
         d = super().save_args()
         extra = self.get_param("extra_gazetteers")
         d.update(extra_gazetteers={k: sorted(v) for k, v in extra.items()}
-                 if extra else None)
+                 if extra else None,
+                 model_path=self.get_param("model_path"))
         return d

@@ -15,6 +15,7 @@ reference's TextAnalyzer interface is.
 from __future__ import annotations
 
 import base64 as b64mod
+import json
 import math
 import re
 from collections import Counter
@@ -474,11 +475,48 @@ _LATIN_PROFILES: Dict[str, Tuple[set, set, str]] = {
 }
 
 
-def detect_language(text: str) -> Optional[str]:
+def build_language_profiles(samples: Dict[str, str], top_tokens: int = 24,
+                            top_trigrams: int = 10) -> Dict[str, Any]:
+    """Train Latin-script language profiles from sample text — the
+    Optimaize-profile-building role. Returns the JSON structure
+    `LangDetector(model_path=...)` loads: per language, the most frequent
+    tokens (stopword slot), most frequent letter trigrams, and observed
+    non-ASCII marks."""
+    out: Dict[str, Any] = {}
+    for lang, text in samples.items():
+        low = text.lower()
+        toks = Counter(t for t in tokenize_text(low) if len(t) <= 6)
+        grams = Counter(low[i:i + 3] for i in range(max(len(low) - 2, 0))
+                        if low[i:i + 3].isalpha())
+        marks = "".join(sorted({c for c in low if ord(c) > 0x7f}))
+        out[lang] = {
+            "stopwords": [w for w, _ in toks.most_common(top_tokens)],
+            "trigrams": [g for g, _ in grams.most_common(top_trigrams)],
+            "marks": marks,
+        }
+    return out
+
+
+def load_language_profiles(path: str) -> Dict[str, Tuple[set, set, str]]:
+    """JSON profile file -> the _LATIN_PROFILES runtime shape. Loaded
+    profiles EXTEND the builtin table (same-language entries override)."""
+    with open(path) as fh:
+        raw = json.load(fh)
+    return {lang: (set(p.get("stopwords", ())),
+                   set(p.get("trigrams", ())),
+                   str(p.get("marks", "")))
+            for lang, p in raw.items()}
+
+
+def detect_language(text: str,
+                    extra_profiles: Optional[
+                        Dict[str, Tuple[set, set, str]]] = None
+                    ) -> Optional[str]:
     """Best-effort language code for a document: script ranges decide
     non-Latin languages; Latin scripts score stopword/trigram/diacritic
     profiles over ~18 languages (reference LangDetector wraps Optimaize's
-    n-gram profiles — same algorithm family, hand-compacted tables)."""
+    n-gram profiles — same algorithm family, hand-compacted tables;
+    `extra_profiles` adds trained ones, see build_language_profiles)."""
     if not text:
         return None
     # script pass
@@ -509,8 +547,10 @@ def detect_language(text: str) -> Optional[str]:
     low = text[:512].lower()
     toks = set(tokenize_text(low))
     grams = {low[i:i + 3] for i in range(max(len(low) - 2, 0))}
+    profiles = _LATIN_PROFILES if not extra_profiles \
+        else {**_LATIN_PROFILES, **extra_profiles}
     best, score = None, 0
-    for lang, (stops, tris, marks) in _LATIN_PROFILES.items():
+    for lang, (stops, tris, marks) in profiles.items():
         s = 2 * len(toks & stops) + len(grams & tris)
         s += 3 * sum(1 for m in marks if m in low)
         if s > score:
@@ -527,12 +567,29 @@ class LangDetector(Transformer):
     input_types = (Text,)
     output_type = PickList
 
+    @classmethod
+    def _declare_params(cls):
+        return [Param("model_path", "JSON language-profile file "
+                      "(build_language_profiles output) extending the "
+                      "builtin table; None = builtin only", None)]
+
     def __init__(self, uid: Optional[str] = None, **params):
         super().__init__(params.pop("operation_name", "langDetect"),
                          uid=uid, **params)
+        self._profiles: Optional[Dict[str, Tuple[set, set, str]]] = None
+        self._profiles_loaded = False
+
+    def _extra_profiles(self):
+        if not self._profiles_loaded:
+            self._profiles_loaded = True
+            path = self.get_param("model_path")
+            if path:
+                self._profiles = load_language_profiles(path)
+        return self._profiles
 
     def transform_value(self, *vals):
-        return PickList(detect_language(vals[0].value))
+        return PickList(detect_language(vals[0].value,
+                                        self._extra_profiles()))
 
 
 _MIME_MAGIC: List[Tuple[bytes, str]] = [
@@ -547,9 +604,21 @@ _MIME_MAGIC: List[Tuple[bytes, str]] = [
 ]
 
 
-def detect_mime(b64_value: Optional[str]) -> Optional[str]:
+def load_mime_magic(path: str) -> List[Tuple[bytes, str]]:
+    """JSON magic-rule file -> prepended detection table (the Tika
+    custom-mimetypes.xml role): [{"magic_hex": "424d", "mime":
+    "image/bmp"}, ...]. Longest-prefix entries should come first."""
+    with open(path) as fh:
+        raw = json.load(fh)
+    return [(bytes.fromhex(r["magic_hex"]), str(r["mime"])) for r in raw]
+
+
+def detect_mime(b64_value: Optional[str],
+                extra_magic: Optional[List[Tuple[bytes, str]]] = None
+                ) -> Optional[str]:
     """MIME type of a base64 payload via magic bytes, or None for
-    empty/undecodable input (shared by the scalar and map detectors)."""
+    empty/undecodable input (shared by the scalar and map detectors).
+    `extra_magic` rules are checked before the builtin table."""
     if not b64_value:
         return None
     try:
@@ -557,6 +626,9 @@ def detect_mime(b64_value: Optional[str]) -> Optional[str]:
             b64_value[:64] + "=" * (-len(b64_value[:64]) % 4))
     except Exception:
         return None
+    for magic, mime in (extra_magic or []):
+        if head.startswith(magic):
+            return mime
     for magic, mime in _MIME_MAGIC:
         if head.startswith(magic):
             return mime
@@ -569,17 +641,33 @@ def detect_mime(b64_value: Optional[str]) -> Optional[str]:
 
 class MimeTypeDetector(Transformer):
     """Base64 -> PickList MIME type via magic bytes (reference
-    MimeTypeDetector via Tika)."""
+    MimeTypeDetector via Tika; `model_path` loads extra magic rules the
+    way Tika loads custom-mimetypes.xml)."""
 
     input_types = (Text,)   # Base64 is a Text subtype
     output_type = PickList
 
+    @classmethod
+    def _declare_params(cls):
+        return [Param("model_path", "JSON magic-rule file checked before "
+                      "the builtin table; None = builtin only", None)]
+
     def __init__(self, uid: Optional[str] = None, **params):
         super().__init__(params.pop("operation_name", "mimeDetect"),
                          uid=uid, **params)
+        self._magic: Optional[List[Tuple[bytes, str]]] = None
+        self._magic_loaded = False
+
+    def _extra_magic(self):
+        if not self._magic_loaded:
+            self._magic_loaded = True
+            path = self.get_param("model_path")
+            if path:
+                self._magic = load_mime_magic(path)
+        return self._magic
 
     def transform_value(self, *vals):
-        return PickList(detect_mime(vals[0].value))
+        return PickList(detect_mime(vals[0].value, self._extra_magic()))
 
 
 # Per-region phone metadata: (country code, set of valid NATIONAL number
