@@ -785,6 +785,37 @@ def run_example(mod_name):
     return time.perf_counter() - t0
 
 
+#: reference checkout's canonical Titanic training file (headerless)
+REF_TITANIC = ("/root/reference/helloworld/src/main/resources/"
+               "TitanicDataset/TitanicPassengersTrainData.csv")
+#: the reference's published holdout metrics for this flow
+#: (/root/reference/README.md:84-96)
+TITANIC_PUBLISHED = {"au_roc": 0.8822, "au_pr": 0.8225}
+
+
+def titanic_quality():
+    """Model-quality parity on the canonical real dataset: train the full
+    OpTitanicSimple flow on the reference's own CSV and report holdout
+    AuROC/AuPR against its published run — quality evidence that lands in
+    the artifact on ANY backend, not just when the TPU sweep runs."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "examples"))
+    import op_titanic_simple as t
+    from transmogrifai_tpu.readers.readers import CSVReader
+
+    with contextlib.redirect_stdout(io.StringIO()):
+        wf, _ = t.build_workflow()
+        model = wf.set_reader(
+            CSVReader(REF_TITANIC, columns=t.PASSENGER_COLUMNS)).train()
+    hold = model.selector_summary().holdout_evaluation
+    out = {"holdout_au_roc": round(float(hold["au_roc"]), 4),
+           "holdout_au_pr": round(float(hold["au_pr"]), 4)}
+    for k, pub in TITANIC_PUBLISHED.items():
+        out[f"published_{k}"] = pub
+        out[f"delta_{k}"] = round(float(hold[k]) - pub, 4)
+    return out
+
+
 # -- main -------------------------------------------------------------------
 
 def main():
@@ -794,6 +825,9 @@ def main():
         return
     if len(sys.argv) > 2 and sys.argv[1] == "--example":
         print(json.dumps({"s": round(run_example(sys.argv[2]), 2)}))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--quality":
+        print(json.dumps(titanic_quality()))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--tree-sweep":
         cfg_json = os.environ.get("BENCH_TREE_CFG")
@@ -941,6 +975,17 @@ def main():
         except Exception as e:
             errors.append(f"{mod}: {type(e).__name__}: {str(e)[:200]}")
         persist_partial(f"example_{key}")
+    # model-quality parity on the canonical real dataset (skipped when the
+    # reference checkout is absent)
+    try:
+        if os.path.isfile(REF_TITANIC) and remaining() > 90:
+            configs["titanic_quality"] = run_subprocess_phase(
+                ["--quality"], min(remaining() - 40, 240),
+                compile_cache=cache_dir)
+            log(f"titanic quality: {configs['titanic_quality']}")
+    except Exception as e:
+        errors.append(f"titanic quality: {type(e).__name__}: {str(e)[:200]}")
+    persist_partial("titanic_quality")
     # cold-vs-warm XLA-compile-cache effect: a SECOND cold process of the
     # same example pays tracing but loads compiles from the per-run cache
     # dir the first run just populated (a controlled pair — the user-level
