@@ -190,3 +190,25 @@ class TestJoinScale:
         got = ds.column("amount").data[keys.index(k0)]
         assert got == pytest.approx(float(ev_amt[mask].sum()), rel=1e-6)
         assert dt < 60, f"100K-parent join+aggregate took {dt:.1f}s"
+
+
+class TestReaderJoinApi:
+    def test_join_methods_on_reader(self):
+        """reference Reader.scala:112-134 outerJoin/leftOuterJoin/innerJoin"""
+        _, _, (plan, cutoff, amount, t, _), left, right = _parent_child()
+        inner = left.inner_join(right, left_features=["plan", "cutoff"],
+                                right_features=["amount", "t"])
+        assert inner.join_type == "inner"
+        ds = inner.generate_dataset([plan, amount])
+        assert "c" not in set(ds.column(KEY_COLUMN).data)
+        lj = left.left_outer_join(right, left_features=["plan", "cutoff"],
+                                  right_features=["amount", "t"])
+        assert lj.generate_dataset([plan, amount]).n_rows == 9
+        oj = left.outer_join(right, left_features=["plan", "cutoff"],
+                             right_features=["amount", "t"])
+        assert oj.join_type == "outer"
+        # chains into secondary aggregation
+        agg = lj.with_secondary_aggregation(TimeBasedFilter(
+            condition=TimeColumn("cutoff"), primary=TimeColumn("t"),
+            time_window=60))
+        assert agg.generate_dataset([plan, cutoff, amount, t]).n_rows == 3

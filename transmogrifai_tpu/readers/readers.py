@@ -37,6 +37,16 @@ class Reader:
     def read(self) -> List[Record]:
         raise NotImplementedError
 
+    # -- joins (reference Reader.scala:112-134) ----------------------------
+    def outer_join(self, other: "Reader", **kw) -> "JoinedReader":
+        return JoinedReader(self, other, join_type="outer", **kw)
+
+    def left_outer_join(self, other: "Reader", **kw) -> "JoinedReader":
+        return JoinedReader(self, other, join_type="left", **kw)
+
+    def inner_join(self, other: "Reader", **kw) -> "JoinedReader":
+        return JoinedReader(self, other, join_type="inner", **kw)
+
     def _generator_of(self, f: Feature) -> FeatureGeneratorStage:
         st = f.origin_stage
         if not isinstance(st, FeatureGeneratorStage):
