@@ -53,22 +53,31 @@ class TestMonoidDefaults:
 class TestTimeWindows:
     EVENTS = [(10.0, 100), (20.0, 200), (40.0, 400), (80.0, 800)]
 
-    def test_predictor_keeps_at_or_before_cutoff(self):
+    def test_predictor_keeps_strictly_before_cutoff(self):
+        # reference filterByDateWithCutoff (FeatureAggregator.scala:120):
+        # predictors keep date < cutoff — the t=400 event is excluded
         fa = FeatureAggregator(Real)
-        assert fa.extract(self.EVENTS, cutoff_time=400) == 70.0
+        assert fa.extract(self.EVENTS, cutoff_time=400) == 30.0
 
-    def test_response_keeps_after_cutoff(self):
+    def test_response_keeps_at_or_after_cutoff(self):
+        # responses keep date >= cutoff (FeatureAggregator.scala:121)
         fa = FeatureAggregator(Real)
         assert fa.extract(self.EVENTS, cutoff_time=400,
-                          is_response=True) == 80.0
+                          is_response=True) == 120.0
 
     def test_window_limits_lookback(self):
-        # window 250ms before cutoff 800: keep events in (550, 800]
-        fa = FeatureAggregator(Real, window_ms=250)
-        assert fa.extract(self.EVENTS, cutoff_time=800) == 80.0
-        # wider window picks up the 400-ms event too
-        fa2 = FeatureAggregator(Real, window_ms=500)
-        assert fa2.extract(self.EVENTS, cutoff_time=800) == 120.0
+        # window 450ms before cutoff 800: keep events in [350, 800)
+        fa = FeatureAggregator(Real, window_ms=450)
+        assert fa.extract(self.EVENTS, cutoff_time=800) == 40.0
+        # wider window picks up the earlier events too: [50, 800)
+        fa2 = FeatureAggregator(Real, window_ms=750)
+        assert fa2.extract(self.EVENTS, cutoff_time=800) == 70.0
+
+    def test_response_window_limits_lookahead(self):
+        # responses with a window keep cutoff <= date <= cutoff + window
+        fa = FeatureAggregator(Real, window_ms=300)
+        assert fa.extract(self.EVENTS, cutoff_time=200,
+                          is_response=True) == 60.0   # t=200 + t=400
 
     def test_no_cutoff_aggregates_everything(self):
         fa = FeatureAggregator(Real)

@@ -1,0 +1,74 @@
+"""The reference's data-prep examples, pinned to their PUBLISHED outputs.
+
+JoinsAndAggregates.scala:127-135 and ConditionalAggregation.scala:105-113
+print expected tables in their source; these tests run the ported flows on
+the reference's own CSVs and assert those exact values. Skips when the
+reference checkout is absent.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+REF = "/root/reference/helloworld/src/main/resources"
+CLICKS = os.path.join(REF, "EmailDataset/Clicks.csv")
+SENDS = os.path.join(REF, "EmailDataset/Sends.csv")
+VISITS = os.path.join(REF, "WebVisitsDataset/WebVisits.csv")
+
+needs_ref = pytest.mark.skipif(
+    not all(map(os.path.isfile, (CLICKS, SENDS, VISITS))),
+    reason="reference datasets not available")
+
+
+def _rows(ds):
+    from transmogrifai_tpu.readers.readers import KEY_COLUMN
+    keys = list(ds.column(KEY_COLUMN).data)
+    names = [n for n in ds.column_names() if n != KEY_COLUMN]
+    return {k: {n: ds.column(n).data[i] for n in names}
+            for i, k in enumerate(keys)}
+
+
+@needs_ref
+def test_joins_and_aggregates_matches_published_table():
+    import op_dataprep
+    rows = _rows(op_dataprep.joins_and_aggregates(CLICKS, SENDS))
+    assert sorted(rows) == ["123", "456", "789"]
+    ctr = [n for n in next(iter(rows.values())) if "ctr" in n][0]
+
+    # published: |1.0|123|1.0|2.0|1.0|
+    assert rows["123"]["numClicksYday"] == 2.0
+    assert rows["123"]["numClicksTomorrow"] == 1.0
+    assert rows["123"]["numSendsLastWeek"] == 1.0
+    assert rows["123"][ctr] == 1.0
+    # published: |0.0|456|1.0|0.0|0.0|
+    assert rows["456"]["numClicksYday"] == 0.0
+    assert rows["456"]["numClicksTomorrow"] == 1.0
+    assert rows["456"]["numSendsLastWeek"] == 0.0
+    assert rows["456"][ctr] == 0.0
+    # published: |0.0|789|null|null|1.0| — the click-side nulls match; ctr
+    # stays null here because the CURRENT reference DivideTransformer maps
+    # an empty operand to an empty result (MathTransformers.scala:192-199),
+    # so null/(1+1) cannot be 0.0 as the (older) comment table shows
+    assert rows["789"]["numSendsLastWeek"] == 1.0
+    assert np.isnan(rows["789"]["numClicksYday"])
+    assert np.isnan(rows["789"]["numClicksTomorrow"])
+    assert np.isnan(rows["789"][ctr])
+
+
+@needs_ref
+def test_conditional_aggregation_matches_published_table():
+    import op_dataprep
+    rows = _rows(op_dataprep.conditional_aggregation(VISITS))
+    # opq never meets the landing-page condition -> dropped
+    assert sorted(rows) == ["abc@salesforce.com", "lmn@salesforce.com",
+                            "xyz@salesforce.com"]
+    # published table, value for value
+    assert rows["xyz@salesforce.com"]["numVisitsWeekPrior"] == 3.0
+    assert rows["xyz@salesforce.com"]["numPurchasesNextDay"] == 1.0
+    assert rows["lmn@salesforce.com"]["numVisitsWeekPrior"] == 0.0
+    assert rows["lmn@salesforce.com"]["numPurchasesNextDay"] == 1.0
+    assert rows["abc@salesforce.com"]["numVisitsWeekPrior"] == 1.0
+    assert rows["abc@salesforce.com"]["numPurchasesNextDay"] == 0.0

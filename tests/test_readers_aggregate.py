@@ -65,13 +65,14 @@ class TestConditionalReader:
         ds = reader.generate_dataset([amount, spent_after])
         keys = list(ds.column(KEY_COLUMN).data)
         i_a, i_b = keys.index("a"), keys.index("b")
-        # user a: first buy at t=1 -> predictors at/before t=1: the buy
-        assert ds.column("amount").data[i_a] == pytest.approx(10.0)
-        # responses strictly after t=1: 5 + 100
-        assert ds.column("after").data[i_a] == pytest.approx(105.0)
-        # user b: first buy at t=4 -> predictors 7+3, response t=6 only
-        assert ds.column("amount").data[i_b] == pytest.approx(10.0)
-        assert ds.column("after").data[i_b] == pytest.approx(2.0)
+        # user a: first buy at t=1 -> predictors strictly before t=1:
+        # none (reference keeps date < cutoff, FeatureAggregator.scala:120)
+        assert np.isnan(ds.column("amount").data[i_a])
+        # responses at/after t=1: 10 + 5 + 100
+        assert ds.column("after").data[i_a] == pytest.approx(115.0)
+        # user b: first buy at t=4 -> predictor t=3 only; responses 3 + 2
+        assert ds.column("amount").data[i_b] == pytest.approx(7.0)
+        assert ds.column("after").data[i_b] == pytest.approx(5.0)
 
     def test_drop_keys_without_condition(self):
         events = EVENTS + [{"user": "c", "t": 1, "amount": 1.0,

@@ -458,20 +458,27 @@ class FeatureAggregator:
                 is_response: bool = False) -> Any:
         """Aggregate raw extracted values from events.
 
-        Predictors keep events at/before cutoff; responses keep events after
-        (reference AggregateDataReader semantics, DataReader.scala:219-246).
-        Event times flow into the aggregator (time-based first/last).
+        Window predicate matches the reference exactly
+        (GenericFeatureAggregator.filterByDateWithCutoff,
+        features/.../aggregators/FeatureAggregator.scala:114-124):
+        predictors keep ``cutoff - window <= t < cutoff``, responses keep
+        ``cutoff <= t <= cutoff + window`` (windows optional). Event times
+        flow into the aggregator (time-based first/last).
         """
         vals, times = [], []
         for ev_val, ev_time in events:
             if cutoff_time is not None and ev_time is not None:
                 if is_response:
-                    if ev_time <= cutoff_time:
+                    if ev_time < cutoff_time:
+                        continue
+                    if self.window_ms is not None and \
+                            ev_time > cutoff_time + self.window_ms:
                         continue
                 else:
-                    if ev_time > cutoff_time:
+                    if ev_time >= cutoff_time:
                         continue
-                    if self.window_ms is not None and ev_time < cutoff_time - self.window_ms:
+                    if self.window_ms is not None and \
+                            ev_time < cutoff_time - self.window_ms:
                         continue
             vals.append(ev_val)
             times.append(ev_time)

@@ -312,9 +312,12 @@ class WorkflowModel:
     def score(self, ds: Optional[Dataset] = None,
               keep_raw_features: bool = False) -> Dataset:
         """Reference saveScores:376 — keep result-feature columns (+ raw if
-        asked)."""
+        asked), plus the row key when the reader produced one (the
+        reference's scored frames always carry KeyFieldName)."""
         full = self.transform(ds)
-        keep = [f.name for f in self.result_features if f.name in full]
+        from ..readers.readers import KEY_COLUMN
+        keep = [KEY_COLUMN] if KEY_COLUMN in full else []
+        keep += [f.name for f in self.result_features if f.name in full]
         if keep_raw_features:
             keep = [f.name for f in self.raw_features() if f.name in full] + keep
         return full.select(keep)
