@@ -28,6 +28,13 @@ for ex in op_titanic_simple op_titanic_mini op_iris op_boston; do
   JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python "examples/${ex}.py" > /dev/null
   echo "  ${ex} ok"
 done
+REF_RES=/root/reference/helloworld/src/main/resources
+if [ -f "$REF_RES/EmailDataset/Clicks.csv" ]; then
+  JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python examples/op_dataprep.py \
+    "$REF_RES/EmailDataset/Clicks.csv" "$REF_RES/EmailDataset/Sends.csv" \
+    "$REF_RES/WebVisitsDataset/WebVisits.csv" > /dev/null
+  echo "  op_dataprep ok"
+fi
 
 echo "== 4/4 driver-contract smoke =="
 python - <<'PY'

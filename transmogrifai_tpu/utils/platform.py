@@ -79,6 +79,15 @@ def enable_compilation_cache() -> None:
                         break
         except OSError:
             pass
+        # AOT entries also bake in XLA-version-specific target tuning
+        # (e.g. prefer-no-scatter) that /proc/cpuinfo cannot see: entries
+        # from another jaxlib spam cpu_aot_loader incompatibility errors
+        # on every load, so the version is part of the scope
+        try:
+            import jaxlib
+            tag += f"-jl{jaxlib.__version__}"
+        except Exception:
+            pass
         loc = os.path.join(os.path.expanduser("~"), ".cache",
                            "transmogrifai_tpu", f"xla-{tag}")
     try:
