@@ -58,8 +58,11 @@ class DivideTransformer(_BinaryMath):
 
     def get_jax_fn(self):
         def fn(a, b):
-            out = a / b
-            return jnp.where(jnp.abs(b) < _EPS, jnp.nan, out)
+            tiny = jnp.abs(b) < _EPS
+            # guard the denominator so the eager numpy path (row-level
+            # transform_value) cannot emit divide-by-zero warnings
+            out = a / jnp.where(tiny, 1.0, b)
+            return jnp.where(tiny, jnp.nan, out)
         return fn
 
 
