@@ -1,0 +1,72 @@
+"""Resolve the probe's fit_s_1=0.0 anomaly with un-fake-able timings.
+
+The r4 staged probe recorded warm 10M-row fit_gbt at <5ms on both the XLA
+and pallas paths — far below the HBM roofline (~60ms for the ~45GB the 10
+rounds x 6 levels must stream). Either the warm timing is an artifact
+(e.g. block_until_ready returning early on the Tree pytree) or something
+is being elided. This probe removes every way a warm fit could dodge work:
+
+  * rep-dependent DATA (not just the PRNG key), regenerated on device, so
+    no level of caching can reuse a prior result;
+  * a host-side checksum of the returned leaves (device->host copy forces
+    full materialization, timed separately);
+  * per-rep wall time on the fit alone AND fit+checksum.
+
+Usage: python tools/tpu_warmfit_check.py [n_rows]
+Appends one JSON line to tools/tpu_stages_r4.jsonl (stage=warmfit_check).
+"""
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from transmogrifai_tpu.ops import trees as T
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+F, B = 64, 32
+out = {"n_rows": N, "backend": jax.default_backend()}
+
+
+@jax.jit
+def gen(key):
+    kx, ky = jax.random.split(key)
+    X = jax.random.normal(kx, (N, F), jnp.float32)
+    y = (jax.random.uniform(ky, (N,)) < 0.5).astype(jnp.float32)
+    return X, y
+
+
+w = jnp.ones(N, jnp.float32)
+for rep in range(3):
+    X, y = gen(jax.random.PRNGKey(rep))
+    jax.block_until_ready(X)
+    edges = T.quantile_edges(X, B)
+    Xb = T.bin_matrix(X, edges)
+    jax.block_until_ready(Xb)
+    del X
+    t0 = time.time()
+    trees = T.fit_gbt(Xb, y, w, jax.random.PRNGKey(rep), n_rounds=10,
+                      depth=6, n_bins=B, learning_rate=0.1,
+                      loss="logistic")[0]
+    jax.block_until_ready(trees)
+    fit_s = time.time() - t0
+    t0 = time.time()
+    csum = float(sum(np.asarray(leaf, np.float64).sum()
+                     for leaf in jax.tree_util.tree_leaves(trees)))
+    host_s = time.time() - t0
+    out[f"rep{rep}"] = {"fit_s": round(fit_s, 3),
+                        "to_host_s": round(host_s, 3),
+                        "checksum": round(csum, 3)}
+    print(json.dumps(out[f"rep{rep}"]), flush=True)
+
+rec = {"stage": "warmfit_check", "ok": True, "s": 0, "detail": out,
+       "ts": round(time.time(), 1)}
+with open(os.path.join(HERE, "tpu_stages_r4.jsonl"), "a") as f:
+    f.write(json.dumps(rec) + "\n")
+print(json.dumps(rec))
