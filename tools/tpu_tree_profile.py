@@ -1,0 +1,113 @@
+"""Piecewise warm timings for the 10M-row tree fit on live TPU.
+
+Finds where fit_gbt's 5.78s/fit (tools/tpu_warmfit_check.py) goes:
+per-level pallas histograms (slot counts 1..16), level routing,
+prediction, and one full grow_tree — each timed on rep-VARYING data
+(same-input reruns through the axon tunnel return cached results and
+time as ~0s; see BENCH_NOTES round-4 session 2).
+
+Usage: python tools/tpu_tree_profile.py [n_rows]
+Appends stage=tree_profile to tools/tpu_stages_r4.jsonl.
+"""
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+import jax
+import jax.numpy as jnp
+
+from transmogrifai_tpu.ops import trees as T
+from transmogrifai_tpu.ops import pallas_hist
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+F, B = 64, 32
+BH = B + 1  # histogram slots incl. missing bin
+out = {"n_rows": N, "backend": jax.default_backend(),
+       "pallas": pallas_hist.available()}
+
+
+@jax.jit
+def gen(key):
+    kx, ky = jax.random.split(key)
+    X = jax.random.normal(kx, (N, F), jnp.float32)
+    y = (jax.random.uniform(ky, (N,)) < 0.5).astype(jnp.float32)
+    return X, y
+
+
+@jax.jit
+def gen_payload(key, n_slots):
+    kp, ks = jax.random.split(key)
+    pay = jax.random.normal(kp, (3, N), jnp.float32)
+    slot = jax.random.randint(ks, (1, N), 0, n_slots).astype(jnp.float32)
+    return pay, slot
+
+
+def timed(label, f, reps=3):
+    """Median-free simple min over reps with varying key; rep 0 discarded
+    (compile)."""
+    best = None
+    for i in range(reps):
+        t0 = time.time()
+        jax.block_until_ready(f(i))
+        dt = time.time() - t0
+        if i > 0:
+            best = dt if best is None else min(best, dt)
+    out[label] = round(best, 3)
+    print(label, round(best, 3), flush=True)
+
+
+X, y = gen(jax.random.PRNGKey(0))
+jax.block_until_ready(X)
+edges = T.quantile_edges(X, B)
+Xb = T.bin_matrix(X, edges)
+Xb_t = Xb.T.copy()
+jax.block_until_ready((Xb, Xb_t))
+del X
+w = jnp.ones(N, jnp.float32)
+
+# 1. raw pallas histogram per level shape (sibling trick: level d uses
+# n_half = 2^(d-1) slots; root uses 1)
+for s in (1, 2, 4, 8, 16):
+    pays = [gen_payload(jax.random.PRNGKey(100 + s * 10 + i), s)
+            for i in range(3)]
+    jax.block_until_ready(pays)
+    timed(f"hist_pallas_s{s}", lambda i, s=s, pays=pays: pallas_hist.
+          hist_pallas(Xb_t, pays[i][0], pays[i][1], n_slots=s, n_bins=BH))
+
+# 2. routing one level (gather-as-matmul) at the widest level
+nodes = [jax.random.randint(jax.random.PRNGKey(200 + i), (N,), 0, 32)
+         for i in range(3)]
+f_lvl = jnp.arange(32, dtype=jnp.int32) % F
+t_lvl = jnp.full((32,), B // 2, jnp.int32)
+m_lvl = jnp.zeros((32,), jnp.int32)
+jax.block_until_ready(nodes)
+timed("route_level_32nodes", lambda i: T._route_level_matmul(
+    Xb, nodes[i], f_lvl, t_lvl, m_lvl, 32))
+
+# 3. one full tree (depth 6) on varying gradients
+gs = [jax.random.normal(jax.random.PRNGKey(300 + i), (N, 1), jnp.float32)
+      for i in range(3)]
+jax.block_until_ready(gs)
+timed("grow_tree_d6", lambda i: T.grow_tree(
+    Xb, gs[i], w, jax.random.PRNGKey(i), depth=6, n_bins=B,
+    reg_lambda=1.0, leaf_mode="newton", learning_rate=0.1,
+    normalize_gain=False))
+
+# 4. forest prediction, 10 trees
+trees10 = T.fit_gbt(Xb, y, w, jax.random.PRNGKey(0), n_rounds=10, depth=6,
+                    n_bins=B, learning_rate=0.1, loss="logistic")[0]
+jax.block_until_ready(trees10)
+Xbs = [jnp.where(Xb == 1, 1 + (i % 2), Xb) for i in range(3)]  # vary input
+jax.block_until_ready(Xbs)
+timed("predict_forest_10", lambda i: T.predict_forest_bins(
+    trees10[0] if False else trees10, Xbs[i], 6))
+
+rec = {"stage": "tree_profile", "ok": True, "s": 0, "detail": out,
+       "ts": round(time.time(), 1)}
+with open(os.path.join(HERE, "tpu_stages_r4.jsonl"), "a") as f:
+    f.write(json.dumps(rec) + "\n")
+print(json.dumps(rec))

@@ -233,11 +233,39 @@ class _TreeEstimator(PredictorEstimator):
                 self._mask_score_host(host_ctx, yn, wn * mn[f], n_classes,
                                       multiclass)
                 for f in range(mn.shape[0])])
+        fused = self._mask_scores_fused(ctx, y, w, masks, n_classes,
+                                        multiclass)
+        if fused is not None:
+            return fused
+
         def one(m):
             return self._mask_score(ctx, y, w * m, n_classes, multiclass)
         if y.shape[0] <= self._VMAP_FOLD_MAX_ROWS:
             return jax.vmap(one)(masks)
         return jnp.stack([one(masks[f]) for f in range(masks.shape[0])])
+
+    def _mask_scores_fused(self, ctx, y, w, masks, n_classes, multiclass):
+        """All-folds-in-one-program fast path; None = not applicable
+        (family hook — the GBT/XGB boosters implement it)."""
+        return None
+
+    def _fused_route_ok(self, ctx, y):
+        """Shared gate for the fold-fused booster path: live pallas on a
+        single-device TPU above the fold-vmap row limit. Mesh-sharded
+        contexts keep the per-fold path (pallas_call does not run under
+        GSPMD sharding here; the mesh story is the XLA matmul kernels)."""
+        from ..ops import pallas_hist
+        Xb = ctx[0]
+        if (jax.default_backend() != "tpu"
+                or not pallas_hist.available()
+                or y.shape[0] <= self._VMAP_FOLD_MAX_ROWS):
+            return False
+        try:
+            if len(Xb.sharding.device_set) > 1:
+                return False
+        except AttributeError:
+            pass
+        return True
 
     def _mask_score(self, ctx, y, w, n_classes, multiclass):
         raise NotImplementedError
@@ -554,6 +582,15 @@ class _GBTBase(_TreeEstimator):
                                 loss=self._loss, **kw)
         return base + T.predict_forest_bins(trees, Xb, kw["depth"])[:, 0]
 
+    def _mask_scores_fused(self, ctx, y, w, masks, n_classes, multiclass):
+        if not self._fused_route_ok(ctx, y):
+            return None
+        Xb, edges, n_bins = ctx
+        _, _, margins = T.fit_gbt_folds(
+            Xb, y, masks * w[None, :], self._key(), n_bins=n_bins,
+            loss=self._loss, **self._gbt_kw())
+        return margins
+
     def _mask_score_host(self, ctx, y, w, n_classes, multiclass):
         from ..ops import trees_host as TH
         Xb, edges, n_bins = ctx
@@ -653,6 +690,18 @@ class _XGBBase(_TreeEstimator):
             sub = T.Tree(feat=trees.feat[:, c], thresh=trees.thresh[:, c],
                          leaf=trees.leaf[:, c], miss=trees.miss[:, c])
             margins[:, c] = TH.predict_bins_host(sub, Xb, depth)[:, 0]
+        return margins
+
+    def _mask_scores_fused(self, ctx, y, w, masks, n_classes, multiclass):
+        if multiclass and not self._regression:
+            return None   # softmax boosting keeps the per-fold path
+        if not self._fused_route_ok(ctx, y):
+            return None
+        Xb, edges, n_bins = ctx
+        _, _, margins = T.fit_gbt_folds(
+            Xb, y, masks * w[None, :], self._key(), n_bins=n_bins,
+            loss="squared" if self._regression else "logistic",
+            **self._common())
         return margins
 
     def _mask_score(self, ctx, y, w, n_classes, multiclass):

@@ -132,7 +132,7 @@ def set_variant(name: str) -> None:
 
 
 def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots,
-            variant):
+            n_folds, variant):
     import jax.experimental.pallas as pl
 
     @pl.when(pl.program_id(0) == 0)
@@ -156,16 +156,25 @@ def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots,
         oh = (xf[:, None, :] == bins).astype(jnp.float32)   # [F, B, blk]
         oh = oh.reshape(F * B, blk)
 
-    slot = slot_ref[:]                                      # [1, blk]
+    # fold-fused: each fold contributes its own slot one-hot x payload
+    # rows to ONE contraction, so the (feature, bin) one-hot above — the
+    # dominant VPU cost — and the Xb traffic are built once for all folds,
+    # and the matmul M dim grows n_folds x (the single-fold M of S*C rows
+    # is far below the 128-row MXU tile; see BENCH_NOTES round-4 session 2)
     slots = jax.lax.broadcasted_iota(jnp.int32, (n_slots, blk), 0) \
         .astype(jnp.float32)
-    slot_oh = (slots == slot).astype(jnp.float32)           # [n_slots, blk]
-    pay = pay_ref[:]                                        # [C, blk]
-    q = (slot_oh[:, None, :] * pay[None, :, :]).reshape(n_slots * C, blk)
+    qs = []
+    for k in range(n_folds):
+        slot = slot_ref[k:k + 1, :]                         # [1, blk]
+        slot_oh = (slots == slot).astype(jnp.float32)       # [n_slots, blk]
+        pay = pay_ref[k * C:(k + 1) * C, :]                 # [C, blk]
+        qs.append((slot_oh[:, None, :] * pay[None, :, :])
+                  .reshape(n_slots * C, blk))
+    q = qs[0] if n_folds == 1 else jnp.concatenate(qs, axis=0)
 
     out_ref[:] += jax.lax.dot_general(
         q, oh, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)                 # [S*C, F*B]
+        preferred_element_type=jnp.float32)                 # [Fo*S*C, F*B]
 
 
 @functools.partial(jax.jit,
@@ -173,18 +182,26 @@ def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots,
 def hist_pallas(Xb_t: jax.Array, pay_t: jax.Array, slot_t: jax.Array,
                 *, n_slots: int, n_bins: int,
                 interpret: bool = False) -> jax.Array:
-    """Gradient histograms [n_slots * C, F * n_bins] (f32).
+    """Gradient histograms [n_folds * n_slots * C, F * n_bins] (f32).
 
-    Xb_t [F, N] int bins; pay_t [C, N] f32 payload channels; slot_t [1, N]
-    f32 slot ids (n_slots drops the row). Ragged N pads internally with
-    dropped-slot rows; the block size adapts to the one-hot width so VMEM
-    tiles stay bounded (see block_rows).
+    Xb_t [F, N] int bins; pay_t [n_folds * C, N] f32 payload channels;
+    slot_t [n_folds, N] f32 slot ids (n_slots drops the row). The fold
+    axis batches independent slot assignments over the SAME binned matrix
+    (CV fold masks in the tree sweep): one (feature, bin) one-hot serves
+    every fold and the contraction M dim scales with n_folds. n_folds is
+    slot_t's leading dim (C must divide pay_t's). Ragged N pads internally
+    with dropped-slot rows; the block size adapts to the one-hot width so
+    VMEM tiles stay bounded (see block_rows).
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     F, N = Xb_t.shape
-    C = pay_t.shape[0]
+    n_folds = slot_t.shape[0]
+    if pay_t.shape[0] % n_folds:
+        raise ValueError(f"pay_t channels {pay_t.shape[0]} not a multiple "
+                         f"of slot_t folds {n_folds}")
+    C = pay_t.shape[0] // n_folds
     B = n_bins
     blk = block_rows(F * B)
     pad = (-N) % blk
@@ -200,20 +217,176 @@ def hist_pallas(Xb_t: jax.Array, pay_t: jax.Array, slot_t: jax.Array,
             f"TMOG_PALLAS_HIST_VARIANT={_VARIANT!r}; expected one of "
             f"{_VARIANTS}")
     kernel = functools.partial(_kernel, F=F, B=B, C=C, n_slots=n_slots,
-                               variant=_VARIANT)
+                               n_folds=n_folds, variant=_VARIANT)
     return pl.pallas_call(
         kernel,
         grid=(N // blk,),
         in_specs=[
             pl.BlockSpec((F, blk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((C, blk), lambda i: (0, i),
+            pl.BlockSpec((n_folds * C, blk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, blk), lambda i: (0, i),
+            pl.BlockSpec((n_folds, blk), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((n_slots * C, F * B), lambda i: (0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n_slots * C, F * B), jnp.float32),
+        out_specs=pl.BlockSpec(
+            (n_folds * n_slots * C, F * B), lambda i: (0, 0),
+            memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_folds * n_slots * C, F * B), jnp.float32),
         interpret=interpret,
     )(Xb_t, pay_t, slot_t)
+
+
+# -- level routing ----------------------------------------------------------
+# Training-time routing (rel' = 2*rel + go_right) is one read of the binned
+# matrix per level, but the XLA gather-free form (trees._onehot_route_step)
+# materializes [chunk, F] f32 selection products in HBM — 48ms/level at the
+# 10M-row config vs ~1ms of Xb traffic. Here the one-hots and products live
+# only in VMEM, and (like the histograms) a fold axis shares the Xb read
+# across every CV fold's tree.
+
+_ROUTE_BLK = 4096
+
+
+def _pad_minor(a: jax.Array, mult: int = 128) -> jax.Array:
+    """Pad the minor axis up to a Mosaic-friendly multiple; padded slots
+    are inert wherever a one-hot over REAL ids selects columns."""
+    pad = (-a.shape[-1]) % mult
+    if pad:
+        a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)])
+    return a
+
+
+def _route_kernel(xb_ref, node_ref, tbl_ref, out_ref, *, F, n_pad,
+                  n_folds):
+    blk = xb_ref.shape[1]
+    xf = xb_ref[:].astype(jnp.float32)                      # [F, blk]
+    fi = jax.lax.broadcasted_iota(jnp.int32, (F, blk), 0) \
+        .astype(jnp.float32)
+    ni = jax.lax.broadcasted_iota(jnp.int32, (n_pad, blk), 0) \
+        .astype(jnp.float32)
+    rows = []
+    for k in range(n_folds):
+        node = node_ref[k:k + 1, :]                         # [1, blk]
+        noh = (ni == node).astype(jnp.float32)              # [n_pad, blk]
+        tbl = tbl_ref[3 * k:3 * k + 3, :]                   # [3, n_pad]
+        ftm = jax.lax.dot_general(                          # [3, blk]
+            tbl, noh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        mask = (fi == ftm[0:1, :]).astype(jnp.float32)      # [F, blk]
+        xsel = jnp.sum(xf * mask, axis=0, keepdims=True)    # [1, blk]
+        right = jnp.logical_or(
+            xsel > ftm[1:2, :],
+            jnp.logical_and(xsel == 0.0, ftm[2:3, :] > 0.5))
+        rows.append(2.0 * node + right.astype(jnp.float32))
+    out_ref[:] = rows[0] if n_folds == 1 else \
+        jnp.concatenate(rows, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("n_nodes", "interpret"))
+def route_pallas(Xb_t: jax.Array, node_t: jax.Array, f_lvl: jax.Array,
+                 t_lvl: jax.Array, m_lvl: jax.Array, *, n_nodes: int,
+                 interpret: bool = False) -> jax.Array:
+    """One level of tree routing for every fold in one Xb pass.
+
+    Xb_t [F, N] int bins; node_t [n_folds, N] f32 in-level node ids;
+    f_lvl/t_lvl/m_lvl [n_folds, n_nodes] split tables. Returns the next
+    level's ids [n_folds, N] f32 (2*node + right; right uses the learned
+    missing direction for bin 0 — same decision as trees._onehot_route_step
+    and the serving traversals). Out-of-range node ids (e.g. row padding)
+    select no table entry and route as node 0's split of feature 0 — the
+    caller slices padded rows away.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    F, N = Xb_t.shape
+    n_orig = N
+    Fo = node_t.shape[0]
+    tbl = jnp.stack([f_lvl.astype(jnp.float32),
+                     t_lvl.astype(jnp.float32),
+                     m_lvl.astype(jnp.float32)], axis=1)    # [Fo, 3, n]
+    tbl = _pad_minor(tbl.reshape(3 * Fo, n_nodes))          # [3Fo, n_pad]
+    n_pad = tbl.shape[1]
+    blk = _ROUTE_BLK
+    pad = (-N) % blk
+    if pad:
+        Xb_t = jnp.pad(Xb_t, ((0, 0), (0, pad)))
+        node_t = jnp.pad(node_t, ((0, 0), (0, pad)),
+                         constant_values=float(n_pad))      # inert
+        N += pad
+    kernel = functools.partial(_route_kernel, F=F, n_pad=n_pad, n_folds=Fo)
+    out = pl.pallas_call(
+        kernel,
+        grid=(N // blk,),
+        in_specs=[
+            pl.BlockSpec((F, blk), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Fo, blk), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3 * Fo, n_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((Fo, blk), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Fo, N), jnp.float32),
+        interpret=interpret,
+    )(Xb_t, node_t, tbl)
+    return out[:, :n_orig]
+
+
+def _lookup_kernel(tbl_ref, idx_ref, out_ref, *, m_pad, n_folds):
+    blk = idx_ref.shape[1]
+    mi = jax.lax.broadcasted_iota(jnp.int32, (m_pad, blk), 0) \
+        .astype(jnp.float32)
+    rows = []
+    for k in range(n_folds):
+        idx = idx_ref[k:k + 1, :]                           # [1, blk]
+        noh = (mi == idx).astype(jnp.float32)               # [m_pad, blk]
+        rows.append(jax.lax.dot_general(
+            tbl_ref[k:k + 1, :], noh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))            # [1, blk]
+    out_ref[:] = rows[0] if n_folds == 1 else \
+        jnp.concatenate(rows, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def table_lookup_pallas(tbl: jax.Array, idx_t: jax.Array, *,
+                        interpret: bool = False) -> jax.Array:
+    """Per-fold small-table lookup out[k, i] = tbl[k, idx[k, i]].
+
+    tbl [n_folds, M] f32 (e.g. leaf payloads); idx_t [n_folds, N] f32 ids.
+    Out-of-range ids (>= M, e.g. row padding) return 0. TPU gathers from
+    tiny tables by huge index vectors serialize; the one-hot contraction
+    here stays on the MXU/VPU and reads idx_t exactly once.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Fo, M = tbl.shape
+    N = idx_t.shape[1]
+    n_orig = N
+    tblp = _pad_minor(tbl)
+    m_pad = tblp.shape[1]
+    blk = _ROUTE_BLK
+    pad = (-N) % blk
+    if pad:
+        idx_t = jnp.pad(idx_t, ((0, 0), (0, pad)),
+                        constant_values=float(m_pad))       # -> 0
+        N += pad
+    kernel = functools.partial(_lookup_kernel, m_pad=m_pad, n_folds=Fo)
+    return pl.pallas_call(
+        kernel,
+        grid=(N // blk,),
+        in_specs=[
+            pl.BlockSpec((Fo, m_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Fo, blk), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((Fo, blk), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Fo, N), jnp.float32),
+        interpret=interpret,
+    )(tblp, idx_t)[:, :n_orig]

@@ -526,7 +526,16 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
         misses.append(m_lvl)
         last = (GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, f_lvl, t_lvl, m_lvl)
 
-        if use_matmul:
+        if use_pallas:
+            # exact-equal decisions to _route_level_matmul (the selected
+            # bin is a single one-hot term, f32-exact), one VMEM-resident
+            # Xb pass instead of HBM selection products
+            from . import pallas_hist
+            node = pallas_hist.route_pallas(
+                Xb.T, node[None].astype(jnp.float32), f_lvl[None],
+                t_lvl[None], m_lvl[None],
+                n_nodes=n_nodes)[0].astype(jnp.int32)
+        elif use_matmul:
             node = _route_level_matmul(Xb, node, f_lvl, t_lvl, m_lvl,
                                        n_nodes)
         else:
@@ -726,6 +735,223 @@ def fit_gbt(Xb: jax.Array, y: jax.Array, w: jax.Array, key: jax.Array, *,
     return trees, base
 
 
+def _grow_tree_folds(Xb_t, G, H, count_unit, key, *, depth, n_bins,
+                     reg_lambda, min_child_weight, min_instances,
+                     min_info_gain, gamma, learning_rate, feature_mask,
+                     interpret=False):
+    """Grow one tree PER FOLD level-wise in shared pallas passes.
+
+    Xb_t [F, N] transposed bins (N pre-padded to the route block size by
+    the caller); G/H/count_unit [Fo, N] per-fold payloads (excluded and
+    padded rows enter as zeros exactly as in grow_tree). Each level runs
+    ONE fold-fused histogram kernel (pallas_hist.hist_pallas fold axis)
+    and ONE fold-fused routing pass (route_pallas), so the binned matrix
+    is read once per level for every fold together; the per-node split
+    algebra (cumsums, _split_scores, argmax, leaves) is the grow_tree
+    math vmapped over the fold axis. Returns (Tree with leading [Fo]
+    axes, leaf_rows [Fo, N]) where leaf_rows are the learning-rate-scaled
+    per-row leaf payloads — bitwise what predict_bins returns for each
+    fold's tree, read off the final routing state instead of re-traversed.
+    """
+    from . import pallas_hist
+
+    F, N = Xb_t.shape
+    Fo = G.shape[0]
+    B = n_bins + 1
+    split_scores_f = jax.vmap(
+        _split_scores,
+        in_axes=(0,) * 9 + (None,) * 6)
+
+    def interleave_f(left, right, n_nodes):
+        # children along axis 1: [Fo, 2p, ...] from per-parent pairs
+        return jnp.stack([left, right], axis=2).reshape(
+            (Fo, n_nodes) + left.shape[2:])
+
+    node = jnp.zeros((Fo, N), jnp.float32)
+    feats, threshs, misses = [], [], []
+    last = None
+    prev = None
+    for d in range(depth):
+        n_nodes = 1 << d
+        if d == 0:
+            slots = node                                  # all rows slot 0
+            n_slots = 1
+        else:
+            # sibling subtraction: histogram LEFT children only, derive
+            # right = parent - left (same trick as grow_tree)
+            n_slots = n_nodes // 2
+            half = jnp.floor(node * 0.5)
+            slots = jnp.where(node == 2.0 * half, half, float(n_slots))
+        # payload channel order per fold: hist_pallas expects fold-major
+        # [Fo*C]; build [Fo, 3, N] -> [3Fo, N] fold-major
+        pay = jnp.stack([G, H, count_unit], axis=1).reshape(3 * Fo, N)
+        hist = pallas_hist.hist_pallas(
+            Xb_t, pay, slots, n_slots=n_slots, n_bins=B,
+            interpret=interpret)                          # [Fo*S*3, F*B]
+        hist = hist.reshape(Fo, n_slots, 3, F, B)
+        hgl = hist[:, :, 0][..., None]                        # [Fo,S,F,B,1]
+        hhl = hist[:, :, 1]                                   # [Fo,S,F,B]
+        hcl = hist[:, :, 2]
+        if d == 0:
+            hg, hh, hc = hgl, hhl, hcl
+        else:
+            pg, ph, pc = prev
+            hg = interleave_f(hgl, pg - hgl, n_nodes)
+            hh = interleave_f(hhl, ph - hhl, n_nodes)
+            hc = interleave_f(hcl, pc - hcl, n_nodes)
+        prev = (hg, hh, hc)
+
+        GL = jnp.cumsum(hg, axis=3)                       # [Fo,n,F,B,1]
+        HL = jnp.cumsum(hh, axis=3)
+        CL = jnp.cumsum(hc, axis=3)
+        Gt, Ht, Ct = GL[:, :, 0, -1, :], HL[:, :, 0, -1], CL[:, :, 0, -1]
+        Gm, Hm, Cm = hg[:, :, :, 0, :], hh[:, :, :, 0], hc[:, :, :, 0]
+
+        gain = split_scores_f(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm,
+                              reg_lambda, min_child_weight, min_instances,
+                              min_info_gain, gamma, False)
+        if feature_mask is not None:
+            gain = jnp.where(feature_mask[None, None, :, None, None],
+                             gain, -jnp.inf)
+
+        flat = gain.reshape(Fo, n_nodes, F * B * 2)
+        best = jnp.argmax(flat, axis=2)                   # [Fo, n]
+        best_gain = jnp.take_along_axis(flat, best[..., None],
+                                        axis=2)[..., 0]
+        ok = jnp.isfinite(best_gain)
+        f_lvl = jnp.where(ok, (best // (B * 2)).astype(jnp.int32), 0)
+        t_lvl = jnp.where(ok, ((best // 2) % B).astype(jnp.int32), B - 1)
+        m_lvl = jnp.where(ok, (best % 2).astype(jnp.int32), 0)
+        feats.append(f_lvl)
+        threshs.append(t_lvl)
+        misses.append(m_lvl)
+        last = (GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, f_lvl, t_lvl, m_lvl)
+
+        node = pallas_hist.route_pallas(Xb_t, node, f_lvl, t_lvl, m_lvl,
+                                        n_nodes=n_nodes,
+                                        interpret=interpret)
+
+    n_leaves = 1 << depth
+    if depth == 0:
+        Gl = G.sum(axis=1)[:, None, None]                 # [Fo, 1, 1]
+        Hl = H.sum(axis=1)[:, None]
+        Cl = count_unit.sum(axis=1)[:, None]
+    else:
+        GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, f_lvl, t_lvl, m_lvl = last
+        n_half = n_leaves // 2
+
+        def leaf_of(GLk, HLk, CLk, Gtk, Htk, Ctk, Gmk, Hmk, Cmk,
+                    fk, tk, mk):
+            nid = jnp.arange(n_half)
+            mr = mk.astype(jnp.float32)
+            Gleft = GLk[nid, fk, tk, :] - mr[:, None] * Gmk[nid, fk, :]
+            Hleft = HLk[nid, fk, tk] - mr * Hmk[nid, fk]
+            Cleft = CLk[nid, fk, tk] - mr * Cmk[nid, fk]
+            Gl = jnp.stack([Gleft, Gtk - Gleft], axis=1).reshape(
+                n_leaves, Gleft.shape[-1])
+            Hl = jnp.stack([Hleft, Htk - Hleft], axis=1).reshape(n_leaves)
+            Cl = jnp.stack([Cleft, Ctk - Cleft], axis=1).reshape(n_leaves)
+            return Gl, Hl, Cl
+        Gl, Hl, Cl = jax.vmap(leaf_of)(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm,
+                                       f_lvl, t_lvl, m_lvl)
+    leaf = -Gl / (Hl + reg_lambda + EPS)[..., None]       # [Fo, L, 1]
+    leaf = jnp.where(Cl[..., None] >= 0.5, leaf, 0.0)
+    leaf = learning_rate * leaf
+    leaf_rows = pallas_hist.table_lookup_pallas(
+        leaf[:, :, 0], node, interpret=interpret)         # [Fo, N]
+    tree = Tree(jnp.concatenate(feats, axis=1),
+                jnp.concatenate(threshs, axis=1), leaf,
+                jnp.concatenate(misses, axis=1))
+    return tree, leaf_rows
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_rounds", "depth", "n_bins", "loss", "subsample",
+                     "feature_frac", "interpret"))
+def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
+                  key: jax.Array, *, n_rounds: int, depth: int,
+                  n_bins: int, learning_rate: float = 0.1,
+                  reg_lambda: float = 1.0, min_child_weight: float = 0.0,
+                  min_instances: float = 1.0, min_info_gain: float = 0.0,
+                  gamma: float = 0.0, subsample: float = 1.0,
+                  feature_frac: float = 1.0, loss: str = "logistic",
+                  interpret: bool = False):
+    """Boosted trees for every CV fold in ONE device program.
+
+    The mask-fold sweep (models/trees.mask_fit_scores) above the fold-vmap
+    row limit used to loop folds through fit_gbt sequentially — each fold
+    re-reading the binned matrix and re-building the (feature, bin)
+    one-hots that dominate the histogram kernel, with a contraction M dim
+    (slots x 3 payload channels) far under the 128-row MXU tile. Here the
+    folds share every Xb pass (fold-fused pallas histograms + routing) and
+    stack their payload rows into the same contraction.
+
+    Xb [N, F] binned (bin_matrix layout); y [N]; W [Fo, N] per-fold
+    weights (0 = row excluded from that fold's fit). Per-fold quantities
+    follow fit_gbt exactly — same base score, same gradient clamps, same
+    per-round subsample/colsample draws (ONE draw shared by all folds,
+    matching the sequential loop where every fold fits with the same
+    key). Returns (trees [rounds, Fo, ...], base [Fo], margins [Fo, N]) —
+    margins are the fitted scores for ALL rows (held-out rows are routed
+    through each fold's trees), i.e. exactly what the sequential
+    per-fold `base + predict_forest_bins(...)` loop produces.
+    """
+    grad_fn = _logistic_grad if loss == "logistic" else _squared_grad
+    Fo, N = W.shape
+    n_orig = N
+    wsum = W.sum(axis=1) + EPS
+    wy = (W * y[None, :]).sum(axis=1)
+    if loss == "logistic":
+        p0 = jnp.clip(wy / wsum, 1e-6, 1 - 1e-6)
+        base = jnp.log(p0 / (1 - p0))
+    else:
+        base = wy / wsum
+
+    # pad rows once to the kernels' block size (inert: zero payloads)
+    from . import pallas_hist
+    blk = pallas_hist._ROUTE_BLK
+    pad = (-N) % blk
+    if pad:
+        Xb = jnp.pad(Xb, ((0, pad), (0, 0)))
+        y = jnp.pad(y, ((0, pad),))
+        W = jnp.pad(W, ((0, 0), (0, pad)))
+        N += pad
+    valid = (jnp.arange(N) < n_orig).astype(jnp.float32)
+    Xb_t = Xb.T
+
+    def one(carry, k):
+        margin, = carry
+        ks, kc, kf = jax.random.split(k, 3)
+        g, h = grad_fn(margin, y[None, :], W)             # [Fo, N] each
+        # padded rows carry literal zeros (grow_tree pads H with 0; the
+        # logistic clamp would otherwise leave them at EPS)
+        h = h * valid[None, :]
+        if subsample < 1.0:
+            rw = (jax.random.uniform(ks, (N,)) < subsample
+                  ).astype(jnp.float32)[None, :]
+            g, h = g * rw, h * rw
+        # count semantics follow grow_tree's count_unit = (H > 0) on the
+        # POST-subsample hessian: the logistic clamp keeps excluded (W=0)
+        # real rows countable exactly as in the sequential path, while
+        # subsampled-out and padded rows drop to 0
+        count = (h > 0).astype(jnp.float32)
+        fm = (_feature_mask(kc, 1, Xb_t.shape[0], feature_frac)[0]
+              if feature_frac < 1.0 else None)
+        tree, leaf_rows = _grow_tree_folds(
+            Xb_t, g, h, count, kf, depth=depth, n_bins=n_bins,
+            reg_lambda=reg_lambda, min_child_weight=min_child_weight,
+            min_instances=min_instances, min_info_gain=min_info_gain,
+            gamma=gamma, learning_rate=learning_rate, feature_mask=fm,
+            interpret=interpret)
+        return (margin + leaf_rows,), tree
+
+    init = jnp.broadcast_to(base[:, None], (Fo, N)).astype(jnp.float32)
+    (margin,), trees = jax.lax.scan(one, (init,),
+                                    jax.random.split(key, n_rounds))
+    return trees, base, margin[:, :n_orig]
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("n_rounds", "depth", "n_bins", "n_classes", "subsample",
@@ -780,7 +1006,8 @@ def _register_pallas_consumers():
     """Tree-fit executables bake the pallas choice in at trace time; the
     kill switch must be able to clear them (set_pallas_enabled)."""
     from . import pallas_hist
-    for fn in (grow_tree, fit_forest, fit_gbt, fit_gbt_softmax):
+    for fn in (grow_tree, fit_forest, fit_gbt, fit_gbt_folds,
+               fit_gbt_softmax):
         pallas_hist.register_cache_consumer(fn)
 
 
