@@ -49,7 +49,11 @@ def test_out_of_range_slot_drops_rows():
 
 def test_histograms_pallas_wrapper_shapes(monkeypatch):
     """trees._histograms_pallas transposes/reshapes consistently with the
-    XLA paths (interpret mode, forced availability)."""
+    XLA paths (interpret mode, forced availability). With the tree
+    consumers' bf16 contraction inputs forced OFF the values must match
+    the segment path near-exactly; with them on (the default,
+    TMOG_HIST_BF16) the g/h channels carry ~0.4% relative quantization
+    while the unit-count channel stays exact."""
     monkeypatch.setattr(PH, "available", lambda: True)
     import functools
     real = PH.hist_pallas
@@ -57,11 +61,29 @@ def test_histograms_pallas_wrapper_shapes(monkeypatch):
         PH, "hist_pallas",
         functools.partial(real, interpret=True))
     Xb, G, H, cu, node, n_nodes, B = _inputs(2 * PH._BLK, k=2, seed=5)
-    out_p = T._histograms_pallas(Xb, G, H, cu, node, n_nodes, B)
     out_s = T._histograms_segment(Xb, G, H, cu, node, n_nodes, B)
-    for a, b_ in zip(out_p, out_s):
-        assert a.shape == b_.shape
-        assert np.allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+    prev = PH._HIST_BF16
+    try:
+        PH.set_hist_bf16(False)
+        out_p = T._histograms_pallas(Xb, G, H, cu, node, n_nodes, B)
+        for a, b_ in zip(out_p, out_s):
+            assert a.shape == b_.shape
+            assert np.allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+        PH.set_hist_bf16(True)
+        out_b = T._histograms_pallas(Xb, G, H, cu, node, n_nodes, B)
+        # the bf16 leg must actually quantize: bitwise equality with the
+        # f32 leg would mean the flag did not reach the kernel
+        assert any(np.any(np.asarray(a) != np.asarray(p))
+                   for a, p in zip(out_b[:2], out_p[:2]))
+        for a, b_ in zip(out_b, out_s):
+            assert a.shape == b_.shape
+            ref = np.asarray(b_)
+            assert np.allclose(np.asarray(a), ref,
+                               atol=0.02 * (np.abs(ref).max() + 1.0))
+        np.testing.assert_array_equal(np.asarray(out_b[2]),
+                                      np.asarray(out_s[2]))  # counts exact
+    finally:
+        PH.set_hist_bf16(prev)
 
 
 class TestBinnedLanes:
@@ -89,7 +111,7 @@ class TestBinnedLanes:
         monkeypatch.setattr(M.jax, "default_backend", lambda: "tpu")
         monkeypatch.setattr(PH, "available", lambda: True)
         monkeypatch.setattr(PH, "hist_pallas",
-                            functools.partial(PH.hist_pallas.__wrapped__,
+                            functools.partial(PH.hist_pallas,
                                               interpret=True))
         scores, y, w = self._lanes(L=4, n=1100)  # forces tail padding
         tps, fps = M.binned_cum_counts_lanes(scores, y, w, 128)

@@ -1,0 +1,97 @@
+"""A/B the streamed-GLM Hessian contraction dtype on live TPU.
+
+The GLM sweep's per-iteration cost is dominated by the compressed-triangle
+Hessian matmul S.T @ xx ([L, c] x [c, T], T = d(d+1)/2) plus the xx
+pair-product build; measured sweep MFU is ~2.75% (BENCH_TPU_AUTORUN r4).
+X arrives in bf16 (sweep_dtype), so the f32 contraction is upcasting
+bf16-precision values — this probe times the same shapes with
+(a) f32 inputs, (b) bf16 inputs + f32 accumulation, and (c) the xx build,
+all on rep-varying data (same-input reruns return tunnel-cached results).
+
+Usage: python tools/tpu_glm_hess_ab.py
+"""
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+c, L, d = 32_768, 240, 64
+T = d * (d + 1) // 2
+iu0, iu1 = np.triu_indices(d)
+iu0 = jnp.asarray(iu0)
+iu1 = jnp.asarray(iu1)
+NBLK = 32  # simulate 32 of the 306 blocks of a 10M-row pass
+
+out = {"c": c, "L": L, "d": d, "T": int(T), "nblk": NBLK,
+       "backend": jax.default_backend()}
+
+
+@jax.jit
+def gen(key):
+    kx, ks = jax.random.split(key)
+    xf = jax.random.normal(kx, (NBLK, c, d), jnp.float32)
+    S = jax.random.normal(ks, (NBLK, c, L), jnp.float32)
+    return xf, S
+
+
+def timed(label, f, data, reps=3):
+    best = None
+    for i in range(reps):
+        t0 = time.time()
+        jax.block_until_ready(f(*data[i]))
+        dt = time.time() - t0
+        if i > 0:
+            best = dt if best is None else min(best, dt)
+    out[label] = round(best, 4)
+    print(label, out[label], flush=True)
+
+
+@jax.jit
+def hess_f32(xf, S):
+    def body(acc, sl):
+        x, s = sl
+        xx = x[:, iu0] * x[:, iu1]
+        return acc + jnp.matmul(s.T, xx,
+                                preferred_element_type=jnp.float32), None
+    acc0 = jnp.zeros((L, T), jnp.float32)
+    return jax.lax.scan(body, acc0, (xf, S))[0]
+
+
+@jax.jit
+def hess_bf16(xf, S):
+    def body(acc, sl):
+        x, s = sl
+        xb = x.astype(jnp.bfloat16)
+        xx = xb[:, iu0] * xb[:, iu1]
+        return acc + jnp.matmul(s.astype(jnp.bfloat16).T, xx,
+                                preferred_element_type=jnp.float32), None
+    acc0 = jnp.zeros((L, T), jnp.float32)
+    return jax.lax.scan(body, acc0, (xf, S))[0]
+
+
+data = [gen(jax.random.PRNGKey(i)) for i in range(3)]
+jax.block_until_ready(data)
+timed("hess_f32_s", hess_f32, data)
+timed("hess_bf16_s", hess_bf16, data)
+
+# numerical drift of the bf16 Hessian (relative, on one block)
+h32 = np.asarray(hess_f32(data[0][0][:1], data[0][1][:1]), np.float64)
+h16 = np.asarray(hess_bf16(data[0][0][:1], data[0][1][:1]), np.float64)
+rel = np.abs(h16 - h32) / (np.abs(h32) + 1e-3)
+out["rel_err_mean"] = float(rel.mean())
+out["rel_err_max"] = float(rel.max())
+flops = 2.0 * NBLK * c * L * T
+out["tflops_f32"] = round(flops / out["hess_f32_s"] / 1e12, 1)
+out["tflops_bf16"] = round(flops / out["hess_bf16_s"] / 1e12, 1)
+print(json.dumps(out))
+rec = {"stage": "glm_hess_ab", "ok": True, "s": 0, "detail": out,
+       "ts": round(time.time(), 1)}
+with open(os.path.join(HERE, "tpu_stages_r4.jsonl"), "a") as f:
+    f.write(json.dumps(rec) + "\n")

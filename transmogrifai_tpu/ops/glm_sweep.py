@@ -14,13 +14,15 @@ lanes (reference workload: the 8-thread pool of OpValidator.scala:270-332,
 every thread refitting against the same cached DataFrame):
 
 - one row-block scan per Newton iteration, carrying per-lane accumulators
-  (g [L, d], compressed Hessian [L, T], intercept sums);
+  (g [L, d], Hessians [L, d, d], intercept sums);
 - lane etas in one MXU contraction `X_blk @ B.T` ([c, d] x [d, L]);
-- every lane's Gram matrix from ONE contraction against the compressed
-  outer-product block XX [c, T], T = d(d+1)/2 upper-triangle pairs:
-  H_tri = S^T @ XX, where S [c, L] are the per-lane curvature weights.
-  No per-lane scaled copy of X exists anywhere, and the triangle halves
-  the contraction FLOPs vs naive [L, d, d] Grams;
+- every lane's weighted Gram from ONE batched einsum 'cl,cd,ce->lde'
+  with S [c, L] the per-lane curvature weights (narrow path, d <= 128).
+  A compressed upper-triangle form (xf[:, iu0] * xf[:, iu1] then an
+  [L, c] x [c, T] matmul) halves the arithmetic but its column GATHER
+  dominated the pass on TPU — 7.8 TF/s vs the einsum's 25.8 TF/s on a
+  v5 lite at the BASELINE shapes (tools/tpu_glm_hess_ab.py). No
+  per-lane scaled copy of X exists anywhere;
 - per-lane 64x64 Newton solves + proximal L1 + intercept steps are
   batched dense linalg on [L, d, d] — microscopic next to the scan.
 
@@ -54,9 +56,19 @@ from . import glm as G
 
 EPS = 1e-12
 
-# Rows per scan block: bounds the [c, T] outer-product block (f32, T=2080
-# at d=64 -> 256MB) and the [c, L] residual/curvature blocks.
+# Rows per scan block on the narrow path: bounds the [c, d, d] pairwise
+# intermediate XLA materializes when lowering the Gram einsum (f32, 512MB
+# at d=64/c=32768) and the [c, L] residual/curvature blocks. _row_block()
+# halves c as d grows so the transient never exceeds that budget (d=128
+# would otherwise double it).
 _ROW_BLOCK = 32_768
+
+
+def _row_block(d: int) -> int:
+    c = _ROW_BLOCK
+    while c > 4_096 and c * d * d * 4 > 512 * (1 << 20):
+        c //= 2
+    return c
 
 # Widest matrix the single compressed-triangle pass handles: past this the
 # [c, d(d+1)/2] pair-product block outgrows HBM and the kernel switches to
@@ -94,24 +106,6 @@ def streamed_route_ok(d: int, lanes: int, budget_bytes: float) -> bool:
             return False
         d_work = nt * _FEATURE_TILE
     return lanes * d_work * d_work * 4.0 * 4.0 <= budget_bytes
-
-
-@functools.lru_cache(maxsize=None)
-def _tri_maps(d: int):
-    """(iu0, iu1, expand) for the compressed symmetric Gram.
-
-    iu0/iu1 [T]: column pairs of the upper triangle (diagonal included).
-    expand [d*d]: full-matrix cell -> triangle slot, so
-    H_full = H_tri[:, expand].reshape(L, d, d) (a static gather)."""
-    iu = np.triu_indices(d)
-    slot = np.zeros((d, d), np.int32)
-    slot[iu] = np.arange(iu[0].size, dtype=np.int32)
-    slot = np.maximum(slot, slot.T)
-    # numpy (NOT jnp): this cache is populated inside jit traces, where
-    # jnp.asarray would capture a per-trace constant tracer and leak it
-    # into later traces
-    return (iu[0].astype(np.int32), iu[1].astype(np.int32),
-            slot.reshape(-1).astype(np.int32))
 
 
 def _residual_curvature(loss: str):
@@ -160,8 +154,6 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
         tile_pairs = [(a, b) for a in range(nt) for b in range(a, nt)]
         d_work = d_pad
     else:
-        iu0, iu1, expand = _tri_maps(d)
-        T = iu0.shape[0]
         d_work = d
 
     def allreduce(v):
@@ -200,7 +192,7 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
     wsum_l = jnp.repeat(wsum_f, Gn)                     # [L]
 
     # pad local rows to the block multiple with w=0 (inert everywhere)
-    c = min(_ROW_BLOCK_WIDE if tiled else _ROW_BLOCK, n)
+    c = min(_ROW_BLOCK_WIDE if tiled else _row_block(d_work), n)
     nb = -(-n // c)
     pad = nb * c - n
     if pad:
@@ -213,10 +205,18 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
 
     eye = jnp.eye(d_work, dtype=jnp.float32)
 
-    def _hessian_blocks_tri(xf, S):
-        """Compressed-triangle contribution [L, T] for one row block."""
-        xx = xf[:, iu0] * xf[:, iu1]                    # [c, T]
-        return jnp.matmul(S.T, xx, preferred_element_type=jnp.float32)
+    def _hessian_blocks_narrow(xf, S):
+        """Per-lane weighted Gram [L, d, d] for one row block, as ONE
+        einsum XLA tiles directly. The previous compressed-triangle form
+        (xf[:, iu0] * xf[:, iu1] -> [c, T] then an [L, c] x [c, T]
+        matmul) halved the contraction FLOPs but its column GATHER
+        dominated the whole pass on TPU: measured on v5 lite at the
+        BASELINE shapes, the gather-built triangle ran 7.8 TF/s
+        end-to-end while this full symmetric einsum runs 25.8 TF/s —
+        1.7x faster despite doing 2x the arithmetic
+        (tools/tpu_glm_hess_ab.py)."""
+        return jnp.einsum('cl,cd,ce->lde', S, xf, xf,
+                          preferred_element_type=jnp.float32)
 
     def _hessian_blocks_tiled(xf, S):
         """Tile-pair contributions [npairs, L, bt*bt] for one row block —
@@ -233,8 +233,8 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
                                   preferred_element_type=jnp.float32))
         return jnp.stack(out)
 
-    def _assemble_tri(hA):
-        return hA[:, expand].reshape(L, d_work, d_work)
+    def _assemble_narrow(hA):
+        return hA  # already the full symmetric [L, d, d]
 
     def _assemble_tiled(hA):
         H = jnp.zeros((L, d_work, d_work), jnp.float32)
@@ -251,8 +251,8 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
         hess_blocks, assemble = _hessian_blocks_tiled, _assemble_tiled
         h_acc0 = jnp.zeros((len(tile_pairs), L, bt * bt), jnp.float32)
     else:
-        hess_blocks, assemble = _hessian_blocks_tri, _assemble_tri
-        h_acc0 = jnp.zeros((L, T), jnp.float32)
+        hess_blocks, assemble = _hessian_blocks_narrow, _assemble_narrow
+        h_acc0 = jnp.zeros((L, d_work, d_work), jnp.float32)
 
     def accumulate(B, b0):
         """One streaming pass: per-lane (g [L,d], Hessian blocks, g0, h0)."""

@@ -114,10 +114,36 @@ def available() -> bool:
 # lowering path in case the reshape form is what stalled the round-3
 # 10M-row first contact (note jnp.repeat would NOT qualify: it lowers to
 # the same broadcast+reshape). Runtime-switchable so
-# tools/tpu_staged_probe.py can try both.
+# tools/tpu_staged_probe.py can try both. NOTE: the bf16 input mode
+# always builds its one-hot with the per-feature concat form (a full-size
+# f32 one-hot next to its bf16 copy would overflow the scoped-VMEM stack,
+# and Mosaic rejects bf16 compares), so this A/B lever only
+# distinguishes lowerings on the f32 path — which is exactly what the
+# probe's pallas_direct stage runs (it does not pass allow_bf16).
 _VARIANTS = ("reshape", "concat")
 _VARIANT = os.environ.get("TMOG_PALLAS_HIST_VARIANT", "reshape").strip() \
     or "reshape"
+
+# Histogram contraction input dtype. bf16 doubles the MXU ceiling (the
+# fused fold fit runs near the f32 matmul peak); the one-hot operand is
+# EXACT in bf16 (0/1) and counts stay integer-exact (1.0 payloads, f32
+# accumulation) — only the g/h payload channels quantize (~0.4%
+# relative). Flip with TMOG_HIST_BF16=0 to fall back to full-f32 inputs.
+_HIST_BF16 = os.environ.get("TMOG_HIST_BF16", "1").strip().lower() \
+    not in ("0", "false", "off")
+
+
+def set_hist_bf16(enabled: bool) -> None:
+    """Toggle bf16 histogram inputs. hist_pallas itself resolves the flag
+    OUTSIDE its jit (it becomes the use_bf16 cache key), so only the
+    registered consumer jits — which bake the flag into their traces —
+    need their caches cleared."""
+    global _HIST_BF16
+    if _HIST_BF16 == bool(enabled):
+        return
+    _HIST_BF16 = bool(enabled)
+    for fn in _cache_consumers:
+        fn.clear_cache()
 
 
 def set_variant(name: str) -> None:
@@ -128,11 +154,11 @@ def set_variant(name: str) -> None:
         _VARIANT = name
         for fn in _cache_consumers:
             fn.clear_cache()
-        hist_pallas.clear_cache()
+        _hist_pallas_jit.clear_cache()
 
 
 def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots,
-            n_folds, variant):
+            n_folds, variant, use_bf16=False):
     import jax.experimental.pallas as pl
 
     @pl.when(pl.program_id(0) == 0)
@@ -140,15 +166,21 @@ def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots,
         out_ref[:] = jnp.zeros_like(out_ref)
 
     blk = xb_ref.shape[1]
+    mxu_dtype = jnp.bfloat16 if use_bf16 else jnp.float32
+    # comparisons must run in f32 (Mosaic rejects bf16 cmpf vectors, like
+    # the f32-iota restriction below); bf16 mode therefore builds the
+    # one-hot feature-by-feature, casting each [B, blk] slice down
+    # immediately — one full-size f32 one-hot next to its bf16 copy would
+    # blow the 16MB scoped-VMEM stack
     xf = xb_ref[:].astype(jnp.float32)                      # [F, blk]
     # Mosaic's tpu.iota only produces integer vectors; build int32 and
     # cast (f32 iota verified fine in interpret mode but fails TPU
     # lowering)
-    if variant == "concat":
+    if variant == "concat" or use_bf16:
         bins2 = jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0) \
             .astype(jnp.float32)                            # [B, 1]
         oh = jnp.concatenate(
-            [(xf[f:f + 1, :] == bins2).astype(jnp.float32)  # [B, blk]
+            [(xf[f:f + 1, :] == bins2).astype(mxu_dtype)    # [B, blk]
              for f in range(F)], axis=0)                    # [F*B, blk]
     else:
         bins = jax.lax.broadcasted_iota(jnp.int32, (1, B, 1), 1) \
@@ -166,8 +198,8 @@ def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots,
     qs = []
     for k in range(n_folds):
         slot = slot_ref[k:k + 1, :]                         # [1, blk]
-        slot_oh = (slots == slot).astype(jnp.float32)       # [n_slots, blk]
-        pay = pay_ref[k * C:(k + 1) * C, :]                 # [C, blk]
+        slot_oh = (slots == slot).astype(mxu_dtype)         # [n_slots, blk]
+        pay = pay_ref[k * C:(k + 1) * C, :].astype(mxu_dtype)
         qs.append((slot_oh[:, None, :] * pay[None, :, :])
                   .reshape(n_slots * C, blk))
     q = qs[0] if n_folds == 1 else jnp.concatenate(qs, axis=0)
@@ -177,11 +209,10 @@ def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots,
         preferred_element_type=jnp.float32)                 # [Fo*S*C, F*B]
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("n_slots", "n_bins", "interpret"))
 def hist_pallas(Xb_t: jax.Array, pay_t: jax.Array, slot_t: jax.Array,
                 *, n_slots: int, n_bins: int,
-                interpret: bool = False) -> jax.Array:
+                interpret: bool = False,
+                allow_bf16: bool = False) -> jax.Array:
     """Gradient histograms [n_folds * n_slots * C, F * n_bins] (f32).
 
     Xb_t [F, N] int bins; pay_t [n_folds * C, N] f32 payload channels;
@@ -192,7 +223,26 @@ def hist_pallas(Xb_t: jax.Array, pay_t: jax.Array, slot_t: jax.Array,
     slot_t's leading dim (C must divide pay_t's). Ragged N pads internally
     with dropped-slot rows; the block size adapts to the one-hot width so
     VMEM tiles stay bounded (see block_rows).
+
+    allow_bf16: opt-in to bf16 contraction INPUTS (f32 accumulation) when
+    the module flag agrees (TMOG_HIST_BF16, default on) — the tree-fit
+    consumers take it (one-hots and unit counts are exact in bf16; the
+    g/h payloads quantize ~0.4% relative, within the tree-quality gates);
+    the rank-metric consumer keeps full-precision weights. The resolved
+    dtype choice is a jit-cache key of the inner impl (NOT a trace-time
+    global read), so set_hist_bf16 toggles cannot serve stale-dtype
+    executables even through wrapped/monkeypatched references.
     """
+    return _hist_pallas_jit(Xb_t, pay_t, slot_t, n_slots=n_slots,
+                            n_bins=n_bins, interpret=interpret,
+                            use_bf16=allow_bf16 and _HIST_BF16)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_slots", "n_bins", "interpret",
+                                    "use_bf16"))
+def _hist_pallas_jit(Xb_t, pay_t, slot_t, *, n_slots, n_bins,
+                     interpret, use_bf16):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -217,7 +267,8 @@ def hist_pallas(Xb_t: jax.Array, pay_t: jax.Array, slot_t: jax.Array,
             f"TMOG_PALLAS_HIST_VARIANT={_VARIANT!r}; expected one of "
             f"{_VARIANTS}")
     kernel = functools.partial(_kernel, F=F, B=B, C=C, n_slots=n_slots,
-                               n_folds=n_folds, variant=_VARIANT)
+                               n_folds=n_folds, variant=_VARIANT,
+                               use_bf16=use_bf16)
     return pl.pallas_call(
         kernel,
         grid=(N // blk,),

@@ -296,7 +296,7 @@ def _histograms_pallas(Xb, G, H, count_unit, node, n_nodes: int, B: int):
         [G.T, H[None, :], count_unit[None, :]], axis=0)      # [C, N]
     hist = pallas_hist.hist_pallas(
         Xb.T, pay, node[None, :].astype(jnp.float32),
-        n_slots=n_nodes, n_bins=B)                           # [nC, F*B]
+        n_slots=n_nodes, n_bins=B, allow_bf16=True)          # [nC, F*B]
     hist = hist.reshape(n_nodes, C, F, B)
     return (hist[:, :K].transpose(0, 2, 3, 1), hist[:, K], hist[:, K + 1])
 
@@ -787,7 +787,7 @@ def _grow_tree_folds(Xb_t, G, H, count_unit, key, *, depth, n_bins,
         pay = jnp.stack([G, H, count_unit], axis=1).reshape(3 * Fo, N)
         hist = pallas_hist.hist_pallas(
             Xb_t, pay, slots, n_slots=n_slots, n_bins=B,
-            interpret=interpret)                          # [Fo*S*3, F*B]
+            interpret=interpret, allow_bf16=True)         # [Fo*S*3, F*B]
         hist = hist.reshape(Fo, n_slots, 3, F, B)
         hgl = hist[:, :, 0][..., None]                        # [Fo,S,F,B,1]
         hhl = hist[:, :, 1]                                   # [Fo,S,F,B]
