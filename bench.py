@@ -309,7 +309,13 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
     if not candidates:
         raise RuntimeError("both sweep families failed: " + "; ".join(errors))
     best = max(candidates, key=lambda b: b.best_metric)
+    # the route label must come from the process where the fits ran: a
+    # child may have disabled pallas after a Mosaic failure or run with
+    # different flags than the parent
+    tree_route = getattr(best_tree, "tree_route", None) or \
+        (tree_route_label(cfg) if best_tree is not None else None)
     out = dict(glm_s=glm_s, tree_s=tree_s, glm_route=glm_route,
+               tree_route=tree_route,
                glm_fits=len(ggrids) * cfg["folds"] if best_glm else 0,
                tree_fits=len(tgrids) * cfg["folds"] if best_tree else 0,
                best_name=best.name, best_grid=best.best_grid,
@@ -322,11 +328,30 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
     return out
 
 
+def tree_route_label(cfg):
+    """Which tree kernel path the mask-fold sweep at cfg's row count
+    takes, read from the flags IN THIS PROCESS — call it where the fits
+    ran (the child computes its own label; the parent must not infer one
+    across the process boundary)."""
+    import jax
+    if jax.default_backend() != "tpu":
+        return "host_native_or_xla"
+    from transmogrifai_tpu.ops import pallas_hist as ph
+    from transmogrifai_tpu.models.trees import _TreeEstimator
+    if cfg["n_rows"] <= _TreeEstimator._VMAP_FOLD_MAX_ROWS:
+        return "xla_fold_vmap"
+    if not ph.available():
+        return "xla_matmul"
+    return "fused_bf16" if ph._HIST_BF16 else "fused_f32"
+
+
 class _TreeSweepResult:
     """Duck-typed stand-in for the validator's BestEstimator when the tree
     sweep ran in a child process (only the fields device_sweeps reads)."""
 
-    def __init__(self, name, best_grid, best_metric, fit_flops=0.0):
+    def __init__(self, name, best_grid, best_metric, fit_flops=0.0,
+                 tree_route=None):
+        self.tree_route = tree_route
         self.name = name
         self.best_grid = best_grid
         self.best_metric = best_metric
@@ -358,7 +383,8 @@ def tree_sweep_child(cfg):
     print("TREE|" + json.dumps(dict(
         tree_s=round(dt, 3), name=best.name, best_grid=best.best_grid,
         best_metric=float(best.best_metric), fit_flops=flops,
-        pallas=pallas_hist.available())), flush=True)
+        pallas=pallas_hist.available(),
+        tree_route=tree_route_label(cfg))), flush=True)
 
 
 def _tree_sweep_subprocess(cfg, errors, timeout_s=None):
@@ -406,7 +432,8 @@ def _tree_sweep_subprocess(cfg, errors, timeout_s=None):
                 log(f"tree sweep child ({tag}) done in {d['tree_s']}s")
                 return (_TreeSweepResult(d["name"], d["best_grid"],
                                          d["best_metric"],
-                                         d.get("fit_flops", 0.0)),
+                                         d.get("fit_flops", 0.0),
+                                         d.get("tree_route")),
                         d["tree_s"], True)
         stderr = (r.stderr or "").strip()
         # device-contention init failure: the runtime is single-tenant,

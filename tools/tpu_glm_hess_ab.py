@@ -5,8 +5,12 @@ Hessian matmul S.T @ xx ([L, c] x [c, T], T = d(d+1)/2) plus the xx
 pair-product build; measured sweep MFU is ~2.75% (BENCH_TPU_AUTORUN r4).
 X arrives in bf16 (sweep_dtype), so the f32 contraction is upcasting
 bf16-precision values — this probe times the same shapes with
-(a) f32 inputs, (b) bf16 inputs + f32 accumulation, and (c) the xx build,
-all on rep-varying data (same-input reruns return tunnel-cached results).
+(a) the triangle form with f32 inputs, (b) with bf16 inputs + f32
+accumulation, (c) the triangle's gather-built xx block alone, and
+(d) the batched full-Gram einsum that glm_sweep now ships (the measured
+winner: the gather in (a)/(c) dominates; the einsum ran 25.8 TF/s vs the
+triangle's 7.8 on a v5 lite despite 2x the arithmetic). All legs use
+rep-varying data (same-input reruns return tunnel-cached results).
 
 Usage: python tools/tpu_glm_hess_ab.py
 """
@@ -76,10 +80,34 @@ def hess_bf16(xf, S):
     return jax.lax.scan(body, acc0, (xf, S))[0]
 
 
+@jax.jit
+def xx_build_only(xf, S):
+    """The triangle's pair-product build alone — isolates the column
+    gather that turned out to dominate the whole pass."""
+    def body(acc, sl):
+        x, s = sl
+        return acc + (x[:, iu0] * x[:, iu1]).sum(), None
+    return jax.lax.scan(body, 0.0, (xf, S))[0]
+
+
+@jax.jit
+def hess_einsum(xf, S):
+    """The shipped form (glm_sweep._hessian_blocks_narrow): one batched
+    per-lane Gram einsum, no gather, full [L, d, d] output."""
+    def body(acc, sl):
+        x, s = sl
+        return acc + jnp.einsum('cl,cd,ce->lde', s, x, x,
+                                preferred_element_type=jnp.float32), None
+    acc0 = jnp.zeros((L, d, d), jnp.float32)
+    return jax.lax.scan(body, acc0, (xf, S))[0]
+
+
 data = [gen(jax.random.PRNGKey(i)) for i in range(3)]
 jax.block_until_ready(data)
 timed("hess_f32_s", hess_f32, data)
 timed("hess_bf16_s", hess_bf16, data)
+timed("xx_build_s", xx_build_only, data)
+timed("hess_einsum_s", hess_einsum, data)
 
 # numerical drift of the bf16 Hessian (relative, on one block)
 h32 = np.asarray(hess_f32(data[0][0][:1], data[0][1][:1]), np.float64)
@@ -90,6 +118,9 @@ out["rel_err_max"] = float(rel.max())
 flops = 2.0 * NBLK * c * L * T
 out["tflops_f32"] = round(flops / out["hess_f32_s"] / 1e12, 1)
 out["tflops_bf16"] = round(flops / out["hess_bf16_s"] / 1e12, 1)
+# the einsum does the FULL d*d contraction (2x the triangle's arithmetic)
+out["tflops_einsum"] = round(2.0 * NBLK * c * L * d * d
+                             / out["hess_einsum_s"] / 1e12, 1)
 print(json.dumps(out))
 rec = {"stage": "glm_hess_ab", "ok": True, "s": 0, "detail": out,
        "ts": round(time.time(), 1)}

@@ -104,7 +104,7 @@ jax.block_until_ready(trees10)
 Xbs = [jnp.where(Xb == 1, 1 + (i % 2), Xb) for i in range(3)]  # vary input
 jax.block_until_ready(Xbs)
 timed("predict_forest_10", lambda i: T.predict_forest_bins(
-    trees10[0] if False else trees10, Xbs[i], 6))
+    trees10, Xbs[i], 6))
 
 rec = {"stage": "tree_profile", "ok": True, "s": 0, "detail": out,
        "ts": round(time.time(), 1)}

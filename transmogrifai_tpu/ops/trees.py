@@ -735,7 +735,7 @@ def fit_gbt(Xb: jax.Array, y: jax.Array, w: jax.Array, key: jax.Array, *,
     return trees, base
 
 
-def _grow_tree_folds(Xb_t, G, H, count_unit, key, *, depth, n_bins,
+def _grow_tree_folds(Xb_t, G, H, count_unit, *, depth, n_bins,
                      reg_lambda, min_child_weight, min_instances,
                      min_info_gain, gamma, learning_rate, feature_mask,
                      interpret=False):
@@ -938,8 +938,11 @@ def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
         count = (h > 0).astype(jnp.float32)
         fm = (_feature_mask(kc, 1, Xb_t.shape[0], feature_frac)[0]
               if feature_frac < 1.0 else None)
+        # kf (grow_tree's per-node feature-resample key) is intentionally
+        # unused: the boosting paths sample features per TREE via
+        # feature_mask, never per node — same as fit_gbt
         tree, leaf_rows = _grow_tree_folds(
-            Xb_t, g, h, count, kf, depth=depth, n_bins=n_bins,
+            Xb_t, g, h, count, depth=depth, n_bins=n_bins,
             reg_lambda=reg_lambda, min_child_weight=min_child_weight,
             min_instances=min_instances, min_info_gain=min_info_gain,
             gamma=gamma, learning_rate=learning_rate, feature_mask=fm,
