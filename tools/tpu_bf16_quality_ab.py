@@ -1,0 +1,48 @@
+"""Same-data bf16-vs-f32 tree-quality A/B on live TPU (10M x 64, 5 folds).
+
+Defeats the tunnel's cross-process result cache by scaling the f32 leg's
+fold weights by (1 + 1e-6) — semantically inert (uniform weight scaling
+leaves splits and Newton leaves unchanged to ~1e-7) but byte-distinct
+inputs. Reports per-fold held-out AuPR for both histogram input dtypes
+and the max |delta|; the round-4 session-2 tunnel drop killed the first
+attempt (BENCH_NOTES), so run this on the next window.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp
+from transmogrifai_tpu.ops import trees as T, pallas_hist as PH
+from transmogrifai_tpu.ops.metrics_ops import au_pr_binned_lanes
+from bench import truth_beta
+
+N, F, B, Fo = 10_000_000, 64, 32, 5
+@jax.jit
+def gen(key):
+    kx, ky, km = jax.random.split(key, 3)
+    X = jax.random.normal(kx, (N, F), jnp.float32)
+    logits = X @ jnp.asarray(truth_beta(F))
+    y = (jax.random.uniform(ky, (N,)) < jax.nn.sigmoid(logits)).astype(jnp.float32)
+    fold = jax.random.randint(km, (N,), 0, Fo)
+    masks = (fold[None, :] != jnp.arange(Fo)[:, None]).astype(jnp.float32)
+    return X, y, masks
+X, y, masks = gen(jax.random.PRNGKey(777)); jax.block_until_ready(X)
+edges = T.quantile_edges(X, B); Xb = T.bin_matrix(X, edges); jax.block_until_ready(Xb); del X
+
+kw = dict(n_rounds=10, depth=6, n_bins=B, learning_rate=0.1, reg_lambda=1.0, loss="logistic")
+out = {}
+for mode, wscale in (("bf16", 1.0), ("f32", 1.0 + 1e-6)):
+    PH.set_hist_bf16(mode == "bf16")
+    t0=time.time()
+    _, _, margins = T.fit_gbt_folds(Xb, y, masks * wscale, jax.random.PRNGKey(1), **kw)
+    jax.block_until_ready(margins)
+    aupr = np.asarray(au_pr_binned_lanes(margins, y, 1.0 - masks, 4096))
+    out[mode] = (time.time()-t0, aupr, np.asarray(margins[:, :100000]))
+    print(f"{mode}(x{wscale}): fit={out[mode][0]:.2f}s  AuPR={np.round(aupr,5).tolist()}", flush=True)
+PH.set_hist_bf16(True)
+d = np.abs(out["bf16"][1] - out["f32"][1])
+md = np.abs(out["bf16"][2] - out["f32"][2])
+print("AuPR |delta| max:", float(d.max()), "; margin |delta| mean:", float(md.mean()))
