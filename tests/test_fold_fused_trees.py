@@ -84,13 +84,22 @@ def test_table_lookup_pallas():
     np.testing.assert_array_equal(np.asarray(got), want.astype(np.float32))
 
 
-@pytest.mark.parametrize("loss,subsample", [
-    ("logistic", 1.0), ("squared", 1.0), ("logistic", 0.7)])
-def test_fused_folds_equal_fused_single_fold_runs(loss, subsample):
+@pytest.mark.parametrize("loss,subsample,unit_w", [
+    ("logistic", 1.0, True), ("squared", 1.0, True),
+    ("logistic", 0.7, True),
+    # non-unit row weights exercise base-score/gradient/count semantics
+    # beyond the 0/1 fold masks
+    ("logistic", 1.0, False)])
+def test_fused_folds_equal_fused_single_fold_runs(loss, subsample, unit_w):
     # n=801: ragged vs the 4096 block pad — padded rows must stay inert
     # in every payload channel (h EPS-clamp and count included)
     Xb, y, masks = _data(n=801, f=6, b=7, folds=3, seed=4)
-    W = masks * 1.0
+    if unit_w:
+        W = masks * 1.0
+    else:
+        rng = np.random.default_rng(9)
+        W = masks * jnp.asarray(
+            rng.uniform(0.5, 2.0, size=y.shape[0]).astype(np.float32))
     kw = dict(n_rounds=3, depth=3, n_bins=7, learning_rate=0.3,
               reg_lambda=1.0, loss=loss, subsample=subsample,
               interpret=True)
