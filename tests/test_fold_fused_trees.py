@@ -157,7 +157,7 @@ def test_mask_fit_scores_routes_through_fused_hook(monkeypatch):
 
     monkeypatch.setattr(T, "fit_gbt_folds", fake_fit_gbt_folds)
     monkeypatch.setattr(type(est), "_fused_route_ok",
-                        lambda self, ctx, y: True)
+                        lambda self, ctx, y, masks=None, depth=None: True)
     w = jnp.ones_like(y)
     out = est.mask_fit_scores(ctx, y, w * 2.0, masks)
     assert out.shape == (3, 300) and float(out[0, 0]) == 0.5

@@ -202,3 +202,45 @@ def test_concat_variant_matches_reshape():
 def test_set_variant_rejects_unknown():
     with pytest.raises(ValueError):
         PH.set_variant("bogus")
+
+
+class TestFusedVmemGuard:
+    """ADVICE r4 (medium): the fold-fused histogram's VMEM-resident output
+    block [n_folds*n_slots*C, F*B] scales with folds x slots x F x bins,
+    but only the one-hot tile was budgeted — XGB-shaped configs compiled
+    to a Mosaic failure with no library fallback."""
+
+    def test_sweep_shapes_fit(self):
+        # the BASELINE sweep shape (64 feat, 33 bins, 5 folds, depth 6)
+        # must keep the fused route on any generation's budget
+        assert PH.fused_hist_fits(64, 33, 5, 6) or PH._vmem_limit() < (
+            100 << 20)  # CPU test host reports the conservative limit
+
+    def test_xgb_default_shape_rejected(self, monkeypatch):
+        # 300 features x 257 bins x 5 folds x depth 6: output block alone
+        # is ~74MB; with the one-hot tile it exceeds even v5e+ VMEM
+        monkeypatch.setattr(PH, "_vmem_limit", lambda: 100 << 20)
+        assert not PH.fused_hist_fits(300, 257, 5, 6)
+
+    def test_baseline_shape_fits_on_v5e_budget(self, monkeypatch):
+        monkeypatch.setattr(PH, "_vmem_limit", lambda: 100 << 20)
+        assert PH.fused_hist_fits(64, 33, 5, 6)
+        assert not PH.fused_hist_fits(2048, 257, 5, 6)
+
+    def test_route_gate_consults_footprint(self, monkeypatch):
+        # _fused_route_ok must return False for an over-budget shape even
+        # when every other condition passes
+        from transmogrifai_tpu.models import trees as MT
+        est = MT.OpXGBoostClassifier()
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(PH, "available", lambda: True)
+        monkeypatch.setattr(est, "_VMAP_FOLD_MAX_ROWS", 0)
+        Xb = jnp.zeros((8, 300), jnp.int8)
+        y = jnp.zeros(8, jnp.float32)
+        masks = jnp.ones((5, 8), jnp.float32)
+        ctx = (Xb, jnp.zeros((300, 256)), 256)
+        monkeypatch.setattr(PH, "_vmem_limit", lambda: 100 << 20)
+        assert not est._fused_route_ok(ctx, y, masks, depth=6)
+        # a sweep-sized shape on the same gate stays on the fused route
+        ctx_small = (jnp.zeros((8, 64), jnp.int8), jnp.zeros((64, 32)), 32)
+        assert est._fused_route_ok(ctx_small, y, masks, depth=6)

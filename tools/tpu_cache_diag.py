@@ -1,0 +1,210 @@
+"""Root-cause the persistent-compile-cache miss over the axon tunnel.
+
+Round-4 observation (BENCH_NOTES): fresh-process TPU runs repay ~150s of
+XLA compiles even though transmogrifai_tpu enables jax's persistent
+compilation cache at import. VERDICT r4 asks for a root cause, not a
+workaround note. Hypotheses this script discriminates:
+
+  H1 local cache never WRITES on the axon backend (executable
+     serialization unsupported by the PJRT plugin, or remote compile
+     bypasses the cache layer) -> cache dir stays empty after a compile.
+  H2 cache writes but never HITS across processes (cache key includes a
+     per-session value, e.g. sitecustomize's session_id=uuid4(), or a
+     backend fingerprint that varies) -> dir has entries, second process
+     recompiles at full cost.
+  H3 cache works; the 150s is NOT XLA compile (e.g. pallas Mosaic
+     compiles through PALLAS_AXON_REMOTE_COMPILE, which jax's cache
+     does not cover) -> second process is fast for plain XLA, slow only
+     for pallas programs.
+
+Three killable child processes (A: cold compile + cache-write probe,
+B: same program + same cache dir, C: same program, cache disabled — the
+terminal-side-cache control). Each prints RESULT|{json}. Run on a live
+tunnel window; ~3-6 min total.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+CACHE = os.path.join(HERE, "xla_cache_diag")
+
+# A deliberately-nontrivial program so compile time is measurable (big
+# matmul chain with fusion opportunities), plus a tiny one to probe the
+# cache-everything (min_entry_size=-1) path.
+CHILD = r"""
+import json, logging, io, os, sys, time
+log_buf = io.StringIO()
+h = logging.StreamHandler(log_buf)
+h.setLevel(logging.DEBUG)
+for name in ("jax._src.compilation_cache", "jax._src.compiler",
+             "jax._src.cache_key", "jax._src.path"):
+    lg = logging.getLogger(name)
+    lg.setLevel(logging.DEBUG)
+    lg.addHandler(h)
+import jax, jax.numpy as jnp
+cache_dir = os.environ.get("DIAG_CACHE_DIR", "")
+if cache_dir:
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+t0 = time.time()
+dev = jax.devices()[0]
+init_s = round(time.time() - t0, 1)
+
+def big(a):
+    for _ in range(8):
+        a = jnp.tanh(a @ a) * 0.5 + a
+    return a.sum()
+
+x = jnp.ones((2048, 2048), jnp.bfloat16)
+t0 = time.time()
+r = jax.jit(big)(x); r.block_until_ready()
+big_cold_s = round(time.time() - t0, 2)
+t0 = time.time()
+r = jax.jit(big)(x); r.block_until_ready()
+big_warm_s = round(time.time() - t0, 3)
+
+# explicit AOT serialize probe: does the plugin support executable
+# serialization at all? (the persistent cache needs it to write)
+ser_err = None
+ser_len = 0
+try:
+    comp = jax.jit(lambda a: (a @ a).sum()).lower(x).compile()
+    exe = comp.runtime_executable()
+    blob = exe.serialize()
+    ser_len = len(blob)
+except Exception as e:
+    ser_err = f"{type(e).__name__}: {str(e)[:200]}"
+
+entries = []
+if cache_dir and os.path.isdir(cache_dir):
+    for root, _, files in os.walk(cache_dir):
+        entries += [os.path.join(root, f) for f in files]
+logs = log_buf.getvalue()
+keep = [ln for ln in logs.splitlines()
+        if any(k in ln.lower() for k in
+               ("cache", "persist", "serializ", "not writing", "miss",
+                "hit", "error"))][:40]
+print("RESULT|" + json.dumps(dict(
+    backend=jax.default_backend(), kind=dev.device_kind, init_s=init_s,
+    big_cold_s=big_cold_s, big_warm_s=big_warm_s,
+    serialize_len=ser_len, serialize_err=ser_err,
+    cache_entries=len(entries),
+    cache_files=[os.path.basename(p) for p in entries[:8]],
+    cache_log_lines=keep)))
+"""
+
+# pallas probe: is the slow part Mosaic kernel compile (H3)? Runs the
+# repo's histogram kernel once; jax's persistent cache does not cover
+# the remote-compile pallas path, so a hit here would be terminal-side.
+CHILD_PALLAS = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["DIAG_REPO"])
+import jax, jax.numpy as jnp
+cache_dir = os.environ.get("DIAG_CACHE_DIR", "")
+if cache_dir:
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+t0 = time.time(); dev = jax.devices()[0]; init_s = round(time.time()-t0, 1)
+from transmogrifai_tpu.ops import pallas_hist
+out = dict(backend=jax.default_backend(), init_s=init_s,
+           pallas=pallas_hist.available())
+if pallas_hist.available():
+    N, F, B, S, C = 1_000_000, 64, 33, 32, 3
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    Xb_t = jax.random.randint(ks[0], (F, N), 0, B).astype(jnp.int8)
+    pay = jax.random.normal(ks[1], (C, N), jnp.float32)
+    slot = jax.random.randint(ks[2], (1, N), 0, S).astype(jnp.float32)
+    jax.block_until_ready(Xb_t)
+    t0 = time.time()
+    h = pallas_hist.hist_pallas(Xb_t, pay, slot, n_slots=S, n_bins=B)
+    jax.block_until_ready(h)
+    out["pallas_cold_s"] = round(time.time() - t0, 2)
+    t0 = time.time()
+    h = pallas_hist.hist_pallas(Xb_t, pay, slot, n_slots=S, n_bins=B)
+    jax.block_until_ready(h)
+    out["pallas_warm_s"] = round(time.time() - t0, 3)
+print("RESULT|" + json.dumps(out))
+"""
+
+
+def run_child(body, extra_env, timeout=420):
+    env = dict(os.environ)
+    env.update(extra_env)
+    env["DIAG_REPO"] = REPO
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, "-c", body],
+                           capture_output=True, text=True,
+                           timeout=timeout, env=env, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"TIMEOUT {timeout}s",
+                "s": timeout}
+    for line in (r.stdout or "").splitlines():
+        if line.startswith("RESULT|"):
+            d = json.loads(line[7:])
+            d["ok"] = True
+            d["s"] = round(time.time() - t0, 1)
+            return d
+    return {"ok": False, "s": round(time.time() - t0, 1),
+            "error": (r.stderr or "").strip()[-400:]}
+
+
+def main():
+    shutil.rmtree(CACHE, ignore_errors=True)
+    os.makedirs(CACHE, exist_ok=True)
+    report = {"ts": time.time()}
+
+    report["A_cold_with_cache"] = run_child(
+        CHILD, {"DIAG_CACHE_DIR": CACHE})
+    report["B_second_process_same_cache"] = run_child(
+        CHILD, {"DIAG_CACHE_DIR": CACHE})
+    report["C_second_program_no_cache"] = run_child(
+        CHILD, {"DIAG_CACHE_DIR": ""})
+    report["P1_pallas_cold"] = run_child(
+        CHILD_PALLAS, {"DIAG_CACHE_DIR": CACHE}, timeout=600)
+    report["P2_pallas_second_process"] = run_child(
+        CHILD_PALLAS, {"DIAG_CACHE_DIR": CACHE}, timeout=600)
+
+    a, b, c = (report["A_cold_with_cache"],
+               report["B_second_process_same_cache"],
+               report["C_second_program_no_cache"])
+    verdict = []
+    if a.get("ok"):
+        if a.get("cache_entries", 0) == 0:
+            verdict.append(
+                "H1: cache never writes on this backend "
+                f"(serialize_err={a.get('serialize_err')})")
+        elif b.get("ok") and b["big_cold_s"] > 0.5 * a["big_cold_s"]:
+            verdict.append(
+                "H2: cache writes but cross-process hit fails "
+                f"(A {a['big_cold_s']}s -> B {b['big_cold_s']}s)")
+        elif b.get("ok"):
+            verdict.append(
+                f"cache WORKS: A {a['big_cold_s']}s -> B {b['big_cold_s']}s"
+                "; the 150s must be pallas/Mosaic or program count (H3)")
+    if c.get("ok") and a.get("ok") and c["big_cold_s"] < 0.5 * a["big_cold_s"]:
+        verdict.append("terminal-side compile cache exists "
+                       f"(no-cache second process {c['big_cold_s']}s)")
+    p1, p2 = report["P1_pallas_cold"], report["P2_pallas_second_process"]
+    if p1.get("ok") and p2.get("ok") and "pallas_cold_s" in p1:
+        verdict.append(
+            f"pallas cold {p1['pallas_cold_s']}s -> second process "
+            f"{p2.get('pallas_cold_s')}s")
+    report["verdict"] = verdict
+    out = os.path.join(HERE, "cache_diag_result.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()

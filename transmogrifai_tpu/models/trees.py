@@ -249,13 +249,18 @@ class _TreeEstimator(PredictorEstimator):
         (family hook — the GBT/XGB boosters implement it)."""
         return None
 
-    def _fused_route_ok(self, ctx, y):
+    def _fused_route_ok(self, ctx, y, masks=None, depth=None):
         """Shared gate for the fold-fused booster path: live pallas on a
         single-device TPU above the fold-vmap row limit. Mesh-sharded
         contexts keep the per-fold path (pallas_call does not run under
-        GSPMD sharding here; the mesh story is the XLA matmul kernels)."""
+        GSPMD sharding here; the mesh story is the XLA matmul kernels).
+        When the caller supplies the sweep shape (masks + tree depth),
+        the fused kernel's VMEM footprint is checked too — its output
+        block scales with folds x slots x F x bins, and an over-budget
+        shape is a Mosaic compile failure, so those fall back to the
+        sequential per-fold path."""
         from ..ops import pallas_hist
-        Xb = ctx[0]
+        Xb, _, n_bins = ctx
         if (jax.default_backend() != "tpu"
                 or not pallas_hist.available()
                 or y.shape[0] <= self._VMAP_FOLD_MAX_ROWS):
@@ -265,6 +270,11 @@ class _TreeEstimator(PredictorEstimator):
                 return False
         except AttributeError:
             pass
+        if masks is not None and depth is not None:
+            # fit_gbt_folds histograms with B = n_bins + 1 slots per bin axis
+            if not pallas_hist.fused_hist_fits(
+                    Xb.shape[1], n_bins + 1, masks.shape[0], depth):
+                return False
         return True
 
     def _mask_score(self, ctx, y, w, n_classes, multiclass):
@@ -583,12 +593,13 @@ class _GBTBase(_TreeEstimator):
         return base + T.predict_forest_bins(trees, Xb, kw["depth"])[:, 0]
 
     def _mask_scores_fused(self, ctx, y, w, masks, n_classes, multiclass):
-        if not self._fused_route_ok(ctx, y):
+        kw = self._gbt_kw()
+        if not self._fused_route_ok(ctx, y, masks, kw["depth"]):
             return None
         Xb, edges, n_bins = ctx
         _, _, margins = T.fit_gbt_folds(
             Xb, y, masks * w[None, :], self._key(), n_bins=n_bins,
-            loss=self._loss, **self._gbt_kw())
+            loss=self._loss, **kw)
         return margins
 
     def _mask_score_host(self, ctx, y, w, n_classes, multiclass):
@@ -695,13 +706,14 @@ class _XGBBase(_TreeEstimator):
     def _mask_scores_fused(self, ctx, y, w, masks, n_classes, multiclass):
         if multiclass and not self._regression:
             return None   # softmax boosting keeps the per-fold path
-        if not self._fused_route_ok(ctx, y):
+        kw = self._common()
+        if not self._fused_route_ok(ctx, y, masks, kw["depth"]):
             return None
         Xb, edges, n_bins = ctx
         _, _, margins = T.fit_gbt_folds(
             Xb, y, masks * w[None, :], self._key(), n_bins=n_bins,
             loss="squared" if self._regression else "logistic",
-            **self._common())
+            **kw)
         return margins
 
     def _mask_score(self, ctx, y, w, n_classes, multiclass):

@@ -70,9 +70,13 @@ def _row_block(d: int) -> int:
         c //= 2
     return c
 
-# Widest matrix the single compressed-triangle pass handles: past this the
-# [c, d(d+1)/2] pair-product block outgrows HBM and the kernel switches to
-# the feature-tiled accumulation (same math, tile-pair granularity).
+# Widest matrix the single-pass (narrow) route handles. The narrow path
+# is the full symmetric per-lane Gram einsum 'cl,cd,ce->lde' — 2x the
+# arithmetic of the old compressed-triangle pair-product form but 3.3x
+# the throughput on v5e (the triangle's column gather xf[:, iu0] was the
+# wall; tools/tpu_glm_hess_ab.py). Past this width the [c, d, d] blocks
+# outgrow the transient budget and the kernel switches to the
+# feature-tiled accumulation (same math, tile-pair granularity).
 TRI_MAX_D = 128
 
 # Feature-tile edge for the wide path: each scan step materializes one

@@ -36,22 +36,28 @@ import numpy as np
 _BLK = 4096
 
 
-def _tile_budget() -> int:
-    """VMEM budget for the [cols, blk] f32 one-hot tile, by device
-    generation. v5e+ carries 128MB of VMEM per core, so a 16MB tile (plus
-    the accumulator and payload tiles, all much smaller) clears the
-    compiler's headroom while cutting the grid-step count 4x vs the old
-    4MB budget — at 10M rows the per-step loop overhead and the skinny
-    [S*C, 256] matmuls were the tree sweep's real wall (8.5s warm fit,
-    BENCH_NOTES r3). Older generations (v2-v4: 16-32MB VMEM) keep the
-    conservative 4MB budget that is known to compile there."""
+def _is_v5_plus() -> bool:
+    """Device-generation probe shared by every VMEM budget: v5e+ carries
+    128MB of VMEM per core, older generations 16-32MB. False on a
+    backend that cannot report a device (budgets then stay at the
+    conservative older-generation values)."""
     try:
         kind = jax.devices()[0].device_kind.lower()
     except Exception:
-        return 4 << 20
-    if any(s in kind for s in ("v5", "v6", "v7")):
-        return 24 << 20
-    return 4 << 20
+        return False
+    return any(s in kind for s in ("v5", "v6", "v7"))
+
+
+def _tile_budget() -> int:
+    """VMEM budget for the [cols, blk] f32 one-hot tile, by device
+    generation. On v5e+ a 16MB tile (plus the accumulator and payload
+    tiles, all much smaller) clears the compiler's headroom while
+    cutting the grid-step count 4x vs the old 4MB budget — at 10M rows
+    the per-step loop overhead and the skinny [S*C, 256] matmuls were
+    the tree sweep's real wall (8.5s warm fit, BENCH_NOTES r3). Older
+    generations keep the conservative 4MB budget known to compile
+    there."""
+    return (24 << 20) if _is_v5_plus() else (4 << 20)
 
 
 def block_rows(n_onehot_cols: int) -> int:
@@ -63,6 +69,42 @@ def block_rows(n_onehot_cols: int) -> int:
     while blk > 128 and n_onehot_cols * blk * 4 > budget:
         blk //= 2
     return blk
+
+
+def _vmem_limit() -> int:
+    """Usable VMEM per core, with compiler headroom held back (100 of
+    128MB on v5e+, 12 of 16MB older). The limit gates kernel forms
+    whose residents scale with problem shape (the fused fold
+    histogram's output block) — exceeding it is a Mosaic compile
+    error, not a slowdown."""
+    return (100 << 20) if _is_v5_plus() else (12 << 20)
+
+
+def fused_hist_fits(n_feat: int, n_bins: int, n_folds: int, depth: int,
+                    channels: int = 3) -> bool:
+    """Will the fold-fused histogram kernel's VMEM residents fit?
+
+    The fused output block [n_folds * n_slots * channels, F * B] f32 is
+    fully VMEM-resident and scales with every one of those factors;
+    block_rows only budgets the one-hot tile, so XGB-shaped configs
+    (256 bins, depth 6, a few hundred features, 3-5 folds) would sail
+    past a Mosaic compile failure with no library-level fallback. Worst
+    level is the deepest histogram pass: sibling subtraction halves the
+    slot count, so n_slots = 2^(depth-2) for depth >= 2. Residents:
+    output block + the [F*B, blk] f32 one-hot tile (+ a bf16 copy when
+    the bf16 input mode is on) + the f32 Xb/payload/slot tiles.
+    Callers (models/trees._fused_route_ok) fall back to the sequential
+    per-fold path when this returns False.
+    """
+    cols = n_feat * n_bins
+    n_slots = 1 << max(depth - 2, 0)
+    out_b = n_folds * n_slots * channels * cols * 4
+    blk = block_rows(cols)
+    onehot_b = cols * blk * 4
+    if _HIST_BF16:
+        onehot_b += cols * blk * 2
+    minor_b = (n_feat + n_folds * channels + n_folds) * blk * 8
+    return out_b + onehot_b + minor_b <= _vmem_limit()
 
 
 # THE pallas kill switch — single flag for every consumer (tree

@@ -928,8 +928,15 @@ def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
         # logistic clamp would otherwise leave them at EPS)
         h = h * valid[None, :]
         if subsample < 1.0:
-            rw = (jax.random.uniform(ks, (N,)) < subsample
-                  ).astype(jnp.float32)[None, :]
+            # draw over the UNPADDED row count so the mask matches
+            # fit_gbt's uniform(ks, (n,)) unconditionally: under the
+            # default jax_threefry_partitionable mode padded draws are
+            # prefix-stable (bits are per-index), but with the flag off
+            # bits depend on array size and a padded draw would break
+            # the exact-parity contract above
+            rw = (jax.random.uniform(ks, (n_orig,)) < subsample
+                  ).astype(jnp.float32)
+            rw = jnp.pad(rw, (0, N - n_orig))[None, :]
             g, h = g * rw, h * rw
         # count semantics follow grow_tree's count_unit = (H > 0) on the
         # POST-subsample hessian: the logistic clamp keeps excluded (W=0)
