@@ -152,6 +152,7 @@ ABSTRACT = {
 FIT_PRODUCTS = {
     "BinaryVectorizerModel": "BinaryVectorizer",
     "DateListVectorizerModel": "DateListVectorizer",
+    "DateMapUnitCircleModel": "DateMapUnitCircleVectorizer",
     "DateVectorizerModel": "DateVectorizer",
     "DecisionTreeNumericBucketizerModel": "DecisionTreeNumericBucketizer",
     "DecisionTreeNumericMapBucketizerModel": "DecisionTreeNumericMapBucketizer",
@@ -437,3 +438,151 @@ def test_fit_products_are_produced(model_name):
     assert isinstance(model, reg[model_name]), (
         f"fitting {est_name} produced {type(model).__name__}, "
         f"FIT_PRODUCTS claims {model_name}")
+
+
+# ---------------------------------------------------------------------------
+# edge-input laws (round 5): the reference's ~60 per-stage suites probe
+# null/empty/zero-row fixtures and wrong-type wiring per stage; here those
+# probes run registry-wide so no stage can opt out.
+# ---------------------------------------------------------------------------
+
+def _with_vector_metadata(ds, specs):
+    """Attach synthetic per-column metadata to OPVector inputs — in real
+    flows derived vectors always carry provenance, and the metadata laws
+    below check stages propagate (or mint) it."""
+    from transmogrifai_tpu.data.dataset import Column
+    from transmogrifai_tpu.data.vector import (VectorColumnMetadata,
+                                               VectorMetadata)
+    for nm, tcls, _ in specs:
+        col = ds.column(nm)
+        if col.kind != T.ColumnKind.VECTOR:
+            continue
+        width = np.asarray(col.data).shape[1]
+        md = VectorMetadata(name=nm, columns=[
+            VectorColumnMetadata(parent_feature_name=nm,
+                                 parent_feature_type="OPVector",
+                                 descriptor_value=f"c{i}")
+            for i in range(width)])
+        ds = ds.with_column(nm, Column(kind=col.kind, data=col.data,
+                                       metadata=md))
+    return ds
+
+
+def _fit_if_needed(stage, ds):
+    return stage.fit(ds) if isinstance(stage, Estimator) else stage
+
+
+@pytest.mark.parametrize("name", sorted(CONCRETE))
+def test_stage_zero_row_transform(name):
+    """Scoring an empty batch is defined for every stage: fit on data,
+    transform a zero-row slice -> zero-row output, no crash (the
+    reference's streaming scorer feeds empty micro-batches)."""
+    stage, ds, feats, rows = build_stage_fixture(name, CONCRETE[name])
+    model = _fit_if_needed(stage, ds)
+    ds0 = ds.take(np.array([], dtype=np.int64))
+    out = model.transform(ds0)
+    assert len(out.column(model.output_name())) == 0, \
+        f"{name}: zero-row transform produced rows"
+
+
+@pytest.mark.parametrize("name", sorted(CONCRETE))
+def test_stage_all_null_inputs(name):
+    """A fitted stage scores all-null records: nullable non-vector inputs
+    go None everywhere (vectors are derived, never null in serving), and
+    the row-level path agrees with the columnar path on those rows."""
+    cls = CONCRETE[name]
+    stage, ds, feats, rows = build_stage_fixture(name, cls)
+    model = _fit_if_needed(stage, ds)
+    specs = _input_specs(cls)
+    null_specs, null_rows_src = [], {}
+    label_ix = None
+    for i, (nm, tcls, as_label) in enumerate(specs):
+        col = ds.column(nm)
+        if (tcls.is_non_nullable or as_label
+                or col.kind == T.ColumnKind.VECTOR):
+            vals = [rows[j][nm] for j in range(N_ROWS)]
+        else:
+            vals = [None] * N_ROWS
+        null_specs.append((nm, tcls, vals))
+        null_rows_src[nm] = vals
+        if as_label:
+            label_ix = i
+    nds, _ = TestFeatureBuilder.build(*null_specs, response_index=label_ix)
+    nds = _with_vector_metadata(nds, specs)
+    out = model.transform(nds)
+    out_col = out.column(model.output_name())
+    assert len(out_col) == N_ROWS, f"{name}: all-null transform lost rows"
+    base_name = type(model).__name__
+    if base_name in NO_ROW_PARITY or base_name in LOOSE_PARITY:
+        return
+    null_rows = [{nm: null_rows_src[nm][i] for nm, _, _ in specs}
+                 for i in range(N_ROWS)]
+    is_pred_block = (
+        out_col.kind == T.ColumnKind.VECTOR and out_col.metadata is not None
+        and out_col.metadata.columns
+        and out_col.metadata.columns[0].descriptor_value == "prediction")
+    if is_pred_block:
+        from transmogrifai_tpu.models.prediction import row_prediction
+    bad = []
+    for i in range(0, min(N_ROWS, 12), 3):
+        rv = model.transform_keyvalue(dict(null_rows[i]))
+        cv = (row_prediction(out_col, i).value if is_pred_block
+              else _column_value(out_col, i))
+        if not _values_close(rv, cv, 1e-5):
+            bad.append((i, rv, cv))
+    assert not bad, (f"{name}: null-row keyvalue != columnar at rows "
+                     f"{[b[0] for b in bad]}; first: row={bad[0][1]!r} "
+                     f"col={bad[0][2]!r}")
+
+
+@pytest.mark.parametrize("name", sorted(CONCRETE))
+def test_vector_output_metadata(name):
+    """Vector outputs carry column metadata when provenance is available:
+    inputs arrive with metadata attached (as in real flows), so a vector
+    output with metadata=None would break ModelInsights/SanityChecker
+    lineage (reference OpVectorMetadata contract)."""
+    cls = CONCRETE[name]
+    stage, ds, feats, rows = build_stage_fixture(name, cls)
+    specs = _input_specs(cls)
+    ds = _with_vector_metadata(ds, specs)
+    model = _fit_if_needed(stage, ds)
+    out_col = model.transform(ds).column(model.output_name())
+    if out_col.kind != T.ColumnKind.VECTOR:
+        pytest.skip("non-vector output")
+    width = np.asarray(out_col.data).shape[1]
+    assert out_col.metadata is not None, \
+        f"{name}: vector output lost provenance metadata"
+    assert len(out_col.metadata.columns) == width, (
+        f"{name}: metadata has {len(out_col.metadata.columns)} columns "
+        f"for a width-{width} vector")
+
+
+def _wrong_type_for(tcls):
+    """A FeatureType that must be rejected for an input declared `tcls`
+    (None when the declaration accepts everything)."""
+    for wrong in (T.Geolocation, T.Binary, T.TextList):
+        if not issubclass(wrong, tcls) and not issubclass(tcls, wrong):
+            return wrong
+    return None
+
+
+@pytest.mark.parametrize("name", sorted(CONCRETE))
+def test_stage_rejects_wrong_input_type(name):
+    """set_input type-checks its wiring (OpPipelineStageSpec law: typed
+    stages reject features of the wrong FeatureType)."""
+    cls = CONCRETE[name]
+    declared = list(getattr(cls, "input_types", ()) or ())
+    if getattr(cls, "is_sequence", False):
+        declared = declared[:cls.fixed_arity + 1]
+    declared = [t or T.FeatureType for t in declared]
+    wrongs = [_wrong_type_for(t) for t in declared]
+    if not declared or all(w is None for w in wrongs):
+        pytest.skip("stage accepts every FeatureType by declaration")
+    rng = np.random.default_rng(RNG_SEED)
+    build_specs = []
+    for i, (t, w) in enumerate(zip(declared, wrongs)):
+        use = w or t
+        build_specs.append((f"w{i}", use, raw_values(use, 8, rng)))
+    ds, feats = TestFeatureBuilder.build(*build_specs)
+    with pytest.raises((TypeError, ValueError)):
+        cls().set_input(*feats)

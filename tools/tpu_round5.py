@@ -126,70 +126,68 @@ def parse_ab(out):
 
 
 def agenda(bf16_env):
-    """(name, argv, timeout, env, parser, critical) in run order."""
+    """(name, argv, timeout, env, parser) in run order."""
     py = sys.executable
     items = [
         ("cache_diag", [py, os.path.join(HERE, "tpu_cache_diag.py")],
-         2400, {}, parse_last_json, False),
+         2400, {}, parse_last_json),
         ("bf16_ab", [py, os.path.join(HERE, "tpu_bf16_quality_ab.py")],
-         2100, {}, parse_ab, False),
+         2100, {}, parse_ab),
         ("bench", [py, os.path.join(REPO, "bench.py")], 2700,
          dict(bf16_env, BENCH_BUDGET_S="2400",
               BENCH_PARTIAL_PATH=os.path.join(HERE,
                                               "bench_r5_partial.json")),
-         parse_last_json, True),
+         parse_last_json),
     ]
     for name, script in (("scoring", "tpu_scoring_profile.py"),
                          ("roofline", "tpu_roofline.py")):
         path = os.path.join(HERE, script)
         if os.path.isfile(path):
             items.append((name, [py, path], 1500, dict(bf16_env),
-                          parse_last_json, False))
+                          parse_last_json))
     return items
 
 
 def main():
-    bf16_env: dict = {}
+    # One stage per pass: the agenda (and every stage's env) is rebuilt
+    # from the log + the persisted bf16 decision before each run, so a
+    # bf16 flip decided by stage N always reaches stage N+1, and a
+    # tunnel drop between stages re-enters the wait loop naturally.
+    ab_path = os.path.join(HERE, "bf16_ab_result.json")
     while time.time() - T0 < TOTAL_WATCH_S:
         ok, attempts = done_stages()
-        # recover a prior bf16 decision across watcher restarts
-        ab_path = os.path.join(HERE, "bf16_ab_result.json")
-        if os.path.isfile(ab_path) and not bf16_env:
+        bf16_env: dict = {}
+        if os.path.isfile(ab_path):
             try:
                 with open(ab_path) as f:
-                    prior = json.load(f)
-                if not prior.get("keep_bf16_default", True):
-                    bf16_env = {"TMOG_HIST_BF16": "0"}
+                    if not json.load(f).get("keep_bf16_default", True):
+                        bf16_env = {"TMOG_HIST_BF16": "0"}
             except ValueError:
                 pass
-        pending = [it for it in agenda(bf16_env)
-                   if it[0] not in ok and attempts.get(it[0], 0) < 3]
-        if not pending:
-            log_line({"stage": "watch", "ok": True,
-                      "detail": "agenda complete"})
+        items = agenda(bf16_env)
+        runnable = [it for it in items
+                    if it[0] not in ok and attempts.get(it[0], 0) < 3]
+        exhausted = [it[0] for it in items
+                     if it[0] not in ok and attempts.get(it[0], 0) >= 3]
+        if not runnable:
+            if exhausted:
+                log_line({"stage": "watch", "ok": False,
+                          "error": f"attempts exhausted: {exhausted}"})
+            else:
+                log_line({"stage": "watch", "ok": True,
+                          "detail": "agenda complete"})
             return
         if not tunnel_up():
             time.sleep(60)
             continue
-        log_line({"stage": "wait", "ok": True,
-                  "s": round(time.time() - T0, 1)})
-        for name, argv, timeout_s, env_extra, parser, critical in pending:
-            detail = run_stage(name, argv, timeout_s, env_extra, parser)
-            if name == "bf16_ab" and detail is not None:
-                with open(ab_path, "w") as f:
-                    json.dump(detail, f)
-                if not detail["keep_bf16_default"]:
-                    bf16_env = {"TMOG_HIST_BF16": "0"}
-            if name == "bench" and detail is not None:
-                with open(os.path.join(REPO, "BENCH_TPU_R5.json"),
-                          "w") as f:
-                    json.dump(detail, f, indent=1)
-            # a dead tunnel fails everything downstream; recheck between
-            # stages so failures are attributed to the tunnel, not code
-            if detail is None and not tunnel_up():
-                log_line({"stage": "watch", "ok": False,
-                          "error": "tunnel dropped mid-agenda; rewaiting"})
-                break
+        name, argv, timeout_s, env_extra, parser = runnable[0]
+        detail = run_stage(name, argv, timeout_s, env_extra, parser)
+        if name == "bf16_ab" and detail is not None:
+            with open(ab_path, "w") as f:
+                json.dump(detail, f)
+        if name == "bench" and detail is not None:
+            with open(os.path.join(REPO, "BENCH_TPU_R5.json"), "w") as f:
+                json.dump(detail, f, indent=1)
     log_line({"stage": "watch", "ok": False, "error": "watch window over"})
 
 

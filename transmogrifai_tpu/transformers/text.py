@@ -375,6 +375,27 @@ class OpCountVectorizerModel(Transformer):
     def transform_value(self, *vals):
         return OPVector(self._encode(vals[0].value))
 
+    def transform_columns(self, *cols: Column) -> Column:
+        # columnar path: one pass over the token lists + vocab metadata
+        # (reference CountVectorizer publishes its vocabulary as vector
+        # metadata; ModelInsights reads term provenance from it)
+        X = np.stack([self._encode(toks) for toks in cols[0].data]) \
+            if len(cols[0]) else np.zeros((0, len(self.vocab)), np.float32)
+        return Column(kind=ColumnKind.VECTOR, data=X,
+                      metadata=self.output_metadata())
+
+    def output_metadata(self) -> Optional["VectorMetadata"]:
+        from ..data.vector import VectorColumnMetadata, VectorMetadata
+        parent = (self.input_features[0].name
+                  if self.input_features else "text")
+        ptype = (self.input_features[0].type_name
+                 if self.input_features else "TextList")
+        return VectorMetadata(
+            name=self.output_name(),
+            columns=[VectorColumnMetadata(
+                parent_feature_name=parent, parent_feature_type=ptype,
+                indicator_value=term) for term in self.vocab])
+
     def save_args(self) -> Dict[str, Any]:
         d = super().save_args()
         d.update(vocab=self.vocab, binary=self.binary,
