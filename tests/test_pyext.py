@@ -1,0 +1,170 @@
+"""Parity: the _tmog_pyext C loops vs their pure-Python fallbacks.
+
+Every pyext entry point must produce byte-identical results to the numpy/
+python path it accelerates (the fallback stays live for builds without a
+compiler), so each case computes both and compares. Reference anchor for
+the semantics under test: the fused row-map transforms of
+core/.../utils/stages/FitStagesUtil.scala:96 (one-hot codes, map key
+explosion, float coercion) — here exercised at the encoding layer.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.ops import pyext_bridge as px
+
+
+pytestmark = pytest.mark.skipif(px.module() is None,
+                                reason="C extension unavailable")
+
+
+MIXED = ["a", None, "b", "a", 1, 1.0, True, float("nan"), "ω", "", "b"]
+
+
+def test_pack_strings_matches_manual_encoding():
+    buf, off = px.pack_strings(MIXED)
+    strs = ["" if v is None else (v if type(v) is str else str(v))
+            for v in MIXED]
+    enc = [s.encode("utf-8", errors="surrogatepass") for s in strs]
+    joined = b"".join(enc)
+    assert bytes(buf[:len(joined)]) == joined
+    lens = np.diff(off)
+    assert lens.tolist() == [len(b) for b in enc]
+
+
+def test_pack_strings_surrogates():
+    s = "x\udcff y"  # surrogateescape leftover must pack, not crash
+    buf, off = px.pack_strings([s])
+    assert bytes(buf[:off[1]]) == s.encode("utf-8", errors="surrogatepass")
+
+
+def test_dict_encode_first_occurrence_order():
+    codes, uniques = px.dict_encode(MIXED)
+    # python reference: same stringification, first-occurrence order
+    seen = {}
+    ref_codes = []
+    for v in MIXED:
+        s = "" if v is None else (v if type(v) is str else str(v))
+        ref_codes.append(seen.setdefault(s, len(seen)))
+    assert codes.tolist() == ref_codes
+    assert uniques == list(dict.fromkeys(
+        "" if v is None else (v if type(v) is str else str(v))
+        for v in MIXED))
+
+
+def test_pivot_codes_matches_python_semantics():
+    from transmogrifai_tpu.automl.vectorizers.encoding import (
+        pivot_block_single,
+    )
+    vocab = ["a", "b", "1.0"]
+    clean = str.lower
+
+    data = ["A", "b", None, float("nan"), "A", 1.0, 1, True, {}, "zz"]
+    # C path (through pivot_block_single's fast route)
+    got = pivot_block_single(data, vocab, True, clean)
+    # forced python path
+    import transmogrifai_tpu.ops.pyext_bridge as bridge
+    orig = bridge.pivot_codes
+    bridge.pivot_codes = lambda *a, **k: None
+    try:
+        want = pivot_block_single(data, vocab, True, clean)
+    finally:
+        bridge.pivot_codes = orig
+    np.testing.assert_array_equal(got, want)
+
+
+def test_extract_key_columns_parity_both_clean_modes():
+    from transmogrifai_tpu.automl.vectorizers import encoding
+
+    rows = [{"k0": 1.5, "K0": 9.0, "other": 2}, None, {}, {"k1": "x"},
+            {"k0": None, "k1": 3}]
+    keys = ["k0", "k1"]
+    for clean_fn in (None, str.lower):
+        got = px.extract_key_columns(rows, keys, clean_fn)
+        import transmogrifai_tpu.ops.pyext_bridge as bridge
+        orig = bridge.extract_key_columns
+        bridge.extract_key_columns = lambda *a, **k: None
+        try:
+            want = encoding.extract_key_columns(rows, keys, clean_fn)
+        finally:
+            bridge.extract_key_columns = orig
+        assert got == want
+
+
+def test_float_column_parity_incl_numeric_strings():
+    vals = [1, None, 2.5, True, "3.5", np.float64(7)]
+    got = px.float_column(vals, -9.0)
+    want = np.fromiter(
+        (-9.0 if v is None else float(v) for v in vals), np.float64,
+        len(vals))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_float_column_bad_string_raises():
+    with pytest.raises((TypeError, ValueError)):
+        px.float_column(["not-a-number"], 0.0)
+
+
+def test_masks_and_ascii():
+    data = ["", None, "x", [], [1], 0, 1]
+    np.testing.assert_array_equal(
+        px.null_mask(data), [v is None for v in data])
+    np.testing.assert_array_equal(
+        px.empty_mask(data), [not v for v in data])
+    assert px.all_ascii(["abc", None, "x y"]) is True
+    assert px.all_ascii(["abc", "ω"]) is False
+    assert px.all_ascii([1]) is False  # non-str: python path decides
+
+
+def test_sink_fusion_score_matches_blockwise_concat():
+    """model.score's sink-fused matrix == concat of per-stage blocks."""
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    from transmogrifai_tpu.types import PickList, Real, Text
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    rng = np.random.default_rng(3)
+    n = 400
+    rows = {
+        "pl": [None if i % 7 == 0 else f"c{i % 9}" for i in range(n)],
+        "tx": [None if i % 5 == 0 else
+               f"w{rng.integers(0, 200)} w{rng.integers(0, 200)}"
+               for i in range(n)],
+        "r": [None if i % 11 == 0 else float(rng.normal())
+              for i in range(n)],
+    }
+    ds = Dataset.from_features([
+        ("pl", PickList, rows["pl"]),
+        ("tx", Text, rows["tx"]),
+        ("r", Real, rows["r"]),
+    ])
+    feats = [
+        FeatureBuilder.PickList("pl").extract(
+            lambda r: r.get("pl")).as_predictor(),
+        FeatureBuilder.Text("tx").extract(
+            lambda r: r.get("tx")).as_predictor(),
+        FeatureBuilder.Real("r").extract(
+            lambda r: r.get("r")).as_predictor(),
+    ]
+    vec = transmogrify(feats)
+    model = Workflow().set_input_dataset(ds).set_result_features(vec).train()
+    scored = model.score(ds).column(vec.name)
+
+    # independent reassembly: every fitted vectorizer's transform_columns
+    # (the unfused path), concatenated in combiner input order
+    from transmogrifai_tpu.automl.vectorizers.combiner import VectorsCombiner
+    comb = next(st for st in model.stages if isinstance(st, VectorsCombiner))
+    full = model.transform(ds)
+    by_name = {st.output_name(): st for st in model.stages}
+    parts = []
+    for name in comb.input_names():
+        st = by_name[name]
+        cols = [full.column(c) for c in st.input_names()]
+        parts.append(np.asarray(st.transform_columns(*cols).data))
+    want = np.concatenate(parts, axis=1)
+    np.testing.assert_array_equal(np.asarray(scored.data), want)
+    assert scored.metadata is not None
+    assert scored.metadata.size == want.shape[1]

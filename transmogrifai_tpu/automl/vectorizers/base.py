@@ -48,6 +48,18 @@ class VectorizerModel(Transformer):
     def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
         raise NotImplementedError
 
+    def transform_block_into(self, cols: Sequence[Column],
+                             out: np.ndarray) -> None:
+        """Write this vectorizer's block into `out` (pre-zeroed, possibly a
+        strided column-slice of the final combined matrix). Serving sink
+        fusion: the DAG runner hands each producer its slice of the
+        VectorsCombiner output so wide blocks never materialize twice
+        (the fused row-map's one-pass discipline,
+        reference FitStagesUtil.scala:96-118, applied to memory traffic).
+        Default: materialize and copy; hot families override to write in
+        place."""
+        out[:] = np.asarray(self.transform_block(cols), np.float32)
+
     def transform_columns(self, *cols: Column) -> Column:
         block = self.transform_block(list(cols))
         block = np.asarray(block, dtype=np.float32)

@@ -40,15 +40,30 @@ class OneHotModel(VectorizerModel):
         self.clean_text = clean_text
         self.multiset = multiset
 
+    def _width(self, j: int) -> int:
+        return len(self.vocabs[j]) + 1 + (1 if self.track_nulls else 0)
+
     def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
-        blocks = []
+        n = len(cols[0]) if cols else 0
+        out = np.zeros((n, sum(self._width(j) for j in range(len(cols)))),
+                       np.float32)
+        self.transform_block_into(cols, out)
+        return out
+
+    def transform_block_into(self, cols: Sequence[Column],
+                             out: np.ndarray) -> None:
+        # indicator scatters land straight in the final combined matrix
         clean = self.clean_text
         pivot = pivot_block_multi if self.multiset else pivot_block_single
+        at = 0
         for j, c in enumerate(cols):
-            blocks.append(pivot(
-                c.data, self.vocabs[j], self.track_nulls,
-                lambda s: clean_text_value(s, clean)))
-        return np.concatenate(blocks, axis=1)
+            w = self._width(j)
+            pivot(c.data, self.vocabs[j], self.track_nulls,
+                  lambda s: clean_text_value(s, clean),
+                  out=out[:, at:at + w])
+            at += w
+        if at != out.shape[1]:  # python -O strips assert; sink fallback
+            raise AssertionError((at, out.shape))  # relies on this firing
 
     def save_args(self) -> Dict[str, Any]:
         d = super().save_args()

@@ -44,7 +44,7 @@ class VectorsCombiner(VectorizerModel):
             at += m.shape[1]
         return out
 
-    def transform_columns(self, *cols: Column) -> Column:
+    def combine_metadata(self, cols: Sequence[Column]) -> VectorMetadata:
         parts: List[VectorMetadata] = []
         for c, f in zip(cols, self.input_features):
             if c.metadata is not None:
@@ -57,5 +57,10 @@ class VectorsCombiner(VectorizerModel):
                                          parent_feature_type=f.type_name,
                                          descriptor_value=str(i))
                     for i in range(width)]))
-        self.set_metadata(VectorMetadata.concat(self.output_name(), parts))
+        md = VectorMetadata.concat(self.output_name(), parts)
+        self.set_metadata(md)
+        return md
+
+    def transform_columns(self, *cols: Column) -> Column:
+        self.combine_metadata(cols)
         return super().transform_columns(*cols)

@@ -17,14 +17,22 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ...ops import pyext_bridge as _px
+
 
 def null_mask(data: Sequence[Any]) -> np.ndarray:
     """[n] bool: value is None (missing)."""
+    out = _px.null_mask(data)
+    if out is not None:
+        return out
     return np.fromiter((v is None for v in data), np.bool_, len(data))
 
 
 def empty_mask(data: Sequence[Any]) -> np.ndarray:
     """[n] bool: value is falsy (None or empty collection/string)."""
+    out = _px.empty_mask(data)
+    if out is not None:
+        return out
     return np.fromiter((not v for v in data), np.bool_, len(data))
 
 
@@ -32,19 +40,25 @@ def factorize(data: Sequence[Any]) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """(uniques, inverse, null_mask) for a column of scalar-ish values.
 
     None becomes "" in the unique table (masked separately); non-strings
-    stringify. Fast path: one O(n) native hashed dictionary-encode pass
-    (native/hashing.cpp tmog_dict_encode); fallback: np.unique's
-    O(n log n) sort. Callers never rely on unique ORDER — codes are
-    remapped through vocab lookups — so the two paths are interchangeable.
+    stringify. Fast path: one O(n) pass in the C extension (PyDict over
+    the interpreter's cached str hashes — no stringify/pack prepass);
+    middle path: the ctypes hashed dictionary-encode over packed bytes;
+    fallback: np.unique's O(n log n) sort. Callers never rely on unique
+    ORDER — codes are remapped through vocab lookups — so the paths are
+    interchangeable.
     """
     nm = null_mask(data)
+    out = _px.dict_encode(data)
+    if out is not None:
+        codes, uniques = out
+        return (np.asarray(uniques, dtype=object), codes, nm)
     strs = ["" if v is None else (v if type(v) is str else str(v))
             for v in data]
     try:
         from ...ops.native_bridge import native_dict_encode
-        out = native_dict_encode(strs)
-        if out is not None:
-            codes, uniques = out
+        nout = native_dict_encode(strs)
+        if nout is not None:
+            codes, uniques = nout
             return (np.asarray(uniques, dtype=object), codes, nm)
     except ImportError:
         pass
@@ -65,23 +79,30 @@ def pivot_codes(uniq: np.ndarray, vocab_index: Dict[str, int], other_code: int,
 
 
 def pivot_block_single(data: Sequence[Any], vocab: Sequence[str],
-                       track_nulls: bool, clean_fn) -> np.ndarray:
+                       track_nulls: bool, clean_fn,
+                       out: Optional[np.ndarray] = None) -> np.ndarray:
     """One-hot pivot of a scalar categorical column: [n, K+1(+1)] with
     topK indicators, OTHER, and optionally a null column.
 
     Serving hot path (the fused row-map slot, FitStagesUtil.scala:96):
-    ONE python pass with a memoized raw-value -> column lookup instead of
-    the earlier stringify + null-scan + dictionary-encode passes —
-    categorical cardinality is tiny next to n, so every row after the
-    first sighting of a value is a single dict hit."""
+    one C pass (pyext pivot_codes, memoized raw-value -> column) plus a
+    fancy-index scatter — categorical cardinality is tiny next to n, so
+    every row after the first sighting of a value is a single dict hit.
+    `out` (pre-zeroed, may be a strided view of the combined matrix)
+    receives the block in place — the serving sink-fusion path."""
     n = len(data)
     k = len(vocab)
     width = k + 1 + (1 if track_nulls else 0)
-    block = np.zeros((n, width), dtype=np.float32)
+    block = np.zeros((n, width), dtype=np.float32) if out is None else out
     if n == 0:
         return block
     index = {v: i for i, v in enumerate(vocab)}
     null_code = k + 1 if track_nulls else -1
+    codes = _px.pivot_codes(data, index, k, null_code, clean_fn)
+    if codes is not None:
+        keep = codes >= 0
+        block[np.arange(n)[keep], codes[keep]] = 1.0
+        return block
     memo: Dict[Any, int] = {}
 
     def code_of(v):
@@ -112,13 +133,16 @@ def pivot_block_single(data: Sequence[Any], vocab: Sequence[str],
 
 
 def pivot_block_multi(data: Sequence[Any], vocab: Sequence[str],
-                      track_nulls: bool, clean_fn) -> np.ndarray:
+                      track_nulls: bool, clean_fn,
+                      out: Optional[np.ndarray] = None) -> np.ndarray:
     """Pivot of a multi-valued (set/list) categorical column. Rows with
-    multiple items set multiple indicators; empty rows hit the null col."""
+    multiple items set multiple indicators; empty rows hit the null col.
+    `out`: pre-zeroed in-place destination (sink fusion), like
+    pivot_block_single."""
     n = len(data)
     k = len(vocab)
     width = k + 1 + (1 if track_nulls else 0)
-    block = np.zeros((n, width), dtype=np.float32)
+    block = np.zeros((n, width), dtype=np.float32) if out is None else out
     if n == 0:
         return block
     lengths = np.fromiter((len(v) if v else 0 for v in data), np.int64, n)
@@ -176,6 +200,9 @@ def category_counts(data: Sequence[Any], clean_fn,
 
 def float_column(vals: Sequence[Any], fill: float) -> np.ndarray:
     """[n] float64 with None -> fill. One C-speed pass."""
+    out = _px.float_column(vals, fill)
+    if out is not None:
+        return out
     return np.fromiter(
         (fill if v is None else float(v) for v in vals),
         np.float64, len(vals))
@@ -200,6 +227,9 @@ def extract_key_columns(data: Sequence[Any], keys: Sequence[str],
     normalizes raw keys before matching (None = exact match).
     """
     n = len(data)
+    out = _px.extract_key_columns(data, keys, clean_fn)
+    if out is not None:
+        return out
     cols: Dict[str, List[Any]] = {k: [None] * n for k in keys}
     if clean_fn is None:
         for i, m in enumerate(data):

@@ -36,32 +36,42 @@ def tokenize(s: Optional[str], to_lowercase: bool = True,
 
 
 def tokenize_hash_counts(docs: Sequence[Optional[str]], bins: int,
-                         seed: int = 0, pad_cols: int = 0) -> np.ndarray:
+                         seed: int = 0, pad_cols: int = 0,
+                         out: Optional[np.ndarray] = None) -> np.ndarray:
     """Documents -> [n, bins + pad_cols] hashed token counts: the whole
     text->tensor loop in ONE native pass when the C++ library is built,
     else a python tokenize + (native or numpy) hashing fallback.
     `pad_cols` appends zero columns for in-place indicator writes (the
     serving path's null tracker) without a second full-matrix copy.
+    `out`: pre-zeroed in-place destination (may be a strided slice of the
+    final combined matrix — serving sink fusion).
 
     The C++ tokenizer is byte-level ASCII; it only takes over when every
     document isascii(), where it is token-for-token identical to the
     unicode python analyzer. Non-ASCII corpora keep unicode tokens."""
-    if all(d is None or d.isascii() for d in docs):
+    from ...ops import pyext_bridge as _px
+    ascii_ok = _px.all_ascii(docs)
+    if ascii_ok is None:
+        ascii_ok = all(d is None or d.isascii() for d in docs)
+    if ascii_ok:
         try:
             from ...ops.native_bridge import native_tokenize_hash_counts
-            out = native_tokenize_hash_counts(docs, bins, seed=seed,
+            res = native_tokenize_hash_counts(docs, bins, seed=seed,
                                               min_len=MIN_TOKEN_LENGTH,
-                                              pad_cols=pad_cols)
-            if out is not None:
-                return out
+                                              pad_cols=pad_cols, out=out)
+            if res is not None:
+                return res
         except ImportError:
             pass
     counts = hash_tokens_to_counts([tokenize(d) for d in docs], bins,
                                    seed=seed)
-    if pad_cols:
-        out = np.zeros((counts.shape[0], bins + pad_cols), np.float32)
+    if out is not None:
         out[:, :bins] = counts
         return out
+    if pad_cols:
+        res = np.zeros((counts.shape[0], bins + pad_cols), np.float32)
+        res[:, :bins] = counts
+        return res
     return counts
 
 
@@ -75,31 +85,50 @@ class SmartTextModel(VectorizerModel):
         #            track_nulls: bool, clean_text: bool}
         self.plans = [dict(p) for p in plans]
 
+    def _plan_width(self, plan: Dict[str, Any]) -> int:
+        extra = 1 if plan["track_nulls"] else 0
+        if plan["mode"] == "pivot":
+            return len(plan["vocab"]) + 1 + extra
+        return plan["bins"] + extra
+
+    def _plan_block(self, plan: Dict[str, Any], c: Column,
+                    out: Optional[np.ndarray]) -> Optional[np.ndarray]:
+        data = c.data
+        track = plan["track_nulls"]
+        if plan["mode"] == "pivot":
+            clean = plan["clean_text"]
+            return pivot_block_single(
+                data, plan["vocab"], track,
+                lambda s: clean_text_value(s, clean), out=out)
+        # hash: counts land directly in a [n, bins(+1)] destination (the
+        # native kernel writes with the destination's row stride — out may
+        # be a slice of the final combined matrix) and the null indicator
+        # fills the trailing column in place — no second full-matrix copy
+        # on serving
+        block = tokenize_hash_counts(data, plan["bins"],
+                                     pad_cols=1 if track else 0, out=out)
+        if track:
+            block[:, plan["bins"]] = null_mask(data)
+        return block
+
     def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
         blocks: List[np.ndarray] = []
         for plan, c in zip(self.plans, cols):
-            data = c.data
-            track = plan["track_nulls"]
-            if plan["mode"] == "pivot":
-                clean = plan["clean_text"]
-                block = pivot_block_single(
-                    data, plan["vocab"], track,
-                    lambda s: clean_text_value(s, clean))
-            else:  # hash
-                if track:
-                    # counts land directly in a [n, bins+1] matrix (the
-                    # native kernel writes with the wider row stride) and
-                    # the null indicator fills the trailing column in
-                    # place — no second full-matrix copy on serving
-                    block = tokenize_hash_counts(data, plan["bins"],
-                                                 pad_cols=1)
-                    block[:, -1] = null_mask(data)
-                else:
-                    block = tokenize_hash_counts(data, plan["bins"])
-            blocks.append(np.asarray(block, np.float32))
+            blocks.append(np.asarray(
+                self._plan_block(plan, c, None), np.float32))
         if len(blocks) == 1:
             return blocks[0]
         return np.concatenate(blocks, axis=1)
+
+    def transform_block_into(self, cols: Sequence[Column],
+                             out: np.ndarray) -> None:
+        at = 0
+        for plan, c in zip(self.plans, cols):
+            w = self._plan_width(plan)
+            self._plan_block(plan, c, out[:, at:at + w])
+            at += w
+        if at != out.shape[1]:  # python -O strips assert; sink fallback
+            raise AssertionError((at, out.shape))  # relies on this firing
 
     def save_args(self) -> Dict[str, Any]:
         d = super().save_args()

@@ -16,6 +16,17 @@ from typing import Optional
 _DIR = os.path.dirname(os.path.abspath(__file__))
 SOURCES = [os.path.join(_DIR, "hashing.cpp"), os.path.join(_DIR, "trees.cpp")]
 LIB = os.path.join(_DIR, "_tmog_native.so")
+PYEXT_SRC = os.path.join(_DIR, "pyext.cpp")
+PYEXT_LIB = os.path.join(_DIR, "_tmog_pyext.so")
+
+
+def _compile(cmd) -> bool:
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=240)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return proc.returncode == 0
 
 
 def build(force: bool = False) -> Optional[str]:
@@ -28,11 +39,29 @@ def build(force: bool = False) -> Optional[str]:
                     for s in srcs)):
         return LIB
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", LIB] + srcs
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=240)
-    except (OSError, subprocess.TimeoutExpired):
-        return None
-    if proc.returncode != 0:
+    if not _compile(cmd):
         return None
     return LIB
+
+
+def build_pyext(force: bool = False) -> Optional[str]:
+    """Build (if needed) the CPython extension module; path or None.
+
+    A real extension module (not ctypes): the per-PyObject loops need the
+    CPython API. Linked without libpython like any wheel .so — symbols
+    resolve from the host interpreter at import.
+    """
+    if not os.path.exists(PYEXT_SRC):
+        return None
+    if (not force and os.path.exists(PYEXT_LIB)
+            and os.path.getmtime(PYEXT_LIB) >= os.path.getmtime(PYEXT_SRC)):
+        return PYEXT_LIB
+    import sysconfig
+    inc = sysconfig.get_paths().get("include")
+    if not inc:
+        return None
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-I", inc,
+           "-o", PYEXT_LIB, PYEXT_SRC]
+    if not _compile(cmd):
+        return None
+    return PYEXT_LIB

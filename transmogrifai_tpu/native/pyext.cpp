@@ -1,0 +1,574 @@
+// CPython extension: C-speed per-object loops for the host transform path.
+//
+// The ctypes library (hashing.cpp) gives C-speed loops over PACKED bytes,
+// but packing itself — and every other per-PyObject pass (dictionary
+// encoding, one-hot code lookup, map-key explosion, float coercion) — was
+// a Python-interpreter loop. At serving time those passes dominate the
+// score pass (reference anchor: the fused row-map of
+// core/.../utils/stages/FitStagesUtil.scala:96-118 ran these loops as
+// compiled JVM bytecode; this module is the equivalent compiled tier).
+//
+// Contract: every function degrades — callers catch ImportError/absence
+// and keep their NumPy/pure-Python fallback. Outputs are written into
+// caller-allocated numpy arrays through the buffer protocol, so this file
+// needs no numpy headers.
+//
+// Build: g++ -O3 -shared -fPIC -I<python-include> (native/build.py).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// Writable int64/float64/uint8 view of a caller-provided numpy array.
+struct BufView {
+  Py_buffer view{};
+  bool ok = false;
+  BufView(PyObject* obj, Py_ssize_t itemsize) {
+    if (PyObject_GetBuffer(obj, &view, PyBUF_WRITABLE | PyBUF_C_CONTIGUOUS) !=
+        0) {
+      return;
+    }
+    if (view.itemsize != itemsize) {
+      PyBuffer_Release(&view);
+      PyErr_SetString(PyExc_TypeError, "output buffer itemsize mismatch");
+      return;
+    }
+    ok = true;
+  }
+  ~BufView() {
+    if (ok) PyBuffer_Release(&view);
+  }
+  Py_ssize_t n() const { return view.len / view.itemsize; }
+  void* data() const { return view.buf; }
+};
+
+// Borrowed fast-sequence items (list/tuple fast path; ndarray via listify).
+struct FastSeq {
+  PyObject* fast = nullptr;
+  PyObject** items = nullptr;
+  Py_ssize_t n = 0;
+  explicit FastSeq(PyObject* seq) {
+    fast = PySequence_Fast(seq, "expected a sequence");
+    if (!fast) return;
+    n = PySequence_Fast_GET_SIZE(fast);
+    items = PySequence_Fast_ITEMS(fast);
+  }
+  ~FastSeq() { Py_XDECREF(fast); }
+};
+
+// utf8 view of a str object; owns a temporary bytes object only when the
+// surrogatepass fallback fires (lone surrogates from surrogateescape
+// ingest must hash, not crash).
+struct Utf8 {
+  const char* p = nullptr;
+  Py_ssize_t len = 0;
+  PyObject* owned = nullptr;
+  bool from(PyObject* s) {
+    p = PyUnicode_AsUTF8AndSize(s, &len);
+    if (p) return true;
+    PyErr_Clear();
+    owned = PyUnicode_AsEncodedString(s, "utf-8", "surrogatepass");
+    if (!owned) return false;
+    p = PyBytes_AS_STRING(owned);
+    len = PyBytes_GET_SIZE(owned);
+    return true;
+  }
+  void release() {
+    Py_XDECREF(owned);
+    owned = nullptr;
+  }
+};
+
+// pack_strings(seq) -> (bytes, offsets_bytes):
+// concatenated utf8 payload + (n+1) int64 offsets, None -> "".
+PyObject* pack_strings(PyObject*, PyObject* args) {
+  PyObject* seq;
+  if (!PyArg_ParseTuple(args, "O", &seq)) return nullptr;
+  FastSeq fs(seq);
+  if (!fs.fast) return nullptr;
+  std::vector<const char*> ptrs(fs.n);
+  std::vector<Py_ssize_t> lens(fs.n);
+  std::vector<PyObject*> owned;
+  Py_ssize_t total = 0;
+  for (Py_ssize_t i = 0; i < fs.n; i++) {
+    PyObject* v = fs.items[i];
+    if (v == Py_None) {
+      ptrs[i] = "";
+      lens[i] = 0;
+      continue;
+    }
+    PyObject* s = v;
+    PyObject* tmp = nullptr;
+    if (!PyUnicode_Check(v)) {
+      tmp = PyObject_Str(v);
+      if (!tmp) {
+        for (PyObject* o : owned) Py_DECREF(o);
+        return nullptr;
+      }
+      owned.push_back(tmp);
+      s = tmp;
+    }
+    Utf8 u;
+    if (!u.from(s)) {
+      for (PyObject* o : owned) Py_DECREF(o);
+      return nullptr;
+    }
+    if (u.owned) owned.push_back(u.owned);
+    ptrs[i] = u.p;
+    lens[i] = u.len;
+    total += u.len;
+  }
+  PyObject* buf = PyBytes_FromStringAndSize(nullptr, total ? total : 1);
+  PyObject* offs = PyBytes_FromStringAndSize(
+      nullptr, (Py_ssize_t)((fs.n + 1) * sizeof(int64_t)));
+  if (!buf || !offs) {
+    Py_XDECREF(buf);
+    Py_XDECREF(offs);
+    for (PyObject* o : owned) Py_DECREF(o);
+    return nullptr;
+  }
+  char* bp = PyBytes_AS_STRING(buf);
+  auto* op = reinterpret_cast<int64_t*>(PyBytes_AS_STRING(offs));
+  int64_t at = 0;
+  op[0] = 0;
+  for (Py_ssize_t i = 0; i < fs.n; i++) {
+    if (lens[i]) std::memcpy(bp + at, ptrs[i], (size_t)lens[i]);
+    at += lens[i];
+    op[i + 1] = at;
+  }
+  if (!total) bp[0] = 0;
+  for (PyObject* o : owned) Py_DECREF(o);
+  return Py_BuildValue("NN", buf, offs);
+}
+
+// dict_encode(seq) -> (n_unique, uniques_list); codes written into the
+// int64 out array. None -> "", non-str stringified. First-occurrence
+// order. Uses the interpreter's cached str hashes — one PyDict probe per
+// row, no packing pass.
+PyObject* dict_encode(PyObject*, PyObject* args) {
+  PyObject* seq;
+  PyObject* out;
+  if (!PyArg_ParseTuple(args, "OO", &seq, &out)) return nullptr;
+  FastSeq fs(seq);
+  if (!fs.fast) return nullptr;
+  BufView ob(out, sizeof(int64_t));
+  if (!ob.ok) return nullptr;
+  if (ob.n() < fs.n) {
+    PyErr_SetString(PyExc_ValueError, "codes buffer too small");
+    return nullptr;
+  }
+  auto* codes = static_cast<int64_t*>(ob.data());
+  PyObject* table = PyDict_New();
+  PyObject* uniques = PyList_New(0);
+  if (!table || !uniques) {
+    Py_XDECREF(table);
+    Py_XDECREF(uniques);
+    return nullptr;
+  }
+  PyObject* empty = PyUnicode_FromString("");
+  if (!empty) {
+    Py_DECREF(table);
+    Py_DECREF(uniques);
+    return nullptr;
+  }
+  int64_t next = 0;
+  for (Py_ssize_t i = 0; i < fs.n; i++) {
+    PyObject* v = fs.items[i];
+    PyObject* key;
+    PyObject* tmp = nullptr;
+    if (v == Py_None) {
+      key = empty;
+    } else if (PyUnicode_Check(v)) {
+      key = v;
+    } else {
+      tmp = PyObject_Str(v);
+      if (!tmp) goto fail;
+      key = tmp;
+    }
+    {
+      PyObject* code = PyDict_GetItemWithError(table, key);
+      if (code) {
+        codes[i] = PyLong_AsLongLong(code);
+      } else {
+        if (PyErr_Occurred()) {
+          Py_XDECREF(tmp);
+          goto fail;
+        }
+        PyObject* c = PyLong_FromLongLong(next);
+        if (!c || PyDict_SetItem(table, key, c) != 0 ||
+            PyList_Append(uniques, key) != 0) {
+          Py_XDECREF(c);
+          Py_XDECREF(tmp);
+          goto fail;
+        }
+        Py_DECREF(c);
+        codes[i] = next++;
+      }
+    }
+    Py_XDECREF(tmp);
+  }
+  Py_DECREF(table);
+  Py_DECREF(empty);
+  return Py_BuildValue("LN", (long long)next, uniques);
+fail:
+  Py_DECREF(table);
+  Py_DECREF(uniques);
+  Py_XDECREF(empty);
+  return nullptr;
+}
+
+// pivot_codes(seq, index_dict, other_code, null_code, clean_cb, out_i64):
+// the one-hot code_of loop (encoding.py pivot_block_single) at C speed.
+// Memoizes per distinct (type, value); clean_cb (a Python callable) runs
+// only on memo misses, so cardinality bounds the interpreter work.
+PyObject* pivot_codes(PyObject*, PyObject* args) {
+  PyObject *seq, *index, *clean_cb, *out;
+  long long other_code, null_code;
+  if (!PyArg_ParseTuple(args, "OOLLOO", &seq, &index, &other_code, &null_code,
+                        &clean_cb, &out)) {
+    return nullptr;
+  }
+  FastSeq fs(seq);
+  if (!fs.fast) return nullptr;
+  BufView ob(out, sizeof(int64_t));
+  if (!ob.ok) return nullptr;
+  if (ob.n() < fs.n) {
+    PyErr_SetString(PyExc_ValueError, "codes buffer too small");
+    return nullptr;
+  }
+  auto* codes = static_cast<int64_t*>(ob.data());
+  PyObject* memo = PyDict_New();
+  if (!memo) return nullptr;
+
+  // resolve(v_str_obj) -> code: clean via callback, then index lookup.
+  auto resolve = [&](PyObject* sobj, int64_t* out_code) -> bool {
+    PyObject* cleaned = PyObject_CallFunctionObjArgs(clean_cb, sobj, nullptr);
+    if (!cleaned) return false;
+    PyObject* hit = PyDict_GetItemWithError(index, cleaned);
+    Py_DECREF(cleaned);
+    if (hit) {
+      *out_code = PyLong_AsLongLong(hit);
+      return true;
+    }
+    if (PyErr_Occurred()) return false;
+    *out_code = other_code;
+    return true;
+  };
+
+  for (Py_ssize_t i = 0; i < fs.n; i++) {
+    PyObject* v = fs.items[i];
+    if (v == Py_None) {
+      codes[i] = null_code;
+      continue;
+    }
+    int is_str = PyUnicode_Check(v);
+    if (!is_str && PyFloat_Check(v)) {
+      double d = PyFloat_AS_DOUBLE(v);
+      if (d != d) {  // NaN: resolve directly, never memoize (nan != nan
+                     // would grow the memo one entry per row)
+        PyObject* s = PyObject_Str(v);
+        if (!s) goto fail;
+        int64_t c;
+        bool okr = resolve(s, &c);
+        Py_DECREF(s);
+        if (!okr) goto fail;
+        codes[i] = c;
+        continue;
+      }
+    }
+    {
+      // memo key carries the type: 1, 1.0, True are ==/same-hash but
+      // stringify differently (str fast path keys on the value itself —
+      // a str never equals a non-str)
+      PyObject* mk;
+      if (is_str) {
+        mk = v;
+        Py_INCREF(mk);
+      } else {
+        mk = PyTuple_Pack(2, (PyObject*)Py_TYPE(v), v);
+        if (!mk) goto fail;
+      }
+      PyObject* hit = PyDict_GetItemWithError(memo, mk);
+      if (hit) {
+        codes[i] = PyLong_AsLongLong(hit);
+        Py_DECREF(mk);
+        continue;
+      }
+      if (PyErr_Occurred()) {
+        PyErr_Clear();  // unhashable oddball: stringify, no memo
+        Py_DECREF(mk);
+        PyObject* s = PyObject_Str(v);
+        if (!s) goto fail;
+        int64_t c;
+        bool okr = resolve(s, &c);
+        Py_DECREF(s);
+        if (!okr) goto fail;
+        codes[i] = c;
+        continue;
+      }
+      PyObject* s = is_str ? v : PyObject_Str(v);
+      if (!s) {
+        Py_DECREF(mk);
+        goto fail;
+      }
+      int64_t c;
+      bool okr = resolve(s, &c);
+      if (!is_str) Py_DECREF(s);
+      if (!okr) {
+        Py_DECREF(mk);
+        goto fail;
+      }
+      PyObject* cobj = PyLong_FromLongLong(c);
+      if (!cobj || PyDict_SetItem(memo, mk, cobj) != 0) {
+        Py_XDECREF(cobj);
+        Py_DECREF(mk);
+        goto fail;
+      }
+      Py_DECREF(cobj);
+      Py_DECREF(mk);
+      codes[i] = c;
+    }
+  }
+  Py_DECREF(memo);
+  Py_RETURN_NONE;
+fail:
+  Py_DECREF(memo);
+  return nullptr;
+}
+
+// extract_key_columns(seq_of_dicts, keys_tuple, clean_cb_or_None) ->
+// {key: [values]}: explode map rows into per-key lists in one C pass.
+// With clean_cb, raw keys memoize to their target column (first-wins on
+// cleaned collisions, matching the Python fallback).
+PyObject* extract_key_columns(PyObject*, PyObject* args) {
+  PyObject *seq, *keys, *clean_cb;
+  if (!PyArg_ParseTuple(args, "OOO", &seq, &keys, &clean_cb)) return nullptr;
+  FastSeq fs(seq);
+  if (!fs.fast) return nullptr;
+  FastSeq ks(keys);
+  if (!ks.fast) return nullptr;
+  PyObject* result = PyDict_New();
+  if (!result) return nullptr;
+  std::vector<PyObject*> cols(ks.n);  // borrowed (result owns)
+  for (Py_ssize_t j = 0; j < ks.n; j++) {
+    PyObject* lst = PyList_New(fs.n);
+    if (!lst) goto fail;
+    for (Py_ssize_t i = 0; i < fs.n; i++) {
+      Py_INCREF(Py_None);
+      PyList_SET_ITEM(lst, i, Py_None);
+    }
+    if (PyDict_SetItem(result, ks.items[j], lst) != 0) {
+      Py_DECREF(lst);
+      goto fail;
+    }
+    Py_DECREF(lst);
+    cols[j] = PyDict_GetItem(result, ks.items[j]);
+  }
+  {
+    bool clean = clean_cb != Py_None;
+    // raw key -> target list (borrowed) or Py_None when unmatched
+    PyObject* key_memo = clean ? PyDict_New() : nullptr;
+    PyObject* index = PyDict_New();  // key/cleaned-key -> col position
+    if ((clean && !key_memo) || !index) {
+      Py_XDECREF(key_memo);
+      Py_XDECREF(index);
+      goto fail;
+    }
+    for (Py_ssize_t j = 0; j < ks.n; j++) {
+      PyObject* pos = PyLong_FromSsize_t(j);
+      if (!pos || PyDict_SetItem(index, ks.items[j], pos) != 0) {
+        Py_XDECREF(pos);
+        Py_XDECREF(key_memo);
+        Py_DECREF(index);
+        goto fail;
+      }
+      Py_DECREF(pos);
+    }
+    for (Py_ssize_t i = 0; i < fs.n; i++) {
+      PyObject* m = fs.items[i];
+      if (m == Py_None || !PyDict_Check(m) || PyDict_GET_SIZE(m) == 0) {
+        continue;
+      }
+      PyObject *k, *v;
+      Py_ssize_t pos = 0;
+      while (PyDict_Next(m, &pos, &k, &v)) {
+        PyObject* target;
+        if (!clean) {
+          target = PyDict_GetItemWithError(index, k);
+          if (!target && PyErr_Occurred()) {
+            Py_DECREF(index);
+            goto fail;
+          }
+        } else {
+          target = PyDict_GetItemWithError(key_memo, k);
+          if (!target) {
+            if (PyErr_Occurred()) {
+              Py_DECREF(key_memo);
+              Py_DECREF(index);
+              goto fail;
+            }
+            PyObject* ks_ = PyObject_Str(k);
+            PyObject* cleaned =
+                ks_ ? PyObject_CallFunctionObjArgs(clean_cb, ks_, nullptr)
+                    : nullptr;
+            Py_XDECREF(ks_);
+            if (!cleaned) {
+              Py_DECREF(key_memo);
+              Py_DECREF(index);
+              goto fail;
+            }
+            PyObject* hit = PyDict_GetItemWithError(index, cleaned);
+            Py_DECREF(cleaned);
+            if (!hit && PyErr_Occurred()) {
+              Py_DECREF(key_memo);
+              Py_DECREF(index);
+              goto fail;
+            }
+            target = hit ? hit : Py_None;
+            if (PyDict_SetItem(key_memo, k, target) != 0) {
+              Py_DECREF(key_memo);
+              Py_DECREF(index);
+              goto fail;
+            }
+          }
+        }
+        if (target && target != Py_None) {
+          Py_ssize_t j = PyLong_AsSsize_t(target);
+          // first-wins on cleaned collisions
+          if (!clean || PyList_GET_ITEM(cols[j], i) == Py_None) {
+            Py_INCREF(v);
+            PyObject* old = PyList_GET_ITEM(cols[j], i);
+            PyList_SET_ITEM(cols[j], i, v);
+            Py_DECREF(old);
+          }
+        }
+      }
+    }
+    Py_XDECREF(key_memo);
+    Py_DECREF(index);
+  }
+  return result;
+fail:
+  Py_DECREF(result);
+  return nullptr;
+}
+
+// float_column(seq, fill, out_f64): None -> fill, numbers coerced.
+PyObject* float_column(PyObject*, PyObject* args) {
+  PyObject *seq, *out;
+  double fill;
+  if (!PyArg_ParseTuple(args, "OdO", &seq, &fill, &out)) return nullptr;
+  FastSeq fs(seq);
+  if (!fs.fast) return nullptr;
+  BufView ob(out, sizeof(double));
+  if (!ob.ok) return nullptr;
+  if (ob.n() < fs.n) {
+    PyErr_SetString(PyExc_ValueError, "output buffer too small");
+    return nullptr;
+  }
+  auto* o = static_cast<double*>(ob.data());
+  for (Py_ssize_t i = 0; i < fs.n; i++) {
+    PyObject* v = fs.items[i];
+    if (v == Py_None) {
+      o[i] = fill;
+    } else if (PyFloat_Check(v)) {
+      o[i] = PyFloat_AS_DOUBLE(v);
+    } else {
+      // float(v) semantics incl. numeric strings — PyNumber_Float parses
+      // str like the python fallback's float() does
+      PyObject* f = PyNumber_Float(v);
+      if (!f) return nullptr;
+      o[i] = PyFloat_AS_DOUBLE(f);
+      Py_DECREF(f);
+    }
+  }
+  Py_RETURN_NONE;
+}
+
+// null_mask(seq, out_u8): 1 where None. empty_mask: 1 where falsy.
+PyObject* null_mask(PyObject*, PyObject* args) {
+  PyObject *seq, *out;
+  if (!PyArg_ParseTuple(args, "OO", &seq, &out)) return nullptr;
+  FastSeq fs(seq);
+  if (!fs.fast) return nullptr;
+  BufView ob(out, 1);
+  if (!ob.ok) return nullptr;
+  if (ob.n() < fs.n) {
+    PyErr_SetString(PyExc_ValueError, "output buffer too small");
+    return nullptr;
+  }
+  auto* o = static_cast<uint8_t*>(ob.data());
+  for (Py_ssize_t i = 0; i < fs.n; i++) o[i] = fs.items[i] == Py_None;
+  Py_RETURN_NONE;
+}
+
+PyObject* empty_mask(PyObject*, PyObject* args) {
+  PyObject *seq, *out;
+  if (!PyArg_ParseTuple(args, "OO", &seq, &out)) return nullptr;
+  FastSeq fs(seq);
+  if (!fs.fast) return nullptr;
+  BufView ob(out, 1);
+  if (!ob.ok) return nullptr;
+  if (ob.n() < fs.n) {
+    PyErr_SetString(PyExc_ValueError, "output buffer too small");
+    return nullptr;
+  }
+  auto* o = static_cast<uint8_t*>(ob.data());
+  for (Py_ssize_t i = 0; i < fs.n; i++) {
+    int t = PyObject_IsTrue(fs.items[i]);
+    if (t < 0) return nullptr;
+    o[i] = t == 0;
+  }
+  Py_RETURN_NONE;
+}
+
+// all_ascii(seq) -> bool: every item None or an ascii-only str (the text
+// kernel's eligibility gate, previously a 200k-call genexpr).
+PyObject* all_ascii(PyObject*, PyObject* args) {
+  PyObject* seq;
+  if (!PyArg_ParseTuple(args, "O", &seq)) return nullptr;
+  FastSeq fs(seq);
+  if (!fs.fast) return nullptr;
+  for (Py_ssize_t i = 0; i < fs.n; i++) {
+    PyObject* v = fs.items[i];
+    if (v == Py_None) continue;
+    if (!PyUnicode_Check(v) || !PyUnicode_IS_ASCII(v)) Py_RETURN_FALSE;
+  }
+  Py_RETURN_TRUE;
+}
+
+PyMethodDef methods[] = {
+    {"all_ascii", all_ascii, METH_VARARGS,
+     "all_ascii(seq) -> bool (None or ascii str everywhere)"},
+    {"pack_strings", pack_strings, METH_VARARGS,
+     "pack_strings(seq) -> (utf8_bytes, offsets_i64_bytes)"},
+    {"dict_encode", dict_encode, METH_VARARGS,
+     "dict_encode(seq, codes_out_i64) -> (n_unique, uniques)"},
+    {"pivot_codes", pivot_codes, METH_VARARGS,
+     "pivot_codes(seq, index, other, null_code, clean_cb, out_i64)"},
+    {"extract_key_columns", extract_key_columns, METH_VARARGS,
+     "extract_key_columns(rows, keys, clean_cb_or_None) -> {key: list}"},
+    {"float_column", float_column, METH_VARARGS,
+     "float_column(seq, fill, out_f64)"},
+    {"null_mask", null_mask, METH_VARARGS, "null_mask(seq, out_u8)"},
+    {"empty_mask", empty_mask, METH_VARARGS, "empty_mask(seq, out_u8)"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef moduledef = {PyModuleDef_HEAD_INIT,
+                         "_tmog_pyext",
+                         "C-speed per-object host transform loops",
+                         -1,
+                         methods,
+                         nullptr,
+                         nullptr,
+                         nullptr,
+                         nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__tmog_pyext(void) { return PyModule_Create(&moduledef); }

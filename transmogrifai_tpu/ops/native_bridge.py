@@ -122,6 +122,10 @@ def native_murmur3(data: bytes, seed: int = 0) -> Optional[int]:
 
 
 def _pack_strings(strings: Sequence[str]):
+    from . import pyext_bridge
+    packed = pyext_bridge.pack_strings(strings)
+    if packed is not None:
+        return packed
     # surrogatepass: strings decoded upstream with errors='surrogateescape'
     # (raw byte columns) must hash/encode instead of crashing ingest
     encoded = [s.encode("utf-8", errors="surrogatepass") for s in strings]
@@ -173,17 +177,36 @@ def native_hash_tokens(token_lists: Sequence[Optional[Sequence[str]]],
 
 def native_tokenize_hash_counts(docs: Sequence[Optional[str]], num_bins: int,
                                 seed: int = 0, min_len: int = 1,
-                                pad_cols: int = 0) -> Optional[np.ndarray]:
+                                pad_cols: int = 0,
+                                out: Optional[np.ndarray] = None
+                                ) -> Optional[np.ndarray]:
     """Fused tokenize+hash+count over raw documents ->
     [n, bins + pad_cols] float32. `pad_cols` trailing zero columns let the
     caller append indicators (null tracking) in place — the C kernel
-    writes with the wider row stride, so no second full-matrix copy."""
+    writes with the wider row stride, so no second full-matrix copy.
+    `out` (pre-ZEROED f32, row-major, unit inner stride — may be a column
+    slice of a wider matrix) receives the counts in place: the kernel
+    accumulates at out's base pointer with out's own row stride, which is
+    what lets the serving sink write text counts straight into the final
+    combined matrix."""
     lib = _load()
     if lib is None:
         return None
-    buf, offsets = _pack_strings([d or "" for d in docs])
-    stride = num_bins + int(pad_cols)
-    out = np.zeros((len(docs), stride), np.float32)
+    from . import pyext_bridge
+    packed = pyext_bridge.pack_strings(docs)  # None -> "" in C
+    if packed is None:
+        packed = _pack_strings([d or "" for d in docs])
+    buf, offsets = packed
+    if out is None:
+        stride = num_bins + int(pad_cols)
+        out = np.zeros((len(docs), stride), np.float32)
+    else:
+        if (out.dtype != np.float32 or out.ndim != 2
+                or out.shape[0] != len(docs)
+                or out.shape[1] < num_bins + int(pad_cols)
+                or out.strides[1] != 4 or out.strides[0] % 4):
+            return None
+        stride = out.strides[0] // 4
     lib.tmog_tokenize_hash_counts_s(_as_u8p(buf), _as_i64p(offsets), len(docs),
                                   num_bins, seed, min_len, stride,
                                   _as_f32p(out))

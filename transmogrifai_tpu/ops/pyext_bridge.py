@@ -1,0 +1,117 @@
+"""Loader for the _tmog_pyext CPython extension (native/pyext.cpp).
+
+Same posture as native_bridge: build on first use, expose typed wrappers,
+return None (or raise ImportError from ``module()``) when unavailable so
+every caller keeps a pure-Python fallback. TMOG_DISABLE_NATIVE disables
+this tier too (one knob for all native code).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_mod: Any = None
+_tried = False
+
+
+def module() -> Any:
+    """The loaded extension module, or None."""
+    global _mod, _tried
+    if _tried:
+        return _mod
+    _tried = True
+    if os.environ.get("TMOG_DISABLE_NATIVE"):
+        return None
+    try:
+        from ..native.build import build_pyext
+        path = build_pyext()
+        if path is None:
+            return None
+        from importlib.machinery import ExtensionFileLoader
+        from importlib.util import module_from_spec, spec_from_file_location
+        loader = ExtensionFileLoader("_tmog_pyext", path)
+        spec = spec_from_file_location("_tmog_pyext", path, loader=loader)
+        if spec is None:
+            return None
+        m = module_from_spec(spec)
+        loader.exec_module(m)
+        _mod = m
+    except (ImportError, OSError):
+        _mod = None
+    return _mod
+
+
+def pack_strings(strings: Sequence[Any]
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    m = module()
+    if m is None:
+        return None
+    buf_b, off_b = m.pack_strings(strings)
+    buf = np.frombuffer(buf_b, dtype=np.uint8)
+    offsets = np.frombuffer(off_b, dtype=np.int64)
+    return buf, offsets
+
+
+def dict_encode(strings: Sequence[Any]
+                ) -> Optional[Tuple[np.ndarray, List[str]]]:
+    m = module()
+    if m is None:
+        return None
+    codes = np.empty(len(strings), np.int64)
+    _, uniques = m.dict_encode(strings, codes)
+    return codes, uniques
+
+
+def pivot_codes(data: Sequence[Any], index: Dict[str, int], other_code: int,
+                null_code: int, clean_fn) -> Optional[np.ndarray]:
+    m = module()
+    if m is None:
+        return None
+    codes = np.empty(len(data), np.int64)
+    m.pivot_codes(data, index, other_code, null_code, clean_fn, codes)
+    return codes
+
+
+def extract_key_columns(data: Sequence[Any], keys: Sequence[str],
+                        clean_fn=None) -> Optional[Dict[str, List[Any]]]:
+    m = module()
+    if m is None:
+        return None
+    return m.extract_key_columns(data, tuple(keys),
+                                 clean_fn if clean_fn is not None else None)
+
+
+def float_column(vals: Sequence[Any], fill: float) -> Optional[np.ndarray]:
+    m = module()
+    if m is None:
+        return None
+    out = np.empty(len(vals), np.float64)
+    m.float_column(vals, float(fill), out)
+    return out
+
+
+def all_ascii(data: Sequence[Any]) -> Optional[bool]:
+    m = module()
+    if m is None:
+        return None
+    return m.all_ascii(data)
+
+
+def null_mask(data: Sequence[Any]) -> Optional[np.ndarray]:
+    m = module()
+    if m is None:
+        return None
+    out = np.empty(len(data), np.uint8)
+    m.null_mask(data, out)
+    return out.view(np.bool_)
+
+
+def empty_mask(data: Sequence[Any]) -> Optional[np.ndarray]:
+    m = module()
+    if m is None:
+        return None
+    out = np.empty(len(data), np.uint8)
+    m.empty_mask(data, out)
+    return out.view(np.bool_)

@@ -52,7 +52,9 @@ class LayerRunner:
 
     # -- one layer ---------------------------------------------------------
     def apply_layer(self, ds: Dataset,
-                    transformers: Sequence[Transformer]) -> Dataset:
+                    transformers: Sequence[Transformer],
+                    sinks: Optional[Tuple[Dict, Dict]] = None) -> Dataset:
+        producer_views, combiner_plans = sinks or ({}, {})
         for st in transformers:
             ds = _ensure_input_columns(ds, st)
         fusable: List[Transformer] = []
@@ -73,8 +75,103 @@ class LayerRunner:
         for st in host:
             with collector.span(st.stage_name, st.uid, "transform",
                                 n_rows=len(ds)):
-                ds = st.transform(ds)
+                plan = combiner_plans.get(st.uid)
+                view = producer_views.get(st.uid)
+                if plan is not None:
+                    ds = self._apply_combiner_sink(ds, st, plan)
+                elif view is not None:
+                    ds = self._apply_into_sink(ds, st, view)
+                else:
+                    ds = st.transform(ds)
         return ds
+
+    # -- serving sink fusion ----------------------------------------------
+    # The reference fused a layer's row transforms into ONE rdd.map pass
+    # (FitStagesUtil.applyOpTransformations:96). The memory-traffic analog
+    # here: at score time the VectorsCombiner's [n, W] output is allocated
+    # up front and every host vectorizer writes its block straight into
+    # its column slice, so wide blocks (512-bin text hashes) exist exactly
+    # once — no per-family temporary + full-matrix copy.
+    def _apply_into_sink(self, ds: Dataset, st, view: np.ndarray) -> Dataset:
+        try:
+            cols = [ds.column(n) for n in st.input_names()]
+            st.transform_block_into(cols, view)
+            col = Column(kind=ColumnKind.VECTOR, data=view,
+                         metadata=st.output_metadata())
+            return ds.with_column(st.output_name(), col)
+        except Exception:
+            # partially-written view is dead weight: the combiner sees the
+            # fallback column object (not the view) and re-copies over it
+            view[:] = 0.0
+            return st.transform(ds)
+
+    def _apply_combiner_sink(self, ds: Dataset, st, plan) -> Dataset:
+        final, views = plan
+        try:
+            cols = [ds.column(n) for n in st.input_names()]
+            for n, c in zip(st.input_names(), cols):
+                v = views[n]
+                if c.data is not v:
+                    d = c.data
+                    if d.ndim == 1:
+                        d = d[:, None]
+                    if d.shape != v.shape:
+                        # loud, like the pre-sink width assertion — a bare
+                        # `v[:] = d` would silently broadcast (n,1) wide
+                        raise AssertionError(
+                            f"combiner input {n}: block {d.shape} vs "
+                            f"planned slice {v.shape}")
+                    v[:] = d
+            md = st.combine_metadata(cols)
+            col = Column(kind=ColumnKind.VECTOR, data=final, metadata=md)
+            return ds.with_column(st.output_name(), col)
+        except Exception:
+            return st.transform(ds)
+
+    def _plan_sinks(self, ds: Dataset,
+                    dag: StagesDAG) -> Tuple[Dict, Dict]:
+        """(producer uid -> slice view, combiner uid -> (final, views)).
+
+        A sink forms when every input of a VectorsCombiner has a fitted
+        vectorizer producer whose metadata pins its width. Host producers
+        get their slice to write in place; device-fused producers' blocks
+        are copied in at combiner time (they materialize on transfer
+        anyway)."""
+        from ..automl.vectorizers.base import VectorizerModel
+        from ..automl.vectorizers.combiner import VectorsCombiner
+        n = ds.n_rows
+        stages = [st for layer in dag.layers for st in layer]
+        by_out = {st.output_name(): st for st in stages}
+        producer_views: Dict[str, np.ndarray] = {}
+        combiner_plans: Dict[str, Tuple[np.ndarray, Dict[str, np.ndarray]]] = {}
+        for st in stages:
+            if not isinstance(st, VectorsCombiner):
+                continue
+            producers, widths = [], []
+            for name in st.input_names():
+                p = by_out.get(name)
+                size = None
+                if isinstance(p, VectorizerModel):
+                    md = p.output_metadata()
+                    if md is not None:
+                        size = md.size
+                if size is None:
+                    break
+                producers.append(p)
+                widths.append(size)
+            else:
+                if not widths:
+                    continue
+                final = np.zeros((n, int(sum(widths))), np.float32)
+                views: Dict[str, np.ndarray] = {}
+                at = 0
+                for name, p, w in zip(st.input_names(), producers, widths):
+                    views[name] = final[:, at:at + w]
+                    if p.get_jax_fn() is None:
+                        producer_views[p.uid] = views[name]
+                    at += w
+                combiner_plans[st.uid] = (final, views)
+        return producer_views, combiner_plans
 
     def _apply_fused(self, ds: Dataset, stages: List[Transformer]) -> Dataset:
         input_names: List[str] = []
@@ -112,7 +209,9 @@ class LayerRunner:
                     raise ValueError(
                         f"DAG contains unfitted estimator {st.stage_name}; "
                         f"train the workflow first")
-            ds = self.apply_layer(ds, layer)  # type: ignore[arg-type]
+        sinks = self._plan_sinks(ds, dag)
+        for layer in dag.layers:
+            ds = self.apply_layer(ds, layer, sinks)  # type: ignore[arg-type]
         return ds
 
     def fit_dag(self, ds: Dataset, dag: StagesDAG,
