@@ -1,0 +1,105 @@
+"""Device/serving scoring profile (VERDICT r4 #3 evidence).
+
+Runs the wide_transmogrify serving flow at 1M rows and decomposes where
+the score pass goes using the framework's own span collector (the
+OpSparkListener-equivalent, utils/metrics.py): per-stage host transform
+times, the fused-device span, and the end-to-end score wall against the
+reference-shaped per-row python loop. Prints ONE JSON line (last line).
+
+Runs on the CPU backend by design: the wide serving pass is host-
+transform-dominated (string hashing, pivots) and bench.py measures it in
+a CPU-backend child for the same reason — dispatching hundreds of tiny
+programs over a remote TPU tunnel would time the wire, not the work.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+
+
+def main():
+    n = int(os.environ.get("SCORING_ROWS", "1000000"))
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    from transmogrifai_tpu.data.dataset import Dataset
+    from transmogrifai_tpu.features.builder import FeatureBuilder
+    from transmogrifai_tpu.types import Date, PickList, Real, RealMap, Text
+    from transmogrifai_tpu.utils.metrics import collector
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    cols = bench.make_wide_rows(n)
+    maps = np.empty(n, dtype=object)
+    for i in range(n):
+        maps[i] = {"k0": cols["m1"][i], "k1": cols["m2"][i]}
+    ds = Dataset.from_features([
+        ("plA", PickList, cols["plA"].tolist()),
+        ("plB", PickList, cols["plB"].tolist()),
+        ("txt", Text, cols["txt"].tolist()),
+        ("r1", Real, cols["r1"].tolist()),
+        ("r2", Real, [None if np.isnan(v) else float(v)
+                      for v in cols["r2"]]),
+        ("dt", Date, cols["dt"].tolist()),
+        ("mp", RealMap, list(maps)),
+    ])
+    feats = [
+        FeatureBuilder.PickList("plA").extract(
+            lambda r: r.get("plA")).as_predictor(),
+        FeatureBuilder.PickList("plB").extract(
+            lambda r: r.get("plB")).as_predictor(),
+        FeatureBuilder.Text("txt").extract(
+            lambda r: r.get("txt")).as_predictor(),
+        FeatureBuilder.Real("r1").extract(
+            lambda r: r.get("r1")).as_predictor(),
+        FeatureBuilder.Real("r2").extract(
+            lambda r: r.get("r2")).as_predictor(),
+        FeatureBuilder.Date("dt").extract(
+            lambda r: r.get("dt")).as_predictor(),
+        FeatureBuilder.RealMap("mp").extract(
+            lambda r: r.get("mp")).as_predictor(),
+    ]
+    vec = transmogrify(feats)
+    model = Workflow().set_input_dataset(ds).set_result_features(vec).train()
+    model.score(ds)  # warm
+
+    collector.enable("scoring_profile")
+    t0 = time.perf_counter()
+    scored = model.score(ds)
+    score_s = time.perf_counter() - t0
+    app = collector.finish()
+    spans = sorted(
+        ({"stage": m.stage_name[:60], "phase": m.phase,
+          "s": round(m.wall_seconds, 3)}
+         for m in app.stage_metrics),
+        key=lambda r: -r["s"])
+
+    width = scored.column(vec.name).data.shape[1]
+    native = True
+    try:
+        from transmogrifai_tpu.ops import pyext_bridge
+        native = pyext_bridge.module() is not None
+    except Exception:
+        native = False
+
+    out = {
+        "metric": "wide_scoring_profile",
+        "rows": n,
+        "vector_width": int(width),
+        "score_s": round(score_s, 3),
+        "rows_per_s": int(n / max(score_s, 1e-9)),
+        "pyext_native": native,
+        "spans": spans[:12],
+        "span_total_s": round(sum(r["s"] for r in spans), 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

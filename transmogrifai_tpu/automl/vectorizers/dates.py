@@ -21,14 +21,16 @@ from .encoding import list_reduce
 
 MS_PER_DAY = 86400000.0
 
-PERIODS: Dict[str, Any] = {
-    # name -> (period length, extractor on epoch-millis numpy array)
-    "HourOfDay": (24.0, lambda ms: (ms / 3600000.0) % 24.0),
-    "DayOfWeek": (7.0, lambda ms: ((ms / MS_PER_DAY) + 3.0) % 7.0),  # epoch was Thu
-    "DayOfMonth": (31.0, lambda ms: _day_of_month(ms)),
-    "DayOfYear": (366.0, lambda ms: _day_of_year(ms)),
-    "WeekOfYear": (53.0, lambda ms: _day_of_year(ms) / 7.0),
-    "MonthOfYear": (12.0, lambda ms: _month_of_year(ms)),
+# name -> period length; extractors are derived from _PERIOD_FROM_DT64
+# below (single source of truth — the vectorizer's one-pass block writer
+# and the dsl DateToUnitCircleTransformer must stay bitwise-identical)
+_PERIOD_LENGTHS: Dict[str, float] = {
+    "HourOfDay": 24.0,
+    "DayOfWeek": 7.0,   # epoch day 0 was a Thursday (+3 offset)
+    "DayOfMonth": 31.0,
+    "DayOfYear": 366.0,
+    "WeekOfYear": 53.0,
+    "MonthOfYear": 12.0,
 }
 
 
@@ -40,25 +42,43 @@ def _dt64(ms: np.ndarray):
     return safe.astype("datetime64[ms]"), finite
 
 
-def _calendar_delta(ms: np.ndarray, unit: str, anchor: str) -> np.ndarray:
-    """Elapsed `unit`s since the start of the enclosing `anchor` period
-    (e.g. days since month start = day-of-month - 1). NaN where missing."""
-    d, finite = _dt64(ms)
-    val = (d.astype(f"M8[{unit}]")
-           - d.astype(f"M8[{anchor}]").astype(f"M8[{unit}]")).astype(np.int64)
-    return np.where(finite, val.astype(np.float64), np.nan)
+def _cal_delta_d(d: np.ndarray, unit: str, anchor: str) -> np.ndarray:
+    """_calendar_delta's core on a PRE-COMPUTED dt64 array (shared across
+    periods by the vectorizer's one-pass block writer)."""
+    return (d.astype(f"M8[{unit}]")
+            - d.astype(f"M8[{anchor}]").astype(f"M8[{unit}]")
+            ).astype(np.int64).astype(np.float64)
 
 
-def _day_of_month(ms: np.ndarray) -> np.ndarray:
-    return _calendar_delta(ms, "D", "M")
+# period -> value from (epoch ms, shared dt64) — THE period definitions;
+# everything else (PERIODS, unit_circle) derives from this table
+_PERIOD_FROM_DT64 = {
+    "HourOfDay": lambda ms, d: (ms / 3600000.0) % 24.0,
+    "DayOfWeek": lambda ms, d: ((ms / MS_PER_DAY) + 3.0) % 7.0,
+    "DayOfMonth": lambda ms, d: _cal_delta_d(d, "D", "M"),
+    "DayOfYear": lambda ms, d: _cal_delta_d(d, "D", "Y"),
+    "WeekOfYear": lambda ms, d: _cal_delta_d(d, "D", "Y") / 7.0,
+    "MonthOfYear": lambda ms, d: _cal_delta_d(d, "M", "Y"),
+}
 
 
-def _day_of_year(ms: np.ndarray) -> np.ndarray:
-    return _calendar_delta(ms, "D", "Y")
+def _standalone_extract(name: str):
+    """ms-only extractor (derives + masks the dt64 form): NaN where the
+    input is NaN, matching the old _calendar_delta behavior."""
+    fn = _PERIOD_FROM_DT64[name]
+
+    def extract(ms: np.ndarray) -> np.ndarray:
+        d, finite = _dt64(ms)
+        val = fn(ms, d)
+        return np.where(finite, val, np.nan)
+
+    return extract
 
 
-def _month_of_year(ms: np.ndarray) -> np.ndarray:
-    return _calendar_delta(ms, "M", "Y")
+PERIODS: Dict[str, Any] = {
+    name: (length, _standalone_extract(name))
+    for name, length in _PERIOD_LENGTHS.items()
+}
 
 
 def unit_circle(ms: np.ndarray, period_name: str
@@ -84,23 +104,45 @@ class DateVectorizerModel(VectorizerModel):
         self.circular_periods = list(circular_periods)
         self.track_nulls = track_nulls
 
+    def _feature_width(self) -> int:
+        return 1 + 2 * len(self.circular_periods) + (
+            1 if self.track_nulls else 0)
+
     def transform_block(self, cols: Sequence[Column]) -> np.ndarray:
+        n = len(cols[0]) if cols else 0
+        out = np.zeros((n, self._feature_width() * len(cols)), np.float32)
+        self.transform_block_into(cols, out)
+        return out
+
+    def transform_block_into(self, cols: Sequence[Column],
+                             out: np.ndarray) -> None:
+        # one pass per column: the dt64 representation and the angle
+        # buffer are computed once and shared across periods (each
+        # unit_circle call re-derived them — 4 periods paid 4x the
+        # calendar casts), and sin/cos land in the destination slice
         X = numeric_block(cols)  # epoch millis, NaN missing
-        blocks: List[np.ndarray] = []
+        at = 0
         for j in range(X.shape[1]):
             ms = X[:, j]
             finite = np.isfinite(ms)
-            days_since = np.where(finite,
-                                  (self.reference_date_ms - ms) / MS_PER_DAY, 0.0)
-            parts = [days_since[:, None]]
+            d, _ = _dt64(ms)
+            out[:, at] = np.where(
+                finite, (self.reference_date_ms - ms) / MS_PER_DAY, 0.0)
+            k = at + 1
             for p in self.circular_periods:
-                s, c, _ = unit_circle(ms, p)
-                parts.append(s[:, None])
-                parts.append(c[:, None])
+                period, _ = PERIODS[p]
+                val = _PERIOD_FROM_DT64[p](ms, d)
+                ang = 2.0 * np.pi * val / period  # same fp order as
+                # unit_circle: bitwise parity with the dsl transformer
+                out[:, k] = np.where(finite, np.sin(ang), 0.0)
+                out[:, k + 1] = np.where(finite, np.cos(ang), 0.0)
+                k += 2
             if self.track_nulls:
-                parts.append((~finite).astype(np.float64)[:, None])
-            blocks.append(np.concatenate(parts, axis=1))
-        return np.concatenate(blocks, axis=1)
+                out[:, k] = ~finite
+                k += 1
+            at = k
+        if at != out.shape[1]:  # python -O strips assert; sink fallback
+            raise AssertionError((at, out.shape))  # relies on this firing
 
     def save_args(self) -> Dict[str, Any]:
         d = super().save_args()

@@ -651,6 +651,11 @@ class OpGBTRegressor(_GBTBase):
 class _XGBBase(_TreeEstimator):
     @classmethod
     def _declare_params(cls):
+        # the real-ML tail of the reference's 41 setters
+        # (OpXGBoostClassifier.scala): alpha/scale_pos_weight/
+        # max_delta_step/colsample_bylevel/base_score change fitted
+        # models; the remaining setters are JNI/tracker plumbing with no
+        # TPU referent
         return [
             Param("num_round", "boosting rounds", 100),
             Param("eta", "learning rate", 0.3),
@@ -658,13 +663,23 @@ class _XGBBase(_TreeEstimator):
             Param("max_bins", "histogram bins", 256),
             Param("min_child_weight", "min hessian per child", 1.0),
             Param("reg_lambda", "L2 on leaves", 1.0),
+            Param("alpha", "L1 on leaf weights (soft-threshold)", 0.0),
             Param("gamma", "complexity penalty per split", 0.0),
             Param("subsample", "row subsample per round", 1.0),
-            Param("colsample_bytree", "feature subsample", 1.0),
+            Param("colsample_bytree", "feature subsample per tree", 1.0),
+            Param("colsample_bylevel", "feature subsample per level", 1.0),
+            Param("scale_pos_weight", "positive-class weight multiplier "
+                  "(binary; xgboost imbalance control)", 1.0),
+            Param("max_delta_step", "cap on each leaf's raw newton step "
+                  "(imbalanced-logistic stabilizer)", 0.0),
+            Param("base_score", "initial prediction (None = weighted "
+                  "label mean, a better-calibrated prior than xgboost's "
+                  "fixed 0.5)", None),
             Param("seed", "rng seed", 42),
         ]
 
     def _common(self):
+        base_score = self.get_param("base_score")
         return dict(
             n_rounds=int(self.get_param("num_round")),
             depth=int(self.get_param("max_depth")),
@@ -673,7 +688,66 @@ class _XGBBase(_TreeEstimator):
             min_child_weight=float(self.get_param("min_child_weight")),
             gamma=float(self.get_param("gamma")),
             subsample=float(self.get_param("subsample")),
-            feature_frac=float(self.get_param("colsample_bytree")))
+            feature_frac=float(self.get_param("colsample_bytree")),
+            alpha=float(self.get_param("alpha")),
+            max_delta_step=float(self.get_param("max_delta_step")),
+            colsample_bylevel=float(self.get_param("colsample_bylevel")),
+            base_score=None if base_score is None else float(base_score))
+
+    _HOST_UNSUPPORTED = ("alpha", "max_delta_step", "colsample_bylevel",
+                         "base_score")
+
+    def _split_host_kw(self, kw):
+        """(host-safe kw, True if the host/native builder can run them).
+
+        The C++ builder implements the core surface; the round-5 tail
+        lives in the XLA/pallas kernels only — non-default values force
+        the device route rather than silently ignoring the params."""
+        host_kw = {k: v for k, v in kw.items()
+                   if k not in self._HOST_UNSUPPORTED}
+        ok = (kw.get("alpha", 0.0) == 0.0
+              and kw.get("max_delta_step", 0.0) == 0.0
+              and kw.get("colsample_bylevel", 1.0) == 1.0
+              and kw.get("base_score") is None)
+        return host_kw, ok
+
+    def _apply_spw(self, y, w, n_classes=2, multiclass=False):
+        """scale_pos_weight: multiply positive-class weights — for the
+        logistic objective this is exactly xgboost's g/h scaling of
+        positive instances, and it reaches every route (device, fused,
+        native host) because all take row weights."""
+        spw = float(self.get_param("scale_pos_weight"))
+        if spw == 1.0 or self._regression or multiclass or n_classes > 2:
+            return w
+        if isinstance(w, np.ndarray):
+            yn = np.asarray(y)
+            return (w * np.where(yn == 1, spw, 1.0)).astype(np.float32)
+        return w * jnp.where(y == 1, spw, 1.0).astype(jnp.float32)
+
+    def _check_multiclass_params(self, multiclass_fit: bool) -> None:
+        if multiclass_fit and self.get_param("base_score") is not None:
+            # softmax boosting has no scalar prior slot; dropping the
+            # param silently would break the never-ignore contract
+            raise ValueError(
+                "base_score is only supported for binary/regression "
+                "xgboost fits (softmax margins start at 0, matching "
+                "xgboost multi:softprob)")
+
+    def mask_fit_scores(self, ctx, y, w, masks, n_classes: int = 2,
+                        multiclass: bool = False):
+        self._check_multiclass_params(multiclass and not self._regression)
+        w = self._apply_spw(y, w, n_classes, multiclass)
+        if isinstance(ctx, tuple) and len(ctx) == 4 and ctx[0] == "host":
+            _, host_ok = self._split_host_kw(self._common())
+            if not host_ok:
+                # round-5 tail params live in the XLA kernels only; untag
+                # the context ONCE so the sweep converts the binned
+                # matrix a single time instead of per (grid point, fold)
+                import jax.numpy as jnp
+                Xb, edges, n_bins = ctx[1:]
+                ctx = (jnp.asarray(Xb), jnp.asarray(edges), n_bins)
+        return super().mask_fit_scores(ctx, y, w, masks, n_classes,
+                                       multiclass)
 
     _regression = False
 
@@ -681,18 +755,22 @@ class _XGBBase(_TreeEstimator):
         from ..ops import trees_host as TH
         Xb, edges, n_bins = ctx
         kw = self._common()
+        host_kw, host_ok = self._split_host_kw(kw)
+        if not host_ok:  # round-5 param tail: XLA kernels only
+            return self._host_fallback(ctx, y, w, n_classes, multiclass)
         depth = kw["depth"]
         seed = int(self.get_param("seed"))
         if self._regression or not multiclass:
             loss = "squared" if self._regression else "logistic"
             out = TH.fit_gbt_host(Xb, y, w, n_bins=n_bins, seed=seed,
-                                  loss=loss, **kw)
+                                  loss=loss, **host_kw)
             if out is None:
                 return self._host_fallback(ctx, y, w, n_classes, multiclass)
             trees, base = out
             return base + TH.predict_bins_host(trees, Xb, depth)[:, 0]
         trees = TH.fit_gbt_softmax_host(
-            Xb, y, w, n_bins=n_bins, n_classes=n_classes, seed=seed, **kw)
+            Xb, y, w, n_bins=n_bins, n_classes=n_classes, seed=seed,
+            **host_kw)
         if trees is None:
             return self._host_fallback(ctx, y, w, n_classes, multiclass)
         # per-class margin = sum over rounds of that class's trees
@@ -725,8 +803,10 @@ class _XGBBase(_TreeEstimator):
             trees, base = T.fit_gbt(Xb, y, w, self._key(), n_bins=n_bins,
                                     loss=loss, **kw)
             return base + T.predict_forest_bins(trees, Xb, depth)[:, 0]
+        self._check_multiclass_params(True)
+        soft_kw = {k: v for k, v in kw.items() if k != "base_score"}
         trees = T.fit_gbt_softmax(Xb, y, w, self._key(), n_bins=n_bins,
-                                  n_classes=n_classes, **kw)
+                                  n_classes=n_classes, **soft_kw)
 
         # trees carry leading [rounds, classes] axes with K=1 payloads;
         # per-class margin = sum over rounds (mirrors the training step)
@@ -750,18 +830,19 @@ class OpXGBoostClassifier(_XGBBase):
         super().__init__("xgbClassifier", uid=uid, **params)
 
     def fit_arrays(self, X, y, w=None):
-        w = self._w(y, w)
         n_classes = max(int(np.max(y)) + 1 if y.size else 2, 2)
+        w = self._apply_spw(y, self._w(y, w), n_classes)
         kw = self._common()
+        host_kw, host_ok = self._split_host_kw(kw)
         depth = kw["depth"]
-        if self._host_route():
+        if self._host_route() and host_ok:
             from ..ops import trees_host as TH
             Xb, edges, n_bins = self._bin_host(X)
             seed = int(self.get_param("seed"))
             yn = np.asarray(y, np.float32)
             if n_classes <= 2:
                 out = TH.fit_gbt_host(Xb, yn, w, n_bins=n_bins, seed=seed,
-                                      loss="logistic", **kw)
+                                      loss="logistic", **host_kw)
                 if out is not None:
                     trees, base = out
                     frozen = self._freeze(trees, jnp.asarray(edges))
@@ -771,7 +852,7 @@ class OpXGBoostClassifier(_XGBBase):
             else:
                 trees = TH.fit_gbt_softmax_host(
                     Xb, yn, w, n_bins=n_bins, n_classes=n_classes,
-                    seed=seed, **kw)
+                    seed=seed, **host_kw)
                 if trees is not None:
                     frozen = self._freeze(trees, jnp.asarray(edges))
                     return SoftmaxEnsembleModel(
@@ -787,9 +868,11 @@ class OpXGBoostClassifier(_XGBBase):
                                      base=float(base),
                                      operation_name=self.operation_name,
                                      **frozen)
+        self._check_multiclass_params(True)
+        soft_kw = {k: v for k, v in kw.items() if k != "base_score"}
         trees = T.fit_gbt_softmax(
             Xb, jnp.asarray(y, jnp.float32), jnp.asarray(w), self._key(),
-            n_bins=n_bins, n_classes=n_classes, **kw)
+            n_bins=n_bins, n_classes=n_classes, **soft_kw)
         frozen = self._freeze(trees, edges)
         return SoftmaxEnsembleModel(depth=depth, n_classes=n_classes,
                                     operation_name=self.operation_name,
@@ -809,13 +892,14 @@ class OpXGBoostRegressor(_XGBBase):
     def fit_arrays(self, X, y, w=None):
         w = self._w(y, w)
         kw = self._common()
-        if self._host_route():
+        host_kw, host_ok = self._split_host_kw(kw)
+        if self._host_route() and host_ok:
             from ..ops import trees_host as TH
             Xb, edges, n_bins = self._bin_host(X)
             out = TH.fit_gbt_host(Xb, np.asarray(y, np.float32), w,
                                   n_bins=n_bins,
                                   seed=int(self.get_param("seed")),
-                                  loss="squared", **kw)
+                                  loss="squared", **host_kw)
             if out is not None:
                 trees, base = out
                 frozen = self._freeze(trees, jnp.asarray(edges))

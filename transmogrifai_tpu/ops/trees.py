@@ -170,9 +170,19 @@ def thresholds_to_values(feat: jax.Array, thresh: jax.Array,
 
 # -- single-tree growth -----------------------------------------------------
 
+def _soft_l1(G, alpha):
+    """XGBoost's L1 soft-threshold on leaf gradient sums: shrink |G| by
+    alpha, zero inside the dead zone (ThresholdL1 in xgboost's
+    split_evaluator; OpXGBoostClassifier.setAlpha on the reference
+    wrapper). alpha == 0 is the identity."""
+    if isinstance(alpha, float) and alpha == 0.0:
+        return G
+    return jnp.sign(G) * jnp.maximum(jnp.abs(G) - alpha, 0.0)
+
+
 def _split_scores(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, reg_lambda,
                   min_child_weight, min_instances, min_info_gain, gamma,
-                  normalize_gain):
+                  alpha, normalize_gain):
     """Gain + validity for every (node, feature, bin, missing-direction)
     split candidate — XGBoost's sparsity-aware split search.
 
@@ -190,7 +200,8 @@ def _split_scores(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, reg_lambda,
     Returns gain [nodes, F, B, 2] with -inf at invalid candidates.
     """
     def score(G, H):
-        return (G * G).sum(-1) / (H + reg_lambda + EPS)
+        Ga = _soft_l1(G, alpha)
+        return (Ga * Ga).sum(-1) / (H + reg_lambda + EPS)
 
     parent = score(Gt, Ht)[:, None, None]
     norm = jnp.maximum(Ht, 1.0)[:, None, None] if normalize_gain else 1.0
@@ -221,6 +232,28 @@ def _feature_mask(key: jax.Array, n_nodes: int, n_feat: int,
     scores = jax.random.uniform(key, (n_nodes, n_feat))
     kth = jnp.sort(scores, axis=1)[:, k - 1:k]
     return scores <= kth
+
+
+def _level_feature_mask(key: jax.Array, n_feat: int, frac: float,
+                        within: Optional[jax.Array],
+                        within_count: Optional[int] = None) -> jax.Array:
+    """[F] bool level subset (XGBoost colsample_bylevel), sampled FROM the
+    colsample_bytree subset when one is active — xgboost nests the two
+    draws ('columns are subsampled from the set of columns chosen for the
+    current tree'), so their intersection is never empty. `within` [F]
+    bool (or None) restricts the draw; `within_count` is its static
+    population (the bytree k), so the level keeps frac * bytree_k
+    features. Excluded features score -inf; the k-th-largest threshold
+    then only ever admits allowed features."""
+    pool = within_count if within_count is not None else n_feat
+    k = max(1, int(round(frac * pool)))
+    if k >= n_feat and within is None:
+        return jnp.ones((n_feat,), bool)
+    scores = jax.random.uniform(key, (1, n_feat))
+    if within is not None:
+        scores = jnp.where(within[None, :], scores, -jnp.inf)
+    kth = jnp.sort(scores, axis=1, descending=True)[:, k - 1:k]
+    return (scores >= kth)[0] & jnp.isfinite(scores[0])
 
 
 def _histograms_segment(Xb, G, H, count_unit, node, n_nodes: int, B: int):
@@ -408,7 +441,9 @@ def _route_level_matmul(Xb, node, f_lvl, t_lvl, m_lvl, n_nodes: int):
 @functools.partial(
     jax.jit,
     static_argnames=("depth", "n_bins", "leaf_mode", "feature_frac",
-                     "normalize_gain", "allow_pallas"))
+                     "normalize_gain", "allow_pallas", "alpha",
+                     "max_delta_step", "level_feature_frac",
+                     "feature_mask_count"))
 def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
               key: jax.Array, *, depth: int, n_bins: int,
               reg_lambda: float = 0.0, min_child_weight: float = 0.0,
@@ -417,7 +452,10 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
               feature_frac: float = 1.0, learning_rate: float = 1.0,
               normalize_gain: bool = True,
               feature_mask: Optional[jax.Array] = None,
-              allow_pallas: bool = True) -> Tree:
+              allow_pallas: bool = True, alpha: float = 0.0,
+              max_delta_step: float = 0.0,
+              level_feature_frac: float = 1.0,
+              feature_mask_count: Optional[int] = None) -> Tree:
     """Grow one depth-`depth` tree level-wise on binned features.
 
     Xb: int8/int32 [N, F] bins; G: f32 [N, K] per-row gradient payload (weights
@@ -505,10 +543,16 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
 
         gain = _split_scores(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm,
                              reg_lambda, min_child_weight, min_instances,
-                             min_info_gain, gamma, normalize_gain)
+                             min_info_gain, gamma, alpha, normalize_gain)
         if feature_mask is not None:
             gain = jnp.where(feature_mask[None, :, None, None],
                              gain, -jnp.inf)
+        if level_feature_frac < 1.0:  # XGBoost colsample_bylevel: one
+            key, sub = jax.random.split(key)  # fresh subset per level,
+            # nested inside the bytree subset when one is active
+            fml = _level_feature_mask(sub, F, level_feature_frac,
+                                      feature_mask, feature_mask_count)
+            gain = jnp.where(fml[None, :, None, None], gain, -jnp.inf)
         if feature_frac < 1.0:
             key, sub = jax.random.split(key)
             fm = _feature_mask(sub, n_nodes, F, feature_frac)
@@ -569,7 +613,12 @@ def grow_tree(Xb: jax.Array, G: jax.Array, H: jax.Array,
         Hl = _interleave(Hleft, Ht - Hleft, n_leaves)
         Cl = _interleave(Cleft, Ct - Cleft, n_leaves)
     if leaf_mode == "newton":
-        leaf = -Gl / (Hl + reg_lambda + EPS)[:, None]
+        leaf = -_soft_l1(Gl, alpha) / (Hl + reg_lambda + EPS)[:, None]
+        if max_delta_step > 0.0:  # XGBoost max_delta_step: cap the raw
+            # (pre-learning-rate) newton step — the imbalanced-logistic
+            # stabilizer (xgboost doc: 'Maximum delta step we allow each
+            # leaf output to be')
+            leaf = jnp.clip(leaf, -max_delta_step, max_delta_step)
     else:  # mean
         leaf = Gl / (Hl + EPS)[:, None]
     # training-empty leaves predict exactly 0: the count histogram is
@@ -689,22 +738,35 @@ def _squared_grad(pred, y, w):
 @functools.partial(
     jax.jit,
     static_argnames=("n_rounds", "depth", "n_bins", "loss", "subsample",
-                     "feature_frac"))
+                     "feature_frac", "alpha", "max_delta_step",
+                     "colsample_bylevel", "base_score"))
 def fit_gbt(Xb: jax.Array, y: jax.Array, w: jax.Array, key: jax.Array, *,
             n_rounds: int, depth: int, n_bins: int,
             learning_rate: float = 0.1, reg_lambda: float = 1.0,
             min_child_weight: float = 0.0, min_instances: float = 1.0,
             min_info_gain: float = 0.0, gamma: float = 0.0,
             subsample: float = 1.0, feature_frac: float = 1.0,
-            loss: str = "logistic") -> Tuple[Tree, jax.Array]:
+            loss: str = "logistic", alpha: float = 0.0,
+            max_delta_step: float = 0.0, colsample_bylevel: float = 1.0,
+            base_score: Optional[float] = None) -> Tuple[Tree, jax.Array]:
     """Second-order boosted trees (XGBoost `hist` equivalent, one XLA program).
 
     loss='logistic' -> binary margins; loss='squared' -> regression. Returns
     (stacked trees, base_score). Prediction = base + sum of tree payloads.
+    `base_score`: None derives the prior from the weighted label mean
+    (better-calibrated start); a float pins the initial margin exactly the
+    XGBoost way (probability for logistic, raw value for squared —
+    OpXGBoostClassifier.setBaseScore on the reference wrapper).
     """
     grad_fn = _logistic_grad if loss == "logistic" else _squared_grad
     wsum = w.sum() + EPS
-    if loss == "logistic":
+    if base_score is not None:
+        if loss == "logistic":
+            p0 = min(max(float(base_score), 1e-6), 1 - 1e-6)
+            base = jnp.asarray(np.log(p0 / (1 - p0)), jnp.float32)
+        else:
+            base = jnp.asarray(float(base_score), jnp.float32)
+    elif loss == "logistic":
         p0 = jnp.clip((w * y).sum() / wsum, 1e-6, 1 - 1e-6)
         base = jnp.log(p0 / (1 - p0))
     else:
@@ -726,7 +788,12 @@ def fit_gbt(Xb: jax.Array, y: jax.Array, w: jax.Array, key: jax.Array, *,
                          min_instances=min_instances,
                          min_info_gain=min_info_gain, gamma=gamma,
                          leaf_mode="newton", feature_mask=fm,
-                         learning_rate=learning_rate, normalize_gain=False)
+                         learning_rate=learning_rate, normalize_gain=False,
+                         alpha=alpha, max_delta_step=max_delta_step,
+                         level_feature_frac=colsample_bylevel,
+                         feature_mask_count=(
+                             max(1, int(round(feature_frac * Xb.shape[1])))
+                             if feature_frac < 1.0 else None))
         margin = margin + predict_bins(tree, Xb, depth)[:, 0]
         return (margin,), tree
 
@@ -738,7 +805,9 @@ def fit_gbt(Xb: jax.Array, y: jax.Array, w: jax.Array, key: jax.Array, *,
 def _grow_tree_folds(Xb_t, G, H, count_unit, *, depth, n_bins,
                      reg_lambda, min_child_weight, min_instances,
                      min_info_gain, gamma, learning_rate, feature_mask,
-                     interpret=False):
+                     interpret=False, alpha=0.0, max_delta_step=0.0,
+                     level_feature_frac=1.0, level_key=None,
+                     feature_mask_count=None):
     """Grow one tree PER FOLD level-wise in shared pallas passes.
 
     Xb_t [F, N] transposed bins (N pre-padded to the route block size by
@@ -760,7 +829,7 @@ def _grow_tree_folds(Xb_t, G, H, count_unit, *, depth, n_bins,
     B = n_bins + 1
     split_scores_f = jax.vmap(
         _split_scores,
-        in_axes=(0,) * 9 + (None,) * 6)
+        in_axes=(0,) * 9 + (None,) * 7)
 
     def interleave_f(left, right, n_nodes):
         # children along axis 1: [Fo, 2p, ...] from per-parent pairs
@@ -809,9 +878,19 @@ def _grow_tree_folds(Xb_t, G, H, count_unit, *, depth, n_bins,
 
         gain = split_scores_f(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm,
                               reg_lambda, min_child_weight, min_instances,
-                              min_info_gain, gamma, False)
+                              min_info_gain, gamma, alpha, False)
         if feature_mask is not None:
             gain = jnp.where(feature_mask[None, None, :, None, None],
+                             gain, -jnp.inf)
+        if level_feature_frac < 1.0 and level_key is not None:
+            # colsample_bylevel: one fresh subset per level, shared by
+            # every fold (fold parity with the sequential loop, which
+            # fits all folds with the same key), nested inside the
+            # bytree subset exactly as grow_tree does
+            level_key, sub = jax.random.split(level_key)
+            fml = _level_feature_mask(sub, F, level_feature_frac,
+                                      feature_mask, feature_mask_count)
+            gain = jnp.where(fml[None, None, :, None, None],
                              gain, -jnp.inf)
 
         flat = gain.reshape(Fo, n_nodes, F * B * 2)
@@ -854,7 +933,9 @@ def _grow_tree_folds(Xb_t, G, H, count_unit, *, depth, n_bins,
             return Gl, Hl, Cl
         Gl, Hl, Cl = jax.vmap(leaf_of)(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm,
                                        f_lvl, t_lvl, m_lvl)
-    leaf = -Gl / (Hl + reg_lambda + EPS)[..., None]       # [Fo, L, 1]
+    leaf = -_soft_l1(Gl, alpha) / (Hl + reg_lambda + EPS)[..., None]
+    if max_delta_step > 0.0:  # [Fo, L, 1] — cap raw newton step
+        leaf = jnp.clip(leaf, -max_delta_step, max_delta_step)
     leaf = jnp.where(Cl[..., None] >= 0.5, leaf, 0.0)
     leaf = learning_rate * leaf
     leaf_rows = pallas_hist.table_lookup_pallas(
@@ -868,7 +949,8 @@ def _grow_tree_folds(Xb_t, G, H, count_unit, *, depth, n_bins,
 @functools.partial(
     jax.jit,
     static_argnames=("n_rounds", "depth", "n_bins", "loss", "subsample",
-                     "feature_frac", "interpret"))
+                     "feature_frac", "interpret", "alpha",
+                     "max_delta_step", "colsample_bylevel", "base_score"))
 def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
                   key: jax.Array, *, n_rounds: int, depth: int,
                   n_bins: int, learning_rate: float = 0.1,
@@ -876,7 +958,10 @@ def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
                   min_instances: float = 1.0, min_info_gain: float = 0.0,
                   gamma: float = 0.0, subsample: float = 1.0,
                   feature_frac: float = 1.0, loss: str = "logistic",
-                  interpret: bool = False):
+                  interpret: bool = False, alpha: float = 0.0,
+                  max_delta_step: float = 0.0,
+                  colsample_bylevel: float = 1.0,
+                  base_score: Optional[float] = None):
     """Boosted trees for every CV fold in ONE device program.
 
     The mask-fold sweep (models/trees.mask_fit_scores) above the fold-vmap
@@ -902,7 +987,13 @@ def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
     n_orig = N
     wsum = W.sum(axis=1) + EPS
     wy = (W * y[None, :]).sum(axis=1)
-    if loss == "logistic":
+    if base_score is not None:  # pinned prior, fit_gbt semantics
+        if loss == "logistic":
+            p0 = min(max(float(base_score), 1e-6), 1 - 1e-6)
+            base = jnp.full((Fo,), np.log(p0 / (1 - p0)), jnp.float32)
+        else:
+            base = jnp.full((Fo,), float(base_score), jnp.float32)
+    elif loss == "logistic":
         p0 = jnp.clip(wy / wsum, 1e-6, 1 - 1e-6)
         base = jnp.log(p0 / (1 - p0))
     else:
@@ -945,15 +1036,21 @@ def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
         count = (h > 0).astype(jnp.float32)
         fm = (_feature_mask(kc, 1, Xb_t.shape[0], feature_frac)[0]
               if feature_frac < 1.0 else None)
-        # kf (grow_tree's per-node feature-resample key) is intentionally
-        # unused: the boosting paths sample features per TREE via
-        # feature_mask, never per node — same as fit_gbt
+        # kf seeds the per-LEVEL colsample_bylevel draws (split exactly
+        # like grow_tree splits its key, so the fused and sequential
+        # routes draw identical level subsets); per-node resampling stays
+        # unused — boosting samples features per tree/level, not per node
         tree, leaf_rows = _grow_tree_folds(
             Xb_t, g, h, count, depth=depth, n_bins=n_bins,
             reg_lambda=reg_lambda, min_child_weight=min_child_weight,
             min_instances=min_instances, min_info_gain=min_info_gain,
             gamma=gamma, learning_rate=learning_rate, feature_mask=fm,
-            interpret=interpret)
+            interpret=interpret, alpha=alpha,
+            max_delta_step=max_delta_step,
+            level_feature_frac=colsample_bylevel, level_key=kf,
+            feature_mask_count=(
+                max(1, int(round(feature_frac * Xb_t.shape[0])))
+                if feature_frac < 1.0 else None))
         return (margin + leaf_rows,), tree
 
     init = jnp.broadcast_to(base[:, None], (Fo, N)).astype(jnp.float32)
@@ -965,14 +1062,17 @@ def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
 @functools.partial(
     jax.jit,
     static_argnames=("n_rounds", "depth", "n_bins", "n_classes", "subsample",
-                     "feature_frac"))
+                     "feature_frac", "alpha", "max_delta_step",
+                     "colsample_bylevel"))
 def fit_gbt_softmax(Xb: jax.Array, y: jax.Array, w: jax.Array,
                     key: jax.Array, *, n_rounds: int, depth: int,
                     n_bins: int, n_classes: int,
                     learning_rate: float = 0.1, reg_lambda: float = 1.0,
                     min_child_weight: float = 0.0, gamma: float = 0.0,
                     subsample: float = 1.0,
-                    feature_frac: float = 1.0) -> Tree:
+                    feature_frac: float = 1.0, alpha: float = 0.0,
+                    max_delta_step: float = 0.0,
+                    colsample_bylevel: float = 1.0) -> Tree:
     """Multiclass softmax boosting: per round, the class axis of the
     grad/hess tensors is vmapped into n_classes parallel tree growths
     (XGBoost multi:softprob shape). Returns trees with leading
@@ -1001,7 +1101,13 @@ def fit_gbt_softmax(Xb: jax.Array, y: jax.Array, w: jax.Array,
                              min_child_weight=min_child_weight, gamma=gamma,
                              leaf_mode="newton", feature_mask=fm,
                              learning_rate=learning_rate,
-                             normalize_gain=False, allow_pallas=False)
+                             normalize_gain=False, allow_pallas=False,
+                             alpha=alpha, max_delta_step=max_delta_step,
+                             level_feature_frac=colsample_bylevel,
+                             feature_mask_count=(
+                                 max(1, int(round(
+                                     feature_frac * Xb.shape[1])))
+                                 if feature_frac < 1.0 else None))
         trees = jax.vmap(per_class, in_axes=(1, 1, 0))(
             g, h, jax.random.split(kf, n_classes))
         step = jax.vmap(lambda t: predict_bins(t, Xb, depth)[:, 0])(trees)
