@@ -40,6 +40,12 @@ def force_cpu(n_devices: int = 8) -> None:
         # Backends already initialized — nothing safe to change; the caller's
         # device-count assert will report what is actually available.
         pass
+    # re-point the persistent cache now that the platform is known: the
+    # import-time enable ran before JAX_PLATFORMS was set, so it chose
+    # the TPU/default dir — CPU-forced processes must not share it (their
+    # executables carry different CPU target tuning; see the -cpu scope
+    # note in enable_compilation_cache)
+    enable_compilation_cache()
 
 
 def enable_compilation_cache() -> None:
@@ -88,6 +94,14 @@ def enable_compilation_cache() -> None:
             tag += f"-jl{jaxlib.__version__}"
         except Exception:
             pass
+        # a TPU-backend process compiles its host-side CPU executables
+        # with different target tuning (+prefer-no-scatter/-gather) than
+        # a pure-CPU process; sharing one dir makes every cross-load
+        # spam cpu_aot_loader feature-mismatch errors. Scope explicit
+        # CPU-platform processes into their own dir (the TPU/default dir
+        # keeps its name so existing warm entries stay valid).
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            tag += "-cpu"
         loc = os.path.join(os.path.expanduser("~"), ".cache",
                            "transmogrifai_tpu", f"xla-{tag}")
     try:
