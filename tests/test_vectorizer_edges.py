@@ -147,3 +147,36 @@ def test_pivot_mixed_type_values_stringify_independently():
     exp[3, 4] = 1  # None -> null column
     exp[4, 3] = 1  # unseen -> OTHER
     np.testing.assert_array_equal(out, exp)
+
+
+def test_date_block_bitwise_parity_with_unit_circle():
+    """The one-pass block writer and the dsl-facing unit_circle must stay
+    BITWISE identical per stored f32 value (dates.py module contract) —
+    this is the test that ties the two period tables together."""
+    import numpy as np
+
+    from transmogrifai_tpu.automl.vectorizers.dates import (
+        DateVectorizerModel, PERIODS, unit_circle,
+    )
+    from transmogrifai_tpu.data.dataset import Column
+    from transmogrifai_tpu.types import ColumnKind
+
+    rng = np.random.default_rng(5)
+    ms = np.where(rng.uniform(size=500) < 0.1, np.nan,
+                  1.4e12 + rng.uniform(0, 2e11, size=500))
+    periods = list(PERIODS)
+    model = DateVectorizerModel(reference_date_ms=1.5e12,
+                                circular_periods=periods,
+                                track_nulls=True)
+    model.set_output_name("d_vec")
+    col = Column(kind=ColumnKind.FLOAT, data=ms)
+    block = model.transform_block([col])
+    for i, p in enumerate(periods):
+        s, c, _ = unit_circle(ms, p)
+        finite = np.isfinite(ms)
+        np.testing.assert_array_equal(
+            block[:, 1 + 2 * i],
+            np.where(finite, s, 0.0).astype(np.float32), err_msg=p)
+        np.testing.assert_array_equal(
+            block[:, 2 + 2 * i],
+            np.where(finite, c, 0.0).astype(np.float32), err_msg=p)

@@ -70,30 +70,43 @@ def main():
         jnp.float32)
 
     def timed(fn, *args, reps=3, **kw):
-        out = fn(*args, **kw)
-        jax.block_until_ready(out)  # warm (compile)
+        # sync via a scalar FETCH, not block_until_ready: the axon remote
+        # backend acks block_until_ready before the kernel finishes, so a
+        # python float out of a reduce is the only honest barrier
+        def sync(o):
+            return float(jnp.sum(o))
+
+        sync(fn(*args, **kw))   # warm (compile)
         best = float("inf")
         for _ in range(reps):
             t0 = time.perf_counter()
-            out = fn(*args, **kw)
-            jax.block_until_ready(out)
+            sync(fn(*args, **kw))
             best = min(best, time.perf_counter() - t0)
         return best
+
+    # RPC floor: the same scalar-fetch sync on a trivial kernel — over
+    # the axon tunnel every timed rep pays one round-trip, so kernel
+    # times are reported both raw and net of this floor
+    tiny = jnp.ones((8, 128), jnp.float32)
+    null_s = timed(lambda a: a * 2.0, tiny, reps=5)
 
     result = {"metric": "tree_sweep_roofline", "backend": backend,
               "device_kind": kind, "rows": n, "cols": F, "folds": folds,
               "n_bins": n_bins, "n_slots": n_slots, "hist_bf16": bf16,
+              "rpc_floor_s": round(null_s, 4),
               "attainable": {"hbm_gbs": hbm_gbs,
                              "peak_bf16_tflops": peak_bf16 / 1e12}}
 
     if pallas_hist.available():
-        hist_s = timed(pallas_hist.hist_pallas, Xb_t, pay_t, slot_t,
-                       n_slots=n_slots, n_bins=n_bins, allow_bf16=bf16)
+        hist_raw = timed(pallas_hist.hist_pallas, Xb_t, pay_t, slot_t,
+                         n_slots=n_slots, n_bins=n_bins, allow_bf16=bf16)
+        hist_s = max(hist_raw - null_s, 1e-6)
         pay_bytes = 2 if bf16 else 4
         hist_read = n * F * 1 + folds * 3 * n * pay_bytes + folds * n * 4
         hist_write = folds * n_slots * 3 * F * n_bins * 4
         hist_flops = 2.0 * n * (folds * 3) * (F * n_bins)
         result["hist"] = {
+            "raw_s": round(hist_raw, 4),
             "s": round(hist_s, 4),
             "bytes_moved_gb": round((hist_read + hist_write) / 1e9, 3),
             "achieved_gbs": round((hist_read + hist_write) / hist_s / 1e9, 1),
@@ -117,16 +130,27 @@ def main():
             jnp.int32)
         d_lvl = jnp.zeros((folds, n_slots), jnp.int32)
         try:
-            route_s = timed(pallas_hist.route_pallas, Xb_t, node_t,
-                            f_lvl, t_lvl, d_lvl, n_nodes=n_slots)
+            route_raw = timed(pallas_hist.route_pallas, Xb_t, node_t,
+                              f_lvl, t_lvl, d_lvl, n_nodes=n_slots,
+                              reps=5)
+            route_s = route_raw - null_s
             route_bytes = n * F * 1 + folds * n * 4 * 2
             result["route"] = {
-                "s": round(route_s, 4),
+                "raw_s": round(route_raw, 4),
+                "s": round(max(route_s, 0.0), 4),
                 "bytes_moved_gb": round(route_bytes / 1e9, 3),
-                "achieved_gbs": round(route_bytes / route_s / 1e9, 1),
-                "pct_hbm_roof": round(
-                    100 * route_bytes / route_s / 1e9 / hbm_gbs, 1),
             }
+            # a net time within ~25% of the RPC floor is inside tunnel
+            # jitter: publish the bound, not a garbage roof percentage
+            if route_s > 0.25 * null_s:
+                result["route"]["achieved_gbs"] = round(
+                    route_bytes / route_s / 1e9, 1)
+                result["route"]["pct_hbm_roof"] = round(
+                    100 * route_bytes / route_s / 1e9 / hbm_gbs, 1)
+            else:
+                result["route"]["below_measurement_floor"] = True
+                result["route"]["achieved_gbs_lower_bound"] = round(
+                    route_bytes / max(null_s * 0.25, 1e-6) / 1e9, 1)
         except Exception as e:  # signature drift: report, don't die
             result["route"] = {"error": str(e)[:200]}
 

@@ -42,23 +42,50 @@ def _dt64(ms: np.ndarray):
     return safe.astype("datetime64[ms]"), finite
 
 
-def _cal_delta_d(d: np.ndarray, unit: str, anchor: str) -> np.ndarray:
-    """_calendar_delta's core on a PRE-COMPUTED dt64 array (shared across
-    periods by the vectorizer's one-pass block writer)."""
-    return (d.astype(f"M8[{unit}]")
-            - d.astype(f"M8[{anchor}]").astype(f"M8[{unit}]")
-            ).astype(np.int64).astype(np.float64)
+def _cal_delta_d(d: np.ndarray, unit: str, anchor: str,
+                 cache: Optional[Dict[str, np.ndarray]] = None
+                 ) -> np.ndarray:
+    """Elapsed `unit`s since the enclosing `anchor` period start, on a
+    PRE-COMPUTED dt64 array. `cache` (unit -> d@[unit]) lets the one-pass
+    block writer share casts across periods (DayOfMonth/DayOfYear/
+    WeekOfYear all need d@[D])."""
+    if cache is None:
+        du = d.astype(f"M8[{unit}]")
+        da = d.astype(f"M8[{anchor}]")
+    else:
+        du = cache.get(unit)
+        if du is None:
+            du = cache[unit] = d.astype(f"M8[{unit}]")
+        da = cache.get(anchor)
+        if da is None:
+            da = cache[anchor] = d.astype(f"M8[{anchor}]")
+    return (du - da.astype(f"M8[{unit}]")).astype(np.int64).astype(
+        np.float64)
 
 
-# period -> value from (epoch ms, shared dt64) — THE period definitions;
-# everything else (PERIODS, unit_circle) derives from this table
+# calendar periods as DATA — (unit, anchor, divisor) — so the one-pass
+# block writer (shared cast cache) and the standalone extractors read
+# the same definition; ms-math periods live in _MS_PERIODS
+_CAL_PERIODS = {
+    "DayOfMonth": ("D", "M", 1.0),
+    "DayOfYear": ("D", "Y", 1.0),
+    "WeekOfYear": ("D", "Y", 7.0),
+    "MonthOfYear": ("M", "Y", 1.0),
+}
+_MS_PERIODS = {
+    "HourOfDay": lambda ms: (ms / 3600000.0) % 24.0,
+    "DayOfWeek": lambda ms: ((ms / MS_PER_DAY) + 3.0) % 7.0,
+}
+
+# period -> value from (epoch ms, shared dt64) — derived views of the
+# tables above; everything else (PERIODS, unit_circle) derives from this
+# (x / 1.0 is bitwise x, so the uniform divide is exact)
 _PERIOD_FROM_DT64 = {
-    "HourOfDay": lambda ms, d: (ms / 3600000.0) % 24.0,
-    "DayOfWeek": lambda ms, d: ((ms / MS_PER_DAY) + 3.0) % 7.0,
-    "DayOfMonth": lambda ms, d: _cal_delta_d(d, "D", "M"),
-    "DayOfYear": lambda ms, d: _cal_delta_d(d, "D", "Y"),
-    "WeekOfYear": lambda ms, d: _cal_delta_d(d, "D", "Y") / 7.0,
-    "MonthOfYear": lambda ms, d: _cal_delta_d(d, "M", "Y"),
+    **{name: (lambda ms, d, _f=fn: _f(ms)) for name, fn in
+       _MS_PERIODS.items()},
+    **{name: (lambda ms, d, _u=u, _a=a, _dv=dv:
+              _cal_delta_d(d, _u, _a) / _dv)
+       for name, (u, a, dv) in _CAL_PERIODS.items()},
 }
 
 
@@ -126,14 +153,21 @@ class DateVectorizerModel(VectorizerModel):
             ms = X[:, j]
             finite = np.isfinite(ms)
             d, _ = _dt64(ms)
+            cast_cache: Dict[str, np.ndarray] = {}
             out[:, at] = np.where(
                 finite, (self.reference_date_ms - ms) / MS_PER_DAY, 0.0)
             k = at + 1
             for p in self.circular_periods:
-                period, _ = PERIODS[p]
-                val = _PERIOD_FROM_DT64[p](ms, d)
-                ang = 2.0 * np.pi * val / period  # same fp order as
-                # unit_circle: bitwise parity with the dsl transformer
+                period = _PERIOD_LENGTHS[p]
+                if p in _CAL_PERIODS:
+                    u, a, dv = _CAL_PERIODS[p]
+                    val = _cal_delta_d(d, u, a, cast_cache) / dv
+                else:
+                    val = _MS_PERIODS[p](ms)
+                # same fp op order AND precision as unit_circle (f64 trig
+                # then the f32 store) — bitwise parity with the dsl
+                # DateToUnitCircleTransformer is a stated invariant
+                ang = 2.0 * np.pi * val / period
                 out[:, k] = np.where(finite, np.sin(ang), 0.0)
                 out[:, k + 1] = np.where(finite, np.cos(ang), 0.0)
                 k += 2
