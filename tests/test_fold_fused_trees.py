@@ -166,3 +166,57 @@ def test_mask_fit_scores_routes_through_fused_hook(monkeypatch):
     assert seen["reg_lambda"] == pytest.approx(2.0)
     assert seen["loss"] == "logistic"
     np.testing.assert_allclose(seen["W"], np.asarray(masks) * 2.0)
+
+
+def test_config_fused_lanes_match_per_config_calls():
+    """The config-fused sweep's per-lane eta/lambda/gamma/mcw vectors:
+    lanes = (config, fold) pairs must reproduce each config's own
+    fold-fused fit EXACTLY (each lane's contraction rows are disjoint, so
+    batching configs into the fold axis must not change a bit)."""
+    Xb, y, masks = _data(n=640, f=5, b=7, folds=2, seed=3)
+    w = jnp.ones_like(y)
+    key = jax.random.PRNGKey(42)
+    configs = [
+        dict(learning_rate=0.1, reg_lambda=1.0, min_child_weight=0.0,
+             gamma=0.0),
+        dict(learning_rate=0.3, reg_lambda=5.0, min_child_weight=2.0,
+             gamma=0.1),
+        dict(learning_rate=0.05, reg_lambda=0.5, min_child_weight=1.0,
+             gamma=0.0),
+    ]
+    F = masks.shape[0]
+    W = masks * w[None, :]
+    kw = dict(n_rounds=3, depth=3, n_bins=8, interpret=True)
+
+    W_lanes = jnp.concatenate([W for _ in configs], axis=0)
+    lane = {k: jnp.repeat(jnp.asarray([c[k] for c in configs],
+                                      jnp.float32), F)
+            for k in configs[0]}
+    _, base_l, marg_l = T.fit_gbt_folds(Xb, y, W_lanes, key, **kw, **lane)
+
+    for ci, c in enumerate(configs):
+        _, base_1, marg_1 = T.fit_gbt_folds(Xb, y, W, key, **kw, **c)
+        np.testing.assert_array_equal(
+            np.asarray(base_l[ci * F:(ci + 1) * F]), np.asarray(base_1),
+            err_msg=f"base config {ci}")
+        np.testing.assert_array_equal(
+            np.asarray(marg_l[ci * F:(ci + 1) * F]), np.asarray(marg_1),
+            err_msg=f"margins config {ci}")
+
+
+def test_grid_fuse_signature_groups_correctly():
+    from transmogrifai_tpu.models.trees import (
+        OpGBTClassifier, OpXGBoostClassifier,
+    )
+    est = OpXGBoostClassifier(num_round=5, max_depth=3, max_bins=16)
+    s1 = est.grid_fuse_signature({"eta": 0.1, "reg_lambda": 1.0})
+    s2 = est.grid_fuse_signature({"eta": 0.3, "reg_lambda": 5.0})
+    s3 = est.grid_fuse_signature({"eta": 0.1, "max_depth": 4})
+    assert s1 == s2          # algebra scalars fuse
+    assert s1 != s3          # structure (depth) splits
+    gbt = OpGBTClassifier(max_iter=3, max_depth=3, max_bins=16)
+    g1 = gbt.grid_fuse_signature({"step_size": 0.1})
+    g2 = gbt.grid_fuse_signature({"step_size": 0.2})
+    g3 = gbt.grid_fuse_signature({"subsampling_rate": 0.8})
+    assert g1 == g2
+    assert g1 != g3          # subsample draw must match to share a key

@@ -1,0 +1,69 @@
+"""A/B the config-fused tree sweep on the live TPU: fused vs per-config
+(TMOG_NO_GRID_FUSE=1) on the same data/grids, asserting metric parity.
+Prints one JSON line."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+
+def run_case(no_fuse: bool):
+    env = dict(os.environ)
+    if no_fuse:
+        env["TMOG_NO_GRID_FUSE"] = "1"
+    else:
+        env.pop("TMOG_NO_GRID_FUSE", None)
+    code = """
+import json, time, sys
+sys.path.insert(0, %r)
+import bench
+import jax.numpy as jnp
+from transmogrifai_tpu.automl.tuning.validators import CrossValidation
+from transmogrifai_tpu.evaluators.evaluators import Evaluators
+from transmogrifai_tpu.models.trees import OpXGBoostClassifier
+cfg = dict(n_rows=2_000_000, n_cols=64, folds=5, gbt_rounds=10,
+           gbt_depth=6, gbt_bins=32, gbt_grid=16)
+X, y, _ = bench.device_data(cfg["n_rows"], cfg["n_cols"], cfg["folds"],
+                            jnp.bfloat16)
+val = CrossValidation(Evaluators.BinaryClassification.au_pr(),
+                      num_folds=cfg["folds"], seed=42,
+                      sweep_dtype=jnp.bfloat16)
+grids = bench.gbt_grids(cfg)
+t0 = time.perf_counter()
+best = val.validate([(OpXGBoostClassifier(), [dict(g) for g in grids])],
+                    X, y)
+dt = time.perf_counter() - t0
+fm = {json.dumps(v.grid, sort_keys=True): v.fold_metrics
+      for v in best.validated}
+print("CASE|" + json.dumps({"s": round(dt, 2), "best": best.best_grid,
+                            "best_metric": float(best.best_metric),
+                            "fold_metrics": fm}))
+"""
+    r = subprocess.run([sys.executable, "-c", code % REPO],
+                       capture_output=True, text=True, timeout=1500,
+                       env=env, cwd=REPO)
+    for line in (r.stdout or "").splitlines():
+        if line.startswith("CASE|"):
+            return json.loads(line[5:])
+    raise RuntimeError((r.stderr or "")[-600:])
+
+
+t0 = time.time()
+fused = run_case(no_fuse=False)
+seq = run_case(no_fuse=True)
+deltas = []
+for k, v in fused["fold_metrics"].items():
+    sv = seq["fold_metrics"].get(k)
+    deltas.append(max(abs(a - b) for a, b in zip(v, sv)))
+out = {"metric": "grid_fuse_ab_2m", "fused_s": fused["s"],
+       "sequential_s": seq["s"],
+       "speedup": round(seq["s"] / max(fused["s"], 1e-9), 2),
+       "max_fold_metric_delta": max(deltas),
+       "same_winner": fused["best"] == seq["best"],
+       "wall_s": round(time.time() - t0, 1)}
+print(json.dumps(out))

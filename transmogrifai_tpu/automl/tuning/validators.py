@@ -14,6 +14,7 @@ naive Bayes) fall back to a per-(fold, grid) loop over sliced arrays.
 """
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -654,23 +655,66 @@ class Validator:
             groups: Dict[Any, List[int]] = {}
             for gi in pending:
                 groups.setdefault(bins_of(gi), []).append(gi)
+            multicls = problem_type == "multiclass"
             for _, group in sorted(groups.items(), key=lambda kv: str(kv[0])):
                 # n_valid: mesh runs pad rows (repeat-last) — the quantile
                 # sketch must see only the real rows so mesh and meshless
                 # sweeps grow from identical bin edges
                 ctx = est.copy(**grids[group[0]]).mask_sweep_context(
                     Xd, n_valid=X.shape[0], mesh=self.mesh)
-                for gi in group:
-                    est_g = est.copy(**grids[gi])
-                    scores = est_g.mask_fit_scores(
-                        ctx, yd, wd, md, n_classes=n_classes,
-                        multiclass=(problem_type == "multiclass"))
-                    out = np.asarray(fold_metrics(scores, yd, wd, md, thr_d))
+
+                def record(gi, scores_f):
+                    out = np.asarray(fold_metrics(scores_f, yd, wd, md,
+                                                  thr_d))
                     fm = [float(v) for v in out]
                     results[gi] = fm
                     if ckpt is not None:
                         ckpt.record(keys[gi], type(est).__name__, grids[gi],
                                     fm, metric)
+
+                # config fusion: grid points whose structural signature
+                # matches fit ONE fold-fused device program (lanes =
+                # configs x folds) — one histogram pass serves them all
+                sig_of = getattr(est, "grid_fuse_signature", lambda g: None)
+                sig_groups: Dict[Any, List[int]] = {}
+                for gi in group:
+                    sig = sig_of(grids[gi])
+                    key = ("solo", gi) if sig is None else ("fuse", sig)
+                    sig_groups.setdefault(key, []).append(gi)
+                for key, gis in sig_groups.items():
+                    fused = None
+                    # OPT-IN (TMOG_GRID_FUSE=1): the widened-M hist
+                    # programs are bitwise-correct (ops-level parity
+                    # suite) but their Mosaic compiles ran 20+ minutes at
+                    # the 2M x 20-lane shape on first hardware contact —
+                    # until that compile cost is root-caused, the default
+                    # sweep keeps the proven per-config programs (and
+                    # their warm persistent-cache entries)
+                    fuse_on = os.environ.get(
+                        "TMOG_GRID_FUSE", "").strip().lower() \
+                        in ("1", "true", "on")
+                    if key[0] == "fuse" and len(gis) > 1 and fuse_on:
+                        try:
+                            fused = est.mask_fit_scores_grid(
+                                ctx, yd, wd, md, [grids[gi] for gi in gis],
+                                n_classes=n_classes, multiclass=multicls)
+                        except Exception as e:  # never lose the sweep to
+                            # the fast path: per-config route is the
+                            # correctness baseline
+                            import logging
+                            logging.getLogger(__name__).warning(
+                                "config-fused sweep failed (%s); "
+                                "falling back per-config", e)
+                            fused = None
+                    if fused is not None:
+                        for k, gi in enumerate(gis):
+                            record(gi, fused[k])
+                        continue
+                    for gi in gis:
+                        est_g = est.copy(**grids[gi])
+                        record(gi, est_g.mask_fit_scores(
+                            ctx, yd, wd, md, n_classes=n_classes,
+                            multiclass=multicls))
                 del ctx  # free the binned matrix before the next group
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,

@@ -249,6 +249,109 @@ class _TreeEstimator(PredictorEstimator):
         (family hook — the GBT/XGB boosters implement it)."""
         return None
 
+    # -- config-fused sweep (grid points batched into the fold axis) ------
+    #: fit_gbt_folds args that may vary PER LANE (pure algebra scalars);
+    #: every other kw must match across a fused group
+    _LANE_KEYS = ("learning_rate", "reg_lambda", "min_child_weight",
+                  "gamma")
+    _LANE_DEFAULTS = {"learning_rate": 0.1, "reg_lambda": 1.0,
+                      "min_child_weight": 0.0, "gamma": 0.0}
+
+    def _sweep_kw(self):
+        """The kw dict this family passes to fit_gbt_folds (hook)."""
+        return None
+
+    def grid_fuse_signature(self, grid):
+        """Hashable structural signature: grid points with EQUAL
+        signatures fit in one fold-fused device program (they differ only
+        in per-lane algebra scalars). None = this grid point cannot
+        fuse. Used by the validator to batch the sweep."""
+        est_g = self.copy(**grid)
+        kw = est_g._sweep_kw()
+        if kw is None:
+            return None
+        items = tuple(sorted(
+            (k, v) for k, v in kw.items() if k not in self._LANE_KEYS))
+        # seed from the GRID-APPLIED copy: a swept seed must split the
+        # group (one key drives the shared subsample/colsample draws)
+        return items + (("loss", getattr(self, "_loss", "logistic")),
+                        ("seed", int(est_g.get_param("seed"))
+                         if est_g.has_param("seed") else 0))
+
+    def mask_fit_scores_grid(self, ctx, y, w, masks, grids,
+                             n_classes: int = 2, multiclass: bool = False):
+        """[G, F, n] margins for a GROUP of same-signature grid points in
+        as few device programs as fit VMEM/HBM, or None (validator falls
+        back to per-config mask_fit_scores). The lanes axis is
+        (config, fold) pairs over the SHARED binned matrix: one histogram
+        one-hot pass serves every config and fold, and the contraction M
+        dim grows from folds*3 toward the MXU's 128 rows (the measured
+        headroom in docs/performance.md's roofline table)."""
+        if isinstance(ctx, tuple) and len(ctx) == 4 and ctx[0] == "host":
+            return None   # host-tagged sweep: the C++ builder path
+        regression = (getattr(self, "_regression", False)
+                      or getattr(self, "_loss", "logistic") == "squared")
+        if multiclass and not regression:
+            return None
+        if len(grids) < 2:
+            return None
+        kws = [self.copy(**g)._sweep_kw() for g in grids]
+        if any(k is None for k in kws):
+            return None
+        sigs = {self.grid_fuse_signature(g) for g in grids}
+        if len(sigs) != 1 or None in sigs:
+            return None
+        depth = kws[0]["depth"]
+        if not self._fused_route_ok(ctx, y, masks, depth):
+            return None
+        from ..ops import pallas_hist
+        Xb, edges, n_bins = ctx
+        F = masks.shape[0]
+        n = y.shape[0]
+        G = len(grids)
+        # chunk size: the fused kernel's VMEM residents scale with lane
+        # count, and HBM carries 4 lane-sized f32 planes (W, g, h,
+        # margins) — cap both
+        hbm_lane_budget = int(os.environ.get(
+            "TMOG_GRID_FUSE_HBM_LANES", "64"))
+        chunk = G
+        while chunk > 1 and (
+                not pallas_hist.fused_hist_fits(
+                    Xb.shape[1], n_bins + 1, chunk * F, depth)
+                or chunk * F > hbm_lane_budget):
+            chunk = (chunk + 1) // 2
+        if chunk == 1 and not pallas_hist.fused_hist_fits(
+                Xb.shape[1], n_bins + 1, F, depth):
+            return None
+
+        loss = "squared" if regression else "logistic"
+        outs = []
+        for lo in range(0, G, chunk):
+            sub = kws[lo:lo + chunk]
+            g_here = len(sub)
+            # per-config w (scale_pos_weight may vary across the grid)
+            Ws = []
+            for gi in range(lo, lo + g_here):
+                est_g = self.copy(**grids[gi])
+                w_g = est_g._apply_spw(y, w, n_classes, multiclass) \
+                    if hasattr(est_g, "_apply_spw") else w
+                Ws.append(masks * w_g[None, :])
+            W_lanes = jnp.concatenate(Ws, axis=0)          # [g*F, n]
+            lane_vec = {
+                key: jnp.repeat(jnp.asarray(
+                    [float(k.get(key, self._LANE_DEFAULTS[key]))
+                     for k in sub], jnp.float32), F)
+                for key in self._LANE_KEYS}
+            shared = {k: v for k, v in sub[0].items()
+                      if k not in self._LANE_KEYS}
+            # the signature pins one seed per group; honor the grid's
+            key = self.copy(**grids[lo])._key()
+            _, _, margins = T.fit_gbt_folds(
+                Xb, y, W_lanes, key, n_bins=n_bins, loss=loss,
+                **shared, **lane_vec)
+            outs.append(margins.reshape(g_here, F, n))
+        return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
     def _fused_route_ok(self, ctx, y, masks=None, depth=None):
         """Shared gate for the fold-fused booster path: live pallas on a
         single-device TPU above the fold-vmap row limit. Mesh-sharded
@@ -567,6 +670,8 @@ class _GBTBase(_TreeEstimator):
             min_info_gain=float(self.get_param("min_info_gain")),
             subsample=float(self.get_param("subsampling_rate")))
 
+    _sweep_kw = _gbt_kw  # config-fused sweep hook
+
     def _fit_gbt(self, X, y, w, loss):
         kw = self._gbt_kw()
         if self._host_route():
@@ -693,6 +798,8 @@ class _XGBBase(_TreeEstimator):
             max_delta_step=float(self.get_param("max_delta_step")),
             colsample_bylevel=float(self.get_param("colsample_bylevel")),
             base_score=None if base_score is None else float(base_score))
+
+    _sweep_kw = _common  # config-fused sweep hook
 
     _HOST_UNSUPPORTED = ("alpha", "max_delta_step", "colsample_bylevel",
                          "base_score")

@@ -827,9 +827,18 @@ def _grow_tree_folds(Xb_t, G, H, count_unit, *, depth, n_bins,
     F, N = Xb_t.shape
     Fo = G.shape[0]
     B = n_bins + 1
+    # reg_lambda / min_child_weight / gamma / learning_rate may be PER
+    # LANE vectors [Fo] (the config-fused sweep batches grid points into
+    # the fold axis; eta and lambda are pure algebra scalars per lane).
+    # Scalars keep the scalar HLO — the single-config path's executables
+    # (and their persistent-cache entries) must stay byte-identical.
+    def _ax(v):
+        return 0 if getattr(v, "ndim", 0) == 1 else None
+
     split_scores_f = jax.vmap(
         _split_scores,
-        in_axes=(0,) * 9 + (None,) * 7)
+        in_axes=(0,) * 9 + (_ax(reg_lambda), _ax(min_child_weight),
+                            None, None, _ax(gamma), None, None))
 
     def interleave_f(left, right, n_nodes):
         # children along axis 1: [Fo, 2p, ...] from per-parent pairs
@@ -933,11 +942,15 @@ def _grow_tree_folds(Xb_t, G, H, count_unit, *, depth, n_bins,
             return Gl, Hl, Cl
         Gl, Hl, Cl = jax.vmap(leaf_of)(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm,
                                        f_lvl, t_lvl, m_lvl)
-    leaf = -_soft_l1(Gl, alpha) / (Hl + reg_lambda + EPS)[..., None]
+    rl_col = reg_lambda[:, None] if getattr(reg_lambda, "ndim", 0) == 1 \
+        else reg_lambda
+    leaf = -_soft_l1(Gl, alpha) / (Hl + rl_col + EPS)[..., None]
     if max_delta_step > 0.0:  # [Fo, L, 1] — cap raw newton step
         leaf = jnp.clip(leaf, -max_delta_step, max_delta_step)
     leaf = jnp.where(Cl[..., None] >= 0.5, leaf, 0.0)
-    leaf = learning_rate * leaf
+    lr_col = learning_rate[:, None, None] \
+        if getattr(learning_rate, "ndim", 0) == 1 else learning_rate
+    leaf = lr_col * leaf
     leaf_rows = pallas_hist.table_lookup_pallas(
         leaf[:, :, 0], node, interpret=interpret)         # [Fo, N]
     tree = Tree(jnp.concatenate(feats, axis=1),
