@@ -15,9 +15,14 @@ sys.path.insert(0, REPO)
 def run_case(no_fuse: bool):
     env = dict(os.environ)
     if no_fuse:
-        env["TMOG_NO_GRID_FUSE"] = "1"
+        env.pop("TMOG_GRID_FUSE", None)   # default: per-config route
     else:
-        env.pop("TMOG_NO_GRID_FUSE", None)
+        env["TMOG_GRID_FUSE"] = "1"       # opt-in fused route
+        # chunk cap under test (lanes = configs x folds); 10 = 2-config
+        # chunks — the first shape to clear before growing toward the
+        # VMEM guard's 20-lane admit
+        env.setdefault("TMOG_GRID_FUSE_HBM_LANES",
+                       os.environ.get("AB_LANES", "10"))
     code = """
 import json, time, sys
 sys.path.insert(0, %r)
