@@ -168,3 +168,49 @@ def test_sink_fusion_score_matches_blockwise_concat():
     np.testing.assert_array_equal(np.asarray(scored.data), want)
     assert scored.metadata is not None
     assert scored.metadata.size == want.shape[1]
+
+
+def test_sink_fusion_survives_producer_failure(monkeypatch):
+    """A producer whose in-place write blows up must fall back loudly-
+    but-correctly: the combiner re-copies its block over the dead view
+    and the final matrix is unchanged vs the unfused reassembly."""
+    import numpy as np
+
+    from transmogrifai_tpu import Dataset, FeatureBuilder
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    from transmogrifai_tpu.automl.vectorizers.categorical import OneHotModel
+    from transmogrifai_tpu.types import PickList, Real
+    from transmogrifai_tpu.workflow.workflow import Workflow
+
+    n = 200
+    rows = {
+        "pl": [f"c{i % 5}" if i % 9 else None for i in range(n)],
+        "r": [float(i % 7) if i % 4 else None for i in range(n)],
+    }
+    ds = Dataset.from_features([
+        ("pl", PickList, rows["pl"]),
+        ("r", Real, rows["r"]),
+    ])
+    feats = [
+        FeatureBuilder.PickList("pl").extract(
+            lambda r: r.get("pl")).as_predictor(),
+        FeatureBuilder.Real("r").extract(
+            lambda r: r.get("r")).as_predictor(),
+    ]
+    vec = transmogrify(feats)
+    model = Workflow().set_input_dataset(ds).set_result_features(
+        vec).train()
+    want = np.asarray(model.score(ds).column(vec.name).data)
+
+    orig = OneHotModel.transform_block_into
+
+    def boom(self, cols, out):
+        if out.base is not None:   # the planned combiner-slice view:
+            out[:, :1] = 1.0       # partial garbage write, then die
+            raise RuntimeError("forced producer failure")
+        return orig(self, cols, out)   # own buffer: behave (the
+        # transform_block fallback route)
+
+    monkeypatch.setattr(OneHotModel, "transform_block_into", boom)
+    got = np.asarray(model.score(ds).column(vec.name).data)
+    np.testing.assert_array_equal(got, want)

@@ -310,15 +310,28 @@ class _TreeEstimator(PredictorEstimator):
         n = y.shape[0]
         G = len(grids)
         # chunk size: the fused kernel's VMEM residents scale with lane
-        # count, and HBM carries 4 lane-sized f32 planes (W, g, h,
-        # margins) — cap both
+        # count, HBM carries 4 lane-sized f32 planes (W, g, h, margins),
+        # and Mosaic's layout search explodes when the out block nears
+        # the scoped-VMEM boundary (r5 session 2: 20+ min compiles at a
+        # 16MB out block) — cap all three
         hbm_lane_budget = int(os.environ.get(
             "TMOG_GRID_FUSE_HBM_LANES", "64"))
+        out_mb_cap = float(os.environ.get("TMOG_GRID_FUSE_OUT_MB", "8"))
+        # worst-level slot count: non-root levels histogram LEFT children
+        # only (sibling subtraction), so the deepest pass carries
+        # 2^(depth-2) slots — same accounting as fused_hist_fits
+        n_slots = 1 << max(depth - 2, 0)
+
+        def out_mb(lanes):
+            return lanes * n_slots * 3 * Xb.shape[1] * (n_bins + 1) \
+                * 4 / 1e6
+
         chunk = G
         while chunk > 1 and (
                 not pallas_hist.fused_hist_fits(
                     Xb.shape[1], n_bins + 1, chunk * F, depth)
-                or chunk * F > hbm_lane_budget):
+                or chunk * F > hbm_lane_budget
+                or out_mb(chunk * F) > out_mb_cap):
             chunk = (chunk + 1) // 2
         if chunk == 1 and not pallas_hist.fused_hist_fits(
                 Xb.shape[1], n_bins + 1, F, depth):
