@@ -1,0 +1,77 @@
+"""Find the Mosaic compile-time knee of the fused histogram kernel.
+
+r5 session 2: widened-M fused programs (configs batched into the fold
+axis) compiled for 20+ minutes at the 2M x 20-lane shape. This probe
+lowers+compiles hist_pallas at increasing lane counts with a HARD
+per-shape timeout in a KILLABLE child (never kill an in-flight compile
+in the parent process — wedge risk), recording compile seconds per
+shape. Output: one JSON line; log lines as it goes.
+
+Usage (next TPU window): python tools/tpu_fuse_compile_knee.py
+Env: KNEE_LANES="5,10,15,20" KNEE_TIMEOUT_S=420 KNEE_ROWS=2000000
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import sys, time
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax, jax.numpy as jnp
+from transmogrifai_tpu.ops import pallas_hist as PH
+
+lanes = %(lanes)d
+n = %(rows)d
+F, B, S = 64, 33, 16   # BASELINE shape, deepest sibling-subtracted level
+rng = np.random.default_rng(0)
+Xb_t = jnp.asarray(rng.integers(0, B, size=(F, n)), jnp.int8)
+pay = jnp.asarray(rng.normal(size=(lanes * 3, n)), jnp.float32)
+slot = jnp.asarray(rng.integers(0, S, size=(lanes, n)), jnp.float32)
+t0 = time.perf_counter()
+out = PH.hist_pallas(Xb_t, pay, slot, n_slots=S, n_bins=B,
+                     allow_bf16=True)
+s = float(jnp.sum(out))           # scalar fetch = honest sync
+print("KNEE|%%.1f" %% (time.perf_counter() - t0), flush=True)
+"""
+
+
+def main():
+    lanes_list = [int(x) for x in os.environ.get(
+        "KNEE_LANES", "5,10,15,20").split(",")]
+    timeout_s = float(os.environ.get("KNEE_TIMEOUT_S", "420"))
+    rows = int(os.environ.get("KNEE_ROWS", "2000000"))
+    results = {}
+    for lanes in lanes_list:
+        code = CHILD % {"repo": REPO, "lanes": lanes, "rows": rows}
+        t0 = time.time()
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True,
+                               timeout=timeout_s, cwd=REPO)
+            got = None
+            for line in (r.stdout or "").splitlines():
+                if line.startswith("KNEE|"):
+                    got = float(line[5:])
+            results[lanes] = (got if got is not None
+                              else f"rc={r.returncode}")
+        except subprocess.TimeoutExpired:
+            results[lanes] = f"TIMEOUT>{timeout_s:.0f}s"
+            print(json.dumps({"lanes": lanes, "result": results[lanes]}),
+                  flush=True)
+            break   # bigger shapes will be worse; stop here
+        print(json.dumps({"lanes": lanes, "result": results[lanes],
+                          "wall_s": round(time.time() - t0, 1)}),
+              flush=True)
+    print(json.dumps({"metric": "fuse_compile_knee", "rows": rows,
+                      "per_lanes_compile_s": results}))
+
+
+if __name__ == "__main__":
+    main()
