@@ -1,0 +1,103 @@
+"""Decompose the warm GLM sweep's wall time (VERDICT r4 weak #3).
+
+The einsum Hessian kernel measured 25.8 TF/s in isolation but the warm
+48-grid x 5-fold GLM phase runs ~17-19s end to end (~5% MFU). This tool
+splits that wall on the live backend into:
+
+  raw_kernel   one sweep_glm_streamed call at the full lane count
+               (compute + per-iteration dispatch, no validator)
+  metrics      the lane-batched AuPR pass on the sweep's margins
+  validator    CrossValidation end to end minus the two above
+               (chunking, checkpoint bookkeeping, host sync)
+
+Prints ONE JSON line. Runs on whatever backend jax gives (intended for
+the TPU window; CPU numbers are still structurally informative).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from transmogrifai_tpu.automl.tuning.validators import CrossValidation
+    import transmogrifai_tpu.automl.tuning.validators as V
+    from transmogrifai_tpu.evaluators.evaluators import Evaluators
+    from transmogrifai_tpu.models.glm import OpLogisticRegression
+    from transmogrifai_tpu.ops.glm_sweep import sweep_glm_streamed
+
+    n = int(os.environ.get("GLMPROF_ROWS", "10000000"))
+    d, folds, grid = 64, 5, 48
+    backend = jax.default_backend()
+    X, y, _ = bench.device_data(n, d, folds, jnp.bfloat16)
+    w = jnp.ones(n, jnp.float32)
+    rng = np.random.default_rng(7)
+    fold = rng.integers(0, folds, size=n)
+    masks = jnp.asarray((fold[None, :] != np.arange(folds)[:, None])
+                        .astype(np.float32))
+    regs = jnp.asarray(np.logspace(-4, 0, grid), jnp.float32)
+    alphas = jnp.zeros(grid, jnp.float32)
+
+    def sync(o):
+        return float(jnp.sum(o[0] if isinstance(o, tuple) else o))
+
+    # raw kernel: one streamed call fitting every (fold, grid) lane
+    t0 = time.perf_counter()
+    Bs, b0s = sweep_glm_streamed(X, y, w, masks, regs, alphas,
+                                 loss="logistic", max_iter=15,
+                                 standardize=True)
+    sync(Bs)
+    kernel_cold_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    Bs, b0s = sweep_glm_streamed(X, y, w * 1.0, masks, regs, alphas,
+                                 loss="logistic", max_iter=15,
+                                 standardize=True)
+    sync(Bs)
+    kernel_warm_s = time.perf_counter() - t0
+
+    # margins + lane-batched metric for all lanes
+    t0 = time.perf_counter()
+    margins = jnp.einsum("fgd,nd->fgn", Bs.astype(jnp.float32),
+                         X.astype(jnp.float32)) + b0s[..., None]
+    from transmogrifai_tpu.automl.tuning.validators import _lanes_metric_fn
+    lm = _lanes_metric_fn("au_pr", "binary", 4096)
+    wl = jnp.repeat((1.0 - masks) * w[None, :], grid, axis=0)  # [F*G, n]
+    vals = lm(margins.reshape(folds * grid, n), y, wl)
+    sync(vals)
+    metrics_s = time.perf_counter() - t0
+
+    # validator end to end (warm second pass)
+    val = CrossValidation(Evaluators.BinaryClassification.au_pr(),
+                          num_folds=folds, seed=42,
+                          sweep_dtype=jnp.bfloat16)
+    glm = (OpLogisticRegression(max_iter=15),
+           [{"reg_param": float(r), "elastic_net_param": 0.0}
+            for r in np.logspace(-4, 0, grid)])
+    val.validate([glm], X, y)
+    t0 = time.perf_counter()
+    val.validate([glm], X, y)
+    validator_warm_s = time.perf_counter() - t0
+
+    out = {"metric": "glm_warm_profile", "backend": backend, "rows": n,
+           "lanes": folds * grid,
+           "kernel_cold_s": round(kernel_cold_s, 2),
+           "kernel_warm_s": round(kernel_warm_s, 2),
+           "margins_plus_metric_s": round(metrics_s, 2),
+           "validator_warm_s": round(validator_warm_s, 2),
+           "validator_overhead_s": round(
+               validator_warm_s - kernel_warm_s - metrics_s, 2)}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
