@@ -214,3 +214,48 @@ def test_sink_fusion_survives_producer_failure(monkeypatch):
     monkeypatch.setattr(OneHotModel, "transform_block_into", boom)
     got = np.asarray(model.score(ds).column(vec.name).data)
     np.testing.assert_array_equal(got, want)
+
+
+def test_pyext_fuzz_parity_random_object_soup():
+    """Property-style: random mixed-type columns through every C loop vs
+    its Python fallback — parity must hold on soup, not just curated
+    cases."""
+    import transmogrifai_tpu.ops.pyext_bridge as bridge
+    from transmogrifai_tpu.automl.vectorizers import encoding
+
+    rng = np.random.default_rng(123)
+    pool = ["a", "B", "", None, 0, 1, -3, 2.5, float("nan"), True, False,
+            "ω", "x y", 1.0, "1.0", "  pad  ", 10**20]
+    for trial in range(5):
+        data = [pool[i] for i in rng.integers(0, len(pool), size=300)]
+
+        got_codes, got_uniq = px.dict_encode(data)
+        seen = {}
+        ref = [seen.setdefault(
+            "" if v is None else (v if type(v) is str else str(v)),
+            len(seen)) for v in data]
+        assert got_codes.tolist() == ref, trial
+
+        np.testing.assert_array_equal(
+            px.null_mask(data), [v is None for v in data])
+        np.testing.assert_array_equal(
+            px.empty_mask(data), [not v for v in data])
+
+        vocab = ["a", "b", "1.0", "x y"]
+        got = encoding.pivot_block_single(data, vocab, True, str.lower)
+        orig = bridge.pivot_codes
+        bridge.pivot_codes = lambda *a, **k: None
+        try:
+            want = encoding.pivot_block_single(data, vocab, True,
+                                               str.lower)
+        finally:
+            bridge.pivot_codes = orig
+        np.testing.assert_array_equal(got, want, err_msg=f"trial {trial}")
+
+        nums = [v for v in data
+                if v is None or isinstance(v, (int, float, bool))]
+        got_f = px.float_column(nums, -1.0)
+        want_f = np.fromiter(
+            (-1.0 if v is None else float(v) for v in nums),
+            np.float64, len(nums))
+        np.testing.assert_array_equal(got_f, want_f)
