@@ -260,6 +260,7 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
     finally:
         V.STREAMED_SWEEP_MIN_ROWS = saved_min_rows
 
+    from transmogrifai_tpu.utils.metrics import collector as _mc
     log(f"tree sweep: {len(tgrids)} configs x {cfg['folds']} folds")
     # On TPU the tree family runs in a KILLABLE subprocess: round-3 first
     # contact saw fit_gbt HANG (not raise) inside the pallas path for 14+
@@ -278,14 +279,22 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
         # single-tenant runtime: the child never got the device — the
         # in-process path below is the one that works there
         in_process = best_tree is None and not child_ran
+    kernel_roofline = []
     if in_process:
         try:
+            # stage-metric collection ON so the fused tree fits record
+            # per-kernel roofline spans (achieved GB/s vs the HBM roof)
+            _mc.enable("bench_tree_sweep")
             t0 = time.perf_counter()
             best_tree = val.validate([(OpXGBoostClassifier(),
                                        [dict(g) for g in tgrids])], X, y)
             tree_s = time.perf_counter() - t0
+            kernel_roofline = [k.to_json()
+                               for k in _mc.current.kernel_metrics]
+            _mc.disable()
             log(f"tree sweep done in {tree_s:.2f}s")
         except Exception as e:
+            _mc.disable()
             errors.append(f"tree sweep: {type(e).__name__}: {str(e)[:200]}")
             # a Mosaic/pallas compile failure surfaces as an exception —
             # retry once on the XLA-only path rather than losing the family
@@ -320,6 +329,10 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
                tree_fits=len(tgrids) * cfg["folds"] if best_tree else 0,
                best_name=best.name, best_grid=best.best_grid,
                best_au_pr=float(best.best_metric))
+    kernel_roofline = kernel_roofline or \
+        getattr(best_tree, "kernel_roofline", None) or []
+    if kernel_roofline:
+        out["kernel_roofline"] = kernel_roofline
     child_flops = getattr(best_tree, "fit_flops", 0.0)
     if child_flops:
         out["tree_fit_flops"] = child_flops
@@ -350,12 +363,13 @@ class _TreeSweepResult:
     sweep ran in a child process (only the fields device_sweeps reads)."""
 
     def __init__(self, name, best_grid, best_metric, fit_flops=0.0,
-                 tree_route=None):
+                 tree_route=None, kernel_roofline=None):
         self.tree_route = tree_route
         self.name = name
         self.best_grid = best_grid
         self.best_metric = best_metric
         self.fit_flops = fit_flops
+        self.kernel_roofline = kernel_roofline or []
 
 
 def tree_sweep_child(cfg):
@@ -372,10 +386,14 @@ def tree_sweep_child(cfg):
     val = CrossValidation(Evaluators.BinaryClassification.au_pr(),
                           num_folds=cfg["folds"], seed=42, sweep_dtype=dtype)
     tgrids = gbt_grids(cfg)
+    from transmogrifai_tpu.utils.metrics import collector
+    collector.enable("bench_tree_sweep_child")
     t0 = time.perf_counter()
     best = val.validate([(OpXGBoostClassifier(),
                           [dict(g) for g in tgrids])], X, y)
     dt = time.perf_counter() - t0
+    kernel_roofline = [k.to_json() for k in collector.current.kernel_metrics]
+    collector.disable()
     from transmogrifai_tpu.ops import pallas_hist
     # per-fit FLOPs from XLA cost analysis, here where the jit cache is
     # warm (the parent would re-lower — and re-risk a pallas compile hang)
@@ -384,6 +402,7 @@ def tree_sweep_child(cfg):
         tree_s=round(dt, 3), name=best.name, best_grid=best.best_grid,
         best_metric=float(best.best_metric), fit_flops=flops,
         pallas=pallas_hist.available(),
+        kernel_roofline=kernel_roofline,
         tree_route=tree_route_label(cfg))), flush=True)
 
 
@@ -433,7 +452,8 @@ def _tree_sweep_subprocess(cfg, errors, timeout_s=None):
                 return (_TreeSweepResult(d["name"], d["best_grid"],
                                          d["best_metric"],
                                          d.get("fit_flops", 0.0),
-                                         d.get("tree_route")),
+                                         d.get("tree_route"),
+                                         d.get("kernel_roofline")),
                         d["tree_s"], True)
         stderr = (r.stderr or "").strip()
         # device-contention init failure: the runtime is single-tenant,
@@ -773,6 +793,85 @@ def wide_transmogrify(n):
                 vs_row_loop=round(loop_s / max(score_s, 1e-9), 2))
 
 
+# -- histogram roofline micro-bench (--hist-roofline) -----------------------
+
+def hist_roofline_bench(n_rows=None):
+    """Micro-bench for the fused multi-(fold x lane) histogram pipeline:
+    analytic bytes-moved per sweep-level for the r5 per-fold baseline vs
+    the batched route+hist kernel (one residency of the binned matrix for
+    all lanes, count channel derived in VMEM, routing fused into the same
+    pass), plus a MEASURED deepest-level pass with achieved GB/s against
+    the device's HBM roof. Runs on any backend — on CPU the jnp fallback
+    path is what gets timed (a liveness number, not a perf claim); the
+    analytic reduction factor is backend-independent. One JSON line."""
+    import jax
+    import jax.numpy as jnp
+    from transmogrifai_tpu.ops import pallas_hist as PH
+    from transmogrifai_tpu.utils.metrics import hbm_roof_gbps, \
+        roofline_fields
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    folds, F, n_bins, depth = 5, 64, 32, 6
+    B = n_bins + 1
+    n = int(n_rows) if n_rows else (10_000_000 if on_tpu else 200_000)
+    per_fold = PH.sweep_level_bytes(n, F, folds, fused="per_fold")
+    r5 = PH.sweep_level_bytes(n, F, folds, fused="r5")
+    fused = PH.sweep_level_bytes(n, F, folds, fused=True)
+    out = {"metric": "hist_level_roofline", "backend": backend,
+           "n_rows": n, "n_cols": F, "folds": folds, "depth": depth,
+           "bytes_per_level_per_fold_route": int(per_fold),
+           "bytes_per_level_r5_fold_fused": int(r5),
+           "bytes_per_level_fused": int(fused),
+           # vs the sequential per-lane route (the fallback when fold
+           # fusion is gated off) AND vs what r5's production fold-fused
+           # TPU route actually streamed — both, so neither number can
+           # be mistaken for the other
+           "bytes_reduction_x_vs_per_fold": round(per_fold / fused, 2),
+           "bytes_reduction_x_vs_r5_fold_fused": round(r5 / fused, 2)}
+
+    # measured deepest routed level (2^(depth-2) nodes): rep-varying
+    # payloads defeat executable result caching on the tunnel, and are
+    # PREcomputed so only route_hist sits in the timed window (the +rep
+    # shift would otherwise add ~80n bytes of traffic the analytic
+    # denominator doesn't count, understating achieved GB/s)
+    n_nodes = 1 << (depth - 2)
+    key = jax.random.PRNGKey(0)
+    kx, kp, kn, kf = jax.random.split(key, 4)
+    Xb_t = jax.random.randint(kx, (F, n), 0, B, jnp.int32).astype(jnp.int8)
+    pay = jax.random.normal(kp, (2 * folds, n), jnp.float32)
+    pays = [pay + float(rep) for rep in range(4)]
+    node = jax.random.randint(kn, (folds, n), 0, n_nodes,
+                              jnp.int32).astype(jnp.float32)
+    f_lvl = jax.random.randint(kf, (folds, n_nodes), 0, F, jnp.int32)
+    t_lvl = jnp.full((folds, n_nodes), B // 2, jnp.int32)
+    m_lvl = jnp.zeros((folds, n_nodes), jnp.int32)
+    jax.block_until_ready((Xb_t, pays, node))
+
+    def one(p):
+        return PH.route_hist(Xb_t, p, node, f_lvl, t_lvl, m_lvl,
+                             n_nodes=n_nodes, n_bins=B,
+                             allow_bf16=True, derive_count=True)
+
+    jax.block_until_ready(one(pays[0]))  # warm/compile
+    times = []
+    for p in pays[1:]:
+        t0 = time.perf_counter()
+        jax.block_until_ready(one(p))
+        times.append(time.perf_counter() - t0)
+    wall = min(times)
+    roof = hbm_roof_gbps(jax.devices()[0].device_kind) if on_tpu else None
+    rf = roofline_fields(wall, fused, roof)  # shared arithmetic: the
+    # micro-bench must report the same numbers a collector.kernel span
+    # of the identical pass would
+    out.update(level_wall_s=round(wall, 4),
+               achieved_gbps=rf["achieved_gbps"])
+    if roof:
+        out.update(hbm_roof_gbps=rf["roof_gbps"],
+                   pct_of_hbm_roof=rf["pct_of_roof"])
+    return out
+
+
 # -- cpu-subprocess phases --------------------------------------------------
 # Tiny example flows and the host-transform-dominated wide bench dispatch
 # hundreds of small programs; over a remote TPU tunnel every dispatch pays
@@ -856,6 +955,10 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--quality":
         print(json.dumps(titanic_quality()))
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--hist-roofline":
+        print(json.dumps(hist_roofline_bench(
+            sys.argv[2] if len(sys.argv) > 2 else None)))
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--tree-sweep":
         cfg_json = os.environ.get("BENCH_TREE_CFG")
         tree_sweep_child(json.loads(cfg_json) if cfg_json
@@ -900,6 +1003,8 @@ def main():
                          f"{cfg['glm_grid'] + cfg['gbt_grid']}"
                          f"model_{cfg['folds']}fold_wall",
                   value=round(device_s, 3), sweep=sweep)
+    if sweep.get("kernel_roofline"):
+        RESULT["kernel_roofline"] = sweep["kernel_roofline"]
     persist_partial("device_sweeps")
 
     # 2. MFU — count only families whose device sweep actually ran, with

@@ -21,6 +21,11 @@ print("package import ok; native kernels:",
 PY
 
 echo "== 2/4 test suite (8-device virtual CPU mesh) =="
+# fused histogram planner + CPU-fallback smoke first, explicitly under
+# JAX_PLATFORMS=cpu: the tier-1 guarantee that the pure-jnp twin of the
+# batched sweep kernel stays live on hosts with no TPU
+JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_hist_batched.py::test_planner_cpu_smoke -q -m 'not slow'
 python -m pytest tests/ -q
 
 echo "== 3/4 examples =="

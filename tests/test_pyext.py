@@ -94,6 +94,14 @@ def test_extract_key_columns_parity_both_clean_modes():
         assert got == want
 
 
+def test_extract_key_columns_rejects_duplicate_keys():
+    """The C layer enforces the no-duplicate invariant itself: a
+    duplicate key would make PyDict_SetItem free an earlier column while
+    the C loop still holds its borrowed pointer (ADVICE r5)."""
+    with pytest.raises(ValueError, match="duplicate key"):
+        px.extract_key_columns([{"k0": 1}], ["k0", "k1", "k0"], None)
+
+
 def test_float_column_parity_incl_numeric_strings():
     vals = [1, None, 2.5, True, "3.5", np.float64(7)]
     got = px.float_column(vals, -9.0)

@@ -370,3 +370,52 @@ def test_mask_fold_tree_sweep_checkpoints(tmp_path, monkeypatch):
     resumed = val2.validate([(OpGBTClassifier(), [dict(g) for g in grids])],
                             X, y)
     assert resumed.best_grid == first.best_grid
+
+
+def _grid_fuse_sweep(X, y, grids, monkeypatch, max_failures):
+    """Drive one mask-fold tree sweep with the config-fused route opt-in
+    and mask_fit_scores_grid monkeypatched to raise."""
+    import transmogrifai_tpu.models.trees as MT
+
+    monkeypatch.setenv("TMOG_GRID_FUSE", "1")
+    monkeypatch.setenv("TMOG_GRID_FUSE_MAX_FAILURES", str(max_failures))
+
+    def boom(*a, **kw):
+        raise ValueError("injected fused-kernel failure")
+
+    monkeypatch.setattr(MT._TreeEstimator, "mask_fit_scores_grid", boom)
+    ev = Evaluators.BinaryClassification.au_pr()
+    return V.CrossValidation(ev, num_folds=2, seed=2).validate(
+        [(OpGBTClassifier(), [dict(g) for g in grids])], X, y)
+
+
+def test_grid_fuse_failure_falls_back_with_one_warning(monkeypatch, caplog):
+    """A fused-route failure below the cap falls back per-config and
+    surfaces ONE sweep-level warning (not a per-config stream) — the
+    warning call itself must execute (it once NameError'd on an
+    undefined cap variable, killing the sweep the fallback was meant to
+    save)."""
+    import logging
+    X, y = _binary_data(600, d=4, seed=31)
+    grids = [{"step_size": s, "max_iter": 4, "max_depth": 2}
+             for s in (0.05, 0.3)]  # same fuse signature -> one group
+    with caplog.at_level(logging.WARNING,
+                         logger="transmogrifai_tpu.automl.tuning.validators"):
+        out = _grid_fuse_sweep(X, y, grids, monkeypatch, max_failures=3)
+    assert all(np.isfinite(v) for m in out.validated
+               for v in m.fold_metrics)
+    # per-config fallback, so no cell is attributed to the fused program
+    assert all(m.route == "mask_folds" for m in out.validated)
+    warn = [r for r in caplog.records if "falling back" in r.message]
+    assert len(warn) == 1, "exactly one sweep-level fallback warning"
+
+
+def test_grid_fuse_repeated_failures_raise_at_cap(monkeypatch):
+    """At TMOG_GRID_FUSE_MAX_FAILURES consecutive fused-route failures
+    the sweep raises instead of silently degrading per-config forever
+    (ADVICE r5)."""
+    X, y = _binary_data(600, d=4, seed=31)
+    grids = [{"step_size": s, "max_iter": 4, "max_depth": 2}
+             for s in (0.05, 0.3)]
+    with pytest.raises(RuntimeError, match="fused sweep route failed"):
+        _grid_fuse_sweep(X, y, grids, monkeypatch, max_failures=1)

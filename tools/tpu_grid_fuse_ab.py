@@ -1,6 +1,7 @@
-"""A/B the config-fused tree sweep on the live TPU: fused vs per-config
-(TMOG_NO_GRID_FUSE=1) on the same data/grids, asserting metric parity.
-Prints one JSON line."""
+"""A/B the config-fused tree sweep on the live TPU: fused
+(TMOG_GRID_FUSE=1) vs per-config (TMOG_GRID_FUSE unset — the fused route
+is opt-in, there is no separate kill knob) on the same data/grids,
+asserting metric parity. Prints one JSON line."""
 import json
 import os
 import subprocess

@@ -115,6 +115,14 @@ def _metric_fn(problem_type: str, metric: str, n_classes: int = 2,
 # vmapped program is simpler and compile-cheaper.
 STREAMED_SWEEP_MIN_ROWS = 200_000
 
+def grid_fuse_max_failures() -> int:
+    """Consecutive config-fused route failures tolerated before the
+    sweep raises (ADVICE r5): the per-config fallback is the correctness
+    baseline, but a fused route that dies on EVERY group is a broken
+    kernel/driver that must surface, not a warning stream to scroll
+    past. Read per sweep, like every other TMOG_GRID_FUSE_* knob."""
+    return int(os.environ.get("TMOG_GRID_FUSE_MAX_FAILURES", "3"))
+
 def _lanes_metric_fn(metric: str, problem_type: str, rank_bins):
     """(scores [L, n], labels [n], w_lanes [L, n]) -> [L] metric values
     when the metric has a lane-batched binned kernel, else None. Single
@@ -615,6 +623,14 @@ class Validator:
                                       and est._host_route())
                 else "mask_folds"))
         pending = [gi for gi in range(len(grids)) if gi not in results]
+        fused_gis: set = set()   # cells whose metrics came via the
+        # config-fused program (route attribution for bench/MFU readers)
+        # consecutive fused-route failure escalation: one sweep-level
+        # warning on first failure, silent per-config fallback while the
+        # streak stays short, a raise once it reaches the cap
+        fuse_fail_streak = 0
+        fuse_failures = 0
+        fuse_max_failures = grid_fuse_max_failures()
         if pending:
             # trees only read X through quantile binning, so the bf16 sweep
             # dtype is safe here too and halves the resident matrix
@@ -700,15 +716,39 @@ class Validator:
                                 n_classes=n_classes, multiclass=multicls)
                         except Exception as e:  # never lose the sweep to
                             # the fast path: per-config route is the
-                            # correctness baseline
+                            # correctness baseline — but a route that
+                            # fails REPEATEDLY is a broken kernel, not a
+                            # per-config nuisance: count the streak, warn
+                            # once at sweep level, raise at the cap
+                            fuse_fail_streak += 1
+                            fuse_failures += 1
+                            if fuse_fail_streak >= fuse_max_failures:
+                                raise RuntimeError(
+                                    f"config-fused sweep route failed "
+                                    f"{fuse_fail_streak} consecutive "
+                                    f"times (last: {type(e).__name__}: "
+                                    f"{e}); the fused kernel path is "
+                                    f"dead — fix it or unset "
+                                    f"TMOG_GRID_FUSE") from e
                             import logging
-                            logging.getLogger(__name__).warning(
-                                "config-fused sweep failed (%s); "
-                                "falling back per-config", e)
+                            logger = logging.getLogger(__name__)
+                            if fuse_failures == 1:
+                                logger.warning(
+                                    "config-fused sweep failed (%s); "
+                                    "falling back per-config (further "
+                                    "failures logged at DEBUG; raising "
+                                    "after %d consecutive)", e,
+                                    fuse_max_failures)
+                            else:
+                                logger.debug(
+                                    "config-fused sweep failure %d: %s",
+                                    fuse_failures, e)
                             fused = None
                     if fused is not None:
+                        fuse_fail_streak = 0
                         for k, gi in enumerate(gis):
                             record(gi, fused[k])
+                            fused_gis.add(gi)
                         continue
                     for gi in gis:
                         est_g = est.copy(**grids[gi])
@@ -716,10 +756,17 @@ class Validator:
                             ctx, yd, wd, md, n_classes=n_classes,
                             multiclass=multicls))
                 del ctx  # free the binned matrix before the next group
+            if fuse_failures:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "config-fused sweep: %d group(s) fell back to the "
+                    "per-config route this sweep", fuse_failures)
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
                            grid=g, metric_name=metric,
-                           fold_metrics=results[gi], route="mask_folds")
+                           fold_metrics=results[gi],
+                           route=("mask_folds:grid_fused"
+                                  if gi in fused_gis else "mask_folds"))
             for gi, g in enumerate(grids)
         ]
 

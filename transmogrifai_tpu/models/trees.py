@@ -309,32 +309,18 @@ class _TreeEstimator(PredictorEstimator):
         F = masks.shape[0]
         n = y.shape[0]
         G = len(grids)
-        # chunk size: the fused kernel's VMEM residents scale with lane
-        # count, HBM carries 4 lane-sized f32 planes (W, g, h, margins),
-        # and Mosaic's layout search explodes when the out block nears
-        # the scoped-VMEM boundary (r5 session 2: 20+ min compiles at a
-        # 16MB out block) — cap all three
-        hbm_lane_budget = int(os.environ.get(
-            "TMOG_GRID_FUSE_HBM_LANES", "64"))
-        out_mb_cap = float(os.environ.get("TMOG_GRID_FUSE_OUT_MB", "8"))
-        # worst-level slot count: non-root levels histogram LEFT children
-        # only (sibling subtraction), so the deepest pass carries
-        # 2^(depth-2) slots — same accounting as fused_hist_fits
-        n_slots = 1 << max(depth - 2, 0)
-
-        def out_mb(lanes):
-            return lanes * n_slots * 3 * Xb.shape[1] * (n_bins + 1) \
-                * 4 / 1e6
-
-        chunk = G
-        while chunk > 1 and (
-                not pallas_hist.fused_hist_fits(
-                    Xb.shape[1], n_bins + 1, chunk * F, depth)
-                or chunk * F > hbm_lane_budget
-                or out_mb(chunk * F) > out_mb_cap):
-            chunk = (chunk + 1) // 2
-        if chunk == 1 and not pallas_hist.fused_hist_fits(
-                Xb.shape[1], n_bins + 1, F, depth):
+        # chunk size from the single planner (ops/pallas_hist
+        # plan_lane_chunk): the fused kernel's VMEM residents scale with
+        # lane count, HBM carries 4 lane-sized f32 planes (W, g, h,
+        # margins), and Mosaic's layout search explodes when the out
+        # block nears the scoped-VMEM boundary (r5 session 2: 20+ min
+        # compiles at a 16MB out block) — the planner gates all three,
+        # INCLUDING at chunk == 1 (a single config's fold lanes that
+        # clear the VMEM gate can still bust the HBM/out-block caps;
+        # ADVICE round 5), where 0 falls back per-config
+        chunk = pallas_hist.plan_lane_chunk(
+            Xb.shape[1], n_bins + 1, F, G, depth)
+        if chunk == 0:
             return None
 
         loss = "squared" if regression else "logistic"
@@ -349,9 +335,14 @@ class _TreeEstimator(PredictorEstimator):
                 w_g = est_g._apply_spw(y, w, n_classes, multiclass) \
                     if hasattr(est_g, "_apply_spw") else w
                 Ws.append(masks * w_g[None, :])
-            W_lanes = jnp.concatenate(Ws, axis=0)          # [g*F, n]
+            # FOLD-MAJOR lanes (fold slow, config fast): all configs of a
+            # fold sit adjacent in the batched kernel's lane axis, and
+            # the 5 folds share one residency of the binned matrix per
+            # program — lane = f * g_here + config
+            W_lanes = jnp.stack(Ws, axis=0).transpose(1, 0, 2) \
+                .reshape(g_here * F, n)                    # [F*g, n]
             lane_vec = {
-                key: jnp.repeat(jnp.asarray(
+                key: jnp.tile(jnp.asarray(
                     [float(k.get(key, self._LANE_DEFAULTS[key]))
                      for k in sub], jnp.float32), F)
                 for key in self._LANE_KEYS}
@@ -359,11 +350,48 @@ class _TreeEstimator(PredictorEstimator):
                       if k not in self._LANE_KEYS}
             # the signature pins one seed per group; honor the grid's
             key = self.copy(**grids[lo])._key()
-            _, _, margins = T.fit_gbt_folds(
-                Xb, y, W_lanes, key, n_bins=n_bins, loss=loss,
-                **shared, **lane_vec)
-            outs.append(margins.reshape(g_here, F, n))
+            _, _, margins = self._timed_fused_fit(
+                "tree_sweep_grid_fused", Xb, g_here * F, depth,
+                shared["n_rounds"],
+                lambda: T.fit_gbt_folds(
+                    Xb, y, W_lanes, key, n_bins=n_bins, loss=loss,
+                    **shared, **lane_vec))
+            outs.append(margins.reshape(F, g_here, n).transpose(1, 0, 2))
         return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+    # (label, shape signature) pairs whose fused program has already run
+    # once this process — the first run's wall includes jit trace +
+    # Mosaic compile (documented 20+ min at sweep shapes), so its span
+    # is marked cold and readers must compare warm spans only
+    _WARM_FUSED_SHAPES: set = set()
+
+    @staticmethod
+    def _timed_fused_fit(label, Xb, lanes, depth, n_rounds, call):
+        """Run one fused-sweep fit; when stage metrics are being
+        collected, time it to completion and record a kernel-roofline
+        span (analytic HBM bytes from the single traffic model in
+        ops/pallas_hist) so BENCH_*.json can report achieved GB/s and
+        %-of-roof without a hand-run roofline script. The first span per
+        (label, shape) carries cold=True: its wall contains the compile,
+        not just the kernel, and would wildly understate achieved GB/s."""
+        from ..utils.metrics import collector
+        if not collector.enabled:
+            return call()
+        import time
+        from ..ops import pallas_hist
+        sig = (label, Xb.shape, str(Xb.dtype), lanes, depth, n_rounds)
+        cold = sig not in _TreeEstimator._WARM_FUSED_SHAPES
+        t0 = time.perf_counter()
+        out = call()
+        jax.block_until_ready(out)
+        collector.kernel(
+            label, time.perf_counter() - t0,
+            pallas_hist.fused_fit_bytes(
+                Xb.shape[0], Xb.shape[1], lanes, depth, n_rounds,
+                xb_itemsize=Xb.dtype.itemsize),
+            cold=cold)
+        _TreeEstimator._WARM_FUSED_SHAPES.add(sig)
+        return out
 
     def _fused_route_ok(self, ctx, y, masks=None, depth=None):
         """Shared gate for the fold-fused booster path: live pallas on a
@@ -715,9 +743,12 @@ class _GBTBase(_TreeEstimator):
         if not self._fused_route_ok(ctx, y, masks, kw["depth"]):
             return None
         Xb, edges, n_bins = ctx
-        _, _, margins = T.fit_gbt_folds(
-            Xb, y, masks * w[None, :], self._key(), n_bins=n_bins,
-            loss=self._loss, **kw)
+        _, _, margins = self._timed_fused_fit(
+            "tree_sweep_fold_fused", Xb, masks.shape[0], kw["depth"],
+            kw["n_rounds"],
+            lambda: T.fit_gbt_folds(
+                Xb, y, masks * w[None, :], self._key(), n_bins=n_bins,
+                loss=self._loss, **kw))
         return margins
 
     def _mask_score_host(self, ctx, y, w, n_classes, multiclass):
@@ -908,10 +939,13 @@ class _XGBBase(_TreeEstimator):
         if not self._fused_route_ok(ctx, y, masks, kw["depth"]):
             return None
         Xb, edges, n_bins = ctx
-        _, _, margins = T.fit_gbt_folds(
-            Xb, y, masks * w[None, :], self._key(), n_bins=n_bins,
-            loss="squared" if self._regression else "logistic",
-            **kw)
+        _, _, margins = self._timed_fused_fit(
+            "tree_sweep_fold_fused", Xb, masks.shape[0], kw["depth"],
+            kw["n_rounds"],
+            lambda: T.fit_gbt_folds(
+                Xb, y, masks * w[None, :], self._key(), n_bins=n_bins,
+                loss="squared" if self._regression else "logistic",
+                **kw))
         return margins
 
     def _mask_score(self, ctx, y, w, n_classes, multiclass):

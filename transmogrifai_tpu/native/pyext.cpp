@@ -356,6 +356,17 @@ PyObject* extract_key_columns(PyObject*, PyObject* args) {
   if (!result) return nullptr;
   std::vector<PyObject*> cols(ks.n);  // borrowed (result owns)
   for (Py_ssize_t j = 0; j < ks.n; j++) {
+    // duplicate keys would make the later PyDict_SetItem replace (and
+    // free) an earlier column while cols[] still holds its borrowed
+    // pointer — enforce the no-duplicate invariant here instead of
+    // assuming the caller upheld it
+    int dup = PyDict_Contains(result, ks.items[j]);
+    if (dup < 0) goto fail;
+    if (dup) {
+      PyErr_Format(PyExc_ValueError,
+                   "extract_key_columns: duplicate key %R", ks.items[j]);
+      goto fail;
+    }
     PyObject* lst = PyList_New(fs.n);
     if (!lst) goto fail;
     for (Py_ssize_t i = 0; i < fs.n; i++) {

@@ -26,6 +26,7 @@ path behind the reference's OpXGBoost* wrappers (SURVEY §2.9).
 """
 from __future__ import annotations
 
+import dataclasses
 import functools
 import os
 
@@ -80,11 +81,27 @@ def _vmem_limit() -> int:
     return (100 << 20) if _is_v5_plus() else (12 << 20)
 
 
-def fused_hist_fits(n_feat: int, n_bins: int, n_folds: int, depth: int,
-                    channels: int = 3) -> bool:
-    """Will the fold-fused histogram kernel's VMEM residents fit?
+@dataclasses.dataclass(frozen=True)
+class HistPlan:
+    """Tile/residency plan for one fused multi-(fold x config-lane)
+    histogram program — the single place tile shapes are derived from
+    (rows, cols, slots, lanes). Produced by plan_fused_hist; consumed by
+    the sweep chunker (plan_lane_chunk / models/trees) and the VMEM gate
+    (fused_hist_fits)."""
 
-    The fused output block [n_folds * n_slots * channels, F * B] f32 is
+    lanes: int        # fold x config lanes resident in one program
+    n_slots: int      # worst-level slot count budgeted (2^(depth-2))
+    blk: int          # rows per grid step (the HBM->VMEM tile height)
+    out_bytes: int    # fused output block, fully VMEM-resident
+    vmem_bytes: int   # estimated total VMEM residents
+    fits: bool        # vmem_bytes within the device budget
+
+
+def plan_fused_hist(n_feat: int, n_bins: int, lanes: int, depth: int,
+                    channels: int = 3) -> HistPlan:
+    """Plan VMEM residency for the fused histogram kernel at this shape.
+
+    The fused output block [lanes * n_slots * channels, F * B] f32 is
     fully VMEM-resident and scales with every one of those factors;
     block_rows only budgets the one-hot tile, so XGB-shaped configs
     (256 bins, depth 6, a few hundred features, 3-5 folds) would sail
@@ -92,19 +109,135 @@ def fused_hist_fits(n_feat: int, n_bins: int, n_folds: int, depth: int,
     level is the deepest histogram pass: sibling subtraction halves the
     slot count, so n_slots = 2^(depth-2) for depth >= 2. Residents:
     output block + the [F*B, blk] f32 one-hot tile (+ a bf16 copy when
-    the bf16 input mode is on) + the f32 Xb/payload/slot tiles.
-    Callers (models/trees._fused_route_ok) fall back to the sequential
-    per-fold path when this returns False.
+    the bf16 input mode is on) + the f32 Xb/payload/slot tiles + the
+    route-fused node one-hot tile (the route+hist kernel keeps a
+    [n_pad, blk] node one-hot alive next to the histogram operands).
     """
     cols = n_feat * n_bins
     n_slots = 1 << max(depth - 2, 0)
-    out_b = n_folds * n_slots * channels * cols * 4
+    out_b = lanes * n_slots * channels * cols * 4
     blk = block_rows(cols)
     onehot_b = cols * blk * 4
     if _HIST_BF16:
         onehot_b += cols * blk * 2
-    minor_b = (n_feat + n_folds * channels + n_folds) * blk * 8
-    return out_b + onehot_b + minor_b <= _vmem_limit()
+    minor_b = (n_feat + lanes * channels + lanes) * blk * 8
+    # route-fused node one-hot: worst routed level has 2^(depth-2) nodes,
+    # minor-padded to 128 lanes (the final level routes through the
+    # standalone route kernel, whose residents are strictly smaller)
+    route_b = max(-(-n_slots // 128) * 128, 128) * blk * 4
+    vmem = out_b + onehot_b + minor_b + route_b
+    return HistPlan(lanes=lanes, n_slots=n_slots, blk=blk, out_bytes=out_b,
+                    vmem_bytes=vmem, fits=vmem <= _vmem_limit())
+
+
+def fused_hist_fits(n_feat: int, n_bins: int, n_folds: int, depth: int,
+                    channels: int = 3) -> bool:
+    """Will the fold-fused histogram kernel's VMEM residents fit? (Thin
+    gate over plan_fused_hist; callers — models/trees._fused_route_ok —
+    fall back to the sequential per-fold path when this returns False.)"""
+    return plan_fused_hist(n_feat, n_bins, n_folds, depth, channels).fits
+
+
+def plan_lane_chunk(n_feat: int, n_bins: int, n_folds: int, n_configs: int,
+                    depth: int, channels: int = 3) -> int:
+    """Configs per fused sweep program, honoring every budget at once.
+
+    The single planner for the config-fused sweep: lanes = configs x
+    folds share one residency of the binned matrix, but three budgets cap
+    how many fit one program — the VMEM plan (plan_fused_hist), the HBM
+    lane budget (TMOG_GRID_FUSE_HBM_LANES: each lane carries 4 lane-sized
+    f32 planes — W, g, h, margins), and the fused output block cap
+    (TMOG_GRID_FUSE_OUT_MB: Mosaic's layout search explodes when the out
+    block nears the scoped-VMEM boundary; r5 session 2 saw 20+ min
+    compiles at a 16MB block). Returns the largest config chunk (halving
+    from n_configs) that clears ALL THREE, and 0 when even a single
+    config's fold lanes violate any cap — callers must then fall back to
+    the per-config route (a chunk of 1 that only cleared the VMEM gate
+    used to sail past the HBM/out-block caps; ADVICE round 5)."""
+    hbm_lane_budget = int(os.environ.get("TMOG_GRID_FUSE_HBM_LANES", "64"))
+    out_mb_cap = float(os.environ.get("TMOG_GRID_FUSE_OUT_MB", "8"))
+
+    def ok(chunk: int) -> bool:
+        lanes = chunk * n_folds
+        plan = plan_fused_hist(n_feat, n_bins, lanes, depth, channels)
+        return (plan.fits and lanes <= hbm_lane_budget
+                and plan.out_bytes / 1e6 <= out_mb_cap)
+
+    chunk = max(n_configs, 1)
+    while chunk > 1 and not ok(chunk):
+        chunk = (chunk + 1) // 2
+    if chunk == 1 and not ok(1):
+        return 0
+    return chunk
+
+
+# -- analytic HBM traffic (roofline accounting) -----------------------------
+
+def sweep_level_bytes(n_rows: int, n_feat: int, lanes: int, *,
+                      channels: int = 2, xb_itemsize: int = 1,
+                      fused=True) -> int:
+    """Analytic HBM bytes moved for ONE mid-sweep tree level.
+
+    Three routes, honest about what each actually streamed:
+
+    fused='per_fold' (or False): the sequential per-lane route (r5's
+    fallback when fold fusion was gated off) — every lane re-streams the
+    binned matrix for its histogram pass AND again for its routing pass,
+    plus per-lane payload (g/h, `channels` f32 planes), the slot plane
+    and the node read+write.
+
+    fused='r5' models what the r5 production TPU route ACTUALLY moved
+    per config: the fold axis was already fused (one hist_pallas + one
+    route_pallas per level for all `lanes` folds, so Xb streams twice
+    per level total), but the count channel was its own HBM plane and
+    routing was a separate pass.
+
+    fused='fused' (or True): the batched route+hist kernel — ONE
+    residency of the binned matrix serves every (fold x config) lane,
+    the count channel is derived in VMEM from the hessian (no HBM
+    plane), and routing rides the same pass (node read + next-level node
+    write per lane).
+
+    The bench/tools roofline reports are computed from this single model
+    so the numbers cannot drift from the kernels they describe.
+    """
+    mode = {True: "fused", False: "per_fold"}.get(fused, fused)
+    xb = n_rows * n_feat * xb_itemsize
+    pay = channels * 4 * n_rows            # g/h f32 planes per lane
+    node = 4 * n_rows                      # f32 slot/node plane
+    if mode == "per_fold":
+        # hist pass: Xb + payload + count plane + slot ids; route pass:
+        # Xb again + node read + node write
+        per_lane = 2 * xb + pay + 2 * node + 2 * node
+        return lanes * per_lane
+    if mode == "r5":
+        # fold-fused hist pass (payload + streamed count + slot ids per
+        # lane) + separate fold-fused route pass (node read + write)
+        return 2 * xb + lanes * (pay + 2 * node + 2 * node)
+    if mode != "fused":
+        raise ValueError(f"unknown traffic mode {fused!r}")
+    return xb + lanes * (pay + 2 * node)   # node read + new-node write
+
+
+def fused_fit_bytes(n_rows: int, n_feat: int, lanes: int, depth: int,
+                    n_rounds: int, *, xb_itemsize: int = 1) -> int:
+    """Analytic HBM bytes for one whole fused-sweep GBT fit (all rounds).
+
+    Per round: the level-0 histogram pass (Xb + per-lane payload + slot),
+    depth-1 fused route+hist passes (_grow_tree_folds calls route_hist
+    for every d in 0..depth-2; sweep_level_bytes each), the final
+    standalone route (Xb + node read/write per lane) and the leaf lookup
+    + margin update (3 lane planes). Used by the sweep's roofline spans
+    (utils/metrics collector) — analytic by construction since the whole
+    fit is one jitted program."""
+    xb = n_rows * n_feat * xb_itemsize
+    plane = 4 * n_rows
+    level0 = xb + lanes * (2 * plane + plane)      # g/h + slot ids
+    mid = max(depth - 1, 0) * sweep_level_bytes(
+        n_rows, n_feat, lanes, xb_itemsize=xb_itemsize, fused=True)
+    final_route = (xb + lanes * 2 * plane) if depth >= 1 else 0
+    leaf_margin = lanes * 3 * plane
+    return n_rounds * (level0 + mid + final_route + leaf_margin)
 
 
 # THE pallas kill switch — single flag for every consumer (tree
@@ -197,10 +330,45 @@ def set_variant(name: str) -> None:
         for fn in _cache_consumers:
             fn.clear_cache()
         _hist_pallas_jit.clear_cache()
+        _route_hist_pallas_jit.clear_cache()
+
+
+def _feature_onehot(xf, *, F, B, blk, variant, use_bf16):
+    """(feature, bin) one-hot tile [F*B, blk] — the shared VPU expansion
+    both histogram kernels contract against. Comparisons must run in f32
+    (Mosaic rejects bf16 cmpf vectors, like the f32-iota restriction
+    below); bf16 mode therefore builds the one-hot feature-by-feature,
+    casting each [B, blk] slice down immediately — one full-size f32
+    one-hot next to its bf16 copy would blow the 16MB scoped-VMEM stack.
+    Mosaic's tpu.iota only produces integer vectors; build int32 and cast
+    (f32 iota verified fine in interpret mode but fails TPU lowering)."""
+    mxu_dtype = jnp.bfloat16 if use_bf16 else jnp.float32
+    if variant == "concat" or use_bf16:
+        bins2 = jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0) \
+            .astype(jnp.float32)                            # [B, 1]
+        return jnp.concatenate(
+            [(xf[f:f + 1, :] == bins2).astype(mxu_dtype)    # [B, blk]
+             for f in range(F)], axis=0)                    # [F*B, blk]
+    bins = jax.lax.broadcasted_iota(jnp.int32, (1, B, 1), 1) \
+        .astype(jnp.float32)
+    oh = (xf[:, None, :] == bins).astype(jnp.float32)       # [F, B, blk]
+    return oh.reshape(F * B, blk)
+
+
+def _fold_payload(pay_ref, k, C, mxu_dtype, derive_count):
+    """Fold k's payload rows, with the unit-count channel derived in VMEM
+    when derive_count: count = (h > 0) on the LAST input channel (the
+    hessian) — exactly grow_tree's count_unit, computed on the VPU
+    instead of streamed as its own HBM plane."""
+    pay = pay_ref[k * C:(k + 1) * C, :]                     # [C, blk] f32
+    if derive_count:
+        cnt = (pay[C - 1:C, :] > 0.0).astype(jnp.float32)
+        pay = jnp.concatenate([pay, cnt], axis=0)           # [C+1, blk]
+    return pay.astype(mxu_dtype)
 
 
 def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots,
-            n_folds, variant, use_bf16=False):
+            n_folds, variant, use_bf16=False, derive_count=False):
     import jax.experimental.pallas as pl
 
     @pl.when(pl.program_id(0) == 0)
@@ -209,62 +377,54 @@ def _kernel(xb_ref, pay_ref, slot_ref, out_ref, *, F, B, C, n_slots,
 
     blk = xb_ref.shape[1]
     mxu_dtype = jnp.bfloat16 if use_bf16 else jnp.float32
-    # comparisons must run in f32 (Mosaic rejects bf16 cmpf vectors, like
-    # the f32-iota restriction below); bf16 mode therefore builds the
-    # one-hot feature-by-feature, casting each [B, blk] slice down
-    # immediately — one full-size f32 one-hot next to its bf16 copy would
-    # blow the 16MB scoped-VMEM stack
     xf = xb_ref[:].astype(jnp.float32)                      # [F, blk]
-    # Mosaic's tpu.iota only produces integer vectors; build int32 and
-    # cast (f32 iota verified fine in interpret mode but fails TPU
-    # lowering)
-    if variant == "concat" or use_bf16:
-        bins2 = jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0) \
-            .astype(jnp.float32)                            # [B, 1]
-        oh = jnp.concatenate(
-            [(xf[f:f + 1, :] == bins2).astype(mxu_dtype)    # [B, blk]
-             for f in range(F)], axis=0)                    # [F*B, blk]
-    else:
-        bins = jax.lax.broadcasted_iota(jnp.int32, (1, B, 1), 1) \
-            .astype(jnp.float32)
-        oh = (xf[:, None, :] == bins).astype(jnp.float32)   # [F, B, blk]
-        oh = oh.reshape(F * B, blk)
+    oh = _feature_onehot(xf, F=F, B=B, blk=blk, variant=variant,
+                         use_bf16=use_bf16)
 
     # fold-fused: each fold contributes its own slot one-hot x payload
     # rows to ONE contraction, so the (feature, bin) one-hot above — the
     # dominant VPU cost — and the Xb traffic are built once for all folds,
     # and the matmul M dim grows n_folds x (the single-fold M of S*C rows
     # is far below the 128-row MXU tile; see BENCH_NOTES round-4 session 2)
+    Co = C + (1 if derive_count else 0)
     slots = jax.lax.broadcasted_iota(jnp.int32, (n_slots, blk), 0) \
         .astype(jnp.float32)
     qs = []
     for k in range(n_folds):
         slot = slot_ref[k:k + 1, :]                         # [1, blk]
         slot_oh = (slots == slot).astype(mxu_dtype)         # [n_slots, blk]
-        pay = pay_ref[k * C:(k + 1) * C, :].astype(mxu_dtype)
+        pay = _fold_payload(pay_ref, k, C, mxu_dtype, derive_count)
         qs.append((slot_oh[:, None, :] * pay[None, :, :])
-                  .reshape(n_slots * C, blk))
+                  .reshape(n_slots * Co, blk))
     q = qs[0] if n_folds == 1 else jnp.concatenate(qs, axis=0)
 
     out_ref[:] += jax.lax.dot_general(
         q, oh, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)                 # [Fo*S*C, F*B]
+        preferred_element_type=jnp.float32)                 # [Fo*S*Co, F*B]
 
 
 def hist_pallas(Xb_t: jax.Array, pay_t: jax.Array, slot_t: jax.Array,
                 *, n_slots: int, n_bins: int,
                 interpret: bool = False,
-                allow_bf16: bool = False) -> jax.Array:
-    """Gradient histograms [n_folds * n_slots * C, F * n_bins] (f32).
+                allow_bf16: bool = False,
+                derive_count: bool = False) -> jax.Array:
+    """Gradient histograms [n_folds * n_slots * Co, F * n_bins] (f32).
 
     Xb_t [F, N] int bins; pay_t [n_folds * C, N] f32 payload channels;
     slot_t [n_folds, N] f32 slot ids (n_slots drops the row). The fold
     axis batches independent slot assignments over the SAME binned matrix
-    (CV fold masks in the tree sweep): one (feature, bin) one-hot serves
-    every fold and the contraction M dim scales with n_folds. n_folds is
-    slot_t's leading dim (C must divide pay_t's). Ragged N pads internally
-    with dropped-slot rows; the block size adapts to the one-hot width so
-    VMEM tiles stay bounded (see block_rows).
+    (CV fold masks AND fused config lanes in the tree sweep): one
+    (feature, bin) one-hot serves every lane and the contraction M dim
+    scales with n_folds. n_folds is slot_t's leading dim (C must divide
+    pay_t's). Ragged N pads internally with dropped-slot rows; the block
+    size adapts to the one-hot width so VMEM tiles stay bounded (see
+    block_rows), and the sequential grid double-buffers the HBM->VMEM
+    tile streams (pallas pipelines the next block's DMA under the current
+    block's contraction).
+
+    derive_count: append a unit-count channel computed IN VMEM as
+    (last-channel > 0) — grow_tree's count_unit = (H > 0) without its own
+    HBM plane (Co = C + 1; counts stay integer-exact, bf16 included).
 
     allow_bf16: opt-in to bf16 contraction INPUTS (f32 accumulation) when
     the module flag agrees (TMOG_HIST_BF16, default on) — the tree-fit
@@ -277,14 +437,22 @@ def hist_pallas(Xb_t: jax.Array, pay_t: jax.Array, slot_t: jax.Array,
     """
     return _hist_pallas_jit(Xb_t, pay_t, slot_t, n_slots=n_slots,
                             n_bins=n_bins, interpret=interpret,
-                            use_bf16=allow_bf16 and _HIST_BF16)
+                            use_bf16=allow_bf16 and _HIST_BF16,
+                            derive_count=derive_count)
+
+
+def _check_variant():
+    if _VARIANT not in _VARIANTS:  # env typo must not silently re-run
+        raise ValueError(          # the default variant as false evidence
+            f"TMOG_PALLAS_HIST_VARIANT={_VARIANT!r}; expected one of "
+            f"{_VARIANTS}")
 
 
 @functools.partial(jax.jit,
                    static_argnames=("n_slots", "n_bins", "interpret",
-                                    "use_bf16"))
+                                    "use_bf16", "derive_count"))
 def _hist_pallas_jit(Xb_t, pay_t, slot_t, *, n_slots, n_bins,
-                     interpret, use_bf16):
+                     interpret, use_bf16, derive_count=False):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -294,6 +462,7 @@ def _hist_pallas_jit(Xb_t, pay_t, slot_t, *, n_slots, n_bins,
         raise ValueError(f"pay_t channels {pay_t.shape[0]} not a multiple "
                          f"of slot_t folds {n_folds}")
     C = pay_t.shape[0] // n_folds
+    Co = C + (1 if derive_count else 0)
     B = n_bins
     blk = block_rows(F * B)
     pad = (-N) % blk
@@ -304,13 +473,10 @@ def _hist_pallas_jit(Xb_t, pay_t, slot_t, *, n_slots, n_bins,
                          constant_values=float(n_slots))  # dropped
         N += pad
 
-    if _VARIANT not in _VARIANTS:  # env typo must not silently re-run
-        raise ValueError(          # the default variant as false evidence
-            f"TMOG_PALLAS_HIST_VARIANT={_VARIANT!r}; expected one of "
-            f"{_VARIANTS}")
+    _check_variant()
     kernel = functools.partial(_kernel, F=F, B=B, C=C, n_slots=n_slots,
                                n_folds=n_folds, variant=_VARIANT,
-                               use_bf16=use_bf16)
+                               use_bf16=use_bf16, derive_count=derive_count)
     return pl.pallas_call(
         kernel,
         grid=(N // blk,),
@@ -323,12 +489,65 @@ def _hist_pallas_jit(Xb_t, pay_t, slot_t, *, n_slots, n_bins,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (n_folds * n_slots * C, F * B), lambda i: (0, 0),
+            (n_folds * n_slots * Co, F * B), lambda i: (0, 0),
             memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
-            (n_folds * n_slots * C, F * B), jnp.float32),
+            (n_folds * n_slots * Co, F * B), jnp.float32),
         interpret=interpret,
     )(Xb_t, pay_t, slot_t)
+
+
+def _hist_segment_jnp(Xb_t, pay_t, slot_t, *, n_slots, n_bins,
+                      derive_count=False):
+    """Pure-jnp twin of hist_pallas (CPU/GPU fallback): one fused
+    segment-sum per fold lane over (slot, feature, bin) cells, same
+    [n_folds * n_slots * Co, F * B] output layout. Out-of-range slot ids
+    (>= n_slots — padding / sibling-subtraction drops) land in a spill
+    segment that is sliced away."""
+    F, N = Xb_t.shape
+    n_folds = slot_t.shape[0]
+    C = pay_t.shape[0] // n_folds
+    B = n_bins
+    fb = (jnp.arange(F, dtype=jnp.int32)[:, None] * B
+          + Xb_t.astype(jnp.int32))                          # [F, N]
+    seg = n_slots * F * B
+
+    def one_fold(slot_k, pay_k):
+        if derive_count:
+            cnt = (pay_k[C - 1:C, :] > 0.0).astype(jnp.float32)
+            pay_k = jnp.concatenate([pay_k, cnt], axis=0)
+        Co = pay_k.shape[0]
+        slot_i = slot_k.astype(jnp.int32)                    # [N]
+        ids = jnp.where(slot_i[None, :] >= n_slots, seg,
+                        slot_i[None, :] * (F * B) + fb)      # [F, N]
+        data = jnp.broadcast_to(pay_k[:, None, :], (Co, F, N))
+        hist = jax.ops.segment_sum(
+            data.reshape(Co, F * N).T, ids.reshape(-1),
+            num_segments=seg + 1)[:seg]                      # [seg, Co]
+        return hist.reshape(n_slots, F, B, Co) \
+            .transpose(0, 3, 1, 2).reshape(n_slots * Co, F * B)
+
+    pay_f = pay_t.reshape(n_folds, C, N)
+    out = jax.vmap(one_fold)(slot_t, pay_f)                  # [Fo, S*Co, FB]
+    return out.reshape(-1, F * B)
+
+
+def hist_folds(Xb_t: jax.Array, pay_t: jax.Array, slot_t: jax.Array, *,
+               n_slots: int, n_bins: int, interpret: bool = False,
+               allow_bf16: bool = False,
+               derive_count: bool = False) -> jax.Array:
+    """Batched multi-(fold x lane) histogram dispatcher: the VMEM pallas
+    kernel on a live TPU (or in interpret mode for tests), the pure-jnp
+    segment-sum fallback everywhere else — same signature and output
+    layout as hist_pallas, so CPU CI exercises the exact call shape the
+    TPU sweep runs."""
+    if interpret or available():
+        return hist_pallas(Xb_t, pay_t, slot_t, n_slots=n_slots,
+                           n_bins=n_bins, interpret=interpret,
+                           allow_bf16=allow_bf16,
+                           derive_count=derive_count)
+    return _hist_segment_jnp(Xb_t, pay_t, slot_t, n_slots=n_slots,
+                             n_bins=n_bins, derive_count=derive_count)
 
 
 # -- level routing ----------------------------------------------------------
@@ -429,6 +648,195 @@ def route_pallas(Xb_t: jax.Array, node_t: jax.Array, f_lvl: jax.Array,
     return out[:, :n_orig]
 
 
+def _route_level_jnp(Xb_t, node_t, f_lvl, t_lvl, m_lvl):
+    """Gather-form twin of route_pallas's decision (CPU fallback). Node
+    ids must be in-range [0, n_nodes) — true for every caller (routing
+    always starts at node 0 and doubles)."""
+    node_i = node_t.astype(jnp.int32)                        # [Fo, N]
+    f = jnp.take_along_axis(f_lvl, node_i, axis=1)           # [Fo, N]
+    t = jnp.take_along_axis(t_lvl, node_i, axis=1)
+    mdir = jnp.take_along_axis(m_lvl, node_i, axis=1)
+    xsel = jnp.take_along_axis(Xb_t.astype(jnp.int32), f, axis=0)
+    right = (xsel > t) | ((xsel == 0) & (mdir > 0))
+    return node_t * 2.0 + right.astype(jnp.float32)
+
+
+def route(Xb_t: jax.Array, node_t: jax.Array, f_lvl: jax.Array,
+          t_lvl: jax.Array, m_lvl: jax.Array, *, n_nodes: int,
+          interpret: bool = False) -> jax.Array:
+    """Level-routing dispatcher: route_pallas on a live TPU / in
+    interpret mode, the gather form on CPU (identical decisions — the
+    pallas selected-bin is a single f32-exact one-hot term)."""
+    if interpret or available():
+        return route_pallas(Xb_t, node_t, f_lvl, t_lvl, m_lvl,
+                            n_nodes=n_nodes, interpret=interpret)
+    return _route_level_jnp(Xb_t, node_t, f_lvl, t_lvl, m_lvl)
+
+
+# -- fused route + histogram ------------------------------------------------
+# One pass of the binned matrix per level instead of two: the level-d
+# split tables route every row IN VMEM and the surviving (left-child)
+# slot ids feed the level-(d+1) histogram contraction in the same grid
+# step — the route pass's separate HBM read of Xb disappears. Works
+# because new_node = 2*node + right is even exactly when the row goes
+# left, and sibling subtraction histograms LEFT children only: the
+# level-(d+1) slot id of a left row is its OLD node id, known the moment
+# `right` is computed. Fold lanes (CV folds x fused config lanes) share
+# the Xb read and the (feature, bin) one-hot exactly as in _kernel.
+
+
+def _route_hist_kernel(xb_ref, pay_ref, node_ref, tbl_ref, hist_ref,
+                       node_out_ref, *, F, B, C, n_nodes, n_pad, n_folds,
+                       variant, use_bf16=False, derive_count=False):
+    import jax.experimental.pallas as pl
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        hist_ref[:] = jnp.zeros_like(hist_ref)
+
+    blk = xb_ref.shape[1]
+    mxu_dtype = jnp.bfloat16 if use_bf16 else jnp.float32
+    xf = xb_ref[:].astype(jnp.float32)                      # [F, blk]
+    oh = _feature_onehot(xf, F=F, B=B, blk=blk, variant=variant,
+                         use_bf16=use_bf16)
+    fi = jax.lax.broadcasted_iota(jnp.int32, (F, blk), 0) \
+        .astype(jnp.float32)
+    ni = jax.lax.broadcasted_iota(jnp.int32, (n_pad, blk), 0) \
+        .astype(jnp.float32)
+    slots = jax.lax.broadcasted_iota(jnp.int32, (n_nodes, blk), 0) \
+        .astype(jnp.float32)
+    Co = C + (1 if derive_count else 0)
+    rows, qs = [], []
+    for k in range(n_folds):
+        node = node_ref[k:k + 1, :]                         # [1, blk]
+        noh = (ni == node).astype(jnp.float32)              # [n_pad, blk]
+        tbl = tbl_ref[3 * k:3 * k + 3, :]                   # [3, n_pad]
+        ftm = jax.lax.dot_general(                          # [3, blk]
+            tbl, noh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        mask = (fi == ftm[0:1, :]).astype(jnp.float32)      # [F, blk]
+        xsel = jnp.sum(xf * mask, axis=0, keepdims=True)    # [1, blk]
+        rightf = jnp.logical_or(
+            xsel > ftm[1:2, :],
+            jnp.logical_and(xsel == 0.0, ftm[2:3, :] > 0.5)
+        ).astype(jnp.float32)                               # [1, blk]
+        rows.append(2.0 * node + rightf)
+        # next level's LEFT-child slot id = old node for left rows; right
+        # rows shift past the iota range (node + n_nodes >= n_nodes) —
+        # the same dropped-slot encoding hist_pallas uses for padding
+        slot_oh = (slots == node + float(n_nodes) * rightf) \
+            .astype(mxu_dtype)                              # [n_nodes, blk]
+        pay = _fold_payload(pay_ref, k, C, mxu_dtype, derive_count)
+        qs.append((slot_oh[:, None, :] * pay[None, :, :])
+                  .reshape(n_nodes * Co, blk))
+    q = qs[0] if n_folds == 1 else jnp.concatenate(qs, axis=0)
+    hist_ref[:] += jax.lax.dot_general(
+        q, oh, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                 # [Fo*S*Co, F*B]
+    node_out_ref[:] = rows[0] if n_folds == 1 else \
+        jnp.concatenate(rows, axis=0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_nodes", "n_bins", "interpret",
+                                    "use_bf16", "derive_count"))
+def _route_hist_pallas_jit(Xb_t, pay_t, node_t, f_lvl, t_lvl, m_lvl, *,
+                           n_nodes, n_bins, interpret, use_bf16,
+                           derive_count=False):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    F, N = Xb_t.shape
+    n_orig = N
+    Fo = node_t.shape[0]
+    if pay_t.shape[0] % Fo:
+        raise ValueError(f"pay_t channels {pay_t.shape[0]} not a multiple "
+                         f"of node_t folds {Fo}")
+    C = pay_t.shape[0] // Fo
+    Co = C + (1 if derive_count else 0)
+    B = n_bins
+    tbl = jnp.stack([f_lvl.astype(jnp.float32),
+                     t_lvl.astype(jnp.float32),
+                     m_lvl.astype(jnp.float32)], axis=1)    # [Fo, 3, n]
+    tbl = _pad_minor(tbl.reshape(3 * Fo, n_nodes))          # [3Fo, n_pad]
+    n_pad = tbl.shape[1]
+    blk = block_rows(F * B)
+    pad = (-N) % blk
+    if pad:
+        Xb_t = jnp.pad(Xb_t, ((0, 0), (0, pad)))
+        pay_t = jnp.pad(pay_t, ((0, 0), (0, pad)))
+        # padded rows carry node id n_pad: they select no table entry
+        # (route as feature-0/thresh-0, then are sliced away) and can
+        # never match a histogram slot (payload is zero anyway)
+        node_t = jnp.pad(node_t, ((0, 0), (0, pad)),
+                         constant_values=float(n_pad))
+        N += pad
+
+    _check_variant()
+    kernel = functools.partial(_route_hist_kernel, F=F, B=B, C=C,
+                               n_nodes=n_nodes, n_pad=n_pad, n_folds=Fo,
+                               variant=_VARIANT, use_bf16=use_bf16,
+                               derive_count=derive_count)
+    hist, node_out = pl.pallas_call(
+        kernel,
+        grid=(N // blk,),
+        in_specs=[
+            pl.BlockSpec((F, blk), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Fo * C, blk), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Fo, blk), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3 * Fo, n_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((Fo * n_nodes * Co, F * B), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Fo, blk), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Fo * n_nodes * Co, F * B), jnp.float32),
+            jax.ShapeDtypeStruct((Fo, N), jnp.float32),
+        ],
+        interpret=interpret,
+    )(Xb_t, pay_t, node_t, tbl)
+    return hist, node_out[:, :n_orig]
+
+
+def route_hist(Xb_t: jax.Array, pay_t: jax.Array, node_t: jax.Array,
+               f_lvl: jax.Array, t_lvl: jax.Array, m_lvl: jax.Array, *,
+               n_nodes: int, n_bins: int, interpret: bool = False,
+               allow_bf16: bool = False, derive_count: bool = False):
+    """Route one level AND histogram the next level's left children in a
+    single pass over the binned matrix, for every (fold x config) lane.
+
+    Xb_t [F, N] int bins; pay_t [Fo * C, N] f32 payload channels (g/h per
+    lane, fold-major; derive_count appends the in-VMEM unit-count
+    channel); node_t [Fo, N] f32 in-level node ids; f_lvl/t_lvl/m_lvl
+    [Fo, n_nodes] the level's split tables. Returns (hist, new_node):
+    hist [Fo * n_nodes * Co, F * n_bins] — the level-(d+1) LEFT-child
+    histograms (n_slots = this level's n_nodes, sibling-subtraction
+    layout) — and new_node [Fo, N] = 2*node + right, bitwise what
+    route_pallas returns. On CPU the jnp fallback chains the gather-form
+    route with the segment-sum histogram (identical decisions; histogram
+    equal up to f32 summation order).
+    """
+    if interpret or available():
+        return _route_hist_pallas_jit(
+            Xb_t, pay_t, node_t, f_lvl, t_lvl, m_lvl, n_nodes=n_nodes,
+            n_bins=n_bins, interpret=interpret,
+            use_bf16=allow_bf16 and _HIST_BF16,
+            derive_count=derive_count)
+    new_node = _route_level_jnp(Xb_t, node_t, f_lvl, t_lvl, m_lvl)
+    right = new_node - 2.0 * node_t                          # 0/1
+    slots = node_t + float(n_nodes) * right                  # left keeps id
+    hist = _hist_segment_jnp(Xb_t, pay_t, slots, n_slots=n_nodes,
+                             n_bins=n_bins, derive_count=derive_count)
+    return hist, new_node
+
+
 def _lookup_kernel(tbl_ref, idx_ref, out_ref, *, m_pad, n_folds):
     blk = idx_ref.shape[1]
     mi = jax.lax.broadcasted_iota(jnp.int32, (m_pad, blk), 0) \
@@ -483,3 +891,16 @@ def table_lookup_pallas(tbl: jax.Array, idx_t: jax.Array, *,
         out_shape=jax.ShapeDtypeStruct((Fo, N), jnp.float32),
         interpret=interpret,
     )(tblp, idx_t)[:, :n_orig]
+
+
+def table_lookup(tbl: jax.Array, idx_t: jax.Array, *,
+                 interpret: bool = False) -> jax.Array:
+    """Per-fold table-lookup dispatcher: the one-hot contraction kernel
+    on a live TPU / in interpret mode, a plain gather on CPU (same
+    out-of-range -> 0 contract)."""
+    if interpret or available():
+        return table_lookup_pallas(tbl, idx_t, interpret=interpret)
+    M = tbl.shape[1]
+    idx = idx_t.astype(jnp.int32)
+    vals = jnp.take_along_axis(tbl, jnp.clip(idx, 0, M - 1), axis=1)
+    return jnp.where((idx >= 0) & (idx < M), vals, 0.0)

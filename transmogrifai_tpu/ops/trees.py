@@ -320,16 +320,19 @@ def set_pallas_enabled(enabled: bool) -> None:
 
 def _histograms_pallas(Xb, G, H, count_unit, node, n_nodes: int, B: int):
     """Level histograms via the VMEM-resident pallas kernel (transposed
-    operands — see ops/pallas_hist.py for the layout rationale)."""
+    operands — see ops/pallas_hist.py for the layout rationale). The
+    unit-count channel is derived IN VMEM from the hessian plane
+    (count_unit = (H > 0) by construction in every caller), saving one
+    full-N f32 HBM stream per level."""
     from . import pallas_hist
     N, F = Xb.shape
     K = G.shape[1]
     C = K + 2
-    pay = jnp.concatenate(
-        [G.T, H[None, :], count_unit[None, :]], axis=0)      # [C, N]
+    pay = jnp.concatenate([G.T, H[None, :]], axis=0)         # [K+1, N]
     hist = pallas_hist.hist_pallas(
         Xb.T, pay, node[None, :].astype(jnp.float32),
-        n_slots=n_nodes, n_bins=B, allow_bf16=True)          # [nC, F*B]
+        n_slots=n_nodes, n_bins=B, allow_bf16=True,
+        derive_count=True)                                   # [nC, F*B]
     hist = hist.reshape(n_nodes, C, F, B)
     return (hist[:, :K].transpose(0, 2, 3, 1), hist[:, K], hist[:, K + 1])
 
@@ -802,25 +805,31 @@ def fit_gbt(Xb: jax.Array, y: jax.Array, w: jax.Array, key: jax.Array, *,
     return trees, base
 
 
-def _grow_tree_folds(Xb_t, G, H, count_unit, *, depth, n_bins,
+def _grow_tree_folds(Xb_t, G, H, *, depth, n_bins,
                      reg_lambda, min_child_weight, min_instances,
                      min_info_gain, gamma, learning_rate, feature_mask,
                      interpret=False, alpha=0.0, max_delta_step=0.0,
                      level_feature_frac=1.0, level_key=None,
                      feature_mask_count=None):
-    """Grow one tree PER FOLD level-wise in shared pallas passes.
+    """Grow one tree PER FOLD level-wise in shared fused passes.
 
     Xb_t [F, N] transposed bins (N pre-padded to the route block size by
-    the caller); G/H/count_unit [Fo, N] per-fold payloads (excluded and
-    padded rows enter as zeros exactly as in grow_tree). Each level runs
-    ONE fold-fused histogram kernel (pallas_hist.hist_pallas fold axis)
-    and ONE fold-fused routing pass (route_pallas), so the binned matrix
-    is read once per level for every fold together; the per-node split
-    algebra (cumsums, _split_scores, argmax, leaves) is the grow_tree
-    math vmapped over the fold axis. Returns (Tree with leading [Fo]
-    axes, leaf_rows [Fo, N]) where leaf_rows are the learning-rate-scaled
-    per-row leaf payloads — bitwise what predict_bins returns for each
-    fold's tree, read off the final routing state instead of re-traversed.
+    the caller); G/H [Fo, N] per-fold payloads (excluded and padded rows
+    enter as zeros exactly as in grow_tree; the unit-count channel is
+    derived in VMEM as (H > 0) — grow_tree's count_unit — instead of
+    streaming its own HBM plane). Each level past the root arrives from
+    ONE fused route+histogram pass (pallas_hist.route_hist): the level's
+    split tables route every row in VMEM and the surviving left-child
+    slot ids feed the next level's histogram in the same read of the
+    binned matrix, so each level costs ONE Xb pass for every (fold x
+    config) lane together — not a histogram pass plus a routing pass.
+    The per-node split algebra (cumsums, _split_scores, argmax, leaves)
+    is the grow_tree math vmapped over the fold axis. On CPU the
+    dispatchers drop to gather/segment-sum fallbacks (same decisions).
+    Returns (Tree with leading [Fo] axes, leaf_rows [Fo, N]) where
+    leaf_rows are the learning-rate-scaled per-row leaf payloads —
+    bitwise what predict_bins returns for each fold's tree, read off the
+    final routing state instead of re-traversed.
     """
     from . import pallas_hist
 
@@ -846,26 +855,29 @@ def _grow_tree_folds(Xb_t, G, H, count_unit, *, depth, n_bins,
             (Fo, n_nodes) + left.shape[2:])
 
     node = jnp.zeros((Fo, N), jnp.float32)
+    # payload channel order per fold: the kernels expect fold-major
+    # [Fo*C]; g/h are level-invariant, so build [Fo, 2, N] -> [2Fo, N]
+    # once — the count channel is derived in VMEM (derive_count)
+    pay = jnp.stack([G, H], axis=1).reshape(2 * Fo, N)
     feats, threshs, misses = [], [], []
     last = None
     prev = None
+    hist = None
     for d in range(depth):
         n_nodes = 1 << d
         if d == 0:
-            slots = node                                  # all rows slot 0
+            # root histogram: all rows slot 0, one plain batched pass
+            hist = pallas_hist.hist_folds(
+                Xb_t, pay, node, n_slots=1, n_bins=B,
+                interpret=interpret, allow_bf16=True,
+                derive_count=True)                        # [Fo*1*3, F*B]
             n_slots = 1
         else:
-            # sibling subtraction: histogram LEFT children only, derive
-            # right = parent - left (same trick as grow_tree)
+            # `hist` holds the LEFT-child histograms of THIS level,
+            # produced by the fused route+hist pass at the end of the
+            # previous iteration (sibling subtraction: right = parent -
+            # left, same trick as grow_tree)
             n_slots = n_nodes // 2
-            half = jnp.floor(node * 0.5)
-            slots = jnp.where(node == 2.0 * half, half, float(n_slots))
-        # payload channel order per fold: hist_pallas expects fold-major
-        # [Fo*C]; build [Fo, 3, N] -> [3Fo, N] fold-major
-        pay = jnp.stack([G, H, count_unit], axis=1).reshape(3 * Fo, N)
-        hist = pallas_hist.hist_pallas(
-            Xb_t, pay, slots, n_slots=n_slots, n_bins=B,
-            interpret=interpret, allow_bf16=True)         # [Fo*S*3, F*B]
         hist = hist.reshape(Fo, n_slots, 3, F, B)
         hgl = hist[:, :, 0][..., None]                        # [Fo,S,F,B,1]
         hhl = hist[:, :, 1]                                   # [Fo,S,F,B]
@@ -915,15 +927,24 @@ def _grow_tree_folds(Xb_t, G, H, count_unit, *, depth, n_bins,
         misses.append(m_lvl)
         last = (GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, f_lvl, t_lvl, m_lvl)
 
-        node = pallas_hist.route_pallas(Xb_t, node, f_lvl, t_lvl, m_lvl,
-                                        n_nodes=n_nodes,
-                                        interpret=interpret)
+        if d < depth - 1:
+            # fused pass: route with this level's tables AND accumulate
+            # the next level's left-child histograms in ONE Xb read
+            hist, node = pallas_hist.route_hist(
+                Xb_t, pay, node, f_lvl, t_lvl, m_lvl, n_nodes=n_nodes,
+                n_bins=B, interpret=interpret, allow_bf16=True,
+                derive_count=True)
+        else:
+            # final level: no further histogram — plain routing pass to
+            # land every row on its leaf
+            node = pallas_hist.route(Xb_t, node, f_lvl, t_lvl, m_lvl,
+                                     n_nodes=n_nodes, interpret=interpret)
 
     n_leaves = 1 << depth
     if depth == 0:
         Gl = G.sum(axis=1)[:, None, None]                 # [Fo, 1, 1]
         Hl = H.sum(axis=1)[:, None]
-        Cl = count_unit.sum(axis=1)[:, None]
+        Cl = (H > 0).astype(jnp.float32).sum(axis=1)[:, None]
     else:
         GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, f_lvl, t_lvl, m_lvl = last
         n_half = n_leaves // 2
@@ -951,7 +972,7 @@ def _grow_tree_folds(Xb_t, G, H, count_unit, *, depth, n_bins,
     lr_col = learning_rate[:, None, None] \
         if getattr(learning_rate, "ndim", 0) == 1 else learning_rate
     leaf = lr_col * leaf
-    leaf_rows = pallas_hist.table_lookup_pallas(
+    leaf_rows = pallas_hist.table_lookup(
         leaf[:, :, 0], node, interpret=interpret)         # [Fo, N]
     tree = Tree(jnp.concatenate(feats, axis=1),
                 jnp.concatenate(threshs, axis=1), leaf,
@@ -1043,10 +1064,10 @@ def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
             rw = jnp.pad(rw, (0, N - n_orig))[None, :]
             g, h = g * rw, h * rw
         # count semantics follow grow_tree's count_unit = (H > 0) on the
-        # POST-subsample hessian: the logistic clamp keeps excluded (W=0)
-        # real rows countable exactly as in the sequential path, while
-        # subsampled-out and padded rows drop to 0
-        count = (h > 0).astype(jnp.float32)
+        # POST-subsample hessian — derived in VMEM by the histogram
+        # kernels (derive_count), no HBM plane: the logistic clamp keeps
+        # excluded (W=0) real rows countable exactly as in the sequential
+        # path, while subsampled-out and padded rows drop to 0
         fm = (_feature_mask(kc, 1, Xb_t.shape[0], feature_frac)[0]
               if feature_frac < 1.0 else None)
         # kf seeds the per-LEVEL colsample_bylevel draws (split exactly
@@ -1054,7 +1075,7 @@ def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
         # routes draw identical level subsets); per-node resampling stays
         # unused — boosting samples features per tree/level, not per node
         tree, leaf_rows = _grow_tree_folds(
-            Xb_t, g, h, count, depth=depth, n_bins=n_bins,
+            Xb_t, g, h, depth=depth, n_bins=n_bins,
             reg_lambda=reg_lambda, min_child_weight=min_child_weight,
             min_instances=min_instances, min_info_gain=min_info_gain,
             gamma=gamma, learning_rate=learning_rate, feature_mask=fm,

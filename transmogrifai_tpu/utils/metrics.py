@@ -34,6 +34,59 @@ class StageMetric:
         return dict(self.__dict__)
 
 
+# HBM roof (GB/s) by device-kind substring, most specific first — the
+# denominator of every %-of-roof figure the kernel spans report. Sources:
+# published per-chip HBM bandwidth specs for each TPU generation.
+HBM_ROOF_GBPS = [("v6e", 1640.0), ("v6", 1640.0), ("v5p", 2765.0),
+                 ("v5", 819.0), ("v4", 1228.0), ("v3", 900.0),
+                 ("v2", 700.0)]
+
+
+def hbm_roof_gbps(device_kind: str) -> Optional[float]:
+    """HBM bandwidth roof for a jax device_kind string, or None when the
+    generation is unknown (CPU hosts, new hardware)."""
+    kind = (device_kind or "").lower()
+    return next((r for s, r in HBM_ROOF_GBPS if s in kind), None)
+
+
+def roofline_fields(wall_seconds: float, bytes_hbm: float,
+                    roof_gbps: Optional[float]) -> Dict[str, Any]:
+    """THE achieved-GB/s / %-of-roof arithmetic, shared by every
+    consumer (collector.kernel spans in BENCH_*.json and bench.py's
+    --hist-roofline micro-bench) so their numbers cannot diverge in
+    rounding or clamping. 3-decimal GB/s so tiny CPU-fallback figures
+    stay nonzero; roof fields None off-TPU."""
+    gbps = bytes_hbm / max(wall_seconds, 1e-9) / 1e9
+    return {"achieved_gbps": round(gbps, 3),
+            "roof_gbps": roof_gbps,
+            "pct_of_roof": (round(100.0 * gbps / roof_gbps, 2)
+                            if roof_gbps else None)}
+
+
+@dataclass
+class KernelRoofline:
+    """One timed kernel/sweep span with analytic HBM traffic attached.
+
+    bytes_hbm comes from the kernel's own traffic model (e.g.
+    ops/pallas_hist.fused_fit_bytes) — analytic by construction, since
+    per-invocation byte counters cannot exist inside a jitted program.
+    achieved_gbps = bytes_hbm / wall; pct_of_roof is against the device
+    generation's published HBM bandwidth (None off-TPU). cold=True marks
+    the first run of a program: its wall includes jit trace + compile,
+    so only cold=False spans are valid bandwidth claims."""
+
+    kernel: str
+    wall_seconds: float
+    bytes_hbm: float
+    achieved_gbps: float = 0.0
+    roof_gbps: Optional[float] = None
+    pct_of_roof: Optional[float] = None
+    cold: Optional[bool] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
 @dataclass
 class AppMetrics:
     """Whole-run metrics (reference AppMetrics)."""
@@ -42,6 +95,7 @@ class AppMetrics:
     start_time: float = 0.0
     end_time: float = 0.0
     stage_metrics: List[StageMetric] = field(default_factory=list)
+    kernel_metrics: List[KernelRoofline] = field(default_factory=list)
 
     @property
     def duration_seconds(self) -> float:
@@ -51,10 +105,14 @@ class AppMetrics:
         return sum(m.wall_seconds for m in self.stage_metrics)
 
     def to_json(self) -> Dict[str, Any]:
-        return {"app_name": self.app_name,
-                "duration_seconds": self.duration_seconds,
-                "total_stage_seconds": self.total_stage_seconds(),
-                "stage_metrics": [m.to_json() for m in self.stage_metrics]}
+        out = {"app_name": self.app_name,
+               "duration_seconds": self.duration_seconds,
+               "total_stage_seconds": self.total_stage_seconds(),
+               "stage_metrics": [m.to_json() for m in self.stage_metrics]}
+        if self.kernel_metrics:
+            out["kernel_metrics"] = [m.to_json()
+                                     for m in self.kernel_metrics]
+        return out
 
     def pretty(self) -> str:
         lines = [f"{'Stage':<42}{'Phase':<18}{'Rows':>9}{'Seconds':>10}"]
@@ -98,6 +156,29 @@ class MetricsCollector:
                 stage_name=stage_name, uid=uid, phase=phase,
                 wall_seconds=time.time() - t0, n_rows=n_rows,
                 n_stages_fused=n_stages_fused))
+
+    def kernel(self, name: str, wall_seconds: float, bytes_hbm: float,
+               cold: Optional[bool] = None) -> Optional[KernelRoofline]:
+        """Record one kernel-roofline span (no-op unless enabled). The
+        roof is resolved from the default backend's device kind at record
+        time; achieved GB/s and %-of-roof are derived here so every
+        consumer (bench.py, BENCH_*.json) reports the same arithmetic.
+        cold=True flags a span whose wall includes jit trace/compile."""
+        if not self.enabled:
+            return None
+        roof = None
+        try:
+            import jax
+            if jax.default_backend() == "tpu":
+                roof = hbm_roof_gbps(jax.devices()[0].device_kind)
+        except Exception:
+            pass
+        rec = KernelRoofline(
+            kernel=name, wall_seconds=round(wall_seconds, 4),
+            bytes_hbm=float(bytes_hbm), cold=cold,
+            **roofline_fields(wall_seconds, bytes_hbm, roof))
+        self.current.kernel_metrics.append(rec)
+        return rec
 
     def save(self, path: str) -> None:
         with open(path, "w") as f:
