@@ -12,7 +12,7 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== 1/4 import + native kernel build =="
+echo "== 1/5 import + native kernel build =="
 python - <<'PY'
 import transmogrifai_tpu
 from transmogrifai_tpu.ops import native_bridge
@@ -20,7 +20,13 @@ print("package import ok; native kernels:",
       "built" if native_bridge.available() else "UNAVAILABLE (numpy fallbacks)")
 PY
 
-echo "== 2/4 test suite (8-device virtual CPU mesh) =="
+echo "== 2/5 tmoglint (static JAX/TPU discipline + stage contracts) =="
+# fails fast on findings not in tools/tmoglint/baseline.json and on stale
+# baseline entries (docs/static_analysis.md); runs before the test tiers
+# because it needs no imports and catches contract breaks in seconds
+python -m tools.tmoglint transmogrifai_tpu/ tests/
+
+echo "== 3/5 test suite (8-device virtual CPU mesh) =="
 # fused histogram planner + CPU-fallback smoke first, explicitly under
 # JAX_PLATFORMS=cpu: the tier-1 guarantee that the pure-jnp twin of the
 # batched sweep kernel stays live on hosts with no TPU
@@ -28,7 +34,7 @@ JAX_PLATFORMS=cpu python -m pytest \
   tests/test_hist_batched.py::test_planner_cpu_smoke -q -m 'not slow'
 python -m pytest tests/ -q
 
-echo "== 3/4 examples =="
+echo "== 4/5 examples =="
 for ex in op_titanic_simple op_titanic_mini op_iris op_boston; do
   JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python "examples/${ex}.py" > /dev/null
   echo "  ${ex} ok"
@@ -41,7 +47,7 @@ if [ -f "$REF_RES/EmailDataset/Clicks.csv" ]; then
   echo "  op_dataprep ok"
 fi
 
-echo "== 4/4 driver-contract smoke =="
+echo "== 5/5 driver-contract smoke =="
 python - <<'PY'
 import __graft_entry__ as g
 g.dryrun_multichip(8)

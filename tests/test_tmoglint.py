@@ -1,0 +1,534 @@
+"""tmoglint: fixture-driven rule tests + baseline freshness + the f32
+embeddings tolerance contract (ops/embeddings.py dtype fix, TPU003).
+
+Every rule family has known-bad snippets (must be caught) and known-good
+snippets (must stay silent) so rule precision is pinned, not aspirational.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from tools.tmoglint.baseline import diff_baseline, load_baseline
+from tools.tmoglint.core import LintContext, run_rules, scan_paths
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(src: str, path: str = "ops/mod.py", rules=None):
+    ctx = LintContext(path, textwrap.dedent(src))
+    return run_rules([ctx], only=rules)
+
+
+def lint_many(named_srcs, rules=None):
+    ctxs = [LintContext(p, textwrap.dedent(s)) for p, s in named_srcs]
+    return run_rules(ctxs, only=rules)
+
+
+def rule_lines(findings, rule):
+    return sorted(f.line for f in findings if f.rule == rule)
+
+
+# -- TPU001: host sync in hot path ------------------------------------------
+
+class TestTPU001:
+    def test_item_in_jitted_fn(self):
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.sum().item()
+        """, rules=["TPU001"])
+        assert len(out) == 1 and out[0].rule == "TPU001"
+        assert ".item()" in out[0].message
+
+    def test_np_asarray_in_scan_body(self):
+        out = lint("""
+            import jax
+            import numpy as np
+
+            def step(c, x):
+                return c, np.asarray(x)
+
+            def run(xs):
+                return jax.lax.scan(step, 0, xs)
+        """, rules=["TPU001"])
+        assert rule_lines(out, "TPU001"), "np.asarray in scan body missed"
+
+    def test_float_cast_of_traced_param(self):
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+        """, rules=["TPU001"])
+        assert len(out) == 1
+
+    def test_block_until_ready_reachable_through_call(self):
+        """Hazards in helpers *called from* jitted code are still caught."""
+        out = lint("""
+            import jax
+
+            def helper(x):
+                return x.block_until_ready()
+
+            @jax.jit
+            def f(x):
+                return helper(x)
+        """, rules=["TPU001"])
+        assert len(out) == 1
+
+    def test_negative_host_code_untouched(self):
+        """The same constructs outside any trace are fine."""
+        out = lint("""
+            import numpy as np
+
+            def host_fn(x):
+                arr = np.asarray(x)
+                return float(arr.sum()), arr.tolist()
+        """, rules=["TPU001"])
+        assert out == []
+
+    def test_negative_scalar_annotated_param(self):
+        """float() of a python-scalar-annotated param is static config."""
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def f(x, frac: float = 0.5):
+                k = int(round(frac * 8))
+                return x * k
+        """, rules=["TPU001"])
+        assert out == []
+
+
+# -- TPU002: recompile hazards ----------------------------------------------
+
+class TestTPU002:
+    def test_branch_on_traced_param(self):
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """, rules=["TPU002"])
+        assert len(out) == 1 and "if" in out[0].message
+
+    def test_static_argnames_typo(self):
+        out = lint("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("n_binz",))
+            def f(x, n_bins):
+                return x * n_bins
+        """, rules=["TPU002"])
+        assert len(out) == 1 and "n_binz" in out[0].message
+
+    def test_fstring_of_traced_param(self):
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                name = f"val={x}"
+                return x
+        """, rules=["TPU002"])
+        assert len(out) == 1
+
+    def test_print_under_trace(self):
+        out = lint("""
+            import jax
+
+            def body(c, x):
+                print("step")
+                return c, x
+
+            def run(xs):
+                return jax.lax.scan(body, 0, xs)
+        """, rules=["TPU002"])
+        assert len(out) == 1 and "print" in out[0].message
+
+    def test_array_annotated_static_arg(self):
+        out = lint("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("tbl",))
+            def f(x, tbl: jax.Array):
+                return x
+        """, rules=["TPU002"])
+        assert len(out) == 1 and "unhashable" in out[0].message
+
+    def test_negative_none_check_and_static_branch(self):
+        """`x is None` is static; branches on static args are static;
+        branches on shapes are static."""
+        out = lint("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("standardize",))
+            def f(x, w=None, standardize=True):
+                if w is None:
+                    w = x * 0 + 1
+                if standardize:
+                    x = x / 2
+                if x.shape[0] > 4:
+                    x = x[:4]
+                return x * w
+        """, rules=["TPU002"])
+        assert out == []
+
+
+# -- TPU003: dtype drift -----------------------------------------------------
+
+class TestTPU003:
+    def test_np_float64_in_ops(self):
+        out = lint("""
+            import numpy as np
+
+            def acc(n):
+                return np.zeros((n, n), np.float64)
+        """, path="ops/kern.py", rules=["TPU003"])
+        assert len(out) == 1 and "float64" in out[0].message
+
+    def test_dtypeless_jnp_zeros_in_ops(self):
+        out = lint("""
+            import jax.numpy as jnp
+
+            def buf(n):
+                return jnp.zeros((n, 8))
+        """, path="ops/kern.py", rules=["TPU003"])
+        assert len(out) == 1 and "dtype-less" in out[0].message
+
+    def test_negative_outside_kernel_path(self):
+        """float64 on a non-ops host path is not TPU003's business."""
+        out = lint("""
+            import numpy as np
+
+            def acc(n):
+                return np.zeros((n, n), np.float64)
+        """, path="readers/csv.py", rules=["TPU003"])
+        assert out == []
+
+    def test_negative_explicit_dtype_and_asarray(self):
+        out = lint("""
+            import jax.numpy as jnp
+
+            def buf(x, n):
+                a = jnp.zeros((n, 8), jnp.float32)
+                b = jnp.asarray(x)  # cast preserves dtype: not a creation
+                return a, b
+        """, path="ops/kern.py", rules=["TPU003"])
+        assert out == []
+
+    def test_suppression_same_line_and_above(self):
+        out = lint("""
+            import numpy as np
+
+            def acc(n):
+                a = np.zeros(n, np.float64)  # tmoglint: disable=TPU003  ABI
+                # tmoglint: disable=TPU003  host precision only
+                b = np.zeros(n, np.float64)
+                return a, b
+        """, path="ops/kern.py", rules=["TPU003"])
+        assert out == []
+
+
+# -- TPU004: tracer leak -----------------------------------------------------
+
+class TestTPU004:
+    def test_self_assign_in_jitted_method(self):
+        out = lint("""
+            import jax
+
+            class Model:
+                @jax.jit
+                def f(self, x):
+                    self.cache = x
+                    return x
+        """, rules=["TPU004"])
+        assert len(out) == 1 and "self.cache" in out[0].message
+
+    def test_global_in_scan_body(self):
+        out = lint("""
+            import jax
+
+            def body(c, x):
+                global LAST
+                LAST = x
+                return c, x
+
+            def run(xs):
+                return jax.lax.scan(body, 0, xs)
+        """, rules=["TPU004"])
+        assert rule_lines(out, "TPU004"), "global stmt under trace missed"
+
+    def test_negative_self_assign_outside_trace(self):
+        out = lint("""
+            class Model:
+                def fit(self, x):
+                    self.cache = x
+                    return self
+        """, rules=["TPU004"])
+        assert out == []
+
+
+# -- DAG001: stage contracts -------------------------------------------------
+
+MINI_TYPES = ("pkg/types.py", """
+    class FeatureType:
+        pass
+
+    class Real(FeatureType):
+        pass
+
+    class Text(FeatureType):
+        pass
+""")
+
+
+class TestDAG001:
+    def test_missing_input_types(self):
+        out = lint_many([MINI_TYPES, ("pkg/stages.py", """
+            class MyStage(Transformer):
+                output_type = Real
+        """)], rules=["DAG001"])
+        assert len(out) == 1 and "input_types" in out[0].message
+
+    def test_unknown_feature_type_in_contract(self):
+        out = lint_many([MINI_TYPES, ("pkg/stages.py", """
+            class Widget:
+                pass
+
+            class MyStage(Transformer):
+                input_types = (Widget,)
+                output_type = Real
+        """)], rules=["DAG001"])
+        assert len(out) == 1 and "Widget" in out[0].message
+
+    def test_set_input_arity_mismatch(self):
+        out = lint_many([MINI_TYPES, ("pkg/stages.py", """
+            class TwoIn(Transformer):
+                input_types = (Real, Real)
+                output_type = Real
+        """), ("pkg/dsl.py", """
+            def wire(a):
+                return TwoIn().set_input(a).get_output()
+        """)], rules=["DAG001"])
+        assert len(out) == 1 and "1 input(s)" in out[0].message
+
+    def test_starred_wiring_of_non_sequence_stage(self):
+        out = lint_many([MINI_TYPES, ("pkg/stages.py", """
+            class TwoIn(Transformer):
+                input_types = (Real, Real)
+                output_type = Real
+                is_sequence = False
+        """), ("pkg/dsl.py", """
+            def wire(feats):
+                return TwoIn().set_input(*feats)
+        """)], rules=["DAG001"])
+        assert len(out) == 1 and "sequence" in out[0].message
+
+    def test_negative_well_formed_stage_and_wiring(self):
+        out = lint_many([MINI_TYPES, ("pkg/stages.py", """
+            class TwoIn(Transformer):
+                input_types = (Real, Text)
+                output_type = Real
+
+            class SeqStage(Transformer):
+                input_types = (Real,)
+                output_type = Real
+                is_sequence = True
+        """), ("pkg/dsl.py", """
+            def wire(a, b, feats):
+                x = TwoIn().set_input(a, b).get_output()
+                y = SeqStage().set_input(*feats).get_output()
+                return x, y
+        """)], rules=["DAG001"])
+        assert out == []
+
+    def test_negative_dynamic_output_type_binding(self):
+        """Passthrough stages that pin output_type per-wiring (in
+        set_input) are declared-enough."""
+        out = lint_many([MINI_TYPES, ("pkg/stages.py", """
+            class Passthrough(Transformer):
+                input_types = (Real,)
+
+                def set_input(self, *features):
+                    out = super().set_input(*features)
+                    self.output_type = features[0].feature_type
+                    return out
+        """)], rules=["DAG001"])
+        assert out == []
+
+
+# -- real-repo guarantees ----------------------------------------------------
+
+class TestRepoScan:
+    @pytest.fixture(scope="class")
+    def repo_findings(self):
+        ctxs, errors = scan_paths(["transmogrifai_tpu", "tests"], REPO_ROOT)
+        return errors + run_rules(ctxs)
+
+    def test_baseline_is_fresh(self, repo_findings):
+        """The committed baseline must match a fresh scan exactly: no new
+        findings (undeclared debt) and no stale entries (fixed debt whose
+        ledger line was never removed)."""
+        baseline = load_baseline(
+            os.path.join(REPO_ROOT, "tools", "tmoglint", "baseline.json"))
+        new, stale = diff_baseline(repo_findings, baseline)
+        assert not new, "\n".join(f.render() for f in new)
+        assert not stale, f"stale baseline entries: {stale}"
+
+    def test_no_syntax_errors_in_repo(self, repo_findings):
+        assert not [f for f in repo_findings if f.rule == "SYNTAX"]
+
+
+class TestCLI:
+    def test_json_report_shape_and_exit_codes(self, tmp_path):
+        bad = tmp_path / "ops"
+        bad.mkdir()
+        (bad / "kern.py").write_text(textwrap.dedent("""
+            import numpy as np
+
+            def acc(n):
+                return np.zeros(n, np.float64)
+        """))
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tmoglint", "ops",
+             "--root", str(tmp_path), "--no-baseline", "--format", "json"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["total_findings"] == 1
+        assert report["counts_by_rule"] == {"TPU003": 1}
+        assert report["new"][0]["rule"] == "TPU003"
+        assert report["ok"] is False
+        # writing a baseline makes the same scan green
+        base = tmp_path / "base.json"
+        proc2 = subprocess.run(
+            [sys.executable, "-m", "tools.tmoglint", "ops",
+             "--root", str(tmp_path), "--baseline", str(base),
+             "--write-baseline"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+        assert proc2.returncode == 0
+        proc3 = subprocess.run(
+            [sys.executable, "-m", "tools.tmoglint", "ops",
+             "--root", str(tmp_path), "--baseline", str(base)],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+        assert proc3.returncode == 0, proc3.stdout + proc3.stderr
+
+    def test_write_baseline_with_rule_filter_refused(self, tmp_path):
+        """A rule-filtered scan must never overwrite the full baseline."""
+        (tmp_path / "clean.py").write_text("x = 1\n")
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tmoglint", "clean.py",
+             "--root", str(tmp_path), "--baseline",
+             str(tmp_path / "b.json"), "--rules", "TPU003",
+             "--write-baseline"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+        assert proc.returncode == 2
+        assert "truncate" in proc.stderr
+        assert not (tmp_path / "b.json").exists()
+
+    def test_stale_baseline_fails(self, tmp_path):
+        """Fixing debt without regenerating the baseline must go red."""
+        (tmp_path / "clean.py").write_text("x = 1\n")
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps({"version": 1, "findings": [
+            {"fingerprint": "deadbeefdeadbeef", "rule": "TPU003",
+             "path": "gone.py", "line": 1, "col": 0,
+             "message": "old debt", "snippet": ""}]}))
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tmoglint", "clean.py",
+             "--root", str(tmp_path), "--baseline", str(base)],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert "stale" in proc.stdout
+
+
+# -- fitted models inherit their estimator's contract ------------------------
+
+class TestFitPinsContract:
+    def test_onehot_model_enforces_estimator_types(self):
+        """OneHotModel's class contract is (None,) = any, but Estimator.fit
+        pins each fitted instance to its estimator's concrete contract."""
+        from transmogrifai_tpu.automl.vectorizers.categorical import (
+            OneHotVectorizer)
+        from transmogrifai_tpu.data.dataset import Dataset
+        from transmogrifai_tpu.features.builder import FeatureBuilder
+        from transmogrifai_tpu.types import PickList, Real
+
+        rows = [{"cab": c, "age": float(i)}
+                for i, c in enumerate(["A", "B", "A", "C"])]
+        resp, feats = FeatureBuilder.from_rows(
+            rows + [{"cab": "A", "age": 1.0, "y": 0.0}], response="y")
+        cab = [f for f in feats if f.name == "cab"][0]
+        age = [f for f in feats if f.name == "age"][0]
+        assert cab.feature_type is PickList
+
+        est = OneHotVectorizer(top_k=3).set_input(cab)
+        ds = Dataset.from_rows(rows, [cab, age]) if \
+            hasattr(Dataset, "from_rows") else None
+        if ds is None:
+            import transmogrifai_tpu.readers.readers as R
+            ds = R.ListReader(rows).generate_dataset([cab, age])
+        model = est.fit(ds)
+        assert model.input_types == est.input_types
+        with pytest.raises(TypeError):
+            model.set_input(age)  # Real into a Text-pinned fitted pivot
+
+        # the pin must survive a save/load round trip (registry path)
+        from transmogrifai_tpu.stages.registry import build_stage
+        args = json.loads(json.dumps(model.save_args()))
+        rebuilt = build_stage(type(model).__name__, args)
+        assert rebuilt.input_types == est.input_types
+        with pytest.raises(TypeError):
+            rebuilt.set_input(age)
+
+
+# -- ops/embeddings.py f32 fix (TPU003 satellite) ----------------------------
+
+class TestEmbeddingsF32:
+    def test_cooccurrence_counts_exact_in_f32(self):
+        from transmogrifai_tpu.ops.embeddings import cooccurrence_matrix
+        docs = [["a", "b", "c", "a"], ["b", "c"], None, ["a"]] * 50
+        C = cooccurrence_matrix(docs, vocab_bins=16, window=3)
+        assert C.dtype == np.float32
+        # windowed counts are small integers: f32 must hold them exactly
+        assert np.array_equal(C, np.round(C))
+        assert np.allclose(C, C.T)
+
+    def test_mean_pool_f32_matches_f64(self):
+        from transmogrifai_tpu.ops.embeddings import (
+            hash_token_ids, mean_pool_docs)
+        rng = np.random.default_rng(0)
+        V, dim = 64, 16
+        emb = rng.normal(size=(V, dim)).astype(np.float32)
+        vocab = [f"tok{i}" for i in range(200)]
+        docs = [list(rng.choice(vocab, size=rng.integers(1, 40)))
+                for _ in range(100)] + [None, []]
+        out = mean_pool_docs(docs, emb)
+        assert out.dtype == np.float32
+        # f64 reference of the same pooling
+        ref = np.zeros((len(docs), dim), np.float64)
+        for i, toks in enumerate(docs):
+            if not toks:
+                continue
+            ids = hash_token_ids(list(toks), V)
+            ref[i] = emb[ids].astype(np.float64).mean(axis=0)
+        assert np.allclose(out, ref, atol=1e-5), \
+            np.abs(out - ref).max()

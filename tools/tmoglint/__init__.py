@@ -1,0 +1,34 @@
+"""tmoglint — AST-level JAX/TPU discipline linter + static stage-contract
+checker for transmogrifai_tpu.
+
+The Scala reference rejected an ill-typed feature DAG at *compile* time; the
+Python rebuild only catches it at runtime (stages/base.py::check_input_types),
+and nothing guards the JAX-specific hazards that silently destroy TPU
+performance. tmoglint restores both as lint-time checks over stdlib `ast`:
+
+* TPU001 host-sync-in-hot-path   — `.item()`, `float()`, `np.asarray`,
+                                    `block_until_ready` under a trace
+* TPU002 recompile-hazard        — Python control flow / stringification of
+                                    traced values, unsound static args
+* TPU003 dtype-drift             — float64 literals and dtype-less jnp
+                                    creation in `ops/` kernel paths
+* TPU004 tracer-leak             — traced values escaping to self./globals
+* DAG001 stage-contract          — every PipelineStage declares real
+                                    FeatureType input/output contracts and the
+                                    DSL wiring matches declared arity
+
+Run: ``python -m tools.tmoglint transmogrifai_tpu/ tests/``
+Suppress one finding: ``# tmoglint: disable=TPU003  <reason>`` on (or on the
+line above) the flagged line. Grandfathered findings live in
+``tools/tmoglint/baseline.json`` (regenerate with ``--write-baseline``); the
+CLI exits nonzero only on findings not in the baseline, or on stale baseline
+entries.
+"""
+from .core import Finding, LintContext, scan_paths, run_rules  # noqa: F401
+from .baseline import load_baseline, write_baseline, diff_baseline  # noqa: F401
+from .cli import main  # noqa: F401
+
+__all__ = [
+    "Finding", "LintContext", "scan_paths", "run_rules",
+    "load_baseline", "write_baseline", "diff_baseline", "main",
+]

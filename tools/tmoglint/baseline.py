@@ -1,0 +1,51 @@
+"""Baseline (grandfathered findings) persistence and diffing.
+
+The baseline is a JSON list of line-number-independent fingerprints plus the
+human-readable finding data at generation time. CI fails on findings *not*
+in the baseline (new debt) AND on baseline entries no longer produced by a
+fresh scan (stale entries — fix the debt, regenerate the file with
+``--write-baseline`` so the ledger never lies).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str) -> Dict[str, Dict[str, object]]:
+    """fingerprint -> recorded finding dict ({} when the file is absent)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    payload = {
+        "version": 1,
+        "tool": "tmoglint",
+        "note": ("grandfathered findings; regenerate with "
+                 "`python -m tools.tmoglint <paths> --write-baseline` "
+                 "after fixing or suppressing debt"),
+        "findings": [f.to_json() for f in
+                     sorted(findings, key=lambda f: (f.path, f.line, f.rule))],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+
+
+def diff_baseline(findings: Sequence[Finding],
+                  baseline: Dict[str, Dict[str, object]]
+                  ) -> Tuple[List[Finding], List[Dict[str, object]]]:
+    """(new findings not grandfathered, stale baseline entries)."""
+    current = {f.fingerprint for f in findings}
+    new = [f for f in findings if f.fingerprint not in baseline]
+    stale = [e for fp, e in sorted(baseline.items()) if fp not in current]
+    return new, stale

@@ -1,0 +1,109 @@
+"""tmoglint CLI.
+
+``python -m tools.tmoglint transmogrifai_tpu/ tests/`` — exit 0 iff the scan
+matches the committed baseline exactly (no new findings, no stale entries).
+``--format json`` emits a machine-readable report for bench tooling.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+from typing import List, Optional, Sequence
+
+from .baseline import (
+    DEFAULT_BASELINE, diff_baseline, load_baseline, write_baseline,
+)
+from .core import RULE_DOCS, run_rules, scan_paths
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.tmoglint",
+        description="AST-level JAX/TPU discipline linter + static "
+                    "stage-contract checker (see docs/static_analysis.md)")
+    p.add_argument("paths", nargs="*",
+                   default=["transmogrifai_tpu", "tests"],
+                   help="files/dirs to lint (default: transmogrifai_tpu tests)")
+    p.add_argument("--root", default=os.getcwd(),
+                   help="path findings are reported relative to "
+                        "(default: cwd)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON (default: tools/tmoglint/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding; ignore the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate the baseline from this scan and exit 0")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        from . import rules_tpu, rules_dag  # noqa: F401  (registers rules)
+        for rid in sorted(RULE_DOCS):
+            print(f"{rid}: {RULE_DOCS[rid]}")
+        return 0
+
+    only = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    if args.write_baseline and only:
+        print("error: --write-baseline with --rules would truncate the "
+              "baseline to the selected rules' findings; regenerate from a "
+              "full scan instead", file=sys.stderr)
+        return 2
+    ctxs, errors = scan_paths(args.paths, args.root)
+    findings = run_rules(ctxs, only=only)
+    findings = errors + findings
+
+    if args.write_baseline:
+        write_baseline(args.baseline, findings)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    if only:
+        # a rule-filtered scan can only judge entries of the selected rules;
+        # unselected rules' grandfathered entries are neither new nor stale
+        selected = {r.upper() for r in only} | {"SYNTAX"}
+        baseline = {fp: e for fp, e in baseline.items()
+                    if str(e.get("rule", "")).upper() in selected}
+    new, stale = diff_baseline(findings, baseline)
+    counts = Counter(f.rule for f in findings)
+
+    if args.format == "json":
+        report = {
+            "tool": "tmoglint",
+            "paths": list(args.paths),
+            "total_findings": len(findings),
+            "counts_by_rule": dict(sorted(counts.items())),
+            "baselined": len(findings) - len(new),
+            "new": [f.to_json() for f in new],
+            "stale_baseline_entries": stale,
+            "ok": not new and not stale,
+        }
+        print(json.dumps(report, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"-- {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed debt; "
+                  f"regenerate with --write-baseline):")
+            for e in stale:
+                print(f"   {e.get('path')}: {e.get('rule')} "
+                      f"{e.get('message')}")
+        summary = (f"tmoglint: {len(findings)} finding(s) "
+                   f"({len(findings) - len(new)} baselined, {len(new)} new, "
+                   f"{len(stale)} stale) over {len(ctxs)} file(s)")
+        print(summary)
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,255 @@
+"""Rule engine: findings, suppression comments, file scanning, fingerprints.
+
+Everything here is stdlib-only (`ast`, `hashlib`, `re`) — the linter must run
+in CI before any heavyweight import and must never import the package under
+analysis.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# rule list stops at the first token that is not `RULE[,RULE...]` so a
+# justification can follow on the same line:
+#   # tmoglint: disable=TPU003  host precision, result cast to f32
+_RULES_PAT = r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+SUPPRESS_RE = re.compile(r"#\s*tmoglint:\s*disable=" + _RULES_PAT)
+SUPPRESS_FILE_RE = re.compile(r"#\s*tmoglint:\s*disable-file=" + _RULES_PAT)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a source line.
+
+    The fingerprint is line-number independent (path | rule | stripped line
+    text | occurrence index) so edits elsewhere in a file do not invalidate
+    the baseline.
+    """
+    rule: str
+    path: str          # posix path relative to the lint root
+    line: int          # 1-based
+    col: int
+    message: str
+    snippet: str       # stripped source of the flagged line
+    occurrence: int = 0
+
+    @property
+    def fingerprint(self) -> str:
+        h = hashlib.sha1()
+        h.update("|".join(
+            (self.path, self.rule, self.snippet,
+             str(self.occurrence))).encode("utf-8"))
+        return h.hexdigest()[:16]
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message}")
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "fingerprint": self.fingerprint, "rule": self.rule,
+            "path": self.path, "line": self.line, "col": self.col,
+            "message": self.message, "snippet": self.snippet,
+        }
+
+
+class LintContext:
+    """Parsed view of one file handed to every per-file rule."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._file_suppressed = self._parse_file_suppressions()
+
+    # -- suppression -------------------------------------------------------
+    def _parse_file_suppressions(self) -> frozenset:
+        out = set()
+        for ln in self.lines[:5]:
+            m = SUPPRESS_FILE_RE.search(ln)
+            if m:
+                out.update(r.strip().upper()
+                           for r in m.group(1).split(",") if r.strip())
+        return frozenset(out)
+
+    def _line_suppressions(self, lineno: int) -> frozenset:
+        """Rules disabled for `lineno` (same line, or a standalone comment
+        directly above)."""
+        out = set()
+        for idx in (lineno - 1, lineno - 2):
+            if not (0 <= idx < len(self.lines)):
+                continue
+            ln = self.lines[idx]
+            if idx == lineno - 2 and not ln.strip().startswith("#"):
+                continue  # line above only counts when it is pure comment
+            m = SUPPRESS_RE.search(ln)
+            if m:
+                out.update(r.strip().upper()
+                           for r in m.group(1).split(",") if r.strip())
+        return frozenset(out)
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        rule = rule.upper()
+        if rule in self._file_suppressed or "ALL" in self._file_suppressed:
+            return True
+        sup = self._line_suppressions(lineno)
+        return rule in sup or "ALL" in sup
+
+    # -- finding construction ---------------------------------------------
+    def finding(self, rule: str, node: ast.AST, message: str
+                ) -> Optional[Finding]:
+        lineno = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        if self.suppressed(rule, lineno):
+            return None
+        snippet = self.lines[lineno - 1].strip() if \
+            0 <= lineno - 1 < len(self.lines) else ""
+        return Finding(rule=rule, path=self.path, line=lineno, col=col,
+                       message=message, snippet=snippet)
+
+
+# -- registry ---------------------------------------------------------------
+# Per-file rules: fn(ctx) -> [Finding]; project rules: fn(ctxs) -> [Finding].
+FILE_RULES: Dict[str, Callable[[LintContext], List[Finding]]] = {}
+PROJECT_RULES: Dict[str, Callable[[Sequence[LintContext]], List[Finding]]] = {}
+RULE_DOCS: Dict[str, str] = {}
+
+
+def file_rule(rule_id: str, doc: str):
+    def deco(fn):
+        FILE_RULES[rule_id] = fn
+        RULE_DOCS[rule_id] = doc
+        return fn
+    return deco
+
+
+def project_rule(rule_id: str, doc: str):
+    def deco(fn):
+        PROJECT_RULES[rule_id] = fn
+        RULE_DOCS[rule_id] = doc
+        return fn
+    return deco
+
+
+# -- scanning ---------------------------------------------------------------
+
+def iter_py_files(paths: Sequence[str], root: str) -> Iterable[str]:
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            yield ap
+        elif os.path.isdir(ap):
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith("."))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def scan_paths(paths: Sequence[str], root: str) -> Tuple[
+        List[LintContext], List[Finding]]:
+    """Parse every .py under `paths`. Unparsable files become SYNTAX findings
+    (the linter must not crash on them)."""
+    ctxs: List[LintContext] = []
+    errors: List[Finding] = []
+    for fpath in iter_py_files(paths, root):
+        rel = os.path.relpath(fpath, root).replace(os.sep, "/")
+        try:
+            with open(fpath, "r", encoding="utf-8") as fh:
+                src = fh.read()
+            ctxs.append(LintContext(rel, src))
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append(Finding(
+                rule="SYNTAX", path=rel,
+                line=getattr(e, "lineno", 1) or 1, col=0,
+                message=f"unparsable file: {e.__class__.__name__}: {e}",
+                snippet=""))
+    return ctxs, errors
+
+
+def _number_occurrences(findings: List[Finding]) -> List[Finding]:
+    """Disambiguate findings sharing (path, rule, snippet) so fingerprints
+    stay unique and line-independent."""
+    seen: Dict[Tuple[str, str, str], int] = {}
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        key = (f.path, f.rule, f.snippet)
+        occ = seen.get(key, 0)
+        seen[key] = occ + 1
+        out.append(dataclasses.replace(f, occurrence=occ))
+    return out
+
+
+def run_rules(ctxs: Sequence[LintContext],
+              only: Optional[Sequence[str]] = None) -> List[Finding]:
+    # import registers the rules
+    from . import rules_tpu, rules_dag  # noqa: F401
+    selected = {r.upper() for r in only} if only else None
+    findings: List[Finding] = []
+    for rule_id, fn in FILE_RULES.items():
+        if selected and rule_id not in selected:
+            continue
+        for ctx in ctxs:
+            findings.extend(fn(ctx))
+    for rule_id, fn in PROJECT_RULES.items():
+        if selected and rule_id not in selected:
+            continue
+        findings.extend(fn(ctxs))
+    return _number_occurrences(findings)
+
+
+# -- small AST helpers shared by rule modules --------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def const_str_tuple(node: ast.expr) -> Optional[List[str]]:
+    """Constant str or tuple/list of constant strs -> list of strings."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+            else:
+                return None
+        return out
+    return None
+
+
+def const_int_tuple(node: ast.expr) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+            else:
+                return None
+        return out
+    return None

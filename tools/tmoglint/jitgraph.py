@@ -1,0 +1,307 @@
+"""Per-module trace-reachability analysis.
+
+Finds every function that can run *under a JAX trace* — directly jitted,
+passed to a trace combinator (`scan`/`while_loop`/`fori_loop`/`vmap`/
+`shard_map`/`pallas_call`/...), returned from a `get_jax_fn` method (the
+repo's fusion protocol, stages/base.py), or called (lexically resolved) from
+any of those — so TPU001/TPU002/TPU004 only fire where a tracer can actually
+appear. Resolution is intra-module and name-based: a deliberate
+over-approximation, tamed by per-line suppression and the baseline.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    LintContext, call_kwarg, const_int_tuple, const_str_tuple, dotted_name,
+)
+
+# last path component of callables whose function-valued arguments are traced
+TRACE_COMBINATORS = {
+    "jit", "pjit", "vmap", "pmap", "xmap", "grad", "value_and_grad",
+    "scan", "while_loop", "fori_loop", "cond", "switch", "associative_scan",
+    "shard_map", "pallas_call", "checkpoint", "remat", "custom_jvp",
+    "custom_vjp", "map",
+}
+# `map`/`cond`/`switch` only count with a jax/lax prefix — bare python `map`
+# must not make its argument "traced".
+_PREFIX_REQUIRED = {"map", "cond", "switch"}
+_JAXISH_PREFIXES = ("jax", "lax", "pl", "pltpu", "pallas", "shard_map")
+
+
+def _is_trace_combinator(dotted: Optional[str]) -> bool:
+    if not dotted:
+        return False
+    parts = dotted.split(".")
+    last = parts[-1]
+    if last not in TRACE_COMBINATORS:
+        return False
+    if last in _PREFIX_REQUIRED or len(parts) == 1:
+        if len(parts) == 1:
+            return last not in _PREFIX_REQUIRED
+        return parts[0] in _JAXISH_PREFIXES or parts[-2] in _JAXISH_PREFIXES
+    return True
+
+
+class FuncInfo:
+    """One function/lambda definition with lexical parent links."""
+
+    def __init__(self, node: ast.AST, name: str, parent: Optional["FuncInfo"],
+                 cls: Optional[str]):
+        self.node = node
+        self.name = name
+        self.parent = parent
+        self.cls = cls              # enclosing class name, if a method
+        self.children: Dict[str, "FuncInfo"] = {}
+        self.traced = False
+        # static params of a *directly* jitted def (from its decorators)
+        self.static_params: Set[str] = set()
+        self.is_direct_jit = False
+
+    def resolve(self, name: str) -> Optional["FuncInfo"]:
+        """Lexical lookup: own nested defs, then enclosing scopes."""
+        scope: Optional[FuncInfo] = self
+        while scope is not None:
+            if name in scope.children:
+                return scope.children[name]
+            scope = scope.parent
+        return None
+
+
+class ModuleGraph:
+    """Function table + traced-set for one parsed module."""
+
+    def __init__(self, ctx: LintContext):
+        self.ctx = ctx
+        self.module_funcs: Dict[str, FuncInfo] = {}
+        self.methods: Dict[Tuple[str, str], FuncInfo] = {}
+        self.all_funcs: List[FuncInfo] = []
+        self._collect(ctx.tree, parent=None, cls=None)
+        self._mark_roots()
+        self._propagate()
+
+    # -- collection --------------------------------------------------------
+    def _collect(self, node: ast.AST, parent: Optional[FuncInfo],
+                 cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(child, child.name, parent, cls)
+                self.all_funcs.append(fi)
+                if parent is not None:
+                    parent.children[child.name] = fi
+                elif cls is not None:
+                    self.methods[(cls, child.name)] = fi
+                else:
+                    self.module_funcs[child.name] = fi
+                self._collect(child, parent=fi, cls=cls)
+            elif isinstance(child, ast.Lambda):
+                fi = FuncInfo(child, "<lambda>", parent, cls)
+                self.all_funcs.append(fi)
+                self._collect(child, parent=fi, cls=cls)
+            elif isinstance(child, ast.ClassDef):
+                self._collect(child, parent=None, cls=child.name)
+            else:
+                self._collect(child, parent=parent, cls=cls)
+
+    # -- roots -------------------------------------------------------------
+    def _decorator_jit_info(self, dec: ast.expr) -> Optional[Set[str]]:
+        """If `dec` is a jit-ish decorator, return the static argnames it
+        declares (possibly empty), else None."""
+        d = dotted_name(dec)
+        if d and d.split(".")[-1] in {"jit", "pjit"}:
+            return set()
+        if isinstance(dec, ast.Call):
+            fn = dotted_name(dec.func)
+            if fn and fn.split(".")[-1] in {"jit", "pjit"}:
+                return self._static_names_from_call(dec, None)
+            # partial(jax.jit, static_argnames=...)
+            if fn and fn.split(".")[-1] == "partial" and dec.args:
+                inner = dotted_name(dec.args[0])
+                if inner and inner.split(".")[-1] in {"jit", "pjit"}:
+                    return self._static_names_from_call(dec, None)
+        return None
+
+    def _static_names_from_call(self, call: ast.Call,
+                                fdef: Optional[ast.AST]) -> Set[str]:
+        names: Set[str] = set()
+        sa = call_kwarg(call, "static_argnames")
+        if sa is not None:
+            vals = const_str_tuple(sa)
+            if vals:
+                names.update(vals)
+        sn = call_kwarg(call, "static_argnums")
+        if sn is not None and fdef is not None:
+            idxs = const_int_tuple(sn)
+            if idxs:
+                params = [a.arg for a in fdef.args.args]
+                for i in idxs:
+                    if 0 <= i < len(params):
+                        names.add(params[i])
+        return names
+
+    def _mark_roots(self) -> None:
+        # 1) decorated defs
+        for fi in self.all_funcs:
+            node = fi.node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    statics = self._decorator_jit_info(dec)
+                    if statics is not None:
+                        fi.traced = True
+                        fi.is_direct_jit = True
+                        fi.static_params |= statics
+                        if isinstance(dec, ast.Call):
+                            fi.static_params |= self._static_names_from_call(
+                                dec, node)
+        # 2) functions handed to trace combinators anywhere in the module,
+        #    resolved lexically from the call site
+        for scope, call in self._iter_calls():
+            if not _is_trace_combinator(dotted_name(call.func)):
+                continue
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                for target in self._func_args_of(arg, scope):
+                    target.traced = True
+        # 3) the repo's fusion protocol: whatever get_jax_fn returns runs
+        #    inside the layer's jitted XLA program
+        for fi in self.all_funcs:
+            if fi.name == "get_jax_fn" or fi.name.endswith("_jax_fn"):
+                for ret in self._returns_of(fi):
+                    for target in self._func_args_of(ret, fi):
+                        target.traced = True
+
+    def _iter_calls(self) -> Iterator[Tuple[Optional[FuncInfo], ast.Call]]:
+        """Every Call node paired with its innermost enclosing FuncInfo."""
+
+        def walk(node: ast.AST, scope: Optional[FuncInfo]):
+            for child in ast.iter_child_nodes(node):
+                new_scope = scope
+                for fi in self.all_funcs:
+                    if fi.node is child:
+                        new_scope = fi
+                        break
+                if isinstance(child, ast.Call):
+                    yield scope, child
+                yield from walk(child, new_scope)
+
+        yield from walk(self.ctx.tree, None)
+
+    def _func_args_of(self, expr: ast.expr,
+                      scope: Optional[FuncInfo]) -> List[FuncInfo]:
+        """FuncInfos referenced by `expr`: bare names (lexically resolved),
+        partial(f, ...), lambdas, self.method."""
+        out: List[FuncInfo] = []
+        if isinstance(expr, ast.Name):
+            target = scope.resolve(expr.id) if scope else None
+            if target is None:
+                target = self.module_funcs.get(expr.id)
+            if target is not None:
+                out.append(target)
+        elif isinstance(expr, ast.Lambda):
+            for fi in self.all_funcs:
+                if fi.node is expr:
+                    out.append(fi)
+        elif isinstance(expr, ast.Call):
+            fn = dotted_name(expr.func)
+            if fn and fn.split(".")[-1] == "partial" and expr.args:
+                out.extend(self._func_args_of(expr.args[0], scope))
+        elif isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            cls = scope.cls if scope else None
+            if cls and (cls, expr.attr) in self.methods:
+                out.append(self.methods[(cls, expr.attr)])
+        return out
+
+    def _returns_of(self, fi: FuncInfo) -> List[ast.expr]:
+        out = []
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                out.append(node.value)
+        return out
+
+    # -- propagation -------------------------------------------------------
+    def _propagate(self) -> None:
+        """Close the traced set over (a) lexical nesting of referenced defs
+        and (b) name/self-method references from traced bodies."""
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.all_funcs:
+                if not fi.traced:
+                    continue
+                for node in self._own_nodes(fi):
+                    targets: List[FuncInfo] = []
+                    if isinstance(node, ast.Name) and \
+                            isinstance(node.ctx, ast.Load):
+                        t = fi.resolve(node.id) or \
+                            self.module_funcs.get(node.id)
+                        if t is not None and t is not fi:
+                            targets.append(t)
+                    elif isinstance(node, ast.Attribute) and \
+                            isinstance(node.value, ast.Name) and \
+                            node.value.id == "self" and fi.cls:
+                        t = self.methods.get((fi.cls, node.attr))
+                        if t is not None and t is not fi:
+                            targets.append(t)
+                    for t in targets:
+                        if not t.traced:
+                            t.traced = True
+                            changed = True
+
+    def _own_nodes(self, fi: FuncInfo) -> Iterator[ast.AST]:
+        """Nodes of fi's body excluding nested function/lambda bodies (their
+        reachability is decided by whether they are referenced)."""
+        nested = {f.node for f in self.all_funcs if f is not fi}
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if child in nested:
+                    continue
+                yield child
+                yield from walk(child)
+
+        yield from walk(fi.node)
+
+    # -- public API --------------------------------------------------------
+    def traced_funcs(self) -> List[FuncInfo]:
+        return [f for f in self.all_funcs if f.traced]
+
+    def iter_traced_nodes(self) -> Iterator[Tuple[FuncInfo, ast.AST]]:
+        for fi in self.traced_funcs():
+            for node in self._own_nodes(fi):
+                yield fi, node
+
+
+def module_graph(ctx: LintContext) -> ModuleGraph:
+    """One ModuleGraph per file, shared by TPU001/TPU002/TPU004 — the
+    reachability walk is the expensive part of a scan."""
+    g = getattr(ctx, "_module_graph", None)
+    if g is None:
+        g = ModuleGraph(ctx)
+        ctx._module_graph = g
+    return g
+
+
+def numpy_aliases(ctx: LintContext) -> Set[str]:
+    out = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def jnp_aliases(ctx: LintContext) -> Set[str]:
+    out = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax.numpy" and a.asname:
+                    out.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax" :
+                for a in node.names:
+                    if a.name == "numpy":
+                        out.add(a.asname or "numpy")
+    return out

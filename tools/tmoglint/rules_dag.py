@@ -1,0 +1,387 @@
+"""DAG001: static stage-contract checking.
+
+The Scala reference made feature-DAG wiring a *compile-time* guarantee: a
+stage whose input/output FeatureTypes did not line up would not build. The
+Python rebuild defers that to runtime (stages/base.py::check_input_types).
+DAG001 restores the static version:
+
+  1. every concrete PipelineStage subclass must *bind* `input_types` and
+     `output_type` (class body, `self.` assignment in __init__, or ctor
+     keyword pass-through) — inheriting the permissive framework defaults
+     silently turns off runtime checking too;
+  2. the bound values must be real FeatureType subclasses (or None for
+     "any"), resolved transitively over the scanned files;
+  3. DSL / call-site wiring must match the declared arity:
+     `Cls(...).set_input(a, b)` is checked against `len(Cls.input_types)`,
+     starred args require `is_sequence = True`, and the dsl.py helper
+     conventions (`_unary`, `_binary_op`) are checked at their call sites.
+
+Unresolvable constructs (computed types, dynamically-built stages) are
+skipped, not guessed at.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, LintContext, dotted_name, project_rule
+
+# framework bases whose own (permissive) defaults do NOT count as a
+# declaration for their subclasses
+FRAMEWORK_BASES = {
+    "PipelineStage", "Transformer", "Estimator",
+    "LambdaTransformer", "JaxTransformer",
+}
+# vectorizer-family abstract bases: their `output_type = OPVector` /
+# `is_sequence = True` are real contracts subclasses may inherit, but their
+# lack of an element type must not silence subclasses -> input_types only
+# stops resolving here
+INPUT_OPAQUE_BASES = {"VectorizerModel", "SequenceVectorizer"}
+_CONTRACT_ATTRS = ("input_types", "output_type")
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    path: str
+    node: ast.ClassDef
+    bases: List[str]                      # last components of base exprs
+    body_assigns: Dict[str, ast.expr]     # attr -> value expr in class body
+    init_binds: Dict[str, Optional[ast.expr]]  # attr -> expr (None=opaque)
+
+
+def _collect_classes(ctxs: Sequence[LintContext]) -> Dict[str, List[ClassInfo]]:
+    table: Dict[str, List[ClassInfo]] = {}
+    for ctx in ctxs:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            bases = []
+            for b in node.bases:
+                d = dotted_name(b)
+                if d:
+                    bases.append(d.split(".")[-1])
+            body_assigns: Dict[str, ast.expr] = {}
+            init_binds: Dict[str, Optional[ast.expr]] = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            body_assigns[t.id] = stmt.value
+                elif isinstance(stmt, ast.AnnAssign) and \
+                        isinstance(stmt.target, ast.Name) and \
+                        stmt.value is not None:
+                    body_assigns[stmt.target.id] = stmt.value
+                elif isinstance(stmt, ast.FunctionDef):
+                    # contract attrs may be bound in any method, e.g.
+                    # passthrough stages pin output_type in set_input()
+                    for attr, val in _method_contract_binds(stmt).items():
+                        init_binds.setdefault(attr, val)
+            table.setdefault(node.name, []).append(ClassInfo(
+                name=node.name, path=ctx.path, node=node, bases=bases,
+                body_assigns=body_assigns, init_binds=init_binds))
+    return table
+
+
+def _method_contract_binds(init: ast.FunctionDef
+                           ) -> Dict[str, Optional[ast.expr]]:
+    """Contract attrs bound inside a method: `self.input_types = X` (expr X,
+    possibly opaque), or passed by keyword to any call (super().__init__ /
+    base ctor pass-through), or accepted as a ctor parameter (value decided
+    per-instance -> opaque but *bound*)."""
+    binds: Dict[str, Optional[ast.expr]] = {}
+    params = {a.arg for a in init.args.args + init.args.kwonlyargs}
+    for attr in _CONTRACT_ATTRS:
+        if attr in params:
+            binds[attr] = None
+
+    def record(attr: str, value: ast.expr) -> None:
+        # a ctor-parameter pass-through (self.output_type = feature_type)
+        # is bound but per-instance -> opaque, not a type literal to judge
+        names = {n.id for n in ast.walk(value) if isinstance(n, ast.Name)}
+        binds[attr] = None if names & params else value
+
+    for node in ast.walk(init):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self" and t.attr in _CONTRACT_ATTRS:
+                    record(t.attr, node.value)
+        elif isinstance(node, ast.Call) and init.name == "__init__":
+            # ctor keyword pass-through only counts in __init__; other
+            # methods constructing *different* stages must not match
+            for kw in node.keywords:
+                if kw.arg in _CONTRACT_ATTRS and kw.arg not in binds:
+                    record(kw.arg, kw.value)
+    return binds
+
+
+class _ContractIndex:
+    """Transitive closures + contract resolution over the class table."""
+
+    def __init__(self, ctxs: Sequence[LintContext]):
+        self.table = _collect_classes(ctxs)
+        self.feature_types = self._closure({"FeatureType"})
+        self.stage_classes = self._closure(set(FRAMEWORK_BASES) |
+                                           {"PipelineStage"})
+        # FeatureType validation needs the actual hierarchy in the scan set
+        self.can_check_types = "FeatureType" in self.table
+
+    def _closure(self, seeds: Set[str]) -> Set[str]:
+        out = set(seeds)
+        changed = True
+        while changed:
+            changed = False
+            for name, infos in self.table.items():
+                if name in out:
+                    continue
+                for info in infos:
+                    if any(b in out for b in info.bases):
+                        out.add(name)
+                        changed = True
+                        break
+        return out
+
+    def pick(self, name: str, prefer_path: Optional[str] = None
+             ) -> Optional[ClassInfo]:
+        infos = self.table.get(name)
+        if not infos:
+            return None
+        if prefer_path:
+            for i in infos:
+                if i.path == prefer_path:
+                    return i
+        for i in infos:
+            if not i.path.startswith("tests/"):
+                return i
+        return infos[0]
+
+    def resolve_attr(self, info: ClassInfo, attr: str, _depth: int = 0
+                     ) -> Tuple[bool, Optional[ast.expr]]:
+        """(bound?, value expr or None-if-opaque), stopping at framework
+        bases so their permissive defaults don't count."""
+        if attr in info.body_assigns:
+            return True, info.body_assigns[attr]
+        if attr in info.init_binds:
+            return True, info.init_binds[attr]
+        if _depth > 16:
+            return False, None
+        for b in info.bases:
+            if b in FRAMEWORK_BASES:
+                continue
+            if attr == "input_types" and b in INPUT_OPAQUE_BASES:
+                continue
+            base = self.pick(b, prefer_path=info.path)
+            if base is not None:
+                bound, val = self.resolve_attr(base, attr, _depth + 1)
+                if bound:
+                    return True, val
+        return False, None
+
+    def input_arity(self, info: ClassInfo) -> Optional[int]:
+        """len(input_types) when statically resolvable to a tuple literal."""
+        bound, val = self.resolve_attr(info, "input_types")
+        if bound and isinstance(val, (ast.Tuple, ast.List)):
+            return len(val.elts)
+        return None
+
+    def is_sequence(self, info: ClassInfo) -> Optional[bool]:
+        bound, val = self.resolve_attr(info, "is_sequence")
+        if bound and isinstance(val, ast.Constant) and \
+                isinstance(val.value, bool):
+            return val.value
+        return None
+
+
+def _type_name_ok(expr: ast.expr, feature_types: Set[str]) -> Optional[str]:
+    """None if the element is valid (known FeatureType or None); else a
+    short description of the offender. Unresolvable exprs are valid."""
+    if isinstance(expr, ast.Constant):
+        if expr.value is None:
+            return None
+        return repr(expr.value)
+    d = dotted_name(expr)
+    if d is None:
+        return None  # computed; cannot judge statically
+    last = d.split(".")[-1]
+    if last in feature_types:
+        return None
+    return d
+
+
+@project_rule("DAG001", "stage input/output contracts declared, well-typed, "
+                        "and consistent with DSL wiring")
+def check_dag001(ctxs: Sequence[LintContext]) -> List[Finding]:
+    idx = _ContractIndex(ctxs)
+    by_path = {c.path: c for c in ctxs}
+    findings: List[Finding] = []
+
+    # -- 1+2: declaration presence and FeatureType validity ----------------
+    for name in sorted(idx.stage_classes):
+        if name in FRAMEWORK_BASES or name in INPUT_OPAQUE_BASES or \
+                name == "HasParams":
+            continue
+        for info in idx.table.get(name, []):
+            ctx = by_path.get(info.path)
+            if ctx is None:
+                continue
+            for attr in _CONTRACT_ATTRS:
+                bound, val = idx.resolve_attr(info, attr)
+                if not bound:
+                    f = ctx.finding(
+                        "DAG001", info.node,
+                        f"stage `{name}` never binds `{attr}` — it inherits "
+                        f"the permissive framework default, so neither the "
+                        f"linter nor runtime check_input_types can verify "
+                        f"its wiring; declare it explicitly")
+                    if f:
+                        findings.append(f)
+                    continue
+                if val is None or not idx.can_check_types:
+                    continue
+                if attr == "input_types" and \
+                        isinstance(val, (ast.Tuple, ast.List)):
+                    for el in val.elts:
+                        bad = _type_name_ok(el, idx.feature_types)
+                        if bad is not None:
+                            f = ctx.finding(
+                                "DAG001", el,
+                                f"`{name}.input_types` entry `{bad}` is not "
+                                f"a known FeatureType subclass (or None)")
+                            if f:
+                                findings.append(f)
+                elif attr == "output_type":
+                    bad = _type_name_ok(val, idx.feature_types)
+                    if bad is not None:
+                        f = ctx.finding(
+                            "DAG001", val,
+                            f"`{name}.output_type` `{bad}` is not a known "
+                            f"FeatureType subclass")
+                        if f:
+                            findings.append(f)
+
+    # -- 3: call-site wiring ----------------------------------------------
+    for ctx in ctxs:
+        findings.extend(_check_wiring(ctx, idx))
+    return findings
+
+
+def _stage_class_of(expr: ast.expr, local_ctors: Dict[str, str]
+                    ) -> Optional[str]:
+    """Class name when `expr` is `Cls(...)` or a local var bound to one."""
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name):
+        return expr.func.id
+    if isinstance(expr, ast.Name):
+        return local_ctors.get(expr.id)
+    return None
+
+
+def _check_wiring(ctx: LintContext, idx: _ContractIndex) -> List[Finding]:
+    findings: List[Finding] = []
+
+    # map of function scope -> {var: ClsName} for simple `x = Cls(...)`
+    def local_ctor_map(fn_node: ast.AST) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        ambiguous: Set[str] = set()
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                var = node.targets[0].id
+                v = node.value
+                cls = None
+                if isinstance(v, ast.Call) and isinstance(v.func, ast.Name):
+                    cls = v.func.id
+                # chained: x = Cls(...).set_param(...) etc.
+                elif isinstance(v, ast.Call) and \
+                        isinstance(v.func, ast.Attribute):
+                    inner = v.func.value
+                    if isinstance(inner, ast.Call) and \
+                            isinstance(inner.func, ast.Name):
+                        cls = inner.func.id
+                if var in out and out.get(var) != cls:
+                    ambiguous.add(var)
+                if cls is not None:
+                    out[var] = cls
+        for var in ambiguous:
+            out.pop(var, None)
+        return out
+
+    scopes: List[Tuple[ast.AST, Dict[str, str]]] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scopes.append((node, local_ctor_map(node)))
+    scopes.append((ctx.tree, {}))
+
+    checked: Set[int] = set()
+    for scope_node, ctors in scopes:
+        for node in ast.walk(scope_node):
+            if id(node) in checked or not isinstance(node, ast.Call):
+                continue
+            # dsl helper conventions
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in ("_unary",) and len(node.args) >= 2:
+                checked.add(id(node))
+                cls_name = node.args[1].id if \
+                    isinstance(node.args[1], ast.Name) else None
+                findings.extend(_arity_check(ctx, idx, node, cls_name, 1,
+                                             starred=False))
+                continue
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id == "_binary_op" and len(node.args) >= 4:
+                checked.add(id(node))
+                for argi, arity in ((2, 1), (3, 2)):
+                    cls_name = node.args[argi].id if \
+                        isinstance(node.args[argi], ast.Name) else None
+                    findings.extend(_arity_check(ctx, idx, node, cls_name,
+                                                 arity, starred=False))
+                continue
+            if not (isinstance(node.func, ast.Attribute) and
+                    node.func.attr == "set_input"):
+                continue
+            checked.add(id(node))
+            cls_name = _stage_class_of(node.func.value, ctors)
+            if cls_name is None:
+                continue
+            starred = any(isinstance(a, ast.Starred) for a in node.args)
+            n_plain = sum(1 for a in node.args
+                          if not isinstance(a, ast.Starred))
+            findings.extend(_arity_check(
+                ctx, idx, node, cls_name,
+                None if starred else n_plain, starred=starred,
+                min_arity=n_plain))
+    return findings
+
+
+def _arity_check(ctx: LintContext, idx: _ContractIndex, node: ast.AST,
+                 cls_name: Optional[str], arity: Optional[int], *,
+                 starred: bool, min_arity: int = 0) -> List[Finding]:
+    out: List[Finding] = []
+    if cls_name is None:
+        return out
+    info = idx.pick(cls_name, prefer_path=ctx.path)
+    if info is None or cls_name not in idx.stage_classes:
+        return out
+    declared = idx.input_arity(info)
+    seq = idx.is_sequence(info)
+    if starred:
+        if seq is False and declared not in (None, 0):
+            f = ctx.finding(
+                "DAG001", node,
+                f"starred set_input(...) on `{cls_name}`, which declares "
+                f"a fixed arity of {declared} and is not a sequence stage")
+            if f:
+                out.append(f)
+        return out
+    if arity is None or declared is None or declared == 0 or seq is True:
+        return out
+    if arity != declared:
+        f = ctx.finding(
+            "DAG001", node,
+            f"`{cls_name}` wired with {arity} input(s) but declares "
+            f"input_types of length {declared} — runtime "
+            f"check_input_types would reject this DAG")
+        if f:
+            out.append(f)
+    return out

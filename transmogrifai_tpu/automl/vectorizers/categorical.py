@@ -31,6 +31,10 @@ def clean_text_value(s: str, clean: bool = True) -> str:
 class OneHotModel(VectorizerModel):
     """Fitted pivot: per feature, topK indicator cols + OTHER + null."""
 
+    # class-level: any element (Text-ish or MultiPickList); Estimator.fit
+    # pins each fitted instance to its estimator's concrete contract
+    input_types = (None,)
+
     def __init__(self, vocabs: Sequence[Sequence[str]], track_nulls: bool = True,
                  clean_text: bool = True, multiset: bool = False,
                  operation_name: str = "pivot", uid: Optional[str] = None):

@@ -123,6 +123,8 @@ def unit_circle(ms: np.ndarray, period_name: str
 
 
 class DateVectorizerModel(VectorizerModel):
+    input_types = (Integral,)  # mirrors DateVectorizer: Date/DateTime
+
     def __init__(self, reference_date_ms: float,
                  circular_periods: Sequence[str], track_nulls: bool = True,
                  operation_name: str = "vecDate", uid: Optional[str] = None):
@@ -237,6 +239,8 @@ class DateListVectorizerModel(VectorizerModel):
     """DateList pivot modes (reference DateListPivot): SinceLast (default) —
     days from reference to most recent event; also ModeDay etc. are reduced
     to SinceFirst/SinceLast here."""
+
+    input_types = (DateList,)  # mirrors DateListVectorizer
 
     def __init__(self, reference_date_ms: float, mode: str = "SinceLast",
                  operation_name: str = "vecDateList", uid: Optional[str] = None):

@@ -35,6 +35,8 @@ def geo_mean(values: Sequence[Sequence[float]]) -> List[float]:
 
 
 class GeolocationModel(VectorizerModel):
+    input_types = (Geolocation,)  # mirrors GeolocationVectorizer
+
     def __init__(self, fills: Sequence[Sequence[float]], track_nulls: bool = True,
                  operation_name: str = "vecGeo", uid: Optional[str] = None):
         super().__init__(operation_name, uid=uid)

@@ -44,6 +44,8 @@ def clean_key(k: str, clean: bool) -> str:
 class MapVectorizerModel(VectorizerModel):
     """Fitted map vectorizer: per (feature, key) column plans."""
 
+    input_types = (OPMap,)  # mirrors MapVectorizer
+
     def __init__(self, feature_plans: Sequence[Dict[str, Any]],
                  clean_keys: bool = False,
                  operation_name: str = "vecMap", uid: Optional[str] = None):
@@ -280,6 +282,8 @@ class DateMapUnitCircleModel(VectorizerModel):
     DateMapToUnitCircleVectorizer.scala via RichMapFeature
     .toUnitCircle:716). Missing keys map to the origin (0, 0) exactly like
     the scalar DateToUnitCircleTransformer."""
+
+    input_types = (OPMap,)  # mirrors DateMapUnitCircleVectorizer
 
     def __init__(self, key_sets: Sequence[List[str]] = (),
                  time_period: str = "HourOfDay", clean_keys: bool = False,

@@ -15,12 +15,18 @@ import numpy as np
 from ...data.dataset import Column
 from ...data.vector import NULL_STRING, VectorColumnMetadata, VectorMetadata
 from ...stages.params import Param
-from ...types import Binary, Currency, Date, DateTime, Integral, Percent, Real, RealNN
+from ...types import (
+    Binary, Currency, Date, DateTime, Integral, OPNumeric, Percent, Real,
+    RealNN,
+)
 from .base import SequenceVectorizer, VectorizerModel, numeric_block
 
 
 class NumericVectorizerModel(VectorizerModel):
     """Fitted numeric vectorizer: impute with per-feature fill, track nulls."""
+
+    # any numeric flavor: the Real/Integral/RealNN estimators all fit this
+    input_types = (OPNumeric,)
 
     def __init__(self, fills: Sequence[float], track_nulls: bool = True,
                  operation_name: str = "vecReal", uid: Optional[str] = None):
@@ -166,6 +172,8 @@ class NumericBucketizerModel(VectorizerModel):
 
     Reference NumericBucketizer.scala:303 — splits are [-inf, s1), [s1, s2)...
     """
+
+    input_types = (OPNumeric,)  # mirrors NumericBucketizer's numeric family
 
     def __init__(self, splits: Sequence[Sequence[float]], track_nulls: bool = True,
                  track_invalid: bool = False,

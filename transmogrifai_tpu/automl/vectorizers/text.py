@@ -78,6 +78,8 @@ def tokenize_hash_counts(docs: Sequence[Optional[str]], bins: int,
 class SmartTextModel(VectorizerModel):
     """Fitted smart-text: per feature either a pivot vocab or a hash space."""
 
+    input_types = (Text,)  # mirrors SmartTextVectorizer
+
     def __init__(self, plans: Sequence[Dict[str, Any]],
                  operation_name: str = "smartTxt", uid: Optional[str] = None):
         super().__init__(operation_name, uid=uid)
@@ -204,6 +206,10 @@ class SmartTextVectorizer(SequenceVectorizer):
 
 class HashingModel(VectorizerModel):
     """Pure hashing-trick vectorizer (no fit stats beyond widths)."""
+
+    # class-level: TextList (is_list=True) or pre-tokenized Text;
+    # Estimator.fit pins each fitted instance to its estimator's contract
+    input_types = (None,)
 
     def __init__(self, num_features: int = 512, shared_hash_space: bool = False,
                  binary_freq: bool = False, is_list: bool = True,

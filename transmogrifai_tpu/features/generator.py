@@ -17,6 +17,8 @@ from .feature import Feature
 class FeatureGeneratorStage(PipelineStage):
     """Origin stage: record -> feature value."""
 
+    input_types = ()  # source stage: extracts from raw records, no inputs
+
     def __init__(self, name: str, feature_type: Type[FeatureType],
                  extract_fn: Callable[[Any], Any],
                  is_response: bool = False,

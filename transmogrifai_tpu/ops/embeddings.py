@@ -49,16 +49,19 @@ def cooccurrence_matrix(token_lists: Sequence[Optional[Sequence[str]]],
     Per document the inner accumulation is vectorized (np.add.at per window
     offset over the whole id array); only the document loop is Python.
     """
-    C = np.zeros((vocab_bins, vocab_bins), np.float64)
+    # int64 accumulation is exact at any corpus size (f32 +1 saturates at
+    # 2^24 per cell; f64 doubles host->device traffic); the returned f32
+    # only feeds log1p, where >=2^24 counts lose < 1e-7 relative
+    C = np.zeros((vocab_bins, vocab_bins), np.int64)
     for toks in token_lists:
         if not toks or len(toks) < 2:
             continue
         ids = hash_token_ids(list(toks), vocab_bins, seed)
         for off in range(1, min(window, len(ids) - 1) + 1):
             a, b = ids[:-off], ids[off:]
-            np.add.at(C, (a, b), 1.0)
-            np.add.at(C, (b, a), 1.0)
-    return C
+            np.add.at(C, (a, b), 1)
+            np.add.at(C, (b, a), 1)
+    return C.astype(np.float32)
 
 
 @partial(jax.jit, static_argnames=("dim", "n_iter"))
@@ -99,7 +102,10 @@ def mean_pool_docs(token_lists: Sequence[Optional[Sequence[str]]],
     lengths = np.fromiter((len(t) if t else 0 for t in token_lists),
                           np.int64, n)
     total = int(lengths.sum())
-    out = np.zeros((n, dim), np.float64)
+    # f32 accumulator: doc lengths are tiny (<<2^24 terms) so the mean-pool
+    # sum stays within f32 tolerance of the f64 reference (tested in
+    # tests/test_tmoglint.py::test_mean_pool_f32_matches_f64)
+    out = np.zeros((n, dim), np.float32)
     if not total:
         return out
     flat: List[str] = [t for toks in token_lists if toks for t in toks]

@@ -362,11 +362,23 @@ def _sharded_sweep_fn(mesh, loss, max_iter, tol, fit_intercept,
         _streamed_core, loss=loss, max_iter=max_iter, tol=tol,
         fit_intercept=fit_intercept, standardize=standardize,
         axis_name=BATCH_AXIS)
+    # the Newton solve is a lax.while_loop; jax 0.4.x shard_map has no
+    # replication rule for `while`, so replication checking must be off
+    # (the accumulate() psums make every carry replicated by construction).
+    # jax >= 0.6 renamed the knob check_rep -> check_vma.
+    import inspect as _inspect
+    sig = _inspect.signature(shard_map)
+    if "check_rep" in sig.parameters:
+        extra = {"check_rep": False}
+    elif "check_vma" in sig.parameters:
+        extra = {"check_vma": False}
+    else:
+        extra = {}
     sm = shard_map(
         core, mesh=mesh,
         in_specs=(P(BATCH_AXIS, None), P(BATCH_AXIS), P(BATCH_AXIS),
                   P(None, BATCH_AXIS), P(None), P(None)),
-        out_specs=(P(None, None, None), P(None, None)))
+        out_specs=(P(None, None, None), P(None, None)), **extra)
     return jax.jit(sm)
 
 

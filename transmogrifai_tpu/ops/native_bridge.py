@@ -294,6 +294,7 @@ def native_parse_floats(data: bytes, bounds: np.ndarray
         return None
     buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(1, np.uint8)
     n = len(bounds) // 2
+    # tmoglint: disable=TPU003  C ABI: tmog_parse_floats writes doubles
     out = np.zeros(n, np.float64)
     lib.tmog_parse_floats(_as_u8p(buf), _as_i64p(np.ascontiguousarray(
         bounds, np.int64)), n, _as_f64p(out))

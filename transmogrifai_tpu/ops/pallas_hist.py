@@ -686,7 +686,8 @@ def route(Xb_t: jax.Array, node_t: jax.Array, f_lvl: jax.Array,
 
 
 def _route_hist_kernel(xb_ref, pay_ref, node_ref, tbl_ref, hist_ref,
-                       node_out_ref, *, F, B, C, n_nodes, n_pad, n_folds,
+                       node_out_ref, *, F: int, B: int, C: int, n_nodes: int,
+                       n_pad: int, n_folds: int,
                        variant, use_bf16=False, derive_count=False):
     import jax.experimental.pallas as pl
 

@@ -87,6 +87,7 @@ def float_column(vals: Sequence[Any], fill: float) -> Optional[np.ndarray]:
     m = module()
     if m is None:
         return None
+    # tmoglint: disable=TPU003  C++ ext ABI: float_column fills a double buffer
     out = np.empty(len(vals), np.float64)
     m.float_column(vals, float(fill), out)
     return out

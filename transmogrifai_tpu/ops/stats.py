@@ -180,6 +180,9 @@ def contingency_stats(table: jax.Array) -> ContingencyStats:
     Ports OpStatistics.{chiSquaredTest:188, mutualInfo:234,
     maxConfidences:280, contingencyStats:300}.
     """
+    # dtype passthrough, not promotion: stays f32 unless the caller already
+    # runs an x64 host table
+    # tmoglint: disable=TPU003  dtype passthrough, not promotion
     t = jnp.asarray(table, jnp.float64 if table.dtype == jnp.float64 else jnp.float32)
     total = jnp.maximum(t.sum(), EPS)
     rows = t.sum(axis=1)

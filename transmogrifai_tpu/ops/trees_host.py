@@ -73,8 +73,12 @@ def quantile_edges_host(X: np.ndarray, n_bins: int) -> np.ndarray:
         stride = -(-n // T._QUANTILE_SAMPLE)
         X = X[::stride]
     X = np.asarray(X, np.float32)
+    # host-only quantile math: f64 keeps the edge interpolation exact and the
+    # returned edges are cast to f32 below, so no f64 reaches the device
+    # tmoglint: disable=TPU003  host precision, result cast to f32
     qs = np.arange(1, n_bins, dtype=np.float64) / n_bins
     with np.errstate(invalid="ignore"):
+        # tmoglint: disable=TPU003  host precision, result cast to f32
         edges = np.nanquantile(X.astype(np.float64), qs, axis=0)
     return np.asarray(edges.T, np.float32)
 

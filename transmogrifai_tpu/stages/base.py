@@ -140,6 +140,15 @@ class PipelineStage(HasParams):
         stages persist their Spark params in the same JSON)."""
         d = {"operation_name": self.operation_name, "uid": self.uid}
         d.update(self.param_values())
+        # a contract pinned on the *instance* (Estimator.fit narrowing the
+        # fitted model to its estimator's types) must survive save/load, or
+        # reloaded models silently revert to the permissive class default
+        if "input_types" in self.__dict__:
+            d["pinned_input_types"] = [
+                None if t is None else t.type_name()
+                for t in self.input_types]
+            d["pinned_is_sequence"] = bool(self.is_sequence)
+            d["pinned_fixed_arity"] = int(self.fixed_arity)
         return d
 
     @classmethod
@@ -301,6 +310,13 @@ class Estimator(PipelineStage):
     def fit(self, ds: Dataset) -> Transformer:
         cols = [ds.column(n) for n in self.input_names()]
         model = self.fit_columns(*cols)
+        # pin the fitted instance to this estimator's contract: model
+        # classes that declare a broad element type (e.g. OneHotModel's
+        # (None,)) enforce, per instance, exactly what their estimator
+        # accepted — the estimator/model pair always sees the same features
+        model.input_types = tuple(self.input_types)
+        model.is_sequence = self.is_sequence
+        model.fixed_arity = self.fixed_arity
         model.set_input(*self._input_features)
         model.set_output_name(self.output_name())
         # model replaces the estimator as origin of the output feature

@@ -124,13 +124,31 @@ def unpack_args(obj: Any, store: Dict[str, np.ndarray]) -> Any:
 def build_stage(class_name: str, args: Dict[str, Any]):
     """Instantiate a stage from its class name + unpacked save_args."""
     cls = resolve_stage_class(class_name)
-    return cls.from_save_args(args)
+    stage = cls.from_save_args(args)
+    _apply_pinned_contract(stage, args)
+    return stage
+
+
+def _apply_pinned_contract(stage, args: Dict[str, Any]) -> None:
+    """Restore an instance-level contract saved by PipelineStage.save_args
+    (Estimator.fit pins fitted models to their estimator's types)."""
+    pinned = args.get("pinned_input_types")
+    if pinned is None:
+        return
+    from ..types import FeatureType
+    stage.input_types = tuple(
+        None if n is None else FeatureType.from_name(n) for n in pinned)
+    if "pinned_is_sequence" in args:
+        stage.is_sequence = bool(args["pinned_is_sequence"])
+    if "pinned_fixed_arity" in args:
+        stage.fixed_arity = int(args["pinned_fixed_arity"])
 
 
 def default_from_save_args(cls: type, args: Dict[str, Any]):
     """Construct cls(**args), dropping keys its __init__ does not accept
     (mirror of PipelineStage.copy's filtering)."""
-    args = {k: v for k, v in args.items() if k != "lambda"}
+    args = {k: v for k, v in args.items()
+            if k != "lambda" and not k.startswith("pinned_")}
     sig = inspect.signature(cls.__init__)
     has_kwargs = any(p.kind is inspect.Parameter.VAR_KEYWORD
                      for p in sig.parameters.values())
