@@ -211,6 +211,7 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
     glm_s = tree_s = 0.0
     glm_warm_s = None
     glm_route = None
+    glm_info = None  # round/pass telemetry of the streamed route
     saved_min_rows = V.STREAMED_SWEEP_MIN_ROWS
     log(f"GLM sweep: {len(ggrids)} grids x {cfg['folds']} folds")
     try:
@@ -219,8 +220,9 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
             best_glm = val.validate([(lr, [dict(g) for g in ggrids])], X, y)
             glm_s = time.perf_counter() - t0
             glm_route = best_glm.validated[0].route
+            glm_info = val.last_streamed_telemetry
             log(f"GLM sweep done in {glm_s:.2f}s (incl. compile, "
-                f"route={glm_route})")
+                f"route={glm_route}, telemetry={glm_info})")
         except Exception as e:
             errors.append(f"glm sweep: {type(e).__name__}: {str(e)[:200]}")
             # the streamed lane-batched kernel is the newest code on this
@@ -239,6 +241,7 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
                                             X, y)
                     glm_s = time.perf_counter() - t0
                     glm_route = best_glm.validated[0].route
+                    glm_info = None  # streamed telemetry does not apply
                     errors.append("glm sweep ok on vmapped-route retry")
                     log(f"GLM sweep (vmapped) done in {glm_s:.2f}s")
                 except Exception as e2:
@@ -329,6 +332,16 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
                tree_fits=len(tgrids) * cfg["folds"] if best_tree else 0,
                best_name=best.name, best_grid=best.best_grid,
                best_au_pr=float(best.best_metric))
+    if glm_route == "streamed" and glm_info:
+        # convergence telemetry: the executed-FLOP model and the
+        # acceptance gates read these (monotone active-lane shrink,
+        # one-pass squared sweeps). The legacy "global" kernel has no
+        # round counters — emit only the keys that exist rather than
+        # JSON nulls that break numeric consumers.
+        out["glm_telemetry"] = glm_info
+        for k in ("glm_rounds", "lanes_retired", "data_passes"):
+            if glm_info.get(k) is not None:
+                out[k] = glm_info[k]
     kernel_roofline = kernel_roofline or \
         getattr(best_tree, "kernel_roofline", None) or []
     if kernel_roofline:
@@ -469,21 +482,38 @@ def _tree_sweep_subprocess(cfg, errors, timeout_s=None):
     return None, 0.0, child_ran
 
 
-def glm_flops_estimate(cfg, route):
+def glm_flops_estimate(cfg, route, telemetry=None):
     """Executed FLOPs for the GLM sweep, matched to the route that actually
     ran (ADVICE r2: attributing vmapped timings to the streamed FLOP model
-    misstates MFU). Streamed (ops/glm_sweep.py): per Newton iteration per
-    lane — eta 2nd + gradient 2nd + compressed Gram 2nT with T = d(d+1)/2
-    (the triangle halves the naive Gram). Vmapped (ops/glm.py per lane):
-    eta 2nd + gradient 2nd + full weighted Gram 2nd^2 + the [n, d] scale
-    nd. 15 iterations, lanes = grid x folds."""
+    misstates MFU) AND to the convergence telemetry the sweep recorded.
+
+    Streamed (ops/glm_sweep.py): per executed lane-pass — eta 2nd +
+    gradient 2nd + FULL symmetric per-lane Gram einsum 2nd^2. (The old
+    model billed the compressed-triangle Gram 2nT, T = d(d+1)/2, which the
+    kernel retired when the triangle's column gather proved to be the TPU
+    wall — _hessian_blocks moved to the full einsum — and it hard-coded 15
+    iterations.) Executed lane-passes come from the sweep's own telemetry
+    — `padded_lane_passes` (sum over rounds of bucket_size x iterations:
+    the device runs the padded power-of-two bucket, so that is what MFU
+    must bill; `lane_passes` is the USEFUL active-lane work) with the
+    logical count as fallback; folds for the one-pass squared-loss Gram
+    path. `glm_rounds`/`lanes_retired`/`data_passes` land in the sweep
+    JSON alongside. Only when telemetry is absent entirely does it fall
+    back to the legacy 15-iterations x all-lanes assumption.
+
+    Vmapped (ops/glm.py per lane): eta 2nd + gradient 2nd + full weighted
+    Gram 2nd^2 + the [n, d] scale nd; 15 iterations x lanes."""
     n, d = cfg["n_rows"], cfg["n_cols"]
-    if route == "streamed":
-        T = d * (d + 1) // 2
-        per_iter_lane = 4 * n * d + 2 * n * T
-    else:  # vmapped / sequential per-lane solve
-        per_iter_lane = 4 * n * d + 2 * n * d * d + n * d
     fits = cfg["glm_grid"] * cfg["folds"]
+    if route == "streamed":
+        per_lane_pass = 4 * n * d + 2 * n * d * d
+        t = telemetry or {}
+        lane_passes = t.get("padded_lane_passes") or t.get("lane_passes")
+        if lane_passes:
+            return per_lane_pass * lane_passes
+        return per_lane_pass * 15 * fits
+    # vmapped / sequential per-lane solve
+    per_iter_lane = 4 * n * d + 2 * n * d * d + n * d
     return per_iter_lane * 15 * fits
 
 
@@ -1008,8 +1038,10 @@ def main():
     persist_partial("device_sweeps")
 
     # 2. MFU — count only families whose device sweep actually ran, with
-    # the FLOP model matched to the route that produced the timing
-    glm_flops = (glm_flops_estimate(cfg, sweep.get("glm_route"))
+    # the FLOP model matched to the route that produced the timing and to
+    # the sweep's own executed-pass telemetry
+    glm_flops = (glm_flops_estimate(cfg, sweep.get("glm_route"),
+                                    sweep.get("glm_telemetry"))
                  if sweep["glm_fits"] else 0.0)
     per_fit = (sweep.get("tree_fit_flops")
                or (tree_flops_cost_analysis(cfg, sweep_dtype)
@@ -1030,7 +1062,10 @@ def main():
         mfu["mfu"] = round((glm_flops + tree_flops) / device_s / peak, 4)
         if glm_warm:
             mfu["glm_mfu_warm"] = round(glm_flops / glm_warm / peak, 4)
-    mfu["glm_flop_model"] = sweep.get("glm_route") or "n/a"
+    mfu["glm_flop_model"] = (sweep.get("glm_route") or "n/a") + (
+        ":measured_passes"
+        if (sweep.get("glm_telemetry") or {}).get("lane_passes")
+        else (":assumed_15it" if sweep.get("glm_route") else ""))
     RESULT["mfu"] = mfu
     persist_partial("mfu")
 

@@ -32,6 +32,13 @@ echo "== 3/5 test suite (8-device virtual CPU mesh) =="
 # batched sweep kernel stays live on hosts with no TPU
 JAX_PLATFORMS=cpu python -m pytest \
   tests/test_hist_batched.py::test_planner_cpu_smoke -q -m 'not slow'
+# convergence-aware GLM sweep smoke (tier-1-safe, small shapes): the
+# squared-loss Gram fast path must stay one-pass and the retirement
+# round driver must keep matching the legacy streamed route on CPU
+JAX_PLATFORMS=cpu python -m pytest \
+  "tests/test_glm_convergence.py::TestGramFastPath::test_single_pass_telemetry" \
+  "tests/test_glm_convergence.py::TestRoundDriver::test_matches_legacy_streamed_logistic" \
+  -q -m 'not slow'
 python -m pytest tests/ -q
 
 echo "== 4/5 examples =="

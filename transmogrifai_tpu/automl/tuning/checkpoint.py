@@ -54,6 +54,74 @@ def sweep_key(model_class: str, grid: Dict[str, Any], n_folds: int,
     return hashlib.sha256(payload.encode()).hexdigest()[:24]
 
 
+class RoundCheckpoint:
+    """Round-granular state of a convergence-aware streamed GLM sweep
+    (ops/glm_sweep.sweep_glm_streamed_rounds): retired-lane coefficients +
+    active-lane state persisted after EVERY retirement boundary, so a
+    preempted streamed sweep resumes at the last finished round instead of
+    restarting the whole family. Finer-grained than SweepCheckpoint's
+    (model x grid) cells — those only land once every fold metric of a
+    cell exists, which for the streamed route means the entire fit.
+
+    One .npz per sweep path (atomic tmp+replace), keyed by the sweep's
+    cell keys + solver knobs: a mismatched key is IGNORED (fresh start),
+    never replayed — the key already folds in the data fingerprint, fold
+    masks, estimator base params and compute path via sweep_key."""
+
+    _META_SCALARS = ("rounds", "data_passes", "lane_passes",
+                     "padded_lane_passes", "warmed")
+    _META_LISTS = ("active_per_round", "iters_per_round", "bucket_sizes")
+    _ARRAYS = ("B", "b0", "delta", "iters", "retired")
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self, key: str) -> Optional[Dict[str, Any]]:
+        import numpy as np
+
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with np.load(self.path, allow_pickle=False) as z:
+                if str(z["key"]) != key:
+                    return None
+                state: Dict[str, Any] = {k: z[k].copy()
+                                         for k in self._ARRAYS}
+                meta = json.loads(str(z["meta"]))
+            for k in self._META_SCALARS:
+                state[k] = meta[k]
+            for k in self._META_LISTS:
+                state[k] = list(meta[k])
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            return None  # torn/foreign/schema-drifted file — refit
+            # rather than trust it (a matching key from an older code
+            # revision can still lack current meta fields)
+        return state
+
+    def save(self, key: str, state: Dict[str, Any]) -> None:
+        import numpy as np
+
+        meta = {k: state[k] for k in self._META_SCALARS}
+        meta.update({k: [int(v) for v in state[k]]
+                     for k in self._META_LISTS})
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, key=np.str_(key), meta=np.str_(json.dumps(meta)),
+                     **{k: np.asarray(state[k]) for k in self._ARRAYS})
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def clear(self) -> None:
+        """Remove the state file once the sweep completed (its results now
+        live in the cell-level SweepCheckpoint records)."""
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+
+
 class SweepCheckpoint:
     """Append-only record of finished sweep cells."""
 

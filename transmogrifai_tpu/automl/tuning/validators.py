@@ -208,6 +208,10 @@ class Validator:
         self.parallelism = int(parallelism)
         # optional sweep checkpoint (resume skips finished model x grid cells)
         self.checkpoint_path: Optional[str] = None
+        # round/pass telemetry of the LAST streamed GLM sweep (bench.py's
+        # executed-FLOP accounting reads it; also mirrored into
+        # utils/metrics.collector.sweep_convergence when collection is on)
+        self.last_streamed_telemetry: Optional[Dict[str, Any]] = None
         self._external_mask_tag = ""  # set per validate() call
         # grid points swept per XLA call (None = auto from the HBM budget);
         # checkpoints land after every chunk, so a preempted vmapped sweep
@@ -539,20 +543,112 @@ class Validator:
     # -- streamed GLM path --------------------------------------------------
     _STREAMED_EVAL_CHUNK = 8
 
+    def _round_checkpoint(self, keys, pending, fit_kwargs):
+        """(RoundCheckpoint, key, resumable state) for the round driver —
+        keyed by the pending cells' sweep keys (which already fold in the
+        data fingerprint, masks, base params and compute path) plus the
+        solver knobs, so state from a different sweep is never replayed."""
+        if self.checkpoint_path is None or keys[0] is None:
+            return None, None, None
+        import hashlib
+        import json as _json
+
+        from .checkpoint import RoundCheckpoint
+        payload = _json.dumps(
+            [[keys[gi] for gi in pending],
+             {k: repr(v) for k, v in sorted(fit_kwargs.items())},
+             os.environ.get("TMOG_GLM_ROUND_ITERS", "")], sort_keys=True)
+        rkey = hashlib.sha256(payload.encode()).hexdigest()[:24]
+        rc = RoundCheckpoint(self.checkpoint_path + ".glm_rounds.npz")
+        return rc, rkey, rc.load(rkey)
+
+    def _record_sweep_telemetry(self, est, info):
+        self.last_streamed_telemetry = dict(info,
+                                            model=type(est).__name__)
+        from ...utils.metrics import collector
+        if collector.enabled:
+            collector.sweep_convergence(
+                family=type(est).__name__, kernel=info["kernel"],
+                rounds=info.get("glm_rounds", 0),
+                data_passes=info.get("data_passes", 0),
+                lane_passes=info.get("lane_passes", 0),
+                lanes_total=info.get("lanes_total", 0),
+                lanes_retired=info.get("lanes_retired", 0),
+                active_per_round=info.get("active_per_round", ()),
+                iters_per_round=info.get("iters_per_round", ()),
+                bucket_sizes=info.get("bucket_sizes", ()))
+
+    def _streamed_fit(self, est, fit_kwargs, Xd, yd, wd, md, regs_p,
+                      alphas_p, keys, pending):
+        """Fit every pending (fold x grid) lane through the best streamed
+        kernel for the loss (docs/performance.md "Convergence-aware GLM
+        sweep"): squared loss -> sufficient-statistics Gram fast path
+        (ONE streaming pass for the whole sweep); IRLS losses -> the
+        host-driven round loop with per-lane retirement and bucket-ladder
+        compaction (round-granular checkpointing when a checkpoint path is
+        set); TMOG_GLM_GRAM=0 / TMOG_GLM_ROUNDS=0 fall back to the legacy
+        single-program global-max route. Returns (B [F, Gp, d] jnp RAW
+        units, b0, telemetry info dict, round-checkpoint or None — the
+        CALLER clears it only after the cells land in the JSONL
+        checkpoint, so a preemption during metric evaluation still
+        resumes from the fully-retired round state instead of
+        refitting)."""
+        from ...ops import glm_sweep as GS
+
+        loss = fit_kwargs["loss"]
+        F = int(md.shape[0])
+        L = F * len(pending)
+        if loss == "squared" and GS.env_on("TMOG_GLM_GRAM"):
+            fk = {k: v for k, v in fit_kwargs.items() if k != "loss"}
+            mi, tl = fk.pop("max_iter"), fk.pop("tol")
+            if self.mesh is not None:
+                B, b0, giters = GS.sweep_glm_squared_gram_sharded(
+                    self.mesh, Xd, yd, wd, md, regs_p, alphas_p, mi, tl,
+                    **fk)
+            else:
+                B, b0, giters = GS.sweep_glm_squared_gram(
+                    Xd, yd, wd, md, regs_p, alphas_p, mi, tl, **fk)
+            info = {"route": "streamed", "kernel": "gram",
+                    "glm_rounds": 1, "data_passes": 1, "lane_passes": F,
+                    "padded_lane_passes": F,  # the Gram pass never pads
+                    "lanes_total": L, "lanes_retired": L,
+                    "gram_solve_iters": int(giters)}
+            return B, b0, info, None
+        if loss != "squared" and GS.env_on("TMOG_GLM_ROUNDS"):
+            rc, rkey, state = self._round_checkpoint(keys, pending,
+                                                     fit_kwargs)
+            on_round = (lambda st: rc.save(rkey, st)) \
+                if rc is not None else None
+            B, b0, info = GS.sweep_glm_streamed_rounds(
+                Xd, yd, wd, md, np.asarray(regs_p), np.asarray(alphas_p),
+                mesh=self.mesh, state=state, on_round=on_round,
+                **fit_kwargs)
+            return jnp.asarray(B), jnp.asarray(b0), info, rc
+        if self.mesh is not None:
+            B, b0 = GS.sweep_glm_streamed_sharded(
+                self.mesh, Xd, yd, wd, md, regs_p, alphas_p, **fit_kwargs)
+        else:
+            B, b0 = GS.sweep_glm_streamed(Xd, yd, wd, md, regs_p,
+                                          alphas_p, **fit_kwargs)
+        return B, b0, {"route": "streamed", "kernel": "global",
+                       "lanes_total": L}, None
+
     def _validate_streamed(self, est, grids, X, y, w, masks, metric,
                            problem_type) -> List[ValidatedModel]:
-        """Streaming lane-batched sweep: ONE program fits every pending
-        (fold x grid) cell with a single X pass per Newton iteration
-        (ops/glm_sweep.sweep_glm_streamed); metrics then run per fold in
-        grid chunks of one scoring matmul each."""
-        from ...ops.glm_sweep import sweep_glm_streamed
-
+        """Streamed convergence-aware sweep: every pending (fold x grid)
+        cell fits through _streamed_fit (Gram fast path / retirement round
+        driver / legacy single program); metrics then run per fold in grid
+        chunks of one scoring matmul each."""
         regs, alphas = self._grid_axis_arrays(est, grids)
         # constant off-axis grid keys (admitted by _constant_off_axis) must
         # bind exactly as on the vmapped path: est.copy(**grids[0])
         base = est.copy(**{k: v for k, v in grids[0].items()})
         margin_thr = self._margin_threshold(est)
         dtype = self.sweep_dtype or jnp.float32
+        # stale telemetry must never survive into a sweep that runs no fit
+        # (fully checkpoint-resumed): bench would pair a previous sweep's
+        # lane_passes with this sweep's near-zero wall
+        self.last_streamed_telemetry = None
         ckpt, keys, results = self._cell_bookkeeping(
             est, grids, X, y, metric, masks.shape[0],
             path=self._sweep_path(f"streamed:{jnp.dtype(dtype).name}"))
@@ -567,15 +663,11 @@ class Validator:
                 if base.has_param("fit_intercept") else True,
                 standardize=bool(base.get_param("standardization"))
                 if base.has_param("standardization") else True)
-            if self.mesh is not None:
-                from ...ops.glm_sweep import sweep_glm_streamed_sharded
-                B, b0 = sweep_glm_streamed_sharded(
-                    self.mesh, Xd, yd, wd, md, jnp.asarray(regs[pending]),
-                    jnp.asarray(alphas[pending]), **fit_kwargs)
-            else:
-                B, b0 = sweep_glm_streamed(
-                    Xd, yd, wd, md, jnp.asarray(regs[pending]),
-                    jnp.asarray(alphas[pending]), **fit_kwargs)
+            B, b0, sweep_info, round_ckpt = self._streamed_fit(
+                est, fit_kwargs, Xd, yd, wd, md,
+                jnp.asarray(regs[pending]), jnp.asarray(alphas[pending]),
+                keys, pending)
+            self._record_sweep_telemetry(est, sweep_info)
             rank_bins = self._rank_bins(X.shape[0])
             thr_d = jnp.asarray(margin_thr, jnp.float32)
             chunk = min(self._STREAMED_EVAL_CHUNK, len(pending))
@@ -597,6 +689,11 @@ class Validator:
                 if ckpt is not None:
                     ckpt.record(keys[gi], type(est).__name__, grids[gi],
                                 fm, metric)
+            if round_ckpt is not None:
+                # only NOW are all cells in the JSONL checkpoint: a
+                # preemption during the evaluation above resumes from the
+                # fully-retired round state instead of refitting
+                round_ckpt.clear()
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
                            grid=g, metric_name=metric,

@@ -188,8 +188,12 @@ class OpLogisticRegression(PredictorEstimator):
     problem_types = ("binary", "multiclass")
     supports_grid_vmap = True
     supports_multiclass_vmap = True
-    # large binary sweeps stream ALL (fold x grid) lanes through one
-    # X pass per Newton iteration (ops/glm_sweep.py)
+    # large binary sweeps stream ALL (fold x grid) lanes through shared
+    # X passes (ops/glm_sweep.py). Parity contract: the convergence-aware
+    # round driver retires each lane at its OWN delta <= tol — the same
+    # stopping rule ops/glm._newton_prox_fit applies per lane — so
+    # streamed coefficients match this estimator's fit_arrays within tol
+    # (tests/test_glm_convergence.py pins it).
     streamed_loss = "logistic"
 
     @classmethod
@@ -262,6 +266,9 @@ class OpLinearSVC(PredictorEstimator):
     problem_types = ("binary",)
     supports_grid_vmap = True
     produces_probabilities = False
+    # same retirement parity contract as OpLogisticRegression; the
+    # 0.5*gap^2 loss scaling keeps reg_param's effective L2 identical on
+    # the streamed and per-lane routes
     streamed_loss = "squared_hinge"
 
     @classmethod
@@ -330,6 +337,12 @@ class OpLinearRegression(PredictorEstimator):
 
     problem_types = ("regression",)
     supports_grid_vmap = True
+    # squared loss has curvature == 1, so the streamed route collapses to
+    # the sufficient-statistics Gram fast path: ONE streaming pass builds
+    # per-fold X^T W X moments, then the whole grid solves off them via
+    # ops/glm.ridge_gram_solve (closed form, the per-lane Newton's fixed
+    # point) and ops/glm.prox_newton_gram (the per-lane update rule
+    # replayed in moment space) — the parity contract with fit_arrays
     streamed_loss = "squared"
 
     @classmethod

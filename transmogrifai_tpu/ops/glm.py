@@ -117,6 +117,98 @@ def _newton_prox_fit(grad_hess_fn, d: int, reg: jax.Array, alpha: jax.Array,
     return beta, b0
 
 
+# -- sufficient-statistics (Gram) solvers for the squared loss ---------------
+#
+# For loss="squared" the IRLS curvature is identically 1, so every lane
+# Hessian collapses to the per-fold weighted Gram X^T diag(w) X —
+# iteration-invariant. ops/glm_sweep streams those moments in ONE pass over
+# X; the two solvers below then replay `_newton_prox_fit`'s exact update
+# rule in moment space. They live HERE, next to the per-lane solvers whose
+# fixed points they share, so the parity contract (pinned by
+# tests/test_glm_convergence.py) cannot drift from the reference math.
+
+
+def ridge_gram_solve(Gm: jax.Array, cm: jax.Array, sx: jax.Array,
+                     sy: jax.Array, sw: jax.Array, l2: jax.Array,
+                     fit_intercept: bool = True
+                     ) -> Tuple[jax.Array, jax.Array]:
+    """Closed-form weighted ridge from per-lane sufficient statistics.
+
+    Gm [L, d, d] = X^T W_l X, cm [L, d] = X^T W_l y, sx [L, d] = X^T W_l 1,
+    sy [L] = 1^T W_l y, sw [L] = 1^T W_l 1, l2 [L]. Solves the stationary
+    point of `_newton_prox_fit(loss=squared, l1=0)` with the intercept
+    eliminated: (G/sw - xbar xbar^T + l2 I) beta = c/sw - xbar ybar and
+    b0 = ybar - xbar.beta — i.e. the point the per-lane Newton iteration
+    converges toward, reached in one batched solve. The 1e-6 jitter matches
+    the iterative Hessian's conditioning. Returns (beta [L, d], b0 [L])."""
+    f32 = jnp.float32
+    d = Gm.shape[-1]
+    eye = jnp.eye(d, dtype=f32)
+    sw_ = jnp.maximum(sw, EPS)
+    if fit_intercept:
+        xbar = sx / sw_[:, None]
+        ybar = sy / sw_
+        A = (Gm / sw_[:, None, None]
+             - xbar[:, :, None] * xbar[:, None, :]
+             + (l2 + 1e-6)[:, None, None] * eye[None])
+        rhs = cm / sw_[:, None] - xbar * ybar[:, None]
+        beta = jnp.linalg.solve(A, rhs[..., None])[..., 0]
+        b0 = ybar - (beta * xbar).sum(1)
+    else:
+        A = Gm / sw_[:, None, None] + (l2 + 1e-6)[:, None, None] * eye[None]
+        beta = jnp.linalg.solve(A, (cm / sw_[:, None])[..., None])[..., 0]
+        b0 = jnp.zeros_like(sy)
+    return beta, b0
+
+
+def prox_newton_gram(Gm: jax.Array, cm: jax.Array, sx: jax.Array,
+                     sy: jax.Array, sw: jax.Array, l1: jax.Array,
+                     l2: jax.Array, beta0: jax.Array, b00: jax.Array,
+                     max_iter, tol, fit_intercept: bool = True
+                     ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Lane-batched proximal Newton on cached squared-loss moments.
+
+    Replays `_newton_prox_fit`'s update rule with every data-dependent term
+    reconstructed from the sufficient statistics (curvature == 1, so no
+    pass over X per iteration): grad = (G beta + b0 sx - c)/sw + l2 beta,
+    H = G/sw + (l2 + 1e-6) I (iteration-invariant, factored once by shape),
+    proximal L1 against H's diagonal, intercept step b0 - g0 (h0/wsum == 1
+    because wsum IS the lane weight sum). Warm-startable via beta0/b00 —
+    the Gram fast path seeds from `ridge_gram_solve` of the same l2
+    (pathwise continuation). max_iter/tol are traced scalars. Returns
+    (beta [L, d], b0 [L], iters executed)."""
+    f32 = jnp.float32
+    d = Gm.shape[-1]
+    eye = jnp.eye(d, dtype=f32)
+    sw_ = jnp.maximum(sw, EPS)
+    H = Gm / sw_[:, None, None] + (l2 + 1e-6)[:, None, None] * eye[None]
+    hdiag = jnp.maximum(jnp.diagonal(H, axis1=1, axis2=2), EPS)
+
+    def cond(state):
+        i, _, _, delta = state
+        return (i < max_iter) & (delta > tol)
+
+    def body(state):
+        i, beta, b0, _ = state
+        g = ((jnp.einsum('lde,le->ld', Gm, beta) + b0[:, None] * sx - cm)
+             / sw_[:, None] + l2[:, None] * beta)
+        step = jnp.linalg.solve(H, g[..., None])[..., 0]
+        beta_new = _soft_threshold(beta - step, l1[:, None] / hdiag)
+        if fit_intercept:
+            g0 = ((sx * beta).sum(1) + b0 * sw_ - sy) / sw_
+            b0_new = b0 - g0
+        else:
+            b0_new = b0
+        delta = (jnp.abs(beta_new - beta).max(1)
+                 + jnp.abs(b0_new - b0)).max()
+        return i + 1, beta_new, b0_new, delta
+
+    state = (jnp.asarray(0, jnp.int32), beta0.astype(f32),
+             b00.astype(f32), jnp.asarray(jnp.inf, f32))
+    i, beta, b0, _ = jax.lax.while_loop(cond, body, state)
+    return beta, b0, i
+
+
 def fit_logistic(X: jax.Array, y: jax.Array, w: jax.Array,
                  reg: jax.Array, elastic_net: jax.Array,
                  max_iter: int = 50, tol: float = 1e-6,

@@ -1,5 +1,6 @@
 """Lane-batched streaming GLM sweep: every (fold x grid) fit in ONE pass
-over the feature matrix per Newton iteration.
+over the feature matrix per Newton iteration — and, since the
+convergence-aware restructure, only for the lanes that still need it.
 
 The vmapped sweep (`automl/tuning/validators._sweep`) runs `fit_one` per
 lane, so each of the L = folds x grid lanes re-streams the [n, d] matrix
@@ -26,27 +27,59 @@ every thread refitting against the same cached DataFrame):
 - per-lane 64x64 Newton solves + proximal L1 + intercept steps are
   batched dense linalg on [L, d, d] — microscopic next to the scan.
 
+Convergence awareness (docs/performance.md "Convergence-aware GLM
+sweep") adds three routes on top of the shared scan machinery:
+
+1. `sweep_glm_squared_gram` — loss="squared" sufficient-statistics fast
+   path. The squared-loss curvature is identically 1, so the lane Hessian
+   collapses to the per-FOLD weighted Gram X^T diag(w * mask_f) X:
+   iteration-invariant and only F matrices, not L. ONE streaming pass
+   builds [F, d, d] Grams + X^T W_f y / X^T W_f 1 moments (psum'd under
+   shard_map); the whole reg x alpha grid then solves off the cached
+   moments — ridge lanes closed form (`ops/glm.ridge_gram_solve`),
+   elastic-net lanes by proximal Newton on the cached Gram
+   (`ops/glm.prox_newton_gram`, seeded from the ridge solution). Up to
+   max_iter full-data passes become exactly one.
+2. `sweep_glm_round` + the host driver `sweep_glm_streamed_rounds` — for
+   IRLS losses (logistic, squared_hinge) the run-to-global-convergence
+   while_loop is replaced by rounds of K iterations with a PER-LANE delta
+   vector in the carry; after each round the host retires converged lanes
+   (coefficients frozen — matching the per-lane solvers' own tol
+   semantics, `ops/glm._newton_prox_fit`) and compacts survivors into the
+   next round's program. The lane axis pads to a power-of-two bucket
+   ladder (`bucket_lanes`) so recompiles are bounded and the jit cache is
+   shared across rounds, chunks and sweeps; inert padded lanes carry zero
+   fold weights. Round 0 optionally fits only each fold's
+   strongest-regularization lane and seeds the rest of the fold from it
+   (glmnet-style pathwise continuation).
+3. `sweep_glm_streamed` — the legacy single-program global-max route,
+   kept as the kill-switch fallback (TMOG_GLM_ROUNDS=0 / TMOG_GLM_GRAM=0)
+   and the parity reference in tests. `tol`/`max_iter` are traced scalars
+   on every route (they only feed while-loop conds), so tuning them never
+   recompiles.
+
 Fold masks enter as weights (mask * w), exactly like the vmapped path, so
 fold semantics are identical; the elementwise residual/curvature rules per
 loss mirror ops/glm's solvers (logistic IRLS, squared, squared-hinge).
 
-Distribution: `sweep_glm_streamed_sharded` runs the SAME core inside a
+Distribution: the `*_sharded` variants run the SAME cores inside a
 shard_map over the mesh `batch` axis — each shard scans its local rows,
 then every accumulator reduction psums over ICI/DCN (the Spark-shuffle /
 Rabit-allreduce slot of SURVEY §2.9); the tiny replicated solves run on
 every shard. Sharded standardization uses one-pass psum'd moments.
 
 Standardization note: the per-lane solvers standardize with the lane's own
-(fold-masked) weights; this kernel standardizes ONCE with the global
+(fold-masked) weights; these kernels standardize ONCE with the global
 weights so the standardized matrix can be shared by every lane. Fold
 means/stds differ from global ones by O(1/sqrt(n)) — statistically inert
-at the scales where this kernel is selected (the validator still routes
+at the scales where these kernels are selected (the validator still routes
 small problems through the per-lane path).
 """
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -93,13 +126,36 @@ _ROW_BLOCK_WIDE = 4_096
 # any transmogrified width seen in practice, well before compile blowup.
 _MAX_TILE_PAIRS = 406
 
+# Newton iterations per jitted round on the retirement route; the
+# retirement granularity / wasted-iteration tradeoff (a lane converging
+# mid-round keeps iterating until the round ends). TMOG_GLM_ROUND_ITERS
+# overrides per process.
+ROUND_ITERS_DEFAULT = 5
+
+# Smallest lane bucket on the compaction ladder: buckets below this save
+# almost no per-pass work but add compile entries.
+_BUCKET_MIN = 8
+
+
+def bucket_lanes(n_active: int) -> int:
+    """Smallest power-of-two bucket >= n_active (floor _BUCKET_MIN): the
+    round kernel's lane axis is padded to this, so a sweep compiles at
+    most log2(L/_BUCKET_MIN)+1 distinct round programs per (n, d, F)
+    shape, reused across rounds, grid chunks and repeated sweeps."""
+    b = _BUCKET_MIN
+    while b < n_active:
+        b *= 2
+    return b
+
 
 def streamed_route_ok(d: int, lanes: int, budget_bytes: float) -> bool:
     """Can the streamed kernel take a (d features, lanes) sweep within
     `budget_bytes` of device memory? Owns the kernel's own padding and
     graph-size policy so route guards (validators._streamable) cannot
     drift from it: per-iteration footprint is the assembled [L, d, d]
-    Hessian + LU workspace + tile accumulators (~4x), and the tiled
+    Hessian + LU workspace + tile accumulators (~4x) at the ROUND
+    DRIVER'S first-round bucket (bucket_lanes pads the lane axis to the
+    next power of two, up to ~2x the logical lane count), and the tiled
     path's Python-unrolled tile-pair loop is capped before XLA graph
     size explodes."""
     if d <= TRI_MAX_D:
@@ -109,7 +165,7 @@ def streamed_route_ok(d: int, lanes: int, budget_bytes: float) -> bool:
         if nt * (nt + 1) // 2 > _MAX_TILE_PAIRS:
             return False
         d_work = nt * _FEATURE_TILE
-    return lanes * d_work * d_work * 4.0 * 4.0 <= budget_bytes
+    return bucket_lanes(lanes) * d_work * d_work * 4.0 * 4.0 <= budget_bytes
 
 
 def _residual_curvature(loss: str):
@@ -134,31 +190,220 @@ def _residual_curvature(loss: str):
     return rc
 
 
-def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
-                   tol, fit_intercept, standardize,
+# -- shared scan geometry ----------------------------------------------------
+
+def _tiling(d: int):
+    """(tiled, d_work, bt, tile_pairs) — the narrow/wide Gram geometry for
+    a d-feature matrix, shared by every streamed route so their padding
+    and transient budgets cannot diverge."""
+    if d <= TRI_MAX_D:
+        return False, d, 0, []
+    bt = _FEATURE_TILE
+    nt = -(-d // bt)
+    return True, nt * bt, bt, [(a, b) for a in range(nt)
+                               for b in range(a, nt)]
+
+
+def _gram_fns(tiled: bool, d_work: int, lanes: int, bt: int, tile_pairs):
+    """(hess_blocks, assemble, blocks0) for `lanes` weighted Grams of a
+    d_work-wide block. `hess_blocks(xf [c, d_work] f32, S [c, lanes])`
+    returns per-block accumulator contributions; `assemble` turns the
+    summed accumulator into the full symmetric [lanes, d_work, d_work]."""
+    if tiled:
+        def hess_blocks(xf, S):
+            # Tile-pair contributions [npairs, lanes, bt*bt] — the wide-d
+            # path: each pair materializes only a [c, bt^2] product (the
+            # [c, d(d+1)/2] full triangle would outgrow HBM past ~128
+            # features); off-diagonal tile pairs are computed once and
+            # mirrored at assembly, keeping the triangle savings at tile
+            # granularity.
+            out = []
+            for a, b in tile_pairs:
+                xa = xf[:, a * bt:(a + 1) * bt]
+                xb = xf[:, b * bt:(b + 1) * bt]
+                P = (xa[:, :, None] * xb[:, None, :]).reshape(-1, bt * bt)
+                out.append(jnp.matmul(S.T, P,
+                                      preferred_element_type=jnp.float32))
+            return jnp.stack(out)
+
+        def assemble(hA):
+            H = jnp.zeros((lanes, d_work, d_work), jnp.float32)
+            for p, (a, b) in enumerate(tile_pairs):
+                blk = hA[p].reshape(lanes, bt, bt)
+                H = H.at[:, a * bt:(a + 1) * bt,
+                         b * bt:(b + 1) * bt].set(blk)
+                if a != b:
+                    H = H.at[:, b * bt:(b + 1) * bt,
+                             a * bt:(a + 1) * bt].set(
+                                 blk.transpose(0, 2, 1))
+            return H
+
+        blocks0 = jnp.zeros((len(tile_pairs), lanes, bt * bt), jnp.float32)
+        return hess_blocks, assemble, blocks0
+
+    def hess_blocks(xf, S):
+        # Per-lane weighted Gram [lanes, d, d] for one row block, as ONE
+        # einsum XLA tiles directly. The previous compressed-triangle form
+        # (xf[:, iu0] * xf[:, iu1] -> [c, T] then an [L, c] x [c, T]
+        # matmul) halved the contraction FLOPs but its column GATHER
+        # dominated the whole pass on TPU: measured on v5 lite at the
+        # BASELINE shapes, the gather-built triangle ran 7.8 TF/s
+        # end-to-end while this full symmetric einsum runs 25.8 TF/s —
+        # 1.7x faster despite doing 2x the arithmetic
+        # (tools/tpu_glm_hess_ab.py).
+        return jnp.einsum('cl,cd,ce->lde', S, xf, xf,
+                          preferred_element_type=jnp.float32)
+
+    return (hess_blocks, lambda hA: hA,
+            jnp.zeros((lanes, d_work, d_work), jnp.float32))
+
+
+def _blocked(Xs, y, w, fold_masks, c: int):
+    """Row-pad to the block multiple with w=0 (inert everywhere) and
+    reshape into scan blocks."""
+    n = Xs.shape[0]
+    F = fold_masks.shape[0]
+    nb = -(-n // c)
+    pad = nb * c - n
+    if pad:
+        Xs = jnp.pad(Xs, ((0, pad), (0, 0)))
+        y = jnp.pad(y, (0, pad))
+        w = jnp.pad(w, (0, pad))
+        fold_masks = jnp.pad(fold_masks, ((0, 0), (0, pad)))
+    return (Xs.reshape(nb, c, Xs.shape[1]), y.reshape(nb, c),
+            w.reshape(nb, c), fold_masks.reshape(F, nb, c).transpose(1, 0, 2))
+
+
+def env_on(name: str, default: str = "1") -> bool:
+    """Tri-state TMOG_* toggle parse, shared by every sweep knob
+    (TMOG_GLM_GRAM / TMOG_GLM_ROUNDS in the validator routing,
+    TMOG_GLM_WARMSTART here) so the accepted falsy spellings cannot
+    drift between modules."""
+    return os.environ.get(name, default).strip().lower() \
+        not in ("0", "false", "off")
+
+
+def _newton_prox_update(B, b0, gA, hA, g0A, h0A, wsum_l, l1, l2, eye,
+                        assemble, fit_intercept: bool):
+    """THE damped-Newton + proximal-L1 + intercept update from streamed
+    accumulators, shared by the legacy global-max kernel and the
+    retirement round kernel — the parity contract between the two routes
+    (and the moment-space replay in ops/glm.prox_newton_gram) lives in
+    this one function, so a change to the update rule reaches every route
+    at once. Returns (B_new, b0_new, delta_vec [L])."""
+    g = gA / wsum_l[:, None] + l2[:, None] * B
+    H = assemble(hA) / wsum_l[:, None, None]
+    H = H + (l2[:, None, None] + 1e-6) * eye[None]
+    step = jnp.linalg.solve(H, g[..., None])[..., 0]
+    B_new = B - step
+    hdiag = jnp.maximum(jnp.diagonal(H, axis1=1, axis2=2), EPS)
+    B_new = (jnp.sign(B_new)
+             * jnp.maximum(jnp.abs(B_new) - l1[:, None] / hdiag, 0.0))
+    if fit_intercept:
+        b0_new = b0 - (g0A / wsum_l) / jnp.maximum(h0A / wsum_l, EPS)
+    else:
+        b0_new = b0
+    delta = jnp.abs(B_new - B).max(axis=1) + jnp.abs(b0_new - b0)
+    return B_new, b0_new, delta
+
+
+def _shard_vary(tree, axis_name):
+    """Under shard_map's varying-manual-axes tracking the scan carry
+    becomes batch-varying inside the body; the initial zeros must carry
+    the same type. pcast is the current spelling; pvary the deprecated
+    one on older jax."""
+    if axis_name is None:
+        return tree
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(tree, axis_name, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(tree, axis_name)
+    return tree
+
+
+def _build_shard_map(core, mesh, in_specs, out_specs):
+    """shard_map with the version shims every sharded sweep route needs:
+    import location (jax >= 0.8 top-level), and replication checking off —
+    jax 0.4.x shard_map has no replication rule for `while` (the
+    accumulate() psums make every carry replicated by construction);
+    jax >= 0.6 renamed the knob check_rep -> check_vma."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    import inspect as _inspect
+    sig = _inspect.signature(shard_map)
+    if "check_rep" in sig.parameters:
+        extra = {"check_rep": False}
+    elif "check_vma" in sig.parameters:
+        extra = {"check_vma": False}
+    else:
+        extra = {}
+    return shard_map(core, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **extra)
+
+
+def _psum_moments(X, w, allreduce):
+    """Two-pass weighted column moments in f32 (psum-aware). One-pass
+    E[x^2]-mean^2 cancels catastrophically in f32 for large-mean features
+    (epoch-millisecond timestamps would lose ALL unit-scale variance),
+    silently diverging from the two-pass path."""
+    f32 = jnp.float32
+    wsum = jnp.maximum(allreduce(w.sum().astype(f32)), EPS)
+    xf = X.astype(f32)
+    mean = allreduce((xf * w[:, None]).sum(0)) / wsum
+    centered = xf - mean[None, :]
+    var = allreduce((centered * centered * w[:, None]).sum(0)) / wsum
+    std = jnp.sqrt(jnp.maximum(var, EPS))
+    return mean, std
+
+
+@jax.jit
+def glm_standardize_stats(X: jax.Array, w: jax.Array
+                          ) -> Tuple[jax.Array, jax.Array]:
+    """Global-weight column (mean, std) for the round driver — computed
+    once per sweep, applied on the fly inside every round's scan so no
+    standardized [n, d] copy is ever materialized."""
+    return _psum_moments(X, w, lambda v: v)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_stats_fn(mesh):
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import BATCH_AXIS
+
+    def core(X, w):
+        return _psum_moments(
+            X, w, lambda v: jax.lax.psum(v, BATCH_AXIS))
+
+    sm = _build_shard_map(core, mesh,
+                          in_specs=(P(BATCH_AXIS, None), P(BATCH_AXIS)),
+                          out_specs=(P(None), P(None)))
+    return jax.jit(sm)
+
+
+# -- legacy single-program route (global-max convergence) --------------------
+
+def _streamed_core(X, y, w, fold_masks, regs, alphas, max_iter, tol, *,
+                   loss, fit_intercept, standardize,
                    axis_name: Optional[str] = None):
     """The sweep body. Under shard_map, X/y/w/fold_masks hold this shard's
     LOCAL rows and `axis_name` names the mesh axis every accumulator
-    reduction psums over; axis_name=None is the single-device path."""
+    reduction psums over; axis_name=None is the single-device path.
+    max_iter/tol are traced scalars (they only feed the while-loop cond),
+    so tuning them never triggers a recompile."""
     n, d = X.shape
     F = fold_masks.shape[0]
     Gn = regs.shape[0]
     L = F * Gn
     rc = _residual_curvature(loss)
-    tiled = d > TRI_MAX_D
-    if tiled:
-        bt = _FEATURE_TILE
-        nt = -(-d // bt)
-        d_pad = nt * bt
-        if d_pad > d:
-            # zero columns are inert end to end: mean 0 -> centered 0,
-            # grad 0, H diagonal = l2 + 1e-6 ridge -> Newton step 0, so
-            # padded betas stay exactly 0 and are sliced off on return
-            X = jnp.pad(X, ((0, 0), (0, d_pad - d)))
-        tile_pairs = [(a, b) for a in range(nt) for b in range(a, nt)]
-        d_work = d_pad
-    else:
-        d_work = d
+    tiled, d_work, bt, tile_pairs = _tiling(d)
+    if d_work > d:
+        # zero columns are inert end to end: mean 0 -> centered 0,
+        # grad 0, H diagonal = l2 + 1e-6 ridge -> Newton step 0, so
+        # padded betas stay exactly 0 and are sliced off on return
+        X = jnp.pad(X, ((0, 0), (0, d_work - d)))
 
     def allreduce(v):
         return jax.lax.psum(v, axis_name) if axis_name else v
@@ -167,20 +412,8 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
         if axis_name is None:
             Xs, mean, std = G._standardize(X, w)
         else:
-            # two-pass weighted moments with psum'd partials — one-pass
-            # E[x^2]-mean^2 cancels catastrophically in f32 for
-            # large-mean features (epoch-millisecond timestamps would
-            # lose ALL unit-scale variance), silently diverging from the
-            # single-device path
-            f32 = jnp.float32
-            wsum = jnp.maximum(allreduce(w.sum().astype(f32)), EPS)
-            xf = X.astype(f32)
-            mean = allreduce((xf * w[:, None]).sum(0)) / wsum
-            centered = xf - mean[None, :]
-            var = allreduce(
-                (centered * centered * w[:, None]).sum(0)) / wsum
-            std = jnp.sqrt(jnp.maximum(var, EPS))
-            Xs = ((X.astype(f32) - mean[None, :]) / std[None, :]) \
+            mean, std = _psum_moments(X, w, allreduce)
+            Xs = ((X.astype(jnp.float32) - mean[None, :]) / std[None, :]) \
                 .astype(X.dtype)
     else:
         Xs = X
@@ -195,68 +428,12 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
         allreduce((fold_masks * w[None, :]).sum(1)), EPS)         # [F]
     wsum_l = jnp.repeat(wsum_f, Gn)                     # [L]
 
-    # pad local rows to the block multiple with w=0 (inert everywhere)
     c = min(_ROW_BLOCK_WIDE if tiled else _row_block(d_work), n)
-    nb = -(-n // c)
-    pad = nb * c - n
-    if pad:
-        Xs = jnp.pad(Xs, ((0, pad), (0, 0)))
-        y = jnp.pad(y, (0, pad))
-        w = jnp.pad(w, (0, pad))
-        fold_masks = jnp.pad(fold_masks, ((0, 0), (0, pad)))
-    xs = (Xs.reshape(nb, c, d_work), y.reshape(nb, c), w.reshape(nb, c),
-          fold_masks.reshape(F, nb, c).transpose(1, 0, 2))
+    xs = _blocked(Xs, y, w, fold_masks, c)
 
     eye = jnp.eye(d_work, dtype=jnp.float32)
-
-    def _hessian_blocks_narrow(xf, S):
-        """Per-lane weighted Gram [L, d, d] for one row block, as ONE
-        einsum XLA tiles directly. The previous compressed-triangle form
-        (xf[:, iu0] * xf[:, iu1] -> [c, T] then an [L, c] x [c, T]
-        matmul) halved the contraction FLOPs but its column GATHER
-        dominated the whole pass on TPU: measured on v5 lite at the
-        BASELINE shapes, the gather-built triangle ran 7.8 TF/s
-        end-to-end while this full symmetric einsum runs 25.8 TF/s —
-        1.7x faster despite doing 2x the arithmetic
-        (tools/tpu_glm_hess_ab.py)."""
-        return jnp.einsum('cl,cd,ce->lde', S, xf, xf,
-                          preferred_element_type=jnp.float32)
-
-    def _hessian_blocks_tiled(xf, S):
-        """Tile-pair contributions [npairs, L, bt*bt] for one row block —
-        the wide-d path: each pair materializes only a [c, bt^2] product
-        (the [c, d(d+1)/2] full triangle would outgrow HBM past ~128
-        features); off-diagonal tile pairs are computed once and mirrored
-        at assembly, keeping the triangle savings at tile granularity."""
-        out = []
-        for a, b in tile_pairs:
-            xa = xf[:, a * bt:(a + 1) * bt]
-            xb = xf[:, b * bt:(b + 1) * bt]
-            P = (xa[:, :, None] * xb[:, None, :]).reshape(-1, bt * bt)
-            out.append(jnp.matmul(S.T, P,
-                                  preferred_element_type=jnp.float32))
-        return jnp.stack(out)
-
-    def _assemble_narrow(hA):
-        return hA  # already the full symmetric [L, d, d]
-
-    def _assemble_tiled(hA):
-        H = jnp.zeros((L, d_work, d_work), jnp.float32)
-        for p, (a, b) in enumerate(tile_pairs):
-            blk = hA[p].reshape(L, bt, bt)
-            H = H.at[:, a * bt:(a + 1) * bt, b * bt:(b + 1) * bt].set(blk)
-            if a != b:
-                H = H.at[:, b * bt:(b + 1) * bt,
-                         a * bt:(a + 1) * bt].set(
-                             blk.transpose(0, 2, 1))
-        return H
-
-    if tiled:
-        hess_blocks, assemble = _hessian_blocks_tiled, _assemble_tiled
-        h_acc0 = jnp.zeros((len(tile_pairs), L, bt * bt), jnp.float32)
-    else:
-        hess_blocks, assemble = _hessian_blocks_narrow, _assemble_narrow
-        h_acc0 = jnp.zeros((L, d_work, d_work), jnp.float32)
+    hess_blocks, assemble, h_acc0 = _gram_fns(tiled, d_work, L, bt,
+                                              tile_pairs)
 
     def accumulate(B, b0):
         """One streaming pass: per-lane (g [L,d], Hessian blocks, g0, h0)."""
@@ -278,17 +455,10 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
             hA = hA + hess_blocks(xf, S)
             return (gA, hA, g0A + R.sum(0), h0A + S.sum(0)), None
 
-        acc0 = (jnp.zeros((L, d_work), jnp.float32), h_acc0,
-                jnp.zeros(L, jnp.float32), jnp.zeros(L, jnp.float32))
-        if axis_name is not None:
-            # under shard_map's varying-manual-axes tracking the carry
-            # becomes batch-varying inside the body; the initial zeros
-            # must carry the same type. pcast is the current spelling;
-            # pvary the deprecated one on older jax.
-            if hasattr(jax.lax, "pcast"):
-                acc0 = jax.lax.pcast(acc0, axis_name, to="varying")
-            elif hasattr(jax.lax, "pvary"):
-                acc0 = jax.lax.pvary(acc0, axis_name)
+        acc0 = _shard_vary(
+            (jnp.zeros((L, d_work), jnp.float32), h_acc0,
+             jnp.zeros(L, jnp.float32), jnp.zeros(L, jnp.float32)),
+            axis_name)
         (gA, hA, g0A, h0A), _ = jax.lax.scan(body, acc0, xs)
         # the Rabit-allreduce/Spark-shuffle slot: partial per-shard sums
         # combine over ICI/DCN
@@ -302,21 +472,10 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
     def body(state):
         i, B, b0, _ = state
         gA, hA, g0A, h0A = accumulate(B, b0)
-        g = gA / wsum_l[:, None] + l2[:, None] * B                  # [L, d]
-        H = assemble(hA) / wsum_l[:, None, None]
-        H = H + (l2[:, None, None] + 1e-6) * eye[None]
-        step = jnp.linalg.solve(H, g[..., None])[..., 0]
-        B_new = B - step
-        hdiag = jnp.maximum(jnp.diagonal(H, axis1=1, axis2=2), EPS)
-        B_new = (jnp.sign(B_new)
-                 * jnp.maximum(jnp.abs(B_new) - l1[:, None] / hdiag, 0.0))
-        if fit_intercept:
-            b0_new = b0 - (g0A / wsum_l) / jnp.maximum(h0A / wsum_l, EPS)
-        else:
-            b0_new = b0
-        delta = (jnp.abs(B_new - B).max(axis=1)
-                 + jnp.abs(b0_new - b0)).max()
-        return i + 1, B_new, b0_new, delta
+        B_new, b0_new, delta_vec = _newton_prox_update(
+            B, b0, gA, hA, g0A, h0A, wsum_l, l1, l2, eye, assemble,
+            fit_intercept)
+        return i + 1, B_new, b0_new, delta_vec.max()
 
     state = (jnp.asarray(0, jnp.int32), jnp.zeros((L, d_work), jnp.float32),
              jnp.zeros(L, jnp.float32), jnp.asarray(jnp.inf, jnp.float32))
@@ -330,61 +489,44 @@ def _streamed_core(X, y, w, fold_masks, regs, alphas, *, loss, max_iter,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("loss", "max_iter", "tol",
-                                    "fit_intercept", "standardize"))
+                   static_argnames=("loss", "fit_intercept", "standardize"))
 def sweep_glm_streamed(X: jax.Array, y: jax.Array, w: jax.Array,
                        fold_masks: jax.Array, regs: jax.Array,
                        alphas: jax.Array, *, loss: str = "logistic",
-                       max_iter: int = 50, tol: float = 1e-6,
+                       max_iter=50, tol=1e-6,
                        fit_intercept: bool = True,
                        standardize: bool = True
                        ) -> Tuple[jax.Array, jax.Array]:
     """All (fold, grid) fits in one program: returns (B [F, G, d] f32,
-    b0 [F, G]) in RAW feature units (unstandardized)."""
-    return _streamed_core(X, y, w, fold_masks, regs, alphas, loss=loss,
-                          max_iter=max_iter, tol=tol,
-                          fit_intercept=fit_intercept,
+    b0 [F, G]) in RAW feature units (unstandardized). max_iter/tol are
+    traced (distinct values share one executable)."""
+    return _streamed_core(X, y, w, fold_masks, regs, alphas, max_iter, tol,
+                          loss=loss, fit_intercept=fit_intercept,
                           standardize=standardize, axis_name=None)
 
 
 @functools.lru_cache(maxsize=None)
-def _sharded_sweep_fn(mesh, loss, max_iter, tol, fit_intercept,
-                      standardize):
-    try:  # jax >= 0.8 top-level; experimental path for older releases
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+def _sharded_sweep_fn(mesh, loss, fit_intercept, standardize):
     from jax.sharding import PartitionSpec as P
 
     from ..parallel.mesh import BATCH_AXIS
 
-    core = functools.partial(
-        _streamed_core, loss=loss, max_iter=max_iter, tol=tol,
-        fit_intercept=fit_intercept, standardize=standardize,
-        axis_name=BATCH_AXIS)
-    # the Newton solve is a lax.while_loop; jax 0.4.x shard_map has no
-    # replication rule for `while`, so replication checking must be off
-    # (the accumulate() psums make every carry replicated by construction).
-    # jax >= 0.6 renamed the knob check_rep -> check_vma.
-    import inspect as _inspect
-    sig = _inspect.signature(shard_map)
-    if "check_rep" in sig.parameters:
-        extra = {"check_rep": False}
-    elif "check_vma" in sig.parameters:
-        extra = {"check_vma": False}
-    else:
-        extra = {}
-    sm = shard_map(
-        core, mesh=mesh,
+    def core(X, y, w, fold_masks, regs, alphas, max_iter, tol):
+        return _streamed_core(X, y, w, fold_masks, regs, alphas, max_iter,
+                              tol, loss=loss, fit_intercept=fit_intercept,
+                              standardize=standardize, axis_name=BATCH_AXIS)
+
+    sm = _build_shard_map(
+        core, mesh,
         in_specs=(P(BATCH_AXIS, None), P(BATCH_AXIS), P(BATCH_AXIS),
-                  P(None, BATCH_AXIS), P(None), P(None)),
-        out_specs=(P(None, None, None), P(None, None)), **extra)
+                  P(None, BATCH_AXIS), P(None), P(None), P(), P()),
+        out_specs=(P(None, None, None), P(None, None)))
     return jax.jit(sm)
 
 
 def sweep_glm_streamed_sharded(mesh, X, y, w, fold_masks, regs, alphas, *,
-                               loss: str = "logistic", max_iter: int = 50,
-                               tol: float = 1e-6, fit_intercept: bool = True,
+                               loss: str = "logistic", max_iter=50,
+                               tol=1e-6, fit_intercept: bool = True,
                                standardize: bool = True
                                ) -> Tuple[jax.Array, jax.Array]:
     """Row-sharded streamed sweep over the mesh `batch` axis.
@@ -395,9 +537,439 @@ def sweep_glm_streamed_sharded(mesh, X, y, w, fold_masks, regs, alphas, *,
     ICI within a slice and DCN across slices. Sharded standardization uses
     one-pass psum'd moments (f32), which differs from the single-device
     two-pass by f32 rounding only."""
-    return _sharded_sweep_fn(mesh, loss, int(max_iter), float(tol),
-                             bool(fit_intercept), bool(standardize))(
-        X, y, w, fold_masks, regs, alphas)
+    return _sharded_sweep_fn(mesh, loss, bool(fit_intercept),
+                             bool(standardize))(
+        X, y, w, fold_masks, regs, alphas,
+        jnp.asarray(max_iter, jnp.int32), jnp.asarray(tol, jnp.float32))
+
+
+# -- squared-loss sufficient-statistics fast path ----------------------------
+
+def _gram_core(X, y, w, fold_masks, regs, alphas, max_iter, tol, *,
+               fit_intercept, standardize,
+               axis_name: Optional[str] = None):
+    """loss="squared" fast path: ONE streaming pass accumulates per-FOLD
+    sufficient statistics (weighted Gram [F, d, d] + X^T W_f y, X^T W_f 1,
+    sums), then the whole reg x alpha grid solves off the cached moments:
+    ridge lanes closed form, elastic-net lanes via proximal Newton seeded
+    from the ridge solution (`ops/glm.{ridge_gram_solve,prox_newton_gram}`
+    — the moment-space replay of the per-lane update rule). When
+    standardize=True the column moments are computed first (one extra
+    stats pass; raw-moment standardization in moment space would cancel
+    catastrophically in f32 for large-mean columns), and standardization
+    is applied per block on the fly — no [n, d] standardized copy."""
+    n, d = X.shape
+    F = fold_masks.shape[0]
+    Gn = regs.shape[0]
+    tiled, d_work, bt, tile_pairs = _tiling(d)
+    if d_work > d:
+        X = jnp.pad(X, ((0, 0), (0, d_work - d)))
+
+    def allreduce(v):
+        return jax.lax.psum(v, axis_name) if axis_name else v
+
+    if standardize:
+        mean, std = _psum_moments(X, w, allreduce)
+    else:
+        mean = jnp.zeros(d_work, jnp.float32)
+        std = jnp.ones(d_work, jnp.float32)
+
+    wsum_f = jnp.maximum(
+        allreduce((fold_masks * w[None, :]).sum(1)), EPS)         # [F]
+
+    c = min(_ROW_BLOCK_WIDE if tiled else _row_block(d_work), n)
+    xs = _blocked(X, y, w, fold_masks, c)
+    hess_blocks, assemble, h_acc0 = _gram_fns(tiled, d_work, F, bt,
+                                              tile_pairs)
+
+    def body(acc, sl):
+        x_blk, y_blk, w_blk, m_blk = sl                 # m_blk [F, c]
+        hA, cA, sxA, syA = acc
+        xf = (x_blk.astype(jnp.float32) - mean[None, :]) / std[None, :]
+        wlf = m_blk.T * w_blk[:, None]                  # [c, F]
+        wy = wlf * y_blk[:, None]                       # [c, F]
+        hA = hA + hess_blocks(xf, wlf)
+        cA = cA + jnp.matmul(xf.T, wy,
+                             preferred_element_type=jnp.float32).T
+        sxA = sxA + jnp.matmul(xf.T, wlf,
+                               preferred_element_type=jnp.float32).T
+        syA = syA + wy.sum(0)
+        return (hA, cA, sxA, syA), None
+
+    acc0 = _shard_vary(
+        (h_acc0, jnp.zeros((F, d_work), jnp.float32),
+         jnp.zeros((F, d_work), jnp.float32), jnp.zeros(F, jnp.float32)),
+        axis_name)
+    (hA, cA, sxA, syA), _ = jax.lax.scan(body, acc0, xs)
+    hA, cA, sxA, syA = (allreduce(hA), allreduce(cA),
+                        allreduce(sxA), allreduce(syA))
+    Gm_f = assemble(hA)                                 # [F, d, d]
+
+    # expand per-fold moments to the fold-major lane axis l = f*Gn + g
+    l1 = jnp.tile(regs * alphas, F)                     # [L]
+    l2 = jnp.tile(regs * (1.0 - alphas), F)             # [L]
+    Gm = jnp.repeat(Gm_f, Gn, axis=0)                   # [L, d, d]
+    cm = jnp.repeat(cA, Gn, axis=0)
+    sx = jnp.repeat(sxA, Gn, axis=0)
+    sy = jnp.repeat(syA, Gn)
+    sw = jnp.repeat(wsum_f, Gn)
+
+    beta_r, b0_r = G.ridge_gram_solve(Gm, cm, sx, sy, sw, l2,
+                                      fit_intercept=fit_intercept)
+    beta_p, b0_p, iters = G.prox_newton_gram(
+        Gm, cm, sx, sy, sw, l1, l2, beta_r, b0_r, max_iter, tol,
+        fit_intercept=fit_intercept)
+    is_l1 = l1 > 0.0
+    B = jnp.where(is_l1[:, None], beta_p, beta_r)
+    b0 = jnp.where(is_l1, b0_p, b0_r)
+
+    if standardize:
+        B = B / std[None, :]
+        b0 = b0 - (B * mean[None, :]).sum(1)
+    B = B[:, :d]
+    return B.reshape(F, Gn, d), b0.reshape(F, Gn), iters
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("fit_intercept", "standardize"))
+def sweep_glm_squared_gram(X: jax.Array, y: jax.Array, w: jax.Array,
+                           fold_masks: jax.Array, regs: jax.Array,
+                           alphas: jax.Array, max_iter=50, tol=1e-6, *,
+                           fit_intercept: bool = True,
+                           standardize: bool = True
+                           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Squared-loss (fold x grid) sweep from ONE streaming Gram pass.
+    Returns (B [F, G, d] f32 RAW units, b0 [F, G], prox-solve iters)."""
+    return _gram_core(X, y, w, fold_masks, regs, alphas, max_iter, tol,
+                      fit_intercept=fit_intercept, standardize=standardize,
+                      axis_name=None)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_gram_fn(mesh, fit_intercept, standardize):
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import BATCH_AXIS
+
+    def core(X, y, w, fold_masks, regs, alphas, max_iter, tol):
+        return _gram_core(X, y, w, fold_masks, regs, alphas, max_iter, tol,
+                          fit_intercept=fit_intercept,
+                          standardize=standardize, axis_name=BATCH_AXIS)
+
+    sm = _build_shard_map(
+        core, mesh,
+        in_specs=(P(BATCH_AXIS, None), P(BATCH_AXIS), P(BATCH_AXIS),
+                  P(None, BATCH_AXIS), P(None), P(None), P(), P()),
+        out_specs=(P(None, None, None), P(None, None), P()))
+    return jax.jit(sm)
+
+
+def sweep_glm_squared_gram_sharded(mesh, X, y, w, fold_masks, regs, alphas,
+                                   max_iter=50, tol=1e-6, *,
+                                   fit_intercept: bool = True,
+                                   standardize: bool = True
+                                   ) -> Tuple[jax.Array, jax.Array,
+                                              jax.Array]:
+    """Row-sharded Gram fast path: each shard accumulates its local rows'
+    per-fold moments, one psum combines them, the grid solves replicated."""
+    return _sharded_gram_fn(mesh, bool(fit_intercept), bool(standardize))(
+        X, y, w, fold_masks, regs, alphas,
+        jnp.asarray(max_iter, jnp.int32), jnp.asarray(tol, jnp.float32))
+
+
+# -- round kernel + host retirement driver (IRLS losses) ---------------------
+
+def _round_core(X, y, w, fold_masks, sel, l1, l2, B0, b00, mean, std,
+                iters_budget, tol, *, loss, fit_intercept,
+                axis_name: Optional[str] = None):
+    """K Newton iterations for one compacted lane bucket, with a PER-LANE
+    delta vector in the carry so the host can retire converged lanes
+    between rounds.
+
+    sel [F, Lb] maps each bucket lane to its fold (one-hot columns);
+    all-zero columns are the ladder's inert padding lanes — their weights
+    vanish, so they sit at B=0/delta=0 and never gate the early exit.
+    B0/b00 carry the lanes' standardized-space state between rounds (the
+    host unstandardizes once at the end); mean/std are applied on the fly
+    per block, so no standardized [n, d] copy is materialized per round.
+    The while cond early-exits as soon as EVERY bucket lane's delta clears
+    tol, so a round never burns budget on an already-converged bucket.
+    Returns (B [Lb, d] standardized space, b0 [Lb], delta [Lb], iters)."""
+    n, d = X.shape
+    F = fold_masks.shape[0]
+    Lb = sel.shape[1]
+    rc = _residual_curvature(loss)
+    tiled, d_work, bt, tile_pairs = _tiling(d)
+    if d_work > d:
+        dp = d_work - d
+        X = jnp.pad(X, ((0, 0), (0, dp)))
+        B0 = jnp.pad(B0, ((0, 0), (0, dp)))
+        mean = jnp.pad(mean, (0, dp))
+        std = jnp.pad(std, (0, dp), constant_values=1.0)
+
+    def allreduce(v):
+        return jax.lax.psum(v, axis_name) if axis_name else v
+
+    wsum_f = jnp.maximum(
+        allreduce((fold_masks * w[None, :]).sum(1)), EPS)         # [F]
+    wsum_l = jnp.maximum((wsum_f[:, None] * sel).sum(0), EPS)     # [Lb]
+
+    c = min(_ROW_BLOCK_WIDE if tiled else _row_block(d_work), n)
+    xs = _blocked(X, y, w, fold_masks, c)
+    eye = jnp.eye(d_work, dtype=jnp.float32)
+    hess_blocks, assemble, h_acc0 = _gram_fns(tiled, d_work, Lb, bt,
+                                              tile_pairs)
+
+    def accumulate(B, b0):
+        Bt = B.T.astype(X.dtype)                        # [d, Lb]
+
+        def body(acc, sl):
+            x_blk, y_blk, w_blk, m_blk = sl             # m_blk [F, c]
+            gA, hA, g0A, h0A = acc
+            # standardize on the fly; the low-precision cast keeps the
+            # eta contraction on the bf16 MXU path exactly like the
+            # materialized-Xs route
+            xs_low = ((x_blk.astype(jnp.float32) - mean[None, :])
+                      / std[None, :]).astype(X.dtype)
+            eta = jnp.matmul(xs_low, Bt,
+                             preferred_element_type=jnp.float32) + b0[None, :]
+            r0, s0 = rc(eta, y_blk)                     # [c, Lb]
+            wlf = m_blk.T * w_blk[:, None]              # [c, F]
+            wl = jnp.matmul(wlf, sel,
+                            preferred_element_type=jnp.float32)  # [c, Lb]
+            R = r0 * wl
+            S = s0 * wl
+            xf = xs_low.astype(jnp.float32)
+            gA = gA + jnp.matmul(xf.T, R,
+                                 preferred_element_type=jnp.float32).T
+            hA = hA + hess_blocks(xf, S)
+            return (gA, hA, g0A + R.sum(0), h0A + S.sum(0)), None
+
+        acc0 = _shard_vary(
+            (jnp.zeros((Lb, d_work), jnp.float32), h_acc0,
+             jnp.zeros(Lb, jnp.float32), jnp.zeros(Lb, jnp.float32)),
+            axis_name)
+        (gA, hA, g0A, h0A), _ = jax.lax.scan(body, acc0, xs)
+        return (allreduce(gA), allreduce(hA),
+                allreduce(g0A), allreduce(h0A))
+
+    def cond(state):
+        i, _, _, delta = state
+        return (i < iters_budget) & (delta.max() > tol)
+
+    def body(state):
+        i, B, b0, _ = state
+        gA, hA, g0A, h0A = accumulate(B, b0)
+        B_new, b0_new, delta_vec = _newton_prox_update(
+            B, b0, gA, hA, g0A, h0A, wsum_l, l1, l2, eye, assemble,
+            fit_intercept)
+        return i + 1, B_new, b0_new, delta_vec
+
+    state = (jnp.asarray(0, jnp.int32), B0.astype(jnp.float32),
+             b00.astype(jnp.float32),
+             jnp.full((Lb,), jnp.inf, jnp.float32))
+    i, B, b0, delta = jax.lax.while_loop(cond, body, state)
+    return B[:, :d], b0, delta, i
+
+
+@functools.partial(jax.jit, static_argnames=("loss", "fit_intercept"))
+def sweep_glm_round(X: jax.Array, y: jax.Array, w: jax.Array,
+                    fold_masks: jax.Array, sel: jax.Array, l1: jax.Array,
+                    l2: jax.Array, B0: jax.Array, b00: jax.Array,
+                    mean: jax.Array, std: jax.Array, iters_budget,
+                    tol, *, loss: str, fit_intercept: bool = True
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One retirement round for a compacted lane bucket (see _round_core).
+    Compiled per (n, d, F, bucket) shape; iters_budget/tol are traced."""
+    return _round_core(X, y, w, fold_masks, sel, l1, l2, B0, b00, mean,
+                       std, iters_budget, tol, loss=loss,
+                       fit_intercept=fit_intercept, axis_name=None)
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_round_fn(mesh, loss, fit_intercept):
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import BATCH_AXIS
+
+    def core(X, y, w, fold_masks, sel, l1, l2, B0, b00, mean, std,
+             iters_budget, tol):
+        return _round_core(X, y, w, fold_masks, sel, l1, l2, B0, b00,
+                           mean, std, iters_budget, tol, loss=loss,
+                           fit_intercept=fit_intercept,
+                           axis_name=BATCH_AXIS)
+
+    sm = _build_shard_map(
+        core, mesh,
+        in_specs=(P(BATCH_AXIS, None), P(BATCH_AXIS), P(BATCH_AXIS),
+                  P(None, BATCH_AXIS), P(None, None), P(None), P(None),
+                  P(None, None), P(None), P(None), P(None), P(), P()),
+        out_specs=(P(None, None), P(None), P(None), P()))
+    return jax.jit(sm)
+
+
+def _new_round_state(L: int, d: int) -> Dict[str, Any]:
+    return {"B": np.zeros((L, d), np.float32),
+            "b0": np.zeros(L, np.float32),
+            "delta": np.full(L, np.inf, np.float32),
+            "iters": np.zeros(L, np.int32),
+            "retired": np.zeros(L, bool), "warmed": False,
+            "rounds": 0, "data_passes": 0, "lane_passes": 0,
+            "padded_lane_passes": 0,
+            "active_per_round": [], "iters_per_round": [],
+            "bucket_sizes": []}
+
+
+def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
+                              loss: str, max_iter: int = 50,
+                              tol: float = 1e-6, fit_intercept: bool = True,
+                              standardize: bool = True, mesh=None,
+                              round_iters: Optional[int] = None,
+                              warm_start: bool = True,
+                              state: Optional[Dict[str, Any]] = None,
+                              on_round: Optional[Callable] = None
+                              ) -> Tuple[np.ndarray, np.ndarray,
+                                         Dict[str, Any]]:
+    """Host-driven convergence-aware streamed sweep for the IRLS losses.
+
+    Runs `sweep_glm_round` (K = round_iters or TMOG_GLM_ROUND_ITERS,
+    default ROUND_ITERS_DEFAULT, Newton iterations per jitted round); after
+    each round, lanes whose own delta cleared `tol` — or that exhausted
+    `max_iter` — RETIRE with their coefficients frozen, and the survivors
+    compact into the next round's power-of-two bucket (`bucket_lanes`).
+    When `warm_start`, round 0 fits only each fold's
+    strongest-regularization lane and seeds the rest of the fold from it
+    (glmnet-style pathwise continuation), so low-reg lanes start near
+    their optimum instead of at zero; TMOG_GLM_WARMSTART=0 disables.
+
+    X/y/w/fold_masks are device arrays (pre-sharded when `mesh` is given,
+    exactly like sweep_glm_streamed_sharded's contract). `state`/`on_round`
+    are the round-granular checkpoint hooks
+    (automl/tuning/checkpoint.RoundCheckpoint): `on_round(state)` fires
+    after every retirement boundary with the full resumable state dict,
+    and passing that dict back as `state` resumes bit-identically.
+
+    Returns (B [F, G, d] f32 RAW units, b0 [F, G], info) where info holds
+    the convergence telemetry (glm_rounds, data_passes, lane_passes,
+    lanes_retired, active_per_round, iters_per_round, bucket_sizes)."""
+    regs = np.asarray(regs, np.float32)
+    alphas = np.asarray(alphas, np.float32)
+    F = int(fold_masks.shape[0])
+    Gn = int(regs.shape[0])
+    L = F * Gn
+    d = int(X.shape[1])
+    K = int(round_iters if round_iters is not None
+            else os.environ.get("TMOG_GLM_ROUND_ITERS",
+                                str(ROUND_ITERS_DEFAULT)))
+    K = max(K, 1)
+    max_iter = int(max_iter)
+    tol_f = float(tol)
+
+    if standardize:
+        if mesh is None:
+            mean, std = glm_standardize_stats(X, w)
+        else:
+            mean, std = _sharded_stats_fn(mesh)(X, w)
+    else:
+        mean = jnp.zeros(d, jnp.float32)
+        std = jnp.ones(d, jnp.float32)
+
+    lane_fold = np.repeat(np.arange(F, dtype=np.int64), Gn)
+    l1v = np.tile(regs * alphas, F).astype(np.float32)
+    l2v = np.tile(regs * (1.0 - alphas), F).astype(np.float32)
+    st = state if state is not None else _new_round_state(L, d)
+
+    def run_round(idx, budget):
+        k = len(idx)
+        Lb = bucket_lanes(k)
+        sel = np.zeros((F, Lb), np.float32)
+        sel[lane_fold[idx], np.arange(k)] = 1.0
+        l1b = np.zeros(Lb, np.float32)
+        l1b[:k] = l1v[idx]
+        # inert pads get l2=1 so their (zero-data) Hessian stays
+        # well-conditioned; their B stays exactly 0 from the zero init
+        l2b = np.ones(Lb, np.float32)
+        l2b[:k] = l2v[idx]
+        B0 = np.zeros((Lb, d), np.float32)
+        B0[:k] = st["B"][idx]
+        b00 = np.zeros(Lb, np.float32)
+        b00[:k] = st["b0"][idx]
+        args = (X, y, w, fold_masks, jnp.asarray(sel), jnp.asarray(l1b),
+                jnp.asarray(l2b), jnp.asarray(B0), jnp.asarray(b00),
+                mean, std, jnp.asarray(budget, jnp.int32),
+                jnp.asarray(tol_f, jnp.float32))
+        if mesh is None:
+            Bb, b0b, db, it = sweep_glm_round(
+                *args, loss=loss, fit_intercept=fit_intercept)
+        else:
+            Bb, b0b, db, it = _sharded_round_fn(
+                mesh, loss, bool(fit_intercept))(*args)
+        st["B"][idx] = np.asarray(Bb)[:k]
+        st["b0"][idx] = np.asarray(b0b)[:k]
+        st["delta"][idx] = np.asarray(db)[:k]
+        it = int(it)
+        st["iters"][idx] += it
+        st["rounds"] += 1
+        st["data_passes"] += it
+        # useful work (active lanes) vs executed work (the padded bucket
+        # the device actually ran) — the FLOP model bills the latter
+        st["lane_passes"] += it * k
+        st["padded_lane_passes"] += it * Lb
+        st["active_per_round"].append(k)
+        st["iters_per_round"].append(it)
+        st["bucket_sizes"].append(Lb)
+
+    def retire(idx):
+        st["retired"][idx] = (st["delta"][idx] <= tol_f) \
+            | (st["iters"][idx] >= max_iter)
+
+    if (warm_start and env_on("TMOG_GLM_WARMSTART") and not st["warmed"]
+            and Gn > 1
+            and not st["retired"].any() and int(st["iters"].max()) == 0):
+        g_star = int(np.argmax(regs))
+        warm_idx = np.arange(F, dtype=np.int64) * Gn + g_star
+        run_round(warm_idx, min(K, max_iter))
+        # pathwise continuation: every other lane of the fold starts at
+        # its fold's strongest-regularization solution instead of zero
+        for f in range(F):
+            rows = np.arange(f * Gn, (f + 1) * Gn)
+            others = rows[rows != warm_idx[f]]
+            st["B"][others] = st["B"][warm_idx[f]]
+            st["b0"][others] = st["b0"][warm_idx[f]]
+        retire(warm_idx)
+        st["warmed"] = True
+        if on_round is not None:
+            on_round(st)
+
+    while True:
+        active = np.flatnonzero(~st["retired"])
+        if active.size == 0:
+            break
+        budget = max(1, min(K, int((max_iter - st["iters"][active]).min())))
+        run_round(active, budget)
+        retire(active)
+        if on_round is not None:
+            on_round(st)
+
+    # host-side unstandardize, f32 like the on-device legacy route
+    mean_h = np.asarray(mean, np.float32)
+    std_h = np.asarray(std, np.float32)
+    B = st["B"] / std_h[None, :]
+    b0 = st["b0"] - (B * mean_h[None, :]).sum(1, dtype=np.float32)
+    info = {"route": "streamed", "kernel": "rounds",
+            "glm_rounds": int(st["rounds"]),
+            "data_passes": int(st["data_passes"]),
+            "lane_passes": int(st["lane_passes"]),
+            "padded_lane_passes": int(st["padded_lane_passes"]),
+            "lanes_total": L,
+            "lanes_retired": int((st["delta"] <= tol_f).sum()),
+            "lanes_at_cap": int(((st["delta"] > tol_f)
+                                 & (st["iters"] >= max_iter)).sum()),
+            "active_per_round": [int(v) for v in st["active_per_round"]],
+            "iters_per_round": [int(v) for v in st["iters_per_round"]],
+            "bucket_sizes": [int(v) for v in st["bucket_sizes"]],
+            "warm_start": bool(st["warmed"])}
+    return B.reshape(F, Gn, d), b0.reshape(F, Gn), info
 
 
 def sweep_scores_fold(X: jax.Array, B_f: jax.Array, b0_f: jax.Array

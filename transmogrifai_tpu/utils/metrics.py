@@ -88,6 +88,34 @@ class KernelRoofline:
 
 
 @dataclass
+class SweepConvergence:
+    """Round/pass telemetry of one convergence-aware GLM sweep
+    (ops/glm_sweep.py). `data_passes` counts executed streaming passes
+    over X inside the fit kernels (the one-time standardization stats
+    pass is excluded and noted in docs/performance.md); `lane_passes` is
+    the USEFUL work — sum over rounds of active_lanes x iterations (the
+    corrected FLOP model, bench.py::glm_flops_estimate, bills the
+    sweep's `padded_lane_passes`: bucket_size x iterations, what the
+    device actually executed). kernel: "gram" (squared-loss sufficient
+    statistics, exactly one pass), "rounds" (retirement driver) or
+    "global" (legacy run-to-global-convergence fallback)."""
+
+    family: str
+    kernel: str
+    rounds: int
+    data_passes: int
+    lane_passes: int
+    lanes_total: int
+    lanes_retired: int
+    active_per_round: List[int] = field(default_factory=list)
+    iters_per_round: List[int] = field(default_factory=list)
+    bucket_sizes: List[int] = field(default_factory=list)
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
+@dataclass
 class AppMetrics:
     """Whole-run metrics (reference AppMetrics)."""
 
@@ -96,6 +124,7 @@ class AppMetrics:
     end_time: float = 0.0
     stage_metrics: List[StageMetric] = field(default_factory=list)
     kernel_metrics: List[KernelRoofline] = field(default_factory=list)
+    sweep_metrics: List[SweepConvergence] = field(default_factory=list)
 
     @property
     def duration_seconds(self) -> float:
@@ -112,6 +141,9 @@ class AppMetrics:
         if self.kernel_metrics:
             out["kernel_metrics"] = [m.to_json()
                                      for m in self.kernel_metrics]
+        if self.sweep_metrics:
+            out["sweep_metrics"] = [m.to_json()
+                                    for m in self.sweep_metrics]
         return out
 
     def pretty(self) -> str:
@@ -178,6 +210,27 @@ class MetricsCollector:
             bytes_hbm=float(bytes_hbm), cold=cold,
             **roofline_fields(wall_seconds, bytes_hbm, roof))
         self.current.kernel_metrics.append(rec)
+        return rec
+
+    def sweep_convergence(self, family: str, kernel: str, rounds: int,
+                          data_passes: int, lane_passes: int,
+                          lanes_total: int, lanes_retired: int,
+                          active_per_round=(), iters_per_round=(),
+                          bucket_sizes=()) -> Optional[SweepConvergence]:
+        """Record one sweep's round/pass telemetry (no-op unless enabled).
+        The validator reports here after every streamed GLM sweep; bench.py
+        reads the same numbers off Validator.last_streamed_telemetry for
+        its executed-FLOP accounting."""
+        if not self.enabled:
+            return None
+        rec = SweepConvergence(
+            family=family, kernel=kernel, rounds=int(rounds),
+            data_passes=int(data_passes), lane_passes=int(lane_passes),
+            lanes_total=int(lanes_total), lanes_retired=int(lanes_retired),
+            active_per_round=[int(v) for v in active_per_round],
+            iters_per_round=[int(v) for v in iters_per_round],
+            bucket_sizes=[int(v) for v in bucket_sizes])
+        self.current.sweep_metrics.append(rec)
         return rec
 
     def save(self, path: str) -> None:
