@@ -78,9 +78,31 @@ def persist_partial(phase: str) -> None:
         pass
 
 
+TRACE_DIR = os.environ.get("BENCH_TRACE_DIR")
+
+
+def save_trace_artifacts() -> None:
+    """Flush the BENCH_TRACE_DIR span tree to disk. Called from the
+    happy path AND the budget-alarm/fatal paths: the preempted long run
+    is exactly the run the trace exists to make inspectable, so dying
+    must not lose it (events.jsonl already streamed)."""
+    if not TRACE_DIR:
+        return
+    try:
+        from transmogrifai_tpu.utils.metrics import collector
+        if not collector.enabled:
+            return
+        collector.save(os.path.join(TRACE_DIR, "bench_stage_metrics.json"))
+        collector.save_chrome_trace(
+            os.path.join(TRACE_DIR, "bench_trace.json"))
+    except Exception:
+        pass  # best-effort: never block the JSON emit on trace IO
+
+
 def emit_and_exit(signum=None, frame=None):
     RESULT.setdefault("errors", []).append("time budget expired; partial run")
     persist_partial("budget_expired")
+    save_trace_artifacts()
     print(json.dumps(RESULT), flush=True)
     os._exit(0)
 
@@ -218,6 +240,9 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
         try:
             t0 = time.perf_counter()
             best_glm = val.validate([(lr, [dict(g) for g in ggrids])], X, y)
+            # every validate() route np.asarray()s its fold metrics to
+            # host floats before returning, so this wall is device-synced
+            # tmoglint: disable=TPU005  validate() blocks via np.asarray
             glm_s = time.perf_counter() - t0
             glm_route = best_glm.validated[0].route
             glm_info = val.last_streamed_telemetry
@@ -239,6 +264,7 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
                     t0 = time.perf_counter()
                     best_glm = val.validate([(lr, [dict(g) for g in ggrids])],
                                             X, y)
+                    # tmoglint: disable=TPU005  validate blocks via np.asarray
                     glm_s = time.perf_counter() - t0
                     glm_route = best_glm.validated[0].route
                     glm_info = None  # streamed telemetry does not apply
@@ -255,6 +281,7 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
             try:
                 t0 = time.perf_counter()
                 val.validate([(lr, [dict(g) for g in ggrids])], X, y)
+                # tmoglint: disable=TPU005  validate blocks via np.asarray
                 glm_warm_s = time.perf_counter() - t0
                 log(f"GLM sweep warm: {glm_warm_s:.2f}s")
             except Exception as e:
@@ -284,20 +311,27 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
         in_process = best_tree is None and not child_ran
     kernel_roofline = []
     if in_process:
+        # a BENCH_TRACE_DIR run already enabled the collector in main();
+        # re-enabling here would reset its span tree mid-run
+        mc_was_enabled = _mc.enabled
         try:
             # stage-metric collection ON so the fused tree fits record
             # per-kernel roofline spans (achieved GB/s vs the HBM roof)
-            _mc.enable("bench_tree_sweep")
+            if not mc_was_enabled:
+                _mc.enable("bench_tree_sweep")
             t0 = time.perf_counter()
             best_tree = val.validate([(OpXGBoostClassifier(),
                                        [dict(g) for g in tgrids])], X, y)
+            # tmoglint: disable=TPU005  validate blocks via np.asarray
             tree_s = time.perf_counter() - t0
             kernel_roofline = [k.to_json()
                                for k in _mc.current.kernel_metrics]
-            _mc.disable()
+            if not mc_was_enabled:
+                _mc.disable()
             log(f"tree sweep done in {tree_s:.2f}s")
         except Exception as e:
-            _mc.disable()
+            if not mc_was_enabled:
+                _mc.disable()
             errors.append(f"tree sweep: {type(e).__name__}: {str(e)[:200]}")
             # a Mosaic/pallas compile failure surfaces as an exception —
             # retry once on the XLA-only path rather than losing the family
@@ -310,6 +344,7 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
                     best_tree = val.validate(
                         [(OpXGBoostClassifier(),
                           [dict(g) for g in tgrids])], X, y)
+                    # tmoglint: disable=TPU005  validate blocks via np.asarray
                     tree_s = time.perf_counter() - t0
                     errors.append("tree sweep ok on retry without pallas")
                     log(f"tree sweep (no pallas) done in {tree_s:.2f}s")
@@ -404,6 +439,7 @@ def tree_sweep_child(cfg):
     t0 = time.perf_counter()
     best = val.validate([(OpXGBoostClassifier(),
                           [dict(g) for g in tgrids])], X, y)
+    # tmoglint: disable=TPU005  validate() blocks via np.asarray
     dt = time.perf_counter() - t0
     kernel_roofline = [k.to_json() for k in collector.current.kernel_metrics]
     collector.disable()
@@ -1001,6 +1037,17 @@ def main():
     backend, kind = probe_backend()
     errors = []
     RESULT["errors"] = errors
+    # optional hierarchical trace of the whole bench (docs/observability.md):
+    # BENCH_TRACE_DIR=<dir> writes bench_trace.json (Perfetto), the span-tree
+    # stage-metrics JSON and a streaming events.jsonl there; inspect with
+    # `python -m transmogrifai_tpu trace-report <dir>`
+    trace_dir = TRACE_DIR
+    if trace_dir:
+        from transmogrifai_tpu.utils.metrics import collector as _coll
+        os.makedirs(trace_dir, exist_ok=True)
+        _coll.enable("bench")
+        _coll.attach_event_log(os.path.join(trace_dir, "events.jsonl"))
+        _coll.event("run_start", run_type="bench")
     if backend is None or backend == "cpu":
         from transmogrifai_tpu.utils.platform import force_cpu
         force_cpu(1)
@@ -1168,6 +1215,12 @@ def main():
         errors.append(f"titanic warm: {type(e).__name__}: {str(e)[:200]}")
     persist_partial("example_warm")
 
+    if trace_dir:
+        from transmogrifai_tpu.utils.metrics import collector as _coll
+        _coll.event("run_end", run_type="bench")
+        save_trace_artifacts()
+        _coll.detach_event_log()
+        _coll.disable()
     if not errors:
         RESULT.pop("errors", None)
     signal.alarm(0)
@@ -1191,6 +1244,7 @@ if __name__ == "__main__":
         RESULT.setdefault("errors", []).append(
             f"{type(e).__name__}: {e}")
         persist_partial("fatal_error")
+        save_trace_artifacts()
         try:
             print(json.dumps(RESULT), flush=True)
         except BrokenPipeError:

@@ -12,7 +12,7 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== 1/5 import + native kernel build =="
+echo "== 1/6 import + native kernel build =="
 python - <<'PY'
 import transmogrifai_tpu
 from transmogrifai_tpu.ops import native_bridge
@@ -20,13 +20,15 @@ print("package import ok; native kernels:",
       "built" if native_bridge.available() else "UNAVAILABLE (numpy fallbacks)")
 PY
 
-echo "== 2/5 tmoglint (static JAX/TPU discipline + stage contracts) =="
+echo "== 2/6 tmoglint (static JAX/TPU discipline + stage contracts) =="
 # fails fast on findings not in tools/tmoglint/baseline.json and on stale
 # baseline entries (docs/static_analysis.md); runs before the test tiers
-# because it needs no imports and catches contract breaks in seconds
-python -m tools.tmoglint transmogrifai_tpu/ tests/
+# because it needs no imports and catches contract breaks in seconds.
+# bench.py + tools/ are in scope since TPU005 (unsynced-wall-timing):
+# that is where the wall-clock benchmarking lives
+python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/
 
-echo "== 3/5 test suite (8-device virtual CPU mesh) =="
+echo "== 3/6 test suite (8-device virtual CPU mesh) =="
 # fused histogram planner + CPU-fallback smoke first, explicitly under
 # JAX_PLATFORMS=cpu: the tier-1 guarantee that the pure-jnp twin of the
 # batched sweep kernel stays live on hosts with no TPU
@@ -41,7 +43,7 @@ JAX_PLATFORMS=cpu python -m pytest \
   -q -m 'not slow'
 python -m pytest tests/ -q
 
-echo "== 4/5 examples =="
+echo "== 4/6 examples =="
 for ex in op_titanic_simple op_titanic_mini op_iris op_boston; do
   JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python "examples/${ex}.py" > /dev/null
   echo "  ${ex} ok"
@@ -54,7 +56,59 @@ if [ -f "$REF_RES/EmailDataset/Clicks.csv" ]; then
   echo "  op_dataprep ok"
 fi
 
-echo "== 5/5 driver-contract smoke =="
+echo "== 5/6 observability smoke (traced workflow + GLM sweep) =="
+# a tiny traced run must produce a loadable span hierarchy: Chrome trace +
+# AppMetrics-with-spans + streaming events.jsonl, all validated by the
+# schema checks in `trace-report --check` (docs/observability.md)
+TRACE_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - "$TRACE_DIR" <<'PY'
+import sys
+
+import numpy as np
+
+out = sys.argv[1]
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.workflow import OpParams, OpWorkflowRunner, Workflow
+
+rows = [{"x": float(i % 7), "y": float(i % 3)} for i in range(120)]
+fx = FeatureBuilder.Real("x").extract(lambda r: r.get("x")).as_predictor()
+fy = FeatureBuilder.Real("y").extract(lambda r: r.get("y")).as_predictor()
+wf = Workflow().set_result_features(transmogrify([fx, fy]))
+runner = OpWorkflowRunner(wf, train_reader=ListReader(rows))
+runner.run(OpWorkflowRunner.TRAIN,
+           OpParams(collect_stage_metrics=True, metrics_location=out))
+
+# tiny traced GLM round sweep: the glm_round spans + event log entries
+import jax.numpy as jnp
+from transmogrifai_tpu.ops.glm_sweep import sweep_glm_streamed_rounds
+from transmogrifai_tpu.utils.metrics import collector
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(400, 4)).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+masks = np.ones((2, 400), np.float32)
+masks[0, ::3] = 0.0
+masks[1, 1::3] = 0.0
+collector.enable("ci_glm_sweep")
+collector.attach_event_log(out + "/events.jsonl")
+with collector.trace_span("glm_sweep", kind="sweep_fit"):
+    sweep_glm_streamed_rounds(
+        jnp.asarray(X), jnp.asarray(y), jnp.ones(400, jnp.float32),
+        jnp.asarray(masks), np.asarray([0.05, 0.2], np.float32),
+        np.zeros(2, np.float32), loss="logistic", max_iter=4, tol=1e-8,
+        standardize=False, round_iters=2, warm_start=False)
+collector.save(out + "/glm_stage_metrics.json")
+collector.save_chrome_trace(out + "/glm_trace.json")
+collector.detach_event_log()
+collector.disable()
+print("traced workflow + GLM sweep ok:", out)
+PY
+PYTHONPATH="$PWD" python -m transmogrifai_tpu trace-report "$TRACE_DIR" --check
+rm -rf "$TRACE_DIR"
+
+echo "== 6/6 driver-contract smoke =="
 python - <<'PY'
 import __graft_entry__ as g
 g.dryrun_multichip(8)

@@ -283,6 +283,186 @@ class TestTPU004:
         assert out == []
 
 
+# -- TPU005: unsynced wall timing --------------------------------------------
+
+class TestTPU005:
+    def test_jnp_call_in_timed_window(self):
+        out = lint("""
+            import time
+            import jax.numpy as jnp
+
+            def bench(a, b):
+                t0 = time.perf_counter()
+                y = jnp.dot(a, b)
+                return time.perf_counter() - t0
+        """, rules=["TPU005"])
+        assert len(out) == 1 and out[0].rule == "TPU005"
+        assert "block_until_ready" in out[0].message
+
+    def test_locally_jitted_name_in_window(self):
+        out = lint("""
+            import time
+            import jax
+
+            f = jax.jit(lambda x: x * 2)
+
+            def bench(x):
+                t0 = time.time()
+                y = f(x)
+                dt = time.time() - t0
+                return dt
+        """, rules=["TPU005"])
+        assert len(out) == 1 and "`f`" in out[0].message
+
+    def test_dispatch_hint_validate(self):
+        out = lint("""
+            import time
+
+            def sweep(val, X, y):
+                t0 = time.perf_counter()
+                best = val.validate([(est, grids)], X, y)
+                return time.perf_counter() - t0
+        """, rules=["TPU005"])
+        assert len(out) == 1 and "val.validate" in out[0].message
+
+    def test_negative_block_until_ready_present(self):
+        out = lint("""
+            import time
+            import jax
+            import jax.numpy as jnp
+
+            def bench(a, b):
+                t0 = time.perf_counter()
+                y = jnp.dot(a, b)
+                jax.block_until_ready(y)
+                return time.perf_counter() - t0
+        """, rules=["TPU005"])
+        assert out == []
+
+    def test_negative_host_only_timing(self):
+        out = lint("""
+            import time
+            import numpy as np
+
+            def bench(a, b):
+                t0 = time.perf_counter()
+                y = np.dot(a, b)
+                return time.perf_counter() - t0
+        """, rules=["TPU005"])
+        assert out == []
+
+    def test_negative_dispatch_outside_window(self):
+        """A jax call BEFORE the anchor is not what the delta times."""
+        out = lint("""
+            import time
+            import jax.numpy as jnp
+
+            def bench(a, b):
+                y = jnp.dot(a, b)
+                t0 = time.perf_counter()
+                s = sum(range(100))
+                return time.perf_counter() - t0
+        """, rules=["TPU005"])
+        assert out == []
+
+    def test_suppression_with_justification(self):
+        out = lint("""
+            import time
+
+            def sweep(val, X, y):
+                t0 = time.perf_counter()
+                best = val.validate([(est, grids)], X, y)
+                # tmoglint: disable=TPU005  validate returns host floats
+                dt = time.perf_counter() - t0
+                return dt
+        """, rules=["TPU005"])
+        assert out == []
+
+    def test_bare_time_import_idiom(self):
+        """`from time import time` — bare time() deltas count too."""
+        out = lint("""
+            from time import time
+            import jax.numpy as jnp
+
+            def bench(a, b):
+                t0 = time()
+                y = jnp.dot(a, b)
+                return time() - t0
+        """, rules=["TPU005"])
+        assert len(out) == 1
+
+    def test_aliased_jax_numpy_import_is_dispatchish(self):
+        """`import jax.numpy as jnumpy` resolves through jnp_aliases
+        (like TPU003) — aliasing must not dodge the rule."""
+        out = lint("""
+            import time
+            import jax.numpy as jnumpy
+
+            def bench(a, b):
+                t0 = time.perf_counter()
+                y = jnumpy.dot(a, b)
+                return time.perf_counter() - t0
+        """, rules=["TPU005"])
+        assert len(out) == 1 and "jnumpy.dot" in out[0].message
+
+    def test_two_anchor_idiom_covers_the_work_between(self):
+        """`t0=..; dispatch; t1=..; dt = t1 - t0` — the window spans from
+        the EARLIEST anchor in the delta, so the dispatch between the two
+        anchors is covered."""
+        out = lint("""
+            import time
+            import jax.numpy as jnp
+
+            def bench(a, b):
+                t0 = time.perf_counter()
+                y = jnp.dot(a, b)
+                t1 = time.perf_counter()
+                dt = t1 - t0
+                return dt, y
+        """, rules=["TPU005"])
+        assert len(out) == 1 and "jnp.dot" in out[0].message
+
+    def test_negative_dispatch_between_two_host_windows(self):
+        """A dispatch call BETWEEN two disjoint host-only timed windows is
+        untimed: each delta pairs with its own (latest) anchor, windows
+        must not merge."""
+        out = lint("""
+            import time
+            import jax.numpy as jnp
+
+            def bench(a, b):
+                t0 = time.perf_counter()
+                s1 = sum(range(100))
+                d1 = time.perf_counter() - t0
+                y = jnp.dot(a, b)
+                t0 = time.perf_counter()
+                s2 = sum(range(100))
+                d2 = time.perf_counter() - t0
+                return d1, d2, y
+        """, rules=["TPU005"])
+        assert out == []
+
+    def test_anchor_reassignment_scopes_each_window(self):
+        """Same anchor name reused: only the window whose own span holds
+        the dispatch call fires, anchored at THAT delta."""
+        out = lint("""
+            import time
+            import jax.numpy as jnp
+
+            def bench(a, b):
+                t0 = time.perf_counter()
+                s1 = sum(range(100))
+                d1 = time.perf_counter() - t0
+                t0 = time.perf_counter()
+                y = jnp.dot(a, b)
+                d2 = time.perf_counter() - t0
+                return d1, d2, y
+        """, rules=["TPU005"])
+        assert len(out) == 1
+        # the finding anchors at d2's line, not d1's
+        assert out[0].snippet.startswith("d2")
+
+
 # -- DAG001: stage contracts -------------------------------------------------
 
 MINI_TYPES = ("pkg/types.py", """

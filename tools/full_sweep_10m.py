@@ -89,6 +89,7 @@ def main() -> None:
                 grids = gbt_grids(cfg)
             best = val.validate([(est, [dict(g) for g in grids])], X, y)
             emit({"phase": fam, "ok": True,
+                  # tmoglint: disable=TPU005  validate blocks via np.asarray
                   "s": round(time.perf_counter() - t0, 1),
                   "cells": len(grids) * cfg["folds"],
                   "route": best.validated[0].route,
@@ -96,6 +97,7 @@ def main() -> None:
                   "best_au_pr": float(best.best_metric)})
         except Exception as e:  # record, keep going to the other family
             emit({"phase": fam, "ok": False,
+                  # tmoglint: disable=TPU005  validate blocks via np.asarray
                   "s": round(time.perf_counter() - t0, 1),
                   "error": f"{type(e).__name__}: {str(e)[:300]}"})
     emit({"phase": "done"})

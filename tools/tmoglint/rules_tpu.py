@@ -1,14 +1,15 @@
-"""TPU discipline rules TPU001-TPU004.
+"""TPU discipline rules TPU001-TPU005.
 
 Each rule only fires inside *trace-reachable* code (see jitgraph.py), except
-TPU003 which is path-scoped to kernel directories. Rationale for each rule is
-in docs/static_analysis.md, tied to the measured rooflines in
+TPU003 which is path-scoped to kernel directories and TPU005 which inspects
+HOST functions (timing code is host code by definition). Rationale for each
+rule is in docs/static_analysis.md, tied to the measured rooflines in
 docs/performance.md.
 """
 from __future__ import annotations
 
 import ast
-from typing import List, Optional, Set
+from typing import List, Optional, Set, Tuple
 
 from .core import Finding, LintContext, dotted_name, file_rule
 from .jitgraph import jnp_aliases, module_graph, numpy_aliases
@@ -296,6 +297,160 @@ def check_tpu003(ctx: LintContext) -> List[Finding]:
                         f"promotes; pass dtype= explicitly")
                     if f:
                         findings.append(f)
+    return findings
+
+
+# -- TPU005: unsynced wall timing --------------------------------------------
+
+# time functions whose subtraction is a wall-clock delta (bare names
+# cover `from time import time/perf_counter/monotonic`)
+_TIME_FUNCS = {"time.time", "time.perf_counter", "time.monotonic",
+               "time", "perf_counter", "monotonic"}
+# jax async dispatch returns before the device finishes; a wall delta
+# around a dispatching call without a block_until_ready in the same
+# function times the ENQUEUE, not the kernel. Dispatch-ish calls are:
+# jax/lax/jax.numpy-aliased dotted calls (aliases resolved per file via
+# jnp_aliases, like TPU003), names bound from jax.jit(...), locally
+# jitted/traced functions (jitgraph), and the repo's known device-sweep
+# drivers (they dispatch jitted programs internally).
+_JAXISH_ROOTS = {"jax", "lax"}
+_DISPATCH_HINTS = {
+    # validator sweep entries (dispatch chunked XLA programs)
+    "validate", "fit_arrays", "predict_arrays",
+    # ops-level sweep/fit drivers
+    "fit_gbt", "fit_gbt_folds", "fit_gbt_softmax", "fit_forest",
+    "grow_tree", "sweep_glm_streamed", "sweep_glm_streamed_rounds",
+    "sweep_glm_round", "sweep_glm_squared_gram", "route_hist",
+    "hist_folds", "knockout_deltas",
+}
+_SYNC_NAMES = {"block_until_ready"}
+
+
+def _is_time_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted_name(node.func)
+    return d in _TIME_FUNCS if d else False
+
+
+def _module_jit_names(ctx: LintContext) -> Set[str]:
+    """Names assigned from jax.jit(...) / pjit(...) anywhere in the file."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            d = dotted_name(node.value.func)
+            if d and d.split(".")[-1] in {"jit", "pjit"}:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _has_sync(fi, graph) -> bool:
+    for node in graph._own_nodes(fi):
+        if isinstance(node, ast.Call):
+            d = dotted_name(node.func)
+            if d and d.split(".")[-1] in _SYNC_NAMES:
+                return True
+        elif isinstance(node, ast.Attribute) and node.attr in _SYNC_NAMES:
+            return True
+    return False
+
+
+def _dispatchish(call: ast.Call, fi, graph, jit_names: Set[str],
+                 jaxish: Set[str]) -> Optional[str]:
+    """Name of the device-dispatching callee, or None."""
+    d = dotted_name(call.func)
+    if not d:
+        return None
+    parts = d.split(".")
+    if parts[-1] in _SYNC_NAMES or d in _TIME_FUNCS:
+        return None
+    if parts[0] in jaxish and len(parts) > 1:
+        return d
+    if parts[-1] in _DISPATCH_HINTS:
+        return d
+    if parts[0] in jit_names:
+        return d
+    if len(parts) == 1:
+        target = fi.resolve(parts[0]) if fi else None
+        if target is None:
+            target = graph.module_funcs.get(parts[0])
+        if target is not None and target.traced:
+            return d
+    return None
+
+
+@file_rule("TPU005", "unsynced-wall-timing: time deltas around jitted "
+                     "dispatch with no block_until_ready")
+def check_tpu005(ctx: LintContext) -> List[Finding]:
+    graph = module_graph(ctx)
+    jit_names = _module_jit_names(ctx)
+    # resolve jax.numpy import aliases per file (TPU003 does the same):
+    # `import jax.numpy as jnumpy` must dispatch like `jnp`
+    jaxish = _JAXISH_ROOTS | jnp_aliases(ctx)
+    findings: List[Finding] = []
+    for fi in graph.all_funcs:
+        if isinstance(fi.node, ast.Lambda):
+            continue
+        if _has_sync(fi, graph):
+            # the function synchronizes somewhere — its walls are the
+            # author's responsibility, not a static lie
+            continue
+        # anchor assignments per name, in line order: each delta pairs
+        # with the LATEST prior assignment of ITS anchor name, so two
+        # disjoint host-only timed windows never merge into one giant
+        # window that swallows an untimed dispatch call between them
+        anchor_lines: dict = {}
+        deltas: List[Tuple[ast.BinOp, int]] = []
+        nodes = list(graph._own_nodes(fi))
+        for node in nodes:
+            if isinstance(node, ast.Assign) and _is_time_call(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        anchor_lines.setdefault(t.id, []).append(
+                            node.lineno)
+            elif isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, ast.Sub):
+                names = [n.id for n in (node.left, node.right)
+                         if isinstance(n, ast.Name)
+                         and n.id in anchor_lines]
+                times = [n for n in (node.left, node.right)
+                         if _is_time_call(n)]
+                if not names or len(names) + len(times) < 2:
+                    continue
+                # per anchor NAME take its latest assignment before the
+                # delta (re-assignment starts a new window), then span
+                # from the EARLIEST such anchor: `t0=..; work; t1=..;
+                # dt = t1 - t0` must cover the work between t0 and t1
+                starts = [max((ln for ln in anchor_lines[nm]
+                               if ln <= node.lineno), default=None)
+                          for nm in names]
+                starts = [s for s in starts if s is not None]
+                if starts:
+                    deltas.append((node, min(starts)))
+        # EVERY offending delta gets its own finding (anchored at its own
+        # line): a suppression on one window must not blind the rule to
+        # later windows in the same function
+        for delta, start in deltas:
+            hit = None
+            for node in nodes:
+                if isinstance(node, ast.Call) and \
+                        start <= node.lineno <= delta.lineno:
+                    hit = _dispatchish(node, fi, graph, jit_names, jaxish)
+                    if hit:
+                        break
+            if not hit:
+                continue
+            f = ctx.finding(
+                "TPU005", delta,
+                f"wall-clock delta in `{fi.name}` times dispatching call "
+                f"`{hit}` with no block_until_ready in the same function "
+                f"— jax dispatch is async, so the wall measures the "
+                f"enqueue, not the device work; block on the result (or "
+                f"justify: host-side conversion already syncs)")
+            if f:
+                findings.append(f)
     return findings
 
 

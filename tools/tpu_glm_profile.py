@@ -1,3 +1,5 @@
+# tmoglint: disable-file=TPU005  every timed window below syncs through
+# sync() (float() of a device sum) or validate()'s host-float conversion
 """Decompose the warm GLM sweep's wall time (VERDICT r4 weak #3).
 
 The einsum Hessian kernel measured 25.8 TF/s in isolation but the warm

@@ -498,6 +498,7 @@ class Validator:
             path=self._sweep_path(f"vmapped:{jnp.dtype(dtype).name}"))
         pending = [gi for gi in range(len(grids)) if gi not in results]
         if pending:
+            from ...utils.metrics import collector
             Xd, yd, wd, md = self._device_arrays(X, y, w, masks, dtype)
             thr_d = jnp.asarray(margin_thr, jnp.float32)
             rank_bins = self._rank_bins(X.shape[0])
@@ -508,19 +509,24 @@ class Validator:
                 idx = pending[start:start + chunk]
                 # pad the tail chunk so every call shares one compiled shape
                 padded = idx + [idx[-1]] * (chunk - len(idx))
-                out = _sweep(Xd, yd, wd, md,
-                             jnp.asarray(regs[padded]),
-                             jnp.asarray(alphas[padded]), thr_d,
-                             fit_one=fit_one, metric=metric,
-                             problem_type=problem_type, n_classes=n_classes,
-                             rank_bins=rank_bins)
-                out = np.asarray(out)  # [F, chunk]
+                with collector.trace_span(
+                        f"glm_vmapped:{type(est).__name__}",
+                        kind="sweep_fit", folds=int(masks.shape[0]),
+                        chunk=chunk):
+                    out = _sweep(Xd, yd, wd, md,
+                                 jnp.asarray(regs[padded]),
+                                 jnp.asarray(alphas[padded]), thr_d,
+                                 fit_one=fit_one, metric=metric,
+                                 problem_type=problem_type,
+                                 n_classes=n_classes, rank_bins=rank_bins)
+                    out = np.asarray(out)  # [F, chunk]
                 for j, gi in enumerate(idx):
                     fm = [float(v) for v in out[:, j]]
                     results[gi] = fm
                     if ckpt is not None:
                         ckpt.record(keys[gi], type(est).__name__, grids[gi],
                                     fm, metric)
+                    self._cell_event(est, gi, fm, "vmapped")
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
                            grid=g, metric_name=metric,
@@ -561,6 +567,19 @@ class Validator:
         rkey = hashlib.sha256(payload.encode()).hexdigest()[:24]
         rc = RoundCheckpoint(self.checkpoint_path + ".glm_rounds.npz")
         return rc, rkey, rc.load(rkey)
+
+    @staticmethod
+    def _cell_event(est, gi, fm, route):
+        """One `sweep_cell_landed` event per finished (model x grid) cell
+        (all fold metrics exist) — the resumable unit of the sweep
+        checkpoint, streamed so `tail -f events.jsonl` shows sweep
+        progress cell by cell."""
+        from ...utils.metrics import collector
+        finite = [v for v in fm if np.isfinite(v)]
+        collector.event(
+            "sweep_cell_landed", model=type(est).__name__,
+            grid_index=int(gi), route=route, n_folds=len(fm),
+            mean_metric=float(np.mean(finite)) if finite else None)
 
     def _record_sweep_telemetry(self, est, info):
         self.last_streamed_telemetry = dict(info,
@@ -617,8 +636,21 @@ class Validator:
         if loss != "squared" and GS.env_on("TMOG_GLM_ROUNDS"):
             rc, rkey, state = self._round_checkpoint(keys, pending,
                                                      fit_kwargs)
-            on_round = (lambda st: rc.save(rkey, st)) \
-                if rc is not None else None
+            from ...utils.metrics import collector
+
+            def on_round(st):
+                # one event per retirement boundary: the tail of
+                # events.jsonl IS the live convergence picture of a
+                # multi-hour sweep (GLM round retired / checkpoint saved)
+                if rc is not None:
+                    rc.save(rkey, st)
+                    collector.event("round_checkpoint_written",
+                                    path=rc.path, rounds=int(st["rounds"]))
+                collector.event(
+                    "glm_round_retired", rounds=int(st["rounds"]),
+                    lanes_retired=int(st["retired"].sum()),
+                    lanes_active=int((~st["retired"]).sum()),
+                    lane_passes=int(st["lane_passes"]))
             B, b0, info = GS.sweep_glm_streamed_rounds(
                 Xd, yd, wd, md, np.asarray(regs_p), np.asarray(alphas_p),
                 mesh=self.mesh, state=state, on_round=on_round,
@@ -654,6 +686,7 @@ class Validator:
             path=self._sweep_path(f"streamed:{jnp.dtype(dtype).name}"))
         pending = [gi for gi in range(len(grids)) if gi not in results]
         if pending:
+            from ...utils.metrics import collector
             Xd, yd, wd, md = self._device_arrays(X, y, w, masks, dtype)
             fit_kwargs = dict(
                 loss=est.streamed_loss,
@@ -663,32 +696,41 @@ class Validator:
                 if base.has_param("fit_intercept") else True,
                 standardize=bool(base.get_param("standardization"))
                 if base.has_param("standardization") else True)
-            B, b0, sweep_info, round_ckpt = self._streamed_fit(
-                est, fit_kwargs, Xd, yd, wd, md,
-                jnp.asarray(regs[pending]), jnp.asarray(alphas[pending]),
-                keys, pending)
+            with collector.trace_span(
+                    f"glm_streamed:{type(est).__name__}", kind="sweep_fit",
+                    folds=int(masks.shape[0]), grids=len(pending)) as sp:
+                B, b0, sweep_info, round_ckpt = self._streamed_fit(
+                    est, fit_kwargs, Xd, yd, wd, md,
+                    jnp.asarray(regs[pending]), jnp.asarray(alphas[pending]),
+                    keys, pending)
+                if sp is not None:
+                    sp.attrs["kernel"] = sweep_info.get("kernel")
             self._record_sweep_telemetry(est, sweep_info)
             rank_bins = self._rank_bins(X.shape[0])
             thr_d = jnp.asarray(margin_thr, jnp.float32)
             chunk = min(self._STREAMED_EVAL_CHUNK, len(pending))
             out = np.empty((masks.shape[0], len(pending)), np.float64)
-            for f in range(masks.shape[0]):
-                vw = (1.0 - md[f]) * wd
-                for s in range(0, len(pending), chunk):
-                    idx = list(range(s, min(s + chunk, len(pending))))
-                    padded = idx + [idx[-1]] * (chunk - len(idx))
-                    vals = _streamed_eval(
-                        Xd, yd, vw, B[f, jnp.asarray(padded)],
-                        b0[f, jnp.asarray(padded)], thr_d, metric=metric,
-                        problem_type=problem_type, rank_bins=rank_bins,
-                        chunk=chunk, use_lanes=self.mesh is None)
-                    out[f, idx] = np.asarray(vals)[:len(idx)]
+            with collector.trace_span(
+                    f"glm_streamed_eval:{type(est).__name__}",
+                    kind="sweep_eval", cells=len(pending)):
+                for f in range(masks.shape[0]):
+                    vw = (1.0 - md[f]) * wd
+                    for s in range(0, len(pending), chunk):
+                        idx = list(range(s, min(s + chunk, len(pending))))
+                        padded = idx + [idx[-1]] * (chunk - len(idx))
+                        vals = _streamed_eval(
+                            Xd, yd, vw, B[f, jnp.asarray(padded)],
+                            b0[f, jnp.asarray(padded)], thr_d, metric=metric,
+                            problem_type=problem_type, rank_bins=rank_bins,
+                            chunk=chunk, use_lanes=self.mesh is None)
+                        out[f, idx] = np.asarray(vals)[:len(idx)]
             for j, gi in enumerate(pending):
                 fm = [float(v) for v in out[:, j]]
                 results[gi] = fm
                 if ckpt is not None:
                     ckpt.record(keys[gi], type(est).__name__, grids[gi],
                                 fm, metric)
+                self._cell_event(est, gi, fm, "streamed")
             if round_ckpt is not None:
                 # only NOW are all cells in the JSONL checkpoint: a
                 # preemption during the evaluation above resumes from the
@@ -769,6 +811,7 @@ class Validator:
             for gi in pending:
                 groups.setdefault(bins_of(gi), []).append(gi)
             multicls = problem_type == "multiclass"
+            from ...utils.metrics import collector
             for _, group in sorted(groups.items(), key=lambda kv: str(kv[0])):
                 # n_valid: mesh runs pad rows (repeat-last) — the quantile
                 # sketch must see only the real rows so mesh and meshless
@@ -776,7 +819,7 @@ class Validator:
                 ctx = est.copy(**grids[group[0]]).mask_sweep_context(
                     Xd, n_valid=X.shape[0], mesh=self.mesh)
 
-                def record(gi, scores_f):
+                def record(gi, scores_f, fused=False):
                     out = np.asarray(fold_metrics(scores_f, yd, wd, md,
                                                   thr_d))
                     fm = [float(v) for v in out]
@@ -784,6 +827,9 @@ class Validator:
                     if ckpt is not None:
                         ckpt.record(keys[gi], type(est).__name__, grids[gi],
                                     fm, metric)
+                    self._cell_event(est, gi, fm,
+                                     "mask_folds:grid_fused" if fused
+                                     else "mask_folds")
 
                 # config fusion: grid points whose structural signature
                 # matches fit ONE fold-fused device program (lanes =
@@ -819,6 +865,12 @@ class Validator:
                             # once at sweep level, raise at the cap
                             fuse_fail_streak += 1
                             fuse_failures += 1
+                            collector.event(
+                                "fused_route_fallback",
+                                model=type(est).__name__,
+                                error_type=type(e).__name__,
+                                streak=fuse_fail_streak,
+                                configs=len(gis))
                             if fuse_fail_streak >= fuse_max_failures:
                                 raise RuntimeError(
                                     f"config-fused sweep route failed "
@@ -844,7 +896,7 @@ class Validator:
                     if fused is not None:
                         fuse_fail_streak = 0
                         for k, gi in enumerate(gis):
-                            record(gi, fused[k])
+                            record(gi, fused[k], fused=True)
                             fused_gis.add(gi)
                         continue
                     for gi in gis:
@@ -899,6 +951,7 @@ class Validator:
             if ckpt is not None:
                 ckpt.record(keys[gi], type(est).__name__, g, fold_vals,
                             metric)
+            self._cell_event(est, gi, fold_vals, "sequential")
         return [
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
                            grid=g, metric_name=metric,

@@ -379,6 +379,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                      help="problem kind override")
     gen.add_argument("--output", default=".", help="project directory")
     gen.add_argument("--name", default=None, help="project name")
+    tr = sub.add_parser(
+        "trace-report",
+        help="summarize a traced run dir (top spans by self-time, "
+             "recompiles per program, kernel roofline, event-log counts); "
+             "--check validates the Chrome-trace/event-log schemas "
+             "(docs/observability.md)")
+    tr.add_argument("dir", help="metrics dir written by a traced run "
+                                "(metrics_location / BENCH_TRACE_DIR)")
+    tr.add_argument("--check", action="store_true",
+                    help="schema validation only; exit 1 on any problem")
+    tr.add_argument("--top", type=int, default=15,
+                    help="rows in the self-time table (default 15)")
     a = p.parse_args(argv)
     if a.command == "gen":
         files = generate_project(a.input, a.response, a.output,
@@ -386,6 +398,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                  schema_path=a.schema, kind=a.kind)
         print(f"Generated {', '.join(files)} in {a.output}")
         return 0
+    if a.command == "trace-report":
+        from .utils.tracing import trace_report
+        text, ok = trace_report(a.dir, check=a.check, top=a.top)
+        print(text)
+        return 0 if ok else 1
     return 1
 
 

@@ -389,7 +389,11 @@ class _TreeEstimator(PredictorEstimator):
             pallas_hist.fused_fit_bytes(
                 Xb.shape[0], Xb.shape[1], lanes, depth, n_rounds,
                 xb_itemsize=Xb.dtype.itemsize),
-            cold=cold)
+            cold=cold,
+            # shape attrs ride into the kernel span of the trace export,
+            # so a Perfetto view names the program's sweep geometry
+            attrs=dict(lanes=int(lanes), depth=int(depth),
+                       n_rounds=int(n_rounds), n_rows=int(Xb.shape[0])))
         _TreeEstimator._WARM_FUSED_SHAPES.add(sig)
         return out
 

@@ -879,45 +879,55 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
     l2v = np.tile(regs * (1.0 - alphas), F).astype(np.float32)
     st = state if state is not None else _new_round_state(L, d)
 
+    # span hook: each retirement round is one child span of whatever the
+    # validator opened (run -> sweep_fit -> sweep_round), carrying the
+    # bucket/active shape — the trace view of the bucket-ladder story, and
+    # the recompile tracker's attribution unit for round programs
+    from ..utils.metrics import collector as _collector
+
     def run_round(idx, budget):
         k = len(idx)
         Lb = bucket_lanes(k)
-        sel = np.zeros((F, Lb), np.float32)
-        sel[lane_fold[idx], np.arange(k)] = 1.0
-        l1b = np.zeros(Lb, np.float32)
-        l1b[:k] = l1v[idx]
-        # inert pads get l2=1 so their (zero-data) Hessian stays
-        # well-conditioned; their B stays exactly 0 from the zero init
-        l2b = np.ones(Lb, np.float32)
-        l2b[:k] = l2v[idx]
-        B0 = np.zeros((Lb, d), np.float32)
-        B0[:k] = st["B"][idx]
-        b00 = np.zeros(Lb, np.float32)
-        b00[:k] = st["b0"][idx]
-        args = (X, y, w, fold_masks, jnp.asarray(sel), jnp.asarray(l1b),
-                jnp.asarray(l2b), jnp.asarray(B0), jnp.asarray(b00),
-                mean, std, jnp.asarray(budget, jnp.int32),
-                jnp.asarray(tol_f, jnp.float32))
-        if mesh is None:
-            Bb, b0b, db, it = sweep_glm_round(
-                *args, loss=loss, fit_intercept=fit_intercept)
-        else:
-            Bb, b0b, db, it = _sharded_round_fn(
-                mesh, loss, bool(fit_intercept))(*args)
-        st["B"][idx] = np.asarray(Bb)[:k]
-        st["b0"][idx] = np.asarray(b0b)[:k]
-        st["delta"][idx] = np.asarray(db)[:k]
-        it = int(it)
-        st["iters"][idx] += it
-        st["rounds"] += 1
-        st["data_passes"] += it
-        # useful work (active lanes) vs executed work (the padded bucket
-        # the device actually ran) — the FLOP model bills the latter
-        st["lane_passes"] += it * k
-        st["padded_lane_passes"] += it * Lb
-        st["active_per_round"].append(k)
-        st["iters_per_round"].append(it)
-        st["bucket_sizes"].append(Lb)
+        with _collector.trace_span(
+                f"glm_round[{Lb}]", kind="sweep_round", bucket=int(Lb),
+                active=int(k), iters_budget=int(budget)):
+            sel = np.zeros((F, Lb), np.float32)
+            sel[lane_fold[idx], np.arange(k)] = 1.0
+            l1b = np.zeros(Lb, np.float32)
+            l1b[:k] = l1v[idx]
+            # inert pads get l2=1 so their (zero-data) Hessian stays
+            # well-conditioned; their B stays exactly 0 from the zero init
+            l2b = np.ones(Lb, np.float32)
+            l2b[:k] = l2v[idx]
+            B0 = np.zeros((Lb, d), np.float32)
+            B0[:k] = st["B"][idx]
+            b00 = np.zeros(Lb, np.float32)
+            b00[:k] = st["b0"][idx]
+            args = (X, y, w, fold_masks, jnp.asarray(sel), jnp.asarray(l1b),
+                    jnp.asarray(l2b), jnp.asarray(B0), jnp.asarray(b00),
+                    mean, std, jnp.asarray(budget, jnp.int32),
+                    jnp.asarray(tol_f, jnp.float32))
+            if mesh is None:
+                Bb, b0b, db, it = sweep_glm_round(
+                    *args, loss=loss, fit_intercept=fit_intercept)
+            else:
+                Bb, b0b, db, it = _sharded_round_fn(
+                    mesh, loss, bool(fit_intercept))(*args)
+            st["B"][idx] = np.asarray(Bb)[:k]
+            st["b0"][idx] = np.asarray(b0b)[:k]
+            st["delta"][idx] = np.asarray(db)[:k]
+            it = int(it)
+            st["iters"][idx] += it
+            st["rounds"] += 1
+            st["data_passes"] += it
+            # useful work (active lanes) vs executed work (the padded
+            # bucket the device actually ran) — the FLOP model bills the
+            # latter
+            st["lane_passes"] += it * k
+            st["padded_lane_passes"] += it * Lb
+            st["active_per_round"].append(k)
+            st["iters_per_round"].append(it)
+            st["bucket_sizes"].append(Lb)
 
     def retire(idx):
         st["retired"][idx] = (st["delta"][idx] <= tol_f) \
@@ -978,3 +988,15 @@ def sweep_scores_fold(X: jax.Array, B_f: jax.Array, b0_f: jax.Array
     (bf16 X stays bf16; f32 accumulation)."""
     return jnp.matmul(X, B_f.T.astype(X.dtype),
                       preferred_element_type=jnp.float32) + b0_f[None, :]
+
+
+# recompile-tracker fallback (utils/tracing): on jax builds without
+# jax.monitoring the tracker samples these entries' lowered-executable
+# counts at span boundaries instead of listening for compile events — the
+# sweep kernels are exactly the programs whose "bounded recompiles on the
+# bucket ladder" claim the tracer exists to verify
+from ..utils import tracing as _tracing  # noqa: E402
+
+_tracing.register_jit_fallback(
+    sweep_glm_round, sweep_glm_streamed, sweep_glm_squared_gram,
+    glm_standardize_stats)

@@ -1164,6 +1164,20 @@ def _register_pallas_consumers():
 _register_pallas_consumers()
 
 
+def _register_trace_fallback():
+    """Recompile-tracker fallback registration (utils/tracing): on jax
+    builds without jax.monitoring, the span tree counts compiles of the
+    tree-fit drivers by sampling their lowered-executable counts at span
+    boundaries — the models/trees._timed_fused_fit kernel spans then
+    still carry true recompile attribution."""
+    from ..utils import tracing
+    tracing.register_jit_fallback(grow_tree, fit_forest, fit_gbt,
+                                  fit_gbt_folds, fit_gbt_softmax)
+
+
+_register_trace_fallback()
+
+
 # -- host-side (numpy) ensemble traversal for serving -----------------------
 
 def np_predict_ensemble(feat: np.ndarray, thresh_val: np.ndarray,

@@ -9,7 +9,16 @@ a `trace()` context manager around jax.profiler for device timelines.
 Collection is opt-in and process-local: `enable()` (or
 OpParams.collect_stage_metrics=True through the runner) turns it on; the
 workflow engine reports fit/transform spans here.
-"""
+
+Since the hierarchical-tracing PR every record is also a node of a span
+TREE (utils/tracing.py): enable() opens a root span and activates the
+recompile tracker; span()/trace_span() nest under it; kernel() and
+sweep_convergence() attach as child spans. The flat StageMetric /
+KernelRoofline / SweepConvergence lists stay exactly as before so
+AppMetrics.to_json() remains byte-compatible for existing consumers — the
+tree adds a "spans" key in save(), a Chrome-trace export
+(save_chrome_trace) and an optional streaming event log
+(attach_event_log / event)."""
 from __future__ import annotations
 
 import contextlib
@@ -18,10 +27,19 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
+from . import tracing
+from .tracing import EventLog, TraceTree
+
 
 @dataclass
 class StageMetric:
-    """One fit/transform span (reference StageMetrics case class)."""
+    """One fit/transform span (reference StageMetrics case class).
+
+    error/error_type: a span is recorded even when its body raises (the
+    `finally` path), and before the tracing PR it silently dropped that
+    fact — a failed fit read exactly like a fast one. Both fields ride
+    into to_json()/the trace export; absent errors serialize as
+    error=False / error_type=None, which old readers ignore."""
 
     stage_name: str
     uid: str
@@ -29,6 +47,8 @@ class StageMetric:
     wall_seconds: float
     n_rows: int = 0
     n_stages_fused: int = 1
+    error: bool = False
+    error_type: Optional[str] = None
 
     def to_json(self) -> Dict[str, Any]:
         return dict(self.__dict__)
@@ -162,17 +182,108 @@ class MetricsCollector:
     def __init__(self) -> None:
         self.enabled = False
         self.current = AppMetrics()
+        self.trace = TraceTree()
+        self._finished = False
+        self._event_log: Optional[EventLog] = None
 
     def enable(self, app_name: str = "transmogrifai_tpu") -> None:
+        """Start (or join) a collected run. Reentrancy-safe: when a run is
+        ALREADY being collected (an outer bench/BENCH_TRACE_DIR trace, a
+        library user's own enable) a nested enable — e.g. runner.run with
+        collect_stage_metrics inside it — must NOT reset the outer span
+        tree mid-run; the nested run's spans simply join the existing
+        tree. disable(), or finish() having closed the run, re-arms a
+        fresh enable."""
+        if self.enabled and not self._finished:
+            return
         self.enabled = True
+        self._finished = False
         self.current = AppMetrics(app_name=app_name, start_time=time.time())
+        self.trace = TraceTree()
+        # activate BEFORE opening the root span so the fallback tracker
+        # samples the root too — compiles landing at run level (between
+        # child spans) must not be invisible on monitoring-less jax
+        tracing.tracker.activate(self.trace)
+        self.trace.open(app_name, "run")
+
+    @property
+    def collecting(self) -> bool:
+        """True while an UNFINISHED run is being collected — the state a
+        nested enable() joins instead of resetting (callers that enable
+        conditionally, like runner.run, key their cleanup on this)."""
+        return self.enabled and not self._finished
 
     def disable(self) -> None:
         self.enabled = False
+        tracing.tracker.deactivate()
 
     def finish(self) -> AppMetrics:
-        self.current.end_time = time.time()
+        """Close the run. Idempotent: end_time (and therefore
+        duration_seconds) freezes on the FIRST call — save() and
+        runner._finish both call here, and the second call used to
+        silently rewrite the run's duration."""
+        if not self._finished:
+            self.current.end_time = time.time()
+            self.trace.close_all()
+            self._finished = True
         return self.current
+
+    # -- event log ---------------------------------------------------------
+    @property
+    def has_event_log(self) -> bool:
+        return self._event_log is not None
+
+    def attach_event_log(self, path: str) -> EventLog:
+        """Open (append) the streaming JSONL event log. Events flow
+        independently of `enabled` — the log is the tail-able liveness
+        channel of a long sweep even when span collection is off. The new
+        log opens BEFORE the old one closes: a failed open (unwritable
+        path) raises with the working log still attached."""
+        new_log = EventLog(path)
+        if self._event_log is not None:
+            self._event_log.close()
+        self._event_log = new_log
+        return new_log
+
+    def detach_event_log(self) -> None:
+        if self._event_log is not None:
+            self._event_log.close()
+            self._event_log = None
+
+    def event(self, event: str, **fields: Any) -> None:
+        """Emit one run event to the attached log (no-op without one)."""
+        if self._event_log is not None:
+            self._event_log.emit(event, **fields)
+
+    # -- spans ---------------------------------------------------------------
+    _EVENTED_KINDS = ("run", "workflow", "stage")
+
+    @contextlib.contextmanager
+    def trace_span(self, name: str, kind: str = "span",
+                   **attrs: Any) -> Iterator[Optional[tracing.Span]]:
+        """Generic span context: nests under the innermost open span,
+        records error/error_type when the body raises, samples the device
+        memory watermark and recompile attribution at close. Yields the
+        Span (None when collection is off) so callers can add attrs."""
+        if not self.enabled:
+            yield None
+            return
+        sp = self.trace.open(name, kind, **attrs)
+        if kind in self._EVENTED_KINDS:
+            self.event("span_start", name=name, kind=kind)
+        err: Optional[str] = None
+        try:
+            yield sp
+        except BaseException as e:
+            err = type(e).__name__
+            raise
+        finally:
+            self.trace.close(sp, error_type=err)
+            if kind in self._EVENTED_KINDS:
+                self.event("span_end", name=name, kind=kind,
+                           wall_seconds=round(sp.duration, 6),
+                           error=err is not None,
+                           **({"error_type": err} if err else {}))
 
     @contextlib.contextmanager
     def span(self, stage_name: str, uid: str, phase: str,
@@ -181,21 +292,40 @@ class MetricsCollector:
             yield
             return
         t0 = time.time()
+        sp = self.trace.open(stage_name, "stage", uid=uid, phase=phase,
+                             n_rows=n_rows, n_stages_fused=n_stages_fused)
+        self.event("stage_start", stage=stage_name, uid=uid, phase=phase)
+        err: Optional[str] = None
         try:
             yield
+        except BaseException as e:
+            # the span records even when the body raises; WITHOUT the
+            # error mark a failed fit reads exactly like a fast one
+            err = type(e).__name__
+            raise
         finally:
+            self.trace.close(sp, error_type=err)
+            wall = time.time() - t0
             self.current.stage_metrics.append(StageMetric(
                 stage_name=stage_name, uid=uid, phase=phase,
-                wall_seconds=time.time() - t0, n_rows=n_rows,
-                n_stages_fused=n_stages_fused))
+                wall_seconds=wall, n_rows=n_rows,
+                n_stages_fused=n_stages_fused,
+                error=err is not None, error_type=err))
+            self.event("stage_end", stage=stage_name, uid=uid, phase=phase,
+                       wall_seconds=round(wall, 6), error=err is not None,
+                       **({"error_type": err} if err else {}))
 
     def kernel(self, name: str, wall_seconds: float, bytes_hbm: float,
-               cold: Optional[bool] = None) -> Optional[KernelRoofline]:
+               cold: Optional[bool] = None,
+               attrs: Optional[Dict[str, Any]] = None
+               ) -> Optional[KernelRoofline]:
         """Record one kernel-roofline span (no-op unless enabled). The
         roof is resolved from the default backend's device kind at record
         time; achieved GB/s and %-of-roof are derived here so every
         consumer (bench.py, BENCH_*.json) reports the same arithmetic.
-        cold=True flags a span whose wall includes jit trace/compile."""
+        cold=True flags a span whose wall includes jit trace/compile.
+        The record also lands as a `kernel` child span of the innermost
+        open span (trace export), with `attrs` merged in."""
         if not self.enabled:
             return None
         roof = None
@@ -210,6 +340,10 @@ class MetricsCollector:
             bytes_hbm=float(bytes_hbm), cold=cold,
             **roofline_fields(wall_seconds, bytes_hbm, roof))
         self.current.kernel_metrics.append(rec)
+        self.trace.add_complete(
+            name, "kernel", wall_seconds, bytes_hbm=rec.bytes_hbm,
+            achieved_gbps=rec.achieved_gbps, roof_gbps=rec.roof_gbps,
+            pct_of_roof=rec.pct_of_roof, cold=rec.cold, **(attrs or {}))
         return rec
 
     def sweep_convergence(self, family: str, kernel: str, rounds: int,
@@ -231,11 +365,40 @@ class MetricsCollector:
             iters_per_round=[int(v) for v in iters_per_round],
             bucket_sizes=[int(v) for v in bucket_sizes])
         self.current.sweep_metrics.append(rec)
+        self.trace.add_complete(
+            f"{family}:{kernel}", "sweep", 0.0, **rec.to_json())
         return rec
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, close: bool = True) -> None:
+        """AppMetrics JSON + (new) the span tree under "spans" — every
+        pre-existing key keeps its exact shape (golden-tested), the tree
+        rides along for trace-report.
+
+        close=False writes a SNAPSHOT without finishing: a run that
+        JOINED an outer collection (runner.run inside a BENCH_TRACE_DIR
+        trace) must not close the outer span tree mid-run — its artifact
+        is the enclosing run's state so far, duration up to now."""
+        if close:
+            doc = self.finish().to_json()
+        else:
+            doc = self.current.to_json()
+            if not self._finished:
+                doc["duration_seconds"] = max(
+                    time.time() - self.current.start_time, 0.0)
+        if self.trace.spans:
+            doc["spans"] = self.trace.to_json()
         with open(path, "w") as f:
-            json.dump(self.finish().to_json(), f, indent=2)
+            json.dump(doc, f, indent=2)
+
+    def save_chrome_trace(self, path: str, close: bool = True) -> None:
+        """Chrome trace_event export of the span tree — open the file in
+        Perfetto (ui.perfetto.dev) or chrome://tracing. close=False (a
+        joined collection, see save) exports with still-open spans drawn
+        up to now instead of closing them."""
+        if close:
+            self.finish()
+        tracing.write_chrome_trace(path, self.trace,
+                                   app_name=self.current.app_name)
 
 
 # the process-wide collector the workflow engine reports to

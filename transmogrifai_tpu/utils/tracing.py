@@ -1,0 +1,736 @@
+"""Hierarchical run tracing: span tree, recompile/HBM attribution, exports.
+
+Reference: the OpSparkListener gave every run a per-stage/job/app metrics
+story surfaced through the Spark UI and its event log. The TPU equivalent
+here is a process-local span TREE (run -> workflow -> layer -> stage ->
+kernel / sweep-round) with the two costs that dominate JAX/TPU runs
+attributed per span:
+
+- **XLA recompiles** — a `jax.monitoring` listener counts every backend
+  compile and books it to the innermost open span (with a
+  lowered-executable-count fallback for jax builds without monitoring),
+  making claims like PR 3's "bounded recompiles on the bucket ladder"
+  runtime-verifiable from any traced run;
+- **device-memory watermarks** — `Device.memory_stats()` sampled at span
+  close (None-safe: CPU hosts report nothing and the attrs are omitted).
+
+Three consumers, one tree:
+
+- Chrome `trace_event` JSON (`chrome_trace`/`write_chrome_trace`) loadable
+  in Perfetto / chrome://tracing;
+- the existing AppMetrics JSON (`utils/metrics.MetricsCollector.save`
+  appends the span list under a new "spans" key, everything else
+  byte-compatible);
+- a streaming JSONL event log (`EventLog`) of timestamped run events, so a
+  preempted multi-hour sweep is monitorable by tailing ONE file.
+
+`trace_report(dir)` renders top-spans-by-self-time, per-program recompile
+counts and the kernel roofline table; `trace_report(dir, check=True)` is
+the schema validator CI runs (`python -m transmogrifai_tpu trace-report
+<dir> --check`).
+
+This module is import-light on purpose: jax is only touched lazily (and
+only when it is already imported) so attaching tracing to a host-only run
+never initializes a backend.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "Span", "TraceTree", "RecompileTracker", "tracker", "EventLog",
+    "register_jit_fallback", "device_memory_attrs", "chrome_trace",
+    "write_chrome_trace", "trace_report",
+]
+
+# the monitoring event one XLA backend compilation emits (jax >= 0.4.x);
+# cache hits from the persistent compile cache do NOT emit it, so the
+# count is true recompiles, not cache loads
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+@dataclass
+class Span:
+    """One node of the run's span tree.
+
+    t_start/t_end are seconds on the owning TraceTree's monotonic clock
+    (perf_counter anchored at tree construction) — wall-time arithmetic
+    between spans is exact regardless of system clock steps."""
+
+    span_id: int
+    parent_id: Optional[int]
+    name: str
+    kind: str               # run|workflow|layer|stage|kernel|sweep|sweep_round
+    t_start: float
+    t_end: Optional[float] = None
+    attrs: Dict[str, Any] = field(default_factory=dict)
+    error: bool = False
+    error_type: Optional[str] = None
+
+    @property
+    def duration(self) -> float:
+        end = self.t_end if self.t_end is not None else self.t_start
+        return max(end - self.t_start, 0.0)
+
+    def to_json(self) -> Dict[str, Any]:
+        out = {"span_id": self.span_id, "parent_id": self.parent_id,
+               "name": self.name, "kind": self.kind,
+               "t_start": round(self.t_start, 6),
+               "t_end": round(self.t_end, 6)
+               if self.t_end is not None else None,
+               "duration_seconds": round(self.duration, 6),
+               "error": self.error}
+        if self.error_type:
+            out["error_type"] = self.error_type
+        if self.attrs:
+            out["attrs"] = _jsonable(self.attrs)
+        return out
+
+
+def _jsonable(d: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in d.items():
+        if isinstance(v, (str, int, float, bool, type(None))):
+            out[k] = v
+        elif isinstance(v, (list, tuple)):
+            out[k] = [x if isinstance(x, (str, int, float, bool, type(None)))
+                      else str(x) for x in v]
+        else:
+            out[k] = str(v)
+    return out
+
+
+class TraceTree:
+    """Span registry + open-span stack for one traced run (one enable()).
+
+    Thread note: the tree is driven from the host thread that dispatches
+    the run; the lock only exists so the jax.monitoring compile listener
+    (which fires synchronously inside compile calls, possibly from helper
+    threads in future jax versions) can attribute safely."""
+
+    def __init__(self) -> None:
+        self._clock0 = time.perf_counter()
+        self._wall0 = time.time()
+        self.spans: List[Span] = []
+        self._stack: List[Span] = []
+        self._next_id = 1
+        self._lock = threading.RLock()
+        # parent span_id -> children, so subtree walks (fallback compile
+        # accounting, self-time) stay O(subtree), not O(all spans)
+        self._children: Dict[int, List[Span]] = {}
+
+    # -- clock -------------------------------------------------------------
+    def now(self) -> float:
+        return time.perf_counter() - self._clock0
+
+    # -- structure ---------------------------------------------------------
+    def current(self) -> Optional[Span]:
+        with self._lock:
+            return self._stack[-1] if self._stack else None
+
+    def open(self, name: str, kind: str, **attrs: Any) -> Span:
+        with self._lock:
+            parent = self._stack[-1].span_id if self._stack else None
+            sp = Span(span_id=self._next_id, parent_id=parent, name=name,
+                      kind=kind, t_start=self.now(), attrs=dict(attrs))
+            self._next_id += 1
+            self.spans.append(sp)
+            if parent is not None:
+                self._children.setdefault(parent, []).append(sp)
+            self._stack.append(sp)
+        tracker.on_span_open(sp)
+        return sp
+
+    def children_of(self, span_id: int) -> List[Span]:
+        with self._lock:
+            return list(self._children.get(span_id, ()))
+
+    def close(self, sp: Span, error_type: Optional[str] = None) -> None:
+        with self._lock:
+            # a double close (e.g. close_all() from save() racing the
+            # still-open context manager's exit) must be a no-op: the
+            # first close fixed t_end, and rewriting it would inflate the
+            # span past its already-closed parent's window
+            already_closed = sp.t_end is not None
+            if not already_closed:
+                sp.t_end = self.now()
+            if error_type:
+                sp.error = True
+                sp.error_type = error_type
+            # pop up to and including sp — tolerates children left open by
+            # an exception unwinding through several context managers. A
+            # close of a span no longer on the stack must not drain it.
+            if any(top is sp for top in self._stack):
+                while self._stack:
+                    top = self._stack.pop()
+                    if top is sp:
+                        break
+                    if top.t_end is None:
+                        top.t_end = sp.t_end
+                    top.attrs.pop("_jit_cache0", None)
+        if already_closed:
+            return
+        tracker.on_span_close(sp, self)
+        mem = device_memory_attrs()
+        if mem:
+            sp.attrs.update(mem)
+
+    def add_complete(self, name: str, kind: str, duration: float,
+                     **attrs: Any) -> Span:
+        """Record an already-measured child span (e.g. a kernel wall that
+        was timed by its own block_until_ready window): t_end = now,
+        t_start = now - duration, parented to the innermost open span."""
+        with self._lock:
+            parent = self._stack[-1].span_id if self._stack else None
+            end = self.now()
+            sp = Span(span_id=self._next_id, parent_id=parent, name=name,
+                      kind=kind, t_start=max(end - max(duration, 0.0), 0.0),
+                      t_end=end, attrs=dict(attrs))
+            self._next_id += 1
+            self.spans.append(sp)
+            if parent is not None:
+                self._children.setdefault(parent, []).append(sp)
+        return sp
+
+    def close_all(self) -> None:
+        with self._lock:
+            while self._stack:
+                self.close(self._stack[-1])
+
+    # -- derived -----------------------------------------------------------
+    def self_seconds(self, sp: Span) -> float:
+        child = sum(s.duration for s in self.children_of(sp.span_id))
+        return max(sp.duration - child, 0.0)
+
+    def to_json(self) -> List[Dict[str, Any]]:
+        return [s.to_json() for s in self.spans]
+
+
+# -- recompile attribution ---------------------------------------------------
+
+# jitted entry points registered for the no-monitoring fallback: the sum of
+# their lowered-executable cache sizes is sampled at span open/close and the
+# delta (minus what nested spans already booked) becomes the span's compile
+# count. Coarser than the listener — it only sees registered functions —
+# but needs nothing from jax beyond the public-ish _cache_size().
+_FALLBACK_JITS: List[Any] = []
+
+
+def register_jit_fallback(*fns: Any) -> None:
+    """Register jitted callables whose executable count stands in for the
+    compile counter on jax builds without `jax.monitoring`. Idempotent."""
+    for fn in fns:
+        if fn is not None and all(fn is not g for g in _FALLBACK_JITS):
+            _FALLBACK_JITS.append(fn)
+
+
+def _fallback_cache_size() -> int:
+    total = 0
+    for fn in _FALLBACK_JITS:
+        size = getattr(fn, "_cache_size", None)
+        if size is None:
+            continue
+        try:
+            total += int(size())
+        except Exception:
+            pass
+    return total
+
+
+class RecompileTracker:
+    """Books every XLA backend compile to the innermost open span.
+
+    Primary path: a `jax.monitoring` duration listener on
+    /jax/core/compile/backend_compile_duration (registered once, gated on
+    an active tree so an idle process pays one dict lookup per compile).
+    Fallback (monitoring-less jax): lowered-executable-count sampling over
+    `register_jit_fallback` functions at span boundaries."""
+
+    def __init__(self) -> None:
+        self._tree: Optional[TraceTree] = None
+        self._listener_installed = False
+        # override switch (tests force the fallback path with it); the
+        # per-activation choice lives in _mode so a pre-jax enable()
+        # falling back does not permanently disable the listener path
+        self._use_monitoring = True
+        self._mode = "monitoring"
+        self.total_compiles = 0
+        self.total_compile_seconds = 0.0
+        self.by_program: Dict[str, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def activate(self, tree: TraceTree) -> None:
+        self._tree = tree
+        self.total_compiles = 0
+        self.total_compile_seconds = 0.0
+        self.by_program = {}
+        if self._monitoring_available():
+            self._install_listener()
+            self._mode = "monitoring"
+        else:
+            self._mode = "fallback"
+
+    def deactivate(self) -> None:
+        self._tree = None
+
+    def _monitoring_available(self) -> bool:
+        if not self._use_monitoring:
+            return False
+        # only consult jax when something else already imported it (the
+        # module contract): a host-only process enabling collection must
+        # not pay the jax import here. With jax absent BOTH tracker paths
+        # are inert — there is nothing compiling to count.
+        jmod = sys.modules.get("jax")
+        if jmod is None:
+            return False
+        try:
+            import jax.monitoring  # cheap: jax itself is loaded
+            return hasattr(jax.monitoring,
+                           "register_event_duration_secs_listener")
+        except Exception:
+            return False
+
+    def _install_listener(self) -> None:
+        if self._listener_installed:
+            return
+        import jax
+        jax.monitoring.register_event_duration_secs_listener(self._on_event)
+        self._listener_installed = True
+
+    # -- monitoring path ---------------------------------------------------
+    def _on_event(self, event: str, duration: float, **_kw: Any) -> None:
+        tree = self._tree
+        # the listener survives activate/deactivate cycles (jax has no
+        # public unregister); in fallback mode it must stay silent or a
+        # later re-activation would double-book with the sampler
+        if tree is None or self._mode != "monitoring" \
+                or event != _COMPILE_EVENT:
+            return
+        self.total_compiles += 1
+        self.total_compile_seconds += float(duration)
+        # the whole read-modify-write under the tree lock: the class
+        # contract says the listener may fire from helper threads, and an
+        # unlocked attrs update would race close()'s watermark update
+        with tree._lock:
+            sp = tree.current()
+            if sp is None:
+                return
+            sp.attrs["compiles"] = int(sp.attrs.get("compiles", 0)) + 1
+            sp.attrs["compile_seconds"] = round(
+                float(sp.attrs.get("compile_seconds", 0.0))
+                + float(duration), 4)
+            self.by_program[sp.name] = self.by_program.get(sp.name, 0) + 1
+
+    # -- fallback path (span-boundary sampling) ----------------------------
+    def on_span_open(self, sp: Span) -> None:
+        if self._tree is None or self._mode != "fallback":
+            return
+        sp.attrs["_jit_cache0"] = _fallback_cache_size()
+
+    def on_span_close(self, sp: Span, tree: TraceTree) -> None:
+        if self._tree is not tree or self._mode != "fallback":
+            sp.attrs.pop("_jit_cache0", None)
+            return
+        base = sp.attrs.pop("_jit_cache0", None)
+        if base is None:
+            return
+        delta = _fallback_cache_size() - int(base)
+        # subtract everything already booked in the WHOLE subtree (not
+        # just direct children): compiles of a grandchild are inside this
+        # span's cache-size delta too, and counting them again would
+        # inflate every ancestor of the booking span. The children index
+        # keeps this O(subtree) per close.
+        booked = 0
+        todo = tree.children_of(sp.span_id)
+        while todo:
+            s = todo.pop()
+            booked += int(s.attrs.get("compiles", 0))
+            todo.extend(tree.children_of(s.span_id))
+        own = max(delta - booked, 0)
+        if own:
+            sp.attrs["compiles"] = int(sp.attrs.get("compiles", 0)) + own
+            self.by_program[sp.name] = self.by_program.get(sp.name, 0) + own
+            self.total_compiles += own
+
+
+#: process-wide tracker the collector activates per enable()
+tracker = RecompileTracker()
+
+
+# -- device-memory watermark -------------------------------------------------
+
+def device_memory_attrs() -> Dict[str, Any]:
+    """HBM watermark attrs for the current local devices, or {} when jax is
+    not imported / the backend reports nothing (CPU memory_stats() is
+    None — the ISSUE's None-safety contract). Never initializes a backend:
+    only consults jax when something else already imported it."""
+    jmod = sys.modules.get("jax")
+    if jmod is None:
+        return {}
+    try:
+        stats = [d.memory_stats() for d in jmod.local_devices()]
+    except Exception:
+        return {}
+    in_use = [s.get("bytes_in_use") for s in stats
+              if isinstance(s, dict) and s.get("bytes_in_use") is not None]
+    peak = [s.get("peak_bytes_in_use") for s in stats
+            if isinstance(s, dict)
+            and s.get("peak_bytes_in_use") is not None]
+    out: Dict[str, Any] = {}
+    if in_use:
+        out["hbm_bytes_in_use"] = int(sum(in_use))
+    if peak:
+        out["hbm_peak_bytes"] = int(max(peak))
+    return out
+
+
+# -- streaming event log -----------------------------------------------------
+
+class EventLog:
+    """Append-only JSONL of timestamped run events.
+
+    Each line: {"seq": N, "t": monotonic_seconds, "ts": wall_epoch,
+    "event": type, ...fields}. `t` is non-decreasing and `seq` strictly
+    increasing — the monotonicity contract `trace_report --check`
+    validates. Lines are flushed per event so `tail -f events.jsonl`
+    follows a live run."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._mono0 = time.perf_counter()
+
+    def emit(self, event: str, **fields: Any) -> None:
+        with self._lock:
+            rec = {"seq": self._seq, "t": round(
+                time.perf_counter() - self._mono0, 6),
+                "ts": round(time.time(), 6), "event": event}
+            rec.update(_jsonable(fields))
+            self._seq += 1
+            try:
+                self._f.write(json.dumps(rec, default=str) + "\n")
+                self._f.flush()
+            except (ValueError, OSError):
+                # closed file / full disk / flaky mount: the liveness
+                # side channel must never kill the run it is monitoring
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+# -- Chrome trace_event export -----------------------------------------------
+
+def chrome_trace(tree: TraceTree, app_name: str = "transmogrifai_tpu"
+                 ) -> Dict[str, Any]:
+    """Chrome trace_event JSON (the format Perfetto and chrome://tracing
+    load): one complete ("ph": "X") event per span, microsecond
+    timestamps on the tree's monotonic clock, span/parent ids + attrs in
+    `args` so the hierarchy survives round-trips through the viewer."""
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = [
+        {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+         "args": {"name": app_name}},
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": 1,
+         "args": {"name": "run"}},
+    ]
+    end_default = tree.now()
+    for sp in tree.spans:
+        end = sp.t_end if sp.t_end is not None else end_default
+        args = {"span_id": sp.span_id, "parent_id": sp.parent_id,
+                "error": sp.error}
+        if sp.error_type:
+            args["error_type"] = sp.error_type
+        args.update(_jsonable(sp.attrs))
+        events.append({
+            "ph": "X", "name": sp.name, "cat": sp.kind,
+            "ts": round(sp.t_start * 1e6, 3),
+            "dur": round(max(end - sp.t_start, 0.0) * 1e6, 3),
+            "pid": pid, "tid": 1, "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"app_name": app_name,
+                          "trace_wall_start": tree._wall0}}
+
+
+def write_chrome_trace(path: str, tree: TraceTree,
+                       app_name: str = "transmogrifai_tpu") -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(chrome_trace(tree, app_name), f, indent=1)
+
+
+# -- trace-report ------------------------------------------------------------
+
+def _load_trace_spans(path: str) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """(span dicts from a chrome trace file, schema problems)."""
+    problems: List[str] = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [], [f"{path}: unreadable trace ({e})"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return [], [f"{path}: no traceEvents list"]
+    spans = []
+    ids = set()
+    for i, ev in enumerate(events):
+        ph = ev.get("ph")
+        if ph is None:
+            problems.append(f"{path}: event {i} missing 'ph'")
+            continue
+        if ph != "X":
+            continue
+        missing = [k for k in ("ts", "dur", "pid", "tid") if k not in ev]
+        if missing:
+            problems.append(f"{path}: X event {i} ({ev.get('name')}) "
+                            f"missing {missing}")
+            continue
+        bad_num = [k for k in ("ts", "dur")
+                   if not isinstance(ev[k], (int, float))
+                   or isinstance(ev[k], bool) or ev[k] < 0]
+        if bad_num:
+            # flag AND drop: the containment arithmetic below must never
+            # crash on the malformed input this validator exists to catch
+            problems.append(f"{path}: X event {i} ({ev.get('name')}) "
+                            f"non-numeric {bad_num}")
+            continue
+        args = ev.get("args", {})
+        sid = args.get("span_id")
+        if sid is not None:
+            if sid in ids:
+                problems.append(f"{path}: duplicate span_id {sid}")
+            ids.add(sid)
+        spans.append(ev)
+    # parent integrity: every parent_id must be a recorded span_id
+    for ev in spans:
+        pid_ = ev.get("args", {}).get("parent_id")
+        if pid_ is not None and pid_ not in ids:
+            problems.append(f"{path}: span {ev.get('name')} has unknown "
+                            f"parent_id {pid_}")
+    # containment: a child's [ts, ts+dur] must sit inside its parent's
+    # window (1ms slack for rounding)
+    by_id = {ev["args"].get("span_id"): ev for ev in spans
+             if ev.get("args", {}).get("span_id") is not None}
+    slack_us = 1000.0
+    for ev in spans:
+        pid_ = ev.get("args", {}).get("parent_id")
+        parent = by_id.get(pid_)
+        if parent is None:
+            continue
+        if ev["ts"] + slack_us < parent["ts"] or \
+                ev["ts"] + ev["dur"] > parent["ts"] + parent["dur"] \
+                + slack_us:
+            problems.append(
+                f"{path}: span {ev.get('name')} escapes parent "
+                f"{parent.get('name')} window")
+    return spans, problems
+
+
+def _check_event_log(path: str) -> Tuple[int, List[str], Dict[str, int]]:
+    """(n valid events, schema problems, counts per event type) in ONE
+    pass — report mode reuses the counts instead of re-parsing a log
+    that can run 10^5+ lines on a long sweep."""
+    problems: List[str] = []
+    counts: Dict[str, int] = {}
+    n = 0
+    last_t = None
+    last_seq = None
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                problems.append(f"{path}:{lineno}: invalid JSON")
+                continue
+            n += 1
+            ev_name = rec.get("event", "?")
+            counts[ev_name] = counts.get(ev_name, 0) + 1
+            if "event" not in rec:
+                problems.append(f"{path}:{lineno}: missing 'event'")
+            t = rec.get("t")
+            if not isinstance(t, (int, float)):
+                problems.append(f"{path}:{lineno}: missing numeric 't'")
+            else:
+                # a re-attached log (resumed run) restarts the monotonic
+                # clock; monotonicity is per seq=0 segment
+                seq = rec.get("seq")
+                if last_t is not None and seq != 0 and t < last_t:
+                    problems.append(f"{path}:{lineno}: timestamp went "
+                                    f"backwards ({t} < {last_t})")
+                last_t = t
+            seq = rec.get("seq")
+            if isinstance(seq, int) and isinstance(last_seq, int) \
+                    and seq != 0 and seq <= last_seq:
+                problems.append(f"{path}:{lineno}: seq not increasing")
+            last_seq = seq if isinstance(seq, int) else last_seq
+    return n, problems, counts
+
+
+def _fmt_table(rows: List[List[str]], header: List[str]) -> List[str]:
+    if not rows:
+        return ["(empty)"]
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    out = ["  ".join(str(h).ljust(w) for h, w in zip(header, widths))]
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return out
+
+
+def trace_report(run_dir: str, check: bool = False,
+                 top: int = 15) -> Tuple[str, bool]:
+    """Render (report text, ok) for a traced run directory.
+
+    Reads every `*trace.json` (chrome traces), `events.jsonl` and
+    `*stage_metrics.json` under `run_dir`. With check=True the text is a
+    validation verdict (schema problems listed) and ok=False on any."""
+    trace_files = sorted(_glob.glob(os.path.join(run_dir, "*trace.json")))
+    event_log = os.path.join(run_dir, "events.jsonl")
+    metric_files = sorted(
+        _glob.glob(os.path.join(run_dir, "*stage_metrics.json")))
+    lines: List[str] = []
+    problems: List[str] = []
+
+    if not trace_files and not metric_files and \
+            not os.path.exists(event_log):
+        return (f"trace-report: nothing to read in {run_dir} (no "
+                f"*trace.json, *stage_metrics.json or events.jsonl)", False)
+
+    # span ids restart at 1 in every trace file: key everything by
+    # (file index, id) or a multi-trace dir (the ci.sh smoke layout)
+    # would subtract one file's children from another file's self-time
+    all_spans: List[Tuple[int, Dict[str, Any]]] = []
+    for fidx, tf in enumerate(trace_files):
+        spans, probs = _load_trace_spans(tf)
+        all_spans.extend((fidx, ev) for ev in spans)
+        problems.extend(probs)
+
+    n_events = 0
+    event_counts: Dict[str, int] = {}
+    if os.path.exists(event_log):
+        n_events, probs, event_counts = _check_event_log(event_log)
+        problems.extend(probs)
+
+    for mf in metric_files:
+        try:
+            with open(mf) as f:
+                doc = json.load(f)
+            for key in ("app_name", "duration_seconds",
+                        "total_stage_seconds", "stage_metrics"):
+                if key not in doc:
+                    problems.append(f"{mf}: missing AppMetrics key "
+                                    f"'{key}'")
+        except (OSError, json.JSONDecodeError) as e:
+            problems.append(f"{mf}: unreadable ({e})")
+
+    if check:
+        lines.append(f"trace-report --check: {len(trace_files)} trace "
+                     f"file(s), {n_events} event(s), "
+                     f"{len(metric_files)} metrics file(s)")
+        if problems:
+            lines.append(f"{len(problems)} problem(s):")
+            lines.extend(f"  {p}" for p in problems)
+        else:
+            lines.append("OK")
+        return "\n".join(lines), not problems
+
+    # -- report mode -------------------------------------------------------
+    lines.append(f"# trace-report {run_dir}")
+    if all_spans:
+        # self time = dur - sum(direct children dur)
+        child_dur: Dict[Any, float] = {}
+        for fidx, ev in all_spans:
+            pid_ = ev.get("args", {}).get("parent_id")
+            if pid_ is not None:
+                key = (fidx, pid_)
+                child_dur[key] = child_dur.get(key, 0.0) + ev["dur"]
+        rows = []
+        for fidx, ev in all_spans:
+            sid = (fidx, ev.get("args", {}).get("span_id"))
+            self_us = max(ev["dur"] - child_dur.get(sid, 0.0), 0.0)
+            rows.append((self_us, ev))
+        rows.sort(key=lambda r: -r[0])
+        table = [[ev.get("name", "?")[:48], ev.get("cat", ""),
+                  f"{ev['dur'] / 1e6:.4f}", f"{self_us / 1e6:.4f}",
+                  str(ev.get("args", {}).get("compiles", "")),
+                  "ERR" if ev.get("args", {}).get("error") else ""]
+                 for self_us, ev in rows[:top]]
+        lines.append(f"\n## Top spans by self-time "
+                     f"({len(all_spans)} spans)")
+        lines.extend(_fmt_table(
+            table, ["span", "kind", "total_s", "self_s", "compiles",
+                    "err"]))
+
+        # recompiles per program (span name)
+        comp: Dict[str, Tuple[int, float]] = {}
+        for _, ev in all_spans:
+            args = ev.get("args", {})
+            c = args.get("compiles")
+            if c:
+                n, s = comp.get(ev.get("name", "?"), (0, 0.0))
+                comp[ev.get("name", "?")] = (
+                    n + int(c), s + float(args.get("compile_seconds", 0.0)))
+        lines.append("\n## Recompiles per program")
+        if comp:
+            lines.extend(_fmt_table(
+                [[name[:48], str(n), f"{s:.2f}"]
+                 for name, (n, s) in
+                 sorted(comp.items(), key=lambda kv: -kv[1][0])],
+                ["program", "compiles", "compile_s"]))
+        else:
+            lines.append("(none recorded)")
+
+        # roofline table from kernel spans
+        kern = [ev for _, ev in all_spans if ev.get("cat") == "kernel"]
+        if kern:
+            lines.append("\n## Kernel roofline")
+            lines.extend(_fmt_table(
+                [[ev.get("name", "?")[:40],
+                  f"{ev['dur'] / 1e6:.4f}",
+                  str(ev.get("args", {}).get("bytes_hbm", "")),
+                  str(ev.get("args", {}).get("achieved_gbps", "")),
+                  str(ev.get("args", {}).get("pct_of_roof", "")),
+                  str(ev.get("args", {}).get("cold", ""))]
+                 for ev in kern],
+                ["kernel", "wall_s", "bytes_hbm", "gbps", "pct_roof",
+                 "cold"]))
+
+        # HBM watermark
+        peaks = [ev.get("args", {}).get("hbm_peak_bytes")
+                 for _, ev in all_spans
+                 if ev.get("args", {}).get("hbm_peak_bytes") is not None]
+        if peaks:
+            lines.append(f"\nHBM peak across spans: "
+                         f"{max(peaks) / 1e9:.3f} GB")
+
+    if n_events:
+        counts = event_counts
+        lines.append(f"\n## Event log ({n_events} events)")
+        lines.extend(_fmt_table(
+            [[k, str(v)] for k, v in
+             sorted(counts.items(), key=lambda kv: -kv[1])],
+            ["event", "count"]))
+
+    if problems:
+        lines.append(f"\n## {len(problems)} schema problem(s)")
+        lines.extend(f"  {p}" for p in problems)
+    return "\n".join(lines), not problems

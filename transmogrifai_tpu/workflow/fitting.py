@@ -203,6 +203,7 @@ class LayerRunner:
     def apply_dag(self, ds: Dataset, dag: StagesDAG) -> Dataset:
         """Score path: every stage must already be a transformer (reference
         OpWorkflowCore.applyTransformationsDAG:290)."""
+        from ..utils.metrics import collector
         for layer in dag.layers:
             for st in layer:
                 if isinstance(st, Estimator):
@@ -210,8 +211,11 @@ class LayerRunner:
                         f"DAG contains unfitted estimator {st.stage_name}; "
                         f"train the workflow first")
         sinks = self._plan_sinks(ds, dag)
-        for layer in dag.layers:
-            ds = self.apply_layer(ds, layer, sinks)  # type: ignore[arg-type]
+        for i, layer in enumerate(dag.layers):
+            with collector.trace_span(f"layer_{i}", kind="layer",
+                                      n_stages=len(layer)):
+                ds = self.apply_layer(ds, layer,
+                                      sinks)  # type: ignore[arg-type]
         return ds
 
     def fit_dag(self, ds: Dataset, dag: StagesDAG,
@@ -223,33 +227,35 @@ class LayerRunner:
         already-fitted transformer (Workflow.with_model_stages — reference
         OpWorkflow.withModelStages:457); matching estimators reuse it,
         rewired to this DAG's features, instead of refitting."""
+        from ..utils.metrics import collector
         prefitted = prefitted or {}
         fitted_layers: List[List[Transformer]] = []
-        for layer in dag.layers:
-            fitted: List[Transformer] = []
-            for st in layer:
-                if isinstance(st, Estimator):
-                    prev = prefitted.get(st.uid)
-                    if prev is not None:
-                        # deep-copy before rewiring: the source model's DAG
-                        # still aliases these objects, and mutating their
-                        # input/output wiring would corrupt it
-                        import copy
-                        prev = copy.deepcopy(prev)
-                        prev.set_input(*st.input_features)
-                        prev.set_output_name(st.output_name())
-                        fitted.append(prev)
-                        continue
-                    from ..utils.metrics import collector
-                    ds_in = _ensure_input_columns(ds, st)
-                    with collector.span(st.stage_name, st.uid, "fit",
-                                        n_rows=len(ds_in)):
-                        model = st.fit(ds_in)
-                    fitted.append(model)
-                else:
-                    fitted.append(st)  # type: ignore[arg-type]
-            ds = self.apply_layer(ds, fitted)
-            fitted_layers.append(fitted)
+        for li, layer in enumerate(dag.layers):
+            with collector.trace_span(f"layer_{li}", kind="layer",
+                                      n_stages=len(layer)):
+                fitted: List[Transformer] = []
+                for st in layer:
+                    if isinstance(st, Estimator):
+                        prev = prefitted.get(st.uid)
+                        if prev is not None:
+                            # deep-copy before rewiring: the source model's
+                            # DAG still aliases these objects, and mutating
+                            # their input/output wiring would corrupt it
+                            import copy
+                            prev = copy.deepcopy(prev)
+                            prev.set_input(*st.input_features)
+                            prev.set_output_name(st.output_name())
+                            fitted.append(prev)
+                            continue
+                        ds_in = _ensure_input_columns(ds, st)
+                        with collector.span(st.stage_name, st.uid, "fit",
+                                            n_rows=len(ds_in)):
+                            model = st.fit(ds_in)
+                        fitted.append(model)
+                    else:
+                        fitted.append(st)  # type: ignore[arg-type]
+                ds = self.apply_layer(ds, fitted)
+                fitted_layers.append(fitted)
         return ds, StagesDAG(layers=fitted_layers)  # type: ignore[arg-type]
 
 

@@ -180,9 +180,19 @@ class OpWorkflowRunner:
         if params.collect_stage_metrics and params.metrics_location:
             from ..utils.metrics import collector
             os.makedirs(params.metrics_location, exist_ok=True)
+            # a collection this run JOINED (outer enable) must not be
+            # finished from here: write snapshots, leave the tree open
+            close = getattr(self, "_owns_collection", True)
             collector.save(os.path.join(
                 params.metrics_location,
-                f"{result.run_type.lower()}_stage_metrics.json"))
+                f"{result.run_type.lower()}_stage_metrics.json"),
+                close=close)
+            # the same span tree as a Chrome trace: open in Perfetto
+            # (ui.perfetto.dev) or chrome://tracing; validated by
+            # `python -m transmogrifai_tpu trace-report <dir> --check`
+            collector.save_chrome_trace(os.path.join(
+                params.metrics_location,
+                f"{result.run_type.lower()}_trace.json"), close=close)
         if params.metrics_location:
             os.makedirs(params.metrics_location, exist_ok=True)
             payload = {k: v for k, v in result.__dict__.items()
@@ -200,29 +210,72 @@ class OpWorkflowRunner:
     def run(self, run_type: str, params: Optional[OpParams] = None
             ) -> RunResult:
         params = params or OpParams()
-        if params.collect_stage_metrics:
-            from ..utils.metrics import collector
-            collector.enable(app_name=type(self.workflow).__name__)
-        if params.debug_nans:
-            from ..utils.sanitizers import debug_nans
-            with debug_nans():
-                return self._dispatch(run_type, params)
-        return self._dispatch(run_type, params)
+        from ..utils.metrics import collector
+        # a collection this run STARTS it also ends (finish + disable in
+        # the finally below): without that, a run with no
+        # metrics_location never finishes the collector and the next
+        # run's enable() would join — accumulating spans across runs. A
+        # collection an OUTER caller started is joined and left alone.
+        started_collection = (params.collect_stage_metrics
+                              and not collector.collecting)
+        self._owns_collection = started_collection
+        attached_log = False
+        error: Optional[str] = None
+        # ALL setup inside the try: a failing makedirs/attach after
+        # enable() must still hit the finally, or the half-started
+        # collection would stay open for the rest of the process
+        try:
+            if params.collect_stage_metrics:
+                collector.enable(app_name=type(self.workflow).__name__)
+            if params.metrics_location and not collector.has_event_log:
+                # the streaming event log attaches whenever a metrics dir
+                # is given (independent of span collection): a preempted
+                # multi-hour run stays monitorable by tailing ONE file. A
+                # log the CALLER attached (bench.py BENCH_TRACE_DIR) is
+                # kept — this run's events flow there, it stays open after.
+                os.makedirs(params.metrics_location, exist_ok=True)
+                collector.attach_event_log(
+                    os.path.join(params.metrics_location, "events.jsonl"))
+                attached_log = True
+            collector.event("run_start", run_type=run_type,
+                            app=type(self.workflow).__name__)
+            if params.debug_nans:
+                from ..utils.sanitizers import debug_nans
+                with debug_nans():
+                    return self._dispatch(run_type, params)
+            return self._dispatch(run_type, params)
+        except BaseException as e:
+            error = type(e).__name__
+            raise
+        finally:
+            collector.event("run_end", run_type=run_type,
+                            error=error is not None,
+                            **({"error_type": error} if error else {}))
+            if attached_log:  # never close a log this run did not open
+                collector.detach_event_log()
+            if started_collection:
+                # idempotent when _finish already saved; collector.current
+                # stays readable after the run, and the next enable()
+                # starts fresh instead of appending to this run's tree
+                collector.finish()
+                collector.disable()
 
     def _dispatch(self, run_type: str, params: OpParams) -> RunResult:
+        from ..utils.metrics import collector
         t0 = time.time()
-        if run_type == self.TRAIN:
-            out = self._train(params)
-        elif run_type == self.SCORE:
-            out = self._score(params)
-        elif run_type == self.STREAMING_SCORE:
-            out = self._streaming_score(params)
-        elif run_type == self.FEATURES:
-            out = self._features(params)
-        elif run_type == self.EVALUATE:
-            out = self._evaluate(params)
-        else:
-            raise ValueError(f"Unknown run type: {run_type!r}")
+        with collector.trace_span(run_type, kind="run"):
+            if run_type == self.TRAIN:
+                out = self._train(params)
+            elif run_type == self.SCORE:
+                out = self._score(params)
+            elif run_type == self.STREAMING_SCORE:
+                out = self._streaming_score(params)
+            elif run_type == self.FEATURES:
+                out = self._features(params)
+            elif run_type == self.EVALUATE:
+                out = self._evaluate(params)
+            else:
+                raise ValueError(f"Unknown run type: {run_type!r}")
         out.wall_seconds = time.time() - t0
         return self._finish(out, params)
 

@@ -129,7 +129,9 @@ class Workflow:
 
     # -- training ----------------------------------------------------------
     def train(self) -> "WorkflowModel":
-        with paused_gc():
+        from ..utils.metrics import collector
+        with paused_gc(), collector.trace_span(
+                f"{type(self).__name__}.train", kind="workflow"):
             return self._train()
 
     def _train(self) -> "WorkflowModel":
@@ -306,7 +308,10 @@ class WorkflowModel:
             if self._reader is None:
                 raise ValueError("score needs a dataset or a reader")
             ds = self._reader.generate_dataset(self.raw_features())
-        with paused_gc():
+        from ..utils.metrics import collector
+        with paused_gc(), collector.trace_span(
+                f"{type(self).__name__}.transform", kind="workflow",
+                n_rows=len(ds)):
             return self.runner.apply_dag(ds, self.dag)
 
     def score(self, ds: Optional[Dataset] = None,
