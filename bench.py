@@ -938,6 +938,110 @@ def hist_roofline_bench(n_rows=None):
     return out
 
 
+# -- statistics-engine roofline micro-bench (--stats-roofline) --------------
+
+def stats_roofline_bench(n_rows=None):
+    """Micro-bench for the one-pass statistics engine (ops/stats_engine):
+    analytic bytes-moved and pass counts for the legacy multi-pass
+    SanityChecker statistics vs the fused single scan, plus a MEASURED
+    fused pass with achieved GB/s against the device's HBM roof. Runs on
+    any backend — on CPU the numbers are liveness, not perf claims; the
+    pass-count reduction is backend-independent. One JSON line."""
+    import jax
+    import jax.numpy as jnp
+    from transmogrifai_tpu.ops import stats as S
+    from transmogrifai_tpu.ops import stats_engine as SE
+    from transmogrifai_tpu.utils.metrics import hbm_roof_gbps, \
+        roofline_fields
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    n = int(n_rows) if n_rows else (10_000_000 if on_tpu else 200_000)
+    d, n_classes, n_groups = 64, 4, 8
+    bytes_pass = SE.stats_pass_bytes(n, d)
+    legacy_passes = SE.legacy_pass_count(corr_matrix=True,
+                                         n_groups=n_groups)
+    out = {"metric": "stats_roofline", "backend": backend,
+           "n_rows": n, "n_cols": d, "n_groups": n_groups,
+           "bytes_per_pass": int(bytes_pass),
+           "legacy_passes": int(legacy_passes), "fused_passes": 1,
+           "traffic_reduction_x": float(legacy_passes)}
+
+    key = jax.random.PRNGKey(0)
+    kx, ky = jax.random.split(key)
+    X = jax.random.normal(kx, (n, d), jnp.float32)
+    y = jax.random.randint(ky, (n,), 0, n_classes,
+                           jnp.int32).astype(jnp.float32)
+    distinct = jnp.arange(n_classes, dtype=jnp.float32)
+    clip = jnp.zeros(d, bool)
+    w = jnp.ones(n, jnp.float32)
+
+    def rep_x(r):
+        # a fresh rep-varying matrix defeats executable result caching on
+        # the tunnel; built OUTSIDE the timed window and dropped after
+        # each rep so only TWO [n, d] copies are ever resident (4 at the
+        # 10M TPU shape would hold ~10GB of a 16GB chip)
+        xv = X + float(r)
+        jax.block_until_ready(xv)
+        return xv
+
+    def fused_one(xv):
+        st, _ = SE.fused_stats(xv, y, w, distinct=distinct, clip=clip,
+                               corr_matrix=True)
+        return st
+
+    jax.block_until_ready(fused_one(X))  # warm/compile
+    times = []
+    for r in range(1, 4):
+        xv = rep_x(r)
+        t0 = time.perf_counter()
+        jax.block_until_ready(fused_one(xv))
+        times.append(time.perf_counter() - t0)
+        del xv
+    wall = min(times)
+    roof = hbm_roof_gbps(jax.devices()[0].device_kind) if on_tpu else None
+    rf = roofline_fields(wall, bytes_pass, roof)  # shared arithmetic:
+    # this line must report the same numbers a collector stats_pass span
+    # of the identical pass would
+    out.update(fused_wall_s=round(wall, 4),
+               achieved_gbps=rf["achieved_gbps"])
+    if roof:
+        out.update(hbm_roof_gbps=rf["roof_gbps"],
+                   pct_of_hbm_roof=rf["pct_of_roof"])
+    # the StatsPass telemetry shape (utils/metrics.StatsPass), verbatim,
+    # so BENCH JSON consumers see the same struct a traced run records
+    # next to kernel_roofline
+    out["stats_pass"] = {
+        "driver": "fused", "rows": n, "cols": d,
+        "tiles": -(-n // SE.stats_row_block(d, n)),
+        "bytes_hbm": int(bytes_pass), "wall_seconds": round(wall, 6),
+        "passes": 1}
+
+    # measured legacy route at the same shape: the separate reductions +
+    # one contingency matmul per categorical group (what the pre-engine
+    # SanityChecker dispatched)
+    def legacy_one(xv):
+        outs = [S.col_stats(xv), S.pearson_with_label(xv, y),
+                S.pearson_matrix(xv), S.col_stats(y[:, None])]
+        yoh = (y[:, None] == distinct[None, :]).astype(jnp.float32)
+        for g in range(n_groups):
+            cols = xv[:, 2 * g:2 * g + 2]
+            outs.append(S.contingency_table(cols, yoh))
+        return outs
+
+    jax.block_until_ready(legacy_one(X))
+    times = []
+    for r in range(1, 4):
+        xv = rep_x(r)
+        t0 = time.perf_counter()
+        jax.block_until_ready(legacy_one(xv))
+        times.append(time.perf_counter() - t0)
+        del xv
+    out.update(legacy_wall_s=round(min(times), 4),
+               speedup_x=round(min(times) / max(wall, 1e-9), 2))
+    return out
+
+
 # -- cpu-subprocess phases --------------------------------------------------
 # Tiny example flows and the host-transform-dominated wide bench dispatch
 # hundreds of small programs; over a remote TPU tunnel every dispatch pays
@@ -1023,6 +1127,10 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--hist-roofline":
         print(json.dumps(hist_roofline_bench(
+            sys.argv[2] if len(sys.argv) > 2 else None)))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--stats-roofline":
+        print(json.dumps(stats_roofline_bench(
             sys.argv[2] if len(sys.argv) > 2 else None)))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--tree-sweep":

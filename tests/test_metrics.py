@@ -27,6 +27,25 @@ def test_span_records_only_when_enabled():
     assert "Total:" in app.pretty()
 
 
+def test_stats_pass_records_and_serializes():
+    """collector.stats_pass: one call -> StatsPass record + a
+    stats_pass[<driver>] kernel-roofline twin, both in to_json()."""
+    c = MetricsCollector()
+    assert c.stats_pass("fused", 100, 8, 2, 3200.0, 0.01) is None  # off
+    c.enable("app")
+    rec = c.stats_pass("fused", rows=100, cols=8, tiles=2,
+                       bytes_hbm=3200.0, wall_seconds=0.01, cold=True)
+    assert rec.driver == "fused" and rec.passes == 1
+    app = c.finish()
+    doc = app.to_json()
+    assert doc["stats_metrics"][0]["rows"] == 100
+    assert doc["stats_metrics"][0]["cold"] is True
+    kernels = {k.kernel for k in app.kernel_metrics}
+    assert "stats_pass[fused]" in kernels
+    spans = [s for s in c.trace.spans if s.name == "stats_pass[fused]"]
+    assert len(spans) == 1 and spans[0].attrs["tiles"] == 2
+
+
 def test_workflow_run_collects_stage_metrics(tmp_path):
     rows = [{"x": float(i % 7), "y": float(i % 3)} for i in range(100)]
     fx = FeatureBuilder.Real("x").extract(lambda r: r.get("x")).as_predictor()

@@ -1,0 +1,575 @@
+"""One-pass statistics engine (ops/stats_engine.py): parity vs the legacy
+per-call reductions, driver equivalence (fused / sharded / streamed),
+SanityChecker + RawFeatureFilter + RecordInsightsCorr rewires, and the
+tracing-based pin that a pearson-mode fit makes exactly ONE device pass.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from transmogrifai_tpu.ops import stats as S
+from transmogrifai_tpu.ops import stats_engine as SE
+from transmogrifai_tpu.utils.metrics import collector
+
+
+def _data(seed=0, n=512, d=6, nan_frac=0.15, classes=3):
+    """Shared shape across tests so the engine's jit cache is hit."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    if nan_frac:
+        X[rng.uniform(size=(n, d)) < nan_frac] = np.nan
+    y = rng.integers(0, classes, size=n).astype(np.float32)
+    return X, y, rng
+
+
+def _truth_corr(X, y, w=None):
+    """f64 pairwise-complete weighted Pearson ground truth."""
+    n, d = X.shape
+    w = np.ones(n) if w is None else np.asarray(w, np.float64)
+    out = np.zeros(d)
+    for j in range(d):
+        ok = np.isfinite(X[:, j])
+        xv = X[ok, j].astype(np.float64)
+        yv = y[ok].astype(np.float64)
+        wv = w[ok]
+        cw = wv.sum()
+        if cw <= 0:
+            out[j] = 0.0
+            continue
+        mx = (wv * xv).sum() / cw
+        my = (wv * yv).sum() / cw
+        cov = (wv * (xv - mx) * (yv - my)).sum()
+        den = np.sqrt((wv * (xv - mx) ** 2).sum()
+                      * (wv * (yv - my) ** 2).sum())
+        out[j] = cov / den if den > 0 else 0.0
+    return out
+
+
+class TestEngineParity:
+    def test_col_stats_match_legacy(self):
+        X, y, _ = _data()
+        st = SE.run_stats(X, y)
+        cs = S.col_stats(jnp.asarray(X))
+        np.testing.assert_allclose(st.count, np.asarray(cs.count))
+        np.testing.assert_allclose(st.mean, np.asarray(cs.mean),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(st.variance, np.asarray(cs.variance),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(st.min, np.asarray(cs.min))
+        np.testing.assert_allclose(st.max, np.asarray(cs.max))
+        np.testing.assert_allclose(st.num_non_zeros,
+                                   np.asarray(cs.num_non_zeros))
+        np.testing.assert_allclose(
+            st.fill_rate,
+            np.asarray(S.fill_rate(jnp.asarray(X))), rtol=1e-6, atol=1e-7)
+
+    def test_corr_label_matches_legacy_and_truth(self):
+        X, y, _ = _data(seed=1)
+        st = SE.run_stats(X, y)
+        legacy = np.asarray(S.pearson_with_label(jnp.asarray(X),
+                                                 jnp.asarray(y)))
+        np.testing.assert_allclose(st.corr_label, legacy,
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(st.corr_label, _truth_corr(X, y),
+                                   atol=1e-4)
+
+    def test_weighted_corr_matches_f64_truth(self):
+        # the engine is w-LINEAR (a true weighted correlation); the legacy
+        # kernel folds w into both centered factors (w^2 weighting), so
+        # the oracle here is the f64 truth, not the legacy kernel
+        X, y, rng = _data(seed=2)
+        w = rng.choice([0.5, 1.0, 2.0], size=len(y)).astype(np.float32)
+        st = SE.run_stats(X, y, w)
+        np.testing.assert_allclose(st.corr_label, _truth_corr(X, y, w),
+                                   atol=1e-4)
+
+    def test_large_mean_welford_stability(self):
+        # mean ~1e6, unit variance: the one-pass E[x^2]-mean^2 form loses
+        # EVERYTHING in f32 (legacy col_stats reports ~1e5x the true
+        # variance here); the tile-merged Welford engine stays exact
+        X, y, _ = _data(seed=3, nan_frac=0.0)
+        X[:, 0] += 1e6
+        st = SE.run_stats(X, y)
+        true_var = X[:, 0].astype(np.float64).var(ddof=1)
+        fused_err = abs(st.variance[0] - true_var) / true_var
+        # ~0.3% — the floor set by f32 tile sums of 1e6-mean data; the
+        # legacy one-pass form is off by ORDERS OF MAGNITUDE here
+        assert fused_err < 1e-2
+        legacy_var = float(np.asarray(
+            S.col_stats(jnp.asarray(X)).variance)[0])
+        legacy_err = abs(legacy_var - true_var) / true_var
+        assert legacy_err > 100 * max(fused_err, 1e-6)
+        np.testing.assert_allclose(st.corr_label, _truth_corr(X, y),
+                                   atol=1e-3)
+
+    def test_corr_matrix_matches_legacy(self):
+        X, y, _ = _data(seed=4)
+        st = SE.run_stats(X, y, corr_matrix=True)
+        legacy = np.asarray(S.pearson_matrix(jnp.asarray(X)))
+        np.testing.assert_allclose(st.corr_matrix, legacy,
+                                   rtol=1e-3, atol=2e-4)
+        np.testing.assert_allclose(np.diag(st.corr_matrix),
+                                   np.ones(X.shape[1]), atol=1e-5)
+
+    def test_contingency_matches_legacy(self):
+        X, y, _ = _data(seed=5, nan_frac=0.05)
+        G = (X[:, :3] > 0).astype(np.float32)
+        X2 = np.concatenate([G * 3.0, X[:, 3:]], axis=1)  # multi-hot-ish
+        distinct = np.unique(y)
+        clip = np.array([True, True, True, False, False, False])
+        st = SE.run_stats(X2, y, distinct=distinct, clip=clip)
+        Y = np.zeros((len(y), len(distinct)), np.float32)
+        for j, v in enumerate(distinct):
+            Y[y == v, j] = 1.0
+        want = np.asarray(S.contingency_table(
+            jnp.asarray(np.minimum(X2[:, :3], 1.0)), jnp.asarray(Y)))
+        np.testing.assert_allclose(st.contingency[:3], want,
+                                   rtol=1e-5, atol=1e-3)
+        want_unclipped = np.asarray(S.contingency_table(
+            jnp.asarray(X2[:, 3:]), jnp.asarray(Y)))
+        np.testing.assert_allclose(st.contingency[3:], want_unclipped,
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_contingency_stats_host_matches_jit(self):
+        rng = np.random.default_rng(6)
+        table = rng.integers(1, 60, size=(3, 4)).astype(np.float64)
+        host = S.contingency_stats_host(table)
+        dev = S.contingency_stats(jnp.asarray(table, jnp.float32))
+        assert abs(host.chi2 - float(dev.chi2)) / float(dev.chi2) < 1e-4
+        assert abs(host.cramers_v - float(dev.cramers_v)) < 1e-5
+        assert abs(host.mutual_info - float(dev.mutual_info)) < 1e-5
+        np.testing.assert_allclose(host.max_rule_confidences,
+                                   np.asarray(dev.max_rule_confidences),
+                                   atol=1e-5)
+
+    def test_fused_hist_matches_hist_numeric(self):
+        from transmogrifai_tpu.filters.raw_feature_filter import \
+            _hist_numeric
+        X, y, _ = _data(seed=7)
+        lo = np.nanmin(X, axis=0).astype(np.float32)
+        hi = np.nanmax(X, axis=0).astype(np.float32)
+        st = SE.run_stats(X, y, lo=lo, hi=hi, bins=16)
+        assert st.hist.shape == (X.shape[1], 17)
+        for j in range(X.shape[1]):
+            want = _hist_numeric(X[:, j].astype(np.float64), 16,
+                                 float(lo[j]), float(hi[j]))
+            np.testing.assert_allclose(st.hist[j, :16], want)
+            # missing bin carries the NaN mass
+            assert st.hist[j, 16] == (~np.isfinite(X[:, j])).sum()
+
+    def test_spearman_ranks_match_legacy(self):
+        X, y, _ = _data(seed=8, d=4)
+        rx, ry = SE.rank_matrices(X, y, col_block=3)  # ragged tail
+        st = SE.run_stats(rx, ry)
+        legacy = np.asarray(S.spearman_with_label(jnp.asarray(X),
+                                                  jnp.asarray(y)))
+        np.testing.assert_allclose(st.corr_label, legacy,
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_empty_and_constant_columns(self):
+        X, y, _ = _data(seed=9)
+        X[:, 0] = np.nan          # empty
+        X[:, 1] = 42.0            # constant
+        st = SE.run_stats(X, y)
+        assert st.count[0] == 0
+        assert st.variance[1] == 0.0
+        assert st.corr_label[0] == 0.0 and st.corr_label[1] == 0.0
+        assert st.mean[1] == pytest.approx(42.0)
+        assert st.fill_rate[0] == 0.0
+
+    def test_label_moments(self):
+        X, y, _ = _data(seed=10)
+        st = SE.run_stats(X, y)
+        yd = y.astype(np.float64)
+        assert st.label_count == pytest.approx(len(y))
+        assert st.label_mean == pytest.approx(yd.mean(), abs=1e-5)
+        assert st.label_variance == pytest.approx(yd.var(ddof=1), rel=1e-4)
+        assert st.label_min == yd.min() and st.label_max == yd.max()
+
+    def test_gram_cap_raises(self):
+        with pytest.raises(ValueError):
+            SE.fused_stats(np.zeros((4, SE.GRAM_MAX_D + 1), np.float32),
+                           np.zeros(4, np.float32), corr_matrix=True)
+
+
+class TestDrivers:
+    def test_streamed_matches_fused(self):
+        X, y, rng = _data(seed=11)
+        w = rng.choice([0.5, 1.0], size=len(y)).astype(np.float32)
+        distinct = np.unique(y)
+        fused = SE.run_stats(X, y, w, distinct=distinct, corr_matrix=True)
+        streamed = SE.run_stats(X, y, w, distinct=distinct,
+                                corr_matrix=True, driver="streamed",
+                                tile_rows=100)
+        for f in ("count", "mean", "variance", "min", "max", "corr_label",
+                  "num_non_zeros", "fill_rate"):
+            np.testing.assert_allclose(getattr(streamed, f),
+                                       getattr(fused, f),
+                                       rtol=2e-5, atol=2e-6, err_msg=f)
+        np.testing.assert_allclose(streamed.corr_matrix, fused.corr_matrix,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(streamed.contingency, fused.contingency,
+                                   rtol=1e-5, atol=1e-4)
+        assert streamed.wsum == pytest.approx(fused.wsum, rel=1e-6)
+
+    def test_sharded_matches_fused(self):
+        from transmogrifai_tpu.parallel.mesh import make_mesh
+        X, y, rng = _data(seed=12, n=514)  # ragged vs the 2-way mesh
+        w = rng.choice([0.5, 1.0], size=len(y)).astype(np.float32)
+        mesh = make_mesh(n_batch=2, n_model=1)
+        fused = SE.run_stats(X, y, w, distinct=np.unique(y),
+                             corr_matrix=True)
+        sharded = SE.run_stats(X, y, w, distinct=np.unique(y),
+                               corr_matrix=True, mesh=mesh)
+        for f in ("count", "mean", "variance", "min", "max", "corr_label",
+                  "fill_rate"):
+            np.testing.assert_allclose(getattr(sharded, f),
+                                       getattr(fused, f),
+                                       rtol=3e-4, atol=3e-5, err_msg=f)
+        np.testing.assert_allclose(sharded.corr_matrix, fused.corr_matrix,
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(sharded.contingency, fused.contingency,
+                                   rtol=1e-4, atol=1e-3)
+
+
+class TestSanityCheckerFused:
+    def _fit(self, monkeypatch, fused, **kw):
+        from transmogrifai_tpu.automl import SanityChecker
+        from transmogrifai_tpu.data.dataset import (
+            Column, column_from_values)
+        from transmogrifai_tpu.data.vector import (
+            VectorColumnMetadata, VectorMetadata)
+        from transmogrifai_tpu.types import ColumnKind, RealNN
+
+        monkeypatch.setenv("TMOG_STATS_FUSED", "1" if fused else "0")
+        rng = np.random.default_rng(13)
+        n = 600
+        y = (rng.uniform(size=n) < 0.5).astype(np.float32)
+        cat = np.stack([y, 1 - y], axis=1)   # leaky indicator group
+        X = np.concatenate(
+            [rng.normal(size=(n, 1)), cat,
+             np.full((n, 1), 3.0)], axis=1).astype(np.float32)
+        meta = VectorMetadata(name="features", columns=[
+            VectorColumnMetadata("num", "Real", descriptor_value="v",
+                                 index=0),
+            VectorColumnMetadata("cat", "PickList", grouping="cat",
+                                 indicator_value="A", index=1),
+            VectorColumnMetadata("cat", "PickList", grouping="cat",
+                                 indicator_value="B", index=2),
+            VectorColumnMetadata("const", "Real", descriptor_value="v",
+                                 index=3),
+        ])
+        chk = SanityChecker(remove_bad_features=True, **kw)
+        label = column_from_values(RealNN, [float(v) for v in y])
+        vec = Column(kind=ColumnKind.VECTOR, data=X, metadata=meta)
+        return chk.fit_columns(label, vec)
+
+    def test_fused_matches_legacy_end_to_end(self, monkeypatch):
+        m_fused = self._fit(monkeypatch, fused=True)
+        m_legacy = self._fit(monkeypatch, fused=False)
+        assert m_fused.indices_to_keep == m_legacy.indices_to_keep
+        assert m_fused.summary.dropped == m_legacy.summary.dropped
+        sf = m_fused.summary
+        sl = m_legacy.summary
+        for a, b in zip(sf.column_stats, sl.column_stats):
+            for k in ("count", "mean", "min", "max"):
+                assert a[k] == pytest.approx(b[k], rel=1e-4, abs=1e-5), k
+            assert a["variance"] == pytest.approx(b["variance"],
+                                                  rel=1e-3, abs=1e-5)
+            if a["corr_label"] is not None and b["corr_label"] is not None:
+                assert a["corr_label"] == pytest.approx(
+                    b["corr_label"], rel=1e-3, abs=1e-4)
+        assert len(sf.categorical_stats) == len(sl.categorical_stats) == 1
+        ga, gb = sf.categorical_stats[0], sl.categorical_stats[0]
+        assert ga["cramers_v"] == pytest.approx(gb["cramers_v"], rel=1e-4)
+        assert ga["chi2"] == pytest.approx(gb["chi2"], rel=1e-3)
+        assert ga["mutual_info"] == pytest.approx(gb["mutual_info"],
+                                                  rel=1e-3, abs=1e-5)
+        np.testing.assert_allclose(ga["contingency_matrix"],
+                                   gb["contingency_matrix"], atol=1e-2)
+        # compare the corr matrix on non-degenerate columns only: for the
+        # constant column the legacy path's diagonal is 0/0 noise (tiny
+        # centering residuals over tiny sd), the fused path's is a clean 0
+        live = [0, 1, 2]
+        cmf = np.asarray(sf.correlations_matrix)[np.ix_(live, live)]
+        cml = np.asarray(sl.correlations_matrix)[np.ix_(live, live)]
+        np.testing.assert_allclose(cmf, cml, rtol=1e-3, atol=2e-4)
+        assert sf.label_distribution == sl.label_distribution
+
+    def test_pearson_fit_is_exactly_one_pass(self, monkeypatch):
+        """THE acceptance pin: a pearson-mode fit (moments + label corr +
+        full corr matrix + categorical contingency) lands exactly ONE
+        stats_pass span, and never touches the legacy per-statistic
+        kernels (each monkeypatched to raise)."""
+        def _boom(*a, **k):  # pragma: no cover - failure path
+            raise AssertionError("legacy multi-pass kernel dispatched "
+                                 "under TMOG_STATS_FUSED=1")
+
+        for fn in ("col_stats", "pearson_with_label", "pearson_matrix",
+                   "spearman_with_label", "contingency_table",
+                   "contingency_stats"):
+            monkeypatch.setattr(S, fn, _boom)
+        collector.enable("test_one_pass")
+        try:
+            self._fit(monkeypatch, fused=True)
+            spans = [s for s in collector.trace.spans
+                     if s.name.startswith("stats_pass")]
+            assert len(spans) == 1, [s.name for s in spans]
+            sp = spans[0]
+            assert sp.name == "stats_pass[fused]"
+            assert sp.attrs["passes"] == 1
+            assert sp.attrs["bytes_hbm"] == SE.stats_pass_bytes(600, 4)
+            passes = collector.current.stats_metrics
+            assert len(passes) == 1 and passes[0].driver == "fused"
+        finally:
+            collector.disable()
+            collector.finish()
+
+    def test_legacy_kill_switch_restores_multi_pass(self, monkeypatch):
+        collector.enable("test_kill_switch")
+        try:
+            model = self._fit(monkeypatch, fused=False)
+            spans = [s for s in collector.trace.spans
+                     if s.name.startswith("stats_pass")]
+            assert spans == []
+            assert model.indices_to_keep == [0]
+        finally:
+            collector.disable()
+            collector.finish()
+
+    def test_spearman_fit_passes(self, monkeypatch):
+        """Spearman keeps its rank pre-pass: one moment pass over X plus
+        one over the ranks (still far below the legacy 4+G)."""
+        collector.enable("test_spearman_passes")
+        try:
+            self._fit(monkeypatch, fused=True, correlation_type="spearman")
+            spans = [s for s in collector.trace.spans
+                     if s.name.startswith("stats_pass")]
+            assert len(spans) == 2
+            labels = {s.attrs.get("label") for s in spans}
+            assert labels == {"sanity_stats", "sanity_spearman"}
+        finally:
+            collector.disable()
+            collector.finish()
+
+    def test_spearman_fused_matches_legacy(self, monkeypatch):
+        mf = self._fit(monkeypatch, fused=True,
+                       correlation_type="spearman")
+        ml = self._fit(monkeypatch, fused=False,
+                       correlation_type="spearman")
+        for a, b in zip(mf.summary.column_stats, ml.summary.column_stats):
+            if a["corr_label"] is not None and b["corr_label"] is not None:
+                assert a["corr_label"] == pytest.approx(
+                    b["corr_label"], rel=1e-3, abs=1e-4)
+
+
+class TestRawFeatureFilterFused:
+    def _ds(self, seed=14, n=400):
+        from transmogrifai_tpu import Dataset
+        from transmogrifai_tpu.types import Real
+        rng = np.random.default_rng(seed)
+        a = rng.normal(size=n)
+        a[rng.uniform(size=n) < 0.2] = np.nan
+        b = rng.normal(5, 2, size=n)
+        empty = np.full(n, np.nan)
+        return Dataset.from_features([
+            ("a", Real, list(a)), ("b", Real, list(b)),
+            ("empty", Real, list(empty))])
+
+    def test_batched_matches_legacy(self, monkeypatch):
+        from transmogrifai_tpu.filters import compute_distributions
+        ds = self._ds()
+        monkeypatch.setenv("TMOG_STATS_FUSED", "1")
+        fused = compute_distributions(ds, ["a", "b", "empty"], bins=20)
+        monkeypatch.setenv("TMOG_STATS_FUSED", "0")
+        legacy = compute_distributions(ds, ["a", "b", "empty"], bins=20)
+        assert [d.name for d in fused] == [d.name for d in legacy]
+        for f, l in zip(fused, legacy):
+            assert (f.count, f.nulls) == (l.count, l.nulls)
+            np.testing.assert_allclose(f.distribution, l.distribution,
+                                       atol=1e-6, err_msg=f.name)
+            np.testing.assert_allclose(f.summary, l.summary,
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=f.name)
+
+    def test_pinned_ranges_fuse_into_one_pass(self, monkeypatch):
+        from transmogrifai_tpu.filters import compute_distributions
+        ds = self._ds(seed=15)
+        ranges = {"a": (-3.0, 3.0), "b": (-1.0, 11.0),
+                  "empty": (0.0, 1.0)}
+        monkeypatch.setenv("TMOG_STATS_FUSED", "1")
+        collector.enable("test_rff_fused_hist")
+        try:
+            fused = compute_distributions(ds, ["a", "b", "empty"],
+                                          bins=10, ranges=ranges)
+            passes = [m for m in collector.current.stats_metrics
+                      if m.label == "rff_sketch"]
+            assert len(passes) == 1  # histogram rode the moment pass
+        finally:
+            collector.disable()
+            collector.finish()
+        monkeypatch.setenv("TMOG_STATS_FUSED", "0")
+        legacy = compute_distributions(ds, ["a", "b", "empty"],
+                                       bins=10, ranges=ranges)
+        for f, l in zip(fused, legacy):
+            np.testing.assert_allclose(f.distribution, l.distribution,
+                                       atol=1e-6, err_msg=f.name)
+
+    def test_inf_values_keep_legacy_semantics(self, monkeypatch):
+        """+/-inf is a VALID value (missing == NaN only): counts, sums
+        and ranges must match the per-column legacy path, with inf mass
+        clipped into the histogram edge bins."""
+        from transmogrifai_tpu import Dataset
+        from transmogrifai_tpu.filters import compute_distributions
+        from transmogrifai_tpu.types import Real
+        rng = np.random.default_rng(20)
+        vals = list(rng.normal(size=40))
+        col = vals + [np.inf, np.inf, -np.inf, None, None]
+        ds = Dataset.from_features([
+            ("r", Real, col), ("plain", Real, list(rng.normal(size=45)))])
+        monkeypatch.setenv("TMOG_STATS_FUSED", "1")
+        fused = compute_distributions(ds, ["r", "plain"], bins=8)
+        monkeypatch.setenv("TMOG_STATS_FUSED", "0")
+        legacy = compute_distributions(ds, ["r", "plain"], bins=8)
+        for f, l in zip(fused, legacy):
+            assert (f.count, f.nulls) == (l.count, l.nulls), f.name
+            np.testing.assert_allclose(f.summary, l.summary, rtol=1e-4,
+                                       err_msg=f.name)
+            np.testing.assert_allclose(f.distribution, l.distribution,
+                                       atol=1e-6, err_msg=f.name)
+        r = fused[0]
+        assert r.nulls == 2 and r.count == 45          # inf is not null
+        # mixed +/-inf: the sum degenerates to NaN on BOTH paths (the
+        # parity loop above already pinned it); the point is it is not a
+        # finite number silently missing the infs
+        assert not np.isfinite(r.summary[2])
+
+    def test_corr_matrix_cap_above_gram_limit_falls_back(self,
+                                                         monkeypatch):
+        """max_corr_matrix_columns raised past the engine's Gram cap must
+        compute the matrix on the legacy kernel, not crash the fit."""
+        from transmogrifai_tpu.automl import SanityChecker
+        from transmogrifai_tpu.data.dataset import (
+            Column, column_from_values)
+        from transmogrifai_tpu.types import ColumnKind, RealNN
+        monkeypatch.setenv("TMOG_STATS_FUSED", "1")
+        monkeypatch.setattr(SE, "GRAM_MAX_D", 4)  # shrink for the test
+        rng = np.random.default_rng(21)
+        X = rng.normal(size=(120, 6)).astype(np.float32)
+        y = (rng.uniform(size=120) < 0.5).astype(np.float32)
+        chk = SanityChecker(max_corr_matrix_columns=8)
+        model = chk.fit_columns(
+            column_from_values(RealNN, [float(v) for v in y]),
+            Column(kind=ColumnKind.VECTOR, data=X))
+        cm = np.asarray(model.summary.correlations_matrix)
+        assert cm.shape == (6, 6)
+        np.testing.assert_allclose(np.diag(cm), np.ones(6), atol=1e-5)
+
+    def test_hist_numeric_shares_one_executable(self):
+        from transmogrifai_tpu.filters.raw_feature_filter import \
+            _hist_numeric
+        v = np.random.default_rng(16).normal(size=300)
+        _hist_numeric(v, 12, -1.0, 1.0)
+        cache0 = S.histogram_batched._cache_size()
+        _hist_numeric(v, 12, -2.5, 4.0)       # new ranges: traced, no
+        _hist_numeric(v + 1, 12, 0.0, 2.0)    # retrace
+        assert S.histogram_batched._cache_size() == cache0
+
+
+class TestInsightsCorrFused:
+    def _cols(self, seed=17):
+        from transmogrifai_tpu.data.dataset import Column
+        from transmogrifai_tpu.models.prediction import (
+            make_prediction_column)
+        from transmogrifai_tpu.types import ColumnKind
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(200, 3)).astype(np.float32)
+        score = 1 / (1 + np.exp(-2 * X[:, 1]))
+        pred = make_prediction_column(
+            (score > 0.5).astype(np.float32),
+            np.stack([-score, score], 1), np.stack([1 - score, score], 1))
+        return Column(kind=ColumnKind.VECTOR, data=X), pred
+
+    def test_small_batches_stay_on_numpy(self, monkeypatch):
+        """Transform-time batches vary in shape; below the element
+        threshold the engine (and its per-shape retrace) must not run."""
+        from transmogrifai_tpu.insights import RecordInsightsCorr
+        vec, pred = self._cols()
+        monkeypatch.setenv("TMOG_STATS_FUSED", "1")
+        collector.enable("test_insights_small")
+        try:
+            RecordInsightsCorr(top_k=2).transform_columns(vec, pred)
+            assert collector.current.stats_metrics == []
+        finally:
+            collector.disable()
+            collector.finish()
+
+    def test_fused_matches_legacy(self, monkeypatch):
+        import json
+
+        import transmogrifai_tpu.insights.corr as corr_mod
+        from transmogrifai_tpu.insights import RecordInsightsCorr
+        vec, pred = self._cols()
+        monkeypatch.setattr(corr_mod, "_FUSED_MIN_ELEMENTS", 0)
+        monkeypatch.setenv("TMOG_STATS_FUSED", "1")
+        out_f = RecordInsightsCorr(top_k=3).transform_columns(vec, pred)
+        monkeypatch.setenv("TMOG_STATS_FUSED", "0")
+        out_l = RecordInsightsCorr(top_k=3).transform_columns(vec, pred)
+        for mf, ml in zip(out_f.data, out_l.data):
+            assert set(mf) == set(ml)
+            for k in mf:
+                a, b = json.loads(mf[k]), json.loads(ml[k])
+                assert a["correlation"] == pytest.approx(
+                    b["correlation"], rel=1e-3, abs=1e-4)
+                assert a["contribution"] == pytest.approx(
+                    b["contribution"], rel=1e-3, abs=1e-4)
+
+
+class TestTelemetry:
+    def test_stats_pass_record_and_json(self):
+        collector.enable("test_stats_telemetry")
+        try:
+            X, y, _ = _data(seed=18)
+            SE.run_stats(X, y, driver="streamed", tile_rows=128)
+            rec = collector.current.stats_metrics[-1]
+            assert rec.driver == "streamed"
+            assert rec.rows == len(y) and rec.cols == X.shape[1]
+            assert rec.tiles == -(-len(y) // 128)
+            assert rec.passes == 1
+            assert rec.bytes_hbm == SE.stats_pass_bytes(len(y), X.shape[1])
+            doc = collector.current.to_json()
+            assert "stats_metrics" in doc
+            assert doc["stats_metrics"][-1]["driver"] == "streamed"
+            # the roofline twin rides the kernel table (BENCH JSON slot)
+            assert any(k.kernel == "stats_pass[streamed]"
+                       for k in collector.current.kernel_metrics)
+        finally:
+            collector.disable()
+            collector.finish()
+
+    def test_stats_pass_event_on_log(self, tmp_path):
+        import json
+        log = str(tmp_path / "events.jsonl")
+        collector.enable("test_stats_event")
+        collector.attach_event_log(log)
+        try:
+            X, y, _ = _data(seed=19)
+            SE.run_stats(X, y)
+        finally:
+            collector.detach_event_log()
+            collector.disable()
+            collector.finish()
+        events = [json.loads(l) for l in open(log) if l.strip()]
+        sp = [e for e in events if e["event"] == "stats_pass"]
+        assert len(sp) == 1 and sp[0]["driver"] == "fused"
+
+    def test_appmetrics_json_unchanged_without_stats(self):
+        collector.enable("test_no_stats")
+        try:
+            doc = collector.current.to_json()
+            assert "stats_metrics" not in doc
+        finally:
+            collector.disable()
+            collector.finish()

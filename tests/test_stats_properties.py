@@ -72,6 +72,48 @@ def test_col_stats_weighted():
     assert abs(float(np.asarray(st.max)[0]) - np.nanmax(x)) < 1e-6
 
 
+@pytest.mark.parametrize("seed", range(3))
+def test_histogram_batched_matches_numpy(seed):
+    """The batched all-columns histogram (RawFeatureFilter's numeric fill
+    path) vs np.histogram per column; NaN mass lands in the last bin."""
+    rng = np.random.default_rng(seed)
+    n, K, bins = 500, 4, 16
+    V = rng.normal(size=(n, K))
+    V[rng.uniform(size=(n, K)) < 0.1] = np.nan
+    lo = np.nanmin(V, axis=0)
+    hi = np.nanmax(V, axis=0)
+    got = np.asarray(S.histogram_batched(
+        jnp.asarray(V, jnp.float32), jnp.asarray(lo, jnp.float32),
+        jnp.asarray(hi, jnp.float32), bins))
+    assert got.shape == (K, bins + 1)
+    for k in range(K):
+        ok = np.isfinite(V[:, k])
+        assert got[k, bins] == (~ok).sum()          # missing bin
+        assert got[k, :bins].sum() == ok.sum()      # mass conservation
+        # interior bins match numpy's fixed-range histogram; the engine
+        # clips the top edge INTO the last bin like np.histogram does
+        want, _ = np.histogram(V[ok, k], bins=bins,
+                               range=(float(lo[k]), float(hi[k])))
+        # f32 binning can shift boundary-straddling values by one bin
+        assert np.abs(got[k, :bins] - want).sum() <= 2
+
+
+def test_contingency_stats_host_vs_scipy():
+    rng = np.random.default_rng(11)
+    table = rng.integers(1, 80, size=(4, 3)).astype(np.float64)
+    got = S.contingency_stats_host(table)
+    chi2, _, _, _ = scipy.stats.chi2_contingency(table, correction=False)
+    assert abs(got.chi2 - chi2) / max(chi2, 1.0) < 1e-9
+    k = min(table.shape) - 1
+    assert abs(got.cramers_v
+               - np.sqrt(chi2 / (table.sum() * k))) < 1e-9
+    # rule confidence/support definitions
+    np.testing.assert_allclose(got.max_rule_confidences,
+                               (table / table.sum(1, keepdims=True)).max(1))
+    np.testing.assert_allclose(got.supports,
+                               table.sum(1) / table.sum())
+
+
 def test_js_divergence_properties():
     rng = np.random.default_rng(9)
     p = rng.dirichlet(np.ones(16))

@@ -4,12 +4,19 @@ Reference: core/.../impl/preparators/SanityChecker.scala:236 (fitFn:535,
 reasonsToRemove:783, categoricalTests:420, defaults :721-736) and
 SanityCheckerMetadata.scala.
 
-TPU-first: every statistic is an XLA reduction over the HBM feature matrix —
-column moments and label correlations are one fused pass (ops/stats.col_stats,
-pearson/spearman_with_label), contingency tables are a single one-hot matmul
-(ops/stats.contingency_table replacing the reduceByKey at
-SanityChecker.scala:440). The fitted model is a static index-gather that XLA
-fuses into the downstream program.
+TPU-first: every statistic is an XLA reduction over the HBM feature matrix.
+Since the one-pass statistics engine (ops/stats_engine.py,
+docs/performance.md "One-pass statistics engine") a pearson-mode fit makes
+EXACTLY ONE device pass over X: per-column moments, label correlations, the
+capped feature-feature Pearson matrix, label moments and every categorical
+contingency table (one batched matmul against an on-device one-hot label,
+replacing both the reduceByKey at SanityChecker.scala:440 and the previous
+one-device-round-trip-per-group host loop) all come out of a single
+blocked/jitted scan. Spearman keeps its rank pre-pass, run blocked on
+device, and feeds the ranks through the same moment engine.
+TMOG_STATS_FUSED=0 restores the legacy multi-pass path (ops/stats called
+per statistic). The fitted model is a static index-gather that XLA fuses
+into the downstream program.
 """
 from __future__ import annotations
 
@@ -22,6 +29,7 @@ import numpy as np
 from ..data.dataset import Column, Dataset
 from ..data.vector import VectorColumnMetadata, VectorMetadata
 from ..ops import stats as S
+from ..ops import stats_engine as SE
 from ..stages.base import Estimator, Transformer
 from ..stages.params import Param
 from ..types import ColumnKind, OPVector, RealNN
@@ -278,45 +286,74 @@ class SanityChecker(Estimator):
         columns = (list(meta.columns) if meta is not None
                    else [None] * X.shape[1])
 
-        # -- device reductions: moments + correlations ---------------------
-        Xj = jnp.asarray(X, jnp.float32)
-        yj = jnp.asarray(y, jnp.float32)
-        cs = S.col_stats(Xj)
-        if self.get_param("correlation_type") == "spearman":
-            corr = np.asarray(S.spearman_with_label(Xj, yj))
-        else:
-            corr = np.asarray(S.pearson_with_label(Xj, yj))
-        # full feature-feature matrix (one X^T X matmul) unless the user opts
+        # distinct label domain + per-value counts in ONE host pass over
+        # the label only (np.unique(return_counts) — the previous
+        # (y[:, None] == distinct[None, :]).sum(0) broadcast materialized
+        # an O(n * k) boolean matrix, ~4GB at 10M rows x 100 classes)
+        distinct, distinct_counts = np.unique(y, return_counts=True)
+        cat_param = self.get_param("categorical_label")
+        is_cat = (bool(cat_param) if cat_param is not None
+                  else len(distinct) < min(100.0, n * 0.1))
+
+        # full feature-feature matrix (one X^T X Gram) unless the user opts
         # out (reference featureLabelCorrOnly, SanityChecker.scala:193)
         # cap on columns for which the full d x d matrix is materialized and
         # stored in the summary: beyond this the matrix costs O(d^2) host
         # memory + JSON size for little diagnostic value (the drop logic only
         # needs corr-with-label)
         corr_matrix_cap = int(self.get_param("max_corr_matrix_columns"))
+        want_matrix = (not bool(self.get_param("feature_label_corr_only"))
+                       and self.get_param("correlation_type") == "pearson"
+                       and X.shape[1] <= corr_matrix_cap)
         corr_matrix: Optional[np.ndarray] = None
-        if not bool(self.get_param("feature_label_corr_only")) and \
-                self.get_param("correlation_type") == "pearson" and \
-                X.shape[1] <= corr_matrix_cap:
-            corr_matrix = np.asarray(S.pearson_matrix(Xj))
-        label_cs = S.col_stats(yj[:, None])
-
-        counts = np.asarray(cs.count)
-        means = np.asarray(cs.mean)
-        mins = np.asarray(cs.min)
-        maxs = np.asarray(cs.max)
-        variances = np.asarray(cs.variance)
-
-        # -- categorical contingency tests ---------------------------------
-        distinct = np.unique(y)
-        cat_param = self.get_param("categorical_label")
-        is_cat = (bool(cat_param) if cat_param is not None
-                  else len(distinct) < min(100.0, n * 0.1))
+        do_cat = is_cat and meta is not None and len(distinct) > 1
         group_stats: List[CategoricalGroupStats] = []
         cramers_by_col: Dict[int, float] = {}
         conf_by_col: Dict[int, Tuple[List[float], List[float]]] = {}
-        if is_cat and meta is not None and len(distinct) > 1:
-            group_stats, cramers_by_col, conf_by_col = self._categorical_tests(
-                X, y, columns, names, distinct)
+
+        if SE.fused_enabled():
+            # -- fused route: ONE engine pass over X -----------------------
+            # a raised max_corr_matrix_columns can exceed the engine's Gram
+            # cap; the matrix then computes on the legacy kernel (one extra
+            # pass for that rare config) instead of failing the fit
+            matrix_fused = want_matrix and X.shape[1] <= SE.GRAM_MAX_D
+            (counts, means, mins, maxs, variances, corr, corr_matrix,
+             label_stats_tuple, cont) = self._fused_device_stats(
+                X, y, distinct if do_cat else None, columns, matrix_fused)
+            if want_matrix and not matrix_fused:
+                corr_matrix = np.asarray(
+                    S.pearson_matrix(jnp.asarray(X, jnp.float32)))
+            if do_cat and cont is not None:
+                group_stats, cramers_by_col, conf_by_col = \
+                    self._categorical_from_contingency(
+                        cont, columns, names,
+                        distinct_counts.astype(np.float64))
+        else:
+            # -- legacy multi-pass route (kill switch TMOG_STATS_FUSED=0) --
+            Xj = jnp.asarray(X, jnp.float32)
+            yj = jnp.asarray(y, jnp.float32)
+            cs = S.col_stats(Xj)
+            if self.get_param("correlation_type") == "spearman":
+                corr = np.asarray(S.spearman_with_label(Xj, yj))
+            else:
+                corr = np.asarray(S.pearson_with_label(Xj, yj))
+            if want_matrix:
+                corr_matrix = np.asarray(S.pearson_matrix(Xj))
+            label_cs = S.col_stats(yj[:, None])
+            counts = np.asarray(cs.count)
+            means = np.asarray(cs.mean)
+            mins = np.asarray(cs.min)
+            maxs = np.asarray(cs.max)
+            variances = np.asarray(cs.variance)
+            label_stats_tuple = (
+                float(np.asarray(label_cs.count)[0]),
+                float(np.asarray(label_cs.mean)[0]),
+                float(np.asarray(label_cs.variance)[0]),
+                float(np.asarray(label_cs.min)[0]),
+                float(np.asarray(label_cs.max)[0]))
+            if do_cat:
+                group_stats, cramers_by_col, conf_by_col = \
+                    self._categorical_tests(X, y, columns, names, distinct)
 
         # -- assemble per-column statistics --------------------------------
         col_stats_list: List[ColumnStatistics] = []
@@ -331,13 +368,11 @@ class SanityChecker(Estimator):
                 max_rule_confidences=conf_by_col.get(i, ([], []))[0],
                 supports=conf_by_col.get(i, ([], []))[1],
             ))
+        l_count, l_mean, l_var, l_min, l_max = label_stats_tuple
         label_stats = ColumnStatistics(
             name=self.input_names()[0] if self.input_names() else "label",
-            column=None, is_label=True, count=float(np.asarray(label_cs.count)[0]),
-            mean=float(np.asarray(label_cs.mean)[0]),
-            min=float(np.asarray(label_cs.min)[0]),
-            max=float(np.asarray(label_cs.max)[0]),
-            variance=float(np.asarray(label_cs.variance)[0]))
+            column=None, is_label=True, count=l_count, mean=l_mean,
+            min=l_min, max=l_max, variance=l_var)
 
         # parent-level maxima (reference maxByParent / corrParentMap)
         by_parent_corr: Dict[str, float] = {}
@@ -422,8 +457,7 @@ class SanityChecker(Estimator):
                                  if corr_matrix is not None else None),
             label_distribution=(
                 {"domain": [float(v) for v in distinct],
-                 "counts": [float(c) for c in
-                            (y[:, None] == distinct[None, :]).sum(axis=0)]}
+                 "counts": [float(c) for c in distinct_counts]}
                 if is_cat else None),
             dropped_parents={
                 names[i]: columns[i].parent_feature_name
@@ -434,7 +468,88 @@ class SanityChecker(Estimator):
                                   summary=summary,
                                   operation_name=self.operation_name)
 
-    # -- contingency machinery --------------------------------------------
+    # -- fused one-pass statistics ----------------------------------------
+    @staticmethod
+    def _grouped_columns(columns: Sequence[Optional[VectorColumnMetadata]]
+                         ) -> Dict[str, List[int]]:
+        """Indicator groups: columns carrying both grouping and
+        indicator_value, keyed parent_grouping (reference :420)."""
+        groups: Dict[str, List[int]] = {}
+        for i, c in enumerate(columns):
+            if c is None or c.grouping is None or c.indicator_value is None:
+                continue
+            groups.setdefault(f"{c.parent_feature_name}_{c.grouping}",
+                              []).append(i)
+        return groups
+
+    def _fused_device_stats(self, X, y, distinct, columns, want_matrix):
+        """ONE engine pass: moments + correlations (+ Pearson matrix +
+        batched contingency) for pearson mode; spearman adds the blocked
+        device rank pre-pass and a second moment pass over the ranks."""
+        groups = self._grouped_columns(columns)
+        distinct_dev = distinct if groups else None
+        clip = None
+        if distinct_dev is not None:
+            # MultiPickList parents: multi-hot counts clip to 1 (ref :428).
+            # Group-wise in the reference; per-column here with every
+            # member of an MPL-touched group marked — same result.
+            clip = np.zeros(X.shape[1], bool)
+            for idxs in groups.values():
+                if any(columns[i].parent_feature_type == "MultiPickList"
+                       for i in idxs):
+                    clip[idxs] = True
+            if not clip.any():
+                clip = None
+        st = SE.run_stats(X, y, distinct=distinct_dev, clip=clip,
+                          corr_matrix=want_matrix, label="sanity_stats")
+        if self.get_param("correlation_type") == "spearman":
+            rx, ry = SE.rank_matrices(X, y)
+            corr = SE.run_stats(rx, ry, label="sanity_spearman").corr_label
+        else:
+            corr = st.corr_label
+        label_stats = (st.label_count, st.label_mean, st.label_variance,
+                       st.label_min, st.label_max)
+        return (st.count, st.mean, st.min, st.max, st.variance, corr,
+                st.corr_matrix, label_stats, st.contingency)
+
+    def _categorical_from_contingency(self, cont: np.ndarray,
+                                      columns, names,
+                                      label_totals: np.ndarray):
+        """Per-group contingency statistics off the engine's batched
+        [d, C] table — host numpy on tiny [k, C] slices, zero device
+        round-trips (the legacy path dispatched one contingency matmul
+        PLUS one contingency_stats program per group)."""
+        groups = self._grouped_columns(columns)
+        group_stats: List[CategoricalGroupStats] = []
+        cramers_by_col: Dict[int, float] = {}
+        conf_by_col: Dict[int, Tuple[List[float], List[float]]] = {}
+        for group, idxs in groups.items():
+            table = np.asarray(cont[idxs], np.float64)
+            if len(idxs) == 1:
+                # single indicator: synthesize the complement row (ref :477)
+                table = np.concatenate(
+                    [table, (label_totals - table[0])[None, :]], axis=0)
+            st = S.contingency_stats_host(table)
+            k = len(idxs)
+            confs = [float(v) for v in st.max_rule_confidences[:k]]
+            sups = [float(v) for v in st.supports[:k]]
+            cv = float(st.cramers_v)
+            for j, i in enumerate(idxs):
+                cramers_by_col[i] = cv
+                conf_by_col[i] = ([confs[j]], [sups[j]])
+            group_stats.append(CategoricalGroupStats(
+                group=group,
+                categorical_features=[names[i] for i in idxs],
+                contingency_matrix=[[float(v) for v in row]
+                                    for row in table],
+                cramers_v=cv, chi2=float(st.chi2),
+                mutual_info=float(st.mutual_info),
+                pointwise_mutual_info=[[float(v) for v in row]
+                                       for row in st.pointwise_mutual_info],
+                max_rule_confidences=confs, supports=sups))
+        return group_stats, cramers_by_col, conf_by_col
+
+    # -- contingency machinery (legacy multi-pass path) -------------------
     def _categorical_tests(self, X: np.ndarray, y: np.ndarray,
                            columns: Sequence[Optional[VectorColumnMetadata]],
                            names: Sequence[str], distinct: np.ndarray):
@@ -444,13 +559,10 @@ class SanityChecker(Estimator):
         Y = np.zeros((len(y), len(distinct)), np.float32)
         Y[np.arange(len(y)), [label_idx[float(v)] for v in y]] = 1.0
 
-        # group columns with both grouping and indicator_value
-        groups: Dict[str, List[int]] = {}
-        for i, c in enumerate(columns):
-            if c is None or c.grouping is None or c.indicator_value is None:
-                continue
-            groups.setdefault(f"{c.parent_feature_name}_{c.grouping}",
-                              []).append(i)
+        # one grouping rule for both routes: the fused path's contingency
+        # slicing must select exactly these groups or the kill switch
+        # silently changes results
+        groups = self._grouped_columns(columns)
 
         group_stats: List[CategoricalGroupStats] = []
         cramers_by_col: Dict[int, float] = {}

@@ -7,11 +7,17 @@ getFeaturesToExclude:441, generateFilteredRaw:482), FeatureDistribution.scala:58
 The reference computes per-feature distributions with an RDD treeAggregate on
 the training and scoring readers, then drops raw features whose fill rate is
 too low, whose train/score fill rates or histogram distributions diverge, or
-whose null-pattern leaks the label. Here the numeric histogram pass is one
-jitted XLA reduction over the stacked numeric columns (digitize + one-hot
-matmul histogram — MXU-friendly, psum-ready under row sharding); text/list/
-map values hash into the same fixed bin space on host (reference
-textBinsFormula:581 hashes text into bins the same way).
+whose null-pattern leaks the label. Since the one-pass statistics engine
+(ops/stats_engine.py) ALL numeric columns sketch together: one engine pass
+over the stacked numeric matrix yields counts/nulls/min/max/sums, and one
+jitted batched histogram reduction (ops/stats.histogram_batched — static
+`bins`, traced per-feature ranges, so nothing ever retraces) bins every
+column at once; when every range is already pinned (the scoring reader, via
+the train-side Summary) the histograms FUSE into the engine pass itself and
+the whole numeric sketch is a single program. TMOG_STATS_FUSED=0 restores
+the per-column path. Text/list/map values hash into the same fixed bin
+space on host (reference textBinsFormula:581 hashes text into bins the
+same way).
 
 Dropped features are *nulled in place* (column of all-missing) rather than
 removed, keeping every downstream stage's input arity and the compiled
@@ -94,15 +100,21 @@ class FeatureDistribution:
 
 def _hist_numeric(values: np.ndarray, bins: int,
                   lo: float, hi: float) -> np.ndarray:
-    """Fixed-range histogram of one numeric column (NaN = missing)."""
+    """Fixed-range histogram of one numeric column (NaN = missing).
+
+    Routed through the jitted batched kernel with a single-column matrix:
+    `bins` is the only static argument and lo/hi are traced, so repeated
+    calls (one per numeric feature on the legacy path) share ONE
+    executable — the un-jitted predecessor re-dispatched a fresh program
+    every call."""
     import jax.numpy as jnp
-    v = jnp.asarray(values, jnp.float32)
-    ok = ~jnp.isnan(v)
-    span = max(hi - lo, EPS)
-    idx = jnp.clip(((v - lo) / span * bins).astype(jnp.int32), 0, bins - 1)
-    idx = jnp.where(ok, idx, bins)  # NaNs into an overflow bucket
-    h = jnp.zeros(bins + 1, jnp.float32).at[idx].add(1.0)
-    return np.asarray(h[:bins], np.float64)
+
+    from ..ops.stats import histogram_batched
+    h = histogram_batched(
+        jnp.asarray(np.asarray(values, np.float32)[:, None]),
+        jnp.asarray([lo], jnp.float32), jnp.asarray([hi], jnp.float32),
+        bins)
+    return np.asarray(h[0, :bins], np.float64)
 
 
 def _dist_numeric(name: str, data: np.ndarray, bins: int,
@@ -122,6 +134,91 @@ def _dist_numeric(name: str, data: np.ndarray, bins: int,
     hist = _hist_numeric(data, bins, lo, hi)
     return FeatureDistribution(name, None, n, nulls, hist.tolist(),
                                [lo, hi, float(valid.sum()), float(len(valid))])
+
+
+def _numeric_distributions_batched(items, bins: int,
+                                   ranges) -> List[FeatureDistribution]:
+    """Sketch EVERY numeric column through the one-pass engine.
+
+    One engine pass over the stacked [n, K] f32 matrix gives counts/
+    nulls/min/max/sums for all K columns; histogram ranges come from the
+    provided train-side Summary where present, else from that same pass's
+    min/max. When every range is pinned up front the histograms ride the
+    engine pass itself (ONE program); otherwise one extra
+    histogram_batched dispatch bins all columns together. Either way:
+    K un-jitted per-column programs -> <= 2 jitted ones.
+
+    Missing means NaN only (FeatureDistribution convention): the engine
+    masks on isfinite, so the rare +/-inf-bearing columns get their
+    count/sum/range corrected on host to the legacy semantics (inf is a
+    valid value; sums/ranges go infinite, histogram mass clips into the
+    edge bins)."""
+    from ..ops import stats_engine as SE
+    from ..ops.stats import histogram_batched
+    import jax.numpy as jnp
+
+    names = [nm for nm, col in items]
+    # stack straight to f32: the f64 per-column copies are only needed by
+    # the per-column legacy fallback, and a transient f64 stack would
+    # triple peak host memory at the 10M-row shape
+    V = np.stack([np.asarray(col.data, np.float32) for _, col in items],
+                 axis=1)
+    n = V.shape[0]
+    has_inf = bool(np.isinf(V).any()) if n else False
+    provided = [ranges.get(nm) for nm in names]
+    all_pinned = all(r is not None for r in provided)
+    if all_pinned and n and not has_inf:
+        lo = np.asarray([r[0] for r in provided], np.float32)
+        hi = np.asarray([r[1] for r in provided], np.float32)
+        st = SE.run_stats(V, np.zeros(n, np.float32), lo=lo, hi=hi,
+                          bins=bins, label="rff_sketch")
+        hist = st.hist
+    else:
+        st = (SE.run_stats(V, np.zeros(n, np.float32),
+                           label="rff_sketch") if n else None)
+        lo = np.asarray(
+            [r[0] if r is not None else
+             (st.min[k] if st is not None and st.count[k] > 0 else 0.0)
+             for k, r in enumerate(provided)], np.float32)
+        hi = np.asarray(
+            [r[1] if r is not None else
+             (st.max[k] if st is not None and st.count[k] > 0 else 0.0)
+             for k, r in enumerate(provided)], np.float32)
+        hist = None  # binned below, after any inf range corrections
+
+    counts = st.count.copy() if st is not None else np.zeros(len(names))
+    sums = (st.mean * st.count if st is not None
+            else np.zeros(len(names)))
+    los, his = lo.astype(np.float64), hi.astype(np.float64)
+    if has_inf and st is not None:
+        # legacy semantics for inf-bearing columns (valid, not missing):
+        # corrected BEFORE binning so the histogram sees the same ranges
+        # the per-column path would
+        for k in np.flatnonzero(np.isinf(V).any(axis=0)):
+            col = V[:, k].astype(np.float64)
+            valid = col[~np.isnan(col)]
+            counts[k] = len(valid)
+            sums[k] = valid.sum() if len(valid) else 0.0
+            if provided[k] is None and len(valid):
+                los[k], his[k] = valid.min(), valid.max()
+    if hist is None:
+        hist = (np.asarray(histogram_batched(
+            jnp.asarray(V), jnp.asarray(los.astype(np.float32)),
+            jnp.asarray(his.astype(np.float32)), bins))
+            if n else np.zeros((len(names), bins + 1)))
+
+    out = []
+    for k, nm in enumerate(names):
+        cnt = int(counts[k])
+        if cnt == 0:
+            out.append(FeatureDistribution(nm, None, n, n, [0.0] * bins,
+                                           [0.0, 0.0, 0.0, 0.0]))
+            continue
+        out.append(FeatureDistribution(
+            nm, None, n, n - cnt,
+            [float(v) for v in hist[k, :bins]],
+            [float(los[k]), float(his[k]), float(sums[k]), float(cnt)]))
+    return out
 
 
 def _hash_bin(value: Any, bins: int) -> int:
@@ -196,16 +293,29 @@ def compute_distributions(ds: Dataset, names: Sequence[str], bins: int,
     """Sketch every named raw column (reference computeFeatureStats).
 
     `ranges` pins per-feature histogram bounds (pass the train-side summary
-    bounds when sketching scoring data)."""
+    bounds when sketching scoring data). Numeric columns sketch TOGETHER
+    through the one-pass engine (<= 2 jitted programs for all of them);
+    TMOG_STATS_FUSED=0 restores the per-column path."""
+    from ..ops import stats_engine as SE
+
+    numeric_items = []
+    for name in names:
+        if name in ds and ds.column(name).kind in _NUMERIC_KINDS:
+            numeric_items.append((name, ds.column(name)))
+    by_name: Dict[str, FeatureDistribution] = {}
+    if numeric_items and SE.fused_enabled():
+        by_name = {d.name: d for d in _numeric_distributions_batched(
+            numeric_items, bins, ranges or {})}
+
     out: List[FeatureDistribution] = []
     for name in names:
         if name not in ds:
             continue
         col = ds.column(name)
         if col.kind in _NUMERIC_KINDS:
-            out.append(_dist_numeric(name, np.asarray(col.data, np.float64),
-                                     bins,
-                                     (ranges or {}).get(name)))
+            out.append(by_name.get(name) or _dist_numeric(
+                name, np.asarray(col.data, np.float64), bins,
+                (ranges or {}).get(name)))
         elif col.kind == ColumnKind.MAP:
             out.extend(_map_key_distributions(name, col.data, bins))
             # whole-map sketch for feature-level fill decisions

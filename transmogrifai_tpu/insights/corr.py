@@ -6,8 +6,13 @@ scored batch; each record's insight is the correlation-weighted, centered
 feature value (columns that both correlate with the score and deviate from
 their mean on this record rank highest).
 
-The whole computation is two matrix reductions (means + cross-moments) —
-one fused XLA pass over the batch, no row loop.
+The whole computation is ONE pass of the one-pass statistics engine
+(ops/stats_engine.py) over the scored batch — the column means/deviations
+and the score cross-moments that used to be two separate matrix reductions
+come out of the same blocked scan (corr_label with the score as the
+"label", population sd from the returned M2). TMOG_STATS_FUSED=0 restores
+the two-reduction numpy path. The per-record contribution assembly is
+O(n * d) output construction either way.
 """
 from __future__ import annotations
 
@@ -21,6 +26,14 @@ from ..stages.base import Transformer
 from ..types import OPVector, Prediction, TextMap
 
 EPS = 1e-12
+
+# Elements below which the scored batch stays on the numpy reductions:
+# transform-time batches vary in shape (ragged last batch, per-request
+# serving), and the engine's jitted scan bakes the row count into its
+# trace — a retrace per new shape plus a host->device round-trip costs
+# more than two vectorized numpy passes until the matrix is big enough
+# to be bandwidth-bound.
+_FUSED_MIN_ELEMENTS = 1 << 20
 
 
 class RecordInsightsCorr(Transformer):
@@ -62,17 +75,29 @@ class RecordInsightsCorr(Transformer):
         return data[:, 0]
 
     def transform_columns(self, *cols: Column) -> Column:
+        from ..ops import stats_engine as SE
+
         vec, pred = cols
         X = np.asarray(vec.data, np.float64)          # [n, d]
         s = self._scores(pred)                        # [n]
         n, d = X.shape
         names = (vec.metadata.column_names() if vec.metadata is not None
                  else [f"f{j}" for j in range(d)])
-        mu = X.mean(axis=0)
-        sd = X.std(axis=0) + EPS
-        s_c = s - s.mean()
-        corr = ((X - mu) * s_c[:, None]).sum(axis=0) / (
-            n * sd * (s.std() + EPS))
+        if SE.fused_enabled() and X.size >= _FUSED_MIN_ELEMENTS:
+            # means + score cross-moments in ONE engine pass; population
+            # sd reconstructed from the returned M2 (the legacy path's
+            # np.std convention)
+            st = SE.run_stats(X, s, label="corr_insights")
+            mu = st.mean
+            sd = np.sqrt(np.maximum(st.m2 / np.maximum(st.count, 1.0),
+                                    0.0)) + EPS
+            corr = st.corr_label
+        else:
+            mu = X.mean(axis=0)
+            sd = X.std(axis=0) + EPS
+            s_c = s - s.mean()
+            corr = ((X - mu) * s_c[:, None]).sum(axis=0) / (
+                n * sd * (s.std() + EPS))
         contrib = corr[None, :] * (X - mu) / sd       # [n, d]
         k = min(self.top_k, d)
         vals: List[Dict[str, str]] = []

@@ -307,40 +307,11 @@ def _newton_prox_update(B, b0, gA, hA, g0A, h0A, wsum_l, l1, l2, eye,
     return B_new, b0_new, delta
 
 
-def _shard_vary(tree, axis_name):
-    """Under shard_map's varying-manual-axes tracking the scan carry
-    becomes batch-varying inside the body; the initial zeros must carry
-    the same type. pcast is the current spelling; pvary the deprecated
-    one on older jax."""
-    if axis_name is None:
-        return tree
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(tree, axis_name, to="varying")
-    if hasattr(jax.lax, "pvary"):
-        return jax.lax.pvary(tree, axis_name)
-    return tree
-
-
-def _build_shard_map(core, mesh, in_specs, out_specs):
-    """shard_map with the version shims every sharded sweep route needs:
-    import location (jax >= 0.8 top-level), and replication checking off —
-    jax 0.4.x shard_map has no replication rule for `while` (the
-    accumulate() psums make every carry replicated by construction);
-    jax >= 0.6 renamed the knob check_rep -> check_vma."""
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
-    import inspect as _inspect
-    sig = _inspect.signature(shard_map)
-    if "check_rep" in sig.parameters:
-        extra = {"check_rep": False}
-    elif "check_vma" in sig.parameters:
-        extra = {"check_vma": False}
-    else:
-        extra = {}
-    return shard_map(core, mesh=mesh, in_specs=in_specs,
-                     out_specs=out_specs, **extra)
+# shard_map construction + carry-vary shims live in parallel/mesh.py since
+# the one-pass stats engine (ops/stats_engine.py) shares them; the private
+# names stay importable for existing callers
+from ..parallel.mesh import build_shard_map as _build_shard_map  # noqa: E402
+from ..parallel.mesh import shard_vary as _shard_vary  # noqa: E402
 
 
 def _psum_moments(X, w, allreduce):

@@ -210,6 +210,44 @@ def contingency_stats(table: jax.Array) -> ContingencyStats:
                             max_rule_confidences=max_conf, supports=support)
 
 
+def contingency_stats_host(table) -> ContingencyStats:
+    """Numpy twin of `contingency_stats` for HOST-resident tables.
+
+    The fused statistics engine (ops/stats_engine.py) returns ALL
+    categorical contingency tables from its single device pass; the
+    per-group chi2/Cramer's V/MI/rule-confidence derivations then run on
+    [k, c]-shaped host tables — dispatching the jitted twin per group
+    would reintroduce exactly the one-round-trip-per-group pattern the
+    engine removes. Same formulas and EPS guards; f64 because it is host
+    numpy on tiny tables."""
+    import numpy as _np
+    # tmoglint: disable=TPU003  host precision on tiny [k, c] tables
+    t = _np.asarray(table, dtype=_np.float64)
+    total = max(float(t.sum()), EPS)
+    rows = t.sum(axis=1)
+    cols = t.sum(axis=0)
+    expected = rows[:, None] * cols[None, :] / total
+    chi2 = float(_np.where(expected > 0, (t - expected) ** 2
+                           / _np.maximum(expected, EPS), 0.0).sum())
+    k = int((rows > 0).sum())
+    c = int((cols > 0).sum())
+    dof = max(min(k - 1, c - 1), 1)
+    cramers_v = float(_np.sqrt(chi2 / (total * dof)))
+    p = t / total
+    px = rows / total
+    py = cols / total
+    pxy_ind = px[:, None] * py[None, :]
+    pmi = _np.where((p > 0) & (pxy_ind > 0),
+                    _np.log(_np.maximum(p, EPS)
+                            / _np.maximum(pxy_ind, EPS)), 0.0)
+    mi = float(_np.where(p > 0, p * pmi, 0.0).sum())
+    conf = t / _np.maximum(rows[:, None], EPS)
+    return ContingencyStats(
+        chi2=chi2, cramers_v=cramers_v, mutual_info=mi,
+        pointwise_mutual_info=pmi, max_rule_confidences=conf.max(axis=1),
+        supports=rows / total)
+
+
 @jax.jit
 def fill_rate(X: jax.Array, w: Optional[jax.Array] = None) -> jax.Array:
     """Fraction of non-missing entries per column (RawFeatureFilter
@@ -235,6 +273,49 @@ def js_divergence(p: jax.Array, q: jax.Array) -> jax.Array:
                                              jnp.maximum(b, EPS)), 0.0).sum(axis=-1)
 
     return 0.5 * kl(p, m) + 0.5 * kl(q, m)
+
+
+def hist_bin_ids(V: jax.Array, lo: jax.Array, hi: jax.Array, bins: int,
+                 ok: jax.Array) -> jax.Array:
+    """Flattened column-offset histogram segment ids for a [n, K] matrix:
+    column k's value lands in segment k*(bins+1) + bin, invalid entries
+    (ok False) in the trailing missing segment. THE binning rule shared
+    by `histogram_batched` (NaN-only missing) and the fused statistics
+    engine's in-pass histograms (finite-only), so the two can never drift
+    in clip semantics. The float-space clip runs BEFORE the int cast so
+    +/-inf clips into the edge bins instead of hitting an undefined
+    float->int conversion."""
+    span = jnp.maximum(hi - lo, EPS)
+    scaled = (jnp.where(ok, V, 0.0) - lo[None, :]) / span[None, :] * bins
+    idx = jnp.clip(scaled, 0.0, float(bins - 1)).astype(jnp.int32)
+    idx = jnp.where(ok, idx, bins)
+    K = V.shape[1]
+    return jnp.arange(K, dtype=jnp.int32)[None, :] * (bins + 1) + idx
+
+
+@functools.partial(jax.jit, static_argnames=("bins",))
+def histogram_batched(V: jax.Array, lo: jax.Array, hi: jax.Array,
+                      bins: int, w: Optional[jax.Array] = None
+                      ) -> jax.Array:
+    """Fixed-range histograms of EVERY column at once: [n, K] -> [K,
+    bins + 1], last bin = missing (NaN) mass. One jitted program for all
+    of RawFeatureFilter's numeric fills (the previous per-column helper
+    dispatched an un-jitted program per column); `lo`/`hi` are traced
+    [K] vectors, so per-feature ranges never retrace, and `bins` is the
+    only static. Binning via the flattened column-offset segment ids of
+    ops/pallas_hist._hist_segment_jnp (histogram-as-GEMM's jnp twin)."""
+    V = jnp.asarray(V)
+    n, K = V.shape
+    if w is None:
+        w = jnp.ones((n,), jnp.float32)
+    # missing == NaN only (the FeatureDistribution convention): +/-inf are
+    # VALID values and clip into the edge bins, exactly like the original
+    # per-column helper
+    ids = hist_bin_ids(V, lo, hi, bins, ~jnp.isnan(V))
+    wt = jnp.broadcast_to(w[:, None], (n, K))
+    return jax.ops.segment_sum(
+        wt.reshape(-1), ids.reshape(-1),
+        num_segments=K * (bins + 1)).reshape(K, bins + 1)
 
 
 @functools.partial(jax.jit, static_argnames=("bins",))

@@ -27,6 +27,43 @@ MODEL_AXIS = "model"
 _active_mesh: Optional[Mesh] = None
 
 
+def shard_vary(tree, axis_name):
+    """Under shard_map's varying-manual-axes tracking a scan carry becomes
+    batch-varying inside the body; the initial zeros must carry the same
+    type. pcast is the current spelling; pvary the deprecated one on older
+    jax. Shared by every sharded streaming kernel (GLM sweep, stats
+    engine) so the version shims live in one place."""
+    if axis_name is None:
+        return tree
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(tree, axis_name, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(tree, axis_name)
+    return tree
+
+
+def build_shard_map(core, mesh, in_specs, out_specs):
+    """shard_map with the version shims every sharded streaming route
+    needs: import location (jax >= 0.8 top-level), and replication
+    checking off — jax 0.4.x shard_map has no replication rule for
+    `while` (accumulator psums make every carry replicated by
+    construction); jax >= 0.6 renamed the knob check_rep -> check_vma."""
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+    import inspect as _inspect
+    sig = _inspect.signature(shard_map)
+    if "check_rep" in sig.parameters:
+        extra = {"check_rep": False}
+    elif "check_vma" in sig.parameters:
+        extra = {"check_vma": False}
+    else:
+        extra = {}
+    return shard_map(core, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **extra)
+
+
 def make_mesh(n_batch: Optional[int] = None, n_model: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
     """Create a (batch, model) mesh over available devices."""

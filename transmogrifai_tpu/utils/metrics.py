@@ -136,6 +136,32 @@ class SweepConvergence:
 
 
 @dataclass
+class StatsPass:
+    """One pass of the one-pass statistics engine (ops/stats_engine.py).
+
+    `passes` is the number of logical reads of X the driver performed
+    (1 by construction — the engine exists so the SanityChecker's
+    pre-model statistics stop costing 4+G passes); `tiles` the scan/tile
+    count inside that read; `bytes_hbm` the analytic traffic
+    (stats_pass_bytes). The wall is fenced with block_until_ready, so a
+    companion kernel-roofline span named stats_pass[<driver>] carries
+    the achieved-GB/s attribution next to the sweep kernels."""
+
+    driver: str             # 'fused' | 'sharded' | 'streamed'
+    rows: int
+    cols: int
+    tiles: int
+    bytes_hbm: float
+    wall_seconds: float
+    passes: int = 1
+    label: str = "stats"
+    cold: Optional[bool] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
+@dataclass
 class AppMetrics:
     """Whole-run metrics (reference AppMetrics)."""
 
@@ -145,6 +171,7 @@ class AppMetrics:
     stage_metrics: List[StageMetric] = field(default_factory=list)
     kernel_metrics: List[KernelRoofline] = field(default_factory=list)
     sweep_metrics: List[SweepConvergence] = field(default_factory=list)
+    stats_metrics: List[StatsPass] = field(default_factory=list)
 
     @property
     def duration_seconds(self) -> float:
@@ -164,6 +191,9 @@ class AppMetrics:
         if self.sweep_metrics:
             out["sweep_metrics"] = [m.to_json()
                                     for m in self.sweep_metrics]
+        if self.stats_metrics:
+            out["stats_metrics"] = [m.to_json()
+                                    for m in self.stats_metrics]
         return out
 
     def pretty(self) -> str:
@@ -367,6 +397,38 @@ class MetricsCollector:
         self.current.sweep_metrics.append(rec)
         self.trace.add_complete(
             f"{family}:{kernel}", "sweep", 0.0, **rec.to_json())
+        return rec
+
+    def stats_pass(self, driver: str, rows: int, cols: int, tiles: int,
+                   bytes_hbm: float, wall_seconds: float,
+                   cold: Optional[bool] = None, passes: int = 1,
+                   label: str = "stats") -> Optional[StatsPass]:
+        """Record one statistics-engine pass (no-op unless enabled).
+
+        Three artifacts from one call, so every consumer sees the same
+        numbers: a StatsPass telemetry record (rides AppMetrics JSON as
+        "stats_metrics" and attaches under the innermost open span — the
+        SanityChecker fit stage when the workflow is traced), a
+        kernel-roofline span named stats_pass[<driver>] (bytes/roofline
+        attribution in the trace's kernel table and BENCH JSON's
+        kernel_roofline list), and a `stats_pass` event on the streaming
+        event log."""
+        if not self.enabled:
+            return None
+        rec = StatsPass(driver=driver, rows=int(rows), cols=int(cols),
+                        tiles=int(tiles), bytes_hbm=float(bytes_hbm),
+                        wall_seconds=round(wall_seconds, 6),
+                        passes=int(passes), label=label, cold=cold)
+        self.current.stats_metrics.append(rec)
+        self.kernel(f"stats_pass[{driver}]", wall_seconds, bytes_hbm,
+                    cold=cold, attrs={"rows": int(rows), "cols": int(cols),
+                                      "tiles": int(tiles),
+                                      "passes": int(passes),
+                                      "label": label})
+        self.event("stats_pass", driver=driver, rows=int(rows),
+                   cols=int(cols), tiles=int(tiles), passes=int(passes),
+                   bytes_hbm=float(bytes_hbm),
+                   wall_seconds=round(wall_seconds, 6), label=label)
         return rec
 
     def save(self, path: str, close: bool = True) -> None:
