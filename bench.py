@@ -1042,6 +1042,197 @@ def stats_roofline_bench(n_rows=None):
     return out
 
 
+# -- streaming data plane scenario (--streaming) ----------------------------
+
+def streaming_bench(n_rows=None):
+    """Scenario config for the tileplane streaming data plane
+    (docs/performance.md "Streaming data plane"): an Avro file on disk is
+    the ONLY copy of X; the bench streams it through every consumer —
+    stats fit, GLM round fit, quantile binning + binned-matrix emission,
+    and bulk scoring through a fitted workflow — reporting rows/s per
+    phase plus the measured copy/compute overlap ratio, so the bench
+    trajectory tracks this path like the flagship sweep. One JSON line;
+    on CPU the numbers are liveness, not perf claims."""
+    import shutil
+    import tempfile
+
+    import jax
+    from transmogrifai_tpu.ops import glm_sweep as GS
+    from transmogrifai_tpu.ops import stats_engine as SE
+    from transmogrifai_tpu.ops import trees as TR
+    from transmogrifai_tpu.parallel import tileplane as TP
+    from transmogrifai_tpu.readers.avro import read_avro_file, \
+        write_avro_file
+    from transmogrifai_tpu.utils.metrics import collector
+
+    backend = jax.default_backend()
+    n = int(n_rows) if n_rows else (2_000_000 if backend == "tpu"
+                                    else 50_000)
+    d, F = 16, 3
+    out = {"metric": "streaming_plane", "backend": backend,
+           "n_rows": n, "n_cols": d, "tile_mb": TP.tile_budget_bytes() >> 20}
+
+    tmp = tempfile.mkdtemp(prefix="bench_stream_")
+    try:
+        rng = np.random.default_rng(0)
+        beta = rng.normal(size=d)
+        schema = {"type": "record", "name": "Row", "fields": (
+            [{"name": f"x{j}", "type": "float"} for j in range(d)]
+            + [{"name": "y", "type": "float"},
+               {"name": "id", "type": "long"}])}
+        t0 = time.perf_counter()
+        # written in SLABS of separate container files so the writer
+        # holds at most one slab of records — the Avro directory really
+        # is the only full copy of X
+        slab = 250_000
+        paths = []
+        i = 0
+        while i < n:
+            rows = min(slab, n - i)
+            recs = []
+            for r_i in range(i, i + rows):
+                x = rng.normal(size=d).astype(np.float32)
+                recs.append({**{f"x{j}": float(x[j]) for j in range(d)},
+                             "y": float(x @ beta > 0), "id": r_i})
+            p = os.path.join(tmp, f"rows_{len(paths):04d}.avro")
+            write_avro_file(p, schema, recs)
+            paths.append(p)
+            del recs
+            i += rows
+        out["write_s"] = round(time.perf_counter() - t0, 2)
+        out["slabs"] = len(paths)
+
+        def read_all():
+            for p in paths:
+                yield from read_avro_file(p)
+
+        def stats_row(r):
+            return ([r[f"x{j}"] for j in range(d)], r["y"], 1.0)
+
+        def glm_row(r):
+            m = [1.0] * F
+            m[r["id"] % F] = 0.0
+            return ([r[f"x{j}"] for j in range(d)], r["y"], 1.0, m)
+
+        def src(fn):
+            return TP.reader_row_source(read_all, fn,
+                                        batch_records=8192, n_rows=n)
+
+        # timed phases run UNTRACED: tracing inserts per-tile
+        # block_until_ready fences the production path does not pay
+        # (docs/observability.md "Tile spans"), so traced rows/s would
+        # systematically understate the async pipeline
+        t0 = time.perf_counter()
+        SE.run_stats(src(stats_row), corr_matrix=True, label="bench")
+        wall = time.perf_counter() - t0
+        ps = SE._last_stream_stats
+        out["stats_fit"] = {
+            "wall_s": round(wall, 3),
+            "rows_per_s": round(n / max(wall, 1e-9))}
+        if ps is not None:  # None on the TMOG_TILEPLANE=0 legacy loop
+            out["stats_fit"].update(tiles=ps.tiles,
+                                    peak_host_rows=ps.peak_host_rows)
+
+        t0 = time.perf_counter()
+        _, _, info = GS.sweep_glm_streamed_rounds(
+            src(glm_row), None, None, None,
+            np.asarray([0.01, 0.1], np.float32),
+            np.zeros(2, np.float32), loss="logistic", max_iter=15,
+            tol=1e-5, warm_start=True)
+        # the round driver returns HOST numpy coefficients — every
+        # streamed pass already fenced on its delta fetch
+        wall = time.perf_counter() - t0  # tmoglint: disable=TPU005
+        out["glm_fit"] = {
+            "wall_s": round(wall, 3),
+            "data_passes": info["data_passes"],
+            "rows_per_s_effective": round(
+                n * max(info["data_passes"], 1) / max(wall, 1e-9)),
+            "rounds": info["glm_rounds"]}
+
+        t0 = time.perf_counter()
+        edges = TR.stream_quantile_edges(src(stats_row), 32,
+                                         hist_bins=512)
+        # stats source yields (x, y, w); binning reads x only
+        xonly = TP.IterSource(
+            lambda: ((c[0],) for c in src(stats_row).chunks()),
+            n_rows=n)
+        binned = TR.stream_bin_matrix(xonly, edges)
+        wall = time.perf_counter() - t0
+        out["tree_binning"] = {
+            "wall_s": round(wall, 3),
+            "rows_per_s": round(n / max(wall, 1e-9)),
+            "binned_mb": round(binned.nbytes / (1 << 20), 1)}
+        del binned
+
+        # separate TRACED probe pass just for the overlap ratio (its
+        # wall is not the headline number)
+        collector.enable("bench_streaming")
+        try:
+            SE.run_stats(src(stats_row), corr_matrix=True,
+                         label="overlap_probe")
+            ps = SE._last_stream_stats
+            if ps is not None and ps.wall_seconds:
+                out["overlap_probe"] = {
+                    "overlap_ratio": round(
+                        (ps.copy_seconds + ps.compute_seconds)
+                        / max(ps.wall_seconds, 1e-9), 3),
+                    "copy_s": round(ps.copy_seconds, 3),
+                    "compute_s": round(ps.compute_seconds, 3),
+                    "wall_s": round(ps.wall_seconds, 3)}
+        finally:
+            collector.finish()
+            collector.disable()
+
+        out["score"] = _streaming_score_phase(
+            os.path.join(tmp, "rows_*.avro"), paths[0], d, n)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def _streaming_score_phase(avro_pattern, train_path, d, n):
+    """Train a tiny transmogrified workflow, then bulk-score the Avro
+    stream through the tileplane scoring path (fixed record tiles,
+    producer-thread Dataset assembly)."""
+    import contextlib
+    import io as _io
+
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    from transmogrifai_tpu.models.glm import OpLogisticRegression
+    from transmogrifai_tpu.readers import AvroStreamingReader, score_stream
+    from transmogrifai_tpu.readers.avro import read_avro_file
+    from transmogrifai_tpu.readers.readers import ListReader
+    from transmogrifai_tpu.stages.params import param_grid
+    from transmogrifai_tpu.workflow import Workflow
+
+    train_rows = []
+    for r in read_avro_file(train_path):
+        train_rows.append(r)
+        if len(train_rows) >= 5000:
+            break
+    preds = [FeatureBuilder.Real(f"x{j}").extract(
+        lambda r, j=j: r.get(f"x{j}")).as_predictor() for j in range(d)]
+    fy = FeatureBuilder.RealNN("y").extract(
+        lambda r: r.get("y")).as_response()
+    vec = transmogrify(preds)
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[(OpLogisticRegression(),
+                                param_grid(reg_param=[0.01]))],
+    ).set_input(fy, vec).get_output()
+    with contextlib.redirect_stdout(_io.StringIO()):
+        model = Workflow().set_reader(ListReader(train_rows)) \
+            .set_result_features(pred).train()
+    reader = AvroStreamingReader(avro_pattern)
+    t0 = time.perf_counter()
+    scored = sum(len(b) for b in score_stream(model, reader,
+                                              tile_rows=4096))
+    wall = time.perf_counter() - t0
+    return {"wall_s": round(wall, 3), "rows_scored": int(scored),
+            "rows_per_s": round(scored / max(wall, 1e-9))}
+
+
 # -- cpu-subprocess phases --------------------------------------------------
 # Tiny example flows and the host-transform-dominated wide bench dispatch
 # hundreds of small programs; over a remote TPU tunnel every dispatch pays
@@ -1131,6 +1322,10 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--stats-roofline":
         print(json.dumps(stats_roofline_bench(
+            sys.argv[2] if len(sys.argv) > 2 else None)))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--streaming":
+        print(json.dumps(streaming_bench(
             sys.argv[2] if len(sys.argv) > 2 else None)))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--tree-sweep":

@@ -155,6 +155,132 @@ collector.detach_event_log()
 collector.disable()
 print("stats engine smoke ok: sharded+streamed parity, 1-pass fit")
 PY
+# streaming data plane smoke (docs/performance.md "Streaming data plane"):
+# an Avro file is the ONLY copy of X — tileplane stats fit (sharded tile
+# lane on the 2-device CPU mesh) + streamed GLM fit + streamed score, with
+# the bounded-host-buffer and overlap claims checked from the artifacts
+PYTHONPATH="$PWD" python - "$TRACE_DIR" <<'PY'
+import sys
+
+out = sys.argv[1]
+from transmogrifai_tpu.utils.platform import force_cpu
+
+force_cpu(2)
+import os
+import tempfile
+
+import numpy as np
+import jax.numpy as jnp
+
+from transmogrifai_tpu.ops import glm_sweep as GS
+from transmogrifai_tpu.ops import stats_engine as SE
+from transmogrifai_tpu.parallel import tileplane as TP
+from transmogrifai_tpu.parallel.mesh import make_mesh
+from transmogrifai_tpu.readers.avro import read_avro_file, write_avro_file
+from transmogrifai_tpu.utils.metrics import collector
+
+collector.enable("ci_streaming")
+collector.attach_event_log(out + "/events.jsonl")
+
+n, d, F = 6000, 8, 2
+rng = np.random.default_rng(0)
+X = rng.normal(size=(n, d)).astype(np.float32)
+beta = rng.normal(size=d)
+y = (X @ beta > 0).astype(np.float32)
+tmp = tempfile.mkdtemp(prefix="ci_stream_")
+path = os.path.join(tmp, "rows.avro")
+schema = {"type": "record", "name": "Row", "fields": (
+    [{"name": f"x{j}", "type": "float"} for j in range(d)]
+    + [{"name": "y", "type": "float"}, {"name": "id", "type": "long"}])}
+write_avro_file(path, schema, [
+    {**{f"x{j}": float(X[i, j]) for j in range(d)},
+     "y": float(y[i]), "id": i} for i in range(n)])
+
+
+def src(fn):
+    return TP.reader_row_source(lambda: read_avro_file(path), fn,
+                                batch_records=512, n_rows=n)
+
+
+fused = SE.run_stats(X, y, corr_matrix=True, label="ci_resident")
+# Avro-served fit, sharded tile lane on the 2-device mesh
+res = SE.run_stats(
+    src(lambda r: ([r[f"x{j}"] for j in range(d)], r["y"], 1.0)),
+    corr_matrix=True, tile_rows=1000, mesh=make_mesh(n_batch=2),
+    label="ci_tileplane")
+np.testing.assert_allclose(res.mean, fused.mean, rtol=2e-4, atol=2e-5)
+np.testing.assert_allclose(res.corr_matrix, fused.corr_matrix,
+                           rtol=2e-3, atol=2e-4)
+ps = SE._last_stream_stats
+assert ps.rows == n and ps.peak_host_rows <= 2 * ps.tile_rows, \
+    (ps.rows, ps.peak_host_rows, ps.tile_rows)
+
+# streamed GLM fit from the same file
+mask = np.stack([(np.arange(n) % F != k).astype(np.float32)
+                 for k in range(F)])
+regs = np.asarray([0.05], np.float32)
+B_src, _, info = GS.sweep_glm_streamed_rounds(
+    src(lambda r: ([r[f"x{j}"] for j in range(d)], r["y"], 1.0,
+                   [float(r["id"] % F != k) for k in range(F)])),
+    None, None, None, regs, np.zeros(1, np.float32), loss="logistic",
+    max_iter=10, tol=1e-6, warm_start=False)
+B_dev, _, _ = GS.sweep_glm_streamed_rounds(
+    jnp.asarray(X), jnp.asarray(y), jnp.ones(n, jnp.float32),
+    jnp.asarray(mask), regs, np.zeros(1, np.float32), loss="logistic",
+    max_iter=10, tol=1e-6, warm_start=False)
+assert info["driver"] == "tileplane"
+np.testing.assert_allclose(B_src, B_dev, rtol=5e-3, atol=7e-4)
+
+# compute-heavy traced pass: the per-tile tile_copy/tile_compute spans
+# whose OVERLAP the post-export check below asserts
+Xb = rng.normal(size=(16000, 96)).astype(np.float32)
+
+
+def gram_step(carry, xt):
+    import jax
+    g = jnp.matmul(xt.T, xt, preferred_element_type=jnp.float32)
+    return carry + jnp.matmul(g, g, preferred_element_type=jnp.float32)
+
+
+import jax
+TP.run_tileplane(TP.ArraySource(Xb, chunk_rows=2000),
+                 jax.jit(gram_step), jnp.zeros((96, 96), jnp.float32),
+                 tile_rows=2000, label="ci_overlap")
+
+# streamed score through the tileplane scoring path
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.readers import AvroStreamingReader, score_stream
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.workflow import Workflow
+
+rows = [{**{f"x{j}": float(X[i, j]) for j in range(d)}, "y": float(y[i])}
+        for i in range(1500)]
+preds = [FeatureBuilder.Real(f"x{j}").extract(
+    lambda r, j=j: r.get(f"x{j}")).as_predictor() for j in range(d)]
+fy = FeatureBuilder.RealNN("y").extract(lambda r: r.get("y")).as_response()
+pred = BinaryClassificationModelSelector.with_train_validation_split(
+    models_and_parameters=[(OpLogisticRegression(),
+                            param_grid(reg_param=[0.01]))],
+).set_input(fy, transmogrify(preds)).get_output()
+model = Workflow().set_reader(ListReader(rows)) \
+    .set_result_features(pred).train()
+scored = sum(len(b) for b in score_stream(model, AvroStreamingReader(path),
+                                          tile_rows=1024))
+assert scored == n, scored
+
+collector.save(out + "/stream_stage_metrics.json")
+collector.save_chrome_trace(out + "/stream_trace.json")
+collector.detach_event_log()
+collector.disable()
+import shutil
+shutil.rmtree(tmp, ignore_errors=True)
+print("streaming smoke ok: avro fit parity, bounded host buffer, "
+      f"{scored} rows scored")
+PY
 PYTHONPATH="$PWD" python -m transmogrifai_tpu trace-report "$TRACE_DIR" --check
 # the stats_pass spans must be visible to trace tooling (not just the
 # in-process assert above): grep the exported chrome trace
@@ -168,6 +294,32 @@ names = [ev.get("name", "") for ev in doc["traceEvents"]]
 n = sum(1 for nm in names if nm.startswith("stats_pass"))
 assert n >= 4, f"expected >=4 stats_pass spans in the trace, saw {n}"
 print(f"trace stats_pass spans ok ({n})")
+PY
+# double-buffering, checked from the ARTIFACT: tile_copy spans for later
+# tiles must overlap tile_compute spans for earlier ones in the exported
+# trace of the compute-heavy pass (docs/observability.md "Tile spans")
+python - "$TRACE_DIR" <<'PY'
+import json
+import sys
+
+with open(sys.argv[1] + "/stream_trace.json") as f:
+    doc = json.load(f)
+evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"
+       and e.get("args", {}).get("label") == "ci_overlap"]
+
+
+def spans(name):
+    return [(e["ts"], e["ts"] + e["dur"], e["args"]["tile"])
+            for e in evs if e["name"] == name]
+
+
+copies, computes = spans("tile_copy"), spans("tile_compute")
+assert len(copies) == 8 and len(computes) == 8, (len(copies),
+                                                 len(computes))
+overlap = any(ct > mt and cs < me and ms < ce
+              for cs, ce, ct in copies for ms, me, mt in computes)
+assert overlap, "no tile_copy overlapped an earlier tile_compute"
+print("tileplane copy/compute overlap ok")
 PY
 rm -rf "$TRACE_DIR"
 

@@ -779,6 +779,105 @@ def _sharded_round_fn(mesh, loss, fit_intercept):
     return jax.jit(sm)
 
 
+# -- tileplane source route (X streamed from disk, never resident) -----------
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _source_prep_step(carry, xt, yt, wt, mt):
+    """Streamed prep-pass step: global-weight column moments via an exact
+    Chan tile merge (one-pass raw E[x^2] would cancel catastrophically in
+    f32 for large-mean columns — same rationale as _psum_moments'
+    two-pass form, restated per tile) plus the per-fold weight sums. The
+    carry is donated: one device-resident accumulator for the pass."""
+    cnt, mean, m2, wsum_f = carry
+    xf = xt.astype(jnp.float32)
+    c_t = wt.sum()
+    safe = jnp.maximum(c_t, EPS)
+    mean_t = (xf * wt[:, None]).sum(0) / safe
+    m2_t = (((xf - mean_t[None, :]) ** 2) * wt[:, None]).sum(0)
+    n = cnt + c_t
+    nsafe = jnp.maximum(n, EPS)
+    delta = mean_t - mean
+    return (n, mean + delta * (c_t / nsafe),
+            m2 + m2_t + delta * delta * (cnt * c_t / nsafe),
+            wsum_f + (mt * wt[:, None]).sum(0))
+
+
+@functools.partial(jax.jit, static_argnames=("loss",), donate_argnums=(0,))
+def _source_round_step(carry, xt, yt, wt, mt, B, b0, sel, mean, std, *,
+                       loss: str):
+    """One fixed-shape tile's contribution to the round accumulators
+    (g [Lb, d_work], Hessian blocks, intercept sums) — the per-tile slice
+    of _round_core.accumulate's scan body, standardizing on the fly.
+    B/b0/sel/mean/std are per-PASS constants (mean/std column-padded to
+    d_work by the driver); the donated carry is the pass's only
+    accumulator. mt is [c, F] row-major (the natural source layout)."""
+    rc = _residual_curvature(loss)
+    d_work = mean.shape[0]
+    Lb = B.shape[0]
+    tiled, _, bt, tile_pairs = _tiling(d_work)
+    if d_work > xt.shape[1]:
+        xt = jnp.pad(xt, ((0, 0), (0, d_work - xt.shape[1])))
+    hess_blocks, _, _ = _gram_fns(tiled, d_work, Lb, bt, tile_pairs)
+    gA, hA, g0A, h0A = carry
+    Bt = B.T.astype(xt.dtype)
+
+    c = min(_ROW_BLOCK_WIDE if tiled else _row_block(d_work), xt.shape[0])
+    nb = -(-xt.shape[0] // c)
+    pad = nb * c - xt.shape[0]
+    if pad:
+        xt = jnp.pad(xt, ((0, pad), (0, 0)))
+        yt = jnp.pad(yt, (0, pad))
+        wt = jnp.pad(wt, (0, pad))
+        mt = jnp.pad(mt, ((0, pad), (0, 0)))
+    xs = (xt.reshape(nb, c, d_work), yt.reshape(nb, c), wt.reshape(nb, c),
+          mt.reshape(nb, c, mt.shape[1]))
+
+    def body(acc, sl):
+        x_blk, y_blk, w_blk, m_blk = sl
+        gA, hA, g0A, h0A = acc
+        xs_low = ((x_blk.astype(jnp.float32) - mean[None, :])
+                  / std[None, :]).astype(x_blk.dtype)
+        eta = jnp.matmul(xs_low, Bt,
+                         preferred_element_type=jnp.float32) + b0[None, :]
+        r0, s0 = rc(eta, y_blk)                         # [c, Lb]
+        wlf = m_blk * w_blk[:, None]                    # [c, F]
+        wl = jnp.matmul(wlf, sel,
+                        preferred_element_type=jnp.float32)  # [c, Lb]
+        R = r0 * wl
+        S = s0 * wl
+        xf = xs_low.astype(jnp.float32)
+        gA = gA + jnp.matmul(xf.T, R,
+                             preferred_element_type=jnp.float32).T
+        hA = hA + hess_blocks(xf, S)
+        return (gA, hA, g0A + R.sum(0), h0A + S.sum(0)), None
+
+    (gA, hA, g0A, h0A), _ = jax.lax.scan(body, (gA, hA, g0A, h0A), xs)
+    return gA, hA, g0A, h0A
+
+
+@functools.partial(jax.jit, static_argnames=("fit_intercept",))
+def _source_round_update(gA, hA, g0A, h0A, B, b0, wsum_l, l1, l2, *,
+                         fit_intercept: bool):
+    """The Newton/prox/intercept update from one streamed pass's merged
+    accumulators — the SAME _newton_prox_update as every other route, so
+    the streamed-source sweep cannot drift from the resident kernels.
+    Returns (B_new, b0_new, delta [Lb])."""
+    d_work = B.shape[1]
+    Lb = B.shape[0]
+    tiled, _, bt, tile_pairs = _tiling(d_work)
+    _, assemble, _ = _gram_fns(tiled, d_work, Lb, bt, tile_pairs)
+    eye = jnp.eye(d_work, dtype=jnp.float32)
+    return _newton_prox_update(B, b0, gA, hA, g0A, h0A, wsum_l, l1, l2,
+                               eye, assemble, fit_intercept)
+
+
+def _source_round_acc0(Lb: int, d_work: int):
+    tiled, _, bt, tile_pairs = _tiling(d_work)
+    _, _, h_acc0 = _gram_fns(tiled, d_work, Lb, bt, tile_pairs)
+    return (jnp.zeros((Lb, d_work), jnp.float32), h_acc0,
+            jnp.zeros(Lb, jnp.float32), jnp.zeros(Lb, jnp.float32))
+
+
 def _new_round_state(L: int, d: int) -> Dict[str, Any]:
     return {"B": np.zeros((L, d), np.float32),
             "b0": np.zeros(L, np.float32),
@@ -814,7 +913,14 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
     their optimum instead of at zero; TMOG_GLM_WARMSTART=0 disables.
 
     X/y/w/fold_masks are device arrays (pre-sharded when `mesh` is given,
-    exactly like sweep_glm_streamed_sharded's contract). `state`/`on_round`
+    exactly like sweep_glm_streamed_sharded's contract) — OR X is a
+    `parallel.tileplane.RowSource` whose chunks yield
+    (x [c, d], y [c], w [c], fold_masks [c, F]) with y/w/fold_masks
+    passed as None: then every data pass (the standardization prep pass
+    and each Newton iteration of each round) streams tiles from the
+    source through the double-buffered tileplane — X is never resident,
+    so the sweep runs at data sizes no HBM holds, re-reading disk once
+    per iteration. `state`/`on_round`
     are the round-granular checkpoint hooks
     (automl/tuning/checkpoint.RoundCheckpoint): `on_round(state)` fires
     after every retirement boundary with the full resumable state dict,
@@ -823,12 +929,27 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
     Returns (B [F, G, d] f32 RAW units, b0 [F, G], info) where info holds
     the convergence telemetry (glm_rounds, data_passes, lane_passes,
     lanes_retired, active_per_round, iters_per_round, bucket_sizes)."""
+    from ..parallel import tileplane as TP
+
     regs = np.asarray(regs, np.float32)
     alphas = np.asarray(alphas, np.float32)
-    F = int(fold_masks.shape[0])
+    src_mode = isinstance(X, TP.RowSource)
+    if src_mode:
+        if mesh is not None:
+            raise ValueError("mesh and RowSource are exclusive: a source "
+                             "sweep streams tiles to the default device")
+        if any(a is not None for a in (y, w, fold_masks)):
+            raise ValueError("with a RowSource, y/w/fold_masks ride the "
+                             "source chunks — pass them as None")
+        probe = X.peek()
+        d = int(probe[0].shape[1])
+        F = int(probe[3].shape[1])
+        tile_rows = TP.tile_rows_for(4 * (d + F + 2), X.n_rows)
+    else:
+        F = int(fold_masks.shape[0])
+        d = int(X.shape[1])
     Gn = int(regs.shape[0])
     L = F * Gn
-    d = int(X.shape[1])
     K = int(round_iters if round_iters is not None
             else os.environ.get("TMOG_GLM_ROUND_ITERS",
                                 str(ROUND_ITERS_DEFAULT)))
@@ -836,7 +957,30 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
     max_iter = int(max_iter)
     tol_f = float(tol)
 
-    if standardize:
+    wsum_f_h = None
+    if src_mode:
+        # ONE streamed prep pass: exact Chan column moments + per-fold
+        # weight sums (the resident path computes these per round from
+        # the resident fold masks; here they are pass-invariant, so
+        # hoisting them costs a single extra read of the stream)
+        d_work = _tiling(d)[1]
+        prep0 = (jnp.asarray(0.0, jnp.float32), jnp.zeros(d, jnp.float32),
+                 jnp.zeros(d, jnp.float32), jnp.zeros(F, jnp.float32))
+        (cnt, mu, m2, wsum_f_dev), _ = TP.run_tileplane(
+            X, _source_prep_step, prep0, tile_rows=tile_rows,
+            label="glm_prep")
+        # host-side fold weight sums; device tiles stay f32
+        wsum_f_h = np.maximum(np.asarray(
+            wsum_f_dev, np.float64), EPS)  # tmoglint: disable=TPU003  host-only
+        if standardize:
+            var = jnp.maximum(m2 / jnp.maximum(cnt, EPS), EPS)
+            mean = jnp.pad(mu, (0, d_work - d))
+            std = jnp.pad(jnp.sqrt(var), (0, d_work - d),
+                          constant_values=1.0)
+        else:
+            mean = jnp.zeros(d_work, jnp.float32)
+            std = jnp.ones(d_work, jnp.float32)
+    elif standardize:
         if mesh is None:
             mean, std = glm_standardize_stats(X, w)
         else:
@@ -856,6 +1000,39 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
     # the recompile tracker's attribution unit for round programs
     from ..utils.metrics import collector as _collector
 
+    def _run_source_round(sel, l1b, l2b, B0, b00, budget):
+        """One retirement round for a compacted bucket, each Newton
+        iteration = one double-buffered streamed pass over the source
+        (accumulate) + one tiny jitted update, with the same
+        per-iteration early exit as the resident while_loop's cond."""
+        d_work = int(mean.shape[0])
+        Lb = sel.shape[1]
+        wsum_l = jnp.asarray(np.maximum(
+            (wsum_f_h[:, None] * sel).sum(0), EPS).astype(np.float32))
+        sel_j = jnp.asarray(sel)
+        l1j = jnp.asarray(l1b)
+        l2j = jnp.asarray(l2b)
+        B = jnp.asarray(np.pad(B0, ((0, 0), (0, d_work - d))))
+        b0j = jnp.asarray(b00)
+        it = 0
+        delta = np.full(Lb, np.inf, np.float32)
+        for _ in range(int(budget)):
+            def step(carry, xt, yt, wt, mt, B=B, b0j=b0j):
+                return _source_round_step(carry, xt, yt, wt, mt, B, b0j,
+                                          sel_j, mean, std, loss=loss)
+
+            (gA, hA, g0A, h0A), _ps = TP.run_tileplane(
+                X, step, _source_round_acc0(Lb, d_work),
+                tile_rows=tile_rows, label="glm_round")
+            B, b0j, delta_dev = _source_round_update(
+                gA, hA, g0A, h0A, B, b0j, wsum_l, l1j, l2j,
+                fit_intercept=bool(fit_intercept))
+            it += 1
+            delta = np.asarray(delta_dev)  # [Lb]: the round's only fetch
+            if float(delta.max()) <= tol_f:
+                break
+        return np.asarray(B)[:, :d], np.asarray(b0j), delta, it
+
     def run_round(idx, budget):
         k = len(idx)
         Lb = bucket_lanes(k)
@@ -874,16 +1051,21 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
             B0[:k] = st["B"][idx]
             b00 = np.zeros(Lb, np.float32)
             b00[:k] = st["b0"][idx]
-            args = (X, y, w, fold_masks, jnp.asarray(sel), jnp.asarray(l1b),
-                    jnp.asarray(l2b), jnp.asarray(B0), jnp.asarray(b00),
-                    mean, std, jnp.asarray(budget, jnp.int32),
-                    jnp.asarray(tol_f, jnp.float32))
-            if mesh is None:
-                Bb, b0b, db, it = sweep_glm_round(
-                    *args, loss=loss, fit_intercept=fit_intercept)
+            if src_mode:
+                Bb, b0b, db, it = _run_source_round(sel, l1b, l2b, B0,
+                                                    b00, budget)
             else:
-                Bb, b0b, db, it = _sharded_round_fn(
-                    mesh, loss, bool(fit_intercept))(*args)
+                args = (X, y, w, fold_masks, jnp.asarray(sel),
+                        jnp.asarray(l1b), jnp.asarray(l2b),
+                        jnp.asarray(B0), jnp.asarray(b00),
+                        mean, std, jnp.asarray(budget, jnp.int32),
+                        jnp.asarray(tol_f, jnp.float32))
+                if mesh is None:
+                    Bb, b0b, db, it = sweep_glm_round(
+                        *args, loss=loss, fit_intercept=fit_intercept)
+                else:
+                    Bb, b0b, db, it = _sharded_round_fn(
+                        mesh, loss, bool(fit_intercept))(*args)
             st["B"][idx] = np.asarray(Bb)[:k]
             st["b0"][idx] = np.asarray(b0b)[:k]
             st["delta"][idx] = np.asarray(db)[:k]
@@ -933,11 +1115,14 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
             on_round(st)
 
     # host-side unstandardize, f32 like the on-device legacy route
-    mean_h = np.asarray(mean, np.float32)
-    std_h = np.asarray(std, np.float32)
+    # (source-mode mean/std are column-padded to d_work; the pads are
+    # inert — slice back to d)
+    mean_h = np.asarray(mean, np.float32)[:d]
+    std_h = np.asarray(std, np.float32)[:d]
     B = st["B"] / std_h[None, :]
     b0 = st["b0"] - (B * mean_h[None, :]).sum(1, dtype=np.float32)
     info = {"route": "streamed", "kernel": "rounds",
+            "driver": "tileplane" if src_mode else "resident",
             "glm_rounds": int(st["rounds"]),
             "data_passes": int(st["data_passes"]),
             "lane_passes": int(st["lane_passes"]),
@@ -970,4 +1155,5 @@ from ..utils import tracing as _tracing  # noqa: E402
 
 _tracing.register_jit_fallback(
     sweep_glm_round, sweep_glm_streamed, sweep_glm_squared_gram,
-    glm_standardize_stats)
+    glm_standardize_stats, _source_prep_step, _source_round_step,
+    _source_round_update)

@@ -41,8 +41,14 @@ Three drivers mirror the PR 3 GLM sweep architecture:
   data-parallel mesh `batch` axis (parallel/mesh.build_shard_map), with
   an exact Chan merge ACROSS shards done as two tiny psum rounds, so
   stats run where sweep data already lives, no host gather;
-- `stream_stats` — host-driven row-tile loop with host-side f64
-  moment-state merge for datasets larger than HBM.
+- `stream_stats` — the double-buffered tileplane driver
+  (parallel/tileplane.py) for datasets larger than HBM: a producer
+  thread device_puts tile k+1 while the device Chan-merges tile k into
+  a DEVICE-resident carry (fetched once at the end); accepts a
+  `tileplane.RowSource` (Avro/CSV reader adapter) so X need never
+  exist as one array, and a `mesh` for the shard_map tile lane.
+  TMOG_TILEPLANE=0 restores the legacy synchronous loop with per-tile
+  host f64 merge.
 
 `run_stats` is the routed front door: it picks a driver, times the pass
 with a block_until_ready fence, and reports a `stats_pass` kernel span +
@@ -597,6 +603,80 @@ def _stream_tile_jit(X, y, w, shift, distinct, clip, lo, hi, *, bins: int,
                               corr_matrix=corr_matrix, shift=shift)
 
 
+@jax.jit
+def _tile_shift_jit(X, w):
+    """Gram shift from the FIRST tile, on device: the per-column masked
+    mean of the tile that is already resident for the pass's first step.
+    Replaces the old host pre-pass over X[:c] (which read the first
+    tile's rows twice — once on host, once when the loop re-sliced
+    0:c)."""
+    return _first_tile_shift(X, w, X.shape[0], lambda v: v)
+
+
+@functools.partial(jax.jit, static_argnames=("bins", "corr_matrix"),
+                   donate_argnums=(0,))
+def _tileplane_step_jit(carry, X, y, w, distinct, clip, lo, hi, *,
+                        bins: int, corr_matrix: bool):
+    """Tileplane step: fold one fixed-shape tile into the DEVICE-resident
+    carry (state, shift). The carry is DONATED — the output state aliases
+    the input buffers, so a whole streamed pass updates one state
+    in place and fetches it ONCE at the end (the legacy loop fetched and
+    host-merged after every tile). Tile buffers are not donate-marked:
+    they have no same-shaped output to alias (XLA would warn and copy);
+    their last reference dies at dispatch, which frees them just as
+    early."""
+    st, shift = carry
+    ts = _scan_state_single(X, y, w, distinct, clip, lo, hi, bins=bins,
+                            corr_matrix=corr_matrix, shift=shift)
+    return _merge_states(st, ts), shift
+
+
+@functools.lru_cache(maxsize=None)
+def _tileplane_sharded_step(mesh, bins: int, corr_matrix: bool,
+                            have_distinct: bool, have_clip: bool,
+                            have_hist: bool, y2d: bool):
+    """The SAME tile-merge step under shard_map over the mesh batch axis:
+    each shard scans its rows of the tile, a psum round Chan-merges
+    across shards, and the replicated result merges into the replicated
+    carry — the tileplane's optional mesh lane."""
+    from jax.sharding import PartitionSpec as P
+
+    def core(carry, X, y, w, *extras):
+        it = iter(extras)
+        distinct = next(it) if have_distinct else None
+        clip = next(it) if have_clip else None
+        lo = next(it) if have_hist else None
+        hi = next(it) if have_hist else None
+        st, shift = carry
+        ts = _scan_state_single(X, y, w, distinct, clip, lo, hi,
+                                bins=bins, corr_matrix=corr_matrix,
+                                shift=shift, axis_name=BATCH_AXIS)
+        return _merge_states(st, ts), shift
+
+    n_extras = int(have_distinct) + int(have_clip) + 2 * int(have_hist)
+    in_specs = (P(), P(BATCH_AXIS, None),
+                P(BATCH_AXIS, None) if y2d else P(BATCH_AXIS),
+                P(BATCH_AXIS)) + (P(),) * n_extras
+    sm = build_shard_map(core, mesh, in_specs=in_specs, out_specs=P())
+    # same donation rule as the single-device step: the replicated carry
+    # aliases its output, so the [d, d] Gram accumulators update in place
+    return jax.jit(sm, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _tile_shift_sharded(mesh):
+    from jax.sharding import PartitionSpec as P
+
+    def core(X, w):
+        return _first_tile_shift(X, w, X.shape[0],
+                                 lambda v: jax.lax.psum(v, BATCH_AXIS))
+
+    sm = build_shard_map(core, mesh, in_specs=(P(BATCH_AXIS, None),
+                                               P(BATCH_AXIS)),
+                         out_specs=P())
+    return jax.jit(sm)
+
+
 def _merge_states_host(a, b):
     """Host-side f64 Chan merge of two fetched states (streamed driver).
     Same arithmetic as _merge_states; numpy so a multi-hour stream never
@@ -641,58 +721,134 @@ def _fetch_state(st: _State) -> _State:
     return _State(*[None if x is None else np.asarray(x) for x in st])
 
 
-def stream_stats(X, y, w=None, *, tile_rows: Optional[int] = None,
-                 distinct=None, clip=None, lo=None, hi=None, bins: int = 0,
-                 corr_matrix: bool = False):
-    """Streamed row-tile driver for host-resident data larger than HBM.
+# last streamed pass's pipeline stats (rows/tiles/peak-buffer): run_stats
+# reads them for telemetry when the input was a RowSource whose row count
+# is unknown before the pass
+_last_stream_stats = None
 
-    Host numpy tiles flow through ONE fixed-shape jitted tile program
-    (ragged last tile zero-weight padded); tile states Chan-merge on the
-    host in f64. Still exactly one read of every row of X. Returns
-    (merged host state, shift)."""
+
+def _stream_source(X, y, w, tile_rows: Optional[int]):
+    """(source, tile_rows, d_probe) for the streamed driver. X may be a
+    tileplane.RowSource yielding (x, y, w) chunks (y/w args must be None
+    then) or a host array with companion y/w arrays."""
+    from ..parallel import tileplane as TP
+
+    if isinstance(X, TP.RowSource):
+        if y is not None or w is not None:
+            raise ValueError("pass y/w inside the RowSource chunks")
+        x0 = X.peek()[0]
+        d = int(x0.shape[1])
+        c = int(tile_rows) if tile_rows else TP.tile_rows_for(4 * d,
+                                                              X.n_rows)
+        return X, c, d
     X = np.asarray(X)
     y = np.asarray(y)
     n, d = X.shape
-    if corr_matrix and d > GRAM_MAX_D:
-        raise ValueError(f"corr_matrix capped at {GRAM_MAX_D} columns")
     w_full = np.ones(n, np.float32) if w is None else \
         np.asarray(w, np.float32)
     c = int(tile_rows or min(stream_tile_rows_default(), max(n, 1)))
-    y2d = y.ndim == 2
+    return TP.ArraySource(X, y, w_full, chunk_rows=c), c, d
 
-    shift_np = np.zeros(d, np.float32)
-    if corr_matrix:
-        x0 = np.asarray(X[:c], np.float32)
-        fin = np.isfinite(x0)
-        v = fin * w_full[:c, None]
-        s = np.where(fin, x0, 0.0) * v
-        cnt0 = v.sum(0)
-        shift_np = np.where(cnt0 > 0,
-                            s.sum(0) / np.maximum(cnt0, EPS),
-                            0.0).astype(np.float32)
-    shift = jnp.asarray(shift_np)
+
+def stream_stats(X, y=None, w=None, *, tile_rows: Optional[int] = None,
+                 distinct=None, clip=None, lo=None, hi=None, bins: int = 0,
+                 corr_matrix: bool = False, mesh=None):
+    """Streamed row-tile driver for data larger than HBM.
+
+    X may be a host array (with y/w arrays) or a `tileplane.RowSource`
+    whose chunks yield (x, y, w) — e.g. the Avro/CSV reader adapter —
+    so the matrix never materializes anywhere. Tiles flow through ONE
+    fixed-shape jitted tile program via the double-buffered tileplane
+    (parallel/tileplane.py): the producer thread device_puts tile k+1
+    while the device merges tile k into the DEVICE-resident carry, which
+    is fetched once at the end. With `mesh`, each tile is row-sharded
+    over the batch axis and the tile step psum-merges across shards.
+    The Gram shift comes from the first tile ON DEVICE (no second read
+    of its rows). TMOG_TILEPLANE=0 restores the legacy synchronous loop
+    with per-tile host f64 merge. Still exactly one read of every row of
+    X per pass. Returns (merged host state, shift)."""
+    from ..parallel import mesh as M
+    from ..parallel import tileplane as TP
+
+    global _last_stream_stats
+    source, c, d = _stream_source(X, y, w, tile_rows)
+    if corr_matrix and d > GRAM_MAX_D:
+        raise ValueError(f"corr_matrix capped at {GRAM_MAX_D} columns")
     distinct_j = None if distinct is None else _as_f32(distinct)
     clip_j = None if clip is None else jnp.asarray(clip, bool)
     lo_j = None if lo is None else _as_f32(lo)
     hi_j = None if hi is None else _as_f32(hi)
+    bins = int(bins)
+    corr_matrix = bool(corr_matrix)
+    big = float(np.finfo(np.float32).max)
 
-    merged = None
-    for start in range(0, n, c):
-        xt = np.asarray(X[start:start + c], np.float32)
-        yt = np.asarray(y[start:start + c], np.float32)
-        wt = w_full[start:start + c]
-        if xt.shape[0] < c:  # zero-weight pad: one executable for all tiles
-            pad = c - xt.shape[0]
-            xt = np.pad(xt, ((0, pad), (0, 0)))
-            yt = np.pad(yt, ((0, pad), (0, 0)) if y2d else (0, pad))
-            wt = np.pad(wt, (0, pad))
-        st = _stream_tile_jit(jnp.asarray(xt), jnp.asarray(yt),
-                              jnp.asarray(wt), shift, distinct_j, clip_j,
-                              lo_j, hi_j, bins=int(bins),
-                              corr_matrix=bool(corr_matrix))
-        st = _fetch_state(st)
-        merged = st if merged is None else _merge_states_host(merged, st)
-    return merged, shift_np
+    if not TP.tileplane_enabled():
+        # legacy synchronous loop (kill switch): per-tile dispatch ->
+        # fetch -> host f64 Chan merge; same tile content as the
+        # pipeline (shared assembly), zero copy/compute overlap
+        merged = None
+        shift = None
+        for tile, _n_valid in TP.iter_fixed_tiles(source, c):
+            xt, yt, wt = (jnp.asarray(a) for a in tile)
+            if shift is None:
+                shift = _tile_shift_jit(xt, wt) if corr_matrix \
+                    else jnp.zeros(d, jnp.float32)
+            st = _stream_tile_jit(xt, yt, wt, shift, distinct_j, clip_j,
+                                  lo_j, hi_j, bins=bins,
+                                  corr_matrix=corr_matrix)
+            st = _fetch_state(st)
+            merged = st if merged is None else \
+                _merge_states_host(merged, st)
+        _last_stream_stats = None
+        return merged, np.asarray(shift, np.float32) if shift is not None \
+            else np.zeros(d, np.float32)
+
+    # tileplane path: device-resident carry, double-buffered H2D
+    probe = source.peek()
+    y2d = probe[1].ndim == 2
+    shardings = None
+    if mesh is not None:
+        n_shards = mesh.shape[M.BATCH_AXIS]
+        c = -(-c // n_shards) * n_shards
+        shardings = (M.batch_sharding(mesh, ndim=2),
+                     M.batch_sharding(mesh, ndim=2 if y2d else 1),
+                     M.batch_sharding(mesh, ndim=1))
+        step_fn = _tileplane_sharded_step(
+            mesh, bins, corr_matrix, distinct is not None,
+            clip is not None, lo is not None, y2d)
+        shift_fn = _tile_shift_sharded(mesh)
+    else:
+        step_fn = functools.partial(_tileplane_step_jit, bins=bins,
+                                    corr_matrix=corr_matrix)
+        shift_fn = _tile_shift_jit
+
+    extras = tuple(a for a in (distinct_j, clip_j, lo_j, hi_j)
+                   if a is not None)
+    if mesh is not None:
+        extras = tuple(jax.device_put(a, M.replicated(mesh))
+                       for a in extras)
+
+    def step(carry, xt, yt, wt):
+        if mesh is not None:
+            return step_fn(carry, xt, yt, wt, *extras)
+        return step_fn(carry, xt, yt, wt, distinct_j, clip_j, lo_j, hi_j)
+
+    first_tile = None
+    if corr_matrix:
+        def first_tile(carry, xt, yt, wt):
+            return carry[0], shift_fn(xt, wt)
+
+    carry0 = (_zero_state(d, corr_matrix=corr_matrix,
+                          n_classes=0 if distinct is None
+                          else int(np.asarray(distinct).shape[0]),
+                          bins=bins, big=big),
+              jnp.zeros(d, jnp.float32))
+    (st, shift), ps = TP.run_tileplane(
+        source, step, carry0, tile_rows=c, label="stats",
+        first_tile=first_tile, shardings=shardings)
+    _last_stream_stats = ps
+    # the ONE fetch of the pass
+    return _fetch_state(st), np.asarray(shift, np.float32)
 
 
 # -- the routed, telemetry-emitting front door -------------------------------
@@ -700,7 +856,8 @@ def stream_stats(X, y, w=None, *, tile_rows: Optional[int] = None,
 _seen_shapes: set = set()
 
 
-def run_stats(X, y, w=None, *, distinct=None, clip=None, lo=None, hi=None,
+def run_stats(X, y=None, w=None, *, distinct=None, clip=None, lo=None,
+              hi=None,
               bins: int = 0, corr_matrix: bool = False, mesh=None,
               driver: Optional[str] = None,
               tile_rows: Optional[int] = None,
@@ -714,11 +871,18 @@ def run_stats(X, y, w=None, *, distinct=None, clip=None, lo=None, hi=None,
     and reported as a `stats_pass[<driver>]` kernel span (analytic bytes
     -> roofline attribution), a StatsPass telemetry record and a
     `stats_pass` event (utils/metrics.collector)."""
+    from ..parallel.tileplane import RowSource
     from ..utils.metrics import collector
 
-    n, d = np.asarray(X).shape if isinstance(X, np.ndarray) else X.shape
-    y2d = (np.asarray(y).ndim if isinstance(y, np.ndarray)
-           else y.ndim) == 2
+    src_mode = isinstance(X, RowSource)
+    if src_mode:
+        n, d = X.n_rows, None  # resolved after the pass
+        y2d = False
+        driver = "streamed"
+    else:
+        n, d = np.asarray(X).shape if isinstance(X, np.ndarray) else X.shape
+        y2d = (np.asarray(y).ndim if isinstance(y, np.ndarray)
+               else y.ndim) == 2
     if driver is None:
         if mesh is not None:
             driver = "sharded"
@@ -741,16 +905,28 @@ def run_stats(X, y, w=None, *, distinct=None, clip=None, lo=None, hi=None,
         st, shift = fused_stats_sharded(mesh, X, y, w, **kw)
         jax.block_until_ready(st)
     elif driver == "streamed":
-        st, shift = stream_stats(X, y, w, tile_rows=tile_rows, **kw)
-        # host state: every tile already blocked on fetch
+        # mesh here selects the tileplane's shard_map lane (tiles
+        # row-sharded over the batch axis, psum tile merge)
+        st, shift = stream_stats(X, y, w, tile_rows=tile_rows, mesh=mesh,
+                                 **kw)
+        # host state: the pass already blocked on the final fetch
     else:
         st, shift = fused_stats(X, y, w, **kw)
         jax.block_until_ready(st)
     wall = time.perf_counter() - t0
 
-    c = stats_row_block(d, n) if driver != "streamed" else \
-        int(tile_rows or min(stream_tile_rows_default(), max(n, 1)))
-    tiles = -(-n // c)
+    if driver == "streamed" and _last_stream_stats is not None:
+        ps = _last_stream_stats
+        n, tiles = ps.rows, ps.tiles
+        d = int(np.asarray(st.cnt).shape[0])
+    else:
+        if d is None:
+            d = int(np.asarray(st.cnt).shape[0])
+        if n is None:
+            n = int(round(float(np.asarray(st.wsum))))
+        c = stats_row_block(d, n) if driver != "streamed" else \
+            int(tile_rows or min(stream_tile_rows_default(), max(n, 1)))
+        tiles = -(-n // c)
     bytes_hbm = stats_pass_bytes(n, d, y2d=y2d, weighted=w is not None)
     collector.stats_pass(driver=driver, rows=int(n), cols=int(d),
                          tiles=int(tiles), bytes_hbm=float(bytes_hbm),
@@ -807,4 +983,5 @@ def rank_matrices(X, y, w=None, *, col_block: int = 128
 from ..utils import tracing as _tracing  # noqa: E402
 
 _tracing.register_jit_fallback(_fused_stats_jit, _stream_tile_jit,
-                               _rank_block_jit)
+                               _rank_block_jit, _tileplane_step_jit,
+                               _tile_shift_jit)

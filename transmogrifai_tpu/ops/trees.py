@@ -101,6 +101,34 @@ def quantile_edges(X: jax.Array, n_bins: int) -> jax.Array:
 _BIN_CHUNK = 1 << 18
 
 
+def _bin_block(xb, edges):
+    """Digitize ONE row block against `edges` — THE binning rule, shared
+    by the resident `bin_matrix` map and the streamed tile emission
+    (`stream_bin_matrix`), so the two paths cannot drift.
+
+    TPU: digitize by counting edges <= x (identical to right-side
+    searchsorted) — a fused broadcast-compare+reduce instead of the
+    binary-search gathers searchsorted lowers to (TPU serializes
+    data-dependent gathers); CPU keeps the O(log B) search. The backend
+    branch resolves at trace time."""
+    n_bins = edges.shape[1] + 1
+    # max stored bin is n_bins (missing bin shifts present bins up by 1),
+    # so up to 127 quantile bins fit int8 exactly
+    out_dtype = jnp.int8 if n_bins <= 127 else jnp.int32
+    xf = jnp.asarray(xb, jnp.float32)
+    missing = jnp.isnan(xf)
+    if jax.default_backend() == "tpu":
+        # NaN >= edge is False, so the count is 0 for missing rows
+        # before the shift; the where picks bin 0 for them explicitly
+        bins = (xf[:, :, None] >= edges[None, :, :]).sum(axis=2) + 1
+    else:
+        xs = jnp.where(missing, -jnp.inf, xf)
+        bins = jax.vmap(
+            lambda col, e: jnp.searchsorted(e, col, side="right"),
+            in_axes=(1, 0), out_axes=1)(xs, edges) + 1
+    return jnp.where(missing, 0, bins).astype(out_dtype)
+
+
 def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
     """Digitize with a dedicated missing bin: NaN -> 0, present values ->
     1 + #edges below-or-equal (searchsorted right, shifted).
@@ -116,32 +144,10 @@ def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
     temporaries never exceed O(_BIN_CHUNK * d); int8 output keeps the
     resident binned matrix at n*d bytes (640MB at the 10M config).
     """
-    n_bins = edges.shape[1] + 1
-    # max stored bin is n_bins (missing bin shifts present bins up by 1),
-    # so up to 127 quantile bins fit int8 exactly
-    out_dtype = jnp.int8 if n_bins <= 127 else jnp.int32
-
-    # TPU: digitize by counting edges <= x (identical to right-side
-    # searchsorted) — a fused broadcast-compare+reduce instead of the
-    # binary-search gathers searchsorted lowers to (TPU serializes
-    # data-dependent gathers); CPU keeps the O(log B) search.
-    count_edges = jax.default_backend() == "tpu"
+    N, d = X.shape
 
     def one_block(xb):
-        xf = jnp.asarray(xb, jnp.float32)
-        missing = jnp.isnan(xf)
-        if count_edges:
-            # NaN >= edge is False, so the count is 0 for missing rows
-            # before the shift; the where picks bin 0 for them explicitly
-            bins = (xf[:, :, None] >= edges[None, :, :]).sum(axis=2) + 1
-        else:
-            xs = jnp.where(missing, -jnp.inf, xf)
-            bins = jax.vmap(
-                lambda col, e: jnp.searchsorted(e, col, side="right"),
-                in_axes=(1, 0), out_axes=1)(xs, edges) + 1
-        return jnp.where(missing, 0, bins).astype(out_dtype)
-
-    N, d = X.shape
+        return _bin_block(xb, edges)
     chunk = min(_BIN_CHUNK, N)
     nchunks = -(-N // chunk)
     pad = nchunks * chunk - N
@@ -166,6 +172,138 @@ def thresholds_to_values(feat: jax.Array, thresh: jax.Array,
     tv = edges[feat, ti]
     tv = jnp.where(thresh <= 0, -jnp.inf, tv)
     return jnp.where(thresh >= n_bins, jnp.inf, tv)
+
+
+# -- streamed binning (tileplane) --------------------------------------------
+
+def _x_source_with_dummies(source):
+    """Wrap an x-only RowSource into the (x, y, w) chunk shape the stats
+    engine's streamed driver expects (zero labels, unit weights)."""
+    from ..parallel.tileplane import IterSource
+
+    def factory():
+        for chunk in source.chunks():
+            x = np.asarray(chunk[0], np.float32)
+            n = x.shape[0]
+            yield (x, np.zeros(n, np.float32), np.ones(n, np.float32))
+
+    return IterSource(factory, n_rows=source.n_rows)
+
+
+def stream_quantile_edges(source, n_bins: int, *, hist_bins: int = 1024,
+                          tile_rows: Optional[int] = None) -> np.ndarray:
+    """Per-feature quantile bin edges from a STREAMED source — the
+    larger-than-HBM replacement for `quantile_edges`.
+
+    Two statistics-engine passes over the source (both double-buffered
+    via the tileplane): one for per-column min/max, one for fixed-range
+    `hist_bins` histograms between them; the edges are then the inverse
+    CDF of each column's histogram (linear interpolation inside the
+    crossing bin — the XGBoost-hist sketch with uniform bins instead of
+    a merged quantile sketch). Edge error is bounded by one histogram
+    bin width, so `hist_bins >> n_bins` (default 1024 vs <= 127 tree
+    bins) keeps streamed splits within a sliver of the resident sketch.
+    NaN rows are excluded exactly like the resident path; an all-NaN
+    column yields NaN edges (bins every present value to 1, never wins
+    a split); a constant column yields repeated edges. Returns
+    [d, n_bins - 1] float32."""
+    from . import stats_engine as SE
+
+    wrapped = _x_source_with_dummies(source)
+    st, _ = SE.stream_stats(wrapped, tile_rows=tile_rows)
+    # host-only sketch finalize on [d]-vectors; device tiles stay f32
+    f8 = np.float64  # tmoglint: disable=TPU003  host-only precision
+    cnt = np.asarray(st.cnt, f8)
+    lo = np.asarray(st.minv, f8)
+    hi = np.asarray(st.maxv, f8)
+    d = cnt.shape[0]
+    ok = cnt > 0
+    lo_r = np.where(ok, lo, 0.0).astype(np.float32)
+    hi_r = np.where(ok, hi, 1.0).astype(np.float32)
+    st2, _ = SE.stream_stats(_x_source_with_dummies(source),
+                             tile_rows=tile_rows, lo=lo_r, hi=hi_r,
+                             bins=int(hist_bins))
+    hist = np.asarray(st2.hist, f8).reshape(d, hist_bins + 1)[:, :hist_bins]
+
+    edges = np.full((d, n_bins - 1), np.nan, np.float32)
+    qs = np.arange(1, n_bins, dtype=f8) / n_bins
+    for j in range(d):
+        total = hist[j].sum()
+        if not ok[j] or total <= 0:
+            continue  # all-NaN column: NaN edges, like nanquantile
+        if hi[j] <= lo[j]:
+            edges[j] = lo[j]  # constant feature: repeated edges
+            continue
+        bounds = lo[j] + (hi[j] - lo[j]) \
+            * np.arange(1, hist_bins + 1, dtype=f8) / hist_bins
+        cum = np.cumsum(hist[j])
+        edges[j] = np.interp(qs * total,
+                             np.concatenate(([0.0], cum)),
+                             np.concatenate(([lo[j]], bounds))
+                             ).astype(np.float32)
+    return edges
+
+
+def stream_bin_matrix(source, edges, *, tile_rows: Optional[int] = None,
+                      sink=None):
+    """Second streamed pass: emit the binned matrix tile-by-tile.
+
+    Each fixed-shape tile runs the SAME `_bin_block` rule as the
+    resident `bin_matrix` (exact parity by construction) under the
+    double-buffered tileplane; the int8 output tiles are fetched with a
+    one-tile lag (D2H of tile k overlaps tile k+1's compute) and handed
+    to `sink(np_tile, n_valid)` — or, when `sink` is None, assembled
+    into the full [n, d] int8/int32 host matrix, which at n*d bytes is
+    the one artifact of the flow SMALL enough to keep (the 10M-row
+    bench's binned matrix is 640MB vs 2.5GB of f32 X). TMOG_TILEPLANE=0
+    degrades to run_tileplane's synchronous single-thread loop."""
+    from ..parallel import tileplane as TP
+
+    edges_j = jnp.asarray(edges, jnp.float32)
+    d = int(edges_j.shape[0])
+    c = int(tile_rows) if tile_rows else TP.tile_rows_for(4 * d,
+                                                          source.n_rows)
+    n_bins = int(np.asarray(edges).shape[1]) + 1
+    out_dtype = np.int8 if n_bins <= 127 else np.int32
+    parts: list = []
+    full = None
+    cursor = 0
+    if sink is not None:
+        out_sink = sink
+    elif source.n_rows is not None:
+        # known row count: write tiles straight into the final [n, d]
+        # matrix — collecting tiles then concatenating would transiently
+        # DOUBLE the peak host memory of the one artifact this flow keeps
+        full = np.empty((int(source.n_rows), d), out_dtype)
+
+        def out_sink(tile, n_valid):
+            nonlocal cursor
+            full[cursor:cursor + n_valid] = tile
+            cursor += n_valid
+    else:
+        def out_sink(tile, n_valid):  # unknown length: concat at the end
+            parts.append(tile)
+
+    def step(carry, xt):
+        return carry, _bin_tile_jit(xt, edges_j)
+
+    # TMOG_TILEPLANE=0 degrades inside run_tileplane to the synchronous
+    # single-thread loop — same tiles, same rule, no producer thread
+    TP.run_tileplane(source, step, jnp.zeros((), jnp.int32),
+                     tile_rows=c, label="tree_bin", sink=out_sink)
+    if sink is not None:
+        return None
+    if full is not None:
+        return full[:cursor]
+    return np.concatenate(parts, axis=0) if parts else \
+        np.zeros((0, d), out_dtype)
+
+
+@jax.jit
+def _bin_tile_jit(x, edges):
+    """One streamed tile's binned output (fixed shape: one executable
+    for every tile of the pass)."""
+    return _bin_block(x, edges)
 
 
 # -- single-tree growth -----------------------------------------------------
@@ -1172,7 +1310,8 @@ def _register_trace_fallback():
     still carry true recompile attribution."""
     from ..utils import tracing
     tracing.register_jit_fallback(grow_tree, fit_forest, fit_gbt,
-                                  fit_gbt_folds, fit_gbt_softmax)
+                                  fit_gbt_folds, fit_gbt_softmax,
+                                  _bin_tile_jit)
 
 
 _register_trace_fallback()

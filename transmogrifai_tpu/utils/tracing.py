@@ -183,12 +183,20 @@ class TraceTree:
             sp.attrs.update(mem)
 
     def add_complete(self, name: str, kind: str, duration: float,
+                     parent_span: Optional["Span"] = None,
                      **attrs: Any) -> Span:
         """Record an already-measured child span (e.g. a kernel wall that
         was timed by its own block_until_ready window): t_end = now,
-        t_start = now - duration, parented to the innermost open span."""
+        t_start = now - duration, parented to the innermost open span —
+        or to `parent_span` when given (a producer THREAD records its
+        tile spans under the span that was current when its pass began;
+        parenting to the consumer thread's transient stage spans would
+        violate the children-inside-parent-window invariant)."""
         with self._lock:
-            parent = self._stack[-1].span_id if self._stack else None
+            if parent_span is not None:
+                parent = parent_span.span_id
+            else:
+                parent = self._stack[-1].span_id if self._stack else None
             end = self.now()
             sp = Span(span_id=self._next_id, parent_id=parent, name=name,
                       kind=kind, t_start=max(end - max(duration, 0.0), 0.0),

@@ -337,8 +337,13 @@ class OpWorkflowRunner:
         if loc:
             os.makedirs(loc, exist_ok=True)
             out_f = open(os.path.join(loc, "scores.jsonl"), "a")
+        # custom_params["score_tile_rows"] overrides the tileplane's
+        # fixed scoring tile (TMOG_SCORE_TILE_ROWS; 0 = legacy per-record
+        # path) per run config, like any other reader param
+        tile_rows = params.custom_params.get("score_tile_rows")
         try:
-            for batch_scores in score_stream(model, self.score_reader):
+            for batch_scores in score_stream(model, self.score_reader,
+                                             tile_rows=tile_rows):
                 n += len(batch_scores)
                 if out_f is not None:
                     for s in batch_scores:
