@@ -1233,6 +1233,146 @@ def _streaming_score_phase(avro_pattern, train_path, d, n):
             "rows_per_s": round(scored / max(wall, 1e-9))}
 
 
+# -- serving scenario (--serving) -------------------------------------------
+
+def serving_bench(n_rows=None):
+    """Scenario config for the production serving engine (serve/,
+    docs/serving.md): a fitted workflow served through the bucket
+    ladder — sustained bulk throughput through the top bucket, plus
+    single-record p50/p99 through the micro-batching queue, BOTH read
+    from the engine's own streaming latency histograms (the bench does
+    not re-time what the engine already measures). One JSON line; on CPU
+    the numbers are liveness, not perf claims."""
+    import threading
+
+    import jax
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    from transmogrifai_tpu.models.glm import OpLogisticRegression
+    from transmogrifai_tpu.readers.readers import ListReader
+    from transmogrifai_tpu.serve import MicroBatcher, ServingEngine
+    from transmogrifai_tpu.stages.params import param_grid
+    from transmogrifai_tpu.utils import tracing
+    from transmogrifai_tpu.utils.metrics import collector
+    from transmogrifai_tpu.workflow import Workflow
+
+    backend = jax.default_backend()
+    n_bulk = int(n_rows) if n_rows else (1_000_000 if backend == "tpu"
+                                         else 100_000)
+    d = 16
+    out = {"metric": "serving", "backend": backend, "n_bulk": n_bulk,
+           "n_cols": d}
+
+    rng = np.random.default_rng(0)
+    beta = rng.normal(size=d)
+
+    def rec(i):
+        x = rng.normal(size=d)
+        return {**{f"x{j}": float(x[j]) for j in range(d)},
+                "y": float(x @ beta > 0)}
+
+    train_rows = [rec(i) for i in range(5000)]
+    preds = [FeatureBuilder.Real(f"x{j}").extract(
+        lambda r, j=j: r.get(f"x{j}")).as_predictor() for j in range(d)]
+    fy = FeatureBuilder.RealNN("y").extract(
+        lambda r: r.get("y")).as_response()
+    # a derived jitted feature so the prewarm/compile accounting is real
+    fsum = (preds[0] + preds[1]) + 1.0
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[(OpLogisticRegression(),
+                                param_grid(reg_param=[0.01]))],
+    ).set_input(fy, transmogrify(preds + [fsum])).get_output()
+    with contextlib.redirect_stdout(io.StringIO()):
+        model = Workflow().set_reader(ListReader(train_rows)) \
+            .set_result_features(pred).train()
+
+    collector.enable("bench_serving")
+    try:
+        engine = ServingEngine(model, max_batch=4096, strict_keys=False)
+        t0 = time.perf_counter()
+        warm = engine.prewarm()
+        out["prewarm"] = {"wall_s": warm["wall_s"],
+                          "buckets": warm["buckets"],
+                          "compiles": warm["compiles"],
+                          "cache_hits": warm["cache_hits"]}
+        base_compiles = tracing.tracker.true_compiles
+
+        # bulk sustained throughput through the bucket ladder (the
+        # engine chunks into top-bucket batches internally)
+        bulk = [{k: v for k, v in rec(i).items() if k != "y"}
+                for i in range(n_bulk)]
+        t0 = time.perf_counter()
+        scored = engine.score_batch(bulk)
+        # score_batch returns host dicts — already synced
+        wall = time.perf_counter() - t0  # tmoglint: disable=TPU005
+        assert len(scored) == n_bulk
+        out["bulk"] = {"wall_s": round(wall, 3),
+                       "rows_per_s": round(n_bulk / max(wall, 1e-9)),
+                       "bucket": engine.max_batch}
+        del scored
+
+        # the COLUMNAR bulk lane (readers/streaming.score_stream over the
+        # tileplane): producer-thread Dataset assembly overlapped with
+        # device scoring — the sustained-throughput path for row floods,
+        # vs the request-shaped per-record ladder above
+        from transmogrifai_tpu.readers import ListStreamingReader
+        from transmogrifai_tpu.readers.streaming import score_stream
+        t0 = time.perf_counter()
+        n2 = sum(len(b) for b in score_stream(
+            model, ListStreamingReader(bulk, batch_size=8192),
+            tile_rows=4096))
+        wall = time.perf_counter() - t0  # tmoglint: disable=TPU005
+        assert n2 == n_bulk
+        out["bulk_stream"] = {"wall_s": round(wall, 3),
+                              "rows_per_s": round(n_bulk / max(wall, 1e-9)),
+                              "tile_rows": 4096}
+        del bulk
+
+        # single-record latency through the micro-batcher, engine's own
+        # histograms as the source of truth
+        batcher = MicroBatcher(engine, max_wait_ms=1.0, max_queue=4096)
+        singles = [{k: v for k, v in rec(i).items() if k != "y"}
+                   for i in range(400)]
+        for r in singles[:200]:  # sequential: isolated-request latency
+            batcher.submit(r)
+        errs = []
+
+        def fire(rs):
+            for r in rs:
+                try:
+                    batcher.submit(r)
+                except Exception as e:  # noqa: BLE001 - recorded below
+                    errs.append(repr(e))
+
+        ths = [threading.Thread(target=fire,
+                                args=(singles[200 + 25 * k:
+                                              200 + 25 * (k + 1)],))
+               for k in range(8)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(120)
+        batcher.shutdown(drain=True)
+        m = engine.metrics()
+        out["single_record"] = {
+            "requests": m["requests"],
+            "p50_ms": m["latency"]["total"]["p50_ms"],
+            "p99_ms": m["latency"]["total"]["p99_ms"],
+            "queue_wait_p95_ms": m["latency"]["queue_wait"]["p95_ms"],
+            "device_score_p50_ms": m["latency"]["device_score"]["p50_ms"],
+        }
+        out["post_warmup_compiles"] = \
+            tracing.tracker.true_compiles - base_compiles
+        out["shed"] = m["shed"]
+        if errs:
+            out["errors"] = errs[:5]
+    finally:
+        collector.finish()
+        collector.disable()
+    return out
+
+
 # -- cpu-subprocess phases --------------------------------------------------
 # Tiny example flows and the host-transform-dominated wide bench dispatch
 # hundreds of small programs; over a remote TPU tunnel every dispatch pays
@@ -1326,6 +1466,10 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--streaming":
         print(json.dumps(streaming_bench(
+            sys.argv[2] if len(sys.argv) > 2 else None)))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--serving":
+        print(json.dumps(serving_bench(
             sys.argv[2] if len(sys.argv) > 2 else None)))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--tree-sweep":

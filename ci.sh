@@ -281,6 +281,113 @@ shutil.rmtree(tmp, ignore_errors=True)
 print("streaming smoke ok: avro fit parity, bounded host buffer, "
       f"{scored} rows scored")
 PY
+# serving smoke (docs/serving.md): fit + save a model, `serve
+# --prewarm-only` via the real CLI (populates the persistent compile
+# cache + writes the serve.json manifest), then a FRESH process starts
+# the engine in-process — prewarm must be all cache hits (0 true XLA
+# compiles) — and fires concurrent mixed-size traffic: p50 sanity, zero
+# post-warmup recompiles (also re-checked from the artifact by the
+# trace-report --check below, which fails on any serve_recompile event),
+# and a clean drain on shutdown.
+SERVE_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - "$SERVE_TMP" <<'PY'
+import sys
+
+import numpy as np
+
+out = sys.argv[1]
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.workflow import Workflow
+
+rng = np.random.default_rng(0)
+rows = [{"a": float(rng.normal()), "b": float(rng.normal()),
+         "y": float(rng.integers(0, 2))} for _ in range(400)]
+fa = FeatureBuilder.Real("a").extract(lambda r: r.get("a")).as_predictor()
+fb = FeatureBuilder.Real("b").extract(lambda r: r.get("b")).as_predictor()
+fy = FeatureBuilder.RealNN("y").extract(lambda r: r.get("y")).as_response()
+fsum = (fa + fb) + 1.0  # a jitted stage, so compile accounting is real
+pred = BinaryClassificationModelSelector.with_train_validation_split(
+    models_and_parameters=[(OpLogisticRegression(),
+                            param_grid(reg_param=[0.01]))],
+).set_input(fy, transmogrify([fa, fb, fsum])).get_output()
+Workflow().set_reader(ListReader(rows)) \
+    .set_result_features(pred).train().save(out + "/model")
+print("serving smoke: model saved")
+PY
+JAX_PLATFORMS=cpu TMOG_COMPILE_CACHE_DIR="$SERVE_TMP/cache" \
+  PYTHONPATH="$PWD" python -m transmogrifai_tpu serve "$SERVE_TMP/model" \
+  --prewarm-only --max-batch 16
+JAX_PLATFORMS=cpu TMOG_COMPILE_CACHE_DIR="$SERVE_TMP/cache" \
+  PYTHONPATH="$PWD" python - "$SERVE_TMP" "$TRACE_DIR" <<'PY'
+import sys
+import threading
+
+import numpy as np
+
+model_dir, trace = sys.argv[1] + "/model", sys.argv[2]
+from transmogrifai_tpu.serve import MicroBatcher, ServingEngine
+from transmogrifai_tpu.utils import tracing
+from transmogrifai_tpu.utils.metrics import collector
+
+collector.enable("ci_serve")
+collector.attach_event_log(trace + "/events.jsonl")
+eng = ServingEngine(model_dir)
+assert eng.buckets == (1, 8, 16), eng.buckets  # the prewarm manifest
+warm = eng.prewarm()
+assert warm["compiles"] == 0, \
+    f"fresh-process prewarm compiled: {warm['compiles']}"
+assert warm["cache_hits"] > 0, warm  # executables really loaded
+base = tracing.tracker.true_compiles
+batcher = MicroBatcher(eng, max_wait_ms=2.0, max_queue=256)
+rng = np.random.default_rng(1)
+errors = []
+
+
+def single(i):
+    try:
+        out = batcher.submit({"a": float(rng.normal()),
+                              "b": float(rng.normal())})
+        assert out
+    except Exception as e:
+        errors.append(repr(e))
+
+
+def bulk(k):
+    try:
+        recs = [{"a": float(i), "b": 0.5} for i in range(k)]
+        assert len(eng.score_batch(recs)) == k
+    except Exception as e:
+        errors.append(repr(e))
+
+
+threads = [threading.Thread(target=single, args=(i,)) for i in range(20)]
+threads += [threading.Thread(target=bulk, args=(k,))
+            for k in (1, 3, 8, 16, 5, 11)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(60)
+batcher.shutdown(drain=True)  # graceful drain
+assert not errors, errors[:3]
+assert tracing.tracker.true_compiles == base, "recompile under traffic"
+assert eng.post_warmup_compiles == 0
+m = eng.metrics()
+assert m["requests"] >= 20 and m["shed"] == 0, m
+p50 = m["latency"]["total"]["p50_ms"]
+assert 0.0 < p50 < 2000.0, p50  # sanity, not a perf claim on CPU
+collector.save(trace + "/serve_stage_metrics.json")
+collector.save_chrome_trace(trace + "/serve_trace.json")
+collector.detach_event_log()
+collector.disable()
+print(f"serving smoke ok: 0 prewarm compiles ({warm['cache_hits']} cache "
+      f"hits), {m['requests']} requests, p50 {p50}ms, clean drain")
+PY
+rm -rf "$SERVE_TMP"
 PYTHONPATH="$PWD" python -m transmogrifai_tpu trace-report "$TRACE_DIR" --check
 # the stats_pass spans must be visible to trace tooling (not just the
 # in-process assert above): grep the exported chrome trace

@@ -164,3 +164,120 @@ class TestModelFamilyParity:
                                                  max_iter=40),
                 param_grid())])
         self._flow(sel)
+
+
+class TestZooParityMapTextMissing:
+    """Serving-satellite parity zoo: the per-record score_function must
+    match the batch XLA score path across a workflow with MAP and TEXT
+    vectorizers — including records whose fields are None or absent
+    entirely — for both a GLM and a tree-ensemble winner. This is the
+    contract the serving engine's single-record 'local' route rides."""
+
+    def _rows(self, n=400, seed=11):
+        rng = np.random.default_rng(seed)
+        rows = []
+        words = ["alpha beta", "gamma delta words", "omega", None]
+        for i in range(n):
+            age = None if rng.uniform() < 0.15 else float(
+                rng.uniform(18, 80))
+            mp = (None if rng.uniform() < 0.1
+                  else {"k1": float(rng.normal()),
+                        "k2": float(rng.normal())})
+            r = {"age": age,
+                 "txt": str(rng.choice([w for w in words if w]))
+                 if rng.uniform() > 0.1 else None,
+                 "cat": str(rng.choice(["red", "green", "blue"])),
+                 "mp": mp,
+                 "label": float((age or 45) > 45)}
+            if rng.uniform() < 0.1:
+                r.pop("age")  # key absent entirely, not just None
+            rows.append(r)
+        return rows
+
+    def _fit(self, models_and_parameters):
+        from transmogrifai_tpu.automl.selectors import (
+            BinaryClassificationModelSelector)
+        from transmogrifai_tpu.automl.transmogrifier import transmogrify
+        from transmogrifai_tpu.readers.readers import ListReader
+        rows = self._rows()
+        f_age = FeatureBuilder.Real("age").extract(
+            lambda r: r.get("age")).as_predictor()
+        f_txt = FeatureBuilder.Text("txt").extract(
+            lambda r: r.get("txt")).as_predictor()
+        f_cat = FeatureBuilder.PickList("cat").extract(
+            lambda r: r.get("cat")).as_predictor()
+        from transmogrifai_tpu.types import RealMap
+        f_mp = FeatureBuilder.RealMap("mp").extract(
+            lambda r: r.get("mp")).as_predictor()
+        f_y = FeatureBuilder.RealNN("label").extract(
+            lambda r: r.get("label")).as_response()
+        vec = transmogrify([f_age, f_txt, f_cat, f_mp])
+        sel = BinaryClassificationModelSelector.with_train_validation_split(
+            models_and_parameters=models_and_parameters)
+        pred = sel.set_input(f_y, vec).get_output()
+        model = Workflow().set_reader(ListReader(rows)) \
+            .set_result_features(pred).train()
+        return model, rows, pred
+
+    def _assert_parity(self, model, rows, pred, indices):
+        from transmogrifai_tpu.models.prediction import (prediction_of,
+                                                         probability_of)
+        scored = model.score()
+        col = scored.column(pred.name)
+        preds = prediction_of(col)
+        probs = probability_of(col)
+        fn = model.score_function()
+        for i in indices:
+            rec = {k: v for k, v in rows[i].items() if k != "label"}
+            out = fn(rec)[pred.name]
+            rv = dict(out.value if hasattr(out, "value") else out)
+            assert abs(float(rv["prediction"]) - float(preds[i])) < 1e-4, i
+            if probs is not None and "probability_1" in rv:
+                assert abs(float(rv["probability_1"])
+                           - float(probs[i, 1])) < 1e-4, i
+
+    def _none_heavy_indices(self, rows):
+        missing = [i for i, r in enumerate(rows)
+                   if r.get("age") is None or r.get("mp") is None
+                   or r.get("txt") is None]
+        assert len(missing) >= 10  # the zoo MUST exercise missing fields
+        return missing[:6] + [0, 7, 123]
+
+    def test_glm_with_map_text_and_missing_fields(self):
+        from transmogrifai_tpu.models.glm import OpLogisticRegression
+        from transmogrifai_tpu.stages.params import param_grid
+        model, rows, pred = self._fit(
+            [(OpLogisticRegression(max_iter=15),
+              param_grid(reg_param=[0.01]))])
+        self._assert_parity(model, rows, pred,
+                            self._none_heavy_indices(rows))
+
+    def test_tree_ensemble_with_map_text_and_missing_fields(self):
+        from transmogrifai_tpu.models.trees import OpGBTClassifier
+        from transmogrifai_tpu.stages.params import param_grid
+        model, rows, pred = self._fit(
+            [(OpGBTClassifier(max_iter=6, max_depth=3), param_grid())])
+        self._assert_parity(model, rows, pred,
+                            self._none_heavy_indices(rows))
+
+    def test_serving_engine_rides_the_same_parity(self):
+        """The serving bucket path agrees with BOTH of the above on the
+        same None-heavy records."""
+        from transmogrifai_tpu.models.glm import OpLogisticRegression
+        from transmogrifai_tpu.serve import ServingEngine
+        from transmogrifai_tpu.stages.params import param_grid
+        model, rows, pred = self._fit(
+            [(OpLogisticRegression(max_iter=15),
+              param_grid(reg_param=[0.01]))])
+        eng = ServingEngine(model, max_batch=8, strict_keys=False)
+        eng.prewarm()
+        fn = model.score_function()
+        idx = self._none_heavy_indices(rows)[:5]
+        recs = [{k: v for k, v in rows[i].items() if k != "label"}
+                for i in idx]
+        served = eng.score_batch([dict(r) for r in recs])
+        for rec, out in zip(recs, served):
+            loc = fn(dict(rec))[pred.name]
+            loc = dict(loc.value if hasattr(loc, "value") else loc)
+            assert abs(float(out[pred.name]["prediction"])
+                       - float(loc["prediction"])) < 1e-4

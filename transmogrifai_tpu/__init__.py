@@ -30,8 +30,10 @@ if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         pass
 
 # Persistent XLA compilation cache: cold processes (examples, CI, local
-# serving starts) stop re-paying every compile. Opt out with
-# TMOG_COMPILE_CACHE=0; see utils/platform.enable_compilation_cache.
+# serving starts) stop re-paying every compile. Point it with
+# TMOG_COMPILE_CACHE_DIR=<dir> (serve prewarm, docs/serving.md), opt out
+# with TMOG_COMPILE_CACHE_DIR=0; see
+# utils/platform.enable_compilation_cache.
 try:
     from .utils.platform import enable_compilation_cache as _ecc
     _ecc()

@@ -391,6 +391,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="schema validation only; exit 1 on any problem")
     tr.add_argument("--top", type=int, default=15,
                     help="rows in the self-time table (default 15)")
+    sv = sub.add_parser(
+        "serve",
+        help="production serving engine over a saved model: AOT-prewarmed "
+             "shape-bucketed executables, async micro-batching, HTTP/JSON "
+             "frontend (docs/serving.md)")
+    sv.add_argument("model_dir", help="saved WorkflowModel directory")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8765,
+                    help="HTTP port (0 = ephemeral; default 8765)")
+    sv.add_argument("--max-batch", type=int, default=64,
+                    help="top bucket of the power-of-two ladder")
+    sv.add_argument("--buckets", default=None,
+                    help="explicit comma-separated bucket ladder "
+                         "(overrides --max-batch)")
+    sv.add_argument("--max-wait-ms", type=float, default=5.0,
+                    help="micro-batch fill window")
+    sv.add_argument("--max-queue", type=int, default=1024,
+                    help="admission queue bound (full -> 503 shed)")
+    sv.add_argument("--single-record", choices=["bucket", "local"],
+                    default="bucket",
+                    help="batch-of-one route: the bucket-1 executable or "
+                         "the pure-Python local replay")
+    sv.add_argument("--example", default=None,
+                    help="JSON file with one sample record for prewarm "
+                         "batches (default: synthesized from feature "
+                         "types)")
+    sv.add_argument("--prewarm-only", action="store_true",
+                    help="compile every bucket, populate the persistent "
+                         "compile cache (TMOG_COMPILE_CACHE_DIR), write "
+                         "the serve.json manifest and exit")
+    sv.add_argument("--metrics-location", default=None,
+                    help="dir for events.jsonl + trace artifacts "
+                         "(enables span collection + the recompile "
+                         "watch; validate with trace-report --check)")
     a = p.parse_args(argv)
     if a.command == "gen":
         files = generate_project(a.input, a.response, a.output,
@@ -403,6 +437,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         text, ok = trace_report(a.dir, check=a.check, top=a.top)
         print(text)
         return 0 if ok else 1
+    if a.command == "serve":
+        from .serve.frontend import run_serve
+        return run_serve(a)
     return 1
 
 

@@ -7,6 +7,13 @@ scores host-side through the same ``transform_keyvalue`` protocol (tree
 ensembles traverse raw-value thresholds in numpy; GLMs are a dot product),
 so no second model format is needed — one artifact serves both the batch
 XLA path and this dependency-light local path.
+
+Serving error contract (docs/serving.md): a bad record must fail with a
+TYPED error naming the offending key BEFORE it reaches a stage — the
+serving frontend maps :class:`UnknownFeatureError` /
+:class:`MissingFeatureError` / :class:`InvalidFeatureError` to HTTP 400
+(client error), where an opaque ``KeyError``/``TypeError`` escaping a
+stage deep in the DAG would surface as a 500.
 """
 from __future__ import annotations
 
@@ -18,6 +25,91 @@ if TYPE_CHECKING:  # pragma: no cover
 ScoreFunction = Callable[[Dict[str, Any]], Dict[str, Any]]
 
 
+class UnknownFeatureError(ValueError):
+    """Record carries a key that matches no raw feature of the workflow."""
+
+    def __init__(self, key: str, known=()):
+        self.key = key
+        hint = f" (known features: {sorted(known)})" if known else ""
+        super().__init__(f"unknown record key {key!r}{hint}")
+
+
+class MissingFeatureError(KeyError):
+    """A raw feature's extract function needs a key the record lacks.
+
+    Subclasses KeyError so pre-existing callers catching the opaque
+    original keep working — but the message now NAMES the feature."""
+
+    def __init__(self, feature: str, key: Any = None):
+        self.feature = feature
+        self.key = key
+        detail = f" (record key {key!r})" if key is not None else ""
+        super().__init__(f"record is missing data for raw feature "
+                         f"{feature!r}{detail}")
+
+    def __str__(self) -> str:  # KeyError.__str__ repr()s the arg
+        return self.args[0]
+
+
+class InvalidFeatureError(ValueError):
+    """A record value failed its feature type's coercion."""
+
+    def __init__(self, feature: str, value: Any, cause: Exception):
+        self.feature = feature
+        self.value = value
+        super().__init__(f"invalid value for raw feature {feature!r}: "
+                         f"{value!r} ({type(cause).__name__}: {cause})")
+
+
+def _extract(gen, record: Dict[str, Any]):
+    """One generator's extract with the typed-error boundary applied."""
+    try:
+        return gen.extract(record)
+    except KeyError as e:
+        raise MissingFeatureError(gen.feature_name,
+                                  key=e.args[0] if e.args else None) from e
+    except (TypeError, ValueError, AttributeError) as e:
+        raise InvalidFeatureError(
+            gen.feature_name,
+            record.get(gen.feature_name) if isinstance(record, dict)
+            else record, e) from e
+
+
+def record_validator(model: "WorkflowModel", strict_keys: bool = True
+                     ) -> Callable[[Dict[str, Any]], None]:
+    """Up-front record validation for the serving path.
+
+    Returns validate(record) raising :class:`UnknownFeatureError` for a
+    key naming no raw feature (strict_keys=False skips that check —
+    batch readers legitimately carry extra columns like row ids),
+    :class:`MissingFeatureError` / :class:`InvalidFeatureError` when a
+    predictor's extract cannot produce a value. Response features are
+    exempt: serving records are unlabeled by contract.
+
+    The extraction here runs AGAIN at batch assembly — deliberate: the
+    duplicate is a few dict lookups + float coercions (microseconds
+    against a millisecond-scale request), and paying it at submit time
+    is what lets the batcher reject a bad record BEFORE it joins a batch
+    other requests share.
+    """
+    raw = model.raw_features()
+    known = {f.name for f in raw}
+    generators = [f.origin_stage for f in raw if not f.is_response]
+
+    def validate(record: Dict[str, Any]) -> None:
+        if not isinstance(record, dict):
+            raise InvalidFeatureError(
+                "<record>", record, TypeError("record must be a dict"))
+        if strict_keys:
+            for k in record:
+                if k not in known:
+                    raise UnknownFeatureError(k, known)
+        for gen in generators:
+            _extract(gen, record)
+
+    return validate
+
+
 def score_function(model: "WorkflowModel") -> ScoreFunction:
     """Build the per-row scorer for a fitted workflow.
 
@@ -25,7 +117,9 @@ def score_function(model: "WorkflowModel") -> ScoreFunction:
     extract functions expect), replays raw-feature extraction and every
     fitted stage in DAG order, and returns {result_feature_name: value}.
     Mirrors OpWorkflowModelLocal.scoreFunction (stage replay in DAG order,
-    local/.../OpWorkflowModelLocal.scala:93).
+    local/.../OpWorkflowModelLocal.scala:93). Extraction failures raise
+    the typed errors above (never a bare KeyError from inside a stage);
+    key-set strictness is the caller's choice via `record_validator`.
     """
     raw_feats = model.raw_features()
     # responses are not extracted at serving time (records are unlabeled;
@@ -38,7 +132,7 @@ def score_function(model: "WorkflowModel") -> ScoreFunction:
     def score(record: Dict[str, Any]) -> Dict[str, Any]:
         row: Dict[str, Any] = {n: None for n in response_names}
         for gen in generators:
-            row[gen.feature_name] = gen.extract(record)
+            row[gen.feature_name] = _extract(gen, record)
         for layer in layers:
             for st in layer:
                 row[st.output_name()] = st.transform_keyvalue(row)

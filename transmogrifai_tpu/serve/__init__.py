@@ -1,0 +1,26 @@
+"""Production serving over a fitted workflow (docs/serving.md).
+
+Reference: the local/ module's OpWorkflowModelLocal pitched low-millisecond
+per-record scoring without a Spark session. This package is that pitch
+rebuilt for the XLA runtime, plus what a real server needs on top:
+
+- :mod:`engine` — ServingEngine: one fixed-shape scoring executable per
+  power-of-two batch bucket, AOT-prewarmed (and persistent-cache-backed,
+  so restarts skip XLA entirely), preallocated reused input buffers, a
+  post-warmup recompile watch riding the PR 4 RecompileTracker;
+- :mod:`batcher` — MicroBatcher: bounded admission queue, micro-batches
+  that dispatch when full or after ``max_wait_ms``, typed
+  :class:`~transmogrifai_tpu.serve.batcher.Overloaded` load-shedding and
+  graceful drain;
+- :mod:`frontend` — dependency-light stdlib HTTP/JSON frontend plus the
+  in-process ``submit()`` API tests and bench drive, and the
+  ``python -m transmogrifai_tpu serve`` CLI body.
+"""
+from .batcher import MicroBatcher, Overloaded
+from .engine import ServingEngine, bucket_ladder, template_record
+from .frontend import ServeFrontend, make_http_server, run_serve
+
+__all__ = [
+    "MicroBatcher", "Overloaded", "ServingEngine", "bucket_ladder",
+    "template_record", "ServeFrontend", "make_http_server", "run_serve",
+]

@@ -1,0 +1,237 @@
+"""Dependency-light HTTP/JSON frontend + the `serve` CLI body.
+
+Stdlib only (http.server.ThreadingHTTPServer) — the serving subsystem
+adds no dependency the batch library doesn't already carry. The HTTP
+layer is deliberately thin: every request body is one JSON record (or a
+list for bulk), the typed errors of the admission path map to status
+codes (validation -> 400, Overloaded -> 503, anything else -> 500), and
+`/metrics` serves the engine's own latency histograms. Tests and
+bench.py drive the same :class:`ServeFrontend` in-process through
+``submit()``/``submit_many()`` — the HTTP layer is transport, not logic.
+
+Endpoints:
+  POST /score     {record} -> scores; [records] -> bulk (bypasses queue)
+  GET  /healthz   liveness + warm/bucket state
+  GET  /metrics   engine counters + p50/p95/p99 latency histograms
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Union
+
+from ..local.scoring import (InvalidFeatureError, MissingFeatureError,
+                             UnknownFeatureError)
+from .batcher import MicroBatcher, Overloaded
+from .engine import ServingEngine
+
+_log = logging.getLogger("transmogrifai_tpu.serve")
+
+Record = Dict[str, Any]
+
+#: the typed client errors -> HTTP 400 (bad request, not a server fault)
+CLIENT_ERRORS = (UnknownFeatureError, MissingFeatureError,
+                 InvalidFeatureError)
+
+
+class ServeFrontend:
+    """In-process API the HTTP handler, tests and bench all share.
+
+    `max_bulk` bounds ONE HTTP bulk request (HTTP 413 above it): the
+    bulk lane bypasses the admission queue, so without a bound a single
+    giant list could hold the engine lock for minutes while single-
+    record traffic starves behind it with no shed available. In-process
+    callers (bench, batch jobs) call engine.score_batch directly when
+    they really mean row floods."""
+
+    def __init__(self, engine: ServingEngine, batcher: MicroBatcher,
+                 max_bulk: int = 65536):
+        self.engine = engine
+        self.batcher = batcher
+        self.max_bulk = int(max_bulk)
+
+    def submit(self, record: Record,
+               timeout: Optional[float] = None) -> Record:
+        """One record through the micro-batching queue."""
+        return self.batcher.submit(record, timeout=timeout)
+
+    def submit_many(self, records: List[Record]) -> List[Record]:
+        """Bulk scoring straight through the bucket ladder (no queue —
+        a bulk caller IS a batch already)."""
+        for r in records:
+            self.engine.validate_record(r)
+        return self.engine.score_batch(records)
+
+    def healthz(self) -> Dict[str, Any]:
+        return {"status": "ok" if self.engine.warm else "warming",
+                "warm": self.engine.warm,
+                "buckets": list(self.engine.buckets),
+                "queue_len": self.batcher.queue_len,
+                "closed": self.batcher.closed}
+
+    def metrics(self) -> Dict[str, Any]:
+        return self.engine.metrics()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "transmogrifai-tpu-serve"
+    frontend: ServeFrontend  # attached by make_http_server
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        _log.debug("http: " + fmt, *args)
+
+    def _reply(self, code: int, payload: Any) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
+        fe = self.server.frontend  # type: ignore[attr-defined]
+        if self.path == "/healthz":
+            self._reply(200, fe.healthz())
+        elif self.path == "/metrics":
+            self._reply(200, fe.metrics())
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        fe = self.server.frontend  # type: ignore[attr-defined]
+        if self.path != "/score":
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            doc: Union[Record, List[Record]] = json.loads(
+                self.rfile.read(length) or b"null")
+            if isinstance(doc, list):
+                if len(doc) > fe.max_bulk:
+                    self._reply(413, {
+                        "error": f"bulk request of {len(doc)} records "
+                                 f"exceeds max_bulk={fe.max_bulk}; "
+                                 f"split into smaller requests"})
+                    return
+                self._reply(200, fe.submit_many(doc))
+            elif isinstance(doc, dict):
+                self._reply(200, fe.submit(doc))
+            else:
+                self._reply(400, {"error": "body must be a JSON record "
+                                           "object or a list of records"})
+        except json.JSONDecodeError as e:
+            self._reply(400, {"error": f"invalid JSON: {e}"})
+        except CLIENT_ERRORS as e:
+            self._reply(400, {"error": str(e),
+                              "error_type": type(e).__name__})
+        except Overloaded as e:
+            self._reply(503, {"error": str(e), "error_type": "Overloaded"})
+        except TimeoutError as e:
+            self._reply(504, {"error": str(e)})
+        except Exception as e:  # pragma: no cover - systemic faults
+            _log.exception("serve: request failed")
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+def make_http_server(frontend: ServeFrontend, host: str = "127.0.0.1",
+                     port: int = 0) -> ThreadingHTTPServer:
+    """ThreadingHTTPServer bound to (host, port); port 0 picks an
+    ephemeral port (server.server_address[1] has the real one)."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.daemon_threads = True
+    httpd.frontend = frontend  # type: ignore[attr-defined]
+    return httpd
+
+
+# -- the `serve` CLI body -----------------------------------------------------
+
+def run_serve(args: Any) -> int:
+    """Body of ``python -m transmogrifai_tpu serve`` (cli.py parses).
+
+    --prewarm-only: compile every bucket, populate the persistent
+    compilation cache, write the serve.json manifest next to the model,
+    print one summary JSON line and exit — the deploy-time prewarm whose
+    cache entries make the NEXT process start compile-free.
+    """
+    if not logging.getLogger().handlers:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    from ..utils.metrics import collector
+    from ..workflow.workflow import WorkflowModel
+
+    model = WorkflowModel.load(args.model_dir)
+    metrics_loc = getattr(args, "metrics_location", None)
+    if metrics_loc:
+        os.makedirs(metrics_loc, exist_ok=True)
+        collector.enable("serve")
+        collector.attach_event_log(os.path.join(metrics_loc,
+                                                "events.jsonl"))
+
+    buckets = None
+    if getattr(args, "buckets", None):
+        buckets = [int(b) for b in str(args.buckets).split(",") if b]
+    example = None
+    if getattr(args, "example", None):
+        with open(args.example) as f:
+            example = json.load(f)
+
+    engine = ServingEngine(
+        model, max_batch=args.max_batch, buckets=buckets, example=example,
+        single_record=getattr(args, "single_record", "bucket"))
+    summary = engine.prewarm()
+
+    def _save_artifacts() -> None:
+        if not metrics_loc:
+            return
+        collector.save(os.path.join(metrics_loc,
+                                    "serve_stage_metrics.json"))
+        collector.save_chrome_trace(os.path.join(metrics_loc,
+                                                 "serve_trace.json"))
+        collector.detach_event_log()
+        collector.disable()
+
+    if getattr(args, "prewarm_only", False):
+        manifest = engine.write_manifest()
+        summary["manifest"] = manifest
+        _save_artifacts()
+        print(json.dumps({"prewarm": summary}, default=str))
+        return 0
+
+    batcher = MicroBatcher(engine, max_wait_ms=args.max_wait_ms,
+                           max_queue=args.max_queue)
+    frontend = ServeFrontend(engine, batcher)
+    httpd = make_http_server(frontend, host=args.host, port=args.port)
+    host, port = httpd.server_address[:2]
+    _log.info("serving %s on http://%s:%s (buckets %s, max_wait %.1fms, "
+              "queue %d)", args.model_dir, host, port,
+              list(engine.buckets), args.max_wait_ms, args.max_queue)
+
+    def _graceful(signum: int, frame: Any) -> None:
+        _log.info("signal %s: draining and shutting down", signum)
+        # shutdown() blocks until serve_forever returns — must not run on
+        # the signal-interrupted main thread itself
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _graceful)
+    except ValueError:  # not on the main thread (tests drive in-process)
+        pass
+
+    try:
+        httpd.serve_forever(poll_interval=0.1)
+    finally:
+        httpd.server_close()
+        batcher.shutdown(drain=True)
+        _save_artifacts()
+        _log.info("serve: drained; %d request(s), %d batch(es), "
+                  "%d shed, %d post-warmup compile(s)",
+                  engine.n_requests, engine.n_batches, engine.n_shed,
+                  engine.post_warmup_compiles)
+    return 0

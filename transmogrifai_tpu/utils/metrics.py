@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
@@ -161,6 +163,90 @@ class StatsPass:
         return dict(self.__dict__)
 
 
+class LatencyHistogram:
+    """Streaming-quantile latency histogram (the serving engine's p50/p95/
+    p99 source, docs/serving.md).
+
+    Fixed log-spaced buckets — `_BPD` per decade from 1µs to ~1000s — so
+    recording is O(1), memory is constant regardless of request count, and
+    quantiles come from the cumulative bucket counts with log-linear
+    interpolation inside the winning bucket (relative error bounded by the
+    bucket ratio, ~33% of a decade step at 7/decade — tight enough for
+    p50-vs-p99 shape, which is what the histogram exists to show).
+    Thread-safe: the serving engine records from the batcher thread and
+    every HTTP worker thread concurrently."""
+
+    _BPD = 7                     # buckets per decade
+    _LO = 1e-6                   # 1µs floor
+    _DECADES = 9                 # 1µs .. 1000s
+    _N = _BPD * _DECADES
+
+    def __init__(self, name: str = "latency") -> None:
+        self.name = name
+        self.count = 0
+        self.total_seconds = 0.0
+        self.max_seconds = 0.0
+        self._counts = [0] * (self._N + 1)  # +1 overflow bucket
+        self._lock = threading.Lock()
+
+    def _bucket(self, seconds: float) -> int:
+        if seconds <= self._LO:
+            return 0
+        b = int(math.log10(seconds / self._LO) * self._BPD)
+        return min(b, self._N)
+
+    #: upper bound of bucket b in seconds
+    def _bound(self, b: int) -> float:
+        return self._LO * 10.0 ** ((b + 1) / self._BPD)
+
+    def record(self, seconds: float) -> None:
+        s = max(float(seconds), 0.0)
+        with self._lock:
+            self.count += 1
+            self.total_seconds += s
+            if s > self.max_seconds:
+                self.max_seconds = s
+            self._counts[self._bucket(s)] += 1
+
+    def quantile(self, q: float) -> float:
+        """Latency (seconds) at quantile q in [0, 1]; 0.0 when empty."""
+        with self._lock:
+            if not self.count:
+                return 0.0
+            target = q * self.count
+            seen = 0
+            for b, c in enumerate(self._counts):
+                if not c:
+                    continue
+                if seen + c >= target:
+                    lo = self._LO * 10.0 ** (b / self._BPD) \
+                        if b else 0.0
+                    hi = min(self._bound(b), self.max_seconds)
+                    frac = (target - seen) / c
+                    return lo + (max(hi, lo) - lo) * frac
+                seen += c
+            return self.max_seconds
+
+    @property
+    def mean_seconds(self) -> float:
+        return self.total_seconds / self.count if self.count else 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        with self._lock:
+            count, total = self.count, self.total_seconds
+            mx = self.max_seconds
+            nonzero = {f"{self._bound(b) * 1e3:.3g}": c
+                       for b, c in enumerate(self._counts) if c}
+        ms = 1e3
+        return {"name": self.name, "count": count,
+                "mean_ms": round(total / count * ms, 4) if count else 0.0,
+                "p50_ms": round(self.quantile(0.50) * ms, 4),
+                "p95_ms": round(self.quantile(0.95) * ms, 4),
+                "p99_ms": round(self.quantile(0.99) * ms, 4),
+                "max_ms": round(mx * ms, 4),
+                "buckets_ms": nonzero}
+
+
 @dataclass
 class AppMetrics:
     """Whole-run metrics (reference AppMetrics)."""
@@ -172,6 +258,8 @@ class AppMetrics:
     kernel_metrics: List[KernelRoofline] = field(default_factory=list)
     sweep_metrics: List[SweepConvergence] = field(default_factory=list)
     stats_metrics: List[StatsPass] = field(default_factory=list)
+    latency_metrics: Dict[str, LatencyHistogram] = field(
+        default_factory=dict)
 
     @property
     def duration_seconds(self) -> float:
@@ -194,6 +282,9 @@ class AppMetrics:
         if self.stats_metrics:
             out["stats_metrics"] = [m.to_json()
                                     for m in self.stats_metrics]
+        if self.latency_metrics:
+            out["latency_metrics"] = {k: h.to_json() for k, h
+                                      in self.latency_metrics.items()}
         return out
 
     def pretty(self) -> str:
@@ -430,6 +521,22 @@ class MetricsCollector:
                    bytes_hbm=float(bytes_hbm),
                    wall_seconds=round(wall_seconds, 6), label=label)
         return rec
+
+    def latency(self, name: str, wall_seconds: float
+                ) -> Optional[LatencyHistogram]:
+        """Record one latency observation into the named streaming
+        histogram (no-op unless enabled). The serving engine reports its
+        per-request/per-phase walls here so p50/p95/p99 ride AppMetrics
+        JSON under "latency_metrics" next to the kernel/sweep telemetry —
+        same numbers the engine's own /metrics endpoint serves."""
+        if not self.enabled:
+            return None
+        hist = self.current.latency_metrics.get(name)
+        if hist is None:
+            hist = self.current.latency_metrics.setdefault(
+                name, LatencyHistogram(name))
+        hist.record(wall_seconds)
+        return hist
 
     def save(self, path: str, close: bool = True) -> None:
         """AppMetrics JSON + (new) the span tree under "spans" — every

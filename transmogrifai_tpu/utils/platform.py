@@ -10,10 +10,35 @@ the same defense tests/conftest.py applied in round 1, now shared.
 """
 from __future__ import annotations
 
+import logging
 import os
 import re
+from typing import Optional
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+_log = logging.getLogger("transmogrifai_tpu.platform")
+
+#: the directory enable_compilation_cache last pointed jax at (None =
+#: cache disabled / not yet configured) — serve --prewarm-only reports it
+_cache_dir: Optional[str] = None
+_cache_logged: object = ()  # last state logged; () = nothing yet
+
+
+def _log_cache_state(state: Optional[str], msg: str, *args: object) -> None:
+    """One line per distinct cache state — startup logs once, and a
+    re-point (force_cpu re-scoping the dir) logs the new location
+    instead of leaving the stale line as the record."""
+    global _cache_logged
+    if _cache_logged != state:
+        _cache_logged = state
+        _log.info(msg, *args)
+
+
+def compile_cache_dir() -> Optional[str]:
+    """Active persistent-compilation-cache directory, or None when the
+    cache is disabled (opt-out, read-only home, old jax)."""
+    return _cache_dir
 
 
 def force_cpu(n_devices: int = 8) -> None:
@@ -58,12 +83,28 @@ def enable_compilation_cache() -> None:
     flow skips compilation entirely — the serving-cold-start story of
     the reference's MLeap path, solved the XLA way.
 
-    Directory: $TMOG_COMPILE_CACHE if set ("0"/"off" disables), else
-    ~/.cache/transmogrifai_tpu/xla. Safe to call repeatedly and before
-    or after backend init (jax reads these configs per compile).
+    Directory: `TMOG_COMPILE_CACHE_DIR` (the documented knob — an
+    explicit directory taken as-is, or "0"/"off" to disable; the serve
+    prewarm story in docs/serving.md keys off it), falling back to the
+    older `TMOG_COMPILE_CACHE` spelling, else a machine-scoped default
+    under ~/.cache/transmogrifai_tpu/xla-*. One line is logged at startup
+    (logger `transmogrifai_tpu.platform`) saying whether the cache is
+    active and where — `serve --prewarm-only` is only useful when it is.
+    Safe to call repeatedly and before or after backend init, BUT the
+    dir must be settled before the process's FIRST compile: jax
+    initializes its compilation-cache singleton on first use, and a
+    re-point after that is silently ignored (measured — a serving
+    restart therefore exports TMOG_COMPILE_CACHE_DIR at launch, not
+    mid-process). force_cpu's re-point is fine: it runs before any
+    compile by the module contract.
     """
-    loc = os.environ.get("TMOG_COMPILE_CACHE", "").strip()
+    global _cache_dir, _cache_logged
+    loc = os.environ.get("TMOG_COMPILE_CACHE_DIR",
+                         os.environ.get("TMOG_COMPILE_CACHE", "")).strip()
     if loc.lower() in ("0", "off", "none", "disable"):
+        _cache_dir = None
+        _log_cache_state(None, "persistent compile cache: DISABLED "
+                               "(opt-out)")
         return
     if not loc:
         # scope the default cache by the host's CPU feature set: XLA:CPU
@@ -107,6 +148,9 @@ def enable_compilation_cache() -> None:
     try:
         os.makedirs(loc, exist_ok=True)
     except OSError:
+        _cache_dir = None
+        _log_cache_state(None, "persistent compile cache: DISABLED "
+                               "(cannot create %s)", loc)
         return  # read-only home: run uncached
     import jax
 
@@ -124,4 +168,9 @@ def enable_compilation_cache() -> None:
         jax.config.update("jax_compilation_cache_max_size",
                           2 * 1024 ** 3)
     except Exception:
-        pass  # older jax without these configs: run uncached
+        _cache_dir = None
+        _log_cache_state(None, "persistent compile cache: DISABLED "
+                               "(jax too old for cache configs)")
+        return  # older jax without these configs: run uncached
+    _cache_dir = loc
+    _log_cache_state(loc, "persistent compile cache: ACTIVE at %s", loc)

@@ -50,10 +50,16 @@ __all__ = [
     "write_chrome_trace", "trace_report",
 ]
 
-# the monitoring event one XLA backend compilation emits (jax >= 0.4.x);
-# cache hits from the persistent compile cache do NOT emit it, so the
-# count is true recompiles, not cache loads
+# the monitoring event one XLA backend compilation emits (jax >= 0.4.x).
+# NOTE (measured on this image's jaxlib): a persistent-compilation-cache
+# HIT emits it too — but a hit is PRECEDED by the cache-retrieval event
+# below, so the tracker classifies the pair and keeps a separate
+# total_cache_hits counter (total_compiles keeps counting both, byte-
+# compatible with every pre-serving consumer; true compiles =
+# total_compiles - total_cache_hits, what the serving engine's
+# post-warmup recompile watch reads)
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_retrieval_time_sec"
 
 
 @dataclass
@@ -271,13 +277,28 @@ class RecompileTracker:
         self._mode = "monitoring"
         self.total_compiles = 0
         self.total_compile_seconds = 0.0
+        self.total_cache_hits = 0
+        # a retrieval event and ITS compile event fire back-to-back on
+        # the SAME thread, so the pairing flag is thread-local: compiles
+        # interleaving from helper threads cannot steal another thread's
+        # pending hit and misclassify a true compile as a cache load
+        self._pending = threading.local()
         self.by_program: Dict[str, int] = {}
+
+    @property
+    def true_compiles(self) -> int:
+        """Compiles that actually ran XLA (persistent-cache loads
+        excluded) — the serving engine's zero-recompile contract counts
+        THESE; a prewarmed restart is all cache hits and reads 0."""
+        return max(self.total_compiles - self.total_cache_hits, 0)
 
     # -- lifecycle ---------------------------------------------------------
     def activate(self, tree: TraceTree) -> None:
         self._tree = tree
         self.total_compiles = 0
         self.total_compile_seconds = 0.0
+        self.total_cache_hits = 0
+        self._pending = threading.local()
         self.by_program = {}
         if self._monitoring_available():
             self._install_listener()
@@ -318,11 +339,23 @@ class RecompileTracker:
         # the listener survives activate/deactivate cycles (jax has no
         # public unregister); in fallback mode it must stay silent or a
         # later re-activation would double-book with the sampler
-        if tree is None or self._mode != "monitoring" \
-                or event != _COMPILE_EVENT:
+        if tree is None or self._mode != "monitoring":
             return
+        if event == _CACHE_HIT_EVENT:
+            # a persistent-cache retrieval fires immediately BEFORE its
+            # compile event (measured order, same thread); mark the pair
+            # so THIS thread's next compile books as a cache LOAD, not a
+            # true XLA compile
+            self._pending.cache_hit = True
+            return
+        if event != _COMPILE_EVENT:
+            return
+        hit = getattr(self._pending, "cache_hit", False)
+        self._pending.cache_hit = False
         self.total_compiles += 1
         self.total_compile_seconds += float(duration)
+        if hit:
+            self.total_cache_hits += 1
         # the whole read-modify-write under the tree lock: the class
         # contract says the listener may fire from helper threads, and an
         # unlocked attrs update would race close()'s watermark update
@@ -334,6 +367,9 @@ class RecompileTracker:
             sp.attrs["compile_seconds"] = round(
                 float(sp.attrs.get("compile_seconds", 0.0))
                 + float(duration), 4)
+            if hit:
+                sp.attrs["cache_hits"] = \
+                    int(sp.attrs.get("cache_hits", 0)) + 1
             self.by_program[sp.name] = self.by_program.get(sp.name, 0) + 1
 
     # -- fallback path (span-boundary sampling) ----------------------------
@@ -637,6 +673,16 @@ def trace_report(run_dir: str, check: bool = False,
     if os.path.exists(event_log):
         n_events, probs, event_counts = _check_event_log(event_log)
         problems.extend(probs)
+        # serving contract (docs/serving.md): the engine emits one
+        # serve_recompile event for every XLA compile that lands AFTER
+        # its warmup finished — under the prewarmed bucket ladder there
+        # must be none, so any such event fails --check exactly like a
+        # schema violation (the ci.sh serving smoke pins this)
+        n_rc = event_counts.get("serve_recompile", 0)
+        if n_rc:
+            problems.append(
+                f"{event_log}: {n_rc} serve_recompile event(s) — XLA "
+                f"compile(s) landed after serving warmup")
 
     for mf in metric_files:
         try:

@@ -53,7 +53,10 @@ class LayerRunner:
     # -- one layer ---------------------------------------------------------
     def apply_layer(self, ds: Dataset,
                     transformers: Sequence[Transformer],
-                    sinks: Optional[Tuple[Dict, Dict]] = None) -> Dataset:
+                    sinks: Optional[Tuple[Dict, Dict]] = None,
+                    traced: bool = True) -> Dataset:
+        import contextlib
+
         producer_views, combiner_plans = sinks or ({}, {})
         for st in transformers:
             ds = _ensure_input_columns(ds, st)
@@ -66,15 +69,22 @@ class LayerRunner:
             (fusable if ok else host).append(st)
 
         from ..utils.metrics import collector
+
+        def span(*args, **kw):
+            # traced=False: the serving engine's per-request path — a span
+            # per stage per request would grow the in-memory tree without
+            # bound under traffic (the engine records ONE span per batch
+            # instead, workflow.score_fixed / serve/engine.py)
+            return collector.span(*args, **kw) if traced \
+                else contextlib.nullcontext()
+
         if fusable:
-            with collector.span(
-                    "+".join(st.stage_name for st in fusable)[:120],
-                    fusable[0].uid, "fused-transform", n_rows=len(ds),
-                    n_stages_fused=len(fusable)):
+            with span("+".join(st.stage_name for st in fusable)[:120],
+                      fusable[0].uid, "fused-transform", n_rows=len(ds),
+                      n_stages_fused=len(fusable)):
                 ds = self._apply_fused(ds, fusable)
         for st in host:
-            with collector.span(st.stage_name, st.uid, "transform",
-                                n_rows=len(ds)):
+            with span(st.stage_name, st.uid, "transform", n_rows=len(ds)):
                 plan = combiner_plans.get(st.uid)
                 view = producer_views.get(st.uid)
                 if plan is not None:
@@ -200,9 +210,14 @@ class LayerRunner:
         return ds
 
     # -- whole DAG ---------------------------------------------------------
-    def apply_dag(self, ds: Dataset, dag: StagesDAG) -> Dataset:
+    def apply_dag(self, ds: Dataset, dag: StagesDAG,
+                  traced: bool = True) -> Dataset:
         """Score path: every stage must already be a transformer (reference
-        OpWorkflowCore.applyTransformationsDAG:290)."""
+        OpWorkflowCore.applyTransformationsDAG:290). traced=False skips
+        all per-layer/per-stage span bookkeeping (the serving fast path,
+        WorkflowModel.score_fixed)."""
+        import contextlib
+
         from ..utils.metrics import collector
         for layer in dag.layers:
             for st in layer:
@@ -212,10 +227,12 @@ class LayerRunner:
                         f"train the workflow first")
         sinks = self._plan_sinks(ds, dag)
         for i, layer in enumerate(dag.layers):
-            with collector.trace_span(f"layer_{i}", kind="layer",
-                                      n_stages=len(layer)):
-                ds = self.apply_layer(ds, layer,
-                                      sinks)  # type: ignore[arg-type]
+            span = collector.trace_span(f"layer_{i}", kind="layer",
+                                        n_stages=len(layer)) if traced \
+                else contextlib.nullcontext()
+            with span:
+                ds = self.apply_layer(ds, layer, sinks,  # type: ignore[arg-type]
+                                      traced=traced)
         return ds
 
     def fit_dag(self, ds: Dataset, dag: StagesDAG,

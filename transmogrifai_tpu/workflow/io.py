@@ -26,6 +26,7 @@ from .workflow import WorkflowModel
 
 MODEL_JSON = "op-model.json"
 ARRAYS_NPZ = "arrays.npz"
+SERVE_JSON = "serve.json"
 FORMAT_VERSION = 1
 
 
@@ -162,9 +163,42 @@ def load_model(path: str,
         except ImportError:
             rff = None
 
-    return WorkflowModel(
+    model = WorkflowModel(
         result_features=[feats[u] for u in doc["result_feature_uids"]],
         dag=dag,
         blacklist=doc.get("blacklisted_features", []),
         rff_results=rff,
     )
+    # model-load hook for serving: remember WHERE the artifact lives so
+    # the engine can pick up the prewarm manifest (serve.json) written by
+    # `serve --prewarm-only` without the caller re-plumbing the path
+    model.source_path = path
+    return model
+
+
+# -- serving prewarm manifest -------------------------------------------------
+# `serve --prewarm-only` records the bucket ladder + template record it
+# compiled alongside the model artifact; a later `serve <dir>` (same or
+# fresh process) prewarms the SAME ladder, so every executable is a
+# persistent-compilation-cache hit and startup performs zero XLA compiles
+# (docs/serving.md "Deploy-time prewarm").
+
+def save_serve_manifest(model_dir: str, manifest: Dict[str, Any]) -> str:
+    p = os.path.join(model_dir, SERVE_JSON)
+    with open(p, "w") as fh:
+        json.dump(manifest, fh, indent=1, default=str)
+    return p
+
+
+def load_serve_manifest(model_dir: Optional[str]) -> Optional[Dict[str, Any]]:
+    if not model_dir:
+        return None
+    p = os.path.join(model_dir, SERVE_JSON)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as fh:
+            doc = json.load(fh)
+        return doc if isinstance(doc, dict) else None
+    except (OSError, json.JSONDecodeError):
+        return None  # a corrupt manifest must not block serving startup

@@ -274,6 +274,9 @@ class WorkflowModel:
         self.rff_results = rff_results
         self._train_data: Optional[Dataset] = None
         self._reader: Optional[Reader] = None
+        #: directory this model was loaded from (io.load_model sets it) —
+        #: the serving engine keys its prewarm manifest off it
+        self.source_path: Optional[str] = None
 
     # -- access ------------------------------------------------------------
     @property
@@ -325,6 +328,21 @@ class WorkflowModel:
         keep += [f.name for f in self.result_features if f.name in full]
         if keep_raw_features:
             keep = [f.name for f in self.raw_features() if f.name in full] + keep
+        return full.select(keep)
+
+    def score_fixed(self, ds: Dataset) -> Dataset:
+        """Fixed-shape serving entry (serve/engine.py): the same compiled
+        per-layer programs as score(), with ZERO per-call span/gc
+        bookkeeping — transform()'s trace_span plus the per-layer and
+        per-stage spans grow the in-memory span tree per call, which a
+        request loop must not do. Callers own the batch shape: pad to a
+        prewarmed bucket (the runner's jit cache then re-uses the bucket's
+        executables; any new shape compiles fresh, which the engine's
+        post-warmup recompile watch will flag)."""
+        full = self.runner.apply_dag(ds, self.dag, traced=False)
+        from ..readers.readers import KEY_COLUMN
+        keep = [KEY_COLUMN] if KEY_COLUMN in full else []
+        keep += [f.name for f in self.result_features if f.name in full]
         return full.select(keep)
 
     def score_and_evaluate(self, evaluator: Evaluator,
@@ -426,3 +444,11 @@ class WorkflowModel:
     def score_function(self) -> Callable[[Dict[str, Any]], Dict[str, Any]]:
         from ..local.scoring import score_function
         return score_function(self)
+
+    # -- serving hook (serve/engine.py) ------------------------------------
+    def serving_engine(self, **kwargs) -> Any:
+        """Production serving engine over this fitted model: AOT-prewarmed
+        shape-bucketed executables + micro-batching (docs/serving.md).
+        Keyword args forward to serve.engine.ServingEngine."""
+        from ..serve.engine import ServingEngine
+        return ServingEngine(self, **kwargs)
