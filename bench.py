@@ -381,12 +381,55 @@ def device_sweeps(X, y, cfg, sweep_dtype, errors):
         getattr(best_tree, "kernel_roofline", None) or []
     if kernel_roofline:
         out["kernel_roofline"] = kernel_roofline
+    if best_tree is not None:
+        # TMOG_TREE_SCAN A/B marker + the compile-wall proxy it moves:
+        # artifacts from scan-on and scan-off runs stay attributable.
+        # Like tree_route, the child's own values win when the sweep ran
+        # in a subprocess (its flags/spans are the ones that fitted)
+        from transmogrifai_tpu.ops import trees as _T
+        child_scan = getattr(best_tree, "tree_scan", None)
+        out["tree_scan"] = bool(_T.tree_scan_enabled()) \
+            if child_scan is None else bool(child_scan)
+        tts = getattr(best_tree, "tree_trace_s", None)
+        if tts is None:
+            tts = tree_trace_seconds(kernel_roofline)
+        if tts:
+            out["tree_trace_s"] = tts
     child_flops = getattr(best_tree, "fit_flops", 0.0)
     if child_flops:
         out["tree_fit_flops"] = child_flops
     if glm_warm_s is not None:
         out["glm_warm_s"] = round(glm_warm_s, 3)
     return out
+
+
+def tree_trace_seconds(kernel_roofline):
+    """Cold-minus-warm compile proxy from the tree sweep's own roofline
+    spans: a cold span's wall includes jit trace + Mosaic compile, so
+    subtracting the median warm wall of the same kernel label leaves the
+    trace+compile share. Labels with no warm twin contribute their full
+    cold wall (an upper bound). This is the number the level-scan rewrite
+    attacks — O(1) programs in depth — so BENCH JSON carries it as
+    `tree_trace_s` next to the `tree_scan` flag for TMOG_TREE_SCAN A/B
+    runs (docs/performance.md). Spans group by (kernel, bytes_hbm):
+    analytic bytes are a pure function of the program shape (rows,
+    lanes, depth, rounds, itemsize), so a grid sweep whose chunking
+    emits several lane counts under one label never mixes one shape's
+    warm walls into another shape's cold baseline."""
+    by = {}
+    for k in kernel_roofline or []:
+        by.setdefault((k.get("kernel"), k.get("bytes_hbm")), []).append(k)
+    total = 0.0
+    for spans in by.values():
+        colds = [float(s.get("wall_seconds", 0.0)) for s in spans
+                 if s.get("cold")]
+        warms = sorted(float(s.get("wall_seconds", 0.0)) for s in spans
+                       if not s.get("cold"))
+        if not colds:
+            continue
+        warm_med = warms[len(warms) // 2] if warms else 0.0
+        total += sum(max(c - warm_med, 0.0) for c in colds)
+    return round(total, 3)
 
 
 def tree_route_label(cfg):
@@ -411,13 +454,16 @@ class _TreeSweepResult:
     sweep ran in a child process (only the fields device_sweeps reads)."""
 
     def __init__(self, name, best_grid, best_metric, fit_flops=0.0,
-                 tree_route=None, kernel_roofline=None):
+                 tree_route=None, kernel_roofline=None, tree_scan=None,
+                 tree_trace_s=None):
         self.tree_route = tree_route
         self.name = name
         self.best_grid = best_grid
         self.best_metric = best_metric
         self.fit_flops = fit_flops
         self.kernel_roofline = kernel_roofline or []
+        self.tree_scan = tree_scan
+        self.tree_trace_s = tree_trace_s
 
 
 def tree_sweep_child(cfg):
@@ -447,11 +493,14 @@ def tree_sweep_child(cfg):
     # per-fit FLOPs from XLA cost analysis, here where the jit cache is
     # warm (the parent would re-lower — and re-risk a pallas compile hang)
     flops = tree_flops_cost_analysis(cfg, dtype)
+    from transmogrifai_tpu.ops import trees as _T
     print("TREE|" + json.dumps(dict(
         tree_s=round(dt, 3), name=best.name, best_grid=best.best_grid,
         best_metric=float(best.best_metric), fit_flops=flops,
         pallas=pallas_hist.available(),
         kernel_roofline=kernel_roofline,
+        tree_scan=bool(_T.tree_scan_enabled()),
+        tree_trace_s=tree_trace_seconds(kernel_roofline),
         tree_route=tree_route_label(cfg))), flush=True)
 
 
@@ -502,7 +551,9 @@ def _tree_sweep_subprocess(cfg, errors, timeout_s=None):
                                          d["best_metric"],
                                          d.get("fit_flops", 0.0),
                                          d.get("tree_route"),
-                                         d.get("kernel_roofline")),
+                                         d.get("kernel_roofline"),
+                                         d.get("tree_scan"),
+                                         d.get("tree_trace_s")),
                         d["tree_s"], True)
         stderr = (r.stderr or "").strip()
         # device-contention init failure: the runtime is single-tenant,

@@ -388,6 +388,108 @@ print(f"serving smoke ok: 0 prewarm compiles ({warm['cache_hits']} cache "
       f"hits), {m['requests']} requests, p50 {p50}ms, clean drain")
 PY
 rm -rf "$SERVE_TMP"
+# tree-sweep smoke on the 2-device CPU mesh: the mesh-sharded fused sweep
+# (TMOG_GRID_FUSE=1 + a mesh validator) must take the
+# mask_folds:grid_fused_sharded route, match the meshless fused kernel's
+# margins at the metric level, and — the level-scan contract — a re-sweep
+# at the same (shape, depth) must book ZERO true compiles, asserted from
+# the saved span artifact (not just in-process state)
+TMOG_GRID_FUSE=1 PYTHONPATH="$PWD" python - "$TRACE_DIR" <<'PY'
+import json
+import sys
+
+out = sys.argv[1]
+from transmogrifai_tpu.utils.platform import force_cpu
+
+force_cpu(2)
+import numpy as np
+import jax.numpy as jnp
+
+from transmogrifai_tpu.automl.tuning.validators import CrossValidation
+from transmogrifai_tpu.evaluators.evaluators import Evaluators
+from transmogrifai_tpu.models.trees import OpXGBoostClassifier
+from transmogrifai_tpu.ops import trees as T
+from transmogrifai_tpu.parallel.mesh import make_mesh
+from transmogrifai_tpu.utils.metrics import collector
+
+rng = np.random.default_rng(0)
+n, d = 900, 6
+X = rng.normal(size=(n, d)).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1]
+     + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+grids = [{"eta": 0.1, "reg_lambda": 1.0}, {"eta": 0.3, "reg_lambda": 5.0}]
+mesh = make_mesh(n_batch=2, n_model=1)
+ev = Evaluators.BinaryClassification.au_pr()
+
+collector.enable("ci_tree_mesh_sweep")
+collector.attach_event_log(out + "/events.jsonl")
+with collector.trace_span("tree_sweep_cold", kind="sweep_fit"):
+    val = CrossValidation(ev, num_folds=2, seed=42, mesh=mesh)
+    best = val.validate([(OpXGBoostClassifier(
+        num_round=4, max_depth=3, max_bins=15),
+        [dict(g) for g in grids])], X, y)
+routes = [v.route for v in best.validated]
+assert all(r == "mask_folds:grid_fused_sharded" for r in routes), routes
+with collector.trace_span("tree_sweep_warm", kind="sweep_fit"):
+    best2 = CrossValidation(ev, num_folds=2, seed=42, mesh=mesh).validate(
+        [(OpXGBoostClassifier(num_round=4, max_depth=3, max_bins=15),
+          [dict(g) for g in grids])], X, y)
+for v1, v2 in zip(best.validated, best2.validated):
+    np.testing.assert_allclose(v1.fold_metrics, v2.fold_metrics, rtol=1e-6)
+
+# meshless reference: the same lanes through the single-device fused
+# kernel — sharded psum-merged margins must agree at the metric level
+vs = CrossValidation(ev, num_folds=2, seed=42).validate(
+    [(OpXGBoostClassifier(num_round=4, max_depth=3, max_bins=15),
+      [dict(g) for g in grids])], X, y)
+for vm, vx in zip(best.validated, vs.validated):
+    np.testing.assert_allclose(vm.fold_metrics, vx.fold_metrics,
+                               rtol=1e-3, atol=1e-4)
+collector.finish()
+collector.save(out + "/tree_mesh_stage_metrics.json")
+collector.save_chrome_trace(out + "/tree_mesh_trace.json")
+collector.detach_event_log()
+collector.disable()
+
+# compile count FROM THE ARTIFACT: the warm re-sweep's tree_shard_merge
+# spans must book 0 compiles (the level-scan program for this (shape,
+# depth) already exists), while the cold sweep compiled at least one
+doc = json.load(open(out + "/tree_mesh_stage_metrics.json"))
+spans = doc["spans"]
+
+
+def subtree_ids(root_name):
+    ids = {s["span_id"] for s in spans if s["name"] == root_name}
+    assert ids, root_name
+    grew = True
+    while grew:
+        grew = False
+        for s in spans:
+            if s.get("parent_id") in ids and s["span_id"] not in ids:
+                ids.add(s["span_id"])
+                grew = True
+    return ids
+
+
+def compiles_in(ids, name=None):
+    return sum(int(s.get("attrs", {}).get("compiles", 0))
+               for s in spans if s["span_id"] in ids
+               and (name is None or s["name"] == name))
+
+
+merge_spans = [s for s in spans if s["name"] == "tree_shard_merge"]
+assert merge_spans, "sharded sweep must record tree_shard_merge spans"
+cold = compiles_in(subtree_ids("tree_sweep_cold"))
+# the warm sweep may re-jit validator-local helpers (fresh fold_metrics
+# closure per validate); the level-scan contract is about the FUSED FIT:
+# its tree_shard_merge spans must book zero compiles on the re-sweep
+warm_merge = compiles_in(subtree_ids("tree_sweep_warm"),
+                         name="tree_shard_merge")
+print(f"tree mesh sweep smoke ok: routes={routes[0]}, cold compiles="
+      f"{cold}, warm fused-fit compiles={warm_merge}")
+assert cold >= 1, f"cold sweep booked {cold} compiles"
+assert warm_merge == 0, f"warm re-sweep recompiled: {warm_merge}"
+PY
 PYTHONPATH="$PWD" python -m transmogrifai_tpu trace-report "$TRACE_DIR" --check
 # the stats_pass spans must be visible to trace tooling (not just the
 # in-process assert above): grep the exported chrome trace

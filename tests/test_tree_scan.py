@@ -1,0 +1,390 @@
+"""Whole-tree level-scan growth + mesh-sharded sweep lanes.
+
+The fused tree fit (ops/trees.fit_gbt_folds) grows every mid-tree level
+inside ONE lax.scan with fixed max-shape carries (TMOG_TREE_SCAN, default
+on), so program size — and the Mosaic compile wall it drives — is O(1) in
+depth instead of O(depth). Contracts pinned here:
+
+  1. the scan form is DECISION/MARGIN BIT-EXACT with the legacy unrolled
+     form across a parity zoo (depths 1-6, colsample_bylevel,
+     alpha/max_delta_step, per-lane scalar vectors, squared loss,
+     subsample, non-unit weights);
+  2. jitted program count is depth-independent for a fixed shape: a
+     re-sweep at the same (shape, depth) costs 0 true compiles and a
+     depth change costs exactly 1 (RecompileTracker);
+  3. the mesh route: fit_gbt_folds_sharded (shard_map over the batch
+     axis, psum-merged per-level histograms) matches the single-device
+     fused fit on the 2-device CPU mesh, and mask_fit_scores_grid takes
+     it instead of falling back per-fold;
+  4. uint8 binning for 128..255 bins is decision-identical to int32.
+"""
+import contextlib
+import functools
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from transmogrifai_tpu.ops import trees as T
+from transmogrifai_tpu.parallel.mesh import make_mesh
+from transmogrifai_tpu.utils.metrics import collector
+
+
+def _data(n=700, f=6, b=7, folds=3, seed=0, unit_w=True):
+    rng = np.random.default_rng(seed)
+    Xb = rng.integers(0, b + 1, size=(n, f)).astype(np.int8)  # 0 = missing
+    y = (rng.uniform(size=n) < 0.4).astype(np.float32)
+    masks = (rng.integers(0, folds, size=n)[None, :]
+             != np.arange(folds)[:, None]).astype(np.float32)
+    W = masks if unit_w else masks * rng.uniform(
+        0.5, 2.0, size=n).astype(np.float32)[None, :]
+    return jnp.asarray(Xb), jnp.asarray(y), jnp.asarray(W)
+
+
+@contextlib.contextmanager
+def scan_mode(on: bool):
+    prev = T.tree_scan_enabled()
+    T.set_tree_scan(on)
+    try:
+        yield
+    finally:
+        T.set_tree_scan(prev)
+
+
+def _fit_both(Xb, y, W, key, **kw):
+    with scan_mode(False):
+        un = T.fit_gbt_folds(Xb, y, W, key, **kw)
+    with scan_mode(True):
+        sc = T.fit_gbt_folds(Xb, y, W, key, **kw)
+    return un, sc
+
+
+def _assert_fit_equal(a, b, msg=""):
+    ta, ba, ma = a
+    tb, bb, mb = b
+    for fld in ("feat", "thresh", "miss", "leaf"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ta, fld)), np.asarray(getattr(tb, fld)),
+            err_msg=f"{msg} tree.{fld}")
+    np.testing.assert_array_equal(np.asarray(ba), np.asarray(bb),
+                                  err_msg=f"{msg} base")
+    np.testing.assert_array_equal(np.asarray(ma), np.asarray(mb),
+                                  err_msg=f"{msg} margins")
+
+
+class TestScanParityZoo:
+    """Scan vs unrolled: every tree decision and every margin bit-exact."""
+
+    @pytest.mark.parametrize("depth", [1, 2, 3, 4, 5, 6])
+    def test_depths(self, depth):
+        Xb, y, W = _data()
+        kw = dict(n_rounds=2, depth=depth, n_bins=7, learning_rate=0.3,
+                  reg_lambda=1.0, loss="logistic")
+        un, sc = _fit_both(Xb, y, W, jax.random.PRNGKey(7), **kw)
+        _assert_fit_equal(un, sc, f"depth={depth}")
+
+    @pytest.mark.parametrize("kw", [
+        dict(colsample_bylevel=0.5),
+        dict(alpha=0.4, max_delta_step=0.7),
+        dict(colsample_bylevel=0.6, alpha=0.2, min_child_weight=1.0,
+             gamma=0.05),
+        dict(loss="squared"),
+        dict(subsample=0.7),
+        dict(feature_frac=0.6, colsample_bylevel=0.7),
+    ], ids=["bylevel", "alpha_mds", "bylevel_alpha_mcw_gamma", "squared",
+            "subsample", "bytree_bylevel"])
+    def test_param_tail(self, kw):
+        Xb, y, W = _data(n=640, seed=3, unit_w=False)
+        base = dict(n_rounds=3, depth=3, n_bins=7, learning_rate=0.2,
+                    reg_lambda=1.5, loss="logistic")
+        base.update(kw)
+        un, sc = _fit_both(Xb, y, W, jax.random.PRNGKey(11), **base)
+        _assert_fit_equal(un, sc, str(kw))
+
+    def test_per_lane_scalar_vectors(self):
+        """The config-fused sweep's per-lane eta/lambda/mcw/gamma vectors
+        ride through the scan carries unchanged."""
+        Xb, y, W = _data(folds=3, seed=5)
+        kw = dict(
+            n_rounds=3, depth=4, n_bins=7, loss="logistic",
+            learning_rate=jnp.asarray([0.1, 0.2, 0.3], jnp.float32),
+            reg_lambda=jnp.asarray([1.0, 2.0, 0.5], jnp.float32),
+            min_child_weight=jnp.asarray([0.0, 1.0, 0.0], jnp.float32),
+            gamma=jnp.asarray([0.0, 0.05, 0.0], jnp.float32))
+        un, sc = _fit_both(Xb, y, W, jax.random.PRNGKey(42), **kw)
+        _assert_fit_equal(un, sc, "lane vectors")
+
+    def test_kill_switch_selects_the_legacy_path(self, monkeypatch):
+        """TMOG_TREE_SCAN=0 (set_tree_scan(False)) must trace the legacy
+        unrolled body — not the scan with different plumbing."""
+        Xb, y, W = _data(n=320)
+        kw = dict(n_rounds=1, depth=2, n_bins=7)
+
+        def boom(*a, **k):
+            raise AssertionError("scan path used under TMOG_TREE_SCAN=0")
+
+        with scan_mode(False):
+            monkeypatch.setattr(T, "_grow_tree_folds_scan", boom)
+            T.fit_gbt_folds(Xb, y, W, jax.random.PRNGKey(0), **kw)
+        monkeypatch.undo()
+
+        def boom2(*a, **k):
+            raise AssertionError("unrolled path used with scan enabled")
+
+        with scan_mode(True):
+            monkeypatch.setattr(T, "_grow_tree_folds_unrolled", boom2)
+            T.fit_gbt_folds(Xb, y, W, jax.random.PRNGKey(0), **kw)
+
+
+class TestProgramCount:
+    """The compile-knee contract: one executable per (shape, depth)."""
+
+    def _run(self, Xb, y, W, depth):
+        with scan_mode(True):
+            out = T.fit_gbt_folds(Xb, y, W, jax.random.PRNGKey(1),
+                                  n_rounds=2, depth=depth, n_bins=7)
+        jax.block_until_ready(out)
+        return out
+
+    def test_resweep_zero_depth_change_one(self):
+        Xb, y, W = _data(n=512, seed=9)
+        # warm: both depths' helper programs (array placement etc.) and
+        # depth 3's fit executable
+        self._run(Xb, y, W, 3)
+        c = collector
+        c.enable("tree_scan_compiles")
+        try:
+            with c.trace_span("resweep", kind="sweep_fit"):
+                self._run(Xb, y, W, 3)
+            with c.trace_span("deeper", kind="sweep_fit"):
+                self._run(Xb, y, W, 4)
+            c.finish()
+        finally:
+            c.disable()
+        by = {s.name: s for s in c.trace.spans}
+        assert int(by["resweep"].attrs.get("compiles", 0)) == 0, \
+            "re-sweep at the same (shape, depth) must hit the jit cache"
+        assert int(by["deeper"].attrs.get("compiles", 0)) == 1, \
+            "a depth change must cost exactly ONE fresh executable"
+
+
+class TestShardedLanes:
+    """Mesh-sharded (fold x config) lanes: psum-merged histograms.
+
+    The strongest pin is BIT-EXACT: a 1-round squared-loss fit with
+    base_score=0.0 has integer gradient/hessian payloads (g = -w*y,
+    h = w with 0/1 weights), so every histogram cell is an integer sum
+    < 2^24 — exact in f32 under ANY summation order, including the
+    cross-shard psum. Trees and margins must then match the
+    single-device fused fit bit for bit, isolating the psum plumbing
+    from the separate (documented) near-tie effect: with real-valued
+    payloads, psum reordering perturbs gains at the ulp level and an
+    argmax between near-equal split candidates may flip — exactly why
+    the validator keys mesh checkpoints separately (_sweep_path)."""
+
+    def _int_kw(self):
+        return dict(n_rounds=1, depth=3, n_bins=7, learning_rate=0.5,
+                    reg_lambda=1.0, loss="squared", base_score=0.0)
+
+    def test_sharded_bit_exact_on_integer_payloads(self):
+        Xb, y, W = _data(n=640, folds=2, seed=1)
+        mesh = make_mesh(n_batch=2, n_model=1)
+        key = jax.random.PRNGKey(3)
+        un = T.fit_gbt_folds(Xb, y, W, key, **self._int_kw())
+        sh = T.fit_gbt_folds_sharded(Xb, y, W, key, mesh=mesh,
+                                     **self._int_kw())
+        _assert_fit_equal(un, sh, "sharded integer payloads")
+        # trees replicate: every shard grew from the same psum'd hists
+        assert np.asarray(sh[0].feat).shape == (1, 2, 7)
+
+    def test_sharded_per_lane_vectors_bit_exact(self):
+        Xb, y, W = _data(n=512, folds=2, seed=2)
+        mesh = make_mesh(n_batch=2, n_model=1)
+        key = jax.random.PRNGKey(5)
+        kw = dict(self._int_kw(),
+                  learning_rate=jnp.asarray([0.1, 0.3], jnp.float32),
+                  reg_lambda=jnp.asarray([1.0, 4.0], jnp.float32))
+        un = T.fit_gbt_folds(Xb, y, W, key, **kw)
+        sh = T.fit_gbt_folds_sharded(Xb, y, W, key, mesh=mesh, **kw)
+        _assert_fit_equal(un, sh, "sharded lane vectors")
+
+    def test_sharded_matches_single_device_logistic(self):
+        """Multi-round logistic: real-valued payloads, so parity is
+        allclose on a seed verified tie-free (see class docstring)."""
+        Xb, y, W = _data(n=640, folds=2, seed=1)
+        mesh = make_mesh(n_batch=2, n_model=1)
+        key = jax.random.PRNGKey(3)
+        kw = dict(n_rounds=3, depth=3, n_bins=7, learning_rate=0.3,
+                  reg_lambda=1.0, loss="logistic")
+        _, b1, m1 = T.fit_gbt_folds(Xb, y, W, key, **kw)
+        _, b2, m2 = T.fit_gbt_folds_sharded(Xb, y, W, key, mesh=mesh, **kw)
+        np.testing.assert_allclose(np.asarray(b2), np.asarray(b1),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(m2), np.asarray(m1),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sharded_unrolled_kill_switch(self):
+        """TMOG_TREE_SCAN=0 works under the sharded driver too (the
+        psums live in both growth forms); identical summation structure
+        on both sides makes this comparison exact regardless of ties."""
+        Xb, y, W = _data(n=512, folds=2, seed=4)
+        mesh = make_mesh(n_batch=2, n_model=1)
+        key = jax.random.PRNGKey(6)
+        kw = dict(n_rounds=3, depth=3, n_bins=7, learning_rate=0.3,
+                  reg_lambda=1.0, loss="logistic")
+        with scan_mode(True):
+            _, _, m_scan = T.fit_gbt_folds_sharded(Xb, y, W, key,
+                                                   mesh=mesh, **kw)
+        with scan_mode(False):
+            _, _, m_un = T.fit_gbt_folds_sharded(Xb, y, W, key,
+                                                 mesh=mesh, **kw)
+        np.testing.assert_array_equal(np.asarray(m_scan),
+                                      np.asarray(m_un))
+
+    def test_sharded_rejects_subsample(self):
+        Xb, y, W = _data(n=512, folds=2)
+        mesh = make_mesh(n_batch=2, n_model=1)
+        with pytest.raises(ValueError, match="subsample"):
+            T.fit_gbt_folds_sharded(Xb, y, W, jax.random.PRNGKey(0),
+                                    mesh=mesh, n_rounds=1, depth=2,
+                                    n_bins=7, subsample=0.8)
+
+
+class TestGridMeshRoute:
+    """mask_fit_scores_grid no longer falls back per-fold on a mesh."""
+
+    def _est(self, **kw):
+        from transmogrifai_tpu.models.trees import OpXGBoostClassifier
+        return OpXGBoostClassifier(num_round=3, max_depth=3, max_bins=15,
+                                   **kw)
+
+    def _arrays(self, n=600, d=5, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        masks = (rng.integers(0, 2, size=n)[None, :]
+                 != np.arange(2)[:, None]).astype(np.float32)
+        return X, jnp.asarray(y), jnp.asarray(masks)
+
+    def test_grid_route_sharded_matches_meshless(self):
+        est = self._est()
+        X, y, masks = self._arrays()
+        w = jnp.ones_like(y)
+        grids = [{"eta": 0.1, "reg_lambda": 1.0},
+                 {"eta": 0.3, "reg_lambda": 4.0}]
+        mesh = make_mesh(n_batch=2, n_model=1)
+        # mesh context: the device binning path (a host-tagged native
+        # context never reaches the fused kernels)
+        ctx = est.mask_sweep_context(jnp.asarray(X), mesh=mesh)
+        sharded = est.mask_fit_scores_grid(ctx, y, w, masks, grids,
+                                           mesh=mesh)
+        assert sharded is not None, "mesh grid sweep must not fall back"
+        assert est._last_grid_route == "grid_fused_sharded"
+        # meshless reference: the same lanes through the single-device
+        # fused program (the gate is TPU-only, so call the kernel direct)
+        Xb, edges, n_bins = ctx
+        F = masks.shape[0]
+        W_lanes = jnp.stack([masks * w[None, :] for _ in grids],
+                            axis=0).transpose(1, 0, 2).reshape(
+                                len(grids) * F, y.shape[0])
+        lane = dict(
+            learning_rate=jnp.tile(jnp.asarray([0.1, 0.3], jnp.float32), F),
+            reg_lambda=jnp.tile(jnp.asarray([1.0, 4.0], jnp.float32), F),
+            min_child_weight=jnp.tile(jnp.asarray([1.0, 1.0], jnp.float32),
+                                      F),
+            gamma=jnp.zeros(len(grids) * F, jnp.float32))
+        kw = est._common()
+        shared = {k: v for k, v in kw.items() if k not in est._LANE_KEYS}
+        _, _, ref = T.fit_gbt_folds(Xb, y, W_lanes, est._key(),
+                                    n_bins=n_bins, loss="logistic",
+                                    **shared, **lane)
+        ref = ref.reshape(F, len(grids), y.shape[0]).transpose(1, 0, 2)
+        np.testing.assert_allclose(np.asarray(sharded), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_shard_kill_switch_and_subsample_gate(self, monkeypatch):
+        est = self._est()
+        X, y, masks = self._arrays(n=400)
+        w = jnp.ones_like(y)
+        grids = [{"eta": 0.1}, {"eta": 0.3}]
+        mesh = make_mesh(n_batch=2, n_model=1)
+        ctx = est.mask_sweep_context(jnp.asarray(X), mesh=mesh)
+        monkeypatch.setenv("TMOG_TREE_SHARD", "0")
+        assert est.mask_fit_scores_grid(ctx, y, w, masks, grids,
+                                        mesh=mesh) is None
+        monkeypatch.delenv("TMOG_TREE_SHARD")
+        sub = self._est(subsample=0.8)
+        assert sub.mask_fit_scores_grid(ctx, y, w, masks, grids,
+                                        mesh=mesh) is None
+
+
+class TestUint8Bins:
+    """128..255 bins now bin to uint8 end-to-end (2x+ less Xb traffic)."""
+
+    def test_bin_dtype_tiers(self):
+        rng = np.random.default_rng(0)
+        X = jnp.asarray(rng.normal(size=(400, 4)).astype(np.float32))
+        for n_bins, want in ((100, jnp.int8), (127, jnp.int8),
+                             (128, jnp.uint8), (200, jnp.uint8),
+                             (255, jnp.uint8), (300, jnp.int32)):
+            edges = T.quantile_edges(X, n_bins)
+            Xb = T.bin_matrix(X, edges)
+            assert Xb.dtype == jnp.dtype(want), (n_bins, Xb.dtype)
+            assert int(jnp.max(Xb)) <= n_bins
+
+    def test_host_bin_dtype(self):
+        from transmogrifai_tpu.ops import trees_host as TH
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(300, 3)).astype(np.float32)
+        Xb, edges, _ = TH.bin_context(X, 200)
+        assert Xb.dtype == np.uint8
+        assert Xb.max() <= 200
+        # device twin agrees bin-for-bin at the shared dtype tier
+        Xb_d = np.asarray(T.bin_matrix(jnp.asarray(X), jnp.asarray(edges)))
+        np.testing.assert_array_equal(Xb_d.astype(np.int32),
+                                      Xb.astype(np.int32))
+
+    def test_uint8_fit_parity_with_int32(self):
+        """Same bins, narrow vs wide dtype: identical trees + margins."""
+        rng = np.random.default_rng(2)
+        n = 500
+        X = jnp.asarray(rng.normal(size=(n, 5)).astype(np.float32))
+        y = jnp.asarray((rng.uniform(size=n) < 0.5).astype(np.float32))
+        W = jnp.asarray((rng.integers(0, 2, size=(2, n)) > 0)
+                        .astype(np.float32))
+        edges = T.quantile_edges(X, 200)
+        Xb8 = T.bin_matrix(X, edges)
+        assert Xb8.dtype == jnp.uint8
+        kw = dict(n_rounds=2, depth=3, n_bins=200)
+        key = jax.random.PRNGKey(8)
+        out8 = T.fit_gbt_folds(Xb8, y, W, key, **kw)
+        out32 = T.fit_gbt_folds(Xb8.astype(jnp.int32), y, W, key, **kw)
+        _assert_fit_equal(out8, out32, "uint8 vs int32")
+
+    def test_stream_bin_matrix_uint8(self):
+        from transmogrifai_tpu.parallel.tileplane import ArraySource
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(700, 4)).astype(np.float32)
+        edges = np.asarray(T.quantile_edges(jnp.asarray(X), 150))
+        got = T.stream_bin_matrix(ArraySource(X), edges, tile_rows=256)
+        assert got.dtype == np.uint8
+        want = np.asarray(T.bin_matrix(jnp.asarray(X), jnp.asarray(edges)))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_fused_folds_still_equal_single_fold_runs_under_scan():
+    """The PR 1 contract (each lane's contraction rows are disjoint)
+    holds under the scan form too — interpret-mode pallas kernels inside
+    lax.scan."""
+    Xb, y, W = _data(n=513, f=5, b=7, folds=2, seed=8)
+    kw = dict(n_rounds=2, depth=3, n_bins=7, interpret=True)
+    with scan_mode(True):
+        fit = functools.partial(T.fit_gbt_folds, Xb, y,
+                                key=jax.random.PRNGKey(7), **kw)
+        _, base, margins = fit(W=W)
+        for k in range(W.shape[0]):
+            _, base1, m1 = fit(W=W[k:k + 1])
+            np.testing.assert_array_equal(np.asarray(margins[k]),
+                                          np.asarray(m1[0]))
+            assert float(base[k]) == float(base1[0])

@@ -1,14 +1,22 @@
-"""Find the Mosaic compile-time knee of the fused histogram kernel.
+"""Find the Mosaic compile-time knee of the fused tree-sweep programs.
 
 r5 session 2: widened-M fused programs (configs batched into the fold
-axis) compiled for 20+ minutes at the 2M x 20-lane shape. This probe
-lowers+compiles hist_pallas at increasing lane counts with a HARD
-per-shape timeout in a KILLABLE child (never kill an in-flight compile
-in the parent process — wedge risk), recording compile seconds per
-shape. Output: one JSON line; log lines as it goes.
+axis) compiled for 20+ minutes at the 2M x 20-lane shape. The level-scan
+rewrite (ops/trees, TMOG_TREE_SCAN) attacks exactly this: the traced
+program carries ONE route_hist kernel at the fixed worst-level shape
+instead of one per level, so trace+compile wall should become O(1) in
+depth. This probe sweeps depth x lane-count under BOTH program forms —
+mode "scan" vs "unrolled" — AOT-lowering and compiling fit_gbt_folds in
+a KILLABLE child with a HARD per-shape timeout (never kill an in-flight
+compile in the parent process — wedge risk), recording trace seconds and
+compile seconds per shape. Mode "hist" keeps the original bare
+hist_pallas kernel probe. One JSON line per shape as it goes; a summary
+line at the end — the next TPU session pins the compile-knee fix with
+this one script.
 
 Usage (next TPU window): python tools/tpu_fuse_compile_knee.py
-Env: KNEE_LANES="5,10,15,20" KNEE_TIMEOUT_S=420 KNEE_ROWS=2000000
+Env: KNEE_MODES="scan,unrolled" KNEE_DEPTHS="3,6" KNEE_LANES="5,10,20"
+     KNEE_TIMEOUT_S=420 KNEE_ROWS=2000000 KNEE_ROUNDS=1
 """
 from __future__ import annotations
 
@@ -20,7 +28,10 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-CHILD = r"""
+# Bare histogram-kernel probe (the original r5 measurement, kept for
+# continuity with the banked KNEE results): one hist_pallas compile at
+# the deepest sibling-subtracted level's shape.
+CHILD_HIST = r"""
 import sys, time
 sys.path.insert(0, %(repo)r)
 import numpy as np
@@ -29,7 +40,8 @@ from transmogrifai_tpu.ops import pallas_hist as PH
 
 lanes = %(lanes)d
 n = %(rows)d
-F, B, S = 64, 33, 16   # BASELINE shape, deepest sibling-subtracted level
+F, B = 64, 33
+S = max(1 << max(%(depth)d - 2, 0), 1)
 rng = np.random.default_rng(0)
 Xb_t = jnp.asarray(rng.integers(0, B, size=(F, n)), jnp.int8)
 pay = jnp.asarray(rng.normal(size=(lanes * 3, n)), jnp.float32)
@@ -38,39 +50,93 @@ t0 = time.perf_counter()
 out = PH.hist_pallas(Xb_t, pay, slot, n_slots=S, n_bins=B,
                      allow_bf16=True)
 s = float(jnp.sum(out))           # scalar fetch = honest sync
-print("KNEE|%%.1f" %% (time.perf_counter() - t0), flush=True)
+print("KNEE|%%.1f|%%.1f" %% (0.0, time.perf_counter() - t0), flush=True)
+"""
+
+# Whole fused-fit probe: AOT lower (trace seconds — O(depth) HLO shows
+# up here) then compile (Mosaic seconds — the knee). TMOG_TREE_SCAN is
+# pinned per child so both program forms are measured from clean
+# processes with identical caches (none).
+CHILD_FIT = r"""
+import os, sys, time
+os.environ["TMOG_TREE_SCAN"] = %(scan)r
+# measure REAL compiles: an UNSET env falls back to the machine-scoped
+# default cache dir, which a prior run may have populated — only the
+# explicit "0" disables the persistent cache
+os.environ["TMOG_COMPILE_CACHE_DIR"] = "0"
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import jax, jax.numpy as jnp
+from transmogrifai_tpu.ops import trees as T
+
+lanes = %(lanes)d
+n = %(rows)d
+F, BINS = 64, 32
+rng = np.random.default_rng(0)
+Xb = jnp.asarray(rng.integers(0, BINS + 1, size=(n, F)), jnp.int8)
+y = jnp.asarray((rng.uniform(size=n) < 0.4), jnp.float32)
+W = jnp.asarray((rng.integers(0, 2, size=(lanes, n)) > 0), jnp.float32)
+t0 = time.perf_counter()
+low = T.fit_gbt_folds.lower(Xb, y, W, jax.random.PRNGKey(0),
+                            n_rounds=%(rounds)d, depth=%(depth)d,
+                            n_bins=BINS)
+t_trace = time.perf_counter() - t0
+t0 = time.perf_counter()
+c = low.compile()
+print("KNEE|%%.1f|%%.1f" %% (t_trace, time.perf_counter() - t0),
+      flush=True)
 """
 
 
+def _probe(mode: str, depth: int, lanes: int, rows: int, rounds: int,
+           timeout_s: float):
+    """(trace_s, compile_s) or an error string; hard-killed child."""
+    if mode == "hist":
+        code = CHILD_HIST % {"repo": REPO, "lanes": lanes, "rows": rows,
+                             "depth": depth}
+    else:
+        code = CHILD_FIT % {"repo": REPO, "lanes": lanes, "rows": rows,
+                            "depth": depth, "rounds": rounds,
+                            "scan": "1" if mode == "scan" else "0"}
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        return f"TIMEOUT>{timeout_s:.0f}s"
+    for line in (r.stdout or "").splitlines():
+        if line.startswith("KNEE|"):
+            tr, co = line[5:].split("|")
+            return {"trace_s": float(tr), "compile_s": float(co)}
+    return f"rc={r.returncode} {(r.stderr or '')[-160:].strip()}"
+
+
 def main():
+    modes = [m.strip() for m in os.environ.get(
+        "KNEE_MODES", "scan,unrolled").split(",") if m.strip()]
+    depths = [int(x) for x in os.environ.get(
+        "KNEE_DEPTHS", "3,6").split(",")]
     lanes_list = [int(x) for x in os.environ.get(
         "KNEE_LANES", "5,10,15,20").split(",")]
     timeout_s = float(os.environ.get("KNEE_TIMEOUT_S", "420"))
     rows = int(os.environ.get("KNEE_ROWS", "2000000"))
+    rounds = int(os.environ.get("KNEE_ROUNDS", "1"))
     results = {}
-    for lanes in lanes_list:
-        code = CHILD % {"repo": REPO, "lanes": lanes, "rows": rows}
-        t0 = time.time()
-        try:
-            r = subprocess.run([sys.executable, "-c", code],
-                               capture_output=True, text=True,
-                               timeout=timeout_s, cwd=REPO)
-            got = None
-            for line in (r.stdout or "").splitlines():
-                if line.startswith("KNEE|"):
-                    got = float(line[5:])
-            results[lanes] = (got if got is not None
-                              else f"rc={r.returncode}")
-        except subprocess.TimeoutExpired:
-            results[lanes] = f"TIMEOUT>{timeout_s:.0f}s"
-            print(json.dumps({"lanes": lanes, "result": results[lanes]}),
-                  flush=True)
-            break   # bigger shapes will be worse; stop here
-        print(json.dumps({"lanes": lanes, "result": results[lanes],
-                          "wall_s": round(time.time() - t0, 1)}),
-              flush=True)
+    for mode in modes:
+        for depth in depths:
+            for lanes in lanes_list:
+                key = f"{mode}:d{depth}:l{lanes}"
+                t0 = time.time()
+                got = _probe(mode, depth, lanes, rows, rounds, timeout_s)
+                results[key] = got
+                print(json.dumps({"mode": mode, "depth": depth,
+                                  "lanes": lanes, "result": got,
+                                  "wall_s": round(time.time() - t0, 1)}),
+                      flush=True)
+                if isinstance(got, str) and got.startswith("TIMEOUT"):
+                    break   # bigger lane counts will be worse; next depth
     print(json.dumps({"metric": "fuse_compile_knee", "rows": rows,
-                      "per_lanes_compile_s": results}))
+                      "rounds": rounds, "per_shape": results}))
 
 
 if __name__ == "__main__":

@@ -762,8 +762,9 @@ class Validator:
                                       and est._host_route())
                 else "mask_folds"))
         pending = [gi for gi in range(len(grids)) if gi not in results]
-        fused_gis: set = set()   # cells whose metrics came via the
-        # config-fused program (route attribution for bench/MFU readers)
+        fused_gis: Dict[int, str] = {}   # cell -> fused route label
+        # ("mask_folds:grid_fused" / ":grid_fused_sharded" on a mesh) —
+        # route attribution for bench/MFU readers
         # consecutive fused-route failure escalation: one sweep-level
         # warning on first failure, silent per-config fallback while the
         # streak stays short, a raise once it reaches the cap
@@ -819,7 +820,7 @@ class Validator:
                 ctx = est.copy(**grids[group[0]]).mask_sweep_context(
                     Xd, n_valid=X.shape[0], mesh=self.mesh)
 
-                def record(gi, scores_f, fused=False):
+                def record(gi, scores_f, route=None):
                     out = np.asarray(fold_metrics(scores_f, yd, wd, md,
                                                   thr_d))
                     fm = [float(v) for v in out]
@@ -827,9 +828,7 @@ class Validator:
                     if ckpt is not None:
                         ckpt.record(keys[gi], type(est).__name__, grids[gi],
                                     fm, metric)
-                    self._cell_event(est, gi, fm,
-                                     "mask_folds:grid_fused" if fused
-                                     else "mask_folds")
+                    self._cell_event(est, gi, fm, route or "mask_folds")
 
                 # config fusion: grid points whose structural signature
                 # matches fit ONE fold-fused device program (lanes =
@@ -856,7 +855,8 @@ class Validator:
                         try:
                             fused = est.mask_fit_scores_grid(
                                 ctx, yd, wd, md, [grids[gi] for gi in gis],
-                                n_classes=n_classes, multiclass=multicls)
+                                n_classes=n_classes, multiclass=multicls,
+                                mesh=self.mesh)
                         except Exception as e:  # never lose the sweep to
                             # the fast path: per-config route is the
                             # correctness baseline — but a route that
@@ -895,9 +895,13 @@ class Validator:
                             fused = None
                     if fused is not None:
                         fuse_fail_streak = 0
+                        # the estimator stamps which fused form ran
+                        # (sharded on a mesh) right before returning
+                        grid_route = "mask_folds:" + getattr(
+                            est, "_last_grid_route", "grid_fused")
                         for k, gi in enumerate(gis):
-                            record(gi, fused[k], fused=True)
-                            fused_gis.add(gi)
+                            record(gi, fused[k], route=grid_route)
+                            fused_gis[gi] = grid_route
                         continue
                     for gi in gis:
                         est_g = est.copy(**grids[gi])
@@ -914,8 +918,7 @@ class Validator:
             ValidatedModel(model_name=type(est).__name__, model_uid=est.uid,
                            grid=g, metric_name=metric,
                            fold_metrics=results[gi],
-                           route=("mask_folds:grid_fused"
-                                  if gi in fused_gis else "mask_folds"))
+                           route=fused_gis.get(gi, "mask_folds"))
             for gi, g in enumerate(grids)
         ]
 

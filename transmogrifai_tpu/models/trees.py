@@ -279,14 +279,23 @@ class _TreeEstimator(PredictorEstimator):
                          if est_g.has_param("seed") else 0))
 
     def mask_fit_scores_grid(self, ctx, y, w, masks, grids,
-                             n_classes: int = 2, multiclass: bool = False):
+                             n_classes: int = 2, multiclass: bool = False,
+                             mesh=None):
         """[G, F, n] margins for a GROUP of same-signature grid points in
         as few device programs as fit VMEM/HBM, or None (validator falls
         back to per-config mask_fit_scores). The lanes axis is
         (config, fold) pairs over the SHARED binned matrix: one histogram
         one-hot pass serves every config and fold, and the contraction M
         dim grows from folds*3 toward the MXU's 128 rows (the measured
-        headroom in docs/performance.md's roofline table)."""
+        headroom in docs/performance.md's roofline table).
+
+        `mesh` (the validator's sweep mesh, None off-mesh): when the
+        batch axis spans >1 devices the group runs through
+        T.fit_gbt_folds_sharded — rows shard over the mesh, per-level
+        histograms psum-merge (DrJAX MapReduce shape), split algebra and
+        trees replicate — instead of the old unconditional fallback to
+        the sequential per-fold path. Gated by _sharded_route_ok
+        (TMOG_TREE_SHARD kill switch, subsample == 1.0)."""
         if isinstance(ctx, tuple) and len(ctx) == 4 and ctx[0] == "host":
             return None   # host-tagged sweep: the C++ builder path
         regression = (getattr(self, "_regression", False)
@@ -302,7 +311,12 @@ class _TreeEstimator(PredictorEstimator):
         if len(sigs) != 1 or None in sigs:
             return None
         depth = kws[0]["depth"]
-        if not self._fused_route_ok(ctx, y, masks, depth):
+        from ..parallel.mesh import mesh_batch_count
+        n_shards = mesh_batch_count(mesh)
+        if n_shards > 1:
+            if not self._sharded_route_ok(kws[0]):
+                return None
+        elif not self._fused_route_ok(ctx, y, masks, depth):
             return None
         from ..ops import pallas_hist
         Xb, edges, n_bins = ctx
@@ -317,12 +331,21 @@ class _TreeEstimator(PredictorEstimator):
         # compiles at a 16MB out block) — the planner gates all three,
         # INCLUDING at chunk == 1 (a single config's fold lanes that
         # clear the VMEM gate can still bust the HBM/out-block caps;
-        # ADVICE round 5), where 0 falls back per-config
+        # ADVICE round 5), where 0 falls back per-config. On a mesh the
+        # lane row-planes shard, so the HBM lane budget scales with the
+        # shard count (the planner's lane-shard budget).
         chunk = pallas_hist.plan_lane_chunk(
-            Xb.shape[1], n_bins + 1, F, G, depth)
+            Xb.shape[1], n_bins + 1, F, G, depth, n_shards=n_shards)
         if chunk == 0:
             return None
 
+        sharded = n_shards > 1
+        self._last_grid_route = "grid_fused_sharded" if sharded \
+            else "grid_fused"
+        label = "tree_sweep_grid_fused_sharded" if sharded \
+            else "tree_sweep_grid_fused"
+        span = "tree_shard_merge" if sharded else (
+            "tree_level_scan" if T.tree_scan_enabled() else None)
         loss = "squared" if regression else "logistic"
         outs = []
         for lo in range(0, G, chunk):
@@ -350,40 +373,67 @@ class _TreeEstimator(PredictorEstimator):
                       if k not in self._LANE_KEYS}
             # the signature pins one seed per group; honor the grid's
             key = self.copy(**grids[lo])._key()
+            if sharded:
+                def fit(W_lanes=W_lanes, key=key, shared=shared,
+                        lane_vec=lane_vec):
+                    return T.fit_gbt_folds_sharded(
+                        Xb, y, W_lanes, key, mesh=mesh, n_bins=n_bins,
+                        loss=loss, **shared, **lane_vec)
+            else:
+                def fit(W_lanes=W_lanes, key=key, shared=shared,
+                        lane_vec=lane_vec):
+                    return T.fit_gbt_folds(
+                        Xb, y, W_lanes, key, n_bins=n_bins, loss=loss,
+                        **shared, **lane_vec)
             _, _, margins = self._timed_fused_fit(
-                "tree_sweep_grid_fused", Xb, g_here * F, depth,
-                shared["n_rounds"],
-                lambda: T.fit_gbt_folds(
-                    Xb, y, W_lanes, key, n_bins=n_bins, loss=loss,
-                    **shared, **lane_vec))
+                label, Xb, g_here * F, depth, shared["n_rounds"], fit,
+                span=span)
             outs.append(margins.reshape(F, g_here, n).transpose(1, 0, 2))
         return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
 
-    # (label, shape signature) pairs whose fused program has already run
-    # once this process — the first run's wall includes jit trace +
-    # Mosaic compile (documented 20+ min at sweep shapes), so its span
-    # is marked cold and readers must compare warm spans only
+    # (backend, label, shape signature) tuples whose fused program has
+    # already run once this process — the first run's wall includes jit
+    # trace + Mosaic compile (documented 20+ min at sweep shapes), so its
+    # span is marked cold and readers must compare warm spans only. Keyed
+    # by backend: after force_cpu re-scopes the platform, a shape warmed
+    # on one backend must NOT be misclassified warm on the other (a fresh
+    # backend means fresh executables, and a mislabeled cold span's
+    # compile wall would pollute warm-span GB/s claims).
     _WARM_FUSED_SHAPES: set = set()
 
     @staticmethod
-    def _timed_fused_fit(label, Xb, lanes, depth, n_rounds, call):
+    def _timed_fused_fit(label, Xb, lanes, depth, n_rounds, call,
+                         span=None):
         """Run one fused-sweep fit; when stage metrics are being
         collected, time it to completion and record a kernel-roofline
         span (analytic HBM bytes from the single traffic model in
         ops/pallas_hist) so BENCH_*.json can report achieved GB/s and
         %-of-roof without a hand-run roofline script. The first span per
-        (label, shape) carries cold=True: its wall contains the compile,
-        not just the kernel, and would wildly understate achieved GB/s."""
+        (backend, label, shape) carries cold=True: its wall contains the
+        compile, not just the kernel, and would wildly understate
+        achieved GB/s. `span` ("tree_level_scan" / "tree_shard_merge")
+        additionally wraps the fit in a named trace span so a Perfetto
+        view shows which growth/merge form ran and the RecompileTracker
+        books the fit's compiles to it (docs/observability.md)."""
         from ..utils.metrics import collector
         if not collector.enabled:
             return call()
+        import contextlib
         import time
         from ..ops import pallas_hist
-        sig = (label, Xb.shape, str(Xb.dtype), lanes, depth, n_rounds)
+        # keyed by backend AND growth form: a set_tree_scan flip clears
+        # the jit caches (the executables differ), so the other form's
+        # first fit recompiles and must be classified cold again
+        sig = (jax.default_backend(), T.tree_scan_enabled(), label,
+               Xb.shape, str(Xb.dtype), lanes, depth, n_rounds)
         cold = sig not in _TreeEstimator._WARM_FUSED_SHAPES
+        cm = collector.trace_span(span, kind="tree_fused",
+                                  lanes=int(lanes), depth=int(depth)) \
+            if span else contextlib.nullcontext()
         t0 = time.perf_counter()
-        out = call()
-        jax.block_until_ready(out)
+        with cm:
+            out = call()
+            jax.block_until_ready(out)
         collector.kernel(
             label, time.perf_counter() - t0,
             pallas_hist.fused_fit_bytes(
@@ -397,11 +447,26 @@ class _TreeEstimator(PredictorEstimator):
         _TreeEstimator._WARM_FUSED_SHAPES.add(sig)
         return out
 
+    def _sharded_route_ok(self, kw) -> bool:
+        """Gate for the mesh-sharded fused sweep (mask_fit_scores_grid
+        with a >1-device batch axis). TMOG_TREE_SHARD=0 is the kill
+        switch; row subsample must stay 1.0 (per-shard uniform draws are
+        index-local — every shard would draw the same bits for its local
+        rows, matching neither the single-device mask nor independence).
+        Unlike _fused_route_ok there is no TPU/pallas requirement: on
+        CPU meshes the jnp twin dispatchers run the identical call
+        shape, which is what makes the route parity-testable in CI."""
+        if os.environ.get("TMOG_TREE_SHARD", "").strip().lower() \
+                in ("0", "false", "off"):
+            return False
+        return float(kw.get("subsample", 1.0)) >= 1.0
+
     def _fused_route_ok(self, ctx, y, masks=None, depth=None):
         """Shared gate for the fold-fused booster path: live pallas on a
         single-device TPU above the fold-vmap row limit. Mesh-sharded
-        contexts keep the per-fold path (pallas_call does not run under
-        GSPMD sharding here; the mesh story is the XLA matmul kernels).
+        contexts keep the per-fold path HERE (single-config fits);
+        the GRID sweep has its own mesh route — mask_fit_scores_grid
+        dispatches to fit_gbt_folds_sharded under _sharded_route_ok.
         When the caller supplies the sweep shape (masks + tree depth),
         the fused kernel's VMEM footprint is checked too — its output
         block scales with folds x slots x F x bins, and an over-budget
@@ -752,7 +817,8 @@ class _GBTBase(_TreeEstimator):
             kw["n_rounds"],
             lambda: T.fit_gbt_folds(
                 Xb, y, masks * w[None, :], self._key(), n_bins=n_bins,
-                loss=self._loss, **kw))
+                loss=self._loss, **kw),
+            span="tree_level_scan" if T.tree_scan_enabled() else None)
         return margins
 
     def _mask_score_host(self, ctx, y, w, n_classes, multiclass):
@@ -949,7 +1015,8 @@ class _XGBBase(_TreeEstimator):
             lambda: T.fit_gbt_folds(
                 Xb, y, masks * w[None, :], self._key(), n_bins=n_bins,
                 loss="squared" if self._regression else "logistic",
-                **kw))
+                **kw),
+            span="tree_level_scan" if T.tree_scan_enabled() else None)
         return margins
 
     def _mask_score(self, ctx, y, w, n_classes, multiclass):

@@ -107,7 +107,12 @@ def plan_fused_hist(n_feat: int, n_bins: int, lanes: int, depth: int,
     (256 bins, depth 6, a few hundred features, 3-5 folds) would sail
     past a Mosaic compile failure with no library-level fallback. Worst
     level is the deepest histogram pass: sibling subtraction halves the
-    slot count, so n_slots = 2^(depth-2) for depth >= 2. Residents:
+    slot count, so n_slots = 2^(depth-2) for depth >= 2. Under the
+    level-scan fit (ops/trees, TMOG_TREE_SCAN default) this is not just
+    the worst case but THE per-program shape: every fused pass runs at
+    the padded 2^(depth-2) slot width, and Mosaic compiles exactly one
+    route_hist program per (shape, depth) instead of one per level.
+    Residents:
     output block + the [F*B, blk] f32 one-hot tile (+ a bf16 copy when
     the bf16 input mode is on) + the f32 Xb/payload/slot tiles + the
     route-fused node one-hot tile (the route+hist kernel keeps a
@@ -139,7 +144,8 @@ def fused_hist_fits(n_feat: int, n_bins: int, n_folds: int, depth: int,
 
 
 def plan_lane_chunk(n_feat: int, n_bins: int, n_folds: int, n_configs: int,
-                    depth: int, channels: int = 3) -> int:
+                    depth: int, channels: int = 3,
+                    n_shards: int = 1) -> int:
     """Configs per fused sweep program, honoring every budget at once.
 
     The single planner for the config-fused sweep: lanes = configs x
@@ -153,8 +159,16 @@ def plan_lane_chunk(n_feat: int, n_bins: int, n_folds: int, n_configs: int,
     from n_configs) that clears ALL THREE, and 0 when even a single
     config's fold lanes violate any cap — callers must then fall back to
     the per-config route (a chunk of 1 that only cleared the VMEM gate
-    used to sail past the HBM/out-block caps; ADVICE round 5)."""
-    hbm_lane_budget = int(os.environ.get("TMOG_GRID_FUSE_HBM_LANES", "64"))
+    used to sail past the HBM/out-block caps; ADVICE round 5).
+
+    `n_shards` is the lane-shard budget of the mesh route
+    (fit_gbt_folds_sharded): the 4 row-planes every lane carries shard
+    over the mesh batch axis, so per-device HBM pressure divides by the
+    shard count and the lane budget multiplies by it. VMEM and
+    out-block caps are PER DEVICE and do not scale — the fused output
+    block is replicated on every shard (psum-merged)."""
+    hbm_lane_budget = int(os.environ.get("TMOG_GRID_FUSE_HBM_LANES", "64")) \
+        * max(int(n_shards), 1)
     out_mb_cap = float(os.environ.get("TMOG_GRID_FUSE_OUT_MB", "8"))
 
     def ok(chunk: int) -> bool:

@@ -7,15 +7,16 @@ Spark MLlib's tree learners behind OpRandomForest*/OpGBT*/OpDecisionTree*
 (core/.../impl/classification/, core/.../impl/regression/).
 
 Design (TPU-first, not a port):
-- Features are quantile-binned once to int8 (int32 past 128 bins;
-  `quantile_edges` / `bin_matrix`);
+- Features are quantile-binned once to int8 (uint8 up to 255 bins, int32
+  past that; `quantile_edges` / `bin_matrix`);
   all growth happens on the binned matrix, which is the XGBoost `hist`
   algorithm shape and keeps every per-level pass a dense, static-shape
   gather/segment-sum that XLA tiles well.
 - Trees are complete binary trees of static depth in heap layout: internal
   node arrays `feat`/`thresh`/`miss` of length 2^depth - 1, leaf payloads
   [2^depth, K]. Bins are shifted: 0 is the dedicated missing bin, present
-  values occupy [1, n_bins] (so int8 holds up to 127 quantile bins), and
+  values occupy [1, n_bins] (int8 holds up to 127 quantile bins, uint8 up
+  to 255 — the XGBoost 256-bin default at 1 byte/cell), and
   every node learns the default direction for missing rows (`miss`,
   XGBoost's sparsity-aware split). A node that fails its split test is
   encoded as (feat=0, thresh=n_bins, miss=0): `bin > thresh` is then
@@ -43,6 +44,7 @@ Design (TPU-first, not a port):
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -101,6 +103,18 @@ def quantile_edges(X: jax.Array, n_bins: int) -> jax.Array:
 _BIN_CHUNK = 1 << 18
 
 
+def bin_dtype(n_bins: int):
+    """Narrowest integer dtype holding shifted bins [0, n_bins] (bin 0 =
+    missing, so the max stored value is n_bins itself): int8 up to 127
+    quantile bins, uint8 up to 255 — the XGBoost 256-bin default stays at
+    1 byte/cell, 4x less Xb traffic than the old int32 fall-through —
+    and int32 beyond. Shared by the resident, streamed and host binning
+    paths so the three can never disagree on width."""
+    if n_bins <= 127:
+        return jnp.int8
+    return jnp.uint8 if n_bins <= 255 else jnp.int32
+
+
 def _bin_block(xb, edges):
     """Digitize ONE row block against `edges` — THE binning rule, shared
     by the resident `bin_matrix` map and the streamed tile emission
@@ -112,9 +126,7 @@ def _bin_block(xb, edges):
     data-dependent gathers); CPU keeps the O(log B) search. The backend
     branch resolves at trace time."""
     n_bins = edges.shape[1] + 1
-    # max stored bin is n_bins (missing bin shifts present bins up by 1),
-    # so up to 127 quantile bins fit int8 exactly
-    out_dtype = jnp.int8 if n_bins <= 127 else jnp.int32
+    out_dtype = bin_dtype(n_bins)
     xf = jnp.asarray(xb, jnp.float32)
     missing = jnp.isnan(xf)
     if jax.default_backend() == "tpu":
@@ -133,16 +145,17 @@ def bin_matrix(X: jax.Array, edges: jax.Array) -> jax.Array:
     """Digitize with a dedicated missing bin: NaN -> 0, present values ->
     1 + #edges below-or-equal (searchsorted right, shifted).
 
-    X [n, d], edges [d, n_bins-1] -> int8 (int32 when n_bins > 127) [n, d]
-    in [0, n_bins]. For present values `bin > t` is equivalent to
+    X [n, d], edges [d, n_bins-1] -> int8 / uint8 / int32 (bin_dtype)
+    [n, d] in [0, n_bins]. For present values `bin > t` is equivalent to
     `x >= edges[t-1]` for t in [1, n_bins-1] (right-side search counts
     edges <= x, so equality on an edge goes right) — the raw serving
     traversal compares with >=, which matters for discrete columns
     (one-hot indicators sit exactly on their edge). Missing rows route by
     each node's learned default direction (Tree.miss), never by the
     comparison. Row blocks are processed by a lax.map so the f32
-    temporaries never exceed O(_BIN_CHUNK * d); int8 output keeps the
-    resident binned matrix at n*d bytes (640MB at the 10M config).
+    temporaries never exceed O(_BIN_CHUNK * d); 1-byte output (int8 up
+    to 127 bins, uint8 to 255) keeps the resident binned matrix at n*d
+    bytes (640MB at the 10M config) through the XGBoost 256-bin default.
     """
     N, d = X.shape
 
@@ -250,10 +263,10 @@ def stream_bin_matrix(source, edges, *, tile_rows: Optional[int] = None,
 
     Each fixed-shape tile runs the SAME `_bin_block` rule as the
     resident `bin_matrix` (exact parity by construction) under the
-    double-buffered tileplane; the int8 output tiles are fetched with a
-    one-tile lag (D2H of tile k overlaps tile k+1's compute) and handed
-    to `sink(np_tile, n_valid)` — or, when `sink` is None, assembled
-    into the full [n, d] int8/int32 host matrix, which at n*d bytes is
+    double-buffered tileplane; the 1-byte (bin_dtype) output tiles are
+    fetched with a one-tile lag (D2H of tile k overlaps tile k+1's
+    compute) and handed to `sink(np_tile, n_valid)` — or, when `sink` is
+    None, assembled into the full [n, d] host matrix, which at n*d bytes is
     the one artifact of the flow SMALL enough to keep (the 10M-row
     bench's binned matrix is 640MB vs 2.5GB of f32 X). TMOG_TILEPLANE=0
     degrades to run_tileplane's synchronous single-thread loop."""
@@ -264,7 +277,7 @@ def stream_bin_matrix(source, edges, *, tile_rows: Optional[int] = None,
     c = int(tile_rows) if tile_rows else TP.tile_rows_for(4 * d,
                                                           source.n_rows)
     n_bins = int(np.asarray(edges).shape[1]) + 1
-    out_dtype = np.int8 if n_bins <= 127 else np.int32
+    out_dtype = np.dtype(bin_dtype(n_bins))
     parts: list = []
     full = None
     cursor = 0
@@ -943,12 +956,119 @@ def fit_gbt(Xb: jax.Array, y: jax.Array, w: jax.Array, key: jax.Array, *,
     return trees, base
 
 
+# -- fold-fused growth: whole-tree level scan vs depth unroll ----------------
+# TMOG_TREE_SCAN gates the whole-tree level-scan form of the fused fit
+# (default ON): levels 0..depth-2 run inside ONE lax.scan whose carries are
+# padded to the worst-level slot count (2^(depth-2)) with inactive slots
+# masked, so the traced program — and its Mosaic route_hist kernel — exists
+# ONCE per fit instead of once per level. Program size and trace/compile
+# wall become O(1) in depth (the compile-knee attack; measurement harness
+# tools/tpu_fuse_compile_knee.py). =0 restores the legacy depth-unrolled
+# path, which produces bit-identical trees and margins.
+_TREE_SCAN = os.environ.get("TMOG_TREE_SCAN", "").strip().lower() \
+    not in ("0", "false", "off")
+
+
+def tree_scan_enabled() -> bool:
+    """Is the level-scan fused fit active? (env TMOG_TREE_SCAN,
+    default on; runtime toggle set_tree_scan)."""
+    return _TREE_SCAN
+
+
+def set_tree_scan(enabled: bool) -> None:
+    """Runtime toggle for the level-scan fused fit (the bench A/B lever).
+    The choice is read at trace time — it is NOT part of the jit key — so
+    flipping clears the fused-fit caches: a compiled unrolled program
+    must never satisfy a scan request or vice versa."""
+    global _TREE_SCAN
+    if _TREE_SCAN == bool(enabled):
+        return
+    _TREE_SCAN = bool(enabled)
+    fit_gbt_folds.clear_cache()
+    _SHARDED_FIT_CACHE.clear()
+
+
+def _allreduce(v, axis_name):
+    """psum under the row-sharded driver (the Rabit-allreduce slot of the
+    XGBoost hist design); identity on a single device."""
+    return jax.lax.psum(v, axis_name) if axis_name is not None else v
+
+
+def _shard_vary_opt(tree, axis_name):
+    """shard_map varying-manual-axes shim for scan carries (see
+    parallel/mesh.shard_vary); identity off-mesh."""
+    if axis_name is None:
+        return tree
+    from ..parallel.mesh import shard_vary
+    return shard_vary(tree, axis_name)
+
+
+def _fold_split_scores(reg_lambda, min_child_weight, gamma):
+    """_split_scores vmapped over the fold/lane axis.
+
+    reg_lambda / min_child_weight / gamma (and learning_rate in the leaf
+    pass) may be PER-LANE vectors [Fo] — the config-fused sweep batches
+    grid points into the fold axis; eta and lambda are pure algebra
+    scalars per lane. Scalars keep the scalar HLO — the single-config
+    path's executables (and their persistent-cache entries) must stay
+    byte-identical."""
+    def _ax(v):
+        return 0 if getattr(v, "ndim", 0) == 1 else None
+
+    return jax.vmap(
+        _split_scores,
+        in_axes=(0,) * 9 + (_ax(reg_lambda), _ax(min_child_weight),
+                            None, None, _ax(gamma), None, None))
+
+
+def _leaf_payload(Gl, Hl, Cl, reg_lambda, alpha, max_delta_step,
+                  learning_rate):
+    """Per-fold newton leaves from leaf sufficient statistics [Fo, L(, K)]
+    — the one shared leaf rule of both fused growth forms."""
+    rl_col = reg_lambda[:, None] if getattr(reg_lambda, "ndim", 0) == 1 \
+        else reg_lambda
+    leaf = -_soft_l1(Gl, alpha) / (Hl + rl_col + EPS)[..., None]
+    if max_delta_step > 0.0:  # [Fo, L, 1] — cap raw newton step
+        leaf = jnp.clip(leaf, -max_delta_step, max_delta_step)
+    leaf = jnp.where(Cl[..., None] >= 0.5, leaf, 0.0)
+    lr_col = learning_rate[:, None, None] \
+        if getattr(learning_rate, "ndim", 0) == 1 else learning_rate
+    return lr_col * leaf
+
+
+def _fold_leaves(last, *, n_leaves, reg_lambda, alpha, max_delta_step,
+                 learning_rate):
+    """Leaf payloads [Fo, n_leaves, 1] read off the LAST level's
+    cumulative histograms (`last` as produced by the level split) — same
+    free-leaf trick as grow_tree's leaf pass, vmapped over folds."""
+    GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, f_lvl, t_lvl, m_lvl = last
+    n_half = n_leaves // 2
+
+    def leaf_of(GLk, HLk, CLk, Gtk, Htk, Ctk, Gmk, Hmk, Cmk,
+                fk, tk, mk):
+        nid = jnp.arange(n_half)
+        mr = mk.astype(jnp.float32)
+        Gleft = GLk[nid, fk, tk, :] - mr[:, None] * Gmk[nid, fk, :]
+        Hleft = HLk[nid, fk, tk] - mr * Hmk[nid, fk]
+        Cleft = CLk[nid, fk, tk] - mr * Cmk[nid, fk]
+        Gl = jnp.stack([Gleft, Gtk - Gleft], axis=1).reshape(
+            n_leaves, Gleft.shape[-1])
+        Hl = jnp.stack([Hleft, Htk - Hleft], axis=1).reshape(n_leaves)
+        Cl = jnp.stack([Cleft, Ctk - Cleft], axis=1).reshape(n_leaves)
+        return Gl, Hl, Cl
+
+    Gl, Hl, Cl = jax.vmap(leaf_of)(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm,
+                                   f_lvl, t_lvl, m_lvl)
+    return _leaf_payload(Gl, Hl, Cl, reg_lambda, alpha, max_delta_step,
+                         learning_rate)
+
+
 def _grow_tree_folds(Xb_t, G, H, *, depth, n_bins,
                      reg_lambda, min_child_weight, min_instances,
                      min_info_gain, gamma, learning_rate, feature_mask,
                      interpret=False, alpha=0.0, max_delta_step=0.0,
                      level_feature_frac=1.0, level_key=None,
-                     feature_mask_count=None):
+                     feature_mask_count=None, axis_name=None):
     """Grow one tree PER FOLD level-wise in shared fused passes.
 
     Xb_t [F, N] transposed bins (N pre-padded to the route block size by
@@ -964,28 +1084,205 @@ def _grow_tree_folds(Xb_t, G, H, *, depth, n_bins,
     The per-node split algebra (cumsums, _split_scores, argmax, leaves)
     is the grow_tree math vmapped over the fold axis. On CPU the
     dispatchers drop to gather/segment-sum fallbacks (same decisions).
+
+    Two program forms, decision/margin bit-identical (tests/
+    test_tree_scan.py): the level-SCAN form (default) runs all mid-tree
+    levels in one lax.scan at the fixed worst-level shape, the legacy
+    unrolled form (TMOG_TREE_SCAN=0) emits one program section per
+    level. `axis_name` names a shard_map mesh axis rows are sharded
+    over: every level histogram psums across shards before the split
+    algebra (DrJAX-style psum-merged MapReduce), routing stays local.
+
     Returns (Tree with leading [Fo] axes, leaf_rows [Fo, N]) where
     leaf_rows are the learning-rate-scaled per-row leaf payloads —
     bitwise what predict_bins returns for each fold's tree, read off the
     final routing state instead of re-traversed.
+    """
+    kw = dict(depth=depth, n_bins=n_bins, reg_lambda=reg_lambda,
+              min_child_weight=min_child_weight,
+              min_instances=min_instances, min_info_gain=min_info_gain,
+              gamma=gamma, learning_rate=learning_rate,
+              feature_mask=feature_mask, interpret=interpret, alpha=alpha,
+              max_delta_step=max_delta_step,
+              level_feature_frac=level_feature_frac, level_key=level_key,
+              feature_mask_count=feature_mask_count, axis_name=axis_name)
+    if tree_scan_enabled() and depth >= 1:
+        return _grow_tree_folds_scan(Xb_t, G, H, **kw)
+    return _grow_tree_folds_unrolled(Xb_t, G, H, **kw)
+
+
+def _grow_tree_folds_scan(Xb_t, G, H, *, depth, n_bins, reg_lambda,
+                          min_child_weight, min_instances, min_info_gain,
+                          gamma, learning_rate, feature_mask,
+                          interpret=False, alpha=0.0, max_delta_step=0.0,
+                          level_feature_frac=1.0, level_key=None,
+                          feature_mask_count=None, axis_name=None):
+    """Whole-tree level-scan form of _grow_tree_folds.
+
+    Levels 0..depth-2 run inside ONE lax.scan with fixed max-shape
+    carries: the slot axis of every histogram/table is padded to
+    S = 2^(depth-2) (the worst level the fused route+hist pass serves —
+    exactly the shape plan_fused_hist already budgets), level d uses the
+    first 2^d slots and masks the rest. One route_hist program — not
+    depth-1 of them — reaches Mosaic, and the interleave/cumsum/argmax
+    split algebra exists once in the HLO. The final level splits and
+    routes outside the scan (its tables are twice the scan width and it
+    needs no histogram pass), reusing the same split closure, so total
+    program size is O(1) in depth.
+
+    Bit-exactness vs the unrolled form: per-slot histogram sums are
+    independent of the kernel's slot count, the split algebra is the
+    same expression on the same values, and padded slots can never be
+    selected by a row (their tables hold the dead all-left encoding).
     """
     from . import pallas_hist
 
     F, N = Xb_t.shape
     Fo = G.shape[0]
     B = n_bins + 1
-    # reg_lambda / min_child_weight / gamma / learning_rate may be PER
-    # LANE vectors [Fo] (the config-fused sweep batches grid points into
-    # the fold axis; eta and lambda are pure algebra scalars per lane).
-    # Scalars keep the scalar HLO — the single-config path's executables
-    # (and their persistent-cache entries) must stay byte-identical.
-    def _ax(v):
-        return 0 if getattr(v, "ndim", 0) == 1 else None
+    split_scores_f = _fold_split_scores(reg_lambda, min_child_weight, gamma)
+    use_level_mask = level_feature_frac < 1.0 and level_key is not None
+    key0 = level_key if level_key is not None \
+        else jnp.zeros((2,), jnp.uint32)
 
-    split_scores_f = jax.vmap(
-        _split_scores,
-        in_axes=(0,) * 9 + (_ax(reg_lambda), _ax(min_child_weight),
-                            None, None, _ax(gamma), None, None))
+    node = jnp.zeros((Fo, N), jnp.float32)
+    pay = jnp.stack([G, H], axis=1).reshape(2 * Fo, N)
+
+    def level_tables(full, n_act, lkey):
+        """Split algebra for ONE level at padded slot width: cumsums over
+        the shifted bin axis, sparsity-aware gains, argmax. Slots >=
+        n_act (scan padding; None = all live) hold zero histograms —
+        their gains are forced out so they land the dead all-left
+        encoding (feat 0, thresh B-1, miss 0) deterministically; live
+        slots see bit-identical algebra to the unrolled path."""
+        S_pad = full.shape[1]
+        hg = full[:, :, 0][..., None]                     # [Fo,S,F,B,1]
+        hh = full[:, :, 1]                                # [Fo,S,F,B]
+        hc = full[:, :, 2]
+        GL = jnp.cumsum(hg, axis=3)
+        HL = jnp.cumsum(hh, axis=3)
+        CL = jnp.cumsum(hc, axis=3)
+        Gt, Ht, Ct = GL[:, :, 0, -1, :], HL[:, :, 0, -1], CL[:, :, 0, -1]
+        Gm, Hm, Cm = hg[:, :, :, 0, :], hh[:, :, :, 0], hc[:, :, :, 0]
+        gain = split_scores_f(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm,
+                              reg_lambda, min_child_weight, min_instances,
+                              min_info_gain, gamma, alpha, False)
+        if feature_mask is not None:
+            gain = jnp.where(feature_mask[None, None, :, None, None],
+                             gain, -jnp.inf)
+        if use_level_mask:
+            # colsample_bylevel: one fresh subset per level, shared by
+            # every fold (fold parity with the sequential loop), nested
+            # inside the bytree subset exactly as grow_tree does
+            lkey, sub = jax.random.split(lkey)
+            fml = _level_feature_mask(sub, F, level_feature_frac,
+                                      feature_mask, feature_mask_count)
+            gain = jnp.where(fml[None, None, :, None, None],
+                             gain, -jnp.inf)
+        flat = gain.reshape(Fo, S_pad, F * B * 2)
+        best = jnp.argmax(flat, axis=2)                   # [Fo, S]
+        best_gain = jnp.take_along_axis(flat, best[..., None],
+                                        axis=2)[..., 0]
+        ok = jnp.isfinite(best_gain)
+        if n_act is not None:
+            ok = ok & (jnp.arange(S_pad, dtype=jnp.int32)[None, :] < n_act)
+        f_lvl = jnp.where(ok, (best // (B * 2)).astype(jnp.int32), 0)
+        t_lvl = jnp.where(ok, ((best // 2) % B).astype(jnp.int32), B - 1)
+        m_lvl = jnp.where(ok, (best % 2).astype(jnp.int32), 0)
+        last = (GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, f_lvl, t_lvl, m_lvl)
+        return f_lvl, t_lvl, m_lvl, lkey, last
+
+    # root histogram: all rows slot 0, one plain batched pass — partial
+    # sums psum-merge across row shards under the sharded driver
+    root = _allreduce(pallas_hist.hist_folds(
+        Xb_t, pay, node, n_slots=1, n_bins=B, interpret=interpret,
+        allow_bf16=True, derive_count=True), axis_name)
+    root = root.reshape(Fo, 1, 3, F, B)
+
+    feats, threshs, misses = [], [], []
+    if depth >= 2:
+        S = 1 << (depth - 2)
+        if S > 1:
+            histL0 = jnp.concatenate(
+                [root, jnp.zeros((Fo, S - 1, 3, F, B), jnp.float32)],
+                axis=1)
+        else:
+            histL0 = root
+        # seeding histL = prev = padded root makes the body UNIFORM: the
+        # level-0 interleave yields [root, root - root, 0, ...] — the
+        # root level's full histogram with no branch on the level index
+        n_act_levels = jnp.asarray([1 << d for d in range(depth - 1)],
+                                   jnp.int32)
+        carry0 = _shard_vary_opt((node, histL0, histL0, key0), axis_name)
+
+        def body(carry, n_act):
+            node, prevh, histL, lkey = carry
+            # full level histogram by sibling subtraction at the PADDED
+            # width: slot 2p = left child (histL), 2p+1 = parent - left;
+            # truncating the interleave at S keeps the carry fixed-shape
+            # (levels inside the scan have at most S live nodes)
+            full = jnp.stack([histL, prevh - histL], axis=2).reshape(
+                Fo, 2 * S, 3, F, B)[:, :S]
+            f_lvl, t_lvl, m_lvl, lkey, _ = level_tables(full, n_act, lkey)
+            # fused pass: route with this level's tables AND accumulate
+            # the next level's left-child histograms in ONE Xb read;
+            # n_nodes is the padded width every level, so Mosaic sees
+            # exactly one route_hist shape per fit
+            hist, node = pallas_hist.route_hist(
+                Xb_t, pay, node, f_lvl, t_lvl, m_lvl, n_nodes=S,
+                n_bins=B, interpret=interpret, allow_bf16=True,
+                derive_count=True)
+            hist = _allreduce(hist, axis_name)
+            return ((node, full, hist.reshape(Fo, S, 3, F, B), lkey),
+                    (f_lvl, t_lvl, m_lvl))
+
+        (node, prevh, histL, key0), (fs, ts, ms) = jax.lax.scan(
+            body, carry0, n_act_levels)
+        full_f = jnp.stack([histL, prevh - histL], axis=2).reshape(
+            Fo, 2 * S, 3, F, B)
+        for d in range(depth - 1):
+            feats.append(fs[d][:, :1 << d])
+            threshs.append(ts[d][:, :1 << d])
+            misses.append(ms[d][:, :1 << d])
+    else:
+        full_f = root
+
+    # final level: split + plain routing pass (no further histogram) —
+    # one unrolled copy of the level body at twice the scan width
+    n_half = 1 << (depth - 1)
+    f_lvl, t_lvl, m_lvl, key0, last = level_tables(full_f, None, key0)
+    feats.append(f_lvl)
+    threshs.append(t_lvl)
+    misses.append(m_lvl)
+    node = pallas_hist.route(Xb_t, node, f_lvl, t_lvl, m_lvl,
+                             n_nodes=n_half, interpret=interpret)
+
+    leaf = _fold_leaves(last, n_leaves=1 << depth, reg_lambda=reg_lambda,
+                        alpha=alpha, max_delta_step=max_delta_step,
+                        learning_rate=learning_rate)
+    leaf_rows = pallas_hist.table_lookup(
+        leaf[:, :, 0], node, interpret=interpret)         # [Fo, N]
+    tree = Tree(jnp.concatenate(feats, axis=1),
+                jnp.concatenate(threshs, axis=1), leaf,
+                jnp.concatenate(misses, axis=1))
+    return tree, leaf_rows
+
+
+def _grow_tree_folds_unrolled(Xb_t, G, H, *, depth, n_bins,
+                              reg_lambda, min_child_weight, min_instances,
+                              min_info_gain, gamma, learning_rate,
+                              feature_mask, interpret=False, alpha=0.0,
+                              max_delta_step=0.0, level_feature_frac=1.0,
+                              level_key=None, feature_mask_count=None,
+                              axis_name=None):
+    """Legacy depth-unrolled form (TMOG_TREE_SCAN=0 kill switch): one
+    program section per level, O(depth) HLO. See _grow_tree_folds."""
+    from . import pallas_hist
+
+    F, N = Xb_t.shape
+    Fo = G.shape[0]
+    B = n_bins + 1
+    split_scores_f = _fold_split_scores(reg_lambda, min_child_weight, gamma)
 
     def interleave_f(left, right, n_nodes):
         # children along axis 1: [Fo, 2p, ...] from per-parent pairs
@@ -1005,10 +1302,10 @@ def _grow_tree_folds(Xb_t, G, H, *, depth, n_bins,
         n_nodes = 1 << d
         if d == 0:
             # root histogram: all rows slot 0, one plain batched pass
-            hist = pallas_hist.hist_folds(
+            hist = _allreduce(pallas_hist.hist_folds(
                 Xb_t, pay, node, n_slots=1, n_bins=B,
                 interpret=interpret, allow_bf16=True,
-                derive_count=True)                        # [Fo*1*3, F*B]
+                derive_count=True), axis_name)            # [Fo*1*3, F*B]
             n_slots = 1
         else:
             # `hist` holds the LEFT-child histograms of THIS level,
@@ -1072,6 +1369,7 @@ def _grow_tree_folds(Xb_t, G, H, *, depth, n_bins,
                 Xb_t, pay, node, f_lvl, t_lvl, m_lvl, n_nodes=n_nodes,
                 n_bins=B, interpret=interpret, allow_bf16=True,
                 derive_count=True)
+            hist = _allreduce(hist, axis_name)
         else:
             # final level: no further histogram — plain routing pass to
             # land every row on its leaf
@@ -1080,36 +1378,16 @@ def _grow_tree_folds(Xb_t, G, H, *, depth, n_bins,
 
     n_leaves = 1 << depth
     if depth == 0:
-        Gl = G.sum(axis=1)[:, None, None]                 # [Fo, 1, 1]
-        Hl = H.sum(axis=1)[:, None]
-        Cl = (H > 0).astype(jnp.float32).sum(axis=1)[:, None]
+        Gl = _allreduce(G.sum(axis=1), axis_name)[:, None, None]
+        Hl = _allreduce(H.sum(axis=1), axis_name)[:, None]
+        Cl = _allreduce((H > 0).astype(jnp.float32).sum(axis=1),
+                        axis_name)[:, None]
+        leaf = _leaf_payload(Gl, Hl, Cl, reg_lambda, alpha,
+                             max_delta_step, learning_rate)
     else:
-        GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm, f_lvl, t_lvl, m_lvl = last
-        n_half = n_leaves // 2
-
-        def leaf_of(GLk, HLk, CLk, Gtk, Htk, Ctk, Gmk, Hmk, Cmk,
-                    fk, tk, mk):
-            nid = jnp.arange(n_half)
-            mr = mk.astype(jnp.float32)
-            Gleft = GLk[nid, fk, tk, :] - mr[:, None] * Gmk[nid, fk, :]
-            Hleft = HLk[nid, fk, tk] - mr * Hmk[nid, fk]
-            Cleft = CLk[nid, fk, tk] - mr * Cmk[nid, fk]
-            Gl = jnp.stack([Gleft, Gtk - Gleft], axis=1).reshape(
-                n_leaves, Gleft.shape[-1])
-            Hl = jnp.stack([Hleft, Htk - Hleft], axis=1).reshape(n_leaves)
-            Cl = jnp.stack([Cleft, Ctk - Cleft], axis=1).reshape(n_leaves)
-            return Gl, Hl, Cl
-        Gl, Hl, Cl = jax.vmap(leaf_of)(GL, HL, CL, Gt, Ht, Ct, Gm, Hm, Cm,
-                                       f_lvl, t_lvl, m_lvl)
-    rl_col = reg_lambda[:, None] if getattr(reg_lambda, "ndim", 0) == 1 \
-        else reg_lambda
-    leaf = -_soft_l1(Gl, alpha) / (Hl + rl_col + EPS)[..., None]
-    if max_delta_step > 0.0:  # [Fo, L, 1] — cap raw newton step
-        leaf = jnp.clip(leaf, -max_delta_step, max_delta_step)
-    leaf = jnp.where(Cl[..., None] >= 0.5, leaf, 0.0)
-    lr_col = learning_rate[:, None, None] \
-        if getattr(learning_rate, "ndim", 0) == 1 else learning_rate
-    leaf = lr_col * leaf
+        leaf = _fold_leaves(last, n_leaves=n_leaves, reg_lambda=reg_lambda,
+                            alpha=alpha, max_delta_step=max_delta_step,
+                            learning_rate=learning_rate)
     leaf_rows = pallas_hist.table_lookup(
         leaf[:, :, 0], node, interpret=interpret)         # [Fo, N]
     tree = Tree(jnp.concatenate(feats, axis=1),
@@ -1118,52 +1396,41 @@ def _grow_tree_folds(Xb_t, G, H, *, depth, n_bins,
     return tree, leaf_rows
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_rounds", "depth", "n_bins", "loss", "subsample",
-                     "feature_frac", "interpret", "alpha",
-                     "max_delta_step", "colsample_bylevel", "base_score"))
-def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
-                  key: jax.Array, *, n_rounds: int, depth: int,
-                  n_bins: int, learning_rate: float = 0.1,
-                  reg_lambda: float = 1.0, min_child_weight: float = 0.0,
-                  min_instances: float = 1.0, min_info_gain: float = 0.0,
-                  gamma: float = 0.0, subsample: float = 1.0,
-                  feature_frac: float = 1.0, loss: str = "logistic",
-                  interpret: bool = False, alpha: float = 0.0,
-                  max_delta_step: float = 0.0,
-                  colsample_bylevel: float = 1.0,
-                  base_score: Optional[float] = None):
-    """Boosted trees for every CV fold in ONE device program.
-
-    The mask-fold sweep (models/trees.mask_fit_scores) above the fold-vmap
-    row limit used to loop folds through fit_gbt sequentially — each fold
-    re-reading the binned matrix and re-building the (feature, bin)
-    one-hots that dominate the histogram kernel, with a contraction M dim
-    (slots x 3 payload channels) far under the 128-row MXU tile. Here the
-    folds share every Xb pass (fold-fused pallas histograms + routing) and
-    stack their payload rows into the same contraction.
-
-    Xb [N, F] binned (bin_matrix layout); y [N]; W [Fo, N] per-fold
-    weights (0 = row excluded from that fold's fit). Per-fold quantities
-    follow fit_gbt exactly — same base score, same gradient clamps, same
-    per-round subsample/colsample draws (ONE draw shared by all folds,
-    matching the sequential loop where every fold fits with the same
-    key). Returns (trees [rounds, Fo, ...], base [Fo], margins [Fo, N]) —
-    margins are the fitted scores for ALL rows (held-out rows are routed
-    through each fold's trees), i.e. exactly what the sequential
-    per-fold `base + predict_forest_bins(...)` loop produces.
-    """
+def _fit_gbt_folds_impl(Xb, y, W, key, *, n_rounds, depth, n_bins,
+                        learning_rate=0.1, reg_lambda=1.0,
+                        min_child_weight=0.0, min_instances=1.0,
+                        min_info_gain=0.0, gamma=0.0, subsample=1.0,
+                        feature_frac=1.0, loss="logistic",
+                        interpret=False, alpha=0.0, max_delta_step=0.0,
+                        colsample_bylevel=1.0, base_score=None,
+                        axis_name=None):
+    """Shared body of fit_gbt_folds (single device, axis_name=None) and
+    fit_gbt_folds_sharded (inside shard_map: inputs hold this shard's
+    LOCAL rows and every histogram/base-score reduction psums over
+    `axis_name`)."""
     grad_fn = _logistic_grad if loss == "logistic" else _squared_grad
     Fo, N = W.shape
     n_orig = N
-    wsum = W.sum(axis=1) + EPS
-    wy = (W * y[None, :]).sum(axis=1)
+    if subsample < 1.0 and axis_name is not None:
+        # per-shard uniform draws are index-local: every shard would draw
+        # the SAME bits for its local rows — neither matching the
+        # single-device mask nor independent. The sweep gate
+        # (models/trees._sharded_route_ok) keeps such configs off this
+        # route; this raise is the trace-time backstop.
+        raise ValueError("row subsample < 1.0 is not supported on the "
+                         "sharded fused sweep route")
+    wsum = _allreduce(W.sum(axis=1), axis_name) + EPS
+    wy = _allreduce((W * y[None, :]).sum(axis=1), axis_name)
     if base_score is not None:  # pinned prior, fit_gbt semantics
         if loss == "logistic":
+            # base_score is a python scalar at every call site (a jit
+            # static arg of fit_gbt_folds / a closure constant of the
+            # sharded driver), never traced
+            # tmoglint: disable=TPU001  static python scalar
             p0 = min(max(float(base_score), 1e-6), 1 - 1e-6)
             base = jnp.full((Fo,), np.log(p0 / (1 - p0)), jnp.float32)
         else:
+            # tmoglint: disable=TPU001  static python scalar
             base = jnp.full((Fo,), float(base_score), jnp.float32)
     elif loss == "logistic":
         p0 = jnp.clip(wy / wsum, 1e-6, 1 - 1e-6)
@@ -1221,14 +1488,182 @@ def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
             max_delta_step=max_delta_step,
             level_feature_frac=colsample_bylevel, level_key=kf,
             feature_mask_count=(
+                # feature_frac: jit static arg / closure constant
+                # tmoglint: disable=TPU001  static python scalar
                 max(1, int(round(feature_frac * Xb_t.shape[0])))
-                if feature_frac < 1.0 else None))
+                if feature_frac < 1.0 else None),
+            axis_name=axis_name)
         return (margin + leaf_rows,), tree
 
     init = jnp.broadcast_to(base[:, None], (Fo, N)).astype(jnp.float32)
+    init = _shard_vary_opt(init, axis_name)
     (margin,), trees = jax.lax.scan(one, (init,),
                                     jax.random.split(key, n_rounds))
     return trees, base, margin[:, :n_orig]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_rounds", "depth", "n_bins", "loss", "subsample",
+                     "feature_frac", "interpret", "alpha",
+                     "max_delta_step", "colsample_bylevel", "base_score"))
+def fit_gbt_folds(Xb: jax.Array, y: jax.Array, W: jax.Array,
+                  key: jax.Array, *, n_rounds: int, depth: int,
+                  n_bins: int, learning_rate: float = 0.1,
+                  reg_lambda: float = 1.0, min_child_weight: float = 0.0,
+                  min_instances: float = 1.0, min_info_gain: float = 0.0,
+                  gamma: float = 0.0, subsample: float = 1.0,
+                  feature_frac: float = 1.0, loss: str = "logistic",
+                  interpret: bool = False, alpha: float = 0.0,
+                  max_delta_step: float = 0.0,
+                  colsample_bylevel: float = 1.0,
+                  base_score: Optional[float] = None):
+    """Boosted trees for every CV fold in ONE device program.
+
+    The mask-fold sweep (models/trees.mask_fit_scores) above the fold-vmap
+    row limit used to loop folds through fit_gbt sequentially — each fold
+    re-reading the binned matrix and re-building the (feature, bin)
+    one-hots that dominate the histogram kernel, with a contraction M dim
+    (slots x 3 payload channels) far under the 128-row MXU tile. Here the
+    folds share every Xb pass (fold-fused pallas histograms + routing) and
+    stack their payload rows into the same contraction. Whole trees grow
+    in ONE lax.scan over levels by default (TMOG_TREE_SCAN, see
+    _grow_tree_folds), so the traced program is O(1) — not O(depth) — in
+    size and one (shape, depth) compiles exactly one executable.
+
+    Xb [N, F] binned (bin_matrix layout); y [N]; W [Fo, N] per-fold
+    weights (0 = row excluded from that fold's fit). Per-fold quantities
+    follow fit_gbt exactly — same base score, same gradient clamps, same
+    per-round subsample/colsample draws (ONE draw shared by all folds,
+    matching the sequential loop where every fold fits with the same
+    key). Returns (trees [rounds, Fo, ...], base [Fo], margins [Fo, N]) —
+    margins are the fitted scores for ALL rows (held-out rows are routed
+    through each fold's trees), i.e. exactly what the sequential
+    per-fold `base + predict_forest_bins(...)` loop produces.
+    """
+    return _fit_gbt_folds_impl(
+        Xb, y, W, key, n_rounds=n_rounds, depth=depth, n_bins=n_bins,
+        learning_rate=learning_rate, reg_lambda=reg_lambda,
+        min_child_weight=min_child_weight, min_instances=min_instances,
+        min_info_gain=min_info_gain, gamma=gamma, subsample=subsample,
+        feature_frac=feature_frac, loss=loss, interpret=interpret,
+        alpha=alpha, max_delta_step=max_delta_step,
+        colsample_bylevel=colsample_bylevel, base_score=base_score)
+
+
+#: jitted shard_map program per (mesh, static config) — an explicit dict
+#: (not lru_cache) so the kill switches can DROP programs for real:
+#: registering each rebuilt jit with the tracing fallback would retain
+#: every cleared generation's executables forever, so instead ONE stable
+#: probe (_ShardedJitProbe, registered at import) sums executable counts
+#: over whatever programs are currently live here.
+_SHARDED_FIT_CACHE: dict = {}
+
+
+class _ShardedJitProbe:
+    """Stable register_jit_fallback entry for the sharded fit programs:
+    no-monitoring compile counting samples the LIVE cache only, and
+    cleared programs become unreachable (no unbounded retention across
+    set_tree_scan / pallas-toggle cache clears)."""
+
+    @staticmethod
+    def _cache_size():
+        total = 0
+        for fn in _SHARDED_FIT_CACHE.values():
+            try:
+                total += int(fn._cache_size())
+            except Exception:
+                pass
+        return total
+
+
+def _sharded_gbt_fn(mesh, static_kw):
+    """One jitted shard_map program per (mesh, static config) — cached
+    (mirroring ops/glm_sweep's sharded-driver caching) so repeated
+    sweeps at one shape reuse the compiled executable."""
+    fn = _SHARDED_FIT_CACHE.get((mesh, static_kw))
+    if fn is not None:
+        return fn
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import BATCH_AXIS, build_shard_map
+
+    kw = dict(static_kw)
+
+    def core(Xb, y, W, key, learning_rate, reg_lambda, min_child_weight,
+             gamma):
+        return _fit_gbt_folds_impl(
+            Xb, y, W, key, learning_rate=learning_rate,
+            reg_lambda=reg_lambda, min_child_weight=min_child_weight,
+            gamma=gamma, axis_name=BATCH_AXIS, **kw)
+
+    sm = build_shard_map(
+        core, mesh,
+        in_specs=(P(BATCH_AXIS, None), P(BATCH_AXIS), P(None, BATCH_AXIS),
+                  P(), P(None), P(None), P(None), P(None)),
+        # trees/base replicate (they are grown from psum-merged
+        # histograms, identical on every shard); margins stay row-sharded
+        out_specs=(P(), P(), P(None, BATCH_AXIS)))
+    fn = jax.jit(sm)
+    _SHARDED_FIT_CACHE[(mesh, static_kw)] = fn
+    return fn
+
+
+def fit_gbt_folds_sharded(Xb: jax.Array, y: jax.Array, W: jax.Array,
+                          key: jax.Array, *, mesh, n_rounds: int,
+                          depth: int, n_bins: int,
+                          learning_rate=0.1, reg_lambda=1.0,
+                          min_child_weight=0.0, min_instances: float = 1.0,
+                          min_info_gain: float = 0.0, gamma=0.0,
+                          subsample: float = 1.0, feature_frac: float = 1.0,
+                          loss: str = "logistic", interpret: bool = False,
+                          alpha: float = 0.0, max_delta_step: float = 0.0,
+                          colsample_bylevel: float = 1.0,
+                          base_score: Optional[float] = None):
+    """fit_gbt_folds with rows sharded over the mesh batch axis.
+
+    The DrJAX MapReduce shape over parallel/mesh.py: each device streams
+    only its row shard of the binned matrix through the fused
+    route+histogram passes, per-level histograms psum-merge across
+    shards before the (replicated) split algebra, and routing stays
+    local — so the (fold x config) lane axis of the sweep finally runs
+    on a mesh instead of falling back to the sequential per-fold path.
+    Requirements: the batch-axis device count must divide N (the
+    validator pads rows up to a multiple of it via
+    pad_rows_to_multiple) and subsample must stay 1.0 (per-shard
+    draws are index-local — see _fit_gbt_folds_impl). The four per-lane
+    algebra params always travel as [Fo] vectors here (one program
+    shape for scalar and vector callers). Margins match the
+    single-device fused fit up to f32 psum summation order.
+    """
+    Fo = W.shape[0]
+
+    def lane(v):
+        a = jnp.asarray(v, jnp.float32)
+        return jnp.broadcast_to(a, (Fo,)) if a.ndim == 0 else a
+
+    static_kw = (
+        ("n_rounds", int(n_rounds)), ("depth", int(depth)),
+        ("n_bins", int(n_bins)), ("min_instances", float(min_instances)),
+        ("min_info_gain", float(min_info_gain)),
+        ("subsample", float(subsample)),
+        ("feature_frac", float(feature_frac)), ("loss", str(loss)),
+        ("interpret", bool(interpret)), ("alpha", float(alpha)),
+        ("max_delta_step", float(max_delta_step)),
+        ("colsample_bylevel", float(colsample_bylevel)),
+        ("base_score", None if base_score is None else float(base_score)))
+    fn = _sharded_gbt_fn(mesh, static_kw)
+    return fn(Xb, y, W, key, lane(learning_rate), lane(reg_lambda),
+              lane(min_child_weight), lane(gamma))
+
+
+class _ShardedCacheClearer:
+    """Adapter so the sharded-program dict sits on the pallas
+    kill-switch consumer list (which calls .clear_cache())."""
+
+    @staticmethod
+    def clear_cache():
+        _SHARDED_FIT_CACHE.clear()
 
 
 @functools.partial(
@@ -1295,7 +1730,7 @@ def _register_pallas_consumers():
     kill switch must be able to clear them (set_pallas_enabled)."""
     from . import pallas_hist
     for fn in (grow_tree, fit_forest, fit_gbt, fit_gbt_folds,
-               fit_gbt_softmax):
+               fit_gbt_softmax, _ShardedCacheClearer()):
         pallas_hist.register_cache_consumer(fn)
 
 
@@ -1311,7 +1746,7 @@ def _register_trace_fallback():
     from ..utils import tracing
     tracing.register_jit_fallback(grow_tree, fit_forest, fit_gbt,
                                   fit_gbt_folds, fit_gbt_softmax,
-                                  _bin_tile_jit)
+                                  _bin_tile_jit, _ShardedJitProbe())
 
 
 _register_trace_fallback()

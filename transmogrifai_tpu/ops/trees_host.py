@@ -85,13 +85,13 @@ def quantile_edges_host(X: np.ndarray, n_bins: int) -> np.ndarray:
 
 def bin_matrix_host(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
     """Numpy twin of ops/trees.bin_matrix: NaN -> 0, present -> 1 +
-    right-side searchsorted. uint8 when the bins fit (<= 127 value bins —
+    right-side searchsorted. uint8 when the bins fit (<= 255 value bins —
     the Xb stream is the native builder's dominant memory traffic at big
-    N), int32 otherwise."""
+    N, and trees.cpp reads 1-byte bins as uint8_t), int32 otherwise."""
     X = np.asarray(X, np.float32)
     n, d = X.shape
     n_bins = edges.shape[1] + 1
-    dtype = np.uint8 if n_bins <= 127 else np.int32
+    dtype = np.uint8 if n_bins <= 255 else np.int32
     out = np.empty((n, d), dtype)
     for f in range(d):
         col = X[:, f]

@@ -64,6 +64,18 @@ def build_shard_map(core, mesh, in_specs, out_specs):
                      out_specs=out_specs, **extra)
 
 
+def mesh_batch_count(mesh) -> int:
+    """Devices on the batch axis (1 for None / degenerate meshes) — the
+    single predicate sweep drivers use to decide whether a mesh context
+    warrants the row-sharded fused route (models/trees)."""
+    if mesh is None:
+        return 1
+    try:
+        return int(dict(mesh.shape).get(BATCH_AXIS, 1))
+    except Exception:
+        return 1
+
+
 def make_mesh(n_batch: Optional[int] = None, n_model: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
     """Create a (batch, model) mesh over available devices."""
