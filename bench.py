@@ -1418,6 +1418,75 @@ def serving_bench(n_rows=None):
         out["shed"] = m["shed"]
         if errs:
             out["errors"] = errs[:5]
+
+        # monitoring on/off A/B (docs/monitoring.md): the same single-
+        # record + bulk traffic through a SECOND engine with the drift
+        # monitor attached — p50/p99 delta and bulk rows/s overhead of
+        # the per-bucket sketch program, sourced from the engines' own
+        # histograms, so the drift tax rides the bench trajectory
+        from transmogrifai_tpu.monitor import ServeMonitor, build_profile
+        profile = build_profile(model)
+        mon = ServeMonitor(profile, window_rows=4096, window_seconds=1e9)
+        eng_on = ServingEngine(model, max_batch=4096, strict_keys=False,
+                               monitor=mon)
+        eng_on.prewarm()
+        base_on = tracing.tracker.true_compiles
+        bulk = [{k: v for k, v in rec(i).items() if k != "y"}
+                for i in range(n_bulk)]
+        t0 = time.perf_counter()
+        assert len(eng_on.score_batch(bulk)) == n_bulk
+        # score_batch returns host dicts — already synced
+        wall_on = time.perf_counter() - t0  # tmoglint: disable=TPU005
+        del bulk
+        # IDENTICAL single-record mix to the baseline phase (200
+        # sequential + 8x25 concurrent): the p50/p99 delta must isolate
+        # the sketch overhead, not a different queue-wait profile
+        b_on = MicroBatcher(eng_on, max_wait_ms=1.0, max_queue=4096)
+        for r in singles[:200]:
+            b_on.submit(dict(r))
+        errs_on = []
+
+        def fire_on(rs):
+            for r in rs:
+                try:
+                    b_on.submit(dict(r))
+                except Exception as e:  # noqa: BLE001 - recorded below
+                    errs_on.append(repr(e))
+
+        ths = [threading.Thread(target=fire_on,
+                                args=(singles[200 + 25 * k:
+                                              200 + 25 * (k + 1)],))
+               for k in range(8)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(120)
+        b_on.shutdown(drain=True)
+        eng_on.finish_monitor()
+        if errs_on:
+            out.setdefault("errors", []).extend(errs_on[:5])
+        m_on = eng_on.metrics()
+        rows_s_off = out["bulk"]["rows_per_s"]
+        rows_s_on = round(n_bulk / max(wall_on, 1e-9))
+        out["monitor_ab"] = {
+            "windows": m_on["monitor"]["windows"],
+            "alerts": m_on["monitor"]["alerts_total"],
+            "post_warmup_compiles_on": (tracing.tracker.true_compiles
+                                        - base_on),
+            "single_p50_ms_off": out["single_record"]["p50_ms"],
+            "single_p50_ms_on": m_on["latency"]["total"]["p50_ms"],
+            "single_p99_ms_off": out["single_record"]["p99_ms"],
+            "single_p99_ms_on": m_on["latency"]["total"]["p99_ms"],
+            "p50_delta_ms": round(m_on["latency"]["total"]["p50_ms"]
+                                  - out["single_record"]["p50_ms"], 4),
+            "p99_delta_ms": round(m_on["latency"]["total"]["p99_ms"]
+                                  - out["single_record"]["p99_ms"], 4),
+            "bulk_rows_per_s_off": rows_s_off,
+            "bulk_rows_per_s_on": rows_s_on,
+            "bulk_overhead_pct": round(
+                100.0 * (rows_s_off - rows_s_on) / max(rows_s_off, 1),
+                2),
+        }
     finally:
         collector.finish()
         collector.disable()

@@ -388,6 +388,150 @@ print(f"serving smoke ok: 0 prewarm compiles ({warm['cache_hits']} cache "
       f"hits), {m['requests']} requests, p50 {p50}ms, clean drain")
 PY
 rm -rf "$SERVE_TMP"
+# drift-monitor smoke (docs/monitoring.md): fit+save writes the
+# monitor.json reference profile; a monitored engine serving traffic
+# from a deliberately SHIFTED distribution raises drift_alert within ONE
+# window (with 0 true XLA compiles after warmup), trace-report --check
+# on that run dir SURFACES the drift (fails + names drift_alert), while
+# identical-distribution traffic stays quiet across 3 windows and
+# passes --check; finally the offline `monitor` CLI over the same
+# shifted file agrees with the serve-side verdict (exit 3 under
+# --fail-on-drift) and stays green on the quiet file.
+MON_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - "$MON_TMP" <<'PY'
+import csv
+import os
+import sys
+
+import numpy as np
+
+out = sys.argv[1]
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.readers.readers import CSVReader, ListReader
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.workflow import Workflow
+
+rng = np.random.default_rng(0)
+
+
+def make_rows(n, shift=0.0, cat=("u", "v", "w")):
+    rows = []
+    for _ in range(n):
+        a, b = float(rng.normal(shift)), float(rng.normal())
+        rows.append({"a": a, "b": b, "c": str(rng.choice(list(cat))),
+                     "y": float(a + 0.5 * b > shift)})
+    return rows
+
+
+fa = FeatureBuilder.Real("a").extract(lambda r: r.get("a")).as_predictor()
+fb = FeatureBuilder.Real("b").extract(lambda r: r.get("b")).as_predictor()
+fc = FeatureBuilder.PickList("c").extract(lambda r: r.get("c")).as_predictor()
+fy = FeatureBuilder.RealNN("y").extract(lambda r: r.get("y")).as_response()
+pred = BinaryClassificationModelSelector.with_train_validation_split(
+    models_and_parameters=[(OpLogisticRegression(),
+                            param_grid(reg_param=[0.01]))],
+).set_input(fy, transmogrify([fa, fb, fc])).get_output()
+model = Workflow().set_reader(ListReader(make_rows(500))) \
+    .set_result_features(pred).train()
+model.save(out + "/model")
+assert os.path.exists(out + "/model/monitor.json"), \
+    "fit+save must write the reference profile"
+
+# the shifted and quiet bulk files (the offline CLI scores these next)
+for name, shift, cat in (("shifted", 9.0, ("q",)),
+                         ("quiet", 0.0, ("u", "v", "w"))):
+    with open(f"{out}/{name}.csv", "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=["a", "b", "c"])
+        w.writeheader()
+        for r in make_rows(384, shift=shift, cat=cat):
+            w.writerow({k: r[k] for k in ("a", "b", "c")})
+
+from transmogrifai_tpu.monitor import ReferenceProfile, ServeMonitor
+from transmogrifai_tpu.serve import ServingEngine
+from transmogrifai_tpu.utils import tracing
+from transmogrifai_tpu.utils.metrics import collector
+from transmogrifai_tpu.workflow.io import load_monitor_profile
+from transmogrifai_tpu.workflow.workflow import WorkflowModel
+
+m2 = WorkflowModel.load(out + "/model")
+prof = ReferenceProfile.from_json(load_monitor_profile(out + "/model"))
+os.makedirs(out + "/drifted")
+os.makedirs(out + "/quiet_run")
+collector.enable("ci_monitor")
+
+# drifted: serve the SAME shifted file the offline CLI will read
+collector.attach_event_log(out + "/drifted/events.jsonl")
+mon = ServeMonitor(prof, window_rows=128, window_seconds=1e9)
+eng = ServingEngine(m2, max_batch=16, monitor=mon)
+eng.prewarm()
+base = tracing.tracker.true_compiles
+eng.score_batch(CSVReader(out + "/shifted.csv").read()[:128])
+assert mon.n_windows == 1, mon.n_windows
+assert mon.alerts_total > 0, "shifted traffic must alert within 1 window"
+assert tracing.tracker.true_compiles == base, \
+    "monitoring must not compile after warmup"
+rep = mon.report()
+assert rep["alerting"] and rep["last"]["alerts"], rep
+targets = {al["target"] for al in rep["last"]["alerts"]}
+assert {"a", "c"} <= targets, targets
+collector.detach_event_log()
+
+# quiet: identical-distribution traffic across 3 windows stays silent
+collector.attach_event_log(out + "/quiet_run/events.jsonl")
+mon2 = ServeMonitor(prof, window_rows=128, window_seconds=1e9)
+eng2 = ServingEngine(m2, max_batch=16, monitor=mon2)
+eng2.prewarm()
+base2 = tracing.tracker.true_compiles
+eng2.score_batch([{k: r[k] for k in ("a", "b", "c")}
+                  for r in make_rows(3 * 128)])
+assert mon2.n_windows == 3 and mon2.alerts_total == 0, \
+    (mon2.n_windows, mon2.alerts_total)
+assert tracing.tracker.true_compiles == base2
+collector.detach_event_log()
+collector.disable()
+print(f"monitor serve smoke ok: drifted window alerted on {sorted(targets)}"
+      f", quiet 3 windows silent, 0 post-warmup compiles")
+PY
+# trace-report --check must FAIL on the drifted run and NAME drift_alert
+if PYTHONPATH="$PWD" python -m transmogrifai_tpu trace-report \
+    "$MON_TMP/drifted" --check > "$MON_TMP/check_drifted.out" 2>&1; then
+  echo "trace-report --check unexpectedly PASSED on the drifted run"
+  exit 1
+fi
+grep -q "drift_alert" "$MON_TMP/check_drifted.out"
+echo "  trace-report surfaced the drift_alert"
+# ... and stay green on the quiet run
+PYTHONPATH="$PWD" python -m transmogrifai_tpu trace-report \
+  "$MON_TMP/quiet_run" --check > /dev/null
+# offline CLI over the same shifted file agrees with the serve verdict
+set +e
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python -m transmogrifai_tpu monitor \
+  "$MON_TMP/model" "$MON_TMP/shifted.csv" --fail-on-drift \
+  --tile-rows 128 > "$MON_TMP/offline_drifted.json"
+MON_RC=$?
+set -e
+[ "$MON_RC" -eq 3 ] || {
+  echo "offline monitor CLI missed the drift (rc=$MON_RC)"; exit 1; }
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python -m transmogrifai_tpu monitor \
+  "$MON_TMP/model" "$MON_TMP/quiet.csv" --fail-on-drift \
+  --tile-rows 128 > "$MON_TMP/offline_quiet.json"
+python - "$MON_TMP" <<'PY'
+import json
+import sys
+
+out = sys.argv[1]
+drifted = json.load(open(out + "/offline_drifted.json"))
+quiet = json.load(open(out + "/offline_quiet.json"))
+assert drifted["verdict"] == "drift" and drifted["alerts_total"] > 0
+assert {a["target"] for a in drifted["last"]["alerts"]} >= {"a", "c"}
+assert quiet["verdict"] == "ok" and quiet["alerts_total"] == 0
+print(f"monitor offline smoke ok: shifted file -> drift "
+      f"({drifted['alerts_total']} alerts), quiet file -> ok")
+PY
+rm -rf "$MON_TMP"
 # tree-sweep smoke on the 2-device CPU mesh: the mesh-sharded fused sweep
 # (TMOG_GRID_FUSE=1 + a mesh validator) must take the
 # mask_folds:grid_fused_sharded route, match the meshless fused kernel's

@@ -1,0 +1,501 @@
+"""Online drift & model-health monitoring (monitor/, docs/monitoring.md).
+
+Unit contracts: the shared-sketch refactor is bit-identical (golden
+parity for RawFeatureFilter distributions + alias identity), JS/PSI are
+well-defined property-wise (bounds, symmetry, zero-window identity),
+reference profiles round-trip through monitor.json, the window sketch
+bins BIT-IDENTICALLY to the profile side, tumbling windows roll over on
+rows/time/force, and the offline driver produces the same verdict the
+serve side would.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu import Dataset, FeatureBuilder
+from transmogrifai_tpu.filters import sketches
+from transmogrifai_tpu.filters import raw_feature_filter as rff
+from transmogrifai_tpu.filters import compute_distributions
+from transmogrifai_tpu.monitor import (DriftPolicy, ReferenceProfile,
+                                       ServeMonitor, build_profile,
+                                       js_divergence_hist,
+                                       js_divergence_nats, offline_report,
+                                       psi)
+from transmogrifai_tpu.monitor.drift import coarsen
+from transmogrifai_tpu.monitor.profile import score_hist, score_of
+from transmogrifai_tpu.readers.streaming import ListStreamingReader
+from transmogrifai_tpu.types import PickList, Real, RealNN, TextMap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- shared-sketch refactor parity -------------------------------------------
+
+#: emitted by filters/sketches.compute_distributions on the dataset below
+#: at the time of the refactor out of raw_feature_filter.py — train-time
+#: RFF distributions must stay BIT-identical across the move (and after:
+#: profile-vs-window comparisons assume both sides bin like this forever)
+GOLDEN_DISTS = [
+    {"name": "x", "key": None, "count": 12, "nulls": 2,
+     "distribution": [3.0, 2.0, 2.0, 0.0, 1.0, 0.0, 0.0, 2.0],
+     "summary": [0.0, 10.0, 35.0, 10.0]},
+    {"name": "c", "key": None, "count": 12, "nulls": 2,
+     "distribution": [1.0, 3.0, 3.0, 3.0, 0.0, 0.0, 0.0, 0.0],
+     "summary": [0.0, 0.0, 10.0, 10.0]},
+    {"name": "m", "key": "k1", "count": 12, "nulls": 6,
+     "distribution": [0.0, 0.0, 0.0, 4.0, 1.0, 0.0, 0.0, 1.0],
+     "summary": [0.0, 0.0, 6.0, 6.0]},
+    {"name": "m", "key": "k2", "count": 12, "nulls": 8,
+     "distribution": [0.0, 3.0, 1.0, 0.0, 0.0, 0.0, 0.0, 0.0],
+     "summary": [0.0, 0.0, 4.0, 4.0]},
+    {"name": "m", "key": "k3", "count": 12, "nulls": 10,
+     "distribution": [0.0, 0.0, 0.0, 0.0, 2.0, 0.0, 0.0, 0.0],
+     "summary": [0.0, 0.0, 2.0, 2.0]},
+    {"name": "m", "key": None, "count": 12, "nulls": 2,
+     "distribution": [0.0, 3.0, 1.0, 2.0, 1.0, 1.0, 0.0, 2.0],
+     "summary": [0.0, 0.0, 10.0, 10.0]},
+]
+
+
+def _golden_ds():
+    vals = [0.0, 0.5, 1.0, 1.5, 2.0, 2.5, 3.0, 5.0, 9.5, 10.0, None,
+            float("nan")]
+    cats = ["alpha", "beta", "alpha", "gamma", None, "", "delta", "beta",
+            "alpha", "beta", "gamma", "x y"]
+    maps = [{"k1": "a", "k2": "b"}, {"k1": "c"}, {}, None,
+            {"k1": "a", "k3": 3.5}, {"k2": ["l1", "l2"]}, {"k1": "a"},
+            {"k2": "b"}, {"k1": "d"}, {"k1": "a"}, {"k2": "b"},
+            {"k3": 7.25}]
+    return Dataset.from_features([("x", Real, vals), ("c", PickList, cats),
+                                  ("m", TextMap, maps)])
+
+
+class TestSketchRefactorParity:
+    def test_golden_distributions_bit_identical(self):
+        dists = compute_distributions(_golden_ds(), ["x", "c", "m"], bins=8)
+        got = [d.to_json() for d in dists]
+        assert got == GOLDEN_DISTS
+
+    def test_rff_aliases_are_the_shared_functions(self):
+        # no second implementation may creep back into raw_feature_filter
+        assert rff._hash_bin is sketches.hash_bin
+        assert rff._is_empty is sketches.is_empty
+        assert rff._dist_numeric is sketches.dist_numeric
+        assert rff._dist_object is sketches.dist_object
+        assert rff._hist_numeric is sketches.hist_numeric
+        assert rff._numeric_distributions_batched \
+            is sketches.numeric_distributions_batched
+        assert rff._map_key_distributions is sketches.map_key_distributions
+        assert rff.compute_distributions is sketches.compute_distributions
+        assert rff.FeatureDistribution is sketches.FeatureDistribution
+
+    def test_hash_hist_update_matches_legacy_object_rules(self):
+        # independent reimplementation of the pre-refactor inline loop
+        import zlib
+
+        def legacy(values, bins):
+            hist = np.zeros(bins)
+            nulls = 0
+            for v in values:
+                if v is None or (isinstance(v, float) and np.isnan(v)) or \
+                        (isinstance(v, (str, list, tuple, set, dict))
+                         and len(v) == 0):
+                    nulls += 1
+                    continue
+                items = v if isinstance(v, (list, tuple, set)) else [v]
+                if not isinstance(v, (list, tuple, set)):
+                    items = [v]
+                for item in items:
+                    s = item if isinstance(item, str) else repr(item)
+                    hist[zlib.crc32(s.encode()) % bins] += 1.0
+            return hist, nulls
+
+        values = ["a", "bb", None, "", ["x", "y"], {"k": 1}, float("nan"),
+                  ("t1", "t2"), "a", 42]
+        want, want_nulls = legacy(values, 16)
+        got = np.zeros(16)
+        nulls = sum(0 if sketches.hash_hist_update(got, v) else 1
+                    for v in values)
+        np.testing.assert_array_equal(got, want)
+        assert nulls == want_nulls
+
+
+# -- drift metric properties -------------------------------------------------
+
+class TestDriftMetricProperties:
+    @pytest.fixture()
+    def hists(self):
+        rng = np.random.default_rng(7)
+        return [rng.integers(0, 50, size=24).astype(float)
+                for _ in range(6)]
+
+    def test_js_bounds_zero_to_ln2(self, hists):
+        ln2 = float(np.log(2.0))
+        for p in hists:
+            for q in hists:
+                v = js_divergence_nats(p, q)
+                assert 0.0 <= v <= ln2, (v, ln2)
+        # disjoint support achieves the upper bound exactly
+        p = np.array([1.0, 0.0, 0.0, 0.0])
+        q = np.array([0.0, 0.0, 1.0, 1.0])
+        assert js_divergence_nats(p, q) == pytest.approx(ln2)
+        assert js_divergence_hist(p, q) == pytest.approx(1.0)
+
+    def test_js_symmetry(self, hists):
+        for p in hists:
+            for q in hists:
+                assert js_divergence_nats(p, q) == pytest.approx(
+                    js_divergence_nats(q, p), abs=1e-12)
+
+    def test_js_zero_window_identity(self, hists):
+        z = np.zeros(24)
+        for p in hists:
+            assert js_divergence_nats(p, z) == 0.0
+            assert js_divergence_nats(z, p) == 0.0
+            assert js_divergence_hist(p, z) == 0.0
+        assert js_divergence_nats(z, z) == 0.0
+        # never NaN, even for garbage (negative mass sums to <= 0)
+        assert js_divergence_nats([-1.0, -2.0], [1.0, 2.0]) == 0.0
+
+    def test_js_self_is_zero_and_scale_invariant(self, hists):
+        for p in hists:
+            assert js_divergence_nats(p, p) == pytest.approx(0.0, abs=1e-12)
+            assert js_divergence_nats(p, 7.5 * p) == pytest.approx(
+                0.0, abs=1e-9)
+
+    def test_psi_properties(self, hists):
+        z = np.zeros(24)
+        for p in hists:
+            # zero-window identity + self identity + symmetry + sign
+            assert psi(p, z) == 0.0
+            assert psi(z, p) == 0.0
+            assert psi(p, p) == pytest.approx(0.0, abs=1e-12)
+            for q in hists:
+                v = psi(p, q)
+                assert np.isfinite(v) and v >= -1e-12
+                assert v == pytest.approx(psi(q, p), abs=1e-9)
+
+    def test_psi_detects_shift(self):
+        rng = np.random.default_rng(0)
+        a, _ = np.histogram(rng.normal(0, 1, 4000), bins=10, range=(-4, 4))
+        b, _ = np.histogram(rng.normal(0, 1, 4000), bins=10, range=(-4, 4))
+        c, _ = np.histogram(rng.normal(2, 1, 4000), bins=10, range=(-4, 4))
+        assert psi(a, b) < 0.1          # same distribution: stable
+        assert psi(a, c) > 0.25         # 2-sigma shift: major
+
+    def test_coarsen_preserves_mass_and_noops_small(self):
+        h = np.arange(40, dtype=float)
+        c = coarsen(h, 10)
+        assert len(c) == 10 and c.sum() == h.sum()
+        h2 = np.arange(7, dtype=float)
+        np.testing.assert_array_equal(coarsen(h2, 10), h2)
+
+    def test_score_hist_clips_out_of_range(self):
+        h = score_hist(np.array([-5.0, 0.5, 2.0, np.nan]), 0.0, 1.0, 4)
+        assert h.sum() == 3          # NaN dropped, not binned
+        assert h[0] == 1 and h[-1] == 1  # out-of-range mass -> edge bins
+
+
+# -- profiles ----------------------------------------------------------------
+
+def _make_rows(n=400, seed=3, shift=0.0, cat=("x", "y", "z")):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        a = float(rng.normal(shift))
+        b = float(rng.normal())
+        rows.append({"a": a, "b": b, "c": str(rng.choice(list(cat))),
+                     "y": float(a + 0.5 * b > shift)})
+    return rows
+
+
+def _fit_model(rows):
+    from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    from transmogrifai_tpu.models.glm import OpLogisticRegression
+    from transmogrifai_tpu.readers.readers import ListReader
+    from transmogrifai_tpu.stages.params import param_grid
+    from transmogrifai_tpu.workflow import Workflow
+
+    fa = FeatureBuilder.Real("a").extract(lambda r: r.get("a")).as_predictor()
+    fb = FeatureBuilder.Real("b").extract(lambda r: r.get("b")).as_predictor()
+    fc = FeatureBuilder.PickList("c").extract(
+        lambda r: r.get("c")).as_predictor()
+    fy = FeatureBuilder.RealNN("y").extract(
+        lambda r: r.get("y")).as_response()
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[(OpLogisticRegression(max_iter=15),
+                                param_grid(reg_param=[0.01]))],
+    ).set_input(fy, transmogrify([fa, fb, fc])).get_output()
+    return Workflow().set_reader(ListReader(rows)) \
+        .set_result_features(pred).train()
+
+
+@pytest.fixture(scope="module")
+def fitted(tmp_path_factory):
+    rows = _make_rows()
+    model = _fit_model(rows)
+    mdir = str(tmp_path_factory.mktemp("monitor") / "model")
+    model.save(mdir)
+    return model, rows, mdir
+
+
+class TestReferenceProfile:
+    def test_saved_next_to_model_and_roundtrips(self, fitted):
+        model, rows, mdir = fitted
+        assert os.path.exists(os.path.join(mdir, "monitor.json"))
+        from transmogrifai_tpu.workflow.io import load_monitor_profile
+        doc = load_monitor_profile(mdir)
+        prof = ReferenceProfile.from_json(doc)
+        assert set(prof.numeric_names) == {"a", "b"}
+        assert prof.hashed_names == ["c"]
+        assert prof.rows == len(rows)
+        a = prof.feature("a")
+        assert a.count == len(rows) and a.nulls == 0 and a.lo < a.hi
+        assert sum(a.hist) == pytest.approx(len(rows))
+        pred = prof.prediction
+        assert pred is not None and pred.field == "probability_1"
+        assert pred.lo == 0.0 and pred.hi == 1.0
+        assert sum(pred.hist) == pytest.approx(len(rows))
+        assert 0.0 < pred.mean < 1.0
+        # json round trip is lossless
+        again = ReferenceProfile.from_json(
+            json.loads(json.dumps(prof.to_json())))
+        assert again.to_json() == prof.to_json()
+
+    def test_profile_matches_rff_sketch_of_train_data(self, fitted):
+        """The profile's numeric histogram IS the RFF sketch of the
+        training data — same shared code path, bit-identical."""
+        model, rows, _ = fitted
+        prof = build_profile(model)
+        dists = {d.name: d for d in compute_distributions(
+            model._train_data, ["a", "b", "c"], prof.bins) if d.key is None}
+        for nm in ("a", "b", "c"):
+            assert prof.feature(nm).hist == dists[nm].distribution
+
+    def test_all_missing_feature_excluded(self):
+        rows = [{"a": float(i % 5), "dead": None,
+                 "y": float(i % 2)} for i in range(300)]
+        from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+        from transmogrifai_tpu.automl.transmogrifier import transmogrify
+        from transmogrifai_tpu.models.glm import OpLogisticRegression
+        from transmogrifai_tpu.readers.readers import ListReader
+        from transmogrifai_tpu.stages.params import param_grid
+        from transmogrifai_tpu.workflow import Workflow
+        fa = FeatureBuilder.Real("a").extract(
+            lambda r: r.get("a")).as_predictor()
+        fd = FeatureBuilder.Real("dead").extract(
+            lambda r: r.get("dead")).as_predictor()
+        fy = FeatureBuilder.RealNN("y").extract(
+            lambda r: r.get("y")).as_response()
+        pred = BinaryClassificationModelSelector \
+            .with_train_validation_split(
+                models_and_parameters=[(OpLogisticRegression(),
+                                        param_grid(reg_param=[0.01]))],
+            ).set_input(fy, transmogrify([fa, fd])).get_output()
+        model = Workflow().set_reader(ListReader(rows)) \
+            .set_result_features(pred).train()
+        prof = build_profile(model)
+        assert prof.feature("dead") is None  # no reference to alert on
+        assert prof.feature("a") is not None
+
+    def test_kill_switch_skips_profile(self, fitted, tmp_path,
+                                       monkeypatch):
+        model, _, _ = fitted
+        monkeypatch.setenv("TMOG_MONITOR_PROFILE", "0")
+        mdir = str(tmp_path / "m2")
+        model.save(mdir)
+        assert not os.path.exists(os.path.join(mdir, "monitor.json"))
+
+    def test_corrupt_profile_loads_none(self, fitted, tmp_path):
+        from transmogrifai_tpu.workflow.io import load_monitor_profile
+        d = str(tmp_path)
+        with open(os.path.join(d, "monitor.json"), "w") as f:
+            f.write("{broken")
+        assert load_monitor_profile(d) is None
+        assert load_monitor_profile(None) is None
+
+
+# -- windows -----------------------------------------------------------------
+
+class TestWindowSketch:
+    def _profile(self, fitted):
+        model, _, mdir = fitted
+        from transmogrifai_tpu.workflow.io import load_monitor_profile
+        return ReferenceProfile.from_json(load_monitor_profile(mdir))
+
+    def test_window_bins_bit_identical_to_profile(self, fitted):
+        """THE alignment pin: replaying the TRAINING rows through the
+        window sketch reproduces the profile histograms exactly — same
+        hist_bin_ids rule, same pinned edges, zero tolerance."""
+        model, rows, _ = fitted
+        prof = build_profile(model)
+        mon = ServeMonitor(prof, window_rows=10 ** 9,
+                           window_seconds=float("inf"))
+        X = np.stack([np.asarray([r["a"] for r in rows], np.float32),
+                      np.asarray([r["b"] for r in rows], np.float32)],
+                     axis=1)
+        mon.observe_numeric(X, np.ones(len(rows), np.float32))
+        mon.observe_hashed({"c": [r["c"] for r in rows]})
+        mon.add_rows(len(rows))
+        rep = mon.maybe_rollover(force=True)
+        feats = {f["feature"]: f for f in rep["features"]}
+        for nm in ("a", "b", "c"):
+            assert feats[nm]["js"] == 0.0, (nm, feats[nm])
+            assert feats[nm]["psi"] == pytest.approx(0.0, abs=1e-12)
+            assert feats[nm]["fill_rate"] == pytest.approx(
+                feats[nm]["train_fill_rate"])
+
+    def test_rollover_by_rows_and_alert_latch(self, fitted):
+        prof = self._profile(fitted)
+        mon = ServeMonitor(prof, window_rows=64,
+                           window_seconds=float("inf"))
+        rng = np.random.default_rng(0)
+
+        def feed(shift, n):
+            X = np.stack([rng.normal(shift, 1, n), rng.normal(0, 1, n)],
+                         axis=1).astype(np.float32)
+            mon.observe_numeric(X, np.ones(n, np.float32))
+            mon.observe_hashed(
+                {"c": [str(c) for c in rng.choice(["x", "y", "z"], n)]})
+            mon.add_rows(n)
+
+        feed(25.0, 64)  # drifted window
+        assert mon.n_windows == 1
+        assert mon.alerting and mon.alerts_total > 0
+        feed(0.0, 64)   # clean window clears the latch
+        assert mon.n_windows == 2
+        assert not mon.alerting
+
+    def test_rollover_by_time_and_force(self, fitted):
+        prof = self._profile(fitted)
+        mon = ServeMonitor(prof, window_rows=10 ** 9, window_seconds=0.0)
+        assert mon.maybe_rollover() is None  # empty: timer never fires
+        mon.observe_numeric(np.zeros((8, 2), np.float32),
+                            np.ones(8, np.float32))
+        mon.add_rows(8)  # window_seconds=0: closes immediately
+        assert mon.n_windows == 1
+        mon2 = ServeMonitor(prof, window_rows=10 ** 9,
+                            window_seconds=float("inf"))
+        mon2.add_rows(5)
+        assert mon2.n_windows == 0
+        assert mon2.maybe_rollover(force=True) is not None
+        assert mon2.n_windows == 1
+
+    def test_empty_window_reports_no_drift(self, fitted):
+        """A window with rows but an EMPTY numeric side (all missing)
+        must report 0 JS/PSI (zero-window identity) and flag the fill
+        collapse instead."""
+        prof = self._profile(fitted)
+        mon = ServeMonitor(prof, window_rows=10 ** 9,
+                           window_seconds=float("inf"))
+        X = np.full((64, 2), np.nan, np.float32)
+        mon.observe_numeric(X, np.ones(64, np.float32))
+        mon.observe_hashed({"c": [None] * 64})
+        mon.add_rows(64)
+        rep = mon.maybe_rollover(force=True)
+        for f in rep["features"]:
+            assert f["js"] == 0.0 and f["psi"] == 0.0
+            assert f["fill_rate"] == 0.0
+        kinds = {(a["target"], a["metric"]) for a in rep["alerts"]}
+        assert ("a", "fill_rate_diff") in kinds
+        assert ("a", "fill_ratio") in kinds
+        # the infinite fill ratio serializes as null, never NaN: the
+        # /drift payload and events.jsonl must stay strict RFC-8259
+        # JSON exactly when the worst drift fires
+        ratio_alert = next(a for a in rep["alerts"]
+                           if a["metric"] == "fill_ratio")
+        assert ratio_alert["value"] is None
+        json.dumps(rep, allow_nan=False)  # raises on any NaN/inf leak
+
+    def test_min_rows_suppresses_alerts(self, fitted):
+        prof = self._profile(fitted)
+        mon = ServeMonitor(prof, policy=DriftPolicy(min_rows=100),
+                           window_rows=10 ** 9,
+                           window_seconds=float("inf"))
+        X = np.full((10, 2), 1e6, np.float32)  # absurd drift, tiny window
+        mon.observe_numeric(X, np.ones(10, np.float32))
+        mon.add_rows(10)
+        rep = mon.maybe_rollover(force=True)
+        assert rep["alerts"] == []
+
+
+# -- offline driver ----------------------------------------------------------
+
+class TestOffline:
+    def test_quiet_and_drifted_verdicts(self, fitted):
+        model, rows, mdir = fitted
+        from transmogrifai_tpu.workflow.io import load_monitor_profile
+        prof = ReferenceProfile.from_json(load_monitor_profile(mdir))
+        same = [{k: v for k, v in r.items() if k != "y"}
+                for r in _make_rows(300, seed=11)]
+        rep = offline_report(model, ListStreamingReader(same, 128), prof,
+                             tile_rows=128)
+        assert rep["rows"] == 300 and rep["windows"] == 1
+        assert rep["verdict"] == "ok" and rep["alerts_total"] == 0
+
+        shifted = [{"a": v["a"] + 30.0, "b": v["b"], "c": "q"}
+                   for v in same]
+        rep2 = offline_report(model, ListStreamingReader(shifted, 128),
+                              prof, tile_rows=128)
+        assert rep2["verdict"] == "drift" and rep2["alerts_total"] > 0
+        targets = {a["target"] for a in rep2["last"]["alerts"]}
+        assert "a" in targets and "c" in targets
+
+    def test_windowed_offline(self, fitted):
+        model, _, mdir = fitted
+        from transmogrifai_tpu.workflow.io import load_monitor_profile
+        prof = ReferenceProfile.from_json(load_monitor_profile(mdir))
+        recs = [{k: v for k, v in r.items() if k != "y"}
+                for r in _make_rows(256, seed=5)]
+        rep = offline_report(model, ListStreamingReader(recs, 64), prof,
+                             tile_rows=64, window_rows=64)
+        assert rep["windows"] == 4
+        assert rep["rows"] == 256
+
+    @pytest.mark.slow
+    def test_monitor_cli_subprocess(self, fitted, tmp_path):
+        """`python -m transmogrifai_tpu monitor <model> <csv>`: drifted
+        file -> verdict drift + exit 3 under --fail-on-drift; the same
+        distribution -> verdict ok, exit 0."""
+        import csv
+        _, rows, mdir = fitted
+        quiet = str(tmp_path / "quiet.csv")
+        drifted = str(tmp_path / "drifted.csv")
+        for path, shift in ((quiet, 0.0), (drifted, 40.0)):
+            with open(path, "w", newline="") as f:
+                w = csv.DictWriter(f, fieldnames=["a", "b", "c"])
+                w.writeheader()
+                for r in _make_rows(300, seed=17):
+                    w.writerow({"a": r["a"] + shift, "b": r["b"],
+                                "c": r["c"]})
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        env.pop("PYTHONSTARTUP", None)
+
+        def run(path):
+            r = subprocess.run(
+                [sys.executable, "-m", "transmogrifai_tpu", "monitor",
+                 mdir, path, "--fail-on-drift", "--tile-rows", "128"],
+                env=env, capture_output=True, text=True, timeout=300)
+            assert r.stdout.strip(), r.stderr[-2000:]
+            return r.returncode, json.loads(
+                r.stdout.strip().splitlines()[-1])
+
+        rc, doc = run(quiet)
+        assert rc == 0 and doc["verdict"] == "ok", doc
+        rc, doc = run(drifted)
+        assert rc == 3 and doc["verdict"] == "drift", doc
+        assert doc["alerts_total"] > 0
+
+    def test_score_of_shapes(self):
+        assert score_of({"p": {"probability_1": 0.7, "prediction": 1.0}},
+                        "p", "probability_1") == 0.7
+        assert score_of({"p": {"prediction": 1.0}}, "p",
+                        "probability_1") == 1.0  # falls back
+        assert score_of({"p": 0.25}, "p", "prediction") == 0.25
+        assert score_of({}, "p", "prediction") is None
+        assert score_of({"p": "junk"}, "p", "prediction") is None

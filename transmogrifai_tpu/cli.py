@@ -425,6 +425,53 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="dir for events.jsonl + trace artifacts "
                          "(enables span collection + the recompile "
                          "watch; validate with trace-report --check)")
+    sv.add_argument("--monitor", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="continuous drift monitoring against the "
+                         "model's monitor.json training profile "
+                         "(docs/monitoring.md); auto = on when the "
+                         "profile exists")
+    sv.add_argument("--monitor-window-rows", type=int, default=4096,
+                    help="tumbling drift window size in rows")
+    sv.add_argument("--monitor-window-seconds", type=float, default=60.0,
+                    help="close a non-empty window after this long even "
+                         "if under --monitor-window-rows")
+    sv.add_argument("--monitor-health-gate", action="store_true",
+                    help="degrade /healthz to 503 while a drift alert "
+                         "is active (hard gate for load balancers)")
+    mo = sub.add_parser(
+        "monitor",
+        help="offline drift report: score a bulk file through the "
+             "tileplane lane and compare feature/prediction "
+             "distributions against the model's monitor.json training "
+             "profile (docs/monitoring.md)")
+    mo.add_argument("model_dir", help="saved WorkflowModel directory "
+                                      "(with monitor.json)")
+    mo.add_argument("data", help="CSV or Avro file of raw records")
+    mo.add_argument("--profile", default=None,
+                    help="explicit profile JSON (default: "
+                         "<model_dir>/monitor.json)")
+    mo.add_argument("--tile-rows", type=int, default=1024,
+                    help="records per scoring tile (score_stream lane)")
+    mo.add_argument("--window-rows", type=int, default=0,
+                    help="tumbling window size; 0 = one window over the "
+                         "whole file (default)")
+    mo.add_argument("--fail-on-drift", action="store_true",
+                    help="exit 3 when any drift_alert fires (CI/cron "
+                         "gate)")
+    mo.add_argument("--metrics-location", default=None,
+                    help="dir for the events.jsonl drift_window/"
+                         "drift_alert stream")
+    for knob, hint in (("max-js", "per-feature JS divergence [0,1]"),
+                       ("max-psi", "per-feature PSI"),
+                       ("max-fill-diff", "abs fill-rate difference"),
+                       ("max-fill-ratio", "fill-rate max/min ratio"),
+                       ("max-pred-js", "prediction calibration JS"),
+                       ("max-score-shift", "abs score-mean shift"),
+                       ("min-rows", "min rows before a window can "
+                                    "alert")):
+        mo.add_argument(f"--{knob}", type=float, default=None,
+                        help=f"alert threshold: {hint}")
     a = p.parse_args(argv)
     if a.command == "gen":
         files = generate_project(a.input, a.response, a.output,
@@ -440,6 +487,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if a.command == "serve":
         from .serve.frontend import run_serve
         return run_serve(a)
+    if a.command == "monitor":
+        from .monitor.offline import run_monitor
+        return run_monitor(a)
     return 1
 
 

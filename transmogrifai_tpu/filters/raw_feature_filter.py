@@ -28,301 +28,35 @@ observable result — excluded features contribute nothing — is the same).
 """
 from __future__ import annotations
 
-import functools
-import json
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..data.dataset import Column, Dataset
-from ..types import ColumnKind
+from . import sketches
+from .sketches import FeatureDistribution
 
-EPS = 1e-12
-_NUMERIC_KINDS = (ColumnKind.FLOAT, ColumnKind.INT, ColumnKind.BOOL)
+EPS = sketches.EPS
+_NUMERIC_KINDS = sketches.NUMERIC_KINDS
 
 
 # -- distributions ----------------------------------------------------------
+# The sketch helpers (FeatureDistribution, numeric histograms through the
+# one-pass engine, crc32 hash bins, map-key sketches) moved VERBATIM to
+# filters/sketches.py so the serve-side drift monitor (monitor/) bins
+# identically to fit-time RFF — one implementation, shared. The legacy
+# underscore names stay importable here (tests + downstream callers);
+# a golden parity test pins that the move changed no distribution bit.
 
-@dataclass
-class FeatureDistribution:
-    """Reference FeatureDistribution.scala:58 — per (feature[, map key])
-    sketch: counts, nulls, histogram over `bins` buckets, numeric summary."""
-
-    name: str
-    key: Optional[str]          # map key, or None for plain features
-    count: int
-    nulls: int
-    distribution: List[float]   # histogram mass per bin (unnormalized)
-    summary: List[float]        # [min, max, sum, count] (reference Summary)
-
-    def fill_rate(self) -> float:
-        """Reference FeatureDistribution.fillRate:92."""
-        return 0.0 if self.count == 0 else (self.count - self.nulls) / self.count
-
-    def relative_fill_rate(self, other: "FeatureDistribution") -> float:
-        return abs(self.fill_rate() - other.fill_rate())
-
-    def relative_fill_ratio(self, other: "FeatureDistribution") -> float:
-        a, b = self.fill_rate(), other.fill_rate()
-        lo, hi = min(a, b), max(a, b)
-        return float("inf") if lo == 0.0 else hi / lo
-
-    def js_divergence(self, other: "FeatureDistribution") -> float:
-        """Jensen-Shannon divergence of normalized histograms (reference
-        FeatureDistribution.jsDivergence:138); in [0, ln 2] -> scaled [0,1]."""
-        p = np.asarray(self.distribution, np.float64)
-        q = np.asarray(other.distribution, np.float64)
-        ps, qs = p.sum(), q.sum()
-        if ps <= 0 or qs <= 0:
-            return 0.0
-        p, q = p / ps, q / qs
-        m = 0.5 * (p + q)
-
-        def kl(a, b):
-            mask = a > 0
-            return float(np.sum(a[mask] * np.log(a[mask] / (b[mask] + EPS))))
-        return (0.5 * kl(p, m) + 0.5 * kl(q, m)) / np.log(2.0)
-
-    def to_json(self) -> Dict[str, Any]:
-        return {"name": self.name, "key": self.key, "count": self.count,
-                "nulls": self.nulls, "distribution": list(self.distribution),
-                "summary": list(self.summary)}
-
-    @staticmethod
-    def from_json(d: Dict[str, Any]) -> "FeatureDistribution":
-        return FeatureDistribution(
-            name=d["name"], key=d.get("key"), count=int(d["count"]),
-            nulls=int(d["nulls"]),
-            distribution=[float(x) for x in d["distribution"]],
-            summary=[float(x) for x in d.get("summary", [])])
-
-
-def _hist_numeric(values: np.ndarray, bins: int,
-                  lo: float, hi: float) -> np.ndarray:
-    """Fixed-range histogram of one numeric column (NaN = missing).
-
-    Routed through the jitted batched kernel with a single-column matrix:
-    `bins` is the only static argument and lo/hi are traced, so repeated
-    calls (one per numeric feature on the legacy path) share ONE
-    executable — the un-jitted predecessor re-dispatched a fresh program
-    every call."""
-    import jax.numpy as jnp
-
-    from ..ops.stats import histogram_batched
-    h = histogram_batched(
-        jnp.asarray(np.asarray(values, np.float32)[:, None]),
-        jnp.asarray([lo], jnp.float32), jnp.asarray([hi], jnp.float32),
-        bins)
-    return np.asarray(h[0, :bins], np.float64)
-
-
-def _dist_numeric(name: str, data: np.ndarray, bins: int,
-                  rng: Optional[Tuple[float, float]] = None
-                  ) -> FeatureDistribution:
-    n = len(data)
-    valid = data[~np.isnan(data)]
-    nulls = n - len(valid)
-    if len(valid) == 0:
-        return FeatureDistribution(name, None, n, nulls, [0.0] * bins,
-                                   [0.0, 0.0, 0.0, 0.0])
-    # histogram range comes from the TRAIN-side Summary when provided so
-    # train/score histograms share bins and JS divergence sees location
-    # shift (reference computes one Summary then bins both readers with it)
-    lo, hi = rng if rng is not None else (float(valid.min()),
-                                          float(valid.max()))
-    hist = _hist_numeric(data, bins, lo, hi)
-    return FeatureDistribution(name, None, n, nulls, hist.tolist(),
-                               [lo, hi, float(valid.sum()), float(len(valid))])
-
-
-def _numeric_distributions_batched(items, bins: int,
-                                   ranges) -> List[FeatureDistribution]:
-    """Sketch EVERY numeric column through the one-pass engine.
-
-    One engine pass over the stacked [n, K] f32 matrix gives counts/
-    nulls/min/max/sums for all K columns; histogram ranges come from the
-    provided train-side Summary where present, else from that same pass's
-    min/max. When every range is pinned up front the histograms ride the
-    engine pass itself (ONE program); otherwise one extra
-    histogram_batched dispatch bins all columns together. Either way:
-    K un-jitted per-column programs -> <= 2 jitted ones.
-
-    Missing means NaN only (FeatureDistribution convention): the engine
-    masks on isfinite, so the rare +/-inf-bearing columns get their
-    count/sum/range corrected on host to the legacy semantics (inf is a
-    valid value; sums/ranges go infinite, histogram mass clips into the
-    edge bins)."""
-    from ..ops import stats_engine as SE
-    from ..ops.stats import histogram_batched
-    import jax.numpy as jnp
-
-    names = [nm for nm, col in items]
-    # stack straight to f32: the f64 per-column copies are only needed by
-    # the per-column legacy fallback, and a transient f64 stack would
-    # triple peak host memory at the 10M-row shape
-    V = np.stack([np.asarray(col.data, np.float32) for _, col in items],
-                 axis=1)
-    n = V.shape[0]
-    has_inf = bool(np.isinf(V).any()) if n else False
-    provided = [ranges.get(nm) for nm in names]
-    all_pinned = all(r is not None for r in provided)
-    if all_pinned and n and not has_inf:
-        lo = np.asarray([r[0] for r in provided], np.float32)
-        hi = np.asarray([r[1] for r in provided], np.float32)
-        st = SE.run_stats(V, np.zeros(n, np.float32), lo=lo, hi=hi,
-                          bins=bins, label="rff_sketch")
-        hist = st.hist
-    else:
-        st = (SE.run_stats(V, np.zeros(n, np.float32),
-                           label="rff_sketch") if n else None)
-        lo = np.asarray(
-            [r[0] if r is not None else
-             (st.min[k] if st is not None and st.count[k] > 0 else 0.0)
-             for k, r in enumerate(provided)], np.float32)
-        hi = np.asarray(
-            [r[1] if r is not None else
-             (st.max[k] if st is not None and st.count[k] > 0 else 0.0)
-             for k, r in enumerate(provided)], np.float32)
-        hist = None  # binned below, after any inf range corrections
-
-    counts = st.count.copy() if st is not None else np.zeros(len(names))
-    sums = (st.mean * st.count if st is not None
-            else np.zeros(len(names)))
-    los, his = lo.astype(np.float64), hi.astype(np.float64)
-    if has_inf and st is not None:
-        # legacy semantics for inf-bearing columns (valid, not missing):
-        # corrected BEFORE binning so the histogram sees the same ranges
-        # the per-column path would
-        for k in np.flatnonzero(np.isinf(V).any(axis=0)):
-            col = V[:, k].astype(np.float64)
-            valid = col[~np.isnan(col)]
-            counts[k] = len(valid)
-            sums[k] = valid.sum() if len(valid) else 0.0
-            if provided[k] is None and len(valid):
-                los[k], his[k] = valid.min(), valid.max()
-    if hist is None:
-        hist = (np.asarray(histogram_batched(
-            jnp.asarray(V), jnp.asarray(los.astype(np.float32)),
-            jnp.asarray(his.astype(np.float32)), bins))
-            if n else np.zeros((len(names), bins + 1)))
-
-    out = []
-    for k, nm in enumerate(names):
-        cnt = int(counts[k])
-        if cnt == 0:
-            out.append(FeatureDistribution(nm, None, n, n, [0.0] * bins,
-                                           [0.0, 0.0, 0.0, 0.0]))
-            continue
-        out.append(FeatureDistribution(
-            nm, None, n, n - cnt,
-            [float(v) for v in hist[k, :bins]],
-            [float(los[k]), float(his[k]), float(sums[k]), float(cnt)]))
-    return out
-
-
-def _hash_bin(value: Any, bins: int) -> int:
-    """Stable host-side hash of a non-numeric value into [0, bins)
-    (reference hashes text into bins, RawFeatureFilter textBinsFormula:581)."""
-    import zlib
-    s = value if isinstance(value, str) else repr(value)
-    return zlib.crc32(s.encode("utf-8")) % bins
-
-
-def _is_empty(v: Any) -> bool:
-    if v is None:
-        return True
-    if isinstance(v, float) and np.isnan(v):
-        return True
-    if isinstance(v, (str, list, tuple, set, dict)) and len(v) == 0:
-        return True
-    return False
-
-
-def _dist_object(name: str, data: np.ndarray, bins: int,
-                 key: Optional[str] = None) -> FeatureDistribution:
-    n = len(data)
-    hist = np.zeros(bins, np.float64)
-    nulls = 0
-    for v in data:
-        if _is_empty(v):
-            nulls += 1
-            continue
-        if isinstance(v, (list, tuple, set)):
-            for item in v:
-                hist[_hash_bin(item, bins)] += 1.0
-        else:
-            hist[_hash_bin(v, bins)] += 1.0
-    return FeatureDistribution(name, key, n, nulls, hist.tolist(),
-                               [0.0, 0.0, float(hist.sum()), float(n - nulls)])
-
-
-def _map_key_distributions(name: str, data: np.ndarray, bins: int
-                           ) -> List[FeatureDistribution]:
-    """Per-key sketches for a map column (reference drops individual keys)."""
-    n = len(data)
-    per_key_hist: Dict[str, np.ndarray] = {}
-    per_key_present: Dict[str, int] = {}
-    for v in data:
-        if not isinstance(v, dict):
-            continue
-        for k, item in v.items():
-            if _is_empty(item):
-                continue
-            h = per_key_hist.setdefault(k, np.zeros(bins, np.float64))
-            if isinstance(item, (int, float, bool)):
-                h[_hash_bin(f"{float(item):.6g}", bins)] += 1.0
-            elif isinstance(item, (list, tuple, set)):
-                for x in item:
-                    h[_hash_bin(x, bins)] += 1.0
-            else:
-                h[_hash_bin(item, bins)] += 1.0
-            per_key_present[k] = per_key_present.get(k, 0) + 1
-    return [
-        FeatureDistribution(name, k, n, n - per_key_present[k],
-                            per_key_hist[k].tolist(),
-                            [0.0, 0.0, float(per_key_hist[k].sum()),
-                             float(per_key_present[k])])
-        for k in sorted(per_key_hist)
-    ]
-
-
-def compute_distributions(ds: Dataset, names: Sequence[str], bins: int,
-                          ranges: Optional[Dict[str, Tuple[float, float]]]
-                          = None) -> List[FeatureDistribution]:
-    """Sketch every named raw column (reference computeFeatureStats).
-
-    `ranges` pins per-feature histogram bounds (pass the train-side summary
-    bounds when sketching scoring data). Numeric columns sketch TOGETHER
-    through the one-pass engine (<= 2 jitted programs for all of them);
-    TMOG_STATS_FUSED=0 restores the per-column path."""
-    from ..ops import stats_engine as SE
-
-    numeric_items = []
-    for name in names:
-        if name in ds and ds.column(name).kind in _NUMERIC_KINDS:
-            numeric_items.append((name, ds.column(name)))
-    by_name: Dict[str, FeatureDistribution] = {}
-    if numeric_items and SE.fused_enabled():
-        by_name = {d.name: d for d in _numeric_distributions_batched(
-            numeric_items, bins, ranges or {})}
-
-    out: List[FeatureDistribution] = []
-    for name in names:
-        if name not in ds:
-            continue
-        col = ds.column(name)
-        if col.kind in _NUMERIC_KINDS:
-            out.append(by_name.get(name) or _dist_numeric(
-                name, np.asarray(col.data, np.float64), bins,
-                (ranges or {}).get(name)))
-        elif col.kind == ColumnKind.MAP:
-            out.extend(_map_key_distributions(name, col.data, bins))
-            # whole-map sketch for feature-level fill decisions
-            out.append(_dist_object(name, col.data, bins))
-        else:
-            out.append(_dist_object(name, col.data, bins))
-    return out
+_hist_numeric = sketches.hist_numeric
+_dist_numeric = sketches.dist_numeric
+_numeric_distributions_batched = sketches.numeric_distributions_batched
+_hash_bin = sketches.hash_bin
+_is_empty = sketches.is_empty
+_dist_object = sketches.dist_object
+_map_key_distributions = sketches.map_key_distributions
+compute_distributions = sketches.compute_distributions
 
 
 # -- results ----------------------------------------------------------------

@@ -1,0 +1,48 @@
+"""Online drift & model-health monitoring (docs/monitoring.md).
+
+RawFeatureFilter's headline safety feature — comparing training-time and
+scoring-time feature distributions and flagging the ones that drift —
+runs once, at fit time. In the production story scoring is a long-lived
+service (serve/, docs/serving.md), and nothing watched the traffic: a
+feature pipeline can silently rot under the served model. This package
+is the serve-side half of that comparison, run continuously:
+
+- :mod:`profile` — ReferenceProfile: per-feature training sketches
+  (numeric histograms with pinned edges from the one-pass stats engine,
+  crc32 hash-bin tables via filters/sketches, fill rates) plus the
+  training prediction distribution, persisted next to the model
+  (``monitor.json``, riding workflow/io like ``serve.json``);
+- :mod:`window` — ServeMonitor: tumbling-window accumulation of the
+  same sufficient statistics over live traffic — one fixed-shape jitted
+  sketch program per serving bucket (prewarmed with the ladder, so the
+  post-warmup zero-recompile contract holds) plus a host path for
+  hash-binned raw values assembled on the batcher thread;
+- :mod:`drift` — PSI, Jensen-Shannon divergence (THE shared
+  implementation behind FeatureDistribution.js_divergence), fill-rate
+  drift and prediction drift (score-mean shift + calibration-bin
+  occupancy) per window;
+- :mod:`alerts` — DriftPolicy thresholds -> ``drift_alert`` events,
+  the ``GET /drift`` payload, ``/metrics`` fields and the optional
+  ``/healthz`` hard gate;
+- :mod:`offline` — ``python -m transmogrifai_tpu monitor <model_dir>
+  <data>``: the same drift engine over a bulk file via the tileplane
+  ``score_stream`` lane, so batch scoring and serving share one verdict.
+
+Window merges are plain sufficient-statistic sums (DrJAX-style
+psum-friendly MapReduce shape, PAPERS arxiv 2403.07128), so the same
+sketch program can later ride the multi-host mesh: a cross-host window
+merge is one psum over the flat histogram state.
+"""
+from .alerts import DriftPolicy
+from .drift import js_divergence_hist, js_divergence_nats, psi, window_report
+from .offline import offline_report, run_monitor
+from .profile import (PredictionProfile, ReferenceProfile, build_profile,
+                      score_of)
+from .window import ServeMonitor, WindowSnapshot
+
+__all__ = [
+    "DriftPolicy", "PredictionProfile", "ReferenceProfile", "ServeMonitor",
+    "WindowSnapshot", "build_profile", "js_divergence_hist",
+    "js_divergence_nats", "offline_report", "psi", "run_monitor",
+    "score_of", "window_report",
+]

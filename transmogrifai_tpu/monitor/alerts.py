@@ -1,0 +1,100 @@
+"""Drift thresholds -> alerts: the policy layer of the monitor.
+
+A DriftPolicy maps one window's drift report (monitor/drift.window_report)
+to a list of typed alerts. Each alert becomes a ``drift_alert`` event on
+the streaming event log (which ``trace-report --check`` surfaces as a
+failure, exactly like a post-warmup ``serve_recompile``), a field in the
+``GET /drift`` payload, and — when the optional hard health gate is on —
+a degraded ``/healthz`` (HTTP 503) until a clean window closes.
+
+Default thresholds follow the PSI conventions (0.25 = major shift) and
+RawFeatureFilter's fill-rate semantics, tightened for serve-time use:
+RFF's fit-time defaults (0.90 JS / 20x fill ratio) answer "is this
+feature unusable?", the monitor's answer "has traffic moved enough that
+a human should look?". `min_rows` suppresses alerts from windows too
+small to be statistically meaningful (a timer-closed trickle window).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List
+
+
+@dataclass
+class DriftPolicy:
+    """Per-window alert thresholds. None disables a check."""
+
+    max_js: float = 0.25          # per-feature JS divergence, [0, 1] scale
+    max_psi: float = 0.25         # per-feature PSI ("major shift" floor)
+    max_fill_diff: float = 0.5    # |window fill-rate - train fill-rate|
+    max_fill_ratio: float = 10.0  # max/min fill-rate ratio (inf alerts)
+    max_pred_js: float = 0.25     # prediction calibration-bin JS
+    max_score_shift: float = 0.2  # |window score mean - train mean|
+    min_rows: int = 32            # windows below this never alert
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "DriftPolicy":
+        return DriftPolicy(**{k: v for k, v in d.items()
+                              if k in DriftPolicy().__dict__})
+
+    # -- evaluation --------------------------------------------------------
+    def _alert(self, target: str, metric: str, value,
+               threshold: float) -> Dict[str, Any]:
+        # value None = unbounded (an infinite fill ratio): every alert
+        # payload must stay strict-RFC-8259 JSON — NaN/inf literals
+        # would make /drift, the offline CLI report and events.jsonl
+        # unparseable exactly when the worst drift fires
+        return {"target": target, "metric": metric,
+                "value": None if value is None else round(float(value), 6),
+                "threshold": float(threshold)}
+
+    def evaluate(self, report: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Alerts raised by one window report (drift.window_report calls
+        this; the report dict carries the result under "alerts")."""
+        out: List[Dict[str, Any]] = []
+        if report.get("rows", 0.0) < self.min_rows:
+            return out
+        for f in report.get("features", []):
+            name = f["feature"]
+            if self.max_js is not None and f["js"] > self.max_js:
+                out.append(self._alert(name, "js", f["js"], self.max_js))
+            if self.max_psi is not None:
+                # sampling-noise compensation (drift.psi_sampling_noise):
+                # the effective threshold carries the expected PSI of an
+                # UNdrifted window of this size plus 2x headroom for its
+                # variance — tiny windows can't alert on pure noise,
+                # production-size windows see max_psi essentially as-is
+                thr = self.max_psi + 2.0 * f.get("psi_noise", 0.0)
+                if f["psi"] > thr:
+                    out.append(self._alert(name, "psi", f["psi"], thr))
+            if self.max_fill_diff is not None and \
+                    f["fill_rate_diff"] > self.max_fill_diff:
+                out.append(self._alert(name, "fill_rate_diff",
+                                       f["fill_rate_diff"],
+                                       self.max_fill_diff))
+            if self.max_fill_ratio is not None:
+                ratio = f.get("fill_ratio")
+                if ratio is None or ratio > self.max_fill_ratio:
+                    # None = one side entirely empty (infinite ratio)
+                    out.append(self._alert(name, "fill_ratio", ratio,
+                                           self.max_fill_ratio))
+        pred = report.get("prediction")
+        if pred is not None and pred.get("rows", 0.0) >= self.min_rows:
+            if self.max_pred_js is not None and pred["js"] > self.max_pred_js:
+                out.append(self._alert("__prediction__", "prediction_js",
+                                       pred["js"], self.max_pred_js))
+            if self.max_psi is not None:
+                thr = self.max_psi + 2.0 * pred.get("psi_noise", 0.0)
+                if pred["psi"] > thr:
+                    out.append(self._alert("__prediction__",
+                                           "prediction_psi", pred["psi"],
+                                           thr))
+            if self.max_score_shift is not None and \
+                    pred["mean_shift"] > self.max_score_shift:
+                out.append(self._alert("__prediction__", "score_shift",
+                                       pred["mean_shift"],
+                                       self.max_score_shift))
+        return out

@@ -1,0 +1,206 @@
+"""Drift metrics over histogram sketches: JS divergence, PSI, fill rate,
+prediction drift.
+
+Host-side numpy on tiny [bins]-shaped tables (the window rollover path —
+dispatching a device program per metric would cost more than the math).
+`js_divergence_hist` is THE Jensen-Shannon implementation:
+filters/sketches.FeatureDistribution.js_divergence (fit-time
+RawFeatureFilter) delegates here, so fit-time and serve-time drift can
+never disagree on the metric. Every comparison is defined for an
+all-zero side: an EMPTY traffic window reports 0 drift, not NaN —
+absence of evidence is not evidence of drift (the fill-rate gate is
+what catches a feature that stopped arriving).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+EPS = 1e-12
+#: Laplace pseudo-count added to every bin inside PSI — an empty bin in
+#: a small window then reads as "about half an observation" instead of a
+#: hard zero, keeping the log-ratio finite WITHOUT the blow-up a fixed
+#: fraction floor produces (a floored-at-1e-4 empty bin against 10% of
+#: train mass contributes ~0.7 PSI of pure sampling noise per bin)
+PSI_PSEUDO = 0.5
+
+
+def _normalize(h) -> Optional[np.ndarray]:
+    """Histogram -> probability vector; None when the side is all-zero
+    (or negative-garbage) so callers can apply the zero-window identity."""
+    p = np.asarray(h, np.float64)
+    s = p.sum()
+    if not np.isfinite(s) or s <= 0.0:
+        return None
+    return p / s
+
+
+def js_divergence_nats(p, q) -> float:
+    """Jensen-Shannon divergence in nats: bounded [0, ln 2], symmetric,
+    0.0 when either side is an all-zero histogram (zero-window identity).
+
+    No epsilon in the log denominator: m = (p+q)/2 is strictly positive
+    wherever p (or q) is, so the KL terms are well-defined exactly."""
+    pn, qn = _normalize(p), _normalize(q)
+    if pn is None or qn is None:
+        return 0.0
+    m = 0.5 * (pn + qn)
+
+    def kl(a: np.ndarray) -> float:
+        mask = a > 0
+        return float(np.sum(a[mask] * np.log(a[mask] / m[mask])))
+
+    # clip guards float round-off at the [0, ln 2] boundaries
+    return float(np.clip(0.5 * kl(pn) + 0.5 * kl(qn), 0.0, np.log(2.0)))
+
+
+def js_divergence_hist(p, q) -> float:
+    """JS divergence scaled to [0, 1] (the FeatureDistribution
+    convention: nats / ln 2)."""
+    return js_divergence_nats(p, q) / float(np.log(2.0))
+
+
+def coarsen(h, target_bins: int = 10) -> np.ndarray:
+    """Sum consecutive bin groups down to <= target_bins. PSI over many
+    fine bins is dominated by per-bin sampling noise (expected PSI of an
+    UNdrifted window is ~bins/rows); the industry convention computes it
+    over ~10 deciles, so drift scoring coarsens the 40-bin sketch before
+    the PSI log-ratio. JS stays at full resolution (its zero bins
+    contribute nothing)."""
+    h = np.asarray(h, np.float64)
+    n = len(h)
+    if n <= target_bins:
+        return h
+    group = int(np.ceil(n / target_bins))
+    pad = (-n) % group
+    if pad:
+        h = np.concatenate([h, np.zeros(pad)])
+    return h.reshape(-1, group).sum(axis=1)
+
+
+def psi(p, q, pseudo: float = PSI_PSEUDO) -> float:
+    """Population Stability Index between two COUNT histograms: sum over
+    bins of (q_i - p_i) * ln(q_i / p_i) on Laplace-smoothed fractions
+    ((count + pseudo) / (total + pseudo * bins)). Symmetric by
+    construction; 0.0 when either side is all-zero (zero-window
+    identity) and exactly 0.0 for identical histograms. Conventional
+    reading: < 0.1 stable, 0.1-0.25 moderate shift, > 0.25 major shift
+    (the alert policy additionally compensates the small-sample
+    expectation, see psi_sampling_noise)."""
+    pc = np.asarray(p, np.float64)
+    qc = np.asarray(q, np.float64)
+    if _normalize(pc) is None or _normalize(qc) is None:
+        return 0.0
+    bins = len(pc)
+    pn = (pc + pseudo) / (pc.sum() + pseudo * bins)
+    qn = (qc + pseudo) / (qc.sum() + pseudo * bins)
+    return float(np.sum((qn - pn) * np.log(qn / pn)))
+
+
+def psi_sampling_noise(p, q) -> float:
+    """First-order expectation of PSI between two samples of the SAME
+    distribution: for multinomial counts over B occupied bins,
+    E[PSI] ~= (B - 1) * (1/n + 1/m) (the chi-square mean, since
+    PSI -> chi2/n for small deviations). The alert policy compares
+    measured PSI against threshold + this bias, so a small window
+    (low n) cannot alert on pure sampling noise while a production-size
+    window (n in the thousands) sees an essentially unshifted
+    threshold."""
+    pn, qn = np.asarray(p, np.float64), np.asarray(q, np.float64)
+    n, m = pn.sum(), qn.sum()
+    if n <= 0 or m <= 0:
+        return 0.0
+    b = max(int(((pn > 0) | (qn > 0)).sum()), 1)
+    return float((b - 1) * (1.0 / n + 1.0 / m))
+
+
+def fill_rate_of(rows: float, nulls: float) -> float:
+    return 0.0 if rows <= 0 else max(rows - nulls, 0.0) / rows
+
+
+def fill_ratio(a: float, b: float) -> float:
+    """max/min of two fill rates (RFF relative_fill_ratio semantics);
+    inf when one side is entirely empty while the other is not, 1.0 when
+    both are empty."""
+    lo, hi = min(a, b), max(a, b)
+    if hi == 0.0:
+        return 1.0
+    return float("inf") if lo == 0.0 else hi / lo
+
+
+# -- per-window report -------------------------------------------------------
+
+def feature_drift(entry: Any, hist: np.ndarray, rows: float,
+                  nulls: float) -> Dict[str, Any]:
+    """Drift metrics for one feature: profile entry (monitor/profile
+    FeatureProfile) vs one window's histogram + fill counts."""
+    train_fill = fill_rate_of(entry.count, entry.nulls)
+    win_fill = fill_rate_of(rows, nulls)
+    cp, cq = coarsen(entry.hist), coarsen(hist)
+    return {
+        "feature": entry.name,
+        "kind": entry.kind,
+        "rows": float(rows),
+        "js": round(js_divergence_hist(entry.hist, hist), 6),
+        "psi": round(psi(cp, cq), 6),
+        "psi_noise": round(psi_sampling_noise(cp, cq), 6),
+        "fill_rate": round(win_fill, 6),
+        "train_fill_rate": round(train_fill, 6),
+        "fill_rate_diff": round(abs(win_fill - train_fill), 6),
+        "fill_ratio": (fill_ratio(win_fill, train_fill)
+                       if np.isfinite(fill_ratio(win_fill, train_fill))
+                       else None),
+    }
+
+
+def prediction_drift(pred: Any, hist: np.ndarray, count: float,
+                     ssum: float) -> Dict[str, Any]:
+    """Prediction-distribution drift: JS + PSI over the calibration-bin
+    occupancy plus the raw score-mean shift (absolute, and scaled by the
+    training score std when it is nonzero)."""
+    mean = (ssum / count) if count > 0 else 0.0
+    shift = abs(mean - pred.mean) if count > 0 else 0.0
+    cp, cq = coarsen(pred.hist), coarsen(hist)
+    return {
+        "field": pred.field,
+        "rows": float(count),
+        "js": round(js_divergence_hist(pred.hist, hist), 6),
+        "psi": round(psi(cp, cq), 6),
+        "psi_noise": round(psi_sampling_noise(cp, cq), 6),
+        "mean": round(mean, 6),
+        "train_mean": round(pred.mean, 6),
+        "mean_shift": round(shift, 6),
+        "mean_shift_sigmas": (round(shift / pred.std, 4)
+                              if pred.std > 0 else None),
+    }
+
+
+def window_report(profile: Any, snapshot: Any, policy: Any) -> Dict[str, Any]:
+    """One window's full drift report: per-feature metrics, prediction
+    drift, and the alerts the policy raises. `profile` is a
+    ReferenceProfile, `snapshot` a window.WindowSnapshot, `policy` an
+    alerts.DriftPolicy."""
+    feats: List[Dict[str, Any]] = []
+    for entry in profile.features:
+        hist = snapshot.hists.get(entry.name)
+        if hist is None:
+            continue
+        feats.append(feature_drift(entry, hist, snapshot.rows,
+                                   snapshot.nulls.get(entry.name, 0.0)))
+    pred = None
+    if profile.prediction is not None and snapshot.pred_hist is not None:
+        pred = prediction_drift(profile.prediction, snapshot.pred_hist,
+                                snapshot.pred_count, snapshot.pred_sum)
+    report: Dict[str, Any] = {
+        "window": snapshot.index,
+        "rows": float(snapshot.rows),
+        "wall_s": round(snapshot.wall_s, 3),
+        "features": feats,
+        "prediction": pred,
+    }
+    report["alerts"] = policy.evaluate(report)
+    worst = max(feats, key=lambda f: f["js"], default=None)
+    report["worst_feature"] = worst["feature"] if worst else None
+    report["worst_js"] = worst["js"] if worst else 0.0
+    return report

@@ -1,0 +1,194 @@
+"""Offline drift reports: the serve-side drift engine over a bulk file.
+
+``python -m transmogrifai_tpu monitor <model_dir> <data>`` loads the
+model and its ``monitor.json`` reference profile, scores the file
+through the tileplane ``score_stream`` lane (readers/streaming.py —
+producer-thread record assembly overlapped with device scoring, the
+PR 6 bulk path), and feeds the SAME ServeMonitor the serving engine
+uses: raw records tee off the stream into the hash/numeric sketches
+while the scored tiles feed the prediction sketch. Batch scoring and
+live serving therefore share one drift engine and one verdict — the
+ci.sh smoke pins that an offline report over a shifted file agrees with
+the serve-side alert on the same distribution.
+
+By default the whole file is ONE window (end-of-file forces the
+rollover); ``--window-rows`` re-enables tumbling windows for
+position-in-file drift hunting. Note the prediction stream lags the raw
+stream by the tileplane's in-flight tiles, so windowed offline reports
+attribute scores to windows approximately; the default single window is
+exact.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ..filters.sketches import numeric_value
+from .alerts import DriftPolicy
+from .profile import ReferenceProfile, score_of
+from .window import ServeMonitor
+
+_log = logging.getLogger("transmogrifai_tpu.monitor")
+
+
+class _TeeReader:
+    """StreamingReader wrapper: batches pass through to score_stream's
+    producer thread and ALSO queue for the monitor (main thread pops).
+    deque append/popleft are atomic, so no extra lock is needed."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.batches: "deque[List[Dict[str, Any]]]" = deque()
+
+    def stream(self) -> Iterator[List[Dict[str, Any]]]:
+        for b in self.inner.stream():
+            self.batches.append(b)
+            yield b
+
+
+def observe_raw_records(monitor: ServeMonitor, records: List[Dict[str, Any]],
+                        generators: Dict[str, Any]) -> None:
+    """Feed one batch of RAW records into the window sketches: numeric
+    matrix (profile order) through the jitted sketch, object values
+    through the host hash path. Shared by the offline driver and the
+    engine's single-record local route."""
+    from ..local.scoring import _extract
+
+    n = len(records)
+    if n == 0:
+        return
+    if monitor.numeric_names:
+        X = np.empty((n, len(monitor.numeric_names)), np.float32)
+        for j, nm in enumerate(monitor.numeric_names):
+            gen = generators[nm]
+            for i, rec in enumerate(records):
+                X[i, j] = numeric_value(_extract(gen, rec))
+        monitor.observe_numeric(X, np.ones(n, np.float32))
+    if monitor.hashed_names:
+        monitor.observe_hashed(
+            {nm: [_extract(generators[nm], rec) for rec in records]
+             for nm in monitor.hashed_names if nm in generators})
+    monitor.add_rows(n)
+
+
+def offline_report(model: Any, stream_reader: Any,
+                   profile: ReferenceProfile, *,
+                   policy: Optional[DriftPolicy] = None,
+                   tile_rows: int = 1024,
+                   window_rows: int = 0) -> Dict[str, Any]:
+    """Drift report for a record stream scored through score_stream.
+
+    window_rows=0 (default): one window over the whole stream."""
+    from ..readers.streaming import score_stream
+
+    monitor = ServeMonitor(
+        profile, policy=policy,
+        window_rows=window_rows if window_rows > 0 else 2 ** 62,
+        window_seconds=float("inf"))
+    generators = {f.name: f.origin_stage for f in model.raw_features()
+                  if not f.is_response}
+    pred = profile.prediction
+    rows = 0
+    tee = _TeeReader(stream_reader)
+    for tile in score_stream(model, tee, tile_rows=tile_rows):
+        while tee.batches:
+            batch = tee.batches.popleft()
+            rows += len(batch)
+            observe_raw_records(monitor, batch, generators)
+        if pred is not None:
+            svals = [score_of(row, pred.feature, pred.field) for row in tile]
+            monitor.observe_scores(
+                np.asarray([v for v in svals if v is not None], np.float64))
+    while tee.batches:  # raw batches the last tile didn't flush
+        batch = tee.batches.popleft()
+        rows += len(batch)
+        observe_raw_records(monitor, batch, generators)
+    monitor.maybe_rollover(force=True)
+    reports = list(monitor.history)
+    return {
+        "rows": rows,
+        "windows": monitor.n_windows,
+        "alerts_total": monitor.alerts_total,
+        "verdict": "drift" if monitor.alerts_total else "ok",
+        "policy": monitor.policy.to_json(),
+        "last": monitor.last_report,
+        "reports": reports,
+    }
+
+
+# -- the `monitor` CLI body ---------------------------------------------------
+
+def _file_stream_reader(path: str, batch_records: int):
+    """A single bulk file as a record stream (CSV or Avro)."""
+    from ..readers.streaming import ListStreamingReader
+    if path.endswith(".avro"):
+        from ..readers.avro import read_avro_file
+        records = list(read_avro_file(path))
+    else:
+        from ..readers.readers import CSVReader
+        records = CSVReader(path).read()
+    return ListStreamingReader(records, batch_size=batch_records)
+
+
+def run_monitor(args: Any) -> int:
+    """Body of ``python -m transmogrifai_tpu monitor`` (cli.py parses).
+
+    Prints one JSON report line; --fail-on-drift exits 3 when any
+    drift_alert fired, so CI/cron can gate on batch-side drift exactly
+    like trace-report --check gates the serve side."""
+    import os
+
+    from ..utils.metrics import collector
+    from ..workflow.io import load_monitor_profile
+    from ..workflow.workflow import WorkflowModel
+
+    if not logging.getLogger().handlers:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    model = WorkflowModel.load(args.model_dir)
+    doc = None
+    if getattr(args, "profile", None):
+        with open(args.profile) as fh:
+            doc = json.load(fh)
+    else:
+        doc = load_monitor_profile(args.model_dir)
+    if not doc:
+        print(json.dumps({"error": f"no monitor.json under "
+                                   f"{args.model_dir} — save the model "
+                                   f"from a fitted session (or pass "
+                                   f"--profile)"}), file=sys.stderr)
+        return 2
+    profile = ReferenceProfile.from_json(doc)
+
+    policy = DriftPolicy()
+    for knob in ("max_js", "max_psi", "max_fill_diff", "max_fill_ratio",
+                 "max_pred_js", "max_score_shift", "min_rows"):
+        v = getattr(args, knob, None)
+        if v is not None:
+            setattr(policy, knob, type(getattr(policy, knob))(v))
+
+    metrics_loc = getattr(args, "metrics_location", None)
+    if metrics_loc:
+        os.makedirs(metrics_loc, exist_ok=True)
+        collector.attach_event_log(os.path.join(metrics_loc,
+                                                "events.jsonl"))
+    try:
+        report = offline_report(
+            model, _file_stream_reader(args.data, int(args.tile_rows)),
+            profile, policy=policy, tile_rows=int(args.tile_rows),
+            window_rows=int(getattr(args, "window_rows", 0) or 0))
+    finally:
+        if metrics_loc:
+            collector.detach_event_log()
+    report["model_dir"] = args.model_dir
+    report["data"] = args.data
+    print(json.dumps(report, default=str))
+    if getattr(args, "fail_on_drift", False) and report["verdict"] == "drift":
+        return 3
+    return 0

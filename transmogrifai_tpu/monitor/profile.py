@@ -1,0 +1,276 @@
+"""Reference profiles: the train-side half of continuous drift monitoring.
+
+At fit/save time one profile is computed per model and persisted NEXT TO
+the model artifact (``monitor.json``, via workflow/io.py — same contract
+as the ``serve.json`` prewarm manifest): per raw predictor feature a
+training sketch — numeric histogram with PINNED edges (lo/hi from the
+one-pass statistics engine's Summary, so serve-side windows bin against
+the training range and location shift piles into edge bins exactly like
+RawFeatureFilter's train-vs-score comparison), or a crc32 hash-bin table
+for categorical/text/list/map features (filters/sketches semantics) —
+plus fill rates and the TRAINING PREDICTION distribution (calibration-bin
+occupancy over the score range + mean/std). The serve monitor
+(monitor/window.py) accumulates the same sufficient statistics over live
+traffic and monitor/drift.py compares the two.
+
+The profile is built from the model's cached training data
+(``model._train_data`` holds the RFF-cleaned raw columns AND the
+prediction column right after train()), so it reflects exactly what the
+model trained on. TMOG_MONITOR_PROFILE=0 disables the automatic build at
+save time.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..filters import sketches
+
+_log = logging.getLogger("transmogrifai_tpu.monitor")
+
+DEFAULT_BINS = 40
+DEFAULT_PRED_BINS = 10
+PROFILE_VERSION = 1
+
+
+@dataclass
+class FeatureProfile:
+    """One raw feature's training sketch."""
+
+    name: str
+    kind: str                 # "numeric" | "hashed"
+    count: float              # total training rows
+    nulls: float              # missing/empty rows
+    hist: List[float]         # [bins] valid mass (numeric: pinned-edge
+    #                           histogram; hashed: crc32 bin table)
+    lo: float = 0.0           # pinned histogram edges (numeric only)
+    hi: float = 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "kind": self.kind, "count": self.count,
+                "nulls": self.nulls, "hist": list(self.hist),
+                "lo": self.lo, "hi": self.hi}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "FeatureProfile":
+        return FeatureProfile(
+            name=d["name"], kind=d["kind"], count=float(d["count"]),
+            nulls=float(d["nulls"]), hist=[float(x) for x in d["hist"]],
+            lo=float(d.get("lo", 0.0)), hi=float(d.get("hi", 0.0)))
+
+
+@dataclass
+class PredictionProfile:
+    """Training prediction distribution: calibration-bin occupancy over
+    [lo, hi] plus moments of the score stream."""
+
+    feature: str              # prediction result-feature name
+    field: str                # "probability_1" | "prediction"
+    count: float
+    mean: float
+    std: float
+    hist: List[float]         # [pred_bins]
+    lo: float
+    hi: float
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.__dict__, hist=list(self.hist))
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "PredictionProfile":
+        return PredictionProfile(
+            feature=d["feature"], field=d["field"], count=float(d["count"]),
+            mean=float(d["mean"]), std=float(d["std"]),
+            hist=[float(x) for x in d["hist"]],
+            lo=float(d["lo"]), hi=float(d["hi"]))
+
+
+@dataclass
+class ReferenceProfile:
+    """The persisted training profile a serve-side monitor compares
+    windows against."""
+
+    bins: int = DEFAULT_BINS
+    pred_bins: int = DEFAULT_PRED_BINS
+    rows: float = 0.0
+    features: List[FeatureProfile] = field(default_factory=list)
+    prediction: Optional[PredictionProfile] = None
+    version: int = PROFILE_VERSION
+
+    def feature(self, name: str) -> Optional[FeatureProfile]:
+        return next((f for f in self.features if f.name == name), None)
+
+    @property
+    def numeric_names(self) -> List[str]:
+        return [f.name for f in self.features if f.kind == "numeric"]
+
+    @property
+    def hashed_names(self) -> List[str]:
+        return [f.name for f in self.features if f.kind == "hashed"]
+
+    def numeric_edges(self) -> Dict[str, np.ndarray]:
+        """Pinned lo/hi vectors in `numeric_names` order — the traced
+        range inputs of the window sketch program."""
+        num = [f for f in self.features if f.kind == "numeric"]
+        return {"lo": np.asarray([f.lo for f in num], np.float32),
+                "hi": np.asarray([f.hi for f in num], np.float32)}
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"version": self.version, "bins": self.bins,
+                "pred_bins": self.pred_bins, "rows": self.rows,
+                "features": [f.to_json() for f in self.features],
+                "prediction": (self.prediction.to_json()
+                               if self.prediction else None)}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "ReferenceProfile":
+        return ReferenceProfile(
+            bins=int(d["bins"]), pred_bins=int(d["pred_bins"]),
+            rows=float(d.get("rows", 0.0)),
+            features=[FeatureProfile.from_json(x) for x in d["features"]],
+            prediction=(PredictionProfile.from_json(d["prediction"])
+                        if d.get("prediction") else None),
+            version=int(d.get("version", PROFILE_VERSION)))
+
+
+# -- score extraction ---------------------------------------------------------
+
+def score_field_of(col) -> str:
+    """Which scalar tracks the prediction distribution: P(class 1) for
+    probabilistic classifiers, else the raw prediction value."""
+    from ..models.prediction import probability_of
+    prob = probability_of(col)
+    return ("probability_1" if prob is not None and prob.shape[1] >= 2
+            else "prediction")
+
+
+def scores_of_column(col, fld: str) -> np.ndarray:
+    from ..models.prediction import prediction_of, probability_of
+    if fld == "probability_1":
+        return np.asarray(probability_of(col)[:, 1], np.float64)
+    return np.asarray(prediction_of(col), np.float64)
+
+
+def score_of(row: Dict[str, Any], prediction_name: str, fld: str
+             ) -> Optional[float]:
+    """The same scalar out of ONE scored row dict ({result: value}) —
+    the shape score_stream and the serving engine emit."""
+    v = row.get(prediction_name)
+    if v is None:
+        return None
+    if isinstance(v, dict):
+        v = v.get(fld, v.get("prediction"))
+    elif hasattr(v, "value") and isinstance(v.value, dict):
+        v = v.value.get(fld, v.value.get("prediction"))
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return None
+    return None if np.isnan(f) else f
+
+
+def score_hist(scores: np.ndarray, lo: float, hi: float,
+               bins: int) -> np.ndarray:
+    """Calibration-bin occupancy: fixed-edge histogram of a score
+    stream, clipping out-of-range scores into the edge bins (a drifted
+    model scoring outside the training range is still mass, not loss).
+    Shared by the profile builder and the window accumulator."""
+    s = np.asarray(scores, np.float64)
+    s = s[np.isfinite(s)]
+    if s.size == 0:
+        return np.zeros(bins, np.float64)
+    span = max(hi - lo, 1e-12)
+    idx = np.clip(((s - lo) / span * bins).astype(np.int64), 0, bins - 1)
+    return np.bincount(idx, minlength=bins).astype(np.float64)
+
+
+# -- building -----------------------------------------------------------------
+
+def build_profile(model: Any, ds: Any = None, *, bins: int = DEFAULT_BINS,
+                  pred_bins: int = DEFAULT_PRED_BINS) -> ReferenceProfile:
+    """Build the training ReferenceProfile for a fitted WorkflowModel.
+
+    `ds` defaults to the model's cached post-train dataset (raw +
+    prediction columns). Numeric features sketch through the shared
+    one-pass engine path (filters/sketches.compute_distributions — the
+    SAME code RawFeatureFilter bins with), object features through the
+    crc32 hash tables; per-map-key sketches are collapsed to the
+    whole-map feature sketch (feature-level drift is the serve-side
+    granularity)."""
+    if ds is None:
+        ds = getattr(model, "_train_data", None)
+    if ds is None:
+        raise ValueError("build_profile needs a dataset (model has no "
+                         "cached training data — pass ds= explicitly)")
+    predictors = [f for f in model.raw_features() if not f.is_response]
+    names = [f.name for f in predictors if f.name in ds]
+    from ..types import ColumnKind
+    names = [nm for nm in names
+             if ds.column(nm).kind != ColumnKind.VECTOR]
+    dists = sketches.compute_distributions(ds, names, bins)
+    feats: List[FeatureProfile] = []
+    for d in dists:
+        if d.key is not None:
+            continue  # map keys collapse to the whole-map sketch
+        if d.count > 0 and d.count - d.nulls == 0:
+            # all-missing at train time (e.g. a feature RawFeatureFilter
+            # nulled in place): no reference distribution exists, and a
+            # serve-side window that DOES carry values would alert
+            # forever — the feature is already excluded from the model
+            continue
+        numeric = ds.column(d.name).kind in sketches.NUMERIC_KINDS
+        feats.append(FeatureProfile(
+            name=d.name, kind="numeric" if numeric else "hashed",
+            count=float(d.count), nulls=float(d.nulls),
+            hist=[float(x) for x in d.distribution],
+            lo=float(d.summary[0]) if numeric else 0.0,
+            hi=float(d.summary[1]) if numeric else 0.0))
+
+    prediction = None
+    try:
+        pred_name = model._prediction_name()
+    except ValueError:
+        pred_name = None
+    if pred_name and pred_name in ds:
+        col = ds.column(pred_name)
+        fld = score_field_of(col)
+        s = scores_of_column(col, fld)
+        s = s[np.isfinite(s)]
+        if s.size:
+            if fld == "probability_1":
+                lo, hi = 0.0, 1.0  # probabilities: calibration bins
+            else:
+                lo, hi = float(s.min()), float(s.max())
+            prediction = PredictionProfile(
+                feature=pred_name, field=fld, count=float(s.size),
+                mean=float(s.mean()), std=float(s.std()),
+                hist=[float(x) for x in score_hist(s, lo, hi, pred_bins)],
+                lo=lo, hi=hi)
+
+    return ReferenceProfile(bins=bins, pred_bins=pred_bins,
+                            rows=float(len(ds)), features=feats,
+                            prediction=prediction)
+
+
+def save_profile_for(model: Any, path: str) -> Optional[str]:
+    """Best-effort profile build + save at model-save time (workflow/io
+    calls this). Monitoring must never fail a model save: errors log and
+    return None. TMOG_MONITOR_PROFILE=0 disables."""
+    import os
+
+    from ..workflow.io import save_monitor_profile
+    if os.environ.get("TMOG_MONITOR_PROFILE", "1").lower() in ("0", "off",
+                                                               "false"):
+        return None
+    if getattr(model, "_train_data", None) is None:
+        return None  # loaded/reconstructed model: no training data cached
+    try:
+        profile = build_profile(model)
+        return save_monitor_profile(path, profile.to_json())
+    except Exception:
+        _log.exception("monitor: reference-profile build failed; model "
+                       "saved WITHOUT monitor.json")
+        return None

@@ -1,0 +1,321 @@
+"""Windowed serve-side sketches: the live half of train-vs-score drift.
+
+A ServeMonitor accumulates, over TUMBLING windows of live traffic, the
+same sufficient statistics the ReferenceProfile froze at fit time:
+
+- numeric raw features: ONE fixed-shape jitted sketch program per
+  serving batch bucket — a [B, K] matrix (the engine's already-assembled
+  padded buffers, pad rows weighted 0) bins through the SHARED rule
+  ops/stats.hist_bin_ids against the profile's pinned edges and adds
+  into a device-resident [K, bins+1] running state. Dispatch is async
+  and nothing is fetched until the window closes, so accumulation never
+  blocks the request path; the per-bucket shapes are prewarmed with the
+  ladder, so the post-warmup zero-recompile contract holds with
+  monitoring on (RecompileTracker + trace-report --check keep pinning
+  it).
+- categorical/text/list/map features: crc32 hash-bin tables built on
+  HOST from the raw values (filters/sketches.hash_hist_update — the
+  profile side's exact code), on the thread that assembled the batch
+  (the micro-batcher's dispatcher for queued traffic).
+- prediction: score-mean moments + calibration-bin occupancy
+  (monitor/profile.score_hist, shared with the profile builder).
+
+Window state is a plain sum of sufficient statistics — the DrJAX
+psum-friendly MapReduce shape (PAPERS arxiv 2403.07128): a future
+multi-host deployment merges windows with one psum over the flat
+histogram state, no new math.
+
+On rollover the device state is fetched ONCE (the only sync, a few KB),
+compared against the profile (monitor/drift.window_report), evaluated by
+the DriftPolicy, and emitted as a ``drift_window`` event plus one
+``drift_alert`` event per threshold breach.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..filters.sketches import hash_hist_update
+from ..ops.stats import hist_bin_ids
+from ..utils.metrics import collector
+from . import drift
+from .alerts import DriftPolicy
+from .profile import ReferenceProfile, score_hist
+
+_log = logging.getLogger("transmogrifai_tpu.monitor")
+
+DEFAULT_WINDOW_ROWS = 4096
+DEFAULT_WINDOW_SECONDS = 60.0
+
+
+@functools.partial(jax.jit, static_argnames=("bins",))
+def _numeric_sketch_step(state, X, w, lo, hi, bins: int):
+    """state [K, bins+1] += weighted histogram of X [B, K] (NaN rows to
+    the trailing missing bin, pad rows carry w=0). The binning rule is
+    ops/stats.hist_bin_ids — shared with histogram_batched, which built
+    the profile side — so window and profile can never drift in clip
+    semantics. One executable per (B, K) shape: B is a prewarmed bucket
+    rung, K is fixed by the profile."""
+    X = jnp.asarray(X)
+    n, K = X.shape
+    ids = hist_bin_ids(X, lo, hi, bins, ~jnp.isnan(X))
+    wt = jnp.broadcast_to(w[:, None], (n, K))
+    return state + jax.ops.segment_sum(
+        wt.reshape(-1), ids.reshape(-1),
+        num_segments=K * (bins + 1)).reshape(K, bins + 1)
+
+
+@dataclass
+class WindowSnapshot:
+    """One closed window's host-side sufficient statistics."""
+
+    index: int
+    rows: float
+    wall_s: float
+    hists: Dict[str, np.ndarray]   # feature -> [bins] valid mass
+    nulls: Dict[str, float]        # feature -> missing rows in window
+    pred_hist: Optional[np.ndarray] = None
+    pred_count: float = 0.0
+    pred_sum: float = 0.0
+
+
+class ServeMonitor:
+    """Tumbling-window drift monitor over a ReferenceProfile.
+
+    All observe/rollover methods are internally locked (re-entrant): the
+    serving engine calls under its own batch lock, the offline driver
+    from its own threads. A window closes when `window_rows` rows have
+    accumulated or `window_seconds` elapsed with traffic in it —
+    whichever first — or on an explicit force (drain/shutdown/offline
+    end-of-file)."""
+
+    def __init__(self, profile: ReferenceProfile, *,
+                 policy: Optional[DriftPolicy] = None,
+                 window_rows: int = DEFAULT_WINDOW_ROWS,
+                 window_seconds: float = DEFAULT_WINDOW_SECONDS,
+                 health_gate: bool = False,
+                 history: int = 8):
+        self.profile = profile
+        self.policy = policy or DriftPolicy()
+        self.window_rows = int(window_rows)
+        self.window_seconds = float(window_seconds)
+        self.health_gate = bool(health_gate)
+        self.bins = int(profile.bins)
+        self.numeric_names: List[str] = profile.numeric_names
+        self.hashed_names: List[str] = profile.hashed_names
+        edges = profile.numeric_edges()
+        self._K = len(self.numeric_names)
+        # pinned edges live on device once; traced inputs of the sketch
+        self._lo = jnp.asarray(edges["lo"]) if self._K else None
+        self._hi = jnp.asarray(edges["hi"]) if self._K else None
+        self._lock = threading.RLock()
+        self.n_windows = 0
+        self.alerts_total = 0
+        self.rows_total = 0
+        self.alerting = False
+        self.last_report: Optional[Dict[str, Any]] = None
+        self.history: "deque[Dict[str, Any]]" = deque(maxlen=history)
+        self._t_last_close = time.monotonic()
+        self._reset_window()
+
+    # -- window state ------------------------------------------------------
+    def _reset_window(self) -> None:
+        # numpy zeros: the first sketch step transfers them; subsequent
+        # states stay device-resident, no extra executable involved
+        self._num_state: Any = (np.zeros((self._K, self.bins + 1),
+                                         np.float32) if self._K else None)
+        self._hash_hists = {nm: np.zeros(self.bins, np.float64)
+                            for nm in self.hashed_names}
+        self._hash_nulls = {nm: 0.0 for nm in self.hashed_names}
+        self._rows = 0
+        pred = self.profile.prediction
+        self._pred_hist = (np.zeros(self.profile.pred_bins, np.float64)
+                          if pred is not None else None)
+        self._pred_count = 0.0
+        self._pred_sum = 0.0
+        self._t_open = time.monotonic()
+
+    # -- observation -------------------------------------------------------
+    def observe_numeric(self, X: np.ndarray, w: np.ndarray) -> None:
+        """Async device accumulation of one padded batch ([B, K] f32 in
+        profile numeric order, w=0 pad rows). Does not sync."""
+        if self._K == 0:
+            return
+        with self._lock:
+            self._num_state = _numeric_sketch_step(
+                self._num_state, X, w, self._lo, self._hi, self.bins)
+
+    def observe_hashed(self, values: Dict[str, Sequence[Any]]) -> None:
+        """Host crc32 hash-bin accumulation of raw object values
+        ({feature: values of the window's valid rows})."""
+        with self._lock:
+            for nm, vals in values.items():
+                hist = self._hash_hists.get(nm)
+                if hist is None:
+                    continue
+                nulls = 0
+                for v in vals:
+                    if not hash_hist_update(hist, v):
+                        nulls += 1
+                self._hash_nulls[nm] += nulls
+
+    def observe_scores(self, scores: np.ndarray) -> None:
+        """Prediction-distribution accumulation (host; shares
+        profile.score_hist with the profile builder)."""
+        pred = self.profile.prediction
+        if pred is None or self._pred_hist is None:
+            return
+        s = np.asarray(scores, np.float64)
+        s = s[np.isfinite(s)]
+        if s.size == 0:
+            return
+        with self._lock:
+            self._pred_hist += score_hist(s, pred.lo, pred.hi,
+                                          self.profile.pred_bins)
+            self._pred_count += float(s.size)
+            self._pred_sum += float(s.sum())
+
+    def add_rows(self, n: int) -> None:
+        """Count n observed rows toward the window and roll over when a
+        boundary is crossed."""
+        with self._lock:
+            self._rows += int(n)
+            self.rows_total += int(n)
+        self.maybe_rollover()
+
+    def observe_batch(self, X: Optional[np.ndarray], w: Optional[np.ndarray],
+                      hashed: Dict[str, Sequence[Any]],
+                      scores: Optional[np.ndarray], n_rows: int) -> None:
+        """One served batch's full observation (engine fast path)."""
+        with self._lock:
+            if X is not None and w is not None:
+                self.observe_numeric(X, w)
+            if hashed:
+                self.observe_hashed(hashed)
+            if scores is not None:
+                self.observe_scores(scores)
+            self.add_rows(n_rows)
+
+    # -- rollover ----------------------------------------------------------
+    def maybe_rollover(self, force: bool = False) -> Optional[Dict[str, Any]]:
+        """Close the window when full / timed out / forced. Returns the
+        new report when one was produced. The only device sync in the
+        monitor happens here (one [K, bins+1] fetch)."""
+        with self._lock:
+            now = time.monotonic()
+            if self._rows <= 0:
+                if self.alerting and \
+                        now - self._t_last_close >= self.window_seconds:
+                    # alert TTL: a FULL window elapsed with zero traffic
+                    # (e.g. the load balancer rotated this replica off
+                    # after the health gate degraded). The stale verdict
+                    # expires so /healthz can recover and let probes —
+                    # and then real traffic, and a real re-verdict —
+                    # back in; a latched gate with no traffic could
+                    # otherwise never clear without a restart
+                    self.alerting = False
+                    self._t_last_close = now
+                    collector.event("drift_alert_expired",
+                                    idle_seconds=round(
+                                        now - self._t_open, 3))
+                    _log.info("drift: alert verdict expired after an "
+                              "idle window; health gate cleared")
+                self._t_open = now  # idle: restart the window timer
+                return None
+            due = (self._rows >= self.window_rows
+                   or now - self._t_open >= self.window_seconds)
+            if not (due or force):
+                return None
+            return self._close_window()
+
+    def _close_window(self) -> Dict[str, Any]:
+        wall = time.monotonic() - self._t_open
+        hists: Dict[str, np.ndarray] = {}
+        nulls: Dict[str, float] = {}
+        if self._K and self._num_state is not None:
+            num = np.asarray(self._num_state, np.float64)  # THE sync
+            for k, nm in enumerate(self.numeric_names):
+                hists[nm] = num[k, :self.bins]
+                nulls[nm] = float(num[k, self.bins])
+        for nm in self.hashed_names:
+            hists[nm] = self._hash_hists[nm]
+            nulls[nm] = float(self._hash_nulls[nm])
+        snap = WindowSnapshot(
+            index=self.n_windows, rows=float(self._rows), wall_s=wall,
+            hists=hists, nulls=nulls, pred_hist=self._pred_hist,
+            pred_count=self._pred_count, pred_sum=self._pred_sum)
+        report = drift.window_report(self.profile, snap, self.policy)
+        self.n_windows += 1
+        alerts = report["alerts"]
+        self.alerts_total += len(alerts)
+        self.alerting = bool(alerts)
+        self.last_report = report
+        self.history.append(report)
+        collector.event("drift_window", window=report["window"],
+                        rows=report["rows"],
+                        wall_seconds=round(report["wall_s"], 3),
+                        worst_feature=report["worst_feature"],
+                        worst_js=report["worst_js"],
+                        alerts=len(alerts))
+        self._t_last_close = time.monotonic()
+        for a in alerts:
+            collector.event("drift_alert", window=report["window"], **a)
+            _log.warning("drift_alert window=%d %s %s=%s > %.4f",
+                         report["window"], a["target"], a["metric"],
+                         "inf" if a["value"] is None
+                         else f"{a['value']:.4f}", a["threshold"])
+        self._reset_window()
+        return report
+
+    # -- prewarm -----------------------------------------------------------
+    def prewarm(self, shapes: Sequence[int]) -> None:
+        """Compile the sketch program for every serving bucket shape
+        (called inside ServingEngine.prewarm, BEFORE the recompile watch
+        arms), then discard the template observations."""
+        if self._K:
+            for b in shapes:
+                self.observe_numeric(np.zeros((int(b), self._K), np.float32),
+                                     np.zeros(int(b), np.float32))
+        with self._lock:
+            self._reset_window()
+
+    # -- reporting ---------------------------------------------------------
+    def healthy(self) -> bool:
+        return not (self.health_gate and self.alerting)
+
+    def report(self) -> Dict[str, Any]:
+        """The ``GET /drift`` payload."""
+        with self._lock:
+            return {
+                "windows": self.n_windows,
+                "window_rows": self.window_rows,
+                "window_seconds": self.window_seconds,
+                "rows_total": self.rows_total,
+                "rows_in_window": self._rows,
+                "alerts_total": self.alerts_total,
+                "alerting": self.alerting,
+                "health_gate": self.health_gate,
+                "policy": self.policy.to_json(),
+                "last": self.last_report,
+                "history": list(self.history),
+            }
+
+    def metrics(self) -> Dict[str, Any]:
+        """Compact counters for the ``/metrics`` payload."""
+        with self._lock:
+            return {"windows": self.n_windows,
+                    "rows_total": self.rows_total,
+                    "rows_in_window": self._rows,
+                    "alerts_total": self.alerts_total,
+                    "alerting": self.alerting,
+                    "last_worst_js": (self.last_report or {}).get(
+                        "worst_js", 0.0)}

@@ -15,6 +15,11 @@ rebuilt for the XLA runtime, plus what a real server needs on top:
 - :mod:`frontend` — dependency-light stdlib HTTP/JSON frontend plus the
   in-process ``submit()`` API tests and bench drive, and the
   ``python -m transmogrifai_tpu serve`` CLI body.
+
+Continuous train-vs-score drift monitoring rides the engine via
+``monitor=`` (transmogrifai_tpu/monitor/, docs/monitoring.md): windowed
+feature/prediction sketches, ``GET /drift``, and the optional
+``/healthz`` hard gate.
 """
 from .batcher import MicroBatcher, Overloaded
 from .engine import ServingEngine, bucket_ladder, template_record

@@ -120,18 +120,33 @@ class MicroBatcher:
             with self._cond:
                 while not self._q and not self._closed:
                     self._cond.wait(0.1)
+                    if not self._q and not self._closed:
+                        break  # idle beat: tick the monitor off-lock
                 if not self._q:
-                    return  # closed AND drained
-                batch = [self._q.popleft()]
-                deadline = time.perf_counter() + self.max_wait_s
-                while len(batch) < self.max_batch:
-                    if self._q:
-                        batch.append(self._q.popleft())
-                        continue
-                    now = time.perf_counter()
-                    if self._closed or now >= deadline:
-                        break
-                    self._cond.wait(min(deadline - now, 0.05))
+                    if self._closed:
+                        return  # closed AND drained
+                    batch = None  # idle: no work gathered this beat
+                else:
+                    batch = [self._q.popleft()]
+                    deadline = time.perf_counter() + self.max_wait_s
+                    while len(batch) < self.max_batch:
+                        if self._q:
+                            batch.append(self._q.popleft())
+                            continue
+                        now = time.perf_counter()
+                        if self._closed or now >= deadline:
+                            break
+                        self._cond.wait(min(deadline - now, 0.05))
+            if batch is None:
+                # drift-monitor heartbeat (docs/monitoring.md): a
+                # `window_seconds` boundary must close even when no
+                # traffic arrives to trigger it — the dispatcher is the
+                # natural idle thread, and the tick runs OUTSIDE the
+                # queue condition so submissions never wait on it
+                tick = getattr(self.engine, "monitor_tick", None)
+                if tick is not None:
+                    tick()
+                continue
             self._dispatch(batch)
 
     def _dispatch(self, batch: List[_Pending]) -> None:

@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..data.dataset import Column, Dataset, column_from_values
+from ..filters.sketches import numeric_value as _numeric_value
 from ..local.scoring import record_validator, score_function
 from ..local.scoring import _extract as _extract_typed
 from ..types import ColumnKind
@@ -123,7 +124,8 @@ class ServingEngine:
                  buckets: Optional[Sequence[int]] = None,
                  example: Optional[Record] = None,
                  single_record: str = "bucket",
-                 strict_keys: bool = True):
+                 strict_keys: bool = True,
+                 monitor: Optional[Any] = None):
         if isinstance(model, str):
             from ..workflow.workflow import WorkflowModel
             model = WorkflowModel.load(model)
@@ -183,6 +185,31 @@ class ServingEngine:
         self._span_budget = int(os.environ.get("TMOG_SERVE_SPAN_BUDGET",
                                                "10000"))
 
+        # -- drift monitor (monitor/window.ServeMonitor, docs/monitoring.md)
+        # Observations run under self._lock after each scored batch: the
+        # numeric sketch is an ASYNC device dispatch (nothing fetched
+        # until a window rolls over), the hash/prediction paths are
+        # host-side sums on the thread that assembled the batch. A
+        # monitor whose profile names a feature this model lacks is
+        # refused up front — comparing misaligned columns would report
+        # garbage drift.
+        self.monitor = monitor
+        self.monitor_errors = 0
+        #: set by _monitor_fault after repeated observation failures:
+        #: observation stops, but the monitor object (and its counters,
+        #: /metrics block and /drift report) stay visible — evidence of
+        #: WHY the drift series stopped must not vanish with it
+        self.monitor_disabled = False
+        self._gen_by_name = {f.name: gen for f, gen in self._predictors}
+        if monitor is not None:
+            missing = (set(monitor.numeric_names)
+                       | set(monitor.hashed_names)) - set(self._gen_by_name)
+            if missing:
+                _log.warning("serve: monitor profile names features this "
+                             "model lacks (%s); monitoring DISABLED",
+                             sorted(missing))
+                self.monitor = None
+
     # -- buckets -----------------------------------------------------------
     def pick_bucket(self, n: int) -> int:
         """Smallest bucket >= n (n must fit the top rung)."""
@@ -225,10 +252,7 @@ class ServingEngine:
             data = col.data
             if col.kind in _NUMERIC_KINDS:
                 for i, rec in enumerate(records):
-                    v = _extract_typed(gen, rec)
-                    data[i] = (np.nan if v is None else
-                               (1.0 if v is True else
-                                (0.0 if v is False else float(v))))
+                    data[i] = _numeric_value(_extract_typed(gen, rec))
             else:
                 for i, rec in enumerate(records):
                     data[i] = _extract_typed(gen, rec)
@@ -251,10 +275,13 @@ class ServingEngine:
         if len(records) == 1 and self._local_fn is not None and self.warm:
             t0 = time.perf_counter()
             res = self._local_fn(records[0])  # host replay: no device lock
+            row = self._local_row(res)
             with self._lock:  # counters/histograms share the lock though
                 self._observe_batch(1, 1, 0.0, time.perf_counter() - t0,
                                     path="local")
-            return [self._local_row(res)]
+                if self.monitor is not None and not self.monitor_disabled:
+                    self._observe_monitor_record(records[0], row)
+            return [row]
         n = len(records)
         bucket = self.pick_bucket(n)
         # pad by repeating the last record: real values keep every
@@ -273,6 +300,8 @@ class ServingEngine:
                    for i in range(n)]
             t2 = time.perf_counter()
             self._observe_batch(bucket, n, t1 - t0, t2 - t1)
+            if self.monitor is not None and not self.monitor_disabled:
+                self._observe_monitor(ds, out, n, bucket)
             self._check_recompiles()
         return out
 
@@ -287,6 +316,81 @@ class ServingEngine:
     def score_record(self, record: Record) -> Record:
         (out,) = self.score_batch([record])
         return out
+
+    # -- drift monitoring --------------------------------------------------
+    def _monitor_scores(self, out_rows: Sequence[Record]):
+        pred = self.monitor.profile.prediction
+        if pred is None:
+            return None
+        from ..monitor.profile import score_of
+        vals = [score_of(r, pred.feature, pred.field) for r in out_rows]
+        return np.asarray([v for v in vals if v is not None], np.float64)
+
+    def _observe_monitor(self, ds: Dataset, out_rows: List[Record],
+                         n: int, bucket: int) -> None:
+        """Feed one scored batch into the window sketches (caller holds
+        self._lock). The numeric matrix copies out of the reusable
+        buffers (np.stack-to-f32 decouples it before the next batch
+        refills them); the device dispatch is async and nothing syncs
+        until a window rolls over. Monitoring must never fail a request:
+        errors count, log, and after 20 the monitor shuts itself off."""
+        mon = self.monitor
+        try:
+            X = w = None
+            if mon.numeric_names:
+                X = np.stack([np.asarray(ds.column(nm).data, np.float32)
+                              for nm in mon.numeric_names], axis=1)
+                w = np.zeros(bucket, np.float32)
+                w[:n] = 1.0
+            hashed = {nm: ds.column(nm).data[:n]
+                      for nm in mon.hashed_names if nm in ds}
+            mon.observe_batch(X, w, hashed, self._monitor_scores(out_rows),
+                              n)
+        except Exception:
+            self._monitor_fault()
+
+    def _observe_monitor_record(self, record: Record, row: Record) -> None:
+        """Single-record local route: one [1, K] dispatch through the
+        bucket-1 sketch executable + the host paths (caller holds
+        self._lock)."""
+        mon = self.monitor
+        try:
+            from ..monitor.offline import observe_raw_records
+            observe_raw_records(mon, [record], self._gen_by_name)
+            scores = self._monitor_scores([row])
+            if scores is not None:
+                mon.observe_scores(scores)
+        except Exception:
+            self._monitor_fault()
+
+    def _monitor_fault(self) -> None:
+        """Shared observation-failure accounting (both score routes):
+        count, log the first few, self-disable after 20 — monitoring
+        must never keep taxing a request path it cannot serve."""
+        self.monitor_errors += 1
+        if self.monitor_errors <= 3:
+            _log.exception("serve: drift-monitor observation failed "
+                           "(%d)", self.monitor_errors)
+        if self.monitor_errors >= 20 and not self.monitor_disabled:
+            _log.error("serve: drift monitor disabled after %d errors",
+                       self.monitor_errors)
+            self.monitor_disabled = True
+
+    def monitor_tick(self) -> None:
+        """Timer-based window rollover for idle periods (the batcher's
+        dispatcher calls this between batches so a `window_seconds`
+        boundary closes even with no traffic arriving)."""
+        if self.monitor is None or self.monitor_disabled:
+            return
+        with self._lock:
+            self.monitor.maybe_rollover()
+
+    def finish_monitor(self) -> None:
+        """Force-close any partial window (drain/shutdown path)."""
+        if self.monitor is None:
+            return
+        with self._lock:
+            self.monitor.maybe_rollover(force=True)
 
     # -- prewarm -----------------------------------------------------------
     def prewarm(self) -> Dict[str, Any]:
@@ -312,6 +416,11 @@ class ServingEngine:
                     "bucket": b,
                     "wall_s": round(time.perf_counter() - tb, 4),
                     "compiles": tracing.tracker.true_compiles - cb0})
+            if self.monitor is not None:
+                # compile the per-bucket window sketch programs now:
+                # monitoring must not add a single post-warmup compile
+                # (the zero-recompile contract holds with monitoring on)
+                self.monitor.prewarm(self.buckets)
             wall = time.perf_counter() - t0
             self.warm = True
             # the watch counts TRUE compiles: persistent-cache loads are
@@ -412,13 +521,18 @@ class ServingEngine:
     def metrics(self) -> Dict[str, Any]:
         """Counters + latency quantiles, the /metrics payload (and the
         source bench.py --serving reads instead of re-timing)."""
-        return {"warm": self.warm,
-                "buckets": list(self.buckets),
-                "max_batch": self.max_batch,
-                "single_record": self.single_record,
-                "requests": self.n_requests,
-                "batches": self.n_batches,
-                "rows": self.n_rows,
-                "shed": self.n_shed,
-                "post_warmup_compiles": self.post_warmup_compiles,
-                "latency": {k: h.to_json() for k, h in self.hist.items()}}
+        out = {"warm": self.warm,
+               "buckets": list(self.buckets),
+               "max_batch": self.max_batch,
+               "single_record": self.single_record,
+               "requests": self.n_requests,
+               "batches": self.n_batches,
+               "rows": self.n_rows,
+               "shed": self.n_shed,
+               "post_warmup_compiles": self.post_warmup_compiles,
+               "latency": {k: h.to_json() for k, h in self.hist.items()}}
+        if self.monitor is not None:
+            out["monitor"] = self.monitor.metrics()
+            out["monitor"]["disabled"] = self.monitor_disabled
+            out["monitor_errors"] = self.monitor_errors
+        return out

@@ -67,11 +67,34 @@ class ServeFrontend:
         return self.engine.score_batch(records)
 
     def healthz(self) -> Dict[str, Any]:
-        return {"status": "ok" if self.engine.warm else "warming",
-                "warm": self.engine.warm,
-                "buckets": list(self.engine.buckets),
-                "queue_len": self.batcher.queue_len,
-                "closed": self.batcher.closed}
+        status = "ok" if self.engine.warm else "warming"
+        out = {"warm": self.engine.warm,
+               "buckets": list(self.engine.buckets),
+               "queue_len": self.batcher.queue_len,
+               "closed": self.batcher.closed}
+        mon = self.engine.monitor
+        if mon is not None:
+            out["drift_alerting"] = mon.alerting
+            if not mon.healthy() and not self.engine.monitor_disabled:
+                # the optional hard health gate (docs/monitoring.md):
+                # with --monitor-health-gate, an alerting window degrades
+                # /healthz (HTTP 503) until a clean window closes or the
+                # verdict expires idle — a load balancer can rotate a
+                # replica off a rotten feed. A self-disabled monitor
+                # (observation faults) cannot refresh its verdict, so
+                # its stale alert must not hold the gate
+                status = "degraded"
+        out["status"] = status
+        return out
+
+    def drift(self) -> Optional[Dict[str, Any]]:
+        """The ``GET /drift`` payload; None when monitoring is off."""
+        mon = self.engine.monitor
+        if mon is None:
+            return None
+        rep = mon.report()
+        rep["disabled"] = self.engine.monitor_disabled
+        return rep
 
     def metrics(self) -> Dict[str, Any]:
         return self.engine.metrics()
@@ -95,9 +118,18 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
         fe = self.server.frontend  # type: ignore[attr-defined]
         if self.path == "/healthz":
-            self._reply(200, fe.healthz())
+            h = fe.healthz()
+            self._reply(503 if h["status"] == "degraded" else 200, h)
         elif self.path == "/metrics":
             self._reply(200, fe.metrics())
+        elif self.path == "/drift":
+            d = fe.drift()
+            if d is None:
+                self._reply(404, {"error": "drift monitoring not enabled "
+                                           "(no monitor.json profile, or "
+                                           "--monitor off)"})
+            else:
+                self._reply(200, d)
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -181,9 +213,67 @@ def run_serve(args: Any) -> int:
         with open(args.example) as f:
             example = json.load(f)
 
+    # drift monitor (docs/monitoring.md): --monitor auto (default) turns
+    # it on exactly when the model artifact carries a monitor.json
+    # reference profile; `on` demands one; `off` disables
+    monitor = None
+    mon_mode = getattr(args, "monitor", "auto")
+    if mon_mode != "off":
+        from ..monitor.profile import ReferenceProfile
+        from ..monitor.window import ServeMonitor
+        from ..workflow.io import load_monitor_profile
+        doc = load_monitor_profile(args.model_dir)
+        if doc is not None:
+            try:
+                monitor = ServeMonitor(
+                    ReferenceProfile.from_json(doc),
+                    window_rows=int(getattr(args, "monitor_window_rows",
+                                            4096)),
+                    window_seconds=float(getattr(args,
+                                                 "monitor_window_seconds",
+                                                 60.0)),
+                    health_gate=bool(getattr(args, "monitor_health_gate",
+                                             False)))
+            except Exception:
+                # a structurally corrupt profile (valid JSON, broken
+                # schema) must not block startup under auto — same
+                # contract as load_monitor_profile's decode guard; an
+                # explicit `on` fails loudly below
+                _log.exception("serve: monitor.json under %s is "
+                               "unusable", args.model_dir)
+                if mon_mode == "on":
+                    return 2
+                monitor = None
+        if monitor is not None:
+            _log.info("serve: drift monitoring ON (%d numeric + %d "
+                      "hashed features, window %d rows / %.0fs%s)",
+                      len(monitor.numeric_names),
+                      len(monitor.hashed_names), monitor.window_rows,
+                      monitor.window_seconds,
+                      ", health gate" if monitor.health_gate else "")
+        elif mon_mode == "on" and doc is None:
+            _log.error("serve: --monitor on but %s has no monitor.json "
+                       "(save the model from a fitted session)",
+                       args.model_dir)
+            return 2
+        elif doc is None:
+            _log.info("serve: no monitor.json next to the model — drift "
+                      "monitoring off")
+
     engine = ServingEngine(
         model, max_batch=args.max_batch, buckets=buckets, example=example,
-        single_record=getattr(args, "single_record", "bucket"))
+        single_record=getattr(args, "single_record", "bucket"),
+        monitor=monitor)
+    if monitor is not None and engine.monitor is None and mon_mode == "on":
+        # the engine refused the monitor (profile/model feature
+        # mismatch — e.g. a retrained model served with a stale
+        # monitor.json). Under auto that degrades to unmonitored with a
+        # warning; under an explicit `on` the operator DEMANDED
+        # monitoring, so running without it must be a startup failure
+        _log.error("serve: --monitor on but the profile does not match "
+                   "this model's features (stale monitor.json? re-save "
+                   "the model)")
+        return 2
     summary = engine.prewarm()
 
     def _save_artifacts() -> None:
@@ -229,6 +319,7 @@ def run_serve(args: Any) -> int:
     finally:
         httpd.server_close()
         batcher.shutdown(drain=True)
+        engine.finish_monitor()  # close the partial drift window
         _save_artifacts()
         _log.info("serve: drained; %d request(s), %d batch(es), "
                   "%d shed, %d post-warmup compile(s)",

@@ -683,6 +683,15 @@ def trace_report(run_dir: str, check: bool = False,
             problems.append(
                 f"{event_log}: {n_rc} serve_recompile event(s) — XLA "
                 f"compile(s) landed after serving warmup")
+        # drift contract (docs/monitoring.md): every threshold breach
+        # the serve-side monitor saw is a drift_alert event; --check
+        # surfaces them the same way — a monitored run that drifted is
+        # not a clean run
+        n_da = event_counts.get("drift_alert", 0)
+        if n_da:
+            problems.append(
+                f"{event_log}: {n_da} drift_alert event(s) — serve-time "
+                f"feature/prediction drift exceeded policy thresholds")
 
     for mf in metric_files:
         try:

@@ -27,6 +27,7 @@ from .workflow import WorkflowModel
 MODEL_JSON = "op-model.json"
 ARRAYS_NPZ = "arrays.npz"
 SERVE_JSON = "serve.json"
+MONITOR_JSON = "monitor.json"
 FORMAT_VERSION = 1
 
 
@@ -97,6 +98,15 @@ def save_model(model: WorkflowModel, path: str, overwrite: bool = True) -> None:
     with open(os.path.join(path, MODEL_JSON), "w") as fh:
         json.dump(doc, fh, indent=1)
     np.savez_compressed(os.path.join(path, ARRAYS_NPZ), **store)
+
+    # drift-monitoring reference profile (docs/monitoring.md): when the
+    # model still carries its post-train dataset, freeze the per-feature
+    # training sketches + prediction distribution next to the artifact so
+    # `serve` and the offline `monitor` CLI can compare live traffic
+    # against them. Best-effort by contract (a monitoring failure must
+    # never fail a model save); TMOG_MONITOR_PROFILE=0 disables.
+    from ..monitor.profile import save_profile_for
+    save_profile_for(model, path)
 
 
 def load_model(path: str,
@@ -202,3 +212,34 @@ def load_serve_manifest(model_dir: Optional[str]) -> Optional[Dict[str, Any]]:
         return doc if isinstance(doc, dict) else None
     except (OSError, json.JSONDecodeError):
         return None  # a corrupt manifest must not block serving startup
+
+
+# -- drift-monitoring reference profile ---------------------------------------
+# Written at save time from the model's cached training data (see
+# monitor/profile.py); `serve` adopts it to run the continuous
+# train-vs-score comparison and `python -m transmogrifai_tpu monitor`
+# replays it over bulk files (docs/monitoring.md). Same robustness
+# contract as the serve manifest: a corrupt profile disables monitoring,
+# it never blocks startup.
+
+def save_monitor_profile(model_dir: str, profile_json: Dict[str, Any]) -> str:
+    p = os.path.join(model_dir, MONITOR_JSON)
+    with open(p, "w") as fh:
+        json.dump(profile_json, fh, indent=1, default=str)
+    return p
+
+
+def load_monitor_profile(model_dir: Optional[str]
+                         ) -> Optional[Dict[str, Any]]:
+    if not model_dir:
+        return None
+    p = os.path.join(model_dir, MONITOR_JSON)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as fh:
+            doc = json.load(fh)
+        return doc if isinstance(doc, dict) and doc.get("features") \
+            is not None else None
+    except (OSError, json.JSONDecodeError):
+        return None
