@@ -24,9 +24,32 @@ echo "== 2/6 tmoglint (static JAX/TPU discipline + stage contracts) =="
 # fails fast on findings not in tools/tmoglint/baseline.json and on stale
 # baseline entries (docs/static_analysis.md); runs before the test tiers
 # because it needs no imports and catches contract breaks in seconds.
-# bench.py + tools/ are in scope since TPU005 (unsynced-wall-timing):
-# that is where the wall-clock benchmarking lives
-python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/
+# bench.py + tools/ are in scope since TPU005 (unsynced-wall-timing);
+# the v2 concurrency (THR001-004) + buffer-lifetime (BUF001-003)
+# families run in the same scan with the SAME empty baseline. The
+# --format json report is saved as a CI artifact so finding counts per
+# rule ride the build outputs next to the BENCH_*.json series.
+ARTIFACTS_DIR="${TMOG_CI_ARTIFACTS:-$(mktemp -d)}"
+mkdir -p "$ARTIFACTS_DIR"
+# one gating scan, captured as the JSON artifact (it carries ok/new/
+# stale + the --stats timings the assert below surfaces); a nonzero rc
+# stops CI right here under `set -e`
+python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/ \
+  --format json > "$ARTIFACTS_DIR/tmoglint_report.json"
+python - "$ARTIFACTS_DIR/tmoglint_report.json" <<'PY'
+import json, sys
+rep = json.load(open(sys.argv[1]))
+assert rep["ok"], rep
+assert "stats" in rep and rep["stats"]["files"] > 150, rep.get("stats")
+print(f"  tmoglint JSON artifact ok: {rep['total_findings']} finding(s), "
+      f"stats={rep['stats']}")
+PY
+# family selection (--rules THR,BUF) must run clean against the SAME
+# baseline with the stale-entry scoping guard active — the concurrency +
+# buffer-lifetime families alone, no TPU/DAG noise
+python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/ \
+  --rules THR,BUF
+echo "  tmoglint: full scan + THR,BUF family scan clean (artifact: $ARTIFACTS_DIR/tmoglint_report.json)"
 
 echo "== 3/6 test suite (8-device virtual CPU mesh) =="
 # fused histogram planner + CPU-fallback smoke first, explicitly under

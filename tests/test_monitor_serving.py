@@ -415,6 +415,7 @@ class TestRobustness:
         def boom(*a, **k):
             raise RuntimeError("sketch exploded")
 
+        # tmoglint: disable=THR001  test fixture patches BEFORE threads
         mon.observe_numeric = boom
         recs = _strip(rows)
         for i in range(20):

@@ -348,6 +348,7 @@ class TestMicroBatcher:
                 time.sleep(delay)
             return real(records)
 
+        # tmoglint: disable=THR001  test fixture patches BEFORE threads
         eng.score_batch = spy
         return eng, calls
 

@@ -1,0 +1,317 @@
+"""Two-thread regression pins for the THR/BUF fixes (tmoglint v2).
+
+Each test here fails on the PRE-fix code: the serving engine's shared
+counters lost updates under HTTP-thread contention (`n_shed += 1`
+unlocked), the RecompileTracker's compile counters raced the
+jax.monitoring listener across threads, MetricsCollector.event() could
+AttributeError when a detach landed between its None-check and the
+emit, and the monitor's numeric sketch step allocated a fresh device
+accumulator per batch instead of donating its carry. The stress tests
+shrink the interpreter's thread switch interval so the read-modify-
+write windows that are "almost never" hit in production get hit
+reliably in CI.
+
+The static side of the same contracts is tmoglint THR001-THR004 /
+BUF001-BUF003 (tests/test_tmoglint.py pins the rule fixtures and the
+empty-baseline repo scan).
+"""
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from transmogrifai_tpu.serve.engine import ServingEngine
+from transmogrifai_tpu.utils.metrics import MetricsCollector
+from transmogrifai_tpu.utils.tracing import (_CACHE_HIT_EVENT,
+                                             _COMPILE_EVENT,
+                                             RecompileTracker, TraceTree)
+
+
+@pytest.fixture()
+def tiny_switch():
+    """Aggressive GIL switch interval: makes lost-update windows in
+    unlocked `x += 1` sequences fire within a few thousand iterations
+    instead of a few billion."""
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)
+    try:
+        yield
+    finally:
+        sys.setswitchinterval(old)
+
+
+def _hammer(n_threads, n_iters, body):
+    errors = []
+
+    def run():
+        try:
+            for _ in range(n_iters):
+                body()
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append(repr(e))
+
+    ths = [threading.Thread(target=run, daemon=True)
+           for _ in range(n_threads)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(60)
+    assert not any(t.is_alive() for t in ths), "stress thread hung"
+    assert not errors, errors[:3]
+
+
+class TestEngineCounters:
+    """ServingEngine.note_shed runs on every HTTP worker thread at once
+    (submit -> Overloaded path). Pre-fix its `n_shed += 1` was unlocked:
+    concurrent increments lost updates, so the /metrics `shed` counter
+    under-reported exactly when shedding was heaviest."""
+
+    class _Stub:
+        # the real method body runs against this minimal state: the
+        # regression is in ServingEngine.note_shed itself
+        def __init__(self):
+            self._stat_lock = threading.Lock()
+            self.n_shed = 0
+
+    def test_note_shed_exact_under_contention(self, tiny_switch):
+        """Invariant pin: exact counts under 8-way contention. (On
+        CPython 3.10 the GIL only switches at calls/backedges, so the
+        bare `+= 1` window rarely loses here — the DISCRIMINATING
+        pre-fix failures for this fix family are
+        TestTrackerCounters.test_true_compiles_never_transient and
+        TestCollectorLatencySave below; this test pins the contract for
+        interpreters without that accident, e.g. free-threaded
+        builds.)"""
+        stub = self._Stub()
+        n_threads, n_iters = 8, 4000
+        _hammer(n_threads, n_iters,
+                lambda: ServingEngine.note_shed(stub, 1))
+        assert stub.n_shed == n_threads * n_iters
+
+
+class TestTrackerCounters:
+    """The jax.monitoring compile listener fires on whatever thread
+    compiles — a serving dispatcher and a bulk scorer can land compiles
+    concurrently. Pre-fix `total_compiles += 1` was unlocked, so the
+    zero-recompile contract's own counter raced."""
+
+    def _tracker(self):
+        tr = RecompileTracker()
+        tree = TraceTree()
+        tr.activate(tree)
+        # tmoglint: disable=THR001  test setup runs BEFORE any thread
+        tr._mode = "monitoring"  # force the listener path deterministically
+        return tr, tree
+
+    def test_concurrent_compile_events_exact(self, tiny_switch):
+        tr, _tree = self._tracker()
+        n_threads, n_iters = 8, 4000
+        _hammer(n_threads, n_iters,
+                lambda: tr._on_event(_COMPILE_EVENT, 0.001))
+        assert tr.total_compiles == n_threads * n_iters
+        assert tr.true_compiles == n_threads * n_iters
+
+    def test_true_compiles_never_transient_on_cache_hits(
+            self, tiny_switch):
+        """THE discriminating pre-fix failure (measured: ~45k bad
+        observations per 200k events on this interpreter): pre-fix,
+        `total_compiles += 1` and `total_cache_hits += 1` were separate
+        unlocked writes with a call (`float(duration)`) between them —
+        a reader polling `true_compiles` during cache-hit-only traffic
+        (a prewarmed restart!) transiently saw phantom true compiles,
+        which is exactly the counter the serving engine's post-warmup
+        recompile watch alarms on. Post-fix both increments and the
+        property read share the tracker lock, so the phantom state is
+        unobservable."""
+        tr, _tree = self._tracker()
+        stop = threading.Event()
+        bad: list = []
+
+        def poll():
+            while not stop.is_set():
+                v = tr.true_compiles
+                if v:
+                    bad.append(v)
+                    return
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        try:
+            for _ in range(20000):
+                tr._on_event(_CACHE_HIT_EVENT, 0.0)
+                tr._on_event(_COMPILE_EVENT, 0.001)
+        finally:
+            stop.set()
+            poller.join(30)
+        assert not bad, (f"true_compiles transiently read {bad[:1]} "
+                         f"during cache-hit-only traffic")
+        assert tr.total_cache_hits == 20000
+        assert tr.true_compiles == 0
+
+    def test_close_all_never_holds_tree_lock_against_listener(self):
+        """Lock-order pin (tmoglint THR003): close_all pops under the
+        tree lock but CLOSES outside it — holding it across close()
+        would take tracker._lock while holding tree._lock, the exact
+        inverse of _on_event's tracker->tree order, and a compile
+        landing during close_all would deadlock."""
+        tr, tree = self._tracker()
+        done = []
+
+        def closer():
+            for _ in range(2000):
+                tree.open("s", "stage")
+                tree.close_all()
+            done.append("closer")
+
+        def listener():
+            for _ in range(2000):
+                tr._on_event(_COMPILE_EVENT, 0.0)
+            done.append("listener")
+
+        ths = [threading.Thread(target=closer, daemon=True),
+               threading.Thread(target=listener, daemon=True)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(30)
+        assert sorted(done) == ["closer", "listener"], \
+            f"deadlock: only {done} finished"
+
+
+class TestCollectorEventLog:
+    """MetricsCollector.event() pre-fix read self._event_log twice
+    (None-check, then emit): a detach_event_log on the main thread
+    between the two raised AttributeError on the serving thread —
+    telemetry must never fail a request path."""
+
+    def test_detach_races_emit_without_error(self, tmp_path, tiny_switch):
+        col = MetricsCollector()
+        stop = threading.Event()
+        errors = []
+
+        def emitter():
+            while not stop.is_set():
+                try:
+                    col.event("tick", i=1)
+                except BaseException as e:  # noqa: BLE001
+                    errors.append(repr(e))
+                    return
+
+        ths = [threading.Thread(target=emitter, daemon=True)
+               for _ in range(4)]
+        for t in ths:
+            t.start()
+        try:
+            for i in range(400):
+                col.attach_event_log(str(tmp_path / f"e{i % 3}.jsonl"))
+                col.detach_event_log()
+        finally:
+            stop.set()
+            for t in ths:
+                t.join(30)
+        assert not errors, errors[:3]
+
+
+class TestCollectorLatencySave:
+    """Pre-fix failure (reproduced: RuntimeError 'dictionary changed
+    size during iteration'): collector.latency() inserts first-seen
+    histogram names into current.latency_metrics from serving threads
+    while save() iterates the same dict building AppMetrics JSON — a
+    serve-time save(close=False) snapshot could crash the run it was
+    observing. Post-fix both sides hold the collector's lifecycle
+    lock."""
+
+    def test_latency_inserts_race_save_snapshot(self, tmp_path,
+                                                tiny_switch):
+        col = MetricsCollector()
+        col.enable("race-test")
+        stop = threading.Event()
+        errors = []
+        counter = [0]
+
+        def insert():
+            # fresh names only: the race needs NEW-key inserts landing
+            # mid-iteration, and a bounded count keeps save() cheap
+            while not stop.is_set() and counter[0] < 4000:
+                counter[0] += 1
+                col.latency(f"lane{counter[0]}", 0.001)
+
+        ths = [threading.Thread(target=insert, daemon=True)
+               for _ in range(2)]
+        for t in ths:
+            t.start()
+        try:
+            while not stop.is_set() and counter[0] < 4000:
+                try:
+                    col.save(str(tmp_path / "m.json"), close=False)
+                except RuntimeError as e:
+                    errors.append(repr(e))
+                    break
+        finally:
+            stop.set()
+            for t in ths:
+                t.join(30)
+            col.disable()
+        assert not errors, errors[:1]
+
+
+class TestSketchDonation:
+    """BUF002 fix: the monitor's per-bucket sketch step donates its
+    [K, bins+1] carry (the tileplane rule — 'the carry is donated,
+    tiles are not'), so a window accumulates in ONE device buffer
+    instead of allocating a fresh one per served batch."""
+
+    def test_carry_buffer_is_donated(self):
+        from transmogrifai_tpu.monitor.window import _numeric_sketch_step
+        lo = jnp.zeros(3)
+        hi = jnp.ones(3)
+        state = jnp.zeros((3, 11), jnp.float32)
+        jax.block_until_ready(state)
+        X = np.full((4, 3), 0.5, np.float32)
+        w = np.ones(4, np.float32)
+        out = _numeric_sketch_step(state, X, w, lo, hi, 10)
+        jax.block_until_ready(out)
+        assert state.is_deleted(), \
+            "sketch step no longer donates its carry (BUF002 regression)"
+
+    def test_donated_accumulation_totals_unchanged(self):
+        """Donation must not change the math: two batches accumulate to
+        the same histogram totals as a fresh numpy reference."""
+        from transmogrifai_tpu.monitor.window import _numeric_sketch_step
+        rng = np.random.default_rng(0)
+        lo = jnp.asarray(np.zeros(2, np.float32))
+        hi = jnp.asarray(np.ones(2, np.float32))
+        state = np.zeros((2, 9), np.float32)
+        total_w = 0.0
+        for _ in range(3):
+            X = rng.random((16, 2)).astype(np.float32)
+            w = np.ones(16, np.float32)
+            state = _numeric_sketch_step(state, X, w, lo, hi, 8)
+            total_w += 16 * 2
+        host = np.asarray(state, np.float64)
+        assert host.shape == (2, 9)
+        assert host.sum() == pytest.approx(total_w)
+
+    def test_window_state_never_read_after_donation(self):
+        """End-to-end: observe_batch repeatedly, then close the window —
+        the rebind-in-place idiom must keep every read on the LIVE
+        buffer (a use-after-donate here raises RuntimeError)."""
+        from transmogrifai_tpu.monitor.profile import (FeatureProfile,
+                                                       ReferenceProfile)
+        from transmogrifai_tpu.monitor.window import ServeMonitor
+        prof = ReferenceProfile(
+            bins=8, rows=8.0,
+            features=[FeatureProfile(
+                name="a", kind="numeric", count=8.0, nulls=0.0,
+                hist=[1.0] * 8, lo=0.0, hi=1.0)])
+        mon = ServeMonitor(prof, window_rows=1000, window_seconds=1e9)
+        rng = np.random.default_rng(1)
+        for _ in range(5):
+            X = rng.random((8, 1)).astype(np.float32)
+            mon.observe_batch(X, np.ones(8, np.float32), {}, None, 8)
+        rep = mon.maybe_rollover(force=True)
+        assert rep is not None and rep["rows"] == 40.0

@@ -463,6 +463,609 @@ class TestTPU005:
         assert out[0].snippet.startswith("d2")
 
 
+# -- THR001: shared-mutable-state races --------------------------------------
+
+class TestTHR001:
+    def test_thread_written_attr_read_unlocked(self):
+        out = lint("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    self.count += 1
+
+                def snapshot(self):
+                    return self.count
+        """, rules=["THR001"])
+        assert len(out) == 1 and out[0].rule == "THR001"
+        assert "Worker.count" in out[0].message
+        assert "lock" in out[0].message
+
+    def test_http_handler_attr_unlocked(self):
+        """ThreadingHTTPServer handlers run one thread per connection:
+        an unlocked counter on the handler class races with itself."""
+        out = lint("""
+            from http.server import BaseHTTPRequestHandler
+
+            class H(BaseHTTPRequestHandler):
+                hits = 0
+
+                def do_GET(self):
+                    self.hits = self.hits + 1
+
+                def metrics(self):
+                    return self.hits
+        """, rules=["THR001"])
+        assert rule_lines(out, "THR001"), "handler-thread race missed"
+
+    def test_negative_common_lock_both_sides(self):
+        out = lint("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    with self._lock:
+                        self.count += 1
+
+                def snapshot(self):
+                    with self._lock:
+                        return self.count
+        """, rules=["THR001"])
+        assert out == []
+
+    def test_negative_init_only_attr_is_config(self):
+        """Attributes only written in __init__ are immutable config —
+        reads from any thread are fine."""
+        out = lint("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.size = 8
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    return self.size * 2
+        """, rules=["THR001"])
+        assert out == []
+
+    def test_private_helper_inherits_caller_lock(self):
+        """A private helper whose EVERY call site holds the lock is
+        effectively locked — the `_close_window` pattern must not
+        flag."""
+        out = lint("""
+            import threading
+
+            class Window:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.rows = 0
+                    threading.Thread(target=self.tick).start()
+
+                def tick(self):
+                    with self._lock:
+                        self._advance()
+
+                def _advance(self):
+                    self.rows += 1
+
+                def read(self):
+                    with self._lock:
+                        return self.rows
+        """, rules=["THR001"])
+        assert out == []
+
+    def test_suppression_with_justification(self):
+        out = lint("""
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0
+                    threading.Thread(target=self._run).start()
+
+                def _run(self):
+                    # tmoglint: disable=THR001  read happens-after join
+                    self.count += 1
+
+                def snapshot(self):
+                    return self.count
+        """, rules=["THR001"])
+        assert out == []
+
+
+# -- THR002: blocking under a lock -------------------------------------------
+
+class TestTHR002:
+    def test_sleep_under_lock(self):
+        out = lint("""
+            import threading
+            import time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def poll(self):
+                    with self._lock:
+                        time.sleep(0.1)
+        """, rules=["THR002"])
+        assert len(out) == 1 and "time.sleep" in out[0].message
+
+    def test_blocking_queue_get_under_lock(self):
+        out = lint("""
+            import queue
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def drain(self):
+                    with self._lock:
+                        return self._q.get()
+        """, rules=["THR002"])
+        assert len(out) == 1 and "queue.get" in out[0].message
+
+    def test_device_fetch_of_jitted_state_under_lock(self):
+        """np.asarray of an attr assigned from a jitted call is a D2H
+        sync — the monitor window-close pattern."""
+        out = lint("""
+            import threading
+
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def _step(s):
+                return s + 1
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.state = None
+
+                def observe(self):
+                    with self._lock:
+                        self.state = _step(self.state)
+
+                def close(self):
+                    with self._lock:
+                        return np.asarray(self.state)
+        """, rules=["THR002"])
+        assert len(out) == 1 and "device-resident" in out[0].message
+
+    def test_negative_async_dispatch_under_lock_ok(self):
+        """Dispatch is async — only WAITING under a lock is flagged."""
+        out = lint("""
+            import threading
+
+            import jax
+
+            @jax.jit
+            def _step(s, x):
+                return s + x
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.state = 0
+
+                def observe(self, x):
+                    with self._lock:
+                        self.state = _step(self.state, x)
+        """, rules=["THR002"])
+        assert out == []
+
+    def test_negative_nonblocking_queue_get(self):
+        out = lint("""
+            import queue
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = queue.Queue()
+
+                def drain(self):
+                    with self._lock:
+                        return self._q.get(block=False)
+        """, rules=["THR002"])
+        assert out == []
+
+
+# -- THR003: lock-order inversion --------------------------------------------
+
+class TestTHR003:
+    def test_lexical_inversion(self):
+        out = lint("""
+            import threading
+
+            class A:
+                def __init__(self):
+                    self.l1 = threading.Lock()
+                    self.l2 = threading.Lock()
+
+                def f(self):
+                    with self.l1:
+                        with self.l2:
+                            pass
+
+                def g(self):
+                    with self.l2:
+                        with self.l1:
+                            pass
+        """, rules=["THR003"])
+        assert len(out) >= 1 and out[0].rule == "THR003"
+        assert "inversion" in out[0].message
+
+    def test_inversion_through_a_call(self):
+        """f holds l1 and calls h (which takes l2); g holds l2 and
+        calls k (which takes l1): the cycle spans the call graph."""
+        out = lint("""
+            import threading
+
+            class A:
+                def __init__(self):
+                    self.l1 = threading.Lock()
+                    self.l2 = threading.Lock()
+
+                def f(self):
+                    with self.l1:
+                        self.h()
+
+                def h(self):
+                    with self.l2:
+                        pass
+
+                def g(self):
+                    with self.l2:
+                        self.k()
+
+                def k(self):
+                    with self.l1:
+                        pass
+        """, rules=["THR003"])
+        assert len(out) >= 1 and out[0].rule == "THR003"
+
+    def test_negative_consistent_global_order(self):
+        out = lint("""
+            import threading
+
+            class A:
+                def __init__(self):
+                    self.l1 = threading.Lock()
+                    self.l2 = threading.Lock()
+
+                def f(self):
+                    with self.l1:
+                        with self.l2:
+                            pass
+
+                def g(self):
+                    with self.l1:
+                        with self.l2:
+                            pass
+        """, rules=["THR003"])
+        assert out == []
+
+
+# -- THR004: Condition / Event misuse ----------------------------------------
+
+class TestTHR004:
+    def test_notify_without_holding(self):
+        out = lint("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def wake(self):
+                    self._cond.notify()
+        """, rules=["THR004"])
+        assert len(out) == 1 and "without holding" in out[0].message
+
+    def test_wait_while_holding_second_lock(self):
+        out = lint("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._lock = threading.Lock()
+
+                def pause(self):
+                    with self._lock:
+                        with self._cond:
+                            self._cond.wait()
+        """, rules=["THR004"])
+        assert len(out) == 1 and "ALSO holding" in out[0].message
+
+    def test_with_on_event(self):
+        out = lint("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._done = threading.Event()
+
+                def finish(self):
+                    with self._done:
+                        pass
+        """, rules=["THR004"])
+        assert len(out) == 1 and "Event" in out[0].message
+
+    def test_negative_proper_condition_discipline(self):
+        out = lint("""
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def pause(self):
+                    with self._cond:
+                        self._cond.wait(0.1)
+
+                def wake(self):
+                    with self._cond:
+                        self._cond.notify_all()
+        """, rules=["THR004"])
+        assert out == []
+
+
+# -- BUF001: use-after-donate ------------------------------------------------
+
+class TestBUF001:
+    def test_read_after_donating_call(self):
+        out = lint("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(c, x):
+                return c + x
+
+            def run(c, xs):
+                out = step(c, xs)
+                return c.sum()
+        """, rules=["BUF001"])
+        assert len(out) == 1 and "donated" in out[0].message
+        assert "rebind" in out[0].message
+
+    def test_donated_in_loop_without_rebind(self):
+        out = lint("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(c, x):
+                return c + x
+
+            def run(c, xs):
+                for x in xs:
+                    step(c, x)
+        """, rules=["BUF001"])
+        assert len(out) == 1 and "loop" in out[0].message
+
+    def test_self_attr_read_after_donation(self):
+        out = lint("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(c, x):
+                return c + x
+
+            class W:
+                def fold(self, x):
+                    out = step(self.state, x)
+                    return self.state.sum()
+        """, rules=["BUF001"])
+        assert len(out) == 1 and "self.state" in out[0].message
+
+    def test_negative_rebind_idiom(self):
+        """`c = step(c, x)` is THE sanctioned carry idiom."""
+        out = lint("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(c, x):
+                return c + x
+
+            def run(c, xs):
+                for x in xs:
+                    c = step(c, x)
+                return c.sum()
+        """, rules=["BUF001"])
+        assert out == []
+
+    def test_negative_metadata_reads_survive_donation(self):
+        """.shape/.dtype stay valid on a deleted array."""
+        out = lint("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(c, x):
+                return c + x
+
+            def run(c, xs):
+                out = step(c, xs)
+                return out, c.shape, c.dtype
+        """, rules=["BUF001"])
+        assert out == []
+
+
+# -- BUF002: donation coverage -----------------------------------------------
+
+class TestBUF002:
+    def test_loop_carry_through_undonated_step(self):
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def step(acc, t):
+                return acc + t
+
+            def run(acc, tiles):
+                for t in tiles:
+                    acc = step(acc, t)
+                return acc
+        """, rules=["BUF002"])
+        assert len(out) == 1 and "does not donate" in out[0].message
+
+    def test_attr_state_through_undonated_step(self):
+        """An attribute is loop-carried across calls by construction —
+        the ServeMonitor sketch-state regression class."""
+        out = lint("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("bins",))
+            def sketch(state, X, bins):
+                return state + X.sum()
+
+            class Mon:
+                def observe(self, X):
+                    self.state = sketch(self.state, X, bins=8)
+        """, rules=["BUF002"])
+        assert len(out) == 1 and "self.state" in out[0].message
+
+    def test_negative_donated_step(self):
+        out = lint("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(acc, t):
+                return acc + t
+
+            def run(acc, tiles):
+                for t in tiles:
+                    acc = step(acc, t)
+                return acc
+        """, rules=["BUF002"])
+        assert out == []
+
+    def test_negative_non_carry_rebind(self):
+        """y = step(x, t) rebinding a DIFFERENT name is not a carry."""
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def step(acc, t):
+                return acc + t
+
+            def run(x, tiles):
+                for t in tiles:
+                    y = step(x, t)
+                return x
+        """, rules=["BUF002"])
+        assert out == []
+
+    def test_suppression(self):
+        out = lint("""
+            import jax
+
+            @jax.jit
+            def step(acc, t):
+                return acc + t
+
+            def run(acc, tiles):
+                for t in tiles:
+                    # tmoglint: disable=BUF002  acc aliases a checkpoint
+                    acc = step(acc, t)
+                return acc
+        """, rules=["BUF002"])
+        assert out == []
+
+
+# -- BUF003: donated buffer into spans/events --------------------------------
+
+class TestBUF003:
+    def test_event_captures_donated_buffer(self):
+        out = lint("""
+            import functools
+
+            import jax
+
+            from transmogrifai_tpu.utils.metrics import collector
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(c, x):
+                return c + x
+
+            def run(c, xs):
+                out = step(c, xs)
+                collector.event("pass_done", state=c)
+                return out
+        """, rules=["BUF003"])
+        assert len(out) == 1 and "span/event/log" in out[0].message
+
+    def test_log_captures_donated_buffer(self):
+        out = lint("""
+            import functools
+            import logging
+
+            import jax
+
+            _log = logging.getLogger(__name__)
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(c, x):
+                return c + x
+
+            def run(c, xs):
+                out = step(c, xs)
+                _log.info("carry was %s", c)
+                return out
+        """, rules=["BUF003"])
+        assert len(out) == 1
+
+    def test_negative_logging_the_rebound_result(self):
+        out = lint("""
+            import functools
+
+            import jax
+
+            from transmogrifai_tpu.utils.metrics import collector
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(c, x):
+                return c + x
+
+            def run(c, xs):
+                collector.event("pass_start", rows=int(c.shape[0]))
+                c = step(c, xs)
+                collector.event("pass_done", state=c)
+                return c
+        """, rules=["BUF003"])
+        assert out == []
+
+
 # -- DAG001: stage contracts -------------------------------------------------
 
 MINI_TYPES = ("pkg/types.py", """
@@ -622,6 +1225,127 @@ class TestCLI:
         assert proc.returncode == 2
         assert "truncate" in proc.stderr
         assert not (tmp_path / "b.json").exists()
+
+    def test_rules_family_prefix_selection(self, tmp_path):
+        """--rules THR,BUF expands to the full families (the ISSUE's
+        spelling) and composes with the stale-entry scoping guard: a
+        baselined TPU entry is out of scope for a THR,BUF scan, so it
+        is neither new nor stale."""
+        (tmp_path / "mod.py").write_text(textwrap.dedent("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(c, x):
+                return c + x
+
+            def run(c, xs):
+                out = step(c, xs)
+                return c.sum()
+        """))
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps({"version": 1, "findings": [
+            {"fingerprint": "feedfeedfeedfeed", "rule": "TPU003",
+             "path": "other.py", "line": 1, "col": 0,
+             "message": "unrelated grandfathered debt", "snippet": ""}]}))
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tmoglint", "mod.py",
+             "--root", str(tmp_path), "--baseline", str(base),
+             "--rules", "THR,BUF", "--format", "json"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        # the family expanded to all seven new rules
+        assert report["rules"] == ["BUF001", "BUF002", "BUF003",
+                                   "THR001", "THR002", "THR003",
+                                   "THR004"]
+        assert report["counts_by_rule"] == {"BUF001": 1}
+        # the TPU003 baseline entry is OUT of scope: not stale
+        assert report["stale_baseline_entries"] == []
+        assert report["new"][0]["rule"] == "BUF001"
+
+    def test_unknown_rule_family_is_usage_error(self, tmp_path):
+        (tmp_path / "clean.py").write_text("x = 1\n")
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tmoglint", "clean.py",
+             "--root", str(tmp_path), "--no-baseline",
+             "--rules", "ZZZ9"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+        assert proc.returncode == 2
+        assert "unknown rule" in proc.stderr
+
+    def test_no_files_is_usage_error(self, tmp_path):
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tmoglint", "missing_dir",
+             "--root", str(tmp_path), "--no-baseline"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+        assert proc.returncode == 2
+        assert "no .py files" in proc.stderr
+
+    def test_stats_line_and_json_stats(self, tmp_path):
+        (tmp_path / "clean.py").write_text("x = 1\n")
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.tmoglint", "clean.py",
+             "--root", str(tmp_path), "--no-baseline", "--stats"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "tmoglint --stats:" in proc.stdout
+        proc2 = subprocess.run(
+            [sys.executable, "-m", "tools.tmoglint", "clean.py",
+             "--root", str(tmp_path), "--no-baseline",
+             "--format", "json"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+        report = json.loads(proc2.stdout)
+        stats = report["stats"]
+        for key in ("files", "jobs", "parse_s", "file_rules_s",
+                    "project_rules_s", "total_s"):
+            assert key in stats, stats
+        assert stats["files"] == 1
+
+    def test_parallel_jobs_match_serial(self, tmp_path):
+        """--jobs 2 and --jobs 1 must produce identical findings (the
+        pool only changes WHO runs the per-file rules)."""
+        (tmp_path / "ops").mkdir()
+        (tmp_path / "ops" / "kern.py").write_text(textwrap.dedent("""
+            import numpy as np
+
+            def acc(n):
+                return np.zeros(n, np.float64)
+        """))
+        (tmp_path / "host.py").write_text(textwrap.dedent("""
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def step(c, x):
+                return c + x
+
+            def run(c, xs):
+                out = step(c, xs)
+                return c.sum()
+        """))
+        (tmp_path / "a.py").write_text("x = 1\n")
+        (tmp_path / "b.py").write_text("y = 2\n")
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        outs = []
+        for jobs in ("1", "2"):
+            proc = subprocess.run(
+                [sys.executable, "-m", "tools.tmoglint", ".",
+                 "--root", str(tmp_path), "--no-baseline",
+                 "--jobs", jobs, "--format", "json"],
+                cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+            assert proc.returncode == 1, proc.stdout + proc.stderr
+            report = json.loads(proc.stdout)
+            outs.append([(f["rule"], f["path"], f["fingerprint"])
+                         for f in report["new"]])
+        assert outs[0] == outs[1]
+        assert {r for r, _, _ in outs[0]} == {"TPU003", "BUF001"}
 
     def test_stale_baseline_fails(self, tmp_path):
         """Fixing debt without regenerating the baseline must go red."""

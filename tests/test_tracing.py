@@ -681,6 +681,37 @@ class TestRunnerIntegration:
         assert proc.returncode == 1
         assert "invalid JSON" in proc.stdout
 
+    def test_exit_codes_match_lint_table(self, tmp_path):
+        """The project-wide exit-code table (docs/static_analysis.md):
+        0 clean, 1 validation problems, 2 usage error — trace-report
+        and the tmoglint CLI must agree so CI failures are attributable
+        at a glance. An empty/non-run directory is a USAGE error (2),
+        not a passing check and not a schema failure."""
+        empty = tmp_path / "not_a_run_dir"
+        empty.mkdir()
+        text, rc = T.trace_report_rc(str(empty), check=True)
+        assert rc == 2 and "nothing to read" in text
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "transmogrifai_tpu", "trace-report",
+             str(empty), "--check"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True)
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        # a VALID run dir: rc 0; a corrupted one: rc 1
+        good = tmp_path / "run"
+        good.mkdir()
+        c = MetricsCollector()
+        c.enable("rc-test")
+        with c.trace_span("s", kind="stage"):
+            pass
+        c.save_chrome_trace(str(good / "run_trace.json"))
+        c.disable()
+        _text, rc = T.trace_report_rc(str(good), check=True)
+        assert rc == 0
+        (good / "events.jsonl").write_text("{broken\n")
+        _text, rc = T.trace_report_rc(str(good), check=True)
+        assert rc == 1
+
 
 # -- device memory watermark -------------------------------------------------
 

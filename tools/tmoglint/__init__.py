@@ -16,8 +16,25 @@ performance. tmoglint restores both as lint-time checks over stdlib `ast`:
 * DAG001 stage-contract          — every PipelineStage declares real
                                     FeatureType input/output contracts and the
                                     DSL wiring matches declared arity
+* THR001 shared-state race       — attr written on one thread root, read on
+                                    another, no common lock on both paths
+* THR002 blocking-under-lock     — device fetch / queue wait / file I/O /
+                                    sleep / join inside a `with lock:` region
+* THR003 lock-order inversion    — cycle in the acquires-while-holding graph
+* THR004 condition misuse        — Condition.wait/notify without holding it;
+                                    `with event:`
+* BUF001 use-after-donate        — a donated buffer read after the jitted
+                                    call without rebinding
+* BUF002 donation-coverage       — loop-carried accumulator through a jitted
+                                    step that does not donate it
+* BUF003 donated-into-telemetry  — donated buffer captured into a
+                                    span/event/log after donation
 
-Run: ``python -m tools.tmoglint transmogrifai_tpu/ tests/``
+Run: ``python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/``
+(the CI file set — bench.py and tools/ are in scope since TPU005).
+``--rules THR,BUF`` selects families; ``--jobs N`` scans per-file rules in
+worker processes; ``--stats`` prints scan timings.
+
 Suppress one finding: ``# tmoglint: disable=TPU003  <reason>`` on (or on the
 line above) the flagged line. Grandfathered findings live in
 ``tools/tmoglint/baseline.json`` (regenerate with ``--write-baseline``); the

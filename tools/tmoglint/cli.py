@@ -2,7 +2,16 @@
 
 ``python -m tools.tmoglint transmogrifai_tpu/ tests/`` — exit 0 iff the scan
 matches the committed baseline exactly (no new findings, no stale entries).
-``--format json`` emits a machine-readable report for bench tooling.
+``--format json`` emits a machine-readable report for bench/CI tooling.
+
+Exit codes follow the project-wide table (docs/static_analysis.md — the
+same meanings ``trace-report --check`` and ``monitor --fail-on-drift``
+use): 0 clean, 1 findings/validation problems, 2 usage error.
+
+``--rules`` accepts exact rule ids AND family prefixes: ``--rules
+THR,BUF`` runs THR001-THR004 + BUF001-BUF003. ``--jobs N`` scans files
+across N worker processes (per-file rules; the cross-file rules run in
+the parent over one shared parse); ``--stats`` prints a timing line.
 """
 from __future__ import annotations
 
@@ -10,20 +19,41 @@ import argparse
 import json
 import os
 import sys
+import time
 from collections import Counter
 from typing import List, Optional, Sequence
 
 from .baseline import (
     DEFAULT_BASELINE, diff_baseline, load_baseline, write_baseline,
 )
-from .core import RULE_DOCS, run_rules, scan_paths
+from .core import (
+    RULE_DOCS, _number_occurrences, expand_rule_selection, iter_py_files,
+    run_file_rules, run_project_rules, scan_paths,
+    start_parallel_file_findings,
+)
+
+#: unified exit codes (docs/static_analysis.md "Exit codes")
+EXIT_OK = 0
+EXIT_FINDINGS = 1
+EXIT_USAGE = 2
+
+
+def _default_jobs() -> int:
+    try:
+        n = os.cpu_count() or 1
+    except Exception:  # pragma: no cover - exotic platforms
+        n = 1
+    # the parent runs parse + cross-file rules CONCURRENTLY with the
+    # pool, so workers get every core (the parent's work is the overlap)
+    return max(1, min(8, n))
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.tmoglint",
         description="AST-level JAX/TPU discipline linter + static "
-                    "stage-contract checker (see docs/static_analysis.md)")
+                    "stage-contract, concurrency and buffer-lifetime "
+                    "checker (see docs/static_analysis.md)")
     p.add_argument("paths", nargs="*",
                    default=["transmogrifai_tpu", "tests"],
                    help="files/dirs to lint (default: transmogrifai_tpu tests)")
@@ -38,7 +68,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="regenerate the baseline from this scan and exit 0")
     p.add_argument("--format", choices=("text", "json"), default="text")
     p.add_argument("--rules", default=None,
-                   help="comma-separated rule ids to run (default: all)")
+                   help="comma-separated rule ids or family prefixes "
+                        "(e.g. 'THR,BUF' or 'TPU001'); default: all")
+    p.add_argument("--jobs", type=int, default=None, metavar="N",
+                   help="worker processes for the per-file rules "
+                        "(default: min(8, cpus) — the parent overlaps "
+                        "the cross-file rules with the pool; 1 = serial)")
+    p.add_argument("--stats", action="store_true",
+                   help="print a scan timing line (files, parse s, "
+                        "file-rule s, project-rule s, total s)")
     p.add_argument("--list-rules", action="store_true")
     return p
 
@@ -46,33 +84,79 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        from . import rules_tpu, rules_dag  # noqa: F401  (registers rules)
+        from .core import _register_rules
+        _register_rules()
         for rid in sorted(RULE_DOCS):
             print(f"{rid}: {RULE_DOCS[rid]}")
-        return 0
+        return EXIT_OK
 
-    only = [r.strip() for r in args.rules.split(",")] if args.rules else None
+    only = [r.strip() for r in args.rules.split(",") if r.strip()] \
+        if args.rules else None
+    try:
+        selected = expand_rule_selection(only)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_USAGE
     if args.write_baseline and only:
         print("error: --write-baseline with --rules would truncate the "
               "baseline to the selected rules' findings; regenerate from a "
               "full scan instead", file=sys.stderr)
-        return 2
+        return EXIT_USAGE
+
+    t_start = time.perf_counter()
+    files = list(iter_py_files(args.paths, args.root))
+    if not files:
+        print(f"error: no .py files under {list(args.paths)} "
+              f"(root {args.root})", file=sys.stderr)
+        return EXIT_USAGE
+    jobs = args.jobs if args.jobs is not None else _default_jobs()
+
+    # kick the worker pool off FIRST: the per-file rules chew in worker
+    # processes while this parent parses the shared ctxs and runs the
+    # cross-file rules — the two phases overlap instead of stacking
+    pool_handle = start_parallel_file_findings(files, args.root, only,
+                                               jobs)
+
+    t0 = time.perf_counter()
     ctxs, errors = scan_paths(args.paths, args.root)
-    findings = run_rules(ctxs, only=only)
-    findings = errors + findings
+    parse_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    project_findings = run_project_rules(ctxs, only)
+    project_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    file_findings = pool_handle.result() if pool_handle is not None \
+        else None
+    used_jobs = jobs
+    if file_findings is None:
+        used_jobs = 1
+        file_findings = run_file_rules(ctxs, only)
+    file_s = time.perf_counter() - t0
+
+    findings = errors + _number_occurrences(
+        file_findings + project_findings)
+    total_s = time.perf_counter() - t_start
+    stats = {"files": len(ctxs), "jobs": used_jobs,
+             "parse_s": round(parse_s, 3),
+             "file_rules_s": round(file_s, 3),
+             "project_rules_s": round(project_s, 3),
+             "total_s": round(total_s, 3)}
 
     if args.write_baseline:
         write_baseline(args.baseline, findings)
         print(f"wrote {len(findings)} finding(s) to {args.baseline}")
-        return 0
+        return EXIT_OK
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
-    if only:
-        # a rule-filtered scan can only judge entries of the selected rules;
-        # unselected rules' grandfathered entries are neither new nor stale
-        selected = {r.upper() for r in only} | {"SYNTAX"}
+    if selected is not None:
+        # a rule-filtered scan can only judge entries of the selected
+        # rules; unselected rules' grandfathered entries are neither new
+        # nor stale (family prefixes expand BEFORE the scoping guard, so
+        # `--rules THR` scopes exactly to THR001..THR004 entries)
+        scoped = selected | {"SYNTAX"}
         baseline = {fp: e for fp, e in baseline.items()
-                    if str(e.get("rule", "")).upper() in selected}
+                    if str(e.get("rule", "")).upper() in scoped}
     new, stale = diff_baseline(findings, baseline)
     counts = Counter(f.rule for f in findings)
 
@@ -80,12 +164,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         report = {
             "tool": "tmoglint",
             "paths": list(args.paths),
+            "rules": sorted(selected) if selected is not None else "all",
             "total_findings": len(findings),
             "counts_by_rule": dict(sorted(counts.items())),
             "baselined": len(findings) - len(new),
             "new": [f.to_json() for f in new],
             "stale_baseline_entries": stale,
             "ok": not new and not stale,
+            "stats": stats,
         }
         print(json.dumps(report, indent=1))
     else:
@@ -102,7 +188,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    f"({len(findings) - len(new)} baselined, {len(new)} new, "
                    f"{len(stale)} stale) over {len(ctxs)} file(s)")
         print(summary)
-    return 1 if (new or stale) else 0
+        if args.stats:
+            print(f"tmoglint --stats: {stats['files']} files, "
+                  f"jobs={stats['jobs']}, parse {stats['parse_s']}s, "
+                  f"file-rules {stats['file_rules_s']}s, "
+                  f"project-rules {stats['project_rules_s']}s, "
+                  f"total {stats['total_s']}s")
+    return EXIT_FINDINGS if (new or stale) else EXIT_OK
 
 
 if __name__ == "__main__":

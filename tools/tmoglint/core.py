@@ -11,7 +11,8 @@ import dataclasses
 import hashlib
 import os
 import re
-from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import (Callable, Dict, Iterable, List, Optional, Sequence,
+                    Set, Tuple)
 
 # rule list stops at the first token that is not `RULE[,RULE...]` so a
 # justification can follow on the same line:
@@ -187,22 +188,131 @@ def _number_occurrences(findings: List[Finding]) -> List[Finding]:
     return out
 
 
-def run_rules(ctxs: Sequence[LintContext],
-              only: Optional[Sequence[str]] = None) -> List[Finding]:
+def _register_rules() -> None:
     # import registers the rules
-    from . import rules_tpu, rules_dag  # noqa: F401
-    selected = {r.upper() for r in only} if only else None
+    from . import rules_tpu, rules_dag, rules_thr, rules_buf  # noqa: F401
+
+
+def expand_rule_selection(only: Optional[Sequence[str]]
+                          ) -> Optional[Set[str]]:
+    """Resolve ``--rules`` tokens to concrete rule ids. A token is either
+    an exact rule id (``THR001``) or a FAMILY prefix (``THR``, ``BUF``,
+    ``TPU``) selecting every registered rule it prefixes. Unknown tokens
+    raise ValueError (a typo'd --rules must not silently select
+    nothing)."""
+    if not only:
+        return None
+    _register_rules()
+    known = set(FILE_RULES) | set(PROJECT_RULES)
+    out: Set[str] = set()
+    for tok in only:
+        t = tok.strip().upper()
+        if not t:
+            continue
+        if t in known:
+            out.add(t)
+            continue
+        fam = {r for r in known if r.startswith(t)}
+        if not fam:
+            raise ValueError(
+                f"unknown rule or family '{tok}' (known: "
+                f"{', '.join(sorted(known))})")
+        out |= fam
+    return out
+
+
+def run_file_rules(ctxs: Sequence[LintContext],
+                   only: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Per-file rules only (the parallelizable part of a scan). Each ctx
+    caches its parse + module graph, so every rule family shares one
+    analysis of the file."""
+    _register_rules()
+    selected = expand_rule_selection(only)
     findings: List[Finding] = []
     for rule_id, fn in FILE_RULES.items():
-        if selected and rule_id not in selected:
+        if selected is not None and rule_id not in selected:
             continue
         for ctx in ctxs:
             findings.extend(fn(ctx))
+    return findings
+
+
+def run_project_rules(ctxs: Sequence[LintContext],
+                      only: Optional[Sequence[str]] = None
+                      ) -> List[Finding]:
+    """Cross-file rules (DAG001 stage contracts, the THR concurrency
+    family): they need the whole project in one view."""
+    _register_rules()
+    selected = expand_rule_selection(only)
+    findings: List[Finding] = []
     for rule_id, fn in PROJECT_RULES.items():
-        if selected and rule_id not in selected:
+        if selected is not None and rule_id not in selected:
             continue
         findings.extend(fn(ctxs))
+    return findings
+
+
+def run_rules(ctxs: Sequence[LintContext],
+              only: Optional[Sequence[str]] = None) -> List[Finding]:
+    findings = run_file_rules(ctxs, only) + run_project_rules(ctxs, only)
     return _number_occurrences(findings)
+
+
+# -- parallel scan ------------------------------------------------------------
+
+def _pool_worker(args: Tuple[Sequence[str], str, Optional[Sequence[str]]]
+                 ) -> List[Finding]:
+    """Worker body: parse this chunk's files ONCE, run every selected
+    per-file rule over them. Findings are plain frozen dataclasses —
+    they pickle straight back. Unparsable files are skipped here (the
+    parent's own parse pass reports them as SYNTAX findings exactly
+    once)."""
+    paths, root, only = args
+    ctxs, _errors = scan_paths(paths, root)
+    return run_file_rules(ctxs, only)
+
+
+class _PoolHandle:
+    """In-flight parallel file-rule scan; .result() joins it (None on
+    any pool failure — the caller falls back to the serial path)."""
+
+    def __init__(self, pool, futures):
+        self._pool = pool
+        self._futures = futures
+
+    def result(self) -> Optional[List[Finding]]:
+        try:
+            out: List[Finding] = []
+            for fut in self._futures:
+                out.extend(fut.result())
+            return out
+        except Exception:
+            return None
+        finally:
+            self._pool.shutdown(wait=False)
+
+
+def start_parallel_file_findings(files: Sequence[str], root: str,
+                                 only: Optional[Sequence[str]],
+                                 jobs: int) -> Optional[_PoolHandle]:
+    """Kick off the per-file rules across `jobs` worker processes and
+    return immediately — the caller overlaps its own parse + cross-file
+    rules with the pool and joins via .result(). Files are interleaved
+    across chunks so one directory of heavyweight modules does not
+    serialize on a single worker. Returns None (caller goes serial)
+    when a pool is not worth it or cannot start."""
+    if jobs < 2 or len(files) < 4:
+        return None
+    try:
+        import concurrent.futures as cf
+        chunks = [list(files[i::jobs]) for i in range(jobs)]
+        chunks = [c for c in chunks if c]
+        pool = cf.ProcessPoolExecutor(max_workers=len(chunks))
+        futures = [pool.submit(_pool_worker, (c, root, only))
+                   for c in chunks]
+        return _PoolHandle(pool, futures)
+    except Exception:
+        return None
 
 
 # -- small AST helpers shared by rule modules --------------------------------

@@ -1,0 +1,404 @@
+"""Device-buffer-lifetime rules BUF001-BUF003 (donation discipline).
+
+The streaming data plane (PR 6) put ``donate_argnums`` carries under
+every streamed hot path: the jitted step consumes its carry buffer and
+aliases it into the output, so a whole pass updates one device-resident
+accumulator in place. Donation is a *host-side* contract the runtime
+only enforces with a late, confusing error: reading a donated-away
+buffer raises ``RuntimeError: Array has been deleted`` at some arbitrary
+later line (or silently returns garbage through a stale numpy view).
+And the inverse failure is silent: a loop-carried accumulator that is
+NOT donated allocates a fresh buffer per tile, doubling HBM pressure on
+exactly the paths sized around "two tiles in flight + the carry"
+(docs/performance.md) — the regression class PR 6's review caught by
+hand in the sharded stats step. These rules make both directions
+lint-time errors:
+
+* **BUF001 use-after-donate** — a Python name (or ``self.attr``) passed
+  in a donated position of a jitted call and then *read* after the call
+  without rebinding. Rebinding at the call statement itself
+  (``carry = step(carry, x)``) is the sanctioned idiom and never flags;
+  metadata reads (``.shape``/``.dtype``/...) stay valid on a deleted
+  array and never flag.
+* **BUF002 donation-coverage** — a loop-carried accumulator threaded
+  through a jitted step that does NOT donate it:
+  ``acc = step(acc, t)`` inside a ``for``/``while``, or
+  ``self.state = step(self.state, ...)`` anywhere (an attribute is
+  loop-carried across calls by construction), where ``step``'s jit spec
+  lacks ``donate_argnums`` covering that parameter.
+* **BUF003 donated-buffer aliasing into spans/events** — the donated
+  name captured into telemetry after the donating call
+  (``collector.event``/``trace.add_complete``/``collector.kernel``/
+  logging/print): the attrs serialize on emit, so the first window that
+  actually drifts is the one that crashes its own alert.
+
+All three ride jitgraph.py: donation specs are parsed off the same
+decorators TPU002 reads, and the rules skip *traced* functions (inside
+an XLA program donation is the compiler's business, not the host's).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    Finding, LintContext, call_kwarg, const_int_tuple, const_str_tuple,
+    dotted_name, file_rule,
+)
+from .jitgraph import module_graph
+
+_STATIC_ACCESSORS = {"shape", "ndim", "dtype", "size", "itemsize",
+                     "nbytes", "sharding"}
+# telemetry/log sinks whose argument capture classifies a read as BUF003
+_TELEMETRY_TAILS = {"event", "add_complete", "kernel", "latency",
+                    "stats_pass", "debug", "info", "warning", "error",
+                    "exception", "log"}
+_TELEMETRY_ROOTS = {"collector", "logging", "log", "_log", "logger",
+                    "print"}
+
+
+class _DonateSpec:
+    """Donated positions/param-names of one jitted callable."""
+
+    def __init__(self, params: List[str], positions: Set[int],
+                 names: Set[str]):
+        self.params = params
+        self.positions = set(positions)
+        self.names = set(names)
+        for i in positions:
+            if 0 <= i < len(params):
+                self.names.add(params[i])
+        for n in list(self.names):
+            if n in params:
+                self.positions.add(params.index(n))
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.positions or self.names)
+
+
+def _jit_call_spec(call: ast.Call) -> Optional[Tuple[Set[int], Set[str]]]:
+    """(donate positions, donate names) when `call` is a jit(...) call,
+    else None. Empty sets = jitted WITHOUT donation."""
+    fn = dotted_name(call.func)
+    if not fn:
+        return None
+    last = fn.split(".")[-1]
+    inner = None
+    if last == "partial" and call.args:
+        inner = dotted_name(call.args[0])
+        if not (inner and inner.split(".")[-1] in {"jit", "pjit"}):
+            return None
+    elif last not in {"jit", "pjit"}:
+        return None
+    pos: Set[int] = set()
+    names: Set[str] = set()
+    dn = call_kwarg(call, "donate_argnums")
+    if dn is not None:
+        vals = const_int_tuple(dn)
+        if vals:
+            pos.update(vals)
+    dm = call_kwarg(call, "donate_argnames")
+    if dm is not None:
+        vals = const_str_tuple(dm)
+        if vals:
+            names.update(vals)
+    return pos, names
+
+
+def _donation_table(ctx: LintContext) -> Dict[str, _DonateSpec]:
+    """name -> _DonateSpec for every jitted callable visible by name in
+    this module: decorated defs and `g = jax.jit(f, ...)` assignments
+    (cached on the ctx — BUF001/2/3 share one walk)."""
+    cached = getattr(ctx, "_donation_table", None)
+    if cached is not None:
+        return cached
+    table: Dict[str, _DonateSpec] = {}
+    defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+            for dec in node.decorator_list:
+                spec = None
+                if isinstance(dec, ast.Call):
+                    spec = _jit_call_spec(dec)
+                else:
+                    d = dotted_name(dec)
+                    if d and d.split(".")[-1] in {"jit", "pjit"}:
+                        spec = (set(), set())
+                if spec is not None:
+                    params = [a.arg for a in node.args.args]
+                    table[node.name] = _DonateSpec(params, *spec)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            spec = _jit_call_spec(node.value)
+            if spec is None or not node.value.args:
+                continue
+            inner = dotted_name(node.value.args[0])
+            params: List[str] = []
+            if inner and inner in defs:
+                params = [a.arg for a in defs[inner].args.args]
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    table[t.id] = _DonateSpec(params, *spec)
+    ctx._donation_table = table
+    return table
+
+
+def _expr_key(expr: ast.expr) -> Optional[str]:
+    """Stable key for a donatable expr: bare name or self.attr."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return f"self.{expr.attr}"
+    return None
+
+
+def _donated_args(call: ast.Call, spec: _DonateSpec) -> List[ast.expr]:
+    out: List[ast.expr] = []
+    for i in sorted(spec.positions):
+        if i < len(call.args):
+            out.append(call.args[i])
+    for kw in call.keywords:
+        if kw.arg in spec.names:
+            out.append(kw.value)
+    return out
+
+
+def _order(node: ast.AST) -> Tuple[int, int]:
+    return (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+
+
+class _FnScan:
+    """One ordered pass over a host function's own nodes: donating
+    calls, loads, stores, telemetry capture, static-accessor reads."""
+
+    def __init__(self, ctx: LintContext, fi, graph,
+                 table: Dict[str, _DonateSpec]):
+        self.ctx = ctx
+        self.fi = fi
+        nodes = sorted(graph._own_nodes(fi), key=_order)
+        self.nodes = nodes
+        self.static_ok: Set[int] = set()
+        self.telemetry: Set[int] = set()
+        for n in nodes:
+            if isinstance(n, ast.Attribute) and \
+                    n.attr in _STATIC_ACCESSORS:
+                for sub in ast.walk(n.value):
+                    self.static_ok.add(id(sub))
+            elif isinstance(n, ast.Call) and _is_telemetry(n):
+                for sub in ast.walk(n):
+                    if sub is not n:
+                        self.telemetry.add(id(sub))
+        # assignment value-subtree -> its statement, for rebind-at-call
+        self.assign_of: Dict[int, ast.Assign] = {}
+        for n in nodes:
+            if isinstance(n, ast.Assign):
+                for sub in ast.walk(n.value):
+                    self.assign_of[id(sub)] = n
+
+    def stores_at(self, node: ast.AST) -> Set[str]:
+        """Keys rebound by an Assign/AugAssign/For-target node."""
+        out: Set[str] = set()
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.For):
+            targets = [node.target]
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for el in elts:
+                k = _expr_key(el)
+                if k:
+                    out.add(k)
+        return out
+
+
+def _is_telemetry(call: ast.Call) -> bool:
+    d = dotted_name(call.func)
+    if not d:
+        return False
+    parts = d.split(".")
+    if parts[0] == "print":
+        return True
+    return (parts[-1] in _TELEMETRY_TAILS
+            and (parts[0] in _TELEMETRY_ROOTS
+                 or "collector" in parts or "trace" in parts
+                 or parts[0].endswith("log")))
+
+
+@file_rule("BUF001", "buffer read after being donated to a jitted call "
+                     "(use-after-donate)")
+def check_buf001(ctx: LintContext) -> List[Finding]:
+    return _check_use_after_donate(ctx, want_telemetry=False)
+
+
+@file_rule("BUF003", "donated buffer captured into a span/event/log "
+                     "after donation")
+def check_buf003(ctx: LintContext) -> List[Finding]:
+    return _check_use_after_donate(ctx, want_telemetry=True)
+
+
+def _check_use_after_donate(ctx: LintContext,
+                            want_telemetry: bool) -> List[Finding]:
+    table = _donation_table(ctx)
+    if not any(s.donates for s in table.values()):
+        return []
+    graph = module_graph(ctx)
+    findings: List[Finding] = []
+    for fi in graph.all_funcs:
+        if fi.traced or isinstance(fi.node, ast.Lambda):
+            continue
+        scan = _FnScan(ctx, fi, graph, table)
+        # loops + the keys each loop body rebinds, for the
+        # donated-in-a-loop-without-rebinding case (iteration 2 passes
+        # an already-deleted buffer back in)
+        loops: List[Tuple[Set[int], Set[str]]] = []
+        for n in scan.nodes:
+            if isinstance(n, (ast.For, ast.While)):
+                ids = {id(sub) for sub in ast.walk(n) if sub is not n}
+                stores: Set[str] = set()
+                for sub in ast.walk(n):
+                    stores |= scan.stores_at(sub)
+                loops.append((ids, stores))
+        # pending[key] = (donating call node, callee name)
+        pending: Dict[str, Tuple[ast.Call, str]] = {}
+        flagged: Set[str] = set()
+        self_loads: Set[int] = set()
+        for node in scan.nodes:
+            # 1) reads of pending keys (loads fire before the store of
+            # the same statement re-binds, matching execution order)
+            key = _expr_key(node) if isinstance(
+                node, (ast.Name, ast.Attribute)) else None
+            if key in pending and key not in flagged and \
+                    isinstance(getattr(node, "ctx", None), ast.Load) and \
+                    id(node) not in scan.static_ok and \
+                    id(node) not in self_loads:
+                in_tel = id(node) in scan.telemetry
+                if in_tel == want_telemetry:
+                    call, callee = pending[key]
+                    rule = "BUF003" if want_telemetry else "BUF001"
+                    if want_telemetry:
+                        msg = (f"`{key}` was donated to `{callee}()` at "
+                               f"line {call.lineno} and is captured "
+                               f"into a span/event/log here — the attrs "
+                               f"serialize on emit and a donated buffer "
+                               f"read raises at exactly that moment; "
+                               f"record it before the donating call, or "
+                               f"log the rebound result")
+                    else:
+                        msg = (f"`{key}` was donated to `{callee}()` at "
+                               f"line {call.lineno} and read here "
+                               f"without rebinding — the buffer is "
+                               f"deleted (RuntimeError under jax, stale "
+                               f"garbage through numpy views); rebind "
+                               f"`{key} = {callee}(...)` or read before "
+                               f"donating")
+                    f = ctx.finding(rule, node, msg)
+                    if f is not None:
+                        findings.append(f)
+                    flagged.add(key)
+            # 2) donating calls open a pending window — unless the call
+            # sits in an Assign whose target rebinds the key
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, (ast.Name, ast.Attribute)):
+                callee = None
+                if isinstance(node.func, ast.Name):
+                    callee = node.func.id
+                spec = table.get(callee) if callee else None
+                if spec is not None and spec.donates:
+                    rebinds: Set[str] = set()
+                    owner = scan.assign_of.get(id(node))
+                    if owner is not None:
+                        rebinds = scan.stores_at(owner)
+                    # loads INSIDE the donating call (its own argument
+                    # expressions) precede the donation — never "after"
+                    for sub in ast.walk(node):
+                        self_loads.add(id(sub))
+                    for expr in _donated_args(node, spec):
+                        k = _expr_key(expr)
+                        if not k or k in rebinds:
+                            continue
+                        loop_hit = next(
+                            ((ids, stores) for ids, stores in loops
+                             if id(node) in ids), None)
+                        pending[k] = (node, callee)
+                        flagged.discard(k)
+                        if not want_telemetry and loop_hit is not None \
+                                and k not in loop_hit[1]:
+                            f = ctx.finding(
+                                "BUF001", node,
+                                f"`{k}` is donated to `{callee}()` "
+                                f"inside a loop that never rebinds it — "
+                                f"iteration 2 passes the already-"
+                                f"deleted buffer back in; rebind "
+                                f"`{k} = {callee}(...)`")
+                            if f is not None:
+                                findings.append(f)
+                            flagged.add(k)
+            # 3) stores clear the pending window
+            stores = scan.stores_at(node)
+            for k in stores:
+                pending.pop(k, None)
+                flagged.discard(k)
+    return findings
+
+
+@file_rule("BUF002", "loop-carried accumulator through a jitted step "
+                     "that does not donate it")
+def check_buf002(ctx: LintContext) -> List[Finding]:
+    table = _donation_table(ctx)
+    if not table:
+        return []
+    graph = module_graph(ctx)
+    findings: List[Finding] = []
+    for fi in graph.all_funcs:
+        if fi.traced or isinstance(fi.node, ast.Lambda):
+            continue
+        loop_nodes: Set[int] = set()
+        for n in graph._own_nodes(fi):
+            if isinstance(n, (ast.For, ast.While)):
+                for sub in ast.walk(n):
+                    if sub is not n:
+                        loop_nodes.add(id(sub))
+        for node in graph._own_nodes(fi):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            if not isinstance(call.func, ast.Name) or not call.args:
+                continue
+            spec = table.get(call.func.id)
+            if spec is None:
+                continue
+            tkeys = {k for t in node.targets
+                     for k in ([_expr_key(t)] if _expr_key(t) else
+                               [_expr_key(e) for e in getattr(
+                                   t, "elts", [])])}
+            tkeys.discard(None)
+            k0 = _expr_key(call.args[0])
+            if k0 is None or k0 not in tkeys:
+                continue  # not a carry rebind through the step
+            carried = (id(node) in loop_nodes
+                       or k0.startswith("self."))
+            if not carried:
+                continue
+            if 0 in spec.positions:
+                continue  # carry IS donated — the contract holds
+            where = ("in a loop" if id(node) in loop_nodes
+                     else "across calls (attribute state)")
+            f = ctx.finding(
+                "BUF002", node,
+                f"`{k0}` is loop-carried {where} through jitted "
+                f"`{call.func.id}` which does not donate its carry — "
+                f"each step allocates a fresh accumulator instead of "
+                f"updating in place (docs/performance.md: the carry is "
+                f"donated, tiles are not); add "
+                f"donate_argnums=(0,) to `{call.func.id}`")
+            if f is not None:
+                findings.append(f)
+    return findings

@@ -1,0 +1,443 @@
+"""Concurrency rules THR001-THR004 over the threadflow dataflow layer.
+
+The threaded serving + streaming stack (serve/, monitor/,
+parallel/tileplane.py, the tracing/metrics registries) stakes its
+correctness on host-side invariants that no test can exhaustively pin:
+which attributes are guarded by which lock, which thread a blocking call
+may run on, and in which order locks nest. These rules enforce them
+statically, in CI, the way TPU001-005 enforce recompile discipline.
+
+* **THR001 shared-state race** — an attribute (or module global) written
+  on one thread root and read/written on another with no common lock on
+  both paths. Scoped to *concurrency-aware* classes — classes that own a
+  lock, classes with thread-reachable methods, and classes defined in
+  modules that spawn threads — so a single-threaded fit pipeline's
+  mutable state never fires.
+* **THR002 blocking-under-lock** — a device fetch (`block_until_ready`,
+  `.item()`, `np.asarray` of device-resident state, the repo's blocking
+  score/sweep drivers), a blocking queue op, thread join, `time.sleep`
+  or file I/O inside a `with lock:` region. Async *dispatch* under a
+  lock is fine (the monitor's sketch step is the design); *waiting*
+  under one serializes every thread behind the device.
+* **THR003 lock-order inversion** — a cycle in the acquires-while-
+  holding graph (lexical `with` nesting plus held-at-call-site x the
+  callee's transitive acquisitions, cross-module).
+* **THR004 condition/event misuse** — `Condition.wait/notify` without
+  holding that condition (RuntimeError at runtime — or silence, when a
+  stale reference is swapped), `Condition.wait` while holding an
+  unrelated lock (the wait releases only the condition; the other lock
+  blocks every peer for the whole sleep), and `with event:` (an Event is
+  not a context manager).
+
+Rationale and the lock-ownership tables these rules check against live
+in docs/serving.md ("Lock ownership & thread roots") and
+docs/static_analysis.md.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, LintContext, dotted_name, project_rule
+from .threadflow import (
+    Access, FileThreads, FuncNode, ProjectThreads, project_threads,
+)
+
+# -- shared scoping ----------------------------------------------------------
+
+
+def _ctx_by_path(ctxs: Sequence[LintContext]) -> Dict[str, LintContext]:
+    return {c.path: c for c in ctxs}
+
+
+def _concurrency_aware(pt: ProjectThreads) -> Tuple[Set[str], Set[str]]:
+    """(classes, module paths) in scope for THR001: lock owners, classes
+    with thread-root-reachable methods, and modules that spawn."""
+    classes: Set[str] = set(pt.lock_owner_classes)
+    paths: Set[str] = set()
+    for ft in pt.files:
+        if ft.spawns or ft.callback_refs:
+            paths.add(ft.path)
+        for fn in ft.funcs:
+            if fn.roots and fn.cls:
+                classes.add(fn.cls)
+    # every class defined in a spawning module is in scope (TilePlaneStats
+    # owns no lock but is written by the producer thread)
+    for ft in pt.files:
+        if ft.path in paths:
+            classes |= set(ft.class_bases)
+    return classes, paths
+
+
+def _roots_desc(roots: Set[str]) -> str:
+    return ",".join(sorted(roots)) if roots else "main"
+
+
+# -- THR001: shared-mutable-state races --------------------------------------
+
+@project_rule("THR001", "shared state written on one thread root and read "
+                        "on another with no common lock")
+def check_thr001(ctxs: Sequence[LintContext]) -> List[Finding]:
+    pt = project_threads(ctxs)
+    by_path = _ctx_by_path(ctxs)
+    classes, paths = _concurrency_aware(pt)
+    multi = pt.multi_roots
+
+    # group accesses per attr id
+    table: Dict[Tuple[str, str], List[Access]] = {}
+    for ft in pt.files:
+        for fn in ft.funcs:
+            for acc in fn.accesses:
+                owner = acc.attr_id[0]
+                if owner.startswith("<module:"):
+                    if ft.path not in paths:
+                        continue
+                elif owner not in classes:
+                    continue
+                table.setdefault(acc.attr_id, []).append(acc)
+
+    findings: List[Finding] = []
+    for attr_id, accs in sorted(table.items()):
+        writes = [a for a in accs if a.write and not a.in_init]
+        if not writes:
+            continue  # init-only attrs are immutable config
+        reported = False
+        for w in writes:
+            wroots = w.func.roots
+            for a in accs:
+                if a is w or a.in_init:
+                    continue
+                aroots = a.func.roots
+                # concurrent iff the two sites can run on two distinct
+                # threads: different roots, a multi-instance root on
+                # either side, or one side on a spawned root while the
+                # other is plain host code ("main" runs concurrently
+                # with every thread it spawned)
+                both = wroots | aroots
+                concurrent = (
+                    bool(both & multi)
+                    or len(both) > 1
+                    or (bool(wroots) != bool(aroots)))
+                if not concurrent:
+                    continue
+                if w.locks & a.locks:
+                    continue  # a common lock guards both paths
+                if w.locks and not a.write and not aroots:
+                    # locked write, unlocked READ on plain host code
+                    # (no thread root): the post-hoc inspection pattern
+                    # (exports, asserts after join) — single attr reads
+                    # are torn-free under the GIL, so the lock already
+                    # guards the invariant that matters
+                    continue
+                # anchor at the side missing the lock — that is where
+                # the fix (or the justification) belongs
+                site, other_acc = (w, a) if not w.locks else (a, w)
+                ctx = by_path.get(site.func.path)
+                if ctx is None:
+                    continue
+                other = (f"{other_acc.func.path}:{other_acc.lineno} in "
+                         f"`{other_acc.func.qualname}` "
+                         f"[{_roots_desc(other_acc.func.roots)}]"
+                         f"{' (unlocked)' if not other_acc.locks else ''}")
+                verb = "written" if site.write else "read"
+                overb = "write" if other_acc.write else "read"
+                f = ctx.finding(
+                    "THR001", _anchor(site),
+                    f"`{attr_id[0]}.{attr_id[1]}` {verb} in "
+                    f"`{site.func.qualname}` "
+                    f"[{_roots_desc(site.func.roots)}] with no lock "
+                    f"common to its {overb} at {other} — guard both "
+                    f"sides with one lock or confine the attribute to "
+                    f"a single thread")
+                if f is not None:
+                    findings.append(f)
+                reported = True
+                break
+            if reported:
+                break
+    return findings
+
+
+class _Anchor:
+    def __init__(self, lineno: int, col: int):
+        self.lineno = lineno
+        self.col_offset = col
+
+
+def _anchor(acc: Access) -> _Anchor:
+    return _Anchor(acc.lineno, acc.col)
+
+
+# -- THR002: blocking calls under a lock -------------------------------------
+
+# attribute calls that BLOCK the calling thread
+_BLOCKING_ATTRS = {"block_until_ready", "item", "tolist", "join",
+                   "sleep", "read", "readline", "readlines", "recv",
+                   "accept", "result"}
+# host drivers that block before returning (they fetch host results);
+# score_fixed leaves extraction under the caller's lock too
+_BLOCKING_HINTS = {"score_fixed", "validate", "fit_arrays",
+                   "predict_arrays", "fit_gbt", "fit_gbt_folds",
+                   "sweep_glm_streamed_rounds", "knockout_deltas"}
+_FETCH_FUNCS = {"asarray", "array"}  # np.* of device state
+
+
+@project_rule("THR002", "blocking call (device fetch / queue wait / file "
+                        "I/O / sleep / join) inside a `with lock:` region")
+def check_thr002(ctxs: Sequence[LintContext]) -> List[Finding]:
+    pt = project_threads(ctxs)
+    by_path = _ctx_by_path(ctxs)
+    findings: List[Finding] = []
+    for ft in pt.files:
+        ctx = by_path.get(ft.path)
+        if ctx is None:
+            continue
+        np_alias = _np_aliases(ctx)
+        for fn in ft.funcs:
+            for call in fn.calls:
+                if call.kind == "with_event" or not call.locks:
+                    continue
+                msg = _blocking_reason(call, fn, ft, pt, np_alias)
+                if msg is None:
+                    continue
+                lock = sorted(call.locks)[0].split("::")[-1]
+                f = ctx.finding(
+                    "THR002", call.node,
+                    f"{msg} while holding `{lock}` in "
+                    f"`{fn.qualname}` — every thread contending for the "
+                    f"lock now waits on this call too; move the blocking "
+                    f"work outside the critical section (or justify: the "
+                    f"lock exists to serialize exactly this)")
+                if f is not None:
+                    findings.append(f)
+    return findings
+
+
+def _np_aliases(ctx: LintContext) -> Set[str]:
+    out = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def _blocking_reason(call, fn: FuncNode, ft: FileThreads,
+                     pt: ProjectThreads,
+                     np_alias: Set[str]) -> Optional[str]:
+    node = call.node
+    if node is None:
+        return None
+    d = dotted_name(node.func)
+    meth = call.method
+    # .wait() on something that is not a held lock (Condition.wait on the
+    # held condition is THR004's business and correct usage here)
+    if meth == "wait":
+        recv_id = _recv_lock_id(node, fn, ft)
+        if recv_id is not None and recv_id in call.locks:
+            return None
+        # waiting on an Event/other-thread result while holding a lock
+        return "`.wait()` blocks"
+    if meth in _BLOCKING_ATTRS:
+        # file .read()/.write() style: only fire for known file/thread/
+        # device receivers to avoid flooding on dict.get-style names
+        if meth in {"read", "readline", "readlines"}:
+            rid = _recv_id(node, fn, ft)
+            if rid is None or rid not in pt.file_ids:
+                return None
+            return f"file `.{meth}()`"
+        if meth == "join":
+            rid = _recv_id(node, fn, ft)
+            if rid is not None and (rid in pt.thread_ids
+                                    or "thread" in rid.lower()):
+                return "`Thread.join()` blocks"
+            return None
+        if meth == "result":
+            return None if d is None or "future" not in d.lower() \
+                else "`.result()` blocks"
+        if meth == "sleep":
+            return "`time.sleep()`" if d in ("time.sleep", "sleep") \
+                else None
+        if meth in {"item", "tolist", "block_until_ready"}:
+            return f"`.{meth}()` syncs with the device"
+    if d == "jax.block_until_ready" or (
+            d and d.endswith(".block_until_ready")):
+        return "`jax.block_until_ready()` syncs with the device"
+    if d in ("jax.device_get",):
+        return "`jax.device_get()` syncs with the device"
+    if d == "open":
+        return "`open()` does file I/O"
+    if d:
+        parts = d.split(".")
+        # np.asarray(self.<device attr>): the D2H fetch of device state
+        if parts[0] in np_alias and parts[-1] in _FETCH_FUNCS \
+                and node.args:
+            if _is_device_expr(node.args[0], fn, pt):
+                return (f"`{d}()` fetches device-resident state to host")
+        if parts[-1] in _BLOCKING_HINTS:
+            return f"`{d}()` blocks until host results are ready"
+        # write/flush on a file object
+        if parts[-1] in {"write", "flush", "writelines"}:
+            rid = _recv_id(node, fn, ft)
+            if rid is not None and rid in pt.file_ids:
+                return f"file `.{parts[-1]}()`"
+    # blocking queue ops on queue-typed receivers
+    if meth in {"get", "put"}:
+        rid = _recv_id(node, fn, ft)
+        if rid is not None and rid in pt.queue_ids:
+            block_kw = next((k for k in node.keywords
+                             if k.arg == "block"), None)
+            if block_kw is not None and isinstance(
+                    block_kw.value, ast.Constant) and \
+                    block_kw.value.value is False:
+                return None
+            return f"blocking `queue.{meth}()`"
+    return None
+
+
+def _is_device_expr(expr: ast.expr, fn: FuncNode,
+                    pt: ProjectThreads) -> bool:
+    """True when `expr` is statically known device-resident state: a
+    self-attribute assigned (anywhere in its class) from a jitted call —
+    fetching it to host blocks on every dispatch queued behind it."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and \
+            expr.value.id == "self" and fn.cls:
+        return (fn.cls, expr.attr) in pt.device_attr_ids
+    return False
+
+
+def _recv_id(node: ast.Call, fn: FuncNode,
+             ft: FileThreads) -> Optional[str]:
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    from .threadflow import _expr_id
+    return _expr_id(fn.cls, node.func.value, ft.path)
+
+
+def _recv_lock_id(node: ast.Call, fn: FuncNode,
+                  ft: FileThreads) -> Optional[str]:
+    rid = _recv_id(node, fn, ft)
+    if rid is None:
+        return None
+    if rid in ft.lock_ids:
+        return rid
+    tail = rid.split("::")[-1]
+    if "lock" in tail.lower() or "cond" in tail.lower():
+        return rid
+    return None
+
+
+# -- THR003: lock-order inversion --------------------------------------------
+
+@project_rule("THR003", "cycle in the acquires-while-holding lock graph")
+def check_thr003(ctxs: Sequence[LintContext]) -> List[Finding]:
+    pt = project_threads(ctxs)
+    by_path = _ctx_by_path(ctxs)
+    edges = pt.lock_order_edges()
+    graph: Dict[str, Set[str]] = {}
+    sites: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for held, acq, path, lineno, func in edges:
+        graph.setdefault(held, set()).add(acq)
+        sites.setdefault((held, acq), (path, lineno, func))
+
+    # DFS cycle detection; report each cycle once via its sorted key
+    findings: List[Finding] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str],
+            done: Set[str]) -> None:
+        on_stack.add(node)
+        stack.append(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                key = tuple(sorted(set(cyc)))
+                if key in seen_cycles:
+                    continue
+                seen_cycles.add(key)
+                path, lineno, func = sites[(node, nxt)]
+                ctx = by_path.get(path)
+                if ctx is None:
+                    continue
+                order = " -> ".join(c.split("::")[-1] for c in cyc)
+                f = ctx.finding(
+                    "THR003", _Anchor(lineno, 0),
+                    f"lock-order inversion: `{order}` — two threads "
+                    f"taking these locks in opposite orders deadlock; "
+                    f"pick one global order (docs/serving.md lock table) "
+                    f"and release before acquiring against it "
+                    f"(cycle closes in `{func}`)")
+                if f is not None:
+                    findings.append(f)
+            elif nxt not in done:
+                dfs(nxt, stack, on_stack, done)
+        stack.pop()
+        on_stack.discard(node)
+        done.add(node)
+
+    done: Set[str] = set()
+    for node in sorted(graph):
+        if node not in done:
+            dfs(node, [], set(), done)
+    return findings
+
+
+# -- THR004: Condition/Event misuse ------------------------------------------
+
+_COND_METHODS = {"wait", "wait_for", "notify", "notify_all"}
+
+
+@project_rule("THR004", "Condition used without holding it / Event used "
+                        "as a context manager")
+def check_thr004(ctxs: Sequence[LintContext]) -> List[Finding]:
+    pt = project_threads(ctxs)
+    by_path = _ctx_by_path(ctxs)
+    findings: List[Finding] = []
+    for ft in pt.files:
+        ctx = by_path.get(ft.path)
+        if ctx is None:
+            continue
+        for fn in ft.funcs:
+            for call in fn.calls:
+                if call.kind == "with_event":
+                    f = ctx.finding(
+                        "THR004", _Anchor(call.lineno, call.col),
+                        f"`with` on threading.Event `"
+                        f"{call.method.split('::')[-1]}` — an Event is "
+                        f"not a context manager (no lock is taken); use "
+                        f"a Condition, or .wait()/.set() directly")
+                    if f is not None:
+                        findings.append(f)
+                    continue
+                if call.node is None or call.method not in _COND_METHODS:
+                    continue
+                rid = _recv_id(call.node, fn, ft)
+                if rid is None or rid not in pt.condition_ids:
+                    continue
+                if rid not in call.locks:
+                    f = ctx.finding(
+                        "THR004", call.node,
+                        f"`.{call.method}()` on Condition "
+                        f"`{rid.split('::')[-1]}` without holding it — "
+                        f"raises RuntimeError('cannot "
+                        f"{'notify' if 'notify' in call.method else 'wait'}"
+                        f" on un-acquired lock') at runtime; wrap in "
+                        f"`with {rid.split('.')[-1]}:`")
+                    if f is not None:
+                        findings.append(f)
+                elif call.method in {"wait", "wait_for"} and \
+                        len(call.locks) > 1:
+                    others = sorted(L.split("::")[-1]
+                                    for L in call.locks if L != rid)
+                    f = ctx.finding(
+                        "THR004", call.node,
+                        f"`.{call.method}()` on "
+                        f"`{rid.split('::')[-1]}` while ALSO holding "
+                        f"{others} — wait releases only the condition's "
+                        f"lock; the other lock stays held for the whole "
+                        f"sleep and starves its waiters")
+                    if f is not None:
+                        findings.append(f)
+    return findings

@@ -1,0 +1,827 @@
+"""Cross-module thread-entry + lock-context dataflow layer.
+
+PRs 6-9 made the library concurrent — tileplane producer threads, the
+MicroBatcher dispatcher, a ThreadingHTTPServer frontend, monitor windows
+ticked from two threads — and the invariants those modules stake their
+correctness on ("observe under the batch lock", "the window-close fetch
+is the monitor's only sync", "no device sync on the dispatcher thread")
+lived only in docstrings. This module is the shared analysis the THR
+rule family (rules_thr.py) runs on:
+
+* **thread roots** — every function that can become a thread's entry
+  point: `threading.Thread(target=f)` spawns (marked *multi-instance*
+  when the spawn sits in a loop/comprehension), `do_GET`/`do_POST`/
+  `handle` methods of `BaseHTTPRequestHandler` subclasses (always
+  multi-instance: ThreadingHTTPServer runs one thread per connection),
+  and callables handed to listener/signal registration APIs (callbacks
+  may fire on any thread — jax.monitoring compile listeners are the
+  in-repo case);
+* **root reachability** — a project-wide call-graph closure from those
+  roots. Calls resolve lexically inside a module (like jitgraph), via
+  `self.method` within a class (including project-resolved bases), and
+  via `obj.method` where `obj`'s class is inferred from parameter/attr
+  annotations, `ClassName(...)` construction, module-level singletons
+  (`collector = MetricsCollector()`), or — last resort — a
+  name-affinity match (`self.engine` -> `ServingEngine`). A deliberate
+  over-approximation, tamed like the rest of tmoglint by per-line
+  suppression;
+* **lock-context lattice** — for every statement, the set of locks
+  lexically held (`with self._lock:` nests), where a "lock" is any
+  attr/name assigned `threading.Lock()`/`RLock()`/`Condition()`
+  (Semaphores are resource counters, not mutual exclusion, and are
+  excluded). Lock identity is class-qualified (`ServingEngine._lock`)
+  so same-named locks of different classes never alias;
+* **shared-state table** — every `self.x`/`obj.x` attribute access and
+  `global` write, tagged (class, attr, read|write, locks-held,
+  reachable-roots). THR001 consumes this directly.
+
+Everything here is stdlib-`ast`; per-file extraction is cached on the
+LintContext (one parse + one walk serves every THR rule) and the joined
+project index is cached on the context *sequence* via `project_threads`.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .core import LintContext, dotted_name
+
+# classes whose subclass methods do_GET/do_POST/... run one-per-connection
+_HANDLER_BASE_HINTS = ("RequestHandler",)
+_HANDLER_METHODS = {"do_GET", "do_POST", "do_PUT", "do_DELETE", "handle",
+                    "handle_one_request"}
+# registration calls whose callable arguments may later fire on any thread
+_CALLBACK_REG_HINTS = ("register", "listener", "add_done_callback",
+                       "subscribe", "signal")
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_CONDITION_CTORS = {"Condition"}
+_EVENT_CTORS = {"Event"}
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+_THREAD_CTORS = {"Thread"}
+
+
+@dataclasses.dataclass
+class Access:
+    """One shared-state touch: self.x / obj.x / global NAME."""
+
+    attr_id: Tuple[str, str]      # (owner class or "<module:path>", attr)
+    write: bool
+    lineno: int
+    col: int
+    locks: frozenset              # lock ids held at the access
+    in_init: bool                 # inside the owner's __init__
+    func: "FuncNode" = None       # backref, filled by FileThreads
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One call with enough shape to resolve project-wide."""
+
+    kind: str                     # 'name' | 'self' | 'attr'
+    recv: Optional[str]           # receiver class-hint source ('self.engine')
+    method: str
+    lineno: int
+    col: int
+    locks: frozenset
+    node: ast.Call = None
+
+
+class FuncNode:
+    """One function/method with its lock/call/access tables."""
+
+    def __init__(self, path: str, qualname: str, cls: Optional[str],
+                 name: str, node: ast.AST):
+        self.path = path
+        self.qualname = qualname
+        self.cls = cls
+        self.name = name
+        self.node = node
+        self.calls: List[CallSite] = []
+        self.accesses: List[Access] = []
+        # locks this function acquires lexically (with-statements)
+        self.acquired: Set[str] = set()
+        # (held_lock, acquired_lock, lineno) lexical nesting edges
+        self.lock_edges: List[Tuple[str, str, int]] = []
+        # roots this function is reachable from (filled by ProjectThreads)
+        self.roots: Set[str] = set()
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"<FuncNode {self.path}:{self.qualname}>"
+
+
+class FileThreads:
+    """Per-file extraction (cached on the LintContext)."""
+
+    def __init__(self, ctx: LintContext):
+        self.ctx = ctx
+        self.path = ctx.path
+        self.funcs: List[FuncNode] = []
+        self.by_qualname: Dict[str, FuncNode] = {}
+        self.module_funcs: Dict[str, FuncNode] = {}
+        self.class_methods: Dict[Tuple[str, str], FuncNode] = {}
+        self.class_bases: Dict[str, List[str]] = {}
+        # (cls, attr) -> class-name hints for obj.method() resolution
+        self.attr_class_hints: Dict[Tuple[str, str], Set[str]] = {}
+        # module-level singletons: name -> class name
+        self.singletons: Dict[str, str] = {}
+        # lock/condition/event/queue/file-typed ids (class-qualified)
+        self.lock_ids: Set[str] = set()
+        self.condition_ids: Set[str] = set()
+        self.event_ids: Set[str] = set()
+        self.queue_ids: Set[str] = set()
+        self.file_ids: Set[str] = set()
+        self.thread_ids: Set[str] = set()
+        # attrs assigned from a jitted call anywhere in their class: the
+        # statically-known device-resident state (THR002 fetch targets)
+        self.device_attr_ids: Set[Tuple[str, str]] = set()
+        # spawn sites: (kind, recv, name, multi_instance, enclosing qualname)
+        self.spawns: List[Tuple[str, Optional[str], str, bool,
+                                Optional[str]]] = []
+        self.callback_refs: List[Tuple[str, Optional[str], str, int]] = []
+        self._jit_names = _jitted_names(ctx)
+        self._collect_classes()
+        self._collect_funcs()
+        self._collect_spawns()
+
+    # -- typed-object discovery -------------------------------------------
+    def _typed_ctor(self, value: ast.expr) -> Optional[str]:
+        """'lock'|'condition'|'event'|'queue'|'thread'|'file' when `value`
+        constructs one, else None."""
+        if not isinstance(value, ast.Call):
+            return None
+        d = dotted_name(value.func)
+        if not d:
+            return None
+        last = d.split(".")[-1]
+        if last in _CONDITION_CTORS:
+            return "condition"
+        if last in _LOCK_CTORS:
+            return "lock"
+        if last in _EVENT_CTORS:
+            return "event"
+        if last in _QUEUE_CTORS:
+            return "queue"
+        if last in _THREAD_CTORS:
+            return "thread"
+        if last == "open":
+            return "file"
+        return None
+
+    def _collect_classes(self) -> None:
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                self.class_bases[node.name] = [
+                    b for b in (dotted_name(x) for x in node.bases) if b]
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                # module-level singleton: name = ClassName()
+                d = dotted_name(node.value.func)
+                if d and "." not in d and d[:1].isupper():
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.singletons[t.id] = d
+
+    def _record_typed(self, cls: Optional[str], target: ast.expr,
+                      value: ast.expr) -> None:
+        kind = self._typed_ctor(value)
+        tid = _target_id(cls, target, self.path)
+        if tid is None:
+            return
+        if kind == "condition":
+            self.condition_ids.add(tid)
+            self.lock_ids.add(tid)     # a Condition is also a lock
+        elif kind == "lock":
+            self.lock_ids.add(tid)
+        elif kind == "event":
+            self.event_ids.add(tid)
+        elif kind == "queue":
+            self.queue_ids.add(tid)
+        elif kind == "thread":
+            self.thread_ids.add(tid)
+        elif kind == "file":
+            self.file_ids.add(tid)
+        # class hints + device attrs for self.X = ... assignments
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self" and cls:
+            if isinstance(value, ast.Call):
+                d = dotted_name(value.func)
+                if d and "." not in d and d[:1].isupper():
+                    self.attr_class_hints.setdefault(
+                        (cls, target.attr), set()).add(d)
+                callee = d.split(".")[-1] if d else ""
+                if callee in self._jit_names:
+                    self.device_attr_ids.add((cls, target.attr))
+            elif isinstance(value, ast.Name):
+                # self.engine = engine — hint from the param annotation
+                ann = self._param_annotations.get(value.id, "")
+                base = _annotation_class(ann)
+                if base:
+                    self.attr_class_hints.setdefault(
+                        (cls, target.attr), set()).add(base)
+
+    # -- function bodies ---------------------------------------------------
+    def _collect_funcs(self) -> None:
+        self._param_annotations: Dict[str, str] = {}
+
+        def walk_defs(node: ast.AST, cls: Optional[str], prefix: str):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    fn = FuncNode(self.path, qual, cls, child.name, child)
+                    self.funcs.append(fn)
+                    self.by_qualname[qual] = fn
+                    if cls is not None and qual == f"{cls}.{child.name}":
+                        self.class_methods[(cls, child.name)] = fn
+                    elif cls is None and qual == child.name:
+                        self.module_funcs[child.name] = fn
+                    self._scan_body(fn)
+                    walk_defs(child, cls, qual + ".")
+                elif isinstance(child, ast.ClassDef):
+                    walk_defs(child, child.name, child.name + ".")
+                else:
+                    walk_defs(child, cls, prefix)
+
+        walk_defs(self.ctx.tree, None, "")
+
+    def _lock_id_of(self, expr: ast.expr, fn: FuncNode) -> Optional[str]:
+        """Lock id for a with/call receiver expr, or None when the expr
+        is not a known lock."""
+        tid = _expr_id(fn.cls, expr, self.path)
+        if tid is not None and tid in self.lock_ids:
+            return tid
+        # `with lock:` on a bare local/param whose NAME matches a known
+        # lock attr tail, or looks lock-ish ('lock'/'cond' in the name):
+        # locks passed as parameters keep their identity by name
+        d = dotted_name(expr)
+        if d and "." not in d and ("lock" in d.lower()
+                                   or "cond" in d.lower()
+                                   or "mutex" in d.lower()):
+            return f"{self.path}::{d}"
+        return None
+
+    def _scan_body(self, fn: FuncNode) -> None:
+        """One walk of fn's own body: lock lattice + accesses + calls."""
+        in_init = fn.name == "__init__"
+        nested: Set[ast.AST] = set()
+        for child in ast.walk(fn.node):
+            if child is not fn.node and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+                nested.add(child)
+        # local var -> class-name hints (params via annotation,
+        # locals via ClassName(...) / getattr-literal aliases)
+        var_cls: Dict[str, str] = {}
+        getattr_alias: Dict[str, Tuple[str, str]] = {}
+        args = getattr(fn.node, "args", None)
+        self._param_annotations = {}
+        if args is not None:
+            for a in (args.args + args.kwonlyargs
+                      + getattr(args, "posonlyargs", [])):
+                ann = ast.unparse(a.annotation) if a.annotation else ""
+                self._param_annotations[a.arg] = ann
+                base = _annotation_class(ann)
+                if base:
+                    var_cls[a.arg] = base
+
+        def class_of(expr: ast.expr) -> Optional[str]:
+            """Receiver class hint for obj.method()/obj.attr."""
+            if isinstance(expr, ast.Name):
+                if expr.id in var_cls:
+                    return var_cls[expr.id]
+                if expr.id in self.singletons:
+                    return self.singletons[expr.id]
+                return None
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self" and fn.cls:
+                hints = self.attr_class_hints.get((fn.cls, expr.attr))
+                if hints:
+                    return sorted(hints)[0]
+            return None
+
+        def visit(node: ast.AST, held: Tuple[str, ...]) -> None:
+            if node in nested:
+                return
+            if isinstance(node, ast.With):
+                new = list(held)
+                for item in node.items:
+                    lid = self._lock_id_of(item.context_expr, fn)
+                    if lid is not None:
+                        for h in new:
+                            fn.lock_edges.append((h, lid, node.lineno))
+                        fn.acquired.add(lid)
+                        new.append(lid)
+                    # `with event:` is a THR004 target; record the expr
+                    eid = _expr_id(fn.cls, item.context_expr, self.path)
+                    if eid is not None and eid in self.event_ids:
+                        fn.calls.append(CallSite(
+                            "with_event", None, eid, node.lineno,
+                            node.col_offset, frozenset(held),
+                            node=None))
+                for item in node.items:
+                    visit(item.context_expr, held)
+                    if item.optional_vars is not None:
+                        visit(item.optional_vars, held)
+                for stmt in node.body:
+                    visit(stmt, tuple(new))
+                return
+            lockset = frozenset(held)
+            if isinstance(node, ast.Assign):
+                self._record_typed(fn.cls, node.targets[0], node.value)
+                # getattr(obj, "literal") alias for later call resolution
+                if isinstance(node.value, ast.Call) and \
+                        dotted_name(node.value.func) == "getattr" and \
+                        len(node.value.args) >= 2 and \
+                        isinstance(node.value.args[1], ast.Constant) and \
+                        isinstance(node.value.args[1].value, str) and \
+                        isinstance(node.targets[0], ast.Name):
+                    cls_hint = class_of(node.value.args[0])
+                    getattr_alias[node.targets[0].id] = (
+                        cls_hint or "", node.value.args[1].value)
+                if isinstance(node.value, ast.Call):
+                    d = dotted_name(node.value.func)
+                    if d and "." not in d and d[:1].isupper() and \
+                            isinstance(node.targets[0], ast.Name):
+                        var_cls[node.targets[0].id] = d
+            if isinstance(node, ast.Global):
+                for nm in node.names:
+                    fn.accesses.append(Access(
+                        (f"<module:{self.path}>", nm), True,
+                        node.lineno, node.col_offset, lockset, in_init,
+                        fn))
+            elif isinstance(node, ast.Attribute):
+                owner = None
+                if isinstance(node.value, ast.Name):
+                    if node.value.id == "self":
+                        owner = fn.cls
+                    else:
+                        owner = class_of(node.value)
+                elif isinstance(node.value, ast.Attribute):
+                    owner = class_of(node.value)
+                if owner is not None and not node.attr.startswith("__"):
+                    is_store = isinstance(node.ctx, (ast.Store, ast.Del))
+                    fn.accesses.append(Access(
+                        (owner, node.attr), is_store, node.lineno,
+                        node.col_offset, lockset,
+                        in_init and owner == fn.cls, fn))
+            if isinstance(node, ast.Call):
+                self._record_call(fn, node, lockset, class_of,
+                                  getattr_alias)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in ast.iter_child_nodes(fn.node):
+            visit(stmt, ())
+
+    def _record_call(self, fn: FuncNode, node: ast.Call,
+                     locks: frozenset, class_of, getattr_alias) -> None:
+        f = node.func
+        site: Optional[CallSite] = None
+        if isinstance(f, ast.Name):
+            if f.id in getattr_alias:
+                cls_hint, meth = getattr_alias[f.id]
+                site = CallSite("attr", cls_hint or None, meth,
+                                node.lineno, node.col_offset, locks, node)
+            else:
+                site = CallSite("name", None, f.id, node.lineno,
+                                node.col_offset, locks, node)
+        elif isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                site = CallSite("self", fn.cls, f.attr, node.lineno,
+                                node.col_offset, locks, node)
+            else:
+                site = CallSite("attr", class_of(f.value)
+                                or dotted_name(f.value), f.attr,
+                                node.lineno, node.col_offset, locks, node)
+        if site is not None:
+            fn.calls.append(site)
+        # callback registrations: handed callables may fire on any thread
+        d = dotted_name(f)
+        if d and any(h in d.lower() for h in _CALLBACK_REG_HINTS):
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                ref = _callable_ref(arg, fn)
+                if ref is not None:
+                    self.callback_refs.append(
+                        (ref[0], ref[1], ref[2], node.lineno))
+
+    # -- spawns ------------------------------------------------------------
+    def _collect_spawns(self) -> None:
+        loops: List[ast.AST] = [
+            n for n in ast.walk(self.ctx.tree)
+            if isinstance(n, (ast.For, ast.While, ast.ListComp,
+                              ast.GeneratorExp, ast.SetComp))]
+
+        def in_loop(node: ast.AST) -> bool:
+            return any(node in ast.walk(lp) for lp in loops)
+
+        for node in ast.walk(self.ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if not d or d.split(".")[-1] != "Thread":
+                continue
+            target = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+            if target is None and node.args:
+                target = node.args[0]
+            if target is None:
+                continue
+            # enclosing function (for nested-def targets and self.method)
+            cls = None
+            encl = None
+            for fn in self.funcs:
+                if any(node is sub for sub in ast.walk(fn.node)):
+                    cls = fn.cls
+                    encl = fn.qualname  # innermost wins (later in list)
+            ref = _callable_ref(target, None, cls=cls)
+            if ref is not None:
+                self.spawns.append((ref[0], ref[1], ref[2], in_loop(node),
+                                    encl))
+        # HTTP handler methods are spawn roots too (one thread per
+        # connection under ThreadingHTTPServer)
+        for (cls, meth), fnode in self.class_methods.items():
+            if meth in _HANDLER_METHODS and any(
+                    any(h in b for h in _HANDLER_BASE_HINTS)
+                    for b in self.class_bases.get(cls, [])):
+                self.spawns.append(("self", cls, meth, True, None))
+
+
+def _jitted_names(ctx: LintContext) -> Set[str]:
+    """Function names that are direct-jit (decorator) or assigned from
+    jax.jit(...) — the 'calls to these produce device arrays' set used
+    for device-attr classification."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = dotted_name(dec)
+                if d and d.split(".")[-1] in {"jit", "pjit"}:
+                    out.add(node.name)
+                elif isinstance(dec, ast.Call):
+                    dn = dotted_name(dec.func)
+                    if dn and dn.split(".")[-1] in {"jit", "pjit"}:
+                        out.add(node.name)
+                    elif dn and dn.split(".")[-1] == "partial" and \
+                            dec.args:
+                        inner = dotted_name(dec.args[0])
+                        if inner and inner.split(".")[-1] in \
+                                {"jit", "pjit"}:
+                            out.add(node.name)
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            d = dotted_name(node.value.func)
+            if d and d.split(".")[-1] in {"jit", "pjit"}:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _annotation_class(ann: str) -> Optional[str]:
+    """Class name out of a parameter annotation ('ServingEngine',
+    'Optional[\"TilePlaneStats\"]' ...)."""
+    if not ann:
+        return None
+    ann = ann.replace('"', "").replace("'", "")
+    for tok in ann.replace("[", " ").replace("]", " ") \
+            .replace(",", " ").split():
+        base = tok.split(".")[-1]
+        if base in ("Optional", "Any", "None", "List", "Dict", "Tuple",
+                    "Sequence", "Set", "Callable", "Iterable",
+                    "Iterator"):
+            continue
+        if base[:1].isupper():
+            return base
+    return None
+
+
+def _target_id(cls: Optional[str], target: ast.expr,
+               path: str) -> Optional[str]:
+    if isinstance(target, ast.Attribute) and \
+            isinstance(target.value, ast.Name) and \
+            target.value.id == "self" and cls:
+        return f"{cls}.{target.attr}"
+    if isinstance(target, ast.Name):
+        return f"{path}::{target.id}"
+    return None
+
+
+def _expr_id(cls: Optional[str], expr: ast.expr,
+             path: str) -> Optional[str]:
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name):
+        if expr.value.id == "self" and cls:
+            return f"{cls}.{expr.attr}"
+        # obj._lock: qualify by the receiver NAME (best effort)
+        return f"{path}::{expr.value.id}.{expr.attr}"
+    if isinstance(expr, ast.Name):
+        return f"{path}::{expr.id}"
+    return None
+
+
+def _callable_ref(expr: ast.expr, fn: Optional[FuncNode],
+                  cls: Optional[str] = None
+                  ) -> Optional[Tuple[str, Optional[str], str]]:
+    """('name'|'self'|'attr', class-hint, name) for a callable expr."""
+    if isinstance(expr, ast.Name):
+        return ("name", None, expr.id)
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name):
+        if expr.value.id == "self":
+            return ("self", cls or (fn.cls if fn else None), expr.attr)
+        return ("attr", expr.value.id, expr.attr)
+    if isinstance(expr, ast.Lambda):
+        return None
+    return None
+
+
+class ProjectThreads:
+    """Joined view over every file: root reachability + lock universe."""
+
+    def __init__(self, files: Sequence[FileThreads]):
+        self.files = list(files)
+        self.method_index: Dict[str, List[FuncNode]] = {}
+        self.class_methods: Dict[Tuple[str, str], FuncNode] = {}
+        self.class_names: Set[str] = set()
+        self.condition_ids: Set[str] = set()
+        self.event_ids: Set[str] = set()
+        self.queue_ids: Set[str] = set()
+        self.file_ids: Set[str] = set()
+        self.thread_ids: Set[str] = set()
+        self.lock_ids: Set[str] = set()
+        self.device_attr_ids: Set[Tuple[str, str]] = set()
+        self.lock_owner_classes: Set[str] = set()
+        # meth -> [(class, FuncNode)] so name-affinity resolution scans
+        # only same-named methods, not the whole project (the resolve
+        # hot path); plus a (kind, recv, meth) memo on top
+        self._meth_by_name: Dict[str, List[Tuple[str, FuncNode]]] = {}
+        self._resolve_memo: Dict[Tuple[str, Optional[str], str],
+                                 List[FuncNode]] = {}
+        self._bases_of: Dict[str, List[str]] = {}
+        for ft in self.files:
+            for (cls, meth), fn in ft.class_methods.items():
+                self.class_methods[(cls, meth)] = fn
+                self.method_index.setdefault(meth, []).append(fn)
+                self._meth_by_name.setdefault(meth, []).append((cls, fn))
+            self._bases_of.update(ft.class_bases)
+            for name, fn in ft.module_funcs.items():
+                self.method_index.setdefault(name, []).append(fn)
+            self.class_names |= set(ft.class_bases)
+            self.condition_ids |= ft.condition_ids
+            self.event_ids |= ft.event_ids
+            self.queue_ids |= ft.queue_ids
+            self.file_ids |= ft.file_ids
+            self.thread_ids |= ft.thread_ids
+            self.lock_ids |= ft.lock_ids
+            self.device_attr_ids |= ft.device_attr_ids
+            for lid in ft.lock_ids:
+                if "::" not in lid and "." in lid:
+                    self.lock_owner_classes.add(lid.split(".")[0])
+        self._mark_roots()
+        self._acquires_closure()
+        self._caller_lock_lattice()
+
+    # -- call resolution ---------------------------------------------------
+    def resolve(self, ft: FileThreads, fn: Optional[FuncNode],
+                kind: str, recv: Optional[str], meth: str
+                ) -> List[FuncNode]:
+        if kind == "name":
+            # lexical: nested defs first, then module functions
+            if fn is not None:
+                qual = f"{fn.qualname}.{meth}"
+                t = ft.by_qualname.get(qual)
+                if t is not None:
+                    return [t]
+            t = ft.module_funcs.get(meth)
+            if t is not None:
+                return [t]
+            # cross-file module function (imported name)
+            cands = [c for c in self.method_index.get(meth, ())
+                     if c.cls is None]
+            return cands[:4]
+        if kind == "self":
+            cls = recv or (fn.cls if fn else None)
+            key = ("self", cls, meth)
+            hit = self._resolve_memo.get(key)
+            if hit is not None:
+                return hit
+            out: List[FuncNode] = []
+            seen: Set[str] = set()
+            while cls and cls not in seen:
+                seen.add(cls)
+                t = self.class_methods.get((cls, meth))
+                if t is not None:
+                    out = [t]
+                    break
+                bases = self._bases_of.get(cls)
+                cls = bases[0].split(".")[-1] if bases else None
+            self._resolve_memo[key] = out
+            return out
+        if kind == "attr":
+            key = ("attr", recv, meth)
+            hit = self._resolve_memo.get(key)
+            if hit is not None:
+                return hit
+            out = []
+            # exact class hint first
+            if recv and recv in self.class_names:
+                t = self.class_methods.get((recv, meth))
+                out = [t] if t is not None else []
+            else:
+                # name-affinity: self.engine -> ServingEngine
+                tail = (recv or "").split(".")[-1].lstrip("_").lower()
+                if tail:
+                    out = [c for cls, c in
+                           self._meth_by_name.get(meth, ())
+                           if cls.lower().endswith(tail)]
+            self._resolve_memo[key] = out
+            return out
+        return []
+
+    # -- roots -------------------------------------------------------------
+    def _mark_roots(self) -> None:
+        seeds: List[Tuple[FuncNode, str, bool]] = []
+        for ft in self.files:
+            for kind, recv, name, multi, encl in ft.spawns:
+                targets = []
+                if kind == "name" and encl:
+                    # nested-def target: resolve through the enclosing
+                    # scope chain (bench's per-shard `fire` workers,
+                    # pipelined()'s `body`)
+                    parts = encl.split(".")
+                    while parts and not targets:
+                        t = ft.by_qualname.get(
+                            ".".join(parts) + "." + name)
+                        if t is not None:
+                            targets = [t]
+                        parts.pop()
+                if not targets:
+                    targets = self.resolve(ft, None, kind, recv, name)
+                for t in targets:
+                    rid = f"thread:{ft.path}:{name}"
+                    if kind == "self" and name in _HANDLER_METHODS:
+                        rid = f"handler:{recv}.{name}"
+                    seeds.append((t, rid, multi))
+            for kind, recv, name, lineno in ft.callback_refs:
+                for t in self.resolve(ft, None, kind, recv, name):
+                    seeds.append((t, f"callback:{name}", True))
+        self.multi_roots: Set[str] = {rid for _, rid, multi in seeds
+                                      if multi}
+        # worklist closure over the project call graph
+        work = []
+        for t, rid, _multi in seeds:
+            if rid not in t.roots:
+                t.roots.add(rid)
+                work.append(t)
+        file_of: Dict[FuncNode, FileThreads] = {}
+        for ft in self.files:
+            for f2 in ft.funcs:
+                file_of[f2] = ft
+        guard = 0
+        while work and guard < 200000:
+            guard += 1
+            fn = work.pop()
+            ft = file_of[fn]
+            for call in fn.calls:
+                if call.kind == "with_event":
+                    continue
+                for t in self.resolve(ft, fn, call.kind, call.recv,
+                                      call.method):
+                    new = fn.roots - t.roots
+                    if new:
+                        t.roots |= new
+                        work.append(t)
+
+    # -- transitive lock acquisition (THR003) ------------------------------
+    def _acquires_closure(self) -> None:
+        """fn -> locks it may acquire, transitively (bounded fixpoint)."""
+        file_of: Dict[FuncNode, FileThreads] = {}
+        for ft in self.files:
+            for f2 in ft.funcs:
+                file_of[f2] = ft
+        self.acquires: Dict[FuncNode, Set[str]] = {
+            fn: set(fn.acquired) for ft in self.files for fn in ft.funcs}
+        for _ in range(6):  # repo call chains are shallow; bound the pass
+            changed = False
+            for ft in self.files:
+                for fn in ft.funcs:
+                    acc = self.acquires[fn]
+                    before = len(acc)
+                    for call in fn.calls:
+                        if call.kind == "with_event":
+                            continue
+                        for t in self.resolve(ft, fn, call.kind,
+                                              call.recv, call.method):
+                            acc |= self.acquires.get(t, set())
+                    if len(acc) != before:
+                        changed = True
+            if not changed:
+                break
+
+    def _caller_lock_lattice(self) -> None:
+        """Locks a *private* helper inherits from its call sites: the
+        intersection over every resolved call site of (locks lexically
+        held there + the caller's own inherited locks). `_close_window`
+        runs under the monitor lock although its own body never takes it
+        — every caller holds it. Only underscore-private functions get
+        the treatment (anything public is externally callable with no
+        lock at all), and call sites inside the owner class's __init__
+        are exempt (construction happens-before sharing). The result is
+        folded into every access/call lockset, so THR001/THR002 judge
+        helpers by the locks actually protecting them."""
+        file_of: Dict[FuncNode, FileThreads] = {}
+        for ft in self.files:
+            for f2 in ft.funcs:
+                file_of[f2] = ft
+        # callee -> list of (caller, locks at site)
+        sites: Dict[FuncNode, List[Tuple[FuncNode, frozenset]]] = {}
+        for ft in self.files:
+            for fn in ft.funcs:
+                for call in fn.calls:
+                    if call.kind == "with_event":
+                        continue
+                    for t in self.resolve(ft, fn, call.kind, call.recv,
+                                          call.method):
+                        sites.setdefault(t, []).append((fn, call.locks))
+        inherited: Dict[FuncNode, frozenset] = {}
+        for _ in range(6):
+            changed = False
+            for ft in self.files:
+                for fn in ft.funcs:
+                    if not fn.name.startswith("_") or \
+                            fn.name.startswith("__"):
+                        continue
+                    callers = [
+                        (c, lk) for c, lk in sites.get(fn, [])
+                        if not (c.name == "__init__" and c.cls
+                                and c.cls == fn.cls)]
+                    if not callers:
+                        continue
+                    acc: Optional[frozenset] = None
+                    for c, lk in callers:
+                        eff = lk | inherited.get(c, frozenset())
+                        acc = eff if acc is None else (acc & eff)
+                    acc = acc or frozenset()
+                    if inherited.get(fn, frozenset()) != acc:
+                        inherited[fn] = acc
+                        changed = True
+            if not changed:
+                break
+        for fn, locks in inherited.items():
+            if not locks:
+                continue
+            for acc in fn.accesses:
+                acc.locks = acc.locks | locks
+            for call in fn.calls:
+                call.locks = call.locks | locks
+
+    def lock_order_edges(self) -> List[Tuple[str, str, str, int, str]]:
+        """(held, acquired, path, lineno, func) edges: lexical nesting +
+        held-at-call-site x callee's transitive acquisitions."""
+        edges: List[Tuple[str, str, str, int, str]] = []
+        for ft in self.files:
+            for fn in ft.funcs:
+                for held, acq, lineno in fn.lock_edges:
+                    edges.append((held, acq, ft.path, lineno,
+                                  fn.qualname))
+                for call in fn.calls:
+                    if call.kind == "with_event" or not call.locks:
+                        continue
+                    for t in self.resolve(ft, fn, call.kind, call.recv,
+                                          call.method):
+                        for acq in self.acquires.get(t, ()):
+                            for held in call.locks:
+                                if held != acq:
+                                    edges.append((held, acq, ft.path,
+                                                  call.lineno,
+                                                  fn.qualname))
+        return edges
+
+
+def file_threads(ctx: LintContext) -> FileThreads:
+    ft = getattr(ctx, "_file_threads", None)
+    if ft is None:
+        ft = FileThreads(ctx)
+        ctx._file_threads = ft
+    return ft
+
+
+_PROJECT_CACHE: Dict[int, ProjectThreads] = {}
+
+
+def project_threads(ctxs: Sequence[LintContext]) -> ProjectThreads:
+    """One joined index per ctx sequence (all THR rules share it — the
+    cross-module reachability walk is the expensive part)."""
+    key = id(ctxs) if not isinstance(ctxs, (list, tuple)) else \
+        hash(tuple(id(c) for c in ctxs))
+    pt = _PROJECT_CACHE.get(key)
+    if pt is None:
+        _PROJECT_CACHE.clear()   # one project at a time; no leak
+        pt = ProjectThreads([file_threads(c) for c in ctxs])
+        _PROJECT_CACHE[key] = pt
+    return pt

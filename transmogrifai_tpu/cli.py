@@ -480,10 +480,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"Generated {', '.join(files)} in {a.output}")
         return 0
     if a.command == "trace-report":
-        from .utils.tracing import trace_report
-        text, ok = trace_report(a.dir, check=a.check, top=a.top)
+        # exit codes follow docs/static_analysis.md "Exit codes" (the
+        # same table the tmoglint CLI uses): 0 clean, 1 problems,
+        # 2 usage error (not a traced run dir)
+        from .utils.tracing import trace_report_rc
+        text, rc = trace_report_rc(a.dir, check=a.check, top=a.top)
         print(text)
-        return 0 if ok else 1
+        return rc
     if a.command == "serve":
         from .serve.frontend import run_serve
         return run_serve(a)

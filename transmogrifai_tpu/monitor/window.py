@@ -57,14 +57,24 @@ DEFAULT_WINDOW_ROWS = 4096
 DEFAULT_WINDOW_SECONDS = 60.0
 
 
-@functools.partial(jax.jit, static_argnames=("bins",))
+@functools.partial(jax.jit, static_argnames=("bins",),
+                   donate_argnums=(0,))
 def _numeric_sketch_step(state, X, w, lo, hi, bins: int):
     """state [K, bins+1] += weighted histogram of X [B, K] (NaN rows to
     the trailing missing bin, pad rows carry w=0). The binning rule is
     ops/stats.hist_bin_ids — shared with histogram_batched, which built
     the profile side — so window and profile can never drift in clip
     semantics. One executable per (B, K) shape: B is a prewarmed bucket
-    rung, K is fixed by the profile."""
+    rung, K is fixed by the profile.
+
+    The state is DONATED (tmoglint BUF002, the tileplane carry rule:
+    "the carry is donated, tiles are not"): every observed batch updates
+    the [K, bins+1] accumulator in place instead of allocating a fresh
+    one per dispatch. observe_numeric rebinds `self._num_state` to the
+    aliased output in the same statement, so the dead input buffer is
+    never reachable again; the first step of a window receives a host
+    numpy array, which has no device buffer to donate and simply
+    transfers."""
     X = jnp.asarray(X)
     n, K = X.shape
     ids = hist_bin_ids(X, lo, hi, bins, ~jnp.isnan(X))
@@ -172,13 +182,19 @@ class ServeMonitor:
         """Prediction-distribution accumulation (host; shares
         profile.score_hist with the profile builder)."""
         pred = self.profile.prediction
-        if pred is None or self._pred_hist is None:
+        if pred is None:
             return
         s = np.asarray(scores, np.float64)
         s = s[np.isfinite(s)]
         if s.size == 0:
             return
         with self._lock:
+            # the _pred_hist check belongs INSIDE the lock: a rollover
+            # on the dispatcher thread swaps the window buffers, and an
+            # unlocked check could read the old window's hist while the
+            # locked block below adds into the new one (tmoglint THR001)
+            if self._pred_hist is None:
+                return
             self._pred_hist += score_hist(s, pred.lo, pred.hi,
                                           self.profile.pred_bins)
             self._pred_count += float(s.size)
@@ -242,7 +258,11 @@ class ServeMonitor:
         hists: Dict[str, np.ndarray] = {}
         nulls: Dict[str, float] = {}
         if self._K and self._num_state is not None:
-            num = np.asarray(self._num_state, np.float64)  # THE sync
+            # THE documented sync: one [K, bins+1] fetch per window
+            # close (docs/monitoring.md), a few KB — the lock hold is
+            # the design, not an accident
+            # tmoglint: disable=THR002  the monitor's ONLY sync, by design
+            num = np.asarray(self._num_state, np.float64)
             for k, nm in enumerate(self.numeric_names):
                 hists[nm] = num[k, :self.bins]
                 nulls[nm] = float(num[k, self.bins])
@@ -290,7 +310,8 @@ class ServeMonitor:
 
     # -- reporting ---------------------------------------------------------
     def healthy(self) -> bool:
-        return not (self.health_gate and self.alerting)
+        with self._lock:  # `alerting` flips on the dispatcher thread
+            return not (self.health_gate and self.alerting)
 
     def report(self) -> Dict[str, Any]:
         """The ``GET /drift`` payload."""

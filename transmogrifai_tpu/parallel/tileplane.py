@@ -253,6 +253,9 @@ def iter_fixed_tiles(source: RowSource, tile_rows: int,
         pend.append(chunk)
         pend_rows += chunk[0].shape[0]
         if track is not None:
+            # single-writer: only the tile assembly (producer thread)
+            # writes this; readers run after the producer joined
+            # tmoglint: disable=THR001  read happens-after join
             track.peak_host_rows = max(track.peak_host_rows, pend_rows)
         while pend_rows >= tile_rows:
             yield pop_tile()
@@ -340,6 +343,8 @@ def _producer(source: RowSource, tile_rows: int, q: "queue.Queue",
                 # overlap the span pair exists to expose
                 jax.block_until_ready(dev)
                 dur = time.perf_counter() - t0
+                # producer-owned field; read only after th.join()
+                # tmoglint: disable=THR001  read happens-after join
                 stats.copy_seconds += dur
                 collector.trace.add_complete(
                     "tile_copy", "tile", dur, parent_span=anchor,
@@ -469,11 +474,16 @@ class _Consumer:
         if self.traced:
             jax.block_until_ready(self.carry)
             dur = time.perf_counter() - t0
+            # consumer-owned field (caller's thread); the producer
+            # never touches compute-side stats
+            # tmoglint: disable=THR001  single-owner, read post-join
             self.stats.compute_seconds += dur
             collector.trace.add_complete(
                 "tile_compute", "tile", dur, parent_span=self.anchor,
                 tile=k, rows=int(n_valid), label=self.stats.label)
+        # tmoglint: disable=THR001  consumer-owned (see compute_seconds)
         self.stats.tiles += 1
+        # tmoglint: disable=THR001  consumer-owned (see compute_seconds)
         self.stats.rows += int(n_valid)
 
     def flush(self) -> None:
@@ -487,8 +497,12 @@ def _finish_pass(stats: TilePlaneStats, traced: bool,
                  t_pass: float) -> TilePlaneStats:
     from ..utils.metrics import collector
 
+    # pass-end bookkeeping: runs on the consumer thread after the
+    # producer joined (run_tileplane finally)
+    # tmoglint: disable=THR001  single-owner, read post-join
     stats.wall_seconds = time.perf_counter() - t_pass
     if traced:
+        # tmoglint: disable=THR001  same happens-after-join ownership
         stats.overlapped = stats.copy_seconds + stats.compute_seconds \
             > stats.wall_seconds * 1.001
         collector.event(

@@ -167,6 +167,12 @@ class ServingEngine:
         # micro-batcher, bulk submit_many calls and prewarm never
         # interleave on the same buffers
         self._lock = threading.RLock()
+        # leaf lock for the shared counters below: note_shed/observe_*
+        # fire from every HTTP worker thread and the dispatcher, and
+        # /metrics reads from yet another — a bare `+= 1` loses updates
+        # under contention. Always acquired AFTER _lock, never around
+        # device work (THR003: the global order is _lock -> _stat_lock)
+        self._stat_lock = threading.Lock()
 
         self.hist: Dict[str, LatencyHistogram] = {
             "total": LatencyHistogram("serve_total"),
@@ -272,7 +278,9 @@ class ServingEngine:
             for s in range(0, len(records), self.max_batch):
                 out.extend(self.score_batch(records[s:s + self.max_batch]))
             return out
-        if len(records) == 1 and self._local_fn is not None and self.warm:
+        with self._stat_lock:
+            warm = self.warm
+        if len(records) == 1 and self._local_fn is not None and warm:
             t0 = time.perf_counter()
             res = self._local_fn(records[0])  # host replay: no device lock
             row = self._local_row(res)
@@ -292,6 +300,9 @@ class ServingEngine:
             t0 = time.perf_counter()
             ds = self._assemble(padded, bucket)
             t1 = time.perf_counter()
+            # the batch lock EXISTS to serialize device scoring +
+            # buffer reuse (docs/serving.md "Lock ownership")
+            # tmoglint: disable=THR002  serialized scoring IS the design
             scored = self.model.score_fixed(ds)
             from ..readers.streaming import _row_value
             cols = [(nm, scored.column(nm), t)
@@ -367,20 +378,26 @@ class ServingEngine:
         """Shared observation-failure accounting (both score routes):
         count, log the first few, self-disable after 20 — monitoring
         must never keep taxing a request path it cannot serve."""
-        self.monitor_errors += 1
-        if self.monitor_errors <= 3:
+        with self._stat_lock:
+            self.monitor_errors += 1
+            errs = self.monitor_errors
+            disable = errs >= 20 and not self.monitor_disabled
+            if disable:
+                self.monitor_disabled = True
+        if errs <= 3:
             _log.exception("serve: drift-monitor observation failed "
-                           "(%d)", self.monitor_errors)
-        if self.monitor_errors >= 20 and not self.monitor_disabled:
+                           "(%d)", errs)
+        if disable:
             _log.error("serve: drift monitor disabled after %d errors",
-                       self.monitor_errors)
-            self.monitor_disabled = True
+                       errs)
 
     def monitor_tick(self) -> None:
         """Timer-based window rollover for idle periods (the batcher's
         dispatcher calls this between batches so a `window_seconds`
         boundary closes even with no traffic arriving)."""
-        if self.monitor is None or self.monitor_disabled:
+        with self._stat_lock:
+            disabled = self.monitor_disabled
+        if self.monitor is None or disabled:
             return
         with self._lock:
             self.monitor.maybe_rollover()
@@ -401,7 +418,8 @@ class ServingEngine:
 
         with self._lock:
             if collector.enabled:
-                self._anchor = collector.trace.current()
+                with self._stat_lock:
+                    self._anchor = collector.trace.current()
             t0 = time.perf_counter()
             compiles0 = tracing.tracker.true_compiles
             hits0 = tracing.tracker.total_cache_hits
@@ -411,6 +429,9 @@ class ServingEngine:
                 cb0 = tracing.tracker.true_compiles
                 recs = [dict(self.example) for _ in range(b)]
                 ds = self._assemble(recs, b)
+                # prewarm compiles serially under the batch lock BY
+                # DESIGN (no traffic is admitted before warm)
+                # tmoglint: disable=THR002  deliberate: prewarm owns the lock
                 self.model.score_fixed(ds)
                 per_bucket.append({
                     "bucket": b,
@@ -422,11 +443,13 @@ class ServingEngine:
                 # (the zero-recompile contract holds with monitoring on)
                 self.monitor.prewarm(self.buckets)
             wall = time.perf_counter() - t0
-            self.warm = True
-            # the watch counts TRUE compiles: persistent-cache loads are
-            # not the cold-start cost the ladder exists to eliminate
-            self._warm_compiles = tracing.tracker.true_compiles
-            self.post_warmup_compiles = 0
+            with self._stat_lock:
+                self.warm = True
+                # the watch counts TRUE compiles: persistent-cache loads
+                # are not the cold-start cost the ladder exists to
+                # eliminate
+                self._warm_compiles = tracing.tracker.true_compiles
+                self.post_warmup_compiles = 0
             summary = {"buckets": list(self.buckets),
                        "wall_s": round(wall, 4),
                        "compiles": (self._warm_compiles - compiles0
@@ -462,30 +485,43 @@ class ServingEngine:
         })
 
     # -- telemetry ---------------------------------------------------------
+    # Counter discipline: every mutable counter below is touched only
+    # under _stat_lock — observe_request/note_shed run on HTTP worker
+    # threads, _observe_batch on the dispatcher, metrics() on whoever
+    # asks. The histograms keep their own internal locks.
     def observe_queue_wait(self, seconds: float) -> None:
         self.hist["queue_wait"].record(seconds)
         collector.latency("serve_queue_wait", seconds)
-        if collector.enabled and self.n_batches <= self._span_budget:
+        with self._stat_lock:
+            in_budget = self.n_batches <= self._span_budget
+            anchor = self._anchor
+        if collector.enabled and in_budget:
             collector.trace.add_complete("queue_wait", "serve", seconds,
-                                         parent_span=self._anchor)
+                                         parent_span=anchor)
 
     def observe_request(self, seconds: float, bucket: int) -> None:
-        self.n_requests += 1
+        with self._stat_lock:
+            self.n_requests += 1
         self.hist["total"].record(seconds)
         collector.latency("serve_total", seconds)
         collector.event("serve_request",
                         wall_ms=round(seconds * 1e3, 3), bucket=bucket)
 
     def note_shed(self, queue_len: int) -> None:
-        self.n_shed += 1
+        with self._stat_lock:
+            self.n_shed += 1
+            shed_total = self.n_shed
         collector.event("serve_shed", queue_len=queue_len,
-                        shed_total=self.n_shed)
+                        shed_total=shed_total)
 
     def _observe_batch(self, bucket: int, n_valid: int,
                        assemble_s: float, score_s: float,
                        path: str = "bucket") -> None:
-        self.n_batches += 1
-        self.n_rows += n_valid
+        with self._stat_lock:
+            self.n_batches += 1
+            self.n_rows += n_valid
+            in_budget = self.n_batches <= self._span_budget
+            anchor = self._anchor
         self.hist["batch_assemble"].record(assemble_s)
         self.hist["device_score"].record(score_s)
         collector.latency("serve_batch_assemble", assemble_s)
@@ -493,25 +529,29 @@ class ServingEngine:
         collector.event("serve_batch", bucket=bucket, rows=n_valid,
                         path=path, assemble_ms=round(assemble_s * 1e3, 3),
                         score_ms=round(score_s * 1e3, 3))
-        if collector.enabled and self.n_batches <= self._span_budget:
+        if collector.enabled and in_budget:
             collector.trace.add_complete(
                 "batch_assemble", "serve", assemble_s,
-                parent_span=self._anchor, bucket=bucket, rows=n_valid)
+                parent_span=anchor, bucket=bucket, rows=n_valid)
             collector.trace.add_complete(
                 "device_score", "serve", score_s,
-                parent_span=self._anchor, bucket=bucket, rows=n_valid,
+                parent_span=anchor, bucket=bucket, rows=n_valid,
                 path=path)
 
     def _check_recompiles(self) -> None:
         """Post-warmup compile watch: with the tracker active (collection
         enabled), any XLA compile after prewarm is booked and flagged —
         the runtime pin behind the zero-recompiles-under-traffic claim."""
-        if not self.warm or not collector.enabled:
+        if not collector.enabled:
             return
-        delta = tracing.tracker.true_compiles - self._warm_compiles
-        if delta > self.post_warmup_compiles:
+        with self._stat_lock:
+            if not self.warm:
+                return
+            delta = tracing.tracker.true_compiles - self._warm_compiles
             new = delta - self.post_warmup_compiles
-            self.post_warmup_compiles = delta
+            if new > 0:
+                self.post_warmup_compiles = delta
+        if new > 0:
             collector.event("serve_recompile", compiles=new,
                             total_post_warmup=delta)
             _log.warning("serve: %d XLA compile(s) landed AFTER warmup "
@@ -521,18 +561,23 @@ class ServingEngine:
     def metrics(self) -> Dict[str, Any]:
         """Counters + latency quantiles, the /metrics payload (and the
         source bench.py --serving reads instead of re-timing)."""
-        out = {"warm": self.warm,
-               "buckets": list(self.buckets),
-               "max_batch": self.max_batch,
-               "single_record": self.single_record,
-               "requests": self.n_requests,
-               "batches": self.n_batches,
-               "rows": self.n_rows,
-               "shed": self.n_shed,
-               "post_warmup_compiles": self.post_warmup_compiles,
-               "latency": {k: h.to_json() for k, h in self.hist.items()}}
+        with self._stat_lock:
+            out = {"warm": self.warm,
+                   "buckets": list(self.buckets),
+                   "max_batch": self.max_batch,
+                   "single_record": self.single_record,
+                   "requests": self.n_requests,
+                   "batches": self.n_batches,
+                   "rows": self.n_rows,
+                   "shed": self.n_shed,
+                   "post_warmup_compiles": self.post_warmup_compiles,
+                   "monitor_disabled": self.monitor_disabled,
+                   "monitor_errors": self.monitor_errors}
+        out["latency"] = {k: h.to_json() for k, h in self.hist.items()}
+        disabled = out.pop("monitor_disabled")
         if self.monitor is not None:
             out["monitor"] = self.monitor.metrics()
-            out["monitor"]["disabled"] = self.monitor_disabled
-            out["monitor_errors"] = self.monitor_errors
+            out["monitor"]["disabled"] = disabled
+        else:
+            out.pop("monitor_errors")
         return out

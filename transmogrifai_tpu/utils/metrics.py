@@ -306,6 +306,14 @@ class MetricsCollector:
         self.trace = TraceTree()
         self._finished = False
         self._event_log: Optional[EventLog] = None
+        # lifecycle lock (tmoglint THR001): enable/finish/attach run on
+        # the driving thread while event()/latency()/span checks fire
+        # from serving + tileplane threads — the state swap in enable()
+        # must never interleave with a half-read (enabled, trace) pair.
+        # RLock: save() -> finish() nests. Ordering: _lock may be held
+        # while taking TraceTree._lock or EventLog._lock, never the
+        # reverse (THR003)
+        self._lock = threading.RLock()
 
     def enable(self, app_name: str = "transmogrifai_tpu") -> None:
         """Start (or join) a collected run. Reentrancy-safe: when a run is
@@ -315,44 +323,51 @@ class MetricsCollector:
         tree mid-run; the nested run's spans simply join the existing
         tree. disable(), or finish() having closed the run, re-arms a
         fresh enable."""
-        if self.enabled and not self._finished:
-            return
-        self.enabled = True
-        self._finished = False
-        self.current = AppMetrics(app_name=app_name, start_time=time.time())
-        self.trace = TraceTree()
-        # activate BEFORE opening the root span so the fallback tracker
-        # samples the root too — compiles landing at run level (between
-        # child spans) must not be invisible on monitoring-less jax
-        tracing.tracker.activate(self.trace)
-        self.trace.open(app_name, "run")
+        with self._lock:
+            if self.enabled and not self._finished:
+                return
+            self.enabled = True
+            self._finished = False
+            self.current = AppMetrics(app_name=app_name,
+                                      start_time=time.time())
+            self.trace = TraceTree()
+            # activate BEFORE opening the root span so the fallback
+            # tracker samples the root too — compiles landing at run
+            # level (between child spans) must not be invisible on
+            # monitoring-less jax
+            tracing.tracker.activate(self.trace)
+            self.trace.open(app_name, "run")
 
     @property
     def collecting(self) -> bool:
         """True while an UNFINISHED run is being collected — the state a
         nested enable() joins instead of resetting (callers that enable
         conditionally, like runner.run, key their cleanup on this)."""
-        return self.enabled and not self._finished
+        with self._lock:
+            return self.enabled and not self._finished
 
     def disable(self) -> None:
-        self.enabled = False
-        tracing.tracker.deactivate()
+        with self._lock:
+            self.enabled = False
+            tracing.tracker.deactivate()
 
     def finish(self) -> AppMetrics:
         """Close the run. Idempotent: end_time (and therefore
         duration_seconds) freezes on the FIRST call — save() and
         runner._finish both call here, and the second call used to
         silently rewrite the run's duration."""
-        if not self._finished:
-            self.current.end_time = time.time()
-            self.trace.close_all()
-            self._finished = True
-        return self.current
+        with self._lock:
+            if not self._finished:
+                self.current.end_time = time.time()
+                self.trace.close_all()
+                self._finished = True
+            return self.current
 
     # -- event log ---------------------------------------------------------
     @property
     def has_event_log(self) -> bool:
-        return self._event_log is not None
+        with self._lock:
+            return self._event_log is not None
 
     def attach_event_log(self, path: str) -> EventLog:
         """Open (append) the streaming JSONL event log. Events flow
@@ -361,20 +376,30 @@ class MetricsCollector:
         log opens BEFORE the old one closes: a failed open (unwritable
         path) raises with the working log still attached."""
         new_log = EventLog(path)
-        if self._event_log is not None:
-            self._event_log.close()
-        self._event_log = new_log
+        with self._lock:
+            if self._event_log is not None:
+                self._event_log.close()
+            self._event_log = new_log
         return new_log
 
     def detach_event_log(self) -> None:
-        if self._event_log is not None:
-            self._event_log.close()
+        with self._lock:
+            log = self._event_log
             self._event_log = None
+        if log is not None:
+            log.close()
 
     def event(self, event: str, **fields: Any) -> None:
-        """Emit one run event to the attached log (no-op without one)."""
-        if self._event_log is not None:
-            self._event_log.emit(event, **fields)
+        """Emit one run event to the attached log (no-op without one).
+        The reference is taken under the lock, the emit happens outside
+        it: a detach racing a serve-thread event sees either the old log
+        (which swallows writes after close) or none — never a torn
+        state, and the file write never extends the lock hold
+        (tmoglint THR002)."""
+        with self._lock:
+            log = self._event_log
+        if log is not None:
+            log.emit(event, **fields)
 
     # -- spans ---------------------------------------------------------------
     _EVENTED_KINDS = ("run", "workflow", "stage")
@@ -386,10 +411,18 @@ class MetricsCollector:
         records error/error_type when the body raises, samples the device
         memory watermark and recompile attribution at close. Yields the
         Span (None when collection is off) so callers can add attrs."""
-        if not self.enabled:
+        with self._lock:
+            if not self.enabled:
+                sp = trace = None
+            else:
+                # capture the TREE that opened the span: a concurrent
+                # enable() may swap self.trace mid-span, and the close
+                # must land on the tree the span belongs to
+                trace = self.trace
+                sp = trace.open(name, kind, **attrs)
+        if sp is None:
             yield None
             return
-        sp = self.trace.open(name, kind, **attrs)
         if kind in self._EVENTED_KINDS:
             self.event("span_start", name=name, kind=kind)
         err: Optional[str] = None
@@ -399,7 +432,7 @@ class MetricsCollector:
             err = type(e).__name__
             raise
         finally:
-            self.trace.close(sp, error_type=err)
+            trace.close(sp, error_type=err)
             if kind in self._EVENTED_KINDS:
                 self.event("span_end", name=name, kind=kind,
                            wall_seconds=round(sp.duration, 6),
@@ -409,12 +442,19 @@ class MetricsCollector:
     @contextlib.contextmanager
     def span(self, stage_name: str, uid: str, phase: str,
              n_rows: int = 0, n_stages_fused: int = 1) -> Iterator[None]:
-        if not self.enabled:
+        with self._lock:
+            if not self.enabled:
+                sp = trace = cur = None
+            else:
+                t0 = time.time()
+                trace = self.trace
+                cur = self.current
+                sp = trace.open(stage_name, "stage", uid=uid,
+                                phase=phase, n_rows=n_rows,
+                                n_stages_fused=n_stages_fused)
+        if sp is None:
             yield
             return
-        t0 = time.time()
-        sp = self.trace.open(stage_name, "stage", uid=uid, phase=phase,
-                             n_rows=n_rows, n_stages_fused=n_stages_fused)
         self.event("stage_start", stage=stage_name, uid=uid, phase=phase)
         err: Optional[str] = None
         try:
@@ -425,9 +465,9 @@ class MetricsCollector:
             err = type(e).__name__
             raise
         finally:
-            self.trace.close(sp, error_type=err)
+            trace.close(sp, error_type=err)
             wall = time.time() - t0
-            self.current.stage_metrics.append(StageMetric(
+            cur.stage_metrics.append(StageMetric(
                 stage_name=stage_name, uid=uid, phase=phase,
                 wall_seconds=wall, n_rows=n_rows,
                 n_stages_fused=n_stages_fused,
@@ -447,8 +487,10 @@ class MetricsCollector:
         cold=True flags a span whose wall includes jit trace/compile.
         The record also lands as a `kernel` child span of the innermost
         open span (trace export), with `attrs` merged in."""
-        if not self.enabled:
-            return None
+        with self._lock:
+            if not self.enabled:
+                return None
+            cur, trace = self.current, self.trace
         roof = None
         try:
             import jax
@@ -460,8 +502,8 @@ class MetricsCollector:
             kernel=name, wall_seconds=round(wall_seconds, 4),
             bytes_hbm=float(bytes_hbm), cold=cold,
             **roofline_fields(wall_seconds, bytes_hbm, roof))
-        self.current.kernel_metrics.append(rec)
-        self.trace.add_complete(
+        cur.kernel_metrics.append(rec)
+        trace.add_complete(
             name, "kernel", wall_seconds, bytes_hbm=rec.bytes_hbm,
             achieved_gbps=rec.achieved_gbps, roof_gbps=rec.roof_gbps,
             pct_of_roof=rec.pct_of_roof, cold=rec.cold, **(attrs or {}))
@@ -476,8 +518,10 @@ class MetricsCollector:
         The validator reports here after every streamed GLM sweep; bench.py
         reads the same numbers off Validator.last_streamed_telemetry for
         its executed-FLOP accounting."""
-        if not self.enabled:
-            return None
+        with self._lock:
+            if not self.enabled:
+                return None
+            cur, trace = self.current, self.trace
         rec = SweepConvergence(
             family=family, kernel=kernel, rounds=int(rounds),
             data_passes=int(data_passes), lane_passes=int(lane_passes),
@@ -485,8 +529,8 @@ class MetricsCollector:
             active_per_round=[int(v) for v in active_per_round],
             iters_per_round=[int(v) for v in iters_per_round],
             bucket_sizes=[int(v) for v in bucket_sizes])
-        self.current.sweep_metrics.append(rec)
-        self.trace.add_complete(
+        cur.sweep_metrics.append(rec)
+        trace.add_complete(
             f"{family}:{kernel}", "sweep", 0.0, **rec.to_json())
         return rec
 
@@ -504,13 +548,15 @@ class MetricsCollector:
         attribution in the trace's kernel table and BENCH JSON's
         kernel_roofline list), and a `stats_pass` event on the streaming
         event log."""
-        if not self.enabled:
-            return None
+        with self._lock:
+            if not self.enabled:
+                return None
+            cur = self.current
         rec = StatsPass(driver=driver, rows=int(rows), cols=int(cols),
                         tiles=int(tiles), bytes_hbm=float(bytes_hbm),
                         wall_seconds=round(wall_seconds, 6),
                         passes=int(passes), label=label, cold=cold)
-        self.current.stats_metrics.append(rec)
+        cur.stats_metrics.append(rec)
         self.kernel(f"stats_pass[{driver}]", wall_seconds, bytes_hbm,
                     cold=cold, attrs={"rows": int(rows), "cols": int(cols),
                                       "tiles": int(tiles),
@@ -529,13 +575,14 @@ class MetricsCollector:
         per-request/per-phase walls here so p50/p95/p99 ride AppMetrics
         JSON under "latency_metrics" next to the kernel/sweep telemetry —
         same numbers the engine's own /metrics endpoint serves."""
-        if not self.enabled:
-            return None
-        hist = self.current.latency_metrics.get(name)
-        if hist is None:
-            hist = self.current.latency_metrics.setdefault(
-                name, LatencyHistogram(name))
-        hist.record(wall_seconds)
+        with self._lock:
+            if not self.enabled:
+                return None
+            hist = self.current.latency_metrics.get(name)
+            if hist is None:
+                hist = self.current.latency_metrics.setdefault(
+                    name, LatencyHistogram(name))
+        hist.record(wall_seconds)  # the histogram has its own lock
         return hist
 
     def save(self, path: str, close: bool = True) -> None:
@@ -547,15 +594,19 @@ class MetricsCollector:
         JOINED an outer collection (runner.run inside a BENCH_TRACE_DIR
         trace) must not close the outer span tree mid-run — its artifact
         is the enclosing run's state so far, duration up to now."""
-        if close:
-            doc = self.finish().to_json()
-        else:
-            doc = self.current.to_json()
-            if not self._finished:
-                doc["duration_seconds"] = max(
-                    time.time() - self.current.start_time, 0.0)
-        if self.trace.spans:
-            doc["spans"] = self.trace.to_json()
+        with self._lock:
+            # snapshot under the lifecycle lock (latency() inserts into
+            # latency_metrics from serving threads mid-iteration
+            # otherwise); the file write below happens OUTSIDE it
+            if close:
+                doc = self.finish().to_json()
+            else:
+                doc = self.current.to_json()
+                if not self._finished:
+                    doc["duration_seconds"] = max(
+                        time.time() - self.current.start_time, 0.0)
+            if self.trace.spans:
+                doc["spans"] = self.trace.to_json()
         with open(path, "w") as f:
             json.dump(doc, f, indent=2)
 
@@ -566,8 +617,9 @@ class MetricsCollector:
         up to now instead of closing them."""
         if close:
             self.finish()
-        tracing.write_chrome_trace(path, self.trace,
-                                   app_name=self.current.app_name)
+        with self._lock:
+            trace, app_name = self.trace, self.current.app_name
+        tracing.write_chrome_trace(path, trace, app_name=app_name)
 
 
 # the process-wide collector the workflow engine reports to

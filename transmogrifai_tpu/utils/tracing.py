@@ -47,7 +47,7 @@ from typing import Any, Dict, List, Optional, Tuple
 __all__ = [
     "Span", "TraceTree", "RecompileTracker", "tracker", "EventLog",
     "register_jit_fallback", "device_memory_attrs", "chrome_trace",
-    "write_chrome_trace", "trace_report",
+    "write_chrome_trace", "trace_report", "trace_report_rc",
 ]
 
 # the monitoring event one XLA backend compilation emits (jax >= 0.4.x).
@@ -214,9 +214,17 @@ class TraceTree:
         return sp
 
     def close_all(self) -> None:
-        with self._lock:
-            while self._stack:
-                self.close(self._stack[-1])
+        # pop-then-close WITHOUT holding the tree lock across close():
+        # close() re-enters the lock itself and then calls the tracker
+        # hooks outside it — holding the lock here would invert the
+        # tracker->tree order _on_event uses (THR003: a compile landing
+        # on another thread during close_all would deadlock)
+        while True:
+            with self._lock:
+                if not self._stack:
+                    return
+                sp = self._stack[-1]
+            self.close(sp)
 
     # -- derived -----------------------------------------------------------
     def self_seconds(self, sp: Span) -> float:
@@ -284,30 +292,42 @@ class RecompileTracker:
         # pending hit and misclassify a true compile as a cache load
         self._pending = threading.local()
         self.by_program: Dict[str, int] = {}
+        # guards the counters + activation state (tmoglint THR001): the
+        # jax.monitoring listener fires on whatever thread compiles — a
+        # serving dispatcher and a prewarm can land compiles
+        # concurrently, and `total_compiles += 1` unlocked loses
+        # updates exactly where the zero-recompile contract reads them.
+        # Ordering: _lock may be held while taking the tree's lock,
+        # never the reverse (TraceTree calls the tracker hooks OUTSIDE
+        # its own lock)
+        self._lock = threading.RLock()
 
     @property
     def true_compiles(self) -> int:
         """Compiles that actually ran XLA (persistent-cache loads
         excluded) — the serving engine's zero-recompile contract counts
         THESE; a prewarmed restart is all cache hits and reads 0."""
-        return max(self.total_compiles - self.total_cache_hits, 0)
+        with self._lock:
+            return max(self.total_compiles - self.total_cache_hits, 0)
 
     # -- lifecycle ---------------------------------------------------------
     def activate(self, tree: TraceTree) -> None:
-        self._tree = tree
-        self.total_compiles = 0
-        self.total_compile_seconds = 0.0
-        self.total_cache_hits = 0
-        self._pending = threading.local()
-        self.by_program = {}
-        if self._monitoring_available():
-            self._install_listener()
-            self._mode = "monitoring"
-        else:
-            self._mode = "fallback"
+        with self._lock:
+            self._tree = tree
+            self.total_compiles = 0
+            self.total_compile_seconds = 0.0
+            self.total_cache_hits = 0
+            self._pending = threading.local()
+            self.by_program = {}
+            if self._monitoring_available():
+                self._install_listener()
+                self._mode = "monitoring"
+            else:
+                self._mode = "fallback"
 
     def deactivate(self) -> None:
-        self._tree = None
+        with self._lock:
+            self._tree = None
 
     def _monitoring_available(self) -> bool:
         if not self._use_monitoring:
@@ -335,51 +355,59 @@ class RecompileTracker:
 
     # -- monitoring path ---------------------------------------------------
     def _on_event(self, event: str, duration: float, **_kw: Any) -> None:
-        tree = self._tree
-        # the listener survives activate/deactivate cycles (jax has no
-        # public unregister); in fallback mode it must stay silent or a
-        # later re-activation would double-book with the sampler
-        if tree is None or self._mode != "monitoring":
-            return
-        if event == _CACHE_HIT_EVENT:
-            # a persistent-cache retrieval fires immediately BEFORE its
-            # compile event (measured order, same thread); mark the pair
-            # so THIS thread's next compile books as a cache LOAD, not a
-            # true XLA compile
-            self._pending.cache_hit = True
-            return
-        if event != _COMPILE_EVENT:
-            return
-        hit = getattr(self._pending, "cache_hit", False)
-        self._pending.cache_hit = False
-        self.total_compiles += 1
-        self.total_compile_seconds += float(duration)
-        if hit:
-            self.total_cache_hits += 1
-        # the whole read-modify-write under the tree lock: the class
-        # contract says the listener may fire from helper threads, and an
-        # unlocked attrs update would race close()'s watermark update
-        with tree._lock:
-            sp = tree.current()
-            if sp is None:
+        with self._lock:
+            tree = self._tree
+            # the listener survives activate/deactivate cycles (jax has
+            # no public unregister); in fallback mode it must stay
+            # silent or a later re-activation would double-book with
+            # the sampler
+            if tree is None or self._mode != "monitoring":
                 return
-            sp.attrs["compiles"] = int(sp.attrs.get("compiles", 0)) + 1
-            sp.attrs["compile_seconds"] = round(
-                float(sp.attrs.get("compile_seconds", 0.0))
-                + float(duration), 4)
+            if event == _CACHE_HIT_EVENT:
+                # a persistent-cache retrieval fires immediately BEFORE
+                # its compile event (measured order, same thread); mark
+                # the pair so THIS thread's next compile books as a
+                # cache LOAD, not a true XLA compile
+                self._pending.cache_hit = True
+                return
+            if event != _COMPILE_EVENT:
+                return
+            hit = getattr(self._pending, "cache_hit", False)
+            self._pending.cache_hit = False
+            self.total_compiles += 1
+            self.total_compile_seconds += float(duration)
             if hit:
-                sp.attrs["cache_hits"] = \
-                    int(sp.attrs.get("cache_hits", 0)) + 1
-            self.by_program[sp.name] = self.by_program.get(sp.name, 0) + 1
+                self.total_cache_hits += 1
+            # the whole read-modify-write under BOTH locks (tracker then
+            # tree — the documented order): the listener may fire from
+            # helper threads, and an unlocked attrs update would race
+            # close()'s watermark update
+            with tree._lock:
+                sp = tree.current()
+                if sp is None:
+                    return
+                sp.attrs["compiles"] = \
+                    int(sp.attrs.get("compiles", 0)) + 1
+                sp.attrs["compile_seconds"] = round(
+                    float(sp.attrs.get("compile_seconds", 0.0))
+                    + float(duration), 4)
+                if hit:
+                    sp.attrs["cache_hits"] = \
+                        int(sp.attrs.get("cache_hits", 0)) + 1
+                self.by_program[sp.name] = \
+                    self.by_program.get(sp.name, 0) + 1
 
     # -- fallback path (span-boundary sampling) ----------------------------
     def on_span_open(self, sp: Span) -> None:
-        if self._tree is None or self._mode != "fallback":
-            return
+        with self._lock:
+            if self._tree is None or self._mode != "fallback":
+                return
         sp.attrs["_jit_cache0"] = _fallback_cache_size()
 
     def on_span_close(self, sp: Span, tree: TraceTree) -> None:
-        if self._tree is not tree or self._mode != "fallback":
+        with self._lock:
+            active = self._tree is tree and self._mode == "fallback"
+        if not active:
             sp.attrs.pop("_jit_cache0", None)
             return
         base = sp.attrs.pop("_jit_cache0", None)
@@ -400,8 +428,10 @@ class RecompileTracker:
         own = max(delta - booked, 0)
         if own:
             sp.attrs["compiles"] = int(sp.attrs.get("compiles", 0)) + own
-            self.by_program[sp.name] = self.by_program.get(sp.name, 0) + own
-            self.total_compiles += own
+            with self._lock:
+                self.by_program[sp.name] = \
+                    self.by_program.get(sp.name, 0) + own
+                self.total_compiles += own
 
 
 #: process-wide tracker the collector activates per enable()
@@ -461,8 +491,14 @@ class EventLog:
                 "ts": round(time.time(), 6), "event": event}
             rec.update(_jsonable(fields))
             self._seq += 1
+            # this lock EXISTS to serialize the per-event line write +
+            # flush: seq/t monotonicity across threads is the file's
+            # contract, so the I/O inside the critical section is the
+            # design, not an accident
             try:
+                # tmoglint: disable=THR002  serialized write IS the lock's job
                 self._f.write(json.dumps(rec, default=str) + "\n")
+                # tmoglint: disable=THR002  flush pairs with the write
                 self._f.flush()
             except (ValueError, OSError):
                 # closed file / full disk / flaky mount: the liveness
@@ -640,13 +676,29 @@ def _fmt_table(rows: List[List[str]], header: List[str]) -> List[str]:
     return out
 
 
+def trace_report_rc(run_dir: str, check: bool = False,
+                    top: int = 15) -> Tuple[str, int]:
+    """(report text, exit code) with the project-wide code table
+    (docs/static_analysis.md "Exit codes", shared with tmoglint):
+    0 = clean, 1 = validation problems found, 2 = usage error (`run_dir`
+    is not a traced run directory at all — nothing to validate is a
+    caller mistake, not a passing check and not a schema failure)."""
+    text, ok = trace_report(run_dir, check=check, top=top)
+    if text.startswith("trace-report: nothing to read"):
+        return text, 2
+    return text, 0 if ok else 1
+
+
 def trace_report(run_dir: str, check: bool = False,
                  top: int = 15) -> Tuple[str, bool]:
     """Render (report text, ok) for a traced run directory.
 
     Reads every `*trace.json` (chrome traces), `events.jsonl` and
     `*stage_metrics.json` under `run_dir`. With check=True the text is a
-    validation verdict (schema problems listed) and ok=False on any."""
+    validation verdict (schema problems listed) and ok=False on any.
+    CLI callers want :func:`trace_report_rc`, which distinguishes a
+    directory with nothing to read (usage error, exit 2) from real
+    schema problems (exit 1)."""
     trace_files = sorted(_glob.glob(os.path.join(run_dir, "*trace.json")))
     event_log = os.path.join(run_dir, "events.jsonl")
     metric_files = sorted(
