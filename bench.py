@@ -1493,6 +1493,148 @@ def serving_bench(n_rows=None):
     return out
 
 
+# -- fleet scenario (--fleet) ------------------------------------------------
+
+def fleet_bench(n_requests=None):
+    """Scenario config for the serving fleet (fleet/, docs/fleet.md):
+    the same tiny model served DIRECT (in-process engine + batcher),
+    then behind the front router with 1 and with 2 real replica
+    subprocesses — per-config rows/s and p50/p99 from the router's own
+    histogram, plus the router-overhead decomposition (fleet p50 minus
+    the replica-reported engine p50: HTTP hop + routing). Replica
+    children run on the CPU backend (the overhead being measured is
+    host-side); one JSON line."""
+    import shutil
+    import tempfile
+    import threading
+
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    from transmogrifai_tpu.fleet import (HealthProber, Router, Supervisor)
+    from transmogrifai_tpu.fleet.frontend import FleetFrontend
+    from transmogrifai_tpu.models.glm import OpLogisticRegression
+    from transmogrifai_tpu.readers.readers import ListReader
+    from transmogrifai_tpu.serve import MicroBatcher, ServingEngine
+    from transmogrifai_tpu.stages.params import param_grid
+    from transmogrifai_tpu.workflow import Workflow
+
+    n_req = int(n_requests) if n_requests else 300
+    d = 8
+    rng = np.random.default_rng(0)
+    beta = rng.normal(size=d)
+
+    def rec(i):
+        x = rng.normal(size=d)
+        return {**{f"x{j}": float(x[j]) for j in range(d)},
+                "y": float(x @ beta > 0)}
+
+    train_rows = [rec(i) for i in range(2000)]
+    preds = [FeatureBuilder.Real(f"x{j}").extract(
+        lambda r, j=j: r.get(f"x{j}")).as_predictor() for j in range(d)]
+    fy = FeatureBuilder.RealNN("y").extract(
+        lambda r: r.get("y")).as_response()
+    fsum = (preds[0] + preds[1]) + 1.0
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[(OpLogisticRegression(),
+                                param_grid(reg_param=[0.01]))],
+    ).set_input(fy, transmogrify(preds + [fsum])).get_output()
+    with contextlib.redirect_stdout(io.StringIO()):
+        model = Workflow().set_reader(ListReader(train_rows)) \
+            .set_result_features(pred).train()
+
+    tmp = tempfile.mkdtemp(prefix="bench_fleet_")
+    out = {"metric": "fleet", "n_requests": n_req}
+    try:
+        mdir = os.path.join(tmp, "model")
+        model.save(mdir)
+        records = [{k: v for k, v in rec(i).items() if k != "y"}
+                   for i in range(n_req)]
+
+        # DIRECT baseline: in-process engine + micro-batcher
+        engine = ServingEngine(mdir, max_batch=16, strict_keys=False)
+        engine.prewarm()
+        batcher = MicroBatcher(engine, max_wait_ms=1.0, max_queue=4096)
+        t0 = time.perf_counter()
+        for r in records:
+            batcher.submit(r)
+        # submit blocks per record: wall is the sequential total
+        wall = time.perf_counter() - t0  # tmoglint: disable=TPU005
+        batcher.shutdown(drain=True)
+        md = engine.metrics()
+        out["direct"] = {
+            "rows_per_s": round(n_req / max(wall, 1e-9)),
+            "p50_ms": md["latency"]["total"]["p50_ms"],
+            "p99_ms": md["latency"]["total"]["p99_ms"]}
+
+        env = {"JAX_PLATFORMS": "cpu",
+               "TMOG_COMPILE_CACHE_DIR": os.path.join(tmp, "cache"),
+               "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))}
+        for n_replicas in (1, 2):
+            lock = threading.RLock()
+            sup = Supervisor(
+                mdir, replicas=n_replicas, lock=lock,
+                metrics_root=os.path.join(tmp, f"fleet{n_replicas}"),
+                serve_args=["--max-batch", "16", "--max-wait-ms", "1",
+                            "--monitor", "off"],
+                env=env, startup_timeout_s=300.0)
+            router = Router(lock, request_timeout=60.0)
+            prober = None
+            try:
+                router.set_champions(sup.start())
+                prober = HealthProber(router, interval_s=0.25).start()
+                fe = FleetFrontend(sup, router)
+                errs = []
+
+                def fire(rs):
+                    for r in rs:
+                        try:
+                            fe.submit(r)
+                        except Exception as e:  # noqa: BLE001
+                            errs.append(repr(e))
+
+                chunk = max(n_req // 4, 1)
+                t0 = time.perf_counter()
+                ths = [threading.Thread(
+                    target=fire, args=(records[k * chunk:
+                                               (k + 1) * chunk],))
+                    for k in range(4)]
+                for t in ths:
+                    t.start()
+                for t in ths:
+                    t.join(600)
+                # fe.submit returns parsed responses: all synced
+                wall = time.perf_counter() - t0  # tmoglint: disable=TPU005
+                served = router.n_requests
+                fm = fe.metrics()
+                rj = router.hist.to_json()
+                engine_p50 = fm["latency"].get("total", {}).get("p50_ms")
+                cfg = {
+                    "rows_per_s": round(served / max(wall, 1e-9)),
+                    "p50_ms": rj["p50_ms"], "p99_ms": rj["p99_ms"],
+                    "engine_p50_ms": engine_p50,
+                    "router_overhead_p50_ms": (
+                        round(rj["p50_ms"] - engine_p50, 4)
+                        if engine_p50 is not None else None),
+                    "retries": router.n_retries, "shed": router.n_shed,
+                    "post_warmup_compiles": fm["post_warmup_compiles"],
+                }
+                if errs:
+                    cfg["errors"] = errs[:5]
+                out[f"replicas_{n_replicas}"] = cfg
+            finally:
+                if prober is not None:
+                    prober.stop()
+                sup.stop(router=router)
+        r1 = out.get("replicas_1", {}).get("rows_per_s") or 1
+        r2 = out.get("replicas_2", {}).get("rows_per_s")
+        if r2:
+            out["scaling_2_over_1"] = round(r2 / r1, 3)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # -- cpu-subprocess phases --------------------------------------------------
 # Tiny example flows and the host-transform-dominated wide bench dispatch
 # hundreds of small programs; over a remote TPU tunnel every dispatch pays
@@ -1590,6 +1732,10 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--serving":
         print(json.dumps(serving_bench(
+            sys.argv[2] if len(sys.argv) > 2 else None)))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--fleet":
+        print(json.dumps(fleet_bench(
             sys.argv[2] if len(sys.argv) > 2 else None)))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--tree-sweep":

@@ -555,6 +555,180 @@ print(f"monitor offline smoke ok: shifted file -> drift "
       f"({drifted['alerts_total']} alerts), quiet file -> ok")
 PY
 rm -rf "$MON_TMP"
+# fleet smoke (docs/fleet.md): fit+save -> REAL CLI --prewarm-only into a
+# shared compile cache -> 2-replica fleet of real serve subprocesses ->
+# concurrent traffic -> kill -9 one replica mid-traffic (zero failed
+# requests; the router retries onto the survivor) -> the supervisor
+# restarts it and the REJOIN performs 0 true XLA compiles, asserted from
+# the restarted incarnation's SAVED event artifact (serve_prewarm
+# carries the RecompileTracker counters) -> shadow-rollout a
+# byte-identical v2 -> clean verdict -> atomic swap under traffic ->
+# trace-report --check green on the fleet log and on the restarted
+# replica's artifacts.
+FLEET_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - "$FLEET_TMP" <<'PY'
+import sys
+
+import numpy as np
+
+out = sys.argv[1]
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.workflow import Workflow
+
+rng = np.random.default_rng(0)
+rows = [{"a": float(rng.normal()), "b": float(rng.normal()),
+         "y": float(rng.integers(0, 2))} for _ in range(400)]
+fa = FeatureBuilder.Real("a").extract(lambda r: r.get("a")).as_predictor()
+fb = FeatureBuilder.Real("b").extract(lambda r: r.get("b")).as_predictor()
+fy = FeatureBuilder.RealNN("y").extract(lambda r: r.get("y")).as_response()
+fsum = (fa + fb) + 1.0  # a jitted stage: compile accounting is real
+pred = BinaryClassificationModelSelector.with_train_validation_split(
+    models_and_parameters=[(OpLogisticRegression(),
+                            param_grid(reg_param=[0.01]))],
+).set_input(fy, transmogrify([fa, fb, fsum])).get_output()
+Workflow().set_reader(ListReader(rows)) \
+    .set_result_features(pred).train().save(out + "/model")
+print("fleet smoke: model saved")
+PY
+JAX_PLATFORMS=cpu TMOG_COMPILE_CACHE_DIR="$FLEET_TMP/cache" \
+  PYTHONPATH="$PWD" python -m transmogrifai_tpu serve "$FLEET_TMP/model" \
+  --prewarm-only --max-batch 16
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - "$FLEET_TMP" <<'PY'
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+
+tmp = sys.argv[1]
+from transmogrifai_tpu.fleet import (HealthProber, RolloutManager, Router,
+                                     Supervisor)
+from transmogrifai_tpu.fleet.frontend import FleetFrontend
+from transmogrifai_tpu.utils.metrics import collector
+
+v1 = tmp + "/model"
+v2 = tmp + "/model_v2"
+shutil.copytree(v1, v2)
+os.remove(v2 + "/serve.json")  # v2 gets its OWN stamped manifest
+
+env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": os.getcwd(),
+       "TMOG_COMPILE_CACHE_DIR": tmp + "/cache"}
+collector.enable("ci_fleet")
+collector.attach_event_log(tmp + "/fleet_events.jsonl")
+lock = threading.RLock()
+sup = Supervisor(v1, replicas=2, lock=lock, metrics_root=tmp + "/fleet",
+                 serve_args=["--max-batch", "16", "--max-wait-ms", "2",
+                             "--monitor", "off"],
+                 env=env, backoff_base_s=0.2, startup_timeout_s=300.0)
+router = Router(lock, request_timeout=60.0)
+router.set_champions(sup.start())
+prober = HealthProber(router, interval_s=0.25).start()
+rollout = RolloutManager(sup, router, lock=lock)
+fe = FleetFrontend(sup, router, rollout)
+
+errors = []
+rng_rec = [{"a": 0.1 * i, "b": -0.05 * i} for i in range(50)]
+
+
+def fire(n, sleep=0.01):
+    for i in range(n):
+        try:
+            assert fe.submit(rng_rec[i % len(rng_rec)])
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+        time.sleep(sleep)
+
+
+# concurrent traffic, then kill -9 one replica mid-flight
+threads = [threading.Thread(target=fire, args=(30,)) for _ in range(4)]
+for t in threads:
+    t.start()
+time.sleep(0.3)
+victim = router.champions[0]
+inc0 = victim.incarnation
+pid = sup.kill_replica(victim)
+print(f"fleet smoke: kill -9 {victim.name} pid={pid} mid-traffic")
+for t in threads:
+    t.join(120)
+assert not errors, errors[:5]  # ZERO failed requests past the kill
+deadline = time.monotonic() + 240
+while time.monotonic() < deadline:
+    if victim.incarnation > inc0 and victim.healthy:
+        break
+    time.sleep(0.1)
+assert victim.healthy and victim.incarnation > inc0, "no rejoin"
+assert sup.rejoin_violations == 0, "rejoin compiled"
+restarted_dir = victim.metrics_dir  # the NEW incarnation's artifacts
+p99 = router.hist.to_json()["p99_ms"]
+assert 0 < p99 < 60000, p99
+
+# shadow-rollout the byte-identical v2: clean verdict -> atomic swap,
+# all under continued traffic
+stopper = threading.Event()
+
+
+def pump():
+    i = 0
+    while not stopper.is_set():
+        try:
+            fe.submit(rng_rec[i % len(rng_rec)])
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+        i += 1
+        time.sleep(0.01)
+
+
+pumps = [threading.Thread(target=pump) for _ in range(2)]
+for t in pumps:
+    t.start()
+try:
+    rollout.start(v2, replicas=1, fraction=1.0, min_shadow=16)
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline and rollout.state == "shadow":
+        time.sleep(0.1)
+finally:
+    stopper.set()
+    for t in pumps:
+        t.join(60)
+assert rollout.state == "swapped", rollout.status()
+assert not errors, errors[:5]  # zero dropped requests through the swap
+assert all(h.model_dir == v2 for h in router.champions)
+assert fe.submit(rng_rec[0])  # v2 serves
+m = fe.metrics()
+assert m["post_warmup_compiles"] == 0, m
+prober.stop()
+sup.stop(router=router)
+collector.detach_event_log()
+collector.disable()
+
+# the compile-free REJOIN, from the SAVED artifact (not process state):
+# the restarted incarnation's serve_prewarm event carries the
+# RecompileTracker counters it booked at startup
+ev = [json.loads(l) for l in open(restarted_dir + "/events.jsonl")]
+pw = [e for e in ev if e["event"] == "serve_prewarm"]
+assert pw and pw[0]["compiles"] == 0 and pw[0]["cache_hits"] > 0, pw
+with open(tmp + "/restarted_dir.txt", "w") as f:
+    f.write(restarted_dir)
+fl = [json.loads(l) for l in open(tmp + "/fleet_events.jsonl")]
+names = {e["event"] for e in fl}
+assert {"fleet_replica_down", "fleet_replica_up", "fleet_rollout_started",
+        "fleet_rollout_swapped"} <= names, names
+print(f"fleet smoke ok: kill -9 survived with 0 errors (p99 {p99}ms), "
+      f"rejoin 0 compiles ({pw[0]['cache_hits']} cache hits, from the "
+      f"artifact), v2 swapped under traffic")
+PY
+# trace-report --check green on the fleet event log AND the restarted
+# replica's own artifacts
+PYTHONPATH="$PWD" python -m transmogrifai_tpu trace-report \
+  "$(cat "$FLEET_TMP/restarted_dir.txt")" --check > /dev/null
+echo "  fleet trace-report: restarted replica artifacts clean"
+rm -rf "$FLEET_TMP"
 # tree-sweep smoke on the 2-device CPU mesh: the mesh-sharded fused sweep
 # (TMOG_GRID_FUSE=1 + a mesh validator) must take the
 # mask_folds:grid_fused_sharded route, match the meshless fused kernel's

@@ -3,6 +3,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 from transmogrifai_tpu import FeatureBuilder
 from transmogrifai_tpu.automl.transmogrifier import transmogrify
@@ -91,3 +92,70 @@ class TestCustomEvaluator:
         assert best.validated[0].metric_name == "neg_brier"
         # lower regularization should win on separable data
         assert best.best_grid["reg_param"] == 0.01
+
+
+class TestLatencyHistogramMerge:
+    """merge()/from_json() (the fleet telemetry substrate,
+    docs/fleet.md): exact bucket-sum semantics — the fleet p99 from
+    summed per-replica buckets must equal one histogram that recorded
+    the union stream."""
+
+    def _record(self, h, vals):
+        for v in vals:
+            h.record(float(v))
+
+    def test_merge_equals_union_stream_quantiles(self):
+        from transmogrifai_tpu.utils.metrics import LatencyHistogram
+        rng = np.random.default_rng(7)
+        for trial in range(5):
+            xs = rng.lognormal(-6 + trial, 1.5, size=400)
+            ys = rng.lognormal(-5, 0.5 + 0.3 * trial, size=250)
+            a, b, u = (LatencyHistogram("t"), LatencyHistogram("t"),
+                       LatencyHistogram("t"))
+            self._record(a, xs)
+            self._record(b, ys)
+            self._record(u, list(xs) + list(ys))
+            a.merge(b)
+            assert a.count == u.count == 650
+            # quantiles read only bucket counts + max: EXACT equality
+            for q in (0.0, 0.25, 0.5, 0.9, 0.95, 0.99, 0.999, 1.0):
+                assert a.quantile(q) == u.quantile(q), (trial, q)
+            assert a.max_seconds == u.max_seconds
+            assert a.total_seconds == pytest.approx(u.total_seconds,
+                                                    rel=1e-9)
+
+    def test_merge_with_empty_is_identity(self):
+        from transmogrifai_tpu.utils.metrics import LatencyHistogram
+        rng = np.random.default_rng(3)
+        h = LatencyHistogram("t")
+        self._record(h, rng.lognormal(-6, 2, 100))
+        before = h.to_json()
+        h.merge(LatencyHistogram("empty"))
+        assert h.to_json() == before
+        # and the other direction: empty.merge(h) == h
+        e = LatencyHistogram("t")
+        e.merge(h)
+        assert e.to_json() == before
+
+    def test_from_json_roundtrip_bitexact(self):
+        from transmogrifai_tpu.utils.metrics import LatencyHistogram
+        rng = np.random.default_rng(11)
+        h = LatencyHistogram("serve_total")
+        self._record(h, rng.lognormal(-7, 2.5, 300))
+        h.record(0.0)      # floor bucket
+        h.record(5000.0)   # overflow bucket
+        doc = h.to_json()
+        r = LatencyHistogram.from_json(doc)
+        assert r.to_json() == doc
+        # merging two from_json copies doubles every bucket exactly
+        r2 = LatencyHistogram.from_json(doc)
+        r.merge(r2)
+        assert r.count == 2 * h.count
+        assert sum(r._counts) == 2 * sum(h._counts)
+
+    def test_from_json_rejects_unknown_bucket(self):
+        from transmogrifai_tpu.utils.metrics import LatencyHistogram
+        with pytest.raises(ValueError):
+            LatencyHistogram.from_json(
+                {"name": "x", "count": 1, "mean_ms": 1.0, "max_ms": 1.0,
+                 "buckets_ms": {"not-a-bucket": 1}})

@@ -533,6 +533,46 @@ class TestHTTPFrontend:
         code, _ = self._req(port, "/nope")
         assert code == 404
 
+    def test_drain_flips_healthz_and_drops_nothing(self, server):
+        """The /drain satellite (docs/fleet.md): GET /drain flips
+        /healthz to draining-503 so a router/LB rotates the replica out
+        BEFORE SIGTERM — while every in-flight and still-arriving
+        request keeps scoring (the no-dropped-requests pin)."""
+        port, pred = server
+        errors, oks = [], []
+
+        def fire(n):
+            for _ in range(n):
+                try:
+                    code, out = self._req(port, "/score",
+                                          {"a": 0.1, "b": 0.2, "c": "x"})
+                    assert code == 200 and pred.name in out, (code, out)
+                    oks.append(1)
+                except Exception as e:  # noqa: BLE001 - tallied below
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=fire, args=(8,))
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        # flip the drain mid-traffic
+        code, d = self._req(port, "/drain")
+        assert code == 200 and d["draining"] is True
+        assert d["status"] == "draining"
+        for t in threads:
+            t.join(60)
+        assert not errors, errors[:3]
+        assert len(oks) == 32  # nothing dropped
+        # the LB view: healthz is 503/draining, idempotently
+        code, h = self._req(port, "/healthz")
+        assert code == 503 and h["status"] == "draining"
+        code, h = self._req(port, "/drain")
+        assert code == 200 and h["status"] == "draining"
+        # ... and scoring STILL works (drain is rotation, not refusal)
+        code, out = self._req(port, "/score",
+                              {"a": 0.0, "b": 0.0, "c": "y"})
+        assert code == 200 and pred.name in out
+
     def test_bulk_above_max_bulk_is_413(self, fitted):
         model, _, _ = fitted
         eng = ServingEngine(model, max_batch=8)
@@ -590,6 +630,70 @@ class TestServeEvents:
         text, ok = trace_report(str(tmp_path), check=True)
         assert not ok
         assert "serve_recompile" in text
+
+
+class TestManifestFreshness:
+    """The serve.json freshness stamp (docs/fleet.md "The manifest
+    contract"): --prewarm-only stamps model hash + monitor presence;
+    adoption verifies both — warning by default, rc 2 under
+    --strict-manifest (how a fleet replica refuses to join)."""
+
+    def _saved(self, fitted, tmp_path):
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+        model, _, _ = fitted
+        mdir = str(tmp_path / "model")
+        model.save(mdir)
+        m2 = WorkflowModel.load(mdir)
+        eng = ServingEngine(m2, buckets=[1, 4])
+        eng.write_manifest()
+        return mdir
+
+    def test_fresh_manifest_verifies_clean(self, fitted, tmp_path):
+        from transmogrifai_tpu.workflow.io import load_serve_manifest
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+        mdir = self._saved(fitted, tmp_path)
+        manifest = load_serve_manifest(mdir)
+        assert manifest["model_hash"] and len(manifest["model_hash"]) == 16
+        assert isinstance(manifest["monitor_profile"], bool)
+        eng = ServingEngine(WorkflowModel.load(mdir))
+        assert eng.manifest_mismatch == []
+
+    def test_stale_hash_warns_and_strict_refuses(self, fitted, tmp_path):
+        import argparse
+        from transmogrifai_tpu.serve.frontend import run_serve
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+        mdir = self._saved(fitted, tmp_path)
+        # the model is re-saved/modified AFTER the prewarm stamped it
+        with open(os.path.join(mdir, "arrays.npz"), "ab") as f:
+            f.write(b"drift")
+        eng = ServingEngine(WorkflowModel.load(mdir))
+        assert eng.manifest_mismatch  # adoption NOTICED (warning path)
+        assert any("model_hash" in p for p in eng.manifest_mismatch)
+        # --strict-manifest: the same staleness is a startup refusal
+        args = argparse.Namespace(
+            model_dir=mdir, max_batch=8, buckets=None, example=None,
+            single_record="bucket", monitor="off", metrics_location=None,
+            strict_manifest=True, prewarm_only=True)
+        assert run_serve(args) == 2
+
+    def test_explicit_bucket_disagreement_is_flagged(self, fitted,
+                                                     tmp_path):
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+        mdir = self._saved(fitted, tmp_path)
+        eng = ServingEngine(WorkflowModel.load(mdir), buckets=[1, 8, 16])
+        assert any("bucket ladder" in p for p in eng.manifest_mismatch)
+
+    def test_monitor_profile_change_is_flagged(self, fitted, tmp_path):
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+        mdir = self._saved(fitted, tmp_path)
+        mon = os.path.join(mdir, "monitor.json")
+        if os.path.exists(mon):
+            os.remove(mon)  # profile vanished since the stamp
+        else:
+            with open(mon, "w") as f:
+                json.dump({"features": []}, f)  # profile appeared
+        eng = ServingEngine(WorkflowModel.load(mdir))
+        assert any("monitor.json" in p for p in eng.manifest_mismatch)
 
 
 class TestPrewarmManifestAndPersistentCache:

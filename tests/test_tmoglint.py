@@ -585,8 +585,108 @@ class TestTHR001:
         """, rules=["THR001"])
         assert out == []
 
+    def test_negative_shared_lock_across_classes(self):
+        """ONE lock object passed into two collaborating classes (the
+        fleet pattern): `self.lock = lock or RLock()` registers a SHARED
+        lock whose identity canonicalizes by name, so a write under
+        Owner A's alias and a read under Owner B's alias intersect."""
+        out = lint("""
+            import threading
 
-# -- THR002: blocking under a lock -------------------------------------------
+            class Handle:
+                def __init__(self):
+                    self.port = 0
+
+            class Supervisor:
+                def __init__(self, handle: Handle, lock=None):
+                    self.lock = lock or threading.RLock()
+                    self.handle = handle
+                    threading.Thread(target=self._watch).start()
+
+                def _watch(self):
+                    with self.lock:
+                        self.handle.port = 99
+
+            class Router:
+                def __init__(self, handle: Handle, lock=None):
+                    self.lock = lock or threading.RLock()
+                    self.handle = handle
+
+                def pick(self):
+                    with self.lock:
+                        return self.handle.port
+        """, rules=["THR001"])
+        assert rule_lines(out, "THR001") == []
+
+    def test_shared_lock_does_not_blind_unlocked_side(self):
+        """The shared-lock alias must not exempt a genuinely unlocked
+        access: same shape as above but the reader takes no lock."""
+        out = lint("""
+            import threading
+
+            class Handle:
+                def __init__(self):
+                    self.port = 0
+
+            class Supervisor:
+                def __init__(self, handle: Handle, lock=None):
+                    self.lock = lock or threading.RLock()
+                    self.handle = handle
+                    threading.Thread(target=self._watch).start()
+
+                def _watch(self):
+                    with self.lock:
+                        self.handle.port = 99
+
+            class Router:
+                def __init__(self, handle: Handle, lock=None):
+                    self.lock = lock or threading.RLock()
+                    self.handle = handle
+
+                def pick(self):
+                    return self.handle.port
+
+                def use(self):
+                    t = threading.Thread(target=self.pick)
+                    t.start()
+        """, rules=["THR001"])
+        assert rule_lines(out, "THR001"), "unlocked reader side missed"
+
+    def test_shared_lock_is_one_thr003_node(self):
+        """Two classes aliasing ONE shared lock and calling into each
+        other while holding it read, pre-canonicalization, as
+        `A.lock -> B.lock` plus `B.lock -> A.lock` — a bogus inversion.
+        It is one reentrant lock: no cycle."""
+        out = lint("""
+            import threading
+
+            class A:
+                def __init__(self, lock, b):
+                    self.lock = lock or threading.RLock()
+                    self.b = b
+
+                def enter_a(self):
+                    with self.lock:
+                        self.b.leaf_b()
+
+                def leaf_a(self):
+                    with self.lock:
+                        pass
+
+            class B:
+                def __init__(self, lock, a):
+                    self.lock = lock or threading.RLock()
+                    self.a = a
+
+                def enter_b(self):
+                    with self.lock:
+                        self.a.leaf_a()
+
+                def leaf_b(self):
+                    with self.lock:
+                        pass
+        """, rules=["THR003"])
+        assert rule_lines(out, "THR003") == []
 
 class TestTHR002:
     def test_sleep_under_lock(self):

@@ -127,6 +127,18 @@ class FileThreads:
         self.singletons: Dict[str, str] = {}
         # lock/condition/event/queue/file-typed ids (class-qualified)
         self.lock_ids: Set[str] = set()
+        # SHARED locks: `self.X = <expr referencing a 'lock'-named
+        # parameter>` in __init__ — one lock object passed into several
+        # collaborating classes (the fleet pattern: Supervisor, Router
+        # and RolloutManager guard the shared ReplicaHandle state with
+        # ONE fleet RLock). Their identity canonicalizes by attribute
+        # name tail ("<shared>::lock"), so `with self.lock:` held in
+        # any of the classes intersects with the others — the same
+        # name-affinity bet the call resolver makes. Cost: two
+        # UNRELATED classes both taking a `lock=` parameter would alias;
+        # acceptable for a lattice that must not flood designed
+        # shared-lock architectures with THR001.
+        self.shared_lock_ids: Set[str] = set()
         self.condition_ids: Set[str] = set()
         self.event_ids: Set[str] = set()
         self.queue_ids: Set[str] = set()
@@ -246,10 +258,33 @@ class FileThreads:
 
         walk_defs(self.ctx.tree, None, "")
 
+    def _record_shared_lock(self, fn: FuncNode, node: ast.Assign) -> None:
+        """Register `self.X = <expr referencing a 'lock'-named param>`
+        in __init__ as a shared lock (see shared_lock_ids)."""
+        if fn.name != "__init__" or fn.cls is None:
+            return
+        t = node.targets[0]
+        if not (isinstance(t, ast.Attribute) and "lock" in t.attr.lower()):
+            return
+        tid = _target_id(fn.cls, t, self.path)
+        if tid is None:
+            return
+        params = set(self._param_annotations or ())
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Name) and sub.id in params and \
+                    "lock" in sub.id.lower():
+                self.lock_ids.add(tid)
+                self.shared_lock_ids.add(tid)
+                return
+
     def _lock_id_of(self, expr: ast.expr, fn: FuncNode) -> Optional[str]:
         """Lock id for a with/call receiver expr, or None when the expr
         is not a known lock."""
         tid = _expr_id(fn.cls, expr, self.path)
+        if tid is not None and tid in self.shared_lock_ids:
+            # one object behind N class-qualified names: canonicalize
+            # so held-sets intersect across the sharing classes
+            return "<shared>::" + tid.split(".")[-1]
         if tid is not None and tid in self.lock_ids:
             return tid
         # `with lock:` on a bare local/param whose NAME matches a known
@@ -331,6 +366,7 @@ class FileThreads:
             lockset = frozenset(held)
             if isinstance(node, ast.Assign):
                 self._record_typed(fn.cls, node.targets[0], node.value)
+                self._record_shared_lock(fn, node)
                 # getattr(obj, "literal") alias for later call resolution
                 if isinstance(node.value, ast.Call) and \
                         dotted_name(node.value.func) == "getattr" and \

@@ -421,6 +421,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="compile every bucket, populate the persistent "
                          "compile cache (TMOG_COMPILE_CACHE_DIR), write "
                          "the serve.json manifest and exit")
+    sv.add_argument("--strict-manifest", action="store_true",
+                    help="refuse to start (rc 2) when the serve.json "
+                         "manifest's model hash / monitor stamp / bucket "
+                         "ladder disagrees with the artifact (the fleet "
+                         "replica contract, docs/fleet.md); default is a "
+                         "startup warning")
     sv.add_argument("--metrics-location", default=None,
                     help="dir for events.jsonl + trace artifacts "
                          "(enables span collection + the recompile "
@@ -439,6 +445,53 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     sv.add_argument("--monitor-health-gate", action="store_true",
                     help="degrade /healthz to 503 while a drift alert "
                          "is active (hard gate for load balancers)")
+    fl = sub.add_parser(
+        "fleet",
+        help="serving FLEET over a saved model: N replica worker "
+             "processes sharing one compile cache behind a front router "
+             "with merged /metrics + /drift and zero-downtime "
+             "champion/challenger rollout (docs/fleet.md)")
+    fl.add_argument("model_dir", help="saved WorkflowModel directory "
+                                      "(run `serve --prewarm-only` "
+                                      "first, or the fleet will)")
+    fl.add_argument("--replicas", type=int, default=2,
+                    help="champion replica count (default 2)")
+    fl.add_argument("--host", default="127.0.0.1",
+                    help="front-router bind host")
+    fl.add_argument("--port", type=int, default=8766,
+                    help="front-router HTTP port (0 = ephemeral)")
+    fl.add_argument("--replica-host", default="127.0.0.1",
+                    help="host replicas bind (and the router dials)")
+    fl.add_argument("--max-batch", type=int, default=None,
+                    help="per-replica bucket-ladder top (serve "
+                         "--max-batch pass-through)")
+    fl.add_argument("--buckets", default=None,
+                    help="explicit per-replica bucket ladder "
+                         "(pass-through)")
+    fl.add_argument("--max-wait-ms", type=float, default=None,
+                    help="per-replica micro-batch fill window "
+                         "(pass-through)")
+    fl.add_argument("--max-queue", type=int, default=None,
+                    help="per-replica admission queue bound "
+                         "(pass-through)")
+    fl.add_argument("--single-record", choices=["bucket", "local"],
+                    default=None, help="per-replica batch-of-one route "
+                                       "(pass-through)")
+    fl.add_argument("--monitor", choices=["auto", "on", "off"],
+                    default="auto",
+                    help="per-replica drift monitoring; the fleet pools "
+                         "replica windows into ONE /drift verdict")
+    fl.add_argument("--probe-interval-s", type=float, default=0.5,
+                    help="router /healthz probe cadence")
+    fl.add_argument("--request-timeout-s", type=float, default=30.0,
+                    help="per-replica request timeout (504 beyond it; "
+                         "timeouts are never retried)")
+    fl.add_argument("--max-restarts", type=int, default=20,
+                    help="per-replica crash-restart budget")
+    fl.add_argument("--metrics-location", default=None,
+                    help="fleet events.jsonl + per-replica-incarnation "
+                         "artifact dirs (default: "
+                         "<model_dir>/fleet_metrics)")
     mo = sub.add_parser(
         "monitor",
         help="offline drift report: score a bulk file through the "
@@ -490,6 +543,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if a.command == "serve":
         from .serve.frontend import run_serve
         return run_serve(a)
+    if a.command == "fleet":
+        from .fleet.frontend import run_fleet
+        return run_fleet(a)
     if a.command == "monitor":
         from .monitor.offline import run_monitor
         return run_monitor(a)

@@ -1,0 +1,42 @@
+"""Serving FLEET: replicas, routing, merged telemetry, zero-downtime
+rollout (docs/fleet.md).
+
+PR 7/9 built one excellent serving replica (serve/ + monitor/); millions
+of users need N of them, operated. This package turns one serving
+process into a fleet:
+
+- :mod:`supervisor` — N ``serve`` worker PROCESSES from one model dir,
+  all sharing one ``TMOG_COMPILE_CACHE_DIR`` and the ``serve.json``
+  prewarm manifest (the FLEET CONTRACT: a replica refuses to join when
+  its model hash or bucket ladder disagrees), restart-on-crash with
+  exponential backoff, and the compile-free-rejoin check read off the
+  RecompileTracker counters;
+- :mod:`router` — least-outstanding-requests spread over healthy
+  replicas, per-replica /healthz probing, retry-once on connection
+  error, fleet-level load shed when every replica sheds, drain
+  coordination for rolling restarts;
+- :mod:`telemetry` — fleet ``/metrics`` and ``/drift`` that MERGE
+  per-replica state: latency histograms by exact bucket sum, monitor
+  window sketches pooled before ONE DriftPolicy verdict (the DrJAX
+  MapReduce shape applied host-side across processes);
+- :mod:`rollout` — champion/challenger: model v2 loads BESIDE v1, a
+  fraction of live traffic shadow-scores on v2 (responses always from
+  v1), the drift engine compares the two prediction distributions, and
+  a clean verdict atomically swaps the pools — a bad challenger tears
+  down without a dropped request;
+- :mod:`frontend` — the fleet HTTP server + the
+  ``python -m transmogrifai_tpu fleet <model_dir> --replicas N`` CLI.
+"""
+from .frontend import FleetFrontend, make_fleet_server, run_fleet
+from .rollout import RolloutConflict, RolloutManager
+from .router import (FleetUnavailable, HealthProber, ReplicaHandle,
+                     Router)
+from .supervisor import Supervisor
+from .telemetry import fleet_drift, fleet_metrics, merge_window_states
+
+__all__ = [
+    "FleetFrontend", "FleetUnavailable", "HealthProber", "ReplicaHandle",
+    "RolloutConflict", "RolloutManager", "Router", "Supervisor",
+    "fleet_drift", "fleet_metrics", "make_fleet_server",
+    "merge_window_states", "run_fleet",
+]

@@ -1,0 +1,386 @@
+"""Fleet HTTP frontend + the ``fleet`` CLI body.
+
+One stdlib ThreadingHTTPServer in front of the replica pool — the same
+transport-thin discipline as ``serve/frontend.py``: every decision lives
+in :class:`FleetFrontend` (which tests and bench drive in-process), the
+handler only maps it onto HTTP.
+
+Endpoints:
+  POST /score          routed to the least-loaded healthy replica
+                       (retry-once on connection error; fleet-level 503
+                       when every replica sheds)
+  GET  /healthz        fleet health: replica table + rollout state
+  GET  /metrics        MERGED telemetry: counters summed, latency
+                       histograms bucket-sum merged (fleet/telemetry)
+  GET  /drift          pooled drift verdict over the replicas' current
+                       window states (one DriftPolicy evaluation)
+  GET  /drain          fleet drain: healthz -> 503 (LB rotation), then
+                       the operator stops the fleet
+  POST /rollout        {"model_dir": .., "fraction": .., "min_shadow":
+                       ..} -> start a champion/challenger rollout
+  GET  /rollout        rollout status (state machine + last verdict)
+  POST /rollout/abort  tear the challenger down, keep champions
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from ..monitor.alerts import DriftPolicy
+from ..monitor.profile import ReferenceProfile
+from ..utils.metrics import collector
+from ..workflow.io import load_monitor_profile
+from . import telemetry
+from .rollout import RolloutConflict, RolloutManager
+from .router import (FleetUnavailable, HealthProber, Router, get_json)
+from .supervisor import Supervisor
+
+_log = logging.getLogger("transmogrifai_tpu.fleet")
+
+Record = Dict[str, Any]
+
+
+class FleetFrontend:
+    """The in-process fleet API (HTTP handler, tests and bench share).
+
+    Wires Supervisor (processes) + Router (traffic) + RolloutManager
+    (model versions) + telemetry (merged observability) behind one
+    object. `profile`/`policy` power the pooled /drift verdict; both are
+    optional (fleets of unmonitored models simply 404 /drift, like a
+    single replica would)."""
+
+    def __init__(self, supervisor: Supervisor, router: Router,
+                 rollout: Optional[RolloutManager] = None, *,
+                 profile: Optional[ReferenceProfile] = None,
+                 policy: Optional[DriftPolicy] = None):
+        self.supervisor = supervisor
+        self.router = router
+        self.rollout = rollout
+        self.profile = profile
+        self.policy = policy or DriftPolicy()
+        self._draining = threading.Event()
+        # one persistent poll pool: telemetry scrapes fan out over the
+        # replicas concurrently without paying thread churn per scrape
+        import concurrent.futures as cf
+        self._poll_pool = cf.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="fleet-poll")
+
+    def close(self) -> None:
+        self._poll_pool.shutdown(wait=False)
+
+    # -- scoring ------------------------------------------------------------
+    def forward_score(self, body: bytes):
+        return self.router.forward_score(body)
+
+    def submit(self, record: Record) -> Record:
+        """In-process single-record scoring through the full router path
+        (bench + tests). Raises FleetUnavailable/TimeoutError like the
+        HTTP surface; raises RuntimeError on replica-side 4xx/5xx."""
+        status, data = self.router.forward_score(
+            json.dumps(record).encode())
+        if status != 200:
+            raise RuntimeError(f"replica returned {status}: "
+                               f"{data[:200]!r}")
+        return json.loads(data)
+
+    # -- health / drain -----------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self) -> Dict[str, Any]:
+        if not self._draining.is_set():
+            self._draining.set()
+            collector.event("fleet_drain")
+            _log.info("fleet: draining — /healthz now 503")
+        return self.healthz()
+
+    def healthz(self) -> Dict[str, Any]:
+        reps = [h.describe() for h in self.router.replicas()]
+        healthy = self.router.healthy_count()
+        status = "ok" if healthy > 0 else "down"
+        if self._draining.is_set():
+            status = "draining"
+        out = {"status": status, "healthy_replicas": healthy,
+               "draining": self._draining.is_set(), "replicas": reps}
+        if self.rollout is not None:
+            out["rollout"] = self.rollout.status()
+        return out
+
+    # -- merged telemetry ---------------------------------------------------
+    def _poll_champions(self, path: str) -> List[Any]:
+        """(describe, payload-or-None) per champion: addresses are
+        snapshotted under the fleet lock (a restart may be rewriting a
+        port on another thread), then the GETs run CONCURRENTLY on the
+        persistent poll pool — one hung replica costs the scrape one
+        timeout, not N of them."""
+        with self.router.lock:
+            targets = [(h.host, h.port, h.describe())
+                       for h in self.router.champions]
+        if not targets:
+            return []
+        futs = [self._poll_pool.submit(get_json, host, port, path)
+                for host, port, _ in targets]
+        return [(desc, f.result())
+                for (_, _, desc), f in zip(targets, futs)]
+
+    def metrics(self) -> Dict[str, Any]:
+        docs: List[Dict[str, Any]] = []
+        per: List[Dict[str, Any]] = []
+        for desc, m in self._poll_champions("/metrics"):
+            if m is not None:
+                docs.append(m)
+            per.append(desc)
+        out = telemetry.fleet_metrics(docs, per_replica=per)
+        out["router"] = {
+            "requests": self.router.n_requests,
+            "retries": self.router.n_retries,
+            "shed": self.router.n_shed,
+            "latency": self.router.hist.to_json(),
+        }
+        return out
+
+    def drift(self) -> Optional[Dict[str, Any]]:
+        """Pooled fleet drift (None -> 404 when monitoring is off):
+        every champion's current window state, summed, one verdict."""
+        if self.profile is None:
+            return None
+        states: List[Dict[str, Any]] = []
+        per: List[Dict[str, Any]] = []
+        for desc, st in self._poll_champions("/drift/window"):
+            if st is not None and "error" not in st:
+                states.append(st)
+                per.append({"name": desc["name"], "url": desc["url"],
+                            "rows": st.get("rows")})
+        return telemetry.fleet_drift(self.profile, states,
+                                     policy=self.policy, per_replica=per)
+
+    # -- rollout ------------------------------------------------------------
+    def start_rollout(self, model_dir: str, *, fraction: float = 0.2,
+                      min_shadow: int = 256,
+                      replicas: Optional[int] = None) -> Dict[str, Any]:
+        if self.rollout is None:
+            raise RuntimeError("rollout manager not configured")
+        return self.rollout.start(model_dir, fraction=fraction,
+                                  min_shadow=min_shadow,
+                                  replicas=replicas)
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    server_version = "transmogrifai-tpu-fleet"
+    frontend: FleetFrontend  # attached by make_fleet_server
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        _log.debug("fleet http: " + fmt, *args)
+
+    def _reply(self, code: int, payload: Any,
+               raw: Optional[bytes] = None) -> None:
+        body = raw if raw is not None else json.dumps(
+            payload, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib handler contract)
+        fe = self.server.frontend  # type: ignore[attr-defined]
+        try:
+            if self.path == "/healthz":
+                h = fe.healthz()
+                self._reply(503 if h["status"] in ("down", "draining")
+                            else 200, h)
+            elif self.path == "/metrics":
+                self._reply(200, fe.metrics())
+            elif self.path == "/drain":
+                self._reply(200, fe.drain())
+            elif self.path == "/drift":
+                d = fe.drift()
+                if d is None:
+                    self._reply(404, {"error": "drift monitoring not "
+                                               "enabled for this fleet"})
+                else:
+                    self._reply(200, d)
+            elif self.path == "/rollout":
+                if fe.rollout is None:
+                    self._reply(404, {"error": "no rollout manager"})
+                else:
+                    self._reply(200, fe.rollout.status())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+        except Exception as e:  # pragma: no cover - systemic faults
+            _log.exception("fleet: GET %s failed", self.path)
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        fe = self.server.frontend  # type: ignore[attr-defined]
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length)
+            if self.path == "/score":
+                try:
+                    status, data = fe.forward_score(body)
+                    self._reply(status, None, raw=data)
+                except FleetUnavailable as e:
+                    self._reply(e.status, {"error": str(e),
+                                           "error_type": "FleetUnavailable"})
+                except TimeoutError as e:
+                    self._reply(504, {"error": str(e)})
+            elif self.path == "/rollout":
+                doc = json.loads(body or b"{}")
+                out = fe.start_rollout(
+                    str(doc["model_dir"]),
+                    fraction=float(doc.get("fraction", 0.2)),
+                    min_shadow=int(doc.get("min_shadow", 256)),
+                    replicas=doc.get("replicas"))
+                self._reply(200, out)
+            elif self.path == "/rollout/abort":
+                if fe.rollout is None:
+                    self._reply(404, {"error": "no rollout manager"})
+                else:
+                    fe.rollout.abort()
+                    self._reply(200, fe.rollout.status())
+            elif self.path == "/drain":
+                # REST-proper alias of GET /drain (which the fleet keeps
+                # for parity with the replica endpoint + curl ergonomics)
+                self._reply(200, fe.drain())
+            else:
+                self._reply(404, {"error": f"unknown path {self.path}"})
+        except (json.JSONDecodeError, KeyError, ValueError) as e:
+            self._reply(400, {"error": f"{type(e).__name__}: {e}"})
+        except RolloutConflict as e:
+            # retryable: another rollout holds the slot right now
+            self._reply(409, {"error": str(e)})
+        except Exception as e:
+            # incl. challenger STARTUP failures (broken artifact, prewarm
+            # rc != 0): a 409 would invite retry loops against a model
+            # that can never come up
+            _log.exception("fleet: POST %s failed", self.path)
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+def make_fleet_server(frontend: FleetFrontend, host: str = "127.0.0.1",
+                      port: int = 0) -> ThreadingHTTPServer:
+    httpd = ThreadingHTTPServer((host, port), _FleetHandler)
+    httpd.daemon_threads = True
+    httpd.frontend = frontend  # type: ignore[attr-defined]
+    return httpd
+
+
+# -- the `fleet` CLI body -----------------------------------------------------
+
+def run_fleet(args: Any) -> int:
+    """Body of ``python -m transmogrifai_tpu fleet`` (cli.py parses):
+    prewarm-if-needed, spawn N replicas, route until SIGTERM, drain."""
+    if not logging.getLogger().handlers:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    metrics_loc = getattr(args, "metrics_location", None) or \
+        os.path.join(args.model_dir, "fleet_metrics")
+    os.makedirs(metrics_loc, exist_ok=True)
+    collector.enable("fleet")
+    collector.attach_event_log(os.path.join(metrics_loc, "events.jsonl"))
+
+    serve_args: List[str] = []
+    if getattr(args, "max_batch", None):
+        serve_args += ["--max-batch", str(args.max_batch)]
+    if getattr(args, "buckets", None):
+        serve_args += ["--buckets", str(args.buckets)]
+    if getattr(args, "max_wait_ms", None) is not None:
+        serve_args += ["--max-wait-ms", str(args.max_wait_ms)]
+    if getattr(args, "max_queue", None):
+        serve_args += ["--max-queue", str(args.max_queue)]
+    if getattr(args, "single_record", None):
+        serve_args += ["--single-record", args.single_record]
+    if getattr(args, "monitor", None):
+        serve_args += ["--monitor", args.monitor]
+
+    lock = threading.RLock()
+    supervisor = Supervisor(
+        args.model_dir, replicas=int(args.replicas), lock=lock,
+        metrics_root=os.path.join(metrics_loc, "replicas"),
+        host=getattr(args, "replica_host", "127.0.0.1"),
+        serve_args=serve_args,
+        max_restarts=int(getattr(args, "max_restarts", 20)))
+    router = Router(lock, request_timeout=float(
+        getattr(args, "request_timeout_s", 30.0)))
+
+    profile = policy = None
+    if getattr(args, "monitor", "auto") != "off":
+        doc = load_monitor_profile(args.model_dir)
+        if doc is not None:
+            try:
+                profile = ReferenceProfile.from_json(doc)
+                policy = DriftPolicy()
+            except Exception:
+                _log.exception("fleet: unusable monitor.json; pooled "
+                               "/drift disabled")
+
+    try:
+        router.set_champions(supervisor.start())
+    except Exception:
+        _log.exception("fleet: startup failed")
+        supervisor.stop()
+        collector.detach_event_log()
+        collector.disable()
+        return 1
+    prober = HealthProber(router, interval_s=float(
+        getattr(args, "probe_interval_s", 0.5))).start()
+    # score-comparison bounds pinned at construction (the shadow worker
+    # reads them on its own thread): the champion's prediction profile
+    # when it has one, else the [0, 1] probability default
+    pred = profile.prediction if profile is not None else None
+    rollout = RolloutManager(
+        supervisor, router, lock=lock,
+        score_lo=pred.lo if pred else 0.0,
+        score_hi=pred.hi if pred else 1.0,
+        score_field=pred.field if pred else None)
+    frontend = FleetFrontend(supervisor, router, rollout,
+                             profile=profile, policy=policy)
+    httpd = make_fleet_server(frontend, host=args.host, port=args.port)
+    host, port = httpd.server_address[:2]
+    _log.info("fleet: %d replica(s) of %s behind http://%s:%s",
+              int(args.replicas), args.model_dir, host, port)
+
+    def _graceful(signum: int, frame: Any) -> None:
+        _log.info("fleet: signal %s — draining and shutting down", signum)
+        frontend.drain()
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    def _drain_signal(signum: int, frame: Any) -> None:
+        frontend.drain()
+
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+        signal.signal(signal.SIGINT, _graceful)
+        if hasattr(signal, "SIGUSR1"):
+            signal.signal(signal.SIGUSR1, _drain_signal)
+    except ValueError:  # not on the main thread (tests drive in-process)
+        pass
+
+    try:
+        httpd.serve_forever(poll_interval=0.1)
+    finally:
+        httpd.server_close()
+        prober.stop()
+        if rollout is not None:
+            rollout.abort()
+        supervisor.stop(router=router)
+        frontend.close()
+        collector.save(os.path.join(metrics_loc,
+                                    "fleet_stage_metrics.json"))
+        collector.save_chrome_trace(os.path.join(metrics_loc,
+                                                 "fleet_trace.json"))
+        collector.detach_event_log()
+        collector.disable()
+        _log.info("fleet: drained; router served %d request(s), "
+                  "%d retried, %d shed", router.n_requests,
+                  router.n_retries, router.n_shed)
+    return 0

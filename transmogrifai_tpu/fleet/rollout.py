@@ -1,0 +1,439 @@
+"""Zero-downtime champion/challenger rollout.
+
+State machine (docs/fleet.md "Rollout"):
+
+    IDLE -> WARMING -> SHADOW -> SWAPPED   (terminal until next start)
+                   \\         \\-> REJECTED (bad challenger torn down)
+                    \\-> REJECTED (challenger failed to come up)
+
+- WARMING: the challenger model dir is prewarmed (``serve
+  --prewarm-only`` via the supervisor, stamping ITS manifest) and a
+  challenger replica pool spawns NEXT TO the champions. Champions never
+  stop serving; a challenger that fails to join is rejected without a
+  single request touching it.
+- SHADOW: the router mirrors a configurable fraction of successful
+  single-record responses into :meth:`RolloutManager.observe` as RAW
+  bytes — the request thread pays one random() and one bounded-queue
+  put, nothing else; parsing, score extraction and re-scoring on a
+  challenger replica all run on the rollout's worker thread, and both
+  scores accumulate into calibration-bin histograms. Responses always
+  come from v1; a request is never double-answered.
+- VERDICT: after ``min_shadow`` mirrored pairs, the v1-vs-v2 prediction
+  distributions are compared with the EXISTING drift engine
+  (monitor/drift: JS on the full histograms, PSI with the
+  sampling-noise compensation on coarsened bins, score-mean shift) —
+  champion/challenger IS train-vs-score drift with "train" replaced by
+  "the model you trust".
+- SWAP: one atomic pool swap under the fleet lock (Router.swap_pools);
+  in-flight champion requests finish on their old handles, every later
+  pick sees v2. The old champions drain (router removal -> outstanding
+  == 0 -> SIGTERM) and stop. ``fleet_rollout_swapped``.
+- REJECTED: the challenger pool tears down the same drain path;
+  champions never stopped serving. ``fleet_rollout_rejected``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..monitor import drift
+from ..monitor.profile import score_hist
+from ..utils.metrics import collector
+from .router import CONN_ERRORS, ReplicaHandle, Router, http_json
+
+_log = logging.getLogger("transmogrifai_tpu.fleet")
+
+Record = Dict[str, Any]
+
+IDLE = "idle"
+WARMING = "warming"
+SHADOW = "shadow"
+SWAPPED = "swapped"
+REJECTED = "rejected"
+
+
+class RolloutConflict(RuntimeError):
+    """A rollout is already in flight (or still draining): the request
+    is well-formed but cannot proceed NOW — the fleet frontend maps
+    this to HTTP 409, while challenger startup FAILURES stay plain
+    errors (HTTP 500): retrying a conflict is right, retrying a broken
+    challenger artifact is not."""
+
+#: default score-distribution comparison bins (the monitor's
+#: calibration-bin convention, monitor/profile.DEFAULT_PRED_BINS x4 for
+#: a sharper JS at rollout sample sizes)
+SHADOW_BINS = 40
+
+
+def response_score(row: Record, field: Optional[str] = None
+                   ) -> Optional[float]:
+    """The scalar prediction out of one /score response row — the same
+    shape monitor/profile.score_of reads: {result: {"probability_1":
+    ..}} for classifiers, {result: number} otherwise. Field auto-detects
+    when not pinned."""
+    for v in row.values():
+        if isinstance(v, dict):
+            for k in ((field,) if field else ("probability_1",
+                                              "prediction")):
+                if k in v:
+                    try:
+                        f = float(v[k])
+                    except (TypeError, ValueError):
+                        continue
+                    if np.isfinite(f):
+                        return f
+        elif isinstance(v, (int, float)) and np.isfinite(float(v)):
+            return float(v)
+    return None
+
+
+class RolloutManager:
+    """Drive one champion/challenger rollout at a time.
+
+    Collaborators are duck-typed for testability: `supervisor` needs
+    ``ensure_manifest``/``spawn_pool``/``stop_replicas``; `router` needs
+    the pool/swap/shadow API. `score_lo`/`score_hi` bound the score
+    histograms — [0, 1] (probabilities) unless the champion's
+    monitor.json prediction profile pins a range."""
+
+    def __init__(self, supervisor: Any, router: Router, *,
+                 lock: Optional[threading.RLock] = None,
+                 score_lo: float = 0.0, score_hi: float = 1.0,
+                 score_field: Optional[str] = None,
+                 max_pred_js: float = 0.25,
+                 max_psi: float = 0.25,
+                 max_score_shift: float = 0.2,
+                 queue_max: int = 1024):
+        self.supervisor = supervisor
+        self.router = router
+        self.lock = lock or router.lock
+        self.score_lo = float(score_lo)
+        self.score_hi = float(score_hi)
+        self.score_field = score_field
+        self.max_pred_js = float(max_pred_js)
+        self.max_psi = float(max_psi)
+        self.max_score_shift = float(max_score_shift)
+        self.state = IDLE
+        self.challenger_dir: Optional[str] = None
+        self.fraction = 0.0
+        self.min_shadow = 0
+        self.shadow_pairs = 0
+        self.shadow_dropped = 0
+        self.shadow_errors = 0
+        self.last_verdict: Optional[Dict[str, Any]] = None
+        self._v1_hist = np.zeros(SHADOW_BINS, np.float64)
+        self._v2_hist = np.zeros(SHADOW_BINS, np.float64)
+        self._v1_sum = 0.0
+        self._v2_sum = 0.0
+        #: raw (request bytes, response bytes) pairs — parsing happens
+        #: on the WORKER thread, so the request thread's only shadow
+        #: cost is one random() and one put_nowait
+        self._q: "queue.Queue[Tuple[bytes, bytes]]" = queue.Queue(
+            maxsize=queue_max)
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        with self.lock:
+            return {"state": self.state,
+                    "challenger_dir": self.challenger_dir,
+                    "fraction": self.fraction,
+                    "min_shadow": self.min_shadow,
+                    "shadow_pairs": self.shadow_pairs,
+                    "shadow_dropped": self.shadow_dropped,
+                    "shadow_errors": self.shadow_errors,
+                    "last_verdict": self.last_verdict}
+
+    def start(self, challenger_dir: str, *, replicas: Optional[int] = None,
+              fraction: float = 0.2, min_shadow: int = 256) -> Dict:
+        """Begin a rollout: prewarm + spawn the challenger pool, then
+        open the shadow tap. Raises on a concurrent rollout; a
+        challenger that cannot come up is REJECTED here (champions were
+        never touched)."""
+        with self.lock:
+            if self.state in (WARMING, SHADOW):
+                # refuse BEFORE touching the worker: stopping it here
+                # would orphan the rollout that owns it (tap open, pairs
+                # queuing, nobody left to reach a verdict)
+                raise RolloutConflict(f"a rollout is already "
+                                      f"{self.state} "
+                                      f"({self.challenger_dir})")
+        # the PREVIOUS (completed) rollout's worker may still be
+        # finishing its swap/teardown (stop_replicas drains): it must be
+        # fully gone before its queue and histograms are reused, or
+        # rollout B's verdict would be computed from A-era shadow pairs
+        # by two racing workers
+        old_worker = self._worker
+        if old_worker is not None and old_worker.is_alive():
+            self._stop.set()
+            old_worker.join(60.0)
+            if old_worker.is_alive():
+                raise RolloutConflict("the previous rollout is still "
+                                      "draining its pools; retry "
+                                      "shortly")
+        with self.lock:
+            if self.state in (WARMING, SHADOW):
+                # a racing start() won the gap between check and claim
+                raise RolloutConflict(f"a rollout is already "
+                                      f"{self.state} "
+                                      f"({self.challenger_dir})")
+            self.state = WARMING
+            self.challenger_dir = challenger_dir
+            self.fraction = float(fraction)
+            self.min_shadow = int(min_shadow)
+            self.shadow_pairs = 0
+            self.shadow_dropped = 0
+            self.shadow_errors = 0
+            self.last_verdict = None
+            self._v1_hist[:] = 0.0
+            self._v2_hist[:] = 0.0
+            self._v1_sum = self._v2_sum = 0.0
+            # stale pairs mirrored for the PREVIOUS champion generation
+            # must not seed this rollout's verdict
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+            n = int(replicas or max(len(self.router.champions), 1))
+        collector.event("fleet_rollout_started",
+                        challenger=challenger_dir, fraction=fraction,
+                        min_shadow=min_shadow, replicas=n)
+        _log.info("fleet: rollout started — challenger %s, %d replica(s),"
+                  " shadow fraction %.2f, verdict after %d pairs",
+                  challenger_dir, n, fraction, min_shadow)
+        try:
+            self.supervisor.ensure_manifest(challenger_dir)
+            pool = self.supervisor.spawn_pool(challenger_dir, n,
+                                              pool="challenger")
+        except Exception as e:
+            with self.lock:
+                self.state = REJECTED
+                self.last_verdict = {"reasons": [f"challenger failed to "
+                                                 f"start: {e}"]}
+            collector.event("fleet_rollout_rejected",
+                            challenger=challenger_dir,
+                            reason="startup_failure", error=str(e))
+            raise
+        with self.lock:
+            # ONE atomic claim: an abort() that won the race flipped
+            # state off WARMING (and set _stop) under this same lock,
+            # so either we see it here — and tear the fresh pool down —
+            # or it runs after SHADOW is visible and takes the normal
+            # abort path against a fully-wired rollout. Clearing _stop
+            # anywhere outside this block would clobber that signal.
+            aborted = self.state != WARMING
+            if not aborted:
+                self.router.set_challengers(pool)
+                self._stop.clear()
+                self.state = SHADOW
+                self.router.shadow_hook = self.observe
+                self.router.shadow_fraction = self.fraction
+                worker = threading.Thread(target=self._shadow_loop,
+                                          name="fleet-shadow",
+                                          daemon=True)
+                self._worker = worker
+        if aborted:
+            # an operator abort() landed while the challenger was
+            # warming: the freshly-spawned pool must not leak and the
+            # abort must WIN — a resurrected rollout would shadow
+            # traffic the operator believes is torn down
+            self.supervisor.stop_replicas(pool, drain=False,
+                                          router=self.router)
+            return self.status()
+        worker.start()
+        return self.status()
+
+    # -- shadow path --------------------------------------------------------
+    def observe(self, request_body: bytes, response_body: bytes) -> None:
+        """Router hook: one mirrored (request, champion response) pair,
+        RAW bytes. Enqueue-and-return — parsing, score extraction and
+        challenger scoring all happen on the worker thread, so the
+        request thread's only shadow cost is this put; a full queue
+        DROPS the sample (counted): shadow scoring must never apply
+        backpressure to live traffic."""
+        try:
+            self._q.put_nowait((request_body, response_body))
+        except queue.Full:
+            with self.lock:
+                self.shadow_dropped += 1
+
+    def _shadow_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                req_body, resp_body = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                self._score_raw_pair(req_body, resp_body)
+            except Exception:
+                with self.lock:
+                    self.shadow_errors += 1
+                _log.exception("fleet: shadow scoring failed")
+            if self._verdict_due():
+                self._decide()
+
+    def _score_raw_pair(self, req_body: bytes, resp_body: bytes) -> None:
+        """Worker-side half of one mirrored pair: parse both sides,
+        extract the champion score, re-score on a challenger."""
+        try:
+            record = json.loads(req_body)
+            row = json.loads(resp_body)
+        except (json.JSONDecodeError, ValueError):
+            return  # the served response already left; nothing to do
+        if not (isinstance(record, dict) and isinstance(row, dict)):
+            return  # bulk bodies are batch jobs, not live traffic
+        v1 = response_score(row, self.score_field)
+        if v1 is None:
+            return
+        self._score_pair(record, v1)
+
+    def _pick_challenger(self) -> Optional[Tuple[ReplicaHandle, str, int]]:
+        with self.lock:
+            ready = [h for h in self.router.challengers
+                     if h.healthy and not h.stopping]
+            if not ready:
+                return None
+            h = min(ready, key=lambda r: r.outstanding)
+            h.outstanding += 1
+            return h, h.host, h.port
+
+    def _score_pair(self, record: Record, v1: float) -> None:
+        picked = self._pick_challenger()
+        if picked is None:
+            with self.lock:
+                self.shadow_dropped += 1
+            return
+        h, host, port = picked
+        try:
+            status, data = http_json(
+                host, port, "POST", "/score",
+                body=json.dumps(record).encode(),
+                timeout=self.router.request_timeout)
+        except CONN_ERRORS + (TimeoutError,):
+            with self.lock:
+                self.shadow_errors += 1
+            return
+        finally:
+            with self.lock:
+                h.outstanding = max(h.outstanding - 1, 0)
+        if status != 200:
+            with self.lock:
+                self.shadow_errors += 1
+            return
+        v2 = response_score(json.loads(data), self.score_field)
+        if v2 is None:
+            with self.lock:
+                self.shadow_errors += 1
+            return
+        with self.lock:
+            self._v1_hist += score_hist(np.asarray([v1]), self.score_lo,
+                                        self.score_hi, SHADOW_BINS)
+            self._v2_hist += score_hist(np.asarray([v2]), self.score_lo,
+                                        self.score_hi, SHADOW_BINS)
+            self._v1_sum += v1
+            self._v2_sum += v2
+            self.shadow_pairs += 1
+
+    def _verdict_due(self) -> bool:
+        with self.lock:
+            return (self.state == SHADOW
+                    and self.shadow_pairs >= self.min_shadow)
+
+    # -- verdict ------------------------------------------------------------
+    def verdict(self) -> Dict[str, Any]:
+        """Compare the shadowed v1-vs-v2 prediction distributions with
+        the drift engine's metrics; {"clean": bool, "reasons": [...]}.
+        Same arithmetic the serve monitor applies to train-vs-score
+        prediction drift, including the small-sample PSI compensation."""
+        with self.lock:
+            h1, h2 = self._v1_hist.copy(), self._v2_hist.copy()
+            n = self.shadow_pairs
+            s1, s2 = self._v1_sum, self._v2_sum
+        js = drift.js_divergence_hist(h1, h2)
+        c1, c2 = drift.coarsen(h1), drift.coarsen(h2)
+        psi = drift.psi(c1, c2)
+        psi_thr = self.max_psi + 2.0 * drift.psi_sampling_noise(c1, c2)
+        shift = abs(s2 / n - s1 / n) if n else 0.0
+        reasons: List[str] = []
+        if js > self.max_pred_js:
+            reasons.append(f"prediction_js {js:.4f} > {self.max_pred_js}")
+        if psi > psi_thr:
+            reasons.append(f"prediction_psi {psi:.4f} > {psi_thr:.4f}")
+        if shift > self.max_score_shift:
+            reasons.append(f"score_shift {shift:.4f} > "
+                           f"{self.max_score_shift}")
+        return {"clean": not reasons, "reasons": reasons,
+                "shadow_pairs": n, "js": round(js, 6),
+                "psi": round(psi, 6), "psi_threshold": round(psi_thr, 6),
+                "mean_shift": round(shift, 6),
+                "v1_mean": round(s1 / n, 6) if n else None,
+                "v2_mean": round(s2 / n, 6) if n else None}
+
+    def _decide(self) -> None:
+        v = self.verdict()
+        with self.lock:
+            if self.state != SHADOW:
+                return  # a concurrent decision already landed
+            self.last_verdict = v
+            # close the tap before acting so no new pairs race the swap
+            self.router.shadow_hook = None
+            self.router.shadow_fraction = 0.0
+            self.state = SWAPPED if v["clean"] else REJECTED
+            challenger_dir = self.challenger_dir
+        self._stop.set()
+        if v["clean"]:
+            self._swap(challenger_dir, v)
+        else:
+            self._reject(challenger_dir, v)
+
+    def _swap(self, challenger_dir: str, v: Dict[str, Any]) -> None:
+        old = self.router.swap_pools()
+        collector.event("fleet_rollout_swapped", challenger=challenger_dir,
+                        shadow_pairs=v["shadow_pairs"], js=v["js"],
+                        psi=v["psi"], mean_shift=v["mean_shift"])
+        _log.info("fleet: rollout SWAPPED to %s (js=%.4f psi=%.4f "
+                  "shift=%.4f over %d shadow pairs); draining %d old "
+                  "champion(s)", challenger_dir, v["js"], v["psi"],
+                  v["mean_shift"], v["shadow_pairs"], len(old))
+        # the retired champions bleed off in-flight work, then stop —
+        # zero dropped requests by construction; state stays SWAPPED
+        # (terminal-informational) until the next start()
+        self.supervisor.stop_replicas(old, drain=True, router=self.router)
+
+    def _reject(self, challenger_dir: str, v: Dict[str, Any]) -> None:
+        with self.lock:
+            pool = list(self.router.challengers)
+        self.router.set_challengers([])
+        collector.event("fleet_rollout_rejected",
+                        challenger=challenger_dir,
+                        reason="; ".join(v["reasons"]),
+                        shadow_pairs=v["shadow_pairs"], js=v["js"],
+                        psi=v["psi"], mean_shift=v["mean_shift"])
+        _log.warning("fleet: rollout REJECTED — %s; tearing down %d "
+                     "challenger(s), champions keep serving",
+                     "; ".join(v["reasons"]), len(pool))
+        self.supervisor.stop_replicas(pool, drain=True, router=self.router)
+
+    def abort(self) -> None:
+        """Operator abort: close the tap, tear the challengers down."""
+        with self.lock:
+            if self.state not in (WARMING, SHADOW):
+                return
+            self.router.shadow_hook = None
+            self.router.shadow_fraction = 0.0
+            self.state = REJECTED
+            pool = list(self.router.challengers)
+            challenger_dir = self.challenger_dir
+        self._stop.set()
+        self.router.set_challengers([])
+        collector.event("fleet_rollout_rejected",
+                        challenger=challenger_dir, reason="aborted")
+        self.supervisor.stop_replicas(pool, drain=True, router=self.router)

@@ -1,0 +1,376 @@
+"""Front router: spread ``POST /score`` over healthy serving replicas.
+
+Same discipline as ``serve/frontend.py`` — stdlib only, transport thin,
+logic testable in-process. The router owns a pool of
+:class:`ReplicaHandle` objects (shared with the supervisor, which owns
+the PROCESSES behind them) and for every request picks the healthy,
+non-draining champion with the fewest outstanding requests
+(least-outstanding-requests beats round-robin under heterogeneous
+latency: a replica stuck compiling or GC-ing accumulates outstanding
+and stops being selected).
+
+Failure semantics, in order:
+
+- CONNECTION error (refused/reset — the replica died mid-request): mark
+  the replica unhealthy, retry ONCE on a different replica. Scoring is
+  idempotent, so the retry can never corrupt state; the health prober
+  brings the replica back when it answers /healthz again.
+- HTTP 503 from a replica (its admission queue shed, or it is
+  draining): try the remaining healthy replicas; when EVERY replica
+  sheds, the fleet itself sheds (fleet-level 503 + ``fleet_shed``
+  event) — backpressure propagates instead of queueing unboundedly.
+- TIMEOUT: returned to the caller as 504, never retried (the request
+  may still be executing; a retry would double the load exactly when
+  the fleet is slowest).
+
+Lock ownership: one fleet-wide RLock (``Router.lock``) guards every
+mutable ReplicaHandle field and the pool lists; it is NEVER held across
+a network call — pick under the lock, request outside it, account under
+it again (docs/fleet.md "Lock ownership").
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.metrics import LatencyHistogram, collector
+
+_log = logging.getLogger("transmogrifai_tpu.fleet")
+
+Record = Dict[str, Any]
+
+#: connection-class failures that justify the one retry (the replica
+#: process is gone or the socket broke; the request never completed on
+#: the fleet's side). TimeoutError is deliberately ABSENT.
+CONN_ERRORS = (ConnectionError, http.client.HTTPException, OSError)
+
+
+class FleetUnavailable(RuntimeError):
+    """No replica could take the request (fleet-level shed or every
+    replica unreachable). Carries the HTTP status the frontend maps to:
+    503 when replicas shed load, 502 when none answered at all."""
+
+    def __init__(self, status: int, detail: str):
+        self.status = status
+        super().__init__(detail)
+
+
+class ReplicaHandle:
+    """One replica slot: identity + mutable runtime state.
+
+    The supervisor owns the PROCESS (spawn/restart/stop) and rewrites
+    ``host``/``port``/``healthy`` across incarnations; the router owns
+    routing state (``outstanding``). Every mutable field is guarded by
+    the one fleet lock both sides share."""
+
+    def __init__(self, index: int, model_dir: str, pool: str = "champion",
+                 host: str = "127.0.0.1", port: int = 0):
+        self.index = index
+        self.model_dir = model_dir
+        self.pool = pool
+        self.host = host
+        self.port = port
+        self.proc: Any = None
+        self.metrics_dir: Optional[str] = None
+        self.incarnation = 0
+        self.restarts = 0
+        self.healthy = False
+        self.draining = False
+        self.stopping = False
+        self.outstanding = 0
+        self.last_pick = 0
+        self.last_error: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        return f"{self.pool}-{self.index}"
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def describe(self) -> Dict[str, Any]:
+        return {"name": self.name, "url": self.url, "pool": self.pool,
+                "model_dir": self.model_dir, "healthy": self.healthy,
+                "draining": self.draining, "outstanding": self.outstanding,
+                "incarnation": self.incarnation, "restarts": self.restarts,
+                "last_error": self.last_error}
+
+
+def http_json(host: str, port: int, method: str, path: str,
+              body: Optional[bytes] = None, timeout: float = 30.0
+              ) -> Tuple[int, bytes]:
+    """One HTTP exchange against a replica; returns (status, raw body).
+    Raises the CONN_ERRORS family on transport failure and TimeoutError
+    when the replica accepted but did not answer in time."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        headers = {"Content-Type": "application/json"} if body else {}
+        conn.request(method, path, body=body, headers=headers)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def get_json(host: str, port: int, path: str,
+             timeout: float = 5.0) -> Optional[Any]:
+    """GET a JSON document off a replica address; None on any failure
+    (telemetry polls must never take the fleet down). Callers snapshot
+    ``handle.host``/``handle.port`` under the fleet lock first — a
+    restart may be rewriting the port on another thread."""
+    try:
+        status, data = http_json(host, port, "GET", path,
+                                 timeout=timeout)
+        if status not in (200, 503):  # 503 healthz still carries JSON
+            return None
+        return json.loads(data)
+    except CONN_ERRORS + (TimeoutError, json.JSONDecodeError, ValueError):
+        return None
+
+
+class Router:
+    """Least-outstanding-requests spread over the champion pool, with
+    the failure semantics in the module docstring. `shadow_hook` (set by
+    fleet/rollout while a rollout is in SHADOW state) receives
+    ``(record, response_row)`` for a sampled fraction of successful
+    single-record requests — always AFTER the champion response is
+    final, never on its latency path."""
+
+    def __init__(self, lock: Optional[threading.RLock] = None, *,
+                 request_timeout: float = 30.0):
+        #: THE fleet lock (shared with the Supervisor + RolloutManager)
+        self.lock = lock or threading.RLock()
+        self.request_timeout = float(request_timeout)
+        self.champions: List[ReplicaHandle] = []
+        self.challengers: List[ReplicaHandle] = []
+        self.hist = LatencyHistogram("fleet_router")
+        self.n_requests = 0
+        self.n_retries = 0
+        self.n_shed = 0
+        self.shadow_hook: Optional[Callable[[Record, Record], None]] = None
+        self.shadow_fraction = 0.0
+        self._pick_seq = 0
+
+    # -- pool management ---------------------------------------------------
+    def set_champions(self, handles: List[ReplicaHandle]) -> None:
+        with self.lock:
+            self.champions = list(handles)
+
+    def set_challengers(self, handles: List[ReplicaHandle]) -> None:
+        with self.lock:
+            self.challengers = list(handles)
+
+    def swap_pools(self) -> List[ReplicaHandle]:
+        """THE atomic champion/challenger swap (fleet/rollout calls on a
+        clean verdict): one assignment under the fleet lock. Requests
+        already routed keep their old handle and finish on it (the old
+        processes stay up until drained); every pick after this instant
+        sees only the new champions. Returns the retired pool."""
+        with self.lock:
+            old = self.champions
+            self.champions = self.challengers
+            for h in self.champions:
+                h.pool = "champion"
+            self.challengers = []
+            self.shadow_hook = None
+            self.shadow_fraction = 0.0
+            return old
+
+    def replicas(self) -> List[ReplicaHandle]:
+        with self.lock:
+            return list(self.champions) + list(self.challengers)
+
+    def healthy_count(self) -> int:
+        with self.lock:
+            return sum(1 for h in self.champions
+                       if h.healthy and not h.draining and not h.stopping)
+
+    # -- routing -----------------------------------------------------------
+    def _pick(self, exclude: set
+              ) -> Optional[Tuple[ReplicaHandle, str, int]]:
+        """(handle, host, port) of the chosen replica — the address is
+        snapshotted under the lock because a supervisor restart rewrites
+        the port on its own thread."""
+        with self.lock:
+            ready = [h for h in self.champions
+                     if h.healthy and not h.draining and not h.stopping
+                     and h.name not in exclude]
+            if not ready:
+                return None
+            # least-outstanding, ties broken least-recently-picked: an
+            # idle fleet round-robins instead of hammering replica 0
+            h = min(ready, key=lambda r: (r.outstanding, r.last_pick))
+            h.outstanding += 1
+            self._pick_seq += 1
+            h.last_pick = self._pick_seq
+            return h, h.host, h.port
+
+    def _done(self, h: ReplicaHandle) -> None:
+        with self.lock:
+            h.outstanding = max(h.outstanding - 1, 0)
+
+    def _mark_conn_failure(self, h: ReplicaHandle, err: str) -> None:
+        with self.lock:
+            h.healthy = False
+            h.last_error = err
+        _log.warning("fleet: replica %s connection failure (%s); "
+                     "marked unhealthy, retrying elsewhere", h.name, err)
+
+    def forward_score(self, body: bytes) -> Tuple[int, bytes]:
+        """Route one /score body to a champion. Returns (status, body)
+        to pass through verbatim; raises FleetUnavailable when no
+        replica could take it."""
+        t0 = time.perf_counter()
+        tried: set = set()
+        conn_failures = 0
+        saw_shed = False
+        while True:
+            picked = self._pick(tried)
+            if picked is None:
+                break
+            h, host, port = picked
+            tried.add(h.name)
+            try:
+                status, data = http_json(host, port, "POST", "/score",
+                                         body=body,
+                                         timeout=self.request_timeout)
+            except TimeoutError:
+                self._done(h)
+                raise
+            except CONN_ERRORS as e:
+                self._done(h)
+                self._mark_conn_failure(h, f"{type(e).__name__}: {e}")
+                conn_failures += 1
+                if conn_failures > 1:
+                    break  # retry-ONCE: two dead sockets end the request
+                with self.lock:
+                    self.n_retries += 1
+                collector.event("fleet_retry", replica=h.name,
+                                error=type(e).__name__)
+                continue
+            self._done(h)
+            if status == 503:
+                # the replica shed (queue full) or is mid-drain: its
+                # refusal is not the fleet's — spread to the rest
+                saw_shed = True
+                continue
+            self.hist.record(time.perf_counter() - t0)
+            with self.lock:
+                self.n_requests += 1
+                hook, frac = self.shadow_hook, self.shadow_fraction
+            if hook is not None and status == 200:
+                self._maybe_shadow(hook, frac, body, data)
+            return status, data
+        if saw_shed:
+            with self.lock:
+                self.n_shed += 1
+                total = self.n_shed
+            collector.event("fleet_shed", shed_total=total,
+                            replicas_tried=len(tried))
+            raise FleetUnavailable(
+                503, "every replica shed the request (fleet overloaded)")
+        raise FleetUnavailable(
+            502 if conn_failures else 503,
+            f"no healthy replica available "
+            f"({conn_failures} connection failure(s), {len(tried)} tried)")
+
+    def _maybe_shadow(self, hook: Callable[[bytes, bytes], None],
+                      fraction: float, body: bytes, data: bytes) -> None:
+        """Sample this request into the rollout's shadow stream: one
+        random() and one bounded-queue put of the RAW bytes — parsing
+        and challenger scoring happen on the rollout's worker thread,
+        so the request path pays effectively nothing. The rollout
+        worker discards bulk (list) bodies; only single-record requests
+        count as live traffic."""
+        import random
+        if fraction <= 0.0 or random.random() >= fraction:
+            return
+        hook(body, data)
+
+    # -- drain coordination ------------------------------------------------
+    def remove(self, handles: List[ReplicaHandle]) -> None:
+        """Take handles out of both pools (no new picks; in-flight
+        requests still hold their references and finish)."""
+        gone = {h.name for h in handles}
+        with self.lock:
+            self.champions = [h for h in self.champions
+                              if h.name not in gone]
+            self.challengers = [h for h in self.challengers
+                                if h.name not in gone]
+
+    def wait_drained(self, handles: List[ReplicaHandle],
+                     timeout: float = 30.0) -> bool:
+        """Block until every handle's outstanding count reaches zero
+        (rolling-restart coordination: remove() first, then this, then
+        stop the process). True when fully drained."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                left = sum(h.outstanding for h in handles)
+            if left == 0:
+                return True
+            time.sleep(0.02)
+        return False
+
+    # -- health probing ----------------------------------------------------
+    def probe_once(self) -> None:
+        """One health sweep: GET /healthz per replica, state updated
+        under the lock AFTER the request returns. The prober is also the
+        recovery path for replicas the forwarder marked unhealthy."""
+        for h in self.replicas():
+            with self.lock:
+                if h.stopping or h.proc is None and h.port == 0:
+                    continue
+                host, port = h.host, h.port
+            doc = None
+            try:
+                status, data = http_json(host, port, "GET", "/healthz",
+                                         timeout=2.0)
+                doc = json.loads(data)
+            except CONN_ERRORS + (TimeoutError, json.JSONDecodeError,
+                                  ValueError):
+                status = None
+            with self.lock:
+                was = h.healthy
+                if doc is None:
+                    h.healthy = False
+                else:
+                    h.draining = bool(doc.get("draining"))
+                    h.healthy = (status == 200
+                                 and doc.get("status") == "ok")
+                now = h.healthy
+            if was != now:
+                _log.info("fleet: replica %s -> %s", h.name,
+                          "healthy" if now else "unhealthy")
+
+
+class HealthProber:
+    """Background /healthz sweep at a fixed interval (daemon thread)."""
+
+    def __init__(self, router: Router, interval_s: float = 0.5):
+        self.router = router
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fleet-prober", daemon=True)
+
+    def start(self) -> "HealthProber":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.router.probe_once()
+            except Exception:  # a probe bug must not kill health-keeping
+                _log.exception("fleet: health probe sweep failed")
+            self._stop.wait(self.interval_s)

@@ -1,0 +1,400 @@
+"""Replica supervisor: N ServingEngine worker PROCESSES from one model.
+
+Each replica is a real ``python -m transmogrifai_tpu serve`` subprocess
+— its own interpreter, its own XLA client, its own GIL — so the fleet
+scales past one process's HTTP/assembly ceiling and a crash takes down
+exactly one replica. What makes N processes cheap is the PR 7 prewarm
+contract: every replica shares one ``TMOG_COMPILE_CACHE_DIR`` and adopts
+the ``serve.json`` manifest written by ``serve --prewarm-only``, so
+replica N+1 (and every supervisor RESTART) starts with ZERO true XLA
+compiles — persistent-cache hits only. The supervisor enforces that
+contract end to end:
+
+- it runs ``serve --prewarm-only`` itself when the manifest is missing
+  (populating the shared cache before the first replica spawns);
+- replicas run ``--strict-manifest``: a replica whose model hash or
+  bucket ladder disagrees with the manifest REFUSES to join (exit 2)
+  instead of silently compiling a divergent ladder;
+- after every restart it reads the replica's ``/metrics`` ``prewarm``
+  block (the RecompileTracker counters, not log lines) and records a
+  ``fleet_replica_up`` event carrying ``prewarm_compiles`` — the chaos
+  pin asserts 0 there.
+
+Crash handling: a watch thread polls child processes; a dead replica
+emits ``fleet_replica_down``, then restarts with exponential backoff on
+a FRESH port (the old port may linger in TIME_WAIT). Backoff doubles
+per consecutive crash and resets after a healthy join, so a crash-loop
+replica cannot melt the host while the rest of the fleet serves.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..utils.metrics import collector
+from ..workflow.io import load_serve_manifest, verify_serve_manifest
+from .router import CONN_ERRORS, ReplicaHandle, get_json, http_json
+
+_log = logging.getLogger("transmogrifai_tpu.fleet")
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An ephemeral port that was free a moment ago. The bind/close gap
+    is a real (tiny) race; replica spawn treats a failed bind as a crash
+    and restarts on a fresh port, so the race self-heals."""
+    s = socket.socket()
+    try:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+class Supervisor:
+    """Own the replica processes of one fleet.
+
+    `serve_args` is the pass-through list of extra ``serve`` CLI flags
+    every replica gets (``["--max-batch", "64", "--monitor", "off"]``
+    style). `metrics_root` (required) holds one subdirectory per replica
+    INCARNATION — ``replica-0/r0``, ``replica-0/r1`` after one restart —
+    each with its own events.jsonl + trace artifacts, because a kill -9
+    never flushes the dying incarnation's files and the restarted one
+    must not append to a half-written log. The fleet lock is shared with
+    the Router so handle state has exactly one guard."""
+
+    def __init__(self, model_dir: str, *, replicas: int = 2,
+                 lock: Optional[threading.RLock] = None,
+                 metrics_root: str,
+                 host: str = "127.0.0.1",
+                 serve_args: Sequence[str] = (),
+                 env: Optional[Dict[str, str]] = None,
+                 python: str = sys.executable,
+                 startup_timeout_s: float = 180.0,
+                 max_restarts: int = 20,
+                 backoff_base_s: float = 0.5,
+                 backoff_cap_s: float = 10.0):
+        self.model_dir = model_dir
+        self.n_replicas = int(replicas)
+        self.lock = lock or threading.RLock()
+        self.metrics_root = metrics_root
+        self.host = host
+        self.serve_args = list(serve_args)
+        self.env = dict(os.environ)
+        if env:
+            self.env.update(env)
+        self.python = python
+        self.startup_timeout_s = float(startup_timeout_s)
+        self.max_restarts = int(max_restarts)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.handles: List[ReplicaHandle] = []
+        self.rejoin_violations = 0
+        self._next_index = 0
+        self._stop = threading.Event()
+        self._watch: Optional[threading.Thread] = None
+        os.makedirs(metrics_root, exist_ok=True)
+        if not self.env.get("TMOG_COMPILE_CACHE_DIR"):
+            # the zero-compile rejoin contract NEEDS a shared persistent
+            # cache; default one under the fleet's own metrics root
+            # rather than silently running without
+            cache = os.path.join(metrics_root, "compile_cache")
+            self.env["TMOG_COMPILE_CACHE_DIR"] = cache
+            _log.warning("fleet: TMOG_COMPILE_CACHE_DIR was unset; using "
+                         "%s so replicas share one persistent cache",
+                         cache)
+
+    # -- manifest / prewarm -------------------------------------------------
+    def ensure_manifest(self, model_dir: Optional[str] = None) -> Dict:
+        """Make sure `model_dir` carries a FRESH serve.json manifest,
+        running ``serve --prewarm-only`` in a child when it is missing
+        OR stale (the deploy step, automated). Returns the manifest.
+        This is what makes every subsequent replica start compile-free:
+        the prewarm child populates the SHARED persistent cache with
+        every ladder rung. Freshness matters because replicas run
+        --strict-manifest: handing them a stale manifest (model
+        re-saved since the last prewarm) would make every one refuse to
+        join with only a generic failed-to-start error."""
+        model_dir = model_dir or self.model_dir
+        manifest = load_serve_manifest(model_dir)
+        if manifest is not None:
+            stale = verify_serve_manifest(model_dir, manifest)
+            if not stale:
+                return manifest
+            _log.warning("fleet: serve.json under %s is STALE (%s) — "
+                         "re-running the prewarm so replicas can join",
+                         model_dir, "; ".join(stale))
+        else:
+            _log.info("fleet: no serve.json under %s", model_dir)
+        cmd = [self.python, "-m", "transmogrifai_tpu", "serve", model_dir,
+               "--prewarm-only"] + self.serve_args
+        _log.info("fleet: running the prewarm: %s", " ".join(cmd))
+        proc = subprocess.run(cmd, env=self.env, capture_output=True,
+                              text=True, timeout=self.startup_timeout_s * 2)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"fleet: `serve --prewarm-only` failed rc="
+                f"{proc.returncode}: {proc.stderr[-800:]}")
+        manifest = load_serve_manifest(model_dir)
+        if manifest is None:
+            raise RuntimeError(f"fleet: prewarm wrote no serve.json "
+                               f"under {model_dir}")
+        return manifest
+
+    # -- spawning -----------------------------------------------------------
+    def _spawn_cmd(self, handle: ReplicaHandle) -> List[str]:
+        return ([self.python, "-m", "transmogrifai_tpu", "serve",
+                 handle.model_dir, "--host", self.host,
+                 "--port", str(handle.port),
+                 "--metrics-location", handle.metrics_dir,
+                 "--strict-manifest"] + self.serve_args)
+
+    def _spawn(self, handle: ReplicaHandle) -> None:
+        """Start one incarnation (no lock held: subprocess spawn and the
+        port probe both touch the OS)."""
+        port = free_port(self.host)
+        with self.lock:
+            restarts = handle.restarts
+        inc_dir = os.path.join(self.metrics_root, handle.name,
+                               f"r{restarts}")
+        os.makedirs(inc_dir, exist_ok=True)
+        log_path = os.path.join(inc_dir, "replica.log")
+        with self.lock:
+            handle.port = port
+            handle.metrics_dir = inc_dir
+            handle.incarnation = restarts
+            handle.healthy = False
+            handle.draining = False
+            cmd = self._spawn_cmd(handle)  # address read under the lock
+        with open(log_path, "ab") as lf:
+            proc = subprocess.Popen(cmd, env=self.env,
+                                    stdout=lf, stderr=lf)
+        with self.lock:
+            handle.proc = proc
+        _log.info("fleet: spawned %s pid=%d port=%d (incarnation %d)",
+                  handle.name, proc.pid, port, handle.incarnation)
+
+    def _wait_healthy(self, handle: ReplicaHandle,
+                      timeout: Optional[float] = None) -> bool:
+        """Poll /healthz until the replica reports ok (model loaded,
+        prewarm done, HTTP up) or its process dies."""
+        deadline = time.monotonic() + (timeout or self.startup_timeout_s)
+        while time.monotonic() < deadline:
+            with self.lock:
+                proc, host, port = handle.proc, handle.host, handle.port
+            if proc is not None and proc.poll() is not None:
+                return False  # died during startup (strict manifest etc.)
+            try:
+                status, data = http_json(host, port, "GET", "/healthz",
+                                         timeout=2.0)
+                if status == 200 and \
+                        json.loads(data).get("status") == "ok":
+                    with self.lock:
+                        handle.healthy = True
+                    return True
+            except CONN_ERRORS + (TimeoutError, json.JSONDecodeError,
+                                  ValueError):
+                pass
+            time.sleep(0.1)
+        return False
+
+    def _note_up(self, handle: ReplicaHandle) -> None:
+        """fleet_replica_up + the compile-free-(re)join check: read the
+        prewarm block the engine serves under /metrics (RecompileTracker
+        counters) and flag any true compile a rejoin performed."""
+        with self.lock:
+            host, port = handle.host, handle.port
+            restarts, incarnation = handle.restarts, handle.incarnation
+        m = get_json(host, port, "/metrics") or {}
+        prewarm = m.get("prewarm") or {}
+        compiles = prewarm.get("compiles")
+        cache_hits = prewarm.get("cache_hits")
+        if restarts > 0 and isinstance(compiles, int) and compiles > 0:
+            with self.lock:
+                self.rejoin_violations += 1
+            _log.warning(
+                "fleet: %s REJOINED WITH %d TRUE XLA COMPILE(S) — the "
+                "shared persistent cache missed (stale manifest? cache "
+                "dir wiped?)", handle.name, compiles)
+        collector.event("fleet_replica_up", replica=handle.name,
+                        url=f"http://{host}:{port}",
+                        incarnation=incarnation, restarts=restarts,
+                        prewarm_compiles=compiles,
+                        prewarm_cache_hits=cache_hits)
+
+    def start(self) -> List[ReplicaHandle]:
+        """Ensure the manifest, spawn the champion pool, wait for every
+        replica to join, start the crash watch. Returns the handles (the
+        Router takes the same list)."""
+        self.ensure_manifest()
+        new = self.spawn_pool(self.model_dir, self.n_replicas,
+                              pool="champion")
+        self._watch = threading.Thread(target=self._watch_loop,
+                                       name="fleet-supervisor",
+                                       daemon=True)
+        self._watch.start()
+        return new
+
+    def spawn_pool(self, model_dir: str, n: int,
+                   pool: str = "champion") -> List[ReplicaHandle]:
+        """Spawn n replicas of `model_dir` and wait until ALL are
+        healthy; raises (and tears the new pool down) when any fails to
+        join — half a pool is not a pool."""
+        batch: List[ReplicaHandle] = []
+        with self.lock:
+            for _ in range(n):
+                h = ReplicaHandle(self._next_index, model_dir, pool=pool,
+                                  host=self.host)
+                self._next_index += 1
+                self.handles.append(h)
+                batch.append(h)
+        for h in batch:
+            self._spawn(h)
+        failed = [h for h in batch if not self._wait_healthy(h)]
+        if failed:
+            names = [h.name for h in failed]
+            self.stop_replicas(batch, drain=False)
+            raise RuntimeError(f"fleet: replica(s) {names} failed to "
+                               f"become healthy (see replica.log under "
+                               f"{self.metrics_root})")
+        for h in batch:
+            self._note_up(h)
+        return batch
+
+    # -- crash watch --------------------------------------------------------
+    def _watch_loop(self) -> None:
+        """Poll child processes; a death is BOOKED here (proc cleared
+        under the lock, so the next sweep cannot double-detect it) and
+        the restart — backoff sleep + spawn + health wait, up to
+        minutes — runs on its own thread: two replicas crashing
+        together restart in parallel instead of the second corpse
+        waiting out the first one's startup_timeout."""
+        while not self._stop.is_set():
+            with self.lock:
+                snapshot = list(self.handles)
+            for h in snapshot:
+                if self._stop.is_set():
+                    return
+                with self.lock:
+                    proc, stopping = h.proc, h.stopping
+                if proc is None or stopping:
+                    continue
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                self._handle_crash(h, rc)
+            self._stop.wait(0.2)
+
+    def _handle_crash(self, h: ReplicaHandle, rc: int) -> None:
+        with self.lock:
+            h.healthy = False
+            h.proc = None
+            h.last_error = f"exited rc={rc}"
+            h.restarts += 1
+            restarts = h.restarts
+        _log.warning("fleet: replica %s died rc=%s (restart %d/%d)",
+                     h.name, rc, restarts, self.max_restarts)
+        collector.event("fleet_replica_down", replica=h.name, rc=rc,
+                        restarts=restarts)
+        if restarts > self.max_restarts:
+            _log.error("fleet: replica %s exceeded max_restarts=%d; "
+                       "leaving it down", h.name, self.max_restarts)
+            return
+        threading.Thread(target=self._restart, args=(h, restarts),
+                         name=f"fleet-restart-{h.name}",
+                         daemon=True).start()
+
+    def _restart(self, h: ReplicaHandle, restarts: int) -> None:
+        backoff = min(self.backoff_base_s * (2 ** (restarts - 1)),
+                      self.backoff_cap_s)
+        # interruptible backoff: a stopping fleet must not wait out the
+        # ladder before exiting
+        if self._stop.wait(backoff):
+            return
+        with self.lock:
+            if h.stopping:  # a rolling stop raced the crash
+                return
+        self._spawn(h)
+        with self.lock:
+            proc, stopping = h.proc, h.stopping
+        if stopping:
+            # a stop landed between the check and the spawn: the fresh
+            # process must not outlive the fleet
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+            return
+        if self._wait_healthy(h):
+            self._note_up(h)
+
+    # -- stopping -----------------------------------------------------------
+    def stop_replicas(self, handles: List[ReplicaHandle],
+                      drain: bool = True, *,
+                      router: Optional[Any] = None,
+                      timeout: float = 30.0) -> None:
+        """Rolling-stop coordination for a set of replicas: (1) mark
+        stopping (the watch won't restart them; the router won't pick
+        them), (2) optional router removal + outstanding-drain wait, (3)
+        GET /drain so the replica's OWN /healthz degrades for any
+        external prober, (4) SIGTERM (the replica's graceful drain path,
+        which flushes its metrics artifacts), (5) SIGKILL stragglers."""
+        with self.lock:
+            for h in handles:
+                h.stopping = True
+        if router is not None:
+            router.remove(handles)
+            router.wait_drained(handles, timeout=timeout)
+        for h in handles:
+            with self.lock:
+                host, port, proc = h.host, h.port, h.proc
+            if drain:
+                try:
+                    http_json(host, port, "GET", "/drain", timeout=2.0)
+                except CONN_ERRORS + (TimeoutError,):
+                    pass
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + timeout
+        for h in handles:
+            with self.lock:
+                proc = h.proc
+            if proc is None:
+                continue
+            try:
+                proc.wait(max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                _log.warning("fleet: replica %s ignored SIGTERM; killing",
+                             h.name)
+                proc.kill()
+                proc.wait(5.0)
+            with self.lock:
+                h.proc = None
+                h.healthy = False
+        with self.lock:
+            self.handles = [h for h in self.handles if h not in handles]
+
+    def stop(self, router: Optional[Any] = None) -> None:
+        self._stop.set()
+        if self._watch is not None:
+            self._watch.join(5.0)
+        with self.lock:
+            handles = list(self.handles)
+        self.stop_replicas(handles, drain=True, router=router)
+
+    # -- chaos helper (tests / ci) ------------------------------------------
+    def kill_replica(self, handle: ReplicaHandle,
+                     sig: int = signal.SIGKILL) -> int:
+        """kill -9 one replica (the chaos pin's hammer). Returns the
+        pid. The watch thread notices the death and restarts it."""
+        with self.lock:
+            proc = handle.proc
+        if proc is None:
+            raise RuntimeError(f"{handle.name} has no live process")
+        proc.send_signal(sig)
+        return proc.pid

@@ -1,0 +1,147 @@
+"""Fleet telemetry: per-replica state MERGED, not concatenated.
+
+Everything the serving stack measures was deliberately shaped as a
+mergeable sufficient statistic (ROADMAP: the DrJAX MapReduce shape,
+PAPERS arxiv 2403.07128 — applied here host-side across processes
+instead of across chips):
+
+- latency histograms are fixed log-spaced bucket counts, so the fleet
+  p50/p99 comes from SUMMED buckets
+  (:meth:`~transmogrifai_tpu.utils.metrics.LatencyHistogram.merge`),
+  exactly what one histogram recording every replica's stream would
+  hold — not an average of per-replica quantiles (which is wrong
+  whenever replicas see different mixes);
+- engine counters (requests/batches/rows/shed/post-warmup compiles) are
+  plain sums;
+- drift-monitor window state is histogram mass + null counts + score
+  moments: the fleet sums the per-replica CURRENT windows
+  (``GET /drift/window``) into one pooled window and runs ONE
+  DriftPolicy verdict on it. That pooling is the statistical point: a
+  fleet of N replicas each holding 1/N of a window must alert exactly
+  like one replica holding the whole window — per-replica small windows
+  must NOT alert where the pooled window wouldn't (the
+  ``psi_sampling_noise`` compensation and ``min_rows`` floor see the
+  pooled row count).
+
+With N=1 every merge is the identity, so fleet endpoints equal the
+single replica's — the golden-parity acceptance pin.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..monitor import drift
+from ..monitor.alerts import DriftPolicy
+from ..monitor.profile import ReferenceProfile
+from ..monitor.window import WindowSnapshot
+from ..utils.metrics import LatencyHistogram
+
+#: engine counters that merge by summation across replicas
+_SUM_KEYS = ("requests", "batches", "rows", "shed",
+             "post_warmup_compiles")
+
+
+def merge_latency(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge a list of LatencyHistogram.to_json() payloads (same
+    histogram name across replicas) into one to_json() payload —
+    bucket-sum exact, identity for a single element."""
+    if not docs:
+        return LatencyHistogram().to_json()
+    out = LatencyHistogram.from_json(docs[0])
+    for d in docs[1:]:
+        out.merge(LatencyHistogram.from_json(d))
+    return out.to_json()
+
+
+def fleet_metrics(replica_metrics: List[Dict[str, Any]],
+                  per_replica: Optional[List[Dict[str, Any]]] = None
+                  ) -> Dict[str, Any]:
+    """The fleet ``GET /metrics`` payload from per-replica /metrics
+    documents: counters summed, latency histograms bucket-sum merged
+    per histogram name. `per_replica` (handle.describe() dicts) rides
+    along so an operator can see the spread behind the merge."""
+    docs = [m for m in replica_metrics if isinstance(m, dict)]
+    out: Dict[str, Any] = {"replicas": len(docs)}
+    for k in _SUM_KEYS:
+        out[k] = sum(int(m.get(k) or 0) for m in docs)
+    out["warm"] = all(bool(m.get("warm")) for m in docs) if docs else False
+    names: List[str] = []
+    for m in docs:
+        for nm in (m.get("latency") or {}):
+            if nm not in names:
+                names.append(nm)
+    out["latency"] = {
+        nm: merge_latency([m["latency"][nm] for m in docs
+                           if nm in (m.get("latency") or {})])
+        for nm in names}
+    if per_replica is not None:
+        out["per_replica"] = per_replica
+    return out
+
+
+def merge_window_states(states: List[Dict[str, Any]]) -> WindowSnapshot:
+    """Sum per-replica ``/drift/window`` states into ONE pooled
+    WindowSnapshot — component-wise addition of every sufficient
+    statistic. Merging a single state reproduces it exactly (golden
+    parity); merging N is bit-exact with a monitor that observed all N
+    traffic streams, because each component is a plain sum and f64
+    addition of the per-replica partial sums is the same arithmetic the
+    single monitor's host merge performs."""
+    hists: Dict[str, np.ndarray] = {}
+    nulls: Dict[str, float] = {}
+    rows = 0.0
+    wall = 0.0
+    pred_hist: Optional[np.ndarray] = None
+    pred_count = 0.0
+    pred_sum = 0.0
+    index = 0
+    for st in states:
+        if not isinstance(st, dict):
+            continue
+        rows += float(st.get("rows") or 0.0)
+        wall = max(wall, float(st.get("wall_s") or 0.0))
+        index = max(index, int(st.get("window_index") or 0))
+        for nm, h in (st.get("hists") or {}).items():
+            arr = np.asarray(h, np.float64)
+            if nm in hists:
+                hists[nm] = hists[nm] + arr
+            else:
+                hists[nm] = arr
+            nulls[nm] = nulls.get(nm, 0.0) + float(
+                (st.get("nulls") or {}).get(nm, 0.0))
+        ph = st.get("pred_hist")
+        if ph is not None:
+            arr = np.asarray(ph, np.float64)
+            pred_hist = arr if pred_hist is None else pred_hist + arr
+            pred_count += float(st.get("pred_count") or 0.0)
+            pred_sum += float(st.get("pred_sum") or 0.0)
+    return WindowSnapshot(index=index, rows=rows, wall_s=wall,
+                          hists=hists, nulls=nulls, pred_hist=pred_hist,
+                          pred_count=pred_count, pred_sum=pred_sum)
+
+
+def fleet_drift(profile: ReferenceProfile,
+                states: List[Dict[str, Any]],
+                policy: Optional[DriftPolicy] = None,
+                per_replica: Optional[List[Dict[str, Any]]] = None
+                ) -> Dict[str, Any]:
+    """The fleet ``GET /drift`` payload: pool the replica window states,
+    run the SAME drift engine (monitor/drift.window_report) once on the
+    pooled window. One verdict for the whole fleet, evaluated at the
+    pooled sample size."""
+    policy = policy or DriftPolicy()
+    good = [s for s in states if isinstance(s, dict)]
+    snap = merge_window_states(good)
+    report = drift.window_report(profile, snap, policy)
+    out: Dict[str, Any] = {
+        "replicas_reporting": len(good),
+        "rows_pooled": snap.rows,
+        "policy": policy.to_json(),
+        "pooled": report,
+        "alerting": bool(report["alerts"]),
+    }
+    if per_replica is not None:
+        out["per_replica"] = per_replica
+    return out

@@ -296,6 +296,44 @@ class ServeMonitor:
         self._reset_window()
         return report
 
+    def window_state(self) -> Dict[str, Any]:
+        """The CURRENT (still-open) window's raw sufficient statistics
+        as a JSON-able dict — the fleet merge unit (``GET /drift/window``
+        in serve/frontend, pooled by fleet/telemetry.merge_window_states
+        before ONE fleet-level DriftPolicy verdict).
+
+        Everything here is a plain sum, so adding two replicas' states
+        component-wise IS the state of one monitor that observed both
+        traffic streams (the DrJAX MapReduce shape applied host-side
+        across processes). Reading does not close or reset the window;
+        it costs one small device fetch, on the caller's (telemetry
+        poll) cadence, not the request path's."""
+        with self._lock:
+            hists: Dict[str, List[float]] = {}
+            nulls: Dict[str, float] = {}
+            if self._K and self._num_state is not None:
+                # same fetch _close_window performs, read-only: a few KB
+                # on an explicit telemetry poll
+                # tmoglint: disable=THR002  explicit poll-path sync, by design
+                num = np.asarray(self._num_state, np.float64)
+                for k, nm in enumerate(self.numeric_names):
+                    hists[nm] = [float(x) for x in num[k, :self.bins]]
+                    nulls[nm] = float(num[k, self.bins])
+            for nm in self.hashed_names:
+                hists[nm] = [float(x) for x in self._hash_hists[nm]]
+                nulls[nm] = float(self._hash_nulls[nm])
+            return {
+                "window_index": self.n_windows,
+                "rows": float(self._rows),
+                "wall_s": round(time.monotonic() - self._t_open, 6),
+                "hists": hists,
+                "nulls": nulls,
+                "pred_hist": ([float(x) for x in self._pred_hist]
+                              if self._pred_hist is not None else None),
+                "pred_count": float(self._pred_count),
+                "pred_sum": float(self._pred_sum),
+            }
+
     # -- prewarm -----------------------------------------------------------
     def prewarm(self, shapes: Sequence[int]) -> None:
         """Compile the sketch program for every serving bucket shape

@@ -20,6 +20,12 @@ Continuous train-vs-score drift monitoring rides the engine via
 ``monitor=`` (transmogrifai_tpu/monitor/, docs/monitoring.md): windowed
 feature/prediction sketches, ``GET /drift``, and the optional
 ``/healthz`` hard gate.
+
+One process is a replica; ``transmogrifai_tpu/fleet/`` (docs/fleet.md)
+operates N of them — the ``GET /drain`` rotation endpoint, the
+``serve.json`` freshness stamp + ``--strict-manifest`` refusal, and the
+``GET /drift/window`` raw-sufficient-statistics endpoint here are the
+replica-side half of that fleet contract.
 """
 from .batcher import MicroBatcher, Overloaded
 from .engine import ServingEngine, bucket_ladder, template_record

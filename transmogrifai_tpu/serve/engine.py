@@ -51,7 +51,8 @@ from ..local.scoring import _extract as _extract_typed
 from ..types import ColumnKind
 from ..utils import tracing
 from ..utils.metrics import LatencyHistogram, collector
-from ..workflow.io import load_serve_manifest, save_serve_manifest
+from ..workflow.io import (load_serve_manifest, manifest_stamp,
+                           save_serve_manifest, verify_serve_manifest)
 
 Record = Dict[str, Any]
 
@@ -141,6 +142,24 @@ class ServingEngine:
             else bucket_ladder(max_batch))
         if self.buckets[0] < 1:
             raise ValueError(f"bucket sizes must be >= 1: {self.buckets}")
+        # manifest freshness (docs/fleet.md "The manifest contract"):
+        # the stamp written at --prewarm-only time must still describe
+        # THIS artifact, or the prewarm silently misses the persistent
+        # cache. A mismatch is a warning here; `serve --strict-manifest`
+        # (and every fleet replica) refuses to start on it.
+        self.manifest_mismatch: List[str] = verify_serve_manifest(
+            getattr(model, "source_path", None), manifest)
+        if manifest and manifest.get("buckets") and \
+                self.buckets != tuple(sorted({int(b) for b
+                                              in manifest["buckets"]})):
+            self.manifest_mismatch.append(
+                f"bucket ladder {list(self.buckets)} != manifest "
+                f"{manifest['buckets']} (prewarmed executables cover "
+                f"different shapes)")
+        if self.manifest_mismatch:
+            _log.warning("serve: STALE serve.json manifest — %s. Re-run "
+                         "`serve --prewarm-only` after saving the model.",
+                         "; ".join(self.manifest_mismatch))
         self.max_batch = self.buckets[-1]
         if single_record not in ("bucket", "local"):
             raise ValueError("single_record must be 'bucket' or 'local'")
@@ -186,6 +205,11 @@ class ServingEngine:
         self.n_shed = 0
         self.warm = False
         self.post_warmup_compiles = 0
+        #: prewarm() summary, re-served under /metrics "prewarm": the
+        #: fleet supervisor reads compiles/cache_hits off a restarted
+        #: replica to assert the compile-free-rejoin contract from the
+        #: RecompileTracker's counters rather than from log lines
+        self.prewarm_summary: Optional[Dict[str, Any]] = None
         self._warm_compiles = 0
         self._anchor = None
         self._span_budget = int(os.environ.get("TMOG_SERVE_SPAN_BUDGET",
@@ -459,6 +483,11 @@ class ServingEngine:
                                       else None),
                        "compile_cache_dir": compile_cache_dir(),
                        "per_bucket": per_bucket}
+            with self._stat_lock:
+                self.prewarm_summary = {
+                    "wall_s": summary["wall_s"],
+                    "compiles": summary["compiles"],
+                    "cache_hits": summary["cache_hits"]}
             collector.event("serve_prewarm", buckets=list(self.buckets),
                             wall_seconds=round(wall, 6),
                             compiles=summary["compiles"],
@@ -482,6 +511,8 @@ class ServingEngine:
             "max_batch": self.max_batch,
             "single_record": self.single_record,
             "example": self.example,
+            # freshness stamp (docs/fleet.md): adoption re-verifies both
+            **manifest_stamp(src),
         })
 
     # -- telemetry ---------------------------------------------------------
@@ -571,6 +602,7 @@ class ServingEngine:
                    "rows": self.n_rows,
                    "shed": self.n_shed,
                    "post_warmup_compiles": self.post_warmup_compiles,
+                   "prewarm": self.prewarm_summary,
                    "monitor_disabled": self.monitor_disabled,
                    "monitor_errors": self.monitor_errors}
         out["latency"] = {k: h.to_json() for k, h in self.hist.items()}

@@ -10,9 +10,15 @@ bench.py drive the same :class:`ServeFrontend` in-process through
 ``submit()``/``submit_many()`` — the HTTP layer is transport, not logic.
 
 Endpoints:
-  POST /score     {record} -> scores; [records] -> bulk (bypasses queue)
-  GET  /healthz   liveness + warm/bucket state
-  GET  /metrics   engine counters + p50/p95/p99 latency histograms
+  POST /score         {record} -> scores; [records] -> bulk (no queue)
+  GET  /healthz       liveness + warm/bucket state (503 when draining)
+  GET  /metrics       engine counters + p50/p95/p99 latency histograms
+  GET  /drain         flip /healthz to draining-503 (also SIGUSR1) so a
+                      router/LB rotates this replica out BEFORE SIGTERM;
+                      in-flight and still-arriving requests keep scoring
+  GET  /drift         drift-monitor report (monitoring.md)
+  GET  /drift/window  the CURRENT window's raw sufficient statistics —
+                      what the fleet telemetry merger pools (fleet.md)
 """
 from __future__ import annotations
 
@@ -26,6 +32,7 @@ from typing import Any, Dict, List, Optional, Union
 
 from ..local.scoring import (InvalidFeatureError, MissingFeatureError,
                              UnknownFeatureError)
+from ..utils.metrics import collector
 from .batcher import MicroBatcher, Overloaded
 from .engine import ServingEngine
 
@@ -53,6 +60,9 @@ class ServeFrontend:
         self.engine = engine
         self.batcher = batcher
         self.max_bulk = int(max_bulk)
+        # drain flag (GET /drain or SIGUSR1): an Event — set/is_set are
+        # atomic, shared by HTTP workers and the signal path
+        self._draining = threading.Event()
 
     def submit(self, record: Record,
                timeout: Optional[float] = None) -> Record:
@@ -65,6 +75,39 @@ class ServeFrontend:
         for r in records:
             self.engine.validate_record(r)
         return self.engine.score_batch(records)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def drain(self) -> Dict[str, Any]:
+        """Flip /healthz to draining-503 while the engine keeps scoring
+        everything in flight (and anything that still arrives): the
+        router — or any external load balancer probing /healthz — takes
+        the replica out of rotation, traffic bleeds off, and only THEN
+        does the operator send SIGTERM. Before this endpoint the only
+        drain was SIGTERM itself, which gave an LB no advance notice.
+        Idempotent; there is deliberately no un-drain (a drained replica
+        restarts, re-proving the compile-free-start contract)."""
+        if not self._draining.is_set():
+            self._draining.set()
+            collector.event("serve_drain",
+                            queue_len=self.batcher.queue_len)
+            _log.info("serve: draining — /healthz now 503, in-flight "
+                      "requests finishing")
+        return self.healthz()
+
+    def drift_window(self) -> Optional[Dict[str, Any]]:
+        """The ``GET /drift/window`` payload: the current window's RAW
+        sufficient statistics (monitor/window.ServeMonitor.window_state)
+        — histogram mass, null counts, prediction sketch, row count.
+        This is the merge unit of fleet-level drift (fleet/telemetry):
+        the fleet sums these across replicas and runs ONE DriftPolicy
+        verdict on the pooled window. None when monitoring is off."""
+        mon = self.engine.monitor
+        if mon is None:
+            return None
+        return mon.window_state()
 
     def healthz(self) -> Dict[str, Any]:
         status = "ok" if self.engine.warm else "warming"
@@ -84,6 +127,11 @@ class ServeFrontend:
                 # (observation faults) cannot refresh its verdict, so
                 # its stale alert must not hold the gate
                 status = "degraded"
+        if self._draining.is_set():
+            # draining wins over every other verdict: the whole point is
+            # that probes stop selecting this replica
+            status = "draining"
+        out["draining"] = self._draining.is_set()
         out["status"] = status
         return out
 
@@ -119,9 +167,19 @@ class _Handler(BaseHTTPRequestHandler):
         fe = self.server.frontend  # type: ignore[attr-defined]
         if self.path == "/healthz":
             h = fe.healthz()
-            self._reply(503 if h["status"] == "degraded" else 200, h)
+            self._reply(503 if h["status"] in ("degraded", "draining")
+                        else 200, h)
         elif self.path == "/metrics":
             self._reply(200, fe.metrics())
+        elif self.path == "/drain":
+            self._reply(200, fe.drain())
+        elif self.path == "/drift/window":
+            w = fe.drift_window()
+            if w is None:
+                self._reply(404, {"error": "drift monitoring not "
+                                           "enabled"})
+            else:
+                self._reply(200, w)
         elif self.path == "/drift":
             d = fe.drift()
             if d is None:
@@ -135,6 +193,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802
         fe = self.server.frontend  # type: ignore[attr-defined]
+        if self.path == "/drain":
+            # REST-proper alias of GET /drain (kept on GET too for curl
+            # ergonomics and the documented LB-rotation contract)
+            self._reply(200, fe.drain())
+            return
         if self.path != "/score":
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
@@ -194,7 +257,6 @@ def run_serve(args: Any) -> int:
             level=logging.INFO,
             format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
-    from ..utils.metrics import collector
     from ..workflow.workflow import WorkflowModel
 
     model = WorkflowModel.load(args.model_dir)
@@ -264,6 +326,16 @@ def run_serve(args: Any) -> int:
         model, max_batch=args.max_batch, buckets=buckets, example=example,
         single_record=getattr(args, "single_record", "bucket"),
         monitor=monitor)
+    if engine.manifest_mismatch and getattr(args, "strict_manifest",
+                                            False):
+        # the fleet contract (docs/fleet.md): a stale serve.json means
+        # the prewarm would compile instead of cache-hit — under
+        # --strict-manifest (every fleet replica) that is a refusal to
+        # join, not a warning
+        _log.error("serve: --strict-manifest and the serve.json "
+                   "manifest is stale: %s",
+                   "; ".join(engine.manifest_mismatch))
+        return 2
     if monitor is not None and engine.monitor is None and mon_mode == "on":
         # the engine refused the monitor (profile/model feature
         # mismatch — e.g. a retrained model served with a stale
@@ -308,9 +380,16 @@ def run_serve(args: Any) -> int:
         # the signal-interrupted main thread itself
         threading.Thread(target=httpd.shutdown, daemon=True).start()
 
+    def _drain_signal(signum: int, frame: Any) -> None:
+        frontend.drain()  # /healthz -> 503; serving continues
+
     try:
         signal.signal(signal.SIGTERM, _graceful)
         signal.signal(signal.SIGINT, _graceful)
+        if hasattr(signal, "SIGUSR1"):
+            # the signal twin of GET /drain: rotate out of the LB first,
+            # SIGTERM later (docs/serving.md "Drain before stop")
+            signal.signal(signal.SIGUSR1, _drain_signal)
     except ValueError:  # not on the main thread (tests drive in-process)
         pass
 

@@ -231,6 +231,69 @@ class LatencyHistogram:
     def mean_seconds(self) -> float:
         return self.total_seconds / self.count if self.count else 0.0
 
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Add `other`'s observations into this histogram, in place.
+
+        EXACT bucket-sum semantics: the log-spaced buckets are identical
+        across all instances, so merged counts equal the counts of
+        recording the union stream, and every quantile of the merge
+        equals the union-stream quantile bit-for-bit (quantiles read
+        only bucket counts + the max, both of which merge losslessly).
+        This is what makes a fleet p99 from summed per-replica buckets
+        honest — no histogram re-fitting, no approximation beyond the
+        bucket resolution each replica already had. Merging an empty
+        histogram is the identity. Locks are taken one at a time
+        (snapshot `other`, then apply), never nested."""
+        with other._lock:
+            counts = list(other._counts)
+            count, total = other.count, other.total_seconds
+            mx = other.max_seconds
+        with self._lock:
+            self.count += count
+            self.total_seconds += total
+            if mx > self.max_seconds:
+                self.max_seconds = mx
+            for b, c in enumerate(counts):
+                if c:
+                    self._counts[b] += c
+        return self
+
+    #: bucket key (the "buckets_ms" label of to_json) -> bucket index;
+    #: built once — from_json must invert the exact formatting record()
+    #: and to_json() use, or a merged fleet histogram would misplace mass
+    _KEY_TO_BUCKET: Optional[Dict[str, int]] = None
+
+    @classmethod
+    def _key_map(cls) -> Dict[str, int]:
+        if cls._KEY_TO_BUCKET is None:
+            lo = cls._LO
+            cls._KEY_TO_BUCKET = {
+                f"{lo * 10.0 ** ((b + 1) / cls._BPD) * 1e3:.3g}": b
+                for b in range(cls._N + 1)}
+        return cls._KEY_TO_BUCKET
+
+    @staticmethod
+    def from_json(doc: Dict[str, Any]) -> "LatencyHistogram":
+        """Rebuild a histogram from its to_json() payload (the fleet
+        telemetry path: each replica serves its histograms over
+        /metrics, the fleet merges the parsed copies). Bucket counts and
+        the total count round-trip exactly; mean/max carry to_json()'s
+        4-decimal-ms rounding, so to_json(from_json(j)) == j."""
+        h = LatencyHistogram(str(doc.get("name", "latency")))
+        count = int(doc.get("count", 0))
+        # factory-local: `h` is unshared until returned (the same
+        # happens-before-sharing argument the __init__ exemption makes)
+        h.count, h.total_seconds, h.max_seconds = (  # tmoglint: disable=THR001
+            count, float(doc.get("mean_ms", 0.0)) * count / 1e3,
+            float(doc.get("max_ms", 0.0)) / 1e3)
+        key_map = h._key_map()
+        for key, c in (doc.get("buckets_ms") or {}).items():
+            b = key_map.get(str(key))
+            if b is None:
+                raise ValueError(f"unknown latency bucket key {key!r}")
+            h._counts[b] += int(c)
+        return h
+
     def to_json(self) -> Dict[str, Any]:
         with self._lock:
             count, total = self.count, self.total_seconds

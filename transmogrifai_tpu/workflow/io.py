@@ -191,7 +191,79 @@ def load_model(path: str,
 # compiled alongside the model artifact; a later `serve <dir>` (same or
 # fresh process) prewarms the SAME ladder, so every executable is a
 # persistent-compilation-cache hit and startup performs zero XLA compiles
-# (docs/serving.md "Deploy-time prewarm").
+# (docs/serving.md "Deploy-time prewarm"). Since the fleet PR the
+# manifest is also the FLEET CONTRACT (docs/fleet.md): it stamps a model
+# content hash + whether a monitor profile existed at prewarm time, and
+# adoption verifies both — a stale manifest (model re-saved after the
+# prewarm) would otherwise silently prewarm-miss the persistent cache
+# and cost every replica a full compile at startup.
+
+def model_content_hash(model_dir: Optional[str]) -> Optional[str]:
+    """Content hash of the model artifact (op-model.json + arrays.npz),
+    16 hex chars. This is the identity the serve.json manifest stamps at
+    --prewarm-only time and every adoption re-computes: equal hash =>
+    the persistent-cache entries the prewarm populated belong to THIS
+    model. None when there is no artifact to hash."""
+    import hashlib
+
+    if not model_dir or not os.path.exists(os.path.join(model_dir,
+                                                        MODEL_JSON)):
+        return None
+    h = hashlib.sha256()
+    for fname in (MODEL_JSON, ARRAYS_NPZ):
+        p = os.path.join(model_dir, fname)
+        h.update(fname.encode())
+        if not os.path.exists(p):
+            h.update(b"|absent")
+            continue
+        with open(p, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                h.update(chunk)
+    return h.hexdigest()[:16]
+
+
+def manifest_stamp(model_dir: Optional[str]) -> Dict[str, Any]:
+    """The freshness fields `serve --prewarm-only` stamps into
+    serve.json: the model content hash and whether a monitor.json
+    reference profile was present when the ladder compiled."""
+    return {
+        "model_hash": model_content_hash(model_dir),
+        "monitor_profile": bool(
+            model_dir
+            and os.path.exists(os.path.join(model_dir, MONITOR_JSON))),
+    }
+
+
+def verify_serve_manifest(model_dir: Optional[str],
+                          manifest: Optional[Dict[str, Any]]
+                          ) -> List[str]:
+    """Mismatch strings for a manifest adopted against the CURRENT
+    artifact state; empty list = fresh (or too old to carry the stamp —
+    pre-stamp manifests verify vacuously rather than failing every
+    existing deployment). The serving engine warns on any mismatch and
+    `serve --strict-manifest` turns it into a startup failure (rc 2);
+    the fleet supervisor runs replicas strict, so a replica REFUSES to
+    join a fleet whose manifest disagrees with its model artifact."""
+    problems: List[str] = []
+    if not manifest or not model_dir:
+        return problems
+    stamped = manifest.get("model_hash")
+    if stamped is not None:
+        now = model_content_hash(model_dir)
+        if now != stamped:
+            problems.append(
+                f"model_hash {now} != manifest {stamped} (model re-saved "
+                f"after `serve --prewarm-only`; prewarm will miss the "
+                f"persistent cache)")
+    if "monitor_profile" in manifest:
+        has_prof = os.path.exists(os.path.join(model_dir, MONITOR_JSON))
+        if bool(manifest["monitor_profile"]) != has_prof:
+            problems.append(
+                f"monitor.json {'appeared' if has_prof else 'vanished'} "
+                f"since the manifest was written (monitor_profile="
+                f"{manifest['monitor_profile']})")
+    return problems
+
 
 def save_serve_manifest(model_dir: str, manifest: Dict[str, Any]) -> str:
     p = os.path.join(model_dir, SERVE_JSON)
