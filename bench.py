@@ -1419,6 +1419,85 @@ def serving_bench(n_rows=None):
         if errs:
             out["errors"] = errs[:5]
 
+        # segment decomposition (docs/observability.md "Request
+        # tracing"): where a request's wall actually goes, from the
+        # engine's own per-segment histograms + pad accounting — the
+        # numbers the fleet /requests endpoint merges across replicas
+        lat = m["latency"]
+        eng_hists = engine.hist
+        total_s = eng_hists["total"].total_seconds
+        out["segments"] = {
+            "queue_wait_p50_ms": lat["queue_wait"]["p50_ms"],
+            "queue_wait_p99_ms": lat["queue_wait"]["p99_ms"],
+            "batch_assemble_p50_ms": lat["batch_assemble"]["p50_ms"],
+            "device_score_p50_ms": lat["device_score"]["p50_ms"],
+            "device_score_p99_ms": lat["device_score"]["p99_ms"],
+            # padding share of all device rows (bulk + singles)
+            "pad_fraction_mean": round(
+                m["pad_rows"] / max(m["bucket_rows"], 1), 4),
+            # device wall (batch walls counted once) over summed
+            # request walls: the device share of the e2e latency mass
+            "device_share": round(
+                eng_hists["device_score"].total_seconds
+                / max(total_s, 1e-9), 4),
+        }
+
+        # request-tracing on/off A/B (the tail-sampling layer's
+        # request-path overhead pin): the IDENTICAL single-record mix
+        # through fresh batchers on the SAME warm engine, submit walls
+        # timed identically into bench-local histograms — tracing adds
+        # one slotted record + a few perf_counter reads per request,
+        # and this shows what that costs at p99
+        from transmogrifai_tpu.serve import ReqTracer
+        from transmogrifai_tpu.utils.metrics import LatencyHistogram
+
+        def _drive_mix(trace_tracer):
+            b = MicroBatcher(engine, max_wait_ms=1.0, max_queue=4096)
+            h = LatencyHistogram("ab")
+            errs_ab = []
+
+            def one(r):
+                t0 = time.perf_counter()
+                rt = (trace_tracer.start(None)
+                      if trace_tracer is not None else None)
+                try:
+                    b.submit(dict(r), trace=rt)
+                    wall = time.perf_counter() - t0
+                    if trace_tracer is not None:
+                        trace_tracer.finish(rt, wall, status=200)
+                    h.record(wall)
+                except Exception as e:  # noqa: BLE001 - recorded
+                    errs_ab.append(repr(e))
+
+            for r in singles[:200]:
+                one(r)
+            ths = [threading.Thread(
+                target=lambda k=k: [one(r) for r in
+                                    singles[200 + 25 * k:
+                                            200 + 25 * (k + 1)]])
+                for k in range(8)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(120)
+            b.shutdown(drain=True)
+            return h, errs_ab
+
+        h_off, e1 = _drive_mix(None)
+        ab_tracer = ReqTracer("bench", sample_rate=0.05)
+        h_on, e2 = _drive_mix(ab_tracer)
+        j_off, j_on = h_off.to_json(), h_on.to_json()
+        out["reqtrace_ab"] = {
+            "p50_ms_off": j_off["p50_ms"], "p50_ms_on": j_on["p50_ms"],
+            "p99_ms_off": j_off["p99_ms"], "p99_ms_on": j_on["p99_ms"],
+            "p50_delta_ms": round(j_on["p50_ms"] - j_off["p50_ms"], 4),
+            "p99_delta_ms": round(j_on["p99_ms"] - j_off["p99_ms"], 4),
+            "traces": ab_tracer.n_traces,
+            "kept": ab_tracer.n_kept,
+        }
+        if e1 or e2:
+            out.setdefault("errors", []).extend((e1 + e2)[:5])
+
         # monitoring on/off A/B (docs/monitoring.md): the same single-
         # record + bulk traffic through a SECOND engine with the drift
         # monitor attached — p50/p99 delta and bulk rows/s overhead of

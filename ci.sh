@@ -728,6 +728,136 @@ PY
 PYTHONPATH="$PWD" python -m transmogrifai_tpu trace-report \
   "$(cat "$FLEET_TMP/restarted_dir.txt")" --check > /dev/null
 echo "  fleet trace-report: restarted replica artifacts clean"
+# request-tracing smoke (docs/observability.md "Request tracing"): a
+# fresh 2-replica fleet with tracing ON under mixed traffic; ONE
+# artificially slow request (X-Tmog-Debug-Sleep, gated by
+# TMOG_DEBUG_SLEEP_MAX_MS in the replica env) and ONE invalid request
+# injected -> both TAIL-KEPT with full segment chains naming the serving
+# replica, the slow request's router+replica segments sum to within 10%
+# of its measured e2e wall, fleet /requests serves both, trace-report
+# --requests exits green on the router's event log, and the
+# zero-post-warmup-recompile contract holds with tracing ON
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - "$FLEET_TMP" <<'PY'
+import json
+import os
+import sys
+import threading
+import time
+
+tmp = sys.argv[1]
+from transmogrifai_tpu.fleet import (HealthProber, Router, Supervisor)
+from transmogrifai_tpu.fleet.frontend import FleetFrontend
+from transmogrifai_tpu.utils.metrics import collector
+
+v1 = tmp + "/model"
+env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": os.getcwd(),
+       "TMOG_COMPILE_CACHE_DIR": tmp + "/cache",
+       # the chaos hook + a tail threshold reachable at smoke volume
+       "TMOG_DEBUG_SLEEP_MAX_MS": "1000",
+       "TMOG_TRACE_SLO_MIN_COUNT": "20"}
+os.environ["TMOG_TRACE_SLO_MIN_COUNT"] = "20"
+trace_dir = tmp + "/reqtrace"
+os.makedirs(trace_dir, exist_ok=True)
+collector.enable("ci_reqtrace")
+collector.attach_event_log(trace_dir + "/events.jsonl")
+lock = threading.RLock()
+sup = Supervisor(v1, replicas=2, lock=lock,
+                 metrics_root=tmp + "/reqtrace_fleet",
+                 serve_args=["--max-batch", "16", "--max-wait-ms", "2",
+                             "--monitor", "off"],
+                 env=env, backoff_base_s=0.2, startup_timeout_s=300.0)
+router = Router(lock, request_timeout=60.0)
+router.set_champions(sup.start())
+prober = HealthProber(router, interval_s=0.25).start()
+fe = FleetFrontend(sup, router)
+assert fe.tracer.enabled
+
+recs = [{"a": 0.1 * i, "b": -0.05 * i} for i in range(40)]
+# mixed warm traffic: singles through the queue + one bulk body
+for i in range(120):
+    assert fe.submit(recs[i % len(recs)])
+status, _ = fe.forward_score(json.dumps(recs[:12]).encode())
+assert status == 200
+
+# the SLOW request: 600ms injected in the replica frontend, its own
+# debug_sleep segment
+rt = fe.tracer.start(None)
+t0 = time.perf_counter()
+status, _ = fe.forward_score(json.dumps(recs[0]).encode(), trace=rt,
+                             headers={"X-Tmog-Debug-Sleep": "600"})
+e2e_ms = (time.perf_counter() - t0) * 1e3
+fe.tracer.finish(rt, e2e_ms / 1e3, status=status)
+assert status == 200
+slow_id = rt.trace_id
+
+# the INVALID request: unknown key under strict validation -> 400
+rt2 = fe.tracer.start(None)
+status, _ = fe.forward_score(
+    json.dumps({"a": 1.0, "b": 2.0, "nope": 3.0}).encode(), trace=rt2)
+fe.tracer.finish(rt2, status=status)
+assert status == 400, status
+bad_id = rt2.trace_id
+
+time.sleep(1.2)  # let replica gauge samplers tick
+req = fe.requests()
+kept = {(k["trace_id"], k["origin"]): k for k in req["kept"]}
+slow_rep = kept.get((slow_id, "replica"))
+slow_rout = kept.get((slow_id, "router"))
+assert slow_rep is not None and slow_rout is not None, sorted(kept)
+assert slow_rep["kept"] == "slow" and slow_rep["replica"], slow_rep
+assert slow_rep["replica"].startswith("champion-"), slow_rep
+bad_rep = kept.get((bad_id, "replica"))
+bad_rout = kept.get((bad_id, "router"))
+assert bad_rep is not None and bad_rout is not None, sorted(kept)
+assert bad_rep["kept"] == "error" and bad_rout["status"] == 400
+assert bad_rep["replica"].startswith("champion-"), bad_rep
+
+# the acceptance pin: router+replica segments (>= 5: route, queue,
+# batch, device, respond) sum to within 10% of the measured e2e wall.
+# The router's `upstream` wall CONTAINS the replica's whole chain, so
+# the non-overlapping sum is router(route) + every replica segment —
+# upstream itself is excluded or the replica time would count twice
+segs = dict(slow_rep["segments"])
+segs_rout = dict(slow_rout["segments"])
+assert {"route", "queue", "batch", "device", "respond"} <= \
+    (set(segs) | set(segs_rout)), (segs, segs_rout)
+total = segs_rout.get("route", 0.0) + sum(segs.values())
+assert abs(total - e2e_ms) <= 0.10 * e2e_ms, (segs, total, e2e_ms)
+
+# merged segment histograms cover the fleet's traffic
+assert req["segments"]["queue"]["count"] >= 120, req["segments"].keys()
+assert req["segments"]["device"]["count"] >= 120
+assert req["joined_traces"] >= 2, req["joined_traces"]
+
+# gauge time-series: both replicas + the router report rings
+hist = fe.history()
+assert len(hist["replicas"]) == 2 and all(
+    len(g) > 0 for g in hist["replicas"].values()), hist["replicas"]
+
+# /debugz answers on a live replica
+from transmogrifai_tpu.fleet.router import get_json
+h0 = router.champions[0]
+dz = get_json(h0.host, h0.port, "/debugz")
+assert dz and dz["batcher_alive"] and dz["dispatcher_beat_age_s"] < 5.0
+assert any("serve-batcher" in k for k in dz["threads"]), dz["threads"]
+
+# tracing ON added zero post-warmup compiles
+m = fe.metrics()
+assert m["post_warmup_compiles"] == 0, m["post_warmup_compiles"]
+
+prober.stop()
+sup.stop(router=router)
+collector.detach_event_log()
+collector.disable()
+print(f"reqtrace smoke ok: slow {slow_id} kept ({total:.1f}ms of "
+      f"{e2e_ms:.1f}ms e2e covered), invalid {bad_id} kept as error, "
+      f"0 post-warmup compiles with tracing ON")
+PY
+# trace-report --requests green (segment sums cover every kept trace's
+# e2e wall) on the router-side event log
+PYTHONPATH="$PWD" python -m transmogrifai_tpu trace-report \
+  "$FLEET_TMP/reqtrace" --requests > /dev/null
+echo "  trace-report --requests: kept traces cover their e2e walls"
 rm -rf "$FLEET_TMP"
 # tree-sweep smoke on the 2-device CPU mesh: the mesh-sharded fused sweep
 # (TMOG_GRID_FUSE=1 + a mesh validator) must take the

@@ -1,0 +1,772 @@
+"""Per-request distributed tracing (serve/reqtrace, docs/observability.md
+"Request tracing").
+
+Pins: trace-id header mint/parse/echo, tail-based sampling precedence
+(errors/sheds/retries always kept, slow past the live SLO quantile,
+probabilistic rest), segment stamping through the real engine + batcher
+(queue/batch/device cover the e2e wall), the EventLog size rotation with
+the monotone-seq contract preserved across the boundary, /requests +
+/metrics/history + /debugz endpoints, the router->replica hop with
+durations-only clock sanity, the per-segment histogram merge property
+(N replicas == union stream, the PR 11 merge harness applied to the new
+segment families), and trace-report --requests coverage flagging.
+"""
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.fleet import telemetry as FT
+from transmogrifai_tpu.fleet.router import ReplicaHandle, Router, get_json
+from transmogrifai_tpu.serve import (MicroBatcher, ReqTracer, ServeFrontend,
+                                     ServingEngine, make_http_server)
+from transmogrifai_tpu.serve import reqtrace as RQ
+from transmogrifai_tpu.utils import tracing
+from transmogrifai_tpu.utils.metrics import (GaugeRing, LatencyHistogram,
+                                             collector)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# header + record + sampler units
+# ---------------------------------------------------------------------------
+
+class TestTraceHeader:
+    def test_mint_parse_format_roundtrip(self):
+        tid = RQ.mint_trace_id()
+        assert len(tid) == 16 and set(tid) <= set("0123456789abcdef")
+        hdr = RQ.format_trace_header(tid, replica="champion-1")
+        got, attrs = RQ.parse_trace_header(hdr)
+        assert got == tid and attrs == {"replica": "champion-1"}
+
+    def test_bare_id_parses(self):
+        got, attrs = RQ.parse_trace_header("abcdef0123456789")
+        assert got == "abcdef0123456789" and attrs == {}
+
+    def test_malformed_rejected(self):
+        assert RQ.parse_trace_header(None) == (None, {})
+        assert RQ.parse_trace_header("") == (None, {})
+        assert RQ.parse_trace_header("not hex!")[0] is None
+        assert RQ.parse_trace_header("x" * 64)[0] is None
+        # attrs without a usable id are dropped wholesale
+        assert RQ.parse_trace_header(";replica=r0")[0] is None
+
+
+class TestRequestTrace:
+    def test_segments_sum_duplicates(self):
+        rt = RQ.RequestTrace("t1", "router")
+        rt.seg("upstream", 0.010)
+        rt.seg("upstream", 0.005)  # the retry's second attempt
+        rt.seg("route", 0.001)
+        ms = rt.segments_ms()
+        assert ms["upstream"] == pytest.approx(15.0)
+        assert ms["route"] == pytest.approx(1.0)
+
+    def test_to_json_optional_fields(self):
+        rt = RQ.RequestTrace("t2", "replica")
+        rt.wall_s = 0.05
+        rt.status = 200
+        doc = rt.to_json()
+        assert "retries" not in doc and "shed" not in doc
+        assert "error_type" not in doc and "bucket" not in doc
+        rt.retries = 1
+        rt.shed = True
+        rt.bucket = 8
+        rt.pad_fraction = 0.5
+        doc = rt.to_json()
+        assert doc["retries"] == 1 and doc["shed"] is True
+        assert doc["bucket"] == 8 and doc["pad_fraction"] == 0.5
+
+    def test_negative_duration_clamps(self):
+        rt = RQ.RequestTrace("t3", "replica")
+        rt.seg("queue", -0.5)
+        assert rt.segments_ms()["queue"] == 0.0
+
+
+class TestTailSampler:
+    def _trace(self, **kw):
+        rt = RQ.RequestTrace("t", "replica")
+        rt.status = kw.pop("status", 200)
+        for k, v in kw.items():
+            setattr(rt, k, v)
+        return rt
+
+    def test_outcome_precedence(self):
+        s = RQ.TailSampler(LatencyHistogram("h"), rate=0.0, min_count=10)
+        assert s.decide(self._trace(status=500)) == "error"
+        assert s.decide(self._trace(status=400)) == "error"
+        assert s.decide(self._trace(error_type="Boom")) == "error"
+        assert s.decide(self._trace(status=503)) == "shed"
+        assert s.decide(self._trace(shed=True)) == "shed"
+        # shed wins over error when both markers are set (503 + shed)
+        assert s.decide(self._trace(status=503, error_type="X")) == "shed"
+        assert s.decide(self._trace(retries=1)) == "retry"
+        assert s.decide(self._trace(shadow_dropped=True)) == "shadow_drop"
+        assert s.decide(self._trace()) is None  # rate 0, nothing special
+
+    def test_slow_needs_min_count_then_keeps_tail(self):
+        h = LatencyHistogram("h")
+        s = RQ.TailSampler(h, rate=0.0, min_count=50, refresh=1)
+        rt = self._trace()
+        rt.wall_s = 1.0
+        assert s.slow_threshold() is None
+        assert s.decide(rt) is None  # too few observations to judge
+        for _ in range(100):
+            h.record(0.002)
+        thr = s.slow_threshold()
+        assert thr is not None and 0.001 < thr < 0.01
+        assert s.decide(rt) == "slow"  # 1s is way past the 2ms p99
+        fast = self._trace()
+        fast.wall_s = 0.0001
+        assert s.decide(fast) is None
+
+    def test_sample_rate_one_keeps_everything(self):
+        s = RQ.TailSampler(LatencyHistogram("h"), rate=1.0, min_count=10)
+        assert s.decide(self._trace()) == "sample"
+
+
+# ---------------------------------------------------------------------------
+# EventLog rotation
+# ---------------------------------------------------------------------------
+
+class TestEventLogRotation:
+    def test_rotation_preserves_monotone_seq(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        # ~1KB threshold: a handful of events per segment
+        log = tracing.EventLog(path, max_mb=0.001, keep=3)
+        for i in range(200):
+            log.emit("tick", i=i, pad="x" * 64)
+        log.close()
+        assert log.rotations >= 2
+        paths = tracing.event_log_paths(path)
+        assert paths[-1] == path and len(paths) >= 3
+        # the tail-across-the-boundary read: one monotone stream
+        recs = list(tracing.iter_events(path))
+        seqs = [r["seq"] for r in recs]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        ts = [r["t"] for r in recs]
+        assert all(b >= a for a, b in zip(ts, ts[1:]))
+        # the newest events survived; the oldest rotated out (keep=3)
+        assert recs[-1]["i"] == 199
+
+    def test_keep_bound_drops_oldest(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = tracing.EventLog(path, max_mb=0.0005, keep=2)
+        for i in range(300):
+            log.emit("tick", i=i, pad="y" * 64)
+        log.close()
+        suffixes = [p[len(path):] for p in tracing.event_log_paths(path)]
+        assert ".3" not in "".join(suffixes)
+        assert len(tracing.event_log_paths(path)) <= 3  # .2, .1, live
+
+    def test_trace_report_check_spans_rotation(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = tracing.EventLog(path, max_mb=0.001, keep=3)
+        for i in range(150):
+            log.emit("tick", i=i, pad="z" * 64)
+        log.close()
+        text, ok = tracing.trace_report(str(tmp_path), check=True)
+        assert ok, text
+        # the count covers every surviving segment, not just the live file
+        n_live = sum(1 for _ in open(path))
+        assert f"{n_live} event(s)" not in text.splitlines()[0] or \
+            len(tracing.event_log_paths(path)) == 1
+
+    def test_rotation_off_by_default_for_small_logs(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = tracing.EventLog(path)  # default: generous 256MB
+        for i in range(50):
+            log.emit("tick", i=i)
+        log.close()
+        assert log.rotations == 0
+        assert tracing.event_log_paths(path) == [path]
+
+    def test_env_disable(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("TMOG_EVENTLOG_MAX_MB", "off")
+        path = str(tmp_path / "events.jsonl")
+        log = tracing.EventLog(path)
+        assert log._max_bytes == 0
+        log.close()
+
+
+# ---------------------------------------------------------------------------
+# ReqTracer: aggregates, kept ring, events, lane spans
+# ---------------------------------------------------------------------------
+
+class TestReqTracer:
+    def test_disabled_is_inert(self):
+        t = RQ.ReqTracer("r0", enabled=False)
+        assert t.start("deadbeef00000000") is None
+        assert t.finish(None) is None
+        assert t.n_traces == 0
+
+    def test_adopts_inbound_id_and_stamps_replica(self):
+        t = RQ.ReqTracer("champion-3", sample_rate=0.0)
+        rt = t.start("deadbeef00000000;hop=router")
+        assert rt.trace_id == "deadbeef00000000"
+        t.finish(rt, 0.001, status=200)
+        assert rt.replica == "champion-3"
+
+    def test_every_request_feeds_segment_hists(self):
+        t = RQ.ReqTracer("r0", sample_rate=0.0)
+        for i in range(10):
+            rt = t.start(None)
+            rt.seg("queue", 0.001)
+            rt.seg("device", 0.004)
+            t.finish(rt, 0.006, status=200)
+        assert t.n_traces == 10 and t.n_kept == 0
+        p = t.requests_payload()
+        assert p["segments"]["queue"]["count"] == 10
+        assert p["segments"]["device"]["count"] == 10
+        assert p["segments"]["e2e"]["count"] == 10
+        assert p["kept"] == []
+        assert p["counters"]["in_flight"] == 0
+
+    def test_kept_ring_is_bounded(self):
+        t = RQ.ReqTracer("r0", sample_rate=1.0, keep=8)
+        for i in range(50):
+            t.finish(t.start(None), 0.001, status=200)
+        assert t.n_kept == 50
+        assert len(t.requests_payload()["kept"]) == 8
+
+    def test_kept_trace_emits_event_and_lane_spans(self, tmp_path):
+        collector.enable("test_reqtrace")
+        log_path = str(tmp_path / "events.jsonl")
+        collector.attach_event_log(log_path)
+        try:
+            t = RQ.ReqTracer("rep-9", sample_rate=0.0)
+            rt = t.start(None)
+            rt.seg("queue", 0.002)
+            rt.seg("device", 0.005)
+            time.sleep(0.01)
+            assert t.finish(rt, status=500) == "error"
+            # event on the log, with the nested segments dict intact
+            evs = [r for r in tracing.iter_events(log_path)
+                   if r["event"] == "request_trace"]
+            assert len(evs) == 1
+            assert evs[0]["trace_id"] == rt.trace_id
+            assert isinstance(evs[0]["segments"], dict)
+            assert evs[0]["segments"]["device"] == pytest.approx(5.0)
+            # lane spans: one request window + one child per segment
+            spans = [s for s in collector.trace.spans
+                     if s.attrs.get("lane") == "req:rep-9"]
+            req = [s for s in spans if s.kind == "request"]
+            segs = [s for s in spans if s.kind == "request_seg"]
+            assert len(req) == 1 and len(segs) == 2
+            sp = req[0]
+            for s in segs:  # containment: children inside the window
+                assert s.t_start >= sp.t_start - 1e-9
+                assert s.t_end <= sp.t_end + 1e-9
+            # chrome export gives the lane its own tid + thread_name
+            doc = tracing.chrome_trace(collector.trace)
+            metas = [e for e in doc["traceEvents"]
+                     if e["ph"] == "M" and e["name"] == "thread_name"]
+            lane_meta = [e for e in metas
+                         if e["args"]["name"] == "req:rep-9"]
+            assert lane_meta and lane_meta[0]["tid"] >= 2
+            xs = [e for e in doc["traceEvents"] if e["ph"] == "X"
+                  and e.get("args", {}).get("lane") == "req:rep-9"]
+            assert xs and all(e["tid"] == lane_meta[0]["tid"]
+                              for e in xs)
+        finally:
+            collector.detach_event_log()
+            collector.finish()
+            collector.disable()
+
+    def test_span_budget_bounds_tree_growth(self):
+        collector.enable("test_reqtrace_budget")
+        try:
+            t = RQ.ReqTracer("r0", sample_rate=1.0, span_budget=3)
+            for _ in range(10):
+                rt = t.start(None)
+                rt.seg("queue", 0.001)
+                t.finish(rt, 0.001, status=200)
+            reqs = [s for s in collector.trace.spans
+                    if s.kind == "request"]
+            assert len(reqs) == 3  # budget, not 10
+            assert t.n_kept == 10  # ring + events unaffected
+        finally:
+            collector.finish()
+            collector.disable()
+
+
+class TestGauges:
+    def test_ring_bounded_and_stamped(self):
+        ring = GaugeRing(maxlen=4)
+        for i in range(10):
+            ring.append(queue_depth=i)
+        snaps = ring.to_json()
+        assert len(snaps) == 4
+        assert [s["queue_depth"] for s in snaps] == [6, 7, 8, 9]
+        assert all("t" in s and "ts" in s for s in snaps)
+        ts = [s["t"] for s in snaps]
+        assert ts == sorted(ts)
+
+    def test_sampler_contains_failures(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("gauge bug")
+            return {"ok": len(calls)}
+
+        s = RQ.GaugeSampler(fn, interval_s=0.05)
+        s.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and len(s.ring) < 2:
+                time.sleep(0.02)
+        finally:
+            s.stop()
+        assert len(s.ring) >= 2  # survived the first-call failure
+        assert not s._thread.is_alive()
+
+    def test_fleet_history_merge(self):
+        docs = [{"replica": "champion-0", "gauges": [{"t": 1, "q": 2}]},
+                {"replica": "champion-1", "gauges": [{"t": 1, "q": 3}]},
+                None]
+        out = FT.fleet_history(docs, router_gauges=[{"t": 1, "r": 1}])
+        assert set(out["replicas"]) == {"champion-0", "champion-1"}
+        assert out["router"] == [{"t": 1, "r": 1}]
+
+
+# ---------------------------------------------------------------------------
+# the property pin: per-segment histogram merge == union stream
+# ---------------------------------------------------------------------------
+
+class TestSegmentMergeProperty:
+    def test_n_replica_merge_equals_union_stream(self, rng):
+        """The PR 11 merge harness applied to the new segment families:
+        fleet_requests' per-segment histograms, merged by exact bucket
+        sum across N replica tracers, must equal ONE tracer that
+        observed the union of all their requests."""
+        n_replicas = 3
+        segment_draws = {"queue": (-7.0, 1.0), "batch": (-8.0, 0.5),
+                         "device": (-6.0, 1.2), "respond": (-9.0, 0.3)}
+        tracers = [RQ.ReqTracer(f"champion-{i}", sample_rate=0.0)
+                   for i in range(n_replicas)]
+        union = RQ.ReqTracer("union", sample_rate=0.0)
+        for i in range(400):
+            t = tracers[int(rng.integers(0, n_replicas))]
+            walls = {nm: float(rng.lognormal(mu, sd))
+                     for nm, (mu, sd) in segment_draws.items()}
+            for tr in (t, union):
+                rt = tr.start(None)
+                for nm, w in walls.items():
+                    rt.seg(nm, w)
+                tr.finish(rt, sum(walls.values()), status=200)
+        merged = FT.fleet_requests([t.requests_payload()
+                                    for t in tracers])
+        want = union.requests_payload()["segments"]
+        assert merged["replicas"] == n_replicas
+        for nm in list(segment_draws) + ["e2e"]:
+            got = merged["segments"][nm]
+            exp = want[nm]
+            for k in ("count", "p50_ms", "p95_ms", "p99_ms", "max_ms",
+                      "buckets_ms"):
+                assert got[k] == exp[k], (nm, k, got[k], exp[k])
+            # mean reconstructs through to_json's 4-decimal-ms rounding
+            # per replica before the merge re-rounds
+            assert got["mean_ms"] == pytest.approx(exp["mean_ms"],
+                                                   rel=1e-3)
+        assert merged["counters"]["traces"] == 400
+
+    def test_merge_pools_kept_and_joins_by_trace_id(self):
+        rep = RQ.ReqTracer("champion-0", sample_rate=1.0)
+        rout = RQ.ReqTracer("router", origin="router", sample_rate=1.0)
+        rt_r = rout.start(None)
+        rt_r.seg("route", 0.0005)
+        rt_p = rep.start(rt_r.trace_id)  # the propagated header
+        rt_p.seg("device", 0.004)
+        rep.finish(rt_p, 0.005, status=200)
+        rt_r.seg("upstream", 0.006)
+        rout.finish(rt_r, 0.007, status=200)
+        out = FT.fleet_requests([rep.requests_payload()],
+                                router_payload=rout.requests_payload())
+        assert out["joined_traces"] == 1
+        origins = {k["origin"] for k in out["kept"]}
+        assert origins == {"replica", "router"}
+        assert "route" in out["router_segments"]
+        # router hop walls never merge into the replica segment pool
+        assert "route" not in out["segments"]
+
+
+# ---------------------------------------------------------------------------
+# real engine + batcher + HTTP integration
+# ---------------------------------------------------------------------------
+
+def _make_rows(n=300, seed=7):
+    r = np.random.default_rng(seed)
+    return [{"a": float(r.normal()), "b": float(r.normal()),
+             "y": float(r.normal() > 0)} for _ in range(n)]
+
+
+def _fit_model(rows):
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    from transmogrifai_tpu.models.glm import OpLogisticRegression
+    from transmogrifai_tpu.readers.readers import ListReader
+    from transmogrifai_tpu.stages.params import param_grid
+    from transmogrifai_tpu.workflow import Workflow
+
+    fa = FeatureBuilder.Real("a").extract(
+        lambda r: r.get("a")).as_predictor()
+    fb = FeatureBuilder.Real("b").extract(
+        lambda r: r.get("b")).as_predictor()
+    fy = FeatureBuilder.RealNN("y").extract(
+        lambda r: r.get("y")).as_response()
+    fsum = (fa + fb) + 1.0
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[(OpLogisticRegression(max_iter=10),
+                                param_grid(reg_param=[0.01]))],
+    ).set_input(fy, transmogrify([fa, fb, fsum])).get_output()
+    return Workflow().set_reader(ListReader(rows)) \
+        .set_result_features(pred).train()
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rows = _make_rows()
+    return _fit_model(rows), rows
+
+
+class TestEngineSegments:
+    def test_queued_request_covers_wall(self, fitted):
+        model, rows = fitted
+        engine = ServingEngine(model, max_batch=16)
+        engine.prewarm()
+        batcher = MicroBatcher(engine, max_wait_ms=1.0)
+        tracer = RQ.ReqTracer("rep-0", sample_rate=1.0)
+        try:
+            rt = tracer.start(None)
+            t0 = time.perf_counter()
+            out = batcher.submit({"a": 0.5, "b": -0.25}, trace=rt)
+            wall = time.perf_counter() - t0
+            tracer.finish(rt, wall, status=200)
+            assert out
+            segs = dict(rt.segs)
+            assert {"queue", "batch", "device"} <= set(segs)
+            assert rt.bucket == 1
+            # the segment chain covers the e2e wall: whatever is
+            # unattributed is scheduler wake + bookkeeping, small in
+            # absolute terms
+            covered = sum(s for _, s in rt.segs)
+            assert wall - covered < 0.050, (wall, segs)
+        finally:
+            batcher.shutdown()
+
+    def test_bulk_trace_accumulates_chunks_and_pads(self, fitted):
+        model, rows = fitted
+        engine = ServingEngine(model, max_batch=8)  # ladder (1, 8)
+        engine.prewarm()
+        batcher = MicroBatcher(engine)
+        fe = ServeFrontend(engine, batcher,
+                           tracer=RQ.ReqTracer("rep-0", sample_rate=1.0))
+        try:
+            recs = [{"a": float(i), "b": 0.0} for i in range(20)]
+            rt = fe.tracer.start(None)
+            out = fe.submit_many(recs, trace=rt)
+            fe.tracer.finish(rt, status=200)
+            assert len(out) == 20
+            assert rt.rows == 20
+            segs = dict(rt.segs)
+            assert {"validate", "batch", "device"} <= set(segs)
+            # 20 rows -> chunks 8+8+4pad->8: 4 pad rows over 24
+            assert rt.pad_fraction == pytest.approx(4 / 24)
+            m = engine.metrics()
+            assert m["pad_rows"] == 4 and m["bucket_rows"] == 24
+            assert "monitor_observe" in m["latency"]
+        finally:
+            batcher.shutdown()
+
+    def test_untraced_path_allocates_no_batch_trace(self, fitted):
+        model, _ = fitted
+        engine = ServingEngine(model, max_batch=8)
+        engine.prewarm()
+        calls = []
+        orig = engine.score_batch
+
+        def spy(records, batch_trace=None):
+            calls.append(batch_trace)
+            return orig(records, batch_trace=batch_trace)
+
+        # test spy installed before any traffic (pre-share setup)
+        engine.score_batch = spy  # tmoglint: disable=THR001
+        batcher = MicroBatcher(engine)
+        try:
+            batcher.submit({"a": 1.0, "b": 2.0})
+            assert calls == [None]
+        finally:
+            batcher.shutdown()
+            engine.score_batch = orig
+
+
+@pytest.fixture()
+def served(fitted):
+    """A live HTTP replica: engine + batcher + traced frontend on an
+    ephemeral port, debug-sleep hook armed."""
+    model, rows = fitted
+    os.environ["TMOG_DEBUG_SLEEP_MAX_MS"] = "2000"
+    try:
+        engine = ServingEngine(model, max_batch=16)
+        engine.prewarm()
+        batcher = MicroBatcher(engine, max_wait_ms=1.0)
+        tracer = RQ.ReqTracer("rep-7", sample_rate=1.0)
+        fe = ServeFrontend(engine, batcher, tracer=tracer)
+        httpd = make_http_server(fe)
+        port = httpd.server_address[1]
+        th = threading.Thread(target=httpd.serve_forever,
+                              kwargs={"poll_interval": 0.05},
+                              daemon=True)
+        th.start()
+        yield {"fe": fe, "port": port, "engine": engine,
+               "batcher": batcher}
+        httpd.shutdown()
+        httpd.server_close()
+        batcher.shutdown()
+    finally:
+        os.environ.pop("TMOG_DEBUG_SLEEP_MAX_MS", None)
+
+
+def _post(port, body, headers=None, timeout=30.0):
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        hdrs = {"Content-Type": "application/json"}
+        hdrs.update(headers or {})
+        conn.request("POST", "/score", body=json.dumps(body).encode(),
+                     headers=hdrs)
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+class TestHttpEndToEnd:
+    def test_header_echo_names_replica(self, served):
+        status, data, headers = _post(
+            served["port"], {"a": 1.0, "b": 2.0},
+            headers={RQ.TRACE_HEADER: "feedface00000001"})
+        assert status == 200
+        tid, attrs = RQ.parse_trace_header(headers.get(RQ.TRACE_HEADER))
+        assert tid == "feedface00000001"
+        assert attrs["replica"] == "rep-7"
+
+    def test_invalid_request_kept_as_error_with_chain(self, served):
+        status, data, headers = _post(
+            served["port"], {"a": 1.0, "b": 2.0, "bogus_key": 1})
+        assert status == 400
+        tid, _ = RQ.parse_trace_header(headers.get(RQ.TRACE_HEADER))
+        kept = [k for k in served["fe"].tracer.requests_payload()["kept"]
+                if k["trace_id"] == tid]
+        assert kept and kept[0]["kept"] == "error"
+        assert kept[0]["status"] == 400
+        assert kept[0]["replica"] == "rep-7"
+        assert "parse" in kept[0]["segments"]
+        assert "respond" in kept[0]["segments"]
+
+    def test_requests_endpoint_serves_segments_and_kept(self, served):
+        for i in range(5):
+            _post(served["port"], {"a": float(i), "b": 0.0})
+        doc = get_json("127.0.0.1", served["port"], "/requests")
+        assert doc["replica"] == "rep-7" and doc["enabled"]
+        assert doc["segments"]["queue"]["count"] >= 5
+        assert doc["segments"]["device"]["count"] >= 5
+        assert doc["counters"]["traces"] >= 5
+        assert doc["kept"]  # sample_rate=1.0 keeps everything
+
+    def test_metrics_history_ring(self, served):
+        fe = served["fe"]
+        sampler = RQ.GaugeSampler(fe.sample_gauges, ring=fe.gauges,
+                                  interval_s=0.05)
+        sampler.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and len(fe.gauges) < 3:
+                time.sleep(0.02)
+        finally:
+            sampler.stop()
+        doc = get_json("127.0.0.1", served["port"], "/metrics/history")
+        assert doc["replica"] == "rep-7"
+        assert len(doc["gauges"]) >= 3
+        snap = doc["gauges"][-1]
+        assert {"t", "ts", "queue_depth", "in_flight", "requests",
+                "shed", "post_warmup_compiles", "warm"} <= set(snap)
+
+    def test_debugz_during_inflight_slow_request(self, served):
+        """THE stuck-diagnosis pin: while a (debug-slept) request is in
+        flight, /debugz answers with live thread stacks + queue/beat
+        health instead of queueing behind the slow request."""
+        port = served["port"]
+        done = {}
+
+        def slow():
+            done["r"] = _post(port, {"a": 1.0, "b": 2.0},
+                              headers={RQ.DEBUG_SLEEP_HEADER: "1500"})
+
+        th = threading.Thread(target=slow, daemon=True)
+        th.start()
+        time.sleep(0.3)  # the slow request is inside its sleep now
+        t0 = time.perf_counter()
+        dz = get_json("127.0.0.1", port, "/debugz", timeout=5.0)
+        wall = time.perf_counter() - t0
+        assert dz is not None and wall < 2.0  # did not wait it out
+        assert dz["in_flight"] >= 1
+        assert dz["batcher_alive"] and not dz["batcher_closed"]
+        assert dz["dispatcher_beat_age_s"] < 5.0
+        names = " ".join(dz["threads"])
+        assert "serve-batcher" in names, names
+        # some thread is visibly parked in the debug sleep
+        frames = "\n".join(f for fs in dz["threads"].values()
+                           for f in fs)
+        assert "debug_sleep" in frames or "sleep" in frames
+        th.join(10)
+        assert done["r"][0] == 200
+        kept = [k for k in served["fe"].tracer.requests_payload()["kept"]
+                if "debug_sleep" in k["segments"]]
+        assert kept, "slow request's sleep segment not traced"
+
+
+# ---------------------------------------------------------------------------
+# router -> replica hop: propagation + clock sanity (durations only)
+# ---------------------------------------------------------------------------
+
+class TestRouterHop:
+    def test_clock_sanity_and_coverage(self, served):
+        handle = ReplicaHandle(0, "m", port=served["port"])
+        handle.healthy = True  # tmoglint: disable=THR001  pre-share setup
+        tracer = RQ.ReqTracer("router", origin="router", sample_rate=1.0)
+        router = Router(tracer=tracer)
+        router.set_champions([handle])
+        rt = tracer.start(None)
+        t0 = time.perf_counter()
+        status, data = router.forward_score(
+            json.dumps({"a": 0.1, "b": 0.2}).encode(), trace=rt,
+            headers={RQ.DEBUG_SLEEP_HEADER: "300"})
+        e2e = time.perf_counter() - t0
+        tracer.finish(rt, e2e, status=status)
+        assert status == 200
+        # the replica named itself through the header echo
+        assert rt.replica == "rep-7"
+        segs_r = dict(rt.segs)
+        assert {"route", "upstream"} <= set(segs_r)
+        # the replica-side record of the SAME trace id
+        rep_kept = [k for k in
+                    served["fe"].tracer.requests_payload()["kept"]
+                    if k["trace_id"] == rt.trace_id]
+        assert rep_kept, "replica did not keep the propagated trace"
+        rep = rep_kept[0]
+        assert rep["replica"] == "rep-7"
+        # CLOCK SANITY — durations only, no cross-process timestamp
+        # arithmetic: the replica's own e2e wall must fit inside the
+        # router's upstream wall (+ timeout-scale tolerance for
+        # transport + scheduler noise), and both inside the router e2e
+        tol_ms = 250.0
+        up_ms = segs_r["upstream"] * 1e3
+        assert rep["wall_ms"] <= up_ms + tol_ms, (rep["wall_ms"], up_ms)
+        assert up_ms <= e2e * 1e3 + tol_ms
+        # the joined chain covers the router e2e within tolerance:
+        # route + every replica segment (upstream excluded — it
+        # CONTAINS the replica chain)
+        chain_ms = segs_r["route"] * 1e3 + sum(rep["segments"].values())
+        assert chain_ms >= 300.0  # the injected sleep is attributed
+        assert abs(chain_ms - e2e * 1e3) <= max(0.25 * e2e * 1e3,
+                                                tol_ms)
+
+    def test_shed_replica_marks_trace(self, served):
+        # no healthy replicas -> FleetUnavailable 503 path finishes the
+        # trace as a shed/error keep at the caller
+        tracer = RQ.ReqTracer("router", origin="router", sample_rate=0.0)
+        router = Router(tracer=tracer)
+        rt = tracer.start(None)
+        from transmogrifai_tpu.fleet.router import FleetUnavailable
+        with pytest.raises(FleetUnavailable):
+            router.forward_score(b"{}", trace=rt)
+        reason = tracer.finish(rt, status=503)
+        assert reason in ("shed", "error")
+
+
+# ---------------------------------------------------------------------------
+# trace-report --requests
+# ---------------------------------------------------------------------------
+
+def _write_events(path, docs):
+    with open(path, "w") as f:
+        for i, d in enumerate(docs):
+            rec = {"seq": i, "t": 0.001 * i, "ts": 1000.0 + i,
+                   "event": "request_trace"}
+            rec.update(d)
+            f.write(json.dumps(rec) + "\n")
+
+
+def _trace_doc(tid, origin, wall_ms, segments, **kw):
+    d = {"trace_id": tid, "origin": origin, "replica": "champion-0",
+         "status": 200, "wall_ms": wall_ms, "segments": segments,
+         "kept": "sample"}
+    d.update(kw)
+    return d
+
+
+class TestRequestsReport:
+    def test_green_when_segments_cover(self, tmp_path):
+        _write_events(str(tmp_path / "events.jsonl"), [
+            _trace_doc("a" * 16, "replica", 100.0,
+                       {"queue": 30.0, "device": 65.0, "respond": 4.0}),
+            _trace_doc("b" * 16, "router", 110.0,
+                       {"route": 1.0, "upstream": 105.0}),
+        ])
+        text, rc = tracing.requests_report_rc(str(tmp_path))
+        assert rc == 0, text
+        assert "coverage OK" in text
+
+    def test_flags_undercovered_slow_request(self, tmp_path):
+        _write_events(str(tmp_path / "events.jsonl"), [
+            _trace_doc("c" * 16, "replica", 500.0,
+                       {"queue": 10.0, "device": 20.0}),
+        ])
+        text, rc = tracing.requests_report_rc(str(tmp_path))
+        assert rc == 1
+        assert "unattributed" in text
+
+    def test_small_walls_tolerate_wake_jitter(self, tmp_path):
+        # 3ms request with 2ms unattributed: under the floor, not a flag
+        _write_events(str(tmp_path / "events.jsonl"), [
+            _trace_doc("d" * 16, "replica", 3.0, {"device": 1.0}),
+        ])
+        text, rc = tracing.requests_report_rc(str(tmp_path))
+        assert rc == 0, text
+
+    def test_flags_replica_wall_exceeding_router(self, tmp_path):
+        _write_events(str(tmp_path / "events.jsonl"), [
+            _trace_doc("e" * 16, "router", 100.0,
+                       {"route": 1.0, "upstream": 98.0}),
+            _trace_doc("e" * 16, "replica", 900.0,
+                       {"queue": 100.0, "device": 790.0,
+                        "respond": 10.0}),
+        ])
+        text, rc = tracing.requests_report_rc(str(tmp_path))
+        assert rc == 1
+        assert "exceeds the router-side wall" in text
+
+    def test_rc2_when_no_traces(self, tmp_path):
+        (tmp_path / "events.jsonl").write_text(
+            '{"seq": 0, "t": 0.0, "ts": 1.0, "event": "tick"}\n')
+        text, rc = tracing.requests_report_rc(str(tmp_path))
+        assert rc == 2
+
+    def test_cli_dispatch(self, tmp_path, capsys):
+        from transmogrifai_tpu.cli import main
+        _write_events(str(tmp_path / "events.jsonl"), [
+            _trace_doc("f" * 16, "replica", 50.0,
+                       {"queue": 20.0, "device": 29.0}),
+        ])
+        rc = main(["trace-report", str(tmp_path), "--requests"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "slowest kept traces" in out

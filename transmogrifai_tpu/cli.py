@@ -389,6 +389,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                 "(metrics_location / BENCH_TRACE_DIR)")
     tr.add_argument("--check", action="store_true",
                     help="schema validation only; exit 1 on any problem")
+    tr.add_argument("--requests", action="store_true",
+                    help="request-tracing report: top-K slowest "
+                         "tail-kept traces with their segment "
+                         "breakdown; flags (exit 1) any request whose "
+                         "segments do not cover its e2e wall within "
+                         "tolerance (docs/observability.md)")
     tr.add_argument("--top", type=int, default=15,
                     help="rows in the self-time table (default 15)")
     sv = sub.add_parser(
@@ -445,6 +451,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     sv.add_argument("--monitor-health-gate", action="store_true",
                     help="degrade /healthz to 503 while a drift alert "
                          "is active (hard gate for load balancers)")
+    sv.add_argument("--replica-id", default=None,
+                    help="identity echoed in the X-Tmog-Trace reply "
+                         "header and stamped on kept request traces "
+                         "(the fleet supervisor passes the handle "
+                         "name; default pid<N>)")
+    sv.add_argument("--request-trace", choices=["on", "off"],
+                    default="on",
+                    help="per-request tracing: segment histograms, "
+                         "tail-kept traces under GET /requests, "
+                         "request_trace events "
+                         "(docs/observability.md; TMOG_REQTRACE=0 "
+                         "also disables)")
+    sv.add_argument("--trace-sample", type=float, default=None,
+                    help="probabilistic keep rate for unremarkable "
+                         "requests (errors/sheds/retries/slow are "
+                         "always kept; default TMOG_TRACE_SAMPLE or "
+                         "0.01)")
     fl = sub.add_parser(
         "fleet",
         help="serving FLEET over a saved model: N replica worker "
@@ -481,6 +504,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     default="auto",
                     help="per-replica drift monitoring; the fleet pools "
                          "replica windows into ONE /drift verdict")
+    fl.add_argument("--request-trace", choices=["on", "off"],
+                    default="on",
+                    help="per-request tracing across the fleet: the "
+                         "router mints X-Tmog-Trace ids, replicas "
+                         "stamp segments, GET /requests merges them "
+                         "(pass-through to replicas too)")
+    fl.add_argument("--trace-sample", type=float, default=None,
+                    help="probabilistic keep rate for unremarkable "
+                         "requests (router + replicas)")
     fl.add_argument("--probe-interval-s", type=float, default=0.5,
                     help="router /healthz probe cadence")
     fl.add_argument("--request-timeout-s", type=float, default=30.0,
@@ -536,6 +568,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # exit codes follow docs/static_analysis.md "Exit codes" (the
         # same table the tmoglint CLI uses): 0 clean, 1 problems,
         # 2 usage error (not a traced run dir)
+        if a.requests:
+            from .utils.tracing import requests_report_rc
+            text, rc = requests_report_rc(a.dir, top=a.top)
+            print(text)
+            return rc
         from .utils.tracing import trace_report_rc
         text, rc = trace_report_rc(a.dir, check=a.check, top=a.top)
         print(text)

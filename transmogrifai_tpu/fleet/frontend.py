@@ -8,10 +8,17 @@ handler only maps it onto HTTP.
 Endpoints:
   POST /score          routed to the least-loaded healthy replica
                        (retry-once on connection error; fleet-level 503
-                       when every replica sheds)
+                       when every replica sheds); X-Tmog-* request
+                       headers pass through to the replica, the
+                       X-Tmog-Trace echo names the serving replica
   GET  /healthz        fleet health: replica table + rollout state
   GET  /metrics        MERGED telemetry: counters summed, latency
                        histograms bucket-sum merged (fleet/telemetry)
+  GET  /metrics/history  per-replica gauge rings + the router's own
+                       (time-series; observability.md)
+  GET  /requests       request tracing: per-segment histograms merged
+                       by exact bucket sum + pooled tail-kept traces
+  GET  /debugz         fleet-process thread dump + router health bits
   GET  /drift          pooled drift verdict over the replicas' current
                        window states (one DriftPolicy evaluation)
   GET  /drain          fleet drain: healthz -> 503 (LB rotation), then
@@ -28,12 +35,16 @@ import logging
 import os
 import signal
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from ..monitor.alerts import DriftPolicy
 from ..monitor.profile import ReferenceProfile
-from ..utils.metrics import collector
+from ..serve import reqtrace
+from ..serve.reqtrace import (GaugeSampler, ReqTracer, RequestTrace,
+                              thread_dump)
+from ..utils.metrics import GaugeRing, collector
 from ..workflow.io import load_monitor_profile
 from . import telemetry
 from .rollout import RolloutConflict, RolloutManager
@@ -64,6 +75,17 @@ class FleetFrontend:
         self.profile = profile
         self.policy = policy or DriftPolicy()
         self._draining = threading.Event()
+        # router-side request tracer (observability.md "Request
+        # tracing"): the frontend guarantees one exists — it mints the
+        # trace ids the hop header carries — and shares it with the
+        # Router so forward_score can stamp route/upstream segments.
+        # A Router built bare (unit tests) keeps tracer=None and pays
+        # nothing.
+        if router.tracer is None:
+            router.tracer = ReqTracer("router", origin="router",
+                                      enabled=reqtrace.env_enabled())
+        self.tracer = router.tracer
+        self.gauges = GaugeRing()
         # one persistent poll pool: telemetry scrapes fan out over the
         # replicas concurrently without paying thread churn per scrape
         import concurrent.futures as cf
@@ -74,15 +96,29 @@ class FleetFrontend:
         self._poll_pool.shutdown(wait=False)
 
     # -- scoring ------------------------------------------------------------
-    def forward_score(self, body: bytes):
-        return self.router.forward_score(body)
+    def forward_score(self, body: bytes,
+                      trace: Optional[RequestTrace] = None,
+                      headers: Optional[Dict[str, str]] = None):
+        return self.router.forward_score(body, trace=trace,
+                                         headers=headers)
 
     def submit(self, record: Record) -> Record:
         """In-process single-record scoring through the full router path
         (bench + tests). Raises FleetUnavailable/TimeoutError like the
         HTTP surface; raises RuntimeError on replica-side 4xx/5xx."""
-        status, data = self.router.forward_score(
-            json.dumps(record).encode())
+        rt = self.tracer.start(None)
+        try:
+            status, data = self.router.forward_score(
+                json.dumps(record).encode(), trace=rt)
+        except FleetUnavailable as e:
+            self.tracer.finish(rt, status=e.status,
+                               error_type="FleetUnavailable")
+            raise
+        except TimeoutError:
+            self.tracer.finish(rt, status=504,
+                               error_type="TimeoutError")
+            raise
+        self.tracer.finish(rt, status=status)
         if status != 200:
             raise RuntimeError(f"replica returned {status}: "
                                f"{data[:200]!r}")
@@ -145,6 +181,55 @@ class FleetFrontend:
         }
         return out
 
+    def requests(self) -> Dict[str, Any]:
+        """The fleet ``GET /requests`` payload: per-replica segment
+        histograms merged by exact bucket sum, kept traces pooled with
+        the router's own ring (fleet/telemetry.fleet_requests)."""
+        docs = [m for _, m in self._poll_champions("/requests")
+                if m is not None]
+        return telemetry.fleet_requests(
+            docs, router_payload=self.tracer.requests_payload())
+
+    def history(self) -> Dict[str, Any]:
+        """The fleet ``GET /metrics/history`` payload: per-replica gauge
+        rings + the router's (fleet/telemetry.fleet_history)."""
+        docs = [m for _, m in self._poll_champions("/metrics/history")
+                if m is not None]
+        return telemetry.fleet_history(docs,
+                                       router_gauges=self.gauges.to_json())
+
+    def sample_gauges(self) -> Dict[str, Any]:
+        """Router-side gauge snapshot (GaugeSampler's read)."""
+        with self.router.lock:
+            outstanding = sum(h.outstanding
+                              for h in self.router.champions)
+            n_requests = self.router.n_requests
+            n_retries = self.router.n_retries
+            n_shed = self.router.n_shed
+        return {"healthy_replicas": self.router.healthy_count(),
+                "outstanding": outstanding,
+                "requests": n_requests,
+                "retries": n_retries,
+                "shed": n_shed,
+                "in_flight": self.tracer.in_flight,
+                "draining": self.draining}
+
+    def debugz(self) -> Dict[str, Any]:
+        """Fleet-process "why is it stuck" snapshot: thread dump +
+        router health bits (each replica serves its OWN /debugz with
+        its batcher/dispatcher state)."""
+        with self.router.lock:
+            outstanding = sum(h.outstanding
+                              for h in self.router.replicas())
+        out = {"threads": thread_dump(),
+               "healthy_replicas": self.router.healthy_count(),
+               "outstanding": outstanding,
+               "in_flight": self.tracer.in_flight,
+               "draining": self.draining}
+        if self.rollout is not None:
+            out["rollout_state"] = self.rollout.state
+        return out
+
     def drift(self) -> Optional[Dict[str, Any]]:
         """Pooled fleet drift (None -> 404 when monitoring is off):
         every champion's current window state, summed, one verdict."""
@@ -178,13 +263,27 @@ class _FleetHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt: str, *args: Any) -> None:
         _log.debug("fleet http: " + fmt, *args)
 
+    @staticmethod
+    def _trace_echo(fe: FleetFrontend,
+                    rt: Optional[RequestTrace]) -> Optional[str]:
+        """The X-Tmog-Trace reply header: trace id + the replica that
+        actually served (known after forward_score reads the replica's
+        own echo)."""
+        if rt is None:
+            return None
+        return reqtrace.format_trace_header(rt.trace_id,
+                                            replica=rt.replica)
+
     def _reply(self, code: int, payload: Any,
-               raw: Optional[bytes] = None) -> None:
+               raw: Optional[bytes] = None,
+               trace_header: Optional[str] = None) -> None:
         body = raw if raw is not None else json.dumps(
             payload, default=str).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if trace_header:
+            self.send_header(reqtrace.TRACE_HEADER, trace_header)
         self.end_headers()
         self.wfile.write(body)
 
@@ -197,6 +296,12 @@ class _FleetHandler(BaseHTTPRequestHandler):
                             else 200, h)
             elif self.path == "/metrics":
                 self._reply(200, fe.metrics())
+            elif self.path == "/metrics/history":
+                self._reply(200, fe.history())
+            elif self.path == "/requests":
+                self._reply(200, fe.requests())
+            elif self.path == "/debugz":
+                self._reply(200, fe.debugz())
             elif self.path == "/drain":
                 self._reply(200, fe.drain())
             elif self.path == "/drift":
@@ -223,14 +328,49 @@ class _FleetHandler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", "0"))
             body = self.rfile.read(length)
             if self.path == "/score":
+                # hop context: adopt a client-supplied trace id when one
+                # arrived, pass every X-Tmog-* header through to the
+                # replica (the debug-sleep chaos hook rides this too)
+                rt = fe.tracer.start(
+                    self.headers.get(reqtrace.TRACE_HEADER))
+                t0 = time.perf_counter()
+                fwd = {k: v for k, v in self.headers.items()
+                       if k.lower().startswith("x-tmog-")}
+                status = None
+                err: Optional[str] = None
                 try:
-                    status, data = fe.forward_score(body)
-                    self._reply(status, None, raw=data)
-                except FleetUnavailable as e:
-                    self._reply(e.status, {"error": str(e),
-                                           "error_type": "FleetUnavailable"})
-                except TimeoutError as e:
-                    self._reply(504, {"error": str(e)})
+                    try:
+                        status, data = fe.forward_score(body, trace=rt,
+                                                        headers=fwd)
+                    except FleetUnavailable as e:
+                        status, err = e.status, "FleetUnavailable"
+                        self._reply(status,
+                                    {"error": str(e),
+                                     "error_type": "FleetUnavailable"},
+                                    trace_header=self._trace_echo(fe,
+                                                                  rt))
+                    except TimeoutError as e:
+                        status, err = 504, "TimeoutError"
+                        self._reply(504, {"error": str(e)},
+                                    trace_header=self._trace_echo(fe,
+                                                                  rt))
+                    else:
+                        t1 = time.perf_counter()
+                        self._reply(status, None, raw=data,
+                                    trace_header=self._trace_echo(fe,
+                                                                  rt))
+                        if rt is not None:
+                            rt.seg("respond",
+                                   time.perf_counter() - t1)
+                except OSError:
+                    # client hung up mid-reply: still worth keeping
+                    err = err or "ClientDisconnect"
+                    raise
+                finally:
+                    # finish on EVERY exit (incl. a failed reply write)
+                    # or in_flight leaks and the trace is dropped
+                    fe.tracer.finish(rt, time.perf_counter() - t0,
+                                     status=status, error_type=err)
             elif self.path == "/rollout":
                 doc = json.loads(body or b"{}")
                 out = fe.start_rollout(
@@ -301,6 +441,10 @@ def run_fleet(args: Any) -> int:
         serve_args += ["--single-record", args.single_record]
     if getattr(args, "monitor", None):
         serve_args += ["--monitor", args.monitor]
+    if getattr(args, "request_trace", None):
+        serve_args += ["--request-trace", args.request_trace]
+    if getattr(args, "trace_sample", None) is not None:
+        serve_args += ["--trace-sample", str(args.trace_sample)]
 
     lock = threading.RLock()
     supervisor = Supervisor(
@@ -310,7 +454,12 @@ def run_fleet(args: Any) -> int:
         serve_args=serve_args,
         max_restarts=int(getattr(args, "max_restarts", 20)))
     router = Router(lock, request_timeout=float(
-        getattr(args, "request_timeout_s", 30.0)))
+        getattr(args, "request_timeout_s", 30.0)),
+        tracer=ReqTracer(
+            "router", origin="router",
+            enabled=(getattr(args, "request_trace", "on") != "off"
+                     and reqtrace.env_enabled()),
+            sample_rate=getattr(args, "trace_sample", None)))
 
     profile = policy = None
     if getattr(args, "monitor", "auto") != "off":
@@ -344,6 +493,8 @@ def run_fleet(args: Any) -> int:
         score_field=pred.field if pred else None)
     frontend = FleetFrontend(supervisor, router, rollout,
                              profile=profile, policy=policy)
+    gauge_sampler = GaugeSampler(frontend.sample_gauges,
+                                 ring=frontend.gauges).start()
     httpd = make_fleet_server(frontend, host=args.host, port=args.port)
     host, port = httpd.server_address[:2]
     _log.info("fleet: %d replica(s) of %s behind http://%s:%s",
@@ -369,6 +520,7 @@ def run_fleet(args: Any) -> int:
         httpd.serve_forever(poll_interval=0.1)
     finally:
         httpd.server_close()
+        gauge_sampler.stop()
         prober.stop()
         if rollout is not None:
             rollout.abort()

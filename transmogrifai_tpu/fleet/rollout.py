@@ -252,18 +252,22 @@ class RolloutManager:
         return self.status()
 
     # -- shadow path --------------------------------------------------------
-    def observe(self, request_body: bytes, response_body: bytes) -> None:
+    def observe(self, request_body: bytes, response_body: bytes) -> bool:
         """Router hook: one mirrored (request, champion response) pair,
         RAW bytes. Enqueue-and-return — parsing, score extraction and
         challenger scoring all happen on the worker thread, so the
         request thread's only shadow cost is this put; a full queue
         DROPS the sample (counted): shadow scoring must never apply
-        backpressure to live traffic."""
+        backpressure to live traffic. Returns False on a drop — the
+        router marks the request's trace so the tail sampler keeps
+        evidence of shadow starvation."""
         try:
             self._q.put_nowait((request_body, response_body))
+            return True
         except queue.Full:
             with self.lock:
                 self.shadow_dropped += 1
+            return False
 
     def _shadow_loop(self) -> None:
         while not self._stop.is_set():

@@ -37,6 +37,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..serve import reqtrace
+from ..serve.reqtrace import ReqTracer, RequestTrace
 from ..utils.metrics import LatencyHistogram, collector
 
 _log = logging.getLogger("transmogrifai_tpu.fleet")
@@ -101,20 +103,37 @@ class ReplicaHandle:
                 "last_error": self.last_error}
 
 
+def http_exchange(host: str, port: int, method: str, path: str,
+                  body: Optional[bytes] = None, timeout: float = 30.0,
+                  headers: Optional[Dict[str, str]] = None
+                  ) -> Tuple[int, bytes, Dict[str, str]]:
+    """One HTTP exchange; returns (status, raw body, response headers).
+    `headers` ride the request — the router propagates the
+    ``X-Tmog-Trace`` hop context through here, and the replica's echo
+    (carrying its replica id) comes back in the third element. Raises
+    the CONN_ERRORS family on transport failure and TimeoutError when
+    the replica accepted but did not answer in time."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        hdrs = dict(headers or {})
+        if body and "Content-Type" not in hdrs:
+            hdrs["Content-Type"] = "application/json"
+        conn.request(method, path, body=body, headers=hdrs)
+        resp = conn.getresponse()
+        return resp.status, resp.read(), dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
 def http_json(host: str, port: int, method: str, path: str,
               body: Optional[bytes] = None, timeout: float = 30.0
               ) -> Tuple[int, bytes]:
     """One HTTP exchange against a replica; returns (status, raw body).
     Raises the CONN_ERRORS family on transport failure and TimeoutError
     when the replica accepted but did not answer in time."""
-    conn = http.client.HTTPConnection(host, port, timeout=timeout)
-    try:
-        headers = {"Content-Type": "application/json"} if body else {}
-        conn.request(method, path, body=body, headers=headers)
-        resp = conn.getresponse()
-        return resp.status, resp.read()
-    finally:
-        conn.close()
+    status, data, _ = http_exchange(host, port, method, path, body=body,
+                                    timeout=timeout)
+    return status, data
 
 
 def get_json(host: str, port: int, path: str,
@@ -142,7 +161,8 @@ class Router:
     final, never on its latency path."""
 
     def __init__(self, lock: Optional[threading.RLock] = None, *,
-                 request_timeout: float = 30.0):
+                 request_timeout: float = 30.0,
+                 tracer: Optional[ReqTracer] = None):
         #: THE fleet lock (shared with the Supervisor + RolloutManager)
         self.lock = lock or threading.RLock()
         self.request_timeout = float(request_timeout)
@@ -155,6 +175,10 @@ class Router:
         self.shadow_hook: Optional[Callable[[Record, Record], None]] = None
         self.shadow_fraction = 0.0
         self._pick_seq = 0
+        #: router-side request tracer (reqtrace; set by FleetFrontend /
+        #: run_fleet): mints the trace id the X-Tmog-Trace header
+        #: carries to the replica, records route/upstream segments
+        self.tracer = tracer
 
     # -- pool management ---------------------------------------------------
     def set_champions(self, handles: List[ReplicaHandle]) -> None:
@@ -221,76 +245,134 @@ class Router:
         _log.warning("fleet: replica %s connection failure (%s); "
                      "marked unhealthy, retrying elsewhere", h.name, err)
 
-    def forward_score(self, body: bytes) -> Tuple[int, bytes]:
+    def forward_score(self, body: bytes, *,
+                      trace: Optional[RequestTrace] = None,
+                      headers: Optional[Dict[str, str]] = None
+                      ) -> Tuple[int, bytes]:
         """Route one /score body to a champion. Returns (status, body)
         to pass through verbatim; raises FleetUnavailable when no
-        replica could take it."""
+        replica could take it.
+
+        `trace` (reqtrace, owned + finished by the CALLER — the fleet
+        frontend, which still has the respond segment to stamp) gets the
+        router segments: `route` (pick wall), `upstream` (replica
+        exchange wall, summed across a retry), the retry count, and the
+        serving replica id read from the X-Tmog-Trace echo. `headers`
+        pass through to the replica — the hop-context header plus any
+        client-supplied X-Tmog-* headers the frontend forwards."""
         t0 = time.perf_counter()
         tried: set = set()
         conn_failures = 0
         saw_shed = False
-        while True:
-            picked = self._pick(tried)
-            if picked is None:
-                break
-            h, host, port = picked
-            tried.add(h.name)
-            try:
-                status, data = http_json(host, port, "POST", "/score",
-                                         body=body,
-                                         timeout=self.request_timeout)
-            except TimeoutError:
+        pick_s = 0.0
+        upstream_s = 0.0
+        fwd_headers = dict(headers or {})
+        if trace is not None:
+            fwd_headers[reqtrace.TRACE_HEADER] = trace.trace_id
+        try:
+            while True:
+                tp = time.perf_counter()
+                picked = self._pick(tried)
+                pick_s += time.perf_counter() - tp
+                if picked is None:
+                    break
+                h, host, port = picked
+                tried.add(h.name)
+                tu = time.perf_counter()
+                try:
+                    status, data, rhead = http_exchange(
+                        host, port, "POST", "/score", body=body,
+                        timeout=self.request_timeout,
+                        headers=fwd_headers)
+                except TimeoutError:
+                    upstream_s += time.perf_counter() - tu
+                    self._done(h)
+                    if trace is not None:
+                        # caller-thread-owned record (reqtrace contract)
+                        trace.replica = h.name  # tmoglint: disable=THR001
+                    raise
+                except CONN_ERRORS as e:
+                    upstream_s += time.perf_counter() - tu
+                    self._done(h)
+                    self._mark_conn_failure(h, f"{type(e).__name__}: {e}")
+                    conn_failures += 1
+                    if conn_failures > 1:
+                        break  # retry-ONCE: two dead sockets end it
+                    with self.lock:
+                        self.n_retries += 1
+                    if trace is not None:
+                        # caller-thread-owned record (reqtrace contract)
+                        trace.retries += 1  # tmoglint: disable=THR001
+                    collector.event("fleet_retry", replica=h.name,
+                                    error=type(e).__name__)
+                    continue
+                upstream_s += time.perf_counter() - tu
                 self._done(h)
-                raise
-            except CONN_ERRORS as e:
-                self._done(h)
-                self._mark_conn_failure(h, f"{type(e).__name__}: {e}")
-                conn_failures += 1
-                if conn_failures > 1:
-                    break  # retry-ONCE: two dead sockets end the request
+                if status == 503:
+                    # the replica shed (queue full) or is mid-drain: its
+                    # refusal is not the fleet's — spread to the rest
+                    saw_shed = True
+                    continue
+                self.hist.record(time.perf_counter() - t0)
                 with self.lock:
-                    self.n_retries += 1
-                collector.event("fleet_retry", replica=h.name,
-                                error=type(e).__name__)
-                continue
-            self._done(h)
-            if status == 503:
-                # the replica shed (queue full) or is mid-drain: its
-                # refusal is not the fleet's — spread to the rest
-                saw_shed = True
-                continue
-            self.hist.record(time.perf_counter() - t0)
-            with self.lock:
-                self.n_requests += 1
-                hook, frac = self.shadow_hook, self.shadow_fraction
-            if hook is not None and status == 200:
-                self._maybe_shadow(hook, frac, body, data)
-            return status, data
-        if saw_shed:
-            with self.lock:
-                self.n_shed += 1
-                total = self.n_shed
-            collector.event("fleet_shed", shed_total=total,
-                            replicas_tried=len(tried))
+                    self.n_requests += 1
+                    hook, frac = self.shadow_hook, self.shadow_fraction
+                if trace is not None:
+                    # the serving replica NAMES ITSELF via the header
+                    # echo; the handle name is the fallback (old
+                    # replicas, stripped proxies). The trace is the
+                    # calling request thread's own record (reqtrace
+                    # single-owner contract)
+                    _, attrs = reqtrace.parse_trace_header(
+                        (rhead or {}).get(reqtrace.TRACE_HEADER))
+                    trace.replica = attrs.get("replica") or h.name  # tmoglint: disable=THR001
+                if hook is not None and status == 200:
+                    self._maybe_shadow(hook, frac, body, data, trace)
+                return status, data
+            if saw_shed:
+                with self.lock:
+                    self.n_shed += 1
+                    total = self.n_shed
+                collector.event("fleet_shed", shed_total=total,
+                                replicas_tried=len(tried))
+                if trace is not None:
+                    # caller-thread-owned record (reqtrace contract)
+                    trace.shed = True  # tmoglint: disable=THR001
+                raise FleetUnavailable(
+                    503,
+                    "every replica shed the request (fleet overloaded)")
             raise FleetUnavailable(
-                503, "every replica shed the request (fleet overloaded)")
-        raise FleetUnavailable(
-            502 if conn_failures else 503,
-            f"no healthy replica available "
-            f"({conn_failures} connection failure(s), {len(tried)} tried)")
+                502 if conn_failures else 503,
+                f"no healthy replica available "
+                f"({conn_failures} connection failure(s), "
+                f"{len(tried)} tried)")
+        finally:
+            # segments stamp on EVERY exit (success, shed, timeout,
+            # no-replica): the caller finishes the trace with the
+            # status it replies with
+            if trace is not None:
+                trace.seg("route", pick_s)
+                if upstream_s:
+                    trace.seg("upstream", upstream_s)
 
-    def _maybe_shadow(self, hook: Callable[[bytes, bytes], None],
-                      fraction: float, body: bytes, data: bytes) -> None:
+    def _maybe_shadow(self, hook: Callable[[bytes, bytes], Any],
+                      fraction: float, body: bytes, data: bytes,
+                      trace: Optional[RequestTrace] = None) -> None:
         """Sample this request into the rollout's shadow stream: one
         random() and one bounded-queue put of the RAW bytes — parsing
         and challenger scoring happen on the rollout's worker thread,
         so the request path pays effectively nothing. The rollout
         worker discards bulk (list) bodies; only single-record requests
-        count as live traffic."""
+        count as live traffic. A DROPPED mirror (queue full — the hook
+        returns False) marks the trace so the tail sampler keeps it:
+        shadow starvation under load is exactly a tail event worth a
+        kept trace."""
         import random
         if fraction <= 0.0 or random.random() >= fraction:
             return
-        hook(body, data)
+        if hook(body, data) is False and trace is not None:
+            # caller-thread-owned record (reqtrace contract)
+            trace.shadow_dropped = True  # tmoglint: disable=THR001
 
     # -- drain coordination ------------------------------------------------
     def remove(self, handles: List[ReplicaHandle]) -> None:

@@ -154,6 +154,10 @@ class Supervisor:
                  handle.model_dir, "--host", self.host,
                  "--port", str(handle.port),
                  "--metrics-location", handle.metrics_dir,
+                 # fleet-assigned identity: the replica echoes it in the
+                 # X-Tmog-Trace header + stamps it on every kept trace,
+                 # so a router-side record names the serving replica
+                 "--replica-id", handle.name,
                  "--strict-manifest"] + self.serve_args)
 
     def _spawn(self, handle: ReplicaHandle) -> None:

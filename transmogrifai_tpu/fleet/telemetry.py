@@ -39,8 +39,10 @@ from ..monitor.window import WindowSnapshot
 from ..utils.metrics import LatencyHistogram
 
 #: engine counters that merge by summation across replicas
+#: (pad_rows/bucket_rows back the fleet-wide pad fraction of the
+#: request-tracing segment decomposition, observability.md)
 _SUM_KEYS = ("requests", "batches", "rows", "shed",
-             "post_warmup_compiles")
+             "post_warmup_compiles", "pad_rows", "bucket_rows")
 
 
 def merge_latency(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -79,6 +81,86 @@ def fleet_metrics(replica_metrics: List[Dict[str, Any]],
     if per_replica is not None:
         out["per_replica"] = per_replica
     return out
+
+
+def fleet_requests(replica_payloads: List[Dict[str, Any]],
+                   router_payload: Optional[Dict[str, Any]] = None,
+                   top: int = 20) -> Dict[str, Any]:
+    """The fleet ``GET /requests`` payload: per-segment latency
+    histograms merged by EXACT bucket sum across replicas (same
+    arithmetic as fleet /metrics latency — the merged p99 of the
+    `device` segment IS the p99 of the union stream), kept traces
+    POOLED (router-side + every replica's ring) and ranked
+    slowest-first, counters summed. The router's own segment
+    histograms (route/upstream walls) stay separate under
+    ``router_segments`` — summing a hop's wall into the replica
+    segments would double-count the time."""
+    docs = [p for p in replica_payloads if isinstance(p, dict)]
+    names: List[str] = []
+    for d in docs:
+        for nm in (d.get("segments") or {}):
+            if nm not in names:
+                names.append(nm)
+    segments = {
+        nm: merge_latency([d["segments"][nm] for d in docs
+                           if nm in (d.get("segments") or {})])
+        for nm in names}
+    kept: List[Dict[str, Any]] = []
+    counters = {"traces": 0, "kept": 0, "in_flight": 0}
+    by_reason: Dict[str, int] = {}
+    sources = docs + ([router_payload]
+                      if isinstance(router_payload, dict) else [])
+    for d in sources:
+        kept.extend(k for k in (d.get("kept") or [])
+                    if isinstance(k, dict))
+        c = d.get("counters") or {}
+        for key in counters:
+            counters[key] += int(c.get(key) or 0)
+        for reason, n in (c.get("kept_by_reason") or {}).items():
+            by_reason[reason] = by_reason.get(reason, 0) + int(n)
+    counters["kept_by_reason"] = by_reason
+    # outcome keeps (error/shed/retry/shadow_drop) rank ahead of
+    # merely-slow/sampled ones, slowest-first within each class: a
+    # bounded top-K must not let a burst of tail-latency keeps crowd
+    # out the one failed request the operator is hunting
+    kept.sort(key=lambda k: (
+        0 if k.get("kept") not in ("sample", "slow") else 1,
+        -(k.get("wall_ms") if isinstance(k.get("wall_ms"),
+                                         (int, float)) else 0.0)))
+    # router+replica records of one request share a trace id — surface
+    # how many kept traces have their cross-hop twin in the pool
+    ids: Dict[str, set] = {}
+    for k in kept:
+        tid = k.get("trace_id")
+        if isinstance(tid, str):
+            ids.setdefault(tid, set()).add(k.get("origin"))
+    out: Dict[str, Any] = {
+        "replicas": len(docs),
+        "segments": segments,
+        "kept": kept[:int(top)],
+        "counters": counters,
+        "joined_traces": sum(1 for o in ids.values() if len(o) > 1),
+    }
+    if isinstance(router_payload, dict):
+        out["router_segments"] = router_payload.get("segments") or {}
+    return out
+
+
+def fleet_history(replica_payloads: List[Dict[str, Any]],
+                  router_gauges: Optional[List[Dict[str, Any]]] = None
+                  ) -> Dict[str, Any]:
+    """The fleet ``GET /metrics/history`` payload: every replica's gauge
+    ring keyed by replica id, plus the router's own ring. Gauge series
+    are deliberately NOT summed across replicas — each snapshot is
+    stamped on its own process clock, and aligning unsynchronized
+    clocks is exactly the cross-process timestamp arithmetic this layer
+    refuses to do; per-replica series + the summed counters in /metrics
+    carry the same information honestly."""
+    replicas: Dict[str, Any] = {}
+    for d in replica_payloads:
+        if isinstance(d, dict) and d.get("replica"):
+            replicas[str(d["replica"])] = d.get("gauges") or []
+    return {"router": list(router_gauges or []), "replicas": replicas}
 
 
 def merge_window_states(states: List[Dict[str, Any]]) -> WindowSnapshot:

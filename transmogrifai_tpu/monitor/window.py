@@ -368,6 +368,16 @@ class ServeMonitor:
                 "history": list(self.history),
             }
 
+    def gauge_state(self) -> Dict[str, Any]:
+        """Drift gauges for the ``GET /metrics/history`` ring
+        (serve/reqtrace.GaugeSampler): the alerting verdict + window
+        progress as plain values — no device fetch, no report build."""
+        with self._lock:
+            return {"drift_alerting": self.alerting,
+                    "drift_windows": self.n_windows,
+                    "drift_alerts_total": self.alerts_total,
+                    "rows_in_window": self._rows}
+
     def metrics(self) -> Dict[str, Any]:
         """Compact counters for the ``/metrics`` payload."""
         with self._lock:

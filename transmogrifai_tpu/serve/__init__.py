@@ -30,8 +30,12 @@ replica-side half of that fleet contract.
 from .batcher import MicroBatcher, Overloaded
 from .engine import ServingEngine, bucket_ladder, template_record
 from .frontend import ServeFrontend, make_http_server, run_serve
+from .reqtrace import (BatchTrace, GaugeSampler, ReqTracer, RequestTrace,
+                       TailSampler, thread_dump)
 
 __all__ = [
     "MicroBatcher", "Overloaded", "ServingEngine", "bucket_ladder",
     "template_record", "ServeFrontend", "make_http_server", "run_serve",
+    "BatchTrace", "GaugeSampler", "ReqTracer", "RequestTrace",
+    "TailSampler", "thread_dump",
 ]

@@ -25,6 +25,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from .reqtrace import BatchTrace, RequestTrace
+
 Record = Dict[str, Any]
 
 
@@ -40,14 +42,18 @@ class Overloaded(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("record", "t_enq", "done", "result", "error")
+    __slots__ = ("record", "t_enq", "done", "result", "error", "trace")
 
-    def __init__(self, record: Record):
+    def __init__(self, record: Record,
+                 trace: Optional[RequestTrace] = None):
         self.record = record
         self.t_enq = time.perf_counter()
         self.done = threading.Event()
         self.result: Optional[Record] = None
         self.error: Optional[BaseException] = None
+        #: per-request trace record (reqtrace, docs/observability.md):
+        #: the dispatcher stamps queue/batch/device segments onto it
+        self.trace = trace
 
 
 class MicroBatcher:
@@ -68,21 +74,27 @@ class MicroBatcher:
         self._q: "collections.deque[_Pending]" = collections.deque()
         self._cond = threading.Condition()
         self._closed = False
+        #: dispatcher heartbeat (written under _cond each loop pass):
+        #: /debugz serves its age — a wedged dispatcher shows up as a
+        #: beat that stopped advancing while the queue grows
+        self._beat = time.perf_counter()
         self._thread = threading.Thread(target=self._loop,
                                         name="serve-batcher", daemon=True)
         self._thread.start()
 
     # -- client side -------------------------------------------------------
     def submit(self, record: Record,
-               timeout: Optional[float] = None) -> Record:
+               timeout: Optional[float] = None,
+               trace: Optional[RequestTrace] = None) -> Record:
         """Validate, enqueue, block for the scored result.
 
         Raises the typed validation errors (unknown/missing/invalid
         feature — reject before admission), :class:`Overloaded` on a full
         queue, TimeoutError when `timeout` expires first, RuntimeError
-        after shutdown."""
+        after shutdown. `trace` (reqtrace) rides the pending slot; the
+        dispatcher stamps queue wait + the batch's shared walls onto it."""
         self.engine.validate_record(record)
-        p = _Pending(record)
+        p = _Pending(record, trace)
         with self._cond:
             if self._closed:
                 raise RuntimeError("batcher is shut down")
@@ -103,6 +115,16 @@ class MicroBatcher:
                     withdrawn = True
                 except ValueError:
                     withdrawn = False
+                # reclaim the trace record before raising: past this
+                # point the CALLER finishes it, and a mid-dispatch
+                # stamp would break the reqtrace single-owner handoff.
+                # The dispatcher captures p.trace ONCE per pending, so
+                # after this detach at most a stamp already in progress
+                # lands — attribute/list ops are CPython-atomic, the
+                # record stays structurally sound and can at worst miss
+                # the late batch segments of a request that timed out
+                # anyway
+                p.trace = None
             if withdrawn or not p.done.is_set():
                 raise TimeoutError(f"no result within {timeout}s "
                                    f"(queue depth {len(self._q)})")
@@ -114,10 +136,24 @@ class MicroBatcher:
     def queue_len(self) -> int:
         return len(self._q)
 
+    @property
+    def alive(self) -> bool:
+        """Dispatcher thread liveness (the /debugz health bit)."""
+        return self._thread.is_alive()
+
+    def beat_age(self) -> float:
+        """Seconds since the dispatcher last passed the top of its loop
+        — near zero on a healthy batcher (it wakes at least every 100ms
+        idle); a growing age with a non-empty queue means the dispatcher
+        is stuck inside a batch (device hang, lock convoy)."""
+        with self._cond:
+            return max(time.perf_counter() - self._beat, 0.0)
+
     # -- dispatcher --------------------------------------------------------
     def _loop(self) -> None:
         while True:
             with self._cond:
+                self._beat = time.perf_counter()
                 while not self._q and not self._closed:
                     self._cond.wait(0.1)
                     if not self._q and not self._closed:
@@ -153,19 +189,37 @@ class MicroBatcher:
         t_d = time.perf_counter()
         for p in batch:
             self.engine.observe_queue_wait(t_d - p.t_enq)
+        # one BatchTrace per traced dispatch: the engine fills the
+        # shared assemble/device/monitor walls, every traced rider gets
+        # them stamped below (an untraced batch allocates nothing)
+        bt = (BatchTrace()
+              if any(p.trace is not None for p in batch) else None)
         try:
             bucket = self.engine.pick_bucket(len(batch))
-            results = self.engine.score_batch([p.record for p in batch])
+            records = [p.record for p in batch]
+            # keyword only when tracing: duck-typed engine stands-ins
+            # (tests, adapters) keep their plain score_batch signature
+            results = (self.engine.score_batch(records) if bt is None
+                       else self.engine.score_batch(records,
+                                                    batch_trace=bt))
         except BaseException as e:
             # submit-time validation already rejected record-level
             # problems, so a failure here is systemic — every waiter of
             # THIS batch gets the typed cause instead of hanging
             for p in batch:
+                tr = p.trace  # ONE read: a timeout may null it out
+                if tr is not None:
+                    tr.seg("queue", t_d - p.t_enq)
                 p.error = e
                 p.done.set()
             return
         t_end = time.perf_counter()
         for p, r in zip(batch, results):
+            tr = p.trace  # ONE read: a timed-out submit reclaims it
+            if tr is not None:
+                tr.seg("queue", t_d - p.t_enq)
+                if bt is not None:
+                    bt.stamp(tr)
             p.result = r
             p.done.set()
             self.engine.observe_request(t_end - p.t_enq, bucket)

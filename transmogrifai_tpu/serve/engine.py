@@ -198,10 +198,18 @@ class ServingEngine:
             "queue_wait": LatencyHistogram("serve_queue_wait"),
             "batch_assemble": LatencyHistogram("serve_batch_assemble"),
             "device_score": LatencyHistogram("serve_device_score"),
+            "monitor_observe": LatencyHistogram("serve_monitor_observe"),
         }
         self.n_requests = 0
         self.n_batches = 0
         self.n_rows = 0
+        #: pad accounting (the request-tracing segment decomposition,
+        #: docs/observability.md): bucket_rows = device rows actually
+        #: scored (incl. padding), pad_rows = the padding share — both
+        #: plain sums, so the fleet merge is exact and
+        #: pad_rows / bucket_rows is the fleet-wide pad fraction
+        self.pad_rows = 0
+        self.bucket_rows = 0
         self.n_shed = 0
         self.warm = False
         self.post_warmup_compiles = 0
@@ -289,18 +297,23 @@ class ServingEngine:
         return Dataset(cols, n_rows=bucket)
 
     # -- scoring -----------------------------------------------------------
-    def score_batch(self, records: Sequence[Record]) -> List[Record]:
+    def score_batch(self, records: Sequence[Record],
+                    batch_trace: Optional[Any] = None) -> List[Record]:
         """Score records through the bucket ladder; returns one
         {result_feature: value} dict per record (same row shapes as the
         local per-record path — map-typed predictions unpack to dicts).
-        Batches above the top bucket chunk into max-bucket slices."""
+        Batches above the top bucket chunk into max-bucket slices.
+        `batch_trace` (reqtrace.BatchTrace) receives the batch's shared
+        assemble/device/monitor walls + pad accounting; chunked bulk
+        accumulates across the slices."""
         records = list(records)
         if not records:
             return []
         if len(records) > self.max_batch:
             out: List[Record] = []
             for s in range(0, len(records), self.max_batch):
-                out.extend(self.score_batch(records[s:s + self.max_batch]))
+                out.extend(self.score_batch(records[s:s + self.max_batch],
+                                            batch_trace=batch_trace))
             return out
         with self._stat_lock:
             warm = self.warm
@@ -308,11 +321,17 @@ class ServingEngine:
             t0 = time.perf_counter()
             res = self._local_fn(records[0])  # host replay: no device lock
             row = self._local_row(res)
+            t1 = time.perf_counter()
+            mon_s = 0.0
             with self._lock:  # counters/histograms share the lock though
-                self._observe_batch(1, 1, 0.0, time.perf_counter() - t0,
-                                    path="local")
+                self._observe_batch(1, 1, 0.0, t1 - t0, path="local")
                 if self.monitor is not None and not self.monitor_disabled:
                     self._observe_monitor_record(records[0], row)
+                    mon_s = time.perf_counter() - t1
+                    self._observe_monitor_wall(mon_s)
+            if batch_trace is not None:
+                batch_trace.add(1, 1, 0.0, t1 - t0, monitor_s=mon_s,
+                                path="local")
             return [row]
         n = len(records)
         bucket = self.pick_bucket(n)
@@ -335,9 +354,18 @@ class ServingEngine:
                    for i in range(n)]
             t2 = time.perf_counter()
             self._observe_batch(bucket, n, t1 - t0, t2 - t1)
+            mon_s = 0.0
             if self.monitor is not None and not self.monitor_disabled:
+                # the monitor segment measures what the REQUEST PATH
+                # pays for observation — the async sketch dispatch +
+                # host hash/score sums, NOT the device wall (that is
+                # fetched once per window close, off this path)
                 self._observe_monitor(ds, out, n, bucket)
+                mon_s = time.perf_counter() - t2  # tmoglint: disable=TPU005  dispatch cost IS the measurement
+                self._observe_monitor_wall(mon_s)
             self._check_recompiles()
+        if batch_trace is not None:
+            batch_trace.add(bucket, n, t1 - t0, t2 - t1, monitor_s=mon_s)
         return out
 
     def _local_row(self, res: Record) -> Record:
@@ -545,12 +573,20 @@ class ServingEngine:
         collector.event("serve_shed", queue_len=queue_len,
                         shed_total=shed_total)
 
+    def _observe_monitor_wall(self, seconds: float) -> None:
+        """Book one batch's monitor-observation wall (request-path cost
+        of the drift sketches — the `monitor` trace segment)."""
+        self.hist["monitor_observe"].record(seconds)
+        collector.latency("serve_monitor_observe", seconds)
+
     def _observe_batch(self, bucket: int, n_valid: int,
                        assemble_s: float, score_s: float,
                        path: str = "bucket") -> None:
         with self._stat_lock:
             self.n_batches += 1
             self.n_rows += n_valid
+            self.pad_rows += bucket - n_valid
+            self.bucket_rows += bucket
             in_budget = self.n_batches <= self._span_budget
             anchor = self._anchor
         self.hist["batch_assemble"].record(assemble_s)
@@ -600,6 +636,8 @@ class ServingEngine:
                    "requests": self.n_requests,
                    "batches": self.n_batches,
                    "rows": self.n_rows,
+                   "pad_rows": self.pad_rows,
+                   "bucket_rows": self.bucket_rows,
                    "shed": self.n_shed,
                    "post_warmup_compiles": self.post_warmup_compiles,
                    "prewarm": self.prewarm_summary,
@@ -612,4 +650,20 @@ class ServingEngine:
             out["monitor"]["disabled"] = disabled
         else:
             out.pop("monitor_errors")
+        return out
+
+    def gauge_state(self) -> Dict[str, Any]:
+        """One cheap gauge snapshot (counters only, no histogram
+        serialization) — the GaugeSampler's per-interval read for the
+        ``GET /metrics/history`` ring (docs/observability.md)."""
+        with self._stat_lock:
+            out: Dict[str, Any] = {
+                "requests": self.n_requests,
+                "rows": self.n_rows,
+                "shed": self.n_shed,
+                "post_warmup_compiles": self.post_warmup_compiles,
+                "warm": self.warm}
+        mon = self.monitor
+        if mon is not None:
+            out.update(mon.gauge_state())
         return out

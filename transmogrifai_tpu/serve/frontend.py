@@ -13,12 +13,27 @@ Endpoints:
   POST /score         {record} -> scores; [records] -> bulk (no queue)
   GET  /healthz       liveness + warm/bucket state (503 when draining)
   GET  /metrics       engine counters + p50/p95/p99 latency histograms
+  GET  /metrics/history  ring of periodic gauge snapshots (queue depth,
+                      in-flight, shed, compiles, drift verdicts) — the
+                      time-series behind the counters
+  GET  /requests      request-tracing payload: per-segment latency
+                      histograms (the fleet merge unit) + the tail-kept
+                      trace ring (observability.md "Request tracing")
+  GET  /debugz        live thread names + stack frames, queue depth,
+                      dispatcher heartbeat age — the "why is it stuck"
+                      snapshot
   GET  /drain         flip /healthz to draining-503 (also SIGUSR1) so a
                       router/LB rotates this replica out BEFORE SIGTERM;
                       in-flight and still-arriving requests keep scoring
   GET  /drift         drift-monitor report (monitoring.md)
   GET  /drift/window  the CURRENT window's raw sufficient statistics —
                       what the fleet telemetry merger pools (fleet.md)
+
+Request tracing: every /score request gets a RequestTrace (trace id
+adopted from the router's ``X-Tmog-Trace`` header or minted), segments
+stamped through parse -> queue -> batch -> device -> monitor -> respond,
+tail-sampled at completion; the reply echoes the header back with this
+replica's id so the router's record and this one share a trace id.
 """
 from __future__ import annotations
 
@@ -27,14 +42,18 @@ import logging
 import os
 import signal
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, List, Optional, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 from ..local.scoring import (InvalidFeatureError, MissingFeatureError,
                              UnknownFeatureError)
-from ..utils.metrics import collector
+from ..utils.metrics import GaugeRing, collector
+from . import reqtrace
 from .batcher import MicroBatcher, Overloaded
 from .engine import ServingEngine
+from .reqtrace import (BatchTrace, GaugeSampler, ReqTracer, RequestTrace,
+                       thread_dump)
 
 _log = logging.getLogger("transmogrifai_tpu.serve")
 
@@ -56,25 +75,82 @@ class ServeFrontend:
     they really mean row floods."""
 
     def __init__(self, engine: ServingEngine, batcher: MicroBatcher,
-                 max_bulk: int = 65536):
+                 max_bulk: int = 65536,
+                 tracer: Optional[ReqTracer] = None):
         self.engine = engine
         self.batcher = batcher
         self.max_bulk = int(max_bulk)
         # drain flag (GET /drain or SIGUSR1): an Event — set/is_set are
         # atomic, shared by HTTP workers and the signal path
         self._draining = threading.Event()
+        #: per-replica request tracer (reqtrace) + the gauge ring behind
+        #: GET /metrics/history; run_serve passes the CLI-configured
+        #: tracer, in-process embedders get the env-gated default
+        self.tracer = tracer if tracer is not None else ReqTracer(
+            f"pid{os.getpid()}", enabled=reqtrace.env_enabled())
+        self.gauges = GaugeRing()
+        #: the X-Tmog-Debug-Sleep chaos hook is OFF unless the operator
+        #: opted in (ci.sh injects its artificially slow request here);
+        #: the cap bounds what any client can inflict
+        try:
+            self.debug_sleep_max_ms = float(
+                os.environ.get("TMOG_DEBUG_SLEEP_MAX_MS", "0"))
+        except ValueError:
+            self.debug_sleep_max_ms = 0.0
+        # duck-typed engine stand-ins (tests, adapters) may not accept
+        # batch_trace=; probe the signature ONCE so the traced bulk path
+        # degrades to untraced batch walls instead of a 500 per request
+        import inspect
+        try:
+            self._engine_takes_batch_trace = "batch_trace" in \
+                inspect.signature(engine.score_batch).parameters
+        except (TypeError, ValueError):
+            self._engine_takes_batch_trace = False
 
-    def submit(self, record: Record,
-               timeout: Optional[float] = None) -> Record:
+    def submit(self, record: Record, timeout: Optional[float] = None,
+               trace: Optional[RequestTrace] = None) -> Record:
         """One record through the micro-batching queue."""
-        return self.batcher.submit(record, timeout=timeout)
+        return self.batcher.submit(record, timeout=timeout, trace=trace)
 
-    def submit_many(self, records: List[Record]) -> List[Record]:
+    def submit_many(self, records: List[Record],
+                    trace: Optional[RequestTrace] = None) -> List[Record]:
         """Bulk scoring straight through the bucket ladder (no queue —
         a bulk caller IS a batch already)."""
+        t0 = time.perf_counter()
         for r in records:
             self.engine.validate_record(r)
-        return self.engine.score_batch(records)
+        if trace is None:
+            return self.engine.score_batch(records)
+        trace.seg("validate", time.perf_counter() - t0)
+        # request-thread-owned record (reqtrace single-owner contract)
+        trace.rows = len(records)  # tmoglint: disable=THR001
+        if not self._engine_takes_batch_trace:
+            return self.engine.score_batch(records)
+        bt = BatchTrace()
+        out = self.engine.score_batch(records, batch_trace=bt)
+        bt.stamp(trace)
+        return out
+
+    def debug_sleep(self, headers: Any,
+                    trace: Optional[RequestTrace]) -> None:
+        """Honor the X-Tmog-Debug-Sleep header (bounded by
+        TMOG_DEBUG_SLEEP_MAX_MS, default 0 = hook disabled): the
+        injected latency is its own trace segment, so a deliberately
+        slow request still covers its e2e wall."""
+        if self.debug_sleep_max_ms <= 0:
+            return
+        raw = headers.get(reqtrace.DEBUG_SLEEP_HEADER)
+        if not raw:
+            return
+        try:
+            ms = min(float(raw), self.debug_sleep_max_ms)
+        except ValueError:
+            return
+        if ms <= 0:
+            return
+        time.sleep(ms / 1e3)
+        if trace is not None:
+            trace.seg("debug_sleep", ms / 1e3)
 
     @property
     def draining(self) -> bool:
@@ -147,6 +223,42 @@ class ServeFrontend:
     def metrics(self) -> Dict[str, Any]:
         return self.engine.metrics()
 
+    def requests(self) -> Dict[str, Any]:
+        """The ``GET /requests`` payload: this replica's per-segment
+        histograms + tail-kept traces (observability.md)."""
+        return self.tracer.requests_payload()
+
+    def history(self) -> Dict[str, Any]:
+        """The ``GET /metrics/history`` payload: the gauge ring."""
+        return {"replica": self.tracer.replica_id,
+                "interval_hint_s": None,
+                "gauges": self.gauges.to_json()}
+
+    def sample_gauges(self) -> Dict[str, Any]:
+        """One gauge snapshot (GaugeSampler's read): queue depth +
+        in-flight + the engine's counter gauges incl. drift verdicts."""
+        out = {"queue_depth": self.batcher.queue_len,
+               "in_flight": self.tracer.in_flight,
+               "draining": self.draining}
+        out.update(self.engine.gauge_state())
+        return out
+
+    def debugz(self) -> Dict[str, Any]:
+        """The "why is it stuck" snapshot: every live thread's name +
+        innermost stack frames (sys._current_frames), queue depth, and
+        the lock-ish health bits — batcher thread alive, dispatcher
+        heartbeat age (a big age with a deep queue = the dispatcher is
+        wedged inside a batch)."""
+        return {"threads": thread_dump(),
+                "queue_len": self.batcher.queue_len,
+                "batcher_alive": self.batcher.alive,
+                "batcher_closed": self.batcher.closed,
+                "dispatcher_beat_age_s": round(self.batcher.beat_age(),
+                                               4),
+                "in_flight": self.tracer.in_flight,
+                "warm": self.engine.warm,
+                "draining": self.draining}
+
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "transmogrifai-tpu-serve"
@@ -155,11 +267,16 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt: str, *args: Any) -> None:
         _log.debug("http: " + fmt, *args)
 
-    def _reply(self, code: int, payload: Any) -> None:
+    def _reply(self, code: int, payload: Any,
+               trace_header: Optional[str] = None) -> None:
         body = json.dumps(payload, default=str).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if trace_header:
+            # hop-context echo: the caller (router or client) learns the
+            # serving replica id without parsing the body
+            self.send_header(reqtrace.TRACE_HEADER, trace_header)
         self.end_headers()
         self.wfile.write(body)
 
@@ -171,6 +288,12 @@ class _Handler(BaseHTTPRequestHandler):
                         else 200, h)
         elif self.path == "/metrics":
             self._reply(200, fe.metrics())
+        elif self.path == "/metrics/history":
+            self._reply(200, fe.history())
+        elif self.path == "/requests":
+            self._reply(200, fe.requests())
+        elif self.path == "/debugz":
+            self._reply(200, fe.debugz())
         elif self.path == "/drain":
             self._reply(200, fe.drain())
         elif self.path == "/drift/window":
@@ -201,35 +324,81 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/score":
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
+        # request trace: id adopted from the router's header (or the
+        # client's), minted here otherwise; None when tracing is off —
+        # every stamp below is behind one None check
+        rt = fe.tracer.start(self.headers.get(reqtrace.TRACE_HEADER))
+        t0 = time.perf_counter()
+        code, payload = self._score_body(fe, rt, t0)
+        t1 = time.perf_counter()
+        header = (reqtrace.format_trace_header(
+            rt.trace_id, replica=fe.tracer.replica_id)
+            if rt is not None else None)
+        try:
+            self._reply(code, payload, trace_header=header)
+        except OSError:
+            # the client hung up (e.g. the router's timeout fired while
+            # we were scoring) — exactly a trace worth keeping
+            if rt is not None:
+                rt.error_type = rt.error_type or "ClientDisconnect"
+            raise
+        finally:
+            # tail sampling happens HERE, after the response left (or
+            # failed to): finish must run on EVERY exit or in_flight
+            # leaks and the interesting trace is dropped
+            if rt is not None:
+                rt.seg("respond", time.perf_counter() - t1)
+                fe.tracer.finish(rt, time.perf_counter() - t0,
+                                 status=code)
+
+    def _score_body(self, fe: "ServeFrontend",
+                    rt: Optional[RequestTrace],
+                    t0: float) -> Tuple[int, Any]:
+        """(status, payload) of one /score request; trace segments and
+        the error/shed markers the tail sampler keys on are stamped on
+        `rt` along the way."""
         try:
             length = int(self.headers.get("Content-Length", "0"))
             doc: Union[Record, List[Record]] = json.loads(
                 self.rfile.read(length) or b"null")
+            if rt is not None:
+                rt.seg("parse", time.perf_counter() - t0)
+            fe.debug_sleep(self.headers, rt)
             if isinstance(doc, list):
                 if len(doc) > fe.max_bulk:
-                    self._reply(413, {
+                    return 413, {
                         "error": f"bulk request of {len(doc)} records "
                                  f"exceeds max_bulk={fe.max_bulk}; "
-                                 f"split into smaller requests"})
-                    return
-                self._reply(200, fe.submit_many(doc))
+                                 f"split into smaller requests"}
+                return 200, fe.submit_many(doc, trace=rt)
             elif isinstance(doc, dict):
-                self._reply(200, fe.submit(doc))
+                return 200, fe.submit(doc, trace=rt)
             else:
-                self._reply(400, {"error": "body must be a JSON record "
-                                           "object or a list of records"})
+                return 400, {"error": "body must be a JSON record "
+                                      "object or a list of records"}
         except json.JSONDecodeError as e:
-            self._reply(400, {"error": f"invalid JSON: {e}"})
+            if rt is not None:
+                # handler-thread-owned record (reqtrace contract)
+                rt.error_type = "JSONDecodeError"  # tmoglint: disable=THR001
+            return 400, {"error": f"invalid JSON: {e}"}
         except CLIENT_ERRORS as e:
-            self._reply(400, {"error": str(e),
-                              "error_type": type(e).__name__})
+            if rt is not None:
+                rt.error_type = type(e).__name__
+            return 400, {"error": str(e),
+                         "error_type": type(e).__name__}
         except Overloaded as e:
-            self._reply(503, {"error": str(e), "error_type": "Overloaded"})
+            if rt is not None:
+                rt.shed = True
+            return 503, {"error": str(e), "error_type": "Overloaded"}
         except TimeoutError as e:
-            self._reply(504, {"error": str(e)})
+            if rt is not None:
+                rt.error_type = "TimeoutError"
+            return 504, {"error": str(e)}
         except Exception as e:  # pragma: no cover - systemic faults
             _log.exception("serve: request failed")
-            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            if rt is not None:
+                rt.error_type = type(e).__name__
+            return 500, {"error": f"{type(e).__name__}: {e}"}
 
 
 def make_http_server(frontend: ServeFrontend, host: str = "127.0.0.1",
@@ -367,12 +536,24 @@ def run_serve(args: Any) -> int:
 
     batcher = MicroBatcher(engine, max_wait_ms=args.max_wait_ms,
                            max_queue=args.max_queue)
-    frontend = ServeFrontend(engine, batcher)
+    # request tracing (docs/observability.md "Request tracing"):
+    # --replica-id is the fleet-assigned identity echoed in the
+    # X-Tmog-Trace reply header and stamped on every kept trace
+    replica_id = getattr(args, "replica_id", None) or f"pid{os.getpid()}"
+    rt_enabled = (getattr(args, "request_trace", "on") != "off"
+                  and reqtrace.env_enabled())
+    tracer = ReqTracer(replica_id, enabled=rt_enabled,
+                       sample_rate=getattr(args, "trace_sample", None))
+    frontend = ServeFrontend(engine, batcher, tracer=tracer)
+    gauge_sampler = GaugeSampler(frontend.sample_gauges,
+                                 ring=frontend.gauges).start()
     httpd = make_http_server(frontend, host=args.host, port=args.port)
     host, port = httpd.server_address[:2]
     _log.info("serving %s on http://%s:%s (buckets %s, max_wait %.1fms, "
-              "queue %d)", args.model_dir, host, port,
-              list(engine.buckets), args.max_wait_ms, args.max_queue)
+              "queue %d, replica %s, request tracing %s)",
+              args.model_dir, host, port, list(engine.buckets),
+              args.max_wait_ms, args.max_queue, replica_id,
+              "on" if rt_enabled else "OFF")
 
     def _graceful(signum: int, frame: Any) -> None:
         _log.info("signal %s: draining and shutting down", signum)
@@ -397,6 +578,7 @@ def run_serve(args: Any) -> int:
         httpd.serve_forever(poll_interval=0.1)
     finally:
         httpd.server_close()
+        gauge_sampler.stop()
         batcher.shutdown(drain=True)
         engine.finish_monitor()  # close the partial drift window
         _save_artifacts()

@@ -21,6 +21,7 @@ tree adds a "spans" key in save(), a Chrome-trace export
 (attach_event_log / event)."""
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import math
@@ -308,6 +309,42 @@ class LatencyHistogram:
                 "p99_ms": round(self.quantile(0.99) * ms, 4),
                 "max_ms": round(mx * ms, 4),
                 "buckets_ms": nonzero}
+
+
+class GaugeRing:
+    """Fixed-length ring of gauge snapshots — the ``GET /metrics/history``
+    time-series (docs/observability.md "Request tracing").
+
+    Each sample is one flat JSON-able dict stamped with the ring's
+    monotonic `t` (seconds since construction) and wall `ts` (epoch).
+    The deque bound makes memory constant under a long-running serve no
+    matter the cadence; dropping the oldest snapshot is the design, not
+    data loss — the ring is a recent-history window, the mergeable
+    aggregates (counters + latency histograms) carry the full run.
+    Thread-safe: the sampler thread appends while HTTP workers read."""
+
+    def __init__(self, maxlen: int = 720) -> None:
+        self._snaps: "collections.deque[Dict[str, Any]]" = \
+            collections.deque(maxlen=int(maxlen))
+        self._lock = threading.Lock()
+        self._mono0 = time.perf_counter()
+
+    def append(self, **gauges: Any) -> Dict[str, Any]:
+        snap: Dict[str, Any] = {
+            "t": round(time.perf_counter() - self._mono0, 3),
+            "ts": round(time.time(), 3)}
+        snap.update(gauges)
+        with self._lock:
+            self._snaps.append(snap)
+        return snap
+
+    def to_json(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(s) for s in self._snaps]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._snaps)
 
 
 @dataclass

@@ -48,6 +48,8 @@ __all__ = [
     "Span", "TraceTree", "RecompileTracker", "tracker", "EventLog",
     "register_jit_fallback", "device_memory_attrs", "chrome_trace",
     "write_chrome_trace", "trace_report", "trace_report_rc",
+    "event_log_paths", "iter_events", "requests_report",
+    "requests_report_rc",
 ]
 
 # the monitoring event one XLA backend compilation emits (jax >= 0.4.x).
@@ -100,17 +102,21 @@ class Span:
         return out
 
 
+def _jsonable_value(v: Any) -> Any:
+    if isinstance(v, (str, int, float, bool, type(None))):
+        return v
+    if isinstance(v, dict):
+        # nested payloads (e.g. a request_trace's per-segment dict) keep
+        # their structure instead of stringifying — events.jsonl lines
+        # must stay machine-parseable JSON all the way down
+        return {str(k): _jsonable_value(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable_value(x) for x in v]
+    return str(v)
+
+
 def _jsonable(d: Dict[str, Any]) -> Dict[str, Any]:
-    out: Dict[str, Any] = {}
-    for k, v in d.items():
-        if isinstance(v, (str, int, float, bool, type(None))):
-            out[k] = v
-        elif isinstance(v, (list, tuple)):
-            out[k] = [x if isinstance(x, (str, int, float, bool, type(None)))
-                      else str(x) for x in v]
-        else:
-            out[k] = str(v)
-    return out
+    return {k: _jsonable_value(v) for k, v in d.items()}
 
 
 class TraceTree:
@@ -207,6 +213,29 @@ class TraceTree:
             sp = Span(span_id=self._next_id, parent_id=parent, name=name,
                       kind=kind, t_start=max(end - max(duration, 0.0), 0.0),
                       t_end=end, attrs=dict(attrs))
+            self._next_id += 1
+            self.spans.append(sp)
+            if parent is not None:
+                self._children.setdefault(parent, []).append(sp)
+        return sp
+
+    def add_window(self, name: str, kind: str, t_start: float,
+                   t_end: float, parent_span: Optional["Span"] = None,
+                   **attrs: Any) -> Span:
+        """Record an already-measured span at an EXPLICIT window on the
+        tree clock (both ends in tree-clock seconds, i.e. values from
+        :meth:`now`). The request-trace exporter uses this to lay a kept
+        request's segment chain end-to-end inside its request window —
+        add_complete's end-is-now anchoring would stack every segment at
+        the same instant."""
+        with self._lock:
+            parent = (parent_span.span_id if parent_span is not None
+                      else (self._stack[-1].span_id if self._stack
+                            else None))
+            t0 = max(float(t_start), 0.0)
+            t1 = max(float(t_end), t0)
+            sp = Span(span_id=self._next_id, parent_id=parent, name=name,
+                      kind=kind, t_start=t0, t_end=t1, attrs=dict(attrs))
             self._next_id += 1
             self.spans.append(sp)
             if parent is not None:
@@ -467,6 +496,28 @@ def device_memory_attrs() -> Dict[str, Any]:
 
 # -- streaming event log -----------------------------------------------------
 
+#: default events.jsonl rotation threshold — generous on purpose: an
+#: offline fit/score run never gets near it, while a long-running serve
+#: replica (which emits per-request events forever) stays bounded
+DEFAULT_EVENTLOG_MAX_MB = 256.0
+
+
+def _eventlog_max_bytes(max_mb: Optional[float]) -> int:
+    """Resolved rotation threshold in bytes; 0 disables rotation."""
+    if max_mb is None:
+        raw = os.environ.get("TMOG_EVENTLOG_MAX_MB", "").strip().lower()
+        if raw in ("", "auto"):
+            max_mb = DEFAULT_EVENTLOG_MAX_MB
+        elif raw in ("0", "off", "false", "no"):
+            max_mb = 0.0
+        else:
+            try:
+                max_mb = float(raw)
+            except ValueError:
+                max_mb = DEFAULT_EVENTLOG_MAX_MB
+    return int(max(float(max_mb), 0.0) * 1e6)
+
+
 class EventLog:
     """Append-only JSONL of timestamped run events.
 
@@ -474,15 +525,34 @@ class EventLog:
     "event": type, ...fields}. `t` is non-decreasing and `seq` strictly
     increasing — the monotonicity contract `trace_report --check`
     validates. Lines are flushed per event so `tail -f events.jsonl`
-    follows a live run."""
+    follows a live run.
 
-    def __init__(self, path: str) -> None:
+    ROTATION: under a long-running serve a per-request event stream
+    grows without bound, so once the live file passes `max_mb`
+    (``TMOG_EVENTLOG_MAX_MB``, default 256 — generous enough that
+    offline runs never rotate; 0/off disables) it shifts to
+    ``events.jsonl.1`` (older segments to ``.2`` … up to `keep`, the
+    oldest dropped) and a fresh live file opens. `seq` and the monotonic
+    clock CONTINUE across the boundary — concatenating the segments
+    oldest-first (:func:`event_log_paths`) reproduces one monotone
+    stream, which is exactly what trace-report reads."""
+
+    def __init__(self, path: str, max_mb: Optional[float] = None,
+                 keep: Optional[int] = None) -> None:
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "a", encoding="utf-8")
         self._lock = threading.Lock()
         self._seq = 0
         self._mono0 = time.perf_counter()
+        self._max_bytes = _eventlog_max_bytes(max_mb)
+        if keep is None:
+            try:
+                keep = int(os.environ.get("TMOG_EVENTLOG_KEEP", "3"))
+            except ValueError:
+                keep = 3
+        self.keep = max(int(keep), 1)
+        self.rotations = 0
 
     def emit(self, event: str, **fields: Any) -> None:
         with self._lock:
@@ -500,10 +570,37 @@ class EventLog:
                 self._f.write(json.dumps(rec, default=str) + "\n")
                 # tmoglint: disable=THR002  flush pairs with the write
                 self._f.flush()
+                if self._max_bytes and self._f.tell() >= self._max_bytes:
+                    self._rotate()
             except (ValueError, OSError):
                 # closed file / full disk / flaky mount: the liveness
                 # side channel must never kill the run it is monitoring
                 pass
+
+    def _rotate(self) -> None:
+        """Shift the full live file to .1 (.1 -> .2 … oldest dropped)
+        and reopen. Caller holds the lock; `seq`/`_mono0` deliberately
+        survive so the stream stays monotone across segments."""
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        try:
+            oldest = f"{self.path}.{self.keep}"
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(self.keep - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        except OSError:
+            pass  # a failed shift falls through to reopening in place
+        # the shift + reopen IS what the lock serializes: an emit racing
+        # a half-rotated log would interleave segments
+        # tmoglint: disable=THR002  rotation is the lock's job
+        self._f = open(self.path, "a", encoding="utf-8")
+        self.rotations += 1
 
     def close(self) -> None:
         with self._lock:
@@ -511,6 +608,41 @@ class EventLog:
                 self._f.close()
             except OSError:
                 pass
+
+
+def event_log_paths(path: str) -> List[str]:
+    """Every segment of a (possibly rotated) event log, OLDEST first —
+    ``events.jsonl.N … events.jsonl.1 events.jsonl``. Reading them in
+    this order reproduces one stream with `seq` strictly increasing
+    across the rotation boundaries."""
+    numbered: List[Tuple[int, str]] = []
+    for p in _glob.glob(path + ".*"):
+        suffix = p[len(path) + 1:]
+        if suffix.isdigit():
+            numbered.append((int(suffix), p))
+    out = [p for _, p in sorted(numbered, reverse=True)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def iter_events(path: str) -> "Any":
+    """Yield every parsed event record across all rotated segments of
+    `path`, oldest first (the tail-across-the-boundary reader).
+    Unparseable lines are skipped — validation is trace-report's job."""
+    for p in event_log_paths(path):
+        try:
+            with open(p, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+        except OSError:
+            continue
 
 
 # -- Chrome trace_event export -----------------------------------------------
@@ -528,6 +660,18 @@ def chrome_trace(tree: TraceTree, app_name: str = "transmogrifai_tpu"
         {"ph": "M", "name": "thread_name", "pid": pid, "tid": 1,
          "args": {"name": "run"}},
     ]
+    # per-LANE view: spans carrying a `lane` attr (the request-trace
+    # exporter stamps one per tracer) render on their own tid row in
+    # Perfetto instead of interleaving with the run hierarchy — kept
+    # request windows + their segment chains read as swimlanes
+    lanes: Dict[str, int] = {}
+    for sp in tree.spans:
+        lane = sp.attrs.get("lane")
+        if isinstance(lane, str) and lane not in lanes:
+            lanes[lane] = 2 + len(lanes)
+    for lane, tid in lanes.items():
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": lane}})
     end_default = tree.now()
     for sp in tree.spans:
         end = sp.t_end if sp.t_end is not None else end_default
@@ -540,7 +684,8 @@ def chrome_trace(tree: TraceTree, app_name: str = "transmogrifai_tpu"
             "ph": "X", "name": sp.name, "cat": sp.kind,
             "ts": round(sp.t_start * 1e6, 3),
             "dur": round(max(end - sp.t_start, 0.0) * 1e6, 3),
-            "pid": pid, "tid": 1, "args": args,
+            "pid": pid, "tid": lanes.get(sp.attrs.get("lane"), 1),
+            "args": args,
         })
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"app_name": app_name,
@@ -622,46 +767,55 @@ def _load_trace_spans(path: str) -> Tuple[List[Dict[str, Any]], List[str]]:
     return spans, problems
 
 
-def _check_event_log(path: str) -> Tuple[int, List[str], Dict[str, int]]:
+def _check_event_log(paths: List[str]
+                     ) -> Tuple[int, List[str], Dict[str, int]]:
     """(n valid events, schema problems, counts per event type) in ONE
     pass — report mode reuses the counts instead of re-parsing a log
-    that can run 10^5+ lines on a long sweep."""
+    that can run 10^5+ lines on a long sweep. `paths` is the rotated
+    segment chain OLDEST FIRST (event_log_paths): `seq`/`t`
+    monotonicity is validated ACROSS rotation boundaries, because the
+    EventLog rotation contract is that the concatenated segments are
+    one monotone stream."""
     problems: List[str] = []
     counts: Dict[str, int] = {}
     n = 0
     last_t = None
     last_seq = None
-    with open(path) as f:
-        for lineno, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                problems.append(f"{path}:{lineno}: invalid JSON")
-                continue
-            n += 1
-            ev_name = rec.get("event", "?")
-            counts[ev_name] = counts.get(ev_name, 0) + 1
-            if "event" not in rec:
-                problems.append(f"{path}:{lineno}: missing 'event'")
-            t = rec.get("t")
-            if not isinstance(t, (int, float)):
-                problems.append(f"{path}:{lineno}: missing numeric 't'")
-            else:
-                # a re-attached log (resumed run) restarts the monotonic
-                # clock; monotonicity is per seq=0 segment
+    for path in paths:
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    problems.append(f"{path}:{lineno}: invalid JSON")
+                    continue
+                n += 1
+                ev_name = rec.get("event", "?")
+                counts[ev_name] = counts.get(ev_name, 0) + 1
+                if "event" not in rec:
+                    problems.append(f"{path}:{lineno}: missing 'event'")
+                t = rec.get("t")
+                if not isinstance(t, (int, float)):
+                    problems.append(f"{path}:{lineno}: missing numeric "
+                                    f"'t'")
+                else:
+                    # a re-attached log (resumed run) restarts the
+                    # monotonic clock; monotonicity is per seq=0 segment
+                    seq = rec.get("seq")
+                    if last_t is not None and seq != 0 and t < last_t:
+                        problems.append(f"{path}:{lineno}: timestamp "
+                                        f"went backwards ({t} < "
+                                        f"{last_t})")
+                    last_t = t
                 seq = rec.get("seq")
-                if last_t is not None and seq != 0 and t < last_t:
-                    problems.append(f"{path}:{lineno}: timestamp went "
-                                    f"backwards ({t} < {last_t})")
-                last_t = t
-            seq = rec.get("seq")
-            if isinstance(seq, int) and isinstance(last_seq, int) \
-                    and seq != 0 and seq <= last_seq:
-                problems.append(f"{path}:{lineno}: seq not increasing")
-            last_seq = seq if isinstance(seq, int) else last_seq
+                if isinstance(seq, int) and isinstance(last_seq, int) \
+                        and seq != 0 and seq <= last_seq:
+                    problems.append(f"{path}:{lineno}: seq not "
+                                    f"increasing")
+                last_seq = seq if isinstance(seq, int) else last_seq
     return n, problems, counts
 
 
@@ -701,13 +855,13 @@ def trace_report(run_dir: str, check: bool = False,
     schema problems (exit 1)."""
     trace_files = sorted(_glob.glob(os.path.join(run_dir, "*trace.json")))
     event_log = os.path.join(run_dir, "events.jsonl")
+    log_paths = event_log_paths(event_log)
     metric_files = sorted(
         _glob.glob(os.path.join(run_dir, "*stage_metrics.json")))
     lines: List[str] = []
     problems: List[str] = []
 
-    if not trace_files and not metric_files and \
-            not os.path.exists(event_log):
+    if not trace_files and not metric_files and not log_paths:
         return (f"trace-report: nothing to read in {run_dir} (no "
                 f"*trace.json, *stage_metrics.json or events.jsonl)", False)
 
@@ -722,8 +876,8 @@ def trace_report(run_dir: str, check: bool = False,
 
     n_events = 0
     event_counts: Dict[str, int] = {}
-    if os.path.exists(event_log):
-        n_events, probs, event_counts = _check_event_log(event_log)
+    if log_paths:
+        n_events, probs, event_counts = _check_event_log(log_paths)
         problems.extend(probs)
         # serving contract (docs/serving.md): the engine emits one
         # serve_recompile event for every XLA compile that lands AFTER
@@ -849,3 +1003,135 @@ def trace_report(run_dir: str, check: bool = False,
         lines.append(f"\n## {len(problems)} schema problem(s)")
         lines.extend(f"  {p}" for p in problems)
     return "\n".join(lines), not problems
+
+
+# -- trace-report --requests -------------------------------------------------
+
+#: a request is flagged when its UNATTRIBUTED wall (e2e minus the sum of
+#: its segments) exceeds BOTH bounds: the fraction catches slow requests
+#: hiding real time outside the segment chain, the floor keeps
+#: millisecond-scale requests from flagging on scheduler-wake jitter
+#: (condition-variable wakeups cost whole milliseconds on a busy CPU
+#: host — attributing those would need a segment per context switch)
+REQUEST_COVERAGE_TOLERANCE = 0.25
+REQUEST_COVERAGE_FLOOR_MS = 25.0
+
+
+def load_request_traces(run_dir: str) -> List[Dict[str, Any]]:
+    """Every `request_trace` event under `run_dir` — the kept traces of
+    the tail sampler (docs/observability.md "Request tracing") — read
+    across rotated event-log segments, oldest first."""
+    path = os.path.join(run_dir, "events.jsonl")
+    return [rec for rec in iter_events(path)
+            if rec.get("event") == "request_trace"]
+
+
+def _coverage_problems(recs: List[Dict[str, Any]],
+                       tolerance: float, floor_ms: float) -> List[str]:
+    problems: List[str] = []
+    by_id: Dict[str, Dict[str, float]] = {}
+    for rec in recs:
+        tid = rec.get("trace_id")
+        wall = rec.get("wall_ms")
+        segs = rec.get("segments") or {}
+        if not isinstance(wall, (int, float)) or isinstance(wall, bool):
+            problems.append(f"request {tid}: non-numeric wall_ms")
+            continue
+        seg_sum = sum(float(v) for v in segs.values()
+                      if isinstance(v, (int, float)))
+        slack = max(tolerance * wall, floor_ms)
+        label = f"{rec.get('origin', '?')} request {tid}"
+        if wall - seg_sum > slack:
+            problems.append(
+                f"{label}: segments cover {seg_sum:.1f}ms of "
+                f"{wall:.1f}ms e2e wall ({wall - seg_sum:.1f}ms "
+                f"unattributed > {slack:.1f}ms tolerance)")
+        elif seg_sum - wall > slack:
+            problems.append(
+                f"{label}: segments sum to {seg_sum:.1f}ms, OVER the "
+                f"{wall:.1f}ms e2e wall by more than {slack:.1f}ms")
+        if isinstance(tid, str):
+            by_id.setdefault(tid, {})[rec.get("origin", "?")] = \
+                float(wall)
+    # cross-process sanity — DURATIONS only, never absolute-timestamp
+    # arithmetic between two hosts' clocks: the replica's own e2e wall
+    # for a traced request must fit inside the router's wall for the
+    # same trace id (plus slack for response serialization/transport)
+    for tid, origins in by_id.items():
+        rep, rout = origins.get("replica"), origins.get("router")
+        if rep is None or rout is None:
+            continue
+        slack = max(tolerance * rout, floor_ms)
+        if rep > rout + slack:
+            problems.append(
+                f"request {tid}: replica-side wall {rep:.1f}ms exceeds "
+                f"the router-side wall {rout:.1f}ms for the same trace")
+    return problems
+
+
+def requests_report(run_dir: str, top: int = 15,
+                    tolerance: float = REQUEST_COVERAGE_TOLERANCE,
+                    floor_ms: float = REQUEST_COVERAGE_FLOOR_MS
+                    ) -> Tuple[str, bool]:
+    """(report text, ok) over the kept request traces of a run dir: the
+    top-`top` slowest kept traces with their segment breakdown, kept
+    reasons, and the coverage check — any request whose segments do not
+    cover its end-to-end wall within tolerance is flagged (ok=False)."""
+    recs = load_request_traces(run_dir)
+    if not recs:
+        return (f"trace-report --requests: no request_trace events in "
+                f"{run_dir} (request tracing off, or no kept traces)",
+                False)
+    problems = _coverage_problems(recs, tolerance, floor_ms)
+    lines = [f"# trace-report --requests {run_dir}",
+             f"{len(recs)} kept trace(s)"]
+    reasons: Dict[str, int] = {}
+    for rec in recs:
+        k = str(rec.get("kept", "?"))
+        reasons[k] = reasons.get(k, 0) + 1
+    lines.append("kept by reason: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(reasons.items(), key=lambda kv:
+                                      -kv[1])))
+    ranked = sorted(
+        recs, key=lambda r: -(r.get("wall_ms")
+                              if isinstance(r.get("wall_ms"),
+                                            (int, float)) else 0.0))
+    rows = []
+    for rec in ranked[:top]:
+        segs = rec.get("segments") or {}
+        seg_sum = sum(float(v) for v in segs.values()
+                      if isinstance(v, (int, float)))
+        wall = rec.get("wall_ms")
+        cover = (f"{100.0 * seg_sum / wall:.0f}%"
+                 if isinstance(wall, (int, float)) and wall else "?")
+        rows.append([str(rec.get("trace_id", "?"))[:16],
+                     str(rec.get("origin", "?")),
+                     str(rec.get("replica", ""))[:20],
+                     str(rec.get("status", "")),
+                     str(rec.get("kept", "")),
+                     f"{wall:.2f}" if isinstance(wall, (int, float))
+                     else "?",
+                     cover,
+                     " ".join(f"{k}={v:.2f}" for k, v in segs.items()
+                              if isinstance(v, (int, float)))[:72]])
+    lines.append(f"\n## Top {min(top, len(ranked))} slowest kept traces")
+    lines.extend(_fmt_table(rows, ["trace", "origin", "replica",
+                                   "status", "kept", "wall_ms", "cover",
+                                   "segments_ms"]))
+    if problems:
+        lines.append(f"\n## {len(problems)} coverage problem(s)")
+        lines.extend(f"  {p}" for p in problems)
+    else:
+        lines.append("\ncoverage OK (every kept trace's segments cover "
+                     "its e2e wall within tolerance)")
+    return "\n".join(lines), not problems
+
+
+def requests_report_rc(run_dir: str, top: int = 15) -> Tuple[str, int]:
+    """(text, exit code) with the project-wide code table
+    (docs/static_analysis.md "Exit codes"): 0 = clean, 1 = coverage
+    problems, 2 = nothing to read (no kept request traces at all)."""
+    text, ok = requests_report(run_dir, top=top)
+    if text.startswith("trace-report --requests: no request_trace"):
+        return text, 2
+    return text, 0 if ok else 1
