@@ -26,9 +26,15 @@ echo "== 2/6 tmoglint (static JAX/TPU discipline + stage contracts) =="
 # because it needs no imports and catches contract breaks in seconds.
 # bench.py + tools/ are in scope since TPU005 (unsynced-wall-timing);
 # the v2 concurrency (THR001-004) + buffer-lifetime (BUF001-003)
-# families run in the same scan with the SAME empty baseline. The
-# --format json report is saved as a CI artifact so finding counts per
-# rule ride the build outputs next to the BENCH_*.json series.
+# families and the v3 SPMD/collective-correctness (SHD001-005) +
+# contract-drift (ENV001/EVT001) families all run in the same scan with
+# the SAME empty baseline — SHD is the pre-hardware gate for the
+# multi-host GSPMD push (correct-at-N=1/wrong-at-N>1 bugs the CPU-mesh
+# tiers cannot see), ENV/EVT keep the knob registry and the event table
+# honest. The --format json report is saved as a CI artifact so finding
+# counts per rule ride the build outputs next to the BENCH_*.json
+# series, and the documented 10s full-scan budget is asserted from its
+# --stats block.
 ARTIFACTS_DIR="${TMOG_CI_ARTIFACTS:-$(mktemp -d)}"
 mkdir -p "$ARTIFACTS_DIR"
 # one gating scan, captured as the JSON artifact (it carries ok/new/
@@ -37,19 +43,43 @@ mkdir -p "$ARTIFACTS_DIR"
 python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/ \
   --format json > "$ARTIFACTS_DIR/tmoglint_report.json"
 python - "$ARTIFACTS_DIR/tmoglint_report.json" <<'PY'
-import json, sys
+import json, subprocess, sys
 rep = json.load(open(sys.argv[1]))
 assert rep["ok"], rep
 assert "stats" in rep and rep["stats"]["files"] > 150, rep.get("stats")
+# the documented budget (docs/static_analysis.md "Running"): a full-repo
+# --jobs scan, every family on, stays under 10s. Wall time on a shared
+# runner is noisy, so a miss gets ONE quiet re-measure before failing —
+# the budget gates linter regressions, not runner load spikes.
+total = rep["stats"]["total_s"]
+rerun = None
+if total >= 10.0:
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.tmoglint", "transmogrifai_tpu/",
+         "tests/", "bench.py", "tools/", "--format", "json"],
+        capture_output=True, text=True)
+    if out.returncode == 0 and out.stdout.strip():
+        rerun = json.loads(out.stdout)["stats"]["total_s"]
+        total = min(total, rerun)
+    else:
+        print(f"  budget re-measure itself failed "
+              f"(rc {out.returncode}): {out.stderr[-500:]}",
+              file=sys.stderr)
+assert total < 10.0, \
+    f"tmoglint full scan blew the 10s budget twice: first " \
+    f"{rep['stats']['total_s']}s, re-measure {rerun}s ({rep['stats']})"
 print(f"  tmoglint JSON artifact ok: {rep['total_findings']} finding(s), "
       f"stats={rep['stats']}")
 PY
-# family selection (--rules THR,BUF) must run clean against the SAME
-# baseline with the stale-entry scoping guard active — the concurrency +
-# buffer-lifetime families alone, no TPU/DAG noise
+# family selection must run clean against the SAME baseline with the
+# stale-entry scoping guard active — v2 (concurrency + buffer lifetime)
+# and v3 (SPMD/collective correctness + contract drift) each alone,
+# no TPU/DAG noise
 python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/ \
   --rules THR,BUF
-echo "  tmoglint: full scan + THR,BUF family scan clean (artifact: $ARTIFACTS_DIR/tmoglint_report.json)"
+python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/ \
+  --rules SHD,ENV,EVT
+echo "  tmoglint: full scan (<10s) + THR,BUF + SHD,ENV,EVT family scans clean (artifact: $ARTIFACTS_DIR/tmoglint_report.json)"
 
 echo "== 3/6 test suite (8-device virtual CPU mesh) =="
 # fused histogram planner + CPU-fallback smoke first, explicitly under

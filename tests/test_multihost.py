@@ -71,6 +71,22 @@ def test_non_divisible_rows_pad_to_device_multiple():
     np.testing.assert_allclose(np.asarray(tot), local.sum(0), atol=1e-4)
 
 
+def test_fetch_global_single_process_matches_asarray():
+    """fetch_global — the documented cross-process fold SHD005 points at
+    — degrades to a plain asarray at one process; the host reduce over
+    it is then the true global reduce."""
+    mesh = MH.global_mesh(n_model=1)
+    n, d = 32, 4
+    rng = np.random.default_rng(2)
+    local = rng.normal(size=(n, d)).astype(np.float32)
+    s_, e_ = MH.process_row_range(n)
+    arr = MH.host_local_rows(local[s_:e_], mesh, n)
+    fetched = MH.fetch_global(arr)
+    np.testing.assert_allclose(fetched, local, rtol=1e-6)
+    np.testing.assert_allclose(np.sum(fetched, axis=0), local.sum(0),
+                               rtol=1e-5)
+
+
 def test_initialize_explicit_coordinator_requires_count(monkeypatch):
     monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
     import pytest

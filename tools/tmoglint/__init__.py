@@ -29,11 +29,27 @@ performance. tmoglint restores both as lint-time checks over stdlib `ast`:
                                     step that does not donate it
 * BUF003 donated-into-telemetry  — donated buffer captured into a
                                     span/event/log after donation
+* SHD001 unreduced shard output  — shard_map out_spec claims replicated
+                                    but no psum on the bound axis reaches it
+                                    (correct at N=1, wrong at N>1)
+* SHD002 axis mismatch/unbound   — collective names an axis the enclosing
+                                    shard_map does not bind (guarded
+                                    axis_name=None paths stay legal)
+* SHD003 shard nondeterminism    — index-local jax.random draw or host
+                                    branch on a per-shard value in a
+                                    sharded body
+* SHD004 spec arity/rank         — in/out_specs vs the core's signature
+* SHD005 host merge w/o fold     — np.sum over a fetched row-sharded array
+                                    in a multi-process path
+* ENV001 knob registry           — TMOG_* env read with no knobs.py row, or
+                                    a row its doc file never mentions
+* EVT001 event schema            — EventLog.event name missing from the
+                                    observability.md table / stale row
 
 Run: ``python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/``
 (the CI file set — bench.py and tools/ are in scope since TPU005).
-``--rules THR,BUF`` selects families; ``--jobs N`` scans per-file rules in
-worker processes; ``--stats`` prints scan timings.
+``--rules THR,BUF`` / ``--rules SHD,ENV,EVT`` select families; ``--jobs N``
+scans per-file rules in worker processes; ``--stats`` prints scan timings.
 
 Suppress one finding: ``# tmoglint: disable=TPU003  <reason>`` on (or on the
 line above) the flagged line. Grandfathered findings live in

@@ -9,9 +9,11 @@ same meanings ``trace-report --check`` and ``monitor --fail-on-drift``
 use): 0 clean, 1 findings/validation problems, 2 usage error.
 
 ``--rules`` accepts exact rule ids AND family prefixes: ``--rules
-THR,BUF`` runs THR001-THR004 + BUF001-BUF003. ``--jobs N`` scans files
-across N worker processes (per-file rules; the cross-file rules run in
-the parent over one shared parse); ``--stats`` prints a timing line.
+THR,BUF`` runs THR001-THR004 + BUF001-BUF003, ``--rules SHD,ENV,EVT``
+the v3 SPMD/collective-correctness + contract-drift families.
+``--jobs N`` scans files across N worker processes (per-file rules;
+the cross-file rules run in the parent over one shared parse);
+``--stats`` prints a timing line.
 """
 from __future__ import annotations
 
@@ -52,7 +54,8 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tools.tmoglint",
         description="AST-level JAX/TPU discipline linter + static "
-                    "stage-contract, concurrency and buffer-lifetime "
+                    "stage-contract, concurrency, buffer-lifetime, "
+                    "SPMD/collective-correctness and contract-drift "
                     "checker (see docs/static_analysis.md)")
     p.add_argument("paths", nargs="*",
                    default=["transmogrifai_tpu", "tests"],

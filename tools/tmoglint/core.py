@@ -59,11 +59,18 @@ class Finding:
 
 
 class LintContext:
-    """Parsed view of one file handed to every per-file rule."""
+    """Parsed view of one file handed to every per-file rule.
 
-    def __init__(self, path: str, source: str):
+    `root` is the absolute lint root when known (scan_paths sets it):
+    contract rules (ENV001/EVT001) use it to read the doc files their
+    registries/tables live in; rules must degrade gracefully when it is
+    None (directly-constructed ctxs in unit fixtures).
+    """
+
+    def __init__(self, path: str, source: str, root: Optional[str] = None):
         self.path = path.replace(os.sep, "/")
         self.source = source
+        self.root = root
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         self._file_suppressed = self._parse_file_suppressions()
@@ -165,7 +172,7 @@ def scan_paths(paths: Sequence[str], root: str) -> Tuple[
         try:
             with open(fpath, "r", encoding="utf-8") as fh:
                 src = fh.read()
-            ctxs.append(LintContext(rel, src))
+            ctxs.append(LintContext(rel, src, root=os.path.abspath(root)))
         except (SyntaxError, UnicodeDecodeError) as e:
             errors.append(Finding(
                 rule="SYNTAX", path=rel,
@@ -190,7 +197,8 @@ def _number_occurrences(findings: List[Finding]) -> List[Finding]:
 
 def _register_rules() -> None:
     # import registers the rules
-    from . import rules_tpu, rules_dag, rules_thr, rules_buf  # noqa: F401
+    from . import (rules_tpu, rules_dag, rules_thr, rules_buf,  # noqa: F401
+                   rules_shd, rules_env, rules_evt)  # noqa: F401
 
 
 def expand_rule_selection(only: Optional[Sequence[str]]

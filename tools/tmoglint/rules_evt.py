@@ -1,0 +1,150 @@
+"""EVT001 — event-schema contract between code and observability.md.
+
+``events.jsonl`` is the fleet's long-term observable surface:
+``trace-report --check`` validates it, dashboards tail it, and the
+monitor/fleet/rollout subsystems each added rows to the event table in
+docs/observability.md. That table IS the schema — but nothing kept it
+honest, and it drifted (the ``stats_pass`` event was emitted for three
+PRs with no table row). EVT001 pins both directions:
+
+* every ``*.event("name", ...)`` call site in the package must use a
+  name the observability.md event table lists;
+* every table row must correspond to an emitted event somewhere in the
+  scanned package (stale rows flagged at the doc line) — this direction
+  only runs when an *event-emitting* package is fully in view (its
+  ``__init__.py`` scanned), so neither a single-file scan nor a scan of
+  an unrelated package (``tools/``) can declare the table stale.
+
+Scope: package code only (files whose top-level directory has a scanned
+``__init__.py``), so tests and bench scripts may emit fixture events
+freely. The table is read from ``docs/observability.md`` under the lint
+root — fixtures bring their own root with their own table.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, LintContext, project_rule
+
+_NAME = re.compile(r"`([a-z][a-z0-9_]*)`")
+_EVENT_DOC = os.path.join("docs", "observability.md")
+
+
+def _event_table(root: str) -> Optional[Tuple[Dict[str, int], List[str]]]:
+    """({event name: 1-based doc line}, doc lines) parsed from the
+    event-log section's table, or None when the doc is absent."""
+    try:
+        with open(os.path.join(root, _EVENT_DOC), "r",
+                  encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return None
+    names: Dict[str, int] = {}
+    in_section = False
+    for i, ln in enumerate(lines):
+        if ln.startswith("## "):
+            in_section = "event log" in ln.lower()
+            continue
+        if not in_section or not ln.lstrip().startswith("|"):
+            continue
+        first_cell = ln.split("|")[1] if ln.count("|") >= 2 else ""
+        if set(first_cell.strip()) <= {"-", ":", " "}:
+            continue  # separator row
+        for m in _NAME.finditer(first_cell):
+            names.setdefault(m.group(1), i + 1)
+    return names, lines
+
+
+def _package_dirs(ctxs: Sequence[LintContext]) -> Set[str]:
+    """Top-level dirs that ARE packages: their own `<top>/__init__.py`
+    is in the scan. A nested package deeper down (tools/tmoglint/) must
+    not make its non-package parent count."""
+    tops = {c.path.split("/", 1)[0] for c in ctxs if "/" in c.path}
+    paths = {c.path for c in ctxs}
+    return {t for t in tops if f"{t}/__init__.py" in paths}
+
+
+def _init_dirs(ctxs: Sequence[LintContext]) -> Set[str]:
+    """Every scanned directory containing an __init__.py — package
+    membership for the per-call-site direction, so a SUBTREE scan
+    (transmogrifai_tpu/serve/) still checks its own files."""
+    return {c.path.rsplit("/", 1)[0] for c in ctxs
+            if c.path.endswith("/__init__.py") and "/" in c.path}
+
+
+def _event_calls(ctx: LintContext) -> List[Tuple[ast.Call, str]]:
+    if ".event(" not in ctx.source:
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "event" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            out.append((node, node.args[0].value))
+    return out
+
+
+@project_rule("EVT001", "EventLog event name missing from the "
+                        "observability.md event table, or stale table "
+                        "row no code emits")
+def check_evt001(ctxs: Sequence[LintContext]) -> List[Finding]:
+    roots = [c.root for c in ctxs if c.root is not None]
+    if not roots:
+        return []
+    table = _event_table(roots[0])
+    if table is None:
+        return []
+    doc_names, doc_lines = table
+    pkg_dirs = _package_dirs(ctxs)
+    init_dirs = _init_dirs(ctxs)
+    findings: List[Finding] = []
+    emitted: Set[str] = set()
+    emitting_pkgs: Set[str] = set()
+    init_scanned: Set[str] = set()
+    for ctx in ctxs:
+        top = ctx.path.split("/", 1)[0]
+        own_dir = ctx.path.rsplit("/", 1)[0] if "/" in ctx.path else ""
+        # per-call-site direction: any file living in a scanned package
+        # directory (its own dir has an __init__.py) — subtree scans of
+        # transmogrifai_tpu/serve/ still check their files; tests/ and
+        # top-level scripts have no __init__ and stay exempt
+        if own_dir not in init_dirs and top not in pkg_dirs:
+            continue
+        if ctx.path == f"{top}/__init__.py":
+            init_scanned.add(top)
+        for node, name in _event_calls(ctx):
+            emitted.add(name)
+            emitting_pkgs.add(top)
+            if name in doc_names:
+                continue
+            f = ctx.finding(
+                "EVT001", node,
+                f"event `{name}` is not listed in the "
+                f"docs/observability.md event table — the table is the "
+                f"schema trace-report and the dashboards read; add a "
+                f"row (event, source, fields) or rename the event to a "
+                f"listed one")
+            if f is not None:
+                findings.append(f)
+    # stale-row direction: only when a package that actually EMITS
+    # events is fully in view (its __init__.py scanned). Scanning some
+    # unrelated package (tools/) must not declare the table stale.
+    if emitting_pkgs & init_scanned:
+        for name, lineno in sorted(doc_names.items()):
+            if name in emitted:
+                continue
+            snippet = doc_lines[lineno - 1].strip() if \
+                0 <= lineno - 1 < len(doc_lines) else ""
+            findings.append(Finding(
+                rule="EVT001", path=_EVENT_DOC.replace(os.sep, "/"),
+                line=lineno, col=0,
+                message=f"event table row `{name}` has no emitting "
+                        f"call site in the scanned package — stale "
+                        f"schema row; delete it or restore the emitter",
+                snippet=snippet))
+    return findings

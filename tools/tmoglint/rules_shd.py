@@ -1,0 +1,248 @@
+"""SPMD/collective-correctness rules SHD001-SHD005.
+
+The multi-host GSPMD push (ROADMAP item 2) rides shard_map bodies whose
+correctness contracts — "this out_spec is replicated because a psum made
+it so", "this axis name matches the mesh", "no per-shard randomness" —
+are invisible to Tier-1: every one of them holds trivially on the
+1-device CPU mesh and only breaks on real hardware at N>1. These rules
+make the contracts lint-time checkable, riding the shardflow.py
+shard-variance dataflow:
+
+* **SHD001 unreduced cross-shard output** — an out_spec claims a
+  replicated result but no psum/all_gather on the bound axis reaches it
+  through the body's dataflow: the forgot-the-psum bug. Each device
+  would return its own partial sum; jax hands back shard 0's.
+* **SHD002 axis-name mismatch / unbound axis** — a collective names an
+  axis the enclosing shard_map does not bind (or runs outside any
+  shard_map, or reaches the trace with ``axis_name=None``). The guarded
+  single-device degenerate path (``x if axis_name is None else
+  psum(x, axis_name)``) folds statically and stays legal.
+* **SHD003 shard-variant nondeterminism** — an index-local
+  ``jax.random`` draw combining with shard-variant data inside a
+  sharded body (every shard draws the SAME bits for its local rows:
+  neither the single-device mask nor independent), or host control flow
+  branching on a per-shard value. The ``fit_gbt_folds_sharded``
+  ``subsample < 1.0`` trace-time raise is recognized as a path
+  condition: with the bar present the draw is statically dead and the
+  scan is clean; remove the bar and the draw flags.
+* **SHD004 spec arity/rank mismatch** — in_specs entries vs the core's
+  positional signature, out_specs entries vs the returned tuple, and
+  per-spec dimension count vs a ``a, b = x.shape`` rank pin.
+* **SHD005 host-side merge without the cross-process fold** — in code
+  reachable from a multi-process entry point (parallel/multihost.py
+  consumers), a host ``np.sum``-style reduction over a *fetched*
+  row-sharded array: under one process it sees every row; under N
+  processes ``np.asarray`` sees only the addressable shards and the
+  "global" sum silently becomes a per-host sum. Reduce on device
+  (psum) before fetching, or go through
+  ``parallel.multihost.fetch_global``.
+
+All project rules: they need cross-module constant/call resolution.
+Suppression (`# tmoglint: disable=SHD00x  reason`) works as everywhere
+else in tmoglint.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from .core import Finding, LintContext, dotted_name, project_rule
+from .shardflow import Pre, shard_analysis
+
+_HOST_REDUCES = {"sum", "mean", "max", "min", "prod", "average", "add"}
+_FETCHERS = {"asarray", "array", "device_get"}
+_SHARDED_PRODUCERS = {"host_local_rows", "device_put_batch",
+                      "make_array_from_process_local_data"}
+_MULTIHOST_HINTS = {"global_mesh", "host_local_rows",
+                    "process_row_range", "padded_global_rows"}
+
+
+def _emit(ctxs: Sequence[LintContext], pres: List[Pre],
+          rule: str) -> List[Finding]:
+    by_path: Dict[str, LintContext] = {c.path: c for c in ctxs}
+    out: List[Finding] = []
+    for p in pres:
+        if p.rule != rule:
+            continue
+        ctx = by_path.get(p.mod.path)
+        if ctx is None:
+            continue
+        f = ctx.finding(rule, p.node, p.message)
+        if f is not None:
+            out.append(f)
+    return out
+
+
+@project_rule("SHD001", "shard_map out_spec claims replicated but no "
+                        "cross-shard reduction reaches it "
+                        "(forgot-the-psum)")
+def check_shd001(ctxs: Sequence[LintContext]) -> List[Finding]:
+    return _emit(ctxs, shard_analysis(ctxs).pres, "SHD001")
+
+
+@project_rule("SHD002", "collective axis name unbound or mismatching "
+                        "the enclosing shard_map's mesh axes")
+def check_shd002(ctxs: Sequence[LintContext]) -> List[Finding]:
+    return _emit(ctxs, shard_analysis(ctxs).pres, "SHD002")
+
+
+@project_rule("SHD003", "shard-variant nondeterminism: index-local "
+                        "random draw or host branch on a per-shard "
+                        "value inside a sharded body")
+def check_shd003(ctxs: Sequence[LintContext]) -> List[Finding]:
+    return _emit(ctxs, shard_analysis(ctxs).pres, "SHD003")
+
+
+@project_rule("SHD004", "shard_map in_specs/out_specs arity or rank "
+                        "mismatch against the core's signature")
+def check_shd004(ctxs: Sequence[LintContext]) -> List[Finding]:
+    return _emit(ctxs, shard_analysis(ctxs).pres, "SHD004")
+
+
+@project_rule("SHD005", "host-side reduce of a fetched row-sharded "
+                        "array without the cross-process fold")
+def check_shd005(ctxs: Sequence[LintContext]) -> List[Finding]:
+    findings: List[Finding] = []
+    for ctx in ctxs:
+        base = ctx.path.rsplit("/", 1)[-1]
+        if base.startswith("test_") or "multihost" not in ctx.source:
+            # tests exercise the single-process degenerate path by
+            # design; the rule guards multi-process production code
+            continue
+        findings.extend(_shd005_file(ctx))
+    return findings
+
+
+def _multihost_aliases(ctx: LintContext) -> Set[str]:
+    """Local names bound to parallel.multihost (module or members)."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                if a.name == "multihost" or mod.endswith("multihost"):
+                    out.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("multihost"):
+                    out.add(a.asname or a.name.split(".")[0])
+    return out
+
+
+def _is_multiprocess_fn(fnode, aliases: Set[str]) -> bool:
+    for node in ast.walk(fnode):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted_name(node.func)
+        if not d:
+            continue
+        parts = d.split(".")
+        if parts[-1] in _MULTIHOST_HINTS or \
+                (parts[0] in aliases and len(parts) > 1) or \
+                parts[-1] == "initialize" and parts[0] in aliases:
+            return True
+    return False
+
+
+def _sharded_call(expr) -> Optional[str]:
+    """Name of the sharded-producer call `expr` is, else None."""
+    if not isinstance(expr, ast.Call):
+        return None
+    d = dotted_name(expr.func)
+    if not d:
+        return None
+    tail = d.split(".")[-1]
+    if tail in _SHARDED_PRODUCERS or tail.endswith("_sharded"):
+        return tail
+    return None
+
+
+def _shd005_file(ctx: LintContext) -> List[Finding]:
+    aliases = _multihost_aliases(ctx)
+    if not aliases:
+        return []
+    findings: List[Finding] = []
+    for fnode in ast.walk(ctx.tree):
+        if not isinstance(fnode, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _is_multiprocess_fn(fnode, aliases):
+            continue
+        # taint: names holding a row-sharded device value, and names
+        # holding its host FETCH (np.asarray/np.array/jax.device_get).
+        # Iterated to a fixpoint: ast.walk is BFS, so a producer
+        # assigned inside an if/for branch is only visible to an
+        # outer-level fetch on a later pass.
+        sharded: Set[str] = set()
+        fetched: Set[str] = set()
+        for _ in range(4):
+            before = (len(sharded), len(fetched))
+            for node in ast.walk(fnode):
+                if not isinstance(node, ast.Assign):
+                    continue
+                val = node.value
+                names = [t.id for t in node.targets
+                         if isinstance(t, ast.Name)]
+                # tuple results: `arr, n = device_put_batch(...)`
+                for t in node.targets:
+                    if isinstance(t, ast.Tuple):
+                        names.extend(e.id for e in t.elts
+                                     if isinstance(e, ast.Name))
+                if not names:
+                    continue
+                if _sharded_call(val):
+                    sharded.update(names)
+                elif isinstance(val, ast.Call):
+                    d = dotted_name(val.func)
+                    tail = d.split(".")[-1] if d else ""
+                    if tail in _FETCHERS and val.args:
+                        inner = val.args[0]
+                        if _sharded_call(inner) or (
+                                isinstance(inner, ast.Name) and
+                                inner.id in sharded):
+                            fetched.update(names)
+            if (len(sharded), len(fetched)) == before:
+                break
+        for node in ast.walk(fnode):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if not d:
+                continue
+            parts = d.split(".")
+            tail = parts[-1]
+            hit = None
+            if tail in _HOST_REDUCES and len(parts) >= 2 and \
+                    parts[0] in ("np", "numpy") and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in fetched:
+                    hit = arg.id
+                elif _sharded_call(arg):
+                    # np.sum(fit_stats_sharded(...)): reducing the raw
+                    # device value host-side implies the fetch
+                    hit = _sharded_call(arg)
+                elif isinstance(arg, ast.Call):
+                    # np.sum(np.asarray(<sharded>)): inline fetch
+                    di = dotted_name(arg.func)
+                    ti = di.split(".")[-1] if di else ""
+                    if ti in _FETCHERS and arg.args and (
+                            _sharded_call(arg.args[0]) or
+                            (isinstance(arg.args[0], ast.Name) and
+                             arg.args[0].id in sharded)):
+                        hit = "<fetch>"
+            elif tail in _HOST_REDUCES and len(parts) == 2 and \
+                    parts[0] in fetched:
+                hit = parts[0]  # fetched.sum()
+            if hit is not None:
+                f = ctx.finding(
+                    "SHD005", node,
+                    f"host-side `{tail}` over a fetched row-sharded "
+                    f"array (`{hit}`) in a multi-process path — "
+                    f"np.asarray of a multi-host global array only "
+                    f"sees this process's addressable shards, so the "
+                    f"'global' reduce silently becomes a per-host one "
+                    f"at N>1 processes; psum on device before "
+                    f"fetching, or fetch via "
+                    f"parallel.multihost.fetch_global")
+                if f is not None:
+                    findings.append(f)
+    return findings

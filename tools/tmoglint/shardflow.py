@@ -1,0 +1,1832 @@
+"""Sharding-dataflow layer: shard_map sites, axis binding, shard-variance.
+
+ROADMAP item 2 moves the whole pipeline onto a multi-host GSPMD mesh, and
+every substrate was shaped psum-able for it (stats-engine Chan merges, GLM
+Grams, tree level histograms, tileplane tiles, monitor windows). The killer
+bug class on that road is *statically detectable and invisible on the
+1-device CPU mesh CI runs on*: a ``shard_map`` body whose ``out_spec``
+claims a replicated result that was never actually psum-merged, an
+``axis_name`` that does not match the enclosing mesh axis, or an
+index-local random draw inside a sharded body — all produce results that
+are correct at N=1 and silently wrong at N>1, the one failure mode Tier-1
+cannot catch before hardware. This module is the shared analysis the SHD
+rule family (rules_shd.py) runs on:
+
+* **site resolution** — every ``build_shard_map``/``shard_map`` call
+  (aliases like ``_build_shard_map`` included), with its core function
+  (nested def / lambda / ``partial``), mesh expression and
+  ``in_specs``/``out_specs`` parsed into per-position axis sets.
+  ``PartitionSpec`` axis names resolve through literal strings, module
+  constants (``BATCH_AXIS``-style) and cross-module ``from ... import``
+  chains, so ``ops/`` kernels binding ``parallel/mesh.py`` constants are
+  seen with their real axis names.
+* **shard-variance dataflow** — an abstract interpreter over the core's
+  body: inputs whose spec carries a bound axis start *shard-variant*,
+  collective reductions on a bound axis (``psum``/``pmax``/``pmin``/
+  ``pmean``/``all_gather``) produce *replicated* values, everything else
+  joins its operands. Helper calls are summarized interprocedurally with
+  their ``axis_name=`` bindings threaded through (``_allreduce`` in
+  ops/trees.py, the ``allreduce`` closures in ops/glm_sweep.py, the
+  ``lambda v: psum(v, BATCH_AXIS)`` shift folds in ops/stats_engine.py),
+  ``lax.scan``/``while_loop``/``fori_loop``/``cond`` bodies are resolved
+  and iterated to a small fixpoint, and branches on *statically known*
+  parameter values fold (``if axis_name is None: return st`` is dead
+  under an ``axis_name=BATCH_AXIS`` binding — the single-device
+  degenerate path stays legal without poisoning the sharded summary).
+* **trace-time-raise path conditions** — an ``if <cond>: raise`` records
+  its (folded) condition; later branches guarded by the *same* condition
+  are dead. This is how ``fit_gbt_folds_sharded``'s ``subsample < 1.0``
+  trace-time bar is promoted to lint time: with the raise present the
+  index-local draw is unreachable and the scan is clean; delete the
+  raise and SHD003 fires on the draw.
+* **collective observations** — every ``psum``/``pvary``/``pcast``/...
+  call actually evaluated under a site binding, with the axis value(s)
+  it received (literal, constant, threaded parameter, or None). SHD002
+  judges these against the site's bound axes.
+
+Everything here is stdlib-``ast``. The joined analysis is cached on the
+ctx *sequence* (all SHD rules share one run), mirroring threadflow.
+Precision is a deliberate over-approximation tamed, like the rest of
+tmoglint, by per-line suppression comments.
+"""
+from __future__ import annotations
+
+import ast
+import itertools
+from typing import (Dict, FrozenSet, List, Optional, Sequence, Set,
+                    Tuple)
+
+from .core import LintContext, dotted_name
+
+# collectives that REDUCE over an axis: result replicated across shards
+COLLECTIVE_REDUCE = {"psum", "pmax", "pmin", "pmean", "all_gather"}
+# collectives whose result stays (or becomes) per-shard
+COLLECTIVE_SHARD = {"psum_scatter", "all_to_all", "ppermute", "pshuffle",
+                    "axis_index"}
+# varying-manual-axes bookkeeping: value-preserving, variance-neutral
+COLLECTIVE_NEUTRAL = {"pvary", "pcast", "pbroadcast"}
+ALL_COLLECTIVES = COLLECTIVE_REDUCE | COLLECTIVE_SHARD | COLLECTIVE_NEUTRAL
+# which positional argument carries the axis name
+_AXIS_ARG_POS = {"axis_index": 0}
+_JAXISH = ("jax", "lax")
+
+# jax.random samplers whose draws are index-local under a sharded body
+RANDOM_SAMPLERS = {
+    "uniform", "normal", "bernoulli", "randint", "choice", "permutation",
+    "truncated_normal", "gumbel", "exponential", "beta", "gamma",
+    "poisson", "categorical", "rademacher", "laplace", "dirichlet",
+}
+
+# metadata reads: valid host-side facts even of a sharded array
+STATIC_ACCESSORS = {"shape", "ndim", "dtype", "size", "itemsize",
+                    "nbytes", "sharding", "aval", "weak_type"}
+
+_MAX_DEPTH = 10
+_MAX_STEPS = 400_000
+_LOOP_PASSES = 3
+
+
+class _Unknown:
+    __slots__ = ()
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return "<?>"
+
+
+UNKNOWN = _Unknown()
+
+
+class Closure:
+    """A function value: def/lambda + the defining frame's env snapshot."""
+
+    __slots__ = ("node", "env", "mod")
+
+    def __init__(self, node, env, mod):
+        self.node = node
+        self.env = env
+        self.mod = mod
+
+
+class FuncRef:
+    """A module-level function (possibly in another module)."""
+
+    __slots__ = ("node", "mod")
+
+    def __init__(self, node, mod):
+        self.node = node
+        self.mod = mod
+
+
+class ModuleRef:
+    __slots__ = ("mod",)
+
+    def __init__(self, mod):
+        self.mod = mod
+
+
+class AbsVal:
+    """Abstract value: shard-variance + known constant + draw taint.
+
+    The draw taint only lives on *replicated* values: a drawn mask is
+    the bug the instant it arithmetically combines with shard-variant
+    data (SHD003 fires there, once), after which the result is ordinary
+    sharded data — keeping the taint alive past that point (or past a
+    psum) would re-flag every derived expression downstream.
+    """
+
+    __slots__ = ("var", "const", "draw", "elems")
+
+    def __init__(self, var: str = "rep", const=UNKNOWN, draw: bool = False,
+                 elems: Optional[Tuple["AbsVal", ...]] = None):
+        self.var = var          # 'rep' | 'shard'
+        self.const = const
+        self.draw = draw and var == "rep"
+        self.elems = elems
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        c = "" if self.const is UNKNOWN else f"={self.const!r}"
+        d = " draw" if self.draw else ""
+        e = f" elems{len(self.elems)}" if self.elems is not None else ""
+        return f"<{self.var}{c}{d}{e}>"
+
+
+REP = AbsVal()
+
+
+def join(*vals: AbsVal) -> AbsVal:
+    var = "rep"
+    draw = False
+    const = UNKNOWN
+    first = True
+    elems = None
+    elems_ok = True
+    for v in vals:
+        if v is None:
+            continue
+        if v.var == "shard":
+            var = "shard"
+        draw = draw or v.draw
+        if first:
+            const = v.const
+            elems = v.elems
+            first = False
+        else:
+            # a REAL None constant is a value like any other — it must
+            # survive an agreeing join (axis_name=None guards fold on it)
+            if const is not v.const and const != v.const:
+                const = UNKNOWN
+            if not (elems_ok and v.elems is not None and elems is not None
+                    and len(v.elems) == len(elems)):
+                elems_ok = False
+                elems = None
+    if first:
+        return REP
+    if elems is not None and elems_ok and len(vals) > 1:
+        elems = tuple(join(*(v.elems[i] for v in vals if v is not None
+                             and v.elems is not None))
+                      for i in range(len(elems)))
+    return AbsVal(var, const, draw, elems)
+
+
+# -- per-module tables -------------------------------------------------------
+
+class ModuleInfo:
+    """Constants, top-level functions and import map for one file."""
+
+    def __init__(self, ctx: LintContext):
+        self.ctx = ctx
+        self.path = ctx.path
+        self.consts: Dict[str, object] = {}
+        self.funcs: Dict[str, ast.AST] = {}
+        # name -> ('module', tail) | ('name', tail, orig)
+        self.imports: Dict[str, Tuple] = {}
+        self.p_aliases: Set[str] = {"P", "PartitionSpec"}
+        for node in ctx.tree.body:
+            self._top(node)
+        # nested imports (inside functions) still matter: the repo's
+        # sharded factories do `from jax.sharding import PartitionSpec
+        # as P` and `from ..parallel.mesh import BATCH_AXIS` locally
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._imports(node)
+
+    def _top(self, node) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.funcs[node.name] = node
+        elif isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Constant):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.consts[t.id] = node.value.value
+        elif isinstance(node, ast.If):
+            for sub in node.body + node.orelse:
+                self._top(sub)
+
+    def _imports(self, node) -> None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                tail = a.name.replace(".", "/") + ".py"
+                self.imports[a.asname or a.name.split(".")[0]] = \
+                    ("module", tail)
+        elif isinstance(node, ast.ImportFrom):
+            mod = (node.module or "").replace(".", "/")
+            for a in node.names:
+                local = a.asname or a.name
+                if a.name == "PartitionSpec":
+                    self.p_aliases.add(local)
+                if node.module is None:
+                    # `from . import pallas_hist` — sibling module
+                    self.imports[local] = ("module", a.name + ".py")
+                else:
+                    self.imports[local] = \
+                        ("name", mod + ".py", a.name)
+                    # `from x import y` where y is itself a module
+                    self.imports.setdefault(
+                        local + "\0mod",
+                        ("module", mod + "/" + a.name + ".py"))
+
+
+class ShardProject:
+    """Joined view: cross-module constant/function resolution.
+
+    ModuleInfo construction walks a whole file; a repo scan has a few
+    hundred files but only a handful participate in sharding, so infos
+    are built LAZILY — site discovery gates on a substring check and
+    resolution pulls in exactly the modules the interp reaches
+    (parallel/mesh.py constants, ops/pallas_hist.py kernels, ...).
+    """
+
+    def __init__(self, ctxs: Sequence[LintContext]):
+        self.ctxs = list(ctxs)
+        self.ctx_by_path: Dict[str, LintContext] = \
+            {c.path: c for c in self.ctxs}
+
+    def mod_for(self, ctx: LintContext) -> ModuleInfo:
+        return module_info(ctx)
+
+    def _find_module(self, tail: str,
+                     near: Optional[str] = None) -> Optional[ModuleInfo]:
+        """Module whose path is `tail` on a path-component boundary
+        (`trees.py` must not match `host_trees.py`); among candidates
+        (ops/trees.py vs models/trees.py) prefer the one sharing the
+        longest directory prefix with the importing module `near` —
+        relative imports resolve to siblings."""
+        best = None
+        best_score = -1
+        near_dir = near.rsplit("/", 1)[0] + "/" if near and "/" in near \
+            else ""
+        for c in self.ctxs:
+            if not (c.path == tail or c.path.endswith("/" + tail)):
+                continue
+            score = 0
+            if near_dir:
+                for a, b in zip(c.path, near_dir):
+                    if a != b:
+                        break
+                    score += 1
+            if score > best_score or (score == best_score and
+                                      best is not None and
+                                      len(c.path) < len(best.path)):
+                best = c
+                best_score = score
+        return module_info(best) if best is not None else None
+
+    def resolve_import(self, mod: ModuleInfo, name: str):
+        """Resolution of an imported name: const value (which may be a
+        real None), FuncRef, ModuleRef — or the UNKNOWN sentinel when
+        the name does not resolve (None must stay distinguishable from
+        not-found)."""
+        ent = mod.imports.get(name)
+        if ent is None:
+            return UNKNOWN
+        if ent[0] == "module":
+            target = self._find_module(ent[1], near=mod.path)
+            return ModuleRef(target) if target is not None else UNKNOWN
+        _, tail, orig = ent
+        target = self._find_module(tail, near=mod.path)
+        if target is not None:
+            if orig in target.consts:
+                return target.consts[orig]
+            if orig in target.funcs:
+                return FuncRef(target.funcs[orig], target)
+        # maybe `from pkg import submodule`
+        ent2 = mod.imports.get(name + "\0mod")
+        if ent2 is not None:
+            target = self._find_module(ent2[1], near=mod.path)
+            if target is not None:
+                return ModuleRef(target)
+        return UNKNOWN
+
+    def resolve_const_str(self, mod: ModuleInfo, name: str):
+        """Constant value of `name` in `mod`'s scope, else UNKNOWN.
+        A constant that IS None resolves to None (a `SOME_AXIS = None`
+        import must parse as a replicated spec entry, not unknown)."""
+        if name in mod.consts:
+            return mod.consts[name]
+        r = self.resolve_import(mod, name)
+        if r is UNKNOWN:
+            return UNKNOWN
+        if isinstance(r, (str, int, float, bool)) or r is None:
+            return r
+        return UNKNOWN
+
+
+def module_info(ctx: LintContext) -> ModuleInfo:
+    mi = getattr(ctx, "_shard_module_info", None)
+    if mi is None:
+        mi = ModuleInfo(ctx)
+        ctx._shard_module_info = mi
+    return mi
+
+
+# -- PartitionSpec parsing ---------------------------------------------------
+
+class SpecVal:
+    """One PartitionSpec: the axis names it shards over."""
+
+    __slots__ = ("axes", "unknown", "node")
+
+    def __init__(self, axes: FrozenSet[str], unknown: bool, node):
+        self.axes = axes
+        self.unknown = unknown
+        self.node = node
+
+    @property
+    def sharded(self) -> bool:
+        return bool(self.axes) or self.unknown
+
+    @property
+    def replicated(self) -> bool:
+        return not self.axes and not self.unknown
+
+    def entry_count(self, tree: ast.Call) -> int:
+        return len(tree.args)
+
+
+class SpecList:
+    """in_specs/out_specs: fixed prefix + optional repeated tail."""
+
+    __slots__ = ("fixed", "rest", "is_tuple")
+
+    def __init__(self, fixed: List[SpecVal], rest: Optional[SpecVal],
+                 is_tuple: bool):
+        self.fixed = fixed
+        self.rest = rest
+        self.is_tuple = is_tuple
+
+    @property
+    def known_count(self) -> Optional[int]:
+        return len(self.fixed) if self.rest is None else None
+
+    def axes(self) -> FrozenSet[str]:
+        out: Set[str] = set()
+        for s in self.fixed + ([self.rest] if self.rest else []):
+            out |= s.axes
+        return frozenset(out)
+
+
+class _SpecParser:
+    def __init__(self, project: ShardProject, mod: ModuleInfo,
+                 scope_consts: Dict[str, object]):
+        self.project = project
+        self.mod = mod
+        self.scope_consts = scope_consts
+
+    def _axis_of(self, node) -> Tuple[FrozenSet[str], bool]:
+        """(axis names, unknown?) of one P(...) entry."""
+        if isinstance(node, ast.Constant):
+            if node.value is None:
+                return frozenset(), False
+            if isinstance(node.value, str):
+                return frozenset({node.value}), False
+            return frozenset(), True
+        if isinstance(node, ast.Name):
+            v = self.scope_consts.get(node.id, UNKNOWN)
+            if v is UNKNOWN:
+                v = self.project.resolve_const_str(self.mod, node.id)
+            if isinstance(v, str):
+                return frozenset({v}), False
+            if v is None:
+                return frozenset(), False
+            return frozenset(), True
+        if isinstance(node, (ast.Tuple, ast.List)):
+            axes: Set[str] = set()
+            unknown = False
+            for el in node.elts:
+                a, u = self._axis_of(el)
+                axes |= a
+                unknown = unknown or u
+            return frozenset(axes), unknown
+        return frozenset(), True
+
+    def spec(self, node) -> Optional[SpecVal]:
+        """SpecVal of a `P(...)` call, else None."""
+        if not isinstance(node, ast.Call):
+            return None
+        d = dotted_name(node.func)
+        if not d or d.split(".")[-1] not in self.mod.p_aliases:
+            return None
+        axes: Set[str] = set()
+        unknown = False
+        for a in node.args:
+            ax, u = self._axis_of(a)
+            axes |= ax
+            unknown = unknown or u
+        return SpecVal(frozenset(axes), unknown, node)
+
+    def specs(self, node) -> Optional[SpecList]:
+        """SpecList of an in_specs/out_specs expression, else None
+        (unanalyzable)."""
+        sv = self.spec(node)
+        if sv is not None:
+            return SpecList([sv], None, is_tuple=False)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            fixed: List[SpecVal] = []
+            rest: Optional[SpecVal] = None
+            for el in node.elts:
+                sub = self.specs(el)
+                if sub is None or sub.is_tuple:
+                    # nested pytree specs: treat entry as one spec with
+                    # the union of axes, unknown when unparsable
+                    if sub is not None:
+                        fixed.append(SpecVal(sub.axes(), False, el))
+                        continue
+                    return None
+                if sub.rest is not None:
+                    return None
+                fixed.extend(sub.fixed)
+            return SpecList(fixed, rest, is_tuple=True)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left = self.specs(node.left)
+            right = self.specs(node.right)
+            if left is None or right is None or left.rest is not None:
+                return None
+            if right.rest is not None:
+                return SpecList(left.fixed + right.fixed, right.rest, True)
+            return SpecList(left.fixed + right.fixed, None, True)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+            # (P(None),) * n_extras — element known, count not
+            for side in (node.left, node.right):
+                sub = self.specs(side)
+                if sub is not None and sub.fixed:
+                    merged = SpecVal(
+                        frozenset(itertools.chain.from_iterable(
+                            s.axes for s in sub.fixed)),
+                        any(s.unknown for s in sub.fixed), side)
+                    return SpecList([], merged, is_tuple=True)
+            return None
+        if isinstance(node, ast.IfExp):
+            a = self.specs(node.body)
+            b = self.specs(node.orelse)
+            if a is None or b is None:
+                return None
+            if a.rest is not None or b.rest is not None:
+                return None
+            n = max(len(a.fixed), len(b.fixed))
+
+            def at(sl, i):
+                return sl.fixed[i] if i < len(sl.fixed) else \
+                    SpecVal(frozenset(), False, node)
+
+            fixed = [SpecVal(at(a, i).axes | at(b, i).axes,
+                             at(a, i).unknown or at(b, i).unknown, node)
+                     for i in range(n)]
+            return SpecList(fixed, None,
+                            is_tuple=a.is_tuple or b.is_tuple)
+        return None
+
+
+# -- site discovery ----------------------------------------------------------
+
+class Site:
+    """One shard_map construction with resolvable core + specs.
+
+    `axes` is the spec-derived binding (what the data actually shards
+    over — the variance seed); `mesh_axes` is the FULL axis set of the
+    mesh when its construction is statically resolvable (a shard_map
+    body binds every mesh axis, spec-listed or not), else None.
+    """
+
+    __slots__ = ("mod", "call", "core", "in_specs", "out_specs", "axes",
+                 "mesh_axes")
+
+    def __init__(self, mod, call, core, in_specs, out_specs,
+                 mesh_axes=None):
+        self.mod = mod
+        self.call = call
+        self.core = core            # Closure
+        self.in_specs = in_specs    # SpecList | None
+        self.out_specs = out_specs  # SpecList | None
+        ax: Set[str] = set()
+        if in_specs is not None:
+            ax |= in_specs.axes()
+        if out_specs is not None:
+            ax |= out_specs.axes()
+        self.axes = frozenset(ax)
+        self.mesh_axes = mesh_axes  # frozenset | None (unresolved)
+
+
+def _is_shard_map_call(call: ast.Call) -> bool:
+    d = dotted_name(call.func)
+    if not d:
+        return False
+    tail = d.split(".")[-1].lstrip("_")
+    return tail in {"shard_map", "build_shard_map"}
+
+
+def _call_arg(call: ast.Call, pos: int, name: str):
+    if len(call.args) > pos:
+        return call.args[pos]
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+# -- the analysis ------------------------------------------------------------
+
+class _Budget(Exception):
+    pass
+
+
+class Pre:
+    """Finding precursor: (rule, mod, node, message)."""
+
+    __slots__ = ("rule", "mod", "node", "message")
+
+    def __init__(self, rule, mod, node, message):
+        self.rule = rule
+        self.mod = mod
+        self.node = node
+        self.message = message
+
+
+class SiteInterp:
+    """Abstract interpretation of one site's core body."""
+
+    def __init__(self, project: ShardProject, site: Site,
+                 result: "ShardAnalysis"):
+        self.project = project
+        self.site = site
+        self.res = result
+        self.steps = 0
+        self.active: Set[int] = set()
+        self.memo: Dict[Tuple, AbsVal] = {}
+        self.fatal_tests: Set[str] = set()
+        self.flagged_nodes: Set[int] = set()
+        self.incomplete = False
+
+    # -- plumbing ----------------------------------------------------------
+    def _tick(self):
+        self.steps += 1
+        if self.steps > _MAX_STEPS:
+            raise _Budget()
+
+    def run(self) -> Optional[AbsVal]:
+        """Interpret the core under its in_specs binding; returns the
+        joined return value or None when the body blew the budget."""
+        core = self.site.core
+        args = self._seed_args(core.node)
+        try:
+            return self.call_closure(core, args, {}, depth=0,
+                                     star_kwargs=False)
+        except _Budget:
+            self.incomplete = True
+            return None
+
+    def _seed_args(self, fnode) -> List[AbsVal]:
+        specs = self.site.in_specs
+        a = fnode.args
+        params = list(getattr(a, "posonlyargs", []) or []) + list(a.args)
+        vararg = a.vararg
+        out: List[AbsVal] = []
+        n_fixed = len(params)
+        if specs is None:
+            return [AbsVal("shard") for _ in params]
+        for i in range(n_fixed):
+            if i < len(specs.fixed):
+                sv = specs.fixed[i]
+            elif specs.rest is not None:
+                sv = specs.rest
+            else:
+                sv = None
+            out.append(AbsVal("shard") if sv is not None and sv.sharded
+                       else REP)
+        if vararg is not None:
+            rest_var = "rep"
+            tail = specs.fixed[n_fixed:]
+            if any(s.sharded for s in tail) or (
+                    specs.rest is not None and specs.rest.sharded):
+                rest_var = "shard"
+            out.append(AbsVal(rest_var))
+        return out
+
+    # -- function invocation ----------------------------------------------
+    def call_value(self, fval: AbsVal, args: List[AbsVal],
+                   kwargs: Dict[str, AbsVal], depth: int,
+                   star_kwargs: bool = False) -> AbsVal:
+        c = fval.const
+        if isinstance(c, _Partial):
+            return self.call_value(AbsVal("rep", c.fn),
+                                   list(c.pre) + args, kwargs, depth,
+                                   star_kwargs)
+        if isinstance(c, Closure):
+            return self.call_closure(c, args, kwargs, depth,
+                                     star_kwargs)
+        if isinstance(c, FuncRef):
+            cl = Closure(c.node, {}, c.mod)
+            return self.call_closure(cl, args, kwargs, depth,
+                                     star_kwargs)
+        return join(fval, *args, *kwargs.values())
+
+    def call_closure(self, cl: Closure, args: List[AbsVal],
+                     kwargs: Dict[str, AbsVal], depth: int,
+                     star_kwargs: bool) -> AbsVal:
+        self._tick()
+        fnode = cl.node
+        if depth > _MAX_DEPTH or id(fnode) in self.active:
+            return join(*args, *kwargs.values()) if (args or kwargs) \
+                else REP
+        key = None
+        if not cl.env:
+            key = (id(fnode), star_kwargs,
+                   tuple(_val_key(v) for v in args),
+                   tuple(sorted((k, _val_key(v))
+                                for k, v in kwargs.items())))
+            hit = self.memo.get(key)
+            if hit is not None:
+                return hit
+        env = dict(cl.env)
+        frame = _Frame(cl.mod, env)
+        a = fnode.args
+        params = [p.arg for p in
+                  getattr(a, "posonlyargs", []) + a.args]
+        # positional
+        consumed = 0
+        for i, p in enumerate(params):
+            if i < len(args):
+                env[p] = args[i]
+                consumed += 1
+            elif p in kwargs:
+                env[p] = kwargs[p]
+            elif star_kwargs:
+                # a **kw expansion may override any later param: its
+                # value is statically unknown, NOT the declared default
+                env[p] = REP
+            else:
+                env[p] = self._default_for(a, i, len(params), frame,
+                                           depth)
+        if a.vararg is not None:
+            extra = args[consumed:]
+            env[a.vararg.arg] = AbsVal(
+                "shard" if any(v.var == "shard" for v in extra) else
+                "rep", UNKNOWN,
+                any(v.draw for v in extra),
+                tuple(extra) if extra else None)
+        for kw in a.kwonlyargs:
+            p = kw.arg
+            if p in kwargs:
+                env[p] = kwargs[p]
+            elif star_kwargs:
+                env[p] = REP
+            else:
+                env[p] = self._kw_default_for(a, p, frame, depth)
+        if a.kwarg is not None:
+            env[a.kwarg.arg] = REP
+        self.active.add(id(fnode))
+        self.res.visited_funcs.add(id(fnode))
+        try:
+            if isinstance(fnode, ast.Lambda):
+                ret = self.eval(fnode.body, frame, depth)
+            else:
+                frame.ret = None
+                self.exec_block(fnode.body, frame, depth)
+                ret = frame.ret if frame.ret is not None else REP
+        finally:
+            self.active.discard(id(fnode))
+        if key is not None:
+            self.memo[key] = ret
+        return ret
+
+    def _default_for(self, a, i, n_params, frame, depth) -> AbsVal:
+        defaults = a.defaults
+        j = i - (n_params - len(defaults))
+        if 0 <= j < len(defaults):
+            return self.eval(defaults[j], frame, depth)
+        return REP
+
+    def _kw_default_for(self, a, name, frame, depth) -> AbsVal:
+        for kw, d in zip(a.kwonlyargs, a.kw_defaults):
+            if kw.arg == name and d is not None:
+                return self.eval(d, frame, depth)
+        return REP
+
+    # -- statements --------------------------------------------------------
+    def exec_block(self, stmts, frame, depth) -> bool:
+        """Returns False when the block provably raises (dead fallout)."""
+        for st in stmts:
+            self._tick()
+            if isinstance(st, ast.Return):
+                v = self.eval(st.value, frame, depth) if st.value \
+                    is not None else REP
+                frame.ret = v if frame.ret is None else join(frame.ret, v)
+                return True
+            if isinstance(st, ast.Raise):
+                return False
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                frame.env[st.name] = AbsVal(
+                    "rep", Closure(st, dict(frame.env), frame.mod))
+                continue
+            if isinstance(st, ast.Assign):
+                v = self.eval(st.value, frame, depth)
+                for t in st.targets:
+                    self.bind(t, v, frame)
+                continue
+            if isinstance(st, ast.AugAssign):
+                v = join(self.eval(st.target, frame, depth, load=True),
+                         self.eval(st.value, frame, depth))
+                self.bind(st.target, v, frame)
+                continue
+            if isinstance(st, ast.AnnAssign):
+                if st.value is not None:
+                    self.bind(st.target,
+                              self.eval(st.value, frame, depth), frame)
+                continue
+            if isinstance(st, ast.If):
+                self.exec_if(st, frame, depth)
+                continue
+            if isinstance(st, (ast.For, ast.While)):
+                self.exec_loop(st, frame, depth)
+                continue
+            if isinstance(st, ast.With):
+                for item in st.items:
+                    self.eval(item.context_expr, frame, depth)
+                self.exec_block(st.body, frame, depth)
+                continue
+            if isinstance(st, ast.Try):
+                self.exec_block(st.body, frame, depth)
+                for h in st.handlers:
+                    self.exec_block(h.body, frame, depth)
+                self.exec_block(st.orelse, frame, depth)
+                self.exec_block(st.finalbody, frame, depth)
+                continue
+            if isinstance(st, ast.Expr):
+                self.eval(st.value, frame, depth)
+                continue
+            if isinstance(st, (ast.Import, ast.ImportFrom)):
+                continue  # ModuleInfo already indexed them
+            # Pass / Assert / Delete / Global / Nonlocal: no-op
+        return True
+
+    def exec_if(self, st: ast.If, frame, depth) -> None:
+        verdict, residual, test_val = self.fold_test(st.test, frame,
+                                                     depth)
+        if verdict is True:
+            self.exec_block(st.body, frame, depth)
+            return
+        if verdict is False:
+            self.exec_block(st.orelse, frame, depth)
+            return
+        if residual is not None and residual in self.fatal_tests:
+            # this condition already proved fatal (trace-time raise):
+            # the body is dead on every path that reaches here
+            self.exec_block(st.orelse, frame, depth)
+            return
+        body_raises = all(isinstance(s, ast.Raise) for s in st.body) \
+            and st.body
+        if body_raises and residual is not None:
+            self.fatal_tests.add(residual)
+            self.exec_block(st.orelse, frame, depth)
+            return
+        # host control flow on a shard-variant value (SHD003): a python
+        # branch inside the traced body whose test varies per shard —
+        # structure checks (`is None`) and metadata are exempt
+        self._maybe_host_branch(st.test, test_val, frame)
+        env0 = dict(frame.env)
+        ret0 = frame.ret
+        self.exec_block(st.body, frame, depth)
+        env1, ret1 = frame.env, frame.ret
+        frame.env = env0
+        frame.ret = ret0
+        self.exec_block(st.orelse, frame, depth)
+        frame.env = _join_envs(env1, frame.env)
+        frame.ret = join(ret1, frame.ret) if (
+            ret1 is not None and frame.ret is not None) else \
+            (ret1 if frame.ret is None else frame.ret)
+
+    def _maybe_host_branch(self, test, test_val, frame) -> None:
+        if test_val is None or test_val.var != "shard":
+            return
+        if id(test) in self.flagged_nodes:
+            return
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Compare) and any(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in sub.ops):
+                return  # pytree-structure check, trace-time static
+        self.flagged_nodes.add(id(test))
+        self.res.pres.append(Pre(
+            "SHD003", frame.mod, test,
+            "host control flow branches on a shard-variant value inside "
+            "a sharded body — each shard takes its own python branch and "
+            "the traced programs diverge across devices; reduce first "
+            "(psum on the mesh axis) or use lax.cond/jnp.where"))
+
+    def exec_loop(self, st, frame, depth) -> None:
+        if isinstance(st, ast.For):
+            it = self.eval(st.iter, frame, depth)
+            elem = join(*it.elems) if it.elems else \
+                AbsVal(it.var, UNKNOWN, it.draw)
+            self.bind(st.target, elem, frame)
+        else:
+            _, _, tv = self.fold_test(st.test, frame, depth)
+            self._maybe_host_branch(st.test, tv, frame)
+        for _ in range(_LOOP_PASSES):
+            before = dict(frame.env)
+            self.exec_block(st.body, frame, depth)
+            frame.env = _join_envs(before, frame.env)
+        self.exec_block(st.orelse, frame, depth)
+
+    def bind(self, target, val: AbsVal, frame) -> None:
+        if isinstance(target, ast.Name):
+            frame.env[target.id] = val
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = target.elts
+            if val.elems is not None and len(val.elems) == len(elts) and \
+                    not any(isinstance(e, ast.Starred) for e in elts):
+                for t, v in zip(elts, val.elems):
+                    self.bind(t, v, frame)
+            else:
+                spread = AbsVal(val.var, UNKNOWN, val.draw)
+                for t in elts:
+                    self.bind(t.value if isinstance(t, ast.Starred)
+                              else t, spread, frame)
+        elif isinstance(target, ast.Attribute):
+            pass  # self.x inside a traced body: out of scope here
+        elif isinstance(target, ast.Subscript):
+            base = target.value
+            if isinstance(base, ast.Name) and base.id in frame.env:
+                old = frame.env[base.id]
+                frame.env[base.id] = AbsVal(
+                    join(old, val).var, UNKNOWN,
+                    old.draw or val.draw)
+
+    # -- test folding ------------------------------------------------------
+    def fold_test(self, test, frame, depth):
+        """(True|False|None, residual-dump|None, AbsVal|None)."""
+        v = self.eval(test, frame, depth)
+        verdict = _truth(v.const)
+        if verdict is not None:
+            return verdict, None, v
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            residue = []
+            for sub in test.values:
+                sv = self.eval(sub, frame, depth)
+                t = _truth(sv.const)
+                if t is False:
+                    return False, None, v
+                if t is not True:
+                    residue.append(sub)
+            if not residue:
+                return True, None, v
+            if len(residue) == 1:
+                return None, ast.dump(residue[0]), v
+            return None, ast.dump(ast.BoolOp(op=ast.And(),
+                                             values=residue)), v
+        return None, ast.dump(test), v
+
+    # -- expressions -------------------------------------------------------
+    def eval(self, node, frame, depth, load=False) -> AbsVal:
+        self._tick()
+        if node is None:
+            return REP
+        if isinstance(node, ast.Constant):
+            return AbsVal("rep", node.value)
+        if isinstance(node, ast.Name):
+            return self.lookup(node.id, frame)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            vals = [self.eval(e, frame, depth) for e in node.elts]
+            return AbsVal(
+                "shard" if any(v.var == "shard" for v in vals) else "rep",
+                UNKNOWN, any(v.draw for v in vals), tuple(vals))
+        if isinstance(node, ast.Starred):
+            return self.eval(node.value, frame, depth)
+        if isinstance(node, ast.Attribute):
+            return self.eval_attr(node, frame, depth)
+        if isinstance(node, ast.Subscript):
+            v = self.eval(node.value, frame, depth)
+            if v.elems is not None and isinstance(node.slice,
+                                                  ast.Constant) and \
+                    isinstance(node.slice.value, int) and \
+                    -len(v.elems) <= node.slice.value < len(v.elems):
+                return v.elems[node.slice.value]
+            self.eval(node.slice, frame, depth)
+            return AbsVal(v.var, UNKNOWN, v.draw,
+                          v.elems if isinstance(node.slice, ast.Slice)
+                          else None)
+        if isinstance(node, ast.BinOp):
+            lv = self.eval(node.left, frame, depth)
+            rv = self.eval(node.right, frame, depth)
+            self._maybe_draw_mix(node, lv, rv, frame)
+            out = join(lv, rv)
+            return AbsVal(out.var, _fold_binop(node.op, lv.const,
+                                               rv.const), out.draw)
+        if isinstance(node, ast.UnaryOp):
+            v = self.eval(node.operand, frame, depth)
+            if isinstance(node.op, ast.Not):
+                t = _truth(v.const)
+                return AbsVal(v.var, (not t) if t is not None else
+                              UNKNOWN, v.draw)
+            return AbsVal(v.var, UNKNOWN, v.draw)
+        if isinstance(node, ast.BoolOp):
+            vals = [self.eval(x, frame, depth) for x in node.values]
+            return join(*vals)
+        if isinstance(node, ast.Compare):
+            vals = [self.eval(node.left, frame, depth)] + \
+                [self.eval(c, frame, depth) for c in node.comparators]
+            const = _fold_compare(node, [v.const for v in vals])
+            out = join(*vals)
+            return AbsVal(out.var, const, out.draw)
+        if isinstance(node, ast.IfExp):
+            verdict, residual, _ = self.fold_test(node.test, frame, depth)
+            if verdict is True:
+                return self.eval(node.body, frame, depth)
+            if verdict is False:
+                return self.eval(node.orelse, frame, depth)
+            if residual is not None and residual in self.fatal_tests:
+                return self.eval(node.orelse, frame, depth)
+            return join(self.eval(node.body, frame, depth),
+                        self.eval(node.orelse, frame, depth))
+        if isinstance(node, ast.Call):
+            return self.eval_call(node, frame, depth)
+        if isinstance(node, ast.Lambda):
+            return AbsVal("rep", Closure(node, dict(frame.env),
+                                         frame.mod))
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            gen_frame = _Frame(frame.mod, dict(frame.env))
+            for g in node.generators:
+                it = self.eval(g.iter, gen_frame, depth)
+                self.bind(g.target,
+                          join(*it.elems) if it.elems else
+                          AbsVal(it.var, UNKNOWN, it.draw), gen_frame)
+            return self.eval(node.elt, gen_frame, depth)
+        if isinstance(node, ast.DictComp):
+            return REP
+        if isinstance(node, ast.Dict):
+            vals = [self.eval(v, frame, depth)
+                    for v in node.values if v is not None]
+            if node.keys and all(
+                    isinstance(k, ast.Constant) and
+                    isinstance(k.value, str) for k in node.keys):
+                return AbsVal("rep", _DictConst(dict(zip(
+                    (k.value for k in node.keys), vals))))
+            return join(*vals) if vals else REP
+        if isinstance(node, ast.JoinedStr):
+            return REP
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self.eval(part, frame, depth)
+            return REP
+        return REP
+
+    def lookup(self, name: str, frame) -> AbsVal:
+        if name in frame.env:
+            return frame.env[name]
+        mod = frame.mod
+        if name in mod.consts:
+            return AbsVal("rep", mod.consts[name])
+        if name in mod.funcs:
+            return AbsVal("rep", FuncRef(mod.funcs[name], mod))
+        r = self.project.resolve_import(mod, name)
+        if not isinstance(r, _Unknown):
+            return AbsVal("rep", r)
+        return REP
+
+    def eval_attr(self, node: ast.Attribute, frame, depth) -> AbsVal:
+        if node.attr in STATIC_ACCESSORS:
+            self.eval(node.value, frame, depth)
+            return REP
+        v = self.eval(node.value, frame, depth)
+        if isinstance(v.const, ModuleRef) and v.const.mod is not None:
+            target = v.const.mod
+            if node.attr in target.funcs:
+                return AbsVal("rep", FuncRef(target.funcs[node.attr],
+                                             target))
+            if node.attr in target.consts:
+                return AbsVal("rep", target.consts[node.attr])
+            return REP
+        return AbsVal(v.var, UNKNOWN, v.draw)
+
+    def _maybe_draw_mix(self, node, lv: AbsVal, rv: AbsVal, frame):
+        mix = (lv.draw and rv.var == "shard" and not rv.draw) or \
+            (rv.draw and lv.var == "shard" and not lv.draw)
+        if not mix or id(node) in self.flagged_nodes:
+            return
+        self.flagged_nodes.add(id(node))
+        self.res.pres.append(Pre(
+            "SHD003", frame.mod, node,
+            "an index-local jax.random draw combines with a "
+            "shard-variant value inside a sharded body — every shard "
+            "draws the SAME bits for its local rows, so the result "
+            "neither matches the single-device draw nor is independent "
+            "across shards (correct at N=1, silently wrong at N>1); "
+            "draw over the GLOBAL row space, or bar the config on the "
+            "sharded route with a trace-time raise"))
+
+    # -- calls -------------------------------------------------------------
+    def eval_call(self, node: ast.Call, frame, depth) -> AbsVal:
+        d = dotted_name(node.func)
+        tail = d.split(".")[-1] if d else None
+        parts = d.split(".") if d else []
+
+        # collectives
+        if tail in ALL_COLLECTIVES and self._jaxish(parts, frame, tail):
+            return self._collective(node, tail, frame, depth)
+        # jax.random samplers
+        if tail in RANDOM_SAMPLERS and len(parts) >= 2 and \
+                parts[-2] == "random":
+            vals = [self.eval(a, frame, depth) for a in node.args] + \
+                [self.eval(k.value, frame, depth) for k in node.keywords]
+            base = join(*vals) if vals else REP
+            return AbsVal(base.var, UNKNOWN, True)
+        # trace combinators with resolvable bodies
+        if tail == "scan" and self._jaxish(parts, frame, tail):
+            return self._model_scan(node, frame, depth)
+        if tail == "while_loop" and self._jaxish(parts, frame, tail):
+            return self._model_while(node, frame, depth)
+        if tail == "fori_loop" and self._jaxish(parts, frame, tail):
+            return self._model_fori(node, frame, depth)
+        if tail in ("cond", "switch") and self._jaxish(parts, frame,
+                                                       tail):
+            return self._model_cond(node, frame, depth)
+        # where(mask, x, y): the canonical mask application — a DRAWN
+        # mask selecting into shard-variant data is the same index-local
+        # bug as `x * mask`, so it must not hide behind the generic
+        # call-join (which deliberately kills draw taint)
+        if tail == "where" and len(node.args) >= 2:
+            vals = [self.eval(a, frame, depth) for a in node.args] + \
+                [self.eval(k.value, frame, depth)
+                 for k in node.keywords]
+            cond_v = vals[0]
+            if cond_v.draw and any(v.var == "shard"
+                                   for v in vals[1:]) and \
+                    id(node) not in self.flagged_nodes:
+                self.flagged_nodes.add(id(node))
+                self.res.pres.append(Pre(
+                    "SHD003", frame.mod, node,
+                    "an index-local jax.random draw selects into "
+                    "shard-variant data (jnp.where) inside a sharded "
+                    "body — every shard draws the SAME bits for its "
+                    "local rows, so the masked result neither matches "
+                    "the single-device draw nor is independent across "
+                    "shards; draw over the GLOBAL row space, or bar "
+                    "the config on the sharded route with a "
+                    "trace-time raise"))
+            base = join(*vals) if vals else REP
+            return AbsVal(base.var, UNKNOWN, base.draw)
+        # gathers re-index a table by per-row ids: a drawn TABLE gathered
+        # this way is no longer aligned to the axis it was drawn over,
+        # so the index-local-draw taint does not survive (routing local
+        # rows through a replicated drawn split table is shard-
+        # consistent — same table on every shard)
+        if tail in ("take_along_axis", "take", "gather") :
+            vals = [self.eval(a, frame, depth) for a in node.args] + \
+                [self.eval(k.value, frame, depth)
+                 for k in node.keywords]
+            base = join(*vals) if vals else REP
+            return AbsVal(base.var, UNKNOWN, False)
+        # iter/next over known tuples (the *extras idiom)
+        if tail == "iter" and len(parts) == 1 and node.args:
+            v = self.eval(node.args[0], frame, depth)
+            return AbsVal(v.var, UNKNOWN, v.draw, v.elems)
+        if tail == "next" and len(parts) == 1 and node.args:
+            v = self.eval(node.args[0], frame, depth)
+            return join(*v.elems) if v.elems else \
+                AbsVal(v.var, UNKNOWN, v.draw)
+        # list.append on a bound name: join into the binding
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("append", "extend", "insert") and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in frame.env:
+            nm = node.func.value.id
+            vals = [self.eval(a, frame, depth) for a in node.args]
+            frame.env[nm] = join(frame.env[nm], *vals)
+            return REP
+        # dict(k=v, ...) with keyword-only literals: keep the mapping so
+        # a later `**kw` expansion binds real values, not unknowns
+        if tail == "dict" and len(parts) == 1 and not node.args and \
+                node.keywords and all(k.arg is not None
+                                      for k in node.keywords):
+            return AbsVal("rep", _DictConst({
+                k.arg: self.eval(k.value, frame, depth)
+                for k in node.keywords}))
+        # partial(f, ...): carry the callable with bound prefix args
+        if tail == "partial" and node.args:
+            fval = self.eval(node.args[0], frame, depth)
+            pre = [self.eval(a, frame, depth) for a in node.args[1:]]
+            if isinstance(fval.const, (Closure, FuncRef)):
+                return AbsVal("rep", _Partial(fval.const, pre))
+            return join(fval, *pre)
+
+        fval = self.eval(node.func, frame, depth)
+        args = []
+        star_kwargs = False
+        for a in node.args:
+            v = self.eval(a, frame, depth)
+            if isinstance(a, ast.Starred):
+                if v.elems is not None:
+                    args.extend(v.elems)
+                else:
+                    args.append(v)
+                    star_kwargs = True  # arity unknown
+            else:
+                args.append(v)
+        kwargs: Dict[str, AbsVal] = {}
+        for k in node.keywords:
+            if k.arg is None:
+                v = self.eval(k.value, frame, depth)
+                if isinstance(v.const, _DictConst):
+                    kwargs.update(v.const.items)
+                else:
+                    star_kwargs = True
+            else:
+                kwargs[k.arg] = self.eval(k.value, frame, depth)
+        if isinstance(fval.const, _Partial):
+            target = fval.const
+            return self.call_value(
+                AbsVal("rep", target.fn), list(target.pre) + args,
+                kwargs, depth + 1, star_kwargs)
+        if isinstance(fval.const, (Closure, FuncRef)):
+            return self.call_value(fval, args, kwargs, depth + 1,
+                                   star_kwargs)
+        return join(fval, *args, *kwargs.values())
+
+    def _jaxish(self, parts: List[str], frame, tail: str) -> bool:
+        if len(parts) >= 2:
+            return parts[0] in _JAXISH or parts[-2] in _JAXISH or \
+                parts[-2] == "random"
+        # bare name: honored when imported from jax/lax
+        ent = frame.mod.imports.get(tail)
+        return ent is not None and ent[0] == "name" and \
+            ("jax" in ent[1] or "lax" in ent[1])
+
+    def _axis_values(self, node: ast.Call, tail: str, frame,
+                     depth) -> Set[object]:
+        """Observed axis binding(s): UNKNOWN, None, or a frozenset of
+        axis names (a tuple axis — psum(x, ('batch', 'model')) — is one
+        multi-axis reduction, folded to its name set)."""
+        pos = _AXIS_ARG_POS.get(tail, 1)
+        expr = _call_arg(node, pos, "axis_name")
+        if expr is None:
+            return {UNKNOWN}
+        v = self.eval(expr, frame, depth)
+        if isinstance(v.const, str):
+            return {frozenset({v.const})}
+        if v.const is None:
+            return {None}
+        if v.elems is not None:
+            names: Set[str] = set()
+            for e in v.elems:
+                if not isinstance(e.const, str):
+                    return {UNKNOWN}
+                names.add(e.const)
+            return {frozenset(names)}
+        return {UNKNOWN}
+
+    def _collective(self, node, tail, frame, depth) -> AbsVal:
+        vals = [self.eval(a, frame, depth) for a in node.args] + \
+            [self.eval(k.value, frame, depth) for k in node.keywords]
+        axes = self._axis_values(node, tail, frame, depth)
+        # observations are PER ENCLOSING SITE: a helper shared by a
+        # batch-bound and a model-bound shard_map must have each use
+        # judged against its own site's binding, not the union
+        rec = self.res.collectives.setdefault(
+            id(node), [frame.mod, node, tail, {}])
+        rec[3].setdefault(self.site, set()).update(axes)
+        base = join(*vals) if vals else REP
+        if tail in COLLECTIVE_REDUCE:
+            bound: Set[str] = set()
+            for a in axes:
+                if isinstance(a, frozenset):
+                    bound |= a
+            # replicated only when every SPEC-sharded axis is reduced
+            # (a psum over 'model' alone does not merge 'batch' row
+            # shards); per-axis variance is not tracked, so a value
+            # sharded over fewer axes than the site's specs may be
+            # under-credited — suppress with a justification there
+            if bound and self.site.axes <= bound:
+                return AbsVal("rep", UNKNOWN, base.draw)
+            return AbsVal(base.var, UNKNOWN, base.draw)
+        if tail in COLLECTIVE_SHARD:
+            return AbsVal("shard", UNKNOWN, base.draw)
+        # pvary/pcast: varying-manual-axes bookkeeping — identity on the
+        # VALUE, so the first argument passes through untouched (joining
+        # in the axis operand would destroy tuple-carry structure)
+        if node.args:
+            v0 = vals[0]
+            return AbsVal(v0.var, v0.const, v0.draw, v0.elems)
+        return AbsVal(base.var, UNKNOWN, base.draw, base.elems)
+
+    # -- combinator models -------------------------------------------------
+    def _model_scan(self, node, frame, depth) -> AbsVal:
+        f = self.eval(_call_arg(node, 0, "f"), frame, depth)
+        init = self.eval(_call_arg(node, 1, "init"), frame, depth)
+        xs_expr = _call_arg(node, 2, "xs")
+        xs = self.eval(xs_expr, frame, depth) if xs_expr is not None \
+            else REP
+        if not isinstance(f.const, (Closure, FuncRef, _Partial)):
+            return join(f, init, xs)
+        carry = init
+        ys: Optional[AbsVal] = None
+        for _ in range(_LOOP_PASSES):
+            res = self.call_value(f, [carry, xs], {}, depth + 1)
+            if res.elems is not None and len(res.elems) == 2:
+                new_carry, y = res.elems
+            else:
+                new_carry, y = res, res
+            carry = join(carry, new_carry)
+            ys = y if ys is None else join(ys, y)
+        return AbsVal(join(carry, ys).var, UNKNOWN,
+                      join(carry, ys).draw, (carry, ys))
+
+    def _model_while(self, node, frame, depth) -> AbsVal:
+        cond = self.eval(_call_arg(node, 0, "cond_fun"), frame, depth)
+        body = self.eval(_call_arg(node, 1, "body_fun"), frame, depth)
+        carry = self.eval(_call_arg(node, 2, "init_val"), frame, depth)
+        if isinstance(cond.const, (Closure, FuncRef)):
+            self.call_value(cond, [carry], {}, depth + 1)
+        if not isinstance(body.const, (Closure, FuncRef)):
+            return join(body, carry)
+        for _ in range(_LOOP_PASSES):
+            carry = join(carry, self.call_value(body, [carry], {},
+                                                depth + 1))
+        return carry
+
+    def _model_fori(self, node, frame, depth) -> AbsVal:
+        body = self.eval(_call_arg(node, 2, "body_fun"), frame, depth)
+        carry = self.eval(_call_arg(node, 3, "init_val"), frame, depth)
+        if not isinstance(body.const, (Closure, FuncRef)):
+            return join(body, carry)
+        for _ in range(_LOOP_PASSES):
+            carry = join(carry, self.call_value(body, [REP, carry], {},
+                                                depth + 1))
+        return carry
+
+    def _model_cond(self, node, frame, depth) -> AbsVal:
+        vals = [self.eval(a, frame, depth) for a in node.args]
+        branches = [v for v in vals[1:]
+                    if isinstance(v.const, (Closure, FuncRef))]
+        ops = [v for v in vals[1:]
+               if not isinstance(v.const, (Closure, FuncRef))]
+        if not branches:
+            return join(*vals) if vals else REP
+        outs = [self.call_value(b, ops, {}, depth + 1) for b in branches]
+        return join(*outs)
+
+
+class _Partial:
+    __slots__ = ("fn", "pre")
+
+    def __init__(self, fn, pre):
+        self.fn = fn
+        self.pre = pre
+
+
+class _DictConst:
+    """A dict literal with known string keys — the `kw = dict(depth=...,
+    axis_name=axis_name)` idiom that threads axis bindings through
+    `**kw` expansions (ops/trees._grow_tree_folds)."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items: Dict[str, AbsVal]):
+        self.items = items
+
+
+class _Frame:
+    __slots__ = ("mod", "env", "ret")
+
+    def __init__(self, mod, env):
+        self.mod = mod
+        self.env = env
+        self.ret = None
+
+
+def _join_envs(a: Dict[str, AbsVal], b: Dict[str, AbsVal]
+               ) -> Dict[str, AbsVal]:
+    out = dict(a)
+    for k, v in b.items():
+        if k in out:
+            out[k] = join(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _val_key(v: AbsVal, depth: int = 3):
+    """Memo key of an abstract value — the tuple STRUCTURE is part of
+    the key (a 4-tuple carry and a scalar must not share a summary)."""
+    elems = None
+    if v.elems is not None and depth > 0:
+        elems = tuple(_val_key(e, depth - 1) for e in v.elems)
+    return (v.var, v.draw, _const_key(v.const), elems)
+
+
+def _const_key(c):
+    # callable/module consts key on the UNDERLYING AST node (stable for
+    # the analysis lifetime) — wrapper objects are allocated per lookup
+    # and id() reuse after GC would silently collide memo entries
+    if isinstance(c, (Closure, FuncRef)):
+        return ("fn", id(c.node))
+    if isinstance(c, ModuleRef):
+        return ("mod", c.mod.path if c.mod is not None else None)
+    if isinstance(c, _Partial):
+        return ("partial", _const_key(c.fn), len(c.pre))
+    if isinstance(c, _DictConst):
+        return ("dict", tuple(sorted(c.items)))
+    if isinstance(c, _Unknown):
+        return "?"
+    try:
+        hash(c)
+        return c
+    except TypeError:
+        return ("id", id(c))
+
+
+def _truth(c):
+    if c is UNKNOWN or isinstance(c, _Unknown):
+        return None
+    if isinstance(c, (Closure, FuncRef, ModuleRef, _Partial)):
+        return True
+    try:
+        return bool(c)
+    except Exception:  # pragma: no cover - exotic consts
+        return None
+
+
+def _fold_binop(op, a, b):
+    if a is UNKNOWN or b is UNKNOWN or isinstance(a, _Unknown) or \
+            isinstance(b, _Unknown):
+        return UNKNOWN
+    try:
+        if isinstance(op, ast.Add):
+            return a + b
+        if isinstance(op, ast.Sub):
+            return a - b
+        if isinstance(op, ast.Mult):
+            return a * b
+        if isinstance(op, ast.Div):
+            return a / b
+    except Exception:
+        return UNKNOWN
+    return UNKNOWN
+
+
+def _fold_compare(node: ast.Compare, consts) -> object:
+    if len(node.ops) != 1:
+        return UNKNOWN
+    a, b = consts[0], consts[1]
+    op = node.ops[0]
+    if isinstance(op, (ast.Is, ast.IsNot)):
+        if isinstance(a, _Unknown) or isinstance(b, _Unknown):
+            return UNKNOWN
+        # the only identity tests that matter here are None checks
+        # (`axis_name is None`); equal-value immutables fold as equal
+        res = (a is b) or (a == b and type(a) is type(b))
+        return res if isinstance(op, ast.Is) else not res
+    if isinstance(a, _Unknown) or isinstance(b, _Unknown):
+        return UNKNOWN
+    try:
+        if isinstance(op, ast.Eq):
+            return a == b
+        if isinstance(op, ast.NotEq):
+            return a != b
+        if isinstance(op, ast.Lt):
+            return a < b
+        if isinstance(op, ast.LtE):
+            return a <= b
+        if isinstance(op, ast.Gt):
+            return a > b
+        if isinstance(op, ast.GtE):
+            return a >= b
+    except Exception:
+        return UNKNOWN
+    return UNKNOWN
+
+
+# -- project analysis --------------------------------------------------------
+
+class ShardAnalysis:
+    """One run over every site: finding precursors + observations."""
+
+    def __init__(self, ctxs: Sequence[LintContext]):
+        self.project = ShardProject(ctxs)
+        self.pres: List[Pre] = []
+        # id(node) -> [mod, node, tail, {axis values}]
+        self.collectives: Dict[int, List] = {}
+        self.visited_funcs: Set[int] = set()
+        self.any_incomplete = False
+        self.sites: List[Site] = []
+        self._discover_sites()
+        for site in self.sites:
+            self._analyze_site(site)
+        self._unbound_collectives(ctxs)
+
+    # -- discovery ---------------------------------------------------------
+    def _discover_sites(self) -> None:
+        for ctx in self.project.ctxs:
+            if "shard_map" not in ctx.source:
+                continue
+            mod = self.project.mod_for(ctx)
+            scopes = _ScopeWalker(mod)
+            for scope_chain, call in scopes.calls():
+                if not _is_shard_map_call(call):
+                    continue
+                core_expr = _call_arg(call, 0, "f")
+                mesh_expr = _call_arg(call, 1, "mesh")  # noqa: F841
+                in_expr = _deref_local(
+                    _call_arg(call, 2, "in_specs"), scope_chain, call)
+                out_expr = _deref_local(
+                    _call_arg(call, 3, "out_specs"), scope_chain, call)
+                core = self._resolve_core(mod, scope_chain, core_expr)
+                if core is None:
+                    continue
+                parser = _SpecParser(self.project, mod, {})
+                in_specs = parser.specs(in_expr) if in_expr is not None \
+                    else None
+                out_specs = parser.specs(out_expr) if out_expr is not None \
+                    else None
+                mesh_axes = self._mesh_axes(mod, scope_chain, mesh_expr,
+                                            call)
+                self.sites.append(Site(mod, call, core, in_specs,
+                                       out_specs,
+                                       mesh_axes=mesh_axes))
+
+    def _mesh_axes(self, mod, scope_chain, expr,
+                   call) -> Optional[FrozenSet[str]]:
+        """Full axis-name set of the site's mesh when statically
+        resolvable: `Mesh(devs, ("batch", "model"))` literals (possibly
+        through one local assignment) and calls to functions whose body
+        constructs such a Mesh (make_mesh/global_mesh). None when the
+        mesh is a parameter or otherwise opaque — shard_map binds ALL
+        mesh axes, so an unresolved mesh must not be treated as
+        binding only the spec axes."""
+        expr = _deref_local(expr, scope_chain, call)
+        if not isinstance(expr, ast.Call):
+            return None
+        d = dotted_name(expr.func)
+        tail = d.split(".")[-1] if d else ""
+        if tail == "Mesh" and len(expr.args) >= 2:
+            return self._axis_tuple(mod, expr.args[1])
+        # one level through a mesh-factory function: union of the axis
+        # tuples of every Mesh(...) it constructs
+        target = None
+        if isinstance(expr.func, ast.Name):
+            fn = mod.funcs.get(expr.func.id)
+            if fn is not None:
+                target = (mod, fn)
+            else:
+                r = self.project.resolve_import(mod, expr.func.id)
+                if isinstance(r, FuncRef):
+                    target = (r.mod, r.node)
+        if target is None:
+            return None
+        tmod, fnode = target
+        out: Set[str] = set()
+        for sub in ast.walk(fnode):
+            if isinstance(sub, ast.Call):
+                sd = dotted_name(sub.func)
+                if sd and sd.split(".")[-1] == "Mesh" and \
+                        len(sub.args) >= 2:
+                    axes = self._axis_tuple(tmod, sub.args[1])
+                    if axes is None:
+                        return None
+                    out |= axes
+        return frozenset(out) if out else None
+
+    def _axis_tuple(self, mod, node) -> Optional[FrozenSet[str]]:
+        if not isinstance(node, (ast.Tuple, ast.List)):
+            return None
+        out: Set[str] = set()
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.add(el.value)
+            elif isinstance(el, ast.Name):
+                v = self.project.resolve_const_str(mod, el.id)
+                if not isinstance(v, str):
+                    return None
+                out.add(v)
+            else:
+                return None
+        return frozenset(out)
+
+    def _resolve_core(self, mod, scope_chain, expr) -> Optional[Closure]:
+        if expr is None:
+            return None
+        if isinstance(expr, ast.Lambda):
+            return Closure(expr, {}, mod)
+        if isinstance(expr, ast.Call):
+            d = dotted_name(expr.func)
+            if d and d.split(".")[-1] == "partial" and expr.args:
+                return self._resolve_core(mod, scope_chain, expr.args[0])
+            return None
+        if isinstance(expr, ast.Name):
+            # innermost enclosing scope's nested defs first
+            for fnode in reversed(scope_chain):
+                for child in ast.walk(fnode):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)) and \
+                            child.name == expr.id:
+                        return Closure(child, {}, mod)
+            if expr.id in mod.funcs:
+                return Closure(mod.funcs[expr.id], {}, mod)
+            r = self.project.resolve_import(mod, expr.id)
+            if isinstance(r, FuncRef):
+                return Closure(r.node, {}, r.mod)
+        return None
+
+    # -- per-site ----------------------------------------------------------
+    def _analyze_site(self, site: Site) -> None:
+        interp = SiteInterp(self.project, site, self)
+        ret = interp.run()
+        self.any_incomplete = self.any_incomplete or interp.incomplete
+        self._check_shd004(site, ret)
+        if ret is not None and not interp.incomplete:
+            self._check_shd001(site, ret)
+
+    def _check_shd001(self, site: Site, ret: AbsVal) -> None:
+        outs = site.out_specs
+        if outs is None or not site.axes:
+            return
+        hint = "/".join(sorted(site.axes))
+
+        def flag(spec: SpecVal, idx: Optional[int], val: AbsVal):
+            if not spec.replicated or val.var != "shard":
+                return
+            where = f"output {idx}" if idx is not None else "the output"
+            node = spec.node if getattr(spec.node, "lineno", None) \
+                else site.call
+            self.pres.append(Pre(
+                "SHD001", site.mod, node,
+                f"out_spec claims {where} replicated but no cross-shard "
+                f"reduction on axis '{hint}' reaches it — each device "
+                f"returns its own partial value and jax keeps shard 0's "
+                f"(correct at 1 device, silently wrong at N>1); psum/"
+                f"all_gather it over '{hint}' before returning, or "
+                f"shard the out_spec"))
+
+        if outs.is_tuple and outs.rest is None and len(outs.fixed) > 1:
+            if ret.elems is not None and len(ret.elems) == \
+                    len(outs.fixed):
+                for i, (spec, val) in enumerate(zip(outs.fixed,
+                                                    ret.elems)):
+                    flag(spec, i, val)
+            else:
+                for i, spec in enumerate(outs.fixed):
+                    flag(spec, i, ret)
+        elif outs.fixed:
+            flag(outs.fixed[0], None, ret)
+
+    def _check_shd004(self, site: Site, ret: Optional[AbsVal]) -> None:
+        core = site.core.node
+        a = core.args
+        n_params = len(getattr(a, "posonlyargs", []) or []) + len(a.args)
+        # specs match the CALL's argument pytree, not the signature:
+        # defaulted params may legally go unmapped, so the floor is the
+        # required (non-defaulted) positional count
+        n_required = n_params - len(a.defaults)
+        specs = site.in_specs
+        if specs is not None and specs.known_count is not None:
+            c = specs.known_count
+            if a.vararg is None and not (n_required <= c <= n_params):
+                self.pres.append(Pre(
+                    "SHD004", site.mod, site.call,
+                    f"in_specs has {c} entr{'y' if c == 1 else 'ies'} "
+                    f"but the core function takes "
+                    f"{n_required if n_required == n_params else f'{n_required}..{n_params}'} "
+                    f"positional argument(s) — shard_map maps specs to "
+                    f"arguments positionally, so every mapped input "
+                    f"needs exactly one spec"))
+            elif a.vararg is not None and c < n_required:
+                self.pres.append(Pre(
+                    "SHD004", site.mod, site.call,
+                    f"in_specs has {c} entries but the core function "
+                    f"requires at least {n_required} positional "
+                    f"arguments"))
+        outs = site.out_specs
+        if outs is not None and outs.is_tuple and outs.rest is None and \
+                ret is not None and ret.elems is not None and \
+                len(outs.fixed) > 1 and len(ret.elems) != len(outs.fixed):
+            self.pres.append(Pre(
+                "SHD004", site.mod, site.call,
+                f"out_specs has {len(outs.fixed)} entries but the core "
+                f"returns {len(ret.elems)} value(s)"))
+        # rank: `a, b = param.shape` unpacks pin a parameter's rank;
+        # a spec with more entries than that rank cannot apply (same
+        # posonly+args param list the arity check counts)
+        if specs is not None:
+            params = [p.arg for p in
+                      (getattr(a, "posonlyargs", []) or []) + a.args]
+            ranks = _shape_unpack_ranks(core)
+            for i, spec in enumerate(specs.fixed):
+                if i >= len(params):
+                    break
+                rank = ranks.get(params[i])
+                n_entries = spec.entry_count(spec.node) if \
+                    isinstance(spec.node, ast.Call) else 0
+                if rank is not None and n_entries > rank:
+                    self.pres.append(Pre(
+                        "SHD004", site.mod, spec.node,
+                        f"in_spec for `{params[i]}` names {n_entries} "
+                        f"dimensions but the core unpacks "
+                        f"`{params[i]}.shape` into {rank} — the spec "
+                        f"cannot apply to a rank-{rank} argument"))
+
+    # -- SHD002 finalize + unbound pass ------------------------------------
+    def _unbound_collectives(self, ctxs: Sequence[LintContext]) -> None:
+        # axis-name universe: every axis a scanned mesh/spec declares
+        # (P(...) entries, resolved Mesh axis tuples, *_AXIS string
+        # constants). When a site's mesh is statically unresolvable it
+        # binds EVERY mesh axis, so only names outside the universe —
+        # plain typos — are provably unbound there.
+        universe: Set[str] = set()
+        for s in self.sites:
+            universe |= s.axes
+            if s.mesh_axes is not None:
+                universe |= s.mesh_axes
+        for ctx in self.project.ctxs:
+            mi = getattr(ctx, "_shard_module_info", None)
+            if mi is None:
+                continue
+            universe |= {v for k, v in mi.consts.items()
+                         if k.endswith("_AXIS") and isinstance(v, str)}
+        for _nid, (mod, node, tail, per_site) in \
+                self.collectives.items():
+            for site, axes in per_site.items():
+                if tail in COLLECTIVE_NEUTRAL and None in axes:
+                    axes = axes - {None}  # guarded identity is legal
+                for ax in axes:
+                    if isinstance(ax, _Unknown):
+                        continue
+                    if ax is None:
+                        self.pres.append(Pre(
+                            "SHD002", mod, node,
+                            f"`{tail}` reached the trace with "
+                            f"axis_name=None — jax rejects an unnamed "
+                            f"collective at trace time; guard the "
+                            f"single-device path (`x if axis_name is "
+                            f"None else lax.{tail}(x, axis_name)`)"))
+                        continue
+                    if not isinstance(ax, frozenset):
+                        continue
+                    if site.mesh_axes is not None:
+                        bad = ax - site.mesh_axes
+                        if bad:
+                            self.pres.append(Pre(
+                                "SHD002", mod, node,
+                                f"`{tail}` names axis "
+                                f"'{sorted(bad)[0]}' but this "
+                                f"shard_map's mesh binds "
+                                f"{sorted(site.mesh_axes)} — an "
+                                f"unbound axis name raises NameError "
+                                f"at trace time on the mesh (and "
+                                f"silently passes on meshless unit "
+                                f"tests that never trace it)"))
+                    else:
+                        # mesh unresolved: it binds every mesh axis,
+                        # so only names outside the project's axis
+                        # universe are provably wrong
+                        bad = ax - site.axes - universe
+                        if bad:
+                            self.pres.append(Pre(
+                                "SHD002", mod, node,
+                                f"`{tail}` names axis "
+                                f"'{sorted(bad)[0]}' which no mesh or "
+                                f"spec in the project declares (this "
+                                f"site's specs bind "
+                                f"{sorted(site.axes) if site.axes else 'no axes'})"
+                                f" — an unbound axis name raises "
+                                f"NameError at trace time on the "
+                                f"mesh"))
+        # collectives with a literal/constant axis in functions NEVER
+        # under any shard_map: the axis has nothing to bind to. Skipped
+        # when any site blew the interp budget — an unvisited function
+        # may simply be unanalyzed, not unreachable.
+        if self.any_incomplete:
+            return
+        seen = {nid for nid in self.collectives}
+        for ctx in ctxs:
+            if not any(c in ctx.source for c in ALL_COLLECTIVES):
+                continue
+            mod = self.project.mod_for(ctx)
+            for fnode, call in _function_calls(ctx):
+                if id(call) in seen or id(fnode) in self.visited_funcs:
+                    continue
+                d = dotted_name(call.func)
+                tail = d.split(".")[-1] if d else None
+                if tail not in ALL_COLLECTIVES or tail in \
+                        COLLECTIVE_NEUTRAL:
+                    continue
+                parts = d.split(".")
+                if len(parts) >= 2 and parts[0] not in _JAXISH and \
+                        parts[-2] not in _JAXISH:
+                    continue
+                expr = _call_arg(call, _AXIS_ARG_POS.get(tail, 1),
+                                 "axis_name")
+                ax: object = UNKNOWN
+                if isinstance(expr, ast.Constant):
+                    ax = expr.value
+                elif isinstance(expr, ast.Name):
+                    ax = self.project.resolve_const_str(mod, expr.id)
+                if isinstance(ax, str):
+                    self.pres.append(Pre(
+                        "SHD002", mod, call,
+                        f"`{tail}` names axis '{ax}' outside any "
+                        f"shard_map body — the axis is unbound and the "
+                        f"call raises NameError the first time it "
+                        f"traces on a mesh"))
+
+
+def _deref_local(expr, scope_chain, call):
+    """Follow `in_specs = (...)` one assignment back: sites commonly
+    build the spec tuple in a local before the shard_map call. Takes
+    the LAST assignment to the name above the call, innermost scope
+    first."""
+    if not isinstance(expr, ast.Name):
+        return expr
+    for fnode in reversed(scope_chain):
+        best = None
+        for node in ast.walk(fnode):
+            if isinstance(node, ast.Assign) and \
+                    node.lineno < call.lineno and \
+                    any(isinstance(t, ast.Name) and t.id == expr.id
+                        for t in node.targets):
+                if best is None or node.lineno > best.lineno:
+                    best = node
+        if best is not None:
+            return best.value
+    return expr
+
+
+def _shape_unpack_ranks(fnode) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for node in ast.walk(fnode):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], (ast.Tuple, ast.List)) and \
+                isinstance(node.value, ast.Attribute) and \
+                node.value.attr == "shape" and \
+                isinstance(node.value.value, ast.Name):
+            out[node.value.value.id] = len(node.targets[0].elts)
+    return out
+
+
+class _ScopeWalker:
+    """(enclosing def chain, Call) pairs for one module."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+
+    def calls(self):
+        def walk(node, chain):
+            for child in ast.iter_child_nodes(node):
+                new_chain = chain
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    new_chain = chain + [child]
+                if isinstance(child, ast.Call):
+                    yield chain, child
+                yield from walk(child, new_chain)
+
+        yield from walk(self.mod.ctx.tree, [])
+
+
+def _function_calls(ctx: LintContext):
+    """(enclosing FunctionDef|None, Call) pairs."""
+    def walk(node, fn):
+        for child in ast.iter_child_nodes(node):
+            new_fn = fn
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                new_fn = child
+            if isinstance(child, ast.Call):
+                yield fn, child
+            yield from walk(child, new_fn)
+
+    yield from walk(ctx.tree, None)
+
+
+_PROJECT_CACHE: Dict[Tuple, ShardAnalysis] = {}
+
+
+def shard_analysis(ctxs: Sequence[LintContext]) -> ShardAnalysis:
+    """One joined analysis per ctx sequence (all SHD rules share it).
+    The cache key is the id-TUPLE itself, not its hash — a hash
+    collision between two ctx lists must not alias their analyses."""
+    key = tuple(id(c) for c in ctxs)
+    sa = _PROJECT_CACHE.get(key)
+    if sa is None:
+        _PROJECT_CACHE.clear()  # one project at a time; no leak
+        sa = ShardAnalysis(ctxs)
+        _PROJECT_CACHE[key] = sa
+    return sa

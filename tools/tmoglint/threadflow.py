@@ -847,14 +847,14 @@ def file_threads(ctx: LintContext) -> FileThreads:
     return ft
 
 
-_PROJECT_CACHE: Dict[int, ProjectThreads] = {}
+_PROJECT_CACHE: Dict[Tuple, ProjectThreads] = {}
 
 
 def project_threads(ctxs: Sequence[LintContext]) -> ProjectThreads:
     """One joined index per ctx sequence (all THR rules share it — the
-    cross-module reachability walk is the expensive part)."""
-    key = id(ctxs) if not isinstance(ctxs, (list, tuple)) else \
-        hash(tuple(id(c) for c in ctxs))
+    cross-module reachability walk is the expensive part). Keyed by the
+    id-tuple itself, not its hash (collisions must not alias indexes)."""
+    key = tuple(id(c) for c in ctxs)
     pt = _PROJECT_CACHE.get(key)
     if pt is None:
         _PROJECT_CACHE.clear()   # one project at a time; no leak

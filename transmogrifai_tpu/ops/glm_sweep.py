@@ -66,7 +66,10 @@ Distribution: the `*_sharded` variants run the SAME cores inside a
 shard_map over the mesh `batch` axis — each shard scans its local rows,
 then every accumulator reduction psums over ICI/DCN (the Spark-shuffle /
 Rabit-allreduce slot of SURVEY §2.9); the tiny replicated solves run on
-every shard. Sharded standardization uses one-pass psum'd moments.
+every shard. Sharded standardization uses one-pass psum'd moments. The
+replicated-out_spec claims of all four sharded drivers are proved
+statically by tmoglint SHD001 (a missing psum is invisible on the
+1-device CI mesh — docs/static_analysis.md).
 
 Standardization note: the per-lane solvers standardize with the lane's own
 (fold-masked) weights; these kernels standardize ONCE with the global

@@ -40,7 +40,9 @@ Three drivers mirror the PR 3 GLM sweep architecture:
 - `fused_stats_sharded` — the SAME core under shard_map over the
   data-parallel mesh `batch` axis (parallel/mesh.build_shard_map), with
   an exact Chan merge ACROSS shards done as two tiny psum rounds, so
-  stats run where sweep data already lives, no host gather;
+  stats run where sweep data already lives, no host gather (the
+  psum-reaches-every-replicated-output contract is tmoglint-SHD001-
+  checked — it cannot fail visibly on a 1-device-per-shard CI mesh);
 - `stream_stats` — the double-buffered tileplane driver
   (parallel/tileplane.py) for datasets larger than HBM: a producer
   thread device_puts tile k+1 while the device Chan-merges tile k into

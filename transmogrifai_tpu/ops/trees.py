@@ -1416,7 +1416,11 @@ def _fit_gbt_folds_impl(Xb, y, W, key, *, n_rounds, depth, n_bins,
         # the SAME bits for its local rows — neither matching the
         # single-device mask nor independent. The sweep gate
         # (models/trees._sharded_route_ok) keeps such configs off this
-        # route; this raise is the trace-time backstop.
+        # route; this raise is the trace-time backstop, and tmoglint
+        # SHD003 enforces it at LINT time: the raise is a recorded path
+        # condition that makes the subsample draw below statically dead
+        # on the sharded route — delete this guard and the linter flags
+        # the draw before any sweep runs (tests/test_tmoglint_shd.py).
         raise ValueError("row subsample < 1.0 is not supported on the "
                          "sharded fused sweep route")
     wsum = _allreduce(W.sum(axis=1), axis_name) + EPS

@@ -47,7 +47,15 @@ def build_shard_map(core, mesh, in_specs, out_specs):
     needs: import location (jax >= 0.8 top-level), and replication
     checking off — jax 0.4.x shard_map has no replication rule for
     `while` (accumulator psums make every carry replicated by
-    construction); jax >= 0.6 renamed the knob check_rep -> check_vma."""
+    construction); jax >= 0.6 renamed the knob check_rep -> check_vma.
+
+    check_rep=False also means NOTHING at runtime verifies a replicated
+    out_spec was actually psum-merged — and at 1 device per shard (every
+    CI mesh) a forgotten psum is the identity. That contract is enforced
+    statically instead: tmoglint SHD001-SHD005 resolve every
+    build_shard_map/shard_map call site, bind the P(...) axis names, and
+    prove each replicated out_spec reduced through the body's dataflow
+    (docs/static_analysis.md)."""
     try:
         from jax import shard_map
     except ImportError:

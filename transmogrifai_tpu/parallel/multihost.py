@@ -118,6 +118,28 @@ def process_row_range(n_rows: int) -> Tuple[int, int]:
     return min(i * per, n_rows), min((i + 1) * per, n_rows)
 
 
+def fetch_global(x) -> np.ndarray:
+    """np.ndarray of a GLOBAL (possibly row-sharded) jax.Array, safe
+    under multi-process SPMD.
+
+    ``np.asarray(x)`` on a multi-host global array either raises (rows
+    living on another host are not addressable) or — worse, via
+    addressable-shard paths — silently yields only THIS host's rows, so
+    a host-side ``np.sum`` over it computes a per-host total that looks
+    global. That is the SHD005 bug class (tmoglint flags it statically:
+    docs/static_analysis.md). This helper is the documented cross-process
+    fold: single-process it is a plain ``asarray``; multi-process it
+    all-gathers the array so every host sees every row. Prefer reducing
+    ON DEVICE (psum inside the sharded program) when you only need the
+    aggregate — fetching all rows to every host is the expensive path.
+    """
+    import jax
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 def host_local_rows(local: np.ndarray, mesh, n_rows_global: int,
                     pad_value: float = 0.0):
     """Global row-sharded jax.Array from this host's local block.

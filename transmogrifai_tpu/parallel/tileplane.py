@@ -38,7 +38,10 @@ This module is the ONE pipeline those consumers now share:
 - an optional shard_map lane: the producer device_puts tiles with the
   caller-supplied shardings (parallel/mesh.batch_sharding) and the
   consumer's step runs under shard_map — stats tiles psum-merge across
-  the mesh batch axis exactly like the resident sharded driver.
+  the mesh batch axis exactly like the resident sharded driver (and
+  under the same tmoglint SHD collective-correctness gate: the lane's
+  replicated carry is only sound because each tile's cross-shard merge
+  psums before folding in — see docs/static_analysis.md).
 
 `TMOG_TILEPLANE=0` is the global kill switch: every consumer keeps its
 legacy synchronous loop behind it. `TMOG_TILE_MB` sizes tiles (default
