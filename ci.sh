@@ -889,6 +889,276 @@ PYTHONPATH="$PWD" python -m transmogrifai_tpu trace-report \
   "$FLEET_TMP/reqtrace" --requests > /dev/null
 echo "  trace-report --requests: kept traces cover their e2e walls"
 rm -rf "$FLEET_TMP"
+# retrain smoke (docs/retraining.md): the loop CLOSED end-to-end — fit v1
+# on distribution A, serve it as a monitored 1-replica fleet, pump
+# SHIFTED traffic -> the pooled /drift verdict alerts -> the controller
+# auto-triggers -> a sandboxed retrain-worker subprocess refits over the
+# labeled history (mostly the shifted slab) with the champion-config
+# narrowing + warm-seed shortcuts -> the validation gate passes (artifact
+# loads, profile rebuilt, holdout within tolerance, offline monitor CLI
+# green on a replay of the tapped triggering window) -> shadow-validate
+# -> atomic swap, all with ZERO failed requests and 0 post-warmup
+# compiles on champions -> more shifted traffic against the NEW champion
+# and the pooled drift verdict CLEARS. Then the containment pass: a
+# second (manual) cycle under TMOG_RETRAIN_FAULT=bad_artifact ends
+# QUARANTINED with its evidence while the serving champion never blinks.
+RETRAIN_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - "$RETRAIN_TMP" <<'PY'
+import csv
+import json
+import sys
+
+import numpy as np
+
+out = sys.argv[1]
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.readers.readers import ListReader
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.workflow import Workflow
+
+rng = np.random.default_rng(0)
+
+SHIFT = 4.0
+
+
+def make_rows(n, shift=0.0):
+    rows = []
+    for _ in range(n):
+        a, b = float(rng.normal(shift)), float(rng.normal())
+        rows.append({"a": a, "b": b, "y": float(a + 0.5 * b > shift)})
+    return rows
+
+
+fa = FeatureBuilder.Real("a").extract(lambda r: r.get("a")).as_predictor()
+fb = FeatureBuilder.Real("b").extract(lambda r: r.get("b")).as_predictor()
+fy = FeatureBuilder.RealNN("y").extract(lambda r: r.get("y")).as_response()
+pred = BinaryClassificationModelSelector.with_train_validation_split(
+    models_and_parameters=[(OpLogisticRegression(max_iter=10),
+                            param_grid(reg_param=[0.01]))],
+).set_input(fy, transmogrify([fa, fb])).get_output()
+Workflow().set_reader(ListReader(make_rows(400))) \
+    .set_result_features(pred).train().save(out + "/model")
+
+# labeled history for the refit: a thin slab of the ORIGINAL
+# distribution plus a thick slab of the SHIFTED one (the label feed
+# caught up with the new world) — the candidate's rebuilt profile must
+# cover the shifted traffic or the replay gate will refuse it
+with open(out + "/history.csv", "w", newline="") as f:
+    w = csv.DictWriter(f, fieldnames=["a", "b", "y"])
+    w.writeheader()
+    for r in make_rows(40) + make_rows(600, shift=SHIFT):
+        w.writerow(r)
+
+# the refit recipe next to the model: the builder module + retrain.json
+with open(out + "/retrain_builder_ci.py", "w") as f:
+    f.write('''
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.workflow import Workflow
+
+
+def build():
+    fa = FeatureBuilder.Real("a").extract(
+        lambda r: r.get("a")).as_predictor()
+    fb = FeatureBuilder.Real("b").extract(
+        lambda r: r.get("b")).as_predictor()
+    fy = FeatureBuilder.RealNN("y").extract(
+        lambda r: r.get("y")).as_response()
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[(OpLogisticRegression(max_iter=10),
+                                param_grid(reg_param=[0.01, 0.1]))],
+    ).set_input(fy, transmogrify([fa, fb])).get_output()
+    return Workflow().set_result_features(pred)
+''')
+with open(out + "/model/retrain.json", "w") as f:
+    json.dump({"builder": "retrain_builder_ci:build",
+               "builder_path": out,
+               "history": [out + "/history.csv"],
+               "holdout_fraction": 0.2, "seed": 7,
+               "fraction": 1.0, "min_shadow": 12, "replicas": 1}, f)
+print("retrain smoke: v1 + history + recipe ready")
+PY
+JAX_PLATFORMS=cpu TMOG_COMPILE_CACHE_DIR="$RETRAIN_TMP/cache" \
+  PYTHONPATH="$PWD" python -m transmogrifai_tpu serve "$RETRAIN_TMP/model" \
+  --prewarm-only --max-batch 16
+JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python - "$RETRAIN_TMP" <<'PY'
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+tmp = sys.argv[1]
+from transmogrifai_tpu.fleet import (HealthProber, RolloutManager, Router,
+                                     Supervisor)
+from transmogrifai_tpu.fleet.frontend import FleetFrontend
+from transmogrifai_tpu.monitor.alerts import DriftPolicy
+from transmogrifai_tpu.monitor.profile import ReferenceProfile
+from transmogrifai_tpu.retrain import RetrainController, RetrainPolicy
+from transmogrifai_tpu.utils.metrics import collector
+from transmogrifai_tpu.workflow.io import (load_monitor_profile,
+                                           model_content_hash)
+
+v1 = tmp + "/model"
+v1_hash = model_content_hash(v1)
+env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": os.getcwd(),
+       "TMOG_COMPILE_CACHE_DIR": tmp + "/cache"}
+collector.enable("ci_retrain")
+collector.attach_event_log(tmp + "/retrain_events.jsonl")
+lock = threading.RLock()
+sup = Supervisor(v1, replicas=1, lock=lock, metrics_root=tmp + "/fleet",
+                 serve_args=["--max-batch", "16", "--max-wait-ms", "2",
+                             "--monitor", "auto",
+                             "--monitor-window-rows", "256"],
+                 env=env, backoff_base_s=0.2, startup_timeout_s=300.0)
+router = Router(lock, request_timeout=60.0)
+router.set_champions(sup.start())
+prober = HealthProber(router, interval_s=0.25).start()
+# RELAXED shadow-verdict comparison: a candidate that LEARNED the shift
+# scores the shifted traffic differently from the stale champion BY
+# DESIGN (docs/retraining.md — the recipe's rollout_* overrides are the
+# production spelling of exactly this). max_pred_js sits ABOVE the JS
+# saturation point (1.0 on disjoint support): the stale champion scores
+# every shifted row ~1.0 while the adapted candidate spreads, so with a
+# small min_shadow the two calibration histograms can be fully disjoint
+# and any threshold < 1 would flake on shadow-pair timing.
+rollout = RolloutManager(sup, router, lock=lock, max_pred_js=1.5,
+                         max_psi=50.0, max_score_shift=0.95)
+profile = ReferenceProfile.from_json(load_monitor_profile(v1))
+assert profile.model_hash == v1_hash, "profile must stamp the model hash"
+fe = FleetFrontend(sup, router, rollout, profile=profile,
+                   policy=DriftPolicy())
+ctl = RetrainController(
+    lambda: router.champions[0].model_dir if router.champions else None,
+    root=tmp + "/retrain", rollout=rollout,
+    policy=RetrainPolicy(min_interval_s=1.0, fit_attempts=2,
+                         fit_timeout_s=420.0, rollout_timeout_s=300.0,
+                         rollout_fraction=1.0, rollout_min_shadow=12,
+                         require_monitor_green=True),
+    drift_poll=fe.drift, drift_poll_interval_s=1.0, env=env)
+fe.retrain = ctl
+ctl.start()
+
+rng = np.random.default_rng(7)
+errors = []
+stop_pump = threading.Event()
+
+
+def pump():
+    while not stop_pump.is_set():
+        rec = {"a": float(rng.normal(4.0)), "b": float(rng.normal())}
+        try:
+            fe.submit(rec)
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+        time.sleep(0.01)
+
+
+pumps = [threading.Thread(target=pump, daemon=True) for _ in range(3)]
+for t in pumps:
+    t.start()
+
+# shifted traffic -> pooled alert -> trigger -> refit -> gate -> shadow
+# -> swap. Generous deadline: the worker is a REAL subprocess fit.
+deadline = time.monotonic() + 600
+while time.monotonic() < deadline and ctl.swapped_total == 0:
+    if ctl.quarantined_total:
+        raise AssertionError(f"cycle quarantined instead of swapping: "
+                             f"{ctl.last_verdict}")
+    time.sleep(0.5)
+assert ctl.swapped_total == 1, \
+    f"no swap within deadline: {ctl.status()}"
+assert not errors, errors[:5]  # zero failed requests through the cycle
+
+new_champ = router.champions[0].model_dir
+assert new_champ != v1, "champion dir did not change"
+assert model_content_hash(new_champ) != v1_hash
+report = (ctl.last_verdict or {}).get("report") or {}
+assert report.get("narrowed") and report.get("warm_seeded"), report
+m = fe.metrics()
+assert m["post_warmup_compiles"] == 0, m["post_warmup_compiles"]
+
+# drift CLEARS on the new champion: more shifted traffic, judged
+# against the NEW champion's own rebuilt profile (window size 256 keeps
+# the pooled sample big enough that JS sampling noise cannot alert)
+t_clear = time.monotonic() + 90
+cleared = None
+while time.monotonic() < t_clear:
+    d = fe.drift()
+    if d and d["rows_pooled"] >= 128:
+        cleared = d
+        break
+    time.sleep(0.5)
+assert cleared is not None, "no pooled window on the new champion"
+assert not cleared["alerting"], cleared["pooled"]["alerts"]
+assert cleared["pooled"]["model_content_hash"] == \
+    model_content_hash(new_champ)
+print(f"retrain smoke: auto cycle swapped ({report['metric']} "
+      f"candidate={report['candidate_metric']:.3f} vs champion="
+      f"{report['champion_metric']:.3f}), drift cleared on the new "
+      f"champion over {cleared['rows_pooled']:.0f} pooled rows")
+
+# ---- containment pass: bad_artifact fault, champion never blinks ----
+os.environ["TMOG_RETRAIN_FAULT"] = "bad_artifact"
+ctl2 = RetrainController(
+    lambda: router.champions[0].model_dir if router.champions else None,
+    root=tmp + "/retrain_fault", rollout=rollout,
+    policy=RetrainPolicy(min_interval_s=0.0, fit_attempts=2,
+                         fit_timeout_s=420.0,
+                         require_monitor_green=True),
+    recipe={"builder": "retrain_builder_ci:build", "builder_path": tmp,
+            "history": [tmp + "/history.csv"]},
+    env=dict(env, TMOG_RETRAIN_FAULT="bad_artifact"))
+champ_before = router.champions[0].model_dir
+n_req_before = router.n_requests
+ctl2.trigger(reason="manual")
+deadline = time.monotonic() + 600
+while time.monotonic() < deadline and ctl2.quarantined_total == 0:
+    assert ctl2.swapped_total == 0, "corrupt artifact must NEVER swap"
+    time.sleep(0.5)
+assert ctl2.quarantined_total == 1, ctl2.status()
+q = ctl2.quarantine_list()
+assert len(q) == 1 and "unloadable" in q[0]["reason"], q
+assert os.path.isdir(q[0]["dir"]), "quarantine evidence missing"
+assert os.path.exists(os.path.join(q[0]["dir"], "candidate",
+                                   "op-model.json")), "evidence lost"
+assert router.champions[0].model_dir == champ_before, \
+    "fault pass touched the champion"
+stop_pump.set()
+for t in pumps:
+    t.join(30)
+assert not errors, errors[:5]  # zero failed requests through the fault
+assert router.n_requests > n_req_before, "traffic kept flowing"
+m = fe.metrics()
+assert m["post_warmup_compiles"] == 0, m["post_warmup_compiles"]
+ctl2.close()
+ctl.close()
+prober.stop()
+sup.stop(router=router)
+fe.close()
+collector.detach_event_log()
+collector.disable()
+
+ev = [json.loads(l) for l in open(tmp + "/retrain_events.jsonl")]
+names = [e["event"] for e in ev]
+for needed in ("retrain_triggered", "retrain_fit_started",
+               "retrain_candidate_ready", "retrain_rollout_started",
+               "retrain_swapped", "fleet_rollout_swapped",
+               "retrain_validation_failed", "retrain_quarantined"):
+    assert needed in names, (needed, sorted(set(names)))
+print("retrain smoke ok: drift->refit->gate->shadow->swap with 0 failed "
+      "requests, then bad_artifact QUARANTINED with evidence while the "
+      "champion served on")
+PY
+rm -rf "$RETRAIN_TMP"
 # tree-sweep smoke on the 2-device CPU mesh: the mesh-sharded fused sweep
 # (TMOG_GRID_FUSE=1 + a mesh validator) must take the
 # mask_folds:grid_fused_sharded route, match the meshless fused kernel's

@@ -1,0 +1,1830 @@
+"""Drift-triggered continuous retraining (retrain/, docs/retraining.md).
+
+Fast tier: the RetrainController state machine against FAKE launchers
+and rollout managers (every transition; every injected fault class ends
+QUARANTINED/COOLDOWN with the champion byte-untouched and the rollout
+never started), journal crash-resume (a handcrafted journal killed
+between each pair of adjacent states resumes with exactly one rollout),
+trigger debounce (window_id dedupe, stale-model hash, cooldown, storm
+breaker), EventLog.follow across size rotation, the drift_alert payload
+regression (window_id + model_content_hash), the across-time GLM warm
+seed, the refit worker run in-process with a real tiny model, and the
+fleet HTTP surface (POST /retrain 409 mirror of RolloutConflict).
+"""
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transmogrifai_tpu.retrain import refit as RF
+from transmogrifai_tpu.retrain.controller import (COOLDOWN, FITTING,
+                                                  QUARANTINED,
+                                                  ROLLING_OUT, TRIGGERED,
+                                                  VALIDATING,
+                                                  RetrainConflict,
+                                                  RetrainController,
+                                                  RetrainPolicy)
+from transmogrifai_tpu.retrain.journal import RetrainJournal
+from transmogrifai_tpu.utils.tracing import EventLog, follow_events
+from transmogrifai_tpu.workflow.io import model_content_hash
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _wait(cond, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------------------
+# shared tiny champion model (real artifact: the validation gate LOADS it)
+# ---------------------------------------------------------------------------
+
+def _make_rows(n, seed=0, shift=0.0):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        a, b = float(rng.normal(shift)), float(rng.normal())
+        rows.append({"a": a, "b": b, "y": float(a + 0.5 * b > shift)})
+    return rows
+
+
+def _fit_and_save(rows, out_dir):
+    from transmogrifai_tpu import FeatureBuilder
+    from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+    from transmogrifai_tpu.automl.transmogrifier import transmogrify
+    from transmogrifai_tpu.models.glm import OpLogisticRegression
+    from transmogrifai_tpu.readers.readers import ListReader
+    from transmogrifai_tpu.stages.params import param_grid
+    from transmogrifai_tpu.workflow import Workflow
+
+    fa = FeatureBuilder.Real("a").extract(
+        lambda r: r.get("a")).as_predictor()
+    fb = FeatureBuilder.Real("b").extract(
+        lambda r: r.get("b")).as_predictor()
+    fy = FeatureBuilder.RealNN("y").extract(
+        lambda r: r.get("y")).as_response()
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[(OpLogisticRegression(max_iter=10),
+                                param_grid(reg_param=[0.01]))],
+    ).set_input(fy, transmogrify([fa, fb])).get_output()
+    model = Workflow().set_reader(ListReader(rows)) \
+        .set_result_features(pred).train()
+    model.save(out_dir)
+    return model
+
+
+@pytest.fixture(scope="module")
+def champion(tmp_path_factory):
+    d = tmp_path_factory.mktemp("retrain_champion")
+    out = str(d / "model")
+    _fit_and_save(_make_rows(300, seed=0), out)
+    return out
+
+
+def _dir_hashes(path):
+    out = {}
+    for name in sorted(os.listdir(path)):
+        p = os.path.join(path, name)
+        if os.path.isfile(p):
+            with open(p, "rb") as fh:
+                out[name] = hash(fh.read())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# fakes
+# ---------------------------------------------------------------------------
+
+class FakeProc:
+    def __init__(self, rc=0, hang=False):
+        self._lk = threading.Lock()
+        self._rc = rc
+        self.hang = hang
+        self.killed = False
+
+    def poll(self):
+        with self._lk:
+            if self.hang and not self.killed:
+                return None
+            return -9 if self.killed else self._rc
+
+    def wait(self, timeout=None):
+        return self.poll()
+
+    def kill(self):
+        with self._lk:
+            self.killed = True
+
+
+class FakeLauncher:
+    """Per-attempt worker behaviors, producing real candidate artifacts
+    (the gate loads them) without a subprocess."""
+
+    def __init__(self, champion_dir, behaviors=("ok",),
+                 cand_metric=0.9, champ_metric=0.9):
+        self.champion_dir = champion_dir
+        self.behaviors = list(behaviors)
+        self.cand_metric = cand_metric
+        self.champ_metric = champ_metric
+        self.calls = 0
+        self.block = None  # threading.Event -> hold the "worker" open
+
+    def _write_candidate(self, spec, corrupt=False, no_monitor=False,
+                         metric=None):
+        out = spec.out_dir
+        if os.path.isdir(out):
+            shutil.rmtree(out)
+        shutil.copytree(self.champion_dir, out)
+        for extra in ("serve.json",):
+            p = os.path.join(out, extra)
+            if os.path.exists(p):
+                os.remove(p)
+        if corrupt:
+            with open(os.path.join(out, "op-model.json"), "w") as fh:
+                fh.write("{corrupt")
+        if no_monitor:
+            os.remove(os.path.join(out, "monitor.json"))
+        report = {
+            "candidate_hash": model_content_hash(out),
+            "champion_hash": model_content_hash(spec.champion_dir),
+            "metric": "au_pr", "metric_larger_better": True,
+            "candidate_metric": (self.cand_metric if metric is None
+                                 else metric),
+            "champion_metric": self.champ_metric,
+            "train_rows": 100, "holdout_rows": 20,
+        }
+        with open(os.path.join(out, RF.REPORT_JSON), "w") as fh:
+            json.dump(report, fh)
+
+    def __call__(self, spec_path):
+        spec = RF.RefitSpec.load(spec_path)
+        b = self.behaviors[min(self.calls, len(self.behaviors) - 1)]
+        self.calls += 1
+        if self.block is not None:
+            self.block.wait(30.0)
+        if b == "ok":
+            self._write_candidate(spec)
+            return FakeProc(0)
+        if b == "crash":
+            return FakeProc(13)
+        if b == "hang":
+            return FakeProc(hang=True)
+        if b == "bad_artifact":
+            self._write_candidate(spec, corrupt=True)
+            return FakeProc(0)
+        if b == "no_monitor":
+            self._write_candidate(spec, no_monitor=True)
+            return FakeProc(0)
+        if b == "low_metric":
+            self._write_candidate(spec, metric=0.1)
+            return FakeProc(0)
+        raise AssertionError(f"unknown behavior {b}")
+
+
+class FakeRollout:
+    def __init__(self, outcome="swapped", delay=0.0):
+        self._lk = threading.Lock()
+        self.outcome = outcome
+        self.delay = delay
+        self.start_calls = []
+        self.aborted = 0
+        self._state = "idle"
+        self._t0 = None
+        self.last_verdict = None
+
+    def start(self, model_dir, fraction=0.2, min_shadow=64,
+              replicas=None, **kw):
+        with self._lk:
+            self.start_calls.append(model_dir)
+            self.start_kwargs = dict(kw, fraction=fraction,
+                                     min_shadow=min_shadow)
+            self._state = "shadow"
+            self._t0 = time.monotonic()
+            return {"state": self._state}
+
+    def status(self):
+        with self._lk:
+            if self._state == "shadow" and \
+                    time.monotonic() - self._t0 >= self.delay:
+                self._state = self.outcome
+                self.last_verdict = {
+                    "clean": self.outcome == "swapped",
+                    "reasons": [] if self.outcome == "swapped"
+                    else ["score_shift 0.5 > 0.2"]}
+            return {"state": self._state,
+                    "last_verdict": self.last_verdict}
+
+    def abort(self):
+        with self._lk:
+            self.aborted += 1
+            self._state = "rejected"
+            # mirror RolloutManager.abort's operator marker — the
+            # controller tells "failed at traffic" from "aborted" by it
+            self.last_verdict = {"clean": False, "reasons": ["aborted"],
+                                 "aborted": True}
+
+    def set_delay(self, v):
+        with self._lk:  # status() reads delay under this lock
+            self.delay = v
+
+
+def _controller(champion_dir, root, launcher, rollout,
+                cls=RetrainController, recipe="default", **policy_kw):
+    kw = dict(min_interval_s=0.0, storm_window_s=3600.0,
+              max_retrains_per_window=100, fit_timeout_s=5.0,
+              fit_attempts=2, backoff_base_s=0.01, backoff_cap_s=0.02,
+              metric_tolerance=0.02, require_monitor_green=False,
+              rollout_timeout_s=10.0,
+              # in-process artifact probe: the fake-driven suite stays
+              # fast; the sandboxed child path has its own test + the
+              # ci.sh fault smoke
+              sandbox_load_probe=False)
+    kw.update(policy_kw)
+    if recipe == "default":
+        recipe = {"builder": "nope:nope", "history": []}
+    return cls(
+        lambda: champion_dir, root=str(root), rollout=rollout,
+        policy=RetrainPolicy(**kw), recipe=recipe, launcher=launcher)
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+class TestJournal:
+    def test_roundtrip_and_seq_continues_on_reopen(self, tmp_path):
+        p = str(tmp_path / "j" / "journal.jsonl")
+        j = RetrainJournal(p)
+        j.append("c1", TRIGGERED, cycle_dir="/x")
+        j.append("c1", FITTING, attempt=1)
+        j.close()
+        j2 = RetrainJournal(p)
+        j2.append("c1", VALIDATING)
+        recs = j2.records()
+        assert [r["seq"] for r in recs] == [0, 1, 2]
+        assert [r["state"] for r in recs] == [TRIGGERED, FITTING,
+                                              VALIDATING]
+        cid, crecs = j2.last_cycle()
+        assert cid == "c1" and len(crecs) == 3
+        j2.close()
+
+    def test_torn_last_line_skipped(self, tmp_path):
+        p = str(tmp_path / "journal.jsonl")
+        j = RetrainJournal(p)
+        j.append("c1", TRIGGERED)
+        j.append("c1", FITTING)
+        j.close()
+        with open(p, "a") as fh:
+            fh.write('{"seq": 2, "cycle": "c1", "state": "valid')  # torn
+        j2 = RetrainJournal(p)
+        recs = j2.records()
+        assert [r["state"] for r in recs] == [TRIGGERED, FITTING]
+        # a new append continues past the torn line's seq space cleanly
+        j2.append("c1", VALIDATING)
+        assert j2.records()[-1]["seq"] == 2
+        j2.close()
+
+    def test_last_cycle_picks_latest(self, tmp_path):
+        j = RetrainJournal(str(tmp_path / "journal.jsonl"))
+        j.append("c1", TRIGGERED)
+        j.append("c1", COOLDOWN)
+        j.append("c2", TRIGGERED)
+        cid, recs = j.last_cycle()
+        assert cid == "c2" and len(recs) == 1
+        j.close()
+
+
+# ---------------------------------------------------------------------------
+# EventLog.follow (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestFollowEvents:
+    def _collect(self, path, n, from_start=True, timeout=10.0):
+        stop = threading.Event()
+        got = []
+        gen = follow_events(path, stop=stop, poll_s=0.01,
+                            from_start=from_start)
+        deadline = time.monotonic() + timeout
+        for rec in gen:
+            got.append(rec)
+            if len(got) >= n:
+                stop.set()
+            if time.monotonic() > deadline:
+                stop.set()
+        return got
+
+    def test_follow_yields_existing_and_new(self, tmp_path):
+        p = str(tmp_path / "events.jsonl")
+        log = EventLog(p, max_mb=0)
+        for i in range(5):
+            log.emit("tick", i=i)
+        got = self._collect(p, 5)
+        assert [r["i"] for r in got] == list(range(5))
+        log.close()
+
+    def test_follow_across_rotation_is_seq_monotone(self, tmp_path):
+        p = str(tmp_path / "events.jsonl")
+        # ~1KB threshold: many rotations over 120 events (keep is
+        # generous so no segment drops — drop semantics are tail -f's)
+        log = EventLog(p, max_mb=0.001, keep=40)
+        for i in range(120):
+            log.emit("tick", i=i, pad="x" * 60)
+        assert log.rotations > 0
+        got = self._collect(p, 120)
+        seqs = [r["seq"] for r in got]
+        assert seqs == sorted(seqs)
+        assert len(set(seqs)) == len(seqs) == 120  # exactly once each
+        assert [r["i"] for r in got] == list(range(120))
+        log.close()
+
+    def test_follow_live_rotation_mid_stream(self, tmp_path):
+        """Events emitted WHILE following, with rotations happening
+        between polls, arrive exactly once and in order."""
+        p = str(tmp_path / "events.jsonl")
+        log = EventLog(p, max_mb=0.001, keep=40)
+        stop = threading.Event()
+        got = []
+
+        def consume():
+            for rec in follow_events(p, stop=stop, poll_s=0.005,
+                                     from_start=True):
+                got.append(rec)
+                if len(got) >= 80:
+                    stop.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        for i in range(80):
+            log.emit("tick", i=i, pad="y" * 60)
+            if i % 7 == 0:
+                time.sleep(0.01)
+        t.join(15.0)
+        stop.set()
+        assert not t.is_alive()
+        assert [r["i"] for r in got] == list(range(80))
+        assert log.rotations > 0
+        log.close()
+
+    def test_follow_truncate_in_place_rescans(self, tmp_path):
+        """logrotate-copytruncate semantics: the file is truncated
+        UNDER the follower with its inode intact, leaving the byte
+        offset past the new EOF — that must trigger the same rescan a
+        replaced inode does, not wedge the tail forever."""
+        p = str(tmp_path / "events.jsonl")
+        log = EventLog(p, max_mb=0)
+        for i in range(3):
+            log.emit("tick", i=i)
+        stop = threading.Event()
+        got = []
+
+        def consume():
+            for rec in follow_events(p, stop=stop, poll_s=0.005,
+                                     from_start=True):
+                got.append(rec)
+                if len(got) >= 6:
+                    stop.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        _wait(lambda: len(got) == 3, msg="pre-truncate tail")
+        with open(p, "r+", encoding="utf-8") as fh:
+            fh.truncate(0)  # same inode, size 0
+        # the shrink must be OBSERVABLE at a poll boundary (tail -F's
+        # contract too): give the follower a few polls before the log
+        # refills past the stale offset
+        time.sleep(0.05)
+        for i in range(3, 6):
+            # the writer's append-mode handle lands at the new EOF and
+            # seq keeps growing, so the rescan's seq filter still holds
+            log.emit("tick", i=i)
+        t.join(15.0)
+        stop.set()
+        assert not t.is_alive()
+        assert [r["i"] for r in got] == list(range(6))
+        log.close()
+
+    def test_from_start_false_skips_history(self, tmp_path):
+        p = str(tmp_path / "events.jsonl")
+        log = EventLog(p, max_mb=0)
+        log.emit("old", i=0)
+        stop = threading.Event()
+        got = []
+
+        def consume():
+            for rec in follow_events(p, stop=stop, poll_s=0.01,
+                                     from_start=False):
+                got.append(rec)
+                stop.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        log.emit("new", i=1)
+        t.join(10.0)
+        assert [r["event"] for r in got] == ["new"]
+        log.close()
+
+
+# ---------------------------------------------------------------------------
+# drift_alert payload: window_id + model_content_hash (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestAlertPayload:
+    def test_profile_stamps_model_hash(self, champion):
+        from transmogrifai_tpu.monitor.profile import ReferenceProfile
+        from transmogrifai_tpu.workflow.io import load_monitor_profile
+        prof = ReferenceProfile.from_json(load_monitor_profile(champion))
+        assert prof.model_hash == model_content_hash(champion)
+        # roundtrip preserves it
+        prof2 = ReferenceProfile.from_json(prof.to_json())
+        assert prof2.model_hash == prof.model_hash
+
+    def _profile(self):
+        from transmogrifai_tpu.monitor.profile import (FeatureProfile,
+                                                       ReferenceProfile)
+        return ReferenceProfile(
+            bins=8, rows=100.0, model_hash="abc123",
+            features=[FeatureProfile(
+                name="a", kind="numeric", count=100.0, nulls=0.0,
+                hist=[12.5] * 8, lo=0.0, hi=1.0)])
+
+    def test_alerts_of_one_window_share_a_stable_window_id(self):
+        from transmogrifai_tpu.monitor.window import ServeMonitor
+        prof = self._profile()
+        mon = ServeMonitor(prof, window_rows=64, window_seconds=1e9)
+        # shifted mass: everything in the top bin -> JS + PSI alerts
+        X = np.full((64, 1), 0.99, np.float32)
+        mon.observe_numeric(X, np.ones(64, np.float32))
+        mon.add_rows(64)
+        rep = mon.last_report
+        assert rep is not None and rep["alerts"]
+        assert rep["window_id"].startswith("abc123:")
+        assert rep["window_id"].endswith(":w0")
+        assert rep["model_content_hash"] == "abc123"
+        # a second monitor over the same profile mints a DIFFERENT
+        # window id for ITS window 0 (replicas must not dedupe away
+        # each other's alerts)
+        mon2 = ServeMonitor(prof, window_rows=64, window_seconds=1e9)
+        assert mon2.window_id(0) != mon.window_id(0)
+
+    def test_pooled_fleet_drift_carries_identity(self):
+        from transmogrifai_tpu.fleet import telemetry as FT
+        from transmogrifai_tpu.monitor.window import ServeMonitor
+        prof = self._profile()
+        mon = ServeMonitor(prof, window_rows=10 ** 9, window_seconds=1e9)
+        X = np.full((40, 1), 0.99, np.float32)
+        mon.observe_numeric(X, np.ones(40, np.float32))
+        mon.add_rows(40)
+        pooled = FT.fleet_drift(prof, [mon.window_state()])
+        # <model_hash>:fleet-<monitor-nonce digest>:w<index> — the tag
+        # keeps a restarted replica's (or fleet's) pooled "w0" from
+        # colliding with dedupe/quarantine state recorded against a
+        # previous incarnation's windows
+        wid = pooled["pooled"]["window_id"]
+        assert wid.startswith("abc123:fleet-") and wid.endswith(":w0")
+        assert pooled["pooled"]["model_content_hash"] == "abc123"
+        # deterministic across polls of the same open window
+        pooled2 = FT.fleet_drift(prof, [mon.window_state()])
+        assert pooled2["pooled"]["window_id"] == wid
+        # a restarted replica = a FRESH monitor = a new id namespace,
+        # even though its window_index restarts at the same 0
+        mon2 = ServeMonitor(prof, window_rows=10 ** 9,
+                            window_seconds=1e9)
+        mon2.add_rows(1)
+        pooled3 = FT.fleet_drift(prof, [mon2.window_state()])
+        assert pooled3["pooled"]["window_id"] != wid
+        assert pooled3["pooled"]["window_id"].endswith(":w0")
+
+    def test_double_trigger_regression(self, champion, tmp_path):
+        """THE regression: two alerts for one window start ONE cycle."""
+        launcher = FakeLauncher(champion)
+        launcher.block = threading.Event()  # hold the cycle in FITTING
+        ro = FakeRollout()
+        ctl = _controller(champion, tmp_path / "r", launcher, ro)
+        alert = {"window_id": "h:m:w3", "target": "a", "metric": "js",
+                 "model_content_hash": model_content_hash(champion)}
+        try:
+            assert ctl.handle_alert(dict(alert)) is None  # triggered
+            assert ctl.handle_alert(dict(alert)) == "duplicate"
+            # same window, different feature -> the cycle is busy, not
+            # a second trigger
+            other = dict(alert, target="b")
+            assert ctl.handle_alert(other) == "busy"
+            assert ctl.cycles_total == 1
+        finally:
+            launcher.block.set()
+            _wait(lambda: ctl.effective_state() == "idle", msg="cycle")
+            ctl.close()
+
+    def test_stale_model_alert_ignored(self, champion, tmp_path):
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), FakeRollout())
+        stale = {"window_id": "h:m:w9", "target": "a", "metric": "js",
+                 "model_content_hash": "deadbeefdeadbeef"}
+        try:
+            assert ctl.handle_alert(stale) == "stale_model"
+            assert ctl.cycles_total == 0
+        finally:
+            ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# controller state machine vs fakes
+# ---------------------------------------------------------------------------
+
+class TestControllerStateMachine:
+    def test_happy_path_swaps(self, champion, tmp_path):
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout(outcome="swapped")
+        ctl = _controller(champion, tmp_path / "r", launcher, ro)
+        try:
+            ctl.trigger(reason="manual")
+            _wait(lambda: ctl.swapped_total == 1, msg="swap")
+            assert ro.start_calls and launcher.calls == 1
+            states = [r["state"] for r in ctl.journal.records()]
+            assert states == [TRIGGERED, FITTING, VALIDATING,
+                              ROLLING_OUT, COOLDOWN]
+            assert ctl.last_verdict["outcome"] == "swapped"
+            assert ctl.quarantined_total == 0
+        finally:
+            ctl.close()
+
+    def test_concurrent_trigger_conflicts(self, champion, tmp_path):
+        launcher = FakeLauncher(champion)
+        launcher.block = threading.Event()
+        ctl = _controller(champion, tmp_path / "r", launcher,
+                          FakeRollout())
+        try:
+            ctl.trigger()
+            with pytest.raises(RetrainConflict):
+                ctl.trigger()
+        finally:
+            launcher.block.set()
+            _wait(lambda: ctl.effective_state() == "idle", msg="cycle")
+            ctl.close()
+
+    def _assert_contained(self, ctl, ro, champion, pre_hashes, reason):
+        assert ctl.quarantined_total == 1
+        assert ro.start_calls == [], "rollout must never see the " \
+                                     "candidate"
+        assert _dir_hashes(champion) == pre_hashes, "champion touched!"
+        q = ctl.quarantine_list()
+        assert len(q) == 1 and reason in q[0]["reason"]
+        assert os.path.isdir(q[0]["dir"]), "evidence dir missing"
+        states = [r["state"] for r in ctl.journal.records()]
+        assert states[-2:] == [QUARANTINED, COOLDOWN]
+
+    def test_fit_crash_retries_then_quarantines(self, champion,
+                                                tmp_path):
+        launcher = FakeLauncher(champion, behaviors=("crash",))
+        ro = FakeRollout()
+        pre = _dir_hashes(champion)
+        ctl = _controller(champion, tmp_path / "r", launcher, ro,
+                          fit_attempts=3)
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.quarantined_total == 1, msg="quarantine")
+            assert launcher.calls == 3  # bounded retries, then stop
+            self._assert_contained(ctl, ro, champion, pre, "fit_failed")
+            assert "fit_crash rc=13" in ctl.quarantine_list()[0]["reason"]
+        finally:
+            ctl.close()
+
+    def test_fit_hang_killed_at_timeout(self, champion, tmp_path):
+        launcher = FakeLauncher(champion, behaviors=("hang",))
+        ro = FakeRollout()
+        pre = _dir_hashes(champion)
+        ctl = _controller(champion, tmp_path / "r", launcher, ro,
+                          fit_timeout_s=0.3, fit_attempts=2)
+        try:
+            t0 = time.monotonic()
+            ctl.trigger()
+            _wait(lambda: ctl.quarantined_total == 1, msg="quarantine")
+            assert time.monotonic() - t0 < 5.0, "timeout not enforced"
+            self._assert_contained(ctl, ro, champion, pre, "fit_failed")
+            assert "fit_timeout" in ctl.quarantine_list()[0]["reason"]
+        finally:
+            ctl.close()
+
+    def test_bad_artifact_fails_validation(self, champion, tmp_path):
+        launcher = FakeLauncher(champion, behaviors=("bad_artifact",))
+        ro = FakeRollout()
+        pre = _dir_hashes(champion)
+        ctl = _controller(champion, tmp_path / "r", launcher, ro)
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.quarantined_total == 1, msg="quarantine")
+            self._assert_contained(ctl, ro, champion, pre,
+                                   "validation_failed")
+            assert "unloadable" in ctl.quarantine_list()[0]["reason"]
+        finally:
+            ctl.close()
+
+    def test_missing_monitor_profile_fails_validation(self, champion,
+                                                      tmp_path):
+        launcher = FakeLauncher(champion, behaviors=("no_monitor",))
+        ro = FakeRollout()
+        ctl = _controller(champion, tmp_path / "r", launcher, ro)
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.quarantined_total == 1, msg="quarantine")
+            assert "monitor.json" in ctl.quarantine_list()[0]["reason"]
+            assert ro.start_calls == []
+        finally:
+            ctl.close()
+
+    def test_low_holdout_metric_fails_validation(self, champion,
+                                                 tmp_path):
+        launcher = FakeLauncher(champion, behaviors=("low_metric",))
+        ro = FakeRollout()
+        pre = _dir_hashes(champion)
+        ctl = _controller(champion, tmp_path / "r", launcher, ro)
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.quarantined_total == 1, msg="quarantine")
+            self._assert_contained(ctl, ro, champion, pre,
+                                   "validation_failed")
+            assert "outside tolerance" in \
+                ctl.quarantine_list()[0]["reason"]
+        finally:
+            ctl.close()
+
+    def test_rollout_rejection_quarantines(self, champion, tmp_path):
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout(outcome="rejected")
+        pre = _dir_hashes(champion)
+        ctl = _controller(champion, tmp_path / "r", launcher, ro)
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.quarantined_total == 1, msg="quarantine")
+            # the rollout RAN (shadow) — rejection is its verdict; the
+            # champion kept serving throughout
+            assert len(ro.start_calls) == 1
+            assert _dir_hashes(champion) == pre
+            assert "rollout_rejected" in \
+                ctl.quarantine_list()[0]["reason"]
+        finally:
+            ctl.close()
+
+    def test_injected_rollout_reject_fault(self, champion, tmp_path,
+                                           monkeypatch):
+        monkeypatch.setenv(RF.FAULT_ENV, "rollout_reject")
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout(outcome="swapped")
+        ctl = _controller(champion, tmp_path / "r", launcher, ro)
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.quarantined_total == 1, msg="quarantine")
+            # the injected fault forces the rejected branch WITHOUT the
+            # candidate ever reaching the real rollout path
+            assert ro.start_calls == []
+            assert "injected rollout_reject" in \
+                ctl.quarantine_list()[0]["reason"]
+        finally:
+            ctl.close()
+
+    def test_quarantined_candidate_never_retried_verbatim(
+            self, champion, tmp_path):
+        # cycle 1: clean fit, rollout rejects -> candidate hash in the
+        # ledger. cycle 2 produces a byte-identical candidate -> it is
+        # refused at VALIDATING, before any rollout.
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout(outcome="rejected")
+        ctl = _controller(champion, tmp_path / "r", launcher, ro)
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.quarantined_total == 1, msg="q1")
+            n_start = len(ro.start_calls)
+            ctl.trigger(force=True)
+            _wait(lambda: ctl.quarantined_total == 2, msg="q2")
+            assert len(ro.start_calls) == n_start  # no second rollout
+            assert "byte-identical to a quarantined" in \
+                ctl.quarantine_list()[1]["reason"]
+        finally:
+            ctl.close()
+
+    def test_cooldown_suppresses_and_force_overrides(self, champion,
+                                                     tmp_path):
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout()
+        ctl = _controller(champion, tmp_path / "r", launcher, ro,
+                          min_interval_s=60.0)
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.swapped_total == 1, msg="swap")
+            alert = {"window_id": "h:m:w1", "target": "a",
+                     "metric": "js"}
+            assert ctl.handle_alert(alert) == "cooldown"
+            with pytest.raises(RetrainConflict):
+                ctl.trigger()
+            ctl.trigger(force=True)  # the operator override
+            _wait(lambda: ctl.swapped_total == 2, msg="swap2")
+        finally:
+            ctl.close()
+
+    def test_storm_breaker(self, champion, tmp_path):
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout()
+        ctl = _controller(champion, tmp_path / "r", launcher, ro,
+                          max_retrains_per_window=2,
+                          storm_window_s=3600.0)
+        try:
+            for i in range(2):
+                ctl.handle_alert({"window_id": f"h:m:w{i}",
+                                  "target": "a", "metric": "js"})
+                _wait(lambda: ctl.effective_state() == "idle",
+                      msg="cycle")
+            out = ctl.handle_alert({"window_id": "h:m:w9",
+                                    "target": "a", "metric": "js"})
+            assert out == "storm_breaker"
+            assert ctl.cycles_total == 2
+            with pytest.raises(RetrainConflict):
+                ctl.trigger()  # un-forced manual respects the breaker
+        finally:
+            ctl.close()
+
+    def test_cooldown_deferred_alert_retriggers_on_redelivery(
+            self, champion, tmp_path):
+        """An alert suppressed by a TRANSIENT condition (cooldown) is
+        NOT consumed: the pooled /drift poll re-delivers the same
+        window_id while the window stays open, and that re-delivery
+        must trigger once the controller frees up — only a trigger (or
+        a permanent suppression) consumes the dedupe key."""
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout()
+        ctl = _controller(champion, tmp_path / "r", launcher, ro,
+                          min_interval_s=0.5)
+        alert = {"window_id": "h:m:w7", "target": "a", "metric": "js"}
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.swapped_total == 1, msg="swap")
+            assert ctl.handle_alert(dict(alert)) == "cooldown"
+            _wait(lambda: ctl.effective_state() == "idle",
+                  msg="cooldown decay")
+            assert ctl.handle_alert(dict(alert)) is None  # NOT duplicate
+            _wait(lambda: ctl.swapped_total == 2, msg="swap2")
+            # consumed only once it actually acted
+            assert ctl.handle_alert(dict(alert)) == "duplicate"
+        finally:
+            ctl.close()
+
+    def test_graceful_close_mid_fitting_pauses_for_resume(
+            self, champion, tmp_path):
+        """close()/SIGTERM during FITTING must NOT quarantine: the
+        journal keeps the cycle at FITTING and the next incarnation
+        resumes it — an operator restart must never permanently ban a
+        retrain (only kill -9 and real failures are exceptional)."""
+        launcher = FakeLauncher(champion, behaviors=("crash", "ok"))
+        ro = FakeRollout()
+        root = tmp_path / "r"
+        ctl = _controller(champion, root, launcher, ro,
+                          backoff_base_s=30.0, backoff_cap_s=30.0,
+                          fit_attempts=3)
+        ctl.trigger()
+        _wait(lambda: launcher.calls == 1, msg="first attempt")
+        ctl.close()  # lands in the retry backoff -> pause, not fail
+        assert ctl.quarantined_total == 0
+        states = [r["state"] for r in ctl.journal.records()]
+        assert QUARANTINED not in states and states[-1] == FITTING
+        ctl2 = _controller(champion, root, FakeLauncher(champion), ro)
+        try:
+            out = ctl2.resume()
+            assert out["resumed"] and out["at_state"] == FITTING
+            _wait(lambda: ctl2.swapped_total == 1, msg="swap")
+            assert ctl2.quarantined_total == 0
+        finally:
+            ctl2.close()
+
+    def test_graceful_close_mid_rollout_pauses_for_resume(
+            self, champion, tmp_path):
+        """close() with the rollout still live leaves the rollout AND
+        the journal's ROLLING_OUT record alone; the resumed controller
+        finds the live rollout and awaits its verdict — exactly one
+        rollout, no quarantine of a validated candidate."""
+        class DistinctLauncher(FakeLauncher):
+            # a real refit candidate is never byte-identical to the
+            # champion; give it its own content hash so the resume
+            # probe cannot mistake it for an already-landed swap
+            def _write_candidate(self, spec, **kw):
+                super()._write_candidate(spec, **kw)
+                with open(os.path.join(spec.out_dir,
+                                       "op-model.json"), "a") as fh:
+                    fh.write("\n")
+                rp = os.path.join(spec.out_dir, RF.REPORT_JSON)
+                with open(rp) as fh:
+                    rep = json.load(fh)
+                rep["candidate_hash"] = model_content_hash(spec.out_dir)
+                with open(rp, "w") as fh:
+                    json.dump(rep, fh)
+
+        ro = FakeRollout(outcome="swapped", delay=3600.0)  # stays live
+        root = tmp_path / "r"
+        ctl = _controller(champion, root, DistinctLauncher(champion), ro)
+        ctl.trigger()
+        _wait(lambda: ctl.state == ROLLING_OUT and ro.start_calls,
+              msg="rolling out")
+        ctl.close()
+        assert ctl.quarantined_total == 0 and ro.aborted == 0
+        states = [r["state"] for r in ctl.journal.records()]
+        assert QUARANTINED not in states and states[-1] == ROLLING_OUT
+        ctl2 = _controller(champion, root, DistinctLauncher(champion),
+                           ro)
+        try:
+            out = ctl2.resume()
+            assert out["resumed"]
+            assert out["action"] == "awaiting_live_rollout"
+            ro.set_delay(0.0)  # the verdict lands now
+            _wait(lambda: ctl2.swapped_total == 1, msg="swap")
+            assert len(ro.start_calls) == 1  # exactly one rollout
+            assert ctl2.quarantined_total == 0
+        finally:
+            ctl2.close()
+
+    def test_recipe_thresholds_passed_per_rollout(self, champion,
+                                                  tmp_path):
+        """The recipe's rollout_* relaxation rides start(thresholds=)
+        for THAT cycle's rollout only — never a mutation of the shared
+        manager (manual POST /rollout keeps the fleet's base guards);
+        a recipe without the keys passes no kwarg at all (duck-typed
+        fakes need not know it)."""
+        ro = FakeRollout()
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), ro)
+        ctl._recipe.update({"rollout_max_pred_js": 1.5,
+                            "rollout_max_psi": 50.0})
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.swapped_total == 1, msg="swap")
+            assert ro.start_kwargs["thresholds"] == {
+                "max_pred_js": 1.5, "max_psi": 50.0}
+        finally:
+            ctl.close()
+
+    def test_rollout_verdict_threshold_overrides_scope(self):
+        """RolloutManager: start(thresholds=) relaxes the verdict for
+        one rollout; the next start resets to the base thresholds."""
+        from transmogrifai_tpu.fleet.rollout import RolloutManager
+
+        class _Router:
+            champions = []
+
+        class SeedableRollout(RolloutManager):
+            """Test seam: seed the tallies the _shadow_loop thread
+            would accumulate — under the manager's own lock, the same
+            discipline _score_pair follows."""
+
+            def seed_disjoint(self):
+                with self.lock:
+                    # fully disjoint score histograms: JS saturates
+                    # at 1.0 (equal means keep the shift guard quiet)
+                    self._v1_hist[0] = 50.0
+                    self._v2_hist[-1] = 50.0
+                    self.shadow_pairs = 50
+                    self._v1_sum = self._v2_sum = 5.0
+
+            def relax(self, **ov):
+                with self.lock:
+                    self._thresholds = ov
+
+            def peek_thresholds(self):
+                with self.lock:
+                    return dict(self._thresholds)
+
+        ro = SeedableRollout(object(), _Router(),
+                             lock=threading.RLock())
+        ro.seed_disjoint()
+        assert not ro.verdict()["clean"]  # base guards reject
+        ro.relax(max_pred_js=1.5, max_psi=50.0)
+        assert ro.verdict()["clean"]  # this rollout's relaxation
+        # a failed next start() (stub supervisor) still RESETS the
+        # overrides before touching the pool — the relaxation never
+        # leaks into a later operator rollout
+        with pytest.raises(Exception):
+            ro.start("/nope")
+        assert ro.peek_thresholds() == {}
+        ro.seed_disjoint()  # start() zeroed the shadow state
+        assert not ro.verdict()["clean"]  # base guards are back
+
+    def test_operator_abort_quarantines_without_banning(self, champion,
+                                                        tmp_path):
+        """An operator abort (RolloutManager.abort's `aborted` verdict
+        marker) quarantines the cycle's evidence but does NOT ban the
+        candidate hash or the trigger — the candidate didn't fail at
+        traffic, someone needed the slot."""
+        class AbortingRollout(FakeRollout):
+            def start(self, *a, **kw):
+                out = super().start(*a, **kw)
+                self.abort()  # the operator wins the slot immediately
+                return out
+
+            def abort(self):
+                with self._lk:
+                    self.aborted += 1
+                    self._state = "rejected"
+                    self.last_verdict = {"clean": False,
+                                         "reasons": ["aborted"],
+                                         "aborted": True}
+
+        ro = AbortingRollout()
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), ro)
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.quarantined_total == 1, msg="quarantine")
+            q = ctl.quarantine_list()
+            assert len(q) == 1 and "aborted" in q[0]["reason"], q
+            assert q[0]["candidate_hash"] is None  # evidence, no ban
+            assert q[0]["window_id"] is None
+            assert not ctl._quarantined_hashes
+            assert not ctl._quarantined_triggers
+        finally:
+            ctl.close()
+
+    def test_graceful_close_racing_validation_does_not_ban(
+            self, champion, tmp_path):
+        """close() racing a long validation (the journal can close
+        under the cycle thread after join(10) times out) must PAUSE the
+        cycle for resume — an operator restart must never quarantine,
+        let alone ban, a candidate that failed nothing."""
+        ro = FakeRollout()
+        entered = threading.Event()
+
+        class RacingValidate(RetrainController):
+            def _validate(self, cyc):
+                entered.set()
+                # a real monitor replay has no stop checks; model the
+                # race by failing the way a closed-journal append
+                # would, AFTER the stop landed
+                _wait(lambda: self._stop.is_set(), msg="stop flag")
+                raise ValueError("I/O operation on closed file")
+
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), ro,
+                          cls=RacingValidate)
+        try:
+            ctl.trigger()
+            assert entered.wait(10.0)
+            ctl.close()
+            assert ctl.quarantined_total == 0
+            assert ctl.swapped_total == 0
+            assert not ctl._quarantined_hashes
+            assert not ctl._quarantined_triggers
+        finally:
+            ctl.close()
+
+    def test_resume_cooldown_counts_downtime(self, champion, tmp_path):
+        """Restarting a day after the last cycle ended must NOT
+        re-impose a full min_interval_s: resume() derives the cooldown
+        from the journal's ts, so a genuine alert right after the
+        restart triggers immediately."""
+        root = tmp_path / "r"
+        os.makedirs(root, exist_ok=True)
+        with open(root / "journal.jsonl", "w") as fh:
+            fh.write(json.dumps({"seq": 0, "ts": time.time() - 86400.0,
+                                 "cycle": "rc-old",
+                                 "state": COOLDOWN}) + "\n")
+        ctl = _controller(champion, root, FakeLauncher(champion),
+                          FakeRollout(), min_interval_s=3600.0)
+        try:
+            out = ctl.resume()
+            assert out["reason"] == "last cycle complete"
+            assert ctl._cooldown_remaining() <= 0.0
+            assert ctl.effective_state() == "idle"
+        finally:
+            ctl.close()
+
+    def test_foreign_rollout_verdict_not_booked(self, champion,
+                                                tmp_path):
+        """A terminal rollout state naming someone ELSE's challenger
+        (ours died; an operator took the slot) must not be booked as
+        this cycle's swap or rejection: the cycle ends quarantined
+        without a verdict, without banning the candidate, and without
+        aborting the foreign rollout."""
+        class ForeignRollout(FakeRollout):
+            def status(self):
+                st = super().status()
+                if st["state"] in ("swapped", "rejected"):
+                    st["challenger_dir"] = "/someone/elses/v9"
+                return st
+
+        ro = ForeignRollout()  # flips terminal on first status() poll
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), ro)
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.quarantined_total == 1, msg="quarantine")
+            assert ctl.swapped_total == 0
+            q = ctl.quarantine_list()
+            assert "did not reach a verdict" in q[0]["reason"], q
+            assert q[0]["candidate_hash"] is None  # no ban either way
+            assert not ctl._quarantined_hashes
+            assert ro.aborted == 0  # never aborts a foreign rollout
+        finally:
+            ctl.close()
+
+    def test_rollout_no_verdict_timeout_quarantines_without_ban(
+            self, champion, tmp_path):
+        """A rollout that never reaches a verdict inside the budget
+        (thin shadow traffic) is aborted and quarantined — but the
+        candidate is NOT banned: nothing about the artifact failed, so
+        a later cycle may ship the same candidate."""
+        ro = FakeRollout(delay=999.0)  # stuck in shadow forever
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), ro,
+                          rollout_timeout_s=0.3)
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.quarantined_total == 1, msg="quarantine")
+            assert ro.aborted == 1  # ours: reclaim the slot
+            q = ctl.quarantine_list()
+            assert "did not reach a verdict" in q[0]["reason"], q
+            assert q[0]["candidate_hash"] is None
+            assert q[0]["window_id"] is None
+            assert not ctl._quarantined_hashes
+            assert not ctl._quarantined_triggers
+        finally:
+            ctl.close()
+
+    def test_unconfigured_suppression_evented_once(self, champion,
+                                                   tmp_path,
+                                                   monkeypatch):
+        """A recipe-less controller suppresses every re-delivered
+        alert, but EVENTS the suppression once per episode — the
+        pooled /drift poll re-delivers the alert fan-out every couple
+        of seconds for as long as the recipe stays missing, and
+        per-delivery events would flood the shared fleet log."""
+        from transmogrifai_tpu.retrain import controller as rc
+        # recipe=None AND the champion dir has no retrain.json
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), FakeRollout(),
+                          recipe=None)
+        evs = []
+        monkeypatch.setattr(
+            rc.collector, "event",
+            lambda name, **kw: evs.append(name))
+        try:
+            for i in range(5):
+                out = ctl.handle_alert({"window_id": f"h:m:w{i}",
+                                        "target": "a", "metric": "js"})
+                assert out == "unconfigured"
+            assert ctl.suppressed["unconfigured"] == 5
+            assert evs.count("retrain_suppressed") == 1
+        finally:
+            ctl.close()
+
+    def test_rollout_conflict_retried_not_quarantined(self, champion,
+                                                      tmp_path):
+        """A transient RolloutConflict from rollout.start (another
+        rollout holds the slot) waits for the slot instead of
+        quarantining: quarantine would ban the validated candidate's
+        hash forever over a momentary collision."""
+        class RolloutConflict(RuntimeError):  # judged by NAME
+            pass
+
+        class BusyThenFree(FakeRollout):
+            def __init__(self):
+                super().__init__(outcome="swapped")
+                self.conflicts = 2
+
+            def start(self, *a, **kw):
+                if self.conflicts > 0:
+                    self.conflicts -= 1
+                    raise RolloutConflict("slot busy")
+                return super().start(*a, **kw)
+
+        ro = BusyThenFree()
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), ro,
+                          rollout_timeout_s=30.0)
+        try:
+            ctl.trigger()
+            _wait(lambda: ctl.swapped_total == 1, timeout=30.0,
+                  msg="swap after conflict retries")
+            assert ro.conflicts == 0 and len(ro.start_calls) == 1
+            assert ctl.quarantined_total == 0
+        finally:
+            ctl.close()
+
+    def test_failed_journal_append_rolls_back_trigger(self, champion,
+                                                      tmp_path):
+        """A disk-full journal append during the trigger mint must roll
+        the TRIGGERED reservation back to IDLE — not wedge the
+        controller in a stateless TRIGGERED with no cycle thread
+        (regression)."""
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), FakeRollout())
+        try:
+            real = ctl.journal.append
+            fail_next = [True]
+
+            def flaky(*a, **kw):
+                if fail_next[0]:
+                    fail_next[0] = False
+                    raise OSError(28, "No space left on device")
+                return real(*a, **kw)
+
+            ctl.journal.append = flaky
+            with pytest.raises(OSError):
+                ctl.trigger(force=True)
+            assert ctl.effective_state() == "idle"
+            assert ctl.cycle is None and ctl.cycles_total == 0
+            # and the controller is RETRIGGERABLE once the disk frees up
+            ctl.trigger(force=True)
+            _wait(lambda: ctl.swapped_total == 1, timeout=30.0,
+                  msg="swap after journal recovery")
+        finally:
+            ctl.close()
+
+    def test_failed_launch_leaves_alert_retriable(self, champion,
+                                                  tmp_path):
+        """A failed cycle mint must NOT consume the alert's dedupe key:
+        the pooled poll's re-delivery of the same window is what retries
+        the deferred trigger (regression)."""
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), FakeRollout())
+        try:
+            real = ctl.journal.append
+            fail_next = [True]
+
+            def flaky(*a, **kw):
+                if fail_next[0]:
+                    fail_next[0] = False
+                    raise OSError(28, "No space left on device")
+                return real(*a, **kw)
+
+            ctl.journal.append = flaky
+            alert = {"window_id": "h:m:w0", "target": "a",
+                     "metric": "js"}
+            with pytest.raises(OSError):
+                ctl.handle_alert(alert)
+            assert ctl.effective_state() == "idle"
+            out = ctl.handle_alert(dict(alert))
+            assert out is None, f"re-delivery suppressed as {out}"
+            _wait(lambda: ctl.swapped_total == 1, timeout=30.0,
+                  msg="swap after alert re-delivery")
+        finally:
+            ctl.close()
+
+    def test_swap_landing_at_deadline_not_quarantined(self, champion,
+                                                      tmp_path):
+        """The shadow verdict can land in the race window between the
+        timeout status read and abort()'s state guard (which no-ops on
+        a terminal rollout). The cycle must book the swap — the old
+        quarantine path would shutil.move cycles/<id>/ and relocate
+        the SERVING champion's model dir out from under the fleet
+        (regression)."""
+        class SwapAtAbort(FakeRollout):
+            def __init__(self):
+                # never decides on its own: the controller times out
+                super().__init__(outcome="swapped", delay=3600.0)
+
+            def abort(self):
+                with self._lk:
+                    # simulate _decide winning the race: the real
+                    # abort's state guard no-oped, the verdict is a
+                    # REAL swap (no aborted marker)
+                    self._state = "swapped"
+                    self.last_verdict = {"clean": True, "reasons": []}
+
+        ro = SwapAtAbort()
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), ro,
+                          rollout_timeout_s=0.5)
+        try:
+            ctl.trigger(force=True)
+            _wait(lambda: ctl.swapped_total == 1, timeout=30.0,
+                  msg="swap booked after the abort race")
+            assert ctl.quarantined_total == 0
+            assert ctl.last_verdict["outcome"] == "swapped"
+            # the cycle dir (holding the now-serving candidate) stayed
+            cand = ctl.last_verdict["candidate_dir"]
+            assert os.path.isdir(cand), "serving candidate dir moved!"
+        finally:
+            ctl.close()
+
+    def test_status_not_blocked_by_cycle_mint(self, champion, tmp_path):
+        """The heavy trigger mint (window CSV, spec, journal fsync)
+        runs OUTSIDE the controller lock: /healthz (effective_state)
+        must answer while the snapshot is in flight (regression)."""
+        gate = threading.Event()
+        entered = threading.Event()
+
+        class SlowMint(RetrainController):
+            def _snapshot_window(self, path):
+                entered.set()
+                gate.wait(10.0)
+                return super()._snapshot_window(path)
+
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), FakeRollout(),
+                          cls=SlowMint)
+        try:
+            t = threading.Thread(
+                target=lambda: ctl.trigger(force=True), daemon=True)
+            t.start()
+            assert entered.wait(5.0), "mint never reached the snapshot"
+            t0 = time.monotonic()
+            st = ctl.effective_state()
+            elapsed = time.monotonic() - t0
+            assert st == "triggered"
+            assert elapsed < 1.0, f"state read blocked {elapsed:.1f}s " \
+                                  f"behind the mint"
+            gate.set()
+            t.join(10.0)
+            _wait(lambda: ctl.swapped_total == 1, timeout=30.0,
+                  msg="swap after slow mint")
+        finally:
+            ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# sandboxed artifact load probe: the child-process path the fake-driven
+# suite bypasses with sandbox_load_probe=False
+# ---------------------------------------------------------------------------
+
+class TestSandboxedLoadProbe:
+    def test_probe_contains_corruption_in_a_child(self, champion,
+                                                  tmp_path):
+        """Default-policy probe: a loadable artifact passes, a corrupt
+        one is refused — and the refusal comes from a CHILD process
+        (the serving process never deserializes the untrusted bytes)."""
+        ctl = _controller(champion, tmp_path / "r",
+                          FakeLauncher(champion), FakeRollout(),
+                          sandbox_load_probe=True)
+        ctl.env["JAX_PLATFORMS"] = "cpu"  # the child really starts jax
+        try:
+            assert ctl._load_probe(champion) is None
+            bad = str(tmp_path / "bad")
+            shutil.copytree(champion, bad)
+            with open(os.path.join(bad, "op-model.json"), "w") as fh:
+                fh.write("{corrupt")
+            err = ctl._load_probe(bad)
+            assert err, "corrupt artifact must be refused"
+            assert "Error" in err  # the child named the exception
+        finally:
+            ctl.close()
+
+
+# ---------------------------------------------------------------------------
+# journal crash-resume: kill between each pair of adjacent states
+# ---------------------------------------------------------------------------
+
+class TestJournalResume:
+    """Handcraft the journal a controller would have written up to each
+    state, then construct a FRESH controller over the same root (the
+    post-kill incarnation) and assert it resumes with EXACTLY one
+    rollout and no duplicate work."""
+
+    def _root(self, tmp_path, champion, journal_states,
+              with_candidate=True, launcher=None):
+        root = tmp_path / "r"
+        os.makedirs(root, exist_ok=True)
+        cyc_dir = str(root / "cycles" / "rc-test")
+        cand_dir = os.path.join(cyc_dir, "candidate")
+        os.makedirs(cyc_dir, exist_ok=True)
+        RF.RefitSpec(champion_dir=champion, out_dir=cand_dir,
+                     builder="nope:nope").save(
+            os.path.join(cyc_dir, RF.SPEC_JSON))
+        cand_hash = None
+        if with_candidate:
+            shutil.copytree(champion, cand_dir)
+            # a real refit candidate is never byte-identical to the
+            # champion; a trailing newline keeps the JSON valid while
+            # giving the candidate its own content hash (the resume
+            # probe compares hashes)
+            with open(os.path.join(cand_dir, "op-model.json"), "a") as fh:
+                fh.write("\n")
+            cand_hash = model_content_hash(cand_dir)
+            with open(os.path.join(cand_dir, RF.REPORT_JSON), "w") as fh:
+                json.dump({"candidate_hash": cand_hash,
+                           "metric": "au_pr",
+                           "metric_larger_better": True,
+                           "candidate_metric": 0.9,
+                           "champion_metric": 0.9}, fh)
+        j = RetrainJournal(str(root / "journal.jsonl"))
+        for st in journal_states:
+            fields = {}
+            if st == TRIGGERED:
+                fields = {"cycle_dir": cyc_dir, "champion_dir": champion,
+                          "champion_hash": model_content_hash(champion),
+                          "trigger": {"window_id": "h:m:w0"}}
+            if st == FITTING:
+                fields = {"attempt": 1}
+            if st == ROLLING_OUT:
+                fields = {"candidate_dir": cand_dir,
+                          "candidate_hash": cand_hash}
+            j.append("rc-test", st, **fields)
+        j.close()
+        return root, cand_dir, cand_hash
+
+    def _resume(self, champion, root, launcher, ro,
+                champion_dir_fn=None):
+        return RetrainController(
+            champion_dir_fn or (lambda: champion), root=str(root),
+            rollout=ro,
+            policy=RetrainPolicy(min_interval_s=0.0, fit_attempts=2,
+                                 backoff_base_s=0.01,
+                                 fit_timeout_s=5.0,
+                                 require_monitor_green=False,
+                                 rollout_timeout_s=10.0,
+                                 sandbox_load_probe=False),
+            recipe={"builder": "nope:nope", "history": []},
+            launcher=launcher)
+
+    def test_kill_after_triggered_resumes_through_fit(self, champion,
+                                                      tmp_path):
+        root, _, _ = self._root(tmp_path, champion, [TRIGGERED],
+                                with_candidate=False)
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout()
+        ctl = self._resume(champion, root, launcher, ro)
+        try:
+            out = ctl.resume()
+            assert out["resumed"] and out["at_state"] == TRIGGERED
+            _wait(lambda: ctl.swapped_total == 1, msg="swap")
+            assert launcher.calls == 1 and len(ro.start_calls) == 1
+        finally:
+            ctl.close()
+
+    def test_kill_mid_fitting_relaunches_once(self, champion, tmp_path):
+        root, _, _ = self._root(tmp_path, champion,
+                                [TRIGGERED, FITTING],
+                                with_candidate=False)
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout()
+        ctl = self._resume(champion, root, launcher, ro)
+        try:
+            out = ctl.resume()
+            assert out["resumed"] and out["at_state"] == FITTING
+            _wait(lambda: ctl.swapped_total == 1, msg="swap")
+            assert launcher.calls == 1 and len(ro.start_calls) == 1
+        finally:
+            ctl.close()
+
+    def test_kill_mid_validating_revalidates_once(self, champion,
+                                                  tmp_path):
+        root, _, _ = self._root(tmp_path, champion,
+                                [TRIGGERED, FITTING, VALIDATING])
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout()
+        ctl = self._resume(champion, root, launcher, ro)
+        try:
+            out = ctl.resume()
+            assert out["resumed"] and out["at_state"] == VALIDATING
+            _wait(lambda: ctl.swapped_total == 1, msg="swap")
+            # the candidate sat on disk across the kill: NO refit ran
+            assert launcher.calls == 0
+            assert len(ro.start_calls) == 1
+        finally:
+            ctl.close()
+
+    def test_kill_mid_rollout_swap_already_landed(self, champion,
+                                                  tmp_path):
+        """The double-rollout hazard: the swap happened, THEN the
+        controller died before journaling it. Resume must detect the
+        landed swap (champion hash == candidate hash) and must NOT
+        start a second rollout."""
+        root, cand_dir, cand_hash = self._root(
+            tmp_path, champion,
+            [TRIGGERED, FITTING, VALIDATING, ROLLING_OUT])
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout()
+        # post-swap world: the candidate IS the serving champion now
+        ctl = self._resume(champion, root, launcher, ro,
+                           champion_dir_fn=lambda: cand_dir)
+        try:
+            out = ctl.resume()
+            assert out["resumed"]
+            assert out["action"] == "swap_already_landed"
+            assert ro.start_calls == [], "second rollout started!"
+            assert launcher.calls == 0
+            _wait(lambda: ctl.swapped_total == 1, msg="bookkeeping")
+            states = [r["state"] for r in ctl.journal.records()]
+            assert states[-1] == COOLDOWN
+        finally:
+            ctl.close()
+
+    def test_swap_already_landed_credits_restart_downtime(self, champion,
+                                                          tmp_path):
+        """The cycle actually ENDED (swap landed) before the crash:
+        restart downtime counts toward the cooldown on this resume
+        branch too, like COOLDOWN/QUARANTINED (regression)."""
+        root, cand_dir, _ = self._root(
+            tmp_path, champion,
+            [TRIGGERED, FITTING, VALIDATING, ROLLING_OUT])
+        # age the journal: the crash (and the landed swap) was 1000s ago
+        jp = os.path.join(str(root), "journal.jsonl")
+        with open(jp) as fh:
+            recs = [json.loads(ln) for ln in fh if ln.strip()]
+        for r in recs:
+            r["ts"] = float(r["ts"]) - 1000.0
+        with open(jp, "w") as fh:
+            for r in recs:
+                fh.write(json.dumps(r) + "\n")
+        ctl = RetrainController(
+            lambda: cand_dir, root=str(root), rollout=FakeRollout(),
+            policy=RetrainPolicy(min_interval_s=600.0,
+                                 require_monitor_green=False,
+                                 sandbox_load_probe=False),
+            recipe={"builder": "nope:nope", "history": []},
+            launcher=FakeLauncher(champion))
+        try:
+            out = ctl.resume()
+            assert out["action"] == "swap_already_landed"
+            assert ctl.swapped_total == 1
+            # 1000s of downtime > the 600s min_interval: no residual
+            # cooldown may block a real alert arriving after restart
+            assert ctl.effective_state() == "idle"
+        finally:
+            ctl.close()
+
+    def test_kill_mid_rollout_not_landed_recovers_one_rollout(
+            self, champion, tmp_path):
+        """The rollout died WITH the controller (challenger pool gone,
+        no swap): resume re-validates and runs exactly one recovery
+        rollout."""
+        root, _, _ = self._root(
+            tmp_path, champion,
+            [TRIGGERED, FITTING, VALIDATING, ROLLING_OUT])
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout()  # idle: the pre-kill rollout left no trace
+        ctl = self._resume(champion, root, launcher, ro)
+        try:
+            out = ctl.resume()
+            assert out["resumed"] and "re-enter" in out["action"]
+            _wait(lambda: ctl.swapped_total == 1, msg="swap")
+            assert len(ro.start_calls) == 1
+            assert launcher.calls == 0  # candidate reused, not refit
+        finally:
+            ctl.close()
+
+    def test_kill_between_quarantined_and_cooldown(self, champion,
+                                                   tmp_path):
+        root, _, _ = self._root(
+            tmp_path, champion,
+            [TRIGGERED, FITTING, QUARANTINED])
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout()
+        ctl = self._resume(champion, root, launcher, ro)
+        try:
+            out = ctl.resume()
+            assert not out["resumed"]  # terminal cycle: only bookkeeping
+            assert launcher.calls == 0 and ro.start_calls == []
+            states = [r["state"] for r in ctl.journal.records()]
+            assert states[-1] == COOLDOWN
+        finally:
+            ctl.close()
+
+    def test_clean_journal_resume_is_noop(self, champion, tmp_path):
+        root, _, _ = self._root(
+            tmp_path, champion, [TRIGGERED, FITTING, VALIDATING,
+                                 ROLLING_OUT, COOLDOWN])
+        launcher = FakeLauncher(champion)
+        ro = FakeRollout()
+        ctl = self._resume(champion, root, launcher, ro)
+        try:
+            out = ctl.resume()
+            assert not out["resumed"]
+            assert launcher.calls == 0 and ro.start_calls == []
+        finally:
+            ctl.close()
+
+    def test_orphan_pid_reuse_guard(self, champion, tmp_path):
+        """A pid file pointing at a process that is NOT a
+        retrain-worker (pid reuse after reboot) must be left alone."""
+        import subprocess
+        import sys
+        root, _, _ = self._root(tmp_path, champion,
+                                [TRIGGERED, FITTING],
+                                with_candidate=False)
+        bystander = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(30)"])
+        try:
+            cyc_dir = str(root / "cycles" / "rc-test")
+            with open(os.path.join(cyc_dir, "worker.pid"), "w") as fh:
+                fh.write(str(bystander.pid))
+            launcher = FakeLauncher(champion)
+            ctl = self._resume(champion, root, launcher, FakeRollout())
+            try:
+                ctl.resume()
+                _wait(lambda: ctl.swapped_total == 1, msg="swap")
+                assert bystander.poll() is None, \
+                    "resume killed an innocent bystander process"
+            finally:
+                ctl.close()
+        finally:
+            bystander.kill()
+            bystander.wait(10)
+
+
+# ---------------------------------------------------------------------------
+# across-time GLM warm seed (ops/glm_sweep warm_seed)
+# ---------------------------------------------------------------------------
+
+class TestWarmSeed:
+    def _problem(self, n=400, d=6, F=2, G=2, seed=0):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        beta_true = rng.normal(size=d).astype(np.float32)
+        y = (X @ beta_true + 0.1 * rng.normal(size=n) > 0
+             ).astype(np.float32)
+        w = np.ones(n, np.float32)
+        masks = np.ones((F, n), np.float32)
+        regs = np.asarray([0.1, 0.01], np.float32)[:G]
+        alphas = np.zeros(G, np.float32)
+        return (jnp.asarray(X), jnp.asarray(y), jnp.asarray(w),
+                jnp.asarray(masks), regs, alphas)
+
+    def test_warm_seed_matches_cold_and_converges_faster(self):
+        from transmogrifai_tpu.ops import glm_sweep as GS
+        X, y, w, m, regs, alphas = self._problem()
+        kw = dict(loss="logistic", max_iter=60, tol=1e-6,
+                  fit_intercept=True, standardize=True)
+        B_cold, b0_cold, info_cold = GS.sweep_glm_streamed_rounds(
+            X, y, w, m, regs, alphas, **kw)
+        assert not info_cold["warm_seeded"]
+        # seed from the cold solution of fold 0, lowest reg (a stand-in
+        # for "the serving champion's coefficients")
+        seed = (np.asarray(B_cold[0, -1]), float(b0_cold[0, -1]))
+        B_warm, b0_warm, info_warm = GS.sweep_glm_streamed_rounds(
+            X, y, w, m, regs, alphas, warm_seed=seed, **kw)
+        assert info_warm["warm_seeded"]
+        assert info_warm["warm_start"]  # the seed replaces round 0
+        np.testing.assert_allclose(B_warm, B_cold, atol=5e-3)
+        np.testing.assert_allclose(b0_warm, b0_cold, atol=5e-3)
+        # starting at (essentially) the optimum costs fewer data passes
+        assert info_warm["data_passes"] <= info_cold["data_passes"]
+
+    def test_warm_seed_dimension_mismatch_is_ignored(self):
+        from transmogrifai_tpu.ops import glm_sweep as GS
+        X, y, w, m, regs, alphas = self._problem(d=6)
+        bad_seed = (np.zeros(9, np.float32), 0.0)
+        B, b0, info = GS.sweep_glm_streamed_rounds(
+            X, y, w, m, regs, alphas, loss="logistic", max_iter=20,
+            tol=1e-5, warm_seed=bad_seed)
+        assert not info["warm_seeded"]  # cold start, not a crash
+
+    def test_champion_shortcuts_applied_to_selector(self, champion):
+        from transmogrifai_tpu.retrain.refit import (
+            apply_champion_shortcuts, champion_config)
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+        model = WorkflowModel.load(champion)
+        cfg = champion_config(model)
+        assert cfg["best_model_name"] == "OpLogisticRegression"
+        assert cfg["coef"] is not None and cfg["coef"].ndim == 1
+        # a fresh 2-model workflow narrows to the champion's winner
+        from transmogrifai_tpu import FeatureBuilder
+        from transmogrifai_tpu.automl import \
+            BinaryClassificationModelSelector
+        from transmogrifai_tpu.automl.transmogrifier import transmogrify
+        from transmogrifai_tpu.models.glm import (OpLinearSVC,
+                                                  OpLogisticRegression)
+        from transmogrifai_tpu.stages.params import param_grid
+        from transmogrifai_tpu.workflow import Workflow
+        fa = FeatureBuilder.Real("a").extract(
+            lambda r: r.get("a")).as_predictor()
+        fy = FeatureBuilder.RealNN("y").extract(
+            lambda r: r.get("y")).as_response()
+        pred = BinaryClassificationModelSelector \
+            .with_train_validation_split(
+                models_and_parameters=[
+                    (OpLogisticRegression(),
+                     param_grid(reg_param=[0.01, 0.1])),
+                    (OpLinearSVC(), param_grid(reg_param=[0.01]))],
+            ).set_input(fy, transmogrify([fa])).get_output()
+        wf = Workflow().set_result_features(pred)
+        applied = apply_champion_shortcuts(wf, cfg, narrow=True,
+                                           warm=True)
+        assert applied == {"narrowed": True, "warm_seeded": True}
+        sel = pred.origin_stage
+        assert len(sel.models) == 1
+        assert type(sel.models[0][0]).__name__ == "OpLogisticRegression"
+        assert sel.models[0][1] == [cfg["best_grid"]]
+        assert sel.warm_seed is not None
+
+
+# ---------------------------------------------------------------------------
+# the refit worker (in-process; the subprocess path is ci.sh's smoke)
+# ---------------------------------------------------------------------------
+
+BUILDER_SRC = '''
+from transmogrifai_tpu import FeatureBuilder
+from transmogrifai_tpu.automl import BinaryClassificationModelSelector
+from transmogrifai_tpu.automl.transmogrifier import transmogrify
+from transmogrifai_tpu.models.glm import OpLogisticRegression
+from transmogrifai_tpu.stages.params import param_grid
+from transmogrifai_tpu.workflow import Workflow
+
+
+def build():
+    fa = FeatureBuilder.Real("a").extract(
+        lambda r: r.get("a")).as_predictor()
+    fb = FeatureBuilder.Real("b").extract(
+        lambda r: r.get("b")).as_predictor()
+    fy = FeatureBuilder.RealNN("y").extract(
+        lambda r: r.get("y")).as_response()
+    pred = BinaryClassificationModelSelector.with_train_validation_split(
+        models_and_parameters=[(OpLogisticRegression(max_iter=10),
+                                param_grid(reg_param=[0.01, 0.1]))],
+    ).set_input(fy, transmogrify([fa, fb])).get_output()
+    return Workflow().set_result_features(pred)
+'''
+
+
+class TestRefitWorker:
+    def _spec(self, champion, tmp_path, **kw):
+        import csv
+        bdir = tmp_path / "builders"
+        bdir.mkdir(exist_ok=True)
+        with open(bdir / "retrain_builder_t.py", "w") as fh:
+            fh.write(BUILDER_SRC)
+        hist = tmp_path / "history.csv"
+        with open(hist, "w", newline="") as fh:
+            w = csv.DictWriter(fh, fieldnames=["a", "b", "y"])
+            w.writeheader()
+            for r in _make_rows(240, seed=3):
+                w.writerow(r)
+        args = dict(champion_dir=champion,
+                    out_dir=str(tmp_path / "candidate"),
+                    builder="retrain_builder_t:build",
+                    builder_path=str(bdir),
+                    history=[str(hist)], holdout_fraction=0.25, seed=5)
+        args.update(kw)
+        return RF.RefitSpec(**args)
+
+    def test_refit_produces_candidate_and_report(self, champion,
+                                                 tmp_path):
+        spec = self._spec(champion, tmp_path)
+        report = RF.run_refit(spec)
+        assert os.path.exists(os.path.join(spec.out_dir,
+                                           "op-model.json"))
+        assert os.path.exists(os.path.join(spec.out_dir, "monitor.json"))
+        assert report["metric"] == "au_pr"
+        assert report["candidate_metric"] is not None
+        assert report["champion_metric"] is not None
+        assert report["narrowed"] and report["warm_seeded"]
+        assert report["candidate_hash"] == \
+            model_content_hash(spec.out_dir)
+        assert report["holdout_rows"] == 60
+        # candidate must actually LOAD + score
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+        m = WorkflowModel.load(spec.out_dir)
+        assert m.score_function()({"a": 0.2, "b": -0.1})
+
+    def test_warm_seeded_reported_false_on_dimension_mismatch(
+            self, champion, tmp_path):
+        """The report's warm_seeded is what the fit DID, not what was
+        assigned: a builder whose vectorization changed dimension (here
+        feature `b` dropped) forces the documented honest cold start,
+        and the report must not claim the across-time warm start."""
+        bdir = tmp_path / "builders"
+        bdir.mkdir(exist_ok=True)
+        with open(bdir / "retrain_builder_1f.py", "w") as fh:
+            fh.write(BUILDER_SRC.replace(
+                "transmogrify([fa, fb])", "transmogrify([fa])"))
+        spec = self._spec(champion, tmp_path,
+                          builder="retrain_builder_1f:build")
+        report = RF.run_refit(spec)
+        assert report["warm_seeded"] is False
+        assert report["narrowed"]  # the other shortcut still applied
+
+    def test_refit_copies_recipe_into_candidate(self, champion,
+                                                tmp_path):
+        """The candidate inherits the champion's retrain.json: after a
+        swap it IS the champion dir, and the next cycle (or a fleet
+        started fresh on it) must find the recipe there — continuous
+        retraining, not one-shot."""
+        champ2 = str(tmp_path / "champ2")
+        shutil.copytree(champion, champ2)
+        with open(os.path.join(champ2, RF.RECIPE_JSON), "w") as fh:
+            json.dump({"builder": "retrain_builder_t:build",
+                       "history": []}, fh)
+        spec = self._spec(champ2, tmp_path)
+        RF.run_refit(spec)
+        assert RF.load_recipe(spec.out_dir) is not None
+
+    def test_labeled_window_rows_join_training(self, champion,
+                                               tmp_path):
+        import csv
+        win = tmp_path / "window.csv"
+        with open(win, "w", newline="") as fh:
+            w = csv.DictWriter(fh, fieldnames=["a", "b", "y"])
+            w.writeheader()
+            rows = _make_rows(40, seed=9)
+            for i, r in enumerate(rows):
+                if i % 2:
+                    r = {"a": r["a"], "b": r["b"], "y": ""}  # unlabeled
+                w.writerow(r)
+        spec = self._spec(champion, tmp_path, window=str(win))
+        report = RF.run_refit(spec)
+        assert report["window_rows"] == 40
+        assert report["window_rows_labeled"] == 20
+        assert report["train_rows"] + report["holdout_rows"] == 260
+
+    def test_validation_fail_fault_reports_failing_metric(
+            self, champion, tmp_path, monkeypatch):
+        monkeypatch.setenv(RF.FAULT_ENV, "validation_fail")
+        spec = self._spec(champion, tmp_path)
+        report = RF.run_refit(spec)
+        assert report["fault_injected"] == "validation_fail"
+        assert report["candidate_metric"] == 0.0
+
+    def test_bad_artifact_fault_corrupts_candidate(self, champion,
+                                                   tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setenv(RF.FAULT_ENV, "bad_artifact")
+        spec = self._spec(champion, tmp_path)
+        RF.run_refit(spec)
+        from transmogrifai_tpu.workflow.workflow import WorkflowModel
+        with pytest.raises(Exception):
+            WorkflowModel.load(spec.out_dir)
+
+
+# ---------------------------------------------------------------------------
+# fleet HTTP surface: POST /retrain + GET /retrainz
+# ---------------------------------------------------------------------------
+
+class TestFleetEndpoints:
+    def _frontend(self, champion, tmp_path, launcher, ro):
+        import threading as th
+
+        from transmogrifai_tpu.fleet.frontend import (FleetFrontend,
+                                                      make_fleet_server)
+        from transmogrifai_tpu.fleet.router import Router
+        ctl = _controller(champion, tmp_path / "r", launcher, ro)
+        router = Router(th.RLock())
+        fe = FleetFrontend(None, router, None, retrain=ctl)
+        httpd = make_fleet_server(fe)
+        t = th.Thread(target=httpd.serve_forever,
+                      kwargs={"poll_interval": 0.05}, daemon=True)
+        t.start()
+        host, port = httpd.server_address[:2]
+        return ctl, fe, httpd, host, port
+
+    def test_retrain_endpoints(self, champion, tmp_path):
+        from transmogrifai_tpu.fleet.router import http_json
+        launcher = FakeLauncher(champion)
+        launcher.block = threading.Event()
+        ro = FakeRollout()
+        ctl, fe, httpd, host, port = self._frontend(
+            champion, tmp_path, launcher, ro)
+        try:
+            st, data = http_json(host, port, "GET", "/retrainz")
+            assert st == 200
+            assert json.loads(data)["state"] == "idle"
+            st, data = http_json(host, port, "POST", "/retrain",
+                                 body=b"{}")
+            assert st == 200
+            # concurrent trigger -> 409, mirroring RolloutConflict
+            st, data = http_json(host, port, "POST", "/retrain",
+                                 body=b"{}")
+            assert st == 409, data
+            assert "already" in json.loads(data)["error"]
+            st, data = http_json(host, port, "GET", "/retrainz")
+            payload = json.loads(data)
+            assert payload["state"] in ("triggered", "fitting")
+            assert payload["cycle"] is not None
+            launcher.block.set()
+            _wait(lambda: ctl.swapped_total == 1, msg="swap")
+            st, data = http_json(host, port, "GET", "/retrainz")
+            payload = json.loads(data)
+            assert payload["swapped_total"] == 1
+            assert payload["quarantine"] == []
+        finally:
+            launcher.block.set()
+            httpd.shutdown()
+            httpd.server_close()
+            fe.close()
+            ctl.close()
+
+    def test_retrainz_404_when_unconfigured(self):
+        import threading as th
+
+        from transmogrifai_tpu.fleet.frontend import (FleetFrontend,
+                                                      make_fleet_server)
+        from transmogrifai_tpu.fleet.router import Router, http_json
+        fe = FleetFrontend(None, Router(th.RLock()), None)
+        httpd = make_fleet_server(fe)
+        t = th.Thread(target=httpd.serve_forever,
+                      kwargs={"poll_interval": 0.05}, daemon=True)
+        t.start()
+        host, port = httpd.server_address[:2]
+        try:
+            st, _ = http_json(host, port, "GET", "/retrainz")
+            assert st == 404
+            st, _ = http_json(host, port, "POST", "/retrain", body=b"{}")
+            assert st == 404
+        finally:
+            httpd.shutdown()
+            httpd.server_close()
+            fe.close()

@@ -146,6 +146,12 @@ KNOBS: List[Dict[str, str]] = [
     {"name": "TMOG_EVENTLOG_KEEP", "default": "3",
      "doc": "docs/observability.md",
      "desc": "rotated event-log segments kept"},
+    # -- continuous retraining ----------------------------------------------
+    {"name": "TMOG_RETRAIN_FAULT", "default": "",
+     "doc": "docs/retraining.md",
+     "desc": "fault injection for the retrain loop: fit_crash|fit_hang|"
+             "bad_artifact|validation_fail|rollout_reject — tests and "
+             "ci.sh prove containment at every stage"},
 ]
 
 

@@ -158,6 +158,12 @@ class ModelSelector(PredictorEstimator):
         self.splitter = splitter
         self.models = list(models)
         self.extra_evaluators = list(evaluators)
+        #: across-time GLM warm start ({"beta": [d] raw-unit coefs,
+        #: "intercept": float}) — the retrain refit worker seeds it from
+        #: the serving champion (retrain/refit.apply_champion_shortcuts)
+        #: and the streamed round driver starts every lane there instead
+        #: of at zero (ops/glm_sweep `warm_seed`). None = cold start.
+        self.warm_seed = None
 
     # -- the sweep ---------------------------------------------------------
     def fit_arrays(self, X: np.ndarray, y: np.ndarray,
@@ -181,6 +187,7 @@ class ModelSelector(PredictorEstimator):
         if prep.label_map and any(k != v for k, v in prep.label_map.items()):
             yt = _remap_labels(yt, prep.label_map)
 
+        self.validator.warm_seed = self.warm_seed
         best: BestEstimator = self.validator.validate(
             self.models, Xt, yt, wt, problem_type=self.problem_type)
 

@@ -359,7 +359,12 @@ class Validator:
             return False
         if problem_type not in ("binary", "regression"):
             return False
-        if X.shape[0] < STREAMED_SWEEP_MIN_ROWS:
+        # an assigned across-time warm seed (retrain refit) is only
+        # consumable by the streamed rounds kernel — a seeded refit
+        # takes this route regardless of scale, else the seed would be
+        # silently dropped (and warm_seeded honestly reported False)
+        if X.shape[0] < STREAMED_SWEEP_MIN_ROWS \
+                and getattr(self, "warm_seed", None) is None:
             return False
         from ...ops.glm_sweep import streamed_route_ok
         lanes = n_folds * max(len(grids), 1)
@@ -651,10 +656,19 @@ class Validator:
                     lanes_retired=int(st["retired"].sum()),
                     lanes_active=int((~st["retired"]).sum()),
                     lane_passes=int(st["lane_passes"]))
+            # across-time warm seed (retrain refit): the previous
+            # champion's raw coefficients, threaded selector -> validator
+            # (ModelSelector.fit_arrays). The sweep ignores a seed whose
+            # dimension disagrees with this vectorization.
+            seed = getattr(self, "warm_seed", None)
+            seed_t = None
+            if isinstance(seed, dict) and seed.get("beta") is not None:
+                seed_t = (np.asarray(seed["beta"], np.float32),
+                          float(seed.get("intercept", 0.0)))
             B, b0, info = GS.sweep_glm_streamed_rounds(
                 Xd, yd, wd, md, np.asarray(regs_p), np.asarray(alphas_p),
                 mesh=self.mesh, state=state, on_round=on_round,
-                **fit_kwargs)
+                warm_seed=seed_t, **fit_kwargs)
             return jnp.asarray(B), jnp.asarray(b0), info, rc
         if self.mesh is not None:
             B, b0 = GS.sweep_glm_streamed_sharded(

@@ -524,6 +524,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="fleet events.jsonl + per-replica-incarnation "
                          "artifact dirs (default: "
                          "<model_dir>/fleet_metrics)")
+    fl.add_argument("--retrain", choices=["auto", "off"], default="off",
+                    help="drift-triggered continuous retraining "
+                         "(docs/retraining.md): auto arms a "
+                         "RetrainController when the model dir carries "
+                         "a retrain.json recipe — pooled /drift alerts "
+                         "launch a sandboxed refit, validated "
+                         "candidates roll out via the "
+                         "champion/challenger path")
+    fl.add_argument("--retrain-min-interval-s", type=float, default=60.0,
+                    help="cooldown between retrain cycle starts")
+    fl.add_argument("--retrain-max-per-window", type=int, default=4,
+                    help="storm breaker: max cycle starts per hour")
+    fl.add_argument("--retrain-fit-timeout-s", type=float, default=900.0,
+                    help="refit worker wall-clock budget, then SIGKILL")
+    fl.add_argument("--retrain-poll-interval-s", type=float, default=2.0,
+                    help="pooled /drift poll cadence of the controller")
+    rw = sub.add_parser(
+        "retrain-worker",
+        help="sandboxed refit worker (one candidate model per run): the "
+             "unit the retrain controller launches, times out, retries "
+             "and quarantines (docs/retraining.md); normally spawned by "
+             "the controller, manual runs take the same spec.json")
+    rw.add_argument("spec", help="RefitSpec JSON written by the "
+                                 "controller (champion dir, builder, "
+                                 "history + window data, holdout split)")
     mo = sub.add_parser(
         "monitor",
         help="offline drift report: score a bulk file through the "
@@ -586,6 +611,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if a.command == "monitor":
         from .monitor.offline import run_monitor
         return run_monitor(a)
+    if a.command == "retrain-worker":
+        from .retrain.refit import run_retrain_worker
+        return run_retrain_worker(a)
     return 1
 
 

@@ -26,6 +26,11 @@ process into a fleet:
   down without a dropped request;
 - :mod:`frontend` — the fleet HTTP server + the
   ``python -m transmogrifai_tpu fleet <model_dir> --replicas N`` CLI.
+
+The loop closes one layer up: ``--retrain auto`` arms a
+:class:`~transmogrifai_tpu.retrain.RetrainController` that tails the
+fleet's pooled ``/drift`` verdict and drives drift -> refit -> validate
+-> this package's rollout path (docs/retraining.md).
 """
 from .frontend import FleetFrontend, make_fleet_server, run_fleet
 from .rollout import RolloutConflict, RolloutManager

@@ -27,6 +27,11 @@ Endpoints:
                        ..} -> start a champion/challenger rollout
   GET  /rollout        rollout status (state machine + last verdict)
   POST /rollout/abort  tear the challenger down, keep champions
+  POST /retrain        manual retrain trigger ({"force": bool}); 409 on
+                       a concurrent cycle, mirroring RolloutConflict
+                       (docs/retraining.md)
+  GET  /retrainz       retrain controller status: state machine, last
+                       candidate verdict, quarantine list
 """
 from __future__ import annotations
 
@@ -41,6 +46,7 @@ from typing import Any, Dict, List, Optional
 
 from ..monitor.alerts import DriftPolicy
 from ..monitor.profile import ReferenceProfile
+from ..retrain.controller import RetrainConflict
 from ..serve import reqtrace
 from ..serve.reqtrace import (GaugeSampler, ReqTracer, RequestTrace,
                               thread_dump)
@@ -68,12 +74,21 @@ class FleetFrontend:
     def __init__(self, supervisor: Supervisor, router: Router,
                  rollout: Optional[RolloutManager] = None, *,
                  profile: Optional[ReferenceProfile] = None,
-                 policy: Optional[DriftPolicy] = None):
+                 policy: Optional[DriftPolicy] = None,
+                 retrain: Optional[Any] = None):
         self.supervisor = supervisor
         self.router = router
         self.rollout = rollout
         self.profile = profile
         self.policy = policy or DriftPolicy()
+        #: retrain controller (retrain/controller.py) — optional; when
+        #: wired, successful single-record bodies tap into its traffic
+        #: ring and POST /retrain + GET /retrainz come alive
+        self.retrain = retrain
+        #: which champion model dir self.profile was loaded for — after
+        #: a rollout swap the pooled /drift verdict must compare against
+        #: the NEW champion's profile or drift could never clear on it
+        self._profile_dir: Optional[str] = None
         self._draining = threading.Event()
         # router-side request tracer (observability.md "Request
         # tracing"): the frontend guarantees one exists — it mints the
@@ -99,8 +114,15 @@ class FleetFrontend:
     def forward_score(self, body: bytes,
                       trace: Optional[RequestTrace] = None,
                       headers: Optional[Dict[str, str]] = None):
-        return self.router.forward_score(body, trace=trace,
-                                         headers=headers)
+        status, data = self.router.forward_score(body, trace=trace,
+                                                 headers=headers)
+        # traffic tap for the retrain controller's "recent window":
+        # successful SINGLE-record bodies only (bulk bodies are batch
+        # jobs), one bounded deque append on the request thread
+        if (self.retrain is not None and status == 200
+                and body[:1] == b"{"):
+            self.retrain.tap(body)
+        return status, data
 
     def submit(self, record: Record) -> Record:
         """In-process single-record scoring through the full router path
@@ -108,7 +130,9 @@ class FleetFrontend:
         HTTP surface; raises RuntimeError on replica-side 4xx/5xx."""
         rt = self.tracer.start(None)
         try:
-            status, data = self.router.forward_score(
+            # through the frontend's own forward_score so in-process
+            # callers feed the retrain traffic tap exactly like HTTP ones
+            status, data = self.forward_score(
                 json.dumps(record).encode(), trace=rt)
         except FleetUnavailable as e:
             self.tracer.finish(rt, status=e.status,
@@ -146,6 +170,8 @@ class FleetFrontend:
                "draining": self._draining.is_set(), "replicas": reps}
         if self.rollout is not None:
             out["rollout"] = self.rollout.status()
+        if self.retrain is not None:
+            out["retrain_state"] = self.retrain.effective_state()
         return out
 
     # -- merged telemetry ---------------------------------------------------
@@ -230,10 +256,44 @@ class FleetFrontend:
             out["rollout_state"] = self.rollout.state
         return out
 
+    def _current_profile(self) -> Optional[ReferenceProfile]:
+        """The reference profile of the CURRENTLY serving champion
+        pool. A rollout swap changes the champion model dir; the pooled
+        verdict must then compare against the new champion's
+        monitor.json (the retrain acceptance pin "drift clears on the
+        new champion" depends on it). Falls back to the as-constructed
+        profile when the dir has none (stub replicas in tests)."""
+        if self.profile is None:
+            return None  # monitoring off for this fleet stays off —
+            # a swap must not silently turn /drift on
+        with self.router.lock:
+            pool = self.router.champions
+            model_dir = pool[0].model_dir if pool else None
+        if model_dir and model_dir != self._profile_dir:
+            from ..workflow.io import load_monitor_profile
+            doc = load_monitor_profile(model_dir)
+            if doc is not None:
+                try:
+                    self.profile = ReferenceProfile.from_json(doc)
+                except Exception:
+                    _log.exception("fleet: unusable monitor.json under "
+                                   "%s; keeping the previous pooled-"
+                                   "drift profile", model_dir)
+            elif self._profile_dir is not None:
+                _log.warning("fleet: champion %s has no monitor.json; "
+                             "pooled /drift keeps the previous "
+                             "champion's profile", model_dir)
+            # artifacts are immutable: remember the dir either way so a
+            # profile-less (or corrupt) champion logs ONCE, not on
+            # every 2s poll for the rest of the fleet's life
+            self._profile_dir = model_dir
+        return self.profile
+
     def drift(self) -> Optional[Dict[str, Any]]:
         """Pooled fleet drift (None -> 404 when monitoring is off):
         every champion's current window state, summed, one verdict."""
-        if self.profile is None:
+        profile = self._current_profile()
+        if profile is None:
             return None
         states: List[Dict[str, Any]] = []
         per: List[Dict[str, Any]] = []
@@ -242,7 +302,7 @@ class FleetFrontend:
                 states.append(st)
                 per.append({"name": desc["name"], "url": desc["url"],
                             "rows": st.get("rows")})
-        return telemetry.fleet_drift(self.profile, states,
+        return telemetry.fleet_drift(profile, states,
                                      policy=self.policy, per_replica=per)
 
     # -- rollout ------------------------------------------------------------
@@ -254,6 +314,20 @@ class FleetFrontend:
         return self.rollout.start(model_dir, fraction=fraction,
                                   min_shadow=min_shadow,
                                   replicas=replicas)
+
+    # -- retrain ------------------------------------------------------------
+    def start_retrain(self, *, force: bool = False) -> Dict[str, Any]:
+        """Manual retrain trigger (``POST /retrain``). Raises
+        RetrainConflict (HTTP 409) on a concurrent cycle or an
+        un-forced cooldown/storm suppression."""
+        if self.retrain is None:
+            raise RuntimeError("retrain controller not configured")
+        return self.retrain.trigger(reason="manual", force=force)
+
+    def retrainz(self) -> Optional[Dict[str, Any]]:
+        """The ``GET /retrainz`` payload (None -> 404 when no
+        controller is wired)."""
+        return None if self.retrain is None else self.retrain.status()
 
 
 class _FleetHandler(BaseHTTPRequestHandler):
@@ -316,6 +390,14 @@ class _FleetHandler(BaseHTTPRequestHandler):
                     self._reply(404, {"error": "no rollout manager"})
                 else:
                     self._reply(200, fe.rollout.status())
+            elif self.path == "/retrainz":
+                r = fe.retrainz()
+                if r is None:
+                    self._reply(404, {"error": "no retrain controller "
+                                               "configured for this "
+                                               "fleet"})
+                else:
+                    self._reply(200, r)
             else:
                 self._reply(404, {"error": f"unknown path {self.path}"})
         except Exception as e:  # pragma: no cover - systemic faults
@@ -385,6 +467,16 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 else:
                     fe.rollout.abort()
                     self._reply(200, fe.rollout.status())
+            elif self.path == "/retrain":
+                if fe.retrain is None:
+                    self._reply(404, {"error": "no retrain controller "
+                                               "configured for this "
+                                               "fleet"})
+                else:
+                    doc = json.loads(body or b"{}")
+                    out = fe.start_retrain(
+                        force=bool(doc.get("force", False)))
+                    self._reply(200, out)
             elif self.path == "/drain":
                 # REST-proper alias of GET /drain (which the fleet keeps
                 # for parity with the replica endpoint + curl ergonomics)
@@ -393,8 +485,9 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 self._reply(404, {"error": f"unknown path {self.path}"})
         except (json.JSONDecodeError, KeyError, ValueError) as e:
             self._reply(400, {"error": f"{type(e).__name__}: {e}"})
-        except RolloutConflict as e:
-            # retryable: another rollout holds the slot right now
+        except (RolloutConflict, RetrainConflict) as e:
+            # retryable: another rollout/retrain holds the slot NOW —
+            # same 409 contract for both loops
             self._reply(409, {"error": str(e)})
         except Exception as e:
             # incl. challenger STARTUP failures (broken artifact, prewarm
@@ -491,8 +584,67 @@ def run_fleet(args: Any) -> int:
         score_lo=pred.lo if pred else 0.0,
         score_hi=pred.hi if pred else 1.0,
         score_field=pred.field if pred else None)
+    # drift-triggered continuous retraining (docs/retraining.md):
+    # --retrain auto wires a RetrainController when the model ships a
+    # retrain.json recipe; its trigger source is the fleet's own pooled
+    # /drift verdict (window_id + model hash ride the payload). Built
+    # BEFORE the frontend so the controller rides its constructor
+    # (construction happens-before the HTTP threads that read it);
+    # the poll closure binds `frontend` late — start() runs after the
+    # frontend exists.
+    retrain_ctl = None
+    if getattr(args, "retrain", "off") == "auto":
+        from ..retrain import controller as RC
+        from ..retrain.refit import load_recipe
+
+        def _champion_dir() -> Optional[str]:
+            with router.lock:
+                pool = router.champions
+                return pool[0].model_dir if pool else None
+
+        def _pooled_drift():
+            return frontend.drift()
+
+        recipe_doc = load_recipe(args.model_dir)
+        if recipe_doc is None:
+            _log.warning("fleet: --retrain auto but %s has no "
+                         "retrain.json recipe; controller disabled",
+                         args.model_dir)
+        else:
+            # the recipe's rollout_* verdict relaxation is applied by
+            # the controller PER retrain rollout (start(thresholds=)),
+            # never to the shared manager — operator-initiated
+            # POST /rollout keeps the fleet's base guards
+            retrain_ctl = RC.RetrainController(
+                _champion_dir,
+                root=os.path.join(metrics_loc, "retrain"),
+                rollout=rollout,
+                # the controller keeps the recipe: after a swap the
+                # champion dir is the CANDIDATE dir (the worker copies
+                # retrain.json into it too, but the handed recipe makes
+                # cycle 2 independent of that copy — continuous, not
+                # one-shot)
+                recipe=recipe_doc,
+                policy=RC.RetrainPolicy(
+                    min_interval_s=float(getattr(
+                        args, "retrain_min_interval_s", 60.0)),
+                    max_retrains_per_window=int(getattr(
+                        args, "retrain_max_per_window", 4)),
+                    fit_timeout_s=float(getattr(
+                        args, "retrain_fit_timeout_s", 900.0))),
+                drift_poll=_pooled_drift,
+                drift_poll_interval_s=float(getattr(
+                    args, "retrain_poll_interval_s", 2.0)),
+                env=dict(supervisor.env))
+
     frontend = FleetFrontend(supervisor, router, rollout,
-                             profile=profile, policy=policy)
+                             profile=profile, policy=policy,
+                             retrain=retrain_ctl)
+    if retrain_ctl is not None:
+        retrain_ctl.start()
+        _log.info("fleet: retrain controller armed (journal under %s)",
+                  retrain_ctl.root)
+
     gauge_sampler = GaugeSampler(frontend.sample_gauges,
                                  ring=frontend.gauges).start()
     httpd = make_fleet_server(frontend, host=args.host, port=args.port)
@@ -522,6 +674,8 @@ def run_fleet(args: Any) -> int:
         httpd.server_close()
         gauge_sampler.stop()
         prober.stop()
+        if retrain_ctl is not None:
+            retrain_ctl.close()
         if rollout is not None:
             rollout.abort()
         supervisor.stop(router=router)

@@ -119,6 +119,11 @@ class RolloutManager:
         self.max_pred_js = float(max_pred_js)
         self.max_psi = float(max_psi)
         self.max_score_shift = float(max_score_shift)
+        #: per-ROLLOUT verdict-threshold overrides (start(thresholds=),
+        #: reset on every start): a retrain cycle relaxes the
+        #: comparison for ITS adapted candidate without disarming the
+        #: guards for later operator-initiated rollouts
+        self._thresholds: Dict[str, float] = {}
         self.state = IDLE
         self.challenger_dir: Optional[str] = None
         self.fraction = 0.0
@@ -152,11 +157,15 @@ class RolloutManager:
                     "last_verdict": self.last_verdict}
 
     def start(self, challenger_dir: str, *, replicas: Optional[int] = None,
-              fraction: float = 0.2, min_shadow: int = 256) -> Dict:
+              fraction: float = 0.2, min_shadow: int = 256,
+              thresholds: Optional[Dict[str, float]] = None) -> Dict:
         """Begin a rollout: prewarm + spawn the challenger pool, then
         open the shadow tap. Raises on a concurrent rollout; a
         challenger that cannot come up is REJECTED here (champions were
-        never touched)."""
+        never touched). `thresholds` overrides max_pred_js / max_psi /
+        max_score_shift for THIS rollout only (the retrain controller's
+        adapted-candidate relaxation); the next start() is back at the
+        manager's base thresholds."""
         with self.lock:
             if self.state in (WARMING, SHADOW):
                 # refuse BEFORE touching the worker: stopping it here
@@ -188,6 +197,9 @@ class RolloutManager:
             self.challenger_dir = challenger_dir
             self.fraction = float(fraction)
             self.min_shadow = int(min_shadow)
+            self._thresholds = {
+                k: float(v) for k, v in (thresholds or {}).items()
+                if k in ("max_pred_js", "max_psi", "max_score_shift")}
             self.shadow_pairs = 0
             self.shadow_dropped = 0
             self.shadow_errors = 0
@@ -361,19 +373,23 @@ class RolloutManager:
             h1, h2 = self._v1_hist.copy(), self._v2_hist.copy()
             n = self.shadow_pairs
             s1, s2 = self._v1_sum, self._v2_sum
+            ov = dict(self._thresholds)
+        js_max = ov.get("max_pred_js", self.max_pred_js)
+        psi_max = ov.get("max_psi", self.max_psi)
+        shift_max = ov.get("max_score_shift", self.max_score_shift)
         js = drift.js_divergence_hist(h1, h2)
         c1, c2 = drift.coarsen(h1), drift.coarsen(h2)
         psi = drift.psi(c1, c2)
-        psi_thr = self.max_psi + 2.0 * drift.psi_sampling_noise(c1, c2)
+        psi_thr = psi_max + 2.0 * drift.psi_sampling_noise(c1, c2)
         shift = abs(s2 / n - s1 / n) if n else 0.0
         reasons: List[str] = []
-        if js > self.max_pred_js:
-            reasons.append(f"prediction_js {js:.4f} > {self.max_pred_js}")
+        if js > js_max:
+            reasons.append(f"prediction_js {js:.4f} > {js_max}")
         if psi > psi_thr:
             reasons.append(f"prediction_psi {psi:.4f} > {psi_thr:.4f}")
-        if shift > self.max_score_shift:
+        if shift > shift_max:
             reasons.append(f"score_shift {shift:.4f} > "
-                           f"{self.max_score_shift}")
+                           f"{shift_max}")
         return {"clean": not reasons, "reasons": reasons,
                 "shadow_pairs": n, "js": round(js, 6),
                 "psi": round(psi, 6), "psi_threshold": round(psi_thr, 6),
@@ -434,6 +450,12 @@ class RolloutManager:
             self.router.shadow_hook = None
             self.router.shadow_fraction = 0.0
             self.state = REJECTED
+            # an abort is an OPERATOR decision, not a shadow verdict:
+            # the marker lets a consumer (the retrain controller) tell
+            # "the model failed at traffic" from "someone needed the
+            # slot" — the latter must not ban the candidate
+            self.last_verdict = {"clean": False, "reasons": ["aborted"],
+                                 "aborted": True}
             pool = list(self.router.challengers)
             challenger_dir = self.challenger_dir
         self._stop.set()

@@ -28,6 +28,8 @@ single replica's — the golden-parity acceptance pin.
 """
 from __future__ import annotations
 
+import hashlib
+import os
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -43,6 +45,17 @@ from ..utils.metrics import LatencyHistogram
 #: request-tracing segment decomposition, observability.md)
 _SUM_KEYS = ("requests", "batches", "rows", "shed",
              "post_warmup_compiles", "pad_rows", "bucket_rows")
+
+#: FALLBACK namespace tag for pooled /drift window_ids when replica
+#: window states carry no monitor nonce (stub replicas in tests): a
+#: restarted fleet's window indices restart at 0, and the retrain
+#: controller's quarantine ledger keys on (champion_hash, window_id)
+#: FOREVER — without a fresh tag a new incarnation's pooled window
+#: could collide with a quarantined id and suppress genuinely new
+#: drift. Real fleets get a tag digested from the contributing
+#: monitors' own nonces (fleet_drift), which also covers a single
+#: replica restarting WITHIN a long-lived fleet process.
+_POOL_NONCE = os.urandom(4).hex()
 
 
 def merge_latency(docs: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -217,6 +230,26 @@ def fleet_drift(profile: ReferenceProfile,
     good = [s for s in states if isinstance(s, dict)]
     snap = merge_window_states(good)
     report = drift.window_report(profile, snap, policy)
+    # pooled window identity, DETERMINISTIC per poll cycle: the same
+    # still-open pooled window polled twice yields the same id, so an
+    # alert consumer (the retrain controller's /drift poll) dedupes
+    # repeat reads; a rollover bumps the max window_index and mints a
+    # fresh id. The namespace tag digests the contributing monitors'
+    # OWN nonces (each ServeMonitor mints one per construction): a
+    # restarted replica — or a restarted fleet — brings a fresh monitor,
+    # its indices restart at 0, and without a fresh tag its pooled "w3"
+    # would collide with dedupe/quarantine state recorded against a
+    # previous incarnation's windows, silently suppressing genuinely
+    # new drift. Falls back to the per-process nonce when states carry
+    # no nonce (stub replicas). Model hash rides along for the
+    # stale-alert check.
+    nonces = sorted({str(s.get("nonce")) for s in good
+                     if isinstance(s, dict) and s.get("nonce")})
+    tag = (hashlib.sha256("|".join(nonces).encode()).hexdigest()[:8]
+           if nonces else _POOL_NONCE)
+    report["window_id"] = (f"{profile.model_hash or 'unstamped'}:"
+                           f"fleet-{tag}:w{int(snap.index)}")
+    report["model_content_hash"] = profile.model_hash
     out: Dict[str, Any] = {
         "replicas_reporting": len(good),
         "rows_pooled": snap.rows,

@@ -99,6 +99,12 @@ class ReferenceProfile:
     features: List[FeatureProfile] = field(default_factory=list)
     prediction: Optional[PredictionProfile] = None
     version: int = PROFILE_VERSION
+    #: content hash of the model artifact this profile was frozen next
+    #: to (workflow/io.model_content_hash, stamped by save_profile_for).
+    #: Rides every drift_alert payload so a consumer (the retrain
+    #: controller) can discard a STALE alert raised by a pre-swap
+    #: model's monitor; None on pre-stamp profiles.
+    model_hash: Optional[str] = None
 
     def feature(self, name: str) -> Optional[FeatureProfile]:
         return next((f for f in self.features if f.name == name), None)
@@ -123,7 +129,8 @@ class ReferenceProfile:
                 "pred_bins": self.pred_bins, "rows": self.rows,
                 "features": [f.to_json() for f in self.features],
                 "prediction": (self.prediction.to_json()
-                               if self.prediction else None)}
+                               if self.prediction else None),
+                "model_hash": self.model_hash}
 
     @staticmethod
     def from_json(d: Dict[str, Any]) -> "ReferenceProfile":
@@ -133,7 +140,8 @@ class ReferenceProfile:
             features=[FeatureProfile.from_json(x) for x in d["features"]],
             prediction=(PredictionProfile.from_json(d["prediction"])
                         if d.get("prediction") else None),
-            version=int(d.get("version", PROFILE_VERSION)))
+            version=int(d.get("version", PROFILE_VERSION)),
+            model_hash=d.get("model_hash"))
 
 
 # -- score extraction ---------------------------------------------------------
@@ -269,6 +277,12 @@ def save_profile_for(model: Any, path: str) -> Optional[str]:
         return None  # loaded/reconstructed model: no training data cached
     try:
         profile = build_profile(model)
+        # stamp the artifact identity: save_model writes op-model.json +
+        # arrays.npz BEFORE calling here, so the hash names exactly the
+        # model this profile describes — drift_alert payloads carry it
+        # and the retrain controller drops alerts from a pre-swap model
+        from ..workflow.io import model_content_hash
+        profile.model_hash = model_content_hash(path)
         return save_monitor_profile(path, profile.to_json())
     except Exception:
         _log.exception("monitor: reference-profile build failed; model "

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -128,6 +129,14 @@ class ServeMonitor:
         self._lo = jnp.asarray(edges["lo"]) if self._K else None
         self._hi = jnp.asarray(edges["hi"]) if self._K else None
         self._lock = threading.RLock()
+        # identity prefix of every window this monitor closes: the
+        # profile's model hash (which model's reference) + a per-monitor
+        # nonce (two replicas of the same model both close "window 3" —
+        # their alerts must NOT dedupe against each other). The id is
+        # STABLE for one window: every alert a window raises shares it,
+        # which is exactly what lets a consumer collapse the N
+        # per-feature alerts of one window into one trigger.
+        self._window_uid = os.urandom(4).hex()
         self.n_windows = 0
         self.alerts_total = 0
         self.rows_total = 0
@@ -274,6 +283,12 @@ class ServeMonitor:
             hists=hists, nulls=nulls, pred_hist=self._pred_hist,
             pred_count=self._pred_count, pred_sum=self._pred_sum)
         report = drift.window_report(self.profile, snap, self.policy)
+        # stable window identity + the profiled model's content hash:
+        # repeated alerts for ONE window share window_id (a consumer
+        # dedupes the per-feature fan-out into one trigger) and a stale
+        # alert from a pre-swap model is recognizable by hash mismatch
+        report["window_id"] = self.window_id(snap.index)
+        report["model_content_hash"] = self.profile.model_hash
         self.n_windows += 1
         alerts = report["alerts"]
         self.alerts_total += len(alerts)
@@ -281,6 +296,7 @@ class ServeMonitor:
         self.last_report = report
         self.history.append(report)
         collector.event("drift_window", window=report["window"],
+                        window_id=report["window_id"],
                         rows=report["rows"],
                         wall_seconds=round(report["wall_s"], 3),
                         worst_feature=report["worst_feature"],
@@ -288,13 +304,22 @@ class ServeMonitor:
                         alerts=len(alerts))
         self._t_last_close = time.monotonic()
         for a in alerts:
-            collector.event("drift_alert", window=report["window"], **a)
+            collector.event("drift_alert", window=report["window"],
+                            window_id=report["window_id"],
+                            model_content_hash=report[
+                                "model_content_hash"], **a)
             _log.warning("drift_alert window=%d %s %s=%s > %.4f",
                          report["window"], a["target"], a["metric"],
                          "inf" if a["value"] is None
                          else f"{a['value']:.4f}", a["threshold"])
         self._reset_window()
         return report
+
+    def window_id(self, index: int) -> str:
+        """The stable identity of window `index` for THIS monitor over
+        THIS model: ``<model_hash>:<monitor-nonce>:w<index>``."""
+        return (f"{self.profile.model_hash or 'unstamped'}:"
+                f"{self._window_uid}:w{int(index)}")
 
     def window_state(self) -> Dict[str, Any]:
         """The CURRENT (still-open) window's raw sufficient statistics
@@ -324,6 +349,7 @@ class ServeMonitor:
                 nulls[nm] = float(self._hash_nulls[nm])
             return {
                 "window_index": self.n_windows,
+                "nonce": self._window_uid,
                 "rows": float(self._rows),
                 "wall_s": round(time.monotonic() - self._t_open, 6),
                 "hists": hists,

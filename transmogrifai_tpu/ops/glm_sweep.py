@@ -899,6 +899,7 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
                               standardize: bool = True, mesh=None,
                               round_iters: Optional[int] = None,
                               warm_start: bool = True,
+                              warm_seed: Optional[Tuple] = None,
                               state: Optional[Dict[str, Any]] = None,
                               on_round: Optional[Callable] = None
                               ) -> Tuple[np.ndarray, np.ndarray,
@@ -914,6 +915,17 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
     strongest-regularization lane and seeds the rest of the fold from it
     (glmnet-style pathwise continuation), so low-reg lanes start near
     their optimum instead of at zero; TMOG_GLM_WARMSTART=0 disables.
+
+    `warm_seed` is the SAME continuation applied ACROSS TIME instead of
+    across the regularization path (the retrain controller's refit):
+    ``(beta_raw [d], b0_raw)`` — a previously-fitted model's RAW-unit
+    coefficients seed EVERY lane (converted into this sweep's
+    standardized space once mean/std are known) and replace the
+    pathwise round 0, so a refit over shifted data starts near the
+    serving model's optimum. Ignored when the dimension disagrees with
+    this sweep's `d` (the vectorization changed — cold start is the
+    only honest option) or when a resumed `state` already carries
+    coefficients.
 
     X/y/w/fold_masks are device arrays (pre-sharded when `mesh` is given,
     exactly like sweep_glm_streamed_sharded's contract) — OR X is a
@@ -996,6 +1008,25 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
     l1v = np.tile(regs * alphas, F).astype(np.float32)
     l2v = np.tile(regs * (1.0 - alphas), F).astype(np.float32)
     st = state if state is not None else _new_round_state(L, d)
+
+    warm_seeded = False
+    if (warm_seed is not None and not st["warmed"]
+            and not st["retired"].any() and int(st["iters"].max()) == 0):
+        seed_b = np.asarray(warm_seed[0], np.float32).reshape(-1)
+        if seed_b.shape[0] == d:
+            # across-time continuation: convert the RAW-unit seed into
+            # THIS sweep's standardized space (st["B"] lives there; the
+            # final unstandardize below inverts exactly this map)
+            mean_h = np.asarray(mean, np.float32)[:d]
+            std_h = np.asarray(std, np.float32)[:d]
+            b_std = seed_b * std_h
+            st["B"][:] = b_std[None, :]
+            st["b0"][:] = (float(warm_seed[1])
+                           + float((seed_b * mean_h).sum()))
+            # the seed plays round 0's role: every lane starts near a
+            # known-good solution, so the pathwise warm round is skipped
+            st["warmed"] = True
+            warm_seeded = True
 
     # span hook: each retirement round is one child span of whatever the
     # validator opened (run -> sweep_fit -> sweep_round), carrying the
@@ -1137,7 +1168,8 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
             "active_per_round": [int(v) for v in st["active_per_round"]],
             "iters_per_round": [int(v) for v in st["iters_per_round"]],
             "bucket_sizes": [int(v) for v in st["bucket_sizes"]],
-            "warm_start": bool(st["warmed"])}
+            "warm_start": bool(st["warmed"]),
+            "warm_seeded": warm_seeded}
     return B.reshape(F, Gn, d), b0.reshape(F, Gn), info
 
 

@@ -1,0 +1,36 @@
+"""Drift-triggered continuous retraining (docs/retraining.md).
+
+The loop ROADMAP item 4 names, closed: the drift monitor (monitor/)
+detects that the world changed, this package retrains — a sandboxed
+refit worker over the recent traffic window plus historical data, GLM
+lanes warm-started from the serving model's coefficients and the sweep
+narrowed to the champion's winning config — validates the candidate
+behind a hard gate, and hands it to the fleet's zero-downtime
+champion/challenger rollout (fleet/rollout.py). Every transition is
+journaled (crash-safe resume, exactly one rollout) and every failure
+class lands in quarantine with its evidence while the champion keeps
+serving.
+
+- :mod:`controller` — the RetrainController state machine
+  (IDLE -> TRIGGERED -> FITTING -> VALIDATING -> ROLLING_OUT ->
+  COOLDOWN, QUARANTINED for failed candidates), trigger debounce,
+  storm breaker, fault containment;
+- :mod:`refit` — the ``retrain-worker`` subprocess body, RefitSpec /
+  retrain.json recipe contract, TMOG_RETRAIN_FAULT injection hooks;
+- :mod:`journal` — the append+fsync transition journal.
+"""
+from .controller import (COOLDOWN, FITTING, IDLE, QUARANTINED,
+                         ROLLING_OUT, TRIGGERED, VALIDATING,
+                         RetrainConflict, RetrainController,
+                         RetrainPolicy)
+from .journal import RetrainJournal
+from .refit import (FAULT_CLASSES, FAULT_ENV, RefitSpec, injected_fault,
+                    load_recipe, run_refit, run_retrain_worker)
+
+__all__ = [
+    "RetrainController", "RetrainPolicy", "RetrainConflict",
+    "RetrainJournal", "RefitSpec", "run_refit", "run_retrain_worker",
+    "load_recipe", "injected_fault", "FAULT_ENV", "FAULT_CLASSES",
+    "IDLE", "TRIGGERED", "FITTING", "VALIDATING", "ROLLING_OUT",
+    "COOLDOWN", "QUARANTINED",
+]

@@ -1,0 +1,1310 @@
+"""RetrainController: drift alert -> refit -> validate -> rollout,
+with hard failure containment (docs/retraining.md).
+
+The state machine (one cycle at a time)::
+
+    IDLE -> TRIGGERED -> FITTING -> VALIDATING -> ROLLING_OUT -> COOLDOWN
+                             |           |             |
+                             v           v             v
+                         QUARANTINED (cycle terminal; controller cools down)
+
+- **Triggers**: ``drift_alert`` events tailed from an event log
+  (utils/tracing.follow_events — rotation-safe), the fleet's pooled
+  ``GET /drift`` verdict (a poll callable), or a manual ``POST
+  /retrain``. Alerts are debounced: the per-window ``window_id``
+  collapses a window's per-feature alert fan-out into one trigger, a
+  ``model_content_hash`` mismatch drops stale alerts raised by a
+  pre-swap model's monitor, `min_interval_s` cooldown separates cycles,
+  and the storm breaker refuses more than `max_retrains_per_window`
+  cycle starts per `storm_window_s` (a flapping feature cannot melt the
+  training budget).
+- **FITTING** is a sandboxed SUBPROCESS (retrain/refit.py) with a hard
+  timeout and exponential-backoff retries: a crashed/hung/OOM'd refit
+  takes down exactly one worker process, and the champion fleet never
+  stops serving.
+- **VALIDATING** is the gate between a candidate and traffic: the
+  artifact must LOAD, the monitor profile must have been rebuilt, the
+  holdout gate metric must be within tolerance of the champion ON THE
+  SAME HOLDOUT, the offline ``monitor`` CLI must be green on a replay
+  of the triggering traffic window, and a candidate byte-identical to a
+  previously quarantined one is refused outright (nothing quarantined
+  is ever retried verbatim).
+- **ROLLING_OUT** hands the candidate to the fleet's existing
+  shadow -> verdict -> swap path (fleet/rollout.RolloutManager,
+  duck-typed) and waits for the terminal verdict.
+- **QUARANTINED**: the whole cycle directory (spec, window snapshot,
+  worker log, candidate artifact, report) moves to
+  ``quarantine/<cycle>/`` and a ledger line records why — evidence
+  preserved, champion untouched.
+
+Crash safety: every transition is journaled (retrain/journal.py,
+append+fsync) BEFORE its side effect starts, so ``kill -9`` of the
+controller at any point resumes exactly once: a mid-FITTING kill reaps
+the orphaned worker via its pid file and relaunches with the attempt
+budget it had left; a mid-ROLLING_OUT kill first probes whether the
+swap already landed (current champion hash == journaled candidate
+hash) — if it did, the cycle completes without a second rollout, and if
+it provably did not, exactly one recovery rollout runs.
+
+Fault injection: ``TMOG_RETRAIN_FAULT=rollout_reject`` is handled HERE
+(the other classes fire inside the worker): the verdict path is forced
+to the rejected branch so tests and ci.sh can prove the containment of
+a dirty shadow verdict without shipping a deliberately-bad model.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ..utils.metrics import collector
+from ..utils.tracing import follow_events
+from ..workflow.io import model_content_hash
+from . import refit as RF
+from .journal import RetrainJournal
+
+_log = logging.getLogger("transmogrifai_tpu.retrain")
+
+IDLE = "idle"
+TRIGGERED = "triggered"
+FITTING = "fitting"
+VALIDATING = "validating"
+ROLLING_OUT = "rolling_out"
+COOLDOWN = "cooldown"
+QUARANTINED = "quarantined"
+
+#: rollout terminal states the controller waits for (the fleet
+#: RolloutManager's vocabulary)
+_ROLLOUT_DONE = ("swapped", "rejected")
+_ROLLOUT_LIVE = ("warming", "shadow")
+
+
+class RetrainConflict(RuntimeError):
+    """A retrain cycle is already in flight (or the trigger is
+    suppressed by cooldown/storm policy without force): well-formed but
+    cannot proceed NOW — the fleet frontend maps this to HTTP 409,
+    mirroring RolloutConflict."""
+
+
+@dataclass
+class RetrainPolicy:
+    """Debounce/containment knobs of one controller."""
+
+    min_interval_s: float = 60.0      # cooldown between cycle starts
+    storm_window_s: float = 3600.0    # storm-breaker lookback
+    max_retrains_per_window: int = 4  # cycle starts per storm window
+    fit_timeout_s: float = 900.0      # worker wall clock, then SIGKILL
+    fit_attempts: int = 3             # total tries (1 + retries)
+    backoff_base_s: float = 1.0       # exponential retry backoff
+    backoff_cap_s: float = 30.0
+    metric_tolerance: float = 0.02    # holdout gate slack vs champion
+    require_monitor_green: bool = True  # offline replay gate on window
+    monitor_timeout_s: float = 300.0  # replay subprocess budget
+    sandbox_load_probe: bool = True   # artifact load gate in a child proc
+    load_probe_timeout_s: float = 120.0  # load-probe subprocess budget
+    rollout_timeout_s: float = 600.0  # shadow -> verdict budget
+    rollout_fraction: float = 0.5     # shadow mirror fraction
+    rollout_min_shadow: int = 64      # pairs before the verdict
+    window_capacity: int = 4096       # traffic-tap ring bound
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
+class _Cycle:
+    """One retrain cycle's context (reconstructable from the journal)."""
+
+    def __init__(self, cycle_id: str, cycle_dir: str,
+                 trigger: Optional[Dict[str, Any]] = None,
+                 champion_dir: str = "", champion_hash: Optional[str] = None):
+        self.id = cycle_id
+        self.dir = cycle_dir
+        self.trigger = trigger or {}
+        self.champion_dir = champion_dir
+        self.champion_hash = champion_hash
+        self.attempt = 0
+        self.report: Optional[Dict[str, Any]] = None
+        self.candidate_hash: Optional[str] = None
+
+    @property
+    def spec_path(self) -> str:
+        return os.path.join(self.dir, RF.SPEC_JSON)
+
+    @property
+    def candidate_dir(self) -> str:
+        return os.path.join(self.dir, "candidate")
+
+    @property
+    def window_path(self) -> str:
+        return os.path.join(self.dir, "window.csv")
+
+
+class RetrainController:
+    """Close the loop: drift alerts in, validated rollouts out.
+
+    Collaborators are duck-typed for testability: `rollout` needs
+    ``start(dir, fraction=, min_shadow=, replicas=)`` + ``status() ->
+    {"state": ...}`` (the fleet RolloutManager fits); `launcher`
+    (tests inject fakes) takes a spec path and returns a Popen-like
+    object with poll/wait/kill; `champion_dir_fn` returns the model dir
+    currently serving (it CHANGES after a swap). `alert_log` is an
+    events.jsonl path to tail; `drift_poll` a callable returning the
+    fleet's pooled /drift payload (either or both may be None)."""
+
+    def __init__(self, champion_dir_fn: Callable[[], Optional[str]], *,
+                 root: str,
+                 rollout: Any = None,
+                 policy: Optional[RetrainPolicy] = None,
+                 recipe: Optional[Dict[str, Any]] = None,
+                 launcher: Optional[Callable[[str], Any]] = None,
+                 alert_log: Optional[str] = None,
+                 drift_poll: Optional[Callable[[], Any]] = None,
+                 drift_poll_interval_s: float = 2.0,
+                 python: str = sys.executable,
+                 env: Optional[Dict[str, str]] = None):
+        self.champion_dir_fn = champion_dir_fn
+        self.root = root
+        self.rollout = rollout
+        self.policy = policy or RetrainPolicy()
+        self._recipe = recipe
+        self._launcher = launcher or self._spawn_worker
+        self.alert_log = alert_log
+        self.drift_poll = drift_poll
+        self.drift_poll_interval_s = float(drift_poll_interval_s)
+        self.python = python
+        self.env = dict(os.environ)
+        if env:
+            self.env.update(env)
+        # every child (worker, monitor replay, load probe) must import
+        # THIS package, wherever the parent was launched from
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        pp = self.env.get("PYTHONPATH")
+        if not pp:
+            self.env["PYTHONPATH"] = pkg_root
+        elif pkg_root not in pp.split(os.pathsep):
+            self.env["PYTHONPATH"] = pkg_root + os.pathsep + pp
+        os.makedirs(root, exist_ok=True)
+        self.quarantine_root = os.path.join(root, "quarantine")
+        os.makedirs(self.quarantine_root, exist_ok=True)
+        self.journal = RetrainJournal(os.path.join(root, "journal.jsonl"))
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self.state = IDLE
+        self.cycle: Optional[_Cycle] = None
+        self.last_verdict: Optional[Dict[str, Any]] = None
+        self.cycles_total = 0
+        self.swapped_total = 0
+        self.quarantined_total = 0
+        self.suppressed: Dict[str, int] = {}
+        self._last_cycle_end = -float("inf")
+        self._cycle_starts: "deque[float]" = deque(maxlen=256)
+        #: (window_id, target, metric) triples already triggered/judged —
+        #: the double-trigger dedupe (bounded)
+        self._seen_alerts: "deque[Tuple]" = deque(maxlen=1024)
+        self._seen_set: Set[Tuple] = set()
+        #: champion-dir -> content hash (immutable artifacts; a swap
+        #: changes the DIR) — _champion_hash runs per alert
+        self._hash_cache: Dict[str, str] = {}
+        #: one retrain_storm_breaker event per breaker episode (the
+        #: poll re-delivers suppressed alerts every couple of seconds)
+        self._storm_announced = False
+        #: same discipline for "unconfigured": one evented suppression
+        #: per missing-recipe episode, not one per poll delivery
+        self._unconfigured_announced = False
+        #: raw single-record /score bodies tapped off live traffic —
+        #: the "recent traffic window" the refit and the replay gate see
+        self._traffic: "deque[bytes]" = deque(
+            maxlen=self.policy.window_capacity)
+        self._cycle_thread: Optional[threading.Thread] = None
+        self._alert_thread: Optional[threading.Thread] = None
+        self._poll_thread: Optional[threading.Thread] = None
+        self._load_quarantine_index()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "RetrainController":
+        """Resume any journaled in-flight cycle, then start the alert
+        tail / drift poll threads."""
+        self.resume()
+        if self.alert_log is not None:
+            self._alert_thread = threading.Thread(
+                target=self._tail_loop, name="retrain-tail", daemon=True)
+            self._alert_thread.start()
+        if self.drift_poll is not None:
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, name="retrain-poll", daemon=True)
+            self._poll_thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        for t in (self._alert_thread, self._poll_thread,
+                  self._cycle_thread):
+            if t is not None and t.is_alive():
+                t.join(10.0)
+        t = self._cycle_thread
+        if t is None or not t.is_alive():
+            self.journal.close()
+        else:
+            # a straggling cycle thread (a validation replay can run
+            # minutes with no stop checks) may still need to journal
+            # its pause state — closing under it would turn the append
+            # into an exception; the fd dies with the process anyway
+            _log.warning("retrain: close() leaving the journal open "
+                         "for a still-running cycle thread")
+
+    # -- traffic tap ---------------------------------------------------------
+    def tap(self, body: bytes) -> None:
+        """Record one successful single-record /score request body (the
+        fleet frontend calls this post-reply). deque append is atomic
+        and bounded — the request thread pays one append, nothing
+        else."""
+        self._traffic.append(body)
+
+    # -- status --------------------------------------------------------------
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            cyc = self.cycle
+            return {
+                "state": self.effective_state(),
+                "cycle": None if cyc is None else {
+                    "id": cyc.id, "dir": cyc.dir,
+                    "attempt": cyc.attempt,
+                    "champion_dir": cyc.champion_dir,
+                    "trigger": cyc.trigger},
+                "last_verdict": self.last_verdict,
+                "cycles_total": self.cycles_total,
+                "swapped_total": self.swapped_total,
+                "quarantined_total": self.quarantined_total,
+                "suppressed": dict(self.suppressed),
+                "cooldown_remaining_s": round(
+                    max(self._cooldown_remaining(), 0.0), 3),
+                "quarantine": self.quarantine_list(),
+                "policy": self.policy.to_json(),
+                "window_rows_tapped": len(self._traffic),
+            }
+
+    def effective_state(self) -> str:
+        """COOLDOWN decays to IDLE once min_interval_s has passed."""
+        with self._lock:
+            if self.state == COOLDOWN and self._cooldown_remaining() <= 0:
+                return IDLE
+            return self.state
+
+    def _cooldown_remaining(self) -> float:
+        with self._lock:  # reentrant — callers already hold it
+            return (self._last_cycle_end + self.policy.min_interval_s
+                    - time.monotonic())
+
+    # -- trigger paths -------------------------------------------------------
+    def handle_alert(self, alert: Dict[str, Any]) -> Optional[str]:
+        """One drift alert (event payload or pooled-/drift alert row):
+        returns the suppression reason, or None when it started a
+        cycle."""
+        wid = alert.get("window_id")
+        key = (wid, alert.get("target"), alert.get("metric")) \
+            if wid else None
+        # the (possibly first-per-champion) artifact sha256 runs before
+        # the lock is taken — /healthz polls effective_state() under it
+        champ_hash = self._champion_hash()
+        with self._lock:
+            if key is not None and key in self._seen_set:
+                return self._suppress("duplicate", alert, log=False)
+            stamped = alert.get("model_content_hash")
+            # PERMANENT suppressions remember the key (the alert can
+            # never become actionable — re-deliveries just spam);
+            # TRANSIENT ones (busy/cooldown/storm/unconfigured) must
+            # NOT: a pooled /drift poll re-delivers the same window_id
+            # while it stays open, and that re-delivery is exactly what
+            # lets a deferred trigger fire once the controller frees up
+            if stamped and champ_hash and stamped != champ_hash:
+                if key is not None:
+                    self._remember(key)
+                return self._suppress("stale_model", alert)
+            if wid and (champ_hash, wid) in self._quarantined_triggers:
+                if key is not None:
+                    self._remember(key)
+                return self._suppress("quarantined_trigger", alert)
+            # transient suppressions are counted but not evented: the
+            # pooled poll re-delivers the same alerts every couple of
+            # seconds for as long as the condition lasts (a whole
+            # 900s fit for "busy"), and per-delivery events would flood
+            # the shared fleet log the liveness tooling consumes
+            if self.state not in (IDLE, COOLDOWN):
+                return self._suppress("busy", alert, log=False)
+            if self._cooldown_remaining() > 0:
+                return self._suppress("cooldown", alert, log=False)
+            if self._storm_count() >= self.policy.max_retrains_per_window:
+                if not self._storm_announced:
+                    self._storm_announced = True
+                    collector.event("retrain_storm_breaker",
+                                    window_s=self.policy.storm_window_s,
+                                    starts=self._storm_count())
+                return self._suppress("storm_breaker", alert, log=False)
+            self._storm_announced = False
+            try:
+                reserved = self._reserve_cycle()
+            except RuntimeError as e:
+                # announce ONCE per missing-recipe episode: the pooled
+                # poll re-delivers the alert fan-out every couple of
+                # seconds for as long as the recipe stays absent, and
+                # per-delivery events would flood the shared fleet log
+                announce = not self._unconfigured_announced
+                self._unconfigured_announced = True
+                if announce:
+                    _log.warning("retrain: cannot start a cycle: %s", e)
+                return self._suppress("unconfigured", alert,
+                                      log=announce)
+            self._unconfigured_announced = False
+        # the heavy mint (window CSV, spec, journal fsync) runs outside
+        # the lock; a failure rolls the reservation back to IDLE and the
+        # un-remembered key lets the alert's re-delivery retry
+        self._launch_cycle(reserved, trigger=alert, reason="drift_alert")
+        with self._lock:
+            if key is not None:
+                self._remember(key)
+        return None
+
+    def trigger(self, reason: str = "manual",
+                force: bool = False) -> Dict[str, Any]:
+        """Manual trigger (``POST /retrain``). Raises RetrainConflict on
+        a concurrent cycle, and — unless `force` — on cooldown/storm
+        suppression. Returns status()."""
+        with self._lock:
+            if self.state not in (IDLE, COOLDOWN):
+                raise RetrainConflict(
+                    f"a retrain cycle is already {self.state}"
+                    f" ({self.cycle.id if self.cycle else '?'})")
+            if not force:
+                if self._cooldown_remaining() > 0:
+                    raise RetrainConflict(
+                        f"cooling down for another "
+                        f"{self._cooldown_remaining():.1f}s (force=true "
+                        f"overrides)")
+                if self._storm_count() >= \
+                        self.policy.max_retrains_per_window:
+                    raise RetrainConflict(
+                        "storm breaker open: "
+                        f"{self._storm_count()} retrains in the last "
+                        f"{self.policy.storm_window_s:.0f}s (force=true "
+                        "overrides)")
+            reserved = self._reserve_cycle()
+        self._launch_cycle(reserved, trigger={"reason": reason},
+                           reason=reason)
+        return self.status()
+
+    def _remember(self, key: Tuple) -> None:
+        if len(self._seen_alerts) == self._seen_alerts.maxlen:
+            old = self._seen_alerts[0]
+            self._seen_set.discard(old)
+        self._seen_alerts.append(key)
+        self._seen_set.add(key)
+
+    def _suppress(self, reason: str, alert: Dict[str, Any],
+                  log: bool = True) -> str:
+        self.suppressed[reason] = self.suppressed.get(reason, 0) + 1
+        if log:
+            collector.event("retrain_suppressed", reason=reason,
+                            window_id=alert.get("window_id"),
+                            target=alert.get("target"),
+                            metric=alert.get("metric"))
+            _log.info("retrain: alert suppressed (%s): %s/%s", reason,
+                      alert.get("target"), alert.get("metric"))
+        return reason
+
+    def _storm_count(self) -> int:
+        cut = time.monotonic() - self.policy.storm_window_s
+        return sum(1 for t in self._cycle_starts if t >= cut)
+
+    def _champion_hash(self) -> Optional[str]:
+        """Content hash of the CURRENT champion dir, cached per dir —
+        artifacts are immutable once saved (a swap changes the dir, not
+        the files), and this runs on every alert: without the cache a
+        drifting window's per-feature fan-out re-sha256s a potentially
+        huge arrays.npz once per alert per poll. Only the cache lookup/
+        fill holds the lock; the sha256 of a multi-GB artifact must
+        never run under it (``effective_state`` — and through it the
+        fleet /healthz — blocks on the same lock)."""
+        try:
+            d = self.champion_dir_fn()
+            if not d:
+                return None
+            with self._lock:
+                h = self._hash_cache.get(d)
+            if h is None:
+                h = model_content_hash(d)
+                if h:
+                    with self._lock:
+                        self._hash_cache[d] = h
+            return h
+        except Exception:
+            return None
+
+    # -- cycle machinery -----------------------------------------------------
+    def _reserve_cycle(self) -> Tuple[str, Dict[str, Any]]:
+        """Caller holds the lock. Validates that a trigger can become a
+        cycle (champion + recipe exist — RuntimeError otherwise, the
+        "unconfigured" path) and RESERVES the state machine: state
+        flips to TRIGGERED so concurrent triggers conflict while the
+        heavy mint (:meth:`_launch_cycle`) runs outside the lock."""
+        champion_dir = self.champion_dir_fn()
+        if not champion_dir:
+            raise RuntimeError("no champion model dir to retrain")
+        recipe = self._recipe or RF.load_recipe(champion_dir)
+        if not recipe:
+            raise RuntimeError(
+                f"no retrain recipe: put {RF.RECIPE_JSON} next to "
+                f"{champion_dir} (or configure the controller with one)")
+        self.state = TRIGGERED
+        return champion_dir, recipe
+
+    def _launch_cycle(self, reserved: Tuple[str, Dict[str, Any]],
+                      trigger: Dict[str, Any], reason: str) -> None:
+        """The heavy half of a trigger, run WITHOUT the lock (window
+        CSV, spec write, artifact hash, journal fsync — /healthz reads
+        the state under the lock and must never wait on disk): mints
+        the cycle, journals TRIGGERED, then commits the in-memory state
+        and starts the cycle thread. ANY failure — journal append on a
+        full disk included — rolls the TRIGGERED reservation back to
+        IDLE and re-raises: a failed trigger must leave the controller
+        retriggerable, never wedged in a stateless TRIGGERED."""
+        champion_dir, recipe = reserved
+        try:
+            cycle_id = f"rc-{int(time.time()):x}-{os.urandom(3).hex()}"
+            cycle_dir = os.path.join(self.root, "cycles", cycle_id)
+            os.makedirs(cycle_dir, exist_ok=True)
+            cyc = _Cycle(cycle_id, cycle_dir, trigger=trigger,
+                         champion_dir=champion_dir,
+                         champion_hash=self._champion_hash())
+            window = self._snapshot_window(cyc.window_path)
+            spec = RF.RefitSpec(
+                champion_dir=champion_dir,
+                out_dir=cyc.candidate_dir,
+                builder=str(recipe["builder"]),
+                history=[str(p) for p in recipe.get("history", [])],
+                window=window,
+                holdout_fraction=float(recipe.get("holdout_fraction",
+                                                  0.2)),
+                seed=int(recipe.get("seed", 7)),
+                narrow_to_champion=bool(recipe.get("narrow_to_champion",
+                                                   True)),
+                warm_start=bool(recipe.get("warm_start", True)),
+                builder_path=recipe.get("builder_path"))
+            spec.save(cyc.spec_path)
+            # journal BEFORE the in-memory commit: a failed append
+            # leaves nothing to roll back but the reservation (a torn
+            # line is terminated on the journal's next reopen)
+            self.journal.append(cyc.id, TRIGGERED, cycle_dir=cyc.dir,
+                                champion_dir=champion_dir,
+                                champion_hash=cyc.champion_hash,
+                                trigger=trigger, reason=reason)
+        except BaseException:
+            with self._lock:
+                if self.state == TRIGGERED:
+                    self.state = IDLE
+            raise
+        with self._lock:
+            self._recipe_runtime = recipe  # rollout fraction etc.
+            self.cycle = cyc
+            self.cycles_total += 1
+            self._cycle_starts.append(time.monotonic())
+            self._cycle_thread = threading.Thread(
+                target=self._run_cycle, args=(cyc, FITTING),
+                name=f"retrain-{cyc.id}", daemon=True)
+            t = self._cycle_thread
+        collector.event("retrain_triggered", cycle=cyc.id, reason=reason,
+                        window_id=trigger.get("window_id"),
+                        target=trigger.get("target"),
+                        champion_dir=champion_dir)
+        _log.info("retrain: cycle %s TRIGGERED (%s) — champion %s",
+                  cyc.id, reason, champion_dir)
+        t.start()
+
+    def _snapshot_window(self, path: str) -> Optional[str]:
+        """The tapped traffic ring as one CSV (the refit's recent-window
+        slice and the validation gate's replay file). None when no
+        traffic was tapped."""
+        bodies = list(self._traffic)
+        records: List[Dict[str, Any]] = []
+        keys: List[str] = []
+        for b in bodies:
+            try:
+                rec = json.loads(b)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            if not isinstance(rec, dict):
+                continue  # bulk bodies are batch jobs, not the window
+            flat = {k: v for k, v in rec.items()
+                    if v is None or isinstance(v, (int, float, str, bool))}
+            if not flat:
+                continue
+            records.append(flat)
+            for k in flat:
+                if k not in keys:
+                    keys.append(k)
+        if not records:
+            return None
+        import csv
+        # runs WITHOUT the controller lock (inside _launch_cycle — the
+        # CSV write must not stall /healthz readers of the state): the
+        # TRIGGERED reservation serializes cycle mints, so exactly one
+        # snapshot is ever in flight, and the deque's atomic append
+        # means a tap racing the list() above lands in this cycle or
+        # the next, never torn.
+        with open(path, "w", newline="") as fh:
+            w = csv.DictWriter(fh, fieldnames=keys)
+            w.writeheader()
+            for r in records:
+                w.writerow(r)
+        return path
+
+    # -- the cycle thread ----------------------------------------------------
+    def _run_cycle(self, cyc: _Cycle, entry_state: str) -> None:
+        try:
+            if entry_state == FITTING:
+                if not self._fit(cyc):
+                    return  # quarantined inside
+                entry_state = VALIDATING
+            if entry_state == VALIDATING:
+                if not self._validate(cyc):
+                    return
+                if self._stop.is_set():
+                    # close() raced the (stop-check-free) validation:
+                    # pause at the journaled VALIDATING state — resume
+                    # re-validates and still rolls out exactly once
+                    _log.info("retrain: cycle %s paused after "
+                              "validation by controller stop; journal "
+                              "will resume it", cyc.id)
+                    return
+                entry_state = ROLLING_OUT
+            if entry_state == ROLLING_OUT:
+                self._roll_out(cyc)
+        except Exception as e:  # noqa: BLE001 - containment of last resort
+            if self._stop.is_set():
+                # a graceful close() raced this thread (e.g. the
+                # journal closed under a long validation replay): an
+                # operator restart must NEVER ban a candidate — leave
+                # the journal's last state for resume() instead of
+                # quarantining
+                _log.warning("retrain: cycle %s interrupted by "
+                             "controller stop (%s: %s); journal will "
+                             "resume it", cyc.id, type(e).__name__, e)
+                return
+            _log.exception("retrain: cycle %s failed unexpectedly",
+                           cyc.id)
+            self._quarantine(cyc, f"controller_error: "
+                                  f"{type(e).__name__}: {e}")
+
+    def _set_state(self, cyc: _Cycle, state: str, **fields: Any) -> None:
+        with self._lock:
+            self.state = state
+        self.journal.append(cyc.id, state, **fields)
+
+    # FITTING ---------------------------------------------------------------
+    def _spawn_worker(self, spec_path: str) -> Any:
+        cmd = [self.python, "-m", "transmogrifai_tpu", "retrain-worker",
+               spec_path]
+        log_path = os.path.join(os.path.dirname(spec_path), "worker.log")
+        with open(log_path, "ab") as lf:
+            return subprocess.Popen(cmd, env=self.env, stdout=lf,
+                                    stderr=lf)
+
+    def _fit(self, cyc: _Cycle) -> bool:
+        """FITTING with timeout + exponential-backoff retries. Returns
+        True when a worker exited 0; quarantines and returns False when
+        the attempt budget is spent."""
+        while True:
+            cyc.attempt += 1
+            self._set_state(cyc, FITTING, attempt=cyc.attempt)
+            collector.event("retrain_fit_started", cycle=cyc.id,
+                            attempt=cyc.attempt)
+            outcome = self._run_worker_once(cyc)
+            if outcome is None:
+                return True
+            if self._stop.is_set():
+                # GRACEFUL stop (close()/SIGTERM) is not a failure: the
+                # journal still reads FITTING, so the next incarnation's
+                # resume() re-enters this cycle — quarantining here
+                # would permanently ban a candidate hash over an
+                # operator restart
+                _log.info("retrain: cycle %s paused mid-FITTING by "
+                          "controller stop; journal will resume it",
+                          cyc.id)
+                return False
+            if cyc.attempt >= self.policy.fit_attempts:
+                self._quarantine(cyc, f"fit_failed after "
+                                      f"{cyc.attempt} attempt(s): "
+                                      f"{outcome}")
+                return False
+            backoff = min(self.policy.backoff_base_s
+                          * (2 ** (cyc.attempt - 1)),
+                          self.policy.backoff_cap_s)
+            collector.event("retrain_fit_retry", cycle=cyc.id,
+                            attempt=cyc.attempt, error=outcome,
+                            backoff_s=round(backoff, 3))
+            _log.warning("retrain: cycle %s fit attempt %d failed (%s);"
+                         " retrying in %.1fs", cyc.id, cyc.attempt,
+                         outcome, backoff)
+            if self._stop.wait(backoff):
+                _log.info("retrain: cycle %s paused mid-retry by "
+                          "controller stop; journal will resume it",
+                          cyc.id)
+                return False
+
+    def _run_worker_once(self, cyc: _Cycle) -> Optional[str]:
+        """One worker launch; None on success, else the failure reason.
+        The timeout path SIGKILLs the worker — a hung fit must not
+        outlive its budget, and the champion never depended on it."""
+        try:
+            proc = self._launcher(cyc.spec_path)
+        except Exception as e:  # noqa: BLE001
+            return f"spawn failed: {type(e).__name__}: {e}"
+        deadline = time.monotonic() + self.policy.fit_timeout_s
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            if time.monotonic() >= deadline:
+                _log.warning("retrain: cycle %s worker exceeded "
+                             "fit_timeout_s=%.0f — killing", cyc.id,
+                             self.policy.fit_timeout_s)
+                try:
+                    proc.kill()
+                    proc.wait(10.0)
+                except Exception:  # noqa: BLE001
+                    pass
+                return f"fit_timeout after {self.policy.fit_timeout_s}s"
+            if self._stop.wait(0.1):
+                try:
+                    proc.kill()
+                except Exception:  # noqa: BLE001
+                    pass
+                return "controller stopped"
+        if rc != 0:
+            return f"fit_crash rc={rc}"
+        return None
+
+    # VALIDATING ------------------------------------------------------------
+    def _validate(self, cyc: _Cycle) -> bool:
+        self._set_state(cyc, VALIDATING)
+        ok, reasons, report = self.validate_candidate(cyc)
+        cyc.report = report
+        cyc.candidate_hash = (report or {}).get("candidate_hash") or \
+            model_content_hash(cyc.candidate_dir)
+        if ok:
+            collector.event("retrain_candidate_ready", cycle=cyc.id,
+                            candidate_hash=cyc.candidate_hash,
+                            metric=(report or {}).get("metric"),
+                            candidate_metric=(report or {}).get(
+                                "candidate_metric"),
+                            champion_metric=(report or {}).get(
+                                "champion_metric"))
+            return True
+        collector.event("retrain_validation_failed", cycle=cyc.id,
+                        reasons="; ".join(reasons))
+        self._quarantine(cyc, f"validation_failed: "
+                              f"{'; '.join(reasons)}")
+        return False
+
+    def validate_candidate(self, cyc: _Cycle
+                           ) -> Tuple[bool, List[str],
+                                      Optional[Dict[str, Any]]]:
+        """The gate, in order of cheapness. Every reason is recorded —
+        a quarantined candidate's evidence names exactly which bar it
+        missed."""
+        reasons: List[str] = []
+        report: Optional[Dict[str, Any]] = None
+        rp = os.path.join(cyc.candidate_dir, RF.REPORT_JSON)
+        try:
+            with open(rp) as fh:
+                report = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            reasons.append(f"candidate report unreadable: "
+                           f"{type(e).__name__}")
+        # artifact must LOAD (a corrupt op-model.json / arrays.npz must
+        # never reach the rollout path, let alone traffic) — probed in
+        # a child process: the worker's output is untrusted, and an
+        # artifact whose load OOMs or segfaults must take down the
+        # probe, never the serving fleet
+        err = self._load_probe(cyc.candidate_dir)
+        if err is not None:
+            reasons.append(f"candidate artifact unloadable: {err}")
+            return False, reasons, report
+        if not os.path.exists(os.path.join(cyc.candidate_dir,
+                                           "monitor.json")):
+            reasons.append("candidate has no monitor.json (profile not "
+                           "rebuilt; the new champion would serve "
+                           "unmonitored)")
+        if report is not None:
+            cand = report.get("candidate_metric")
+            champ = report.get("champion_metric")
+            metric = report.get("metric", "au_pr")
+            tol = self.policy.metric_tolerance
+            if cand is None:
+                reasons.append(f"holdout {metric} missing for the "
+                               f"candidate")
+            elif champ is not None:
+                larger = bool(report.get("metric_larger_better", True))
+                bad = (cand < champ - tol) if larger else \
+                    (cand > champ + tol)
+                if bad:
+                    reasons.append(
+                        f"holdout {metric} {cand:.4f} outside tolerance "
+                        f"of champion {champ:.4f} (+/-{tol})")
+        # nothing quarantined is ever retried verbatim
+        chash = (report or {}).get("candidate_hash") or \
+            model_content_hash(cyc.candidate_dir)
+        with self._lock:
+            repeat = bool(chash) and chash in self._quarantined_hashes
+        if repeat:
+            reasons.append(f"candidate {chash} is byte-identical to a "
+                           f"quarantined one")
+        if not reasons and self.policy.require_monitor_green:
+            r = self._monitor_replay(cyc)
+            if r is not None:
+                reasons.append(r)
+        return (not reasons), reasons, report
+
+    _LOAD_PROBE_SRC = (
+        "import sys\n"
+        "from transmogrifai_tpu.workflow.workflow import WorkflowModel\n"
+        "try:\n"
+        "    WorkflowModel.load(sys.argv[1])\n"
+        "except Exception as e:\n"
+        "    sys.stderr.write(f'{type(e).__name__}: {e}')\n"
+        "    sys.exit(4)\n"
+    )
+
+    def _load_probe(self, candidate_dir: str) -> Optional[str]:
+        """Prove the candidate artifact loads, without loading it HERE.
+        None = loadable; a reason string otherwise. The in-process
+        fallback (``sandbox_load_probe=False``) exists for tests that
+        drive the state machine with fakes — production controllers
+        keep the boundary: untrusted bytes never deserialize inside
+        the fleet frontend."""
+        if not self.policy.sandbox_load_probe:
+            try:
+                from ..workflow.workflow import WorkflowModel
+                WorkflowModel.load(candidate_dir)
+            except Exception as e:  # noqa: BLE001
+                return f"{type(e).__name__}: {e}"
+            return None
+        cmd = [self.python, "-c", self._LOAD_PROBE_SRC, candidate_dir]
+        try:
+            proc = subprocess.run(
+                cmd, env=self.env, capture_output=True, text=True,
+                timeout=self.policy.load_probe_timeout_s)
+        except subprocess.TimeoutExpired:
+            return (f"load probe exceeded "
+                    f"{self.policy.load_probe_timeout_s}s")
+        if proc.returncode != 0:
+            tail = (proc.stderr or "").strip()[-300:]
+            return tail or f"load probe died rc={proc.returncode}"
+        return None
+
+    def _monitor_replay(self, cyc: _Cycle) -> Optional[str]:
+        """The offline ``monitor`` CLI over the triggering traffic
+        window, against the CANDIDATE's rebuilt profile: the drift that
+        triggered this cycle must be GONE on the candidate. None =
+        green; a reason string otherwise. No window snapshot = nothing
+        to replay (manual triggers on idle fleets)."""
+        if not os.path.exists(cyc.window_path):
+            return None
+        cmd = [self.python, "-m", "transmogrifai_tpu", "monitor",
+               cyc.candidate_dir, cyc.window_path, "--fail-on-drift"]
+        try:
+            proc = subprocess.run(
+                cmd, env=self.env, capture_output=True, text=True,
+                timeout=self.policy.monitor_timeout_s)
+        except subprocess.TimeoutExpired:
+            return (f"monitor replay exceeded "
+                    f"{self.policy.monitor_timeout_s}s")
+        if proc.returncode == 3:
+            return ("monitor replay still drifting on the triggering "
+                    "window (the candidate did not learn the shift)")
+        if proc.returncode != 0:
+            return (f"monitor replay failed rc={proc.returncode}: "
+                    f"{proc.stderr[-300:]}")
+        return None
+
+    # ROLLING_OUT -----------------------------------------------------------
+    def _roll_out(self, cyc: _Cycle) -> None:
+        """Hand the candidate to the fleet's shadow -> verdict -> swap
+        path. The ROLLING_OUT journal record lands BEFORE start() so a
+        crash anywhere in here resumes into the exactly-once probe."""
+        self._set_state(cyc, ROLLING_OUT,
+                        candidate_dir=cyc.candidate_dir,
+                        candidate_hash=cyc.candidate_hash)
+        recipe = getattr(self, "_recipe_runtime", None) or self._recipe \
+            or RF.load_recipe(cyc.champion_dir) or {}
+        fraction = float(recipe.get("fraction",
+                                    self.policy.rollout_fraction))
+        min_shadow = int(recipe.get("min_shadow",
+                                    self.policy.rollout_min_shadow))
+        replicas = recipe.get("replicas")
+        # the recipe's rollout_* keys relax the shadow-verdict
+        # comparison for THIS cycle's adapted candidate only — passed
+        # per start() so operator-initiated rollouts keep the fleet's
+        # base guards (only when present: duck-typed fakes need not
+        # grow the kwarg)
+        thresholds = {k[len("rollout_"):]: float(recipe[k])
+                      for k in ("rollout_max_pred_js", "rollout_max_psi",
+                                "rollout_max_score_shift")
+                      if recipe.get(k) is not None}
+        start_kw: Dict[str, Any] = dict(fraction=fraction,
+                                        min_shadow=min_shadow,
+                                        replicas=replicas)
+        if thresholds:
+            start_kw["thresholds"] = thresholds
+        collector.event("retrain_rollout_started", cycle=cyc.id,
+                        candidate_dir=cyc.candidate_dir,
+                        fraction=fraction, min_shadow=min_shadow)
+        if RF.injected_fault() == "rollout_reject":
+            _log.error("retrain: injected rollout_reject — forcing the "
+                       "dirty-verdict branch")
+            self._rollout_rejected(cyc, {"reasons": ["injected "
+                                                     "rollout_reject"]})
+            return
+        if self.rollout is None:
+            self._quarantine(cyc, "no rollout manager configured")
+            return
+        deadline = time.monotonic() + self.policy.rollout_timeout_s
+        while True:
+            try:
+                self.rollout.start(cyc.candidate_dir, **start_kw)
+                break
+            except Exception as e:  # noqa: BLE001
+                # a CONFLICT (another rollout holds the slot right now)
+                # is transient — waiting for the slot is right, exactly
+                # like an HTTP client retrying the 409; judged by name
+                # to stay duck-typed (tests drive fakes, and importing
+                # fleet.rollout here would cycle through fleet/__init__
+                # -> frontend -> this module). Anything else (broken
+                # artifact, spawn failure) is terminal: quarantine.
+                if (type(e).__name__ == "RolloutConflict"
+                        and time.monotonic() < deadline
+                        and not self._stop.is_set()):
+                    _log.info("retrain: cycle %s rollout slot busy "
+                              "(%s); waiting", cyc.id, e)
+                    if not self._stop.wait(1.0):
+                        continue
+                if self._stop.is_set():
+                    _log.info("retrain: cycle %s paused before rollout "
+                              "start by controller stop; journal will "
+                              "resume it", cyc.id)
+                    return
+                # a deadline-expired CONFLICT is still slot contention
+                # (someone else held the rollout for the whole budget)
+                # — not the candidate's fault: keep the evidence but
+                # don't ban the hash/trigger, the same candidate may
+                # ship once the slot frees up
+                self._quarantine(
+                    cyc, f"rollout start failed: "
+                         f"{type(e).__name__}: {e}",
+                    ban=type(e).__name__ != "RolloutConflict")
+                return
+        self._await_rollout(cyc)
+
+    def _await_rollout(self, cyc: _Cycle) -> None:
+        deadline = time.monotonic() + self.policy.rollout_timeout_s
+        while time.monotonic() < deadline and not self._stop.is_set():
+            st = (self.rollout.status() or {}).get("state")
+            if st in _ROLLOUT_DONE:
+                break
+            time.sleep(0.1)
+        status = self.rollout.status() or {}
+        st = status.get("state")
+        # attribute the verdict to THIS cycle only when the manager
+        # names OUR candidate: a terminal state can belong to someone
+        # else's rollout (ours died, an operator took the slot) and
+        # must not book a swap — or a hash-banning rejection — onto
+        # this cycle. Duck-typed fakes that report no challenger_dir
+        # are trusted (they only ever run our candidate).
+        ro_dir = status.get("challenger_dir")
+        ours = ro_dir is None or ro_dir == cyc.candidate_dir
+        if st == "swapped" and ours:
+            self._swapped(cyc, status.get("last_verdict"))
+        elif st == "rejected" and ours:
+            self._rollout_rejected(cyc, status.get("last_verdict")
+                                    or {"reasons": ["rollout rejected"]})
+        elif self._stop.is_set():
+            # GRACEFUL stop with the rollout still live: leave it and
+            # the journal's ROLLING_OUT record alone — resume() probes
+            # swap-landed / still-live / dead and takes exactly one
+            # recovery path. Quarantining a validated candidate over an
+            # operator restart would ban its hash forever.
+            _log.info("retrain: cycle %s paused mid-ROLLING_OUT by "
+                      "controller stop; journal will resume it", cyc.id)
+        else:
+            if ours:  # never abort someone ELSE's live rollout
+                try:
+                    self.rollout.abort()
+                except Exception:  # noqa: BLE001
+                    pass
+                # the verdict can land in the race window between the
+                # status read above and abort()'s state guard (which
+                # no-ops on a terminal rollout): re-read BEFORE
+                # quarantining — moving cycles/<id>/ after the swap
+                # landed would relocate the SERVING champion's model
+                # dir out from under the fleet
+                status = self.rollout.status() or {}
+                st2 = status.get("state")
+                ro_dir = status.get("challenger_dir")
+                verdict2 = status.get("last_verdict") or {}
+                if ro_dir is None or ro_dir == cyc.candidate_dir:
+                    if st2 == "swapped":
+                        self._swapped(cyc, status.get("last_verdict"))
+                        return
+                    if st2 == "rejected" and not verdict2.get("aborted"):
+                        # a REAL shadow verdict landed in the race (our
+                        # abort no-oped against it) — book the
+                        # rejection; our own abort landing instead
+                        # falls through to the honest timeout reason
+                        self._rollout_rejected(
+                            cyc, verdict2
+                            or {"reasons": ["rollout rejected"]})
+                        return
+            # no verdict inside the budget (thin shadow traffic, or a
+            # foreign rollout holding the slot) is not the candidate's
+            # fault — keep the evidence, don't ban the hash/trigger
+            self._quarantine(cyc, f"rollout did not reach a verdict "
+                                  f"within "
+                                  f"{self.policy.rollout_timeout_s}s "
+                                  f"(state {st})", ban=False)
+
+    def _swapped(self, cyc: _Cycle, verdict: Any) -> None:
+        with self._lock:
+            self.swapped_total += 1
+            self.last_verdict = {"cycle": cyc.id, "outcome": "swapped",
+                                 "candidate_dir": cyc.candidate_dir,
+                                 "candidate_hash": cyc.candidate_hash,
+                                 "verdict": verdict,
+                                 "report": cyc.report}
+        collector.event("retrain_swapped", cycle=cyc.id,
+                        candidate_dir=cyc.candidate_dir,
+                        candidate_hash=cyc.candidate_hash)
+        _log.info("retrain: cycle %s SWAPPED -> %s", cyc.id,
+                  cyc.candidate_dir)
+        self._finish(cyc, COOLDOWN)
+
+    def _rollout_rejected(self, cyc: _Cycle, verdict: Dict) -> None:
+        collector.event("retrain_rollout_rejected", cycle=cyc.id,
+                        reasons="; ".join(verdict.get("reasons", [])))
+        # an OPERATOR abort (verdict marker from RolloutManager.abort)
+        # is not the candidate's fault: quarantine the cycle's evidence
+        # but do NOT ban the hash/trigger — the same candidate may ship
+        # on the next cycle once the slot frees up
+        self._quarantine(cyc, f"rollout_rejected: "
+                              f"{'; '.join(verdict.get('reasons', []))}",
+                         verdict=verdict,
+                         ban=not verdict.get("aborted", False))
+
+    # QUARANTINE / COOLDOWN --------------------------------------------------
+    def _quarantine(self, cyc: _Cycle, reason: str,
+                    verdict: Any = None, ban: bool = True) -> None:
+        """Move the cycle's whole evidence trail into quarantine, ledger
+        it, cool down. The champion was never touched. `ban=False`
+        (operator abort) keeps the evidence but leaves candidate_hash /
+        window_id out of the ledger entry, so neither this incarnation
+        nor a resumed one (the index rebuilds FROM the ledger) refuses
+        the candidate or the trigger later — the failure was not the
+        candidate's."""
+        dest = os.path.join(self.quarantine_root, cyc.id)
+        try:
+            if os.path.isdir(cyc.dir):
+                shutil.move(cyc.dir, dest)
+        except OSError:
+            _log.exception("retrain: quarantine move failed for %s",
+                           cyc.id)
+            dest = cyc.dir  # evidence stays where it is
+        chash = cyc.candidate_hash if ban else None
+        entry = {"cycle": cyc.id, "reason": reason, "dir": dest,
+                 "candidate_hash": chash,
+                 "champion_hash": cyc.champion_hash,
+                 "window_id": ((cyc.trigger or {}).get("window_id")
+                               if ban else None),
+                 "ts": round(time.time(), 3)}
+        try:
+            with open(os.path.join(self.quarantine_root,
+                                   "ledger.jsonl"), "a") as fh:
+                fh.write(json.dumps(entry, default=str) + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+        except OSError:
+            _log.exception("retrain: quarantine ledger write failed")
+        with self._lock:
+            self.quarantined_total += 1
+            self._quarantine_entries.append(entry)
+            if chash:
+                self._quarantined_hashes.add(chash)
+            wid = entry["window_id"]
+            if wid:
+                self._quarantined_triggers.add((cyc.champion_hash, wid))
+            self.last_verdict = {"cycle": cyc.id,
+                                 "outcome": "quarantined",
+                                 "reason": reason, "dir": dest,
+                                 "verdict": verdict,
+                                 "report": cyc.report}
+        self.journal.append(cyc.id, QUARANTINED, reason=reason,
+                            quarantine_dir=dest, candidate_hash=chash)
+        collector.event("retrain_quarantined", cycle=cyc.id,
+                        reason=reason, quarantine_dir=dest)
+        _log.warning("retrain: cycle %s QUARANTINED (%s) — evidence in "
+                     "%s; champion untouched", cyc.id, reason, dest)
+        self._finish(cyc, COOLDOWN)
+
+    def _finish(self, cyc: _Cycle, state: str) -> None:
+        with self._lock:
+            self.state = state
+            self._last_cycle_end = time.monotonic()
+            self.cycle = None
+        self.journal.append(cyc.id, COOLDOWN)
+
+    def quarantine_list(self) -> List[Dict[str, Any]]:
+        """The ledger, from the in-memory mirror (loaded once at
+        construction, appended in _quarantine): status()/GET /retrainz
+        poll this — re-parsing the whole JSONL under the controller
+        lock per poll would contend with trigger handling and grow
+        with the ledger."""
+        with self._lock:
+            return list(self._quarantine_entries)
+
+    @staticmethod
+    def _read_ledger(path: str) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        try:
+            with open(path, encoding="utf-8") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        except OSError:
+            pass
+        return out
+
+    def _load_quarantine_index(self) -> None:
+        entries = self._read_ledger(os.path.join(self.quarantine_root,
+                                                 "ledger.jsonl"))
+        hashes: Set[str] = set()
+        triggers: Set[Tuple] = set()
+        for e in entries:
+            if e.get("candidate_hash"):
+                hashes.add(e["candidate_hash"])
+            if e.get("window_id"):
+                triggers.add((e.get("champion_hash"), e["window_id"]))
+        with self._lock:  # cycle + trigger threads read these sets
+            self._quarantine_entries = entries
+            self._quarantined_hashes = hashes
+            self._quarantined_triggers = triggers
+
+    # -- crash resume --------------------------------------------------------
+    def resume(self) -> Dict[str, Any]:
+        """Replay the journal; re-enter an in-flight cycle EXACTLY
+        ONCE. Returns a description of what happened (tests assert on
+        it). Idempotent for a clean journal."""
+        cycle_id, recs = self.journal.last_cycle()
+        if cycle_id is None or not recs:
+            return {"resumed": False, "reason": "empty journal"}
+        last = recs[-1]
+        st = last.get("state")
+
+        def _ended_ago() -> float:
+            """Wall seconds since the journal's last record — restart
+            downtime COUNTS toward the cooldown (restarting the fleet a
+            day after the last cycle must not re-impose a full
+            min_interval_s before a real alert can trigger)."""
+            ts = last.get("ts")
+            if isinstance(ts, (int, float)):
+                return max(0.0, time.time() - float(ts))
+            return 0.0
+
+        if st in (COOLDOWN, None):
+            # the cycle finished; only the cooldown clock carries over
+            with self._lock:
+                self.state = COOLDOWN
+                self._last_cycle_end = time.monotonic() - _ended_ago()
+            return {"resumed": False, "reason": "last cycle complete"}
+        first = recs[0]
+        cyc = _Cycle(cycle_id, first.get("cycle_dir", ""),
+                     trigger=first.get("trigger") or {},
+                     champion_dir=first.get("champion_dir", ""),
+                     champion_hash=first.get("champion_hash"))
+        cyc.attempt = max([int(r.get("attempt", 0)) for r in recs] or [0])
+        cand_hash = None
+        for r in recs:
+            if r.get("candidate_hash"):
+                cand_hash = r["candidate_hash"]
+        cyc.candidate_hash = cand_hash
+        if st == QUARANTINED:
+            # the quarantine ledger landed (it precedes the journal
+            # record)? Either way the cycle is terminal — only the
+            # missing COOLDOWN mark is replayed.
+            self.journal.append(cyc.id, COOLDOWN)
+            with self._lock:
+                self.state = COOLDOWN
+                self._last_cycle_end = time.monotonic() - _ended_ago()
+            return {"resumed": False, "reason": "was quarantined"}
+        self._reap_orphan_worker(cyc)
+        collector.event("retrain_resumed", cycle=cyc.id, at_state=st)
+        _log.warning("retrain: resuming cycle %s from journaled state "
+                     "%s", cyc.id, st)
+        if st in (TRIGGERED, FITTING):
+            entry = FITTING
+        elif st == VALIDATING:
+            entry = VALIDATING
+        elif st == ROLLING_OUT:
+            # EXACTLY-ONCE probe: did the swap land before the crash?
+            champ = self._champion_hash()
+            if cyc.candidate_hash and champ and \
+                    champ == cyc.candidate_hash:
+                _log.info("retrain: cycle %s swap already landed "
+                          "(champion hash == candidate); completing "
+                          "without a second rollout", cyc.id)
+                self._swapped(cyc, {"resumed": True})
+                with self._lock:
+                    # the cycle actually ended before the crash —
+                    # restart downtime counts toward the cooldown here
+                    # exactly as in the COOLDOWN/QUARANTINED branches
+                    # (_finish just stamped "now")
+                    self._last_cycle_end = \
+                        time.monotonic() - _ended_ago()
+                return {"resumed": True, "at_state": st,
+                        "action": "swap_already_landed"}
+            ro_status = (self.rollout.status() or {}) \
+                if self.rollout is not None else {}
+            live = ro_status.get("state")
+            # same attribution rule as _await_rollout: only a rollout
+            # the manager says is running OUR candidate (or a fake that
+            # reports no challenger_dir) is this cycle's — an operator
+            # rollout that took the slot after the crash must neither
+            # be awaited as ours nor have its rejection banish our
+            # candidate; a foreign slot-holder means OUR rollout died,
+            # which is exactly the one-recovery-pass case below
+            ro_dir = ro_status.get("challenger_dir")
+            ours = ro_dir is None or ro_dir == cyc.candidate_dir
+            if live in _ROLLOUT_LIVE and ours:
+                with self._lock:
+                    self.state = ROLLING_OUT
+                    self.cycle = cyc
+                    self._cycle_thread = threading.Thread(
+                        target=self._await_rollout, args=(cyc,),
+                        name=f"retrain-{cyc.id}", daemon=True)
+                    t = self._cycle_thread
+                t.start()
+                return {"resumed": True, "at_state": st,
+                        "action": "awaiting_live_rollout"}
+            if live == "rejected" and ours:
+                self._rollout_rejected(
+                    cyc, {"reasons": ["rejected before the crash"]})
+                return {"resumed": True, "at_state": st,
+                        "action": "was_rejected"}
+            # the rollout provably did not swap and is not live (it died
+            # with the controller's process): ONE recovery pass,
+            # re-validated first — the candidate artifact sat on disk
+            # across the crash
+            entry = VALIDATING
+        else:
+            return {"resumed": False, "reason": f"unknown state {st}"}
+        with self._lock:
+            self.state = entry
+            self.cycle = cyc
+            self.cycles_total += 1
+            self._cycle_starts.append(time.monotonic())
+            self._cycle_thread = threading.Thread(
+                target=self._run_cycle, args=(cyc, entry),
+                name=f"retrain-{cyc.id}", daemon=True)
+            t = self._cycle_thread
+        t.start()
+        return {"resumed": True, "at_state": st, "action": f"re-enter "
+                                                           f"{entry}"}
+
+    def _reap_orphan_worker(self, cyc: _Cycle) -> None:
+        """A kill -9 of the controller mid-FITTING leaves the worker
+        subprocess orphaned; its pid file (written by retrain-worker)
+        lets the resumed controller kill it before relaunching, so two
+        workers never fit one cycle. Best-effort with a cmdline check
+        against pid reuse."""
+        pid_path = os.path.join(cyc.dir, "worker.pid")
+        try:
+            with open(pid_path) as fh:
+                pid = int(fh.read().strip())
+        except (OSError, ValueError):
+            return
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as fh:
+                cmdline = fh.read().decode("utf-8", "replace")
+        except OSError:
+            return  # no such process
+        if "retrain-worker" not in cmdline:
+            return  # pid was reused by something else — leave it alone
+        _log.warning("retrain: reaping orphaned worker pid=%d of cycle "
+                     "%s", pid, cyc.id)
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+
+    # -- trigger threads -----------------------------------------------------
+    def _tail_loop(self) -> None:
+        try:
+            for rec in follow_events(self.alert_log, stop=self._stop,
+                                     poll_s=0.2):
+                if rec.get("event") == "drift_alert":
+                    try:
+                        self.handle_alert(rec)
+                    except RetrainConflict:
+                        pass
+                    except Exception:  # noqa: BLE001
+                        _log.exception("retrain: alert handling failed")
+        except Exception:  # noqa: BLE001
+            _log.exception("retrain: alert tail died")
+
+    def _poll_loop(self) -> None:
+        poll_broken = False
+        while not self._stop.wait(self.drift_poll_interval_s):
+            try:
+                payload = self.drift_poll()
+            except Exception:  # noqa: BLE001
+                # one log line per error EPISODE (the poll re-fires
+                # every couple of seconds — flooding would bury the
+                # diagnostic), but never silent: this poll IS the
+                # auto-retrain trigger source, and a persistently
+                # failing /drift otherwise kills it with no trace
+                if not poll_broken:
+                    poll_broken = True
+                    _log.exception(
+                        "retrain: drift poll failing; auto-trigger "
+                        "degraded until it recovers")
+                continue
+            if poll_broken:
+                poll_broken = False
+                _log.info("retrain: drift poll recovered")
+            if not isinstance(payload, dict) or \
+                    not payload.get("alerting"):
+                continue
+            pooled = payload.get("pooled") or {}
+            for a in pooled.get("alerts", []):
+                alert = dict(a)
+                alert.setdefault("window_id", pooled.get("window_id"))
+                alert.setdefault("model_content_hash",
+                                 pooled.get("model_content_hash"))
+                try:
+                    self.handle_alert(alert)
+                except RetrainConflict:
+                    pass
+                except Exception:  # noqa: BLE001
+                    _log.exception("retrain: pooled alert handling "
+                                   "failed")

@@ -1,0 +1,133 @@
+"""Crash-safe controller journal: the retrain state machine's memory.
+
+Append-only JSONL riding the EventLog discipline (one record per line,
+`seq` strictly increasing, monotone across reopen) with one addition the
+liveness log deliberately does not pay: every append is ``flush`` +
+``fsync``, because the journal is CORRECTNESS state, not telemetry — a
+``kill -9`` between any two controller transitions must leave a journal
+from which the next incarnation resumes exactly once (no orphaned
+challenger pool, no double rollout; docs/retraining.md "The journal").
+
+Record shape::
+
+    {"seq": N, "ts": epoch_s, "cycle": "rc-<hex>", "state": "<STATE>",
+     ...transition fields}
+
+A crash can tear at most the LAST line (single write + fsync per
+record); replay skips an unparseable trailing line, so the resumed
+controller sees the last transition that was durably recorded —
+re-entering a state whose side effect may or may not have happened is
+each state's own idempotence problem, solved in
+controller.RetrainController.resume() (worker pid file, candidate-hash
+probe against the live champion).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class RetrainJournal:
+    """Append-only, fsync-per-record JSONL journal for one controller.
+
+    Reopening an existing journal continues `seq` where the file left
+    off (the EventLog contract), so a resumed controller's records
+    interleave monotonically with its predecessor's."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lock = threading.Lock()
+        self._seq = 0
+        for rec in self.records():
+            s = rec.get("seq")
+            if isinstance(s, int) and s >= self._seq:
+                self._seq = s + 1
+        # a crash can leave a TORN final line with no newline; appending
+        # straight after it would weld the next record onto the garbage
+        # and lose BOTH — terminate the torn tail first so it stays an
+        # isolated unparseable line replay skips forever
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(0, os.SEEK_END)
+                if fh.tell() > 0:
+                    fh.seek(-1, os.SEEK_END)
+                    torn = fh.read(1) != b"\n"
+                else:
+                    torn = False
+        except OSError:
+            torn = False
+        self._f = open(path, "a", encoding="utf-8")
+        if torn:
+            self._f.write("\n")
+            self._f.flush()
+
+    def append(self, cycle: Optional[str], state: str,
+               **fields: Any) -> Dict[str, Any]:
+        """Durably record one transition. Raises on I/O failure — a
+        journal that cannot be written means the controller must NOT
+        proceed to the state it was about to record (fail-stop beats
+        resuming from a lie)."""
+        with self._lock:
+            rec: Dict[str, Any] = {"seq": self._seq,
+                                   "ts": round(time.time(), 6),
+                                   "cycle": cycle, "state": state}
+            rec.update({k: v for k, v in fields.items() if v is not None})
+            line = json.dumps(rec, default=str)
+            # this lock EXISTS to serialize the durable line write (the
+            # EventLog discipline): seq monotonicity + whole-line
+            # atomicity across threads ARE the journal's contract, so
+            # the I/O inside the critical section is the design
+            # tmoglint: disable=THR002  serialized durable write IS the lock's job
+            self._f.write(line + "\n")
+            # tmoglint: disable=THR002  flush+fsync pair with the write
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self._seq += 1
+            return rec
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+    # -- replay --------------------------------------------------------------
+    def records(self) -> List[Dict[str, Any]]:
+        """Every durable record, in order. A torn final line (crash
+        mid-append) is skipped; a torn line anywhere else is skipped
+        too (it cannot exist under the single-writer fsync discipline,
+        but replay must not die on a corrupt file)."""
+        out: List[Dict[str, Any]] = []
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if isinstance(rec, dict):
+                        out.append(rec)
+        except OSError:
+            pass
+        return out
+
+    def last_cycle(self) -> Tuple[Optional[str], List[Dict[str, Any]]]:
+        """(cycle id, that cycle's records in order) for the most recent
+        cycle the journal names, or (None, []) for a fresh journal.
+        Non-cycle records (controller start/stop marks) are ignored."""
+        recs = self.records()
+        last: Optional[str] = None
+        for rec in recs:
+            if rec.get("cycle"):
+                last = rec["cycle"]
+        if last is None:
+            return None, []
+        return last, [r for r in recs if r.get("cycle") == last]

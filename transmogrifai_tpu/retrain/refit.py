@@ -1,0 +1,409 @@
+"""The sandboxed refit worker: one retrain candidate, one subprocess.
+
+``python -m transmogrifai_tpu retrain-worker <spec.json>`` is the unit
+the RetrainController launches (and kills, and retries): it fits ONE
+candidate model from the recent traffic window plus historical data and
+writes a ``candidate_report.json`` the controller's validation gate
+reads. Running it as a real subprocess is the containment boundary —
+a crashed, hung or OOM'd refit takes down exactly this process, never
+the controller or the serving fleet, and the controller's timeout +
+``kill`` always works because there is a pid to kill.
+
+The refit recipe (``retrain.json`` next to the champion model, written
+by the training pipeline) names a BUILDER — ``"module:function"``
+returning an untrained :class:`~transmogrifai_tpu.workflow.Workflow` —
+because a saved model artifact holds fitted transformers, not the
+estimator recipe that produced them; the builder IS that recipe. The
+worker then applies the two across-time shortcuts the ROADMAP names:
+
+- **GLM warm start across time**: the champion's selected linear
+  model's coefficients seed every lane of the streamed GLM round driver
+  (ops/glm_sweep ``warm_seed`` — the PR 3 pathwise continuation applied
+  across time instead of across the regularization path), so the refit
+  starts near the serving model's optimum instead of at zero;
+- **champion-config narrowing**: the hyperparameter grid collapses to
+  the champion's winning (model, grid) cell (``narrow_to_champion``),
+  which is how "trees re-swept at the champion config" lands — the
+  sweep re-fits the winning config on fresh data rather than re-running
+  model selection.
+
+Fault injection (``TMOG_RETRAIN_FAULT``, docs/retraining.md): the hooks
+tests and ci.sh use to PROVE containment at every stage. Each fires at
+the stage it names and is inert when unset:
+
+- ``fit_crash``     — the worker dies (exit 13) mid-fit;
+- ``fit_hang``      — the worker sleeps past any timeout;
+- ``bad_artifact``  — the candidate's op-model.json is corrupted after
+  save (an artifact that exists but cannot be loaded);
+- ``validation_fail`` — the candidate reports a holdout metric that
+  cannot pass the gate.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import logging
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+_log = logging.getLogger("transmogrifai_tpu.retrain")
+
+SPEC_JSON = "spec.json"
+REPORT_JSON = "candidate_report.json"
+RECIPE_JSON = "retrain.json"
+
+#: the fault-injection env knob (docs/retraining.md "Fault injection")
+FAULT_ENV = "TMOG_RETRAIN_FAULT"
+FAULT_CLASSES = ("fit_crash", "fit_hang", "bad_artifact",
+                 "validation_fail", "rollout_reject")
+
+
+def injected_fault() -> Optional[str]:
+    """The active fault class, or None. Unknown values are ignored (a
+    typo'd chaos knob must not invent a new failure mode)."""
+    v = os.environ.get(FAULT_ENV, "").strip().lower()
+    return v if v in FAULT_CLASSES else None
+
+
+@dataclass
+class RefitSpec:
+    """Everything one refit worker run needs, JSON round-trippable (the
+    controller writes it into the cycle dir; the worker subprocess and
+    a human post-mortem both read the same file)."""
+
+    champion_dir: str
+    out_dir: str
+    builder: str                       # "module:function" -> Workflow
+    history: List[str] = field(default_factory=list)   # labeled CSV/Avro
+    window: Optional[str] = None       # recent-traffic records (CSV)
+    holdout_fraction: float = 0.2
+    seed: int = 7
+    narrow_to_champion: bool = True
+    warm_start: bool = True
+    builder_path: Optional[str] = None  # sys.path entry for the builder
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "RefitSpec":
+        keys = {f for f in RefitSpec("", "", "").__dict__}
+        return RefitSpec(**{k: v for k, v in d.items() if k in keys})
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as fh:
+            json.dump(self.to_json(), fh, indent=1)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "RefitSpec":
+        with open(path) as fh:
+            return RefitSpec.from_json(json.load(fh))
+
+
+def load_recipe(model_dir: str) -> Optional[Dict[str, Any]]:
+    """The ``retrain.json`` recipe next to a model artifact ({"builder":
+    "module:function", "history": [paths], optional "builder_path",
+    "fraction", "min_shadow", "replicas"}), or None when the model has
+    no refit recipe (the controller then refuses to auto-retrain)."""
+    p = os.path.join(model_dir, RECIPE_JSON)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as fh:
+            doc = json.load(fh)
+        return doc if isinstance(doc, dict) and doc.get("builder") \
+            else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+# -- champion introspection ---------------------------------------------------
+
+def champion_config(model: Any) -> Dict[str, Any]:
+    """The champion's winning (model name, grid) + linear coefficients
+    when its selected model is a linear family — the warm-start seed and
+    the narrowed sweep cell. Tolerant: a champion without a selector (or
+    with a tree winner) yields partial info and the refit proceeds
+    without the missing shortcut."""
+    out: Dict[str, Any] = {"best_model_name": None, "best_grid": None,
+                           "coef": None, "intercept": None}
+    summary = getattr(model, "selector_summary", lambda: None)()
+    if summary is not None:
+        out["best_model_name"] = summary.best_model_name
+        out["best_grid"] = dict(summary.best_grid or {})
+    sel = getattr(model, "_selected_model", lambda: None)()
+    best = getattr(sel, "best_model", None)
+    beta = getattr(best, "beta", None)
+    if beta is not None:
+        out["coef"] = np.asarray(beta, np.float32)
+        out["intercept"] = float(getattr(best, "intercept", 0.0))
+    return out
+
+
+def find_selector(wf: Any) -> Any:
+    """The built workflow's ModelSelector stage, or None."""
+    from ..automl.selector import ModelSelector
+    from ..workflow.dag import collect_features
+
+    for f in collect_features(wf.result_features):
+        if isinstance(f.origin_stage, ModelSelector):
+            return f.origin_stage
+    return None
+
+
+def apply_champion_shortcuts(wf: Any, cfg: Dict[str, Any], *,
+                             narrow: bool, warm: bool) -> Dict[str, Any]:
+    """Mutate the built workflow's ModelSelector in place: narrow the
+    sweep to the champion's winning cell and seed the GLM warm start.
+    Returns {"narrowed": bool, "warm_seeded": bool} for the report."""
+    applied = {"narrowed": False, "warm_seeded": False}
+    selector = find_selector(wf)
+    if selector is None:
+        return applied
+    if narrow and cfg.get("best_model_name"):
+        kept = []
+        for est, grids in selector.models:
+            if type(est).__name__ == cfg["best_model_name"]:
+                grid = cfg.get("best_grid") or {}
+                kept.append((est, [dict(grid)] if grid else grids))
+        if kept:
+            selector.models = kept
+            applied["narrowed"] = True
+    if warm and cfg.get("coef") is not None:
+        selector.warm_seed = {"beta": cfg["coef"],
+                              "intercept": cfg.get("intercept", 0.0)}
+        applied["warm_seeded"] = True
+    return applied
+
+
+# -- data assembly ------------------------------------------------------------
+
+def _read_records(path: str) -> List[Dict[str, Any]]:
+    if path.endswith(".avro"):
+        from ..readers.avro import read_avro_file
+        return list(read_avro_file(path))
+    from ..readers.readers import CSVReader
+    return CSVReader(path).read()
+
+
+def assemble_training_records(spec: RefitSpec, label_name: str
+                              ) -> Tuple[List[Dict[str, Any]], Dict[str, int]]:
+    """History records + the LABELED slice of the traffic window.
+
+    Live /score traffic rarely carries labels; window records that do
+    (a label feed joined upstream, or a smoke test that includes them)
+    join the training set, the rest only serve the validation-gate
+    monitor replay. Returns (records, provenance counts)."""
+    records: List[Dict[str, Any]] = []
+    counts = {"history_rows": 0, "window_rows": 0,
+              "window_rows_labeled": 0}
+    for p in spec.history:
+        rows = _read_records(p)
+        counts["history_rows"] += len(rows)
+        records.extend(rows)
+    if spec.window and os.path.exists(spec.window):
+        rows = _read_records(spec.window)
+        counts["window_rows"] = len(rows)
+        labeled = [r for r in rows if r.get(label_name) is not None]
+        counts["window_rows_labeled"] = len(labeled)
+        records.extend(labeled)
+    return records, counts
+
+
+def holdout_split(records: List[Dict[str, Any]], fraction: float,
+                  seed: int) -> Tuple[List[Dict[str, Any]],
+                                      List[Dict[str, Any]]]:
+    """Deterministic (seeded) train/holdout split — the gate compares
+    candidate vs champion on the SAME holdout rows."""
+    rng = np.random.default_rng(int(seed))
+    n = len(records)
+    k = int(round(n * float(fraction)))
+    idx = rng.permutation(n)
+    hold = set(int(i) for i in idx[:k])
+    train = [r for i, r in enumerate(records) if i not in hold]
+    held = [r for i, r in enumerate(records) if i in hold]
+    return train, held
+
+
+def gate_evaluator(problem_type: Optional[str]) -> Tuple[Any, str]:
+    """(evaluator, metric name) for the validation gate's holdout
+    comparison: AuPR for binary (the ISSUE's gate), error rate for
+    multiclass, RMSE for regression."""
+    from ..evaluators.evaluators import (BinaryClassificationEvaluator,
+                                         MultiClassificationEvaluator,
+                                         RegressionEvaluator)
+    if problem_type == "multiclass":
+        return MultiClassificationEvaluator(metric="error"), "error"
+    if problem_type == "regression":
+        return RegressionEvaluator(metric="rmse"), "rmse"
+    return BinaryClassificationEvaluator(metric="au_pr"), "au_pr"
+
+
+def holdout_metric(model: Any, records: List[Dict[str, Any]],
+                   evaluator: Any, metric: str) -> Optional[float]:
+    """One model's gate metric on the holdout records; None when it
+    cannot be computed (empty holdout, degenerate labels)."""
+    from ..readers.readers import ListReader
+    if not records:
+        return None
+    try:
+        ds = ListReader(records).generate_dataset(model.raw_features())
+        out = model.evaluate(evaluator, ds)
+        v = out.get(metric)
+        return float(v) if v is not None and np.isfinite(v) else None
+    except Exception:
+        _log.exception("retrain: holdout evaluation failed")
+        return None
+
+
+# -- the worker body ----------------------------------------------------------
+
+def _import_builder(spec: RefitSpec):
+    mod_name, _, fn_name = spec.builder.partition(":")
+    if not fn_name:
+        raise ValueError(f"builder {spec.builder!r} is not "
+                         f"'module:function'")
+    for p in (spec.builder_path, os.path.dirname(os.path.abspath(
+            os.path.join(spec.champion_dir, RECIPE_JSON)))):
+        if p and p not in sys.path:
+            sys.path.insert(0, p)
+    mod = importlib.import_module(mod_name)
+    fn = getattr(mod, fn_name)
+    return fn
+
+
+def run_refit(spec: RefitSpec) -> Dict[str, Any]:
+    """Fit one candidate per `spec`; returns the report it also writes
+    to ``<out_dir>/candidate_report.json``. Raises on unrecoverable
+    errors (the CLI maps them to a nonzero exit the controller books as
+    a fit failure)."""
+    from ..workflow.io import model_content_hash
+    from ..workflow.workflow import WorkflowModel
+
+    fault = injected_fault()
+    t0 = time.monotonic()
+    champion = WorkflowModel.load(spec.champion_dir)
+    cfg = champion_config(champion)
+    label_name = champion._response_name()
+
+    builder = _import_builder(spec)
+    wf = builder()
+    applied = apply_champion_shortcuts(
+        wf, cfg, narrow=spec.narrow_to_champion, warm=spec.warm_start)
+
+    records, counts = assemble_training_records(spec, label_name)
+    if not records:
+        raise ValueError("refit has no training records (empty history "
+                         "and unlabeled window)")
+    train, held = holdout_split(records, spec.holdout_fraction, spec.seed)
+
+    if fault == "fit_crash":
+        _log.error("retrain-worker: injected fit_crash — dying mid-fit")
+        os._exit(13)
+    if fault == "fit_hang":
+        _log.error("retrain-worker: injected fit_hang — sleeping past "
+                   "any timeout")
+        while True:  # the controller's timeout + kill is the exit
+            time.sleep(3600.0)
+
+    from ..readers.readers import ListReader
+    model = wf.set_reader(ListReader(train)).train()
+    model.save(spec.out_dir)  # writes monitor.json (profile rebuilt)
+    # the candidate inherits the champion's refit recipe: once it SWAPS
+    # in it IS the champion dir, and the next cycle (or a fleet started
+    # fresh on it) must find retrain.json there — without this the
+    # "continuous" loop would be one-shot
+    recipe_src = os.path.join(spec.champion_dir, RECIPE_JSON)
+    if os.path.exists(recipe_src):
+        import shutil
+        shutil.copy(recipe_src, os.path.join(spec.out_dir, RECIPE_JSON))
+
+    if fault == "bad_artifact":
+        _log.error("retrain-worker: injected bad_artifact — corrupting "
+                   "the candidate's op-model.json")
+        with open(os.path.join(spec.out_dir, "op-model.json"), "w") as fh:
+            fh.write("{corrupt json the loader must refuse")
+
+    # honesty check on the across-time warm start: the seed is only
+    # ever CONSUMED by the IRLS rounds kernel, which returns the truth
+    # as info["warm_seeded"] (a dimension-mismatched seed is ignored —
+    # a new categorical level widens the design matrix and cold start
+    # is the only honest option — and the squared-loss/Gram and legacy
+    # routes never take a seed at all). Reporting the assignment alone
+    # would claim a warm start the fit never took.
+    if applied["warm_seeded"]:
+        sel = find_selector(wf)
+        tel = getattr(getattr(sel, "validator", None),
+                      "last_streamed_telemetry", None) if sel else None
+        applied["warm_seeded"] = bool(tel and tel.get("warm_seeded"))
+
+    summary = model.selector_summary()
+    problem = summary.problem_type if summary is not None else None
+    evaluator, metric = gate_evaluator(problem)
+    cand_metric = holdout_metric(model, held, evaluator, metric)
+    champ_metric = holdout_metric(champion, held, evaluator, metric)
+    if fault == "validation_fail":
+        _log.error("retrain-worker: injected validation_fail — "
+                   "reporting a gate-failing holdout metric")
+        cand_metric = (0.0 if evaluator.is_larger_better(metric)
+                       else float("1e9"))
+
+    report = {
+        "champion_dir": spec.champion_dir,
+        "candidate_dir": spec.out_dir,
+        "champion_hash": model_content_hash(spec.champion_dir),
+        "candidate_hash": model_content_hash(spec.out_dir),
+        "metric": metric,
+        "metric_larger_better": bool(evaluator.is_larger_better(metric)),
+        "candidate_metric": cand_metric,
+        "champion_metric": champ_metric,
+        "train_rows": len(train),
+        "holdout_rows": len(held),
+        "warm_seeded": applied["warm_seeded"],
+        "narrowed": applied["narrowed"],
+        "best_model_name": cfg.get("best_model_name"),
+        "best_grid": cfg.get("best_grid"),
+        "fault_injected": fault,
+        "wall_s": round(time.monotonic() - t0, 3),
+        **counts,
+    }
+    with open(os.path.join(spec.out_dir, REPORT_JSON), "w") as fh:
+        json.dump(report, fh, indent=1, default=str)
+    return report
+
+
+def run_retrain_worker(args: Any) -> int:
+    """Body of ``python -m transmogrifai_tpu retrain-worker`` (cli.py
+    parses). Exit 0 on a written candidate + report, nonzero otherwise;
+    the controller treats any nonzero exit (or timeout-kill) as a fit
+    failure and retries with backoff."""
+    if not logging.getLogger().handlers:
+        logging.basicConfig(
+            level=logging.INFO,
+            format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    spec = RefitSpec.load(args.spec)
+    # the worker stamps its pid next to the spec so a RESUMED controller
+    # (kill -9 mid-FITTING) can reap an orphaned worker before
+    # relaunching — no two workers ever fit the same cycle
+    pid_path = os.path.join(os.path.dirname(os.path.abspath(args.spec)),
+                            "worker.pid")
+    try:
+        with open(pid_path, "w") as fh:
+            fh.write(str(os.getpid()))
+    except OSError:
+        pass
+    try:
+        report = run_refit(spec)
+    except Exception as e:  # noqa: BLE001 - the exit code IS the signal
+        _log.exception("retrain-worker: refit failed")
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}),
+              file=sys.stderr)
+        return 1
+    print(json.dumps(report, default=str))
+    return 0

@@ -609,6 +609,15 @@ class EventLog:
             except OSError:
                 pass
 
+    def follow(self, *, stop: Optional[threading.Event] = None,
+               poll_s: float = 0.1, from_start: bool = False) -> "Any":
+        """Tail-subscribe to THIS log's path (:func:`follow_events`):
+        yields parsed events seq-monotone across size-rotation
+        boundaries until `stop` is set. Safe from another thread — the
+        follower reads the files, never this writer's handle."""
+        return follow_events(self.path, stop=stop, poll_s=poll_s,
+                             from_start=from_start)
+
 
 def event_log_paths(path: str) -> List[str]:
     """Every segment of a (possibly rotated) event log, OLDEST first —
@@ -643,6 +652,133 @@ def iter_events(path: str) -> "Any":
                         continue
         except OSError:
             continue
+
+
+def follow_events(path: str, *, stop: Optional[threading.Event] = None,
+                  poll_s: float = 0.1, from_start: bool = False) -> "Any":
+    """Tail-subscribe to a (possibly rotating) event log: yield every
+    parsed event with a `seq` STRICTLY greater than the last one seen,
+    until `stop` is set (the retrain controller's trigger source;
+    :meth:`EventLog.follow` delegates here).
+
+    The steady-state cost is `tail -f`'s: an open handle + byte offset
+    on the LIVE file, reading only appended lines per poll. The cursor
+    that survives rotation is the EventLog's own monotonicity contract —
+    `seq` strictly increases across ``events.jsonl.N`` boundaries — so
+    when the live file is REPLACED under the handle (inode change, or
+    the file shrank), the follower rescans every segment oldest-first
+    (:func:`iter_events`) and emits only records beyond the last seq:
+    events appended just before the shift are seen exactly once, from
+    the ``.1`` segment they rotated into. A segment dropped past `keep`
+    between polls is lost — the same contract tail -f + logrotate gives.
+    A torn final line (writer mid-append, or a crash) is held back until
+    its newline lands; records without an integer `seq` are skipped
+    (they also fail trace-report --check).
+
+    `from_start=False` (default) begins AFTER the current end of the
+    log — a subscriber attaching to a long-running serve must not
+    replay history as fresh triggers. The log may not exist yet; the
+    follower waits for it to appear."""
+    last = -1
+    # tail-mode attach consumes the first full-segment scan silently
+    # (advancing `last` past history instead of pre-scanning AND
+    # rescanning — history is parsed exactly once either way)
+    primed = from_start
+
+    f = None
+    ino = None
+
+    def _close():
+        nonlocal f, ino
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+        f, ino = None, None
+
+    def _parse(line: str):
+        nonlocal last
+        line = line.strip()
+        if not line:
+            return None
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            return None
+        s = rec.get("seq") if isinstance(rec, dict) else None
+        if not isinstance(s, int) or s <= last:
+            return None
+        last = s
+        return rec
+
+    try:
+        while stop is None or not stop.is_set():
+            rotated = False
+            try:
+                st = os.stat(path)
+            except OSError:
+                _close()
+                st = None
+                if not primed:
+                    # no LIVE file at attach time: skip whatever
+                    # rotated history already exists (a follower
+                    # attaching mid-rotation must not replay it);
+                    # everything that lands later is fresh
+                    for rec in iter_events(path):
+                        s = rec.get("seq")
+                        if isinstance(s, int) and s > last:
+                            last = s
+                    primed = True
+            if st is not None:
+                if f is None or st.st_ino != ino \
+                        or st.st_size < f.tell():
+                    # fresh file under the path: first attach, a
+                    # rotation that shifted the one we were reading to
+                    # .1, or a truncate-in-place (logrotate copytruncate
+                    # keeps the inode but drops our offset past EOF) —
+                    # catch up through ALL segments by seq (on a plain
+                    # first attach with from_start=False this pass only
+                    # advances `last` past pre-existing history)
+                    rotated = True
+                    _close()
+                    for rec in iter_events(path):
+                        s = rec.get("seq")
+                        if isinstance(s, int) and s > last:
+                            last = s
+                            if primed:
+                                yield rec
+                    primed = True
+                    try:
+                        # read the fresh live file from byte 0 — lines
+                        # the rescan already emitted are dropped by the
+                        # seq filter, and a line appended between the
+                        # rescan and this open is NOT missed (seeking to
+                        # EOF here would skip it)
+                        f = open(path, encoding="utf-8")
+                        ino = st.st_ino
+                    except OSError:
+                        _close()
+                if f is not None and not rotated:
+                    while True:
+                        pos = f.tell()
+                        line = f.readline()
+                        if not line:
+                            break
+                        if not line.endswith("\n"):
+                            # torn tail: the writer is mid-append (or
+                            # died mid-line); re-read once it completes
+                            f.seek(pos)
+                            break
+                        rec = _parse(line)
+                        if rec is not None:
+                            yield rec
+            if stop is None:
+                time.sleep(poll_s)
+            elif stop.wait(poll_s):
+                return
+    finally:
+        _close()
 
 
 # -- Chrome trace_event export -----------------------------------------------
