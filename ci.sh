@@ -12,7 +12,14 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-echo "== 1/6 import + native kernel build =="
+# plan-time autotuner (docs/planning.md): the smokes below assert
+# HAND-plan contracts (exact bucket ladders, tile shapes), so CI must
+# not inherit whatever calibration corpus this box's bench runs have
+# accumulated in the user cache — every step sees a cold scratch corpus
+# (the dedicated planner step 6/7 swaps in its own seeded scratch dir)
+export TMOG_PLAN_CORPUS_DIR="$(mktemp -d)/corpus"
+
+echo "== 1/7 import + native kernel build =="
 python - <<'PY'
 import transmogrifai_tpu
 from transmogrifai_tpu.ops import native_bridge
@@ -20,7 +27,7 @@ print("package import ok; native kernels:",
       "built" if native_bridge.available() else "UNAVAILABLE (numpy fallbacks)")
 PY
 
-echo "== 2/6 tmoglint (static JAX/TPU discipline + stage contracts) =="
+echo "== 2/7 tmoglint (static JAX/TPU discipline + stage contracts) =="
 # fails fast on findings not in tools/tmoglint/baseline.json and on stale
 # baseline entries (docs/static_analysis.md); runs before the test tiers
 # because it needs no imports and catches contract breaks in seconds.
@@ -81,7 +88,7 @@ python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/ \
   --rules SHD,ENV,EVT
 echo "  tmoglint: full scan (<10s) + THR,BUF + SHD,ENV,EVT family scans clean (artifact: $ARTIFACTS_DIR/tmoglint_report.json)"
 
-echo "== 3/6 test suite (8-device virtual CPU mesh) =="
+echo "== 3/7 test suite (8-device virtual CPU mesh) =="
 # fused histogram planner + CPU-fallback smoke first, explicitly under
 # JAX_PLATFORMS=cpu: the tier-1 guarantee that the pure-jnp twin of the
 # batched sweep kernel stays live on hosts with no TPU
@@ -96,7 +103,7 @@ JAX_PLATFORMS=cpu python -m pytest \
   -q -m 'not slow'
 python -m pytest tests/ -q
 
-echo "== 4/6 examples =="
+echo "== 4/7 examples =="
 for ex in op_titanic_simple op_titanic_mini op_iris op_boston; do
   JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python "examples/${ex}.py" > /dev/null
   echo "  ${ex} ok"
@@ -109,7 +116,7 @@ if [ -f "$REF_RES/EmailDataset/Clicks.csv" ]; then
   echo "  op_dataprep ok"
 fi
 
-echo "== 5/6 observability smoke (traced workflow + GLM sweep) =="
+echo "== 5/7 observability smoke (traced workflow + GLM sweep) =="
 # a tiny traced run must produce a loadable span hierarchy: Chrome trace +
 # AppMetrics-with-spans + streaming events.jsonl, all validated by the
 # schema checks in `trace-report --check` (docs/observability.md)
@@ -1303,7 +1310,60 @@ print("tileplane copy/compute overlap ok")
 PY
 rm -rf "$TRACE_DIR"
 
-echo "== 6/6 driver-contract smoke =="
+echo "== 6/7 plan-time autotuner (docs/planning.md) =="
+# the cold-corpus no-op proof FIRST: with an empty corpus every resolved
+# decision must be bit-identical to the hand default its call site
+# shipped with — the planner's no-regression guarantee. (tmoglint
+# already scanned the planner package with the EMPTY baseline in 2/7:
+# ENV001 covers the new TMOG_PLAN* knobs, EVT001 the plan_* events.)
+PLAN_TMP=$(mktemp -d)
+JAX_PLATFORMS=cpu TMOG_PLAN_CORPUS_DIR="$PLAN_TMP/corpus" \
+  PYTHONPATH="$PWD" python - <<'PY'
+from transmogrifai_tpu.planner import plan_fit, plan_serving
+from transmogrifai_tpu.planner.model import HAND_DEFAULTS
+from transmogrifai_tpu.serve.engine import bucket_ladder
+
+plan = plan_fit(1_000_000, 64, n_folds=5, n_grids=12, depth=6, n_bins=32)
+for name, d in plan.decisions.items():
+    assert d.value == HAND_DEFAULTS[name], (name, d.value, d.source)
+assert plan_serving(64).buckets == bucket_ladder(64)
+print("cold-corpus no-op ok: plan == hand defaults, ladder == hand ladder")
+PY
+# seed the scratch corpus with a scaled micro-bench grid, then exercise
+# the corpus/explain CLIs against it
+JAX_PLATFORMS=cpu TMOG_PLAN_CORPUS_DIR="$PLAN_TMP/corpus" \
+  PYTHONPATH="$PWD" python -m transmogrifai_tpu plan calibrate \
+  --budget-s 150 --scale 0.25
+JAX_PLATFORMS=cpu TMOG_PLAN_CORPUS_DIR="$PLAN_TMP/corpus" \
+  PYTHONPATH="$PWD" python -m transmogrifai_tpu plan show > /dev/null
+JAX_PLATFORMS=cpu TMOG_PLAN_CORPUS_DIR="$PLAN_TMP/corpus" \
+  PYTHONPATH="$PWD" python -m transmogrifai_tpu plan explain \
+  --rows 200000 --feat 32 > /dev/null
+# --plan-ab smoke: the identical seeded workload under the hand plan vs
+# the autotuned plan (fresh child processes, no shared jit caches); the
+# autotuned plan must be no slower OUTSIDE the noise margin (generous
+# 25% — this is a scaled smoke on a contended 1-core runner; the tight
+# comparison is bench.py's full-size artifact)
+JAX_PLATFORMS=cpu TMOG_PLAN_CORPUS_DIR="$PLAN_TMP/corpus" \
+  BENCH_PLAN_AB_CALIBRATE=0 BENCH_PLAN_AB_NOISE=0.25 \
+  BENCH_PLAN_AB_CFG='{"n_rows":30000,"n_cols":16,"folds":3,"glm_grid":6,"gbt_grid":2,"gbt_rounds":3,"gbt_depth":3,"gbt_bins":16,"serve_singles":200,"serve_max_batch":64}' \
+  PYTHONPATH="$PWD" python bench.py --plan-ab > "$PLAN_TMP/plan_ab.json"
+python - "$PLAN_TMP/plan_ab.json" <<'PY'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+assert doc.get("hand") and doc.get("auto"), doc.get("errors")
+assert doc["autotuned_ok"], doc["deltas"]
+d = doc["deltas"]
+print(f"plan-ab smoke ok: warm sweep auto/hand="
+      f"{d['sweep_auto_over_hand']} (noise {d['noise_margin']}), "
+      f"serve p50 {d['serve_p50_hand_ms']} -> {d['serve_p50_auto_ms']}ms"
+      f", moved={d['decisions_moved']}")
+PY
+rm -rf "$PLAN_TMP"
+
+echo "== 7/7 driver-contract smoke =="
 python - <<'PY'
 import __graft_entry__ as g
 g.dryrun_multichip(8)

@@ -107,13 +107,25 @@ def _spawn_and_collect(port):
     return outs, err
 
 
+# some jaxlib builds ship a CPU client without cross-process collective
+# support at all — the children die in the first psum with this exact
+# message. That is an environment limit, not a repo regression: skip
+# (the single-process mesh degradation tests still run everywhere).
+_BACKEND_UNSUPPORTED = \
+    "Multiprocess computations aren't implemented on the CPU backend"
+
+
 @pytest.mark.slow
 def test_two_process_distributed_matches_numpy():
     # one retry on a fresh port: _free_port closes the socket before the
     # coordinator binds it, so a busy host can steal it in the window
     outs, err = _spawn_and_collect(_free_port())
-    if err is not None:
+    if err is not None and _BACKEND_UNSUPPORTED not in err:
         outs, err = _spawn_and_collect(_free_port())
+    if err is not None and _BACKEND_UNSUPPORTED in err:
+        pytest.skip("this jaxlib's CPU backend does not implement "
+                    "multiprocess computations (environment limit, "
+                    "not a repo regression)")
     assert err is None, err
     assert len(outs) == 2
 

@@ -146,6 +146,15 @@ KNOBS: List[Dict[str, str]] = [
     {"name": "TMOG_EVENTLOG_KEEP", "default": "3",
      "doc": "docs/observability.md",
      "desc": "rotated event-log segments kept"},
+    # -- plan-time autotuning -----------------------------------------------
+    {"name": "TMOG_PLAN", "default": "1",
+     "doc": "docs/planning.md",
+     "desc": "plan-time autotuner kill switch (0 = every decision pins "
+             "to its hand default; explicit TMOG_* overrides still win)"},
+    {"name": "TMOG_PLAN_CORPUS_DIR", "default": "~/.cache (auto)",
+     "doc": "docs/planning.md",
+     "desc": "calibration-corpus directory the measured cost model "
+             "reads and calibrate/bench runs append to"},
     # -- continuous retraining ----------------------------------------------
     {"name": "TMOG_RETRAIN_FAULT", "default": "",
      "doc": "docs/retraining.md",

@@ -112,8 +112,13 @@ def _metric_fn(problem_type: str, metric: str, n_classes: int = 2,
 # Rows above which GLM sweeps route through the streaming lane-batched
 # kernel (ops/glm_sweep.py): one X pass per Newton iteration for ALL
 # (fold x grid) lanes instead of one per lane. Below it, the per-lane
-# vmapped program is simpler and compile-cheaper.
+# vmapped program is simpler and compile-cheaper. Since the autotuning
+# PR this is the HAND default of a plan-time decision (docs/planning.md)
+# — but reassigning the module global still pins the route outright
+# (hand beats model, same precedence as an env knob): tests and
+# bench.py's vmapped-retry path rely on exactly that.
 STREAMED_SWEEP_MIN_ROWS = 200_000
+_STREAMED_SWEEP_MIN_ROWS_HAND = STREAMED_SWEEP_MIN_ROWS
 
 def grid_fuse_max_failures() -> int:
     """Consecutive config-fused route failures tolerated before the
@@ -362,8 +367,23 @@ class Validator:
         # an assigned across-time warm seed (retrain refit) is only
         # consumable by the streamed rounds kernel — a seeded refit
         # takes this route regardless of scale, else the seed would be
-        # silently dropped (and warm_seeded honestly reported False)
-        if X.shape[0] < STREAMED_SWEEP_MIN_ROWS \
+        # silently dropped (and warm_seeded honestly reported False).
+        # The row floor is a plan-time decision (docs/planning.md): the
+        # measured crossover between the streamed and vmapped kernels
+        # at this (feat, lanes) shape, falling back to the hand
+        # STREAMED_SWEEP_MIN_ROWS on a cold corpus / TMOG_PLAN=0 /
+        # planner fault. A REASSIGNED module global is a hand override
+        # and wins over the model — the same precedence an explicitly
+        # set TMOG_* var gets
+        min_rows = STREAMED_SWEEP_MIN_ROWS
+        if min_rows == _STREAMED_SWEEP_MIN_ROWS_HAND:
+            try:
+                from ...planner.plan import glm_streamed_min_rows
+                min_rows = glm_streamed_min_rows(
+                    X.shape[1], n_folds * max(len(grids), 1))
+            except Exception:
+                min_rows = STREAMED_SWEEP_MIN_ROWS
+        if X.shape[0] < min_rows \
                 and getattr(self, "warm_seed", None) is None:
             return False
         from ...ops.glm_sweep import streamed_route_ok
@@ -827,6 +847,43 @@ class Validator:
                 groups.setdefault(bins_of(gi), []).append(gi)
             multicls = problem_type == "multiclass"
             from ...utils.metrics import collector
+
+            # config-fusion gate, resolved ONCE per sweep through the
+            # plan-time autotuner (docs/planning.md): an explicitly-set
+            # TMOG_GRID_FUSE wins either way (hand beats model, logged
+            # as plan_override); otherwise fusion turns on only when the
+            # corpus measured the fused route faster AND the planned
+            # out-block clears the compile-knee term — the 20-minute
+            # Mosaic compile r5 paid is now rejected at plan time. Cold
+            # corpus keeps today's opt-in default (off).
+            def depth_of(gi):
+                g = grids[gi]
+                if "max_depth" in g:
+                    return int(g["max_depth"])
+                return int(est.get_param("max_depth")) \
+                    if est.has_param("max_depth") else 0
+            n_shards = 1
+            if self.mesh is not None:
+                from ...parallel.mesh import BATCH_AXIS
+                n_shards = max(self.mesh.shape.get(BATCH_AXIS, 1), 1)
+            try:
+                from ...planner.plan import grid_fuse_enabled
+                plan_fuse_on = grid_fuse_enabled(
+                    n_rows=X.shape[0], n_feat=X.shape[1],
+                    n_folds=masks.shape[0], n_grids=len(pending),
+                    depth=max((depth_of(gi) for gi in pending),
+                              default=0),
+                    n_bins=int(max((b for b in groups if b), default=0)
+                               or 0),
+                    n_shards=n_shards)
+            except Exception:
+                # the degraded path must keep today's hand behavior
+                # EXACTLY: the pre-planner gate was an opt-IN whitelist
+                # (env_on's falsy-list parse would flip fusion ON for
+                # nonstandard truthy spellings like "yes")
+                plan_fuse_on = os.environ.get(
+                    "TMOG_GRID_FUSE", "").strip().lower() \
+                    in ("1", "true", "on")
             for _, group in sorted(groups.items(), key=lambda kv: str(kv[0])):
                 # n_valid: mesh runs pad rows (repeat-last) — the quantile
                 # sketch must see only the real rows so mesh and meshless
@@ -855,17 +912,13 @@ class Validator:
                     sig_groups.setdefault(key, []).append(gi)
                 for key, gis in sig_groups.items():
                     fused = None
-                    # OPT-IN (TMOG_GRID_FUSE=1): the widened-M hist
-                    # programs are bitwise-correct (ops-level parity
-                    # suite) but their Mosaic compiles ran 20+ minutes at
-                    # the 2M x 20-lane shape on first hardware contact —
-                    # until that compile cost is root-caused, the default
-                    # sweep keeps the proven per-config programs (and
-                    # their warm persistent-cache entries)
-                    fuse_on = os.environ.get(
-                        "TMOG_GRID_FUSE", "").strip().lower() \
-                        in ("1", "true", "on")
-                    if key[0] == "fuse" and len(gis) > 1 and fuse_on:
+                    # the widened-M hist programs are bitwise-correct
+                    # (ops-level parity suite) but their Mosaic compiles
+                    # ran 20+ minutes at the 2M x 20-lane shape on first
+                    # hardware contact — plan_fuse_on (resolved above)
+                    # keeps fusion opt-in until measured evidence clears
+                    # both the wall and the compile knee
+                    if key[0] == "fuse" and len(gis) > 1 and plan_fuse_on:
                         try:
                             fused = est.mask_fit_scores_grid(
                                 ctx, yd, wd, md, [grids[gi] for gi in gis],

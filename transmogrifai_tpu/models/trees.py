@@ -344,6 +344,7 @@ class _TreeEstimator(PredictorEstimator):
             else "grid_fused"
         label = "tree_sweep_grid_fused_sharded" if sharded \
             else "tree_sweep_grid_fused"
+        self._plan_growth_form()
         span = "tree_shard_merge" if sharded else (
             "tree_level_scan" if T.tree_scan_enabled() else None)
         loss = "squared" if regression else "logistic"
@@ -400,6 +401,42 @@ class _TreeEstimator(PredictorEstimator):
     # backend means fresh executables, and a mislabeled cold span's
     # compile wall would pollute warm-span GB/s claims).
     _WARM_FUSED_SHAPES: set = set()
+
+    @staticmethod
+    def _plan_growth_form() -> None:
+        """Plan-time scan-vs-unrolled choice for the fused fits
+        (docs/planning.md): consult the measured cost model and apply
+        it through ops/trees.set_tree_scan BEFORE the span label and
+        jit-cache signature are read. planned_tree_scan returns None —
+        the current form stays untouched, no cache clear, no behavior
+        change — unless the corpus MEASURED a preference; and even
+        then, a lever someone ELSE flipped stays flipped: an
+        explicitly-set TMOG_TREE_SCAN and a programmatic set_tree_scan
+        call (the documented runtime A/B lever) are both hand settings
+        and beat the model. The guard: the planner only moves the form
+        when it currently sits where the planner (or the hand default)
+        left it. Any planner fault leaves the form alone."""
+        try:
+            from ..planner.plan import planned_tree_scan
+            want = planned_tree_scan()
+        except Exception:
+            return
+        if want is None:
+            return
+        cur = T.tree_scan_enabled()
+        baseline = _TreeEstimator._plan_scan_applied
+        if baseline is None:
+            baseline = True  # ops/trees' hand default (scan on); an
+            #                  env-set TMOG_TREE_SCAN returned None above
+        if cur != baseline:
+            return  # hand-flipped at runtime: hand beats model
+        if want != cur:
+            T.set_tree_scan(want)
+        _TreeEstimator._plan_scan_applied = want
+
+    #: the last growth form the PLANNER applied (None = never) — the
+    #: hands-off guard above compares the live lever against this
+    _plan_scan_applied = None
 
     @staticmethod
     def _timed_fused_fit(label, Xb, lanes, depth, n_rounds, call,
@@ -812,6 +849,7 @@ class _GBTBase(_TreeEstimator):
         if not self._fused_route_ok(ctx, y, masks, kw["depth"]):
             return None
         Xb, edges, n_bins = ctx
+        self._plan_growth_form()
         _, _, margins = self._timed_fused_fit(
             "tree_sweep_fold_fused", Xb, masks.shape[0], kw["depth"],
             kw["n_rounds"],
@@ -1009,6 +1047,7 @@ class _XGBBase(_TreeEstimator):
         if not self._fused_route_ok(ctx, y, masks, kw["depth"]):
             return None
         Xb, edges, n_bins = ctx
+        self._plan_growth_form()
         _, _, margins = self._timed_fused_fit(
             "tree_sweep_fold_fused", Xb, masks.shape[0], kw["depth"],
             kw["n_rounds"],

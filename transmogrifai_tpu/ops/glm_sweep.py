@@ -140,12 +140,37 @@ ROUND_ITERS_DEFAULT = 5
 _BUCKET_MIN = 8
 
 
+_bucket_floor_cached = None
+
+
+def _bucket_floor() -> int:
+    """The compaction ladder's smallest bucket — a plan-time decision
+    since the autotuning PR (family ``glm_bucket``, docs/planning.md):
+    a measured corpus may move it, a cold corpus (or TMOG_PLAN=0, or
+    any planner fault) keeps the hand _BUCKET_MIN. Resolved ONCE per
+    process: bucket_lanes is read per retirement round, and a corpus
+    append from another process mid-sweep must not flip the floor
+    between rounds of one sweep — the padded program shapes (and the
+    'at most log2(L/floor)+1 distinct round programs' compile pin) are
+    fixed for the process lifetime. A planner fault is NOT cached, so
+    a transiently unreadable corpus can still resolve later."""
+    global _bucket_floor_cached
+    if _bucket_floor_cached is None:
+        try:
+            from ..planner.plan import planned_glm_bucket_floor
+            _bucket_floor_cached = max(planned_glm_bucket_floor(), 1)
+        except Exception:
+            return _BUCKET_MIN
+    return _bucket_floor_cached
+
+
 def bucket_lanes(n_active: int) -> int:
-    """Smallest power-of-two bucket >= n_active (floor _BUCKET_MIN): the
-    round kernel's lane axis is padded to this, so a sweep compiles at
-    most log2(L/_BUCKET_MIN)+1 distinct round programs per (n, d, F)
-    shape, reused across rounds, grid chunks and repeated sweeps."""
-    b = _BUCKET_MIN
+    """Smallest power-of-two bucket >= n_active (floor _bucket_floor,
+    hand default _BUCKET_MIN): the round kernel's lane axis is padded
+    to this, so a sweep compiles at most log2(L/floor)+1 distinct round
+    programs per (n, d, F) shape, reused across rounds, grid chunks and
+    repeated sweeps."""
+    b = _bucket_floor()
     while b < n_active:
         b *= 2
     return b

@@ -110,7 +110,16 @@ def stream_threshold_bytes() -> int:
 
 
 def stream_tile_rows_default() -> int:
-    return int(os.environ.get("TMOG_STATS_TILE_ROWS", str(1 << 18)))
+    """Rows per streamed statistics tile. An explicitly-set
+    TMOG_STATS_TILE_ROWS wins (hand beats model, logged as a
+    plan_override event); otherwise the plan-time autotuner picks the
+    tile shape — cold corpus / TMOG_PLAN=0 / any planner fault all
+    yield the 2^18 hand default (docs/planning.md)."""
+    try:
+        from ..planner.plan import planned_stats_tile_rows
+        return planned_stats_tile_rows()
+    except Exception:
+        return int(os.environ.get("TMOG_STATS_TILE_ROWS", str(1 << 18)))
 
 
 def stats_pass_bytes(n: int, d: int, *, itemsize: int = 4,

@@ -82,10 +82,18 @@ def tileplane_enabled() -> bool:
 
 
 def tile_budget_bytes() -> int:
-    """Host/device bytes per tile (TMOG_TILE_MB, default 32MB): the knob
-    that sizes every consumer's tile. Two tiles in flight + the carry is
-    the pipeline's whole device footprint."""
-    return int(os.environ.get("TMOG_TILE_MB", str(_TILE_MB_DEFAULT))) << 20
+    """Host/device bytes per tile: the knob that sizes every consumer's
+    tile. Two tiles in flight + the carry is the pipeline's whole device
+    footprint. An explicitly-set TMOG_TILE_MB wins (hand beats model);
+    otherwise the plan-time autotuner picks the size — a cold corpus
+    (or TMOG_PLAN=0, or any planner fault) yields the same 32MB hand
+    default this knob always had (docs/planning.md)."""
+    try:
+        from ..planner.plan import planned_tile_mb
+        return planned_tile_mb() << 20
+    except Exception:
+        return int(os.environ.get(
+            "TMOG_TILE_MB", str(_TILE_MB_DEFAULT))) << 20
 
 
 def tile_rows_for(row_bytes: int, n_rows: Optional[int] = None,

@@ -1,0 +1,283 @@
+"""The calibration corpus: append-only JSONL of measured plan evidence.
+
+One record = one measured observation of one kernel family at one shape
+under one route/knob setting: ``(backend, family, shape, route, knobs)
+-> (wall_s, compile_s, bytes_hbm, work)``. Records come from three
+sources (the ``src`` field): the bounded ``plan calibrate`` micro-bench
+grid, harvested TraceTree span artifacts (the kernel-roofline spans
+every traced fit/bench/ci run exports since PR 4), and future hardware
+bench runs — every bench run makes the planner smarter.
+
+Storage is one ``corpus-<backend>.jsonl`` per backend under the corpus
+dir (``TMOG_PLAN_CORPUS_DIR``), append-only, with content-hash dedupe
+so merging corpora from different runs and boxes composes: replaying
+the same bench artifact twice adds nothing, and two boxes' CPU corpora
+union cleanly while their TPU corpora stay separate files. Corrupt
+lines (torn tails from a killed run, hand edits) are skipped on load,
+never fatal — a broken corpus must degrade the planner to its priors,
+not break a fit.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, Iterable, List, Mapping, Optional
+
+RECORD_V = 1
+
+
+def _hostname() -> str:
+    try:
+        import platform
+        return platform.node() or "unknown"
+    except Exception:
+        return "unknown"
+
+#: span-name -> (family, route) map for harvesting the kernel-roofline
+#: spans traced runs export (utils/metrics collector.kernel). Families
+#: match the calibration micro-bench families so harvested hardware
+#: evidence and seeded CPU evidence feed the same decisions.
+_SPAN_FAMILIES = {
+    "tree_sweep_grid_fused": ("tree_sweep", "grid_fused"),
+    "tree_sweep_grid_fused_sharded": ("tree_sweep", "grid_fused_sharded"),
+    "tree_sweep_fold_fused": ("tree_fit", "fused"),
+    "tree_sweep_per_config": ("tree_sweep", "per_config"),
+    "stats_pass[fused]": ("stats_tile", "fused"),
+    "stats_pass[streamed]": ("stats_tile", "streamed"),
+    "stats_pass[sharded]": ("stats_tile", "sharded"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanRecord:
+    """One measured observation. ``shape`` holds the numeric geometry
+    (rows/feat/lanes/depth/...), ``knobs`` the knob values under test
+    (e.g. ``{"value": 32}`` for a tile-MB candidate), ``work`` the
+    normalizing unit count (bytes moved or rows processed) so walls
+    compare across shapes as unit costs. ``cold`` marks a wall that
+    includes jit trace + compile (only cold records inform the
+    compile-cost term; warm records inform the run-cost term)."""
+
+    family: str
+    backend: str
+    route: str = ""
+    shape: Mapping[str, float] = dataclasses.field(default_factory=dict)
+    knobs: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+    wall_s: float = 0.0
+    compile_s: float = 0.0
+    bytes_hbm: float = 0.0
+    work: float = 0.0
+    cold: bool = False
+    src: str = ""
+    host: str = ""
+    ts: float = 0.0
+
+    def key(self) -> str:
+        """Content hash for merge dedupe — everything but the timestamp
+        and the source label (the same measurement replayed from the
+        same artifact — or harvested twice under different src tags, as
+        a traced bench run does — must not double-weight the model)."""
+        doc = dataclasses.asdict(self)
+        doc.pop("ts", None)
+        doc.pop("src", None)
+        blob = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+        return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+    def to_json(self) -> Dict[str, Any]:
+        doc = dataclasses.asdict(self)
+        doc["v"] = RECORD_V
+        doc["shape"] = {k: float(v) for k, v in self.shape.items()}
+        return doc
+
+    @staticmethod
+    def from_json(doc: Mapping[str, Any]) -> "PlanRecord":
+        if not isinstance(doc, Mapping) or "family" not in doc \
+                or "backend" not in doc:
+            raise ValueError("not a plan record")
+        return PlanRecord(
+            family=str(doc["family"]), backend=str(doc["backend"]),
+            route=str(doc.get("route", "")),
+            shape={str(k): float(v)
+                   for k, v in (doc.get("shape") or {}).items()},
+            knobs=dict(doc.get("knobs") or {}),
+            wall_s=float(doc.get("wall_s", 0.0)),
+            compile_s=float(doc.get("compile_s", 0.0)),
+            bytes_hbm=float(doc.get("bytes_hbm", 0.0)),
+            work=float(doc.get("work", 0.0)),
+            cold=bool(doc.get("cold", False)),
+            src=str(doc.get("src", "")),
+            host=str(doc.get("host", "")),
+            ts=float(doc.get("ts", 0.0)))
+
+
+class Corpus:
+    """Per-backend JSONL record store under one directory."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    def _file(self, backend: str) -> str:
+        return os.path.join(self.path, f"corpus-{backend}.jsonl")
+
+    def backends(self) -> List[str]:
+        try:
+            names = sorted(os.listdir(self.path))
+        except OSError:
+            return []
+        return [n[len("corpus-"):-len(".jsonl")] for n in names
+                if n.startswith("corpus-") and n.endswith(".jsonl")]
+
+    def fingerprint(self) -> tuple:
+        """Cheap change token (name, size, mtime per backend file) — the
+        plan layer caches decisions against it, so an append or an
+        external merge invalidates cached choices without re-reading the
+        files on every knob lookup."""
+        out = []
+        for b in self.backends():
+            try:
+                st = os.stat(self._file(b))
+                out.append((b, st.st_size, st.st_mtime_ns))
+            except OSError:
+                continue
+        return tuple(out)
+
+    def load(self, backend: Optional[str] = None) -> List[PlanRecord]:
+        """All parseable records (one backend, or every backend file).
+        Corrupt/torn/foreign lines are skipped — load never raises on
+        file content."""
+        out: List[PlanRecord] = []
+        backends = [backend] if backend else self.backends()
+        for b in backends:
+            try:
+                with open(self._file(b), "r", encoding="utf-8") as fh:
+                    lines = fh.read().splitlines()
+            except OSError:
+                continue
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(PlanRecord.from_json(json.loads(line)))
+                except (ValueError, TypeError, KeyError):
+                    continue  # torn tail / hand edit: skip, never fatal
+        return out
+
+    def append(self, records: Iterable[PlanRecord]) -> int:
+        """Append records, deduping by content hash against what is
+        already stored (and within the batch). Returns the number of
+        NEW records written."""
+        by_backend: Dict[str, List[PlanRecord]] = {}
+        for r in records:
+            by_backend.setdefault(r.backend, []).append(r)
+        if not by_backend:
+            return 0
+        os.makedirs(self.path, exist_ok=True)
+        wrote = 0
+        for backend, recs in by_backend.items():
+            seen = {r.key() for r in self.load(backend)}
+            fresh = []
+            for r in recs:
+                if not r.host:
+                    # stamp the measuring machine: absolute unit costs
+                    # are only comparable within one host, and the cost
+                    # model's knob argmin groups by this field
+                    r = dataclasses.replace(r, host=_hostname())
+                k = r.key()
+                if k in seen:
+                    continue
+                seen.add(k)
+                if not r.ts:
+                    r = dataclasses.replace(r, ts=round(time.time(), 3))
+                fresh.append(r)
+            if not fresh:
+                continue
+            with open(self._file(backend), "a", encoding="utf-8") as fh:
+                for r in fresh:
+                    fh.write(json.dumps(r.to_json(), sort_keys=True)
+                             + "\n")
+            wrote += len(fresh)
+        return wrote
+
+    def merge_from(self, other: "Corpus") -> int:
+        """Fold another corpus dir in (per backend, dedup'd) — how
+        corpora from different boxes/runs compose."""
+        return self.append(other.load())
+
+    def summary(self) -> Dict[str, Any]:
+        """Record counts per (backend, family, route) for `plan show`."""
+        counts: Dict[str, Dict[str, int]] = {}
+        for r in self.load():
+            fam = counts.setdefault(r.backend, {})
+            key = f"{r.family}:{r.route}" if r.route else r.family
+            fam[key] = fam.get(key, 0) + 1
+        return {"path": self.path, "backends": counts,
+                "total": sum(sum(f.values()) for f in counts.values())}
+
+
+def harvest_metrics_doc(doc: Mapping[str, Any], backend: str,
+                        src: str = "harvest") -> List[PlanRecord]:
+    """Plan records from one saved AppMetrics JSON (the
+    ``bench_stage_metrics.json`` / ``stage_metrics.json`` artifact a
+    traced run writes — collector.save()).
+
+    Reads the span tree's kernel spans (they carry the shape attrs the
+    flat kernel_metrics list drops) and falls back to kernel_metrics
+    when no span tree was exported. Unknown span names are skipped —
+    harvesting an artifact from a newer/older repo version degrades to
+    fewer records, never an error."""
+    out: List[PlanRecord] = []
+    spans = doc.get("spans")
+    rows: List[Mapping[str, Any]] = []
+    if isinstance(spans, list):
+        rows = [s for s in spans if isinstance(s, dict)
+                and s.get("kind") == "kernel"]
+    if not rows:
+        rows = [m for m in doc.get("kernel_metrics") or []
+                if isinstance(m, dict)]
+    for s in rows:
+        name = str(s.get("name") or s.get("kernel") or "")
+        fam_route = _SPAN_FAMILIES.get(name)
+        if fam_route is None:
+            continue
+        family, route = fam_route
+        attrs = s.get("attrs") or {}
+        wall = float(s.get("duration_seconds")
+                     or s.get("wall_seconds") or 0.0)
+        if wall <= 0.0:
+            continue
+        cold = bool(attrs.get("cold", s.get("cold")) or False)
+        shape = {}
+        for k_attr, k_shape in (("n_rows", "rows"), ("rows", "rows"),
+                                ("cols", "feat"), ("lanes", "lanes"),
+                                ("depth", "depth"), ("tiles", "tiles"),
+                                ("n_rounds", "rounds")):
+            v = attrs.get(k_attr)
+            if isinstance(v, (int, float)) and k_shape not in shape:
+                shape[k_shape] = float(v)
+        bytes_hbm = float(attrs.get("bytes_hbm", s.get("bytes_hbm"))
+                          or 0.0)
+        out.append(PlanRecord(
+            family=family, backend=backend, route=route, shape=shape,
+            wall_s=0.0 if cold else wall,
+            compile_s=wall if cold else 0.0,
+            bytes_hbm=bytes_hbm, work=bytes_hbm or shape.get("rows", 0.0),
+            cold=cold, src=src))
+    return out
+
+
+def harvest_metrics_file(path: str, backend: str,
+                         src: str = "harvest") -> List[PlanRecord]:
+    """harvest_metrics_doc over a JSON file; unreadable/unparseable
+    files yield no records (harvest is best-effort by contract)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(doc, dict):
+        return []
+    return harvest_metrics_doc(doc, backend, src=src)

@@ -142,9 +142,17 @@ class CSVStreamingReader(FileStreamingReader):
 # -- tileplane bulk scoring ---------------------------------------------------
 
 def score_tile_rows_default() -> int:
-    """Records per scoring tile (TMOG_SCORE_TILE_ROWS): the fixed batch
-    shape every stage program compiles ONCE for."""
-    return int(os.environ.get("TMOG_SCORE_TILE_ROWS", "1024"))
+    """Records per scoring tile: the fixed batch shape every stage
+    program compiles ONCE for. An explicitly-set TMOG_SCORE_TILE_ROWS
+    wins (hand beats model, logged as a plan_override event); otherwise
+    the plan-time autotuner picks the tile — cold corpus / TMOG_PLAN=0
+    / any planner fault all yield the 1024 hand default
+    (docs/planning.md)."""
+    try:
+        from ..planner.plan import planned_score_tile_rows
+        return planned_score_tile_rows()
+    except Exception:
+        return int(os.environ.get("TMOG_SCORE_TILE_ROWS", "1024"))
 
 
 def _record_tiles(stream_reader: StreamingReader, tile_rows: int
