@@ -1298,6 +1298,133 @@ def _streaming_score_phase(avro_pattern, train_path, d, n):
             "rows_per_s": round(scored / max(wall, 1e-9))}
 
 
+# -- sharded ingest A/B (--ingest-ab) ---------------------------------------
+
+def ingest_ab_bench(n_rows=None):
+    """Serial-vs-parallel ingest A/B over a multi-shard CSV input
+    (docs/performance.md "Parallel sharded ingest"): three arms feed
+    the SAME streamed stats fit — the legacy per-record reader source,
+    the columnar sharded source at workers=1, and at workers=2 — and
+    the bench reports pure parse rows/s (source drained with no device
+    work), end-to-end fit wall + rows/s, a traced-probe device idle
+    share (1 - compute/wall on the tileplane consumer), and a
+    bit-identical check on the resulting moments. One JSON line; on CPU
+    the numbers are liveness + speedup shape, not absolute perf."""
+    import shutil
+    import tempfile
+
+    import jax
+    from transmogrifai_tpu.ops import stats_engine as SE
+    from transmogrifai_tpu.parallel import ingest as ING
+    from transmogrifai_tpu.parallel import tileplane as TP
+    from transmogrifai_tpu.readers.readers import CSVReader
+
+    backend = jax.default_backend()
+    n = int(n_rows) if n_rows else (2_000_000 if backend == "tpu"
+                                    else 120_000)
+    d, shards = 8, 8
+    out = {"metric": "ingest_ab", "backend": backend, "n_rows": n,
+           "n_cols": d, "shards": shards}
+
+    tmp = tempfile.mkdtemp(prefix="bench_ingest_")
+    try:
+        rng = np.random.default_rng(0)
+        per = -(-n // shards)
+        t0 = time.perf_counter()
+        paths = []
+        for s in range(shards):
+            rows = min(per, n - s * per)
+            p = os.path.join(tmp, f"part-{s:03d}.csv")
+            with open(p, "w") as fh:
+                fh.write(",".join(f"x{j}" for j in range(d))
+                         + ",y\n")
+                block = rng.normal(size=(rows, d + 1))
+                for r in block:
+                    fh.write(",".join(f"{v:.6f}" for v in r) + "\n")
+            paths.append(p)
+        out["write_s"] = round(time.perf_counter() - t0, 2)
+
+        def stats_cols(c):
+            return (np.stack([c[f"x{j}"] for j in range(d)], 1),
+                    c["y"], np.ones_like(c["y"]))
+
+        def stats_row(r):
+            return ([r[f"x{j}"] for j in range(d)], r["y"], 1.0)
+
+        def legacy_source():
+            def read_all():
+                for p in paths:
+                    yield from CSVReader(p).read()
+            return TP.reader_row_source(read_all, stats_row,
+                                        batch_records=8192, n_rows=n)
+
+        def columnar_source(workers):
+            return ING.sharded_reader_source(
+                paths, stats_cols, batch_records=8192, n_rows=n,
+                workers=workers, label=f"ab_w{workers}")
+
+        arms = [("legacy_per_record", legacy_source),
+                ("columnar_w1", lambda: columnar_source(1)),
+                ("columnar_w2", lambda: columnar_source(2))]
+        # warmup: compile the stats step once (same tile shape for all
+        # arms) so no arm's fit wall carries the cold compile
+        SE.run_stats(columnar_source(1), label="ab_warmup")
+        means = {}
+        for name, mk in arms:
+            # pure parse: drain the chunk stream, no device in the loop
+            t0 = time.perf_counter()
+            rows = sum(int(c[0].shape[0]) for c in mk().chunks())
+            parse_wall = time.perf_counter() - t0
+            assert rows == n
+            # end-to-end: the streamed stats fit (untraced — tracing
+            # fences each tile and would understate the async pipeline)
+            t0 = time.perf_counter()
+            res = SE.run_stats(mk(), label=f"ab_{name}")
+            fit_wall = time.perf_counter() - t0
+            means[name] = (np.asarray(res.mean), np.asarray(res.m2))
+            ps = SE._last_stream_stats
+            arm = {"parse_wall_s": round(parse_wall, 3),
+                   "parse_rows_per_s": round(n / max(parse_wall, 1e-9)),
+                   "fit_wall_s": round(fit_wall, 3),
+                   "fit_rows_per_s": round(n / max(fit_wall, 1e-9))}
+            if ps is not None:
+                arm["tiles"] = ps.tiles
+            # separate TRACED probe for the idle share (compute-side
+            # timings only accumulate under tracing): the fraction of
+            # the pass wall the consumer spent NOT computing —
+            # feed-starved headroom
+            from transmogrifai_tpu.utils.metrics import collector
+            collector.enable(f"bench_ingest_{name}")
+            try:
+                SE.run_stats(mk(), label=f"ab_probe_{name}")
+                ps = SE._last_stream_stats
+                if ps is not None and ps.wall_seconds:
+                    arm["device_idle_share"] = round(
+                        1.0 - ps.compute_seconds
+                        / max(ps.wall_seconds, 1e-9), 3)
+            finally:
+                collector.finish()
+                collector.disable()
+            out[name] = arm
+
+        ref_mean, ref_m2 = means["legacy_per_record"]
+        out["bit_identical"] = bool(all(
+            np.array_equal(m, ref_mean) and np.array_equal(q, ref_m2)
+            for m, q in means.values()))
+        legacy, w2 = out["legacy_per_record"], out["columnar_w2"]
+        out["parse_speedup_w2_vs_legacy"] = round(
+            w2["parse_rows_per_s"] / max(legacy["parse_rows_per_s"], 1),
+            2)
+        out["parse_speedup_w2_vs_w1"] = round(
+            w2["parse_rows_per_s"]
+            / max(out["columnar_w1"]["parse_rows_per_s"], 1), 2)
+        out["fit_speedup_w2_vs_legacy"] = round(
+            legacy["fit_wall_s"] / max(w2["fit_wall_s"], 1e-9), 2)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # -- serving scenario (--serving) -------------------------------------------
 
 def serving_bench(n_rows=None):
@@ -2108,6 +2235,10 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--streaming":
         print(json.dumps(streaming_bench(
+            sys.argv[2] if len(sys.argv) > 2 else None)))
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--ingest-ab":
+        print(json.dumps(ingest_ab_bench(
             sys.argv[2] if len(sys.argv) > 2 else None)))
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--serving":

@@ -341,6 +341,84 @@ shutil.rmtree(tmp, ignore_errors=True)
 print("streaming smoke ok: avro fit parity, bounded host buffer, "
       f"{scored} rows scored")
 PY
+# sharded ingest smoke (docs/performance.md "Parallel sharded ingest"):
+# a multi-shard CSV streams through the parse-worker pool at
+# TMOG_INGEST_WORKERS=2 — stats moments must be BIT-IDENTICAL to the
+# workers=1 serial pass, the parallel pass must add 0 compiles after
+# the serial warmup (same tile shapes => same executables), and the
+# exported trace must carry tile_parse spans from >=2 distinct workers
+# on their own ingest-w<j> lanes (trace-report --check below also
+# validates the ingest_pass events on the shared log)
+PYTHONPATH="$PWD" python - "$TRACE_DIR" <<'PY'
+import sys
+
+out = sys.argv[1]
+from transmogrifai_tpu.utils.platform import force_cpu
+
+force_cpu(2)
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from transmogrifai_tpu.ops import stats_engine as SE
+from transmogrifai_tpu.parallel import ingest as ING
+from transmogrifai_tpu.utils import tracing
+from transmogrifai_tpu.utils.metrics import collector
+
+collector.enable("ci_ingest")
+collector.attach_event_log(out + "/events.jsonl")
+
+n_shards, rows, d = 4, 900, 6
+rng = np.random.default_rng(0)
+tmp = tempfile.mkdtemp(prefix="ci_ingest_")
+paths = []
+for s in range(n_shards):
+    p = os.path.join(tmp, f"part-{s:03d}.csv")
+    with open(p, "w") as fh:
+        fh.write(",".join(f"x{j}" for j in range(d)) + ",y\n")
+        for r in rng.normal(size=(rows, d + 1)):
+            fh.write(",".join(f"{v:.6f}" for v in r) + "\n")
+    paths.append(p)
+
+
+def src(workers):
+    return ING.sharded_reader_source(
+        paths, lambda c: (np.stack([c[f"x{j}"] for j in range(d)], 1),
+                          c["y"], np.ones_like(c["y"])),
+        batch_records=256, n_rows=n_shards * rows, workers=workers,
+        label=f"ci_w{workers}")
+
+
+serial = SE.run_stats(src(1), tile_rows=1024, label="ci_ingest_serial")
+base = tracing.tracker.true_compiles
+parallel = SE.run_stats(src(2), tile_rows=1024, label="ci_ingest_par")
+compiles = tracing.tracker.true_compiles - base
+assert compiles == 0, f"parallel ingest pass compiled: {compiles}"
+for f in ("count", "mean", "variance", "m2", "min", "max"):
+    a, b = np.asarray(getattr(serial, f)), np.asarray(getattr(parallel, f))
+    assert np.array_equal(a, b), f"stats field {f} not bit-identical"
+
+spans = [s for s in collector.trace.spans if s.name == "tile_parse"]
+par_workers = {s.attrs["worker"] for s in spans
+               if s.attrs["label"] == "ci_w2"}
+assert len(par_workers) >= 2, f"parse workers seen: {par_workers}"
+lanes = {s.attrs["lane"] for s in spans}
+assert {"ingest-w0", "ingest-w1"} <= lanes, lanes
+[ingest_ev] = [r for r in collector.current.ingest_metrics
+               if r.workers == 2]
+assert ingest_ev.shards == n_shards and ingest_ev.rows == n_shards * rows
+
+collector.save(out + "/ingest_stage_metrics.json")
+collector.save_chrome_trace(out + "/ingest_trace.json")
+collector.detach_event_log()
+collector.disable()
+import shutil
+shutil.rmtree(tmp, ignore_errors=True)
+print(f"ingest smoke ok: bit-identical at workers=2, 0 compiles, "
+      f"{len(par_workers)} parse lanes")
+PY
 # serving smoke (docs/serving.md): fit + save a model, `serve
 # --prewarm-only` via the real CLI (populates the persistent compile
 # cache + writes the serve.json manifest), then a FRESH process starts

@@ -113,6 +113,12 @@ KNOBS: List[Dict[str, str]] = [
     {"name": "TMOG_SCORE_TILE_ROWS", "default": "1024",
      "doc": "docs/performance.md",
      "desc": "records per bulk-scoring tile (0 = legacy per-record path)"},
+    {"name": "TMOG_TILE_PREFETCH", "default": "1 (planner may raise)",
+     "doc": "docs/performance.md",
+     "desc": "tileplane prefetch ring depth (tiles queued ahead of compute)"},
+    {"name": "TMOG_INGEST_WORKERS", "default": "1 (planner may raise)",
+     "doc": "docs/performance.md",
+     "desc": "parse-worker pool size for sharded columnar ingest"},
     # -- serving ------------------------------------------------------------
     {"name": "TMOG_SERVE_SPAN_BUDGET", "default": "10000",
      "doc": "docs/serving.md",

@@ -124,15 +124,23 @@ def offline_report(model: Any, stream_reader: Any,
 # -- the `monitor` CLI body ---------------------------------------------------
 
 def _file_stream_reader(path: str, batch_records: int):
-    """A single bulk file as a record stream (CSV or Avro)."""
-    from ..readers.streaming import ListStreamingReader
+    """A single bulk file as a record stream (CSV or Avro), decoded
+    LAZILY: batches come off the file as the scoring tileplane drains
+    them instead of materializing the whole record list up front —
+    the monitor's bulk replay now holds at most the in-flight tiles
+    plus one decode batch, whatever the file size."""
+    from ..readers.streaming import IterStreamingReader
     if path.endswith(".avro"):
         from ..readers.avro import read_avro_file
-        records = list(read_avro_file(path))
+
+        def records():
+            return read_avro_file(path)
     else:
         from ..readers.readers import CSVReader
-        records = CSVReader(path).read()
-    return ListStreamingReader(records, batch_size=batch_records)
+
+        def records():
+            return CSVReader(path).iter_records()
+    return IterStreamingReader(records, batch_records=batch_records)
 
 
 def run_monitor(args: Any) -> int:

@@ -985,6 +985,11 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
         d = int(probe[0].shape[1])
         F = int(probe[3].shape[1])
         tile_rows = TP.tile_rows_for(4 * (d + F + 2), X.n_rows)
+        # ring depth resolved ONCE for the whole sweep (prep pass +
+        # every Newton round) — per-round re-resolution could let a
+        # mid-sweep env/corpus change vary the ring between rounds,
+        # and one sweep should run one configuration end to end
+        prefetch = TP.tile_prefetch_depth()
     else:
         F = int(fold_masks.shape[0])
         d = int(X.shape[1])
@@ -1008,7 +1013,7 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
                  jnp.zeros(d, jnp.float32), jnp.zeros(F, jnp.float32))
         (cnt, mu, m2, wsum_f_dev), _ = TP.run_tileplane(
             X, _source_prep_step, prep0, tile_rows=tile_rows,
-            label="glm_prep")
+            label="glm_prep", prefetch=prefetch)
         # host-side fold weight sums; device tiles stay f32
         wsum_f_h = np.maximum(np.asarray(
             wsum_f_dev, np.float64), EPS)  # tmoglint: disable=TPU003  host-only
@@ -1082,7 +1087,8 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
 
             (gA, hA, g0A, h0A), _ps = TP.run_tileplane(
                 X, step, _source_round_acc0(Lb, d_work),
-                tile_rows=tile_rows, label="glm_round")
+                tile_rows=tile_rows, label="glm_round",
+                prefetch=prefetch)
             B, b0j, delta_dev = _source_round_update(
                 gA, hA, g0A, h0A, B, b0j, wsum_l, l1j, l2j,
                 fit_intercept=bool(fit_intercept))

@@ -763,7 +763,8 @@ def _stream_source(X, y, w, tile_rows: Optional[int]):
 
 def stream_stats(X, y=None, w=None, *, tile_rows: Optional[int] = None,
                  distinct=None, clip=None, lo=None, hi=None, bins: int = 0,
-                 corr_matrix: bool = False, mesh=None):
+                 corr_matrix: bool = False, mesh=None,
+                 prefetch: Optional[int] = None):
     """Streamed row-tile driver for data larger than HBM.
 
     X may be a host array (with y/w arrays) or a `tileplane.RowSource`
@@ -777,7 +778,9 @@ def stream_stats(X, y=None, w=None, *, tile_rows: Optional[int] = None,
     The Gram shift comes from the first tile ON DEVICE (no second read
     of its rows). TMOG_TILEPLANE=0 restores the legacy synchronous loop
     with per-tile host f64 merge. Still exactly one read of every row of
-    X per pass. Returns (merged host state, shift)."""
+    X per pass. `prefetch` overrides the tileplane ring depth for this
+    pass (None = env > planner > hand default 1; bit-identical at any
+    depth). Returns (merged host state, shift)."""
     from ..parallel import mesh as M
     from ..parallel import tileplane as TP
 
@@ -854,9 +857,13 @@ def stream_stats(X, y=None, w=None, *, tile_rows: Optional[int] = None,
                           else int(np.asarray(distinct).shape[0]),
                           bins=bins, big=big),
               jnp.zeros(d, jnp.float32))
+    # depth resolved HERE (env > planner > hand default 1) so the pass
+    # stats record the ring the pass actually ran with; depth never
+    # changes tile boundaries, so results are bit-identical at any value
+    depth = max(1, int(prefetch)) if prefetch else TP.tile_prefetch_depth()
     (st, shift), ps = TP.run_tileplane(
         source, step, carry0, tile_rows=c, label="stats",
-        first_tile=first_tile, shardings=shardings)
+        first_tile=first_tile, shardings=shardings, prefetch=depth)
     _last_stream_stats = ps
     # the ONE fetch of the pass
     return _fetch_state(st), np.asarray(shift, np.float32)

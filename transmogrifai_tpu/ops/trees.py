@@ -204,7 +204,8 @@ def _x_source_with_dummies(source):
 
 
 def stream_quantile_edges(source, n_bins: int, *, hist_bins: int = 1024,
-                          tile_rows: Optional[int] = None) -> np.ndarray:
+                          tile_rows: Optional[int] = None,
+                          prefetch: Optional[int] = None) -> np.ndarray:
     """Per-feature quantile bin edges from a STREAMED source — the
     larger-than-HBM replacement for `quantile_edges`.
 
@@ -223,7 +224,8 @@ def stream_quantile_edges(source, n_bins: int, *, hist_bins: int = 1024,
     from . import stats_engine as SE
 
     wrapped = _x_source_with_dummies(source)
-    st, _ = SE.stream_stats(wrapped, tile_rows=tile_rows)
+    st, _ = SE.stream_stats(wrapped, tile_rows=tile_rows,
+                            prefetch=prefetch)
     # host-only sketch finalize on [d]-vectors; device tiles stay f32
     f8 = np.float64  # tmoglint: disable=TPU003  host-only precision
     cnt = np.asarray(st.cnt, f8)
@@ -235,7 +237,7 @@ def stream_quantile_edges(source, n_bins: int, *, hist_bins: int = 1024,
     hi_r = np.where(ok, hi, 1.0).astype(np.float32)
     st2, _ = SE.stream_stats(_x_source_with_dummies(source),
                              tile_rows=tile_rows, lo=lo_r, hi=hi_r,
-                             bins=int(hist_bins))
+                             bins=int(hist_bins), prefetch=prefetch)
     hist = np.asarray(st2.hist, f8).reshape(d, hist_bins + 1)[:, :hist_bins]
 
     edges = np.full((d, n_bins - 1), np.nan, np.float32)
@@ -258,7 +260,7 @@ def stream_quantile_edges(source, n_bins: int, *, hist_bins: int = 1024,
 
 
 def stream_bin_matrix(source, edges, *, tile_rows: Optional[int] = None,
-                      sink=None):
+                      sink=None, prefetch: Optional[int] = None):
     """Second streamed pass: emit the binned matrix tile-by-tile.
 
     Each fixed-shape tile runs the SAME `_bin_block` rule as the
@@ -303,7 +305,8 @@ def stream_bin_matrix(source, edges, *, tile_rows: Optional[int] = None,
     # TMOG_TILEPLANE=0 degrades inside run_tileplane to the synchronous
     # single-thread loop — same tiles, same rule, no producer thread
     TP.run_tileplane(source, step, jnp.zeros((), jnp.int32),
-                     tile_rows=c, label="tree_bin", sink=out_sink)
+                     tile_rows=c, label="tree_bin", sink=out_sink,
+                     prefetch=prefetch)
     if sink is not None:
         return None
     if full is not None:

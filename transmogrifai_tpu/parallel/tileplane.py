@@ -18,9 +18,20 @@ This module is the ONE pipeline those consumers now share:
   numpy tiles (ragged tail zero-padded — the repo-wide zero-weight pad
   convention makes padded rows inert in every consumer's math) and
   `device_put`s tile k+1 while the caller's thread runs tile k's jitted
-  step — classic double buffering. A one-token copy slot (released when
-  the consumer dequeues a tile) gates each device_put, so at most TWO
-  tiles are ever in flight: the one computing and the one being copied;
+  step — classic double buffering, generalized to a DEPTH-N PREFETCH
+  RING: the copy slot carries `TMOG_TILE_PREFETCH` tokens (released
+  when the consumer dequeues a tile), so at most depth+1 tiles are ever
+  in flight — the one computing plus up to `depth` copied-ahead. The
+  hand default of 1 is exactly the old two-in-flight double buffering;
+  the plan-time autotuner raises it when measured tile_parse/tile_copy
+  unit costs dominate tile_compute (docs/planning.md). Depth NEVER
+  changes tile sizes or boundaries, so results stay bit-identical at
+  any depth;
+- the feed side itself can parallelize: a RowSource may parse file
+  shards on a worker pool (parallel/ingest.ShardedSource) as long as
+  `chunks()` yields the same chunk sequence as a serial read — the
+  fixed-tile assembly below is order-preserving, which is what keeps
+  stats/GLM/tree reductions bit-identical to serial ingest;
 - the CARRY (moment state, GLM accumulators) stays device-resident for
   the whole pass and is fetched ONCE at the end, not per tile;
 - the consumer's jitted step DONATES the carry (donate_argnums=(0,)),
@@ -64,6 +75,7 @@ from typing import (Any, Callable, Dict, Iterable, Iterator, List,
 import numpy as np
 
 _TILE_MB_DEFAULT = 32
+_TILE_PREFETCH_DEFAULT = 1
 
 
 def env_on(name: str, default: str = "1") -> bool:
@@ -94,6 +106,27 @@ def tile_budget_bytes() -> int:
     except Exception:
         return int(os.environ.get(
             "TMOG_TILE_MB", str(_TILE_MB_DEFAULT))) << 20
+
+
+def tile_prefetch_depth() -> int:
+    """Copy-slot tokens in the prefetch ring: how many tiles the
+    producer may run AHEAD of the consumer (device footprint is
+    depth+1 tiles plus the carry). An explicitly-set TMOG_TILE_PREFETCH
+    wins (hand beats model); otherwise the plan-time autotuner derives
+    the depth from measured tile_parse/tile_copy/tile_compute span
+    ratios — a cold corpus (or TMOG_PLAN=0, or any planner fault)
+    yields the depth-1 hand default, i.e. the classic double buffering
+    this pipeline always had. Depth only changes how far the feed side
+    runs ahead, never tile shapes, so any depth is bit-identical."""
+    try:
+        from ..planner.plan import planned_tile_prefetch
+        return max(1, int(planned_tile_prefetch()))
+    except Exception:
+        try:
+            return max(1, int(os.environ.get(
+                "TMOG_TILE_PREFETCH", str(_TILE_PREFETCH_DEFAULT))))
+        except ValueError:
+            return _TILE_PREFETCH_DEFAULT
 
 
 def tile_rows_for(row_bytes: int, n_rows: Optional[int] = None,
@@ -144,6 +177,14 @@ class RowSource:
                 if close is not None:
                     close()
         return self._peek_cache
+
+    def set_span_anchor(self, anchor: Any) -> None:
+        """Tile-span parent hook: run_tileplane hands the span current
+        at pass START here, on the caller's thread, BEFORE any pipeline
+        thread starts — a source that records its own `tile_parse`
+        spans from parse workers (parallel/ingest.ShardedSource)
+        parents them to the same anchor as tile_copy/tile_compute.
+        Default: ignore."""
 
 
 class ArraySource(RowSource):
@@ -279,9 +320,10 @@ def iter_fixed_tiles(source: RowSource, tile_rows: int,
 class TilePlaneStats:
     """Per-pass pipeline telemetry (mutable; filled as the pass runs)."""
 
-    def __init__(self, tile_rows: int, label: str):
+    def __init__(self, tile_rows: int, label: str, prefetch: int = 1):
         self.label = label
         self.tile_rows = int(tile_rows)
+        self.prefetch_depth = int(prefetch)
         self.tiles = 0
         self.rows = 0
         #: max host rows buffered in the tile assembly at any instant —
@@ -296,6 +338,7 @@ class TilePlaneStats:
     def to_json(self) -> Dict[str, Any]:
         return {"label": self.label, "tiles": self.tiles, "rows": self.rows,
                 "tile_rows": self.tile_rows,
+                "prefetch_depth": self.prefetch_depth,
                 "peak_host_rows": self.peak_host_rows,
                 "copy_seconds": round(self.copy_seconds, 6),
                 "compute_seconds": round(self.compute_seconds, 6),
@@ -327,11 +370,12 @@ def _producer(source: RowSource, tile_rows: int, q: "queue.Queue",
     to the span current at pass START — the consumer thread's transient
     stage spans open and close concurrently and must not adopt them).
 
-    `copy_slot` (1 token, released when the consumer DEQUEUES a tile)
-    gates each device_put: at most one tile is copied-but-unconsumed
-    while one computes, so in-flight device tiles are bounded at TWO —
-    the double-buffering contract the TMOG_TILE_MB sizing guidance
-    promises."""
+    `copy_slot` (prefetch-depth tokens, each released when the consumer
+    DEQUEUES a tile) gates each device_put: at most `depth` tiles are
+    copied-but-unconsumed while one computes, so in-flight device tiles
+    are bounded at depth+1 — the footprint contract the TMOG_TILE_MB
+    sizing guidance promises (depth 1 = the classic two-in-flight
+    double buffering)."""
     import jax
 
     from ..utils.metrics import collector
@@ -379,10 +423,17 @@ def run_tileplane(source: RowSource, step: Callable[..., Any], carry0: Any,
                   *, tile_rows: int, label: str = "tileplane",
                   first_tile: Optional[Callable[..., Any]] = None,
                   sink: Optional[Callable[[np.ndarray, int], None]] = None,
-                  shardings: Optional[Sequence[Any]] = None
+                  shardings: Optional[Sequence[Any]] = None,
+                  prefetch: Optional[int] = None
                   ) -> Tuple[Any, TilePlaneStats]:
     """ONE double-buffered pass of `source` through a fixed-shape jitted
     `step`, returning the final DEVICE carry and the pass stats.
+
+    `prefetch` is the ring depth — how many tiles the producer may copy
+    ahead of the consumer (None resolves tile_prefetch_depth(): env >
+    planner > hand default 1). Depth changes device footprint
+    ((depth+1) tiles + carry) and overlap, never tile boundaries, so
+    the carry is bit-identical at any depth.
 
     step(carry, *tile_arrays) -> carry, or -> (carry, out_tile) when
     `sink` is given (out tiles are fetched with a one-tile lag and handed
@@ -398,7 +449,12 @@ def run_tileplane(source: RowSource, step: Callable[..., Any], carry0: Any,
 
     traced = bool(collector.enabled)
     anchor = collector.trace.current() if traced else None
-    stats = TilePlaneStats(tile_rows, label)
+    depth = max(1, int(prefetch)) if prefetch else tile_prefetch_depth()
+    stats = TilePlaneStats(tile_rows, label, prefetch=depth)
+    # anchor handed over BEFORE any pipeline thread exists: a sharded
+    # source's parse workers parent their tile_parse spans to the same
+    # span the copy/compute spans use
+    source.set_span_anchor(anchor)
     t_pass = time.perf_counter()
     if not tileplane_enabled():
         # kill switch: the SAME pass, fully synchronous on the caller's
@@ -407,10 +463,10 @@ def run_tileplane(source: RowSource, step: Callable[..., Any], carry0: Any,
                          stats=stats, first_tile=first_tile, sink=sink,
                          shardings=shardings, traced=traced,
                          anchor=anchor, t_pass=t_pass)
-    q: "queue.Queue" = queue.Queue(maxsize=1)
-    # one copy slot, released when a tile is DEQUEUED: while tile k
-    # computes, exactly tile k+1 may be copied — two tiles in flight
-    copy_slot = threading.Semaphore(1)
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    # `depth` copy slots, each released when a tile is DEQUEUED: while
+    # tile k computes, tiles k+1..k+depth may be copied ahead
+    copy_slot = threading.Semaphore(depth)
     stop = threading.Event()
     th = threading.Thread(
         target=_producer, args=(source, tile_rows, q, copy_slot, stop,
@@ -519,6 +575,7 @@ def _finish_pass(stats: TilePlaneStats, traced: bool,
         collector.event(
             "tileplane_pass", label=stats.label, tiles=stats.tiles,
             rows=stats.rows, tile_rows=stats.tile_rows,
+            prefetch_depth=stats.prefetch_depth,
             peak_host_rows=stats.peak_host_rows,
             copy_seconds=round(stats.copy_seconds, 6),
             compute_seconds=round(stats.compute_seconds, 6),
@@ -557,18 +614,20 @@ def _run_sync(source: RowSource, step, carry0, *, tile_rows: int,
 
 # -- generic pipelined producer/consumer (record-batch consumers) ------------
 
-def pipelined(produce: Iterable[Any], *, label: str = "tileplane"
-              ) -> Iterator[Any]:
+def pipelined(produce: Iterable[Any], *, label: str = "tileplane",
+              depth: Optional[int] = None) -> Iterator[Any]:
     """Run `produce` (any host-side iterable — e.g. records -> fixed-size
-    Dataset tiles for bulk scoring) on a background thread with a 1-deep
-    queue, yielding its items on the caller's thread.
+    Dataset tiles for bulk scoring) on a background thread with a
+    `depth`-deep queue, yielding its items on the caller's thread.
 
     The array pipeline above is for numeric tile math; this is the same
-    double-buffering for consumers whose 'tile' is a host object (the
+    prefetch ring for consumers whose 'tile' is a host object (the
     scoring path assembles a Dataset per record tile here while the
-    device scores the previous one). Items are produced at most one
-    ahead."""
-    q: "queue.Queue" = queue.Queue(maxsize=1)
+    device scores the previous one). Items are produced at most `depth`
+    ahead (None resolves tile_prefetch_depth(); the hand default of 1
+    is the old one-ahead double buffering)."""
+    d = max(1, int(depth)) if depth else tile_prefetch_depth()
+    q: "queue.Queue" = queue.Queue(maxsize=d)
     stop = threading.Event()
 
     def body():

@@ -50,6 +50,19 @@ _SPAN_FAMILIES = {
     "stats_pass[sharded]": ("stats_tile", "sharded"),
 }
 
+#: tile-kind span names -> (family, route): the per-tile feed/compute
+#: spans the tileplane and the sharded ingest engine emit. Harvested
+#: AGGREGATED — per (name, label) sums over a whole pass — because one
+#: traced pass emits hundreds of near-identical per-tile spans and the
+#: planner only needs their unit costs; the tile_prefetch decision
+#: derives its ring depth from these families' feed/compute ratio
+#: (planner/model.feed_compute_ratio).
+_TILE_SPAN_FAMILIES = {
+    "tile_parse": ("ingest_parse", "parse"),
+    "tile_copy": ("tileplane_copy", "copy"),
+    "tile_compute": ("tileplane_compute", "compute"),
+}
+
 
 @dataclasses.dataclass(frozen=True)
 class PlanRecord:
@@ -266,6 +279,46 @@ def harvest_metrics_doc(doc: Mapping[str, Any], backend: str,
             compile_s=wall if cold else 0.0,
             bytes_hbm=bytes_hbm, work=bytes_hbm or shape.get("rows", 0.0),
             cold=cold, src=src))
+    if isinstance(spans, list):
+        out.extend(_harvest_tile_spans(spans, backend, src))
+    return out
+
+
+def _harvest_tile_spans(spans: List[Mapping[str, Any]], backend: str,
+                        src: str) -> List[PlanRecord]:
+    """One aggregate record per (tile-span name, pass label): summed
+    wall over summed rows, i.e. the pass's unit cost for that pipeline
+    stage, with the tile count in the shape. Per-tile harvesting would
+    bloat the corpus by hundreds of records per traced pass while
+    informing the exact same median."""
+    agg: Dict[tuple, List[float]] = {}
+    for s in spans:
+        if not isinstance(s, dict) or s.get("kind") != "tile":
+            continue
+        name = str(s.get("name") or "")
+        if name not in _TILE_SPAN_FAMILIES:
+            continue
+        wall = float(s.get("duration_seconds") or 0.0)
+        if wall <= 0.0:
+            continue
+        attrs = s.get("attrs") or {}
+        rows = attrs.get("rows")
+        rows = float(rows) if isinstance(rows, (int, float)) else 0.0
+        slot = agg.setdefault((name, str(attrs.get("label") or "")),
+                              [0.0, 0.0, 0.0])
+        slot[0] += wall
+        slot[1] += rows
+        slot[2] += 1.0
+    out: List[PlanRecord] = []
+    for (name, label), (wall, rows, tiles) in agg.items():
+        if rows <= 0.0:
+            continue
+        family, route = _TILE_SPAN_FAMILIES[name]
+        out.append(PlanRecord(
+            family=family, backend=backend, route=route,
+            shape={"rows": rows, "tiles": tiles},
+            knobs={"label": label} if label else {},
+            wall_s=wall, work=rows, src=src))
     return out
 
 

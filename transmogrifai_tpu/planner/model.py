@@ -55,6 +55,11 @@ HAND_DEFAULTS: Dict[str, Any] = {
     "glm_bucket_floor": 8,
     # serve/engine._BUCKET_FLOOR (serving bucket ladder floor)
     "serve_bucket_floor": 8,
+    # parallel/tileplane TMOG_TILE_PREFETCH default (prefetch-ring
+    # depth; 1 = the classic two-in-flight double buffering)
+    "tile_prefetch": 1,
+    # parallel/ingest TMOG_INGEST_WORKERS default (parse-worker pool)
+    "ingest_workers": 1,
 }
 
 #: candidate grids the measured argmin searches over (the default is
@@ -68,6 +73,8 @@ CANDIDATES: Dict[str, Tuple] = {
     "serve_bucket_floor": (2, 4, 8),
     "grid_fuse_hbm_lanes": (32, 64, 128),
     "grid_fuse_out_mb": (2.0, 4.0, 8.0, 12.0, 16.0),
+    "tile_prefetch": (1, 2, 3, 4),
+    "ingest_workers": (1, 2, 4, 8),
 }
 
 #: Mosaic compile budget a planned program must clear; anything past it
@@ -238,6 +245,38 @@ class CostModel:
             return default, "prior", alts
         best = min(winners, key=lambda c: winners[c])
         return best, "measured", alts
+
+    def feed_compute_ratio(self) -> Optional[float]:
+        """Median (tile_parse + tile_copy) / tile_compute unit-cost
+        ratio over the harvested tileplane tile spans — how many times
+        slower the FEED side (host parse + H2D copy) runs than the
+        device step. The prefetch-depth decision sizes the ring from
+        this: a feed k x slower than compute needs ~k tiles in flight
+        before the device stops starving.
+
+        Per host, like choose_value: absolute unit costs are not
+        comparable across machines, so the ratio is formed only on
+        hosts that measured the compute side, and the cross-host median
+        is returned. None when no host measured tile_compute, or no
+        host measured any feed-side family — cold stays cold."""
+        def per_host(family: str) -> Dict[str, float]:
+            hosts: Dict[str, List[float]] = {}
+            for r in self.obs(family):
+                hosts.setdefault(r.host, []).append(
+                    self._unit_cost(r, _default_work))
+            return {h: statistics.median(v) for h, v in hosts.items()}
+
+        compute = per_host("tileplane_compute")
+        parse = per_host("ingest_parse")
+        copy = per_host("tileplane_copy")
+        ratios = []
+        for host, c in compute.items():
+            if c <= 0:
+                continue
+            feed = parse.get(host, 0.0) + copy.get(host, 0.0)
+            if feed > 0:
+                ratios.append(feed / c)
+        return statistics.median(ratios) if ratios else None
 
     def choose_route(self, family: str, routes: Sequence[str],
                      default: str, shape: Mapping[str, float],

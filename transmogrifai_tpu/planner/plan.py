@@ -18,6 +18,11 @@ score_tile_rows             readers/streaming.score_tile_rows_default
 glm_bucket_floor            ops/glm_sweep.bucket_lanes (lane-retirement
                             compaction ladder)
 serve_bucket_floor          serve/engine bucket ladder (plan_serving)
+tile_prefetch               parallel/tileplane.tile_prefetch_depth
+                            (prefetch-ring depth; derived from measured
+                            tile_parse/tile_copy/tile_compute ratios)
+ingest_workers              parallel/ingest.ingest_workers (sharded
+                            parse-worker pool size)
 ==========================  ===========================================
 
 Precedence, strictly: **an explicitly-set TMOG_* env var always wins**
@@ -53,6 +58,8 @@ _ENV_FOR: Dict[str, str] = {
     "tile_mb": "TMOG_TILE_MB",
     "stats_tile_rows": "TMOG_STATS_TILE_ROWS",
     "score_tile_rows": "TMOG_SCORE_TILE_ROWS",
+    "tile_prefetch": "TMOG_TILE_PREFETCH",
+    "ingest_workers": "TMOG_INGEST_WORKERS",
 }
 
 _lock = threading.Lock()
@@ -226,6 +233,55 @@ def planned_score_tile_rows() -> int:
     return int(_decide(
         "score_tile_rows",
         _value_decision("score_tile_rows", "score_tile")).value)
+
+
+def _compute_tile_prefetch(model: CostModel) -> PlanDecision:
+    """Prefetch-ring depth: the measured knob argmin when the knob
+    family carries direct A/B evidence; otherwise DERIVED from the
+    measured tile-span ratios the tileplane already publishes — a feed
+    side (tile_parse + tile_copy unit cost) running k x slower than the
+    device step (tile_compute) needs ~ceil(k) tiles in flight before
+    the consumer stops starving, clamped to the candidate range. Cold
+    on both -> the depth-1 hand default (classic double buffering)."""
+    import math as _math
+
+    default = HAND_DEFAULTS["tile_prefetch"]
+    value, source, alts = model.choose_value(
+        "tile_prefetch", "tileplane_prefetch", default)
+    if source == "measured":
+        return PlanDecision(name="tile_prefetch", value=value,
+                            source=source, alternatives=alts)
+    ratio = model.feed_compute_ratio()
+    if ratio is None:
+        return PlanDecision(name="tile_prefetch", value=value,
+                            source=source, alternatives=alts,
+                            reason="no tile-span evidence")
+    from .model import CANDIDATES
+    cap = max(CANDIDATES["tile_prefetch"])
+    depth = max(1, min(cap, int(_math.ceil(ratio))))
+    return PlanDecision(
+        name="tile_prefetch", value=depth,
+        source="prior" if depth == default else "measured",
+        alternatives=alts,
+        reason=f"feed/compute unit-cost ratio {ratio:.2f}")
+
+
+def planned_tile_prefetch() -> int:
+    """Tileplane prefetch-ring depth —
+    parallel/tileplane.tile_prefetch_depth."""
+    return max(1, int(_decide("tile_prefetch",
+                              _compute_tile_prefetch).value))
+
+
+def planned_ingest_workers() -> int:
+    """Sharded-ingest parse-worker pool size —
+    parallel/ingest.ingest_workers. Moves off the serial hand default
+    only on direct measured A/B evidence (the ingest_ab bench / a
+    calibration run feeding the ingest_parse family with knob
+    records)."""
+    return max(1, int(_decide(
+        "ingest_workers",
+        _value_decision("ingest_workers", "ingest_parse")).value))
 
 
 def planned_glm_bucket_floor() -> int:
@@ -484,6 +540,11 @@ def plan_fit(n_rows: int, n_feat: int, *, n_folds: int = 1,
     decisions["glm_bucket_floor"] = _decide(
         "glm_bucket_floor",
         _value_decision("glm_bucket_floor", "glm_bucket"))
+    decisions["tile_prefetch"] = _decide("tile_prefetch",
+                                         _compute_tile_prefetch)
+    decisions["ingest_workers"] = _decide(
+        "ingest_workers",
+        _value_decision("ingest_workers", "ingest_parse"))
     shape = {"rows": float(n_rows), "feat": float(n_feat),
              "folds": float(n_folds), "grids": float(n_grids),
              "depth": float(depth), "bins": float(n_bins),

@@ -14,7 +14,8 @@ import json
 import os
 import struct
 import zlib
-from typing import Any, BinaryIO, Callable, Dict, Iterator, List, Optional
+from typing import (Any, BinaryIO, Callable, Dict, Iterator, List,
+                    Optional, Sequence, Tuple)
 
 _MAGIC = b"Obj\x01"
 
@@ -163,8 +164,11 @@ def _decode(schema: Any, d: _Bin, named: Dict[str, Any]) -> Any:
     raise AvroDecodeError(f"unsupported schema: {schema!r}")
 
 
-def read_avro_file(path: str) -> Iterator[Dict[str, Any]]:
-    """Iterate records of one OCF file."""
+def _open_ocf(path: str) -> Tuple[_Bin, Any, str, bytes, Dict[str, Any]]:
+    """Parse one OCF header: returns the decoder positioned at the first
+    data block plus (schema, codec, sync, named-type registry). Shared
+    by the record iterator and the columnar block reader so both see
+    the identical framing/codec contract."""
     with open(path, "rb") as f:
         data = f.read()
     d = _Bin(data)
@@ -186,7 +190,12 @@ def read_avro_file(path: str) -> Iterator[Dict[str, Any]]:
     codec = meta.get("avro.codec", b"null").decode()
     named: Dict[str, Any] = {}
     _collect_named(schema, named)
+    return d, schema, codec, sync, named
 
+
+def _iter_ocf_blocks(d: _Bin, codec: str, sync: bytes
+                     ) -> Iterator[Tuple[int, _Bin]]:
+    """Yield (record_count, block decoder) per data block."""
     while not d.at_end():
         count = d.long()
         size = d.long()
@@ -195,11 +204,60 @@ def read_avro_file(path: str) -> Iterator[Dict[str, Any]]:
             block = zlib.decompress(block, -15)
         elif codec != "null":
             raise AvroDecodeError(f"unsupported codec {codec!r}")
-        bd = _Bin(block)
-        for _ in range(count):
-            yield _decode(schema, bd, named)
+        yield count, _Bin(block)
         if d.read(16) != sync:
             raise AvroDecodeError("sync marker mismatch")
+
+
+def read_avro_file(path: str) -> Iterator[Dict[str, Any]]:
+    """Iterate records of one OCF file."""
+    d, schema, codec, sync, named = _open_ocf(path)
+    for count, bd in _iter_ocf_blocks(d, codec, sync):
+        for _ in range(count):
+            yield _decode(schema, bd, named)
+
+
+def read_avro_columns(path: str, *,
+                      fields: Optional[Sequence[str]] = None,
+                      batch_records: int = 8192
+                      ) -> Iterator[Dict[str, List[Any]]]:
+    """Stream one OCF file as `{field -> value list}` COLUMN chunks of
+    up to `batch_records` records: block decode appends each field value
+    straight into its column list — the per-record dict the row readers
+    build (and the per-cell walk consuming it) never exists. The
+    sharded ingest engine's parse workers feed these lists to ONE
+    vectorized conversion per column (readers.columnar_f32,
+    docs/performance.md "Ingest pipeline").
+
+    The top-level schema must be a record (what write_avro_file and
+    every reference DataReaders.Simple.avro flow produce). `fields`
+    restricts OUTPUT to the named subset — the wire format is
+    positional, so skipped fields still decode, they just never
+    allocate per-record containers."""
+    d, schema, codec, sync, named = _open_ocf(path)
+    schema = _resolve(schema, named)
+    if not (isinstance(schema, dict) and schema.get("type") == "record"):
+        raise AvroDecodeError(
+            f"{path}: columnar decode needs a top-level record schema, "
+            f"got {schema!r}")
+    fspecs = [(f["name"], f["type"]) for f in schema["fields"]]
+    keep = set(fields) if fields is not None else None
+    out_names = [nm for nm, _ in fspecs if keep is None or nm in keep]
+    cols: Dict[str, List[Any]] = {nm: [] for nm in out_names}
+    n_buf = 0
+    for count, bd in _iter_ocf_blocks(d, codec, sync):
+        for _ in range(count):
+            for nm, ftype in fspecs:
+                v = _decode(ftype, bd, named)
+                if keep is None or nm in keep:
+                    cols[nm].append(v)
+            n_buf += 1
+            if n_buf >= batch_records:
+                yield cols
+                cols = {nm: [] for nm in out_names}
+                n_buf = 0
+    if n_buf:
+        yield cols
 
 
 from .readers import Reader

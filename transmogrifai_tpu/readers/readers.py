@@ -160,6 +160,105 @@ class CSVReader(Reader):
                 f"{len(self.columns)} ({', '.join(self.columns[:4])}...)")
         return row
 
+    def iter_records(self) -> Iterable[Record]:
+        """Stream records one at a time off the file handle (python csv
+        module only — no whole-file native scan). The bulk monitor route
+        (monitor/offline._file_stream_reader) reads through this so the
+        tileplane pulls record batches incrementally instead of
+        materializing the file before the first tile scores."""
+        with open(self.path, newline="") as fh:
+            if self.columns is not None:
+                for i, raw in enumerate(_csv.reader(fh)):
+                    if any(f != "" for f in raw):
+                        yield {k: self._coerce(k, v) for k, v
+                               in zip(self.columns, self._checked(raw, i))}
+            else:
+                for row in _csv.DictReader(fh):
+                    yield {k: self._coerce(k, v) for k, v in row.items()}
+
+
+# -- columnar decode (parallel/ingest fast lane) ------------------------------
+
+_F32_NULL_VALUES = ("", "NA", "null", "NULL", "None")
+
+
+def columnar_f32(values: Sequence[Any],
+                 null_values: Sequence[str] = _F32_NULL_VALUES
+                 ) -> np.ndarray:
+    """ONE vectorized float32 conversion for a whole column chunk — the
+    columnar replacement for the per-cell CSVReader._coerce walk on
+    numeric ingest paths (parallel/ingest.sharded_reader_source).
+
+    String columns map the null spellings to NaN in one `np.isin` pass,
+    then parse with a single `astype`; numeric/bool columns are one
+    `astype`; object columns (Avro nullable unions) map None -> NaN in
+    one array build. Null handling matches _coerce's None for the
+    zero-weight / NaN-missing conventions downstream."""
+    arr = np.asarray(values)
+    if arr.dtype.kind in "fiub":
+        return arr.astype(np.float32, copy=False)
+    if arr.dtype.kind in "US":
+        if null_values:
+            mask = np.isin(arr, np.asarray(list(null_values)))
+            if mask.any():
+                arr = np.where(mask, "nan", arr)
+        return arr.astype(np.float32)
+    return np.array([np.nan if v is None else v for v in values],
+                    dtype=np.float32)
+
+
+def csv_columnar_chunks(path: str, *,
+                        columns: Optional[Sequence[str]] = None,
+                        fields: Optional[Sequence[str]] = None,
+                        batch_records: int = 8192,
+                        null_values: Sequence[str] = _F32_NULL_VALUES
+                        ) -> Iterable[Dict[str, np.ndarray]]:
+    """Stream a CSV file as `{column -> float32 array}` chunks of up to
+    `batch_records` rows: rows buffer raw, transpose once per chunk
+    (a single C-level `zip(*rows)`), and each kept column converts with
+    ONE vectorized columnar_f32 call — no per-cell coercion, no
+    per-record dicts. This is the parse-worker decode of the sharded
+    ingest engine (docs/performance.md "Ingest pipeline").
+
+    `fields` names the columns of a HEADERLESS file (same contract as
+    CSVReader(columns=...)); otherwise the first row is the header.
+    `columns` restricts output to the named subset (decode still reads
+    every cell off disk, but only kept columns pay conversion). Blank
+    rows are skipped and a field-count mismatch raises — same
+    malformed-input posture as CSVReader._checked."""
+    with open(path, newline="") as fh:
+        reader = _csv.reader(fh)
+        if fields is not None:
+            names = [str(c) for c in fields]
+        else:
+            try:
+                names = next(reader)
+            except StopIteration:
+                return
+        keep = [(nm, j) for j, nm in enumerate(names)
+                if columns is None or nm in set(columns)]
+        n_fields = len(names)
+        buf: List[Sequence[str]] = []
+
+        def flush() -> Dict[str, np.ndarray]:
+            cols = list(zip(*buf))
+            return {nm: columnar_f32(cols[j], null_values)
+                    for nm, j in keep}
+
+        for i, raw in enumerate(reader):
+            if not any(f != "" for f in raw):
+                continue
+            if len(raw) != n_fields:
+                raise ValueError(
+                    f"{path}: row {i + 1} has {len(raw)} fields, "
+                    f"expected {n_fields}")
+            buf.append(raw)
+            if len(buf) >= batch_records:
+                yield flush()
+                buf = []
+        if buf:
+            yield flush()
+
 
 class JSONLinesReader(Reader):
     def __init__(self, path: str, key_fn: Optional[Callable[[Record], str]] = None):

@@ -73,14 +73,24 @@ class FileStreamingReader(StreamingReader):
         self._seen: set = set()
         # path -> last observed size, for candidates deferred mid-write
         self._pending: Dict[str, int] = {}
+        # path -> (size, mtime) from the most recent _size stat: the
+        # sort key reads mtime from HERE, so each candidate costs its
+        # stability stats only — no third per-candidate stat per scan —
+        # and ordering can't shift under a mid-scan mtime touch
+        self._statted: Dict[str, Tuple[int, float]] = {}
 
     def _size(self, p: str) -> int:
         """Stat seam (monkeypatched by tests to simulate active writers);
-        -1 = vanished between glob and stat."""
+        -1 = vanished between glob and stat. ONE os.stat serves both the
+        size-stability check and the mtime ordering (cached in
+        `_statted`)."""
         try:
-            return os.path.getsize(p)
+            st = os.stat(p)
         except OSError:
+            self._statted.pop(p, None)
             return -1
+        self._statted[p] = (st.st_size, st.st_mtime)
+        return st.st_size
 
     def _paths(self) -> List[str]:
         out = []
@@ -112,7 +122,23 @@ class FileStreamingReader(StreamingReader):
         for p in list(self._pending):
             if p not in matched:
                 self._pending.pop(p)
-        return sorted(out, key=lambda p: (os.path.getmtime(p), p))
+
+        def order(p: str) -> Tuple[float, str]:
+            st = self._statted.get(p)
+            if st is None:
+                # only reachable when a test monkeypatches _size past
+                # the cache; real scans always statted admitted paths
+                try:
+                    return (os.path.getmtime(p), p)
+                except OSError:
+                    return (0.0, p)
+            return (st[1], p)
+
+        # mtime order with the PATH as tiebreak: equal mtimes (same-run
+        # shard writers, coarse filesystems) sort lexicographically, so
+        # shard order — and everything downstream that must be
+        # bit-identical across ingest worker counts — is deterministic
+        return sorted(out, key=order)
 
     def stream(self) -> Iterator[List[Record]]:
         for p in self._paths():
@@ -121,6 +147,39 @@ class FileStreamingReader(StreamingReader):
 
     def poll(self) -> List[List[Record]]:
         return [batch for batch in self.stream()]
+
+    def snapshot_paths(self) -> List[str]:
+        """Currently-stable unseen shards in deterministic order WITHOUT
+        consuming them (`stream()` marks files seen; this does not).
+        The sharded ingest engine (parallel/ingest.sharded_reader_source)
+        builds its per-worker shard assignment from this listing and
+        re-reads the same files once per pass."""
+        return self._paths()
+
+
+class IterStreamingReader(StreamingReader):
+    """Batches of `batch_records` off a fresh-iterator factory — a
+    file-backed stream that decodes LAZILY (the monitor's bulk replay
+    route: the tileplane producer pulls the next batch only as the
+    device drains the previous tiles, so a bulk file never materializes
+    as one record list)."""
+
+    def __init__(self, factory: Callable[[], Iterator[Record]],
+                 batch_records: int = 1024,
+                 key_fn: Optional[Callable[[Record], str]] = None):
+        super().__init__(key_fn)
+        self.factory = factory
+        self.batch_records = max(1, int(batch_records))
+
+    def stream(self) -> Iterator[List[Record]]:
+        buf: List[Record] = []
+        for rec in self.factory():
+            buf.append(rec)
+            if len(buf) >= self.batch_records:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
 
 
 class AvroStreamingReader(FileStreamingReader):

@@ -164,6 +164,31 @@ class StatsPass:
         return dict(self.__dict__)
 
 
+@dataclass
+class IngestPass:
+    """One parallel-parse pass of the sharded ingest engine
+    (parallel/ingest.py ShardedSource).
+
+    `workers` is the parse-worker count the pass actually ran with
+    (after the min(workers, shards) clamp), `parse_seconds` the SUM of
+    per-worker decode time (compare against `wall_seconds` for the
+    overlap factor: parse_seconds > wall_seconds means the pool decoded
+    in parallel), `chunks` the columnar chunk count reassembled in shard
+    order. Serial degradations (workers <= 1) are recorded too so A/B
+    runs land both sides in one metrics doc."""
+
+    label: str
+    workers: int
+    shards: int
+    chunks: int
+    rows: int
+    parse_seconds: float
+    wall_seconds: float
+
+    def to_json(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
 class LatencyHistogram:
     """Streaming-quantile latency histogram (the serving engine's p50/p95/
     p99 source, docs/serving.md).
@@ -358,6 +383,7 @@ class AppMetrics:
     kernel_metrics: List[KernelRoofline] = field(default_factory=list)
     sweep_metrics: List[SweepConvergence] = field(default_factory=list)
     stats_metrics: List[StatsPass] = field(default_factory=list)
+    ingest_metrics: List[IngestPass] = field(default_factory=list)
     latency_metrics: Dict[str, LatencyHistogram] = field(
         default_factory=dict)
 
@@ -382,6 +408,9 @@ class AppMetrics:
         if self.stats_metrics:
             out["stats_metrics"] = [m.to_json()
                                     for m in self.stats_metrics]
+        if self.ingest_metrics:
+            out["ingest_metrics"] = [m.to_json()
+                                     for m in self.ingest_metrics]
         if self.latency_metrics:
             out["latency_metrics"] = {k: h.to_json() for k, h
                                       in self.latency_metrics.items()}
@@ -666,6 +695,32 @@ class MetricsCollector:
                    cols=int(cols), tiles=int(tiles), passes=int(passes),
                    bytes_hbm=float(bytes_hbm),
                    wall_seconds=round(wall_seconds, 6), label=label)
+        return rec
+
+    def ingest_pass(self, label: str, workers: int, shards: int,
+                    chunks: int, rows: int, parse_seconds: float,
+                    wall_seconds: float) -> Optional[IngestPass]:
+        """Record one sharded-ingest parse pass (no-op unless enabled).
+
+        Mirrors stats_pass: an IngestPass telemetry record (rides
+        AppMetrics JSON as "ingest_metrics") plus an `ingest_pass` event
+        on the streaming event log (docs/observability.md). The per-tile
+        decode walls themselves ride as `tile_parse` spans emitted by
+        the parse workers, one Perfetto lane per worker."""
+        with self._lock:
+            if not self.enabled:
+                return None
+            cur = self.current
+        rec = IngestPass(label=label, workers=int(workers),
+                         shards=int(shards), chunks=int(chunks),
+                         rows=int(rows),
+                         parse_seconds=round(parse_seconds, 6),
+                         wall_seconds=round(wall_seconds, 6))
+        cur.ingest_metrics.append(rec)
+        self.event("ingest_pass", label=label, workers=int(workers),
+                   shards=int(shards), chunks=int(chunks), rows=int(rows),
+                   parse_seconds=round(parse_seconds, 6),
+                   wall_seconds=round(wall_seconds, 6))
         return rec
 
     def latency(self, name: str, wall_seconds: float
