@@ -33,12 +33,16 @@ echo "== 2/7 tmoglint (static JAX/TPU discipline + stage contracts) =="
 # because it needs no imports and catches contract breaks in seconds.
 # bench.py + tools/ are in scope since TPU005 (unsynced-wall-timing);
 # the v2 concurrency (THR001-004) + buffer-lifetime (BUF001-003)
-# families and the v3 SPMD/collective-correctness (SHD001-005) +
-# contract-drift (ENV001/EVT001) families all run in the same scan with
-# the SAME empty baseline — SHD is the pre-hardware gate for the
-# multi-host GSPMD push (correct-at-N=1/wrong-at-N>1 bugs the CPU-mesh
-# tiers cannot see), ENV/EVT keep the knob registry and the event table
-# honest. The --format json report is saved as a CI artifact so finding
+# families, the v3 SPMD/collective-correctness (SHD001-005) +
+# contract-drift (ENV001/EVT001) families and the v4 trace-contract
+# (TRC001-005) + plan-precedence (PLN001) families all run in the same
+# scan with the SAME empty baseline — SHD is the pre-hardware gate for
+# the multi-host GSPMD push (correct-at-N=1/wrong-at-N>1 bugs the
+# CPU-mesh tiers cannot see), ENV/EVT keep the knob registry and the
+# event table honest, TRC/PLN statically prove the zero-recompile and
+# plan-precedence contracts no CPU tier can time-out on (correct on
+# the warm test box, wrong on hardware). The --format json report is
+# saved as a CI artifact so finding
 # counts per rule ride the build outputs next to the BENCH_*.json
 # series, and the documented 10s full-scan budget is asserted from its
 # --stats block.
@@ -79,14 +83,71 @@ print(f"  tmoglint JSON artifact ok: {rep['total_findings']} finding(s), "
       f"stats={rep['stats']}")
 PY
 # family selection must run clean against the SAME baseline with the
-# stale-entry scoping guard active — v2 (concurrency + buffer lifetime)
-# and v3 (SPMD/collective correctness + contract drift) each alone,
-# no TPU/DAG noise
+# stale-entry scoping guard active — v2 (concurrency + buffer lifetime),
+# v3 (SPMD/collective correctness + contract drift) and v4
+# (trace-contract + plan-precedence) each alone, no TPU/DAG noise
 python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/ \
   --rules THR,BUF
 python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/ \
   --rules SHD,ENV,EVT
-echo "  tmoglint: full scan (<10s) + THR,BUF + SHD,ENV,EVT family scans clean (artifact: $ARTIFACTS_DIR/tmoglint_report.json)"
+python -m tools.tmoglint transmogrifai_tpu/ tests/ bench.py tools/ \
+  --rules TRC,PLN
+# mutation drives, one per v4 family: the clean scan above is only
+# meaningful if the rules FIRE when the contract actually breaks. Each
+# drive copies the real serve hot path aside, scans the copy clean,
+# seeds the canonical contract break (a per-request jit construction
+# for TRC001; a raw governed TMOG_* read bypassing the planner for
+# PLN001), asserts the real CLI exits 1 naming the rule, then deletes
+# the mutation and asserts the scan is clean again — through
+# `python -m tools.tmoglint`, not library calls.
+MUT_TMP=$(mktemp -d)
+python - "$MUT_TMP" <<'PY'
+import os
+import shutil
+import subprocess
+import sys
+
+mut = sys.argv[1]
+src = "transmogrifai_tpu/serve/engine.py"
+dst = os.path.join(mut, "serve", "engine.py")
+os.makedirs(os.path.dirname(dst), exist_ok=True)
+# a unique single-line statement inside ServingEngine.score_batch — the
+# mutation lands directly on the per-request path the rules scope to
+ANCHOR = "        records = list(records)\n"
+
+
+def scan(rules):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.tmoglint", "serve/engine.py",
+         "--root", mut, "--no-baseline", "--rules", rules],
+        capture_output=True, text=True)
+
+
+def drive(rule, family, mutation):
+    text = open(src).read()
+    assert text.count(ANCHOR) == 1, "score_batch anchor drifted"
+    shutil.copyfile(src, dst)
+    clean = scan(family)
+    assert clean.returncode == 0, (rule, clean.stdout, clean.stderr)
+    with open(dst, "w") as f:
+        f.write(text.replace(ANCHOR, ANCHOR + mutation))
+    hit = scan(family)
+    assert hit.returncode == 1 and rule in hit.stdout, \
+        (rule, hit.returncode, hit.stdout, hit.stderr)
+    shutil.copyfile(src, dst)  # deleting the mutation restores clean
+    again = scan(family)
+    assert again.returncode == 0, (rule, again.stdout)
+    print(f"  mutation drive: {rule} fires on the seeded serve-path "
+          f"break and clears on restore")
+
+
+drive("TRC001", "TRC",
+      "        _mut = jax.jit(lambda x: x)  # seeded: per-request jit\n")
+drive("PLN001", "PLN",
+      '        _mut = os.environ.get("TMOG_TILE_MB")  # seeded: raw read\n')
+PY
+rm -rf "$MUT_TMP"
+echo "  tmoglint: full scan (<10s) + THR,BUF + SHD,ENV,EVT + TRC,PLN family scans clean, v4 mutation drives fire (artifact: $ARTIFACTS_DIR/tmoglint_report.json)"
 
 echo "== 3/7 test suite (8-device virtual CPU mesh) =="
 # fused histogram planner + CPU-fallback smoke first, explicitly under

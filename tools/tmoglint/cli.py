@@ -2,7 +2,10 @@
 
 ``python -m tools.tmoglint transmogrifai_tpu/ tests/`` — exit 0 iff the scan
 matches the committed baseline exactly (no new findings, no stale entries).
-``--format json`` emits a machine-readable report for bench/CI tooling.
+``--format json`` emits a machine-readable report for bench/CI tooling;
+``--format sarif`` the SARIF 2.1.0 rendering of the same report (new
+findings as results, the rest in the run property bag) for CI code
+annotations.
 
 Exit codes follow the project-wide table (docs/static_analysis.md — the
 same meanings ``trace-report --check`` and ``monitor --fail-on-drift``
@@ -41,6 +44,15 @@ EXIT_USAGE = 2
 
 
 def _default_jobs() -> int:
+    # TMOG_LINT_JOBS pins the pool width where cpu_count lies about the
+    # share CI actually grants (cgroup-limited runners) — same problem
+    # TMOG_INGEST_WORKERS solves for the ingest pool. --jobs still wins.
+    env = os.environ.get("TMOG_LINT_JOBS", "").strip()
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass  # unparseable pin falls back to the cpu heuristic
     try:
         n = os.cpu_count() or 1
     except Exception:  # pragma: no cover - exotic platforms
@@ -69,7 +81,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="report every finding; ignore the baseline")
     p.add_argument("--write-baseline", action="store_true",
                    help="regenerate the baseline from this scan and exit 0")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule ids or family prefixes "
                         "(e.g. 'THR,BUF' or 'TPU001'); default: all")
@@ -163,7 +176,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     new, stale = diff_baseline(findings, baseline)
     counts = Counter(f.rule for f in findings)
 
-    if args.format == "json":
+    if args.format in ("json", "sarif"):
         report = {
             "tool": "tmoglint",
             "paths": list(args.paths),
@@ -176,7 +189,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "ok": not new and not stale,
             "stats": stats,
         }
-        print(json.dumps(report, indent=1))
+        if args.format == "sarif":
+            # SARIF is a pure function of the JSON report (sarif.py), so
+            # the two formats — and the exit code — can never disagree
+            from .core import _register_rules
+            from .sarif import to_sarif
+            _register_rules()
+            print(json.dumps(to_sarif(report, dict(RULE_DOCS)), indent=1))
+        else:
+            print(json.dumps(report, indent=1))
     else:
         for f in new:
             print(f.render())

@@ -198,7 +198,7 @@ def _number_occurrences(findings: List[Finding]) -> List[Finding]:
 def _register_rules() -> None:
     # import registers the rules
     from . import (rules_tpu, rules_dag, rules_thr, rules_buf,  # noqa: F401
-                   rules_shd, rules_env, rules_evt)  # noqa: F401
+                   rules_shd, rules_env, rules_evt, rules_trc)  # noqa: F401
 
 
 def expand_rule_selection(only: Optional[Sequence[str]]

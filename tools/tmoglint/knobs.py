@@ -161,6 +161,11 @@ KNOBS: List[Dict[str, str]] = [
      "doc": "docs/planning.md",
      "desc": "calibration-corpus directory the measured cost model "
              "reads and calibrate/bench runs append to"},
+    # -- static analysis ----------------------------------------------------
+    {"name": "TMOG_LINT_JOBS", "default": "min(8, cpus)",
+     "doc": "docs/static_analysis.md",
+     "desc": "tmoglint worker-pool width for the per-file rules "
+             "(--jobs wins; pins the pool on cgroup-limited CI runners)"},
     # -- continuous retraining ----------------------------------------------
     {"name": "TMOG_RETRAIN_FAULT", "default": "",
      "doc": "docs/retraining.md",

@@ -1,0 +1,73 @@
+"""SARIF 2.1.0 emission for ``tmoglint --format sarif``.
+
+CI publishers (GitHub code scanning et al.) ingest SARIF and render
+findings as inline code annotations. The conversion is a pure function
+of the ``--format json`` report so the two outputs can never disagree:
+``results`` are exactly the report's NEW findings (the baseline-known
+debt is not re-announced on every PR), and everything else the JSON
+report carries — counts, stale entries, the ok verdict, scan stats —
+rides in the run-level property bag for round-tripping. Exit codes are
+the CLI's concern and stay on the shared table (0 clean / 1 findings
+or stale / 2 usage).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+#: stable key for result matching across runs (SARIF fingerprints dict)
+FINGERPRINT_KEY = "tmoglint/v1"
+
+
+def to_sarif(report: Dict[str, object],
+             rule_docs: Dict[str, str]) -> Dict[str, object]:
+    """The SARIF document for one ``--format json`` report dict."""
+    new: List[Dict[str, object]] = list(report.get("new", []))  # type: ignore
+    used_rules = sorted({str(f.get("rule", "")) for f in new})
+    rules = [{
+        "id": rid,
+        "shortDescription": {"text": rule_docs.get(rid, rid)},
+        "helpUri": "docs/static_analysis.md",
+    } for rid in used_rules]
+    results = [{
+        "ruleId": f.get("rule"),
+        "level": "error",
+        "message": {"text": f.get("message")},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.get("path")},
+                "region": {
+                    "startLine": f.get("line"),
+                    "startColumn": int(f.get("col", 0)) + 1,
+                    "snippet": {"text": f.get("snippet")},
+                },
+            },
+        }],
+        "fingerprints": {FINGERPRINT_KEY: f.get("fingerprint")},
+    } for f in new]
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [{
+            "tool": {"driver": {
+                "name": str(report.get("tool", "tmoglint")),
+                "rules": rules,
+            }},
+            "results": results,
+            # everything else the JSON report says, verbatim, so the
+            # SARIF output round-trips against it in tests and CI can
+            # read the verdict without re-running the scan
+            "properties": {
+                "paths": report.get("paths"),
+                "rules": report.get("rules"),
+                "total_findings": report.get("total_findings"),
+                "counts_by_rule": report.get("counts_by_rule"),
+                "baselined": report.get("baselined"),
+                "stale_baseline_entries":
+                    report.get("stale_baseline_entries"),
+                "ok": report.get("ok"),
+                "stats": report.get("stats"),
+            },
+        }],
+    }

@@ -177,6 +177,10 @@ def _cal_glm_routes(backend: str, scale: float) -> List[PlanRecord]:
                  "lanes": float(lanes)}
         work = float(rows) * d * lanes
 
+        # calibration compiles one program per measured shape ON PURPOSE
+        # (the lambda closes over this shape's Xd/yd) and the warmup call
+        # below keeps the compile out of the clocked window
+        # tmoglint: disable=TRC001  per-shape compile IS the measurement
         vfit = jax.jit(jax.vmap(
             lambda wl, r: G.fit_logistic(Xd, yd, wl, r, 0.0,
                                          max_iter=10),

