@@ -19,7 +19,7 @@ cd "$(dirname "$0")"
 # (the dedicated planner step 6/7 swaps in its own seeded scratch dir)
 export TMOG_PLAN_CORPUS_DIR="$(mktemp -d)/corpus"
 
-echo "== 1/7 import + native kernel build =="
+echo "== 1/8 import + native kernel build =="
 python - <<'PY'
 import transmogrifai_tpu
 from transmogrifai_tpu.ops import native_bridge
@@ -27,7 +27,7 @@ print("package import ok; native kernels:",
       "built" if native_bridge.available() else "UNAVAILABLE (numpy fallbacks)")
 PY
 
-echo "== 2/7 tmoglint (static JAX/TPU discipline + stage contracts) =="
+echo "== 2/8 tmoglint (static JAX/TPU discipline + stage contracts) =="
 # fails fast on findings not in tools/tmoglint/baseline.json and on stale
 # baseline entries (docs/static_analysis.md); runs before the test tiers
 # because it needs no imports and catches contract breaks in seconds.
@@ -149,7 +149,7 @@ PY
 rm -rf "$MUT_TMP"
 echo "  tmoglint: full scan (<10s) + THR,BUF + SHD,ENV,EVT + TRC,PLN family scans clean, v4 mutation drives fire (artifact: $ARTIFACTS_DIR/tmoglint_report.json)"
 
-echo "== 3/7 test suite (8-device virtual CPU mesh) =="
+echo "== 3/8 test suite (8-device virtual CPU mesh) =="
 # fused histogram planner + CPU-fallback smoke first, explicitly under
 # JAX_PLATFORMS=cpu: the tier-1 guarantee that the pure-jnp twin of the
 # batched sweep kernel stays live on hosts with no TPU
@@ -164,7 +164,7 @@ JAX_PLATFORMS=cpu python -m pytest \
   -q -m 'not slow'
 python -m pytest tests/ -q
 
-echo "== 4/7 examples =="
+echo "== 4/8 examples =="
 for ex in op_titanic_simple op_titanic_mini op_iris op_boston; do
   JAX_PLATFORMS=cpu PYTHONPATH="$PWD" python "examples/${ex}.py" > /dev/null
   echo "  ${ex} ok"
@@ -177,7 +177,7 @@ if [ -f "$REF_RES/EmailDataset/Clicks.csv" ]; then
   echo "  op_dataprep ok"
 fi
 
-echo "== 5/7 observability smoke (traced workflow + GLM sweep) =="
+echo "== 5/8 observability smoke (traced workflow + GLM sweep) =="
 # a tiny traced run must produce a loadable span hierarchy: Chrome trace +
 # AppMetrics-with-spans + streaming events.jsonl, all validated by the
 # schema checks in `trace-report --check` (docs/observability.md)
@@ -1449,7 +1449,7 @@ print("tileplane copy/compute overlap ok")
 PY
 rm -rf "$TRACE_DIR"
 
-echo "== 6/7 plan-time autotuner (docs/planning.md) =="
+echo "== 6/8 plan-time autotuner (docs/planning.md) =="
 # the cold-corpus no-op proof FIRST: with an empty corpus every resolved
 # decision must be bit-identical to the hand default its call site
 # shipped with — the planner's no-regression guarantee. (tmoglint
@@ -1502,7 +1502,7 @@ print(f"plan-ab smoke ok: warm sweep auto/hand="
 PY
 rm -rf "$PLAN_TMP"
 
-echo "== 7/7 driver-contract smoke =="
+echo "== 7/8 driver-contract smoke =="
 python - <<'PY'
 import __graft_entry__ as g
 g.dryrun_multichip(8)
@@ -1517,5 +1517,116 @@ out = json.loads(lines[-1])
 assert {"metric", "value", "unit", "vs_baseline"} <= set(out), out
 print("bench JSON ok:", out["metric"], out["value"], out["unit"])
 '
+
+# multihost pod smoke: a REAL 2-process jax.distributed pod on localhost
+# (gloo cross-process psums) — clean-run parity vs the single-process
+# sweep, then a chaos kill of child 1 at the first GLM round boundary
+# and a full-pod relaunch that resumes from the rank-0 RoundCheckpoint
+# bit-identically (docs/performance.md "Multi-host pod scaling")
+echo "== 8/8 multihost pod smoke =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import os, shutil, tempfile
+import numpy as np
+from transmogrifai_tpu.parallel.launch import launch_local_pod
+
+PAYLOAD = r"""
+import json, os
+import numpy as np
+from transmogrifai_tpu.parallel import multihost as MH
+MH.initialize()
+import jax
+pc = jax.process_count(); pid = jax.process_index()
+mesh = MH.global_mesh(n_model=1)
+rng = np.random.default_rng(1)
+n, d = 40, 4
+X = rng.normal(size=(n, d)).astype(np.float32)
+y = (X[:, 0] - X[:, 2] + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+w = np.ones(n, np.float32)
+masks = np.zeros((2, n), np.float32)
+masks[0, ::2] = 1.0
+masks[1, 1::2] = 1.0
+bounds = [0, 20, n] if pc == 2 else [0, n]
+lo, hi = bounds[pid], bounds[pid + 1]
+from transmogrifai_tpu.ops import glm_sweep as GS
+from transmogrifai_tpu.automl.tuning.checkpoint import RoundCheckpoint
+regs = np.asarray([1.0, 0.3, 0.1, 0.03], np.float32)
+alphas = np.zeros(4, np.float32)
+# rank-0-owned checkpoint: every rank LOADS the same file (the round
+# state is replicated, so resume decisions stay SPMD-consistent), only
+# rank 0 writes it
+rc = RoundCheckpoint(os.path.join(os.environ["SMOKE_CK_DIR"], "rc.npz"))
+KEY = "multihost-resume-smoke"
+state = rc.load(KEY)
+resumed = state is not None
+
+def on_round(s):
+    if pid == 0:
+        rc.save(KEY, s)
+    print("ROUND %d retired" % s["rounds"], flush=True)
+
+B, b0, info = GS.sweep_glm_streamed_rounds(
+    X[lo:hi], y[lo:hi], w[lo:hi], masks[:, lo:hi], regs, alphas,
+    loss="logistic", mesh=mesh, round_iters=2, state=state,
+    on_round=on_round)
+out = dict(pid=pid, resumed=bool(resumed), rounds=int(info["glm_rounds"]),
+           B=np.asarray(B).tolist(), b0=np.asarray(b0).tolist())
+print("RESULT|" + json.dumps(out), flush=True)
+MH.finalize()
+"""
+
+tmp = tempfile.mkdtemp(prefix="ci_mh_")
+try:
+    clean = os.path.join(tmp, "clean"); os.makedirs(clean)
+    chaos = os.path.join(tmp, "chaos"); os.makedirs(chaos)
+
+    # 1. clean 2-process pod run
+    pod = launch_local_pod(PAYLOAD, n_procs=2, devices_per_proc=2,
+                           timeout=300.0, extra_env={"SMOKE_CK_DIR": clean})
+    assert pod.ok, (pod.error, [c.stderr_tail[-300:] for c in pod.children])
+    ref = pod.result(0)
+    assert not ref["resumed"]
+    assert ref["B"] == pod.result(1)["B"], "pod ranks disagree"
+
+    # single-process reference parity (same global data, no mesh)
+    rng = np.random.default_rng(1)
+    n, d = 40, 4
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    y = (X[:, 0] - X[:, 2]
+         + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+    w = np.ones(n, np.float32)
+    masks = np.zeros((2, n), np.float32)
+    masks[0, ::2] = 1.0
+    masks[1, 1::2] = 1.0
+    from transmogrifai_tpu.ops import glm_sweep as GS
+    regs = np.asarray([1.0, 0.3, 0.1, 0.03], np.float32)
+    alphas = np.zeros(4, np.float32)
+    B1, _, _ = GS.sweep_glm_streamed_rounds(
+        X, y, w, masks, regs, alphas, loss="logistic", round_iters=2)
+    pd = float(np.max(np.abs(np.asarray(ref["B"]) - np.asarray(B1))))
+    assert pd <= 1e-4, pd
+
+    # 2. chaos: kill child 1 at the first retirement boundary
+    pod = launch_local_pod(PAYLOAD, n_procs=2, devices_per_proc=2,
+                           timeout=300.0, grace_s=2.0,
+                           kill_on="retired", kill_target=1,
+                           extra_env={"SMOKE_CK_DIR": chaos})
+    assert not pod.ok and "chaos-killed" in (pod.error or ""), pod.error
+    assert os.path.exists(os.path.join(chaos, "rc.npz")), \
+        "no checkpoint written before the kill"
+
+    # 3. relaunch the pod; every rank resumes from rank 0's checkpoint
+    pod = launch_local_pod(PAYLOAD, n_procs=2, devices_per_proc=2,
+                           timeout=300.0, extra_env={"SMOKE_CK_DIR": chaos})
+    assert pod.ok, (pod.error, [c.stderr_tail[-300:] for c in pod.children])
+    res = pod.result(0)
+    assert res["resumed"], "resume run did not load the checkpoint"
+    err = float(np.max(np.abs(np.asarray(res["B"])
+                              - np.asarray(ref["B"]))))
+    assert err == 0.0, err
+    print("multihost smoke ok: pod parity %.1e, chaos kill + "
+          "checkpoint resume bit-identical" % pd)
+finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+PY
 
 echo "CI GREEN"

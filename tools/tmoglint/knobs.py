@@ -119,6 +119,20 @@ KNOBS: List[Dict[str, str]] = [
     {"name": "TMOG_INGEST_WORKERS", "default": "1 (planner may raise)",
      "doc": "docs/performance.md",
      "desc": "parse-worker pool size for sharded columnar ingest"},
+    # -- multi-host pod -----------------------------------------------------
+    {"name": "TMOG_MULTIHOST", "default": "",
+     "doc": "docs/performance.md",
+     "desc": "master opt-in for environment-driven multi-host init and "
+             "per-process ingest striping (launch_local_pod sets it)"},
+    {"name": "TMOG_COORD_ADDR", "default": "",
+     "doc": "docs/performance.md",
+     "desc": "host:port of the jax.distributed coordinator (rank 0)"},
+    {"name": "TMOG_PROC_COUNT", "default": "",
+     "doc": "docs/performance.md",
+     "desc": "total process count of the pod multihost.initialize joins"},
+    {"name": "TMOG_PROC_ID", "default": "",
+     "doc": "docs/performance.md",
+     "desc": "this process's rank in the pod (0..TMOG_PROC_COUNT-1)"},
     # -- serving ------------------------------------------------------------
     {"name": "TMOG_SERVE_SPAN_BUDGET", "default": "10000",
      "doc": "docs/serving.md",

@@ -34,8 +34,11 @@ shard-variance dataflow:
   row-sharded array: under one process it sees every row; under N
   processes ``np.asarray`` sees only the addressable shards and the
   "global" sum silently becomes a per-host sum. Reduce on device
-  (psum) before fetching, or go through
-  ``parallel.multihost.fetch_global``.
+  (psum) before fetching, go through
+  ``parallel.multihost.fetch_global``, or — when only this host's
+  rows are wanted — fetch them explicitly with
+  ``parallel.multihost.fetch_local`` (which the rule leaves alone:
+  a reduce over an explicitly local fetch states its scope).
 
 All project rules: they need cross-module constant/call resolution.
 Suppression (`# tmoglint: disable=SHD00x  reason`) works as everywhere
@@ -241,8 +244,10 @@ def _shd005_file(ctx: LintContext) -> List[Finding]:
                     f"sees this process's addressable shards, so the "
                     f"'global' reduce silently becomes a per-host one "
                     f"at N>1 processes; psum on device before "
-                    f"fetching, or fetch via "
-                    f"parallel.multihost.fetch_global")
+                    f"fetching, fetch via "
+                    f"parallel.multihost.fetch_global, or use "
+                    f"parallel.multihost.fetch_local when only this "
+                    f"host's rows are wanted")
                 if f is not None:
                     findings.append(f)
     return findings

@@ -431,8 +431,28 @@ class Validator:
                     jnp.asarray(w, jnp.float32),
                     jnp.asarray(masks, jnp.float32))
         from ...parallel.mesh import (
-            BATCH_AXIS, batch_sharding, pad_rows_to_multiple, sharded_along,
+            BATCH_AXIS, batch_sharding, mesh_is_multiprocess,
+            pad_rows_to_multiple, sharded_along,
         )
+        if mesh_is_multiprocess(self.mesh):
+            # SPMD pod sweep: X/y/w/masks hold THIS PROCESS's rows; each
+            # block lands as the process's batch-axis stripe of a global
+            # array (same pad semantics as the single-host branch below:
+            # X repeats its last row, weights pad 0 = inert, masks pad 1)
+            from ...parallel import multihost as MH
+            layout = MH.row_layout(np.asarray(X).shape[0], self.mesh)
+            return (
+                MH.host_local_block(
+                    np.asarray(np.asarray(X), jnp.dtype(dtype)),
+                    self.mesh, layout, pad_value=None),
+                MH.host_local_block(np.asarray(y, np.float32),
+                                    self.mesh, layout),
+                MH.host_local_block(np.asarray(w, np.float32),
+                                    self.mesh, layout),
+                MH.host_local_block(np.asarray(masks, np.float32),
+                                    self.mesh, layout, pad_value=1.0,
+                                    axis=1),
+            )
         nb = self.mesh.shape[BATCH_AXIS]
         # X pads by repeating the last real row (pad_value=None): tree
         # quantile binning is unweighted, so synthetic values would shift

@@ -339,7 +339,35 @@ def _newton_prox_update(B, b0, gA, hA, g0A, h0A, wsum_l, l1, l2, eye,
 # the one-pass stats engine (ops/stats_engine.py) shares them; the private
 # names stay importable for existing callers
 from ..parallel.mesh import build_shard_map as _build_shard_map  # noqa: E402
+from ..parallel.mesh import mesh_is_multiprocess as _mesh_is_mp  # noqa: E402
 from ..parallel.mesh import shard_vary as _shard_vary  # noqa: E402
+
+
+def _is_global_array(a) -> bool:
+    """True for a jax.Array whose shards span other processes (already
+    landed on a multi-process mesh) — such inputs pass through the
+    sharded entry points untouched."""
+    return isinstance(a, jax.Array) and not a.is_fully_addressable
+
+
+def _land_rows_multihost(mesh, X, y, w, fold_masks):
+    """Land THIS PROCESS's host-local sweep rows as global batch-sharded
+    arrays (multihost.host_local_block; every process calls with its own
+    stripe — SPMD). X/y/w pad along rows with zeros (zero weight = inert
+    in every accumulator), fold masks pad along their row axis (axis 1)
+    with ones (irrelevant under w=0) — the uneven-stripe generalization
+    of the validator's pad_rows_to_multiple."""
+    from ..parallel import multihost as MH
+
+    Xl = np.asarray(X)
+    n = Xl.shape[0]
+    layout = MH.row_layout(n, mesh)
+    wl = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
+    return (MH.host_local_block(Xl, mesh, layout),
+            MH.host_local_block(np.asarray(y, np.float32), mesh, layout),
+            MH.host_local_block(wl, mesh, layout),
+            MH.host_local_block(np.asarray(fold_masks, np.float32), mesh,
+                                layout, pad_value=1.0, axis=1))
 
 
 def _psum_moments(X, w, allreduce):
@@ -535,9 +563,28 @@ def sweep_glm_streamed_sharded(mesh, X, y, w, fold_masks, regs, alphas, *,
     this). Each shard scans only its local rows; accumulator psums ride
     ICI within a slice and DCN across slices. Sharded standardization uses
     one-pass psum'd moments (f32), which differs from the single-device
-    two-pass by f32 rounding only."""
-    return _sharded_sweep_fn(mesh, loss, bool(fit_intercept),
-                             bool(standardize))(
+    two-pass by f32 rounding only.
+
+    On a MULTI-PROCESS mesh, host (or fully-addressable) X/y/w/fold_masks
+    are treated as THIS PROCESS's rows and landed as the process's
+    batch-axis block of one global array (_land_rows_multihost); the
+    accumulator psums then cross hosts over DCN. Already-global inputs
+    pass through untouched."""
+    fn = _sharded_sweep_fn(mesh, loss, bool(fit_intercept),
+                           bool(standardize))
+    if _mesh_is_mp(mesh):
+        from ..parallel import multihost as MH
+
+        if not _is_global_array(X):
+            X, y, w, fold_masks = _land_rows_multihost(mesh, X, y, w,
+                                                       fold_masks)
+        return fn(
+            X, y, w, fold_masks,
+            MH.replicated_global(np.asarray(regs, np.float32), mesh),
+            MH.replicated_global(np.asarray(alphas, np.float32), mesh),
+            MH.replicated_global(np.asarray(int(max_iter), np.int32), mesh),
+            MH.replicated_global(np.asarray(float(tol), np.float32), mesh))
+    return fn(
         X, y, w, fold_masks, regs, alphas,
         jnp.asarray(max_iter, jnp.int32), jnp.asarray(tol, jnp.float32))
 
@@ -670,8 +717,23 @@ def sweep_glm_squared_gram_sharded(mesh, X, y, w, fold_masks, regs, alphas,
                                    ) -> Tuple[jax.Array, jax.Array,
                                               jax.Array]:
     """Row-sharded Gram fast path: each shard accumulates its local rows'
-    per-fold moments, one psum combines them, the grid solves replicated."""
-    return _sharded_gram_fn(mesh, bool(fit_intercept), bool(standardize))(
+    per-fold moments, one psum combines them, the grid solves replicated.
+    Multi-process meshes follow sweep_glm_streamed_sharded's landing
+    contract (host inputs = this process's rows)."""
+    fn = _sharded_gram_fn(mesh, bool(fit_intercept), bool(standardize))
+    if _mesh_is_mp(mesh):
+        from ..parallel import multihost as MH
+
+        if not _is_global_array(X):
+            X, y, w, fold_masks = _land_rows_multihost(mesh, X, y, w,
+                                                       fold_masks)
+        return fn(
+            X, y, w, fold_masks,
+            MH.replicated_global(np.asarray(regs, np.float32), mesh),
+            MH.replicated_global(np.asarray(alphas, np.float32), mesh),
+            MH.replicated_global(np.asarray(int(max_iter), np.int32), mesh),
+            MH.replicated_global(np.asarray(float(tol), np.float32), mesh))
+    return fn(
         X, y, w, fold_masks, regs, alphas,
         jnp.asarray(max_iter, jnp.int32), jnp.asarray(tol, jnp.float32))
 
@@ -991,6 +1053,14 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
         # and one sweep should run one configuration end to end
         prefetch = TP.tile_prefetch_depth()
     else:
+        if _mesh_is_mp(mesh) and not _is_global_array(X):
+            # multi-process resume/round driver: host inputs are THIS
+            # PROCESS's rows (same landing contract as the sharded
+            # sweeps); the host-driven retirement loop below is
+            # deterministic on replicated round outputs, so every
+            # process takes identical retire/compact decisions
+            X, y, w, fold_masks = _land_rows_multihost(mesh, X, y, w,
+                                                       fold_masks)
         F = int(fold_masks.shape[0])
         d = int(X.shape[1])
     Gn = int(regs.shape[0])
@@ -1030,6 +1100,10 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
             mean, std = glm_standardize_stats(X, w)
         else:
             mean, std = _sharded_stats_fn(mesh)(X, w)
+    elif _mesh_is_mp(mesh):
+        from ..parallel import multihost as MH
+        mean = MH.replicated_global(np.zeros(d, np.float32), mesh)
+        std = MH.replicated_global(np.ones(d, np.float32), mesh)
     else:
         mean = jnp.zeros(d, jnp.float32)
         std = jnp.ones(d, jnp.float32)
@@ -1120,11 +1194,20 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
                 Bb, b0b, db, it = _run_source_round(sel, l1b, l2b, B0,
                                                     b00, budget)
             else:
-                args = (X, y, w, fold_masks, jnp.asarray(sel),
-                        jnp.asarray(l1b), jnp.asarray(l2b),
-                        jnp.asarray(B0), jnp.asarray(b00),
-                        mean, std, jnp.asarray(budget, jnp.int32),
-                        jnp.asarray(tol_f, jnp.float32))
+                if _mesh_is_mp(mesh):
+                    from ..parallel import multihost as MH
+
+                    def land(a, dt):
+                        return MH.replicated_global(
+                            np.asarray(a, dt), mesh)
+                else:
+                    def land(a, dt):
+                        return jnp.asarray(a, dt)
+                args = (X, y, w, fold_masks, land(sel, np.float32),
+                        land(l1b, np.float32), land(l2b, np.float32),
+                        land(B0, np.float32), land(b00, np.float32),
+                        mean, std, land(budget, np.int32),
+                        land(tol_f, np.float32))
                 if mesh is None:
                     Bb, b0b, db, it = sweep_glm_round(
                         *args, loss=loss, fit_intercept=fit_intercept)

@@ -572,8 +572,47 @@ def fused_stats_sharded(mesh, X, y, w=None, *, distinct=None, clip=None,
     pad mask happens here) or pre-sharded jax arrays whose rows already
     divide the batch axis — the no-host-gather path when the matrix
     already lives on the mesh. Accumulator merges psum over ICI/DCN; the
-    tiny finalize runs replicated."""
+    tiny finalize runs replicated.
+
+    On a MULTI-PROCESS mesh X/y/w are THIS PROCESS's host-local row
+    block (every process calls with its own rows — SPMD); the blocks
+    land as the process's `batch`-axis stripe of one global array
+    (multihost.host_local_block) and the psum merges become genuine
+    cross-host collectives. The set of (row, weight) pairs equals the
+    single-process call's, so the sufficient statistics match within
+    float tolerance (docs/performance.md)."""
     from ..parallel import mesh as M
+
+    if M.mesh_is_multiprocess(mesh):
+        from ..parallel import multihost as MH
+
+        Xl = np.asarray(X, np.float32)
+        yl = np.asarray(y, np.float32)
+        n, d = Xl.shape
+        if corr_matrix and d > GRAM_MAX_D:
+            raise ValueError(f"corr_matrix capped at {GRAM_MAX_D} columns")
+        wl = np.ones(n, np.float32) if w is None else \
+            np.asarray(w, np.float32)
+        layout = MH.row_layout(n, mesh)       # collective (count gather)
+        X = MH.host_local_block(Xl, mesh, layout)
+        y = MH.host_local_block(yl, mesh, layout)
+        w = MH.host_local_block(wl, mesh, layout)  # zero weight = inert pad
+        extras = []
+        if distinct is not None:
+            extras.append(MH.replicated_global(
+                np.asarray(distinct, np.float32), mesh))
+        if clip is not None:
+            extras.append(MH.replicated_global(np.asarray(clip, bool),
+                                               mesh))
+        if lo is not None:
+            extras.append(MH.replicated_global(np.asarray(lo, np.float32),
+                                               mesh))
+            extras.append(MH.replicated_global(np.asarray(hi, np.float32),
+                                               mesh))
+        fn = _sharded_stats_fn(mesh, int(bins), bool(corr_matrix),
+                               distinct is not None, clip is not None,
+                               lo is not None, y.ndim == 2)
+        return fn(X, y, w, *extras)
 
     X = _as_f32(X)
     y = _as_f32(y)
@@ -670,8 +709,15 @@ def _tileplane_sharded_step(mesh, bins: int, corr_matrix: bool,
                 P(BATCH_AXIS)) + (P(),) * n_extras
     sm = build_shard_map(core, mesh, in_specs=in_specs, out_specs=P())
     # same donation rule as the single-device step: the replicated carry
-    # aliases its output, so the [d, d] Gram accumulators update in place
-    return jax.jit(sm, donate_argnums=(0,))
+    # aliases its output, so the [d, d] Gram accumulators update in place.
+    # EXCEPT on a multi-process mesh: donating buffers into a program
+    # whose psums run gloo cross-host collectives corrupts the CPU
+    # client's heap on this jaxlib (observed: "corrupted double-linked
+    # list" aborts on the second donated step) — the pod path keeps the
+    # carry copy instead
+    from ..parallel.mesh import mesh_is_multiprocess
+    donate = () if mesh_is_multiprocess(mesh) else (0,)
+    return jax.jit(sm, donate_argnums=donate)
 
 
 @functools.lru_cache(maxsize=None)
@@ -796,10 +842,15 @@ def stream_stats(X, y=None, w=None, *, tile_rows: Optional[int] = None,
     corr_matrix = bool(corr_matrix)
     big = float(np.finfo(np.float32).max)
 
-    if not TP.tileplane_enabled():
+    if not TP.tileplane_enabled() and (mesh is None
+                                       or not M.mesh_is_multiprocess(mesh)):
         # legacy synchronous loop (kill switch): per-tile dispatch ->
         # fetch -> host f64 Chan merge; same tile content as the
-        # pipeline (shared assembly), zero copy/compute overlap
+        # pipeline (shared assembly), zero copy/compute overlap.
+        # A multi-process mesh NEVER takes this branch — its psum is a
+        # pod collective every process must join, so it falls through to
+        # the mesh tile path (which run_tileplane already runs
+        # synchronously when the shardings span processes)
         merged = None
         shift = None
         for tile, _n_valid in TP.iter_fixed_tiles(source, c):
@@ -821,9 +872,33 @@ def stream_stats(X, y=None, w=None, *, tile_rows: Optional[int] = None,
     probe = source.peek()
     y2d = probe[1].ndim == 2
     shardings = None
+    pc = 1
     if mesh is not None:
         n_shards = mesh.shape[M.BATCH_AXIS]
-        c = -(-c // n_shards) * n_shards
+        pc = M.mesh_process_count(mesh)
+        if pc > 1:
+            # SPMD streaming: `source` is THIS PROCESS's stripe of the
+            # row stream. The tile step's psum is a pod collective, so
+            # every process must run the SAME tile count with the SAME
+            # (uniform) tile shape: size tiles from the pod-uniform
+            # padded per-process row count (row_layout is itself the
+            # pod's one host collective), then pad the local stream so
+            # uneven stripes still emit identical tile sequences.
+            from ..parallel import multihost as MH
+
+            if source.n_rows is None:
+                raise ValueError("multi-host streaming needs a source "
+                                 "with a known n_rows (the local stripe "
+                                 "row count)")
+            layout = MH.row_layout(int(source.n_rows), mesh)
+            if not tile_rows:
+                c = TP.tile_rows_for(4 * d, layout.per_process * pc)
+            c = -(-c // n_shards) * n_shards
+            c_local = c // pc
+            n_tiles = -(-layout.per_process // c_local)
+            source = TP.PaddedSource(source, n_tiles * c_local)
+        else:
+            c = -(-c // n_shards) * n_shards
         shardings = (M.batch_sharding(mesh, ndim=2),
                      M.batch_sharding(mesh, ndim=2 if y2d else 1),
                      M.batch_sharding(mesh, ndim=1))
@@ -839,8 +914,13 @@ def stream_stats(X, y=None, w=None, *, tile_rows: Optional[int] = None,
     extras = tuple(a for a in (distinct_j, clip_j, lo_j, hi_j)
                    if a is not None)
     if mesh is not None:
-        extras = tuple(jax.device_put(a, M.replicated(mesh))
-                       for a in extras)
+        if pc > 1:
+            from ..parallel import multihost as MH
+            extras = tuple(MH.replicated_global(np.asarray(a), mesh)
+                           for a in extras)
+        else:
+            extras = tuple(jax.device_put(a, M.replicated(mesh))
+                           for a in extras)
 
     def step(carry, xt, yt, wt):
         if mesh is not None:
@@ -857,12 +937,18 @@ def stream_stats(X, y=None, w=None, *, tile_rows: Optional[int] = None,
                           else int(np.asarray(distinct).shape[0]),
                           bins=bins, big=big),
               jnp.zeros(d, jnp.float32))
+    if pc > 1:
+        # a multi-process jit cannot adopt single-device carry leaves:
+        # land them replicated over the global mesh up front
+        from ..parallel import multihost as MH
+        carry0 = jax.tree_util.tree_map(
+            lambda a: MH.replicated_global(np.asarray(a), mesh), carry0)
     # depth resolved HERE (env > planner > hand default 1) so the pass
     # stats record the ring the pass actually ran with; depth never
     # changes tile boundaries, so results are bit-identical at any value
     depth = max(1, int(prefetch)) if prefetch else TP.tile_prefetch_depth()
     (st, shift), ps = TP.run_tileplane(
-        source, step, carry0, tile_rows=c, label="stats",
+        source, step, carry0, tile_rows=c // pc, label="stats",
         first_tile=first_tile, shardings=shardings, prefetch=depth)
     _last_stream_stats = ps
     # the ONE fetch of the pass

@@ -1642,7 +1642,19 @@ def fit_gbt_folds_sharded(Xb: jax.Array, y: jax.Array, W: jax.Array,
     algebra params always travel as [Fo] vectors here (one program
     shape for scalar and vector callers). Margins match the
     single-device fused fit up to f32 psum summation order.
+
+    On a MULTI-PROCESS mesh Xb/y/W are THIS PROCESS's host-local rows
+    (SPMD — every process calls with its own stripe); they land as the
+    process's batch-axis block of one global array and the histogram
+    psums become cross-host collectives. Histogram bin counts are
+    integer sums of the same (row, weight) set as the single-process
+    call, so trees match EXACTLY when gradients agree bit-for-bit and
+    within f32 psum order otherwise. Margins come back as a HOST array
+    holding only this process's rows (fetch_local), trimmed of layout
+    padding.
     """
+    from ..parallel.mesh import mesh_is_multiprocess
+
     Fo = W.shape[0]
 
     def lane(v):
@@ -1660,6 +1672,28 @@ def fit_gbt_folds_sharded(Xb: jax.Array, y: jax.Array, W: jax.Array,
         ("colsample_bylevel", float(colsample_bylevel)),
         ("base_score", None if base_score is None else float(base_score)))
     fn = _sharded_gbt_fn(mesh, static_kw)
+    if mesh_is_multiprocess(mesh):
+        from ..parallel import multihost as MH
+
+        Xl = np.asarray(Xb)
+        n_local = Xl.shape[0]
+        layout = MH.row_layout(n_local, mesh)
+        # zero-weight padding is inert end to end: W=0 rows contribute
+        # nothing to the base score, histograms or leaf counts (the
+        # count unit is (H > 0) and H carries the weight). Xb pads by
+        # repeating the last real row — already-binned values, so any
+        # constant would do, but a repeat keeps bin indices in range.
+        Xb = MH.host_local_block(Xl, mesh, layout, pad_value=None)
+        y = MH.host_local_block(np.asarray(y, np.float32), mesh, layout)
+        W = MH.host_local_block(np.asarray(W, np.float32), mesh, layout,
+                                axis=1)
+        key = MH.replicated_global(np.asarray(key), mesh)
+        lanes = tuple(MH.replicated_global(np.asarray(lane(v)), mesh)
+                      for v in (learning_rate, reg_lambda,
+                                min_child_weight, gamma))
+        trees, base, margins = fn(Xb, y, W, key, *lanes)
+        margins = MH.fetch_local(margins, axis=1)[:, :n_local]
+        return trees, base, margins
     return fn(Xb, y, W, key, lane(learning_rate), lane(reg_lambda),
               lane(min_child_weight), lane(gamma))
 

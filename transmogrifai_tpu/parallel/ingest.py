@@ -293,7 +293,8 @@ def sharded_reader_source(paths: Sequence[str],
                           batch_records: int = 8192,
                           n_rows: Optional[int] = None,
                           workers: Optional[int] = None,
-                          label: str = "ingest") -> ShardedSource:
+                          label: str = "ingest",
+                          stripe: Optional[bool] = None) -> ShardedSource:
     """ShardedSource over CSV/Avro file shards with COLUMNAR decode.
 
     Each shard decodes in whole column blocks — one vectorized
@@ -305,8 +306,27 @@ def sharded_reader_source(paths: Sequence[str],
     extension per shard (.avro = container decode, else CSV);
     `columns` restricts decode to the named fields (CSV header names /
     Avro record fields). Shard ORDER is the caller's `paths` order —
-    pass FileStreamingReader's deterministic listing for file globs."""
+    pass FileStreamingReader's deterministic listing for file globs.
+
+    `stripe` (None = auto: TMOG_MULTIHOST set AND >1 jax processes)
+    keeps only THIS PROCESS's contiguous stripe of `paths`
+    (multihost.stripe_paths): under multi-host SPMD every process calls
+    with the SAME deterministic global listing and opens ONLY its own
+    files — its parsed rows are its batch-axis block of the global row
+    set. When the stripe drops files, a caller-supplied global `n_rows`
+    no longer describes the local stream and is reset to None. Pass
+    stripe=False when `paths` is already a per-process stripe."""
     paths = [str(p) for p in paths]
+    if stripe is None:
+        from .multihost import multihost_enabled
+        stripe = multihost_enabled()
+    if stripe:
+        from . import multihost as MH
+        if MH.process_count() > 1:
+            mine = [str(p) for p in MH.stripe_paths(paths)]
+            if len(mine) != len(paths):
+                paths = mine
+                n_rows = None
 
     def factory_for(path: str) -> Callable[[], Iterator[Tuple[np.ndarray, ...]]]:
         if path.endswith(".avro"):

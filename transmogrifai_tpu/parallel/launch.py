@@ -1,0 +1,281 @@
+"""Localhost pod launcher: a coordinator + N coordinated child processes.
+
+The multi-host story (docs/performance.md "Multi-host pod scaling") needs
+a way to run REAL `jax.distributed` pods on one box — for the 2-process
+tests, the `bench.py --multihost` A/B, and the ci.sh kill/resume smoke —
+without every caller re-inventing the fragile parts: free-port races,
+per-child env assembly, pipe draining, and above all CONTAINMENT. A pod
+is only as alive as its coordinator (child 0 hosts the coordination
+service): if it dies, every other child blocks inside
+`jax.distributed.initialize` or the next collective for minutes. This
+launcher guarantees no child outlives the launch call:
+
+* a wall-clock deadline kills the whole pod (SIGKILL, then reap);
+* any child exiting nonzero kills the rest after a short grace (they
+  are wedged in a collective that can never complete);
+* the coordinator exiting — even cleanly — starts the same grace for
+  stragglers;
+* an optional chaos hook (`kill_on` marker -> SIGKILL `kill_target`)
+  drives the elastic-resume smoke: kill one worker mid-round, relaunch
+  the pod, and the RoundCheckpoint resumes at the last finished round.
+
+Children communicate results by printing ``RESULT|{json}`` lines; the
+launcher parses every such line per child. Each child gets
+TMOG_COORD_ADDR / TMOG_PROC_COUNT / TMOG_PROC_ID (which
+`multihost.initialize()` reads, bringing up gloo CPU collectives before
+the backend exists) and a CPU platform with
+``--xla_force_host_platform_device_count`` virtual devices.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+RESULT_PREFIX = "RESULT|"
+
+_DEV_COUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\d+")
+
+
+def free_port() -> int:
+    """A currently-free localhost TCP port. Inherently racy (the socket
+    closes before the coordinator rebinds it) — callers retry a failed
+    launch once on a fresh port."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def pod_env(port: int, process_id: int, n_procs: int,
+            devices_per_proc: int,
+            extra_env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """The environment one pod child runs under: CPU platform with
+    `devices_per_proc` virtual devices, TMOG_* coordination vars (the
+    spellings `multihost.initialize()` prefers), the legacy JAX_*
+    spellings cleared so an outer distributed context cannot leak in,
+    and the repo importable."""
+    env = dict(os.environ)
+    for stale in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                  "JAX_PROCESS_ID"):
+        env.pop(stale, None)
+    flags = _DEV_COUNT_RE.sub("", env.get("XLA_FLAGS", "")).strip()
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(flags + " " if flags else "")
+        + f"--xla_force_host_platform_device_count={devices_per_proc}",
+        TMOG_MULTIHOST="1",
+        TMOG_COORD_ADDR=f"127.0.0.1:{port}",
+        TMOG_PROC_COUNT=str(n_procs),
+        TMOG_PROC_ID=str(process_id),
+    )
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    pp = env.get("PYTHONPATH", "")
+    if repo not in pp.split(os.pathsep):
+        env["PYTHONPATH"] = repo + (os.pathsep + pp if pp else "")
+    if extra_env:
+        env.update({k: str(v) for k, v in extra_env.items()})
+    return env
+
+
+class ChildResult(NamedTuple):
+    process_id: int
+    returncode: Optional[int]     # None: never exited (killed unreaped)
+    results: List[dict]           # parsed RESULT| payloads, in order
+    stdout: List[str]
+    stderr_tail: str
+    killed: bool                  # containment or chaos hook killed it
+
+
+class PodResult(NamedTuple):
+    ok: bool
+    error: Optional[str]          # first failure description
+    children: List[ChildResult]
+    wall_s: float
+
+    def result(self, process_id: int = 0) -> Optional[dict]:
+        """The last RESULT| payload of one child (None if absent)."""
+        r = self.children[process_id].results
+        return r[-1] if r else None
+
+
+class _Child:
+    def __init__(self, process_id: int, proc: subprocess.Popen):
+        self.process_id = process_id
+        self.proc = proc
+        self.stdout: List[str] = []
+        self.stderr: List[str] = []
+        self.killed = False
+        self._threads: List[threading.Thread] = []
+
+    def start_readers(self, on_line) -> None:
+        for stream, sink in ((self.proc.stdout, self.stdout),
+                             (self.proc.stderr, self.stderr)):
+            t = threading.Thread(target=self._drain,
+                                 args=(stream, sink, on_line), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _drain(self, stream, sink: List[str], on_line) -> None:
+        try:
+            for line in iter(stream.readline, ""):
+                line = line.rstrip("\n")
+                sink.append(line)
+                if sink is self.stdout and on_line is not None:
+                    on_line(self.process_id, line)
+        except ValueError:
+            pass  # stream closed during kill
+        finally:
+            try:
+                stream.close()
+            except OSError:
+                pass
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            self.killed = True
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+
+    def finish(self) -> ChildResult:
+        rc = self.proc.poll()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        results = []
+        for line in self.stdout:
+            if line.startswith(RESULT_PREFIX):
+                try:
+                    results.append(json.loads(line[len(RESULT_PREFIX):]))
+                except ValueError:
+                    pass
+        return ChildResult(
+            process_id=self.process_id, returncode=rc, results=results,
+            stdout=self.stdout,
+            stderr_tail="\n".join(self.stderr)[-2000:],
+            killed=self.killed)
+
+
+def launch_local_pod(payload: str, *, n_procs: int = 2,
+                     devices_per_proc: int = 2, timeout: float = 240.0,
+                     extra_env: Optional[Dict[str, str]] = None,
+                     per_process_env: Optional[
+                         Sequence[Optional[Dict[str, str]]]] = None,
+                     kill_on: Optional[str] = None, kill_target: int = 1,
+                     grace_s: float = 3.0,
+                     python: str = sys.executable) -> PodResult:
+    """Run `payload` (python source) as an `n_procs` localhost CPU pod.
+
+    Every child runs the SAME source (SPMD — it learns its rank from
+    TMOG_PROC_ID via `multihost.initialize()`); `per_process_env` adds
+    per-rank overrides on top of `extra_env`. Returns once every child
+    is reaped — no code path leaves a live child behind.
+
+    `kill_on`/`kill_target`: when the marker substring appears on ANY
+    child's stdout, SIGKILL child `kill_target` — the chaos hook the
+    RoundCheckpoint resume smoke drives. The launch then reports
+    ok=False with error "chaos-killed", and the caller relaunches."""
+    port = free_port()
+    children: List[_Child] = []
+    chaos_fired = threading.Event()
+
+    def on_line(pid: int, line: str) -> None:
+        if kill_on and kill_on in line and not chaos_fired.is_set():
+            chaos_fired.set()
+            if kill_target < len(children):
+                children[kill_target].kill()
+
+    t0 = time.perf_counter()
+    try:
+        for i in range(n_procs):
+            env = pod_env(port, i, n_procs, devices_per_proc, extra_env)
+            if per_process_env and i < len(per_process_env) \
+                    and per_process_env[i]:
+                env.update({k: str(v)
+                            for k, v in per_process_env[i].items()})
+            proc = subprocess.Popen(
+                [python, "-c", payload], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, start_new_session=True)
+            children.append(_Child(i, proc))
+        for c in children:
+            c.start_readers(on_line)
+
+        deadline = time.monotonic() + timeout
+        error: Optional[str] = None
+        grace_until: Optional[float] = None
+        while True:
+            rcs = [c.proc.poll() for c in children]
+            if all(rc is not None for rc in rcs):
+                break
+            now = time.monotonic()
+            if now >= deadline:
+                error = error or (f"pod timeout after {timeout:.0f}s; "
+                                  f"rcs={rcs}")
+                for c in children:
+                    c.kill()
+                deadline = now + 10.0  # bounded reap wait post-kill
+                continue
+            # containment: a failed child — or ANY exited coordinator —
+            # means the stragglers are wedged in a collective that can
+            # never complete; give them a short grace, then kill
+            failed = next((i for i, rc in enumerate(rcs)
+                           if rc is not None and rc != 0), None)
+            coordinator_gone = rcs[0] is not None
+            if (failed is not None or coordinator_gone) \
+                    and grace_until is None:
+                grace_until = now + grace_s
+                if failed is not None:
+                    error = (f"child {failed} exited rc={rcs[failed]}"
+                             + (" (chaos-killed)"
+                                if chaos_fired.is_set() else ""))
+            if grace_until is not None and now >= grace_until:
+                if error is None and any(rc is None for rc in rcs):
+                    error = (f"coordinator exited rc={rcs[0]} with "
+                             f"children still running; rcs={rcs}")
+                if error is not None:
+                    for c in children:
+                        c.kill()
+                grace_until = None
+            time.sleep(0.05)
+    finally:
+        for c in children:
+            c.kill()
+        for c in children:
+            try:
+                c.proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                pass
+
+    results = [c.finish() for c in children]
+    if error is None:
+        bad = next((r for r in results if r.returncode != 0), None)
+        if bad is not None:
+            error = (f"child {bad.process_id} rc={bad.returncode}: "
+                     f"{bad.stderr_tail[-400:]}")
+    if chaos_fired.is_set():
+        error = error or "chaos-killed"
+    wall = time.perf_counter() - t0
+    try:
+        from ..utils.metrics import collector
+        if collector.enabled:
+            collector.event(
+                "multihost_pod", procs=n_procs,
+                devices_per_proc=devices_per_proc,
+                wall_seconds=round(wall, 3),
+                ok=error is None,
+                chaos_killed=chaos_fired.is_set(),
+                error=(error or "")[:200])
+    except Exception:
+        pass
+    return PodResult(ok=error is None, error=error, children=results,
+                     wall_s=wall)

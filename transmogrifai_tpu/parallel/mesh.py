@@ -84,9 +84,36 @@ def mesh_batch_count(mesh) -> int:
         return 1
 
 
+def mesh_process_count(mesh) -> int:
+    """Distinct processes owning the mesh's devices (1 for None / local
+    meshes). The predicate the engine drivers use to pick the multi-host
+    data landing (make_array_from_process_local_data) over the
+    single-host one (device_put of the full array)."""
+    if mesh is None:
+        return 1
+    try:
+        return len({d.process_index
+                    for d in np.asarray(mesh.devices).ravel()})
+    except Exception:
+        return 1
+
+
+def mesh_is_multiprocess(mesh) -> bool:
+    return mesh_process_count(mesh) > 1
+
+
 def make_mesh(n_batch: Optional[int] = None, n_model: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
-    """Create a (batch, model) mesh over available devices."""
+    """Create a (batch, model) mesh over available devices.
+
+    With jax.distributed initialized, `jax.devices()` is the GLOBAL
+    device list in process order, so the batch axis (the row/data axis)
+    spans hosts with each process's devices contiguous along it — the
+    per-host device assignment `make_array_from_process_local_data`
+    needs for a host's rows to land on its own devices. The model axis
+    (the lane axis of the sweep) stays within a host at n_model <=
+    local device count; the 2-D (data x lane) pod mesh of
+    docs/performance.md is exactly this reshape."""
     devs = list(devices if devices is not None else jax.devices())
     if n_batch is None:
         n_batch = len(devs) // n_model

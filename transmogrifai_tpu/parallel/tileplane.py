@@ -220,6 +220,52 @@ class IterSource(RowSource):
             yield tuple(np.asarray(a) for a in chunk)
 
 
+class PaddedSource(RowSource):
+    """A source padded to exactly `n_target` rows with zero rows.
+
+    The multi-host streamed pass needs every process to emit the SAME
+    number of tiles — the tile step's psum is a collective, so a process
+    running out of rows one tile early would wedge the whole pod in a
+    reduction its peers never join. Each process wraps its (uneven)
+    local stripe in a PaddedSource sized to the pod-uniform per-process
+    row count (multihost.row_layout): padded rows are zeros, so the
+    zero-weight convention keeps them inert in every statistic. The
+    inner source must own at least one row (its first chunk is the
+    shape template for the padding)."""
+
+    def __init__(self, inner: RowSource, n_target: int):
+        self.inner = inner
+        self.n_target = int(n_target)
+        self.n_rows = int(n_target)
+
+    def chunks(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        seen = 0
+        template: Optional[Tuple[np.ndarray, ...]] = None
+        for chunk in self.inner.chunks():
+            if template is None:
+                template = chunk
+            seen += chunk[0].shape[0]
+            if seen > self.n_target:
+                raise ValueError(
+                    f"PaddedSource: inner source produced {seen} rows, "
+                    f"more than the layout's {self.n_target}")
+            yield chunk
+        if seen < self.n_target:
+            if template is None:
+                raise ValueError("PaddedSource: empty inner source — "
+                                 "every process must own at least one "
+                                 "row (one file of its stripe)")
+            miss = self.n_target - seen
+            yield tuple(np.zeros((miss,) + tuple(a.shape[1:]), a.dtype)
+                        for a in template)
+
+    def peek(self) -> Tuple[np.ndarray, ...]:
+        return self.inner.peek()
+
+    def set_span_anchor(self, anchor: Any) -> None:
+        self.inner.set_span_anchor(anchor)
+
+
 def reader_row_source(read_records: Callable[[], Iterable[Dict[str, Any]]],
                       row_fn: Callable[[Dict[str, Any]],
                                        Sequence[Sequence[float]]],
@@ -354,11 +400,31 @@ _SENTINEL = object()
 
 
 def _device_put_tile(tile, shardings):
+    """Land one host tile on the mesh. Single-host shardings are a plain
+    device_put; a sharding spanning multiple PROCESSES means `tile` holds
+    only THIS process's rows of the global tile, so the global array is
+    assembled via make_array_from_process_local_data — each host's rows
+    land on its own devices and never cross the wire (the cross-host
+    traffic is the psum in the step, not the copy). Dims sharded over the
+    batch axis scale by the process count; replicated dims do not."""
     import jax
 
     if shardings is None:
         return tuple(jax.device_put(a) for a in tile)
-    return tuple(jax.device_put(a, s) for a, s in zip(tile, shardings))
+    out = []
+    for a, s in zip(tile, shardings):
+        if getattr(s, "is_fully_addressable", True):
+            out.append(jax.device_put(a, s))
+        else:
+            pc = len({d.process_index
+                      for d in np.asarray(s.mesh.devices).ravel()})
+            gshape = list(a.shape)
+            for i, name in enumerate(s.spec):
+                if name is not None and i < len(gshape):
+                    gshape[i] = gshape[i] * pc
+            out.append(jax.make_array_from_process_local_data(
+                s, np.ascontiguousarray(a), tuple(gshape)))
+    return tuple(out)
 
 
 def _producer(source: RowSource, tile_rows: int, q: "queue.Queue",
@@ -456,9 +522,15 @@ def run_tileplane(source: RowSource, step: Callable[..., Any], carry0: Any,
     # span the copy/compute spans use
     source.set_span_anchor(anchor)
     t_pass = time.perf_counter()
-    if not tileplane_enabled():
+    multiproc = bool(shardings) and any(
+        not getattr(s, "is_fully_addressable", True) for s in shardings)
+    if not tileplane_enabled() or multiproc:
         # kill switch: the SAME pass, fully synchronous on the caller's
-        # thread — no producer thread, no queue, no copy/compute overlap
+        # thread — no producer thread, no queue, no copy/compute overlap.
+        # Multi-process shardings ALWAYS take this path: landing tile k+1
+        # on the producer thread while the step's cross-process gloo
+        # collectives run tile k corrupts the CPU client's heap on this
+        # jaxlib — the pod pays serialized copy/compute for correctness.
         return _run_sync(source, step, carry0, tile_rows=tile_rows,
                          stats=stats, first_tile=first_tile, sink=sink,
                          shardings=shardings, traced=traced,

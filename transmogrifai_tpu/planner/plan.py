@@ -87,9 +87,16 @@ def corpus_dir() -> str:
 
 
 def _backend() -> str:
+    """Corpus key for this process's measurements. Multi-process pods
+    append "-pc<N>": a collective-bearing span's wall includes DCN
+    waits, so pod measurements must never steer (or be steered by)
+    single-process plans — the suffix keys them into their own
+    corpus-<backend>.jsonl file and plan cache (docs/planning.md)."""
     try:
         import jax
-        return jax.default_backend()
+        backend = jax.default_backend()
+        pc = jax.process_count()
+        return f"{backend}-pc{pc}" if pc > 1 else backend
     except Exception:
         return "cpu"
 

@@ -66,10 +66,18 @@ class FileStreamingReader(StreamingReader):
     sight, so a quiet directory behaves exactly as before."""
 
     def __init__(self, pattern: str, reader_factory: Callable[[str], Reader],
-                 key_fn: Optional[Callable[[Record], str]] = None):
+                 key_fn: Optional[Callable[[Record], str]] = None,
+                 stripe: bool = False):
         super().__init__(key_fn)
         self.pattern = pattern
         self.reader_factory = reader_factory
+        #: multi-host SPMD striping: when True and >1 jax processes are
+        #: up, every listing keeps only THIS PROCESS's contiguous stripe
+        #: (parallel/multihost.stripe_paths) — each host opens only its
+        #: own shard files. Meant for one-shot batch listings: a
+        #: tail-follow loop could observe files at different times on
+        #: different hosts and mis-stripe.
+        self.stripe = stripe
         self._seen: set = set()
         # path -> last observed size, for candidates deferred mid-write
         self._pending: Dict[str, int] = {}
@@ -138,7 +146,12 @@ class FileStreamingReader(StreamingReader):
         # shard writers, coarse filesystems) sort lexicographically, so
         # shard order — and everything downstream that must be
         # bit-identical across ingest worker counts — is deterministic
-        return sorted(out, key=order)
+        ordered = sorted(out, key=order)
+        if self.stripe:
+            from ..parallel import multihost as MH
+            if MH.process_count() > 1:
+                ordered = MH.stripe_paths(ordered)
+        return ordered
 
     def stream(self) -> Iterator[List[Record]]:
         for p in self._paths():
