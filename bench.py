@@ -1600,11 +1600,16 @@ def multihost_bench(n_rows=None):
                     fh.write(",".join(f"{v:.6f}" for v in r) + "\n")
 
         env = {"BENCH_MH_DIR": tmp, "BENCH_MH_D": str(d)}
+        trace_dir = os.path.join(tmp, "podtrace")
         arms = {}
         for name, n_procs, dev in (("one_proc", 1, 4), ("two_proc", 2, 2)):
+            # flight-record the real pod arm only: the recorder's value
+            # is cross-process skew/collective-wait, meaningless at pc=1
             pod = launch_local_pod(_MULTIHOST_CHILD, n_procs=n_procs,
                                    devices_per_proc=dev, timeout=420.0,
-                                   extra_env=env)
+                                   extra_env=env,
+                                   trace_dir=(trace_dir if n_procs > 1
+                                              else None))
             if not pod.ok:
                 arms[name] = {"ok": False, "error": pod.error,
                               "stderr_tail": [c.stderr_tail[-400:]
@@ -1647,6 +1652,32 @@ def multihost_bench(n_rows=None):
                 "(real cross-process gloo psums, 0 recompiles, "
                 "identical stats); per-host parse scaling needs "
                 "per-host cores")
+
+        # pod flight recorder on the real pod arm: merge the per-rank
+        # artifact dirs into skew / collective-wait / MFU columns and
+        # harvest the measured spans into the cpu-pc2 planner corpus
+        # (docs/observability.md "Pod tracing"). This child runs
+        # one-shot sharded entry points, no engine rounds, so the merge
+        # aligns on one synthetic round — collective_share and the MFU
+        # sinks are still exact (measured durations, analytic costs).
+        if two and two.get("ok"):
+            from transmogrifai_tpu.parallel import podtrace as PT
+            rep = PT.merge_pod(trace_dir)
+            out["pod_trace"] = {
+                "rounds": len(rep["rounds"]),
+                "synthetic_rounds": rep["synthetic_rounds"],
+                "coverage_min_seen": rep["coverage_min_seen"],
+                "collective_share": {
+                    r["rank"]: r["collective_share"]
+                    for r in rep["ranks"]},
+                "collective_wait_s": {
+                    r["rank"]: r["collective_s"]
+                    for r in rep["ranks"]},
+                "skew": rep["skew"],
+                "mfu_top_sinks": rep["mfu_table"][:3],
+                "problems": rep["problems"],
+                "corpus_rows_harvested": PT.harvest_pod(trace_dir),
+            }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     return out
@@ -2471,7 +2502,7 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--multihost":
         res = multihost_bench(sys.argv[2] if len(sys.argv) > 2 else None)
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "MULTICHIP_r06.json")
+                            "MULTICHIP_r07.json")
         with open(path, "w") as fh:
             json.dump(res, fh, indent=2)
         print(json.dumps(res))

@@ -1629,4 +1629,163 @@ finally:
     shutil.rmtree(tmp, ignore_errors=True)
 PY
 
+# pod flight recorder (docs/observability.md "Pod tracing"): a clean
+# traced 2-process pod must merge green (round-aligned swimlanes,
+# >= 75% span coverage of every rank's round wall, 0 post-warmup
+# recompiles, >= 1 new planner-corpus row at the cpu-pc2 key); a chaos
+# pod with a debug-sleep stall injected on rank 1 must be NAMED by
+# trace-report --pod; a wedged pod's timeout error must name the
+# straggler's rank/round/phase from heartbeats
+echo "== 8/8b pod flight recorder =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, shutil, subprocess, sys, tempfile
+import numpy as np
+from transmogrifai_tpu.parallel import podtrace as PT
+from transmogrifai_tpu.parallel.launch import launch_local_pod
+
+PAYLOAD = r"""
+import json, os
+import numpy as np
+from transmogrifai_tpu.parallel import multihost as MH
+MH.initialize()
+import jax
+pc = jax.process_count(); pid = jax.process_index()
+mesh = MH.global_mesh(n_model=1)
+rng = np.random.default_rng(1)
+n, d = 40, 4
+X = rng.normal(size=(n, d)).astype(np.float32)
+y = (X[:, 0] - X[:, 2] + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+w = np.ones(n, np.float32)
+masks = np.zeros((2, n), np.float32)
+masks[0, ::2] = 1.0
+masks[1, 1::2] = 1.0
+bounds = [0, 20, n] if pc == 2 else [0, n]
+lo, hi = bounds[pid], bounds[pid + 1]
+from transmogrifai_tpu.ops import glm_sweep as GS
+regs = np.asarray([1.0, 0.3, 0.1, 0.03], np.float32)
+alphas = np.zeros(4, np.float32)
+B, b0, info = GS.sweep_glm_streamed_rounds(
+    X[lo:hi], y[lo:hi], w[lo:hi], masks[:, lo:hi], regs, alphas,
+    loss="logistic", mesh=mesh, round_iters=2)
+print("RESULT|" + json.dumps({"pid": pid,
+                              "rounds": int(info["glm_rounds"])}),
+      flush=True)
+MH.finalize()
+"""
+
+WEDGE = r"""
+import time
+import numpy as np
+from transmogrifai_tpu.parallel import multihost as MH
+MH.initialize()
+import jax
+pid = jax.process_index()
+mesh = MH.global_mesh(n_model=1)
+from transmogrifai_tpu.parallel import podtrace
+with podtrace.pod_round(0):
+    if pid == 1:
+        podtrace.beat("compute:wedged", rnd=0, force=True)
+        time.sleep(600)
+    from transmogrifai_tpu.ops import stats_engine as SE
+    SE.fused_stats_sharded(mesh, np.ones((8, 2), np.float32),
+                           np.ones(8, np.float32),
+                           np.ones(8, np.float32))
+MH.finalize()
+"""
+
+
+def run(trace_dir, **kw):
+    # one retry on a fresh port (free_port's close-then-rebind race)
+    pod = launch_local_pod(PAYLOAD, n_procs=2, devices_per_proc=2,
+                           timeout=300.0, trace_dir=trace_dir, **kw)
+    if not pod.ok:
+        shutil.rmtree(trace_dir, ignore_errors=True)
+        pod = launch_local_pod(PAYLOAD, n_procs=2, devices_per_proc=2,
+                               timeout=300.0, trace_dir=trace_dir, **kw)
+    assert pod.ok, (pod.error,
+                    [c.stderr_tail[-300:] for c in pod.children])
+    return pod
+
+
+def round_compiles(rank_dir):
+    """Per-rank [(round, bucket, compiles-in-window)] from the span
+    tree — the post-warmup recompile gate's raw data."""
+    doc = json.load(open(os.path.join(rank_dir, PT.METRICS_NAME)))
+    spans = doc["spans"]
+    rounds = sorted(
+        ((s["attrs"]["round"], s["attrs"].get("bucket"),
+          s["t_start"], s["t_end"])
+         for s in spans if s["kind"] == "pod_round"),
+        key=lambda r: r[0])
+    out = []
+    for rnd, bucket, t0, t1 in rounds:
+        n = sum(int(s["attrs"].get("compiles") or 0) for s in spans
+                if s["kind"] != "pod_round"
+                and s.get("t_start") is not None
+                and s.get("t_end") is not None
+                and s["t_start"] >= t0 - 1e-6
+                and s["t_end"] <= t1 + 1e-6)
+        out.append((rnd, bucket, n))
+    return out
+
+
+tmp = tempfile.mkdtemp(prefix="ci_podtrace_")
+try:
+    # 1. clean traced pod -> merged timeline green
+    clean = os.path.join(tmp, "clean")
+    run(clean)
+    rep = PT.merge_pod(clean)
+    assert rep["problems"] == [], rep["problems"]
+    assert not rep["synthetic_rounds"] and len(rep["rounds"]) >= 2
+    assert rep["coverage_min_seen"] >= 0.75, rep["coverage_min_seen"]
+    assert os.path.exists(rep["trace_path"])
+    text, rc = PT.pod_report_rc(clean)
+    assert rc == 0, text
+
+    # 0 post-warmup recompiles: a round at an already-seen bucket shape
+    # must compile nothing (the bucket-ladder contract, now visible per
+    # rank in the flight recorder)
+    for rank, rd in PT.rank_dirs(clean):
+        seen, bad = set(), []
+        for rnd, bucket, n in round_compiles(rd):
+            if bucket in seen and n > 0:
+                bad.append((rnd, bucket, n))
+            seen.add(bucket)
+        assert not bad, f"rank {rank}: post-warmup recompiles {bad}"
+
+    # planner corpus grows at the (backend, process-count) key
+    corpus = os.path.join(tmp, "corpus")
+    rows = PT.harvest_pod(clean, corpus_path=corpus)
+    assert rows >= 1, rows
+    assert os.path.exists(os.path.join(corpus, "corpus-cpu-pc2.jsonl"))
+    assert PT.harvest_pod(clean, corpus_path=corpus) == 0  # dedupe
+
+    # 2. chaos straggler: injected debug-sleep on rank 1 must be named,
+    # through the CLI surface
+    chaos = os.path.join(tmp, "chaos")
+    run(chaos, debug_sleep_ms=200, debug_sleep_target=1)
+    rep = PT.merge_pod(chaos)
+    assert rep["skew"]["flagged"], rep["skew"]
+    assert rep["skew"]["straggler_rank"] == 1, rep["skew"]
+    r = subprocess.run(
+        [sys.executable, "-m", "transmogrifai_tpu", "trace-report",
+         "--pod", chaos], capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "straggler: rank 1" in r.stdout, r.stdout[-2000:]
+
+    # 3. wedged pod: the reaper names rank/round/phase from heartbeats
+    wedged = os.path.join(tmp, "wedged")
+    pod = launch_local_pod(WEDGE, n_procs=2, devices_per_proc=2,
+                           timeout=30.0, trace_dir=wedged)
+    assert not pod.ok and "timeout" in (pod.error or ""), pod.error
+    assert "likely straggler: rank 1" in pod.error, pod.error
+    assert "compute:wedged" in pod.error, pod.error
+    print("pod flight recorder ok: %d rounds merged, coverage %.0f%%, "
+          "%d corpus rows at cpu-pc2, chaos straggler + wedge both "
+          "named rank 1" % (len(rep["rounds"]),
+                            100.0 * rep["coverage_min_seen"], rows))
+finally:
+    shutil.rmtree(tmp, ignore_errors=True)
+PY
+
 echo "CI GREEN"

@@ -356,6 +356,57 @@ def test_launch_chaos_hook_kills_target_on_marker():
     assert pod.wall_s < 60.0
 
 
+def test_launch_timeout_error_names_straggler_from_heartbeats(tmp_path):
+    """With a trace dir the reaper is not blind: the timeout error names
+    the rank whose heartbeat shows it still computing while its peer is
+    parked in a collective — rank, round, phase, beat age. The children
+    write flight-recorder heartbeats with stdlib json only (the
+    launcher's env plumbing is what's under test, not the recorder —
+    tests/test_podtrace.py owns that)."""
+    from transmogrifai_tpu.parallel.launch import launch_local_pod
+
+    payload = (
+        "import json, os, time\n"
+        "assert os.environ['TMOG_PODTRACE'] == '1'\n"
+        "root = os.environ['TMOG_PODTRACE_DIR']\n"
+        "rank = os.environ['TMOG_PROC_ID']\n"
+        "d = os.path.join(root, 'rank-' + rank)\n"
+        "os.makedirs(d, exist_ok=True)\n"
+        "phase = ('collective:glm_round' if rank == '0'\n"
+        "         else 'compute:glm_prep')\n"
+        "with open(os.path.join(d, 'heartbeat.jsonl'), 'a') as fh:\n"
+        "    fh.write(json.dumps({'round': 4, 'phase': phase,\n"
+        "                         'mono': time.monotonic(),\n"
+        "                         'ts': time.time()}) + '\\n')\n"
+        "time.sleep(600)\n")
+    pod = launch_local_pod(payload, n_procs=2, devices_per_proc=1,
+                           timeout=4.0, trace_dir=str(tmp_path))
+    assert not pod.ok and "timeout" in pod.error
+    assert "likely straggler: rank 1" in pod.error
+    assert "round 4" in pod.error
+    assert "compute:glm_prep" in pod.error
+
+
+def test_launch_debug_sleep_env_targets_one_rank(tmp_path):
+    """debug_sleep_ms reaches ONLY the target rank's environment —
+    the chaos-straggler injection the ci.sh pod stage asserts on."""
+    from transmogrifai_tpu.parallel.launch import launch_local_pod
+
+    payload = (
+        "import json, os\n"
+        "print('RESULT|' + json.dumps(\n"
+        "    {'rank': os.environ['TMOG_PROC_ID'],\n"
+        "     'sleep': os.environ.get('TMOG_PODTRACE_DEBUG_SLEEP_MS')}),\n"
+        "    flush=True)\n")
+    pod = launch_local_pod(payload, n_procs=2, devices_per_proc=1,
+                           timeout=60.0, trace_dir=str(tmp_path),
+                           debug_sleep_ms=150, debug_sleep_target=1)
+    assert pod.ok, pod.error
+    by_rank = {r["rank"]: r["sleep"]
+               for r in (pod.result(i) for i in range(2))}
+    assert by_rank == {"0": None, "1": "150"}
+
+
 def test_pod_env_shapes_child_topology():
     from transmogrifai_tpu.parallel.launch import pod_env
 

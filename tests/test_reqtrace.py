@@ -534,6 +534,20 @@ def served(fitted):
         os.environ.pop("TMOG_DEBUG_SLEEP_MAX_MS", None)
 
 
+def _kept_for(tracer, tid, timeout=5.0):
+    """Kept-trace rows for `tid`, polled: the handler thread records
+    the trace AFTER the response leaves (the respond segment must be
+    measured), so a client reading the payload right after its reply
+    races finish()."""
+    deadline = time.perf_counter() + timeout
+    while True:
+        kept = [k for k in tracer.requests_payload()["kept"]
+                if k["trace_id"] == tid]
+        if kept or time.perf_counter() >= deadline:
+            return kept
+        time.sleep(0.01)
+
+
 def _post(port, body, headers=None, timeout=30.0):
     import http.client
     conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
@@ -563,8 +577,7 @@ class TestHttpEndToEnd:
             served["port"], {"a": 1.0, "b": 2.0, "bogus_key": 1})
         assert status == 400
         tid, _ = RQ.parse_trace_header(headers.get(RQ.TRACE_HEADER))
-        kept = [k for k in served["fe"].tracer.requests_payload()["kept"]
-                if k["trace_id"] == tid]
+        kept = _kept_for(served["fe"].tracer, tid)
         assert kept and kept[0]["kept"] == "error"
         assert kept[0]["status"] == 400
         assert kept[0]["replica"] == "rep-7"
@@ -657,9 +670,7 @@ class TestRouterHop:
         segs_r = dict(rt.segs)
         assert {"route", "upstream"} <= set(segs_r)
         # the replica-side record of the SAME trace id
-        rep_kept = [k for k in
-                    served["fe"].tracer.requests_payload()["kept"]
-                    if k["trace_id"] == rt.trace_id]
+        rep_kept = _kept_for(served["fe"].tracer, rt.trace_id)
         assert rep_kept, "replica did not keep the propagated trace"
         rep = rep_kept[0]
         assert rep["replica"] == "rep-7"

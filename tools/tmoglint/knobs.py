@@ -133,6 +133,27 @@ KNOBS: List[Dict[str, str]] = [
     {"name": "TMOG_PROC_ID", "default": "",
      "doc": "docs/performance.md",
      "desc": "this process's rank in the pod (0..TMOG_PROC_COUNT-1)"},
+    # -- pod flight recorder ------------------------------------------------
+    {"name": "TMOG_PODTRACE", "default": "",
+     "doc": "docs/observability.md",
+     "desc": "master opt-in for the per-rank pod flight recorder "
+             "(launch_local_pod's trace_dir sets it)"},
+    {"name": "TMOG_PODTRACE_DIR", "default": "",
+     "doc": "docs/observability.md",
+     "desc": "pod trace root; each rank writes rank-<k>/ artifacts "
+             "(metrics.json, heartbeat.jsonl, events.jsonl, meta.json)"},
+    {"name": "TMOG_PODTRACE_HEARTBEAT_S", "default": "0.5",
+     "doc": "docs/observability.md",
+     "desc": "minimum interval between heartbeat lines (phase "
+             "transitions always beat)"},
+    {"name": "TMOG_PODTRACE_SPAN_BUDGET", "default": "20000",
+     "doc": "docs/observability.md",
+     "desc": "pod_* spans recorded per rank before the recorder goes "
+             "quiet (heartbeats continue)"},
+    {"name": "TMOG_PODTRACE_DEBUG_SLEEP_MS", "default": "0",
+     "doc": "docs/observability.md",
+     "desc": "chaos hook: per-round stall injected on this rank so the "
+             "ci.sh pod stage can assert straggler attribution"},
     # -- serving ------------------------------------------------------------
     {"name": "TMOG_SERVE_SPAN_BUDGET", "default": "10000",
      "doc": "docs/serving.md",

@@ -395,6 +395,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "breakdown; flags (exit 1) any request whose "
                          "segments do not cover its e2e wall within "
                          "tolerance (docs/observability.md)")
+    tr.add_argument("--pod", action="store_true",
+                    help="pod flight-recorder report: DIR is a pod "
+                         "trace root holding rank-<k>/ dirs; merges "
+                         "the ranks into one Chrome trace with rank "
+                         "swimlanes and prints per-round skew, "
+                         "straggler attribution, collective-wait share "
+                         "and the MFU sink table; exit 1 on span "
+                         "undercoverage or broken round alignment "
+                         "(docs/observability.md)")
     tr.add_argument("--top", type=int, default=15,
                     help="rows in the self-time table (default 15)")
     sv = sub.add_parser(
@@ -629,6 +638,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # exit codes follow docs/static_analysis.md "Exit codes" (the
         # same table the tmoglint CLI uses): 0 clean, 1 problems,
         # 2 usage error (not a traced run dir)
+        if a.pod:
+            from .parallel.podtrace import pod_report_rc
+            text, rc = pod_report_rc(a.dir, top=a.top)
+            print(text)
+            return rc
         if a.requests:
             from .utils.tracing import requests_report_rc
             text, rc = requests_report_rc(a.dir, top=a.top)

@@ -358,16 +358,20 @@ def _land_rows_multihost(mesh, X, y, w, fold_masks):
     with ones (irrelevant under w=0) — the uneven-stripe generalization
     of the validator's pad_rows_to_multiple."""
     from ..parallel import multihost as MH
+    from ..parallel import podtrace
 
     Xl = np.asarray(X)
     n = Xl.shape[0]
     layout = MH.row_layout(n, mesh)
     wl = np.ones(n, np.float32) if w is None else np.asarray(w, np.float32)
-    return (MH.host_local_block(Xl, mesh, layout),
-            MH.host_local_block(np.asarray(y, np.float32), mesh, layout),
-            MH.host_local_block(wl, mesh, layout),
-            MH.host_local_block(np.asarray(fold_masks, np.float32), mesh,
-                                layout, pad_value=1.0, axis=1))
+    with podtrace.ingest("glm_land", rows=int(n),
+                         cols=int(Xl.shape[1]) if Xl.ndim > 1 else 1):
+        return (MH.host_local_block(Xl, mesh, layout),
+                MH.host_local_block(np.asarray(y, np.float32), mesh,
+                                    layout),
+                MH.host_local_block(wl, mesh, layout),
+                MH.host_local_block(np.asarray(fold_masks, np.float32),
+                                    mesh, layout, pad_value=1.0, axis=1))
 
 
 def _psum_moments(X, w, allreduce):
@@ -574,16 +578,30 @@ def sweep_glm_streamed_sharded(mesh, X, y, w, fold_masks, regs, alphas, *,
                            bool(standardize))
     if _mesh_is_mp(mesh):
         from ..parallel import multihost as MH
+        from ..parallel import podtrace
 
         if not _is_global_array(X):
             X, y, w, fold_masks = _land_rows_multihost(mesh, X, y, w,
                                                        fold_masks)
-        return fn(
-            X, y, w, fold_masks,
-            MH.replicated_global(np.asarray(regs, np.float32), mesh),
-            MH.replicated_global(np.asarray(alphas, np.float32), mesh),
-            MH.replicated_global(np.asarray(int(max_iter), np.int32), mesh),
-            MH.replicated_global(np.asarray(float(tol), np.float32), mesh))
+        # flight recorder: the psums are inside the jitted program, so
+        # the collective window is the whole sharded call; the explicit
+        # block (recording only) pins the barrier wall to this bracket
+        # instead of the caller's eventual fetch
+        with podtrace.collective(
+                "glm_sweep", rows=int(X.shape[0]), feat=int(X.shape[1]),
+                lanes=int(np.asarray(regs).shape[0])) as _psp:
+            out = fn(
+                X, y, w, fold_masks,
+                MH.replicated_global(np.asarray(regs, np.float32), mesh),
+                MH.replicated_global(np.asarray(alphas, np.float32),
+                                     mesh),
+                MH.replicated_global(np.asarray(int(max_iter), np.int32),
+                                     mesh),
+                MH.replicated_global(np.asarray(float(tol), np.float32),
+                                     mesh))
+            if _psp is not None:
+                jax.block_until_ready(out)
+        return out
     return fn(
         X, y, w, fold_masks, regs, alphas,
         jnp.asarray(max_iter, jnp.int32), jnp.asarray(tol, jnp.float32))
@@ -723,16 +741,29 @@ def sweep_glm_squared_gram_sharded(mesh, X, y, w, fold_masks, regs, alphas,
     fn = _sharded_gram_fn(mesh, bool(fit_intercept), bool(standardize))
     if _mesh_is_mp(mesh):
         from ..parallel import multihost as MH
+        from ..parallel import podtrace
 
         if not _is_global_array(X):
             X, y, w, fold_masks = _land_rows_multihost(mesh, X, y, w,
                                                        fold_masks)
-        return fn(
-            X, y, w, fold_masks,
-            MH.replicated_global(np.asarray(regs, np.float32), mesh),
-            MH.replicated_global(np.asarray(alphas, np.float32), mesh),
-            MH.replicated_global(np.asarray(int(max_iter), np.int32), mesh),
-            MH.replicated_global(np.asarray(float(tol), np.float32), mesh))
+        # collective window = sharded call + block (recording only):
+        # the Gram psum is inside the program — see sweep_glm_streamed_
+        # sharded above for the attribution contract
+        with podtrace.collective(
+                "glm_gram", rows=int(X.shape[0]), feat=int(X.shape[1]),
+                lanes=int(np.asarray(regs).shape[0])) as _psp:
+            out = fn(
+                X, y, w, fold_masks,
+                MH.replicated_global(np.asarray(regs, np.float32), mesh),
+                MH.replicated_global(np.asarray(alphas, np.float32),
+                                     mesh),
+                MH.replicated_global(np.asarray(int(max_iter), np.int32),
+                                     mesh),
+                MH.replicated_global(np.asarray(float(tol), np.float32),
+                                     mesh))
+            if _psp is not None:
+                jax.block_until_ready(out)
+        return out
     return fn(
         X, y, w, fold_masks, regs, alphas,
         jnp.asarray(max_iter, jnp.int32), jnp.asarray(tol, jnp.float32))
@@ -1137,6 +1168,7 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
     # bucket/active shape — the trace view of the bucket-ladder story, and
     # the recompile tracker's attribution unit for round programs
     from ..utils.metrics import collector as _collector
+    from ..parallel import podtrace as _podtrace
 
     def _run_source_round(sel, l1b, l2b, B0, b00, budget):
         """One retirement round for a compacted bucket, each Newton
@@ -1175,60 +1207,81 @@ def sweep_glm_streamed_rounds(X, y, w, fold_masks, regs, alphas, *,
     def run_round(idx, budget):
         k = len(idx)
         Lb = bucket_lanes(k)
+        mp_round = (not src_mode) and _mesh_is_mp(mesh)
         with _collector.trace_span(
                 f"glm_round[{Lb}]", kind="sweep_round", bucket=int(Lb),
-                active=int(k), iters_budget=int(budget)):
-            sel = np.zeros((F, Lb), np.float32)
-            sel[lane_fold[idx], np.arange(k)] = 1.0
-            l1b = np.zeros(Lb, np.float32)
-            l1b[:k] = l1v[idx]
-            # inert pads get l2=1 so their (zero-data) Hessian stays
-            # well-conditioned; their B stays exactly 0 from the zero init
-            l2b = np.ones(Lb, np.float32)
-            l2b[:k] = l2v[idx]
-            B0 = np.zeros((Lb, d), np.float32)
-            B0[:k] = st["B"][idx]
-            b00 = np.zeros(Lb, np.float32)
-            b00[:k] = st["b0"][idx]
+                active=int(k), iters_budget=int(budget)), \
+                _podtrace.pod_round(st["rounds"], bucket=int(Lb),
+                                    active=int(k)):
+            args = None
+            with _podtrace.compute("glm_prep", lanes=int(Lb)):
+                sel = np.zeros((F, Lb), np.float32)
+                sel[lane_fold[idx], np.arange(k)] = 1.0
+                l1b = np.zeros(Lb, np.float32)
+                l1b[:k] = l1v[idx]
+                # inert pads get l2=1 so their (zero-data) Hessian stays
+                # well-conditioned; their B stays exactly 0 from the
+                # zero init
+                l2b = np.ones(Lb, np.float32)
+                l2b[:k] = l2v[idx]
+                B0 = np.zeros((Lb, d), np.float32)
+                B0[:k] = st["B"][idx]
+                b00 = np.zeros(Lb, np.float32)
+                b00[:k] = st["b0"][idx]
+                if not src_mode:
+                    if mp_round:
+                        from ..parallel import multihost as MH
+
+                        def land(a, dt):
+                            return MH.replicated_global(
+                                np.asarray(a, dt), mesh)
+                    else:
+                        def land(a, dt):
+                            return jnp.asarray(a, dt)
+                    args = (X, y, w, fold_masks, land(sel, np.float32),
+                            land(l1b, np.float32), land(l2b, np.float32),
+                            land(B0, np.float32), land(b00, np.float32),
+                            mean, std, land(budget, np.int32),
+                            land(tol_f, np.float32))
             if src_mode:
                 Bb, b0b, db, it = _run_source_round(sel, l1b, l2b, B0,
                                                     b00, budget)
+            elif mesh is None:
+                Bb, b0b, db, it = sweep_glm_round(
+                    *args, loss=loss, fit_intercept=fit_intercept)
             else:
-                if _mesh_is_mp(mesh):
-                    from ..parallel import multihost as MH
-
-                    def land(a, dt):
-                        return MH.replicated_global(
-                            np.asarray(a, dt), mesh)
-                else:
-                    def land(a, dt):
-                        return jnp.asarray(a, dt)
-                args = (X, y, w, fold_masks, land(sel, np.float32),
-                        land(l1b, np.float32), land(l2b, np.float32),
-                        land(B0, np.float32), land(b00, np.float32),
-                        mean, std, land(budget, np.int32),
-                        land(tol_f, np.float32))
-                if mesh is None:
-                    Bb, b0b, db, it = sweep_glm_round(
-                        *args, loss=loss, fit_intercept=fit_intercept)
-                else:
+                # the psum lives INSIDE the jitted round program, so the
+                # collective window on the multi-process path is program
+                # call + result fetch: a victim rank's wall here is the
+                # barrier wait the skew table attributes (single-process
+                # meshes record the same window as plain compute)
+                bracket = (_podtrace.collective if mp_round
+                           else _podtrace.compute)
+                with bracket("glm_round", rows=int(X.shape[0]),
+                             feat=int(d), lanes=int(Lb),
+                             iters=int(budget)):
                     Bb, b0b, db, it = _sharded_round_fn(
                         mesh, loss, bool(fit_intercept))(*args)
-            st["B"][idx] = np.asarray(Bb)[:k]
-            st["b0"][idx] = np.asarray(b0b)[:k]
-            st["delta"][idx] = np.asarray(db)[:k]
-            it = int(it)
-            st["iters"][idx] += it
-            st["rounds"] += 1
-            st["data_passes"] += it
-            # useful work (active lanes) vs executed work (the padded
-            # bucket the device actually ran) — the FLOP model bills the
-            # latter
-            st["lane_passes"] += it * k
-            st["padded_lane_passes"] += it * Lb
-            st["active_per_round"].append(k)
-            st["iters_per_round"].append(it)
-            st["bucket_sizes"].append(Lb)
+                    Bb = np.asarray(Bb)
+                    b0b = np.asarray(b0b)
+                    db = np.asarray(db)
+                    it = int(it)
+            with _podtrace.compute("glm_retire", active=int(k)):
+                st["B"][idx] = np.asarray(Bb)[:k]
+                st["b0"][idx] = np.asarray(b0b)[:k]
+                st["delta"][idx] = np.asarray(db)[:k]
+                it = int(it)
+                st["iters"][idx] += it
+                st["rounds"] += 1
+                st["data_passes"] += it
+                # useful work (active lanes) vs executed work (the
+                # padded bucket the device actually ran) — the FLOP
+                # model bills the latter
+                st["lane_passes"] += it * k
+                st["padded_lane_passes"] += it * Lb
+                st["active_per_round"].append(k)
+                st["iters_per_round"].append(it)
+                st["bucket_sizes"].append(Lb)
 
     def retire(idx):
         st["retired"][idx] = (st["delta"][idx] <= tol_f) \

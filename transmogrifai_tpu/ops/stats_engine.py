@@ -951,6 +951,15 @@ def stream_stats(X, y=None, w=None, *, tile_rows: Optional[int] = None,
         source, step, carry0, tile_rows=c // pc, label="stats",
         first_tile=first_tile, shardings=shardings, prefetch=depth)
     _last_stream_stats = ps
+    if pc > 1:
+        # flight recorder: the ONE fetch of the pass is where a victim
+        # rank absorbs its peers' lag (the tile psums are inside the
+        # sharded step) — bracket it as the pass's collective window
+        from ..parallel import podtrace
+        with podtrace.collective("stats_fetch",
+                                 rows=int(source.n_rows or 0),
+                                 cols=int(d)):
+            return _fetch_state(st), np.asarray(shift, np.float32)
     # the ONE fetch of the pass
     return _fetch_state(st), np.asarray(shift, np.float32)
 

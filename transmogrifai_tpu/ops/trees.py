@@ -1674,25 +1674,37 @@ def fit_gbt_folds_sharded(Xb: jax.Array, y: jax.Array, W: jax.Array,
     fn = _sharded_gbt_fn(mesh, static_kw)
     if mesh_is_multiprocess(mesh):
         from ..parallel import multihost as MH
+        from ..parallel import podtrace
 
         Xl = np.asarray(Xb)
         n_local = Xl.shape[0]
         layout = MH.row_layout(n_local, mesh)
-        # zero-weight padding is inert end to end: W=0 rows contribute
-        # nothing to the base score, histograms or leaf counts (the
-        # count unit is (H > 0) and H carries the weight). Xb pads by
-        # repeating the last real row — already-binned values, so any
-        # constant would do, but a repeat keeps bin indices in range.
-        Xb = MH.host_local_block(Xl, mesh, layout, pad_value=None)
-        y = MH.host_local_block(np.asarray(y, np.float32), mesh, layout)
-        W = MH.host_local_block(np.asarray(W, np.float32), mesh, layout,
-                                axis=1)
-        key = MH.replicated_global(np.asarray(key), mesh)
-        lanes = tuple(MH.replicated_global(np.asarray(lane(v)), mesh)
-                      for v in (learning_rate, reg_lambda,
-                                min_child_weight, gamma))
-        trees, base, margins = fn(Xb, y, W, key, *lanes)
-        margins = MH.fetch_local(margins, axis=1)[:, :n_local]
+        with podtrace.ingest("tree_land", rows=int(n_local),
+                             feat=int(Xl.shape[1])):
+            # zero-weight padding is inert end to end: W=0 rows
+            # contribute nothing to the base score, histograms or leaf
+            # counts (the count unit is (H > 0) and H carries the
+            # weight). Xb pads by repeating the last real row —
+            # already-binned values, so any constant would do, but a
+            # repeat keeps bin indices in range.
+            Xb = MH.host_local_block(Xl, mesh, layout, pad_value=None)
+            y = MH.host_local_block(np.asarray(y, np.float32), mesh,
+                                    layout)
+            W = MH.host_local_block(np.asarray(W, np.float32), mesh,
+                                    layout, axis=1)
+            key = MH.replicated_global(np.asarray(key), mesh)
+            lanes = tuple(MH.replicated_global(np.asarray(lane(v)), mesh)
+                          for v in (learning_rate, reg_lambda,
+                                    min_child_weight, gamma))
+        # collective window = sharded fit + local-margin fetch: the
+        # histogram psums live inside the jitted program, so a victim
+        # rank's barrier wall lands here (the skew table's attribution
+        # contract — see parallel/podtrace.py)
+        with podtrace.collective("tree_fit", rows=int(layout.n_padded),
+                                 feat=int(Xl.shape[1]), folds=int(Fo),
+                                 depth=int(depth), rounds=int(n_rounds)):
+            trees, base, margins = fn(Xb, y, W, key, *lanes)
+            margins = MH.fetch_local(margins, axis=1)[:, :n_local]
         return trees, base, margins
     return fn(Xb, y, W, key, lane(learning_rate), lane(reg_lambda),
               lane(min_child_weight), lane(gamma))

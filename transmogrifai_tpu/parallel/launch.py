@@ -171,7 +171,8 @@ def launch_local_pod(payload: str, *, n_procs: int = 2,
                      per_process_env: Optional[
                          Sequence[Optional[Dict[str, str]]]] = None,
                      kill_on: Optional[str] = None, kill_target: int = 1,
-                     grace_s: float = 3.0,
+                     grace_s: float = 3.0, trace_dir: Optional[str] = None,
+                     debug_sleep_ms: int = 0, debug_sleep_target: int = 1,
                      python: str = sys.executable) -> PodResult:
     """Run `payload` (python source) as an `n_procs` localhost CPU pod.
 
@@ -183,10 +184,46 @@ def launch_local_pod(payload: str, *, n_procs: int = 2,
     `kill_on`/`kill_target`: when the marker substring appears on ANY
     child's stdout, SIGKILL child `kill_target` — the chaos hook the
     RoundCheckpoint resume smoke drives. The launch then reports
-    ok=False with error "chaos-killed", and the caller relaunches."""
+    ok=False with error "chaos-killed", and the caller relaunches.
+
+    `trace_dir` turns the pod flight recorder on (TMOG_PODTRACE=1,
+    per-rank artifacts under `trace_dir/rank-<k>/` — see
+    parallel/podtrace.py). With a trace dir the reaper stops being
+    blind: both the deadline kill and the dead-coordinator kill read
+    every rank's heartbeat file and name the likely straggler — rank,
+    last-known round and phase, beat age — in the returned error.
+    `debug_sleep_ms`/`debug_sleep_target` inject a per-round stall into
+    one rank (the chaos straggler the ci.sh pod stage asserts on)."""
     port = free_port()
     children: List[_Child] = []
     chaos_fired = threading.Event()
+    if trace_dir is not None:
+        extra_env = dict(extra_env or {})
+        extra_env.setdefault("TMOG_PODTRACE", "1")
+        extra_env["TMOG_PODTRACE_DIR"] = str(trace_dir)
+    if debug_sleep_ms and trace_dir is not None:
+        ppe: List[Optional[Dict[str, str]]] = [
+            dict(per_process_env[i]) if per_process_env
+            and i < len(per_process_env) and per_process_env[i] else {}
+            for i in range(n_procs)]
+        if 0 <= debug_sleep_target < n_procs:
+            ppe[debug_sleep_target]["TMOG_PODTRACE_DEBUG_SLEEP_MS"] = \
+                str(int(debug_sleep_ms))
+        per_process_env = ppe
+    hb_dir = trace_dir if trace_dir is not None else \
+        (extra_env or {}).get("TMOG_PODTRACE_DIR")
+
+    def straggler_note(rcs) -> str:
+        """Heartbeat-derived blame table appended to reaper errors —
+        empty string when no flight recorder ran."""
+        if not hb_dir:
+            return ""
+        try:
+            from . import podtrace
+            text, _ = podtrace.straggler_table(hb_dir, rcs=rcs)
+            return "\n" + text if text else ""
+        except Exception:
+            return ""
 
     def on_line(pid: int, line: str) -> None:
         if kill_on and kill_on in line and not chaos_fired.is_set():
@@ -220,7 +257,7 @@ def launch_local_pod(payload: str, *, n_procs: int = 2,
             now = time.monotonic()
             if now >= deadline:
                 error = error or (f"pod timeout after {timeout:.0f}s; "
-                                  f"rcs={rcs}")
+                                  f"rcs={rcs}" + straggler_note(rcs))
                 for c in children:
                     c.kill()
                 deadline = now + 10.0  # bounded reap wait post-kill
@@ -234,14 +271,20 @@ def launch_local_pod(payload: str, *, n_procs: int = 2,
             if (failed is not None or coordinator_gone) \
                     and grace_until is None:
                 grace_until = now + grace_s
+                # first cause wins: a child found dead AFTER the
+                # deadline kill is the reaper's own SIGKILL, not a new
+                # root cause — it must not clobber the timeout error
+                # (which carries the heartbeat blame table)
                 if failed is not None:
-                    error = (f"child {failed} exited rc={rcs[failed]}"
-                             + (" (chaos-killed)"
-                                if chaos_fired.is_set() else ""))
+                    error = error or (
+                        f"child {failed} exited rc={rcs[failed]}"
+                        + (" (chaos-killed)"
+                           if chaos_fired.is_set() else ""))
             if grace_until is not None and now >= grace_until:
                 if error is None and any(rc is None for rc in rcs):
                     error = (f"coordinator exited rc={rcs[0]} with "
-                             f"children still running; rcs={rcs}")
+                             f"children still running; rcs={rcs}"
+                             + straggler_note(rcs))
                 if error is not None:
                     for c in children:
                         c.kill()

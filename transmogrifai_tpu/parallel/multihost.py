@@ -141,6 +141,14 @@ def initialize(coordinator_address: Optional[str] = None,
                                process_id=process_id)
     _initialized = True
     try:
+        # pod flight recorder: per-rank TraceTree/EventLog + heartbeat
+        # into TMOG_PODTRACE_DIR/rank-<k>/ (no-op unless TMOG_PODTRACE)
+        from . import podtrace
+        podtrace.start(process_id=int(process_id),
+                       processes=int(num_processes))
+    except Exception:
+        pass  # telemetry must never break distributed bring-up
+    try:
         from ..utils.metrics import collector
         if collector.enabled:
             collector.event(
@@ -163,6 +171,14 @@ def finalize() -> None:
     global _initialized
     if not _initialized:
         return
+    try:
+        # save this rank's flight-recorder artifacts while every peer
+        # is still alive (a rank that dies before here leaves a torn
+        # dir, which merge_pod degrades to a partial report)
+        from . import podtrace
+        podtrace.finish()
+    except Exception:
+        pass
     import jax
     try:
         jax.distributed.shutdown()
@@ -371,7 +387,9 @@ def row_layout(n_local: int, mesh) -> RowLayout:
     The uniform block length is the max padded count, rounded up to this
     host's share of the mesh batch axis."""
     pc = process_count()
-    counts = allgather_counts(n_local)
+    from . import podtrace
+    with podtrace.collective("row_layout", procs=pc, rows=int(n_local)):
+        counts = allgather_counts(n_local)
     try:
         n_batch = int(dict(mesh.shape).get(BATCH_AXIS, 1))
     except Exception:

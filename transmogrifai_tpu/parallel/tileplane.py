@@ -580,7 +580,7 @@ class _Consumer:
     consumer loop and the kill-switch synchronous fallback."""
 
     def __init__(self, step, first_tile, sink, stats: TilePlaneStats,
-                 traced: bool, anchor, carry0):
+                 traced: bool, anchor, carry0, multiproc: bool = False):
         self.step = step
         self.first_tile = first_tile
         self.sink = sink
@@ -588,6 +588,7 @@ class _Consumer:
         self.traced = traced
         self.anchor = anchor
         self.carry = carry0
+        self.multiproc = multiproc
         self._pending: Optional[Tuple[Any, int]] = None
 
     def feed(self, dev, n_valid: int, k: int) -> None:
@@ -620,6 +621,14 @@ class _Consumer:
             collector.trace.add_complete(
                 "tile_compute", "tile", dur, parent_span=self.anchor,
                 tile=k, rows=int(n_valid), label=self.stats.label)
+            if self.multiproc:
+                # the step's cross-process psum merge is inside this
+                # already-measured block window — attribute it to the
+                # pod collective ledger without a second clock read
+                from . import podtrace
+                podtrace.note_collective(
+                    "tile_merge", dur, tile=k, rows=int(n_valid),
+                    label=self.stats.label)
         # tmoglint: disable=THR001  consumer-owned (see compute_seconds)
         self.stats.tiles += 1
         # tmoglint: disable=THR001  consumer-owned (see compute_seconds)
@@ -665,8 +674,10 @@ def _run_sync(source: RowSource, step, carry0, *, tile_rows: int,
     import jax
 
     from ..utils.metrics import collector
+    multiproc = bool(shardings) and any(
+        not getattr(s, "is_fully_addressable", True) for s in shardings)
     consumer = _Consumer(step, first_tile, sink, stats, traced, anchor,
-                         carry0)
+                         carry0, multiproc=multiproc)
     for k, (tile, n_valid) in enumerate(
             iter_fixed_tiles(source, tile_rows, stats)):
         t0 = time.perf_counter()

@@ -322,6 +322,67 @@ def _harvest_tile_spans(spans: List[Mapping[str, Any]], backend: str,
     return out
 
 
+#: pod-span kind -> family: the flight-recorder span families
+#: (parallel/podtrace.py) harvested per (site, round) occurrence. The
+#: route is the bracket's `site` attr (glm_round, tree_fit, tile_merge,
+#: stats_fetch, ...) and the shape always carries ``procs`` — pod
+#: evidence is keyed per process count so a 2-process collective wall
+#: never informs a single-process decision at the same geometry.
+_POD_SPAN_FAMILIES = {
+    "pod_collective": "pod_collective",
+    "pod_compute": "pod_compute",
+    "pod_ingest": "pod_ingest",
+}
+
+
+def harvest_pod_spans(spans: List[Mapping[str, Any]], backend: str, *,
+                      procs: int, src: str = "podtrace"
+                      ) -> List[PlanRecord]:
+    """Plan records from one rank's pod_* spans, aggregated per
+    (kind, site) over the whole fit — summed wall over summed rows, the
+    same per-pass unit-cost shape _harvest_tile_spans uses, because one
+    traced fit emits one bracket per engine round and the planner needs
+    the fit-level cost, not per-round noise. Unknown kinds/sites skip
+    silently (best-effort harvest contract)."""
+    agg: Dict[tuple, List[float]] = {}
+    shapes: Dict[tuple, Dict[str, float]] = {}
+    for s in spans:
+        if not isinstance(s, dict):
+            continue
+        family = _POD_SPAN_FAMILIES.get(str(s.get("kind") or ""))
+        if family is None:
+            continue
+        wall = float(s.get("duration_seconds") or 0.0)
+        if wall <= 0.0:
+            continue
+        attrs = s.get("attrs") or {}
+        site = str(attrs.get("site") or "")
+        if not site:
+            continue
+        slot = agg.setdefault((family, site), [0.0, 0.0, 0.0])
+        slot[0] += wall
+        rows = attrs.get("rows")
+        slot[1] += float(rows) if isinstance(rows, (int, float)) else 0.0
+        slot[2] += 1.0
+        shp = shapes.setdefault((family, site), {})
+        for k in ("feat", "lanes", "depth", "folds", "cols"):
+            v = attrs.get(k)
+            if isinstance(v, (int, float)):
+                # max over occurrences: buckets shrink as lanes retire,
+                # so the widest bracket names the fit's geometry
+                shp[k] = max(shp.get(k, 0.0), float(v))
+    out: List[PlanRecord] = []
+    for (family, site), (wall, rows, count) in agg.items():
+        shape = {"procs": float(int(procs)), "spans": count}
+        if rows > 0.0:
+            shape["rows"] = rows
+        shape.update(shapes.get((family, site), {}))
+        out.append(PlanRecord(
+            family=family, backend=backend, route=site, shape=shape,
+            wall_s=wall, work=rows or count, src=src))
+    return out
+
+
 def harvest_metrics_file(path: str, backend: str,
                          src: str = "harvest") -> List[PlanRecord]:
     """harvest_metrics_doc over a JSON file; unreadable/unparseable

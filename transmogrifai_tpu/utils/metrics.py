@@ -72,6 +72,24 @@ def hbm_roof_gbps(device_kind: str) -> Optional[float]:
     return next((r for s, r in HBM_ROOF_GBPS if s in kind), None)
 
 
+# Peak dense-compute roof (GFLOP/s, bf16 matmul peak per chip) by
+# device-kind substring — the denominator of the pod flight recorder's
+# MFU column (parallel/podtrace.py). Sources: published per-chip peak
+# compute specs for each TPU generation. Same substring-match contract
+# as HBM_ROOF_GBPS: most specific first, None off-TPU.
+FLOPS_ROOF_GFLOPS = [("v6e", 918000.0), ("v6", 918000.0),
+                     ("v5p", 459000.0), ("v5", 197000.0),
+                     ("v4", 275000.0), ("v3", 123000.0),
+                     ("v2", 45000.0)]
+
+
+def flops_roof_gflops(device_kind: str) -> Optional[float]:
+    """Peak-compute roof for a jax device_kind string, or None when the
+    generation is unknown (CPU hosts, new hardware)."""
+    kind = (device_kind or "").lower()
+    return next((r for s, r in FLOPS_ROOF_GFLOPS if s in kind), None)
+
+
 def roofline_fields(wall_seconds: float, bytes_hbm: float,
                     roof_gbps: Optional[float]) -> Dict[str, Any]:
     """THE achieved-GB/s / %-of-roof arithmetic, shared by every

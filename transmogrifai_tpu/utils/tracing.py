@@ -49,7 +49,7 @@ __all__ = [
     "register_jit_fallback", "device_memory_attrs", "chrome_trace",
     "write_chrome_trace", "trace_report", "trace_report_rc",
     "event_log_paths", "iter_events", "requests_report",
-    "requests_report_rc",
+    "requests_report_rc", "fmt_table",
 ]
 
 # the monitoring event one XLA backend compilation emits (jax >= 0.4.x).
@@ -75,7 +75,10 @@ class Span:
     span_id: int
     parent_id: Optional[int]
     name: str
-    kind: str               # run|workflow|layer|stage|kernel|sweep|sweep_round
+    kind: str               # run|workflow|layer|stage|kernel|sweep|
+                            # sweep_round|tile|pod_round|pod_compute|
+                            # pod_collective|pod_ingest (pod_* families:
+                            # parallel/podtrace.py span glossary)
     t_start: float
     t_end: Optional[float] = None
     attrs: Dict[str, Any] = field(default_factory=dict)
@@ -955,7 +958,11 @@ def _check_event_log(paths: List[str]
     return n, problems, counts
 
 
-def _fmt_table(rows: List[List[str]], header: List[str]) -> List[str]:
+def fmt_table(rows: List[List[str]], header: List[str]) -> List[str]:
+    """Left-justified fixed-width text table — the one formatter every
+    report surface shares (trace-report, trace-report --requests,
+    trace-report --pod via parallel/podtrace.py, the fleet status
+    table) so their column alignment cannot drift apart."""
     if not rows:
         return ["(empty)"]
     widths = [max(len(str(r[i])) for r in [header] + rows)
@@ -964,6 +971,9 @@ def _fmt_table(rows: List[List[str]], header: List[str]) -> List[str]:
     for r in rows:
         out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
     return out
+
+
+_fmt_table = fmt_table  # pre-pod-tracing private spelling, still imported
 
 
 def trace_report_rc(run_dir: str, check: bool = False,
